@@ -1,0 +1,17 @@
+"""localai_tpu — a TPU-native (JAX/XLA/Pallas/pjit) inference-serving framework.
+
+Brand-new implementation of the capability surface of LocalAI (reference:
+Quickkill0/LocalAI, mounted at /root/reference), re-designed TPU-first:
+
+- one persistent in-process JAX engine per slice instead of per-model gRPC
+  subprocesses (reference: pkg/model/process.go:93 spawns one binary per model);
+- "loading a model" = sharding weights over a `jax.sharding.Mesh` and compiling
+  prefill/decode programs, not exec()ing a backend binary;
+- the LRU watchdog (reference: pkg/model/watchdog.go:22) evicts weights from
+  HBM rather than killing processes;
+- parallelism (tensor/data/expert/sequence) is mesh-axis configuration
+  compiled into XLA collectives over ICI, not NCCL/MPI or llama.cpp RPC
+  (reference: core/p2p/p2p.go, grpc-server.cpp:331-352).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,10 @@
+"""Model families.
+
+Registry mapping architecture-family names to their JAX builders, the moral
+equivalent of the reference's backend dispatch table
+(/root/reference/pkg/model/initializers.go:20-37 alias table) — except every
+family compiles into the same persistent engine instead of spawning a
+per-model subprocess.
+"""
+
+from localai_tpu.models.config import ArchConfig, PRESETS, get_arch  # noqa: F401

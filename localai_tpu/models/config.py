@@ -1,0 +1,158 @@
+"""Architecture configs for decoder-only transformer families.
+
+The reference delegates architecture to llama.cpp GGUF metadata
+(/root/reference/core/config/gguf.go:15-60 introspects a GGUF to guess context
+size and layout). Here architectures are first-class dataclasses so the JAX
+model builders, the sharding planner (localai_tpu.parallel.sharding), and the
+engine all agree on shapes statically — XLA requires static shapes to tile
+matmuls onto the MXU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ArchConfig:
+    """Shape/hyperparameter description of a Llama-family decoder.
+
+    Covers Llama 2/3, Mistral, Qwen2 (qkv biases), TinyLlama and friends —
+    the same families the reference serves through llama.cpp GGUFs.
+    """
+
+    name: str = "llama"
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: Optional[int] = None  # defaults to hidden_size // num_heads
+    rope_theta: float = 10000.0
+    rope_scaling: Optional[str] = None  # None | "linear" | "llama3"
+    rope_scaling_factor: float = 1.0
+    # llama3-style rope scaling extras
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_position: int = 8192
+    max_position: int = 8192
+    rms_eps: float = 1e-5
+    tie_embeddings: bool = False
+    attn_qkv_bias: bool = False  # Qwen2-style
+    # Mixture-of-experts (Mixtral/DeepSeek-style); 0 experts = dense MLP
+    num_experts: int = 0
+    num_experts_per_token: int = 2
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim if self.head_dim is not None else self.hidden_size // self.num_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+
+# ---------------------------------------------------------------------------
+# Presets. Shapes match the public model cards for the configs listed in
+# /root/repo/BASELINE.json; weights are loaded from local safetensors when
+# available or randomly initialized for benchmarking.
+# ---------------------------------------------------------------------------
+
+PRESETS: dict[str, ArchConfig] = {
+    # Tiny configs for tests / CI on the virtual CPU mesh.
+    "tiny": ArchConfig(
+        name="tiny",
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        max_position=256,
+        rope_theta=10000.0,
+    ),
+    "tiny-moe": ArchConfig(
+        name="tiny-moe",
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        max_position=256,
+        num_experts=4,
+        num_experts_per_token=2,
+    ),
+    "llama-3.2-1b": ArchConfig(
+        name="llama-3.2-1b",
+        vocab_size=128256,
+        hidden_size=2048,
+        intermediate_size=8192,
+        num_layers=16,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=64,
+        rope_theta=500000.0,
+        rope_scaling="llama3",
+        rope_scaling_factor=32.0,
+        max_position=131072,
+        tie_embeddings=True,
+    ),
+    "llama-3-8b": ArchConfig(
+        name="llama-3-8b",
+        vocab_size=128256,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        rope_theta=500000.0,
+        max_position=8192,
+    ),
+    "mistral-7b": ArchConfig(
+        name="mistral-7b",
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        rope_theta=10000.0,
+        max_position=32768,
+    ),
+    "qwen2-7b": ArchConfig(
+        name="qwen2-7b",
+        vocab_size=152064,
+        hidden_size=3584,
+        intermediate_size=18944,
+        num_layers=28,
+        num_heads=28,
+        num_kv_heads=4,
+        rope_theta=1000000.0,
+        max_position=32768,
+        attn_qkv_bias=True,
+    ),
+    "mixtral-8x7b": ArchConfig(
+        name="mixtral-8x7b",
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        rope_theta=1000000.0,
+        max_position=32768,
+        num_experts=8,
+        num_experts_per_token=2,
+    ),
+}
+
+
+def get_arch(name: str) -> ArchConfig:
+    try:
+        return PRESETS[name]
+    except KeyError:
+        raise KeyError(f"unknown architecture preset {name!r}; known: {sorted(PRESETS)}") from None

@@ -1,0 +1,229 @@
+"""Llama-family decoder (Llama 2/3, Mistral, Qwen2, TinyLlama; MoE variant for
+Mixtral) as pure-functional JAX.
+
+Design (TPU-first, not a llama.cpp translation):
+- Parameters are a pytree of stacked per-layer weights ([L, ...] leading axis)
+  and the forward pass is a single `lax.scan` over layers — one traced layer
+  body regardless of depth, which keeps compile time flat for 80-layer models
+  and lets XLA pipeline HBM weight streaming against MXU compute.
+- Two entry points: `prefill` (dense causal attention over a bucketed prompt)
+  and `decode_step` (one token per active slot against the slot KV cache).
+  These are the programs the engine jits with shardings; the reference's
+  equivalent split is llama.cpp's prompt-processing vs token-generation phases
+  (timings surfaced at backend/backend.proto:169-170).
+- GQA, RoPE (linear/llama3 scaling), RMSNorm, SwiGLU; optional qkv bias
+  (Qwen2) and sparse-MoE MLP (Mixtral) chosen statically from ArchConfig.
+
+Weight-name parity with HF checkpoints is handled in io.py (safetensors
+loader), not here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from localai_tpu.models.config import ArchConfig
+from localai_tpu.ops.attention import causal_prefill_attention, decode_attention
+from localai_tpu.ops.norm import rms_norm
+from localai_tpu.ops.rope import apply_rope, rope_frequencies
+
+Params = dict[str, Any]
+
+
+class KVCache(NamedTuple):
+    """Slot KV cache: one contiguous region per batch slot.
+
+    k, v: [L, B_slots, S_max, K_heads, head_dim]. Slot occupancy/lengths are
+    tracked by the engine; shapes stay static under jit.
+    """
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    @staticmethod
+    def zeros(cfg: ArchConfig, num_slots: int, max_seq: int, dtype=None) -> "KVCache":
+        dtype = jnp.dtype(cfg.dtype) if dtype is None else dtype
+        shape = (cfg.num_layers, num_slots, max_seq, cfg.num_kv_heads, cfg.head_dim_)
+        return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def _dtype(cfg: ArchConfig):
+    return jnp.dtype(cfg.dtype)
+
+
+def init_params(cfg: ArchConfig, key: jnp.ndarray, scale: float = 0.02) -> Params:
+    """Random init with HF-compatible tree structure (stacked layers)."""
+    dt = _dtype(cfg)
+    L, D, F = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    H, K, Hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    keys = iter(jax.random.split(key, 16))
+
+    def rnd(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    layers: Params = {
+        "attn_norm": jnp.ones((L, D), dt),
+        "wq": rnd(next(keys), (L, D, H * Hd)),
+        "wk": rnd(next(keys), (L, D, K * Hd)),
+        "wv": rnd(next(keys), (L, D, K * Hd)),
+        "wo": rnd(next(keys), (L, H * Hd, D)),
+        "mlp_norm": jnp.ones((L, D), dt),
+    }
+    if cfg.attn_qkv_bias:
+        layers["bq"] = jnp.zeros((L, H * Hd), dt)
+        layers["bk"] = jnp.zeros((L, K * Hd), dt)
+        layers["bv"] = jnp.zeros((L, K * Hd), dt)
+    if cfg.is_moe:
+        E = cfg.num_experts
+        layers["router"] = rnd(next(keys), (L, D, E))
+        layers["w_gate"] = rnd(next(keys), (L, E, D, F))
+        layers["w_up"] = rnd(next(keys), (L, E, D, F))
+        layers["w_down"] = rnd(next(keys), (L, E, F, D))
+    else:
+        layers["w_gate"] = rnd(next(keys), (L, D, F))
+        layers["w_up"] = rnd(next(keys), (L, D, F))
+        layers["w_down"] = rnd(next(keys), (L, F, D))
+
+    params: Params = {
+        "embed": rnd(next(keys), (cfg.vocab_size, D)),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), dt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = rnd(next(keys), (cfg.vocab_size, D))
+    return params
+
+
+def _mlp(cfg: ArchConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """SwiGLU MLP; dense or sparse-MoE (Mixtral-style top-k routing).
+
+    x: [..., D]. The MoE branch computes all experts and combines with routing
+    weights — correct and mesh-shardable on the expert axis; the
+    all_to_all dispatch optimization lives in localai_tpu.parallel.
+    """
+    if not cfg.is_moe:
+        gate = jax.nn.silu(x @ lp["w_gate"])
+        return ((gate * (x @ lp["w_up"])) @ lp["w_down"]).astype(x.dtype)
+
+    E, topk = cfg.num_experts, cfg.num_experts_per_token
+    router_logits = (x @ lp["router"]).astype(jnp.float32)  # [..., E]
+    weights, sel = jax.lax.top_k(router_logits, topk)  # [..., topk]
+    weights = jax.nn.softmax(weights, axis=-1)
+    onehot = jax.nn.one_hot(sel, E, dtype=jnp.float32)  # [..., topk, E]
+    combine = jnp.einsum("...te,...t->...e", onehot, weights)
+    gate = jax.nn.silu(jnp.einsum("...d,edf->...ef", x, lp["w_gate"]))
+    up = jnp.einsum("...d,edf->...ef", x, lp["w_up"])
+    expert_out = jnp.einsum("...ef,efd->...ed", gate * up, lp["w_down"])  # [..., E, D]
+    return jnp.einsum("...ed,...e->...d", expert_out.astype(jnp.float32), combine).astype(x.dtype)
+
+
+def _attn_proj_qkv(cfg: ArchConfig, lp: Params, x: jnp.ndarray):
+    """x: [..., D] -> q [..., H, Hd], k/v [..., K, Hd]."""
+    H, K, Hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    q = x @ lp["wq"]
+    k = x @ lp["wk"]
+    v = x @ lp["wv"]
+    if cfg.attn_qkv_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.reshape(*x.shape[:-1], H, Hd)
+    k = k.reshape(*x.shape[:-1], K, Hd)
+    v = v.reshape(*x.shape[:-1], K, Hd)
+    return q, k, v
+
+
+def _unembed(cfg: ArchConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
+    w = params["embed"] if cfg.tie_embeddings else params["lm_head"]
+    return (h.astype(jnp.float32) @ w.astype(jnp.float32).T)
+
+
+def prefill(
+    cfg: ArchConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [B, S] int32, right-padded
+    lengths: jnp.ndarray,  # [B] int32 valid lengths
+):
+    """Prompt processing. Returns (last_logits [B, V] f32, k [L,B,S,K,Hd], v)."""
+    B, S = tokens.shape
+    inv_freq = rope_frequencies(cfg)
+    positions = jnp.arange(S)[None, :].repeat(B, axis=0)  # [B, S]
+    length_mask = jnp.arange(S)[None, :] < lengths[:, None]
+
+    h = params["embed"][tokens]  # [B, S, D]
+
+    def layer(h, lp):
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _attn_proj_qkv(cfg, lp, x)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        attn = causal_prefill_attention(q, k, v, length_mask)
+        attn = attn.reshape(B, S, -1) @ lp["wo"]
+        h = h + attn
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
+        h = h + _mlp(cfg, lp, x)
+        return h, (k, v)
+
+    h, (ks, vs) = jax.lax.scan(layer, h, params["layers"])
+    h = rms_norm(h, params["final_norm"], cfg.rms_eps)
+
+    last = jnp.take_along_axis(h, (lengths - 1)[:, None, None], axis=1)[:, 0]  # [B, D]
+    logits = _unembed(cfg, params, last)
+    return logits, ks, vs
+
+
+def decode_step(
+    cfg: ArchConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [B] int32 current token per slot
+    positions: jnp.ndarray,  # [B] int32 position of `tokens` in each sequence
+    cache: KVCache,
+):
+    """One decode step for the whole slot batch.
+
+    Writes the new k/v at `positions` and attends over [0, positions]. Returns
+    (logits [B, V] f32, new_cache). The engine jits this with the cache donated
+    so XLA updates it in place in HBM.
+    """
+    B = tokens.shape[0]
+    inv_freq = rope_frequencies(cfg)
+    h = params["embed"][tokens]  # [B, D]
+    cache_len = positions + 1
+    batch_idx = jnp.arange(B)
+
+    def layer(h, xs):
+        lp, kc, vc = xs
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _attn_proj_qkv(cfg, lp, x)  # q [B,H,Hd], k/v [B,K,Hd]
+        q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
+        k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
+        kc = kc.at[batch_idx, positions].set(k.astype(kc.dtype))
+        vc = vc.at[batch_idx, positions].set(v.astype(vc.dtype))
+        attn = decode_attention(q, kc, vc, cache_len)
+        h = h + attn.reshape(B, -1) @ lp["wo"]
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
+        h = h + _mlp(cfg, lp, x)
+        return h, (kc, vc)
+
+    h, (ks, vs) = jax.lax.scan(layer, h, (params["layers"], cache.k, cache.v))
+    h = rms_norm(h, params["final_norm"], cfg.rms_eps)
+    logits = _unembed(cfg, params, h)
+    return logits, KVCache(k=ks, v=vs)
+
+
+def write_prefill_to_cache(
+    cache: KVCache,
+    ks: jnp.ndarray,  # [L, B_new, S, K, Hd] from prefill
+    vs: jnp.ndarray,
+    slot: jnp.ndarray,  # scalar int32 — destination slot for batch row 0
+) -> KVCache:
+    """Copy a prefilled request's k/v into its slot (batch row 0 only).
+
+    jit-friendly: dynamic_update_slice along the slot axis.
+    """
+    k = jax.lax.dynamic_update_slice(cache.k, ks[:, :1], (0, slot, 0, 0, 0))
+    v = jax.lax.dynamic_update_slice(cache.v, vs[:, :1], (0, slot, 0, 0, 0))
+    return KVCache(k=k, v=v)

@@ -1,0 +1,5 @@
+"""TPU compute ops: norms, rotary embeddings, attention, sampling, KV cache.
+
+Everything here is shape-static and jit-traceable; control flow uses lax
+primitives so XLA can fuse and tile onto the MXU/VPU.
+"""

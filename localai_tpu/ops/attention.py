@@ -1,0 +1,75 @@
+"""Attention ops: batched causal prefill and single-token decode against a
+slot KV cache.
+
+Design notes (TPU-first):
+- Prefill attention is a dense causal softmax-attention over the bucketed
+  prompt length. XLA fuses the mask+softmax chain; a Pallas flash-attention
+  kernel (localai_tpu.ops.flash) can be swapped in for long buckets.
+- Decode attention reads the whole slot cache [B, S_max, K, H] with a length
+  mask. This is the JAX equivalent of llama.cpp's unified KV cache read in
+  its slot loop (reference: backend/cpp/llama-cpp/grpc-server.cpp:679
+  PredictStream -> server slots); instead of per-slot pointers we use one
+  dense cache and mask, which keeps shapes static under jit.
+- GQA: queries have H heads, cache has K kv-heads, H % K == 0; we reshape
+  queries to [B, K, H//K, ...] and broadcast the cache.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def causal_prefill_attention(
+    q: jnp.ndarray,  # [B, S, H, D]
+    k: jnp.ndarray,  # [B, S, K, D]
+    v: jnp.ndarray,  # [B, S, K, D]
+    length_mask: jnp.ndarray | None = None,  # [B, S] bool, True = valid token
+) -> jnp.ndarray:
+    """Dense causal attention for prompt processing. Returns [B, S, H, D]."""
+    B, S, H, D = q.shape
+    K = k.shape[2]
+    G = H // K
+    scale = 1.0 / (D**0.5)
+
+    qf = q.astype(jnp.float32).reshape(B, S, K, G, D)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+
+    # scores: [B, K, G, S_q, S_k]
+    scores = jnp.einsum("bqkgd,bskd->bkgqs", qf, kf) * scale
+    causal = jnp.tril(jnp.ones((S, S), dtype=bool))
+    mask = causal[None, None, None, :, :]
+    if length_mask is not None:
+        mask = jnp.logical_and(mask, length_mask[:, None, None, None, :])
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", probs, vf)
+    return out.reshape(B, S, H, D).astype(q.dtype)
+
+
+def decode_attention(
+    q: jnp.ndarray,  # [B, H, D] query for the single new token per slot
+    k_cache: jnp.ndarray,  # [B, S_max, K, D]
+    v_cache: jnp.ndarray,  # [B, S_max, K, D]
+    cache_len: jnp.ndarray,  # [B] int32: number of valid cache entries (incl. current token)
+) -> jnp.ndarray:
+    """Single-step attention against the slot cache. Returns [B, H, D]."""
+    B, H, D = q.shape
+    S = k_cache.shape[1]
+    K = k_cache.shape[2]
+    G = H // K
+    scale = 1.0 / (D**0.5)
+
+    qf = q.astype(jnp.float32).reshape(B, K, G, D)
+    kf = k_cache.astype(jnp.float32)
+    vf = v_cache.astype(jnp.float32)
+
+    scores = jnp.einsum("bkgd,bskd->bkgs", qf, kf) * scale  # [B, K, G, S]
+    valid = jnp.arange(S)[None, :] < cache_len[:, None]  # [B, S]
+    scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs, vf)
+    return out.reshape(B, H, D).astype(q.dtype)

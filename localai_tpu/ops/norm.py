@@ -1,0 +1,19 @@
+"""RMSNorm, computed in float32 for stability and cast back.
+
+Reference equivalent: ggml's rms_norm inside llama.cpp (vendored by
+backend/cpp/llama-cpp). XLA fuses this into the surrounding matmuls, so no
+Pallas kernel is needed for it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(dtype)

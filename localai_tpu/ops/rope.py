@@ -1,0 +1,47 @@
+"""Rotary position embeddings with linear and llama3 frequency scaling.
+
+The reference forwards rope knobs to llama.cpp (core/config/model_config.go:231-237
+`rope_scaling`, `rope_freq_base`); here the same knobs select the frequency
+schedule used by the JAX model. Frequencies are computed once per call in
+float32; XLA constant-folds them under jit when positions are traced but the
+config is static.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from localai_tpu.models.config import ArchConfig
+
+
+def rope_frequencies(cfg: ArchConfig) -> jnp.ndarray:
+    """Per-pair inverse frequencies [head_dim/2], float32."""
+    hd = cfg.head_dim_
+    inv_freq = 1.0 / (cfg.rope_theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    if cfg.rope_scaling == "linear":
+        inv_freq = inv_freq / cfg.rope_scaling_factor
+    elif cfg.rope_scaling == "llama3":
+        # Llama-3.1/3.2 long-context NTK-by-parts scaling.
+        low_wavelen = cfg.rope_original_max_position / cfg.rope_low_freq_factor
+        high_wavelen = cfg.rope_original_max_position / cfg.rope_high_freq_factor
+        wavelen = 2.0 * math.pi / inv_freq
+        scaled = inv_freq / cfg.rope_scaling_factor
+        smooth = (cfg.rope_original_max_position / wavelen - cfg.rope_low_freq_factor) / (
+            cfg.rope_high_freq_factor - cfg.rope_low_freq_factor
+        )
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        mid = (1.0 - smooth) * scaled + smooth * inv_freq
+        inv_freq = jnp.where(wavelen > low_wavelen, scaled, jnp.where(wavelen < high_wavelen, inv_freq, mid))
+    return inv_freq
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray) -> jnp.ndarray:
+    """Rotate half-pairs. x: [..., seq, heads, head_dim], positions: [..., seq]."""
+    angles = positions[..., :, None].astype(jnp.float32) * inv_freq  # [..., seq, hd/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., seq, 1, hd/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,133 @@
+"""Batched, per-slot parameterized token sampling.
+
+The reference's sampler lives inside llama.cpp (params parsed at
+backend/cpp/llama-cpp/grpc-server.cpp:118 parse_options: temperature, top_k,
+top_p, min_p, repeat/presence/frequency penalties, seed, logit bias). Here the
+whole chain is one jitted function over the decode batch: every slot carries
+its own sampling parameters as array entries, so one compiled program serves
+heterogeneous requests (no recompile per request — that is the continuous-
+batching contract).
+
+Grammar-constrained decoding plugs in through `logit_bias`: the engine writes
+-inf outside the grammar-allowed token set (reference equivalent: GBNF
+sampling inside llama.cpp, pkg/functions grammar generation).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+class SamplingParams(NamedTuple):
+    """Per-slot sampling parameters; every field has shape [B]."""
+
+    temperature: jnp.ndarray  # f32; <= 0 means greedy
+    top_k: jnp.ndarray  # i32; 0 disables
+    top_p: jnp.ndarray  # f32; >= 1 disables
+    min_p: jnp.ndarray  # f32; 0 disables
+    repeat_penalty: jnp.ndarray  # f32; 1.0 disables (llama.cpp semantics)
+    presence_penalty: jnp.ndarray  # f32; 0 disables
+    frequency_penalty: jnp.ndarray  # f32; 0 disables
+
+    @staticmethod
+    def make(
+        batch: int,
+        temperature=0.0,
+        top_k=0,
+        top_p=1.0,
+        min_p=0.0,
+        repeat_penalty=1.0,
+        presence_penalty=0.0,
+        frequency_penalty=0.0,
+    ) -> "SamplingParams":
+        full = lambda v, dt: jnp.full((batch,), v, dtype=dt)
+        return SamplingParams(
+            temperature=full(temperature, jnp.float32),
+            top_k=full(top_k, jnp.int32),
+            top_p=full(top_p, jnp.float32),
+            min_p=full(min_p, jnp.float32),
+            repeat_penalty=full(repeat_penalty, jnp.float32),
+            presence_penalty=full(presence_penalty, jnp.float32),
+            frequency_penalty=full(frequency_penalty, jnp.float32),
+        )
+
+
+def apply_penalties(
+    logits: jnp.ndarray,  # [B, V] f32
+    counts: jnp.ndarray,  # [B, V] i32 — occurrences of each token so far (prompt+generated)
+    params: SamplingParams,
+) -> jnp.ndarray:
+    seen = counts > 0
+    rp = params.repeat_penalty[:, None]
+    penalized = jnp.where(logits > 0, logits / rp, logits * rp)
+    logits = jnp.where(seen, penalized, logits)
+    logits = logits - params.presence_penalty[:, None] * seen.astype(jnp.float32)
+    logits = logits - params.frequency_penalty[:, None] * counts.astype(jnp.float32)
+    return logits
+
+
+def _filter_sorted(sorted_logits: jnp.ndarray, params: SamplingParams) -> jnp.ndarray:
+    """Apply top-k / top-p / min-p masks on descending-sorted logits [B, V]."""
+    B, V = sorted_logits.shape
+    ranks = jnp.arange(V)[None, :]
+
+    k = jnp.where(params.top_k <= 0, V, params.top_k)[:, None]
+    keep = ranks < k
+
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Keep tokens until the cumulative mass *before* this token reaches top_p
+    # (always keeps the first token).
+    keep_p = (cum - probs) < params.top_p[:, None]
+    keep = jnp.logical_and(keep, keep_p)
+
+    max_prob = probs[:, :1]
+    keep_mp = probs >= params.min_p[:, None] * max_prob
+    keep = jnp.logical_and(keep, keep_mp)
+
+    keep = keep.at[:, 0].set(True)  # never mask everything
+    return jnp.where(keep, sorted_logits, NEG_INF)
+
+
+def sample(
+    logits: jnp.ndarray,  # [B, V] any float dtype
+    rng: jnp.ndarray,  # [B] batch of PRNG keys (jax.random.key dtype)
+    params: SamplingParams,
+    counts: jnp.ndarray | None = None,  # [B, V] i32
+    logit_bias: jnp.ndarray | None = None,  # [B, V] f32 (grammar masks, user bias)
+) -> jnp.ndarray:
+    """Sample one token per slot. Returns [B] int32."""
+    logits = logits.astype(jnp.float32)
+    if counts is not None:
+        logits = apply_penalties(logits, counts, params)
+    if logit_bias is not None:
+        logits = logits + logit_bias
+
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # llama.cpp chain order: top-k/top-p/min-p filter on unscaled logits,
+    # temperature last — so the kept support is temperature-independent.
+    sorted_logits, sorted_idx = jax.lax.top_k(logits, logits.shape[-1])
+    filtered = _filter_sorted(sorted_logits, params)
+    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
+    filtered = jnp.where(filtered <= NEG_INF, NEG_INF, filtered / temp)
+
+    def draw(key, row):
+        return jax.random.categorical(key, row)
+
+    pos = jax.vmap(draw)(rng, filtered)
+    sampled_tok = jnp.take_along_axis(sorted_idx, pos[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+    return jnp.where(params.temperature <= 0.0, greedy_tok, sampled_tok)
+
+
+def update_counts(counts: jnp.ndarray, tokens: jnp.ndarray, active: jnp.ndarray) -> jnp.ndarray:
+    """counts[b, tokens[b]] += 1 for active slots. All shapes static."""
+    B = counts.shape[0]
+    inc = active.astype(counts.dtype)
+    return counts.at[jnp.arange(B), tokens].add(inc)

@@ -1,0 +1,18 @@
+"""Parallelism: device meshes, sharding plans, and collective patterns.
+
+TPU-native replacement for the reference's delegated parallelism
+(llama.cpp `tensor_split` across GPUs, vLLM `tensor_parallel_size`, llama.cpp
+RPC layer split over libp2p tunnels — SURVEY.md §2.5). Here every strategy is
+a mesh axis:
+
+  dp — data/batch parallel (request-level)
+  tp — tensor parallel (Megatron column/row splits over ICI)
+  ep — expert parallel (MoE expert axis)
+  sp — sequence/context parallel (ring attention for long context)
+
+XLA inserts the collectives (psum/all_gather/reduce_scatter/ppermute) from the
+shardings; nothing here opens a socket.
+"""
+
+from localai_tpu.parallel.mesh import MeshPlan, build_mesh  # noqa: F401
+from localai_tpu.parallel.sharding import param_shardings, cache_shardings  # noqa: F401

@@ -1,0 +1,100 @@
+"""Sharding plans for Llama-family parameters, KV caches, and activations.
+
+Megatron-style tensor parallel mapped onto a named mesh:
+- attention q/k/v projections: column-parallel (heads split over "tp")
+- attention output projection: row-parallel
+- MLP gate/up: column-parallel; down: row-parallel
+- embeddings / lm_head: vocab-parallel (logits all-gathered by XLA only at
+  the sampling boundary)
+- MoE expert weights: expert axis over "ep" (falls back to "tp" when ep==1
+  so Mixtral still tensor-parallelizes inside each expert)
+- KV cache: kv-heads over "tp", slots over "dp"
+
+The reference reaches the same goals by passing `tensor_split` to llama.cpp
+(grpc-server.cpp:493-496) or `tensor_parallel_size` to vLLM
+(backend/python/vllm/backend.py:106-107); here the plan is explicit
+PartitionSpecs and XLA compiles the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from localai_tpu.models.config import ArchConfig
+
+Params = dict[str, Any]
+
+
+def _layer_specs(cfg: ArchConfig) -> dict[str, P]:
+    # Leading axis of every layer param is the stacked layer dim (never sharded:
+    # lax.scan iterates over it).
+    specs: dict[str, P] = {
+        "attn_norm": P(None, None),
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "mlp_norm": P(None, None),
+    }
+    if cfg.attn_qkv_bias:
+        specs["bq"] = P(None, "tp")
+        specs["bk"] = P(None, "tp")
+        specs["bv"] = P(None, "tp")
+    if cfg.is_moe:
+        specs["router"] = P(None, None, None)
+        specs["w_gate"] = P(None, "ep", None, "tp")
+        specs["w_up"] = P(None, "ep", None, "tp")
+        specs["w_down"] = P(None, "ep", "tp", None)
+    else:
+        specs["w_gate"] = P(None, None, "tp")
+        specs["w_up"] = P(None, None, "tp")
+        specs["w_down"] = P(None, "tp", None)
+    return specs
+
+
+def param_specs(cfg: ArchConfig) -> Params:
+    specs: Params = {
+        "embed": P("tp", None),
+        "layers": _layer_specs(cfg),
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P("tp", None)
+    return specs
+
+
+def param_shardings(cfg: ArchConfig, mesh: Mesh) -> Params:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def cache_specs() -> tuple[P, P]:
+    # [L, B_slots, S_max, K, Hd]: slots over dp, kv heads over tp.
+    spec = P(None, "dp", None, "tp", None)
+    return spec, spec
+
+
+def cache_shardings(mesh: Mesh) -> tuple[NamedSharding, NamedSharding]:
+    ks, vs = cache_specs()
+    return NamedSharding(mesh, ks), NamedSharding(mesh, vs)
+
+
+def validate_plan(cfg: ArchConfig, tp: int, ep: int = 1) -> None:
+    """Fail fast on shapes that cannot shard evenly (XLA would pad silently)."""
+    if cfg.num_kv_heads % tp != 0:
+        raise ValueError(
+            f"num_kv_heads={cfg.num_kv_heads} not divisible by tp={tp}; "
+            f"choose tp in divisors of kv heads for {cfg.name}"
+        )
+    if cfg.num_heads % tp != 0:
+        raise ValueError(f"num_heads={cfg.num_heads} not divisible by tp={tp}")
+    if cfg.intermediate_size % tp != 0:
+        raise ValueError(f"intermediate_size={cfg.intermediate_size} not divisible by tp={tp}")
+    if cfg.is_moe and cfg.num_experts % ep != 0:
+        raise ValueError(f"num_experts={cfg.num_experts} not divisible by ep={ep}")

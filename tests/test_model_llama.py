@@ -1,0 +1,109 @@
+"""Model-level tests: prefill/decode consistency, GQA, MoE, sharded execution.
+
+Mirrors the reference's model-smoke tier (SURVEY.md §4, Makefile
+test-llama-gguf) but runs on the virtual CPU mesh with tiny random models, so
+it is hermetic and exercises real sharding.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from localai_tpu.models import get_arch
+from localai_tpu.models.llama import (
+    KVCache,
+    decode_step,
+    init_params,
+    prefill,
+    write_prefill_to_cache,
+)
+from localai_tpu.parallel import MeshPlan, build_mesh, param_shardings
+from localai_tpu.parallel.sharding import validate_plan
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = get_arch("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def test_prefill_shapes(tiny):
+    cfg, params = tiny
+    tokens = jnp.array([[1, 2, 3, 4, 0, 0, 0, 0], [5, 6, 0, 0, 0, 0, 0, 0]], jnp.int32)
+    lengths = jnp.array([4, 2], jnp.int32)
+    logits, ks, vs = prefill(cfg, params, tokens, lengths)
+    assert logits.shape == (2, cfg.vocab_size)
+    assert ks.shape == (cfg.num_layers, 2, 8, cfg.num_kv_heads, cfg.head_dim_)
+    assert jnp.isfinite(logits).all()
+
+
+def test_padding_invariance(tiny):
+    """Right-padding must not change the last-token logits."""
+    cfg, params = tiny
+    toks = [7, 8, 9]
+    t1 = jnp.array([toks + [0] * 5], jnp.int32)
+    t2 = jnp.array([toks + [0] * 13], jnp.int32)
+    l = jnp.array([3], jnp.int32)
+    logits1, _, _ = prefill(cfg, params, t1, l)
+    logits2, _, _ = prefill(cfg, params, t2, l)
+    assert jnp.allclose(logits1, logits2, atol=2e-2), float(jnp.abs(logits1 - logits2).max())
+
+
+def test_decode_matches_prefill(tiny):
+    """Greedy decode token-by-token must match prefilling the whole sequence.
+
+    This is the core correctness invariant of the KV cache path.
+    """
+    cfg, params = tiny
+    seq = [3, 14, 15, 9, 2, 6]
+    S = 16
+    num_slots = 2
+
+    # Full-prefill logits for the whole sequence.
+    full = jnp.array([seq + [0] * (S - len(seq))], jnp.int32)
+    ref_logits, _, _ = prefill(cfg, params, full, jnp.array([len(seq)], jnp.int32))
+
+    # Prefill the first 3 tokens, then decode the rest one-by-one.
+    boot = 3
+    pre = jnp.array([seq[:boot] + [0] * (S - boot)], jnp.int32)
+    logits, ks, vs = prefill(cfg, params, pre, jnp.array([boot], jnp.int32))
+    cache = KVCache.zeros(cfg, num_slots, S, dtype=ks.dtype)
+    cache = write_prefill_to_cache(cache, ks, vs, jnp.int32(0))
+
+    for i in range(boot, len(seq)):
+        toks = jnp.array([seq[i], 0], jnp.int32)  # slot 1 idle
+        pos = jnp.array([i, 0], jnp.int32)
+        logits_d, cache = decode_step(cfg, params, toks, pos, cache)
+
+    assert jnp.allclose(logits_d[0], ref_logits[0], atol=5e-2), float(
+        jnp.abs(logits_d[0] - ref_logits[0]).max()
+    )
+
+
+def test_moe_forward():
+    cfg = get_arch("tiny-moe")
+    params = init_params(cfg, jax.random.key(1))
+    tokens = jnp.array([[1, 2, 3, 4]], jnp.int32)
+    logits, _, _ = prefill(cfg, params, tokens, jnp.array([4], jnp.int32))
+    assert logits.shape == (1, cfg.vocab_size)
+    assert jnp.isfinite(logits).all()
+
+
+def test_sharded_prefill_matches_single(devices8, tiny):
+    """tp=2 x dp=2 sharded prefill must produce the same logits as unsharded."""
+    cfg, params = tiny
+    validate_plan(cfg, tp=2)
+    mesh = build_mesh(MeshPlan(dp=2, tp=2))
+    shardings = param_shardings(cfg, mesh)
+    sharded_params = jax.device_put(params, shardings)
+
+    tokens = jnp.array(
+        [[1, 2, 3, 4, 0, 0, 0, 0], [9, 8, 7, 0, 0, 0, 0, 0]], jnp.int32
+    )
+    lengths = jnp.array([4, 3], jnp.int32)
+
+    ref, _, _ = prefill(cfg, params, tokens, lengths)
+    fn = jax.jit(lambda p, t, l: prefill(cfg, p, t, l)[0])
+    out = fn(sharded_params, tokens, lengths)
+    assert jnp.allclose(out, ref, atol=5e-2), float(jnp.abs(out - ref).max())
