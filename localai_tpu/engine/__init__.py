@@ -1,0 +1,12 @@
+"""Serving engine: the persistent per-slice JAX process.
+
+TPU-native inversion of the reference's process model: instead of spawning one
+gRPC subprocess per model (reference: pkg/model/process.go:93), a single
+resident engine owns the devices; "loading a model" shards weights over the
+mesh and compiles prefill/decode programs, and requests are multiplexed onto
+KV-cache slots (the JAX equivalent of llama.cpp's server slots,
+backend/cpp/llama-cpp/grpc-server.cpp:679 PredictStream → slot queue).
+"""
+
+from localai_tpu.engine.engine import Engine, EngineConfig, GenRequest  # noqa: F401
+from localai_tpu.engine.tokenizer import ByteTokenizer, load_tokenizer  # noqa: F401

@@ -1,0 +1,496 @@
+"""Continuous-batching serving engine.
+
+The JAX re-design of llama.cpp's server slot machinery (reference:
+backend/cpp/llama-cpp/grpc-server.cpp:679 PredictStream posts server_tasks
+into a slot-based queue; vendored server-context start_loop is the hot loop).
+Key differences, TPU-first:
+
+- One resident engine owns the devices. Requests are multiplexed onto a fixed
+  number of KV-cache *slots*; all shapes are static so the decode program
+  compiles exactly once.
+- Prompt lengths are bucketed (powers of two) so prefill compiles once per
+  bucket, never per request.
+- The whole per-step chain — layer stack, KV write, attention, penalties,
+  top-k/p filtering, sampling — is one jitted program; per-slot sampling
+  parameters ride in as [B] arrays, so heterogeneous requests share one
+  compiled step (no recompilation, no host round-trip inside the chain).
+- KV cache, token-count table and PRNG state are donated on every step: XLA
+  updates them in place in HBM.
+- Streaming is UTF-8-safe incremental detokenization mirroring the byte
+  reassembly at core/backend/llm.go:146-166.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from collections import deque
+from functools import partial
+from typing import Any, Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.models import llama
+from localai_tpu.models.config import ArchConfig
+from localai_tpu.ops.sampling import SamplingParams, sample
+from localai_tpu.parallel.mesh import MeshPlan, build_mesh
+from localai_tpu.parallel.sharding import cache_shardings, param_shardings, validate_plan
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    max_slots: int = 8
+    max_seq: int = 2048
+    min_prefill_bucket: int = 32
+    base_seed: int = 0
+
+    def buckets(self) -> list[int]:
+        out, b = [], self.min_prefill_bucket
+        while b < self.max_seq:
+            out.append(b)
+            b *= 2
+        out.append(self.max_seq)
+        return out
+
+
+@dataclasses.dataclass
+class GenRequest:
+    prompt_ids: list[int]
+    max_new_tokens: int = 128
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    min_p: float = 0.0
+    repeat_penalty: float = 1.0
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    stop: list[str] = dataclasses.field(default_factory=list)
+    seed: Optional[int] = None
+    ignore_eos: bool = False
+    logit_bias: dict[int, float] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class TokenEvent:
+    kind: str  # "token" | "done" | "error"
+    text: str = ""
+    token_id: int = -1
+    finish_reason: Optional[str] = None  # "stop" | "length"
+    error: Optional[str] = None
+    # Filled on "done", mirroring Reply timing fields (backend.proto:169-170).
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    timing_prompt_processing: float = 0.0  # seconds (TTFT component)
+    timing_token_generation: float = 0.0
+
+
+class RequestHandle:
+    """Streaming consumer side of a submitted request."""
+
+    def __init__(self) -> None:
+        self._q: "queue.Queue[TokenEvent]" = queue.Queue()
+        self.cancelled = threading.Event()
+
+    def __iter__(self) -> Iterator[TokenEvent]:
+        while True:
+            ev = self._q.get()
+            yield ev
+            if ev.kind in ("done", "error"):
+                return
+
+    def cancel(self) -> None:
+        self.cancelled.set()
+
+    def result(self) -> tuple[str, TokenEvent]:
+        """Drain the stream; returns (full text, final event)."""
+        parts: list[str] = []
+        final = TokenEvent(kind="error", error="empty stream")
+        for ev in self:
+            if ev.kind == "token":
+                parts.append(ev.text)
+            final = ev
+        if final.kind == "error":
+            raise RuntimeError(final.error)
+        return "".join(parts), final
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: GenRequest
+    handle: RequestHandle
+    prompt_len: int
+    generated: list[int] = dataclasses.field(default_factory=list)
+    emitted_len: int = 0  # chars of decoded text already streamed
+    t_submit: float = 0.0
+    t_first: float = 0.0
+    done: bool = False
+
+
+class Engine:
+    """Persistent multi-slot generation engine for one loaded model."""
+
+    def __init__(
+        self,
+        cfg: ArchConfig,
+        params: Any,
+        tokenizer,
+        mesh_plan: Optional[MeshPlan] = None,
+        engine_cfg: Optional[EngineConfig] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ) -> None:
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        self.ecfg = engine_cfg or EngineConfig()
+        ndev = len(devices) if devices is not None else len(jax.devices())
+        self.plan = mesh_plan or MeshPlan(dp=1, tp=1)
+        validate_plan(cfg, self.plan.tp, self.plan.ep)
+        self.mesh = build_mesh(self.plan, devices)
+
+        B, S, V = self.ecfg.max_slots, self.ecfg.max_seq, cfg.vocab_size
+        with self.mesh:
+            pshard = param_shardings(cfg, self.mesh)
+            self.params = jax.tree.map(
+                lambda a, s: jax.device_put(a, s), params, pshard
+            )
+            kshard, vshard = cache_shardings(self.mesh)
+            self.cache = llama.KVCache(
+                k=jax.device_put(
+                    jnp.zeros((cfg.num_layers, B, S, cfg.num_kv_heads, cfg.head_dim_), jnp.dtype(cfg.dtype)),
+                    kshard,
+                ),
+                v=jax.device_put(
+                    jnp.zeros((cfg.num_layers, B, S, cfg.num_kv_heads, cfg.head_dim_), jnp.dtype(cfg.dtype)),
+                    vshard,
+                ),
+            )
+        self.counts = jnp.zeros((B, V), jnp.int32)
+        self.rngs = jax.random.split(jax.random.key(self.ecfg.base_seed), B)
+        self.bias = jnp.zeros((B, V), jnp.float32)
+
+        # Host-side control state (numpy, device_put'd per step — tiny arrays).
+        self.h_tokens = np.zeros((B,), np.int32)
+        self.h_positions = np.zeros((B,), np.int32)
+        self.h_active = np.zeros((B,), bool)
+        self.h_sampling = {
+            "temperature": np.zeros((B,), np.float32),
+            "top_k": np.zeros((B,), np.int32),
+            "top_p": np.ones((B,), np.float32),
+            "min_p": np.zeros((B,), np.float32),
+            "repeat_penalty": np.ones((B,), np.float32),
+            "presence_penalty": np.zeros((B,), np.float32),
+            "frequency_penalty": np.zeros((B,), np.float32),
+        }
+        self.slots: list[Optional[_Slot]] = [None] * B
+
+        self._pending: deque[tuple[GenRequest, RequestHandle]] = deque()
+        self._pending_lock = threading.Lock()
+        self._wake = threading.Event()
+        self._shutdown = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        # Metrics (reference: GetMetrics RPC, backend/backend.proto:39-47).
+        self.m_prompt_tokens = 0
+        self.m_generated_tokens = 0
+        self._decode_time = 0.0
+        self._decode_tokens = 0
+
+        self._build_programs()
+
+    # ------------------------------------------------------------------ #
+    # Compiled programs
+    # ------------------------------------------------------------------ #
+
+    def _build_programs(self) -> None:
+        cfg = self.cfg
+
+        @partial(jax.jit, static_argnames=())
+        def _prefill(params, tokens, lengths):
+            return llama.prefill(cfg, params, tokens, lengths)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def _insert(cache, counts, ks, vs, slot, prompt_counts):
+            cache = llama.write_prefill_to_cache(cache, ks, vs, slot)
+            counts = counts.at[slot].set(prompt_counts)
+            return cache, counts
+
+        @partial(jax.jit, donate_argnums=(3,))
+        def _first_sample(logits, rng, sampling, counts_row, bias_row):
+            tok = sample(logits, rng[None], sampling, counts_row, bias_row)
+            counts_row = counts_row.at[0, tok[0]].add(1)
+            return tok[0], counts_row
+
+        @partial(jax.jit, donate_argnums=(1, 2, 3))
+        def _decode(params, cache, counts, rngs, bias, tokens, positions, active, sampling):
+            logits, cache = llama.decode_step(cfg, params, tokens, positions, cache)
+            split = jax.vmap(lambda k: jax.random.split(k, 2))(rngs)
+            rngs, draw = split[:, 0], split[:, 1]
+            nxt = sample(logits, draw, sampling, counts, bias)
+            counts = counts.at[jnp.arange(tokens.shape[0]), nxt].add(active.astype(jnp.int32))
+            nxt = jnp.where(active, nxt, 0)
+            return nxt, cache, counts, rngs
+
+        @partial(jax.jit)
+        def _embed(params, tokens, lengths):
+            return llama.encode(cfg, params, tokens, lengths)
+
+        self._prefill_fn = _prefill
+        self._insert_fn = _insert
+        self._first_sample_fn = _first_sample
+        self._decode_fn = _decode
+        self._embed_fn = _embed
+
+    # ------------------------------------------------------------------ #
+    # Public API
+    # ------------------------------------------------------------------ #
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True, name="engine-loop")
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def submit(self, request: GenRequest) -> RequestHandle:
+        if not request.prompt_ids:
+            raise ValueError("empty prompt")
+        limit = self.ecfg.max_seq - 1
+        if len(request.prompt_ids) > limit:
+            request.prompt_ids = request.prompt_ids[-limit:]
+        handle = RequestHandle()
+        with self._pending_lock:
+            self._pending.append((request, handle))
+        self._wake.set()
+        self.start()
+        return handle
+
+    def generate(self, prompt_ids: list[int], **kw) -> tuple[str, TokenEvent]:
+        return self.submit(GenRequest(prompt_ids=list(prompt_ids), **kw)).result()
+
+    def embed(self, ids_batch: list[list[int]]) -> np.ndarray:
+        """Batched sentence embeddings [N, D] (L2-normalized)."""
+        S = self._bucket_for(max(len(x) for x in ids_batch))
+        N = len(ids_batch)
+        toks = np.zeros((N, S), np.int32)
+        lens = np.zeros((N,), np.int32)
+        for i, ids in enumerate(ids_batch):
+            ids = ids[: S]
+            toks[i, : len(ids)] = ids
+            lens[i] = len(ids)
+        return np.asarray(self._embed_fn(self.params, toks, lens))
+
+    def metrics(self) -> dict[str, float]:
+        tps = self._decode_tokens / self._decode_time if self._decode_time > 0 else 0.0
+        return {
+            "prompt_tokens_processed": float(self.m_prompt_tokens),
+            "tokens_generated": float(self.m_generated_tokens),
+            "tokens_per_second": tps,
+            "active_slots": float(int(self.h_active.sum())),
+            "queue_depth": float(len(self._pending)),
+        }
+
+    def warmup(self, prompt_len: int = 8) -> None:
+        """Compile prefill (smallest bucket) + decode before serving."""
+        _, ev = self.generate([1] * prompt_len, max_new_tokens=2)
+        assert ev.kind == "done"
+
+    # ------------------------------------------------------------------ #
+    # Engine loop
+    # ------------------------------------------------------------------ #
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.ecfg.buckets():
+            if n <= b:
+                return b
+        return self.ecfg.max_seq
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def _loop(self) -> None:
+        while not self._shutdown.is_set():
+            admitted = self._admit_pending()
+            if self.h_active.any():
+                self._step()
+            elif not admitted:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+
+    def _admit_pending(self) -> bool:
+        admitted = False
+        while True:
+            slot_idx = self._free_slot()
+            if slot_idx is None:
+                return admitted
+            with self._pending_lock:
+                if not self._pending:
+                    return admitted
+                request, handle = self._pending.popleft()
+            if handle.cancelled.is_set():
+                handle._q.put(TokenEvent(kind="done", finish_reason="stop"))
+                continue
+            try:
+                self._admit(slot_idx, request, handle)
+                admitted = True
+            except Exception as e:  # noqa: BLE001 — surface to the caller, keep serving
+                handle._q.put(TokenEvent(kind="error", error=f"{type(e).__name__}: {e}"))
+
+    def _admit(self, slot_idx: int, request: GenRequest, handle: RequestHandle) -> None:
+        t0 = time.monotonic()
+        ids = request.prompt_ids
+        bucket = self._bucket_for(len(ids))
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, : len(ids)] = ids
+        lens = np.array([len(ids)], np.int32)
+
+        logits, ks, vs = self._prefill_fn(self.params, toks, lens)
+
+        prompt_counts = np.zeros((self.cfg.vocab_size,), np.int32)
+        np.add.at(prompt_counts, np.asarray(ids, np.int64), 1)
+        self.cache, self.counts = self._insert_fn(
+            self.cache, self.counts, ks, vs, jnp.int32(slot_idx), prompt_counts
+        )
+
+        # Per-slot control state.
+        r = request
+        row = {
+            "temperature": r.temperature, "top_k": r.top_k, "top_p": r.top_p,
+            "min_p": r.min_p, "repeat_penalty": r.repeat_penalty,
+            "presence_penalty": r.presence_penalty, "frequency_penalty": r.frequency_penalty,
+        }
+        for k, v in row.items():
+            self.h_sampling[k][slot_idx] = v
+        seed = r.seed if r.seed is not None else (self.ecfg.base_seed + slot_idx + 1)
+        self.rngs = self.rngs.at[slot_idx].set(jax.random.key(seed))
+        bias_row = np.zeros((1, self.cfg.vocab_size), np.float32)
+        for tid, b in r.logit_bias.items():
+            if 0 <= int(tid) < self.cfg.vocab_size:
+                bias_row[0, int(tid)] = b
+        self.bias = self.bias.at[slot_idx].set(bias_row[0])
+
+        # First token comes from the prefill logits.
+        sampling1 = SamplingParams.make(1, **row)
+        key = jax.random.fold_in(jax.random.key(seed), 0)
+        tok, counts_row = self._first_sample_fn(
+            logits, key, sampling1, self.counts[slot_idx][None], self.bias[slot_idx][None]
+        )
+        self.counts = self.counts.at[slot_idx].set(counts_row[0])
+        tok = int(tok)
+
+        slot = _Slot(request=request, handle=handle, prompt_len=len(ids), t_submit=t0)
+        slot.t_first = time.monotonic()
+        self.slots[slot_idx] = slot
+        self.h_tokens[slot_idx] = tok
+        self.h_positions[slot_idx] = len(ids)
+        self.h_active[slot_idx] = True
+        self.m_prompt_tokens += len(ids)
+        self._post_token(slot_idx, tok)
+
+    def _step(self) -> None:
+        t0 = time.monotonic()
+        sampling = SamplingParams(**{k: jnp.asarray(v) for k, v in self.h_sampling.items()})
+        nxt, self.cache, self.counts, self.rngs = self._decode_fn(
+            self.params, self.cache, self.counts, self.rngs, self.bias,
+            jnp.asarray(self.h_tokens), jnp.asarray(self.h_positions),
+            jnp.asarray(self.h_active), sampling,
+        )
+        nxt = np.asarray(nxt)
+        n_active = int(self.h_active.sum())
+        self._decode_time += time.monotonic() - t0
+        self._decode_tokens += n_active
+
+        for i in range(self.ecfg.max_slots):
+            if not self.h_active[i]:
+                continue
+            self.h_positions[i] += 1
+            tok = int(nxt[i])
+            self.h_tokens[i] = tok
+            self._post_token(i, tok)
+
+    def _post_token(self, slot_idx: int, tok: int) -> None:
+        """Append one generated token to a slot: stream text, check stops."""
+        slot = self.slots[slot_idx]
+        assert slot is not None
+        r, handle = slot.request, slot.handle
+        if handle.cancelled.is_set():
+            self._finish(slot_idx, "stop")
+            return
+
+        is_eos = (not r.ignore_eos) and tok in self.tokenizer.eos_ids
+        if not is_eos:
+            slot.generated.append(tok)
+            self.m_generated_tokens += 1
+
+        text = self.tokenizer.decode(slot.generated)
+        new = text[slot.emitted_len:]
+
+        # Stop-sequence scan over the un-emitted tail (+ held-back overlap).
+        finish: Optional[str] = None
+        if is_eos:
+            finish = "stop"
+        elif r.stop:
+            window_start = max(0, slot.emitted_len - max(len(s) for s in r.stop))
+            window = text[window_start:]
+            cut = None
+            for s in r.stop:
+                idx = window.find(s)
+                if idx >= 0:
+                    cut = window_start + idx if cut is None else min(cut, window_start + idx)
+            if cut is not None:
+                new = text[slot.emitted_len: cut]
+                finish = "stop"
+        if finish is None and (
+            len(slot.generated) >= r.max_new_tokens
+            or slot.prompt_len + len(slot.generated) >= self.ecfg.max_seq
+        ):
+            finish = "length"
+
+        if finish is None:
+            # Hold back partial UTF-8 (decoder emits U+FFFD for incomplete
+            # sequences — mirror of core/backend/llm.go:146-166) and any tail
+            # that could be the start of a stop sequence.
+            hold = 0
+            if new.endswith("�"):
+                hold = 1
+            if r.stop:
+                for s in r.stop:
+                    for k in range(min(len(s) - 1, len(new)), 0, -1):
+                        if new.endswith(s[:k]):
+                            hold = max(hold, k)
+                            break
+            if hold:
+                new = new[: len(new) - hold]
+
+        if new:
+            slot.emitted_len += len(new)
+            handle._q.put(TokenEvent(kind="token", text=new, token_id=tok))
+        if finish is not None:
+            self._finish(slot_idx, finish)
+
+    def _finish(self, slot_idx: int, reason: str) -> None:
+        slot = self.slots[slot_idx]
+        assert slot is not None
+        now = time.monotonic()
+        slot.handle._q.put(
+            TokenEvent(
+                kind="done",
+                finish_reason=reason,
+                prompt_tokens=slot.prompt_len,
+                completion_tokens=len(slot.generated),
+                timing_prompt_processing=slot.t_first - slot.t_submit,
+                timing_token_generation=now - slot.t_first,
+            )
+        )
+        self.slots[slot_idx] = None
+        self.h_active[slot_idx] = False

@@ -1,0 +1,107 @@
+"""Tokenizer abstraction.
+
+Two implementations behind one small interface:
+
+- `HFTokenizer`: wraps a local HuggingFace tokenizer directory (the reference's
+  `use_tokenizer_template` path hands templating/tokenization to the backend,
+  backend/python/vllm/backend.py chat-template usage; here it is first-class).
+- `ByteTokenizer`: dependency-free byte-level tokenizer used for tests and
+  synthetic benchmarks — no downloads needed in an egress-free environment.
+
+The engine only sees ids; all text handling (incremental UTF-8-safe decode,
+chat templates) flows through this interface.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    bos_id: int | None
+    eos_ids: tuple[int, ...]
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]: ...
+
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+    def token_strings(self) -> list[str]:
+        """Decoded string for every token id (for grammar-mask precompute)."""
+        ...
+
+
+class ByteTokenizer:
+    """UTF-8 byte-level tokenizer: id = byte value; specials above 255.
+
+    vocab_size defaults to 512 to match the "tiny" test architectures, leaving
+    ids [258, 512) unused.
+    """
+
+    PAD = 258
+
+    def __init__(self, vocab_size: int = 512):
+        self.vocab_size = vocab_size
+        self.bos_id: int | None = 256
+        self.eos_ids: tuple[int, ...] = (257,)
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def token_strings(self) -> list[str]:
+        out = []
+        for i in range(self.vocab_size):
+            out.append(chr(i) if i < 256 else "")
+        return out
+
+
+class HFTokenizer:
+    """Local HuggingFace tokenizer (no network access; path must exist)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.vocab_size = len(self._tok)
+        self.bos_id = self._tok.bos_token_id
+        eos = self._tok.eos_token_id
+        eos_ids = [eos] if isinstance(eos, int) else list(eos or [])
+        # Llama-3 style <|eot_id|> terminators if present.
+        for special in ("<|eot_id|>", "<|im_end|>", "<|end|>"):
+            tid = self._tok.convert_tokens_to_ids(special)
+            if tid is not None and tid >= 0 and tid not in eos_ids:
+                eos_ids.append(tid)
+        self.eos_ids = tuple(eos_ids)
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]:
+        ids = self._tok.encode(text, add_special_tokens=False)
+        if add_bos and self.bos_id is not None:
+            ids = [self.bos_id] + ids
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    def token_strings(self) -> list[str]:
+        return [self._tok.decode([i]) for i in range(self.vocab_size)]
+
+    @property
+    def chat_template(self) -> str | None:
+        return getattr(self._tok, "chat_template", None)
+
+    def apply_chat_template(self, messages, add_generation_prompt: bool = True) -> str:
+        return self._tok.apply_chat_template(
+            messages, tokenize=False, add_generation_prompt=add_generation_prompt
+        )
+
+
+def load_tokenizer(path: str | None, vocab_size: int = 512) -> Tokenizer:
+    """Factory: HF tokenizer when a local path is given, byte-level otherwise."""
+    if path:
+        return HFTokenizer(path)
+    return ByteTokenizer(vocab_size=vocab_size)

@@ -1,0 +1,182 @@
+"""Checkpoint loading: HF safetensors → stacked-layer JAX param tree.
+
+The reference consumes GGUF via llama.cpp (backend/cpp/llama-cpp) or HF
+checkpoints via torch backends (backend/python/transformers/backend.py). Here
+the canonical on-disk format is HF safetensors, mapped into the stacked
+[L, ...] layout that `localai_tpu.models.llama` scans over, and placed shard-
+by-shard onto the mesh so a 70B never materializes unsharded in host RAM.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.models.config import ArchConfig
+
+Params = dict[str, Any]
+
+# Our layer-param name -> HF per-layer tensor name (weights transposed: HF
+# linear stores [out, in]; our matmuls are x @ W with W [in, out]).
+_LAYER_MAP = {
+    "attn_norm": ("input_layernorm.weight", False),
+    "wq": ("self_attn.q_proj.weight", True),
+    "wk": ("self_attn.k_proj.weight", True),
+    "wv": ("self_attn.v_proj.weight", True),
+    "wo": ("self_attn.o_proj.weight", True),
+    "bq": ("self_attn.q_proj.bias", False),
+    "bk": ("self_attn.k_proj.bias", False),
+    "bv": ("self_attn.v_proj.bias", False),
+    "mlp_norm": ("post_attention_layernorm.weight", False),
+    "w_gate": ("mlp.gate_proj.weight", True),
+    "w_up": ("mlp.up_proj.weight", True),
+    "w_down": ("mlp.down_proj.weight", True),
+}
+
+_MOE_LAYER_MAP = {
+    "router": ("block_sparse_moe.gate.weight", True),
+    "w_gate": ("block_sparse_moe.experts.{e}.w1.weight", True),
+    "w_up": ("block_sparse_moe.experts.{e}.w3.weight", True),
+    "w_down": ("block_sparse_moe.experts.{e}.w2.weight", True),
+}
+
+
+def _index(ckpt_dir: str) -> dict[str, str]:
+    """tensor name -> safetensors shard filename."""
+    idx_path = os.path.join(ckpt_dir, "model.safetensors.index.json")
+    if os.path.exists(idx_path):
+        with open(idx_path) as f:
+            return json.load(f)["weight_map"]
+    single = os.path.join(ckpt_dir, "model.safetensors")
+    if not os.path.exists(single):
+        raise FileNotFoundError(f"no safetensors checkpoint under {ckpt_dir}")
+    from safetensors import safe_open
+
+    with safe_open(single, framework="numpy") as f:
+        return {name: "model.safetensors" for name in f.keys()}
+
+
+class _ShardReader:
+    """Lazily-opened safetensors shards with a tensor-name index."""
+
+    def __init__(self, ckpt_dir: str):
+        self.dir = ckpt_dir
+        self.weight_map = _index(ckpt_dir)
+        self._open: dict[str, Any] = {}
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.weight_map
+
+    def get(self, name: str) -> np.ndarray:
+        from safetensors import safe_open
+
+        fname = self.weight_map[name]
+        if fname not in self._open:
+            self._open[fname] = safe_open(os.path.join(self.dir, fname), framework="numpy")
+        return self._open[fname].get_tensor(name)
+
+
+def load_hf_checkpoint(
+    cfg: ArchConfig,
+    ckpt_dir: str,
+    put: Callable[[str, np.ndarray], jnp.ndarray] | None = None,
+) -> Params:
+    """Load an HF-format Llama-family checkpoint into the stacked param tree.
+
+    `put(path, np_array) -> device array` lets the caller place each tensor
+    with its target sharding as it is read (engine passes a mesh-aware
+    device_put); default is plain jnp.asarray in cfg.dtype.
+    """
+    dt = jnp.dtype(cfg.dtype)
+    reader = _ShardReader(ckpt_dir)
+    if put is None:
+        put = lambda path, arr: jnp.asarray(arr, dt)
+
+    def grab(name: str, transpose: bool) -> np.ndarray:
+        arr = reader.get(name)
+        if transpose and arr.ndim == 2:
+            arr = arr.T
+        return np.ascontiguousarray(arr)
+
+    def stack_layers(our: str, hf_suffix: str, transpose: bool) -> np.ndarray:
+        rows = [
+            grab(f"model.layers.{i}.{hf_suffix}", transpose) for i in range(cfg.num_layers)
+        ]
+        return np.stack(rows)
+
+    layers: Params = {}
+    layer_map = dict(_LAYER_MAP)
+    if cfg.is_moe:
+        for k in ("w_gate", "w_up", "w_down"):
+            layer_map.pop(k)
+    for our, (suffix, transpose) in layer_map.items():
+        probe = f"model.layers.0.{suffix}"
+        if probe not in reader:
+            continue  # optional tensors (qkv bias)
+        layers[our] = put(f"layers/{our}", stack_layers(our, suffix, transpose))
+
+    if cfg.is_moe:
+        layers["router"] = put(
+            "layers/router", stack_layers("router", _MOE_LAYER_MAP["router"][0], True)
+        )
+        for our in ("w_gate", "w_up", "w_down"):
+            suffix, transpose = _MOE_LAYER_MAP[our]
+            per_layer = []
+            for i in range(cfg.num_layers):
+                experts = [
+                    grab(f"model.layers.{i}.{suffix.format(e=e)}", transpose)
+                    for e in range(cfg.num_experts)
+                ]
+                per_layer.append(np.stack(experts))
+            layers[our] = put(f"layers/{our}", np.stack(per_layer))
+
+    params: Params = {
+        "embed": put("embed", grab("model.embed_tokens.weight", False)),
+        "layers": layers,
+        "final_norm": put("final_norm", grab("model.norm.weight", False)),
+    }
+    if not cfg.tie_embeddings:
+        name = "lm_head.weight"
+        if name in reader:
+            params["lm_head"] = put("lm_head", grab(name, False))
+        else:  # some checkpoints tie without declaring it
+            params["lm_head"] = params["embed"]
+    return params
+
+
+def arch_from_hf_config(ckpt_dir: str) -> ArchConfig:
+    """Build an ArchConfig from an HF config.json (llama/mistral/qwen2/mixtral)."""
+    with open(os.path.join(ckpt_dir, "config.json")) as f:
+        hf = json.load(f)
+    rope_scaling = hf.get("rope_scaling") or {}
+    scaling_type = rope_scaling.get("rope_type") or rope_scaling.get("type")
+    model_type = hf.get("model_type", "llama")
+    return ArchConfig(
+        name=hf.get("_name_or_path", model_type) or model_type,
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=hf.get("head_dim"),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rope_scaling=("llama3" if scaling_type == "llama3" else ("linear" if scaling_type else None)),
+        rope_scaling_factor=rope_scaling.get("factor", 1.0),
+        rope_low_freq_factor=rope_scaling.get("low_freq_factor", 1.0),
+        rope_high_freq_factor=rope_scaling.get("high_freq_factor", 4.0),
+        rope_original_max_position=rope_scaling.get(
+            "original_max_position_embeddings", hf.get("max_position_embeddings", 8192)
+        ),
+        max_position=hf.get("max_position_embeddings", 8192),
+        rms_eps=hf.get("rms_norm_eps", 1e-5),
+        tie_embeddings=hf.get("tie_word_embeddings", False),
+        attn_qkv_bias=(model_type == "qwen2"),
+        num_experts=hf.get("num_local_experts", 0),
+        num_experts_per_token=hf.get("num_experts_per_tok", 2),
+    )

@@ -141,13 +141,16 @@ def _unembed(cfg: ArchConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
     return (h.astype(jnp.float32) @ w.astype(jnp.float32).T)
 
 
-def prefill(
+def _forward_hidden(
     cfg: ArchConfig,
     params: Params,
     tokens: jnp.ndarray,  # [B, S] int32, right-padded
     lengths: jnp.ndarray,  # [B] int32 valid lengths
+    collect_kv: bool,
 ):
-    """Prompt processing. Returns (last_logits [B, V] f32, k [L,B,S,K,Hd], v)."""
+    """Shared full-sequence forward. Returns (h [B,S,D] after final norm,
+    length_mask [B,S], (ks, vs) or None). Single source of truth for the layer
+    body used by both `prefill` and `encode`."""
     B, S = tokens.shape
     inv_freq = rope_frequencies(cfg)
     positions = jnp.arange(S)[None, :].repeat(B, axis=0)  # [B, S]
@@ -161,18 +164,47 @@ def prefill(
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         attn = causal_prefill_attention(q, k, v, length_mask)
-        attn = attn.reshape(B, S, -1) @ lp["wo"]
-        h = h + attn
+        h = h + attn.reshape(B, S, -1) @ lp["wo"]
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
         h = h + _mlp(cfg, lp, x)
-        return h, (k, v)
+        return h, ((k, v) if collect_kv else None)
 
-    h, (ks, vs) = jax.lax.scan(layer, h, params["layers"])
+    h, kv = jax.lax.scan(layer, h, params["layers"])
     h = rms_norm(h, params["final_norm"], cfg.rms_eps)
+    return h, length_mask, kv
 
-    last = jnp.take_along_axis(h, (lengths - 1)[:, None, None], axis=1)[:, 0]  # [B, D]
+
+def prefill(
+    cfg: ArchConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [B, S] int32, right-padded
+    lengths: jnp.ndarray,  # [B] int32 valid lengths
+):
+    """Prompt processing. Returns (last_logits [B, V] f32, k [L,B,S,K,Hd], v)."""
+    h, _, (ks, vs) = _forward_hidden(cfg, params, tokens, lengths, collect_kv=True)
+    last_idx = jnp.maximum(lengths - 1, 0)  # empty prompt reads position 0, not wrap to S-1
+    last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]  # [B, D]
     logits = _unembed(cfg, params, last)
     return logits, ks, vs
+
+
+def encode(
+    cfg: ArchConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [B, S] int32, right-padded
+    lengths: jnp.ndarray,  # [B] int32
+) -> jnp.ndarray:
+    """Sentence embedding: masked mean-pool of final hidden states, L2-normed.
+
+    Serves the Embedding RPC capability (reference: backend/backend.proto
+    Embedding; backend/python/transformers SentenceTransformer branch) from the
+    same decoder weights.
+    """
+    h, length_mask, _ = _forward_hidden(cfg, params, tokens, lengths, collect_kv=False)
+    h = h.astype(jnp.float32)
+    mask = length_mask[..., None].astype(jnp.float32)
+    pooled = (h * mask).sum(axis=1) / jnp.maximum(mask.sum(axis=1), 1.0)
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
 
 
 def decode_step(

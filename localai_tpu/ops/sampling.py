@@ -72,22 +72,29 @@ def apply_penalties(
 
 
 def _filter_sorted(sorted_logits: jnp.ndarray, params: SamplingParams) -> jnp.ndarray:
-    """Apply top-k / top-p / min-p masks on descending-sorted logits [B, V]."""
+    """Apply top-k, then top-p, then min-p on descending-sorted logits [B, V].
+
+    Chain semantics match llama.cpp: each stage renormalizes over the
+    candidate set left by the previous stage (top-p mass is measured over the
+    post-top-k distribution, min-p against the surviving max-probability).
+    """
     B, V = sorted_logits.shape
     ranks = jnp.arange(V)[None, :]
 
     k = jnp.where(params.top_k <= 0, V, params.top_k)[:, None]
     keep = ranks < k
 
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    # Renormalized softmax over the top-k survivors (masked-out rows get 0).
+    probs = jax.nn.softmax(jnp.where(keep, sorted_logits, NEG_INF), axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     # Keep tokens until the cumulative mass *before* this token reaches top_p
     # (always keeps the first token).
     keep_p = (cum - probs) < params.top_p[:, None]
     keep = jnp.logical_and(keep, keep_p)
 
-    max_prob = probs[:, :1]
-    keep_mp = probs >= params.min_p[:, None] * max_prob
+    # min-p over the post-top-p survivors, renormalized.
+    probs = jax.nn.softmax(jnp.where(keep, sorted_logits, NEG_INF), axis=-1)
+    keep_mp = probs >= params.min_p[:, None] * probs[:, :1]
     keep = jnp.logical_and(keep, keep_mp)
 
     keep = keep.at[:, 0].set(True)  # never mask everything

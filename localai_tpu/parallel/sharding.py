@@ -96,5 +96,10 @@ def validate_plan(cfg: ArchConfig, tp: int, ep: int = 1) -> None:
         raise ValueError(f"num_heads={cfg.num_heads} not divisible by tp={tp}")
     if cfg.intermediate_size % tp != 0:
         raise ValueError(f"intermediate_size={cfg.intermediate_size} not divisible by tp={tp}")
+    if cfg.vocab_size % tp != 0:
+        raise ValueError(
+            f"vocab_size={cfg.vocab_size} not divisible by tp={tp} "
+            "(embed/lm_head are vocab-parallel)"
+        )
     if cfg.is_moe and cfg.num_experts % ep != 0:
         raise ValueError(f"num_experts={cfg.num_experts} not divisible by ep={ep}")

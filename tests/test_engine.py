@@ -1,0 +1,171 @@
+"""Engine tests: continuous batching, streaming, stops, sampling, slot reuse.
+
+The reference has no in-repo harness for its slot machinery (it lives in
+vendored llama.cpp); here the engine is first-class and tested hermetically
+on the virtual CPU mesh (SURVEY.md §4 last row).
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from localai_tpu.engine import ByteTokenizer, Engine, EngineConfig, GenRequest
+from localai_tpu.models import get_arch
+from localai_tpu.models.llama import init_params, prefill
+from localai_tpu.parallel.mesh import MeshPlan
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = get_arch("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    eng = Engine(
+        cfg,
+        params,
+        ByteTokenizer(cfg.vocab_size),
+        engine_cfg=EngineConfig(max_slots=4, max_seq=128, min_prefill_bucket=16),
+    )
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def test_greedy_deterministic(engine):
+    text1, ev1 = engine.generate([65, 66, 67], max_new_tokens=12, ignore_eos=True)
+    text2, ev2 = engine.generate([65, 66, 67], max_new_tokens=12, ignore_eos=True)
+    assert text1 == text2
+    assert ev1.completion_tokens == 12
+    assert ev1.finish_reason == "length"
+    assert ev1.prompt_tokens == 3
+    assert ev1.timing_prompt_processing > 0
+
+
+def test_greedy_matches_prefill_logits(engine):
+    """Each greedily-decoded token must equal argmax of a fresh full prefill."""
+    prompt = [10, 20, 30, 40]
+    text, ev = engine.generate(prompt, max_new_tokens=5, ignore_eos=True)
+    cfg = engine.cfg
+    seq = list(prompt)
+    import jax.numpy as jnp
+
+    for step in range(5):
+        toks = jnp.array([seq + [0] * (32 - len(seq))], jnp.int32)
+        logits, _, _ = prefill(cfg, engine.params, toks, jnp.array([len(seq)], jnp.int32))
+        nxt = int(jnp.argmax(logits[0]))
+        seq.append(nxt)
+    expected = engine.tokenizer.decode(seq[len(prompt):])
+    assert text == expected
+
+
+def test_concurrent_batching(engine):
+    """More requests than slots; all complete, greedy results stay correct."""
+    ref, _ = engine.generate([65, 66], max_new_tokens=8, ignore_eos=True)
+    results = {}
+
+    def run(i):
+        if i % 2 == 0:
+            results[i] = engine.generate([65, 66], max_new_tokens=8, ignore_eos=True)[0]
+        else:
+            results[i] = engine.generate(
+                [70 + i], max_new_tokens=8, temperature=0.9, seed=i, ignore_eos=True
+            )[0]
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(10)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert len(results) == 10
+    for i in range(0, 10, 2):
+        assert results[i] == ref, f"greedy result changed under batching (req {i})"
+
+
+def test_seeded_sampling_reproducible(engine):
+    kw = dict(max_new_tokens=10, temperature=0.8, top_k=50, seed=1234, ignore_eos=True)
+    t1, _ = engine.generate([97, 98, 99], **kw)
+    t2, _ = engine.generate([97, 98, 99], **kw)
+    assert t1 == t2
+
+
+def test_stop_sequence(engine):
+    # Find what greedy emits, then use a substring of it as a stop sequence.
+    full, _ = engine.generate([65, 66, 67], max_new_tokens=10, ignore_eos=True)
+    assert len(full) > 2
+    stop = full[2:4]
+    text, ev = engine.generate([65, 66, 67], max_new_tokens=10, ignore_eos=True, stop=[stop])
+    assert ev.finish_reason == "stop"
+    assert stop not in text
+    assert text == full[: full.index(stop)]
+
+
+def test_eos_stops(engine):
+    """Bias sampling so EOS is emitted immediately."""
+    eos = engine.tokenizer.eos_ids[0]
+    text, ev = engine.generate([65], max_new_tokens=10, logit_bias={eos: 1e9})
+    assert ev.finish_reason == "stop"
+    assert ev.completion_tokens == 0
+    assert text == ""
+
+
+def test_streaming_events(engine):
+    handle = engine.submit(GenRequest(prompt_ids=[72, 73], max_new_tokens=6, ignore_eos=True))
+    kinds = [ev.kind for ev in handle]
+    assert kinds[-1] == "done"
+    assert all(k == "token" for k in kinds[:-1])
+
+
+def test_metrics(engine):
+    before = engine.metrics()
+    engine.generate([1, 2, 3, 4], max_new_tokens=4, ignore_eos=True)
+    after = engine.metrics()
+    assert after["prompt_tokens_processed"] >= before["prompt_tokens_processed"] + 4
+    assert after["tokens_generated"] >= before["tokens_generated"] + 4
+    assert after["tokens_per_second"] > 0
+
+
+def test_embed(engine):
+    out = engine.embed([[1, 2, 3], [4, 5]])
+    assert out.shape == (2, engine.cfg.hidden_size)
+    norms = np.linalg.norm(out, axis=-1)
+    assert np.allclose(norms, 1.0, atol=1e-3)
+    # Embeddings are padding-invariant by construction (masked mean-pool).
+    again = engine.embed([[1, 2, 3]])
+    assert np.allclose(out[0], again[0], atol=1e-3)
+
+
+def test_long_prompt_truncated(engine):
+    ids = [65] * 500  # > max_seq=128
+    text, ev = engine.generate(ids, max_new_tokens=4, ignore_eos=True)
+    assert ev.prompt_tokens <= 127
+    assert ev.kind == "done"
+
+
+def test_sharded_engine(devices8):
+    """Engine over a dp=2 x tp=2 mesh: decode path must match full prefill
+    under the *same* sharding (greedy argmax can legitimately differ from the
+    unsharded run on a random model — float reassociation across tp shards —
+    so the invariant is self-consistency, like test_greedy_matches_prefill_logits)."""
+    import jax.numpy as jnp
+
+    cfg = get_arch("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    eng = Engine(
+        cfg,
+        params,
+        ByteTokenizer(cfg.vocab_size),
+        mesh_plan=MeshPlan(dp=2, tp=2),
+        engine_cfg=EngineConfig(max_slots=2, max_seq=64, min_prefill_bucket=16),
+    )
+    prompt = [65, 66, 67]
+    out, ev = eng.generate(prompt, max_new_tokens=8, ignore_eos=True)
+    assert ev.completion_tokens == 8
+
+    seq = list(prompt)
+    for _ in range(8):
+        toks = jnp.array([seq + [0] * (32 - len(seq))], jnp.int32)
+        logits, _, _ = eng._prefill_fn(eng.params, toks, jnp.array([len(seq)], jnp.int32))
+        seq.append(int(jnp.argmax(logits[0])))
+    eng.stop()
+    assert out == eng.tokenizer.decode(seq[len(prompt):])

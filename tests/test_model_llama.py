@@ -90,6 +90,29 @@ def test_moe_forward():
     assert jnp.isfinite(logits).all()
 
 
+def test_encode_embeddings(tiny):
+    """encode(): L2-normalized, padding-invariant, pooled over valid tokens only."""
+    import numpy as np
+
+    from localai_tpu.models.llama import encode
+
+    cfg, params = tiny
+    t1 = jnp.array([[1, 2, 3, 0, 0, 0, 0, 0]], jnp.int32)
+    t2 = jnp.array([[1, 2, 3] + [0] * 13], jnp.int32)
+    l = jnp.array([3], jnp.int32)
+    e1 = encode(cfg, params, t1, l)
+    e2 = encode(cfg, params, t2, l)
+    assert e1.shape == (1, cfg.hidden_size)
+    assert np.allclose(np.linalg.norm(np.asarray(e1), axis=-1), 1.0, atol=1e-4)
+    assert jnp.allclose(e1, e2, atol=1e-3), float(jnp.abs(e1 - e2).max())
+    # Different content -> different embedding.
+    e3 = encode(cfg, params, jnp.array([[9, 9, 9, 0, 0, 0, 0, 0]], jnp.int32), l)
+    assert not jnp.allclose(e1, e3, atol=1e-2)
+    # Zero-length row must not NaN.
+    e0 = encode(cfg, params, t1, jnp.array([0], jnp.int32))
+    assert jnp.isfinite(e0).all()
+
+
 def test_sharded_prefill_matches_single(devices8, tiny):
     """tp=2 x dp=2 sharded prefill must produce the same logits as unsharded."""
     cfg, params = tiny

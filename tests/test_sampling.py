@@ -52,6 +52,19 @@ def test_min_p_restricts_support():
     assert set(toks) <= {0, 1}, set(toks)
 
 
+def test_top_p_renormalizes_after_top_k():
+    """llama.cpp chain: top-p mass is measured over the post-top-k distribution.
+
+    probs [0.4, 0.3, 0.2, 0.1], top_k=3, top_p=0.75: renormalized survivors are
+    [0.444, 0.333, 0.222]; token 2's preceding mass 0.777 > 0.75 so support
+    must be {0, 1} (un-renormalized cum 0.7 < 0.75 would wrongly keep it).
+    """
+    logits = jnp.log(jnp.array([[0.4, 0.3, 0.2, 0.1]], jnp.float32))
+    params = SamplingParams.make(1, temperature=1.0, top_k=3, top_p=0.75)
+    toks = {sample(logits, keys(1, s), params).tolist()[0] for s in range(60)}
+    assert toks <= {0, 1}, toks
+
+
 def test_per_slot_heterogeneous_params():
     """Slot 0 greedy, slot 1 top-k=1 (deterministic), in one batch."""
     logits = jnp.array([[1.0, 3.0, 2.0], [9.0, 1.0, 0.0]], jnp.float32)
