@@ -1,0 +1,114 @@
+"""Benchmark: aggregate decode throughput through the serving engine.
+
+Measures the north-star metric path (BASELINE.md): output tokens/sec of the
+continuous-batching engine, full public API (submit → slots → jitted decode →
+streamed events), random-init weights (zero-egress environment; shapes match
+the public model card so the compute is real).
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": null}
+vs_baseline is null because the reference publishes no numbers (SURVEY.md §6).
+
+Env knobs: BENCH_ARCH (default llama-3.2-1b; "tiny" for smoke),
+BENCH_SLOTS, BENCH_PROMPT, BENCH_GEN, BENCH_MAX_SEQ.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+
+def main() -> None:
+    import jax
+
+    try:
+        devices = jax.devices()
+    except RuntimeError:
+        jax.config.update("jax_platforms", "cpu")
+        devices = jax.devices()
+    print(f"bench devices: {devices}", file=sys.stderr)
+
+    from localai_tpu.engine import ByteTokenizer, Engine, EngineConfig
+    from localai_tpu.models import get_arch
+    from localai_tpu.models.llama import init_params
+
+    arch = os.environ.get("BENCH_ARCH", "llama-3.2-1b")
+    slots = int(os.environ.get("BENCH_SLOTS", "8"))
+    prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
+    gen_len = int(os.environ.get("BENCH_GEN", "128"))
+    max_seq = int(os.environ.get("BENCH_MAX_SEQ", "1024"))
+
+    cfg = get_arch(arch)
+    params = jax.jit(lambda k: init_params(cfg, k))(jax.random.key(0))
+    eng = Engine(
+        cfg,
+        params,
+        ByteTokenizer(cfg.vocab_size),
+        engine_cfg=EngineConfig(max_slots=slots, max_seq=max_seq),
+    )
+    t0 = time.time()
+    eng.warmup(prompt_len)
+    print(f"warmup/compile: {time.time() - t0:.1f}s", file=sys.stderr)
+
+    # Reset counters after warmup so the measurement covers steady state only.
+    eng._decode_time = 0.0
+    eng._decode_tokens = 0
+
+    ttfts: list[float] = []
+    errors: list[str] = []
+    lock = threading.Lock()
+
+    def one(i: int) -> None:
+        ids = [(i * 37 + j) % 255 + 1 for j in range(prompt_len)]
+        try:
+            _, ev = eng.generate(ids, max_new_tokens=gen_len, ignore_eos=True)
+            with lock:
+                ttfts.append(ev.timing_prompt_processing)
+        except Exception as e:  # noqa: BLE001 — a partial run must not report a fake metric
+            with lock:
+                errors.append(f"request {i}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(slots)]
+    wall0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.time() - wall0
+
+    if errors:
+        for err in errors:
+            print(err, file=sys.stderr)
+        print(f"bench failed: {len(errors)}/{slots} requests errored", file=sys.stderr)
+        sys.exit(1)
+
+    decode_tps = eng._decode_tokens / eng._decode_time if eng._decode_time else 0.0
+    total_tokens = slots * gen_len
+    ttfts.sort()
+    p50_ttft = ttfts[len(ttfts) // 2]
+    print(
+        f"arch={arch} slots={slots} gen={gen_len} wall={wall:.2f}s "
+        f"end_to_end_tps={total_tokens / wall:.1f} decode_tps={decode_tps:.1f} "
+        f"p50_ttft={p50_ttft * 1000:.1f}ms",
+        file=sys.stderr,
+    )
+    eng.stop()
+
+    print(
+        json.dumps(
+            {
+                "metric": f"decode_tokens_per_sec_{arch}_bs{slots}",
+                "value": round(decode_tps, 2),
+                "unit": "tok/s",
+                "vs_baseline": None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

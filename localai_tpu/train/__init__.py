@@ -1,0 +1,10 @@
+"""Training: sharded causal-LM fine-tuning steps.
+
+The reference is inference-only (SURVEY.md §5 "No training checkpoints"); this
+module goes beyond parity so the same model definitions, mesh axes and
+sharding plans serve fine-tuning on TPU pods. The step is one jitted program:
+forward (remat over the layer scan), loss, grad, optax update — XLA inserts
+the dp gradient psums and tp weight collectives from the shardings.
+"""
+
+from localai_tpu.train.step import causal_lm_loss, make_train_step, train_init  # noqa: F401

@@ -1,0 +1,73 @@
+"""Sharded causal-LM training step.
+
+Design:
+- Reuses the inference forward (`llama._forward_hidden`) so training and
+  serving can never drift; the full-sequence unembed lives here because only
+  training needs [B, S, V] logits.
+- `jax.checkpoint` wraps the forward to rematerialize activations in backward,
+  trading MXU FLOPs for HBM — the standard TPU memory lever.
+- Shardings: params per `parallel.sharding.param_specs` (tp/ep axes); batch
+  over ("dp", "sp") — sequence axis sharding gives context parallelism and
+  XLA inserts the attention collectives.
+- Optimizer state is initialized under jit from already-sharded params, so it
+  inherits their shardings without a separate placement pass.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from localai_tpu.models import llama
+from localai_tpu.models.config import ArchConfig
+
+Params = dict[str, Any]
+
+
+def full_logits(cfg: ArchConfig, params: Params, tokens: jnp.ndarray, lengths: jnp.ndarray):
+    """[B, S, V] float32 logits over the whole (padded) sequence."""
+    h, _, _ = llama._forward_hidden(cfg, params, tokens, lengths, collect_kv=False)
+    return llama._unembed(cfg, params, h)
+
+
+def causal_lm_loss(
+    cfg: ArchConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [B, S] int32, right-padded
+    lengths: jnp.ndarray,  # [B] int32
+) -> jnp.ndarray:
+    """Mean next-token cross-entropy over valid positions (predict t+1 at t)."""
+    forward = jax.checkpoint(partial(full_logits, cfg))
+    logits = forward(params, tokens, lengths)  # [B, S, V]
+    B, S = tokens.shape
+    targets = jnp.roll(tokens, -1, axis=1)  # [B, S]; position t predicts t+1
+    valid = jnp.arange(S)[None, :] < (lengths - 1)[:, None]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]  # [B, S]
+    denom = jnp.maximum(valid.sum(), 1)
+    return (nll * valid).sum() / denom
+
+
+def train_init(tx: optax.GradientTransformation, params: Params):
+    """Optimizer state sharded like the params (init under jit)."""
+    return jax.jit(tx.init)(params)
+
+
+def make_train_step(
+    cfg: ArchConfig,
+    tx: optax.GradientTransformation,
+) -> Callable:
+    """One jitted step: (params, opt_state, tokens, lengths) -> (params, opt_state, loss)."""
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens, lengths):
+        loss, grads = jax.value_and_grad(partial(causal_lm_loss, cfg))(params, tokens, lengths)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
