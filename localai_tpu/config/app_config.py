@@ -1,0 +1,73 @@
+"""Application-level configuration.
+
+Reference: core/config/application_config.go (461 LoC, functional AppOption
+pattern fed by ~70 kong CLI flags with env aliases, core/cli/run.go:23-120).
+Here: one dataclass, populated from env vars (LOCALAI_*) and/or CLI args.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env(name: str, default, cast=str):
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    if cast is bool:
+        return v.lower() in ("1", "true", "yes", "on")
+    return cast(v)
+
+
+@dataclasses.dataclass
+class ApplicationConfig:
+    address: str = "127.0.0.1"
+    port: int = 8080
+    models_dir: str = "models"
+    generated_content_dir: str = "generated"
+
+    # Auth (reference: core/http/middleware/auth.go).
+    api_keys: list[str] = dataclasses.field(default_factory=list)
+
+    # Lifecycle (reference: watchdog flags, run.go).
+    max_active_models: int = 1  # LRU HBM budget: how many engines stay resident
+    watchdog_idle_timeout_s: float = 0.0  # 0 disables
+    watchdog_busy_timeout_s: float = 0.0
+
+    # Engine defaults.
+    preload_models: list[str] = dataclasses.field(default_factory=list)
+    default_context_size: int = 2048
+
+    cors: bool = True
+    metrics: bool = True
+    debug: bool = False
+
+    machine_tag: str = ""  # echoed as a response header when set
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ApplicationConfig":
+        cfg = cls(
+            address=_env("LOCALAI_ADDRESS", cls.address),
+            port=_env("LOCALAI_PORT", cls.port, int),
+            models_dir=_env("LOCALAI_MODELS_PATH", cls.models_dir),
+            generated_content_dir=_env("LOCALAI_GENERATED_CONTENT_PATH", cls.generated_content_dir),
+            max_active_models=_env("LOCALAI_MAX_ACTIVE_MODELS", cls.max_active_models, int),
+            watchdog_idle_timeout_s=_env("LOCALAI_WATCHDOG_IDLE_TIMEOUT", 0.0, float),
+            watchdog_busy_timeout_s=_env("LOCALAI_WATCHDOG_BUSY_TIMEOUT", 0.0, float),
+            default_context_size=_env("LOCALAI_CONTEXT_SIZE", cls.default_context_size, int),
+            cors=_env("LOCALAI_CORS", True, bool),
+            metrics=not _env("LOCALAI_DISABLE_METRICS", False, bool),
+            debug=_env("LOCALAI_DEBUG", False, bool),
+            machine_tag=_env("LOCALAI_MACHINE_TAG", ""),
+        )
+        keys = os.environ.get("LOCALAI_API_KEY", "")
+        if keys:
+            cfg.api_keys = [k.strip() for k in keys.split(",") if k.strip()]
+        preload = os.environ.get("LOCALAI_PRELOAD_MODELS", "")
+        if preload:
+            cfg.preload_models = [m.strip() for m in preload.split(",") if m.strip()]
+        for k, v in overrides.items():
+            setattr(cfg, k, v)
+        return cfg

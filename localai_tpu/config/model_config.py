@@ -1,0 +1,233 @@
+"""Per-model YAML configuration.
+
+TPU-native rework of the reference ModelConfig (core/config/model_config.go:
+31-83 fields, :363-478 SetDefaults, :480-508 validation, :520-538 usecase
+flags, :593-679 GuessUsecases). Differences by design:
+
+- `backend` names a JAX model family (llama-family decoder today) instead of a
+  subprocess binary; `model` points at an HF-format checkpoint directory or an
+  arch preset name (random-init, for benchmarks) instead of a GGUF file.
+- Parallelism is part of the model config (mesh axes tp/dp/ep/sp), because on
+  TPU the sharding plan is as much a property of serving a model as its
+  context size — the reference buries this in engine-specific options
+  (tensor_split, grpc-server.cpp:493-496).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import re
+from typing import Any, Optional
+
+import yaml
+
+
+class Usecase(enum.Flag):
+    """Endpoint routing flags (reference: model_config.go:520-538)."""
+
+    CHAT = enum.auto()
+    COMPLETION = enum.auto()
+    EDIT = enum.auto()
+    EMBEDDINGS = enum.auto()
+    TOKENIZE = enum.auto()
+    RERANK = enum.auto()
+    IMAGE = enum.auto()
+    VIDEO = enum.auto()
+    TTS = enum.auto()
+    TRANSCRIPT = enum.auto()
+    SOUND_GENERATION = enum.auto()
+    VAD = enum.auto()
+    DETECTION = enum.auto()
+
+    @classmethod
+    def any_llm(cls) -> "Usecase":
+        return cls.CHAT | cls.COMPLETION | cls.EDIT | cls.EMBEDDINGS | cls.TOKENIZE
+
+
+_NAME_RE = re.compile(r"^[a-zA-Z0-9_\-./:]+$")
+
+
+@dataclasses.dataclass
+class TemplateConfig:
+    """Prompt template selection (reference: TemplateConfig model_config.go:250-278)."""
+
+    chat: Optional[str] = None  # jinja2 template for the whole chat
+    chat_message: Optional[str] = None  # jinja2 template applied per message
+    completion: Optional[str] = None
+    edit: Optional[str] = None
+    use_tokenizer_template: bool = False  # use the HF tokenizer's chat template
+    family: Optional[str] = None  # built-in family: llama3 | chatml | mistral | alpaca
+
+
+@dataclasses.dataclass
+class ParallelConfig:
+    """Mesh axes for serving this model (tp over ICI first; see parallel.mesh)."""
+
+    tp: int = 0  # 0 = all devices
+    dp: int = 1
+    ep: int = 1
+    sp: int = 1
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    name: str = ""
+    backend: str = "llama"  # JAX model family
+    model: str = ""  # checkpoint dir (HF safetensors) or arch preset name
+    tokenizer: str = ""  # tokenizer dir; empty = byte-level fallback
+    description: str = ""
+
+    # Generation defaults (reference: PredictionOptions / LLMConfig).
+    context_size: int = 2048
+    max_tokens: int = 512
+    temperature: float = 0.7
+    top_k: int = 40
+    top_p: float = 0.95
+    min_p: float = 0.0
+    repeat_penalty: float = 1.0
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    seed: Optional[int] = None
+    stop: list[str] = dataclasses.field(default_factory=list)
+
+    # Engine shape knobs.
+    max_slots: int = 8
+    parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+
+    # Capabilities.
+    embeddings: bool = False
+    template: TemplateConfig = dataclasses.field(default_factory=TemplateConfig)
+    system_prompt: str = ""
+
+    # Free-form extras (kept for forward-compat, like the reference's
+    # yaml passthrough options).
+    options: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    known_usecases: Optional[Usecase] = None  # explicit override
+
+    def validate(self) -> None:
+        """Reject path traversal and malformed names (model_config.go:480-508)."""
+        if not self.name or not _NAME_RE.match(self.name):
+            raise ValueError(f"invalid model name {self.name!r}")
+        for field in ("model", "tokenizer"):
+            v = getattr(self, field)
+            if ".." in v.split(os.sep):
+                raise ValueError(f"path traversal in {field}: {v!r}")
+
+    def usecases(self) -> Usecase:
+        """Endpoint routing (reference GuessUsecases, model_config.go:593-679)."""
+        if self.known_usecases is not None:
+            return self.known_usecases
+        uc = Usecase.CHAT | Usecase.COMPLETION | Usecase.EDIT | Usecase.TOKENIZE
+        if self.embeddings or "bert" in self.backend or "embed" in self.name.lower():
+            uc |= Usecase.EMBEDDINGS
+        return uc
+
+    def has_usecase(self, uc: Usecase) -> bool:
+        return bool(self.usecases() & uc)
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "ModelConfig":
+        data = dict(data)
+        tmpl = data.pop("template", None) or {}
+        par = data.pop("parallel", None) or {}
+        known = data.pop("known_usecases", None)
+        fields = {f.name for f in dataclasses.fields(cls)}
+        extra = {k: v for k, v in data.items() if k not in fields}
+        kept = {k: v for k, v in data.items() if k in fields and k != "options"}
+        cfg = cls(**kept)
+        cfg.template = TemplateConfig(**tmpl) if isinstance(tmpl, dict) else TemplateConfig()
+        cfg.parallel = ParallelConfig(**par) if isinstance(par, dict) else ParallelConfig()
+        cfg.options = {**extra, **(data.get("options") or {})}
+        if known:
+            uc = Usecase(0)
+            for item in known:
+                uc |= Usecase[item.upper()]
+            cfg.known_usecases = uc
+        return cfg
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        if self.known_usecases is not None:
+            d["known_usecases"] = [u.name.lower() for u in Usecase if self.known_usecases & u]
+        else:
+            d.pop("known_usecases")
+        return d
+
+
+class ModelConfigLoader:
+    """Loads and watches per-model YAML configs from a directory.
+
+    Reference: core/config/model_config_loader.go (LoadModelConfigsFromPath);
+    one YAML file per model, or a multi-doc `models.yaml`.
+    """
+
+    def __init__(self, models_dir: str):
+        self.models_dir = models_dir
+        self._configs: dict[str, ModelConfig] = {}
+
+    def load_all(self) -> dict[str, ModelConfig]:
+        self._configs = {}
+        if not os.path.isdir(self.models_dir):
+            return self._configs
+        for fname in sorted(os.listdir(self.models_dir)):
+            if not fname.endswith((".yaml", ".yml")):
+                continue
+            path = os.path.join(self.models_dir, fname)
+            try:
+                with open(path) as f:
+                    docs = list(yaml.safe_load_all(f))
+            except yaml.YAMLError as e:
+                raise ValueError(f"invalid YAML in {path}: {e}") from e
+            for doc in docs:
+                if not isinstance(doc, dict):
+                    continue
+                entries = doc.get("models") if "models" in doc else [doc]
+                if not isinstance(entries, list):
+                    entries = [entries]
+                for entry in entries:
+                    cfg = ModelConfig.from_dict(entry)
+                    if not cfg.name:
+                        cfg.name = os.path.splitext(fname)[0]
+                    cfg.validate()
+                    self._configs[cfg.name] = cfg
+        return self._configs
+
+    def register(self, cfg: ModelConfig) -> None:
+        cfg.validate()
+        self._configs[cfg.name] = cfg
+
+    def get(self, name: str) -> Optional[ModelConfig]:
+        return self._configs.get(name)
+
+    def names(self) -> list[str]:
+        return sorted(self._configs)
+
+    def first_with(self, uc: Usecase) -> Optional[ModelConfig]:
+        """Default-model pick for an endpoint (reference:
+        BuildFilteredFirstAvailableDefaultModel, middleware/request.go:92)."""
+        for name in self.names():
+            if self._configs[name].has_usecase(uc):
+                return self._configs[name]
+        return None
+
+    def write(self, cfg: ModelConfig) -> str:
+        """Persist a model config as YAML (model import API)."""
+        cfg.validate()
+        os.makedirs(self.models_dir, exist_ok=True)
+        path = os.path.join(self.models_dir, f"{cfg.name.replace('/', '_')}.yaml")
+        with open(path, "w") as f:
+            yaml.safe_dump(cfg.to_dict(), f, sort_keys=False)
+        self._configs[cfg.name] = cfg
+        return path
+
+    def delete(self, name: str) -> bool:
+        cfg = self._configs.pop(name, None)
+        if cfg is None:
+            return False
+        path = os.path.join(self.models_dir, f"{name.replace('/', '_')}.yaml")
+        if os.path.exists(path):
+            os.remove(path)
+        return True

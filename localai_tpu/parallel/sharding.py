@@ -85,6 +85,21 @@ def cache_shardings(mesh: Mesh) -> tuple[NamedSharding, NamedSharding]:
     return NamedSharding(mesh, ks), NamedSharding(mesh, vs)
 
 
+def max_valid_tp(cfg: ArchConfig, n_devices: int) -> int:
+    """Largest tp ≤ n_devices that divides every sharded dimension.
+
+    Any tp ≤ n_devices is legal (build_mesh truncates unused devices), so all
+    integers are probed — e.g. 6 kv-heads on 8 devices serves at tp=6.
+    """
+    for tp in range(n_devices, 1, -1):
+        try:
+            validate_plan(cfg, tp)
+            return tp
+        except ValueError:
+            continue
+    return 1
+
+
 def validate_plan(cfg: ArchConfig, tp: int, ep: int = 1) -> None:
     """Fail fast on shapes that cannot shard evenly (XLA would pad silently)."""
     if cfg.num_kv_heads % tp != 0:

@@ -1,0 +1,9 @@
+"""HTTP API server: OpenAI-compatible REST surface over the JAX engine.
+
+Reference: core/http (echo server, routes/openai.go + routes/localai.go).
+Rebuilt on the Python stdlib (ThreadingHTTPServer) — no web framework
+dependency — with SSE streaming wired straight to the engine's token queues.
+"""
+
+from localai_tpu.server.manager import ModelManager  # noqa: F401
+from localai_tpu.server.app import create_server, Router  # noqa: F401

@@ -1,0 +1,300 @@
+"""Stdlib HTTP server: routing, auth, SSE streaming, Prometheus metrics.
+
+Reference: core/http/app.go:45-226 (echo middleware chain: body limit, error
+handler, request logging, CORS, API-key auth, metrics) — rebuilt with
+http.server.ThreadingHTTPServer so the framework dependency is zero and the
+streaming path is a direct engine-queue → chunked-write loop.
+"""
+
+from __future__ import annotations
+
+import hmac
+import json
+import logging
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Iterator, Optional
+from urllib.parse import parse_qs, urlparse
+
+from localai_tpu.config import ApplicationConfig
+
+log = logging.getLogger("localai_tpu.http")
+
+MAX_BODY = 100 * 1024 * 1024  # reference uses a 50MB gRPC cap; HTTP gets 100MB
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    params: dict[str, str]
+    query: dict[str, list[str]]
+    headers: dict[str, str]
+    body: Optional[dict[str, Any]]
+    raw_body: bytes = b""
+
+
+@dataclass
+class Response:
+    status: int = 200
+    body: Any = None  # dict → JSON; str/bytes → raw
+    content_type: str = "application/json"
+    headers: dict[str, str] = field(default_factory=dict)
+
+
+class SSEStream:
+    """Handler return value that streams `data:` frames from a generator."""
+
+    def __init__(self, events: Iterator[Any]):
+        self.events = events
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str, kind: str = "invalid_request_error"):
+        super().__init__(message)
+        self.status = status
+        self.kind = kind
+
+    def to_response(self) -> Response:
+        # OpenAI-style error envelope (reference: core/http error handler).
+        return Response(
+            status=self.status,
+            body={"error": {"message": str(self), "type": self.kind, "code": self.status}},
+        )
+
+
+class Metrics:
+    """api_call duration histogram, Prometheus text format.
+
+    Reference: core/services/metrics.go:28-46 (OTel histogram `api_call`).
+    """
+
+    BUCKETS = (0.005, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0, float("inf"))
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._hist: dict[str, list[int]] = {}
+        self._sum: dict[str, float] = {}
+        self._count: dict[str, int] = {}
+
+    def observe(self, path: str, seconds: float) -> None:
+        with self._lock:
+            h = self._hist.setdefault(path, [0] * len(self.BUCKETS))
+            for i, b in enumerate(self.BUCKETS):
+                if seconds <= b:
+                    h[i] += 1
+            self._sum[path] = self._sum.get(path, 0.0) + seconds
+            self._count[path] = self._count.get(path, 0) + 1
+
+    def render(self) -> str:
+        lines = [
+            "# HELP localai_api_call API call duration seconds",
+            "# TYPE localai_api_call histogram",
+        ]
+        with self._lock:
+            for path, h in sorted(self._hist.items()):
+                for i, b in enumerate(self.BUCKETS):
+                    le = "+Inf" if b == float("inf") else repr(b)
+                    lines.append(
+                        f'localai_api_call_bucket{{path="{path}",le="{le}"}} {h[i]}'
+                    )
+                lines.append(f'localai_api_call_sum{{path="{path}"}} {self._sum[path]}')
+                lines.append(f'localai_api_call_count{{path="{path}"}} {self._count[path]}')
+        return "\n".join(lines) + "\n"
+
+
+Handler = Callable[[Request], "Response | SSEStream"]
+
+
+class Router:
+    def __init__(self) -> None:
+        self.routes: list[tuple[str, re.Pattern, Handler]] = []
+
+    def add(self, method: str, pattern: str, handler: Handler) -> None:
+        """Pattern params as `:name` segments, e.g. `/models/jobs/:uuid`."""
+        regex = re.sub(r":(\w+)", r"(?P<\1>[^/]+)", pattern)
+        self.routes.append((method.upper(), re.compile(f"^{regex}$"), handler))
+
+    def match(self, method: str, path: str) -> Optional[tuple[Handler, dict[str, str]]]:
+        for m, rx, h in self.routes:
+            if m != method.upper():
+                continue
+            match = rx.match(path)
+            if match:
+                return h, match.groupdict()
+        return None
+
+    def methods_for(self, path: str) -> set[str]:
+        return {m for m, rx, _ in self.routes if rx.match(path)}
+
+
+# Paths that never require auth (reference: auth.go exempts health endpoints).
+AUTH_EXEMPT = {"/healthz", "/readyz", "/version"}
+
+
+def create_server(app_cfg: ApplicationConfig, router: Router) -> ThreadingHTTPServer:
+    metrics = Metrics()
+    router.add("GET", "/metrics", lambda req: Response(
+        body=metrics.render(), content_type="text/plain; version=0.0.4"
+    ))
+
+    class RequestHandlerImpl(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = "localai-tpu"
+
+        def log_message(self, fmt, *args):  # route through logging, not stderr
+            log.debug("%s " + fmt, self.address_string(), *args)
+
+        def _deny(self, status: int, msg: str) -> None:
+            self._respond(ApiError(status, msg).to_response())
+
+        def _authed(self) -> bool:
+            if not app_cfg.api_keys:
+                return True
+            path = urlparse(self.path).path
+            if path in AUTH_EXEMPT:
+                return True
+            header = self.headers.get("Authorization", "")
+            token = header[7:] if header.startswith("Bearer ") else header
+            if not token:
+                token = self.headers.get("x-api-key", "") or self.headers.get("xi-api-key", "")
+            # Constant-time compare over bytes (reference: auth.go constant-
+            # time option); bytes form tolerates non-ASCII header values.
+            tb = token.encode("utf-8", "surrogateescape")
+            return any(hmac.compare_digest(tb, k.encode()) for k in app_cfg.api_keys)
+
+        def _common_headers(self) -> dict[str, str]:
+            h = {}
+            if app_cfg.cors:
+                h["Access-Control-Allow-Origin"] = "*"
+                h["Access-Control-Allow-Headers"] = "Authorization, Content-Type, Extra-Usage"
+                h["Access-Control-Allow-Methods"] = "GET, POST, DELETE, OPTIONS"
+            if app_cfg.machine_tag:
+                h["LocalAI-Machine-Tag"] = app_cfg.machine_tag
+            return h
+
+        def _respond(self, resp: Response) -> None:
+            body = resp.body
+            if isinstance(body, (dict, list)):
+                data = json.dumps(body).encode()
+            elif isinstance(body, str):
+                data = body.encode()
+            elif body is None:
+                data = b""
+            else:
+                data = body
+            self.send_response(resp.status)
+            self.send_header("Content-Type", resp.content_type)
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in {**self._common_headers(), **resp.headers}.items():
+                self.send_header(k, v)
+            self.end_headers()
+            if self.command != "HEAD":
+                self.wfile.write(data)
+
+        def _respond_sse(self, stream: SSEStream) -> None:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "keep-alive")
+            self.send_header("Transfer-Encoding", "chunked")
+            for k, v in self._common_headers().items():
+                self.send_header(k, v)
+            self.end_headers()
+
+            def write_chunk(payload: bytes) -> None:
+                self.wfile.write(f"{len(payload):X}\r\n".encode() + payload + b"\r\n")
+                self.wfile.flush()
+
+            try:
+                for ev in stream.events:
+                    if isinstance(ev, (dict, list)):
+                        ev = json.dumps(ev)
+                    write_chunk(f"data: {ev}\n\n".encode())
+                write_chunk(b"data: [DONE]\n\n")
+            except (BrokenPipeError, ConnectionResetError):
+                log.debug("SSE client disconnected")
+            finally:
+                try:
+                    write_chunk(b"")  # terminating chunk
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+        def _handle(self) -> None:
+            start = time.monotonic()
+            parsed = urlparse(self.path)
+            path = parsed.path.rstrip("/") or "/"
+            if self.command == "OPTIONS":
+                self.send_response(204)
+                for k, v in self._common_headers().items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            if not self._authed():
+                self._deny(401, "invalid or missing API key")
+                return
+
+            matched = router.match(self.command, path)
+            if matched is None:
+                if router.methods_for(path):
+                    self._deny(405, f"method {self.command} not allowed for {path}")
+                else:
+                    self._deny(404, f"no route for {path}")
+                return
+            handler, params = matched
+
+            raw = b""
+            length = int(self.headers.get("Content-Length") or 0)
+            if length > MAX_BODY:
+                self._deny(413, "request body too large")
+                return
+            if length:
+                raw = self.rfile.read(length)
+            body = None
+            if raw:
+                ctype = self.headers.get("Content-Type", "")
+                if "json" in ctype or raw.lstrip()[:1] in (b"{", b"["):
+                    try:
+                        body = json.loads(raw)
+                    except json.JSONDecodeError as e:
+                        self._deny(400, f"invalid JSON body: {e}")
+                        return
+
+            req = Request(
+                method=self.command,
+                path=path,
+                params=params,
+                query=parse_qs(parsed.query),
+                headers={k.lower(): v for k, v in self.headers.items()},
+                body=body,
+                raw_body=raw,
+            )
+            try:
+                result = handler(req)
+            except ApiError as e:
+                self._respond(e.to_response())
+                return
+            except Exception as e:  # noqa: BLE001
+                log.exception("handler error for %s %s", self.command, path)
+                self._respond(ApiError(500, f"{type(e).__name__}: {e}", "server_error").to_response())
+                return
+            finally:
+                metrics.observe(path, time.monotonic() - start)
+
+            if isinstance(result, SSEStream):
+                self._respond_sse(result)
+            else:
+                self._respond(result)
+
+        def do_GET(self):  # noqa: N802
+            self._handle()
+
+        do_POST = do_DELETE = do_PUT = do_HEAD = do_OPTIONS = do_GET
+
+    server = ThreadingHTTPServer((app_cfg.address, app_cfg.port), RequestHandlerImpl)
+    server.daemon_threads = True
+    return server

@@ -1,0 +1,266 @@
+"""Model lifecycle manager: lazy engine loading with singleflight + LRU.
+
+TPU re-design of pkg/model (loader.go singleflight :163-221, watchdog LRU
+eviction :135-195): "loading" compiles and shards weights into the resident
+process; "evicting" drops an engine's HBM buffers instead of killing a
+subprocess. One manager owns all engines for the slice.
+"""
+
+from __future__ import annotations
+
+import gc
+import logging
+import threading
+import time
+from typing import Optional
+
+import jax
+
+from localai_tpu.config import ApplicationConfig, ModelConfig, ModelConfigLoader
+from localai_tpu.engine import Engine, EngineConfig
+from localai_tpu.engine.tokenizer import load_tokenizer
+from localai_tpu.parallel.mesh import MeshPlan
+from localai_tpu.templates import Evaluator
+
+log = logging.getLogger("localai_tpu.manager")
+
+
+class LoadedModel:
+    def __init__(self, cfg: ModelConfig, engine: Engine, evaluator: Evaluator):
+        self.cfg = cfg
+        self.engine = engine
+        self.evaluator = evaluator
+        self.loaded_at = time.monotonic()
+        self.last_used = time.monotonic()
+        self.busy_since: Optional[float] = None
+        self.in_flight = 0
+        self._lock = threading.Lock()
+
+    def touch(self) -> None:
+        self.last_used = time.monotonic()
+
+    def acquire(self) -> None:
+        with self._lock:
+            self.in_flight += 1
+            if self.busy_since is None:
+                self.busy_since = time.monotonic()
+            self.touch()
+
+    def release(self) -> None:
+        with self._lock:
+            self.in_flight = max(0, self.in_flight - 1)
+            if self.in_flight == 0:
+                self.busy_since = None
+            self.touch()
+
+    def lease(self) -> "Lease":
+        return Lease(self)
+
+
+class Lease:
+    """Idempotent in-flight marker: release() is safe to call from both a
+    streaming generator's finally and an error path without double-counting."""
+
+    def __init__(self, lm: "LoadedModel"):
+        self._lm = lm
+        self._released = False
+        self._lock = threading.Lock()
+        lm.acquire()
+
+    def release(self) -> None:
+        with self._lock:
+            if self._released:
+                return
+            self._released = True
+        self._lm.release()
+
+
+class ModelManager:
+    def __init__(self, app_cfg: ApplicationConfig, config_loader: Optional[ModelConfigLoader] = None):
+        self.app_cfg = app_cfg
+        self.configs = config_loader or ModelConfigLoader(app_cfg.models_dir)
+        self.configs.load_all()
+        self._loaded: dict[str, LoadedModel] = {}
+        self._lock = threading.Lock()
+        self._loading: dict[str, threading.Event] = {}
+
+    # ------------------------------------------------------------------ #
+
+    def list_configs(self) -> list[ModelConfig]:
+        return [self.configs.get(n) for n in self.configs.names()]
+
+    def loaded_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._loaded)
+
+    def get(self, name: str) -> LoadedModel:
+        """Singleflight load (reference: loader.go:163-221). Raises KeyError
+        for unknown models."""
+        while True:
+            with self._lock:
+                lm = self._loaded.get(name)
+                if lm is not None:
+                    lm.touch()
+                    return lm
+                ev = self._loading.get(name)
+                if ev is None:
+                    ev = threading.Event()
+                    self._loading[name] = ev
+                    break  # we are the loader
+            ev.wait()  # someone else is loading; retry
+
+        try:
+            cfg = self.configs.get(name)
+            if cfg is None:
+                raise KeyError(f"model {name!r} not found")
+            lm = self._load(cfg)
+            with self._lock:
+                self._loaded[name] = lm
+                self._evict_lru_locked(protect=name)
+            return lm
+        finally:
+            with self._lock:
+                self._loading.pop(name, None)
+            ev.set()
+
+    def lease(self, name: str) -> tuple[LoadedModel, Lease]:
+        """get() + acquire, atomically w.r.t. eviction: the lease is taken
+        while the model is verifiably still resident, so LRU/drain logic sees
+        in_flight > 0 before any teardown can start."""
+        while True:
+            lm = self.get(name)
+            with self._lock:
+                if self._loaded.get(name) is lm:
+                    return lm, lm.lease()
+            # evicted in the window between get() and now — reload and retry
+
+    def peek(self, name: str) -> Optional[LoadedModel]:
+        """Loaded model without triggering a load (monitoring paths)."""
+        with self._lock:
+            return self._loaded.get(name)
+
+    def unload(self, name: str, drain_s: float = 30.0) -> bool:
+        """Shutdown endpoint semantics (reference: /backend/shutdown).
+
+        Drains in-flight requests (up to drain_s) in the background before
+        dropping HBM buffers, so an active stream isn't cut mid-generation.
+        """
+        with self._lock:
+            lm = self._loaded.pop(name, None)
+        if lm is None:
+            return False
+        threading.Thread(
+            target=self._drain_and_teardown, args=(lm, drain_s), daemon=True
+        ).start()
+        return True
+
+    def _drain_and_teardown(self, lm: LoadedModel, drain_s: float) -> None:
+        deadline = time.monotonic() + drain_s
+        while lm.in_flight > 0 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        self._teardown(lm)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            loaded = list(self._loaded.values())
+            self._loaded.clear()
+        for lm in loaded:
+            self._teardown(lm)
+
+    # ------------------------------------------------------------------ #
+
+    def _teardown(self, lm: LoadedModel) -> None:
+        log.info("evicting model %s from HBM", lm.cfg.name)
+        lm.engine.stop()
+        # Drop device buffer references; XLA frees HBM when the last ref dies.
+        lm.engine.params = None
+        lm.engine.cache = None
+        gc.collect()
+
+    def _evict_lru_locked(self, protect: str = "") -> None:
+        """Reference: watchdog.go:135-195 LRU to MaxActiveBackends.
+
+        `protect` is the model a get() is about to hand to its caller — never
+        evict it, even though its lease hasn't been acquired yet."""
+        budget = max(1, self.app_cfg.max_active_models)
+        while len(self._loaded) > budget:
+            idle = [
+                (lm.last_used, n)
+                for n, lm in self._loaded.items()
+                if lm.in_flight == 0 and n != protect
+            ]
+            if not idle:
+                return  # everything busy; let the next call retry
+            _, victim = min(idle)
+            lm = self._loaded.pop(victim)
+            threading.Thread(
+                target=self._drain_and_teardown, args=(lm, 30.0), daemon=True
+            ).start()
+
+    def _load(self, cfg: ModelConfig) -> LoadedModel:
+        import os
+
+        from localai_tpu.models.config import PRESETS, get_arch
+        from localai_tpu.models.llama import init_params
+
+        t0 = time.monotonic()
+
+        ckpt_dir: Optional[str] = None
+        if cfg.model in PRESETS:
+            arch = get_arch(cfg.model)
+        else:
+            ckpt_dir = cfg.model
+            if not os.path.isabs(ckpt_dir):
+                ckpt_dir = os.path.join(self.app_cfg.models_dir, ckpt_dir)
+            if not os.path.isdir(ckpt_dir):
+                raise FileNotFoundError(
+                    f"model {cfg.name!r}: checkpoint dir {ckpt_dir!r} not found "
+                    f"and not an arch preset ({sorted(PRESETS)})"
+                )
+            from localai_tpu.engine.weights import arch_from_hf_config
+
+            arch = arch_from_hf_config(ckpt_dir)
+
+        from localai_tpu.parallel.sharding import max_valid_tp
+
+        n_devices = len(jax.devices())
+        par = cfg.parallel
+        avail = n_devices // max(1, par.dp * par.ep * par.sp)
+        tp = par.tp or max_valid_tp(arch, max(1, avail))
+        plan = MeshPlan(dp=par.dp, tp=max(1, tp), ep=par.ep, sp=par.sp)
+
+        tok_path = cfg.tokenizer or (ckpt_dir if ckpt_dir else None)
+        if tok_path and not _has_tokenizer_files(tok_path):
+            tok_path = None
+        tokenizer = load_tokenizer(tok_path, vocab_size=arch.vocab_size)
+
+        if ckpt_dir is not None:
+            from localai_tpu.engine.weights import load_hf_checkpoint
+
+            params = load_hf_checkpoint(arch, ckpt_dir)
+        else:
+            params = jax.jit(lambda k: init_params(arch, k))(jax.random.key(0))
+
+        engine = Engine(
+            arch,
+            params,
+            tokenizer,
+            mesh_plan=plan,
+            engine_cfg=EngineConfig(max_slots=cfg.max_slots, max_seq=cfg.context_size),
+        )
+        engine.start()
+        evaluator = Evaluator(cfg, tokenizer)
+        log.info(
+            "loaded model %s (arch=%s mesh=%s) in %.1fs",
+            cfg.name, arch.name, plan, time.monotonic() - t0,
+        )
+        return LoadedModel(cfg, engine, evaluator)
+
+
+def _has_tokenizer_files(path: str) -> bool:
+    import os
+
+    return any(
+        os.path.exists(os.path.join(path, f))
+        for f in ("tokenizer.json", "tokenizer.model", "tokenizer_config.json")
+    )

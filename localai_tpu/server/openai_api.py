@@ -1,0 +1,420 @@
+"""OpenAI-compatible endpoints + LocalAI native endpoints.
+
+Reference: core/http/endpoints/openai/*.go (chat.go:27 SSE+tools,
+completion.go, edit.go, embeddings.go, list.go) and endpoints/localai
+(tokenize.go, system.go, backend.go monitor/shutdown). Handlers translate
+HTTP requests into engine GenRequests; the streaming path iterates the
+engine's per-request event queue directly into SSE frames.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Iterator, Optional
+
+from localai_tpu import __version__
+from localai_tpu.config import Usecase
+from localai_tpu.engine import GenRequest
+from localai_tpu.server.app import ApiError, Request, Response, Router, SSEStream
+from localai_tpu.server.manager import LoadedModel, ModelManager
+
+
+def _now() -> int:
+    return int(time.time())
+
+
+def _fingerprint() -> str:
+    return f"localai-tpu-{__version__}"
+
+
+class OpenAIApi:
+    def __init__(self, manager: ModelManager):
+        self.manager = manager
+        self.started_at = time.time()
+
+    # ------------------------------------------------------------------ #
+    # Routing
+    # ------------------------------------------------------------------ #
+
+    def register(self, r: Router) -> None:
+        for prefix in ("/v1", ""):
+            r.add("POST", f"{prefix}/chat/completions", self.chat)
+            r.add("POST", f"{prefix}/completions", self.completion)
+            r.add("POST", f"{prefix}/edits", self.edit)
+            r.add("POST", f"{prefix}/embeddings", self.embeddings)
+            r.add("GET", f"{prefix}/models", self.list_models)
+        r.add("GET", "/v1/models/:name", self.get_model)
+        r.add("POST", "/v1/tokenize", self.tokenize)
+        r.add("POST", "/tokenize", self.tokenize)
+        r.add("GET", "/healthz", self.health)
+        r.add("GET", "/readyz", self.health)
+        r.add("GET", "/version", self.version)
+        r.add("GET", "/system", self.system)
+        r.add("GET", "/backend/monitor", self.backend_monitor)
+        r.add("POST", "/backend/monitor", self.backend_monitor)
+        r.add("POST", "/backend/shutdown", self.backend_shutdown)
+
+    # ------------------------------------------------------------------ #
+    # Helpers
+    # ------------------------------------------------------------------ #
+
+    def _resolve_name(self, req: Request, usecase: Usecase) -> str:
+        """Model from body, else first config serving the usecase (reference:
+        middleware/request.go:92 BuildFilteredFirstAvailableDefaultModel)."""
+        body = req.body or {}
+        name = body.get("model") or (req.params or {}).get("name")
+        if not name:
+            cfg = self.manager.configs.first_with(usecase)
+            if cfg is None:
+                raise ApiError(404, f"no model configured for {usecase}")
+            name = cfg.name
+        cfg = self.manager.configs.get(name)
+        if cfg is None:
+            raise ApiError(404, f"model {name!r} not found")
+        if not cfg.has_usecase(usecase):
+            raise ApiError(400, f"model {name!r} does not support {usecase}")
+        return name
+
+    def _resolve(self, req: Request, usecase: Usecase):
+        """Loaded model + idempotent lease, taken atomically w.r.t. eviction."""
+        name = self._resolve_name(req, usecase)
+        try:
+            return self.manager.lease(name)
+        except KeyError:
+            raise ApiError(404, f"model {name!r} not found") from None
+
+    def _gen_request(self, lm: LoadedModel, body: dict[str, Any], prompt_ids: list[int],
+                     extra_stop: Optional[list[str]] = None) -> GenRequest:
+        cfg = lm.cfg
+        stop = body.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        stop = list(stop) + [s for s in (extra_stop or []) if s not in stop]
+        max_tokens = body.get("max_completion_tokens") or body.get("max_tokens") or cfg.max_tokens
+
+        def pick(key: str, default):
+            v = body.get(key)
+            return default if v is None else v
+
+        logit_bias = {}
+        for k, v in (body.get("logit_bias") or {}).items():
+            try:
+                logit_bias[int(k)] = float(v)
+            except (TypeError, ValueError):
+                raise ApiError(400, f"invalid logit_bias entry {k!r}") from None
+
+        return GenRequest(
+            prompt_ids=prompt_ids,
+            max_new_tokens=int(max_tokens),
+            temperature=float(pick("temperature", cfg.temperature)),
+            top_k=int(pick("top_k", cfg.top_k)),
+            top_p=float(pick("top_p", cfg.top_p)),
+            min_p=float(pick("min_p", cfg.min_p)),
+            repeat_penalty=float(pick("repeat_penalty", cfg.repeat_penalty)),
+            presence_penalty=float(pick("presence_penalty", cfg.presence_penalty)),
+            frequency_penalty=float(pick("frequency_penalty", cfg.frequency_penalty)),
+            stop=stop,
+            seed=body.get("seed", cfg.seed),
+            logit_bias=logit_bias,
+        )
+
+    @staticmethod
+    def _usage(final, extra: bool) -> dict[str, Any]:
+        u = {
+            "prompt_tokens": final.prompt_tokens,
+            "completion_tokens": final.completion_tokens,
+            "total_tokens": final.prompt_tokens + final.completion_tokens,
+        }
+        if extra:
+            # reference: Extra-Usage header surfaces backend timings
+            # (chat.go:47-50; proto Reply timing fields).
+            u["timing_prompt_processing"] = final.timing_prompt_processing
+            u["timing_token_generation"] = final.timing_token_generation
+        return u
+
+    # ------------------------------------------------------------------ #
+    # Chat
+    # ------------------------------------------------------------------ #
+
+    def chat(self, req: Request) -> Response | SSEStream:
+        body = req.body or {}
+        messages = body.get("messages")
+        if not messages or not isinstance(messages, list):
+            raise ApiError(400, "messages is required and must be a non-empty array")
+        lm, lease = self._resolve(req, Usecase.CHAT)
+        try:
+            return self._chat_inner(req, lm, lease, body)
+        except BaseException:
+            lease.release()  # idempotent — safe even if the inner path released
+            raise
+
+    def _chat_inner(self, req: Request, lm: LoadedModel, lease, body: dict[str, Any]) -> Response | SSEStream:
+        from localai_tpu.functions import tools_prompt_for, parse_function_calls
+
+        tools = body.get("tools") or []
+        if body.get("functions"):  # legacy field
+            tools = [{"type": "function", "function": f} for f in body["functions"]]
+        tprompt = tools_prompt_for(tools) if tools else ""
+
+        prompt = lm.evaluator.template_messages(body["messages"], tools_prompt=tprompt)
+        add_bos = not lm.cfg.template.use_tokenizer_template
+        ids = lm.engine.tokenizer.encode(prompt, add_bos=add_bos)
+        gen = self._gen_request(lm, body, ids, extra_stop=lm.evaluator.stop_sequences())
+
+        rid = f"chatcmpl-{uuid.uuid4().hex[:28]}"
+        created = _now()
+        model_name = lm.cfg.name
+        extra_usage = "extra-usage" in req.headers
+
+        if body.get("stream"):
+            handle = lm.engine.submit(gen)
+
+            def events() -> Iterator[dict]:
+                try:
+                    base = {
+                        "id": rid, "object": "chat.completion.chunk",
+                        "created": created, "model": model_name,
+                        "system_fingerprint": _fingerprint(),
+                    }
+                    yield {**base, "choices": [{"index": 0, "delta": {"role": "assistant", "content": ""}, "finish_reason": None}]}
+                    final = None
+                    for ev in handle:
+                        if ev.kind == "token":
+                            yield {**base, "choices": [{"index": 0, "delta": {"content": ev.text}, "finish_reason": None}]}
+                        elif ev.kind == "error":
+                            yield {"error": {"message": ev.error, "type": "server_error"}}
+                            return
+                        else:
+                            final = ev
+                    out = {**base, "choices": [{"index": 0, "delta": {}, "finish_reason": final.finish_reason}]}
+                    out["usage"] = self._usage(final, extra_usage)
+                    yield out
+                finally:
+                    lease.release()
+
+            return SSEStream(events())
+
+        try:
+            text, final = lm.engine.submit(gen).result()
+        finally:
+            lease.release()
+
+        message: dict[str, Any] = {"role": "assistant", "content": text}
+        finish = final.finish_reason
+        if tools:
+            calls = parse_function_calls(text, lm.cfg)
+            if calls:
+                message = {"role": "assistant", "content": None, "tool_calls": calls}
+                finish = "tool_calls"
+        return Response(body={
+            "id": rid, "object": "chat.completion", "created": created,
+            "model": model_name, "system_fingerprint": _fingerprint(),
+            "choices": [{"index": 0, "message": message, "finish_reason": finish}],
+            "usage": self._usage(final, extra_usage),
+        })
+
+    # ------------------------------------------------------------------ #
+    # Completion / edit
+    # ------------------------------------------------------------------ #
+
+    def completion(self, req: Request) -> Response | SSEStream:
+        body = req.body or {}
+        prompts = body.get("prompt", "")
+        if isinstance(prompts, str):
+            prompts = [prompts]
+        if not prompts or not all(isinstance(p, str) for p in prompts):
+            raise ApiError(400, "prompt must be a string or array of strings")
+        lm, lease = self._resolve(req, Usecase.COMPLETION)
+        rid = f"cmpl-{uuid.uuid4().hex[:28]}"
+        created = _now()
+        extra_usage = "extra-usage" in req.headers
+        try:
+            return self._completion_inner(lm, lease, body, prompts, rid, created, extra_usage)
+        except BaseException:
+            lease.release()
+            raise
+
+    def _completion_inner(self, lm, lease, body, prompts, rid, created, extra_usage) -> Response | SSEStream:
+        if body.get("stream"):
+            if len(prompts) != 1:
+                raise ApiError(400, "streaming supports a single prompt")
+            templated = lm.evaluator.template_completion(prompts[0])
+            ids = lm.engine.tokenizer.encode(templated, add_bos=True)
+            handle = lm.engine.submit(self._gen_request(lm, body, ids))
+
+            def events() -> Iterator[dict]:
+                base = {"id": rid, "object": "text_completion", "created": created,
+                        "model": lm.cfg.name}
+                try:
+                    final = None
+                    for ev in handle:
+                        if ev.kind == "token":
+                            yield {**base, "choices": [{"index": 0, "text": ev.text, "finish_reason": None}]}
+                        elif ev.kind == "error":
+                            yield {"error": {"message": ev.error, "type": "server_error"}}
+                            return
+                        else:
+                            final = ev
+                    yield {**base,
+                           "choices": [{"index": 0, "text": "", "finish_reason": final.finish_reason}],
+                           "usage": self._usage(final, extra_usage)}
+                finally:
+                    lease.release()
+
+            return SSEStream(events())
+
+        try:
+            choices = []
+            pt = ct = 0
+            tpp = ttg = 0.0
+            for i, p in enumerate(prompts):
+                templated = lm.evaluator.template_completion(p)
+                ids = lm.engine.tokenizer.encode(templated, add_bos=True)
+                text, final = lm.engine.submit(self._gen_request(lm, body, ids)).result()
+                if body.get("echo"):
+                    text = p + text
+                choices.append({"index": i, "text": text, "finish_reason": final.finish_reason})
+                pt += final.prompt_tokens
+                ct += final.completion_tokens
+                tpp += final.timing_prompt_processing
+                ttg += final.timing_token_generation
+        finally:
+            lease.release()
+
+        usage = {"prompt_tokens": pt, "completion_tokens": ct, "total_tokens": pt + ct}
+        if extra_usage:
+            usage["timing_prompt_processing"] = tpp
+            usage["timing_token_generation"] = ttg
+        return Response(body={
+            "id": rid, "object": "text_completion", "created": created,
+            "model": lm.cfg.name, "choices": choices, "usage": usage,
+        })
+
+    def edit(self, req: Request) -> Response:
+        body = req.body or {}
+        instruction = body.get("instruction", "")
+        if not instruction:
+            raise ApiError(400, "instruction is required")
+        lm, lease = self._resolve(req, Usecase.EDIT)
+        try:
+            prompt = lm.evaluator.template_edit(instruction, body.get("input", ""))
+            ids = lm.engine.tokenizer.encode(prompt, add_bos=True)
+            text, final = lm.engine.submit(self._gen_request(lm, body, ids)).result()
+        finally:
+            lease.release()
+        return Response(body={
+            "object": "edit", "created": _now(),
+            "choices": [{"index": 0, "text": text}],
+            "usage": self._usage(final, "extra-usage" in req.headers),
+        })
+
+    # ------------------------------------------------------------------ #
+    # Embeddings / tokenize
+    # ------------------------------------------------------------------ #
+
+    def embeddings(self, req: Request) -> Response:
+        body = req.body or {}
+        inputs = body.get("input", "")
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        if not isinstance(inputs, list) or not inputs:
+            raise ApiError(400, "input must be a non-empty string or array")
+        lm, lease = self._resolve(req, Usecase.EMBEDDINGS)
+        try:
+            tok = lm.engine.tokenizer
+            ids_batch: list[list[int]] = []
+            for item in inputs:
+                if isinstance(item, str):
+                    ids_batch.append(tok.encode(item) or [0])
+                elif isinstance(item, list):  # pre-tokenized input
+                    ids_batch.append([int(t) for t in item] or [0])
+                else:
+                    raise ApiError(400, "input items must be strings or token arrays")
+            vecs = lm.engine.embed(ids_batch)
+        finally:
+            lease.release()
+        n_tokens = sum(len(x) for x in ids_batch)
+        return Response(body={
+            "object": "list", "model": lm.cfg.name,
+            "data": [
+                {"object": "embedding", "index": i, "embedding": [float(x) for x in vec]}
+                for i, vec in enumerate(vecs)
+            ],
+            "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+        })
+
+    def tokenize(self, req: Request) -> Response:
+        body = req.body or {}
+        content = body.get("content", "")
+        lm, lease = self._resolve(req, Usecase.TOKENIZE)
+        try:
+            ids = lm.engine.tokenizer.encode(content)
+        finally:
+            lease.release()
+        return Response(body={"tokens": ids})
+
+    # ------------------------------------------------------------------ #
+    # Introspection
+    # ------------------------------------------------------------------ #
+
+    def list_models(self, req: Request) -> Response:
+        data = [
+            {"id": cfg.name, "object": "model", "created": _now(), "owned_by": "localai-tpu"}
+            for cfg in self.manager.list_configs()
+        ]
+        return Response(body={"object": "list", "data": data})
+
+    def get_model(self, req: Request) -> Response:
+        name = req.params["name"]
+        if self.manager.configs.get(name) is None:
+            raise ApiError(404, f"model {name!r} not found")
+        return Response(body={"id": name, "object": "model", "created": _now(), "owned_by": "localai-tpu"})
+
+    def health(self, req: Request) -> Response:
+        return Response(body={"status": "ok"})
+
+    def version(self, req: Request) -> Response:
+        return Response(body={"version": __version__})
+
+    def system(self, req: Request) -> Response:
+        import jax
+
+        loaded = self.manager.loaded_names()
+        backends = {}
+        for n in loaded:
+            lm = self.manager.peek(n)  # never trigger a load from a monitoring poll
+            if lm is not None:
+                backends[n] = lm.engine.metrics()
+        return Response(body={
+            "backends": backends,
+            "loaded_models": loaded,
+            "configured_models": self.manager.configs.names(),
+            "devices": [str(d) for d in jax.devices()],
+            "uptime_s": time.time() - self.started_at,
+            "version": __version__,
+        })
+
+    def backend_monitor(self, req: Request) -> Response:
+        body = req.body or {}
+        name = body.get("model") or (req.query.get("model") or [None])[0]
+        if not name:
+            raise ApiError(400, "model is required")
+        lm = self.manager.peek(name)
+        if lm is None:
+            raise ApiError(404, f"model {name!r} is not loaded")
+        return Response(body={
+            "model": name,
+            "metrics": lm.engine.metrics(),
+            "loaded_for_s": time.monotonic() - lm.loaded_at,
+            "in_flight": lm.in_flight,
+        })
+
+    def backend_shutdown(self, req: Request) -> Response:
+        body = req.body or {}
+        name = body.get("model")
+        if not name:
+            raise ApiError(400, "model is required")
+        if not self.manager.unload(name):
+            raise ApiError(404, f"model {name!r} is not loaded")
+        return Response(body={"status": "ok"})

@@ -1,0 +1,90 @@
+"""Config tests (reference tier: core/config/model_config_test.go)."""
+
+import os
+
+import pytest
+import yaml
+
+from localai_tpu.config import ApplicationConfig, ModelConfig, ModelConfigLoader, Usecase
+
+
+def test_from_dict_defaults():
+    cfg = ModelConfig.from_dict({"name": "m1", "model": "tiny"})
+    assert cfg.backend == "llama"
+    assert cfg.context_size == 2048
+    assert cfg.has_usecase(Usecase.CHAT)
+    assert cfg.has_usecase(Usecase.COMPLETION)
+    assert not cfg.has_usecase(Usecase.EMBEDDINGS)
+
+
+def test_embeddings_flag_enables_usecase():
+    cfg = ModelConfig.from_dict({"name": "e", "model": "tiny", "embeddings": True})
+    assert cfg.has_usecase(Usecase.EMBEDDINGS)
+
+
+def test_known_usecases_override():
+    cfg = ModelConfig.from_dict({"name": "m", "model": "tiny", "known_usecases": ["chat"]})
+    assert cfg.has_usecase(Usecase.CHAT)
+    assert not cfg.has_usecase(Usecase.COMPLETION)
+
+
+def test_validation_rejects_bad_names():
+    with pytest.raises(ValueError):
+        ModelConfig.from_dict({"name": "bad name!", "model": "x"}).validate()
+    with pytest.raises(ValueError):
+        ModelConfig.from_dict({"name": "ok", "model": "../../etc/passwd"}).validate()
+
+
+def test_extra_options_preserved():
+    cfg = ModelConfig.from_dict({"name": "m", "model": "tiny", "custom_knob": 42})
+    assert cfg.options["custom_knob"] == 42
+
+
+def test_loader_roundtrip(tmp_path):
+    d = tmp_path / "models"
+    d.mkdir()
+    (d / "m1.yaml").write_text(yaml.safe_dump({"name": "m1", "model": "tiny"}))
+    (d / "multi.yaml").write_text(
+        yaml.safe_dump({"models": [{"name": "m2", "model": "tiny"}, {"name": "m3", "model": "tiny-moe"}]})
+    )
+    (d / "noname.yaml").write_text(yaml.safe_dump({"model": "tiny"}))
+    (d / "ignored.txt").write_text("not yaml")
+
+    loader = ModelConfigLoader(str(d))
+    configs = loader.load_all()
+    assert set(configs) == {"m1", "m2", "m3", "noname"}
+
+    # write + reload + delete
+    loader.write(ModelConfig.from_dict({"name": "m4", "model": "tiny"}))
+    assert ModelConfigLoader(str(d)).load_all().keys() >= {"m4"}
+    assert loader.delete("m4")
+    assert "m4" not in ModelConfigLoader(str(d)).load_all()
+
+
+def test_loader_invalid_yaml_raises(tmp_path):
+    d = tmp_path / "models"
+    d.mkdir()
+    (d / "bad.yaml").write_text("{ not: [valid")
+    with pytest.raises(ValueError, match="invalid YAML"):
+        ModelConfigLoader(str(d)).load_all()
+
+
+def test_first_with():
+    loader = ModelConfigLoader("/nonexistent")
+    loader.register(ModelConfig.from_dict({"name": "z-chat", "model": "tiny"}))
+    loader.register(ModelConfig.from_dict({"name": "a-embed", "model": "tiny", "known_usecases": ["embeddings"]}))
+    assert loader.first_with(Usecase.CHAT).name == "z-chat"
+    assert loader.first_with(Usecase.EMBEDDINGS).name == "a-embed"
+    assert loader.first_with(Usecase.TTS) is None
+
+
+def test_app_config_env(monkeypatch):
+    monkeypatch.setenv("LOCALAI_PORT", "9090")
+    monkeypatch.setenv("LOCALAI_API_KEY", "k1, k2")
+    monkeypatch.setenv("LOCALAI_MODELS_PATH", "/tmp/models")
+    cfg = ApplicationConfig.from_env()
+    assert cfg.port == 9090
+    assert cfg.api_keys == ["k1", "k2"]
+    assert cfg.models_dir == "/tmp/models"
+    cfg2 = ApplicationConfig.from_env(port=1234)
+    assert cfg2.port == 1234

@@ -1,0 +1,71 @@
+"""Tool-calling parse tests (reference tier: pkg/functions/parse_test.go)."""
+
+import json
+
+from localai_tpu.config import ModelConfig
+from localai_tpu.functions import parse_function_calls, tools_prompt_for
+
+TOOLS = [
+    {"type": "function", "function": {
+        "name": "get_weather",
+        "description": "Get weather",
+        "parameters": {"type": "object", "properties": {"city": {"type": "string"}}},
+    }}
+]
+
+
+def test_tools_prompt_contains_schema():
+    p = tools_prompt_for(TOOLS)
+    assert "get_weather" in p
+    assert '"city"' in p
+
+
+def test_parse_plain_json():
+    calls = parse_function_calls('{"name": "get_weather", "arguments": {"city": "Rome"}}')
+    assert len(calls) == 1
+    assert calls[0]["function"]["name"] == "get_weather"
+    assert json.loads(calls[0]["function"]["arguments"]) == {"city": "Rome"}
+    assert calls[0]["id"].startswith("call_")
+
+
+def test_parse_json_with_prose():
+    text = 'Sure, let me check.\n{"name": "get_weather", "arguments": {"city": "Oslo"}}\nDone.'
+    calls = parse_function_calls(text)
+    assert len(calls) == 1
+    assert json.loads(calls[0]["function"]["arguments"])["city"] == "Oslo"
+
+
+def test_parse_multiple_calls():
+    text = '{"name": "a", "arguments": {}} {"name": "b", "arguments": {"x": [1, 2]}}'
+    calls = parse_function_calls(text)
+    assert [c["function"]["name"] for c in calls] == ["a", "b"]
+
+
+def test_parse_nested_braces_and_strings():
+    text = '{"name": "f", "arguments": {"s": "has } brace", "o": {"k": 1}}}'
+    calls = parse_function_calls(text)
+    assert len(calls) == 1
+    args = json.loads(calls[0]["function"]["arguments"])
+    assert args["s"] == "has } brace"
+
+
+def test_parse_llama31_tags():
+    text = '<function=search>{"q": "tpu"}</function>'
+    calls = parse_function_calls(text)
+    assert calls[0]["function"]["name"] == "search"
+    assert json.loads(calls[0]["function"]["arguments"]) == {"q": "tpu"}
+
+
+def test_parse_regex_mode():
+    cfg = ModelConfig.from_dict({
+        "name": "m", "model": "tiny",
+        "function_response_regex": r"CALL (?P<name>\w+)\((?P<arguments>.*?)\)",
+    })
+    calls = parse_function_calls('CALL lookup({"id": 7})', cfg)
+    assert calls[0]["function"]["name"] == "lookup"
+    assert json.loads(calls[0]["function"]["arguments"]) == {"id": 7}
+
+
+def test_no_calls_in_plain_text():
+    assert parse_function_calls("just a normal answer") == []
+    assert parse_function_calls('{"not_a_call": true}') == []

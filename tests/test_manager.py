@@ -1,0 +1,107 @@
+"""Model-manager lifecycle tests (reference tier: pkg/model/loader_test.go +
+watchdog_test.go): singleflight, LRU eviction with protection, lease
+semantics, graceful unload drain."""
+
+import threading
+import time
+
+import pytest
+import yaml
+
+from localai_tpu.config import ApplicationConfig
+from localai_tpu.server import ModelManager
+
+
+def _mk_manager(tmp_path, max_active=1, n_models=3):
+    d = tmp_path / "models"
+    d.mkdir()
+    for i in range(n_models):
+        (d / f"m{i}.yaml").write_text(yaml.safe_dump({
+            "name": f"m{i}", "model": "tiny", "context_size": 64,
+            "max_slots": 2, "max_tokens": 4,
+        }))
+    return ModelManager(ApplicationConfig(models_dir=str(d), max_active_models=max_active))
+
+
+def test_singleflight_load(tmp_path):
+    mgr = _mk_manager(tmp_path, max_active=2, n_models=1)
+    results = []
+
+    def load():
+        results.append(mgr.get("m0"))
+
+    threads = [threading.Thread(target=load) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert len(results) == 4
+    assert all(r is results[0] for r in results), "singleflight must return one instance"
+    mgr.shutdown()
+
+
+def test_lru_eviction_protects_new_model(tmp_path):
+    mgr = _mk_manager(tmp_path, max_active=1, n_models=2)
+    lm0 = mgr.get("m0")
+    lm1 = mgr.get("m1")  # must evict m0, never the just-loaded m1
+    assert mgr.peek("m1") is lm1
+    deadline = time.monotonic() + 10
+    while mgr.peek("m0") is not None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert mgr.peek("m0") is None, "LRU should have evicted m0"
+    # The evicted engine's buffers were dropped.
+    deadline = time.monotonic() + 10
+    while lm0.engine.params is not None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert lm0.engine.params is None
+    # The survivor still serves requests.
+    text, ev = lm1.engine.generate([65, 66], max_new_tokens=2, ignore_eos=True)
+    assert ev.kind == "done"
+    mgr.shutdown()
+
+
+def test_busy_model_not_evicted(tmp_path):
+    mgr = _mk_manager(tmp_path, max_active=1, n_models=2)
+    lm0, lease0 = mgr.lease("m0")
+    mgr.get("m1")  # m0 is busy -> cannot evict it; over budget is tolerated
+    assert mgr.peek("m0") is lm0
+    lease0.release()
+    mgr.shutdown()
+
+
+def test_lease_idempotent_release(tmp_path):
+    mgr = _mk_manager(tmp_path, max_active=2, n_models=1)
+    lm, lease = mgr.lease("m0")
+    assert lm.in_flight == 1
+    lease.release()
+    lease.release()
+    lease.release()
+    assert lm.in_flight == 0
+    mgr.shutdown()
+
+
+def test_unload_drains_in_flight(tmp_path):
+    mgr = _mk_manager(tmp_path, max_active=2, n_models=1)
+    lm, lease = mgr.lease("m0")
+    handle = lm.engine.submit(
+        __import__("localai_tpu.engine", fromlist=["GenRequest"]).GenRequest(
+            prompt_ids=[65, 66], max_new_tokens=4, ignore_eos=True
+        )
+    )
+    assert mgr.unload("m0")
+    assert mgr.peek("m0") is None  # immediately deregistered
+    # The in-flight stream still completes (drain waits for the lease).
+    events = list(handle)
+    assert events[-1].kind == "done"
+    lease.release()
+    deadline = time.monotonic() + 10
+    while lm.engine.params is not None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert lm.engine.params is None, "teardown should run after drain"
+
+
+def test_get_unknown_model_raises(tmp_path):
+    mgr = _mk_manager(tmp_path, n_models=1)
+    with pytest.raises(KeyError):
+        mgr.get("nope")
+    mgr.shutdown()

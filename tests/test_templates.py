@@ -1,0 +1,92 @@
+"""Template evaluator tests (reference tier: core/templates/evaluator_test.go)."""
+
+from localai_tpu.config import ModelConfig
+from localai_tpu.templates import Evaluator
+from localai_tpu.templates.evaluator import normalize_messages
+
+
+def _cfg(**tmpl) -> ModelConfig:
+    return ModelConfig.from_dict({"name": "t", "model": "tiny", "template": tmpl})
+
+
+MSGS = [
+    {"role": "system", "content": "be brief"},
+    {"role": "user", "content": "hi"},
+]
+
+
+def test_family_llama3():
+    out = Evaluator(_cfg(family="llama3")).template_messages(MSGS)
+    assert "<|start_header_id|>system<|end_header_id|>\n\nbe brief<|eot_id|>" in out
+    assert out.endswith("<|start_header_id|>assistant<|end_header_id|>\n\n")
+
+
+def test_family_chatml_default():
+    out = Evaluator(_cfg()).template_messages(MSGS)
+    assert "<|im_start|>user\nhi<|im_end|>" in out
+    assert out.endswith("<|im_start|>assistant\n")
+
+
+def test_family_mistral():
+    out = Evaluator(_cfg(family="mistral")).template_messages([{"role": "user", "content": "q"}])
+    assert "[INST] q [/INST]" in out
+
+
+def test_custom_chat_template():
+    out = Evaluator(_cfg(chat="{% for m in messages %}<{{ m.role }}>{{ m.content }}{% endfor %}GO")).template_messages(MSGS)
+    assert out == "<system>be brief<user>hiGO"
+
+
+def test_custom_chat_message_template():
+    ev = Evaluator(_cfg(chat_message="{{ role }}|{{ content }}"))
+    out = ev.template_messages([{"role": "user", "content": "x"}])
+    assert out.startswith("user|x")
+
+
+def test_system_prompt_injection():
+    cfg = _cfg(family="chatml")
+    cfg.system_prompt = "SYS"
+    out = Evaluator(cfg).template_messages([{"role": "user", "content": "q"}])
+    assert "<|im_start|>system\nSYS<|im_end|>" in out
+
+
+def test_tools_prompt_merged_into_system():
+    out = Evaluator(_cfg(family="chatml")).template_messages(MSGS, tools_prompt="TOOLS")
+    assert "be brief\nTOOLS" in out
+    # No system message: tools prompt becomes one.
+    out2 = Evaluator(_cfg(family="chatml")).template_messages(
+        [{"role": "user", "content": "q"}], tools_prompt="TOOLS"
+    )
+    assert "<|im_start|>system\nTOOLS" in out2
+
+
+def test_normalize_content_parts():
+    msgs = normalize_messages(
+        [{"role": "user", "content": [{"type": "text", "text": "a"}, {"type": "image_url", "image_url": {}}, {"type": "text", "text": "b"}]}]
+    )
+    assert msgs[0]["content"] == "a\nb"
+
+
+def test_normalize_tool_calls():
+    msgs = normalize_messages(
+        [{"role": "assistant", "content": None,
+          "tool_calls": [{"function": {"name": "f", "arguments": '{"x": 1}'}}]}]
+    )
+    assert '"name": "f"' in msgs[0]["content"]
+
+
+def test_completion_and_edit():
+    ev = Evaluator(_cfg(completion="PRE {{ input }} POST"))
+    assert ev.template_completion("abc") == "PRE abc POST"
+    ev2 = Evaluator(_cfg())
+    assert ev2.template_completion("abc") == "abc"
+    out = ev2.template_edit("fix", "txt")
+    assert "fix" in out and "txt" in out
+
+
+def test_stop_sequences_by_family():
+    assert "<|im_end|>" in Evaluator(_cfg(family="chatml")).stop_sequences()
+    cfg = _cfg(family="llama3")
+    cfg.stop = ["CUSTOM"]
+    stops = Evaluator(cfg).stop_sequences()
+    assert "CUSTOM" in stops and "<|eot_id|>" in stops
