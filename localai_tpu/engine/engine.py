@@ -72,6 +72,12 @@ class GenRequest:
     seed: Optional[int] = None
     ignore_eos: bool = False
     logit_bias: dict[int, float] = dataclasses.field(default_factory=dict)
+    # Grammar-constrained decoding (localai_tpu.functions.jsonschema
+    # GrammarConstraint): the engine picks the best valid token from the
+    # model's top-k candidates each step and may emit EOS only when the
+    # grammar is complete. Penalty counts track sampled (not overridden)
+    # tokens for these requests — an accepted approximation.
+    grammar: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -185,6 +191,8 @@ class Engine:
             "frequency_penalty": np.zeros((B,), np.float32),
         }
         self.slots: list[Optional[_Slot]] = [None] * B
+        self._tok_strs: Optional[list[str]] = None  # lazy grammar cache
+        self.grammar_topk = 64
 
         self._pending: deque[tuple[GenRequest, RequestHandle]] = deque()
         self._pending_lock = threading.Lock()
@@ -217,21 +225,44 @@ class Engine:
             counts = counts.at[slot].set(prompt_counts)
             return cache, counts
 
-        @partial(jax.jit, donate_argnums=(3,))
-        def _first_sample(logits, rng, sampling, counts_row, bias_row):
+        topk_k = min(self.grammar_topk, cfg.vocab_size)
+
+        def _first_sample_impl(logits, rng, sampling, counts_row, bias_row, with_topk):
             tok = sample(logits, rng[None], sampling, counts_row, bias_row)
             counts_row = counts_row.at[0, tok[0]].add(1)
-            return tok[0], counts_row
+            if not with_topk:
+                return tok[0], counts_row
+            _, tk_ids = jax.lax.top_k(logits + bias_row, topk_k)
+            return tok[0], counts_row, tk_ids[0]
 
-        @partial(jax.jit, donate_argnums=(1, 2, 3))
-        def _decode(params, cache, counts, rngs, bias, tokens, positions, active, sampling):
+        _first_sample = jax.jit(
+            partial(_first_sample_impl, with_topk=False), donate_argnums=(3,)
+        )
+        _first_sample_topk = jax.jit(
+            partial(_first_sample_impl, with_topk=True), donate_argnums=(3,)
+        )
+
+        def _decode_impl(params, cache, counts, rngs, bias, tokens, positions, active, sampling, with_topk):
             logits, cache = llama.decode_step(cfg, params, tokens, positions, cache)
             split = jax.vmap(lambda k: jax.random.split(k, 2))(rngs)
             rngs, draw = split[:, 0], split[:, 1]
             nxt = sample(logits, draw, sampling, counts, bias)
             counts = counts.at[jnp.arange(tokens.shape[0]), nxt].add(active.astype(jnp.int32))
             nxt = jnp.where(active, nxt, 0)
-            return nxt, cache, counts, rngs
+            if not with_topk:
+                return nxt, cache, counts, rngs
+            # Candidates for grammar-constrained slots, walked host-side in
+            # probability order (tiny [B, K] transfer). Compiled as a separate
+            # program so grammar-free serving never pays the vocab sort.
+            _, tk_ids = jax.lax.top_k(logits + bias, topk_k)
+            return nxt, cache, counts, rngs, tk_ids
+
+        _decode = jax.jit(
+            partial(_decode_impl, with_topk=False), donate_argnums=(1, 2, 3)
+        )
+        _decode_topk = jax.jit(
+            partial(_decode_impl, with_topk=True), donate_argnums=(1, 2, 3)
+        )
 
         @partial(jax.jit)
         def _embed(params, tokens, lengths):
@@ -240,7 +271,9 @@ class Engine:
         self._prefill_fn = _prefill
         self._insert_fn = _insert
         self._first_sample_fn = _first_sample
+        self._first_sample_topk_fn = _first_sample_topk
         self._decode_fn = _decode
+        self._decode_topk_fn = _decode_topk
         self._embed_fn = _embed
 
     # ------------------------------------------------------------------ #
@@ -265,6 +298,8 @@ class Engine:
         limit = self.ecfg.max_seq - 1
         if len(request.prompt_ids) > limit:
             request.prompt_ids = request.prompt_ids[-limit:]
+        if request.grammar is not None and self._tok_strs is None:
+            self._token_str(0)  # build the table here, not in the engine loop
         handle = RequestHandle()
         with self._pending_lock:
             self._pending.append((request, handle))
@@ -297,10 +332,23 @@ class Engine:
             "queue_depth": float(len(self._pending)),
         }
 
-    def warmup(self, prompt_len: int = 8) -> None:
-        """Compile prefill (smallest bucket) + decode before serving."""
+    def warmup(self, prompt_len: int = 8, grammar: bool = False) -> None:
+        """Compile prefill (smallest bucket) + decode before serving.
+
+        With grammar=True, also compiles the top-k decode variants and builds
+        the token-string table, so the first constrained request doesn't stall
+        every active slot on a mid-serving XLA compile."""
         _, ev = self.generate([1] * prompt_len, max_new_tokens=2)
         assert ev.kind == "done"
+        if grammar:
+            from localai_tpu.functions.jsonschema import GrammarConstraint
+
+            self._token_str(0)  # build the table outside the engine loop
+            _, ev = self.generate(
+                [1] * prompt_len, max_new_tokens=4,
+                grammar=GrammarConstraint({"type": "boolean"}),
+            )
+            assert ev.kind == "done"
 
     # ------------------------------------------------------------------ #
     # Engine loop
@@ -382,11 +430,17 @@ class Engine:
         # First token comes from the prefill logits.
         sampling1 = SamplingParams.make(1, **row)
         key = jax.random.fold_in(jax.random.key(seed), 0)
-        tok, counts_row = self._first_sample_fn(
-            logits, key, sampling1, self.counts[slot_idx][None], self.bias[slot_idx][None]
-        )
-        self.counts = self.counts.at[slot_idx].set(counts_row[0])
-        tok = int(tok)
+        fs_args = (logits, key, sampling1, self.counts[slot_idx][None], self.bias[slot_idx][None])
+        if request.grammar is not None:
+            tok, counts_row, tk_ids = self._first_sample_topk_fn(*fs_args)
+            self.counts = self.counts.at[slot_idx].set(counts_row[0])
+            tok = self._grammar_choose(request, int(tok), np.asarray(tk_ids))
+            if tok is None:
+                raise RuntimeError("grammar admits no token from this model's vocabulary")
+        else:
+            tok, counts_row = self._first_sample_fn(*fs_args)
+            self.counts = self.counts.at[slot_idx].set(counts_row[0])
+            tok = int(tok)
 
         slot = _Slot(request=request, handle=handle, prompt_len=len(ids), t_submit=t0)
         slot.t_first = time.monotonic()
@@ -400,11 +454,22 @@ class Engine:
     def _step(self) -> None:
         t0 = time.monotonic()
         sampling = SamplingParams(**{k: jnp.asarray(v) for k, v in self.h_sampling.items()})
-        nxt, self.cache, self.counts, self.rngs = self._decode_fn(
+        grammar_active = any(
+            self.h_active[i] and self.slots[i] is not None
+            and self.slots[i].request.grammar is not None
+            for i in range(self.ecfg.max_slots)
+        )
+        args = (
             self.params, self.cache, self.counts, self.rngs, self.bias,
             jnp.asarray(self.h_tokens), jnp.asarray(self.h_positions),
             jnp.asarray(self.h_active), sampling,
         )
+        tk_ids = None
+        if grammar_active:
+            nxt, self.cache, self.counts, self.rngs, tk_ids = self._decode_topk_fn(*args)
+            tk_ids = np.asarray(tk_ids)
+        else:
+            nxt, self.cache, self.counts, self.rngs = self._decode_fn(*args)
         nxt = np.asarray(nxt)
         n_active = int(self.h_active.sum())
         self._decode_time += time.monotonic() - t0
@@ -415,8 +480,77 @@ class Engine:
                 continue
             self.h_positions[i] += 1
             tok = int(nxt[i])
+            slot = self.slots[i]
+            if slot is not None and slot.request.grammar is not None and tk_ids is not None:
+                chosen = self._grammar_choose(slot.request, tok, tk_ids[i])
+                if chosen is None:
+                    slot.handle._q.put(TokenEvent(
+                        kind="error", error="grammar admits no token from the candidate set"
+                    ))
+                    self.slots[i] = None
+                    self.h_active[i] = False
+                    continue
+                tok = chosen
             self.h_tokens[i] = tok
             self._post_token(i, tok)
+
+    # ------------------------------------------------------------------ #
+    # Grammar-constrained decoding
+    # ------------------------------------------------------------------ #
+
+    def _token_str(self, tok: int) -> str:
+        if self._tok_strs is None:
+            self._tok_strs = self.tokenizer.token_strings()
+        return self._tok_strs[tok] if 0 <= tok < len(self._tok_strs) else ""
+
+    def _grammar_choose(self, request: GenRequest, sampled: int, candidates: np.ndarray) -> Optional[int]:
+        """Pick the highest-probability grammar-valid token.
+
+        The sampled token keeps priority (preserves temperature sampling when
+        the model already follows the grammar); otherwise candidates are
+        walked in probability order; EOS is valid only once the grammar is
+        complete. Falls back to a full-vocab scan before giving up.
+        """
+        g = request.grammar
+        complete = g.complete()
+
+        def ok(tok: int) -> bool:
+            if tok in self.tokenizer.eos_ids:
+                return complete
+            return g.allowed(self._token_str(tok))
+
+        if ok(sampled):
+            self._grammar_advance(g, sampled)
+            return sampled
+        for tok in candidates.tolist():
+            if tok == sampled:
+                continue
+            if ok(tok):
+                self._grammar_advance(g, int(tok))
+                return int(tok)
+        # Rare fallback: full-vocab scan, pre-filtered by a per-first-char
+        # probe cache so the expensive machine clone runs only on tokens whose
+        # first char is currently legal (bounds clones to |charset|, not |V|).
+        first_char_ok: dict[str, bool] = {}
+        for tok in range(self.cfg.vocab_size):
+            s = self._token_str(tok)
+            if not s:
+                continue
+            c = s[0]
+            if c not in first_char_ok:
+                first_char_ok[c] = g.allowed(c)
+            if not first_char_ok[c]:
+                continue
+            if g.allowed(s):
+                self._grammar_advance(g, tok)
+                return tok
+        if complete:
+            return next(iter(self.tokenizer.eos_ids), None)
+        return None
+
+    def _grammar_advance(self, g, tok: int) -> None:
+        if tok not in self.tokenizer.eos_ids:
+            g.advance(self._token_str(tok))
 
     def _post_token(self, slot_idx: int, tok: int) -> None:
         """Append one generated token to a slot: stream text, check stops."""
@@ -450,6 +584,8 @@ class Engine:
             if cut is not None:
                 new = text[slot.emitted_len: cut]
                 finish = "stop"
+        if finish is None and r.grammar is not None and r.grammar.strictly_complete():
+            finish = "stop"  # constrained output can no longer be extended — done
         if finish is None and (
             len(slot.generated) >= r.max_new_tokens
             or slot.prompt_len + len(slot.generated) >= self.ecfg.max_seq

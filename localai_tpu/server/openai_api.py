@@ -151,16 +151,40 @@ class OpenAIApi:
 
     def _chat_inner(self, req: Request, lm: LoadedModel, lease, body: dict[str, Any]) -> Response | SSEStream:
         from localai_tpu.functions import tools_prompt_for, parse_function_calls
+        from localai_tpu.functions.jsonschema import GrammarConstraint, tool_call_schema
 
         tools = body.get("tools") or []
         if body.get("functions"):  # legacy field
             tools = [{"type": "function", "function": f} for f in body["functions"]]
+        tool_choice = body.get("tool_choice")
+        if tool_choice == "none":
+            tools = []
         tprompt = tools_prompt_for(tools) if tools else ""
+
+        # Constrained decoding (reference: chat.go:224-253 grammar generation
+        # for tools / response_format; here a token-mask grammar).
+        grammar = None
+        rf = body.get("response_format") or {}
+        if rf.get("type") == "json_object":
+            grammar = GrammarConstraint({"type": "object"})
+        elif rf.get("type") == "json_schema":
+            schema = (rf.get("json_schema") or {}).get("schema") or {}
+            grammar = GrammarConstraint(schema)
+        if tools and (tool_choice == "required" or isinstance(tool_choice, dict)):
+            selected = tools
+            if isinstance(tool_choice, dict):
+                fname = (tool_choice.get("function") or {}).get("name")
+                named = [t for t in tools if (t.get("function") or {}).get("name") == fname]
+                if not named:
+                    raise ApiError(400, f"tool_choice names unknown function {fname!r}")
+                selected = named
+            grammar = GrammarConstraint(tool_call_schema(selected))
 
         prompt = lm.evaluator.template_messages(body["messages"], tools_prompt=tprompt)
         add_bos = not lm.cfg.template.use_tokenizer_template
         ids = lm.engine.tokenizer.encode(prompt, add_bos=add_bos)
         gen = self._gen_request(lm, body, ids, extra_stop=lm.evaluator.stop_sequences())
+        gen.grammar = grammar
 
         rid = f"chatcmpl-{uuid.uuid4().hex[:28]}"
         created = _now()

@@ -1,0 +1,208 @@
+"""JSON-schema constraint machine tests (reference tier: pkg/functions
+grammars tests). Plus engine-level constrained decoding."""
+
+import json
+
+import jax
+import pytest
+
+from localai_tpu.functions.jsonschema import (
+    GrammarConstraint,
+    JsonSchemaMachine,
+    tool_call_schema,
+)
+
+
+def accepts(schema, text) -> bool:
+    m = JsonSchemaMachine(schema)
+    return m.feed_text(text)
+
+
+def completes(schema, text) -> bool:
+    m = JsonSchemaMachine(schema)
+    return m.feed_text(text) and m.is_complete()
+
+
+# ---------------------------------------------------------------------- #
+# Machine unit tests
+# ---------------------------------------------------------------------- #
+
+def test_any_json():
+    for text in ['{"a": 1}', "[1, 2, 3]", '"hi"', "42", "-3.5e2", "true", "null"]:
+        assert completes({}, text), text
+
+
+def test_rejects_invalid_json():
+    for text in ["{a: 1}", "[1,]", "tru", "01", "--1", '{"a" 1}', "}"]:
+        m = JsonSchemaMachine({})
+        ok = m.feed_text(text) and m.is_complete()
+        assert not ok, text
+
+
+def test_string_escapes():
+    assert completes({"type": "string"}, '"a\\n\\"b\\u00e9"')
+    assert not accepts({"type": "string"}, '"a\\x"')
+
+
+def test_number_vs_integer():
+    assert completes({"type": "number"}, "3.14")
+    assert completes({"type": "integer"}, "-7")
+    assert not accepts({"type": "integer"}, "3.")
+    m = JsonSchemaMachine({"type": "integer"})
+    assert m.feed_text("3")
+    assert m.is_complete()  # trailing-number acceptance
+
+
+def test_enum_and_const():
+    schema = {"enum": ["red", "green", 3]}
+    assert completes(schema, '"red"')
+    assert completes(schema, "3")
+    assert not accepts(schema, '"blue"')
+    assert completes({"const": "x"}, '"x"')
+
+
+def test_object_properties_and_required():
+    schema = {
+        "type": "object",
+        "properties": {"name": {"type": "string"}, "age": {"type": "integer"}},
+        "required": ["name"],
+    }
+    assert completes(schema, '{"name": "bo"}')
+    assert completes(schema, '{"age": 3, "name": "bo"}')
+    # closing without required key is invalid
+    assert not completes(schema, '{"age": 3}')
+    # undeclared key rejected (closed object by default)
+    assert not accepts(schema, '{"nope"')
+    # wrong value type rejected
+    assert not accepts(schema, '{"age": "old"')
+
+
+def test_object_key_prefix_disambiguation():
+    schema = {
+        "type": "object",
+        "properties": {"a": {"type": "integer"}, "ab": {"type": "integer"}},
+    }
+    assert completes(schema, '{"a": 1}')
+    assert completes(schema, '{"ab": 2}')
+    assert completes(schema, '{"a": 1, "ab": 2}')
+    # the same key cannot repeat
+    assert not accepts(schema, '{"a": 1, "a"')
+
+
+def test_additional_properties():
+    schema = {"type": "object", "additionalProperties": {"type": "integer"}}
+    assert completes(schema, '{"anything": 5}')
+    assert not accepts(schema, '{"anything": "s"')
+
+
+def test_array_items_and_bounds():
+    schema = {"type": "array", "items": {"type": "integer"}, "minItems": 2, "maxItems": 3}
+    assert completes(schema, "[1, 2]")
+    assert completes(schema, "[1, 2, 3]")
+    assert not completes(schema, "[1]")
+    assert not accepts(schema, "[1, 2, 3, 4")
+    assert not accepts(schema, '["s"')
+
+
+def test_nested_structures():
+    schema = {
+        "type": "object",
+        "properties": {
+            "user": {
+                "type": "object",
+                "properties": {"tags": {"type": "array", "items": {"type": "string"}}},
+                "required": ["tags"],
+            }
+        },
+        "required": ["user"],
+    }
+    assert completes(schema, '{"user": {"tags": ["a", "b"]}}')
+    assert not accepts(schema, '{"user": {"tags": [1')
+
+
+def test_whitespace_tolerated():
+    assert completes({"type": "object", "properties": {"a": {"type": "integer"}}},
+                     '{ "a" : 1 }')
+
+
+def test_tool_call_schema():
+    tools = [{"type": "function", "function": {
+        "name": "get_weather",
+        "parameters": {"type": "object", "properties": {"city": {"type": "string"}},
+                       "required": ["city"]},
+    }}]
+    schema = tool_call_schema(tools)
+    good = '{"name": "get_weather", "arguments": {"city": "Rome"}}'
+    assert completes(schema, good)
+    assert not accepts(schema, '{"name": "other"')
+    assert not accepts(schema, '{"name": "get_weather", "arguments": {"city": 3')
+
+
+# ---------------------------------------------------------------------- #
+# Constraint wrapper + engine integration
+# ---------------------------------------------------------------------- #
+
+def test_strictly_complete_vs_complete():
+    g = GrammarConstraint({"type": "integer"})
+    g.advance("12")
+    assert g.complete()  # EOS would be legal here
+    assert not g.strictly_complete()  # but "123" is still reachable — no cut
+    assert g.allowed("3")
+    h = GrammarConstraint({"type": "object", "properties": {}})
+    h.advance("{}")
+    assert h.strictly_complete()  # nothing can follow a closed object
+
+
+def test_grammar_constraint_clone_semantics():
+    g = GrammarConstraint({"type": "boolean"})
+    assert g.allowed("tr")
+    assert g.allowed("false")
+    assert not g.complete()
+    # allowed() must not mutate state
+    assert g.advance("tr")
+    assert g.allowed("ue")
+    assert not g.allowed("x")
+    assert g.advance("ue")
+    assert g.complete()
+
+
+@pytest.fixture(scope="module")
+def engine():
+    from localai_tpu.engine import ByteTokenizer, Engine, EngineConfig
+    from localai_tpu.models import get_arch
+    from localai_tpu.models.llama import init_params
+
+    cfg = get_arch("tiny")
+    eng = Engine(cfg, init_params(cfg, jax.random.key(0)), ByteTokenizer(cfg.vocab_size),
+                 engine_cfg=EngineConfig(max_slots=2, max_seq=128, min_prefill_bucket=16))
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def test_engine_constrained_decode_valid_json(engine):
+    from localai_tpu.engine import GenRequest
+
+    schema = {"type": "object",
+              "properties": {"ok": {"type": "boolean"}}, "required": ["ok"]}
+    handle = engine.submit(GenRequest(
+        prompt_ids=[65, 66, 67], max_new_tokens=64,
+        grammar=GrammarConstraint(schema),
+    ))
+    text, final = handle.result()
+    assert final.finish_reason == "stop", (text, final)
+    parsed = json.loads(text)
+    assert isinstance(parsed["ok"], bool)
+
+
+def test_engine_constrained_decode_with_sampling(engine):
+    from localai_tpu.engine import GenRequest
+
+    schema = {"type": "array", "items": {"type": "integer"}, "minItems": 1, "maxItems": 3}
+    text, final = engine.submit(GenRequest(
+        prompt_ids=[80, 81], max_new_tokens=64, temperature=0.9, seed=7,
+        grammar=GrammarConstraint(schema),
+    )).result()
+    parsed = json.loads(text)
+    assert isinstance(parsed, list) and 1 <= len(parsed) <= 3
+    assert all(isinstance(x, int) for x in parsed)
