@@ -83,10 +83,12 @@ def main(argv: list[str] | None = None) -> int:
 
     from localai_tpu.server import ModelManager, Router, create_server
     from localai_tpu.server.openai_api import OpenAIApi
+    from localai_tpu.server.stores_api import StoresApi
 
     manager = ModelManager(app_cfg)
     router = Router()
     OpenAIApi(manager).register(router)
+    StoresApi().register(router)
 
     for name in app_cfg.preload_models:
         log.info("preloading model %s", name)

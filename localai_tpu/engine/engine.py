@@ -532,7 +532,10 @@ class Engine:
         # probe cache so the expensive machine clone runs only on tokens whose
         # first char is currently legal (bounds clones to |charset|, not |V|).
         first_char_ok: dict[str, bool] = {}
+        eos_ids = set(self.tokenizer.eos_ids)
         for tok in range(self.cfg.vocab_size):
+            if tok in eos_ids:  # EOS stays gated on grammar completion
+                continue
             s = self._token_str(tok)
             if not s:
                 continue

@@ -88,7 +88,37 @@ class HFTokenizer:
         return self._tok.decode(list(ids), skip_special_tokens=True)
 
     def token_strings(self) -> list[str]:
-        return [self._tok.decode([i]) for i in range(self.vocab_size)]
+        """Each token's contribution to a joint decode.
+
+        decode([i]) alone is wrong for SentencePiece ("▁34" → "34", losing
+        the space the joint decode emits) and for byte-level BPE ("Ġword").
+        Map the raw token pieces instead: "▁"→space for SP; the GPT-2 byte
+        decoder for byte-level BPE. Special tokens map to "" so grammar-
+        constrained decoding never selects them as text.
+        """
+        toks = self._tok.convert_ids_to_tokens(list(range(self.vocab_size)))
+        specials = set(getattr(self._tok, "all_special_ids", []) or [])
+        specials.update(self.eos_ids)
+        byte_level = any(t is not None and "Ġ" in t for t in toks[:4096])
+        byte_decoder = _gpt2_byte_decoder() if byte_level else None
+        out: list[str] = []
+        for i, t in enumerate(toks):
+            if t is None or i in specials:
+                out.append("")
+            elif byte_decoder is not None:
+                try:
+                    out.append(
+                        bytes(byte_decoder[c] for c in t).decode("utf-8", "replace")
+                    )
+                except KeyError:
+                    out.append("")  # non-byte-level piece (added token)
+            elif "▁" in t:
+                out.append(t.replace("▁", " "))
+            elif t.startswith("<0x") and t.endswith(">") and len(t) == 6:
+                out.append(bytes([int(t[3:5], 16)]).decode("utf-8", "replace"))
+            else:
+                out.append(t)
+        return out
 
     @property
     def chat_template(self) -> str | None:
@@ -98,6 +128,19 @@ class HFTokenizer:
         return self._tok.apply_chat_template(
             messages, tokenize=False, add_generation_prompt=add_generation_prompt
         )
+
+
+def _gpt2_byte_decoder() -> dict[str, int]:
+    """Inverse of the GPT-2 bytes→unicode table used by byte-level BPE."""
+    bs = list(range(ord("!"), ord("~") + 1)) + list(range(0xA1, 0xAD)) + list(range(0xAE, 0x100))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {chr(c): b for b, c in zip(bs, cs)}
 
 
 def load_tokenizer(path: str | None, vocab_size: int = 512) -> Tokenizer:

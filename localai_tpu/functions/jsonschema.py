@@ -271,6 +271,8 @@ class _Object(_Frame):
             return REJECT
         if s == "colon":
             if ch == ":":
+                if self.current_key in self.seen:
+                    return REJECT  # duplicate key via the additionalProperties path
                 self.seen.add(self.current_key)
                 schema = self.props.get(self.current_key)
                 if schema is None:

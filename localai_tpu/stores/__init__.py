@@ -1,0 +1,9 @@
+"""Vector stores: the /stores/{set,get,delete,find} capability.
+
+Reference: backend/go/local-store/store.go:18-47 (in-memory brute-force store
+behind the Stores* RPCs; cosine similarity with a normalized fast path) and
+pkg/store/client.go. TPU-native difference: similarity search is one batched
+matmul — exactly what the MXU is for — instead of a Go loop over entries.
+"""
+
+from localai_tpu.stores.store import StoreRegistry, VectorStore  # noqa: F401

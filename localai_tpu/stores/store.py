@@ -1,0 +1,138 @@
+"""In-memory vector store with JAX-accelerated cosine top-k.
+
+Semantics mirror backend/go/local-store/store.go:
+- set: upsert by exact key (float bit-pattern equality);
+- get/delete: exact-key lookup;
+- find: cosine-similarity top-k, with the normalized fast path (when every
+  stored vector and the query are unit-norm, cosine == dot product and the
+  normalization divide is skipped — store.go's `normalized` flag).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+
+class VectorStore:
+    def __init__(self, dim: Optional[int] = None):
+        self.dim = dim
+        self._lock = threading.Lock()
+        self._keys: np.ndarray = np.zeros((0, 0), np.float32)
+        self._values: list[bytes] = []
+        self._index: dict[bytes, int] = {}  # key bytes -> row
+        self._all_normalized = True
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def _check_dim(self, arr: np.ndarray) -> None:
+        if self.dim is None:
+            self.dim = arr.shape[-1]
+            self._keys = np.zeros((0, self.dim), np.float32)
+        elif arr.shape[-1] != self.dim:
+            raise ValueError(f"key dim {arr.shape[-1]} != store dim {self.dim}")
+
+    def set(self, keys: np.ndarray, values: list[bytes]) -> None:
+        keys = np.asarray(keys, np.float32)
+        if keys.ndim != 2 or len(keys) != len(values):
+            raise ValueError("keys must be [N, D] with one value per key")
+        with self._lock:
+            self._check_dim(keys)
+            new_rows: list[np.ndarray] = []
+            for k, v in zip(keys, values):
+                kb = k.tobytes()
+                row = self._index.get(kb)
+                if row is not None:
+                    self._values[row] = v  # upsert (also dedupes within a batch)
+                else:
+                    self._index[kb] = len(self._values)
+                    self._values.append(v)
+                    new_rows.append(k)
+            if new_rows:
+                stacked = np.stack(new_rows)
+                self._keys = np.concatenate([self._keys, stacked], axis=0)
+                # Incremental: only the new rows need checking (O(new), not O(N)).
+                self._all_normalized = self._all_normalized and bool(
+                    np.allclose(np.linalg.norm(stacked, axis=-1), 1.0, atol=1e-3)
+                )
+
+    def get(self, keys: np.ndarray) -> list[Optional[bytes]]:
+        keys = np.asarray(keys, np.float32)
+        with self._lock:
+            out = []
+            for k in keys:
+                row = self._index.get(k.tobytes())
+                out.append(self._values[row] if row is not None else None)
+            return out
+
+    def delete(self, keys: np.ndarray) -> int:
+        keys = np.asarray(keys, np.float32)
+        with self._lock:
+            rows = sorted(
+                {r for k in keys if (r := self._index.get(k.tobytes())) is not None},
+                reverse=True,
+            )
+            if not rows:
+                return 0
+            keep = np.ones(len(self._values), bool)
+            for r in rows:
+                keep[r] = False
+            self._keys = self._keys[keep]
+            self._values = [v for i, v in enumerate(self._values) if keep[i]]
+            self._index = {k.tobytes(): i for i, k in enumerate(self._keys)}
+            if not self._all_normalized and len(self._keys):
+                # Removing the offending rows may restore the fast path.
+                self._all_normalized = bool(
+                    np.allclose(np.linalg.norm(self._keys, axis=-1), 1.0, atol=1e-3)
+                )
+            elif not len(self._keys):
+                self._all_normalized = True
+            return len(rows)
+
+    def find(self, key: np.ndarray, topk: int) -> tuple[np.ndarray, list[bytes], np.ndarray]:
+        """Returns (keys [K, D], values, similarities [K]) sorted descending."""
+        import jax.numpy as jnp
+
+        q = np.asarray(key, np.float32).reshape(-1)
+        with self._lock:
+            if not len(self._values):
+                return np.zeros((0, self.dim or len(q)), np.float32), [], np.zeros((0,), np.float32)
+            if self.dim is not None and len(q) != self.dim:
+                raise ValueError(f"query dim {len(q)} != store dim {self.dim}")
+            mat = self._keys
+            values = list(self._values)
+            normalized = self._all_normalized  # snapshot with mat, same lock
+        k = min(topk, len(values))
+        if normalized:
+            sims = jnp.asarray(mat) @ jnp.asarray(q)  # cosine == dot (fast path)
+        else:
+            qn = q / max(float(np.linalg.norm(q)), 1e-9)
+            norms = jnp.linalg.norm(jnp.asarray(mat), axis=-1).clip(1e-9)
+            sims = (jnp.asarray(mat) @ jnp.asarray(qn)) / norms
+        import jax
+
+        vals, idx = jax.lax.top_k(sims, k)
+        idx = np.asarray(idx)
+        return mat[idx], [values[i] for i in idx], np.asarray(vals)
+
+
+class StoreRegistry:
+    """Named stores, created on first use (reference: one store per loaded
+    local-store backend instance; here a name → store map)."""
+
+    def __init__(self) -> None:
+        self._stores: dict[str, VectorStore] = {}
+        self._lock = threading.Lock()
+
+    def get(self, name: str = "") -> VectorStore:
+        with self._lock:
+            if name not in self._stores:
+                self._stores[name] = VectorStore()
+            return self._stores[name]
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._stores)

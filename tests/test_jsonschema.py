@@ -93,6 +93,12 @@ def test_additional_properties():
     schema = {"type": "object", "additionalProperties": {"type": "integer"}}
     assert completes(schema, '{"anything": 5}')
     assert not accepts(schema, '{"anything": "s"')
+    # duplicate keys rejected even through the additionalProperties path
+    assert not accepts(schema, '{"k": 1, "k"' + ":")
+    mixed = {"type": "object", "properties": {"a": {"type": "integer"}},
+             "additionalProperties": {"type": "string"}}
+    assert completes(mixed, '{"a": 1, "b": "x"}')
+    assert not accepts(mixed, '{"a": 1, "a":')
 
 
 def test_array_items_and_bounds():

@@ -1,0 +1,110 @@
+"""Vector store tests (reference tier: tests/integration/stores_test.go:79-316
+— set/get/delete/find + cosine-similarity math, normalized and unnormalized)."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from localai_tpu.stores import StoreRegistry, VectorStore
+
+
+def test_set_get_delete():
+    s = VectorStore()
+    keys = np.eye(3, dtype=np.float32)
+    s.set(keys, [b"a", b"b", b"c"])
+    assert len(s) == 3
+    got = s.get(keys[:2])
+    assert got == [b"a", b"b"]
+    assert s.get(np.array([[0.5, 0.5, 0.0]], np.float32)) == [None]
+    # upsert
+    s.set(keys[:1], [b"a2"])
+    assert len(s) == 3
+    assert s.get(keys[:1]) == [b"a2"]
+    # delete
+    assert s.delete(keys[1:2]) == 1
+    assert len(s) == 2
+    assert s.get(keys[1:2]) == [None]
+    # survivors intact after compaction
+    assert s.get(keys[2:3]) == [b"c"]
+
+
+def test_find_cosine_normalized():
+    s = VectorStore()
+    keys = np.array([[1, 0], [0, 1], [0.70710678, 0.70710678]], np.float32)
+    s.set(keys, [b"x", b"y", b"xy"])
+    found_keys, values, sims = s.find(np.array([1.0, 0.0], np.float32), 2)
+    assert values[0] == b"x"
+    assert sims[0] == pytest.approx(1.0, abs=1e-5)
+    assert values[1] == b"xy"
+    assert sims[1] == pytest.approx(0.70710678, abs=1e-5)
+
+
+def test_find_cosine_unnormalized():
+    s = VectorStore()
+    keys = np.array([[2, 0], [0, 3]], np.float32)  # not unit norm
+    s.set(keys, [b"x", b"y"])
+    _, values, sims = s.find(np.array([4.0, 0.0], np.float32), 2)
+    assert values[0] == b"x"
+    assert sims[0] == pytest.approx(1.0, abs=1e-4)  # cosine ignores magnitude
+    assert sims[1] == pytest.approx(0.0, abs=1e-4)
+
+
+def test_find_empty_and_topk_clamp():
+    s = VectorStore()
+    k, v, sims = s.find(np.array([1.0, 0.0], np.float32), 5)
+    assert v == [] and len(sims) == 0
+    s.set(np.array([[1, 0]], np.float32), [b"only"])
+    _, v, _ = s.find(np.array([1.0, 0.0], np.float32), 10)
+    assert v == [b"only"]
+
+
+def test_dim_mismatch_rejected():
+    s = VectorStore()
+    s.set(np.eye(3, dtype=np.float32), [b"a", b"b", b"c"])
+    with pytest.raises(ValueError):
+        s.set(np.eye(2, dtype=np.float32), [b"x", b"y"])
+    with pytest.raises(ValueError):
+        s.find(np.array([1.0, 0.0], np.float32), 1)  # query dim 2 != 3
+
+
+def test_registry_named_stores():
+    reg = StoreRegistry()
+    reg.get("a").set(np.array([[1.0]], np.float32), [b"v"])
+    assert len(reg.get("a")) == 1
+    assert len(reg.get("b")) == 0
+    assert reg.names() == ["a", "b"]
+
+
+def test_stores_http_api():
+    from localai_tpu.config import ApplicationConfig
+    from localai_tpu.server import Router, create_server
+    from localai_tpu.server.stores_api import StoresApi
+
+    router = Router()
+    StoresApi().register(router)
+    cfg = ApplicationConfig(address="127.0.0.1", port=0)
+    server = create_server(cfg, router)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{port}"
+
+    def post(path, payload):
+        req = urllib.request.Request(base + path, data=json.dumps(payload).encode(),
+                                     headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    try:
+        post("/stores/set", {"keys": [[1, 0], [0, 1]], "values": ["a", "b"]})
+        got = post("/stores/get", {"keys": [[1, 0]]})
+        assert got["values"] == ["a"]
+        found = post("/stores/find", {"key": [1, 0], "topk": 1})
+        assert found["values"] == ["a"]
+        assert found["similarities"][0] == pytest.approx(1.0, abs=1e-5)
+        post("/stores/delete", {"keys": [[1, 0]]})
+        assert post("/stores/get", {"keys": [[1, 0]]})["values"] == []
+    finally:
+        server.shutdown()
