@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from localai_tpu.models.config import ArchConfig
-from localai_tpu.ops.attention import causal_prefill_attention, decode_attention
+from localai_tpu.ops.attention import decode_attention, prefill_attention
 from localai_tpu.ops.norm import rms_norm
 from localai_tpu.ops.rope import apply_rope, rope_frequencies
 
@@ -163,7 +163,7 @@ def _forward_hidden(
         q, k, v = _attn_proj_qkv(cfg, lp, x)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
-        attn = causal_prefill_attention(q, k, v, length_mask)
+        attn = prefill_attention(q, k, v, length_mask, lengths)
         h = h + attn.reshape(B, S, -1) @ lp["wo"]
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
         h = h + _mlp(cfg, lp, x)

@@ -16,10 +16,35 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+
+def prefill_attention(
+    q: jnp.ndarray,  # [B, S, H, D]
+    k: jnp.ndarray,  # [B, S, K, D]
+    v: jnp.ndarray,  # [B, S, K, D]
+    length_mask: jnp.ndarray | None,  # [B, S] bool
+    lengths: jnp.ndarray | None = None,  # [B] int32 (enables flash path)
+) -> jnp.ndarray:
+    """Prefill attention dispatcher: Pallas flash kernel on TPU (opt-in via
+    LOCALAI_FLASH=1 until burned in on hardware), dense math otherwise."""
+    S = q.shape[1]
+    if (
+        lengths is not None
+        and os.environ.get("LOCALAI_FLASH", "0") == "1"
+        and jax.default_backend() == "tpu"
+        and (S & (S - 1)) == 0  # power-of-two bucket, divisible by any block
+    ):
+        from localai_tpu.ops.flash import flash_prefill_attention
+
+        blk = min(128, S)
+        return flash_prefill_attention(q, k, v, lengths, block_q=blk, block_k=blk)
+    return causal_prefill_attention(q, k, v, length_mask)
 
 
 def causal_prefill_attention(

@@ -1,0 +1,131 @@
+"""Pallas flash attention (causal, GQA, length-masked) for TPU prefill.
+
+The prefill hot op: dense attention materializes [B, H, S, S] scores in HBM
+(O(S²) memory traffic); this kernel streams KV blocks through VMEM with the
+online-softmax recurrence, so HBM traffic is O(S) per query block and the
+matmuls hit the MXU at block size 128. Reference equivalent: llama.cpp's
+flash-attn path (grpc-server.cpp params_parse `flash_attention`).
+
+Layout: q [B, H, S, D] (head-major so a (q-block, head) grid step is one
+contiguous VMEM tile), kv [B, K_heads, S, D]; GQA maps query head h to kv
+head h // (H // K). Causal + per-row validity masking via the `lengths` [B]
+scalar-prefetch argument.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(
+    lengths_ref,  # scalar-prefetch [B]
+    q_ref,  # [1, 1, BQ, D]
+    k_ref,  # [1, 1, S, D]
+    v_ref,  # [1, 1, S, D]
+    o_ref,  # [1, 1, BQ, D]
+    *,
+    block_q: int,
+    block_k: int,
+    seq_len: int,
+    scale: float,
+):
+    import jax.experimental.pallas as pl
+
+    b = pl.program_id(0)
+    qi = pl.program_id(2)
+
+    length = lengths_ref[b]
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [BQ, D]
+    bq = q.shape[0]
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    num_kv_blocks = pl.cdiv(
+        jnp.minimum((qi + 1) * block_q, seq_len), block_k
+    )
+
+    def body(ck, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, 0, pl.ds(ck * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(ck * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BQ, BK]
+        kv_pos = ck * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        mask = (kv_pos <= q_pos) & (kv_pos < length)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc_new, m_new, l_new
+
+    d = q.shape[-1]
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, num_kv_blocks, body, (acc0, m0, l0))
+
+    # Fully-masked rows (padding) have l == 0; emit zeros, not NaNs.
+    o = acc / jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = o.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_q", "block_k", "interpret")
+)
+def flash_prefill_attention(
+    q: jnp.ndarray,  # [B, S, H, D]
+    k: jnp.ndarray,  # [B, S, K, D]
+    v: jnp.ndarray,  # [B, S, K, D]
+    lengths: jnp.ndarray,  # [B] int32 valid lengths
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Causal GQA flash attention. Returns [B, S, H, D] in q.dtype."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, H, D = q.shape
+    K = k.shape[2]
+    G = H // K
+    if S % block_q or S % block_k:
+        raise ValueError(f"seq len {S} must be a multiple of block sizes ({block_q},{block_k})")
+    scale = 1.0 / (D**0.5)
+
+    # Head-major layout: one (b, h, q-block) grid step reads contiguous tiles.
+    qh = q.transpose(0, 2, 1, 3)  # [B, H, S, D]
+    kh = k.transpose(0, 2, 1, 3)  # [B, K, S, D]
+    vh = v.transpose(0, 2, 1, 3)
+
+    grid = (B, H, S // block_q)
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, seq_len=S, scale=scale
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                # index maps take (*grid_ids, *scalar_prefetch_refs)
+                pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, S, D), lambda b, h, i, *_: (b, h // G, 0, 0)),
+                pl.BlockSpec((1, 1, S, D), lambda b, h, i, *_: (b, h // G, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, *_: (b, h, i, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), qh, kh, vh)
+    return out.transpose(0, 2, 1, 3)  # [B, S, H, D]

@@ -1,0 +1,92 @@
+"""Ring attention: sequence-parallel causal attention over the "sp" mesh axis.
+
+The reference has no sequence/context parallelism at all (SURVEY.md §5
+"Long-context: not implemented — green-field"). Here it is first-class: the
+sequence axis is sharded over "sp"; each device computes attention for its
+query block while KV blocks rotate around the ring via ppermute (one ICI hop
+per step), accumulating with the online-softmax recurrence — so a context of
+length S needs only S/n KV residency per chip and the collective traffic
+rides neighbor-to-neighbor ICI links (Liu et al., Ring Attention; the
+public scaling-book recipe).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _local_ring(q, k, v, lengths, *, axis: str, n_shards: int):
+    """Per-shard body under shard_map.
+
+    q: [B, S_l, H, D], k/v: [B, S_l, K, D] — the local sequence block.
+    lengths: [B] global valid lengths (replicated).
+    """
+    B, S_l, H, D = q.shape
+    K = k.shape[2]
+    G = H // K
+    scale = 1.0 / (D**0.5)
+    my = jax.lax.axis_index(axis)
+
+    qf = (q.astype(jnp.float32) * scale).reshape(B, S_l, K, G, D)
+    q_pos = my * S_l + jnp.arange(S_l)  # [S_l] global query positions
+
+    acc0 = jnp.zeros((B, K, G, S_l, D), jnp.float32)
+    m0 = jnp.full((B, K, G, S_l, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, K, G, S_l, 1), jnp.float32)
+
+    def step(s, carry):
+        k_blk, v_blk, acc, m, l = carry
+        src = (my - s) % n_shards  # global index of the block we hold now
+        kv_pos = src * S_l + jnp.arange(S_l)  # [S_l]
+
+        scores = jnp.einsum(
+            "bqkgd,bskd->bkgqs", qf, k_blk.astype(jnp.float32)
+        )  # [B, K, G, S_q, S_kv]
+        causal = kv_pos[None, :] <= q_pos[:, None]  # [S_q, S_kv]
+        valid = kv_pos[None, :] < lengths[:, None]  # [B, S_kv]
+        full_mask = causal[None, None, None] & valid[:, None, None, None, :]
+        scores = jnp.where(full_mask, scores, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bkgqs,bskd->bkgqd", p, v_blk.astype(jnp.float32)
+        )
+
+        perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+        k_blk = jax.lax.ppermute(k_blk, axis, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis, perm)
+        return k_blk, v_blk, acc_new, m_new, l_new
+
+    _, _, acc, m, l = jax.lax.fori_loop(0, n_shards, step, (k, v, acc0, m0, l0))
+    out = acc / jnp.maximum(l, 1e-30)  # fully-masked (padding) rows -> 0
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, S_l, H, D).astype(q.dtype)
+
+
+def ring_prefill_attention(
+    q: jnp.ndarray,  # [B, S, H, D] sharded on S over `axis`
+    k: jnp.ndarray,  # [B, S, K, D]
+    v: jnp.ndarray,
+    lengths: jnp.ndarray,  # [B]
+    mesh: Mesh,
+    axis: str = "sp",
+) -> jnp.ndarray:
+    """Causal GQA attention with the sequence axis sharded over `axis`."""
+    n = mesh.shape[axis]
+    seq_spec = P(None, axis, None, None)
+    fn = jax.shard_map(
+        partial(_local_ring, axis=axis, n_shards=n),
+        mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec, P(None)),
+        out_specs=seq_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, lengths)

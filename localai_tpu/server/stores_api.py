@@ -73,7 +73,10 @@ class StoresApi:
         key = body.get("key")
         if not isinstance(key, list) or not key:
             raise ApiError(400, "key must be a non-empty float array")
-        topk = int(body.get("topk") or 10)
+        topk_raw = body.get("topk", 10)
+        if not isinstance(topk_raw, int) or isinstance(topk_raw, bool) or topk_raw < 0:
+            raise ApiError(400, "topk must be a non-negative integer")
+        topk = topk_raw
         try:
             keys, values, sims = self._store(body).find(np.asarray(key, np.float32), topk)
         except ValueError as e:

@@ -106,10 +106,15 @@ class VectorStore:
             values = list(self._values)
             normalized = self._all_normalized  # snapshot with mat, same lock
         k = min(topk, len(values))
+        if k <= 0:
+            return np.zeros((0, self.dim), np.float32), [], np.zeros((0,), np.float32)
+        # The query is always normalized (store.go:500 requires isNormalized
+        # on both sides before the fast path; normalizing q is cheap and makes
+        # the flag only about the stored rows).
+        qn = q / max(float(np.linalg.norm(q)), 1e-9)
         if normalized:
-            sims = jnp.asarray(mat) @ jnp.asarray(q)  # cosine == dot (fast path)
+            sims = jnp.asarray(mat) @ jnp.asarray(qn)  # cosine == dot (fast path)
         else:
-            qn = q / max(float(np.linalg.norm(q)), 1e-9)
             norms = jnp.linalg.norm(jnp.asarray(mat), axis=-1).clip(1e-9)
             sims = (jnp.asarray(mat) @ jnp.asarray(qn)) / norms
         import jax

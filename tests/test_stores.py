@@ -42,6 +42,23 @@ def test_find_cosine_normalized():
     assert sims[1] == pytest.approx(0.70710678, abs=1e-5)
 
 
+def test_find_unnormalized_query_on_normalized_store():
+    """Cosine must ignore the query's magnitude even on the fast path
+    (reference store.go:500 gates on both sides being normalized)."""
+    s = VectorStore()
+    s.set(np.array([[1, 0], [0, 1]], np.float32), [b"x", b"y"])
+    _, values, sims = s.find(np.array([2.0, 0.0], np.float32), 1)
+    assert values[0] == b"x"
+    assert sims[0] == pytest.approx(1.0, abs=1e-5)  # not 2.0
+
+
+def test_find_topk_zero():
+    s = VectorStore()
+    s.set(np.array([[1, 0]], np.float32), [b"x"])
+    _, values, sims = s.find(np.array([1.0, 0.0], np.float32), 0)
+    assert values == [] and len(sims) == 0
+
+
 def test_find_cosine_unnormalized():
     s = VectorStore()
     keys = np.array([[2, 0], [0, 3]], np.float32)  # not unit norm
