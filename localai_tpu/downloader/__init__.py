@@ -1,0 +1,9 @@
+"""Artifact downloader: URI schemes, range-resume, checksum verification.
+
+Reference: pkg/downloader/uri.go (schemes huggingface://, file://, http(s)
+at uri.go:27-37; `.partial` + HTTP Range resume + SHA verification at
+uri.go:373-459). OCI/ollama pulls are out of scope for the TPU rebuild's
+first rounds (models are HF safetensors, not container layers).
+"""
+
+from localai_tpu.downloader.uri import DownloadError, download, resolve_uri  # noqa: F401
