@@ -90,10 +90,22 @@ def main() -> None:
     total_tokens = slots * gen_len
     ttfts.sort()
     p50_ttft = ttfts[len(ttfts) // 2]
+
+    # HBM roofline: each decode step streams the weights once plus the live
+    # KV prefix for every slot; v5e ≈ 819 GB/s. steps/s * batch = tok/s.
+    param_bytes = sum(
+        a.size * a.dtype.itemsize for a in jax.tree.leaves(eng.params)
+    )
+    avg_len = prompt_len + gen_len / 2
+    kv_bytes = 2 * cfg.num_layers * slots * avg_len * cfg.num_kv_heads * cfg.head_dim_ * 2
+    hbm_bw = 819e9
+    roofline_tps = hbm_bw / (param_bytes + kv_bytes) * slots
+    pct = 100.0 * decode_tps / roofline_tps if roofline_tps else 0.0
     print(
         f"arch={arch} slots={slots} gen={gen_len} wall={wall:.2f}s "
         f"end_to_end_tps={total_tokens / wall:.1f} decode_tps={decode_tps:.1f} "
-        f"p50_ttft={p50_ttft * 1000:.1f}ms",
+        f"p50_ttft={p50_ttft * 1000:.1f}ms "
+        f"roofline={roofline_tps:.0f}tok/s achieved={pct:.1f}%",
         file=sys.stderr,
     )
     eng.stop()
@@ -105,6 +117,8 @@ def main() -> None:
                 "value": round(decode_tps, 2),
                 "unit": "tok/s",
                 "vs_baseline": None,
+                "p50_ttft_ms": round(p50_ttft * 1000, 1),
+                "pct_of_hbm_roofline": round(pct, 1),
             }
         )
     )

@@ -6,23 +6,45 @@ into a slot-based queue; vendored server-context start_loop is the hot loop).
 Key differences, TPU-first:
 
 - One resident engine owns the devices. Requests are multiplexed onto a fixed
-  number of KV-cache *slots*; all shapes are static so the decode program
-  compiles exactly once.
+  number of KV-cache *slots*; all shapes are static so each program compiles
+  exactly once.
+- The entire control state lives on device: KV cache, penalty counts, PRNG
+  keys, logit bias, current token and position per slot. The host never sits
+  in the per-token critical path — decode runs in fused N-step `lax.scan`
+  blocks (one dispatch per N tokens), and sampled tokens feed the next step
+  entirely on device.
+- Dispatch is pipelined: up to `pipeline_depth` decode blocks are in flight
+  while the host does detokenization/stop-scan bookkeeping on earlier
+  results. This matters doubly on remote-tunneled TPU runtimes where each
+  dispatch/transfer costs milliseconds of RTT.
+- Admission is fused and batched: one program prefills up to M prompts,
+  writes their KV into the cache slots, samples each first token and updates
+  all per-slot device state — one dispatch per admission group instead of
+  three per request.
 - Prompt lengths are bucketed (powers of two) so prefill compiles once per
-  bucket, never per request.
-- The whole per-step chain — layer stack, KV write, attention, penalties,
-  top-k/p filtering, sampling — is one jitted program; per-slot sampling
-  parameters ride in as [B] arrays, so heterogeneous requests share one
-  compiled step (no recompilation, no host round-trip inside the chain).
-- KV cache, token-count table and PRNG state are donated on every step: XLA
-  updates them in place in HBM.
+  (bucket, group-size), never per request.
+- Sampling variants compile separately so the common paths stay cheap:
+  pure-greedy blocks never pay a categorical, unfiltered sampling never pays
+  a sort (Gumbel argmax), and the partial top-k candidate chain only runs
+  when a slot actually uses top-k/top-p/min-p.
+- Grammar-constrained requests are host-interactive by nature (the pushdown
+  machine walks candidate tokens in probability order), so they fall back to
+  single-step blocks that also return top-k candidate ids; the host's
+  corrected token is fed back as an override input on the next dispatch.
 - Streaming is UTF-8-safe incremental detokenization mirroring the byte
   reassembly at core/backend/llm.go:146-166.
+
+Slot-finish detection (EOS / stop sequence / length) happens host-side with
+up to one block of lag; the device may decode a handful of tokens past the
+finish point, which are discarded. That waste is bounded by
+pipeline_depth * block size and is the price of keeping the device saturated.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import logging
+import os
 import queue
 import threading
 import time
@@ -36,9 +58,38 @@ import numpy as np
 
 from localai_tpu.models import llama
 from localai_tpu.models.config import ArchConfig
-from localai_tpu.ops.sampling import SamplingParams, sample
+from localai_tpu.ops.sampling import (
+    SamplingParams,
+    sample,
+    sample_greedy,
+    sample_simple,
+)
 from localai_tpu.parallel.mesh import MeshPlan, build_mesh
 from localai_tpu.parallel.sharding import cache_shardings, param_shardings, validate_plan
+
+log = logging.getLogger("localai_tpu.engine")
+
+_SAMPLING_FIELDS = (
+    "temperature",
+    "top_k",
+    "top_p",
+    "min_p",
+    "repeat_penalty",
+    "presence_penalty",
+    "frequency_penalty",
+)
+
+
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache: warmup compiles survive restarts."""
+    try:
+        if jax.config.jax_compilation_cache_dir is None:
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.path.expanduser("~/.cache/localai_tpu/xla"),
+            )
+    except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
+        pass
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,6 +98,12 @@ class EngineConfig:
     max_seq: int = 2048
     min_prefill_bucket: int = 32
     base_seed: int = 0
+    # Decode-block sizes the scheduler chooses from (descending). Bigger
+    # blocks amortize dispatch overhead; smaller ones bound end-of-request
+    # overshoot.
+    block_sizes: tuple[int, ...] = (16, 4, 1)
+    # Decode blocks kept in flight while the host processes earlier results.
+    pipeline_depth: int = 3
 
     def buckets(self) -> list[int]:
         out, b = [], self.min_prefill_bucket
@@ -131,13 +188,43 @@ class _Slot:
     prompt_len: int
     generated: list[int] = dataclasses.field(default_factory=list)
     emitted_len: int = 0  # chars of decoded text already streamed
+    scheduled: int = 0  # decode steps dispatched (>= len(generated))
     t_submit: float = 0.0
     t_first: float = 0.0
-    done: bool = False
+
+
+def _host_copy_async(arr: Any) -> None:
+    """Start a device→host copy without blocking; np.asarray later is then a
+    cheap wait instead of a full round trip."""
+    try:
+        arr.copy_to_host_async()
+    except Exception:  # noqa: BLE001 — optional fast path
+        pass
+
+
+@dataclasses.dataclass
+class _Entry:
+    """One in-flight dispatch whose results the host still has to process."""
+
+    kind: str  # "admit" | "block"
+    toks: Any  # device array: admit [M]; block [n, B]
+    tk: Any  # top-k candidate ids or None: admit [M, K]; block [n, B, K]
+    gen: list[int]  # slot-generation snapshot at dispatch
+    items: Optional[list] = None  # admit: [(slot_idx, request, handle, plen, t0)]
+    active: Optional[np.ndarray] = None  # block: active mask at dispatch
+    n: int = 0  # block: tokens per slot in this entry
+
+    def ready(self) -> bool:
+        try:
+            return bool(self.toks.is_ready())
+        except Exception:  # noqa: BLE001 — platforms without is_ready
+            return True
 
 
 class Engine:
     """Persistent multi-slot generation engine for one loaded model."""
+
+    GRAMMAR_TOPK = 64
 
     def __init__(
         self,
@@ -148,10 +235,10 @@ class Engine:
         engine_cfg: Optional[EngineConfig] = None,
         devices: Optional[Sequence[jax.Device]] = None,
     ) -> None:
+        _enable_compile_cache()
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.ecfg = engine_cfg or EngineConfig()
-        ndev = len(devices) if devices is not None else len(jax.devices())
         self.plan = mesh_plan or MeshPlan(dp=1, tp=1)
         validate_plan(cfg, self.plan.tp, self.plan.ep)
         self.mesh = build_mesh(self.plan, devices)
@@ -173,13 +260,14 @@ class Engine:
                     vshard,
                 ),
             )
+        # Device-resident per-slot state.
         self.counts = jnp.zeros((B, V), jnp.int32)
         self.rngs = jax.random.split(jax.random.key(self.ecfg.base_seed), B)
         self.bias = jnp.zeros((B, V), jnp.float32)
+        self.d_tokens = jnp.zeros((B,), jnp.int32)
+        self.d_positions = jnp.zeros((B,), jnp.int32)
 
-        # Host-side control state (numpy, device_put'd per step — tiny arrays).
-        self.h_tokens = np.zeros((B,), np.int32)
-        self.h_positions = np.zeros((B,), np.int32)
+        # Host-side control state.
         self.h_active = np.zeros((B,), bool)
         self.h_sampling = {
             "temperature": np.zeros((B,), np.float32),
@@ -190,12 +278,16 @@ class Engine:
             "presence_penalty": np.zeros((B,), np.float32),
             "frequency_penalty": np.zeros((B,), np.float32),
         }
+        self.h_override_tok = np.zeros((B,), np.int32)
+        self.h_override_mask = np.zeros((B,), bool)
         self.slots: list[Optional[_Slot]] = [None] * B
+        self._slot_gen = [0] * B
         self._tok_strs: Optional[list[str]] = None  # lazy grammar cache
-        self.grammar_topk = 64
+        self.grammar_topk = self.GRAMMAR_TOPK
 
         self._pending: deque[tuple[GenRequest, RequestHandle]] = deque()
         self._pending_lock = threading.Lock()
+        self._inflight: deque[_Entry] = deque()
         self._wake = threading.Event()
         self._shutdown = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -206,6 +298,8 @@ class Engine:
         self._decode_time = 0.0
         self._decode_tokens = 0
 
+        self._block_cache: dict[tuple, Any] = {}
+        self._admit_cache: dict[tuple, Any] = {}
         self._build_programs()
 
     # ------------------------------------------------------------------ #
@@ -219,62 +313,127 @@ class Engine:
         def _prefill(params, tokens, lengths):
             return llama.prefill(cfg, params, tokens, lengths)
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def _insert(cache, counts, ks, vs, slot, prompt_counts):
-            cache = llama.write_prefill_to_cache(cache, ks, vs, slot)
-            counts = counts.at[slot].set(prompt_counts)
-            return cache, counts
-
-        topk_k = min(self.grammar_topk, cfg.vocab_size)
-
-        def _first_sample_impl(logits, rng, sampling, counts_row, bias_row, with_topk):
-            tok = sample(logits, rng[None], sampling, counts_row, bias_row)
-            counts_row = counts_row.at[0, tok[0]].add(1)
-            if not with_topk:
-                return tok[0], counts_row
-            _, tk_ids = jax.lax.top_k(logits + bias_row, topk_k)
-            return tok[0], counts_row, tk_ids[0]
-
-        _first_sample = jax.jit(
-            partial(_first_sample_impl, with_topk=False), donate_argnums=(3,)
-        )
-        _first_sample_topk = jax.jit(
-            partial(_first_sample_impl, with_topk=True), donate_argnums=(3,)
-        )
-
-        def _decode_impl(params, cache, counts, rngs, bias, tokens, positions, active, sampling, with_topk):
-            logits, cache = llama.decode_step(cfg, params, tokens, positions, cache)
-            split = jax.vmap(lambda k: jax.random.split(k, 2))(rngs)
-            rngs, draw = split[:, 0], split[:, 1]
-            nxt = sample(logits, draw, sampling, counts, bias)
-            counts = counts.at[jnp.arange(tokens.shape[0]), nxt].add(active.astype(jnp.int32))
-            nxt = jnp.where(active, nxt, 0)
-            if not with_topk:
-                return nxt, cache, counts, rngs
-            # Candidates for grammar-constrained slots, walked host-side in
-            # probability order (tiny [B, K] transfer). Compiled as a separate
-            # program so grammar-free serving never pays the vocab sort.
-            _, tk_ids = jax.lax.top_k(logits + bias, topk_k)
-            return nxt, cache, counts, rngs, tk_ids
-
-        _decode = jax.jit(
-            partial(_decode_impl, with_topk=False), donate_argnums=(1, 2, 3)
-        )
-        _decode_topk = jax.jit(
-            partial(_decode_impl, with_topk=True), donate_argnums=(1, 2, 3)
-        )
-
         @partial(jax.jit)
         def _embed(params, tokens, lengths):
             return llama.encode(cfg, params, tokens, lengths)
 
         self._prefill_fn = _prefill
-        self._insert_fn = _insert
-        self._first_sample_fn = _first_sample
-        self._first_sample_topk_fn = _first_sample_topk
-        self._decode_fn = _decode
-        self._decode_topk_fn = _decode_topk
         self._embed_fn = _embed
+
+    def _get_block(self, variant: str, n: int):
+        """Fused n-step decode block program for one sampling variant.
+
+        variant: "greedy" | "simple" | "filtered" | "grammar".
+        State flows through the scan entirely on device; only the sampled
+        token ids (and, for grammar, top-k candidates) come back to the host.
+        All per-dispatch host control (active mask, sampling params, token
+        overrides) rides in ONE packed [10, B] f32 array — on remote-tunneled
+        runtimes every separate H2D transfer costs milliseconds of RTT, so
+        the hot path gets exactly one.
+        """
+        key = (variant, n)
+        fn = self._block_cache.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        B, S = self.ecfg.max_slots, self.ecfg.max_seq
+        K = min(self.GRAMMAR_TOPK, cfg.vocab_size)
+
+        def block(params, cache, counts, rngs, bias, tokens, positions, pack):
+            active = pack[0] > 0
+            samp = SamplingParams(
+                temperature=pack[1], top_k=pack[2].astype(jnp.int32),
+                top_p=pack[3], min_p=pack[4], repeat_penalty=pack[5],
+                presence_penalty=pack[6], frequency_penalty=pack[7],
+            )
+            overrides = pack[8].astype(jnp.int32)  # token ids < 2^24: exact in f32
+            omask = pack[9] > 0
+            tokens = jnp.where(omask, overrides, tokens)
+            act_i32 = active.astype(jnp.int32)
+
+            def body(carry, _):
+                tokens, positions, cache, counts, rngs = carry
+                logits, cache = llama.decode_step(cfg, params, tokens, positions, cache)
+                split = jax.vmap(lambda k: jax.random.split(k, 2))(rngs)
+                rngs, draw = split[:, 0], split[:, 1]
+                if variant == "greedy":
+                    nxt = sample_greedy(logits, samp, counts, bias)
+                elif variant == "simple":
+                    nxt = sample_simple(logits, draw, samp, counts, bias)
+                else:
+                    nxt = sample(logits, draw, samp, counts, bias)
+                counts = counts.at[jnp.arange(B), nxt].add(act_i32)
+                nxt = jnp.where(active, nxt, 0)
+                if variant == "grammar":
+                    _, tk = jax.lax.top_k(logits + bias, K)
+                    out = (nxt, tk)
+                else:
+                    out = (nxt,)
+                # Clamp so idle/overshooting slots keep writing inside their
+                # own cache row instead of out-of-bounds.
+                positions = jnp.minimum(positions + 1, S - 1)
+                return (nxt, positions, cache, counts, rngs), out
+
+            (tokens, positions, cache, counts, rngs), outs = jax.lax.scan(
+                body, (tokens, positions, cache, counts, rngs), None, length=n
+            )
+            toks_block = outs[0]  # [n, B]
+            tk_block = outs[1] if variant == "grammar" else None
+            return cache, counts, rngs, tokens, positions, toks_block, tk_block
+
+        fn = jax.jit(block, donate_argnums=(1, 2, 3, 5, 6))
+        self._block_cache[key] = fn
+        return fn
+
+    def _get_admit(self, m: int, bucket: int, has_bias: bool, with_topk: bool):
+        """Fused admission program: prefill M prompts, write their KV/state
+        into their slots, and sample each first token — one dispatch.
+
+        Host control arrives packed: `aux` [3, M] i32 (lens, slot ids, seeds)
+        and `samp_pack` [7, M] f32 (sampling params), so an admission costs
+        three H2D transfers (prompts, aux, samp) instead of twelve.
+        """
+        key = (m, bucket, has_bias, with_topk)
+        fn = self._admit_cache.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        V = cfg.vocab_size
+        K = min(self.GRAMMAR_TOPK, V)
+
+        def admit(params, cache, counts, rngs, bias, d_tokens, d_positions,
+                  prompt_toks, aux, samp_pack, bias_rows):
+            lens, slot_ids, seeds = aux[0], aux[1], aux[2]
+            samp = SamplingParams(
+                temperature=samp_pack[0], top_k=samp_pack[1].astype(jnp.int32),
+                top_p=samp_pack[2], min_p=samp_pack[3], repeat_penalty=samp_pack[4],
+                presence_penalty=samp_pack[5], frequency_penalty=samp_pack[6],
+            )
+            logits, ks, vs = llama.prefill(cfg, params, prompt_toks, lens)
+            valid = (jnp.arange(bucket)[None, :] < lens[:, None]).astype(jnp.int32)
+            rows = jnp.zeros((m, V), jnp.int32)
+            rows = rows.at[jnp.arange(m)[:, None], prompt_toks].add(valid)
+            brows = bias_rows if has_bias else jnp.zeros((m, V), jnp.float32)
+            keys0 = jax.vmap(jax.random.key)(seeds.astype(jnp.uint32))
+            draws = jax.vmap(lambda k: jax.random.fold_in(k, 0))(keys0)
+            toks = sample(logits, draws, samp, rows, brows)  # [m]
+            rows = rows.at[jnp.arange(m), toks].add(1)
+            tk = jax.lax.top_k(logits + brows, K)[1] if with_topk else None
+            for j in range(m):  # m is static and small — unrolled
+                s = slot_ids[j]
+                cache = llama.write_prefill_to_cache(
+                    cache, ks[:, j:j + 1], vs[:, j:j + 1], s
+                )
+                counts = counts.at[s].set(rows[j])
+                rngs = rngs.at[s].set(keys0[j])
+                bias = bias.at[s].set(brows[j])
+                d_tokens = d_tokens.at[s].set(toks[j])
+                d_positions = d_positions.at[s].set(lens[j])
+            return cache, counts, rngs, bias, d_tokens, d_positions, toks, tk
+
+        fn = jax.jit(admit, donate_argnums=(1, 2, 3, 4, 5, 6))
+        self._admit_cache[key] = fn
+        return fn
 
     # ------------------------------------------------------------------ #
     # Public API
@@ -295,9 +454,17 @@ class Engine:
     def submit(self, request: GenRequest) -> RequestHandle:
         if not request.prompt_ids:
             raise ValueError("empty prompt")
+        # Never mutate the caller's request object (it may be reused).
+        request = dataclasses.replace(request, prompt_ids=list(request.prompt_ids))
         limit = self.ecfg.max_seq - 1
         if len(request.prompt_ids) > limit:
-            request.prompt_ids = request.prompt_ids[-limit:]
+            # Truncate from the left but keep the leading token (BOS / system
+            # prompt head), mirroring llama.cpp context-shift semantics.
+            head = request.prompt_ids[0]
+            request.prompt_ids = [head] + request.prompt_ids[-(limit - 1):]
+            log.warning(
+                "prompt truncated to %d tokens (max_seq=%d)", limit, self.ecfg.max_seq
+            )
         if request.grammar is not None and self._tok_strs is None:
             self._token_str(0)  # build the table here, not in the engine loop
         handle = RequestHandle()
@@ -333,11 +500,35 @@ class Engine:
         }
 
     def warmup(self, prompt_len: int = 8, grammar: bool = False) -> None:
-        """Compile prefill (smallest bucket) + decode before serving.
+        """Compile AND execute the serving programs before traffic arrives.
 
-        With grammar=True, also compiles the top-k decode variants and builds
-        the token-string table, so the first constrained request doesn't stall
-        every active slot on a mid-serving XLA compile."""
+        Runs every admission group size (powers of two up to max_slots at
+        `prompt_len`'s bucket) and every greedy/simple decode-block size once
+        against throwaway state, so neither the first burst of traffic nor
+        the first sampled request stalls active slots on a mid-serving XLA
+        compile — real executions populate the jit dispatch cache, which
+        AOT lower/compile alone does not. The persistent compilation cache
+        (~/.cache/localai_tpu/xla) makes repeat warmups much faster.
+
+        With grammar=True, also compiles the single-step grammar block and
+        exercises a constrained request end-to-end.
+        """
+        bucket = self._bucket_for(prompt_len)
+        # Two passes: the very first execution transitions the live state's
+        # avals (fresh zeros → committed program outputs); the second pass
+        # re-traces every program against the stabilized avals so serving
+        # never pays a retrace.
+        for _pass in range(2):
+            m = 1
+            while m <= self.ecfg.max_slots:
+                self._warm_admit(m, bucket)
+                m *= 2
+            for n in self.ecfg.block_sizes:
+                # "filtered" is the variant real traffic hits under the
+                # server's sampling defaults (temperature+top_k/top_p), so it
+                # must be warm too.
+                for variant in ("greedy", "simple", "filtered"):
+                    self._warm_block(variant, n)
         _, ev = self.generate([1] * prompt_len, max_new_tokens=2)
         assert ev.kind == "done"
         if grammar:
@@ -351,6 +542,53 @@ class Engine:
             assert ev.kind == "done"
 
     # ------------------------------------------------------------------ #
+    # Warmup helpers
+    # ------------------------------------------------------------------ #
+    #
+    # Warmup executes the real programs against the LIVE engine state, not
+    # throwaway clones: jit caches key on the concrete avals (sharding and
+    # layout included), and the live state's avals change once the first
+    # program output replaces the freshly-initialized arrays. Warming on
+    # clones leaves every program to pay a several-hundred-ms retrace on its
+    # first real call. Running on live state is safe before serving: all
+    # slots are free, admission resets every per-slot row, and inactive-slot
+    # decode writes only into rows that the next admission overwrites.
+
+    def _warm_block(self, variant: str, n: int) -> None:
+        B = self.ecfg.max_slots
+        fn = self._get_block(variant, n)
+        pack = np.zeros((10, B), np.float32)
+        pack[3] = 1.0  # top_p
+        pack[5] = 1.0  # repeat_penalty
+        (
+            self.cache, self.counts, self.rngs, self.d_tokens, self.d_positions,
+            toks, _tk,
+        ) = fn(
+            self.params, self.cache, self.counts, self.rngs, self.bias,
+            self.d_tokens, self.d_positions, jnp.asarray(pack),
+        )
+        jax.block_until_ready(toks)
+
+    def _warm_admit(self, m: int, bucket: int, has_bias: bool = False, with_topk: bool = False) -> None:
+        fn = self._get_admit(m, bucket, has_bias, with_topk)
+        aux = np.zeros((3, m), np.int32)
+        aux[0] = 1  # lens
+        aux[1] = np.arange(m) % self.ecfg.max_slots  # slot ids
+        samp_pack = np.zeros((7, m), np.float32)
+        samp_pack[2] = 1.0  # top_p
+        samp_pack[4] = 1.0  # repeat_penalty
+        (
+            self.cache, self.counts, self.rngs, self.bias,
+            self.d_tokens, self.d_positions, toks, _tk,
+        ) = fn(
+            self.params, self.cache, self.counts, self.rngs, self.bias,
+            self.d_tokens, self.d_positions,
+            jnp.zeros((m, bucket), jnp.int32), jnp.asarray(aux), jnp.asarray(samp_pack),
+            jnp.zeros((m, self.cfg.vocab_size), jnp.float32),
+        )
+        jax.block_until_ready(toks)
+
+    # ------------------------------------------------------------------ #
     # Engine loop
     # ------------------------------------------------------------------ #
 
@@ -360,139 +598,308 @@ class Engine:
                 return b
         return self.ecfg.max_seq
 
-    def _free_slot(self) -> Optional[int]:
-        for i, s in enumerate(self.slots):
-            if s is None:
-                return i
-        return None
-
-    def _loop(self) -> None:
-        while not self._shutdown.is_set():
-            admitted = self._admit_pending()
-            if self.h_active.any():
-                self._step()
-            elif not admitted:
-                self._wake.wait(timeout=0.05)
-                self._wake.clear()
-
-    def _admit_pending(self) -> bool:
-        admitted = False
-        while True:
-            slot_idx = self._free_slot()
-            if slot_idx is None:
-                return admitted
-            with self._pending_lock:
-                if not self._pending:
-                    return admitted
-                request, handle = self._pending.popleft()
-            if handle.cancelled.is_set():
-                handle._q.put(TokenEvent(kind="done", finish_reason="stop"))
-                continue
-            try:
-                self._admit(slot_idx, request, handle)
-                admitted = True
-            except Exception as e:  # noqa: BLE001 — surface to the caller, keep serving
-                handle._q.put(TokenEvent(kind="error", error=f"{type(e).__name__}: {e}"))
-
-    def _admit(self, slot_idx: int, request: GenRequest, handle: RequestHandle) -> None:
-        t0 = time.monotonic()
-        ids = request.prompt_ids
-        bucket = self._bucket_for(len(ids))
-        toks = np.zeros((1, bucket), np.int32)
-        toks[0, : len(ids)] = ids
-        lens = np.array([len(ids)], np.int32)
-
-        logits, ks, vs = self._prefill_fn(self.params, toks, lens)
-
-        prompt_counts = np.zeros((self.cfg.vocab_size,), np.int32)
-        np.add.at(prompt_counts, np.asarray(ids, np.int64), 1)
-        self.cache, self.counts = self._insert_fn(
-            self.cache, self.counts, ks, vs, jnp.int32(slot_idx), prompt_counts
-        )
-
-        # Per-slot control state.
-        r = request
-        row = {
-            "temperature": r.temperature, "top_k": r.top_k, "top_p": r.top_p,
-            "min_p": r.min_p, "repeat_penalty": r.repeat_penalty,
-            "presence_penalty": r.presence_penalty, "frequency_penalty": r.frequency_penalty,
-        }
-        for k, v in row.items():
-            self.h_sampling[k][slot_idx] = v
-        seed = r.seed if r.seed is not None else (self.ecfg.base_seed + slot_idx + 1)
-        self.rngs = self.rngs.at[slot_idx].set(jax.random.key(seed))
-        bias_row = np.zeros((1, self.cfg.vocab_size), np.float32)
-        for tid, b in r.logit_bias.items():
-            if 0 <= int(tid) < self.cfg.vocab_size:
-                bias_row[0, int(tid)] = b
-        self.bias = self.bias.at[slot_idx].set(bias_row[0])
-
-        # First token comes from the prefill logits.
-        sampling1 = SamplingParams.make(1, **row)
-        key = jax.random.fold_in(jax.random.key(seed), 0)
-        fs_args = (logits, key, sampling1, self.counts[slot_idx][None], self.bias[slot_idx][None])
-        if request.grammar is not None:
-            tok, counts_row, tk_ids = self._first_sample_topk_fn(*fs_args)
-            self.counts = self.counts.at[slot_idx].set(counts_row[0])
-            tok = self._grammar_choose(request, int(tok), np.asarray(tk_ids))
-            if tok is None:
-                raise RuntimeError("grammar admits no token from this model's vocabulary")
-        else:
-            tok, counts_row = self._first_sample_fn(*fs_args)
-            self.counts = self.counts.at[slot_idx].set(counts_row[0])
-            tok = int(tok)
-
-        slot = _Slot(request=request, handle=handle, prompt_len=len(ids), t_submit=t0)
-        slot.t_first = time.monotonic()
-        self.slots[slot_idx] = slot
-        self.h_tokens[slot_idx] = tok
-        self.h_positions[slot_idx] = len(ids)
-        self.h_active[slot_idx] = True
-        self.m_prompt_tokens += len(ids)
-        self._post_token(slot_idx, tok)
-
-    def _step(self) -> None:
-        t0 = time.monotonic()
-        sampling = SamplingParams(**{k: jnp.asarray(v) for k, v in self.h_sampling.items()})
-        grammar_active = any(
+    def _grammar_active(self) -> bool:
+        return any(
             self.h_active[i] and self.slots[i] is not None
             and self.slots[i].request.grammar is not None
             for i in range(self.ecfg.max_slots)
         )
-        args = (
-            self.params, self.cache, self.counts, self.rngs, self.bias,
-            jnp.asarray(self.h_tokens), jnp.asarray(self.h_positions),
-            jnp.asarray(self.h_active), sampling,
-        )
-        tk_ids = None
-        if grammar_active:
-            nxt, self.cache, self.counts, self.rngs, tk_ids = self._decode_topk_fn(*args)
-            tk_ids = np.asarray(tk_ids)
-        else:
-            nxt, self.cache, self.counts, self.rngs = self._decode_fn(*args)
-        nxt = np.asarray(nxt)
-        n_active = int(self.h_active.sum())
-        self._decode_time += time.monotonic() - t0
-        self._decode_tokens += n_active
 
+    def _loop(self) -> None:
+        trace = os.environ.get("LOCALAI_ENGINE_TRACE", "0") == "1"
+        last = time.monotonic()
+        while not self._shutdown.is_set():
+            now = time.monotonic()
+            if self.h_active.any():
+                self._decode_time += now - last
+            last = now
+
+            admitted = self._admit_pending()
+            grammar = self._grammar_active()
+            depth = 1 if grammar else self.ecfg.pipeline_depth
+            nblocks = sum(1 for e in self._inflight if e.kind == "block")
+            active = bool(self.h_active.any())
+
+            if active and nblocks < depth and not (grammar and self._inflight):
+                t0 = time.monotonic()
+                self._dispatch_block(grammar)
+                if trace:
+                    print(f"[eng {time.monotonic():.3f}] dispatch block n={self._inflight[-1].n} "
+                          f"took {(time.monotonic()-t0)*1000:.1f}ms inflight={len(self._inflight)}")
+                nblocks += 1
+
+            if self._inflight:
+                front = self._inflight[0]
+                fr = front.ready()
+                if fr or nblocks >= depth or not active:
+                    t0 = time.monotonic()
+                    e = self._inflight.popleft()
+                    self._process_entry(e)
+                    if trace:
+                        print(f"[eng {time.monotonic():.3f}] process {e.kind} n={e.n} ready={fr} "
+                              f"took {(time.monotonic()-t0)*1000:.1f}ms inflight={len(self._inflight)}")
+                else:
+                    # Nothing ready and nothing to dispatch (e.g. grammar mode
+                    # waiting on an in-flight admit): don't busy-spin.
+                    time.sleep(0.001)
+            elif not active and not admitted:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+
+    # ------------------------------------------------------------------ #
+    # Admission
+    # ------------------------------------------------------------------ #
+
+    def _admit_pending(self) -> bool:
+        admitted = False
+        while True:
+            free = [i for i, s in enumerate(self.slots) if s is None]
+            if not free:
+                return admitted
+            group: list[tuple[GenRequest, RequestHandle]] = []
+            bucket = 0
+            with self._pending_lock:
+                while self._pending and len(group) < len(free):
+                    request, handle = self._pending[0]
+                    if handle.cancelled.is_set():
+                        self._pending.popleft()
+                        handle._q.put(TokenEvent(kind="done", finish_reason="stop"))
+                        continue
+                    b = self._bucket_for(len(request.prompt_ids))
+                    if not group:
+                        bucket = b
+                    elif b != bucket:
+                        break  # different bucket — next round
+                    group.append(self._pending.popleft())
+            if not group:
+                return admitted
+            # Dispatch in power-of-two chunks (binary decomposition) so each
+            # admission program compiles for a small fixed set of M values.
+            idx = 0
+            while idx < len(group):
+                m = 1
+                while m * 2 <= len(group) - idx:
+                    m *= 2
+                chunk = group[idx: idx + m]
+                idx += m
+                try:
+                    self._dispatch_admit(chunk, bucket, [free.pop(0) for _ in chunk])
+                    admitted = True
+                except Exception as e:  # noqa: BLE001 — surface to callers, keep serving
+                    for request, handle in chunk:
+                        handle._q.put(
+                            TokenEvent(kind="error", error=f"{type(e).__name__}: {e}")
+                        )
+
+    def _dispatch_admit(
+        self,
+        chunk: list[tuple[GenRequest, RequestHandle]],
+        bucket: int,
+        slot_ids: list[int],
+    ) -> None:
+        m = len(chunk)
+        V = self.cfg.vocab_size
+        t0 = time.monotonic()
+        prompt_toks = np.zeros((m, bucket), np.int32)
+        aux = np.zeros((3, m), np.int32)  # lens, slot ids, seeds
+        aux[1] = np.asarray(slot_ids, np.int32)
+        samp_pack = np.zeros((7, m), np.float32)
+        bias_rows = None
+        with_topk = False
+        items = []
+        for j, (r, _handle) in enumerate(chunk):
+            ids = r.prompt_ids
+            prompt_toks[j, : len(ids)] = ids
+            aux[0, j] = len(ids)
+            if r.seed is not None:
+                aux[2, j] = r.seed & 0x7FFFFFFF
+            else:
+                # Randomized per request (reference default RAND_SEED=-1,
+                # core/config/model_config.go:18).
+                aux[2, j] = int.from_bytes(os.urandom(4), "little") & 0x7FFFFFFF
+            for fi, k in enumerate(_SAMPLING_FIELDS):
+                samp_pack[fi, j] = getattr(r, k)
+            if r.logit_bias:
+                if bias_rows is None:
+                    bias_rows = np.zeros((m, V), np.float32)
+                for tid, bval in r.logit_bias.items():
+                    if 0 <= int(tid) < V:
+                        bias_rows[j, int(tid)] = bval
+            if r.grammar is not None:
+                with_topk = True
+
+        has_bias = bias_rows is not None
+        trace = os.environ.get("LOCALAI_ENGINE_TRACE", "0") == "1"
+        t_a = time.monotonic()
+        fn = self._get_admit(m, bucket, has_bias, with_topk)
+        t_b = time.monotonic()
+        args_in = (
+            jnp.asarray(prompt_toks), jnp.asarray(aux), jnp.asarray(samp_pack),
+            jnp.asarray(bias_rows) if has_bias else jnp.zeros((m, V), jnp.float32),
+        )
+        t_c = time.monotonic()
+        (
+            self.cache, self.counts, self.rngs, self.bias,
+            self.d_tokens, self.d_positions, toks, tk,
+        ) = fn(
+            self.params, self.cache, self.counts, self.rngs, self.bias,
+            self.d_tokens, self.d_positions, *args_in,
+        )
+        t_d = time.monotonic()
+        _host_copy_async(toks)
+        if trace:
+            print(f"[eng {time.monotonic():.3f}] dispatch admit m={m} bucket={bucket} "
+                  f"get={1e3*(t_b-t_a):.1f} h2d={1e3*(t_c-t_b):.1f} call={1e3*(t_d-t_c):.1f}ms")
+        # Claim slots only after a successful dispatch so a failed admission
+        # (e.g. compile error) never leaks slot state.
+        for j, ((r, handle), slot_idx) in enumerate(zip(chunk, slot_ids)):
+            for k in _SAMPLING_FIELDS:
+                self.h_sampling[k][slot_idx] = getattr(r, k)
+            self._slot_gen[slot_idx] += 1
+            self.slots[slot_idx] = _Slot(
+                request=r, handle=handle, prompt_len=int(aux[0, j]), scheduled=1, t_submit=t0
+            )
+            self.h_active[slot_idx] = True
+            self.h_override_mask[slot_idx] = False
+            items.append((slot_idx, r, handle, int(aux[0, j]), t0))
+        self._inflight.append(
+            _Entry(kind="admit", toks=toks, tk=tk, gen=list(self._slot_gen), items=items)
+        )
+
+    # ------------------------------------------------------------------ #
+    # Decode blocks
+    # ------------------------------------------------------------------ #
+
+    def _pick_block_size(self) -> int:
+        """Largest remaining token budget over active slots picks the block.
+
+        remaining >= max block size → max block (throughput). Otherwise the
+        smallest block that covers `remaining` — one slightly-overshooting
+        dispatch beats a tail of tiny dispatches when every dispatch costs an
+        RTT."""
+        remaining = 1
         for i in range(self.ecfg.max_slots):
-            if not self.h_active[i]:
+            s = self.slots[i]
+            if s is None or not self.h_active[i]:
                 continue
-            self.h_positions[i] += 1
-            tok = int(nxt[i])
-            slot = self.slots[i]
-            if slot is not None and slot.request.grammar is not None and tk_ids is not None:
-                chosen = self._grammar_choose(slot.request, tok, tk_ids[i])
-                if chosen is None:
-                    slot.handle._q.put(TokenEvent(
-                        kind="error", error="grammar admits no token from the candidate set"
-                    ))
-                    self.slots[i] = None
-                    self.h_active[i] = False
+            rem = max(
+                1,
+                min(
+                    s.request.max_new_tokens - s.scheduled,
+                    self.ecfg.max_seq - s.prompt_len - s.scheduled,
+                ),
+            )
+            remaining = max(remaining, rem)
+        chosen = self.ecfg.block_sizes[0]
+        for n in sorted(self.ecfg.block_sizes):
+            if n >= remaining:
+                return n
+            chosen = n
+        return chosen
+
+    def _dispatch_block(self, grammar: bool) -> None:
+        B = self.ecfg.max_slots
+        if grammar:
+            variant, n = "grammar", 1
+        else:
+            act = [i for i in range(B) if self.h_active[i]]
+            hs = self.h_sampling
+            needs_filter = any(
+                hs["temperature"][i] > 0
+                and (hs["top_k"][i] > 0 or hs["top_p"][i] < 1 or hs["min_p"][i] > 0)
+                for i in act
+            )
+            any_temp = any(hs["temperature"][i] > 0 for i in act)
+            variant = "filtered" if needs_filter else ("simple" if any_temp else "greedy")
+            n = self._pick_block_size()
+
+        active_snapshot = self.h_active.copy()
+        pack = np.zeros((10, B), np.float32)
+        pack[0] = active_snapshot
+        for fi, k in enumerate(_SAMPLING_FIELDS):
+            pack[1 + fi] = self.h_sampling[k]
+        pack[8] = self.h_override_tok
+        pack[9] = self.h_override_mask
+        fn = self._get_block(variant, n)
+        (
+            self.cache, self.counts, self.rngs, self.d_tokens, self.d_positions,
+            toks_block, tk_block,
+        ) = fn(
+            self.params, self.cache, self.counts, self.rngs, self.bias,
+            self.d_tokens, self.d_positions, jnp.asarray(pack),
+        )
+        _host_copy_async(toks_block)
+        if tk_block is not None:
+            _host_copy_async(tk_block)
+        self.h_override_mask[:] = False
+        for i in range(B):
+            if active_snapshot[i] and self.slots[i] is not None:
+                self.slots[i].scheduled += n
+        self._inflight.append(
+            _Entry(
+                kind="block", toks=toks_block, tk=tk_block,
+                gen=list(self._slot_gen), active=active_snapshot, n=n,
+            )
+        )
+
+    # ------------------------------------------------------------------ #
+    # Result processing (host bookkeeping)
+    # ------------------------------------------------------------------ #
+
+    def _process_entry(self, e: _Entry) -> None:
+        toks = np.asarray(e.toks)
+        tk = np.asarray(e.tk) if e.tk is not None else None
+        if e.kind == "admit":
+            for j, (slot_idx, request, handle, plen, _t0) in enumerate(e.items):
+                if self._slot_gen[slot_idx] != e.gen[slot_idx]:
                     continue
-                tok = chosen
-            self.h_tokens[i] = tok
-            self._post_token(i, tok)
+                slot = self.slots[slot_idx]
+                if slot is None:
+                    continue
+                tok = int(toks[j])
+                if request.grammar is not None:
+                    chosen = self._grammar_choose(request, tok, tk[j])
+                    if chosen is None:
+                        handle._q.put(TokenEvent(
+                            kind="error",
+                            error="grammar admits no token from this model's vocabulary",
+                        ))
+                        self._release(slot_idx)
+                        continue
+                    if chosen != tok:
+                        self.h_override_tok[slot_idx] = chosen
+                        self.h_override_mask[slot_idx] = True
+                    tok = chosen
+                slot.t_first = time.monotonic()
+                self.m_prompt_tokens += plen
+                self._post_token(slot_idx, tok)
+            return
+
+        consumed = 0
+        for step in range(e.n):
+            for i in range(self.ecfg.max_slots):
+                if not e.active[i] or self._slot_gen[i] != e.gen[i]:
+                    continue
+                slot = self.slots[i]
+                if slot is None:
+                    continue
+                tok = int(toks[step, i])
+                if slot.request.grammar is not None:
+                    chosen = self._grammar_choose(slot.request, tok, tk[step, i])
+                    if chosen is None:
+                        slot.handle._q.put(TokenEvent(
+                            kind="error",
+                            error="grammar admits no token from the candidate set",
+                        ))
+                        self._release(i)
+                        continue
+                    if chosen != tok:
+                        self.h_override_tok[i] = chosen
+                        self.h_override_mask[i] = True
+                    tok = chosen
+                consumed += 1
+                self._post_token(i, tok)
+        self._decode_tokens += consumed
 
     # ------------------------------------------------------------------ #
     # Grammar-constrained decoding
@@ -503,13 +910,30 @@ class Engine:
             self._tok_strs = self.tokenizer.token_strings()
         return self._tok_strs[tok] if 0 <= tok < len(self._tok_strs) else ""
 
+    def _first_char_buckets(self) -> dict[str, list[int]]:
+        """Token ids grouped by first character (built once per tokenizer) —
+        bounds the full-vocab grammar fallback to buckets whose first char the
+        machine currently allows."""
+        if not hasattr(self, "_fc_buckets"):
+            buckets: dict[str, list[int]] = {}
+            eos = set(self.tokenizer.eos_ids)
+            for tok in range(self.cfg.vocab_size):
+                if tok in eos:
+                    continue
+                s = self._token_str(tok)
+                if s:
+                    buckets.setdefault(s[0], []).append(tok)
+            self._fc_buckets = buckets
+        return self._fc_buckets
+
     def _grammar_choose(self, request: GenRequest, sampled: int, candidates: np.ndarray) -> Optional[int]:
         """Pick the highest-probability grammar-valid token.
 
         The sampled token keeps priority (preserves temperature sampling when
         the model already follows the grammar); otherwise candidates are
         walked in probability order; EOS is valid only once the grammar is
-        complete. Falls back to a full-vocab scan before giving up.
+        complete. Falls back to a first-char-bucketed vocab scan before
+        giving up.
         """
         g = request.grammar
         complete = g.complete()
@@ -528,25 +952,16 @@ class Engine:
             if ok(tok):
                 self._grammar_advance(g, int(tok))
                 return int(tok)
-        # Rare fallback: full-vocab scan, pre-filtered by a per-first-char
-        # probe cache so the expensive machine clone runs only on tokens whose
-        # first char is currently legal (bounds clones to |charset|, not |V|).
-        first_char_ok: dict[str, bool] = {}
-        eos_ids = set(self.tokenizer.eos_ids)
-        for tok in range(self.cfg.vocab_size):
-            if tok in eos_ids:  # EOS stays gated on grammar completion
+        # Rare fallback: scan only the first-char buckets the machine allows,
+        # so the worst case is bounded by the size of the legal buckets, not
+        # |V| machine clones.
+        for c, toks in self._first_char_buckets().items():
+            if not g.allowed(c):
                 continue
-            s = self._token_str(tok)
-            if not s:
-                continue
-            c = s[0]
-            if c not in first_char_ok:
-                first_char_ok[c] = g.allowed(c)
-            if not first_char_ok[c]:
-                continue
-            if g.allowed(s):
-                self._grammar_advance(g, tok)
-                return tok
+            for tok in toks:
+                if g.allowed(self._token_str(tok)):
+                    self._grammar_advance(g, tok)
+                    return tok
         if complete:
             return next(iter(self.tokenizer.eos_ids), None)
         return None
@@ -554,6 +969,10 @@ class Engine:
     def _grammar_advance(self, g, tok: int) -> None:
         if tok not in self.tokenizer.eos_ids:
             g.advance(self._token_str(tok))
+
+    # ------------------------------------------------------------------ #
+    # Token bookkeeping / streaming
+    # ------------------------------------------------------------------ #
 
     def _post_token(self, slot_idx: int, tok: int) -> None:
         """Append one generated token to a slot: stream text, check stops."""
@@ -621,15 +1040,20 @@ class Engine:
         slot = self.slots[slot_idx]
         assert slot is not None
         now = time.monotonic()
+        t_first = slot.t_first or now
         slot.handle._q.put(
             TokenEvent(
                 kind="done",
                 finish_reason=reason,
                 prompt_tokens=slot.prompt_len,
                 completion_tokens=len(slot.generated),
-                timing_prompt_processing=slot.t_first - slot.t_submit,
-                timing_token_generation=now - slot.t_first,
+                timing_prompt_processing=t_first - slot.t_submit,
+                timing_token_generation=now - t_first,
             )
         )
+        self._release(slot_idx)
+
+    def _release(self, slot_idx: int) -> None:
         self.slots[slot_idx] = None
         self.h_active[slot_idx] = False
+        self.h_override_mask[slot_idx] = False

@@ -72,16 +72,18 @@ def apply_penalties(
 
 
 def _filter_sorted(sorted_logits: jnp.ndarray, params: SamplingParams) -> jnp.ndarray:
-    """Apply top-k, then top-p, then min-p on descending-sorted logits [B, V].
+    """Apply top-k, then top-p, then min-p on descending-sorted logits [B, K].
 
     Chain semantics match llama.cpp: each stage renormalizes over the
     candidate set left by the previous stage (top-p mass is measured over the
     post-top-k distribution, min-p against the surviving max-probability).
+    K may be a partial candidate set (see `sample`); top_k larger than K is
+    clamped to K.
     """
     B, V = sorted_logits.shape
     ranks = jnp.arange(V)[None, :]
 
-    k = jnp.where(params.top_k <= 0, V, params.top_k)[:, None]
+    k = jnp.where(params.top_k <= 0, V, jnp.minimum(params.top_k, V))[:, None]
     keep = ranks < k
 
     # Renormalized softmax over the top-k survivors (masked-out rows get 0).
@@ -107,8 +109,19 @@ def sample(
     params: SamplingParams,
     counts: jnp.ndarray | None = None,  # [B, V] i32
     logit_bias: jnp.ndarray | None = None,  # [B, V] f32 (grammar masks, user bias)
+    num_candidates: int = 64,
 ) -> jnp.ndarray:
-    """Sample one token per slot. Returns [B] int32."""
+    """Sample one token per slot. Returns [B] int32.
+
+    TPU note: a full-vocab sort is a multi-ms operation at V=128k, so the
+    filter chain runs over a partial top-`num_candidates` candidate set
+    (exact when V <= num_candidates, e.g. every test arch). Consequences on
+    a real vocab: `top_k` is clamped to num_candidates (llama.cpp default is
+    40), and top-p mass is measured over the renormalized top-candidate head
+    — the tail mass beyond 64 candidates is negligible for any top_p < 1.
+    Slots with no filters active sample the exact full distribution via
+    `jax.random.categorical` (Gumbel argmax — no sort at all).
+    """
     logits = logits.astype(jnp.float32)
     if counts is not None:
         logits = apply_penalties(logits, counts, params)
@@ -116,21 +129,65 @@ def sample(
         logits = logits + logit_bias
 
     greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
 
     # llama.cpp chain order: top-k/top-p/min-p filter on unscaled logits,
     # temperature last — so the kept support is temperature-independent.
-    sorted_logits, sorted_idx = jax.lax.top_k(logits, logits.shape[-1])
+    K = min(num_candidates, logits.shape[-1])
+    sorted_logits, sorted_idx = jax.lax.top_k(logits, K)
     filtered = _filter_sorted(sorted_logits, params)
-    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
     filtered = jnp.where(filtered <= NEG_INF, NEG_INF, filtered / temp)
 
     def draw(key, row):
         return jax.random.categorical(key, row)
 
     pos = jax.vmap(draw)(rng, filtered)
-    sampled_tok = jnp.take_along_axis(sorted_idx, pos[:, None], axis=-1)[:, 0].astype(jnp.int32)
+    cand_tok = jnp.take_along_axis(sorted_idx, pos[:, None], axis=-1)[:, 0].astype(jnp.int32)
 
+    # Exact full-distribution draw for unfiltered slots.
+    free_tok = jax.vmap(draw)(rng, logits / temp).astype(jnp.int32)
+
+    needs_filter = (params.top_k > 0) | (params.top_p < 1.0) | (params.min_p > 0.0)
+    sampled_tok = jnp.where(needs_filter, cand_tok, free_tok)
     return jnp.where(params.temperature <= 0.0, greedy_tok, sampled_tok)
+
+
+def sample_simple(
+    logits: jnp.ndarray,  # [B, V]
+    rng: jnp.ndarray,  # [B] PRNG keys
+    params: SamplingParams,
+    counts: jnp.ndarray | None = None,
+    logit_bias: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Greedy + exact unfiltered categorical only — no top-k/top-p/min-p.
+
+    The engine dispatches this variant when no active slot has filters
+    enabled; it avoids the partial-sort entirely (one Gumbel argmax pass).
+    """
+    logits = logits.astype(jnp.float32)
+    if counts is not None:
+        logits = apply_penalties(logits, counts, params)
+    if logit_bias is not None:
+        logits = logits + logit_bias
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
+    free_tok = jax.vmap(jax.random.categorical)(rng, logits / temp).astype(jnp.int32)
+    return jnp.where(params.temperature <= 0.0, greedy_tok, free_tok)
+
+
+def sample_greedy(
+    logits: jnp.ndarray,  # [B, V]
+    params: SamplingParams,
+    counts: jnp.ndarray | None = None,
+    logit_bias: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Pure argmax (with penalties/bias) — the cheapest per-step sampler."""
+    logits = logits.astype(jnp.float32)
+    if counts is not None:
+        logits = apply_penalties(logits, counts, params)
+    if logit_bias is not None:
+        logits = logits + logit_bias
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
 def update_counts(counts: jnp.ndarray, tokens: jnp.ndarray, active: jnp.ndarray) -> jnp.ndarray:

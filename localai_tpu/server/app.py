@@ -46,10 +46,16 @@ class Response:
 
 
 class SSEStream:
-    """Handler return value that streams `data:` frames from a generator."""
+    """Handler return value that streams `data:` frames from a generator.
 
-    def __init__(self, events: Iterator[Any]):
+    `on_disconnect` (optional) is invoked when the client drops mid-stream so
+    the producer can cancel upstream work — e.g. the engine request handle —
+    instead of decoding to max_new_tokens into an unread queue.
+    """
+
+    def __init__(self, events: Iterator[Any], on_disconnect: Optional[Callable[[], None]] = None):
         self.events = events
+        self.on_disconnect = on_disconnect
 
 
 class ApiError(Exception):
@@ -217,7 +223,15 @@ def create_server(app_cfg: ApplicationConfig, router: Router) -> ThreadingHTTPSe
                 write_chunk(b"data: [DONE]\n\n")
             except (BrokenPipeError, ConnectionResetError):
                 log.debug("SSE client disconnected")
+                if stream.on_disconnect is not None:
+                    try:
+                        stream.on_disconnect()
+                    except Exception:  # noqa: BLE001
+                        log.exception("SSE on_disconnect callback failed")
             finally:
+                # Close the generator so its finally blocks (lease release)
+                # run deterministically even when the client dropped early.
+                stream.events.close()
                 try:
                     write_chunk(b"")  # terminating chunk
                 except (BrokenPipeError, ConnectionResetError):

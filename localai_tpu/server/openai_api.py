@@ -203,21 +203,46 @@ class OpenAIApi:
                     }
                     yield {**base, "choices": [{"index": 0, "delta": {"role": "assistant", "content": ""}, "finish_reason": None}]}
                     final = None
-                    for ev in handle:
-                        if ev.kind == "token":
-                            yield {**base, "choices": [{"index": 0, "delta": {"content": ev.text}, "finish_reason": None}]}
-                        elif ev.kind == "error":
-                            yield {"error": {"message": ev.error, "type": "server_error"}}
-                            return
+                    if tools:
+                        # Buffer and parse so tool calls stream as tool_calls
+                        # deltas, not raw JSON content (reference: chat.go
+                        # streams function-call deltas).
+                        parts: list[str] = []
+                        for ev in handle:
+                            if ev.kind == "token":
+                                parts.append(ev.text)
+                            elif ev.kind == "error":
+                                yield {"error": {"message": ev.error, "type": "server_error"}}
+                                return
+                            else:
+                                final = ev
+                        text = "".join(parts)
+                        calls = parse_function_calls(text, lm.cfg)
+                        if calls:
+                            deltas = [{**c, "index": i} for i, c in enumerate(calls)]
+                            yield {**base, "choices": [{"index": 0, "delta": {"tool_calls": deltas}, "finish_reason": None}]}
+                            finish = "tool_calls"
                         else:
-                            final = ev
-                    out = {**base, "choices": [{"index": 0, "delta": {}, "finish_reason": final.finish_reason}]}
+                            if text:
+                                yield {**base, "choices": [{"index": 0, "delta": {"content": text}, "finish_reason": None}]}
+                            finish = final.finish_reason
+                    else:
+                        for ev in handle:
+                            if ev.kind == "token":
+                                yield {**base, "choices": [{"index": 0, "delta": {"content": ev.text}, "finish_reason": None}]}
+                            elif ev.kind == "error":
+                                yield {"error": {"message": ev.error, "type": "server_error"}}
+                                return
+                            else:
+                                final = ev
+                        finish = final.finish_reason
+                    out = {**base, "choices": [{"index": 0, "delta": {}, "finish_reason": finish}]}
                     out["usage"] = self._usage(final, extra_usage)
                     yield out
                 finally:
                     lease.release()
 
-            return SSEStream(events())
+            return SSEStream(events(), on_disconnect=handle.cancel)
 
         try:
             text, final = lm.engine.submit(gen).result()
@@ -286,7 +311,7 @@ class OpenAIApi:
                 finally:
                     lease.release()
 
-            return SSEStream(events())
+            return SSEStream(events(), on_disconnect=handle.cancel)
 
         try:
             choices = []
