@@ -401,6 +401,11 @@ class Engine:
         V = cfg.vocab_size
         K = min(self.GRAMMAR_TOPK, V)
 
+        # Logits may cover more ids than the tokenizer can decode (padded
+        # embedding rows); permanently mask those out of sampling via the
+        # per-slot bias rows written at admission.
+        tok_v = min(getattr(self.tokenizer, "vocab_size", V) or V, V)
+
         def admit(params, cache, counts, rngs, bias, d_tokens, d_positions,
                   prompt_toks, aux, samp_pack, bias_rows):
             lens, slot_ids, seeds = aux[0], aux[1], aux[2]
@@ -414,6 +419,10 @@ class Engine:
             rows = jnp.zeros((m, V), jnp.int32)
             rows = rows.at[jnp.arange(m)[:, None], prompt_toks].add(valid)
             brows = bias_rows if has_bias else jnp.zeros((m, V), jnp.float32)
+            if tok_v < V:
+                from localai_tpu.ops.sampling import NEG_INF
+
+                brows = jnp.where(jnp.arange(V)[None, :] >= tok_v, NEG_INF, brows)
             keys0 = jax.vmap(jax.random.key)(seeds.astype(jnp.uint32))
             draws = jax.vmap(lambda k: jax.random.fold_in(k, 0))(keys0)
             toks = sample(logits, draws, samp, rows, brows)  # [m]
@@ -523,6 +532,10 @@ class Engine:
             while m <= self.ecfg.max_slots:
                 self._warm_admit(m, bucket)
                 m *= 2
+            # Bias/grammar requests always admit as singletons (see
+            # _admit_pending), so only their m=1 variants need warming.
+            self._warm_admit(1, bucket, has_bias=True)
+            self._warm_admit(1, bucket, with_topk=True)
             for n in self.ecfg.block_sizes:
                 # "filtered" is the variant real traffic hits under the
                 # server's sampling defaults (temperature+top_k/top_p), so it
@@ -673,15 +686,23 @@ class Engine:
                     group.append(self._pending.popleft())
             if not group:
                 return admitted
-            # Dispatch in power-of-two chunks (binary decomposition) so each
-            # admission program compiles for a small fixed set of M values.
+            # Requests with logit_bias or a grammar select different program
+            # variants (has_bias / with_topk); admit them as singletons so
+            # only the (m=1, ...) variants ever compile — those are warmed.
+            special = [gh for gh in group if gh[0].logit_bias or gh[0].grammar is not None]
+            plain = [gh for gh in group if not (gh[0].logit_bias or gh[0].grammar is not None)]
+            # Dispatch plain requests in power-of-two chunks (binary
+            # decomposition) so each admission program compiles for a small
+            # fixed set of M values.
+            chunks: list[list[tuple[GenRequest, RequestHandle]]] = [[gh] for gh in special]
             idx = 0
-            while idx < len(group):
+            while idx < len(plain):
                 m = 1
-                while m * 2 <= len(group) - idx:
+                while m * 2 <= len(plain) - idx:
                     m *= 2
-                chunk = group[idx: idx + m]
+                chunks.append(plain[idx: idx + m])
                 idx += m
+            for chunk in chunks:
                 try:
                     self._dispatch_admit(chunk, bucket, [free.pop(0) for _ in chunk])
                     admitted = True

@@ -85,7 +85,12 @@ class HFTokenizer:
         return ids
 
     def decode(self, ids: Sequence[int]) -> str:
-        return self._tok.decode(list(ids), skip_special_tokens=True)
+        # Guard ids beyond the tokenizer table: the model's vocab (and hence
+        # the engine's logits) may be padded past len(tokenizer) — e.g.
+        # checkpoints with rounded-up embedding rows. Such ids decode to
+        # nothing rather than crashing the stream.
+        valid = [i for i in ids if 0 <= i < self.vocab_size]
+        return self._tok.decode(valid, skip_special_tokens=True)
 
     def token_strings(self) -> list[str]:
         """Each token's contribution to a joint decode.

