@@ -81,7 +81,9 @@ def main(argv: list[str] | None = None) -> int:
     )
     log = logging.getLogger("localai_tpu")
 
+    from localai_tpu.gallery import Gallery, GalleryService
     from localai_tpu.server import ModelManager, Router, create_server
+    from localai_tpu.server.gallery_api import GalleryApi
     from localai_tpu.server.openai_api import OpenAIApi
     from localai_tpu.server.stores_api import StoresApi
 
@@ -89,6 +91,12 @@ def main(argv: list[str] | None = None) -> int:
     router = Router()
     OpenAIApi(manager).register(router)
     StoresApi().register(router)
+    gallery_service = GalleryService(
+        app_cfg.models_dir,
+        config_loader=manager.configs,
+        galleries=[Gallery(name=g["name"], url=g["url"]) for g in app_cfg.galleries],
+    )
+    GalleryApi(gallery_service, manager=manager).register(router)
 
     for name in app_cfg.preload_models:
         log.info("preloading model %s", name)

@@ -40,6 +40,10 @@ class ApplicationConfig:
     preload_models: list[str] = dataclasses.field(default_factory=list)
     default_context_size: int = 2048
 
+    # Model galleries: [{"name": ..., "url": ...}] (reference: run.go
+    # --galleries flag / GALLERIES env, JSON-encoded).
+    galleries: list[dict] = dataclasses.field(default_factory=list)
+
     cors: bool = True
     metrics: bool = True
     debug: bool = False
@@ -68,6 +72,11 @@ class ApplicationConfig:
         preload = os.environ.get("LOCALAI_PRELOAD_MODELS", "")
         if preload:
             cfg.preload_models = [m.strip() for m in preload.split(",") if m.strip()]
+        galleries = os.environ.get("LOCALAI_GALLERIES", "")
+        if galleries:
+            import json
+
+            cfg.galleries = json.loads(galleries)
         for k, v in overrides.items():
             setattr(cfg, k, v)
         return cfg

@@ -1,0 +1,12 @@
+"""Model gallery: downloadable model artifacts + async install jobs.
+
+Reference: core/gallery (models.go:75 InstallModelFromGallery, :159
+InstallModel, :363 DeleteModelFromSystem; gallery.go:22-80 YAML-over-URI
+index fetch) driven through core/services/gallery.go's job queue with
+progress polling. Backend-bundle galleries (OCI images keyed on GPU
+capability, backends.go:73) have no TPU analogue — there is one resident
+engine, not per-model binaries — so only the *model* gallery is ported.
+"""
+
+from localai_tpu.gallery.gallery import Gallery, GalleryEntry, load_index  # noqa: F401
+from localai_tpu.gallery.service import GalleryService, InstallJob  # noqa: F401

@@ -1,0 +1,132 @@
+"""Downloader tests: schemes, resume, SHA verification.
+
+Reference tier: pkg/downloader/uri_test.go; resume/SHA semantics from
+uri.go:373-459.
+"""
+
+import hashlib
+import http.server
+import os
+import threading
+
+import pytest
+
+from localai_tpu.downloader import DownloadError, download, resolve_uri
+
+
+def test_resolve_uri_schemes():
+    assert resolve_uri("https://x/y") == "https://x/y"
+    assert resolve_uri("file:///tmp/a") == "file:///tmp/a"
+    assert (
+        resolve_uri("huggingface://meta-llama/Llama-3.2-1B/model.safetensors")
+        == "https://huggingface.co/meta-llama/Llama-3.2-1B/resolve/main/model.safetensors"
+    )
+    assert (
+        resolve_uri("huggingface://o/r@dev/f.bin")
+        == "https://huggingface.co/o/r/resolve/dev/f.bin"
+    )
+    assert (
+        resolve_uri("github:owner/repo/gallery/index.yaml")
+        == "https://raw.githubusercontent.com/owner/repo/main/gallery/index.yaml"
+    )
+    with pytest.raises(DownloadError):
+        resolve_uri("huggingface://justowner")
+
+
+def test_file_scheme_with_sha(tmp_path):
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"payload" * 100)
+    sha = hashlib.sha256(src.read_bytes()).hexdigest()
+    dest = tmp_path / "out" / "dst.bin"
+    got = download(f"file://{src}", str(dest), sha256=sha)
+    assert got == str(dest)
+    assert dest.read_bytes() == src.read_bytes()
+    # Matching existing dest short-circuits (no partial left behind).
+    download(f"file://{src}", str(dest), sha256=sha)
+    assert not os.path.exists(str(dest) + ".partial")
+
+
+def test_sha_mismatch_raises(tmp_path):
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"data")
+    dest = tmp_path / "dst.bin"
+    with pytest.raises(DownloadError, match="sha256 mismatch"):
+        download(f"file://{src}", str(dest), sha256="0" * 64)
+    assert not dest.exists()
+    assert not os.path.exists(str(dest) + ".partial")
+
+
+class _RangeHandler(http.server.BaseHTTPRequestHandler):
+    """Tiny HTTP server with Range support (the stdlib handler has none)."""
+
+    payload = b"0123456789abcdef" * 4096  # 64 KiB
+    support_range = True
+    requests_seen: list[str] = []
+
+    def do_GET(self):  # noqa: N802
+        type(self).requests_seen.append(self.headers.get("Range") or "")
+        start = 0
+        rng = self.headers.get("Range")
+        if rng and self.support_range:
+            start = int(rng.split("=")[1].split("-")[0])
+            if start >= len(self.payload):
+                self.send_response(416)
+                self.end_headers()
+                return
+            self.send_response(206)
+        else:
+            self.send_response(200)
+        body = self.payload[start:]
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+@pytest.fixture()
+def http_server():
+    server = http.server.HTTPServer(("127.0.0.1", 0), _RangeHandler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    _RangeHandler.requests_seen = []
+    _RangeHandler.support_range = True
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+def test_http_download_and_progress(http_server, tmp_path):
+    dest = tmp_path / "f.bin"
+    seen = []
+    sha = hashlib.sha256(_RangeHandler.payload).hexdigest()
+    download(f"{http_server}/f.bin", str(dest), sha256=sha,
+             progress=lambda d, t: seen.append((d, t)))
+    assert dest.read_bytes() == _RangeHandler.payload
+    assert seen[-1][0] == len(_RangeHandler.payload)
+    assert seen[-1][1] == len(_RangeHandler.payload)
+
+
+def test_http_resume_from_partial(http_server, tmp_path):
+    dest = tmp_path / "f.bin"
+    half = len(_RangeHandler.payload) // 2
+    (tmp_path / "f.bin.partial").write_bytes(_RangeHandler.payload[:half])
+    download(f"{http_server}/f.bin", str(dest))
+    assert dest.read_bytes() == _RangeHandler.payload
+    # The request carried a Range header from the partial's offset.
+    assert f"bytes={half}-" in _RangeHandler.requests_seen
+
+
+def test_http_server_ignores_range(http_server, tmp_path):
+    _RangeHandler.support_range = False
+    dest = tmp_path / "f.bin"
+    (tmp_path / "f.bin.partial").write_bytes(b"junkjunk")
+    download(f"{http_server}/f.bin", str(dest))
+    assert dest.read_bytes() == _RangeHandler.payload  # restarted cleanly
+
+
+def test_http_416_means_complete(http_server, tmp_path):
+    dest = tmp_path / "f.bin"
+    (tmp_path / "f.bin.partial").write_bytes(_RangeHandler.payload)
+    download(f"{http_server}/f.bin", str(dest))
+    assert dest.read_bytes() == _RangeHandler.payload

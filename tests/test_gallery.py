@@ -1,0 +1,210 @@
+"""Gallery install flow: index → async job → installed model serves.
+
+Reference tier: core/gallery/models_test.go + app_test.go gallery apply flows
+(app_test.go:304-392) using a local fixture gallery
+(tests/fixtures/gallery_simple.yaml pattern) — here the gallery artifacts are
+a real HF checkpoint produced by save_hf_checkpoint, fetched over file://.
+"""
+
+import hashlib
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import jax
+import pytest
+import yaml
+
+from localai_tpu.engine.weights import save_hf_checkpoint
+from localai_tpu.gallery import Gallery, GalleryService, load_index
+from localai_tpu.models.llama import init_params
+
+from test_checkpoint import TINY, _write_tokenizer
+
+
+def _sha(path: str) -> str:
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+@pytest.fixture(scope="module")
+def gallery_dir(tmp_path_factory):
+    """A local gallery: artifact files + index.yaml with file:// URIs."""
+    root = tmp_path_factory.mktemp("gallery")
+    art = root / "artifacts" / "tiny-hf"
+    params = init_params(TINY, jax.random.key(7))
+    save_hf_checkpoint(TINY, params, str(art))
+    _write_tokenizer(str(art))
+    files = []
+    for fname in sorted(os.listdir(art)):
+        p = art / fname
+        files.append({
+            "filename": fname,
+            "uri": f"file://{p}",
+            "sha256": _sha(str(p)),
+        })
+    index = [{
+        "name": "tiny-gallery-model",
+        "description": "test checkpoint",
+        "license": "mit",
+        "tags": ["llm", "tiny"],
+        "files": files,
+        "overrides": {
+            "context_size": 128, "max_slots": 2, "max_tokens": 8,
+            "temperature": 0.0, "template": {"use_tokenizer_template": True},
+        },
+    }]
+    (root / "index.yaml").write_text(yaml.safe_dump(index))
+    return root
+
+
+def _wait_job(service: GalleryService, uuid: str, timeout: float = 60.0) -> dict:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        j = service.job(uuid)
+        if j and j["processed"]:
+            return j
+        time.sleep(0.05)
+    raise TimeoutError(f"job {uuid} did not finish: {service.job(uuid)}")
+
+
+def test_load_index(gallery_dir):
+    entries = load_index(Gallery(name="local", url=f"file://{gallery_dir}/index.yaml"))
+    assert len(entries) == 1
+    e = entries[0]
+    assert e.id == "local@tiny-gallery-model"
+    assert e.overrides["context_size"] == 128
+    assert all("sha256" in f for f in e.files)
+
+
+def test_install_from_gallery_and_serve(gallery_dir, tmp_path_factory):
+    """The full reference flow: apply → job polls done → model serves chat."""
+    from localai_tpu.config import ApplicationConfig
+    from localai_tpu.server import ModelManager, Router, create_server
+    from localai_tpu.server.gallery_api import GalleryApi
+    from localai_tpu.server.openai_api import OpenAIApi
+
+    models = tmp_path_factory.mktemp("gal_models")
+    app_cfg = ApplicationConfig(address="127.0.0.1", port=0, models_dir=str(models))
+    manager = ModelManager(app_cfg)
+    service = GalleryService(
+        str(models), config_loader=manager.configs,
+        galleries=[Gallery(name="local", url=f"file://{gallery_dir}/index.yaml")],
+    )
+    router = Router()
+    OpenAIApi(manager).register(router)
+    GalleryApi(service, manager=manager).register(router)
+    server = create_server(app_cfg, router)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{port}"
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    try:
+        # Browse.
+        with urllib.request.urlopen(base + "/models/available", timeout=30) as r:
+            avail = json.loads(r.read())
+        assert avail and avail[0]["id"] == "local@tiny-gallery-model"
+        assert avail[0]["installed"] is False
+
+        # Install (async) + poll.
+        out = post("/models/apply", {"id": "local@tiny-gallery-model"})
+        job = _wait_job(service, out["uuid"])
+        assert job["status"] == "done", job
+        assert job["progress"] == 100.0
+        assert (models / "tiny-gallery-model.yaml").exists()
+
+        # Now listed as installed and serving.
+        with urllib.request.urlopen(base + "/models/available", timeout=30) as r:
+            assert json.loads(r.read())[0]["installed"] is True
+        resp = post("/v1/chat/completions", {
+            "model": "tiny-gallery-model",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 4,
+        })
+        assert resp["choices"][0]["message"]["role"] == "assistant"
+
+        # Delete: config + artifacts gone, endpoint 404s afterwards.
+        post("/models/delete/tiny-gallery-model", {})
+        assert not (models / "tiny-gallery-model.yaml").exists()
+        assert not (models / "tiny-gallery-model").exists()
+        with pytest.raises(urllib.error.HTTPError):
+            post("/v1/chat/completions", {
+                "model": "tiny-gallery-model",
+                "messages": [{"role": "user", "content": "hi"}],
+            })
+    finally:
+        server.shutdown()
+        manager.shutdown()
+
+
+def test_inline_install_and_bad_sha(gallery_dir, tmp_path):
+    """Inline files/overrides form + checksum failure surfaces in the job."""
+    models = tmp_path / "models"
+    models.mkdir()
+    service = GalleryService(str(models))
+    src = gallery_dir / "artifacts" / "tiny-hf" / "config.json"
+
+    uuid = service.apply(
+        name="inline-model",
+        files=[{"filename": "config.json", "uri": f"file://{src}", "sha256": _sha(str(src))}],
+        overrides={"context_size": 64},
+    )
+    job = _wait_job(service, uuid)
+    assert job["status"] == "done"
+    cfg = yaml.safe_load((models / "inline-model.yaml").read_text())
+    assert cfg["name"] == "inline-model"
+    assert cfg["context_size"] == 64
+    assert cfg["model"].endswith("inline-model")
+
+    uuid = service.apply(
+        name="bad-sha",
+        files=[{"filename": "x", "uri": f"file://{src}", "sha256": "0" * 64}],
+    )
+    job = _wait_job(service, uuid)
+    assert job["status"] == "error"
+    assert "sha256 mismatch" in job["error"]
+
+
+def test_gallery_management(tmp_path):
+    service = GalleryService(str(tmp_path))
+    service.add_gallery("a", "file:///nonexistent/index.yaml")
+    with pytest.raises(ValueError):
+        service.add_gallery("a", "file:///other")
+    assert service.list_available() == []  # bad gallery logged, not fatal
+    assert service.remove_gallery("a") is True
+    assert service.remove_gallery("a") is False
+
+
+def test_path_traversal_rejected(tmp_path):
+    """Names and artifact filenames must never escape models_dir."""
+    service = GalleryService(str(tmp_path))
+    with pytest.raises(ValueError):
+        service.apply(name="../evil", files=[{"uri": "file:///x"}])
+    with pytest.raises(ValueError):
+        service.apply(name="a/b", files=[{"uri": "file:///x"}])
+    with pytest.raises(ValueError):
+        service.delete_model("..")
+    with pytest.raises(ValueError):
+        service.delete_model("a/../../b")
+
+    # Malicious index filename escaping the install dir fails the job.
+    src = tmp_path / "payload"
+    src.write_bytes(b"x")
+    uuid = service.apply(
+        name="esc",
+        files=[{"filename": "../../outside", "uri": f"file://{src}"}],
+    )
+    job = _wait_job(service, uuid)
+    assert job["status"] == "error"
+    assert "escapes" in job["error"]
+    assert not (tmp_path.parent / "outside").exists()
