@@ -31,10 +31,13 @@ class ApplicationConfig:
     # Auth (reference: core/http/middleware/auth.go).
     api_keys: list[str] = dataclasses.field(default_factory=list)
 
-    # Lifecycle (reference: watchdog flags, run.go).
-    max_active_models: int = 1  # LRU HBM budget: how many engines stay resident
+    # Lifecycle (reference: watchdog flags, run.go). max_active_models <= 0
+    # means unlimited (reference MaxActiveBackends default) — HBM is the real
+    # budget; set a positive value to enforce LRU eviction.
+    max_active_models: int = 0
     watchdog_idle_timeout_s: float = 0.0  # 0 disables
     watchdog_busy_timeout_s: float = 0.0
+    watchdog_interval_s: float = 5.0  # reference ticks at 30s (watchdog.go:197)
 
     # Engine defaults.
     preload_models: list[str] = dataclasses.field(default_factory=list)
@@ -60,6 +63,7 @@ class ApplicationConfig:
             max_active_models=_env("LOCALAI_MAX_ACTIVE_MODELS", cls.max_active_models, int),
             watchdog_idle_timeout_s=_env("LOCALAI_WATCHDOG_IDLE_TIMEOUT", 0.0, float),
             watchdog_busy_timeout_s=_env("LOCALAI_WATCHDOG_BUSY_TIMEOUT", 0.0, float),
+            watchdog_interval_s=_env("LOCALAI_WATCHDOG_INTERVAL", cls.watchdog_interval_s, float),
             default_context_size=_env("LOCALAI_CONTEXT_SIZE", cls.default_context_size, int),
             cors=_env("LOCALAI_CORS", True, bool),
             metrics=not _env("LOCALAI_DISABLE_METRICS", False, bool),

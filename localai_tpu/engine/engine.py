@@ -486,6 +486,22 @@ class Engine:
     def generate(self, prompt_ids: list[int], **kw) -> tuple[str, TokenEvent]:
         return self.submit(GenRequest(prompt_ids=list(prompt_ids), **kw)).result()
 
+    def cancel_all(self) -> int:
+        """Cancel every active and pending request (watchdog busy-kill path —
+        reference: watchdog.go:250-279 kills the wedged backend process; here
+        the slots drain via their cancelled handles). Returns count."""
+        n = 0
+        with self._pending_lock:
+            for _req, handle in self._pending:
+                handle.cancel()
+                n += 1
+        for slot in list(self.slots):
+            if slot is not None:
+                slot.handle.cancel()
+                n += 1
+        self._wake.set()
+        return n
+
     def embed(self, ids_batch: list[list[int]]) -> np.ndarray:
         """Batched sentence embeddings [N, D] (L2-normalized)."""
         S = self._bucket_for(max(len(x) for x in ids_batch))

@@ -83,6 +83,13 @@ class ModelManager:
         self._loaded: dict[str, LoadedModel] = {}
         self._lock = threading.Lock()
         self._loading: dict[str, threading.Event] = {}
+        self._wd_stop = threading.Event()
+        self._wd_thread: Optional[threading.Thread] = None
+        if app_cfg.watchdog_idle_timeout_s > 0 or app_cfg.watchdog_busy_timeout_s > 0:
+            self._wd_thread = threading.Thread(
+                target=self._watchdog_loop, daemon=True, name="watchdog"
+            )
+            self._wd_thread.start()
 
     # ------------------------------------------------------------------ #
 
@@ -113,7 +120,16 @@ class ModelManager:
             cfg = self.configs.get(name)
             if cfg is None:
                 raise KeyError(f"model {name!r} not found")
-            lm = self._load(cfg)
+            try:
+                lm = self._load(cfg)
+            except (KeyError, RuntimeError):
+                raise
+            except Exception as e:
+                # Containment: a failed load (bad checkpoint, HBM OOM,
+                # compile error) errors this one call and leaves serving up
+                # (reference: initializers.go:123-150).
+                gc.collect()
+                raise RuntimeError(f"failed to load model {name!r}: {e}") from e
             with self._lock:
                 self._loaded[name] = lm
                 self._evict_lru_locked(protect=name)
@@ -161,11 +177,46 @@ class ModelManager:
         self._teardown(lm)
 
     def shutdown(self) -> None:
+        self._wd_stop.set()
         with self._lock:
             loaded = list(self._loaded.values())
             self._loaded.clear()
         for lm in loaded:
             self._teardown(lm)
+
+    # ------------------------------------------------------------------ #
+    # Watchdog (reference: pkg/model/watchdog.go:197-279)
+    # ------------------------------------------------------------------ #
+
+    def _watchdog_loop(self) -> None:
+        while not self._wd_stop.wait(self.app_cfg.watchdog_interval_s):
+            try:
+                self._watchdog_tick()
+            except Exception:  # noqa: BLE001 — the watchdog must survive
+                log.exception("watchdog tick failed")
+
+    def _watchdog_tick(self, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        idle_t = self.app_cfg.watchdog_idle_timeout_s
+        busy_t = self.app_cfg.watchdog_busy_timeout_s
+        with self._lock:
+            snapshot = list(self._loaded.items())
+        for name, lm in snapshot:
+            if busy_t > 0 and lm.busy_since is not None and now - lm.busy_since > busy_t:
+                # A wedged generation holds its slot forever otherwise. The
+                # reference kills the backend process (watchdog.go:250-279);
+                # here the engine's requests are cancelled (slots drain to
+                # their clients as finish_reason=stop) and the engine is
+                # evicted so the next request gets a fresh one.
+                n = lm.engine.cancel_all()
+                log.warning(
+                    "watchdog: model %s busy for >%gs — cancelled %d requests and evicting",
+                    name, busy_t, n,
+                )
+                self.unload(name, drain_s=5.0)
+            elif idle_t > 0 and lm.in_flight == 0 and now - lm.last_used > idle_t:
+                log.info("watchdog: model %s idle for >%gs — evicting", name, idle_t)
+                self.unload(name, drain_s=0.0)
 
     # ------------------------------------------------------------------ #
 
@@ -182,7 +233,9 @@ class ModelManager:
 
         `protect` is the model a get() is about to hand to its caller — never
         evict it, even though its lease hasn't been acquired yet."""
-        budget = max(1, self.app_cfg.max_active_models)
+        budget = self.app_cfg.max_active_models
+        if budget <= 0:
+            return  # unlimited — HBM is the only budget (reference default)
         while len(self._loaded) > budget:
             idle = [
                 (lm.last_used, n)

@@ -105,3 +105,101 @@ def test_get_unknown_model_raises(tmp_path):
     with pytest.raises(KeyError):
         mgr.get("nope")
     mgr.shutdown()
+
+
+def _mk_watchdog_manager(tmp_path, idle=0.0, busy=0.0, interval=0.2):
+    d = tmp_path / "models"
+    d.mkdir(exist_ok=True)
+    (d / "wd.yaml").write_text(yaml.safe_dump({
+        "name": "wd", "model": "tiny", "context_size": 64,
+        "max_slots": 2, "max_tokens": 4,
+    }))
+    return ModelManager(ApplicationConfig(
+        models_dir=str(d),
+        watchdog_idle_timeout_s=idle,
+        watchdog_busy_timeout_s=busy,
+        watchdog_interval_s=interval,
+    ))
+
+
+def test_watchdog_idle_eviction(tmp_path):
+    """Reference: watchdog.go:220-248 idle-timeout kill."""
+    mgr = _mk_watchdog_manager(tmp_path, idle=0.5)
+    lm = mgr.get("wd")
+    deadline = time.monotonic() + 15
+    while mgr.peek("wd") is not None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert mgr.peek("wd") is None, "idle model should have been evicted"
+    deadline = time.monotonic() + 10
+    while lm.engine.params is not None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert lm.engine.params is None
+    # A new request transparently reloads.
+    lm2 = mgr.get("wd")
+    assert lm2 is not lm
+    mgr.shutdown()
+
+
+def test_watchdog_busy_kill_cancels_wedged(tmp_path):
+    """Reference: watchdog.go:250-279 busy-timeout kill. A request that never
+    finishes (huge budget) is cancelled and its model evicted."""
+    from localai_tpu.engine import GenRequest
+
+    mgr = _mk_watchdog_manager(tmp_path, busy=0.8)
+    lm, lease = mgr.lease("wd")
+    handle = lm.engine.submit(GenRequest(
+        prompt_ids=[65, 66], max_new_tokens=10_000, ignore_eos=True,
+    ))
+    events = list(handle)  # watchdog cancel ends the stream
+    assert events[-1].kind == "done"
+    assert events[-1].finish_reason == "stop"
+    lease.release()
+    deadline = time.monotonic() + 15
+    while mgr.peek("wd") is not None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert mgr.peek("wd") is None, "wedged model should have been evicted"
+    mgr.shutdown()
+
+
+def test_watchdog_no_timeouts_leaves_models_alone(tmp_path):
+    mgr = _mk_watchdog_manager(tmp_path)  # both timeouts 0 = disabled
+    assert mgr._wd_thread is None
+    lm = mgr.get("wd")
+    time.sleep(0.5)
+    assert mgr.peek("wd") is lm
+    mgr.shutdown()
+
+
+def test_failed_load_keeps_serving(tmp_path):
+    """OOM/bad-checkpoint containment: a failing load errors that one call,
+    and other models keep serving (reference: initializers.go:123-150)."""
+    d = tmp_path / "models"
+    d.mkdir()
+    (d / "good.yaml").write_text(yaml.safe_dump({
+        "name": "good", "model": "tiny", "context_size": 64, "max_tokens": 4,
+    }))
+    bad_dir = tmp_path / "bad-ckpt"
+    bad_dir.mkdir()
+    (bad_dir / "config.json").write_text("{not json")
+    (d / "bad.yaml").write_text(yaml.safe_dump({
+        "name": "bad", "model": str(bad_dir), "context_size": 64,
+    }))
+    mgr = ModelManager(ApplicationConfig(models_dir=str(d)))
+    with pytest.raises(RuntimeError, match="failed to load model 'bad'"):
+        mgr.get("bad")
+    # Retry fails again (no stuck loading state) ...
+    with pytest.raises(RuntimeError):
+        mgr.get("bad")
+    # ... and the good model loads and serves.
+    lm = mgr.get("good")
+    text, ev = lm.engine.generate([65], max_new_tokens=2, ignore_eos=True)
+    assert ev.kind == "done"
+    mgr.shutdown()
+
+
+def test_unlimited_budget_default(tmp_path):
+    mgr = _mk_manager(tmp_path, max_active=0, n_models=3)
+    for i in range(3):
+        mgr.get(f"m{i}")
+    assert len(mgr.loaded_names()) == 3  # nothing evicted
+    mgr.shutdown()
