@@ -31,12 +31,12 @@ def prefill_attention(
     length_mask: jnp.ndarray | None,  # [B, S] bool
     lengths: jnp.ndarray | None = None,  # [B] int32 (enables flash path)
 ) -> jnp.ndarray:
-    """Prefill attention dispatcher: Pallas flash kernel on TPU (opt-in via
-    LOCALAI_FLASH=1 until burned in on hardware), dense math otherwise."""
+    """Prefill attention dispatcher: Pallas flash kernel on TPU by default
+    (opt out with LOCALAI_FLASH=0), dense math otherwise."""
     S = q.shape[1]
     if (
         lengths is not None
-        and os.environ.get("LOCALAI_FLASH", "0") == "1"
+        and os.environ.get("LOCALAI_FLASH", "1") != "0"
         and jax.default_backend() == "tpu"
         and (S & (S - 1)) == 0  # power-of-two bucket, divisible by any block
     ):

@@ -75,8 +75,11 @@ def _flash_kernel(
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, num_kv_blocks, body, (acc0, m0, l0))
 
-    # Fully-masked rows (padding) have l == 0; emit zeros, not NaNs.
-    o = acc / jnp.maximum(l, 1e-30)
+    # Padding query rows (q_pos >= length) attend over the valid prefix and
+    # would emit finite garbage; zero them explicitly so the output contract
+    # is "padded rows are zeros" for any downstream pooling without a mask.
+    q_row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    o = jnp.where(q_row < length, acc / jnp.maximum(l, 1e-30), 0.0)
     o_ref[0, 0] = o.astype(o_ref.dtype)
 
 

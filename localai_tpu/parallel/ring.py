@@ -67,7 +67,11 @@ def _local_ring(q, k, v, lengths, *, axis: str, n_shards: int):
         return k_blk, v_blk, acc_new, m_new, l_new
 
     _, _, acc, m, l = jax.lax.fori_loop(0, n_shards, step, (k, v, acc0, m0, l0))
-    out = acc / jnp.maximum(l, 1e-30)  # fully-masked (padding) rows -> 0
+    out = acc / jnp.maximum(l, 1e-30)
+    # Padding query rows attend over the valid prefix (finite garbage); zero
+    # them so the contract is "padded rows are zeros" (matches ops/flash.py).
+    valid_q = (q_pos[None, :] < lengths[:, None])[:, None, None, :, None]
+    out = jnp.where(valid_q, out, 0.0)
     return out.transpose(0, 3, 1, 2, 4).reshape(B, S_l, H, D).astype(q.dtype)
 
 
