@@ -135,6 +135,12 @@ class GenRequest:
     # grammar is complete. Penalty counts track sampled (not overridden)
     # tokens for these requests — an accepted approximation.
     grammar: Optional[Any] = None
+    # Top-N logprobs per generated token (0 = off). When > 0 every token
+    # event carries the sampled token's logprob and the top-N alternatives,
+    # computed from log_softmax(logits + bias) — the raw model distribution
+    # (with user bias), before penalties/temperature, matching OpenAI
+    # semantics (reference: Reply logprobs in backend.proto / chat.go).
+    logprobs: int = 0
 
 
 @dataclasses.dataclass
@@ -149,6 +155,9 @@ class TokenEvent:
     completion_tokens: int = 0
     timing_prompt_processing: float = 0.0  # seconds (TTFT component)
     timing_token_generation: float = 0.0
+    # Filled on "token" when the request asked for logprobs.
+    logprob: Optional[float] = None
+    top_logprobs: Optional[list] = None  # [(token_id, logprob)] descending
 
 
 class RequestHandle:
@@ -209,7 +218,8 @@ class _Entry:
     kind: str  # "admit" | "block"
     toks: Any  # device array: admit [M]; block [n, B]
     tk: Any  # top-k candidate ids or None: admit [M, K]; block [n, B, K]
-    gen: list[int]  # slot-generation snapshot at dispatch
+    lp: Any = None  # logprob triple (tok_lp, lp_ids, lp_vals) or None
+    gen: list[int] = dataclasses.field(default_factory=list)  # slot-generation snapshot at dispatch
     items: Optional[list] = None  # admit: [(slot_idx, request, handle, plen, t0)]
     active: Optional[np.ndarray] = None  # block: active mask at dispatch
     n: int = 0  # block: tokens per slot in this entry
@@ -225,6 +235,7 @@ class Engine:
     """Persistent multi-slot generation engine for one loaded model."""
 
     GRAMMAR_TOPK = 64
+    LOGPROB_TOPK = 20  # OpenAI caps top_logprobs at 20
 
     def __init__(
         self,
@@ -320,7 +331,7 @@ class Engine:
         self._prefill_fn = _prefill
         self._embed_fn = _embed
 
-    def _get_block(self, variant: str, n: int):
+    def _get_block(self, variant: str, n: int, with_lp: bool = False):
         """Fused n-step decode block program for one sampling variant.
 
         variant: "greedy" | "simple" | "filtered" | "grammar".
@@ -330,14 +341,19 @@ class Engine:
         overrides) rides in ONE packed [10, B] f32 array — on remote-tunneled
         runtimes every separate H2D transfer costs milliseconds of RTT, so
         the hot path gets exactly one.
+
+        with_lp additionally returns, per step, the sampled token's logprob
+        and the top-LOGPROB_TOPK (ids, logprobs) from log_softmax(logits +
+        bias) — the OpenAI logprobs contract (pre-penalty, pre-temperature).
         """
-        key = (variant, n)
+        key = (variant, n, with_lp)
         fn = self._block_cache.get(key)
         if fn is not None:
             return fn
         cfg = self.cfg
         B, S = self.ecfg.max_slots, self.ecfg.max_seq
         K = min(self.GRAMMAR_TOPK, cfg.vocab_size)
+        LK = min(self.LOGPROB_TOPK, cfg.vocab_size)
 
         def block(params, cache, counts, rngs, bias, tokens, positions, pack):
             active = pack[0] > 0
@@ -369,6 +385,13 @@ class Engine:
                     out = (nxt, tk)
                 else:
                     out = (nxt,)
+                if with_lp:
+                    logp = jax.nn.log_softmax(
+                        logits.astype(jnp.float32) + bias, axis=-1
+                    )
+                    lp_vals, lp_ids = jax.lax.top_k(logp, LK)
+                    tok_lp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
+                    out = out + (tok_lp, lp_ids, lp_vals)
                 # Clamp so idle/overshooting slots keep writing inside their
                 # own cache row instead of out-of-bounds.
                 positions = jnp.minimum(positions + 1, S - 1)
@@ -379,13 +402,15 @@ class Engine:
             )
             toks_block = outs[0]  # [n, B]
             tk_block = outs[1] if variant == "grammar" else None
-            return cache, counts, rngs, tokens, positions, toks_block, tk_block
+            lp_block = tuple(outs[-3:]) if with_lp else None  # ([n,B],[n,B,LK],[n,B,LK])
+            return cache, counts, rngs, tokens, positions, toks_block, tk_block, lp_block
 
         fn = jax.jit(block, donate_argnums=(1, 2, 3, 5, 6))
         self._block_cache[key] = fn
         return fn
 
-    def _get_admit(self, m: int, bucket: int, has_bias: bool, with_topk: bool):
+    def _get_admit(self, m: int, bucket: int, has_bias: bool, with_topk: bool,
+                   with_lp: bool = False):
         """Fused admission program: prefill M prompts, write their KV/state
         into their slots, and sample each first token — one dispatch.
 
@@ -393,13 +418,14 @@ class Engine:
         and `samp_pack` [7, M] f32 (sampling params), so an admission costs
         three H2D transfers (prompts, aux, samp) instead of twelve.
         """
-        key = (m, bucket, has_bias, with_topk)
+        key = (m, bucket, has_bias, with_topk, with_lp)
         fn = self._admit_cache.get(key)
         if fn is not None:
             return fn
         cfg = self.cfg
         V = cfg.vocab_size
         K = min(self.GRAMMAR_TOPK, V)
+        LK = min(self.LOGPROB_TOPK, V)
 
         # Logits may cover more ids than the tokenizer can decode (padded
         # embedding rows); permanently mask those out of sampling via the
@@ -428,6 +454,12 @@ class Engine:
             toks = sample(logits, draws, samp, rows, brows)  # [m]
             rows = rows.at[jnp.arange(m), toks].add(1)
             tk = jax.lax.top_k(logits + brows, K)[1] if with_topk else None
+            lp = None
+            if with_lp:
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32) + brows, axis=-1)
+                lp_vals, lp_ids = jax.lax.top_k(logp, LK)
+                tok_lp = jnp.take_along_axis(logp, toks[:, None], axis=-1)[:, 0]
+                lp = (tok_lp, lp_ids, lp_vals)
             for j in range(m):  # m is static and small — unrolled
                 s = slot_ids[j]
                 cache = llama.write_prefill_to_cache(
@@ -438,7 +470,7 @@ class Engine:
                 bias = bias.at[s].set(brows[j])
                 d_tokens = d_tokens.at[s].set(toks[j])
                 d_positions = d_positions.at[s].set(lens[j])
-            return cache, counts, rngs, bias, d_tokens, d_positions, toks, tk
+            return cache, counts, rngs, bias, d_tokens, d_positions, toks, tk, lp
 
         fn = jax.jit(admit, donate_argnums=(1, 2, 3, 4, 5, 6))
         self._admit_cache[key] = fn
@@ -524,7 +556,7 @@ class Engine:
             "queue_depth": float(len(self._pending)),
         }
 
-    def warmup(self, prompt_len: int = 8, grammar: bool = False) -> None:
+    def warmup(self, prompt_len: int = 8, grammar: bool = False, logprobs: bool = False) -> None:
         """Compile AND execute the serving programs before traffic arrives.
 
         Runs every admission group size (powers of two up to max_slots at
@@ -548,16 +580,20 @@ class Engine:
             while m <= self.ecfg.max_slots:
                 self._warm_admit(m, bucket)
                 m *= 2
-            # Bias/grammar requests always admit as singletons (see
+            # Bias/grammar/logprobs requests always admit as singletons (see
             # _admit_pending), so only their m=1 variants need warming.
             self._warm_admit(1, bucket, has_bias=True)
             self._warm_admit(1, bucket, with_topk=True)
+            if logprobs:
+                self._warm_admit(1, bucket, with_lp=True)
             for n in self.ecfg.block_sizes:
                 # "filtered" is the variant real traffic hits under the
                 # server's sampling defaults (temperature+top_k/top_p), so it
                 # must be warm too.
                 for variant in ("greedy", "simple", "filtered"):
                     self._warm_block(variant, n)
+                    if logprobs:
+                        self._warm_block(variant, n, with_lp=True)
         _, ev = self.generate([1] * prompt_len, max_new_tokens=2)
         assert ev.kind == "done"
         if grammar:
@@ -583,23 +619,24 @@ class Engine:
     # slots are free, admission resets every per-slot row, and inactive-slot
     # decode writes only into rows that the next admission overwrites.
 
-    def _warm_block(self, variant: str, n: int) -> None:
+    def _warm_block(self, variant: str, n: int, with_lp: bool = False) -> None:
         B = self.ecfg.max_slots
-        fn = self._get_block(variant, n)
+        fn = self._get_block(variant, n, with_lp)
         pack = np.zeros((10, B), np.float32)
         pack[3] = 1.0  # top_p
         pack[5] = 1.0  # repeat_penalty
         (
             self.cache, self.counts, self.rngs, self.d_tokens, self.d_positions,
-            toks, _tk,
+            toks, _tk, _lp,
         ) = fn(
             self.params, self.cache, self.counts, self.rngs, self.bias,
             self.d_tokens, self.d_positions, jnp.asarray(pack),
         )
         jax.block_until_ready(toks)
 
-    def _warm_admit(self, m: int, bucket: int, has_bias: bool = False, with_topk: bool = False) -> None:
-        fn = self._get_admit(m, bucket, has_bias, with_topk)
+    def _warm_admit(self, m: int, bucket: int, has_bias: bool = False,
+                    with_topk: bool = False, with_lp: bool = False) -> None:
+        fn = self._get_admit(m, bucket, has_bias, with_topk, with_lp)
         aux = np.zeros((3, m), np.int32)
         aux[0] = 1  # lens
         aux[1] = np.arange(m) % self.ecfg.max_slots  # slot ids
@@ -608,7 +645,7 @@ class Engine:
         samp_pack[4] = 1.0  # repeat_penalty
         (
             self.cache, self.counts, self.rngs, self.bias,
-            self.d_tokens, self.d_positions, toks, _tk,
+            self.d_tokens, self.d_positions, toks, _tk, _lp,
         ) = fn(
             self.params, self.cache, self.counts, self.rngs, self.bias,
             self.d_tokens, self.d_positions,
@@ -631,6 +668,13 @@ class Engine:
         return any(
             self.h_active[i] and self.slots[i] is not None
             and self.slots[i].request.grammar is not None
+            for i in range(self.ecfg.max_slots)
+        )
+
+    def _lp_active(self) -> bool:
+        return any(
+            self.h_active[i] and self.slots[i] is not None
+            and self.slots[i].request.logprobs > 0
             for i in range(self.ecfg.max_slots)
         )
 
@@ -702,11 +746,15 @@ class Engine:
                     group.append(self._pending.popleft())
             if not group:
                 return admitted
-            # Requests with logit_bias or a grammar select different program
-            # variants (has_bias / with_topk); admit them as singletons so
-            # only the (m=1, ...) variants ever compile — those are warmed.
-            special = [gh for gh in group if gh[0].logit_bias or gh[0].grammar is not None]
-            plain = [gh for gh in group if not (gh[0].logit_bias or gh[0].grammar is not None)]
+            # Requests with logit_bias, a grammar, or logprobs select
+            # different program variants (has_bias / with_topk / with_lp);
+            # admit them as singletons so only the (m=1, ...) variants ever
+            # compile — those are warmed.
+            def _special(r: GenRequest) -> bool:
+                return bool(r.logit_bias) or r.grammar is not None or r.logprobs > 0
+
+            special = [gh for gh in group if _special(gh[0])]
+            plain = [gh for gh in group if not _special(gh[0])]
             # Dispatch plain requests in power-of-two chunks (binary
             # decomposition) so each admission program compiles for a small
             # fixed set of M values.
@@ -743,6 +791,7 @@ class Engine:
         samp_pack = np.zeros((7, m), np.float32)
         bias_rows = None
         with_topk = False
+        with_lp = False
         items = []
         for j, (r, _handle) in enumerate(chunk):
             ids = r.prompt_ids
@@ -764,11 +813,13 @@ class Engine:
                         bias_rows[j, int(tid)] = bval
             if r.grammar is not None:
                 with_topk = True
+            if r.logprobs > 0:
+                with_lp = True
 
         has_bias = bias_rows is not None
         trace = os.environ.get("LOCALAI_ENGINE_TRACE", "0") == "1"
         t_a = time.monotonic()
-        fn = self._get_admit(m, bucket, has_bias, with_topk)
+        fn = self._get_admit(m, bucket, has_bias, with_topk, with_lp)
         t_b = time.monotonic()
         args_in = (
             jnp.asarray(prompt_toks), jnp.asarray(aux), jnp.asarray(samp_pack),
@@ -777,7 +828,7 @@ class Engine:
         t_c = time.monotonic()
         (
             self.cache, self.counts, self.rngs, self.bias,
-            self.d_tokens, self.d_positions, toks, tk,
+            self.d_tokens, self.d_positions, toks, tk, lp,
         ) = fn(
             self.params, self.cache, self.counts, self.rngs, self.bias,
             self.d_tokens, self.d_positions, *args_in,
@@ -800,7 +851,7 @@ class Engine:
             self.h_override_mask[slot_idx] = False
             items.append((slot_idx, r, handle, int(aux[0, j]), t0))
         self._inflight.append(
-            _Entry(kind="admit", toks=toks, tk=tk, gen=list(self._slot_gen), items=items)
+            _Entry(kind="admit", toks=toks, tk=tk, lp=lp, gen=list(self._slot_gen), items=items)
         )
 
     # ------------------------------------------------------------------ #
@@ -850,6 +901,7 @@ class Engine:
             variant = "filtered" if needs_filter else ("simple" if any_temp else "greedy")
             n = self._pick_block_size()
 
+        with_lp = self._lp_active()
         active_snapshot = self.h_active.copy()
         pack = np.zeros((10, B), np.float32)
         pack[0] = active_snapshot
@@ -857,10 +909,10 @@ class Engine:
             pack[1 + fi] = self.h_sampling[k]
         pack[8] = self.h_override_tok
         pack[9] = self.h_override_mask
-        fn = self._get_block(variant, n)
+        fn = self._get_block(variant, n, with_lp)
         (
             self.cache, self.counts, self.rngs, self.d_tokens, self.d_positions,
-            toks_block, tk_block,
+            toks_block, tk_block, lp_block,
         ) = fn(
             self.params, self.cache, self.counts, self.rngs, self.bias,
             self.d_tokens, self.d_positions, jnp.asarray(pack),
@@ -874,7 +926,7 @@ class Engine:
                 self.slots[i].scheduled += n
         self._inflight.append(
             _Entry(
-                kind="block", toks=toks_block, tk=tk_block,
+                kind="block", toks=toks_block, tk=tk_block, lp=lp_block,
                 gen=list(self._slot_gen), active=active_snapshot, n=n,
             )
         )
@@ -886,6 +938,9 @@ class Engine:
     def _process_entry(self, e: _Entry) -> None:
         toks = np.asarray(e.toks)
         tk = np.asarray(e.tk) if e.tk is not None else None
+        lp = (
+            tuple(np.asarray(a) for a in e.lp) if e.lp is not None else None
+        )  # (tok_lp, lp_ids, lp_vals)
         if e.kind == "admit":
             for j, (slot_idx, request, handle, plen, _t0) in enumerate(e.items):
                 if self._slot_gen[slot_idx] != e.gen[slot_idx]:
@@ -909,7 +964,8 @@ class Engine:
                     tok = chosen
                 slot.t_first = time.monotonic()
                 self.m_prompt_tokens += plen
-                self._post_token(slot_idx, tok)
+                lpj = (lp[0][j], lp[1][j], lp[2][j]) if lp is not None else None
+                self._post_token(slot_idx, tok, lpj)
             return
 
         consumed = 0
@@ -935,7 +991,8 @@ class Engine:
                         self.h_override_mask[i] = True
                     tok = chosen
                 consumed += 1
-                self._post_token(i, tok)
+                lpi = (lp[0][step, i], lp[1][step, i], lp[2][step, i]) if lp is not None else None
+                self._post_token(i, tok, lpi)
         self._decode_tokens += consumed
 
     # ------------------------------------------------------------------ #
@@ -946,6 +1003,10 @@ class Engine:
         if self._tok_strs is None:
             self._tok_strs = self.tokenizer.token_strings()
         return self._tok_strs[tok] if 0 <= tok < len(self._tok_strs) else ""
+
+    def token_text(self, tok: int) -> str:
+        """Decoded string for one token id (logprob entries in the API)."""
+        return self._token_str(tok)
 
     def _first_char_buckets(self) -> dict[str, list[int]]:
         """Token ids grouped by first character (built once per tokenizer) —
@@ -1011,14 +1072,32 @@ class Engine:
     # Token bookkeeping / streaming
     # ------------------------------------------------------------------ #
 
-    def _post_token(self, slot_idx: int, tok: int) -> None:
-        """Append one generated token to a slot: stream text, check stops."""
+    def _post_token(self, slot_idx: int, tok: int, lp=None) -> None:
+        """Append one generated token to a slot: stream text, check stops.
+
+        lp, when present, is this step's (tok_lp scalar, lp_ids [LK],
+        lp_vals [LK]) from the decode/admit program.
+        """
         slot = self.slots[slot_idx]
         assert slot is not None
         r, handle = slot.request, slot.handle
         if handle.cancelled.is_set():
             self._finish(slot_idx, "stop")
             return
+
+        logprob = None
+        top_logprobs = None
+        if lp is not None and r.logprobs > 0:
+            tok_lp, lp_ids, lp_vals = lp
+            logprob = float(tok_lp)
+            # Grammar overrides replace the sampled token; recover the
+            # emitted token's logprob from the top-LK list when possible.
+            ids = lp_ids.tolist()
+            if r.grammar is not None:
+                logprob = float(lp_vals[ids.index(tok)]) if tok in ids else None
+            top_logprobs = [
+                (int(i), float(v)) for i, v in zip(ids[: r.logprobs], lp_vals[: r.logprobs])
+            ]
 
         is_eos = (not r.ignore_eos) and tok in self.tokenizer.eos_ids
         if not is_eos:
@@ -1067,9 +1146,12 @@ class Engine:
             if hold:
                 new = new[: len(new) - hold]
 
-        if new:
+        if new or (r.logprobs > 0 and lp is not None and not is_eos):
             slot.emitted_len += len(new)
-            handle._q.put(TokenEvent(kind="token", text=new, token_id=tok))
+            handle._q.put(TokenEvent(
+                kind="token", text=new, token_id=tok,
+                logprob=logprob, top_logprobs=top_logprobs,
+            ))
         if finish is not None:
             self._finish(slot_idx, finish)
 

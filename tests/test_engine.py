@@ -169,3 +169,47 @@ def test_sharded_engine(devices8):
         seq.append(int(jnp.argmax(logits[0])))
     eng.stop()
     assert out == eng.tokenizer.decode(seq[len(prompt):])
+
+
+def test_logprobs_match_prefill(engine):
+    """Streamed logprobs must match a recomputed forward pass (VERDICT #9)."""
+    import jax.numpy as jnp
+
+    prompt = [11, 22, 33]
+    handle = engine.submit(GenRequest(
+        prompt_ids=prompt, max_new_tokens=4, ignore_eos=True, logprobs=5,
+    ))
+    events = [ev for ev in handle if ev.kind == "token"]
+    assert len(events) == 4
+    cfg = engine.cfg
+    seq = list(prompt)
+    for ev in events:
+        assert ev.logprob is not None
+        assert len(ev.top_logprobs) == 5
+        toks = jnp.array([seq + [0] * (32 - len(seq))], jnp.int32)
+        logits, _, _ = prefill(cfg, engine.params, toks, jnp.array([len(seq)], jnp.int32))
+        logp = jax.nn.log_softmax(logits[0].astype(jnp.float32))
+        assert abs(float(logp[ev.token_id]) - ev.logprob) < 2e-2
+        # top-1 alternative is the argmax (= greedy token)
+        top_id, top_lp = ev.top_logprobs[0]
+        assert top_id == int(jnp.argmax(logp))
+        assert abs(float(logp[top_id]) - top_lp) < 2e-2
+        # descending order
+        lps = [v for _, v in ev.top_logprobs]
+        assert lps == sorted(lps, reverse=True)
+        seq.append(ev.token_id)
+
+
+def test_logprobs_concurrent_with_plain(engine):
+    """lp and non-lp requests share the batch without corrupting each other."""
+    h_lp = engine.submit(GenRequest(prompt_ids=[1, 2], max_new_tokens=6,
+                                    ignore_eos=True, logprobs=3))
+    h_plain = engine.submit(GenRequest(prompt_ids=[3, 4], max_new_tokens=6,
+                                       ignore_eos=True))
+    lp_events = [ev for ev in h_lp if ev.kind == "token"]
+    text, ev = h_plain.result()
+    assert ev.finish_reason == "length"
+    assert all(e.logprob is not None for e in lp_events)
+    # plain request must match its solo run
+    text2, _ = engine.generate([3, 4], max_new_tokens=6, ignore_eos=True)
+    assert text == text2

@@ -238,3 +238,67 @@ def test_auth(tmp_path):
     finally:
         server.shutdown()
         manager.shutdown()
+
+
+def test_chat_n_choices_and_logprobs(api):
+    base, _ = api
+    out = _post(base, "/v1/chat/completions", {
+        "model": "tiny-chat",
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 6, "n": 3, "logprobs": True, "top_logprobs": 4,
+        "temperature": 0.9, "seed": 7,
+    })
+    assert len(out["choices"]) == 3
+    assert [c["index"] for c in out["choices"]] == [0, 1, 2]
+    for c in out["choices"]:
+        lp = c["logprobs"]["content"]
+        assert lp, "logprobs content must be non-empty"
+        for entry in lp:
+            assert isinstance(entry["logprob"], float)
+            assert len(entry["top_logprobs"]) == 4
+            assert isinstance(entry["bytes"], list)
+    # usage sums all choices
+    assert out["usage"]["completion_tokens"] >= 3
+
+
+def test_chat_stream_n_choices(api):
+    base, _ = api
+    req = urllib.request.Request(
+        base + "/v1/chat/completions",
+        data=json.dumps({
+            "model": "tiny-chat", "stream": True, "max_tokens": 5, "n": 2,
+            "messages": [{"role": "user", "content": "hi"}],
+            "logprobs": True, "top_logprobs": 2,
+        }).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    frames = []
+    with urllib.request.urlopen(req, timeout=120) as r:
+        for line in r:
+            line = line.decode().strip()
+            if line.startswith("data: "):
+                frames.append(line[6:])
+    chunks = [json.loads(f) for f in frames[:-1]]
+    seen_idx = {c["choices"][0]["index"] for c in chunks}
+    assert seen_idx == {0, 1}
+    finishes = [c for c in chunks if c["choices"][0]["finish_reason"]]
+    assert len(finishes) == 2
+    assert "usage" in chunks[-1]
+    lp_chunks = [c for c in chunks if "logprobs" in c["choices"][0]]
+    assert lp_chunks, "streamed chunks must carry logprobs"
+
+
+def test_completions_multiprompt_parallel_and_logprobs(api):
+    base, manager = api
+    out = _post(base, "/v1/completions", {
+        "model": "tiny-chat", "prompt": ["alpha", "beta", "gamma"],
+        "max_tokens": 5, "logprobs": 3, "n": 2,
+    })
+    assert len(out["choices"]) == 6
+    for c in out["choices"]:
+        lp = c["logprobs"]
+        assert len(lp["tokens"]) == len(lp["token_logprobs"]) == len(lp["text_offset"])
+        assert all(len(t) <= 3 for t in lp["top_logprobs"])
+    # offsets monotonically increase within a choice
+    offs = out["choices"][0]["logprobs"]["text_offset"]
+    assert offs == sorted(offs)
