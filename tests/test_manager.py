@@ -107,11 +107,11 @@ def test_get_unknown_model_raises(tmp_path):
     mgr.shutdown()
 
 
-def _mk_watchdog_manager(tmp_path, idle=0.0, busy=0.0, interval=0.2):
+def _mk_watchdog_manager(tmp_path, idle=0.0, busy=0.0, interval=0.2, context=64):
     d = tmp_path / "models"
     d.mkdir(exist_ok=True)
     (d / "wd.yaml").write_text(yaml.safe_dump({
-        "name": "wd", "model": "tiny", "context_size": 64,
+        "name": "wd", "model": "tiny", "context_size": context,
         "max_slots": 2, "max_tokens": 4,
     }))
     return ModelManager(ApplicationConfig(
@@ -145,10 +145,12 @@ def test_watchdog_busy_kill_cancels_wedged(tmp_path):
     finishes (huge budget) is cancelled and its model evicted."""
     from localai_tpu.engine import GenRequest
 
-    mgr = _mk_watchdog_manager(tmp_path, busy=0.8)
+    # Large context so a warm compile cache can't finish the request by
+    # "length" before the watchdog fires (the wedge must outlive the timeout).
+    mgr = _mk_watchdog_manager(tmp_path, busy=0.8, context=8192)
     lm, lease = mgr.lease("wd")
     handle = lm.engine.submit(GenRequest(
-        prompt_ids=[65, 66], max_new_tokens=10_000, ignore_eos=True,
+        prompt_ids=[65, 66], max_new_tokens=100_000, ignore_eos=True,
     ))
     events = list(handle)  # watchdog cancel ends the stream
     assert events[-1].kind == "done"
