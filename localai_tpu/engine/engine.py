@@ -319,14 +319,18 @@ class Engine:
 
     def _build_programs(self) -> None:
         cfg = self.cfg
+        # sp>1 routes prefill through ring attention over the mesh's "sp"
+        # axis (long-context serving — KV residency per chip is bucket/sp).
+        ring_mesh = self.mesh if self.plan.sp > 1 else None
+        self._ring_mesh = ring_mesh
 
         @partial(jax.jit, static_argnames=())
         def _prefill(params, tokens, lengths):
-            return llama.prefill(cfg, params, tokens, lengths)
+            return llama.prefill(cfg, params, tokens, lengths, mesh=ring_mesh)
 
         @partial(jax.jit)
         def _embed(params, tokens, lengths):
-            return llama.encode(cfg, params, tokens, lengths)
+            return llama.encode(cfg, params, tokens, lengths, mesh=ring_mesh)
 
         self._prefill_fn = _prefill
         self._embed_fn = _embed
@@ -440,7 +444,9 @@ class Engine:
                 top_p=samp_pack[2], min_p=samp_pack[3], repeat_penalty=samp_pack[4],
                 presence_penalty=samp_pack[5], frequency_penalty=samp_pack[6],
             )
-            logits, ks, vs = llama.prefill(cfg, params, prompt_toks, lens)
+            logits, ks, vs = llama.prefill(
+                cfg, params, prompt_toks, lens, mesh=self._ring_mesh
+            )
             valid = (jnp.arange(bucket)[None, :] < lens[:, None]).astype(jnp.int32)
             rows = jnp.zeros((m, V), jnp.int32)
             rows = rows.at[jnp.arange(m)[:, None], prompt_toks].add(valid)

@@ -147,11 +147,21 @@ def _forward_hidden(
     tokens: jnp.ndarray,  # [B, S] int32, right-padded
     lengths: jnp.ndarray,  # [B] int32 valid lengths
     collect_kv: bool,
+    mesh=None,  # jax.sharding.Mesh with an "sp" axis > 1 → ring attention
 ):
     """Shared full-sequence forward. Returns (h [B,S,D] after final norm,
     length_mask [B,S], (ks, vs) or None). Single source of truth for the layer
-    body used by both `prefill` and `encode`."""
+    body used by both `prefill` and `encode`.
+
+    With a mesh whose "sp" axis is > 1, attention runs as ring attention
+    (localai_tpu.parallel.ring): the sequence axis shards over "sp" and KV
+    blocks rotate neighbor-to-neighbor over ICI, so per-chip KV residency is
+    S/sp — the long-context serving path (the reference has no sequence
+    parallelism; SURVEY.md §5)."""
     B, S = tokens.shape
+    use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
+    if use_ring and S % mesh.shape["sp"] != 0:
+        raise ValueError(f"sequence bucket {S} not divisible by sp={mesh.shape['sp']}")
     inv_freq = rope_frequencies(cfg)
     positions = jnp.arange(S)[None, :].repeat(B, axis=0)  # [B, S]
     length_mask = jnp.arange(S)[None, :] < lengths[:, None]
@@ -163,7 +173,12 @@ def _forward_hidden(
         q, k, v = _attn_proj_qkv(cfg, lp, x)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
-        attn = prefill_attention(q, k, v, length_mask, lengths)
+        if use_ring:
+            from localai_tpu.parallel.ring import ring_prefill_attention
+
+            attn = ring_prefill_attention(q, k, v, lengths, mesh)
+        else:
+            attn = prefill_attention(q, k, v, length_mask, lengths)
         h = h + attn.reshape(B, S, -1) @ lp["wo"]
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
         h = h + _mlp(cfg, lp, x)
@@ -179,9 +194,10 @@ def prefill(
     params: Params,
     tokens: jnp.ndarray,  # [B, S] int32, right-padded
     lengths: jnp.ndarray,  # [B] int32 valid lengths
+    mesh=None,  # Mesh with sp>1 → ring attention (sequence parallel)
 ):
     """Prompt processing. Returns (last_logits [B, V] f32, k [L,B,S,K,Hd], v)."""
-    h, _, (ks, vs) = _forward_hidden(cfg, params, tokens, lengths, collect_kv=True)
+    h, _, (ks, vs) = _forward_hidden(cfg, params, tokens, lengths, collect_kv=True, mesh=mesh)
     last_idx = jnp.maximum(lengths - 1, 0)  # empty prompt reads position 0, not wrap to S-1
     last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]  # [B, D]
     logits = _unembed(cfg, params, last)
@@ -193,6 +209,7 @@ def encode(
     params: Params,
     tokens: jnp.ndarray,  # [B, S] int32, right-padded
     lengths: jnp.ndarray,  # [B] int32
+    mesh=None,
 ) -> jnp.ndarray:
     """Sentence embedding: masked mean-pool of final hidden states, L2-normed.
 
@@ -200,7 +217,7 @@ def encode(
     Embedding; backend/python/transformers SentenceTransformer branch) from the
     same decoder weights.
     """
-    h, length_mask, _ = _forward_hidden(cfg, params, tokens, lengths, collect_kv=False)
+    h, length_mask, _ = _forward_hidden(cfg, params, tokens, lengths, collect_kv=False, mesh=mesh)
     h = h.astype(jnp.float32)
     mask = length_mask[..., None].astype(jnp.float32)
     pooled = (h * mask).sum(axis=1) / jnp.maximum(mask.sum(axis=1), 1.0)
