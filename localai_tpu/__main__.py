@@ -83,13 +83,16 @@ def main(argv: list[str] | None = None) -> int:
 
     from localai_tpu.gallery import Gallery, GalleryService
     from localai_tpu.server import ModelManager, Router, create_server
+    from localai_tpu.server.audio_api import AudioApi
     from localai_tpu.server.gallery_api import GalleryApi
     from localai_tpu.server.openai_api import OpenAIApi
     from localai_tpu.server.stores_api import StoresApi
 
     manager = ModelManager(app_cfg)
     router = Router()
-    OpenAIApi(manager).register(router)
+    oai = OpenAIApi(manager)
+    oai.register(router)
+    AudioApi(manager, oai).register(router)
     StoresApi().register(router)
     gallery_service = GalleryService(
         app_cfg.models_dir,

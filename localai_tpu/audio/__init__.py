@@ -1,0 +1,10 @@
+"""Audio utilities: WAV I/O, resampling, mel features, VAD.
+
+TPU-side feature extraction (log-mel) is JAX so it fuses into the model
+forward; host-side I/O is stdlib `wave` + numpy (the reference links libsndfile
+via Go bindings — pkg/sound and backend/go/whisper).
+"""
+
+from localai_tpu.audio.wav import read_wav, resample, write_wav  # noqa: F401
+from localai_tpu.audio.features import log_mel_spectrogram, mel_filterbank  # noqa: F401
+from localai_tpu.audio.vad import energy_vad  # noqa: F401

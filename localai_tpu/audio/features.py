@@ -1,0 +1,79 @@
+"""Log-mel spectrogram in JAX (Whisper-style front end).
+
+The reference computes mel features inside whisper.cpp's C++ (`log_mel_
+spectrogram`, vendored via backend/go/whisper). Here the front end is JAX so
+it jits into the encoder forward: framing is a gather, the DFT is `jnp.fft
+.rfft`, and the mel projection is a matmul that lands on the MXU.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax.numpy as jnp
+import numpy as np
+
+SAMPLE_RATE = 16_000
+N_FFT = 400
+HOP = 160
+N_MELS = 80
+
+
+def _hz_to_mel(f: np.ndarray | float) -> np.ndarray:
+    """Slaney mel scale (linear < 1kHz, log above) — Whisper's filterbank."""
+    f = np.asarray(f, np.float64)
+    lin = f / (200.0 / 3)
+    log_step = np.log(6.4) / 27.0
+    return np.where(f >= 1000.0, 15.0 + np.log(np.maximum(f, 1e-10) / 1000.0) / log_step, lin)
+
+
+def _mel_to_hz(m: np.ndarray) -> np.ndarray:
+    m = np.asarray(m, np.float64)
+    log_step = np.log(6.4) / 27.0
+    return np.where(m >= 15.0, 1000.0 * np.exp(log_step * (m - 15.0)), m * (200.0 / 3))
+
+
+@lru_cache(maxsize=4)
+def mel_filterbank(n_mels: int = N_MELS, n_fft: int = N_FFT, sr: int = SAMPLE_RATE) -> np.ndarray:
+    """[n_mels, n_fft//2 + 1] slaney-normalized triangular filterbank."""
+    n_freqs = n_fft // 2 + 1
+    freqs = np.linspace(0, sr / 2, n_freqs)
+    mel_pts = _mel_to_hz(np.linspace(_hz_to_mel(0.0), _hz_to_mel(sr / 2.0), n_mels + 2))
+    fb = np.zeros((n_mels, n_freqs))
+    for i in range(n_mels):
+        lo, ctr, hi = mel_pts[i], mel_pts[i + 1], mel_pts[i + 2]
+        up = (freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - freqs) / max(hi - ctr, 1e-10)
+        fb[i] = np.maximum(0.0, np.minimum(up, down))
+        fb[i] *= 2.0 / (hi - lo)  # slaney area normalization
+    return fb.astype(np.float32)
+
+
+def log_mel_spectrogram(
+    audio: jnp.ndarray,  # [T] float32 at 16 kHz
+    n_mels: int = N_MELS,
+    n_fft: int = N_FFT,
+    hop: int = HOP,
+) -> jnp.ndarray:
+    """Whisper-style log-mel: [n_frames, n_mels] float32.
+
+    Matches the reference pipeline's semantics (hann window, reflect pad,
+    power spectrum, slaney mel, log10 clamped to max-8, (x+4)/4 scaling) so
+    real Whisper checkpoints see the distribution they were trained on.
+    """
+    audio = jnp.asarray(audio, jnp.float32)
+    pad = n_fft // 2
+    x = jnp.pad(audio, (pad, pad), mode="reflect")
+    n_frames = 1 + (x.shape[0] - n_fft) // hop
+    idx = jnp.arange(n_frames)[:, None] * hop + jnp.arange(n_fft)[None, :]
+    frames = x[idx]  # [n_frames, n_fft]
+    window = jnp.asarray(np.hanning(n_fft + 1)[:-1].astype(np.float32))
+    spec = jnp.fft.rfft(frames * window, axis=-1)
+    power = jnp.abs(spec) ** 2  # [n_frames, n_freqs]
+    # Whisper drops the final frame (it frames with center=True then trims).
+    power = power[:-1]
+    fb = jnp.asarray(mel_filterbank(n_mels, n_fft))
+    mel = power @ fb.T  # MXU matmul
+    logmel = jnp.log10(jnp.maximum(mel, 1e-10))
+    logmel = jnp.maximum(logmel, logmel.max() - 8.0)
+    return (logmel + 4.0) / 4.0

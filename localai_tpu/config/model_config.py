@@ -120,6 +120,19 @@ class ModelConfig:
         """Endpoint routing (reference GuessUsecases, model_config.go:593-679)."""
         if self.known_usecases is not None:
             return self.known_usecases
+        b = self.backend
+        if b == "whisper" or "whisper" in self.model:
+            return Usecase.TRANSCRIPT
+        if b == "tts" or b in ("piper", "bark"):
+            return Usecase.TTS | Usecase.SOUND_GENERATION
+        if b == "vad" or "silero" in self.model:
+            return Usecase.VAD
+        if b == "diffusion" or b in ("diffusers", "stablediffusion"):
+            return Usecase.IMAGE | Usecase.VIDEO
+        if b == "rerank" or "rerank" in self.name.lower():
+            return Usecase.RERANK
+        if b == "detection":
+            return Usecase.DETECTION
         uc = Usecase.CHAT | Usecase.COMPLETION | Usecase.EDIT | Usecase.TOKENIZE
         if self.embeddings or "bert" in self.backend or "embed" in self.name.lower():
             uc |= Usecase.EMBEDDINGS

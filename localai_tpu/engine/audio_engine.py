@@ -1,0 +1,218 @@
+"""Resident engines for the audio modalities: STT (whisper), TTS, VAD.
+
+These present the same lifecycle surface as the text Engine (stop(),
+params/cache attrs, metrics(), cancel_all()) so ModelManager treats every
+backend uniformly (reference: every backend speaks the same gRPC contract —
+backend/backend.proto; here the contract is this small Python interface).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.models import tts as tts_model
+from localai_tpu.models import whisper as whisper_model
+
+
+class _BaseAudioEngine:
+    """Lifecycle shims shared by the audio engines."""
+
+    def __init__(self) -> None:
+        self.cache = None
+        self._lock = threading.Lock()
+        self.m_requests = 0
+        self.m_audio_seconds = 0.0
+        self._busy_time = 0.0
+
+    def start(self) -> None:  # resident once constructed
+        pass
+
+    def stop(self) -> None:
+        pass
+
+    def cancel_all(self) -> int:
+        return 0
+
+    def metrics(self) -> dict[str, float]:
+        return {
+            "requests": float(self.m_requests),
+            "audio_seconds_processed": self.m_audio_seconds,
+            "busy_seconds": self._busy_time,
+        }
+
+
+class WhisperEngine(_BaseAudioEngine):
+    """Batched chunked transcription on one resident whisper model.
+
+    An utterance is split into fixed 2*n_audio_ctx-frame chunks (whisper's
+    30 s window for real checkpoints) and ALL chunks decode as one batched
+    jitted program — the TPU transcribes the whole file in one dispatch
+    rather than llama.cpp-style sequential windows.
+    """
+
+    MAX_NEW_TOKENS = 192
+
+    def __init__(self, cfg: whisper_model.WhisperConfig, params: Any, tokenizer=None):
+        super().__init__()
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer  # HF WhisperTokenizer or None (test preset)
+        self._jit_cache: dict[tuple, Any] = {}
+
+    @property
+    def chunk_samples(self) -> int:
+        from localai_tpu.audio.features import HOP
+
+        return 2 * self.cfg.n_audio_ctx * HOP
+
+    def _program(self, n_chunks: int, prompt_len: int, max_tokens: int):
+        key = (n_chunks, prompt_len, max_tokens)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            cfg = self.cfg
+
+            def run(params, mel, prompt_ids):
+                return whisper_model.transcribe_greedy(cfg, params, mel, prompt_ids, max_tokens)
+
+            fn = jax.jit(run)
+            self._jit_cache[key] = fn
+        return fn
+
+    def _prompt_ids(self, language: Optional[str], translate: bool) -> list[int]:
+        cfg = self.cfg
+        lang_id = cfg.first_lang_id
+        if language and self.tokenizer is not None:
+            tok = self.tokenizer.convert_tokens_to_ids(f"<|{language}|>")
+            if tok is not None and tok >= 0:
+                lang_id = tok
+        task = cfg.translate_id if translate else cfg.transcribe_id
+        return [cfg.sot_id, lang_id, task, cfg.no_timestamps_id]
+
+    def decode_tokens(self, ids: list[int]) -> str:
+        if self.tokenizer is not None:
+            return self.tokenizer.decode(ids, skip_special_tokens=True)
+        # Test preset fallback: printable-byte identity mapping.
+        return "".join(chr(t) for t in ids if 32 <= t < 127)
+
+    def transcribe(
+        self,
+        audio: np.ndarray,  # [T] float32 @ 16 kHz
+        language: Optional[str] = None,
+        translate: bool = False,
+    ) -> dict:
+        from localai_tpu.audio.features import HOP, log_mel_spectrogram
+
+        t0 = time.monotonic()
+        cs = self.chunk_samples
+        n_chunks = max(1, -(-len(audio) // cs))
+        padded = np.zeros((n_chunks * cs,), np.float32)
+        padded[: len(audio)] = audio
+
+        with self._lock:
+            mel_frames = 2 * self.cfg.n_audio_ctx
+            mels = []
+            for c in range(n_chunks):
+                m = log_mel_spectrogram(
+                    jnp.asarray(padded[c * cs: (c + 1) * cs]), n_mels=self.cfg.n_mels
+                )
+                mels.append(m[:mel_frames])
+            mel = jnp.stack(mels)  # [n_chunks, frames, n_mels]
+            prompt = jnp.asarray(self._prompt_ids(language, translate), jnp.int32)
+            fn = self._program(n_chunks, int(prompt.shape[0]), self.MAX_NEW_TOKENS)
+            toks, n_valid = fn(self.params, mel, prompt)
+            toks = np.asarray(toks)
+            n_valid = np.asarray(n_valid)
+
+        segments = []
+        texts = []
+        chunk_s = cs / 16000.0
+        for c in range(n_chunks):
+            ids = [int(t) for t in toks[c, : int(n_valid[c])]]
+            text = self.decode_tokens(ids).strip()
+            texts.append(text)
+            seg_end = min(len(audio) / 16000.0, (c + 1) * chunk_s)
+            segments.append({
+                "id": c,
+                "start": c * chunk_s,
+                "end": seg_end,
+                "text": text,
+                "tokens": ids,
+            })
+        self.m_requests += 1
+        self.m_audio_seconds += len(audio) / 16000.0
+        self._busy_time += time.monotonic() - t0
+        return {
+            "text": " ".join(t for t in texts if t).strip(),
+            "segments": segments,
+            "language": language or "en",
+            "duration": len(audio) / 16000.0,
+        }
+
+
+class TTSEngine(_BaseAudioEngine):
+    """Text → waveform on one resident acoustic model + Griffin-Lim."""
+
+    def __init__(self, cfg: tts_model.TTSConfig, params: Any, voices: Optional[list[str]] = None):
+        super().__init__()
+        self.cfg = cfg
+        self.params = params
+        self.voices = voices or [f"voice-{i}" for i in range(cfg.n_voices)]
+        self._fn = jax.jit(
+            lambda p, ids, ln, v: tts_model.synthesize(cfg, p, ids, ln, v)
+        )
+
+    def voice_id(self, voice: Optional[str]) -> int:
+        if not voice:
+            return 0
+        if voice in self.voices:
+            return self.voices.index(voice) % self.cfg.n_voices
+        try:
+            return int(voice) % self.cfg.n_voices
+        except ValueError:
+            return 0
+
+    def synthesize(self, text: str, voice: Optional[str] = None) -> tuple[np.ndarray, int]:
+        """Returns (float32 samples, sample_rate). Long text is chunked at
+        max_text bytes and the waveforms concatenated."""
+        t0 = time.monotonic()
+        data = text.encode("utf-8")[: self.cfg.max_text * 16] or b" "
+        vid = jnp.int32(self.voice_id(voice))
+        chunks = [
+            data[i: i + self.cfg.max_text] for i in range(0, len(data), self.cfg.max_text)
+        ]
+        outs = []
+        with self._lock:
+            for chunk in chunks:
+                ids = np.zeros((self.cfg.max_text,), np.int32)
+                ids[: len(chunk)] = np.frombuffer(chunk, np.uint8)
+                audio, n = self._fn(self.params, jnp.asarray(ids), jnp.int32(len(chunk)), vid)
+                outs.append(np.asarray(audio)[: int(n)])
+        wav = np.concatenate(outs) if outs else np.zeros((1,), np.float32)
+        self.m_requests += 1
+        self.m_audio_seconds += len(wav) / self.cfg.sample_rate
+        self._busy_time += time.monotonic() - t0
+        return wav, self.cfg.sample_rate
+
+
+class VADEngine(_BaseAudioEngine):
+    """Voice-activity detection (energy detector — audio/vad.py)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.params = {}  # weightless
+
+    def detect(self, audio: np.ndarray, sample_rate: int = 16_000) -> list[dict]:
+        from localai_tpu.audio.vad import energy_vad
+
+        t0 = time.monotonic()
+        segs = energy_vad(audio, sample_rate)
+        self.m_requests += 1
+        self.m_audio_seconds += len(audio) / sample_rate
+        self._busy_time += time.monotonic() - t0
+        return [{"start": s.start, "end": s.end} for s in segs]

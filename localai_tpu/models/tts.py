@@ -1,0 +1,282 @@
+"""Text-to-speech as pure-functional JAX: a FastSpeech-style non-autoregressive
+acoustic model plus a Griffin-Lim vocoder.
+
+The reference ships seven TTS backends (piper, bark, coqui, kokoro, chatterbox,
+kitten, melotts — SURVEY.md §2.4; RPC TTS in backend/backend.proto and
+endpoint core/http/endpoints/localai/tts.go). They are all torch/onnx
+pipelines; this is a TPU-first redesign of the same capability:
+
+- Char ids → transformer encoder → fixed-rate length regulator (static
+  shapes; no data-dependent durations, so the whole utterance jits as one
+  XLA program) → transformer decoder → mel head.
+- Vocoder: mel → linear spectrum (filterbank pseudo-inverse matmul) →
+  Griffin-Lim phase recovery as a `lax.fori_loop` of STFT/iSTFT pairs —
+  batched FFTs and matmuls, no host round-trips.
+- Speaker voices are learned embeddings added to the encoder output.
+
+Weights use our own safetensors layout (save_tts / load_tts round-trip);
+there is no de-facto HF-standard TTS checkpoint to be compatible with.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from functools import lru_cache
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TTSConfig:
+    name: str = "tts"
+    vocab_size: int = 256  # utf-8 bytes
+    d_model: int = 256
+    n_heads: int = 4
+    enc_layers: int = 4
+    dec_layers: int = 4
+    ffn_mult: int = 4
+    n_voices: int = 8
+    max_text: int = 256  # chars per chunk
+    frames_per_char: int = 6  # fixed-rate length regulator
+    n_mels: int = 80
+    n_fft: int = 1024
+    hop: int = 256
+    sample_rate: int = 22050
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def ffn(self) -> int:
+        return self.d_model * self.ffn_mult
+
+    @property
+    def max_frames(self) -> int:
+        return self.max_text * self.frames_per_char
+
+
+TTS_PRESETS: dict[str, TTSConfig] = {
+    "tts-test": TTSConfig(
+        name="tts-test", d_model=32, n_heads=2, enc_layers=1, dec_layers=1,
+        max_text=32, frames_per_char=2, n_mels=20, n_fft=256, hop=64,
+        sample_rate=8000, n_voices=2,
+    ),
+    "tts-base": TTSConfig(name="tts-base"),
+}
+
+
+def _sinusoids(length: int, channels: int) -> np.ndarray:
+    log_ts = np.log(10000.0) / max(channels // 2 - 1, 1)
+    inv = np.exp(-log_ts * np.arange(channels // 2))
+    scaled = np.arange(length)[:, None] * inv[None, :]
+    return np.concatenate([np.sin(scaled), np.cos(scaled)], axis=1).astype(np.float32)
+
+
+def _block_params(rnd, L, d, ffn) -> Params:
+    return {
+        "ln1_w": jnp.ones((L, d)), "ln1_b": jnp.zeros((L, d)),
+        "q_w": rnd((L, d, d)), "k_w": rnd((L, d, d)), "v_w": rnd((L, d, d)),
+        "o_w": rnd((L, d, d)),
+        "ln2_w": jnp.ones((L, d)), "ln2_b": jnp.zeros((L, d)),
+        "fc1_w": rnd((L, d, ffn)), "fc1_b": jnp.zeros((L, ffn)),
+        "fc2_w": rnd((L, ffn, d)), "fc2_b": jnp.zeros((L, d)),
+    }
+
+
+def init_params(cfg: TTSConfig, key: jnp.ndarray, scale: float = 0.02) -> Params:
+    keys = iter(jax.random.split(key, 64))
+
+    def rnd(shape):
+        return jax.random.normal(next(keys), shape, jnp.float32) * scale
+
+    return {
+        "embed": rnd((cfg.vocab_size, cfg.d_model)),
+        "voice": rnd((cfg.n_voices, cfg.d_model)),
+        "enc_pos": jnp.asarray(_sinusoids(cfg.max_text, cfg.d_model)),
+        "dec_pos": jnp.asarray(_sinusoids(cfg.max_frames, cfg.d_model)),
+        "enc": _block_params(rnd, cfg.enc_layers, cfg.d_model, cfg.ffn),
+        "dec": _block_params(rnd, cfg.dec_layers, cfg.d_model, cfg.ffn),
+        "mel_w": rnd((cfg.d_model, cfg.n_mels)),
+        "mel_b": jnp.zeros((cfg.n_mels,)),
+        "ln_out_w": jnp.ones((cfg.d_model,)), "ln_out_b": jnp.zeros((cfg.d_model,)),
+    }
+
+
+def _ln(x, w, b, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return (x32 - mu) * jax.lax.rsqrt(var + eps) * w + b
+
+
+def _blocks(cfg: TTSConfig, params_blk: Params, h: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Non-causal transformer stack. h [B, T, d]; mask [B, T] valid."""
+    B, T, d = h.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+
+    def layer(h, lp):
+        x = _ln(h, lp["ln1_w"], lp["ln1_b"])
+        q = (x @ lp["q_w"]).reshape(B, T, H, Dh)
+        k = (x @ lp["k_w"]).reshape(B, T, H, Dh)
+        v = (x @ lp["v_w"]).reshape(B, T, H, Dh)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * Dh**-0.5
+        scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, d)
+        h = h + attn @ lp["o_w"]
+        x = _ln(h, lp["ln2_w"], lp["ln2_b"])
+        h = h + jax.nn.gelu(x @ lp["fc1_w"] + lp["fc1_b"]) @ lp["fc2_w"] + lp["fc2_b"]
+        return h, None
+
+    h, _ = jax.lax.scan(layer, h, params_blk)
+    return h
+
+
+def text_to_mel(
+    cfg: TTSConfig,
+    params: Params,
+    text_ids: jnp.ndarray,  # [B, max_text] int32, zero-padded
+    lengths: jnp.ndarray,  # [B] int32
+    voice: jnp.ndarray,  # [B] int32 speaker ids
+):
+    """Returns (mel [B, max_frames, n_mels] f32, frame_mask [B, max_frames])."""
+    B, T = text_ids.shape
+    r = cfg.frames_per_char
+    text_mask = jnp.arange(T)[None, :] < lengths[:, None]
+
+    h = params["embed"][text_ids] + params["enc_pos"][None, :T]
+    h = h + params["voice"][voice][:, None, :]
+    h = _blocks(cfg, params["enc"], h, text_mask)
+
+    # Fixed-rate length regulator: repeat each char embedding r times.
+    hf = jnp.repeat(h, r, axis=1)  # [B, T*r, d]
+    frame_mask = jnp.repeat(text_mask, r, axis=1)
+    hf = hf + params["dec_pos"][None, : hf.shape[1]]
+    hf = _blocks(cfg, params["dec"], hf, frame_mask)
+    hf = _ln(hf, params["ln_out_w"], params["ln_out_b"])
+    mel = hf @ params["mel_w"] + params["mel_b"]
+    mel = jnp.where(frame_mask[..., None], mel, jnp.log(jnp.float32(1e-5)))
+    return mel, frame_mask
+
+
+# --------------------------------------------------------------------------- #
+# Vocoder: mel → waveform via Griffin-Lim (all-JAX)
+# --------------------------------------------------------------------------- #
+
+
+@lru_cache(maxsize=4)
+def _mel_inverse(n_mels: int, n_fft: int, sr: int) -> np.ndarray:
+    from localai_tpu.audio.features import mel_filterbank
+
+    fb = mel_filterbank(n_mels, n_fft, sr)  # [n_mels, n_freqs]
+    return np.linalg.pinv(fb).astype(np.float32)  # [n_freqs, n_mels]
+
+
+def _stft(x: jnp.ndarray, n_fft: int, hop: int, window: jnp.ndarray) -> jnp.ndarray:
+    n_frames = 1 + (x.shape[-1] - n_fft) // hop
+    idx = jnp.arange(n_frames)[:, None] * hop + jnp.arange(n_fft)[None, :]
+    return jnp.fft.rfft(x[..., idx] * window, axis=-1)  # [..., n_frames, n_freqs]
+
+
+def _istft(spec: jnp.ndarray, n_fft: int, hop: int, window: jnp.ndarray, length: int) -> jnp.ndarray:
+    frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) * window  # [n_frames, n_fft]
+    n_frames = frames.shape[-2]
+    idx = (jnp.arange(n_frames)[:, None] * hop + jnp.arange(n_fft)[None, :]).reshape(-1)
+    x = jnp.zeros((length,), jnp.float32).at[idx].add(frames.reshape(-1))
+    wsq = jnp.zeros((length,), jnp.float32).at[idx].add(
+        jnp.tile(window**2, (n_frames, 1)).reshape(-1)
+    )
+    return x / jnp.maximum(wsq, 1e-8)
+
+
+def mel_to_audio(
+    cfg: TTSConfig,
+    log_mel: jnp.ndarray,  # [T_frames, n_mels] natural-log mel magnitudes
+    n_iter: int = 32,
+) -> jnp.ndarray:
+    """Griffin-Lim phase recovery. Returns [T_samples] float32."""
+    inv = jnp.asarray(_mel_inverse(cfg.n_mels, cfg.n_fft, cfg.sample_rate))
+    mag = jnp.maximum(jnp.exp(log_mel) @ inv.T, 0.0)  # [T_frames, n_freqs]
+    window = jnp.asarray(np.hanning(cfg.n_fft + 1)[:-1].astype(np.float32))
+    n_frames = mag.shape[0]
+    length = (n_frames - 1) * cfg.hop + cfg.n_fft
+
+    key = jax.random.key(0)
+    phase = jax.random.uniform(key, mag.shape, jnp.float32, 0, 2 * np.pi)
+    spec = mag * jnp.exp(1j * phase)
+
+    def gl_iter(_, spec):
+        x = _istft(spec, cfg.n_fft, cfg.hop, window, length)
+        new = _stft(x, cfg.n_fft, cfg.hop, window)
+        new = new[: n_frames]
+        return mag * jnp.exp(1j * jnp.angle(new))
+
+    spec = jax.lax.fori_loop(0, n_iter, gl_iter, spec)
+    audio = _istft(spec, cfg.n_fft, cfg.hop, window, length)
+    peak = jnp.max(jnp.abs(audio))
+    return audio / jnp.maximum(peak, 1e-6) * 0.95
+
+
+def synthesize(
+    cfg: TTSConfig,
+    params: Params,
+    text_ids: jnp.ndarray,  # [max_text] int32 zero-padded
+    length: jnp.ndarray,  # scalar int32
+    voice: jnp.ndarray,  # scalar int32
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One utterance → (audio [max_samples] f32, n_samples scalar i32)."""
+    mel, frame_mask = text_to_mel(
+        cfg, params, text_ids[None], length[None], voice[None]
+    )
+    audio = mel_to_audio(cfg, mel[0])
+    n_frames_valid = jnp.sum(frame_mask[0].astype(jnp.int32))
+    n_samples = jnp.minimum(n_frames_valid * cfg.hop, audio.shape[0])
+    return audio, n_samples
+
+
+# --------------------------------------------------------------------------- #
+# Checkpoint I/O (our safetensors layout)
+# --------------------------------------------------------------------------- #
+
+
+def save_tts(cfg: TTSConfig, params: Params, ckpt_dir: str) -> None:
+    from safetensors.numpy import save_file
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    flat = {}
+    for k, v in params.items():
+        if isinstance(v, dict):
+            for k2, v2 in v.items():
+                flat[f"{k}.{k2}"] = np.asarray(v2, np.float32)
+        else:
+            flat[k] = np.asarray(v, np.float32)
+    save_file(flat, os.path.join(ckpt_dir, "model.safetensors"))
+    with open(os.path.join(ckpt_dir, "config.json"), "w") as f:
+        json.dump({"model_type": "localai-tts", **dataclasses.asdict(cfg)}, f, indent=1)
+
+
+def load_tts(ckpt_dir: str) -> tuple[TTSConfig, Params]:
+    from safetensors import safe_open
+
+    with open(os.path.join(ckpt_dir, "config.json")) as f:
+        hf = json.load(f)
+    hf.pop("model_type", None)
+    cfg = TTSConfig(**hf)
+    params: Params = {"enc": {}, "dec": {}}
+    with safe_open(os.path.join(ckpt_dir, "model.safetensors"), framework="numpy") as f:
+        for name in f.keys():
+            arr = jnp.asarray(f.get_tensor(name))
+            if "." in name:
+                grp, sub = name.split(".", 1)
+                params.setdefault(grp, {})[sub] = arr
+            else:
+                params[name] = arr
+    return cfg, params

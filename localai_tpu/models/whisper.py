@@ -1,0 +1,456 @@
+"""Whisper-family speech-to-text as pure-functional JAX.
+
+The reference serves STT through whisper.cpp (backend/go/whisper/gowhisper.cpp,
+RPC AudioTranscription in backend/backend.proto) running GGML CPU/CUDA
+kernels. This is a TPU redesign, not a port:
+
+- Encoder (conv1d ×2 → sinusoidal pos → pre-LN transformer) and decoder
+  (learned pos, causal self-attn + cross-attn) are stacked-layer pytrees
+  scanned with `lax.scan` — one traced block per stack, flat compile time.
+- Transcription is ONE jitted program: mel → encoder → cross-KV precompute →
+  prompt scan → greedy token scan with an EOT done-mask. No host round-trips
+  inside an utterance; batch is a leading axis throughout, so a TPU chip
+  transcribes B utterances at once.
+- Weights load from HF safetensors (WhisperForConditionalGeneration names),
+  matching engine/weights.py conventions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class WhisperConfig:
+    name: str = "whisper"
+    vocab_size: int = 51865
+    d_model: int = 384  # whisper-tiny
+    enc_layers: int = 4
+    dec_layers: int = 4
+    n_heads: int = 6
+    n_mels: int = 80
+    n_audio_ctx: int = 1500  # 30 s of 10 ms frames, conv-halved
+    n_text_ctx: int = 448
+    ffn_mult: int = 4
+    # Special tokens (HF whisper defaults; tiny test preset overrides).
+    sot_id: int = 50258  # <|startoftranscript|>
+    eot_id: int = 50257  # <|endoftext|>
+    no_timestamps_id: int = 50363
+    transcribe_id: int = 50359
+    translate_id: int = 50358
+    first_lang_id: int = 50259  # <|en|>
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def ffn(self) -> int:
+        return self.d_model * self.ffn_mult
+
+
+WHISPER_PRESETS: dict[str, WhisperConfig] = {
+    # Hermetic test/CI preset: one audio "second" is 100 frames → 50 ctx.
+    "whisper-test": WhisperConfig(
+        name="whisper-test", vocab_size=128, d_model=32, enc_layers=2,
+        dec_layers=2, n_heads=2, n_mels=16, n_audio_ctx=64, n_text_ctx=32,
+        sot_id=1, eot_id=2, no_timestamps_id=3, transcribe_id=4,
+        translate_id=5, first_lang_id=6,
+    ),
+    "whisper-tiny": WhisperConfig(name="whisper-tiny"),
+    "whisper-base": WhisperConfig(
+        name="whisper-base", d_model=512, enc_layers=6, dec_layers=6, n_heads=8
+    ),
+    "whisper-small": WhisperConfig(
+        name="whisper-small", d_model=768, enc_layers=12, dec_layers=12, n_heads=12
+    ),
+    "whisper-large-v3": WhisperConfig(
+        name="whisper-large-v3", vocab_size=51866, d_model=1280, enc_layers=32,
+        dec_layers=32, n_heads=20, n_mels=128,
+    ),
+}
+
+
+def sinusoids(length: int, channels: int) -> np.ndarray:
+    """Whisper's fixed audio positional embedding."""
+    log_timescale = np.log(10000.0) / (channels // 2 - 1)
+    inv = np.exp(-log_timescale * np.arange(channels // 2))
+    scaled = np.arange(length)[:, None] * inv[None, :]
+    return np.concatenate([np.sin(scaled), np.cos(scaled)], axis=1).astype(np.float32)
+
+
+class SelfCache(NamedTuple):
+    """Decoder self-attention KV cache [L, B, n_text_ctx, H, Dh]."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+
+def _dt(cfg: WhisperConfig):
+    return jnp.dtype(cfg.dtype)
+
+
+def _attn_block_params(rnd, L, d, ffn, cross: bool) -> Params:
+    p = {
+        "ln1_w": jnp.ones((L, d)), "ln1_b": jnp.zeros((L, d)),
+        "q_w": rnd((L, d, d)), "q_b": jnp.zeros((L, d)),
+        "k_w": rnd((L, d, d)),  # whisper: no k bias
+        "v_w": rnd((L, d, d)), "v_b": jnp.zeros((L, d)),
+        "o_w": rnd((L, d, d)), "o_b": jnp.zeros((L, d)),
+        "ln2_w": jnp.ones((L, d)), "ln2_b": jnp.zeros((L, d)),
+        "fc1_w": rnd((L, d, ffn)), "fc1_b": jnp.zeros((L, ffn)),
+        "fc2_w": rnd((L, ffn, d)), "fc2_b": jnp.zeros((L, d)),
+    }
+    if cross:
+        p.update({
+            "lnx_w": jnp.ones((L, d)), "lnx_b": jnp.zeros((L, d)),
+            "xq_w": rnd((L, d, d)), "xq_b": jnp.zeros((L, d)),
+            "xk_w": rnd((L, d, d)),
+            "xv_w": rnd((L, d, d)), "xv_b": jnp.zeros((L, d)),
+            "xo_w": rnd((L, d, d)), "xo_b": jnp.zeros((L, d)),
+        })
+    return p
+
+
+def init_params(cfg: WhisperConfig, key: jnp.ndarray, scale: float = 0.02) -> Params:
+    d, M = cfg.d_model, cfg.n_mels
+    keys = iter(jax.random.split(key, 64))
+
+    def rnd(shape):
+        return jax.random.normal(next(keys), shape, jnp.float32) * scale
+
+    enc = _attn_block_params(rnd, cfg.enc_layers, d, cfg.ffn, cross=False)
+    dec = _attn_block_params(rnd, cfg.dec_layers, d, cfg.ffn, cross=True)
+    return {
+        "conv1_w": rnd((d, M, 3)), "conv1_b": jnp.zeros((d,)),
+        "conv2_w": rnd((d, d, 3)), "conv2_b": jnp.zeros((d,)),
+        "enc_pos": jnp.asarray(sinusoids(cfg.n_audio_ctx, d)),
+        "enc": enc,
+        "enc_ln_w": jnp.ones((d,)), "enc_ln_b": jnp.zeros((d,)),
+        "embed": rnd((cfg.vocab_size, d)),
+        "dec_pos": rnd((cfg.n_text_ctx, d)),
+        "dec": dec,
+        "dec_ln_w": jnp.ones((d,)), "dec_ln_b": jnp.zeros((d,)),
+    }
+
+
+def _ln(x, w, b, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps) * w + b).astype(x.dtype)
+
+
+def _heads(cfg: WhisperConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """[..., d] → [..., H, Dh]"""
+    return x.reshape(*x.shape[:-1], cfg.n_heads, cfg.head_dim)
+
+
+def _mha(cfg, q, k, v, mask=None):
+    """q [B,Tq,H,Dh], k/v [B,Tk,H,Dh] → [B,Tq,d]. mask [Tq,Tk] or [B,Tq,Tk]."""
+    scale = cfg.head_dim ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if mask is not None:
+        m = mask if mask.ndim == 3 else mask[None]
+        scores = jnp.where(m[:, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.reshape(*out.shape[:-2], cfg.d_model).astype(q.dtype)
+
+
+def encode(cfg: WhisperConfig, params: Params, mel: jnp.ndarray) -> jnp.ndarray:
+    """mel [B, T_frames, n_mels] → encoder states [B, T_frames//2, d].
+
+    T_frames must be 2 * n_audio_ctx (whisper pads/trims audio to 30 s; the
+    serving layer handles that).
+    """
+    x = mel.transpose(0, 2, 1)  # [B, M, T] for NCH conv
+    dn = ("NCH", "OIH", "NCH")
+    x = jax.lax.conv_general_dilated(
+        x, params["conv1_w"], (1,), [(1, 1)], dimension_numbers=dn
+    ) + params["conv1_b"][None, :, None]
+    x = jax.nn.gelu(x, approximate=False)
+    x = jax.lax.conv_general_dilated(
+        x, params["conv2_w"], (2,), [(1, 1)], dimension_numbers=dn
+    ) + params["conv2_b"][None, :, None]
+    x = jax.nn.gelu(x, approximate=False)
+    h = x.transpose(0, 2, 1)  # [B, T_a, d]
+    h = h + params["enc_pos"][None, : h.shape[1]]
+
+    def layer(h, lp):
+        x = _ln(h, lp["ln1_w"], lp["ln1_b"])
+        q = _heads(cfg, x @ lp["q_w"] + lp["q_b"])
+        k = _heads(cfg, x @ lp["k_w"])
+        v = _heads(cfg, x @ lp["v_w"] + lp["v_b"])
+        h = h + _mha(cfg, q, k, v) @ lp["o_w"] + lp["o_b"]
+        x = _ln(h, lp["ln2_w"], lp["ln2_b"])
+        h = h + jax.nn.gelu(x @ lp["fc1_w"] + lp["fc1_b"], approximate=False) @ lp["fc2_w"] + lp["fc2_b"]
+        return h, None
+
+    h, _ = jax.lax.scan(layer, h, params["enc"])
+    return _ln(h, params["enc_ln_w"], params["enc_ln_b"])
+
+
+def cross_kv(cfg: WhisperConfig, params: Params, enc_out: jnp.ndarray):
+    """Precompute per-layer cross-attention K/V: [L, B, T_a, H, Dh] each."""
+
+    def layer(_, lp):
+        k = _heads(cfg, enc_out @ lp["xk_w"])
+        v = _heads(cfg, enc_out @ lp["xv_w"] + lp["xv_b"])
+        return None, (k, v)
+
+    _, (ks, vs) = jax.lax.scan(layer, None, params["dec"])
+    return ks, vs
+
+
+def decode_step(
+    cfg: WhisperConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [B] int32
+    pos: jnp.ndarray,  # [B] int32 position of `tokens`
+    cache: SelfCache,
+    xk: jnp.ndarray,  # [L, B, T_a, H, Dh]
+    xv: jnp.ndarray,
+):
+    """One decoder step. Returns (logits [B, V] f32, new cache)."""
+    B = tokens.shape[0]
+    h = params["embed"][tokens] + params["dec_pos"][pos]  # [B, d]
+    batch_idx = jnp.arange(B)
+    cache_len = pos + 1
+    T = cache.k.shape[2]
+
+    def layer(h, xs):
+        lp, kc, vc, xk_l, xv_l = xs
+        x = _ln(h, lp["ln1_w"], lp["ln1_b"])
+        q = _heads(cfg, x @ lp["q_w"] + lp["q_b"])  # [B, H, Dh]
+        k = _heads(cfg, x @ lp["k_w"])
+        v = _heads(cfg, x @ lp["v_w"] + lp["v_b"])
+        kc = kc.at[batch_idx, pos].set(k)
+        vc = vc.at[batch_idx, pos].set(v)
+        valid = jnp.arange(T)[None, :] < cache_len[:, None]  # [B, T]
+        scores = jnp.einsum(
+            "bhd,bthd->bht", q.astype(jnp.float32), kc.astype(jnp.float32)
+        ) * cfg.head_dim**-0.5
+        scores = jnp.where(valid[:, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bht,bthd->bhd", probs, vc.astype(jnp.float32))
+        h = h + attn.reshape(B, cfg.d_model).astype(h.dtype) @ lp["o_w"] + lp["o_b"]
+
+        x = _ln(h, lp["lnx_w"], lp["lnx_b"])
+        xq = _heads(cfg, x @ lp["xq_w"] + lp["xq_b"])
+        xscores = jnp.einsum(
+            "bhd,bthd->bht", xq.astype(jnp.float32), xk_l.astype(jnp.float32)
+        ) * cfg.head_dim**-0.5
+        xprobs = jax.nn.softmax(xscores, axis=-1)
+        xattn = jnp.einsum("bht,bthd->bhd", xprobs, xv_l.astype(jnp.float32))
+        h = h + xattn.reshape(B, cfg.d_model).astype(h.dtype) @ lp["xo_w"] + lp["xo_b"]
+
+        x = _ln(h, lp["ln2_w"], lp["ln2_b"])
+        h = h + jax.nn.gelu(x @ lp["fc1_w"] + lp["fc1_b"], approximate=False) @ lp["fc2_w"] + lp["fc2_b"]
+        return h, (kc, vc)
+
+    h, (ks, vs) = jax.lax.scan(layer, h, (params["dec"], cache.k, cache.v, xk, xv))
+    h = _ln(h, params["dec_ln_w"], params["dec_ln_b"])
+    logits = h.astype(jnp.float32) @ params["embed"].astype(jnp.float32).T
+    return logits, SelfCache(k=ks, v=vs)
+
+
+def transcribe_greedy(
+    cfg: WhisperConfig,
+    params: Params,
+    mel: jnp.ndarray,  # [B, 2*n_audio_ctx, n_mels]
+    prompt_ids: jnp.ndarray,  # [P] int32 (sot, lang, task, no_timestamps)
+    max_tokens: int,
+):
+    """Whole-utterance greedy transcription in one jitted program.
+
+    Returns (tokens [B, max_tokens] i32 — eot-padded, n_valid [B] i32).
+    """
+    B = mel.shape[0]
+    enc_out = encode(cfg, params, mel)
+    xk, xv = cross_kv(cfg, params, enc_out)
+    cache = SelfCache(
+        k=jnp.zeros((cfg.dec_layers, B, cfg.n_text_ctx, cfg.n_heads, cfg.head_dim), jnp.float32),
+        v=jnp.zeros((cfg.dec_layers, B, cfg.n_text_ctx, cfg.n_heads, cfg.head_dim), jnp.float32),
+    )
+    P = prompt_ids.shape[0]
+
+    def prompt_step(carry, i):
+        cache, _ = carry
+        tok = jnp.full((B,), prompt_ids[i], jnp.int32)
+        pos = jnp.full((B,), i, jnp.int32)
+        logits, cache = decode_step(cfg, params, tok, pos, cache, xk, xv)
+        return (cache, logits), None
+
+    (cache, logits), _ = jax.lax.scan(
+        prompt_step, (cache, jnp.zeros((B, cfg.vocab_size), jnp.float32)), jnp.arange(P)
+    )
+
+    def gen_step(carry, i):
+        cache, logits, done = carry
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        tok = jnp.where(done, cfg.eot_id, tok)
+        done = done | (tok == cfg.eot_id)
+        pos = jnp.full((B,), P + i, jnp.int32)
+        pos = jnp.minimum(pos, cfg.n_text_ctx - 1)
+        logits, cache = decode_step(cfg, params, tok, pos, cache, xk, xv)
+        return (cache, logits, done), tok
+
+    (_, _, done), toks = jax.lax.scan(
+        gen_step, (cache, logits, jnp.zeros((B,), bool)), jnp.arange(max_tokens)
+    )
+    toks = toks.T  # [B, max_tokens]
+    n_valid = jnp.sum((toks != cfg.eot_id).astype(jnp.int32), axis=-1)
+    return toks, n_valid
+
+
+# --------------------------------------------------------------------------- #
+# HF checkpoint I/O (WhisperForConditionalGeneration names)
+# --------------------------------------------------------------------------- #
+
+_ENC_MAP = {
+    "ln1_w": ("self_attn_layer_norm.weight", False),
+    "ln1_b": ("self_attn_layer_norm.bias", False),
+    "q_w": ("self_attn.q_proj.weight", True),
+    "q_b": ("self_attn.q_proj.bias", False),
+    "k_w": ("self_attn.k_proj.weight", True),
+    "v_w": ("self_attn.v_proj.weight", True),
+    "v_b": ("self_attn.v_proj.bias", False),
+    "o_w": ("self_attn.out_proj.weight", True),
+    "o_b": ("self_attn.out_proj.bias", False),
+    "ln2_w": ("final_layer_norm.weight", False),
+    "ln2_b": ("final_layer_norm.bias", False),
+    "fc1_w": ("fc1.weight", True),
+    "fc1_b": ("fc1.bias", False),
+    "fc2_w": ("fc2.weight", True),
+    "fc2_b": ("fc2.bias", False),
+}
+
+_DEC_EXTRA = {
+    "lnx_w": ("encoder_attn_layer_norm.weight", False),
+    "lnx_b": ("encoder_attn_layer_norm.bias", False),
+    "xq_w": ("encoder_attn.q_proj.weight", True),
+    "xq_b": ("encoder_attn.q_proj.bias", False),
+    "xk_w": ("encoder_attn.k_proj.weight", True),
+    "xv_w": ("encoder_attn.v_proj.weight", True),
+    "xv_b": ("encoder_attn.v_proj.bias", False),
+    "xo_w": ("encoder_attn.out_proj.weight", True),
+    "xo_b": ("encoder_attn.out_proj.bias", False),
+}
+
+
+def _stack(reader, prefix: str, L: int, layer_map: dict) -> Params:
+    out: Params = {}
+    for our, (suffix, transpose) in layer_map.items():
+        rows = []
+        for i in range(L):
+            arr = reader.get(f"{prefix}.{i}.{suffix}")
+            if transpose and arr.ndim == 2:
+                arr = arr.T
+            rows.append(np.ascontiguousarray(arr))
+        out[our] = jnp.asarray(np.stack(rows))
+    return out
+
+
+def load_hf_whisper(cfg: WhisperConfig, ckpt_dir: str) -> Params:
+    from localai_tpu.engine.weights import _ShardReader
+
+    reader = _ShardReader(ckpt_dir)
+
+    def grab(name: str) -> jnp.ndarray:
+        return jnp.asarray(reader.get(name))
+
+    dec_map = dict(_ENC_MAP, **_DEC_EXTRA)
+    return {
+        "conv1_w": grab("model.encoder.conv1.weight"),
+        "conv1_b": grab("model.encoder.conv1.bias"),
+        "conv2_w": grab("model.encoder.conv2.weight"),
+        "conv2_b": grab("model.encoder.conv2.bias"),
+        "enc_pos": grab("model.encoder.embed_positions.weight"),
+        "enc": _stack(reader, "model.encoder.layers", cfg.enc_layers, _ENC_MAP),
+        "enc_ln_w": grab("model.encoder.layer_norm.weight"),
+        "enc_ln_b": grab("model.encoder.layer_norm.bias"),
+        "embed": grab("model.decoder.embed_tokens.weight"),
+        "dec_pos": grab("model.decoder.embed_positions.weight"),
+        "dec": _stack(reader, "model.decoder.layers", cfg.dec_layers, dec_map),
+        "dec_ln_w": grab("model.decoder.layer_norm.weight"),
+        "dec_ln_b": grab("model.decoder.layer_norm.bias"),
+    }
+
+
+def save_hf_whisper(cfg: WhisperConfig, params: Params, ckpt_dir: str) -> None:
+    """Inverse of load_hf_whisper — lets tests fabricate real checkpoints."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    tensors: dict[str, np.ndarray] = {}
+
+    def emit(name: str, arr, transpose=False) -> None:
+        a = np.asarray(jnp.asarray(arr, jnp.float32))
+        if transpose and a.ndim == 2:
+            a = a.T
+        tensors[name] = np.ascontiguousarray(a)
+
+    emit("model.encoder.conv1.weight", params["conv1_w"])
+    emit("model.encoder.conv1.bias", params["conv1_b"])
+    emit("model.encoder.conv2.weight", params["conv2_w"])
+    emit("model.encoder.conv2.bias", params["conv2_b"])
+    emit("model.encoder.embed_positions.weight", params["enc_pos"])
+    emit("model.encoder.layer_norm.weight", params["enc_ln_w"])
+    emit("model.encoder.layer_norm.bias", params["enc_ln_b"])
+    emit("model.decoder.embed_tokens.weight", params["embed"])
+    emit("model.decoder.embed_positions.weight", params["dec_pos"])
+    emit("model.decoder.layer_norm.weight", params["dec_ln_w"])
+    emit("model.decoder.layer_norm.bias", params["dec_ln_b"])
+    for i in range(cfg.enc_layers):
+        for our, (suffix, transpose) in _ENC_MAP.items():
+            emit(f"model.encoder.layers.{i}.{suffix}", params["enc"][our][i], transpose)
+    dec_map = dict(_ENC_MAP, **_DEC_EXTRA)
+    for i in range(cfg.dec_layers):
+        for our, (suffix, transpose) in dec_map.items():
+            emit(f"model.decoder.layers.{i}.{suffix}", params["dec"][our][i], transpose)
+
+    from safetensors.numpy import save_file
+
+    save_file(tensors, os.path.join(ckpt_dir, "model.safetensors"))
+    with open(os.path.join(ckpt_dir, "config.json"), "w") as f:
+        json.dump({
+            "model_type": "whisper",
+            "vocab_size": cfg.vocab_size,
+            "d_model": cfg.d_model,
+            "encoder_layers": cfg.enc_layers,
+            "decoder_layers": cfg.dec_layers,
+            "encoder_attention_heads": cfg.n_heads,
+            "decoder_attention_heads": cfg.n_heads,
+            "num_mel_bins": cfg.n_mels,
+            "max_source_positions": cfg.n_audio_ctx,
+            "max_target_positions": cfg.n_text_ctx,
+            "decoder_start_token_id": cfg.sot_id,
+            "eos_token_id": cfg.eot_id,
+        }, f, indent=1)
+
+
+def whisper_config_from_hf(ckpt_dir: str) -> WhisperConfig:
+    with open(os.path.join(ckpt_dir, "config.json")) as f:
+        hf = json.load(f)
+    return WhisperConfig(
+        name=hf.get("_name_or_path", "whisper"),
+        vocab_size=hf["vocab_size"],
+        d_model=hf["d_model"],
+        enc_layers=hf["encoder_layers"],
+        dec_layers=hf["decoder_layers"],
+        n_heads=hf["encoder_attention_heads"],
+        n_mels=hf.get("num_mel_bins", 80),
+        n_audio_ctx=hf.get("max_source_positions", 1500),
+        n_text_ctx=hf.get("max_target_positions", 448),
+        sot_id=hf.get("decoder_start_token_id", 50258),
+        eot_id=(hf.get("eos_token_id") if isinstance(hf.get("eos_token_id"), int) else 50257) or 50257,
+    )

@@ -36,6 +36,40 @@ class Request:
     body: Optional[dict[str, Any]]
     raw_body: bytes = b""
 
+    def form(self) -> dict[str, tuple[Optional[str], bytes]]:
+        """Parse a multipart/form-data body → {field: (filename|None, bytes)}.
+
+        Used by upload endpoints (audio transcription, model import) — the
+        reference gets this from echo's form binding; here it is a direct
+        RFC 7578 boundary parse over raw_body.
+        """
+        ctype = self.headers.get("content-type", "")
+        if "multipart/form-data" not in ctype:
+            raise ApiError(400, "expected multipart/form-data")
+        m = re.search(r'boundary="?([^";]+)"?', ctype)
+        if not m:
+            raise ApiError(400, "multipart body missing boundary")
+        boundary = m.group(1).encode()
+        out: dict[str, tuple[Optional[str], bytes]] = {}
+        for part in self.raw_body.split(b"--" + boundary):
+            part = part.strip(b"\r\n")
+            if not part or part == b"--":
+                continue
+            if b"\r\n\r\n" in part:
+                head, _, content = part.partition(b"\r\n\r\n")
+            else:
+                head, _, content = part.partition(b"\n\n")
+            name = filename = None
+            for line in head.decode("utf-8", "replace").splitlines():
+                if line.lower().startswith("content-disposition"):
+                    nm = re.search(r'name="([^"]*)"', line)
+                    fm = re.search(r'filename="([^"]*)"', line)
+                    name = nm.group(1) if nm else None
+                    filename = fm.group(1) if fm else None
+            if name is not None:
+                out[name] = (filename, content)
+        return out
+
 
 @dataclass
 class Response:

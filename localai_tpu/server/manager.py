@@ -250,11 +250,41 @@ class ModelManager:
                 target=self._drain_and_teardown, args=(lm, 30.0), daemon=True
             ).start()
 
+    def _resolve_ckpt_dir(self, model: str) -> str:
+        import os
+
+        ckpt_dir = model
+        if not os.path.isabs(ckpt_dir):
+            ckpt_dir = os.path.join(self.app_cfg.models_dir, ckpt_dir)
+        return ckpt_dir
+
     def _load(self, cfg: ModelConfig) -> LoadedModel:
         import os
 
         from localai_tpu.models.config import PRESETS, get_arch
         from localai_tpu.models.llama import init_params
+
+        # Non-text backends have their own loaders (reference: the model
+        # loader spawns a different gRPC backend binary per modality —
+        # initializers.go:50-154; here each returns a resident engine).
+        backend_loaders = {
+            "whisper": self._load_whisper,
+            "tts": self._load_tts,
+            "vad": self._load_vad,
+        }
+        loader = backend_loaders.get(cfg.backend)
+        if loader is None and cfg.backend == "llama" and (
+            cfg.model in whisper_presets() or "whisper" in cfg.model
+        ):
+            loader = self._load_whisper
+        if loader is not None:
+            t0 = time.monotonic()
+            lm = loader(cfg)
+            log.info(
+                "loaded model %s (backend=%s) in %.1fs",
+                cfg.name, cfg.backend, time.monotonic() - t0,
+            )
+            return lm
 
         t0 = time.monotonic()
 
@@ -315,6 +345,68 @@ class ModelManager:
             cfg.name, arch.name, plan, time.monotonic() - t0,
         )
         return LoadedModel(cfg, engine, evaluator)
+
+    # ------------------------------------------------------------------ #
+    # Audio backends
+    # ------------------------------------------------------------------ #
+
+    def _load_whisper(self, cfg: ModelConfig) -> LoadedModel:
+        import os
+
+        import jax as _jax
+
+        from localai_tpu.engine.audio_engine import WhisperEngine
+        from localai_tpu.models import whisper as W
+
+        if cfg.model in W.WHISPER_PRESETS:
+            wcfg = W.WHISPER_PRESETS[cfg.model]
+            params = W.init_params(wcfg, _jax.random.key(0))
+            tokenizer = None
+        else:
+            ckpt_dir = self._resolve_ckpt_dir(cfg.model)
+            if not os.path.isdir(ckpt_dir):
+                raise FileNotFoundError(
+                    f"model {cfg.name!r}: whisper checkpoint {ckpt_dir!r} not found"
+                )
+            wcfg = W.whisper_config_from_hf(ckpt_dir)
+            params = W.load_hf_whisper(wcfg, ckpt_dir)
+            tokenizer = None
+            if _has_tokenizer_files(ckpt_dir):
+                from transformers import AutoTokenizer
+
+                tokenizer = AutoTokenizer.from_pretrained(ckpt_dir)
+        return LoadedModel(cfg, WhisperEngine(wcfg, params, tokenizer), None)
+
+    def _load_tts(self, cfg: ModelConfig) -> LoadedModel:
+        import os
+
+        import jax as _jax
+
+        from localai_tpu.engine.audio_engine import TTSEngine
+        from localai_tpu.models import tts as T
+
+        if cfg.model in T.TTS_PRESETS:
+            tcfg = T.TTS_PRESETS[cfg.model]
+            params = T.init_params(tcfg, _jax.random.key(0))
+        else:
+            ckpt_dir = self._resolve_ckpt_dir(cfg.model)
+            if not os.path.isdir(ckpt_dir):
+                raise FileNotFoundError(
+                    f"model {cfg.name!r}: tts checkpoint {ckpt_dir!r} not found"
+                )
+            tcfg, params = T.load_tts(ckpt_dir)
+        return LoadedModel(cfg, TTSEngine(tcfg, params, voices=cfg.options.get("voices")), None)
+
+    def _load_vad(self, cfg: ModelConfig) -> LoadedModel:
+        from localai_tpu.engine.audio_engine import VADEngine
+
+        return LoadedModel(cfg, VADEngine(), None)
+
+
+def whisper_presets() -> dict:
+    from localai_tpu.models.whisper import WHISPER_PRESETS
+
+    return WHISPER_PRESETS
 
 
 def _has_tokenizer_files(path: str) -> bool:

@@ -1,0 +1,272 @@
+"""Audio modality tests: WAV I/O, mel features, VAD, whisper STT, TTS, and
+the HTTP endpoints (multipart transcription, speech synthesis, VAD).
+
+Reference tier: the audio endpoints are exercised in app_test.go with fixture
+WAVs against whisper.cpp; here everything runs hermetically on the virtual
+CPU mesh with tiny random-init (whisper) / random-init (tts) weights.
+"""
+
+import io
+import json
+import threading
+import urllib.request
+import uuid
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import yaml
+
+from localai_tpu.audio import energy_vad, log_mel_spectrogram, read_wav, resample, write_wav
+from localai_tpu.models import tts as tts_model
+from localai_tpu.models import whisper as whisper_model
+
+SR = 16_000
+
+
+def _tone(freq=440.0, seconds=1.0, sr=SR, amp=0.4):
+    t = np.arange(int(sr * seconds)) / sr
+    return (amp * np.sin(2 * np.pi * freq * t)).astype(np.float32)
+
+
+# --------------------------------------------------------------------------- #
+# WAV / features / VAD
+# --------------------------------------------------------------------------- #
+
+
+def test_wav_round_trip_and_resample():
+    x = _tone()
+    data = write_wav(x, SR)
+    y, sr = read_wav(data)
+    assert sr == SR
+    assert np.abs(y - x).max() < 1e-3
+    z = resample(x, SR, 8000)
+    assert abs(len(z) - len(x) // 2) <= 2
+
+
+def test_wav_stereo_and_widths():
+    # Stereo 16-bit: averaged to mono.
+    import wave
+
+    x = _tone()
+    pcm = (x * 32767).astype(np.int16)
+    stereo = np.stack([pcm, pcm], axis=1).reshape(-1)
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as w:
+        w.setnchannels(2)
+        w.setsampwidth(2)
+        w.setframerate(SR)
+        w.writeframes(stereo.tobytes())
+    y, sr = read_wav(buf.getvalue())
+    assert sr == SR and len(y) == len(x)
+    assert np.abs(y - x).max() < 1e-3
+
+
+def test_log_mel_shape_and_scale():
+    mel = log_mel_spectrogram(jnp.asarray(_tone()), n_mels=16)
+    assert mel.shape == (100, 16)  # 1 s at 10 ms hop
+    assert bool(jnp.isfinite(mel).all())
+    # Whisper scaling keeps values in a small range around [-1, 1.5]
+    assert float(mel.max()) < 4.0 and float(mel.min()) > -4.0
+
+
+def test_vad_finds_speech_segment():
+    rng = np.random.default_rng(0)
+    sig = np.concatenate([
+        np.zeros(SR // 2),
+        _tone(300, 0.5) + 0.002 * rng.standard_normal(SR // 2).astype(np.float32),
+        np.zeros(SR // 2),
+    ])
+    segs = energy_vad(sig, SR)
+    assert len(segs) == 1
+    assert 0.3 < segs[0].start < 0.6
+    assert 0.9 < segs[0].end < 1.2
+
+
+def test_vad_silence_has_no_segments():
+    rng = np.random.default_rng(1)
+    noise = (0.0005 * rng.standard_normal(SR)).astype(np.float32)
+    assert energy_vad(noise, SR) == []
+
+
+# --------------------------------------------------------------------------- #
+# Whisper model
+# --------------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def wcfg():
+    return whisper_model.WHISPER_PRESETS["whisper-test"]
+
+
+@pytest.fixture(scope="module")
+def wparams(wcfg):
+    return whisper_model.init_params(wcfg, jax.random.key(0))
+
+
+def test_whisper_transcribe_shapes_and_determinism(wcfg, wparams):
+    mel = jnp.asarray(
+        np.random.default_rng(0).standard_normal((2, 2 * wcfg.n_audio_ctx, wcfg.n_mels)),
+        jnp.float32,
+    )
+    prompt = jnp.asarray(
+        [wcfg.sot_id, wcfg.first_lang_id, wcfg.transcribe_id, wcfg.no_timestamps_id],
+        jnp.int32,
+    )
+    toks, n_valid = whisper_model.transcribe_greedy(wcfg, wparams, mel, prompt, 8)
+    assert toks.shape == (2, 8)
+    assert n_valid.shape == (2,)
+    # batch-size independence: row 0 alone decodes to the same ids
+    toks1, _ = whisper_model.transcribe_greedy(wcfg, wparams, mel[:1], prompt, 8)
+    np.testing.assert_array_equal(np.asarray(toks)[0], np.asarray(toks1)[0])
+
+
+def test_whisper_hf_checkpoint_round_trip(wcfg, wparams, tmp_path):
+    d = str(tmp_path / "whisper-ckpt")
+    whisper_model.save_hf_whisper(wcfg, wparams, d)
+    cfg2 = whisper_model.whisper_config_from_hf(d)
+    assert cfg2.d_model == wcfg.d_model
+    assert cfg2.enc_layers == wcfg.enc_layers
+    params2 = whisper_model.load_hf_whisper(cfg2, d)
+    mel = jnp.zeros((1, 2 * wcfg.n_audio_ctx, wcfg.n_mels), jnp.float32)
+    prompt = jnp.asarray([wcfg.sot_id], jnp.int32)
+    t1, _ = whisper_model.transcribe_greedy(wcfg, wparams, mel, prompt, 4)
+    t2, _ = whisper_model.transcribe_greedy(cfg2, params2, mel, prompt, 4)
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+
+
+# --------------------------------------------------------------------------- #
+# TTS model
+# --------------------------------------------------------------------------- #
+
+
+def test_tts_synthesize_and_round_trip(tmp_path):
+    cfg = tts_model.TTS_PRESETS["tts-test"]
+    params = tts_model.init_params(cfg, jax.random.key(0))
+    text = b"hello"
+    ids = np.zeros((cfg.max_text,), np.int32)
+    ids[: len(text)] = list(text)
+    audio, n = tts_model.synthesize(
+        cfg, params, jnp.asarray(ids), jnp.int32(len(text)), jnp.int32(0)
+    )
+    assert bool(jnp.isfinite(audio).all())
+    assert int(n) == len(text) * cfg.frames_per_char * cfg.hop
+    # Voices differ
+    audio2, _ = tts_model.synthesize(
+        cfg, params, jnp.asarray(ids), jnp.int32(len(text)), jnp.int32(1)
+    )
+    assert not np.allclose(np.asarray(audio), np.asarray(audio2))
+    # Checkpoint round-trip
+    d = str(tmp_path / "tts-ckpt")
+    tts_model.save_tts(cfg, params, d)
+    cfg2, params2 = tts_model.load_tts(d)
+    assert cfg2 == cfg
+    audio3, _ = tts_model.synthesize(
+        cfg2, params2, jnp.asarray(ids), jnp.int32(len(text)), jnp.int32(0)
+    )
+    np.testing.assert_allclose(np.asarray(audio), np.asarray(audio3), atol=1e-5)
+
+
+# --------------------------------------------------------------------------- #
+# HTTP endpoints
+# --------------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def audio_api(tmp_path_factory):
+    from localai_tpu.config import ApplicationConfig
+    from localai_tpu.server import ModelManager, Router, create_server
+    from localai_tpu.server.audio_api import AudioApi
+    from localai_tpu.server.openai_api import OpenAIApi
+
+    d = tmp_path_factory.mktemp("audio-models")
+    (d / "stt.yaml").write_text(yaml.safe_dump({
+        "name": "stt", "model": "whisper-test", "backend": "whisper",
+    }))
+    (d / "voice.yaml").write_text(yaml.safe_dump({
+        "name": "voice", "model": "tts-test", "backend": "tts",
+    }))
+    (d / "vad.yaml").write_text(yaml.safe_dump({
+        "name": "vad", "model": "energy", "backend": "vad",
+    }))
+    app_cfg = ApplicationConfig(
+        address="127.0.0.1", port=0, models_dir=str(d), max_active_models=4
+    )
+    manager = ModelManager(app_cfg)
+    router = Router()
+    oai = OpenAIApi(manager)
+    oai.register(router)
+    AudioApi(manager, oai).register(router)
+    server = create_server(app_cfg, router)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{port}"
+    server.shutdown()
+    manager.shutdown()
+
+
+def _multipart(fields: dict) -> tuple[bytes, str]:
+    boundary = uuid.uuid4().hex
+    out = io.BytesIO()
+    for name, value in fields.items():
+        out.write(f"--{boundary}\r\n".encode())
+        if isinstance(value, tuple):
+            fname, blob = value
+            out.write(
+                f'Content-Disposition: form-data; name="{name}"; filename="{fname}"\r\n'
+                f"Content-Type: application/octet-stream\r\n\r\n".encode()
+            )
+            out.write(blob)
+        else:
+            out.write(f'Content-Disposition: form-data; name="{name}"\r\n\r\n'.encode())
+            out.write(str(value).encode())
+        out.write(b"\r\n")
+    out.write(f"--{boundary}--\r\n".encode())
+    return out.getvalue(), f"multipart/form-data; boundary={boundary}"
+
+
+def test_transcription_endpoint(audio_api):
+    wav = write_wav(_tone(seconds=0.5), SR)
+    body, ctype = _multipart({
+        "file": ("test.wav", wav), "model": "stt", "response_format": "verbose_json",
+    })
+    req = urllib.request.Request(
+        audio_api + "/v1/audio/transcriptions", data=body,
+        headers={"Content-Type": ctype},
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        out = json.loads(r.read())
+    assert out["task"] == "transcribe"
+    assert out["duration"] == pytest.approx(0.5, abs=0.01)
+    assert isinstance(out["text"], str)
+    assert out["segments"] and out["segments"][0]["start"] == 0.0
+
+
+def test_speech_endpoint_returns_wav(audio_api):
+    req = urllib.request.Request(
+        audio_api + "/v1/audio/speech",
+        data=json.dumps({"model": "voice", "input": "hi there"}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        assert r.headers["Content-Type"] == "audio/wav"
+        blob = r.read()
+    samples, sr = read_wav(blob)
+    assert sr == tts_model.TTS_PRESETS["tts-test"].sample_rate
+    assert len(samples) > 0
+    assert np.abs(samples).max() <= 1.0
+
+
+def test_vad_endpoint(audio_api):
+    sig = np.concatenate([np.zeros(SR // 2), _tone(250, 0.5), np.zeros(SR // 2)])
+    req = urllib.request.Request(
+        audio_api + "/vad",
+        data=json.dumps({"audio": sig.tolist(), "sample_rate": SR}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as r:
+        out = json.loads(r.read())
+    assert len(out["segments"]) == 1
+    seg = out["segments"][0]
+    assert 0.3 < seg["start"] < 0.6 < 0.9 < seg["end"] < 1.2
