@@ -85,6 +85,7 @@ def main(argv: list[str] | None = None) -> int:
     from localai_tpu.server import ModelManager, Router, create_server
     from localai_tpu.server.audio_api import AudioApi
     from localai_tpu.server.gallery_api import GalleryApi
+    from localai_tpu.server.image_api import ImageApi
     from localai_tpu.server.openai_api import OpenAIApi
     from localai_tpu.server.stores_api import StoresApi
 
@@ -93,6 +94,7 @@ def main(argv: list[str] | None = None) -> int:
     oai = OpenAIApi(manager)
     oai.register(router)
     AudioApi(manager, oai).register(router)
+    ImageApi(manager, oai, app_cfg.generated_content_dir).register(router)
     StoresApi().register(router)
     gallery_service = GalleryService(
         app_cfg.models_dir,

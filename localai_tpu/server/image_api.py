@@ -1,0 +1,139 @@
+"""Image and video generation endpoints.
+
+Reference: core/http/endpoints/openai/image.go (b64/url response, files
+under generated_content_dir served back over HTTP) and endpoints/openai/
+video.go. PNG/GIF encoding via PIL on the host; generation on the TPU.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import os
+import re
+import time
+import uuid
+
+from localai_tpu.config import Usecase
+from localai_tpu.server.app import ApiError, Request, Response, Router
+from localai_tpu.server.manager import ModelManager
+from localai_tpu.server.openai_api import OpenAIApi
+
+_SIZE_RE = re.compile(r"^(\d+)x(\d+)$")
+_NAME_RE = re.compile(r"^[A-Za-z0-9._-]+$")
+
+
+class ImageApi:
+    def __init__(self, manager: ModelManager, base: OpenAIApi, content_dir: str):
+        self.manager = manager
+        self._base = base
+        self.content_dir = content_dir
+
+    def register(self, r: Router) -> None:
+        r.add("POST", "/v1/images/generations", self.generations)
+        r.add("POST", "/images/generations", self.generations)
+        r.add("POST", "/v1/videos", self.videos)
+        r.add("GET", "/generated-images/:name", self.serve_image)
+        r.add("GET", "/generated-videos/:name", self.serve_video)
+
+    # ------------------------------------------------------------------ #
+
+    def _parse_size(self, body) -> tuple[int, int] | None:
+        size = body.get("size")
+        if not size:
+            return None
+        m = _SIZE_RE.match(str(size))
+        if not m:
+            raise ApiError(400, f"invalid size {size!r} (expected WxH)")
+        w, h = int(m.group(1)), int(m.group(2))
+        if not (8 <= w <= 4096 and 8 <= h <= 4096):
+            raise ApiError(400, "size out of range")
+        return (w, h)
+
+    def generations(self, req: Request) -> Response:
+        from PIL import Image
+
+        body = req.body or {}
+        prompt = body.get("prompt")
+        if not prompt or not isinstance(prompt, str):
+            raise ApiError(400, "prompt is required")
+        n = int(body.get("n") or 1)
+        if not 1 <= n <= 8:
+            raise ApiError(400, "n must be between 1 and 8")
+        steps = int(body.get("step") or body.get("steps") or 20)
+        size = self._parse_size(body)
+        response_format = body.get("response_format") or "url"
+
+        lm, lease = self._base._resolve(req, Usecase.IMAGE)
+        try:
+            images = lm.engine.generate(
+                prompt, n=n, steps=steps, seed=body.get("seed"), size=size,
+                guidance=float(body.get("guidance_scale") or 4.0),
+            )
+        finally:
+            lease.release()
+
+        os.makedirs(self.content_dir, exist_ok=True)
+        data = []
+        for img in images:
+            buf = io.BytesIO()
+            Image.fromarray(img).save(buf, format="PNG")
+            png = buf.getvalue()
+            if response_format == "b64_json":
+                data.append({"b64_json": base64.b64encode(png).decode()})
+            else:
+                name = f"{uuid.uuid4().hex}.png"
+                with open(os.path.join(self.content_dir, name), "wb") as f:
+                    f.write(png)
+                data.append({"url": f"/generated-images/{name}"})
+        return Response(body={"created": int(time.time()), "data": data})
+
+    def videos(self, req: Request) -> Response:
+        from PIL import Image
+
+        body = req.body or {}
+        prompt = body.get("prompt")
+        if not prompt or not isinstance(prompt, str):
+            raise ApiError(400, "prompt is required")
+        n_frames = int(body.get("n_frames") or 8)
+        if not 2 <= n_frames <= 64:
+            raise ApiError(400, "n_frames must be between 2 and 64")
+        steps = int(body.get("step") or body.get("steps") or 12)
+
+        lm, lease = self._base._resolve(req, Usecase.VIDEO)
+        try:
+            frames = lm.engine.generate_video(
+                prompt, n_frames=n_frames, steps=steps, seed=body.get("seed"),
+            )
+        finally:
+            lease.release()
+
+        os.makedirs(self.content_dir, exist_ok=True)
+        pil_frames = [Image.fromarray(f) for f in frames]
+        name = f"{uuid.uuid4().hex}.gif"
+        path = os.path.join(self.content_dir, name)
+        pil_frames[0].save(
+            path, format="GIF", save_all=True, append_images=pil_frames[1:],
+            duration=int(body.get("frame_ms") or 125), loop=0,
+        )
+        return Response(body={
+            "created": int(time.time()),
+            "data": [{"url": f"/generated-videos/{name}"}],
+        })
+
+    # ------------------------------------------------------------------ #
+
+    def _serve(self, name: str, ctype: str) -> Response:
+        if not _NAME_RE.match(name):
+            raise ApiError(400, "invalid file name")
+        path = os.path.join(self.content_dir, name)
+        if not os.path.exists(path):
+            raise ApiError(404, f"{name} not found")
+        with open(path, "rb") as f:
+            return Response(body=f.read(), content_type=ctype)
+
+    def serve_image(self, req: Request) -> Response:
+        return self._serve(req.params["name"], "image/png")
+
+    def serve_video(self, req: Request) -> Response:
+        return self._serve(req.params["name"], "image/gif")

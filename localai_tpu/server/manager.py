@@ -271,6 +271,9 @@ class ModelManager:
             "whisper": self._load_whisper,
             "tts": self._load_tts,
             "vad": self._load_vad,
+            "diffusion": self._load_diffusion,
+            "diffusers": self._load_diffusion,
+            "stablediffusion": self._load_diffusion,
         }
         loader = backend_loaders.get(cfg.backend)
         if loader is None and cfg.backend == "llama" and (
@@ -401,6 +404,26 @@ class ModelManager:
         from localai_tpu.engine.audio_engine import VADEngine
 
         return LoadedModel(cfg, VADEngine(), None)
+
+    def _load_diffusion(self, cfg: ModelConfig) -> LoadedModel:
+        import os
+
+        import jax as _jax
+
+        from localai_tpu.engine.image_engine import DiffusionEngine
+        from localai_tpu.models import diffusion as D
+
+        if cfg.model in D.DIFFUSION_PRESETS:
+            dcfg = D.DIFFUSION_PRESETS[cfg.model]
+            params = D.init_params(dcfg, _jax.random.key(0))
+        else:
+            ckpt_dir = self._resolve_ckpt_dir(cfg.model)
+            if not os.path.isdir(ckpt_dir):
+                raise FileNotFoundError(
+                    f"model {cfg.name!r}: diffusion checkpoint {ckpt_dir!r} not found"
+                )
+            dcfg, params = D.load_diffusion(ckpt_dir)
+        return LoadedModel(cfg, DiffusionEngine(dcfg, params), None)
 
 
 def whisper_presets() -> dict:
