@@ -332,8 +332,15 @@ class Engine:
         def _embed(params, tokens, lengths):
             return llama.encode(cfg, params, tokens, lengths, mesh=ring_mesh)
 
+        @partial(jax.jit)
+        def _score(params, tokens, lengths, cond_lengths):
+            return llama.sequence_logprob(
+                cfg, params, tokens, lengths, cond_lengths, mesh=ring_mesh
+            )
+
         self._prefill_fn = _prefill
         self._embed_fn = _embed
+        self._score_fn = _score
 
     def _get_block(self, variant: str, n: int, with_lp: bool = False):
         """Fused n-step decode block program for one sampling variant.
@@ -551,6 +558,26 @@ class Engine:
             toks[i, : len(ids)] = ids
             lens[i] = len(ids)
         return np.asarray(self._embed_fn(self.params, toks, lens))
+
+    def rerank(self, query_ids: list[int], docs_ids: list[list[int]]) -> np.ndarray:
+        """Relevance scores [N]: mean conditional log-likelihood of each
+        document given the query (rerank capability — backend.proto Rerank,
+        core/backend/rerank.go). Higher is more relevant."""
+        limit = self.ecfg.max_seq - 1
+        q = list(query_ids)[: limit // 2]
+        rows = []
+        for d in docs_ids:
+            d = list(d)[: limit - len(q)] or [0]
+            rows.append(q + d)
+        S = self._bucket_for(max(len(r) for r in rows))
+        N = len(rows)
+        toks = np.zeros((N, S), np.int32)
+        lens = np.zeros((N,), np.int32)
+        conds = np.full((N,), len(q), np.int32)
+        for i, r in enumerate(rows):
+            toks[i, : len(r)] = r
+            lens[i] = len(r)
+        return np.asarray(self._score_fn(self.params, toks, lens, conds))
 
     def metrics(self) -> dict[str, float]:
         tps = self._decode_tokens / self._decode_time if self._decode_time > 0 else 0.0

@@ -18,6 +18,69 @@ import numpy as np
 from localai_tpu.models import diffusion as dit
 
 
+class DetectionEngine:
+    """Resident DETR-style detector (models/detection.py)."""
+
+    def __init__(self, cfg, params: Any):
+        from localai_tpu.models import detection as det
+
+        self.cfg = cfg
+        self.params = params
+        self.cache = None
+        self._lock = threading.Lock()
+        self._fn = jax.jit(lambda p, img: det.forward(cfg, p, img))
+        self.m_requests = 0
+        self._busy_time = 0.0
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+    def cancel_all(self) -> int:
+        return 0
+
+    def metrics(self) -> dict[str, float]:
+        return {"requests": float(self.m_requests), "busy_seconds": self._busy_time}
+
+    def detect(self, img: np.ndarray, threshold: float = 0.5) -> list[dict]:
+        """img uint8 [H, W, 3] (any size; resized to the model's grid).
+        Returns [{x, y, width, height, confidence, class_name}] in pixels of
+        the INPUT image (reference contract: proto Detection → DetectResponse
+        x/y/width/height/confidence/class_name)."""
+        from PIL import Image
+
+        t0 = time.monotonic()
+        H, W = img.shape[:2]
+        s = self.cfg.image_size
+        resized = np.asarray(
+            Image.fromarray(img).resize((s, s), Image.BILINEAR), np.float32
+        ) / 255.0
+        with self._lock:
+            logits, boxes = self._fn(self.params, jnp.asarray(resized[None]))
+        probs = np.asarray(jax.nn.softmax(jnp.asarray(logits[0]), axis=-1))
+        boxes = np.asarray(boxes[0])
+        out = []
+        for qi in range(probs.shape[0]):
+            cls = int(probs[qi, :-1].argmax())  # last class = no-object
+            conf = float(probs[qi, cls])
+            if conf < threshold:
+                continue
+            cx, cy, bw, bh = boxes[qi]
+            out.append({
+                "x": float((cx - bw / 2) * W),
+                "y": float((cy - bh / 2) * H),
+                "width": float(bw * W),
+                "height": float(bh * H),
+                "confidence": conf,
+                "class_name": self.cfg.class_names[cls],
+            })
+        self.m_requests += 1
+        self._busy_time += time.monotonic() - t0
+        return out
+
+
 class DiffusionEngine:
     def __init__(self, cfg: dit.DiffusionConfig, params: Any):
         self.cfg = cfg

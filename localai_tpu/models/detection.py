@@ -1,0 +1,208 @@
+"""Object detection as pure-functional JAX: a DETR-style set predictor.
+
+The reference serves detection through RF-DETR (backend/python/rfdetr,
+RPC Detect → core/backend/detection.go:12, endpoint /v1/detection). Same
+capability, TPU-first shape: patchify → transformer encoder → learned object
+queries cross-attending in a decoder → per-query class logits + sigmoid box
+regression (cx, cy, w, h in [0,1]). Fixed query count keeps every shape
+static; confidence filtering happens on the host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = dict[str, Any]
+
+COCO_CLASSES = (
+    "person bicycle car motorcycle airplane bus train truck boat traffic-light "
+    "fire-hydrant stop-sign parking-meter bench bird cat dog horse sheep cow "
+    "elephant bear zebra giraffe backpack umbrella handbag tie suitcase frisbee "
+    "skis snowboard sports-ball kite baseball-bat baseball-glove skateboard "
+    "surfboard tennis-racket bottle wine-glass cup fork knife spoon bowl banana "
+    "apple sandwich orange broccoli carrot hot-dog pizza donut cake chair couch "
+    "potted-plant bed dining-table toilet tv laptop mouse remote keyboard "
+    "cell-phone microwave oven toaster sink refrigerator book clock vase "
+    "scissors teddy-bear hair-drier toothbrush"
+).split()
+
+
+@dataclasses.dataclass(frozen=True)
+class DetectionConfig:
+    name: str = "detr"
+    image_size: int = 256
+    patch: int = 16
+    d_model: int = 256
+    n_heads: int = 8
+    enc_layers: int = 4
+    dec_layers: int = 4
+    ffn_mult: int = 4
+    n_queries: int = 50
+    class_names: tuple[str, ...] = tuple(COCO_CLASSES)
+
+    @property
+    def n_classes(self) -> int:
+        return len(self.class_names)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def ffn(self) -> int:
+        return self.d_model * self.ffn_mult
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch * self.patch * 3
+
+
+DETECTION_PRESETS: dict[str, DetectionConfig] = {
+    "detr-test": DetectionConfig(
+        name="detr-test", image_size=32, patch=8, d_model=32, n_heads=2,
+        enc_layers=1, dec_layers=1, n_queries=8,
+        class_names=("cat", "dog", "car"),
+    ),
+    "detr-base": DetectionConfig(name="detr-base"),
+}
+
+
+def _block_params(rnd, L, d, ffn, cross: bool) -> Params:
+    p = {
+        "ln1_w": jnp.ones((L, d)), "ln1_b": jnp.zeros((L, d)),
+        "q_w": rnd((L, d, d)), "k_w": rnd((L, d, d)), "v_w": rnd((L, d, d)),
+        "o_w": rnd((L, d, d)),
+        "ln2_w": jnp.ones((L, d)), "ln2_b": jnp.zeros((L, d)),
+        "fc1_w": rnd((L, d, ffn)), "fc1_b": jnp.zeros((L, ffn)),
+        "fc2_w": rnd((L, ffn, d)), "fc2_b": jnp.zeros((L, d)),
+    }
+    if cross:
+        p.update({
+            "lnx_w": jnp.ones((L, d)), "lnx_b": jnp.zeros((L, d)),
+            "xq_w": rnd((L, d, d)), "xk_w": rnd((L, d, d)), "xv_w": rnd((L, d, d)),
+            "xo_w": rnd((L, d, d)),
+        })
+    return p
+
+
+def init_params(cfg: DetectionConfig, key: jnp.ndarray, scale: float = 0.02) -> Params:
+    keys = iter(jax.random.split(key, 64))
+    d = cfg.d_model
+
+    def rnd(shape):
+        return jax.random.normal(next(keys), shape, jnp.float32) * scale
+
+    return {
+        "patch_w": rnd((cfg.patch_dim, d)), "patch_b": jnp.zeros((d,)),
+        "pos": rnd((cfg.n_patches, d)),
+        "queries": rnd((cfg.n_queries, d)),
+        "enc": _block_params(rnd, cfg.enc_layers, d, cfg.ffn, cross=False),
+        "dec": _block_params(rnd, cfg.dec_layers, d, cfg.ffn, cross=True),
+        "ln_f_w": jnp.ones((d,)), "ln_f_b": jnp.zeros((d,)),
+        # +1 class for "no object" (DETR convention)
+        "cls_w": rnd((d, cfg.n_classes + 1)), "cls_b": jnp.zeros((cfg.n_classes + 1,)),
+        "box_w": rnd((d, 4)), "box_b": jnp.zeros((4,)),
+    }
+
+
+def _ln(x, w, b, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return (x32 - mu) * jax.lax.rsqrt(var + eps) * w + b
+
+
+def _attn(cfg, q, k, v):
+    B, Tq = q.shape[:2]
+    H, Dh = cfg.n_heads, cfg.head_dim
+    qh = q.reshape(B, Tq, H, Dh)
+    kh = k.reshape(B, k.shape[1], H, Dh)
+    vh = v.reshape(B, v.shape[1], H, Dh)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * Dh**-0.5
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, vh).reshape(B, Tq, cfg.d_model)
+
+
+def forward(cfg: DetectionConfig, params: Params, img: jnp.ndarray):
+    """img [B, H, W, 3] in [0,1] → (class_logits [B, Q, C+1], boxes [B, Q, 4]).
+
+    Boxes are (cx, cy, w, h) normalized to [0, 1]."""
+    B = img.shape[0]
+    p, n = cfg.patch, cfg.image_size // cfg.patch
+    x = img.reshape(B, n, p, n, p, 3).transpose(0, 1, 3, 2, 4, 5).reshape(B, n * n, cfg.patch_dim)
+    h = x @ params["patch_w"] + params["patch_b"] + params["pos"][None]
+
+    def enc_layer(h, lp):
+        x = _ln(h, lp["ln1_w"], lp["ln1_b"])
+        h = h + _attn(cfg, x @ lp["q_w"], x @ lp["k_w"], x @ lp["v_w"]) @ lp["o_w"]
+        x = _ln(h, lp["ln2_w"], lp["ln2_b"])
+        h = h + jax.nn.gelu(x @ lp["fc1_w"] + lp["fc1_b"]) @ lp["fc2_w"] + lp["fc2_b"]
+        return h, None
+
+    mem, _ = jax.lax.scan(enc_layer, h, params["enc"])
+
+    q = jnp.broadcast_to(params["queries"][None], (B, cfg.n_queries, cfg.d_model))
+
+    def dec_layer(q, lp):
+        x = _ln(q, lp["ln1_w"], lp["ln1_b"])
+        q = q + _attn(cfg, x @ lp["q_w"], x @ lp["k_w"], x @ lp["v_w"]) @ lp["o_w"]
+        x = _ln(q, lp["lnx_w"], lp["lnx_b"])
+        q = q + _attn(cfg, x @ lp["xq_w"], mem @ lp["xk_w"], mem @ lp["xv_w"]) @ lp["xo_w"]
+        x = _ln(q, lp["ln2_w"], lp["ln2_b"])
+        q = q + jax.nn.gelu(x @ lp["fc1_w"] + lp["fc1_b"]) @ lp["fc2_w"] + lp["fc2_b"]
+        return q, None
+
+    q, _ = jax.lax.scan(dec_layer, q, params["dec"])
+    q = _ln(q, params["ln_f_w"], params["ln_f_b"])
+    cls_logits = q @ params["cls_w"] + params["cls_b"]
+    boxes = jax.nn.sigmoid(q @ params["box_w"] + params["box_b"])
+    return cls_logits, boxes
+
+
+def save_detection(cfg: DetectionConfig, params: Params, ckpt_dir: str) -> None:
+    from safetensors.numpy import save_file
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    flat = {}
+    for k, v in params.items():
+        if isinstance(v, dict):
+            for k2, v2 in v.items():
+                flat[f"{k}.{k2}"] = np.asarray(v2, np.float32)
+        else:
+            flat[k] = np.asarray(v, np.float32)
+    save_file(flat, os.path.join(ckpt_dir, "model.safetensors"))
+    d = dataclasses.asdict(cfg)
+    d["class_names"] = list(cfg.class_names)
+    with open(os.path.join(ckpt_dir, "config.json"), "w") as f:
+        json.dump({"model_type": "localai-detr", **d}, f, indent=1)
+
+
+def load_detection(ckpt_dir: str) -> tuple[DetectionConfig, Params]:
+    from safetensors import safe_open
+
+    with open(os.path.join(ckpt_dir, "config.json")) as f:
+        hf = json.load(f)
+    hf.pop("model_type", None)
+    hf["class_names"] = tuple(hf.get("class_names", COCO_CLASSES))
+    cfg = DetectionConfig(**hf)
+    params: Params = {}
+    with safe_open(os.path.join(ckpt_dir, "model.safetensors"), framework="numpy") as f:
+        for name in f.keys():
+            arr = jnp.asarray(f.get_tensor(name))
+            if "." in name:
+                grp, sub = name.split(".", 1)
+                params.setdefault(grp, {})[sub] = arr
+            else:
+                params[name] = arr
+    return cfg, params

@@ -224,6 +224,29 @@ def encode(
     return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
 
 
+def sequence_logprob(
+    cfg: ArchConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [B, S] int32, right-padded
+    lengths: jnp.ndarray,  # [B] int32 total valid length
+    cond_lengths: jnp.ndarray,  # [B] int32 — score only positions >= cond_len
+    mesh=None,
+) -> jnp.ndarray:
+    """Mean log P(tokens[cond_len:len] | tokens[:cond_len]) per row — the
+    scoring primitive behind reranking (reference capability: core/backend/
+    rerank.go RPC to a cross-encoder; here relevance is measured as the
+    document's conditional likelihood under the LLM given the query)."""
+    h, _, _ = _forward_hidden(cfg, params, tokens, lengths, collect_kv=False, mesh=mesh)
+    logits = _unembed(cfg, params, h[:, :-1])  # [B, S-1, V] predicts tokens[1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    tgt = tokens[:, 1:]  # [B, S-1]
+    tok_lp = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    pos = jnp.arange(tgt.shape[1])[None, :] + 1  # position of the target token
+    valid = (pos >= cond_lengths[:, None]) & (pos < lengths[:, None])
+    n = jnp.maximum(valid.sum(axis=-1), 1)
+    return (tok_lp * valid).sum(axis=-1) / n  # [B]
+
+
 def decode_step(
     cfg: ArchConfig,
     params: Params,

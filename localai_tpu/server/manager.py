@@ -274,6 +274,7 @@ class ModelManager:
             "diffusion": self._load_diffusion,
             "diffusers": self._load_diffusion,
             "stablediffusion": self._load_diffusion,
+            "detection": self._load_detection,
         }
         loader = backend_loaders.get(cfg.backend)
         if loader is None and cfg.backend == "llama" and (
@@ -404,6 +405,26 @@ class ModelManager:
         from localai_tpu.engine.audio_engine import VADEngine
 
         return LoadedModel(cfg, VADEngine(), None)
+
+    def _load_detection(self, cfg: ModelConfig) -> LoadedModel:
+        import os
+
+        import jax as _jax
+
+        from localai_tpu.engine.image_engine import DetectionEngine
+        from localai_tpu.models import detection as Det
+
+        if cfg.model in Det.DETECTION_PRESETS:
+            dcfg = Det.DETECTION_PRESETS[cfg.model]
+            params = Det.init_params(dcfg, _jax.random.key(0))
+        else:
+            ckpt_dir = self._resolve_ckpt_dir(cfg.model)
+            if not os.path.isdir(ckpt_dir):
+                raise FileNotFoundError(
+                    f"model {cfg.name!r}: detection checkpoint {ckpt_dir!r} not found"
+                )
+            dcfg, params = Det.load_detection(ckpt_dir)
+        return LoadedModel(cfg, DetectionEngine(dcfg, params), None)
 
     def _load_diffusion(self, cfg: ModelConfig) -> LoadedModel:
         import os

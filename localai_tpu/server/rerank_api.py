@@ -1,0 +1,93 @@
+"""Rerank (jina-compatible) and object-detection endpoints.
+
+Reference: core/http/routes/jina.go → endpoints/jina/rerank.go (POST
+/v1/rerank: query + documents → relevance-sorted results) and
+endpoints/localai/detection.go (POST /v1/detection: image → boxes).
+"""
+
+from __future__ import annotations
+
+import base64
+
+import numpy as np
+
+from localai_tpu.config import Usecase
+from localai_tpu.server.app import ApiError, Request, Response, Router
+from localai_tpu.server.manager import ModelManager
+from localai_tpu.server.openai_api import OpenAIApi
+
+
+class RerankApi:
+    def __init__(self, manager: ModelManager, base: OpenAIApi):
+        self.manager = manager
+        self._base = base
+
+    def register(self, r: Router) -> None:
+        r.add("POST", "/v1/rerank", self.rerank)
+        r.add("POST", "/rerank", self.rerank)
+        r.add("POST", "/v1/detection", self.detection)
+
+    def rerank(self, req: Request) -> Response:
+        body = req.body or {}
+        query = body.get("query")
+        documents = body.get("documents")
+        if not query or not isinstance(query, str):
+            raise ApiError(400, "query is required")
+        if not documents or not isinstance(documents, list):
+            raise ApiError(400, "documents must be a non-empty array")
+        docs = [d.get("text", "") if isinstance(d, dict) else str(d) for d in documents]
+        top_n = int(body.get("top_n") or len(docs))
+
+        lm, lease = self._base._resolve(req, Usecase.RERANK)
+        try:
+            tok = lm.engine.tokenizer
+            q_ids = tok.encode(query) or [0]
+            d_ids = [tok.encode(d) or [0] for d in docs]
+            scores = lm.engine.rerank(q_ids, d_ids)
+        finally:
+            lease.release()
+
+        order = np.argsort(-scores)[:top_n]
+        results = [
+            {
+                "index": int(i),
+                "relevance_score": float(scores[i]),
+                "document": {"text": docs[i]},
+            }
+            for i in order
+        ]
+        n_tokens = len(q_ids) + sum(len(d) for d in d_ids)
+        return Response(body={
+            "model": lm.cfg.name,
+            "results": results,
+            "usage": {"total_tokens": n_tokens, "prompt_tokens": n_tokens},
+        })
+
+    def detection(self, req: Request) -> Response:
+        body = req.body or {}
+        img_b64 = body.get("image")
+        if not img_b64 or not isinstance(img_b64, str):
+            raise ApiError(400, "image (base64) is required")
+        if img_b64.startswith("data:"):
+            img_b64 = img_b64.split(",", 1)[-1]
+        try:
+            raw = base64.b64decode(img_b64)
+        except Exception:  # noqa: BLE001
+            raise ApiError(400, "invalid base64 image") from None
+        import io
+
+        from PIL import Image
+
+        try:
+            img = np.asarray(Image.open(io.BytesIO(raw)).convert("RGB"))
+        except Exception as e:  # noqa: BLE001
+            raise ApiError(400, f"could not decode image: {e}") from None
+
+        thr = body.get("threshold")
+        thr = 0.5 if thr is None else float(thr)  # 0.0 is a valid threshold
+        lm, lease = self._base._resolve(req, Usecase.DETECTION)
+        try:
+            detections = lm.engine.detect(img, threshold=thr)
+        finally:
+            lease.release()
+        return Response(body={"detections": detections})
