@@ -1,7 +1,9 @@
-"""CLI entrypoint: `python -m localai_tpu [run|models|version] ...`
+"""CLI entrypoint:
+`python -m localai_tpu [run|worker|federated|models|transcribe|tts|version]`
 
 Reference: cmd/local-ai kong CLI (core/cli/cli.go:11-20 command tree,
-run.go:23-120 flags with env aliases). Flags here mirror the env-var names
+run.go:23-120 flags with env aliases, worker.go, federated.go,
+transcript.go, tts.go). Flags here mirror the env-var names
 ApplicationConfig.from_env reads, so either style works.
 """
 
@@ -17,20 +19,118 @@ def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="localai-tpu", description="TPU-native LocalAI-compatible server")
     sub = p.add_subparsers(dest="command")
 
+    def add_run_flags(cmd):
+        cmd.add_argument("--address", default=None, help="bind address (LOCALAI_ADDRESS)")
+        cmd.add_argument("--port", type=int, default=None, help="bind port (LOCALAI_PORT)")
+        cmd.add_argument("--models-path", default=None, help="model configs dir (LOCALAI_MODELS_PATH)")
+        cmd.add_argument("--api-key", action="append", default=None, help="require this API key (repeatable)")
+        cmd.add_argument("--max-active-models", type=int, default=None)
+        cmd.add_argument("--preload", action="append", default=None, help="model name to load at boot (repeatable)")
+        cmd.add_argument("--debug", action="store_true")
+        # Multi-host (jax.distributed over DCN) and federation joining.
+        cmd.add_argument("--coordinator", default=None, help="host:port of process 0 (LOCALAI_COORDINATOR)")
+        cmd.add_argument("--num-processes", type=int, default=None, help="LOCALAI_NUM_PROCESSES")
+        cmd.add_argument("--process-id", type=int, default=None, help="LOCALAI_PROCESS_ID")
+        cmd.add_argument("--federator", default=None, help="federation router URL to register with")
+        cmd.add_argument("--worker-name", default=None, help="name announced to the federator")
+
     run = sub.add_parser("run", help="start the API server (default)")
-    run.add_argument("--address", default=None, help="bind address (LOCALAI_ADDRESS)")
-    run.add_argument("--port", type=int, default=None, help="bind port (LOCALAI_PORT)")
-    run.add_argument("--models-path", default=None, help="model configs dir (LOCALAI_MODELS_PATH)")
-    run.add_argument("--api-key", action="append", default=None, help="require this API key (repeatable)")
-    run.add_argument("--max-active-models", type=int, default=None)
-    run.add_argument("--preload", action="append", default=None, help="model name to load at boot (repeatable)")
-    run.add_argument("--debug", action="store_true")
+    add_run_flags(run)
+    worker = sub.add_parser(
+        "worker", help="start a serving process that joins a federation"
+    )
+    add_run_flags(worker)
+
+    fed = sub.add_parser("federated", help="start the federation front door")
+    fed.add_argument("--address", default="0.0.0.0")
+    fed.add_argument("--port", type=int, default=9090)
+    fed.add_argument("--strategy", choices=("least-used", "random"), default="least-used")
+    fed.add_argument(
+        "--workers", default="",
+        help="comma-separated name=url pairs (more can register at runtime)",
+    )
+    fed.add_argument("--debug", action="store_true")
 
     models = sub.add_parser("models", help="list configured models")
     models.add_argument("--models-path", default=None)
 
+    tr = sub.add_parser("transcribe", help="transcribe a WAV file locally")
+    tr.add_argument("file")
+    tr.add_argument("--model", default="whisper-tiny")
+    tr.add_argument("--models-path", default=None)
+    tr.add_argument("--language", default=None)
+
+    tts = sub.add_parser("tts", help="synthesize speech to a WAV file")
+    tts.add_argument("text")
+    tts.add_argument("--model", default="tts-base")
+    tts.add_argument("--models-path", default=None)
+    tts.add_argument("--voice", default=None)
+    tts.add_argument("--output", default="out.wav")
+
     sub.add_parser("version", help="print version")
     return p
+
+
+def _run_federated(args) -> int:
+    logging.basicConfig(
+        level=logging.DEBUG if args.debug else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    from localai_tpu.federation import FederatedServer
+
+    workers = []
+    for pair in (args.workers or "").split(","):
+        pair = pair.strip()
+        if not pair:
+            continue
+        name, _, url = pair.partition("=")
+        if not url:
+            name, url = f"worker-{len(workers)}", name
+        workers.append((name, url))
+    fed = FederatedServer(
+        address=args.address, port=args.port, strategy=args.strategy, workers=workers
+    )
+    fed.start()
+    logging.getLogger("localai_tpu").info(
+        "federation router on %s:%d (%d workers, strategy=%s)",
+        args.address, fed.port, len(workers), args.strategy,
+    )
+    signal.sigwait({signal.SIGINT, signal.SIGTERM})
+    fed.stop()
+    return 0
+
+
+def _run_local_audio(args) -> int:
+    """`transcribe` / `tts` one-shot commands (reference: core/cli/
+    transcript.go and tts.go run the backend without the HTTP server)."""
+    from localai_tpu.config import ApplicationConfig, ModelConfig
+
+    app_cfg = ApplicationConfig.from_env(
+        **({"models_dir": args.models_path} if args.models_path else {})
+    )
+    from localai_tpu.server.manager import ModelManager
+
+    manager = ModelManager(app_cfg)
+    if args.command == "transcribe":
+        from localai_tpu.audio import read_wav, resample
+
+        if manager.configs.get(args.model) is None:
+            manager.configs.register(ModelConfig(name=args.model, model=args.model, backend="whisper"))
+        lm = manager.get(args.model)
+        audio, sr = read_wav(args.file)
+        out = lm.engine.transcribe(resample(audio, sr, 16_000), language=args.language)
+        print(out["text"])
+        return 0
+    # tts
+    from localai_tpu.audio import write_wav
+
+    if manager.configs.get(args.model) is None:
+        manager.configs.register(ModelConfig(name=args.model, model=args.model, backend="tts"))
+    lm = manager.get(args.model)
+    samples, sr = lm.engine.synthesize(args.text, voice=args.voice)
+    write_wav(samples, sr, path=args.output)
+    print(args.output)
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -60,7 +160,13 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{name}\tbackend={mc.backend}\tmodel={mc.model}")
         return 0
 
-    # run
+    if args.command == "federated":
+        return _run_federated(args)
+
+    if args.command in ("transcribe", "tts"):
+        return _run_local_audio(args)
+
+    # run / worker
     if args.address:
         overrides["address"] = args.address
     if args.port:
@@ -80,6 +186,16 @@ def main(argv: list[str] | None = None) -> int:
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
     log = logging.getLogger("localai_tpu")
+
+    # Multi-host: wire this process into the global device mesh BEFORE any
+    # jax computation (jax.distributed must come first).
+    from localai_tpu.parallel.distributed import init_distributed
+
+    init_distributed(
+        coordinator=getattr(args, "coordinator", None),
+        num_processes=getattr(args, "num_processes", None),
+        process_id=getattr(args, "process_id", None),
+    )
 
     from localai_tpu.gallery import Gallery, GalleryService
     from localai_tpu.server import ModelManager, Router, create_server
@@ -110,6 +226,19 @@ def main(argv: list[str] | None = None) -> int:
         manager.get(name)
 
     server = create_server(app_cfg, router)
+
+    # Join a federation when asked (worker mode or --federator).
+    federator = getattr(args, "federator", None) or __import__("os").environ.get(
+        "LOCALAI_FEDERATOR"
+    )
+    if federator:
+        import socket
+
+        from localai_tpu.federation.router import register_with_federator
+
+        name = getattr(args, "worker_name", None) or socket.gethostname()
+        my_url = f"http://{app_cfg.address}:{server.server_address[1]}"
+        register_with_federator(federator, name, my_url)
 
     def _stop(signum, frame):
         log.info("shutting down")

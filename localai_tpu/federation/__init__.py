@@ -1,0 +1,7 @@
+"""Federation: one front door over many serving processes."""
+
+from localai_tpu.federation.router import (  # noqa: F401
+    FederatedServer,
+    Worker,
+    WorkerRegistry,
+)

@@ -1,0 +1,291 @@
+"""Federation router: balance requests across serving processes.
+
+TPU redesign of the reference's federated mode (core/p2p/federated_server.go:
+66-99 — a libp2p tunnel picking a worker per connection with random or
+least-used selection; node discovery over a DHT). Here discovery is explicit
+registration over HTTP (TPU pods live on flat DCN networks — no NAT traversal
+to solve), and proxying happens at the HTTP layer so SSE streams pass through
+chunk-by-chunk.
+
+Strategies (reference parity):
+- least-used: fewest in-flight requests (federated_server.go LoadBalanced)
+- random: uniform pick
+- targeted: honor a `LocalAI-Worker` header naming one worker
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+log = logging.getLogger("localai_tpu.federation")
+
+HOP_HEADERS = {
+    "connection", "keep-alive", "transfer-encoding", "te", "upgrade",
+    "proxy-authorization", "proxy-authenticate", "host", "content-length",
+}
+
+
+@dataclass
+class Worker:
+    name: str
+    url: str  # base URL, e.g. http://10.0.0.2:8080
+    in_flight: int = 0
+    total_served: int = 0
+    healthy: bool = True
+    last_seen: float = field(default_factory=time.monotonic)
+
+
+class WorkerRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._workers: dict[str, Worker] = {}
+
+    def add(self, name: str, url: str) -> None:
+        with self._lock:
+            w = self._workers.get(name)
+            if w is not None:
+                w.url = url.rstrip("/")
+                w.healthy = True
+                w.last_seen = time.monotonic()
+            else:
+                self._workers[name] = Worker(name=name, url=url.rstrip("/"))
+
+    def remove(self, name: str) -> bool:
+        with self._lock:
+            return self._workers.pop(name, None) is not None
+
+    def list(self) -> list[Worker]:
+        with self._lock:
+            return list(self._workers.values())
+
+    def pick(self, strategy: str, target: Optional[str] = None) -> Optional[Worker]:
+        with self._lock:
+            if target:
+                w = self._workers.get(target)
+                return w if w is not None and w.healthy else None
+            healthy = [w for w in self._workers.values() if w.healthy]
+            if not healthy:
+                return None
+            if strategy == "random":
+                return random.choice(healthy)
+            # least-used (default — federated_server.go LoadBalanced); ties
+            # break by fewest total served, i.e. round-robin when idle.
+            return min(healthy, key=lambda w: (w.in_flight, w.total_served, w.name))
+
+    def acquire(self, w: Worker) -> None:
+        with self._lock:
+            w.in_flight += 1
+            w.total_served += 1
+
+    def release(self, w: Worker) -> None:
+        with self._lock:
+            w.in_flight = max(0, w.in_flight - 1)
+
+    def mark(self, w: Worker, healthy: bool) -> None:
+        with self._lock:
+            w.healthy = healthy
+            if healthy:
+                w.last_seen = time.monotonic()
+
+
+class FederatedServer:
+    """HTTP front door proxying to registered workers.
+
+    Control endpoints (served locally, never proxied):
+      GET  /federation/workers       — registry snapshot
+      POST /federation/register      — {name, url} joins the pool
+      POST /federation/unregister    — {name} leaves
+    Everything else proxies to a worker chosen by the strategy, or by the
+    `LocalAI-Worker: <name>` request header (targeted mode).
+    """
+
+    def __init__(
+        self,
+        address: str = "127.0.0.1",
+        port: int = 9090,
+        strategy: str = "least-used",
+        workers: Optional[list[tuple[str, str]]] = None,
+        health_interval_s: float = 5.0,
+    ):
+        self.registry = WorkerRegistry()
+        self.strategy = strategy
+        for name, url in workers or []:
+            self.registry.add(name, url)
+        self._health_interval = health_interval_s
+        self._stop = threading.Event()
+        self._server = self._build(address, port)
+        self._health_thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        if self._health_interval > 0:
+            self._health_thread = threading.Thread(target=self._health_loop, daemon=True)
+            self._health_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.shutdown()
+
+    # ------------------------------------------------------------------ #
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self._health_interval):
+            for w in self.registry.list():
+                try:
+                    with urllib.request.urlopen(w.url + "/healthz", timeout=3):
+                        pass
+                    self.registry.mark(w, True)
+                except Exception:  # noqa: BLE001
+                    log.warning("worker %s (%s) unhealthy", w.name, w.url)
+                    self.registry.mark(w, False)
+
+    def _build(self, address: str, port: int) -> ThreadingHTTPServer:
+        fed = self
+
+        class Proxy(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            server_version = "localai-tpu-federation"
+
+            def log_message(self, fmt, *args):
+                log.debug("%s " + fmt, self.address_string(), *args)
+
+            def _json(self, status: int, body: dict) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _control(self) -> bool:
+                if self.path == "/federation/workers" and self.command == "GET":
+                    self._json(200, {"workers": [
+                        {
+                            "name": w.name, "url": w.url, "healthy": w.healthy,
+                            "in_flight": w.in_flight,
+                        }
+                        for w in fed.registry.list()
+                    ], "strategy": fed.strategy})
+                    return True
+                if self.path == "/federation/register" and self.command == "POST":
+                    body = self._read_json()
+                    if not body or "name" not in body or "url" not in body:
+                        self._json(400, {"error": "name and url required"})
+                        return True
+                    fed.registry.add(body["name"], body["url"])
+                    self._json(200, {"status": "registered"})
+                    return True
+                if self.path == "/federation/unregister" and self.command == "POST":
+                    body = self._read_json()
+                    ok = bool(body) and fed.registry.remove(body.get("name", ""))
+                    self._json(200 if ok else 404, {"status": "ok" if ok else "unknown"})
+                    return True
+                return False
+
+            def _read_json(self) -> Optional[dict]:
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    return json.loads(self.rfile.read(n)) if n else None
+                except (ValueError, json.JSONDecodeError):
+                    return None
+
+            def _proxy(self) -> None:
+                target = self.headers.get("LocalAI-Worker")
+                worker = fed.registry.pick(fed.strategy, target)
+                if worker is None:
+                    self._json(503, {"error": {
+                        "message": "no healthy federation worker available",
+                        "type": "server_error",
+                    }})
+                    return
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else None
+                headers = {
+                    k: v for k, v in self.headers.items()
+                    if k.lower() not in HOP_HEADERS and k != "LocalAI-Worker"
+                }
+                req = urllib.request.Request(
+                    worker.url + self.path, data=body, headers=headers,
+                    method=self.command,
+                )
+                fed.registry.acquire(worker)
+                try:
+                    resp = urllib.request.urlopen(req, timeout=600)
+                except urllib.error.HTTPError as e:
+                    resp = e  # proxy error bodies through unchanged
+                except Exception as e:  # noqa: BLE001
+                    fed.registry.mark(worker, False)
+                    self._json(502, {"error": {
+                        "message": f"worker {worker.name} failed: {e}",
+                        "type": "server_error",
+                    }})
+                    fed.registry.release(worker)
+                    return
+                try:
+                    self.send_response(resp.status)
+                    is_stream = False
+                    for k, v in resp.headers.items():
+                        if k.lower() in HOP_HEADERS:
+                            continue
+                        if k.lower() == "content-type" and "event-stream" in v:
+                            is_stream = True
+                        self.send_header(k, v)
+                    self.send_header("LocalAI-Served-By", worker.name)
+                    if is_stream:
+                        # Chunked pass-through so tokens stream live.
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                        while True:
+                            chunk = resp.read1(65536) if hasattr(resp, "read1") else resp.read(4096)
+                            if not chunk:
+                                break
+                            self.wfile.write(f"{len(chunk):X}\r\n".encode() + chunk + b"\r\n")
+                            self.wfile.flush()
+                        self.wfile.write(b"0\r\n\r\n")
+                    else:
+                        data = resp.read()
+                        self.send_header("Content-Length", str(len(data)))
+                        self.end_headers()
+                        if self.command != "HEAD":
+                            self.wfile.write(data)
+                except (BrokenPipeError, ConnectionResetError):
+                    log.debug("federation client disconnected")
+                finally:
+                    resp.close()
+                    fed.registry.release(worker)
+
+            def _handle(self) -> None:
+                if not self._control():
+                    self._proxy()
+
+            do_GET = do_POST = do_DELETE = do_PUT = do_HEAD = _handle
+
+        return ThreadingHTTPServer((address, port), Proxy)
+
+
+def register_with_federator(federator_url: str, name: str, my_url: str) -> bool:
+    """Worker-side join (reference: p2p node announcing on the DHT)."""
+    try:
+        req = urllib.request.Request(
+            federator_url.rstrip("/") + "/federation/register",
+            data=json.dumps({"name": name, "url": my_url}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10):
+            return True
+    except Exception:  # noqa: BLE001
+        log.warning("could not register with federator %s", federator_url)
+        return False

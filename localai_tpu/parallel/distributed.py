@@ -1,0 +1,63 @@
+"""Multi-host bootstrap: jax.distributed over DCN.
+
+The reference scales out with llama.cpp RPC workers over TCP and a libp2p
+DHT for discovery (core/p2p/p2p.go). The TPU equivalent is structurally
+different and simpler: within a slice, chips already share ICI and XLA
+compiles the collectives; across hosts/slices, `jax.distributed.initialize`
+wires the processes into one global mesh over DCN, and the HTTP-level
+federation router (localai_tpu.federation) spreads requests across
+independent serving processes.
+
+Env contract (mirrors the reference's worker env flags, core/cli/worker):
+  LOCALAI_COORDINATOR     host:port of process 0
+  LOCALAI_NUM_PROCESSES   total process count
+  LOCALAI_PROCESS_ID      this process's rank
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger("localai_tpu.distributed")
+
+
+def init_distributed(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize jax.distributed from args or LOCALAI_* env; returns True
+    when a multi-process runtime was started, False for single-process runs.
+
+    After this returns True, `jax.devices()` spans every host and a Mesh
+    built from it shards programs across the whole pod (dp/tp/... axes ride
+    ICI within a slice and DCN across slices).
+    """
+    coordinator = coordinator or os.environ.get("LOCALAI_COORDINATOR")
+    if not coordinator:
+        return False
+    num_processes = int(
+        num_processes
+        if num_processes is not None
+        else os.environ.get("LOCALAI_NUM_PROCESSES", "1")
+    )
+    process_id = int(
+        process_id if process_id is not None else os.environ.get("LOCALAI_PROCESS_ID", "0")
+    )
+    if num_processes <= 1:
+        return False
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    log.info(
+        "jax.distributed up: process %d/%d via %s — %d global devices",
+        process_id, num_processes, coordinator, len(jax.devices()),
+    )
+    return True

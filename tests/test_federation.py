@@ -1,0 +1,146 @@
+"""Federation tests: two real serving processes behind one router port —
+balancing, targeted routing, dynamic registration, failover, SSE pass-through.
+
+Reference tier: core/p2p federated_server.go semantics (least-used/random
+worker pick) — tested there only implicitly; here end-to-end over HTTP.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+import yaml
+
+from localai_tpu.config import ApplicationConfig
+from localai_tpu.federation import FederatedServer
+from localai_tpu.federation.router import register_with_federator
+from localai_tpu.server import ModelManager, Router, create_server
+from localai_tpu.server.openai_api import OpenAIApi
+
+
+def _mk_worker(tmp_path, name):
+    d = tmp_path / f"models-{name}"
+    d.mkdir()
+    (d / "m.yaml").write_text(yaml.safe_dump({
+        "name": "m", "model": "tiny", "context_size": 64,
+        "max_slots": 2, "max_tokens": 8,
+    }))
+    app_cfg = ApplicationConfig(address="127.0.0.1", port=0, models_dir=str(d))
+    manager = ModelManager(app_cfg)
+    router = Router()
+    OpenAIApi(manager).register(router)
+    server = create_server(app_cfg, router)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, manager, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+@pytest.fixture(scope="module")
+def federation(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("fed")
+    s1, m1, url1 = _mk_worker(tmp, "w1")
+    s2, m2, url2 = _mk_worker(tmp, "w2")
+    fed = FederatedServer(
+        address="127.0.0.1", port=0, strategy="least-used",
+        workers=[("w1", url1), ("w2", url2)], health_interval_s=0,
+    )
+    fed.start()
+    yield fed, f"http://127.0.0.1:{fed.port}", (url1, url2)
+    fed.stop()
+    s1.shutdown()
+    s2.shutdown()
+    m1.shutdown()
+    m2.shutdown()
+
+
+def _post(base, path, payload, headers=None):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return json.loads(r.read()), dict(r.headers)
+
+
+def test_proxy_and_balance(federation):
+    fed, base, _ = federation
+    served_by = set()
+    for _ in range(6):
+        out, headers = _post(base, "/v1/chat/completions", {
+            "model": "m", "messages": [{"role": "user", "content": "x"}],
+            "max_tokens": 2,
+        })
+        assert out["object"] == "chat.completion"
+        served_by.add(headers["LocalAI-Served-By"])
+    # least-used over idle workers alternates; both must have served
+    assert served_by == {"w1", "w2"}
+
+
+def test_targeted_routing(federation):
+    fed, base, _ = federation
+    for _ in range(3):
+        _out, headers = _post(
+            base, "/v1/chat/completions",
+            {"model": "m", "messages": [{"role": "user", "content": "x"}], "max_tokens": 2},
+            headers={"LocalAI-Worker": "w2"},
+        )
+        assert headers["LocalAI-Served-By"] == "w2"
+
+
+def test_workers_listing_and_dynamic_registration(federation):
+    fed, base, (url1, _) = federation
+    with urllib.request.urlopen(base + "/federation/workers", timeout=10) as r:
+        out = json.loads(r.read())
+    assert {w["name"] for w in out["workers"]} >= {"w1", "w2"}
+    assert out["strategy"] == "least-used"
+
+    assert register_with_federator(base, "w3", url1)
+    with urllib.request.urlopen(base + "/federation/workers", timeout=10) as r:
+        out = json.loads(r.read())
+    assert "w3" in {w["name"] for w in out["workers"]}
+    fed.registry.remove("w3")
+
+
+def test_failover_to_healthy_worker(federation):
+    fed, base, _ = federation
+    w1 = next(w for w in fed.registry.list() if w.name == "w1")
+    fed.registry.mark(w1, False)
+    try:
+        for _ in range(3):
+            _out, headers = _post(base, "/v1/chat/completions", {
+                "model": "m", "messages": [{"role": "user", "content": "x"}],
+                "max_tokens": 2,
+            })
+            assert headers["LocalAI-Served-By"] == "w2"
+        # targeted at an unhealthy worker → 503
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base, "/v1/chat/completions",
+                  {"model": "m", "messages": [{"role": "user", "content": "x"}]},
+                  headers={"LocalAI-Worker": "w1"})
+        assert e.value.code == 503
+    finally:
+        fed.registry.mark(w1, True)
+
+
+def test_sse_streams_through_federation(federation):
+    fed, base, _ = federation
+    req = urllib.request.Request(
+        base + "/v1/chat/completions",
+        data=json.dumps({
+            "model": "m", "stream": True, "max_tokens": 4,
+            "messages": [{"role": "user", "content": "hi"}],
+        }).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    frames = []
+    with urllib.request.urlopen(req, timeout=300) as r:
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        for line in r:
+            line = line.decode().strip()
+            if line.startswith("data: "):
+                frames.append(line[6:])
+    assert frames[-1] == "[DONE]"
+    chunks = [json.loads(f) for f in frames[:-1]]
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
