@@ -96,6 +96,11 @@ class ModelConfig:
     max_slots: int = 8
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
 
+    # Speculative decoding (reference: draft_model/n_draft,
+    # core/config/model_config.go:211-212).
+    draft_model: str = ""  # arch preset or checkpoint dir; empty = off
+    n_draft: int = 5
+
     # Capabilities.
     embeddings: bool = False
     template: TemplateConfig = dataclasses.field(default_factory=TemplateConfig)

@@ -245,6 +245,9 @@ class Engine:
         mesh_plan: Optional[MeshPlan] = None,
         engine_cfg: Optional[EngineConfig] = None,
         devices: Optional[Sequence[jax.Device]] = None,
+        draft_cfg: Optional[ArchConfig] = None,
+        draft_params: Any = None,
+        n_draft: int = 5,
     ) -> None:
         _enable_compile_cache()
         self.cfg = cfg
@@ -253,6 +256,15 @@ class Engine:
         self.plan = mesh_plan or MeshPlan(dp=1, tp=1)
         validate_plan(cfg, self.plan.tp, self.plan.ep)
         self.mesh = build_mesh(self.plan, devices)
+        # Speculative decoding (reference: draft_model/n_draft,
+        # model_config.go:211-212 passed into llama.cpp's batch decode).
+        self.draft_cfg = draft_cfg
+        self.n_draft = max(1, int(n_draft))
+        if draft_cfg is not None and draft_cfg.vocab_size != cfg.vocab_size:
+            raise ValueError(
+                f"draft model vocab ({draft_cfg.vocab_size}) must match the "
+                f"target vocab ({cfg.vocab_size})"
+            )
 
         B, S, V = self.ecfg.max_slots, self.ecfg.max_seq, cfg.vocab_size
         with self.mesh:
@@ -271,6 +283,28 @@ class Engine:
                     vshard,
                 ),
             )
+        self.draft_params = None
+        self.d_cache = None
+        if draft_cfg is not None:
+            validate_plan(draft_cfg, self.plan.tp, self.plan.ep)
+            with self.mesh:
+                dshard = param_shardings(draft_cfg, self.mesh)
+                self.draft_params = jax.tree.map(
+                    lambda a, s: jax.device_put(a, s), draft_params, dshard
+                )
+                dk, dv = cache_shardings(self.mesh)
+                dc_shape = (
+                    draft_cfg.num_layers, B, S, draft_cfg.num_kv_heads,
+                    draft_cfg.head_dim_,
+                )
+                self.d_cache = llama.KVCache(
+                    k=jax.device_put(jnp.zeros(dc_shape, jnp.dtype(draft_cfg.dtype)), dk),
+                    v=jax.device_put(jnp.zeros(dc_shape, jnp.dtype(draft_cfg.dtype)), dv),
+                )
+        # Metrics for speculative acceptance (tokens accepted / window).
+        self.m_spec_rounds = 0
+        self.m_spec_accepted = 0
+
         # Device-resident per-slot state.
         self.counts = jnp.zeros((B, V), jnp.int32)
         self.rngs = jax.random.split(jax.random.key(self.ecfg.base_seed), B)
@@ -485,8 +519,110 @@ class Engine:
                 d_positions = d_positions.at[s].set(lens[j])
             return cache, counts, rngs, bias, d_tokens, d_positions, toks, tk, lp
 
-        fn = jax.jit(admit, donate_argnums=(1, 2, 3, 4, 5, 6))
+        if self.draft_cfg is None:
+            fn = jax.jit(admit, donate_argnums=(1, 2, 3, 4, 5, 6))
+        else:
+            dcfg = self.draft_cfg
+
+            def admit_spec(params, cache, counts, rngs, bias, d_tokens,
+                           d_positions, dparams, dcache, prompt_toks, aux,
+                           samp_pack, bias_rows):
+                out = admit(params, cache, counts, rngs, bias, d_tokens,
+                            d_positions, prompt_toks, aux, samp_pack, bias_rows)
+                # Prefill the draft model too so its KV cache matches the
+                # prompt before the first speculative round.
+                _, dks, dvs = llama.prefill(dcfg, dparams, prompt_toks, aux[0])
+                for j in range(m):
+                    dcache = llama.write_prefill_to_cache(
+                        dcache, dks[:, j:j + 1], dvs[:, j:j + 1], aux[1][j]
+                    )
+                return out + (dcache,)
+
+            fn = jax.jit(admit_spec, donate_argnums=(1, 2, 3, 4, 5, 6, 8))
         self._admit_cache[key] = fn
+        return fn
+
+    def _get_spec_block(self):
+        """Speculative greedy block: n_draft draft-model steps propose a
+        token window, one target decode_chunk verifies all of them, and an
+        accept-scan (with penalties/bias, matching the plain greedy block's
+        sampling exactly) emits the longest agreeing prefix plus the target's
+        own next token. Generates 1..n_draft+1 tokens per dispatch.
+
+        Device-state contract matches the normal blocks: everything stays
+        resident; only the token window [B, k+1] and accepted counts [B]
+        come back to the host.
+        """
+        fn = self._block_cache.get(("spec",))
+        if fn is not None:
+            return fn
+        cfg, dcfg = self.cfg, self.draft_cfg
+        B, S, V = self.ecfg.max_slots, self.ecfg.max_seq, self.cfg.vocab_size
+        k = self.n_draft
+        from localai_tpu.ops.sampling import apply_penalties
+
+        def spec(params, dparams, cache, dcache, counts, bias, tokens, positions, pack):
+            active = pack[0] > 0
+            act_i32 = active.astype(jnp.int32)
+            samp = SamplingParams(
+                temperature=pack[1], top_k=pack[2].astype(jnp.int32),
+                top_p=pack[3], min_p=pack[4], repeat_penalty=pack[5],
+                presence_penalty=pack[6], frequency_penalty=pack[7],
+            )
+
+            # 1. Draft proposes k tokens greedily. k+1 steps run so the LAST
+            # proposal's kv is also in the draft cache — on a fully-accepted
+            # window the next round continues from position pos+k+1, which
+            # must see d_k's kv row (the extra step's own proposal is
+            # discarded).
+            def dstep(carry, i):
+                cur, dcache = carry
+                pos_i = jnp.minimum(positions + i, S - 1)
+                logits, dcache = llama.decode_step(dcfg, dparams, cur, pos_i, dcache)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (nxt, dcache), nxt
+
+            (_, dcache), drafts = jax.lax.scan(
+                dstep, (tokens, dcache), jnp.arange(k + 1)
+            )
+            drafts = drafts[:k]  # [k, B]
+
+            # 2. Target verifies the whole window in one chunked decode.
+            chunk = jnp.concatenate([tokens[:, None], drafts.T], axis=1)  # [B, k+1]
+            pos_chunk = jnp.minimum(positions[:, None] + jnp.arange(k + 1)[None, :], S - 1)
+            logits_all, cache = llama.decode_chunk(cfg, params, chunk, pos_chunk, cache)
+
+            # 3. Accept-scan: greedy with penalties, counts updated token by
+            # token so repeat/presence/frequency semantics match the plain
+            # greedy block exactly.
+            def vstep(carry, t):
+                counts, still, cur_tok = carry
+                lt = jax.lax.dynamic_index_in_dim(
+                    logits_all, t, axis=1, keepdims=False
+                ).astype(jnp.float32)  # [B, V]
+                lt = apply_penalties(lt, counts, samp) + bias
+                g = jnp.argmax(lt, axis=-1).astype(jnp.int32)
+                emit = still & active
+                counts = counts.at[jnp.arange(B), g].add(emit.astype(jnp.int32) * act_i32)
+                cur_tok = jnp.where(emit, g, cur_tok)
+                nxt_draft = jax.lax.dynamic_index_in_dim(
+                    chunk, jnp.minimum(t + 1, k), axis=1, keepdims=False
+                )
+                still = still & (t < k) & (g == nxt_draft)
+                return (counts, still, cur_tok), jnp.where(emit, g, -1)
+
+            (counts, _, cur_tok), toks_out = jax.lax.scan(
+                vstep,
+                (counts, jnp.ones((B,), bool), tokens),
+                jnp.arange(k + 1),
+            )  # toks_out [k+1, B], -1 where not emitted
+            acc = jnp.sum((toks_out >= 0).astype(jnp.int32), axis=0)  # [B]
+            new_tokens = jnp.where(active, cur_tok, tokens)
+            new_positions = jnp.minimum(positions + acc, S - 1)
+            return cache, dcache, counts, new_tokens, new_positions, toks_out, acc
+
+        fn = jax.jit(spec, donate_argnums=(2, 3, 4, 6, 7))
+        self._block_cache[("spec",)] = fn
         return fn
 
     # ------------------------------------------------------------------ #
@@ -581,13 +717,21 @@ class Engine:
 
     def metrics(self) -> dict[str, float]:
         tps = self._decode_tokens / self._decode_time if self._decode_time > 0 else 0.0
-        return {
+        out = {
             "prompt_tokens_processed": float(self.m_prompt_tokens),
             "tokens_generated": float(self.m_generated_tokens),
             "tokens_per_second": tps,
             "active_slots": float(int(self.h_active.sum())),
             "queue_depth": float(len(self._pending)),
         }
+        if self.draft_cfg is not None:
+            out["spec_rounds"] = float(self.m_spec_rounds)
+            out["spec_tokens_accepted"] = float(self.m_spec_accepted)
+            out["spec_accept_rate"] = (
+                self.m_spec_accepted / (self.m_spec_rounds * (self.n_draft + 1))
+                if self.m_spec_rounds else 0.0
+            )
+        return out
 
     def warmup(self, prompt_len: int = 8, grammar: bool = False, logprobs: bool = False) -> None:
         """Compile AND execute the serving programs before traffic arrives.
@@ -676,15 +820,27 @@ class Engine:
         samp_pack = np.zeros((7, m), np.float32)
         samp_pack[2] = 1.0  # top_p
         samp_pack[4] = 1.0  # repeat_penalty
-        (
-            self.cache, self.counts, self.rngs, self.bias,
-            self.d_tokens, self.d_positions, toks, _tk, _lp,
-        ) = fn(
-            self.params, self.cache, self.counts, self.rngs, self.bias,
-            self.d_tokens, self.d_positions,
+        args = (
             jnp.zeros((m, bucket), jnp.int32), jnp.asarray(aux), jnp.asarray(samp_pack),
             jnp.zeros((m, self.cfg.vocab_size), jnp.float32),
         )
+        if self.draft_cfg is None:
+            (
+                self.cache, self.counts, self.rngs, self.bias,
+                self.d_tokens, self.d_positions, toks, _tk, _lp,
+            ) = fn(
+                self.params, self.cache, self.counts, self.rngs, self.bias,
+                self.d_tokens, self.d_positions, *args,
+            )
+        else:
+            (
+                self.cache, self.counts, self.rngs, self.bias,
+                self.d_tokens, self.d_positions, toks, _tk, _lp, self.d_cache,
+            ) = fn(
+                self.params, self.cache, self.counts, self.rngs, self.bias,
+                self.d_tokens, self.d_positions, self.draft_params, self.d_cache,
+                *args,
+            )
         jax.block_until_ready(toks)
 
     # ------------------------------------------------------------------ #
@@ -859,13 +1015,23 @@ class Engine:
             jnp.asarray(bias_rows) if has_bias else jnp.zeros((m, V), jnp.float32),
         )
         t_c = time.monotonic()
-        (
-            self.cache, self.counts, self.rngs, self.bias,
-            self.d_tokens, self.d_positions, toks, tk, lp,
-        ) = fn(
-            self.params, self.cache, self.counts, self.rngs, self.bias,
-            self.d_tokens, self.d_positions, *args_in,
-        )
+        if self.draft_cfg is None:
+            (
+                self.cache, self.counts, self.rngs, self.bias,
+                self.d_tokens, self.d_positions, toks, tk, lp,
+            ) = fn(
+                self.params, self.cache, self.counts, self.rngs, self.bias,
+                self.d_tokens, self.d_positions, *args_in,
+            )
+        else:
+            (
+                self.cache, self.counts, self.rngs, self.bias,
+                self.d_tokens, self.d_positions, toks, tk, lp, self.d_cache,
+            ) = fn(
+                self.params, self.cache, self.counts, self.rngs, self.bias,
+                self.d_tokens, self.d_positions, self.draft_params, self.d_cache,
+                *args_in,
+            )
         t_d = time.monotonic()
         _host_copy_async(toks)
         if trace:
@@ -935,6 +1101,15 @@ class Engine:
             n = self._pick_block_size()
 
         with_lp = self._lp_active()
+        if (
+            self.draft_cfg is not None
+            and not grammar
+            and variant == "greedy"
+            and not with_lp
+            and not self.h_override_mask.any()
+        ):
+            self._dispatch_spec_block()
+            return
         active_snapshot = self.h_active.copy()
         pack = np.zeros((10, B), np.float32)
         pack[0] = active_snapshot
@@ -964,6 +1139,37 @@ class Engine:
             )
         )
 
+    def _dispatch_spec_block(self) -> None:
+        """One speculative round: draft k + verify. Emits 1..k+1 tokens per
+        active slot (kind="spec"; tk carries accepted counts)."""
+        B = self.ecfg.max_slots
+        active_snapshot = self.h_active.copy()
+        pack = np.zeros((10, B), np.float32)
+        pack[0] = active_snapshot
+        for fi, k in enumerate(_SAMPLING_FIELDS):
+            pack[1 + fi] = self.h_sampling[k]
+        fn = self._get_spec_block()
+        (
+            self.cache, self.d_cache, self.counts, self.d_tokens,
+            self.d_positions, toks_out, acc,
+        ) = fn(
+            self.params, self.draft_params, self.cache, self.d_cache,
+            self.counts, self.bias, self.d_tokens, self.d_positions,
+            jnp.asarray(pack),
+        )
+        _host_copy_async(toks_out)
+        _host_copy_async(acc)
+        for i in range(B):
+            if active_snapshot[i] and self.slots[i] is not None:
+                self.slots[i].scheduled += 1  # ≥1 token guaranteed per round
+        self._inflight.append(
+            _Entry(
+                kind="spec", toks=toks_out, tk=acc,
+                gen=list(self._slot_gen), active=active_snapshot,
+                n=self.n_draft + 1,
+            )
+        )
+
     # ------------------------------------------------------------------ #
     # Result processing (host bookkeeping)
     # ------------------------------------------------------------------ #
@@ -974,6 +1180,29 @@ class Engine:
         lp = (
             tuple(np.asarray(a) for a in e.lp) if e.lp is not None else None
         )  # (tok_lp, lp_ids, lp_vals)
+        if e.kind == "spec":
+            # toks [k+1, B] with -1 marking not-emitted; tk holds accepted
+            # counts per slot. Only slots that actually emit count toward the
+            # acceptance-rate denominator (pipelined overshoot rounds after a
+            # request finished would otherwise dilute it).
+            consumed = 0
+            emitting_slots = set()
+            for step in range(e.n):
+                for i in range(self.ecfg.max_slots):
+                    if not e.active[i] or self._slot_gen[i] != e.gen[i]:
+                        continue
+                    if self.slots[i] is None:
+                        continue
+                    tok = int(toks[step, i])
+                    if tok < 0:
+                        continue
+                    consumed += 1
+                    emitting_slots.add(i)
+                    self._post_token(i, tok)
+            self.m_spec_rounds += len(emitting_slots)
+            self.m_spec_accepted += consumed
+            self._decode_tokens += consumed
+            return
         if e.kind == "admit":
             for j, (slot_idx, request, handle, plen, _t0) in enumerate(e.items):
                 if self._slot_gen[slot_idx] != e.gen[slot_idx]:

@@ -286,6 +286,55 @@ def decode_step(
     return logits, KVCache(k=ks, v=vs)
 
 
+def decode_chunk(
+    cfg: ArchConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [B, T] — T new tokens per slot (draft window)
+    positions: jnp.ndarray,  # [B, T] int32 — their positions (contiguous per slot)
+    cache: KVCache,
+):
+    """Multi-token decode: write T new k/v per slot and return logits for all
+    T positions — the verify pass of speculative decoding (the reference
+    passes draft tokens to llama.cpp's batch decode; model_config.go:211
+    draft_model). Token t attends to the whole cache plus in-window tokens at
+    earlier positions; returns (logits [B, T, V] f32, new_cache)."""
+    B, T = tokens.shape
+    inv_freq = rope_frequencies(cfg)
+    h = params["embed"][tokens]  # [B, T, D]
+    batch_idx = jnp.arange(B)[:, None].repeat(T, axis=1)  # [B, T]
+    S = cache.k.shape[2]
+
+    def layer(h, xs):
+        lp, kc, vc = xs
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _attn_proj_qkv(cfg, lp, x)  # q [B,T,H,Hd], k/v [B,T,K,Hd]
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        kc = kc.at[batch_idx, positions].set(k.astype(kc.dtype))
+        vc = vc.at[batch_idx, positions].set(v.astype(vc.dtype))
+        # Mask: key slot s visible to query t iff s <= positions[b, t]
+        # (cache rows beyond a slot's window hold stale bytes — never newer
+        # positions — so position masking alone is sufficient).
+        valid = jnp.arange(S)[None, None, :] <= positions[:, :, None]  # [B, T, S]
+        K_h = kc.shape[2]
+        G = q.shape[2] // K_h
+        qf = (q.astype(jnp.float32) * (cfg.head_dim_**-0.5)).reshape(B, T, K_h, G, cfg.head_dim_)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qf, kc.astype(jnp.float32))
+        scores = jnp.where(valid[:, None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bkgts,bskd->btkgd", probs, vc.astype(jnp.float32))
+        attn = attn.reshape(B, T, -1).astype(h.dtype)
+        h = h + attn @ lp["wo"]
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
+        h = h + _mlp(cfg, lp, x)
+        return h, (kc, vc)
+
+    h, (ks, vs) = jax.lax.scan(layer, h, (params["layers"], cache.k, cache.v))
+    h = rms_norm(h, params["final_norm"], cfg.rms_eps)
+    logits = _unembed(cfg, params, h)  # [B, T, V]
+    return logits, KVCache(k=ks, v=vs)
+
+
 def write_prefill_to_cache(
     cache: KVCache,
     ks: jnp.ndarray,  # [L, B_new, S, K, Hd] from prefill

@@ -335,12 +335,33 @@ class ModelManager:
         else:
             params = jax.jit(lambda k: init_params(arch, k))(jax.random.key(0))
 
+        draft_arch = None
+        draft_params = None
+        if cfg.draft_model:
+            if cfg.draft_model in PRESETS:
+                draft_arch = get_arch(cfg.draft_model)
+                draft_params = jax.jit(lambda k: init_params(draft_arch, k))(
+                    jax.random.key(1)
+                )
+            else:
+                from localai_tpu.engine.weights import (
+                    arch_from_hf_config,
+                    load_hf_checkpoint,
+                )
+
+                dd = self._resolve_ckpt_dir(cfg.draft_model)
+                draft_arch = arch_from_hf_config(dd)
+                draft_params = load_hf_checkpoint(draft_arch, dd)
+
         engine = Engine(
             arch,
             params,
             tokenizer,
             mesh_plan=plan,
             engine_cfg=EngineConfig(max_slots=cfg.max_slots, max_seq=cfg.context_size),
+            draft_cfg=draft_arch,
+            draft_params=draft_params,
+            n_draft=cfg.n_draft,
         )
         engine.start()
         evaluator = Evaluator(cfg, tokenizer)
