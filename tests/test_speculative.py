@@ -58,18 +58,19 @@ def test_spec_matches_plain_greedy(setup):
 
 
 def test_spec_self_draft_accepts_nearly_everything(setup):
-    """Draft == target → windows accept (near-)fully. Not exactly 1.0: the
-    draft path (decode_step) and verify path (decode_chunk) reduce in
-    different orders, so a near-tie argmax can flip on random-init weights;
-    acceptance is a throughput property, exactness is covered separately."""
+    """Draft == target → windows accept (near-)fully: 12 tokens = 1 from
+    admission + 11 speculative over 3 windows of 4 → rate 11/12. A near-tie
+    argmax can flip between the draft path (decode_step) and verify path
+    (decode_chunk) on random-init weights, so assert a floor, not equality;
+    exactness vs plain greedy is covered separately."""
     cfg, params, _, _ = setup
     spec = _mk(cfg, params, draft_cfg=cfg, draft_params=params, n_draft=3)
     try:
         _text, ev = spec.generate([65, 66], max_new_tokens=12, ignore_eos=True)
         assert ev.completion_tokens == 12
         m = spec.metrics()
-        assert m["spec_accept_rate"] >= 0.7
-        assert m["spec_tokens_accepted"] == 12
+        assert m["spec_tokens_accepted"] == 11
+        assert m["spec_accept_rate"] >= 0.85  # 11/12 when nothing flips
     finally:
         spec.stop()
 
