@@ -202,6 +202,7 @@ def main(argv: list[str] | None = None) -> int:
     from localai_tpu.server.audio_api import AudioApi
     from localai_tpu.server.gallery_api import GalleryApi
     from localai_tpu.server.image_api import ImageApi
+    from localai_tpu.server.realtime_api import RealtimeApi
     from localai_tpu.server.rerank_api import RerankApi
     from localai_tpu.server.openai_api import OpenAIApi
     from localai_tpu.server.stores_api import StoresApi
@@ -213,6 +214,7 @@ def main(argv: list[str] | None = None) -> int:
     AudioApi(manager, oai).register(router)
     ImageApi(manager, oai, app_cfg.generated_content_dir).register(router)
     RerankApi(manager, oai).register(router)
+    RealtimeApi(manager, oai).register(router)
     StoresApi().register(router)
     gallery_service = GalleryService(
         app_cfg.models_dir,

@@ -271,6 +271,28 @@ def create_server(app_cfg: ApplicationConfig, router: Router) -> ThreadingHTTPSe
                 except (BrokenPipeError, ConnectionResetError):
                     pass
 
+        def _upgrade_websocket(self, upgrade) -> None:
+            from localai_tpu.server.ws import WebSocket, accept_key
+
+            key = self.headers.get("Sec-WebSocket-Key")
+            if (self.headers.get("Upgrade", "").lower() != "websocket") or not key:
+                self._deny(400, "expected a WebSocket upgrade request")
+                return
+            self.send_response(101, "Switching Protocols")
+            self.send_header("Upgrade", "websocket")
+            self.send_header("Connection", "Upgrade")
+            self.send_header("Sec-WebSocket-Accept", accept_key(key))
+            self.end_headers()
+            self.wfile.flush()
+            ws = WebSocket(self.rfile, self.wfile)
+            try:
+                upgrade.session(ws)
+            except (BrokenPipeError, ConnectionResetError, ConnectionError):
+                log.debug("websocket client disconnected")
+            finally:
+                ws.close()
+                self.close_connection = True
+
         def _handle(self) -> None:
             start = time.monotonic()
             parsed = urlparse(self.path)
@@ -323,6 +345,11 @@ def create_server(app_cfg: ApplicationConfig, router: Router) -> ThreadingHTTPSe
             )
             try:
                 result = handler(req)
+                from localai_tpu.server.ws import WebSocketUpgrade
+
+                if isinstance(result, WebSocketUpgrade):
+                    self._upgrade_websocket(result)
+                    return
             except ApiError as e:
                 self._respond(e.to_response())
                 return

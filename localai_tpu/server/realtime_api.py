@@ -1,0 +1,245 @@
+"""OpenAI-realtime-compatible voice WebSocket.
+
+Reference: core/http/endpoints/openai/realtime.go (1,301 LoC; session event
+loop over a websocket: audio in → transcription → LLM → TTS audio out) and
+its types file. This is the same protocol subset on the TPU stack: whisper
+for STT, the llama engine for the turn, the TTS engine for audio out —
+each resolved through the same ModelManager usecases as the REST routes.
+
+Supported client events: session.update, input_audio_buffer.append /
+commit / clear, conversation.item.create, response.create, response.cancel.
+Server events mirror OpenAI's: session.created/updated, committed,
+item.created, response.created, response.audio_transcript.delta,
+response.text.delta, response.audio.delta, response.done, error.
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+import uuid
+from typing import Any, Optional
+
+import numpy as np
+
+from localai_tpu.config import Usecase
+from localai_tpu.server.app import Request, Router
+from localai_tpu.server.manager import ModelManager
+from localai_tpu.server.openai_api import OpenAIApi
+from localai_tpu.server.ws import WebSocket, WebSocketUpgrade
+
+log = logging.getLogger("localai_tpu.realtime")
+
+
+def _rid(prefix: str) -> str:
+    return f"{prefix}_{uuid.uuid4().hex[:20]}"
+
+
+class RealtimeSession:
+    def __init__(self, api: "RealtimeApi", query_model: Optional[str]):
+        self.api = api
+        self.config: dict[str, Any] = {
+            "id": _rid("sess"),
+            "model": query_model or "",
+            "modalities": ["text", "audio"],
+            "instructions": "",
+            "voice": "",
+            "input_audio_format": "pcm16",
+            "output_audio_format": "pcm16",
+            "input_sample_rate": 24_000,
+            "output_sample_rate": 24_000,
+            "temperature": 0.7,
+            "max_response_output_tokens": 512,
+        }
+        self.conversation: list[dict[str, str]] = []
+        self.audio_buffer = bytearray()
+
+    # ------------------------------------------------------------------ #
+
+    def run(self, ws: WebSocket) -> None:
+        ws.send_json({"type": "session.created", "session": self.config})
+        while True:
+            ev = ws.recv_json()
+            if ev is None:
+                return
+            try:
+                self.handle(ws, ev)
+            except Exception as e:  # noqa: BLE001 — error event, keep session
+                log.exception("realtime event failed")
+                ws.send_json({"type": "error", "error": {
+                    "type": "server_error", "message": f"{type(e).__name__}: {e}",
+                }})
+
+    def handle(self, ws: WebSocket, ev: dict) -> None:
+        kind = ev.get("type")
+        if kind == "session.update":
+            patch = ev.get("session") or {}
+            for k, v in patch.items():
+                if k in self.config and k != "id":
+                    self.config[k] = v
+            ws.send_json({"type": "session.updated", "session": self.config})
+        elif kind == "input_audio_buffer.append":
+            self.audio_buffer.extend(base64.b64decode(ev.get("audio") or ""))
+        elif kind == "input_audio_buffer.clear":
+            self.audio_buffer.clear()
+            ws.send_json({"type": "input_audio_buffer.cleared"})
+        elif kind == "input_audio_buffer.commit":
+            self._commit_audio(ws)
+        elif kind == "conversation.item.create":
+            item = ev.get("item") or {}
+            text = " ".join(
+                c.get("text", "") for c in item.get("content") or []
+                if c.get("type") in ("input_text", "text")
+            ).strip()
+            role = item.get("role", "user")
+            if text:
+                self.conversation.append({"role": role, "content": text})
+            ws.send_json({"type": "conversation.item.created", "item": {
+                "id": item.get("id") or _rid("item"), "type": "message",
+                "role": role,
+                "content": [{"type": "input_text", "text": text}],
+            }})
+        elif kind == "response.create":
+            self._respond(ws, ev.get("response") or {})
+        elif kind == "response.cancel":
+            ws.send_json({"type": "response.cancelled"})
+        else:
+            ws.send_json({"type": "error", "error": {
+                "type": "invalid_request_error",
+                "message": f"unknown event type {kind!r}",
+            }})
+
+    # ------------------------------------------------------------------ #
+
+    def _commit_audio(self, ws: WebSocket) -> None:
+        from localai_tpu.audio import resample
+
+        item_id = _rid("item")
+        if not self.audio_buffer:
+            ws.send_json({"type": "error", "error": {
+                "type": "invalid_request_error",
+                "message": "input audio buffer is empty",
+            }})
+            return
+        pcm = np.frombuffer(bytes(self.audio_buffer), np.int16).astype(np.float32) / 32768.0
+        self.audio_buffer.clear()
+        sr = int(self.config["input_sample_rate"])
+        audio16 = resample(pcm, sr, 16_000)
+
+        lm, lease = self.api._lease(Usecase.TRANSCRIPT, self.config.get("transcription_model"))
+        try:
+            result = lm.engine.transcribe(audio16)
+        finally:
+            lease.release()
+        text = result["text"]
+        self.conversation.append({"role": "user", "content": text})
+        ws.send_json({"type": "input_audio_buffer.committed", "item_id": item_id})
+        ws.send_json({"type": "conversation.item.created", "item": {
+            "id": item_id, "type": "message", "role": "user",
+            "content": [{"type": "input_audio", "transcript": text}],
+        }})
+
+    def _respond(self, ws: WebSocket, overrides: dict) -> None:
+        from localai_tpu.engine import GenRequest
+
+        resp_id = _rid("resp")
+        modalities = overrides.get("modalities") or self.config["modalities"]
+        instructions = overrides.get("instructions") or self.config["instructions"]
+        ws.send_json({"type": "response.created", "response": {"id": resp_id}})
+
+        messages = []
+        if instructions:
+            messages.append({"role": "system", "content": instructions})
+        messages.extend(self.conversation)
+        if not messages:
+            messages = [{"role": "user", "content": ""}]
+
+        lm, lease = self.api._lease(Usecase.CHAT, self.config.get("model") or None)
+        try:
+            prompt = lm.evaluator.template_messages(messages)
+            ids = lm.engine.tokenizer.encode(
+                prompt, add_bos=not lm.cfg.template.use_tokenizer_template
+            )
+            gen = GenRequest(
+                prompt_ids=ids,
+                max_new_tokens=int(self.config["max_response_output_tokens"]),
+                temperature=float(self.config["temperature"]),
+                stop=lm.evaluator.stop_sequences(),
+            )
+            handle = lm.engine.submit(gen)
+            parts: list[str] = []
+            delta_type = (
+                "response.audio_transcript.delta"
+                if "audio" in modalities else "response.text.delta"
+            )
+            for tev in handle:
+                if tev.kind == "token":
+                    parts.append(tev.text)
+                    ws.send_json({
+                        "type": delta_type, "response_id": resp_id,
+                        "delta": tev.text,
+                    })
+                elif tev.kind == "error":
+                    ws.send_json({"type": "error", "error": {
+                        "type": "server_error", "message": tev.error,
+                    }})
+                    return
+        finally:
+            lease.release()
+        text = "".join(parts)
+        self.conversation.append({"role": "assistant", "content": text})
+
+        if "audio" in modalities:
+            self._send_audio(ws, resp_id, text)
+
+        ws.send_json({"type": "response.done", "response": {
+            "id": resp_id, "status": "completed",
+            "output": [{
+                "type": "message", "role": "assistant",
+                "content": [{"type": "text", "text": text}],
+            }],
+        }})
+
+    def _send_audio(self, ws: WebSocket, resp_id: str, text: str) -> None:
+        from localai_tpu.audio import resample
+
+        try:
+            lm, lease = self.api._lease(Usecase.TTS, self.config.get("tts_model"))
+        except Exception:  # noqa: BLE001 — no TTS model configured: text only
+            return
+        try:
+            samples, sr = lm.engine.synthesize(text or " ", voice=self.config.get("voice"))
+        finally:
+            lease.release()
+        out_sr = int(self.config["output_sample_rate"])
+        pcm = resample(samples, sr, out_sr)
+        pcm16 = (np.clip(pcm, -1, 1) * 32767.0).astype(np.int16).tobytes()
+        chunk = out_sr * 2 // 10  # 100 ms per delta
+        for off in range(0, len(pcm16), chunk):
+            ws.send_json({
+                "type": "response.audio.delta", "response_id": resp_id,
+                "delta": base64.b64encode(pcm16[off: off + chunk]).decode(),
+            })
+        ws.send_json({"type": "response.audio.done", "response_id": resp_id})
+
+
+class RealtimeApi:
+    def __init__(self, manager: ModelManager, base: OpenAIApi):
+        self.manager = manager
+        self._base = base
+
+    def register(self, r: Router) -> None:
+        r.add("GET", "/v1/realtime", self.realtime)
+
+    def _lease(self, usecase: Usecase, name: Optional[str]):
+        if not name:
+            cfg = self.manager.configs.first_with(usecase)
+            if cfg is None:
+                raise RuntimeError(f"no model configured for {usecase}")
+            name = cfg.name
+        return self.manager.lease(name)
+
+    def realtime(self, req: Request) -> WebSocketUpgrade:
+        model = (req.query.get("model") or [None])[0]
+        session = RealtimeSession(self, model)
+        return WebSocketUpgrade(session.run)
