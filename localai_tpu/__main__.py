@@ -202,8 +202,10 @@ def main(argv: list[str] | None = None) -> int:
     from localai_tpu.server.audio_api import AudioApi
     from localai_tpu.server.gallery_api import GalleryApi
     from localai_tpu.server.image_api import ImageApi
+    from localai_tpu.server.openapi import register_openapi
     from localai_tpu.server.realtime_api import RealtimeApi
     from localai_tpu.server.rerank_api import RerankApi
+    from localai_tpu.server.webui import register_webui
     from localai_tpu.server.openai_api import OpenAIApi
     from localai_tpu.server.stores_api import StoresApi
 
@@ -222,6 +224,8 @@ def main(argv: list[str] | None = None) -> int:
         galleries=[Gallery(name=g["name"], url=g["url"]) for g in app_cfg.galleries],
     )
     GalleryApi(gallery_service, manager=manager).register(router)
+    register_openapi(router)
+    register_webui(router)
 
     for name in app_cfg.preload_models:
         log.info("preloading model %s", name)

@@ -152,11 +152,15 @@ Handler = Callable[[Request], "Response | SSEStream"]
 class Router:
     def __init__(self) -> None:
         self.routes: list[tuple[str, re.Pattern, Handler]] = []
+        # Original (method, pattern, handler) tuples — the OpenAPI doc and
+        # WebUI introspect these (reference: swagger route).
+        self.declared: list[tuple[str, str, Handler]] = []
 
     def add(self, method: str, pattern: str, handler: Handler) -> None:
         """Pattern params as `:name` segments, e.g. `/models/jobs/:uuid`."""
         regex = re.sub(r":(\w+)", r"(?P<\1>[^/]+)", pattern)
         self.routes.append((method.upper(), re.compile(f"^{regex}$"), handler))
+        self.declared.append((method.upper(), pattern, handler))
 
     def match(self, method: str, path: str) -> Optional[tuple[Handler, dict[str, str]]]:
         for m, rx, h in self.routes:

@@ -638,6 +638,8 @@ class OpenAIApi:
     def system(self, req: Request) -> Response:
         import jax
 
+        from localai_tpu.utils.sysinfo import device_info, recommend_mesh
+
         loaded = self.manager.loaded_names()
         backends = {}
         for n in loaded:
@@ -649,6 +651,8 @@ class OpenAIApi:
             "loaded_models": loaded,
             "configured_models": self.manager.configs.names(),
             "devices": [str(d) for d in jax.devices()],
+            "sysinfo": device_info(),
+            "recommended_mesh": recommend_mesh(),
             "uptime_s": time.time() - self.started_at,
             "version": __version__,
         })

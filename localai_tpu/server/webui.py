@@ -1,0 +1,146 @@
+"""Embedded WebUI.
+
+Reference: core/http/views (23 templates: chat, models, gallery install,
+tts, image generation, talk) + ui.go/ui_api.go routes. Here: one
+dependency-free single-page app served at `/` that drives the same public
+APIs the CLI and SDKs use — chat with SSE streaming, model list + load
+state, gallery browse/install with job polling, TTS playback, image
+generation. No build step, no external assets (air-gapped TPU pods).
+"""
+
+from __future__ import annotations
+
+from localai_tpu.server.app import Request, Response, Router
+
+
+def register_webui(router: Router) -> None:
+    def index(req: Request) -> Response:
+        """WebUI single-page app."""
+        return Response(body=_HTML, content_type="text/html; charset=utf-8")
+
+    router.add("GET", "/", index)
+    router.add("GET", "/index.html", index)
+
+
+_HTML = r"""<!doctype html>
+<html><head><meta charset="utf-8"><meta name="viewport" content="width=device-width,initial-scale=1">
+<title>localai-tpu</title><style>
+:root{--b:#0a6b5d;--bg:#fafafa;--fg:#1c1c1c;--mut:#777;--line:#e3e3e3}
+*{box-sizing:border-box}body{margin:0;font-family:system-ui,sans-serif;background:var(--bg);color:var(--fg)}
+header{display:flex;align-items:center;gap:1.5rem;padding:.8rem 1.4rem;background:#fff;border-bottom:1px solid var(--line)}
+header h1{font-size:1.05rem;margin:0}
+nav button{background:none;border:none;padding:.45rem .8rem;font-size:.95rem;cursor:pointer;border-radius:6px;color:var(--mut)}
+nav button.on{background:var(--b);color:#fff}
+main{max-width:900px;margin:1.2rem auto;padding:0 1rem}
+.card{background:#fff;border:1px solid var(--line);border-radius:8px;padding:1rem;margin-bottom:1rem}
+select,input,textarea{font:inherit;padding:.45rem .6rem;border:1px solid var(--line);border-radius:6px;width:100%}
+button.act{background:var(--b);color:#fff;border:none;border-radius:6px;padding:.5rem 1rem;font:inherit;cursor:pointer}
+button.act:disabled{opacity:.5}
+#log{display:flex;flex-direction:column;gap:.6rem;min-height:200px;max-height:55vh;overflow-y:auto;padding:.4rem}
+.msg{padding:.55rem .8rem;border-radius:10px;max-width:85%;white-space:pre-wrap}
+.msg.user{align-self:flex-end;background:var(--b);color:#fff}
+.msg.assistant{align-self:flex-start;background:#efefef}
+.row{display:flex;gap:.6rem;margin-top:.6rem}
+table{width:100%;border-collapse:collapse}td,th{text-align:left;padding:.45rem;border-bottom:1px solid var(--line)}
+.pill{font-size:.75rem;padding:.1rem .5rem;border-radius:999px;background:#eee;color:var(--mut)}
+.pill.loaded{background:#d9f2ea;color:var(--b)}
+#imgout img{max-width:256px;border-radius:8px;margin:.3rem}
+.small{font-size:.8rem;color:var(--mut)}
+</style></head><body>
+<header><h1>localai-tpu</h1><nav id="nav"></nav>
+<span style="flex:1"></span><a class="small" href="/swagger">API docs</a></header>
+<main id="main"></main>
+<script>
+const TABS={chat:Chat,models:Models,gallery:GalleryTab,tts:TTS,image:Images};
+let tab='chat';
+function nav(){const n=document.getElementById('nav');n.innerHTML='';
+ for(const t of Object.keys(TABS)){const b=document.createElement('button');
+  b.textContent=t;b.className=t===tab?'on':'';b.onclick=()=>{tab=t;render()};n.appendChild(b)}}
+function render(){nav();document.getElementById('main').innerHTML='';TABS[tab](document.getElementById('main'))}
+async function models(uc){const r=await fetch('/v1/models');const d=await r.json();return d.data.map(m=>m.id)}
+function sel(opts,id){return `<select id="${id}">`+opts.map(o=>`<option>${o}</option>`).join('')+`</select>`}
+
+function Chat(el){
+ el.innerHTML=`<div class="card"><div class="row"><div style="flex:1" id="mslot"></div></div>
+ <div id="log"></div><div class="row"><textarea id="inp" rows="2" placeholder="Say something…"></textarea>
+ <button class="act" id="send">Send</button></div></div>`;
+ models().then(ms=>{document.getElementById('mslot').innerHTML=sel(ms,'model')});
+ const hist=[];
+ document.getElementById('send').onclick=async()=>{
+  const inp=document.getElementById('inp');const text=inp.value.trim();if(!text)return;
+  inp.value='';hist.push({role:'user',content:text});
+  const log=document.getElementById('log');
+  log.insertAdjacentHTML('beforeend',`<div class="msg user"></div>`);
+  log.lastChild.textContent=text;
+  log.insertAdjacentHTML('beforeend',`<div class="msg assistant"></div>`);
+  const out=log.lastChild;log.scrollTop=log.scrollHeight;
+  const resp=await fetch('/v1/chat/completions',{method:'POST',headers:{'Content-Type':'application/json'},
+   body:JSON.stringify({model:document.getElementById('model').value,messages:hist,stream:true})});
+  const rd=resp.body.getReader();const dec=new TextDecoder();let buf='',acc='';
+  for(;;){const{done,value}=await rd.read();if(done)break;buf+=dec.decode(value,{stream:true});
+   let i;while((i=buf.indexOf('\n\n'))>=0){const f=buf.slice(0,i);buf=buf.slice(i+2);
+    const line=f.split('\n').find(l=>l.startsWith('data: '));if(!line)continue;
+    const data=line.slice(6);if(data==='[DONE]')continue;
+    try{const c=JSON.parse(data);const d=c.choices&&c.choices[0].delta;
+     if(d&&d.content){acc+=d.content;out.textContent=acc;log.scrollTop=log.scrollHeight}}catch(e){}}}
+  hist.push({role:'assistant',content:acc});};
+}
+
+async function Models(el){
+ el.innerHTML=`<div class="card"><table id="mt"><tr><th>model</th><th>backend</th><th>state</th><th></th></tr></table></div>`;
+ const sys=await(await fetch('/system')).json();
+ const loaded=new Set(sys.loaded_models||[]);
+ const list=await(await fetch('/v1/models')).json();
+ const t=document.getElementById('mt');
+ for(const m of list.data){const tr=document.createElement('tr');
+  tr.innerHTML=`<td>${m.id}</td><td class="small">${m.owned_by}</td>
+  <td><span class="pill ${loaded.has(m.id)?'loaded':''}">${loaded.has(m.id)?'loaded':'idle'}</span></td>
+  <td>${loaded.has(m.id)?`<button class="act" data-m="${m.id}">unload</button>`:''}</td>`;
+  t.appendChild(tr)}
+ t.onclick=async e=>{const m=e.target.dataset&&e.target.dataset.m;if(!m)return;
+  await fetch('/backend/shutdown',{method:'POST',headers:{'Content-Type':'application/json'},body:JSON.stringify({model:m})});
+  Models(el)};
+}
+
+async function GalleryTab(el){
+ el.innerHTML=`<div class="card" id="gl">loading gallery…</div>`;
+ const g=document.getElementById('gl');
+ try{
+  const d=await(await fetch('/models/available')).json();
+  if(!d.length){g.textContent='no galleries configured';return}
+  g.innerHTML=`<table>`+d.map(m=>`<tr><td>${m.name}</td><td class="small">${m.description||''}</td>
+   <td><button class="act" data-n="${m.gallery?m.gallery+'@':''}${m.name}">install</button></td></tr>`).join('')+`</table><div id="job"></div>`;
+  g.onclick=async e=>{const n=e.target.dataset&&e.target.dataset.n;if(!n)return;
+   const r=await(await fetch('/models/apply',{method:'POST',headers:{'Content-Type':'application/json'},body:JSON.stringify({id:n})})).json();
+   const poll=async()=>{const s=await(await fetch('/models/jobs/'+r.uuid)).json();
+    document.getElementById('job').textContent=`${n}: ${s.message||''} ${s.processed?'done':''}`;
+    if(!s.processed)setTimeout(poll,500)};poll()};
+ }catch(e){g.textContent='gallery unavailable: '+e}
+}
+
+function TTS(el){
+ el.innerHTML=`<div class="card"><div id="ts"></div>
+ <div class="row"><input id="txt" placeholder="Text to speak"><button class="act" id="go">Speak</button></div>
+ <div class="row"><audio id="au" controls style="width:100%"></audio></div></div>`;
+ models().then(ms=>{document.getElementById('ts').innerHTML=sel(ms,'tmodel')});
+ document.getElementById('go').onclick=async()=>{
+  const r=await fetch('/v1/audio/speech',{method:'POST',headers:{'Content-Type':'application/json'},
+   body:JSON.stringify({model:document.getElementById('tmodel').value,input:document.getElementById('txt').value})});
+  if(!r.ok){alert('tts failed: '+(await r.text()));return}
+  document.getElementById('au').src=URL.createObjectURL(await r.blob())};
+}
+
+function Images(el){
+ el.innerHTML=`<div class="card"><div id="is"></div>
+ <div class="row"><input id="prompt" placeholder="Prompt"><button class="act" id="gen">Generate</button></div>
+ <div id="imgout"></div></div>`;
+ models().then(ms=>{document.getElementById('is').innerHTML=sel(ms,'imodel')});
+ document.getElementById('gen').onclick=async()=>{
+  const r=await fetch('/v1/images/generations',{method:'POST',headers:{'Content-Type':'application/json'},
+   body:JSON.stringify({model:document.getElementById('imodel').value,prompt:document.getElementById('prompt').value,response_format:'b64_json'})});
+  if(!r.ok){alert('generation failed: '+(await r.text()));return}
+  const d=await r.json();
+  document.getElementById('imgout').innerHTML=d.data.map(x=>`<img src="data:image/png;base64,${x.b64_json}">`).join('')};
+}
+render();
+</script></body></html>"""

@@ -1,0 +1,1 @@
+"""Cross-cutting utilities (reference: pkg/utils, internal/, pkg/xsysinfo)."""

@@ -1,0 +1,90 @@
+"""WebUI, OpenAPI doc, and sysinfo tests."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+import yaml
+
+from localai_tpu.config import ApplicationConfig
+from localai_tpu.server import ModelManager, Router, create_server
+from localai_tpu.server.openai_api import OpenAIApi
+from localai_tpu.server.openapi import build_openapi, register_openapi
+from localai_tpu.server.webui import register_webui
+
+
+@pytest.fixture(scope="module")
+def api(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ui-models")
+    (d / "m.yaml").write_text(yaml.safe_dump({
+        "name": "m", "model": "tiny", "context_size": 64, "max_tokens": 4,
+    }))
+    app_cfg = ApplicationConfig(address="127.0.0.1", port=0, models_dir=str(d))
+    manager = ModelManager(app_cfg)
+    router = Router()
+    OpenAIApi(manager).register(router)
+    register_openapi(router)
+    register_webui(router)
+    server = create_server(app_cfg, router)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{port}", router
+    server.shutdown()
+    manager.shutdown()
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        return r.read().decode(), r.headers
+
+
+def test_webui_served_at_root(api):
+    base, _ = api
+    body, headers = _get(base, "/")
+    assert headers["Content-Type"].startswith("text/html")
+    assert "localai-tpu" in body
+    assert "/v1/chat/completions" in body  # the chat tab drives the real API
+
+
+def test_openapi_document(api):
+    base, router = api
+    body, headers = _get(base, "/swagger.json")
+    doc = json.loads(body)
+    assert doc["openapi"].startswith("3.")
+    assert "/v1/chat/completions" in doc["paths"]
+    post = doc["paths"]["/v1/chat/completions"]["post"]
+    assert "messages" in post["requestBody"]["content"]["application/json"]["schema"]["properties"]
+    # path params templated
+    assert "/v1/models/{name}" in doc["paths"]
+    # every declared route appears
+    declared = {p for _m, p, _h in router.declared}
+    assert len(doc["paths"]) >= len({p for p in declared}) - 5  # tolerance for merging
+
+    html, h2 = _get(base, "/swagger")
+    assert h2["Content-Type"].startswith("text/html")
+
+
+def test_system_includes_sysinfo(api):
+    base, _ = api
+    body, _ = _get(base, "/system")
+    out = json.loads(body)
+    info = out["sysinfo"]
+    assert info["device_count"] >= 1
+    assert info["platform"]
+    assert out["recommended_mesh"]["tp"] == info["device_count"]
+    assert info["cpu_count"] >= 1
+
+
+def test_build_openapi_offline():
+    router = Router()
+
+    def handler(req):
+        """Test summary line."""
+        return None
+
+    router.add("GET", "/x/:id", handler)
+    doc = build_openapi(router)
+    op = doc["paths"]["/x/{id}"]["get"]
+    assert op["summary"] == "Test summary line."
+    assert op["parameters"][0]["name"] == "id"
