@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import signal
 import sys
 
@@ -202,7 +203,9 @@ def main(argv: list[str] | None = None) -> int:
     from localai_tpu.server.audio_api import AudioApi
     from localai_tpu.server.gallery_api import GalleryApi
     from localai_tpu.server.image_api import ImageApi
+    from localai_tpu.server.mcp_api import McpApi, make_job_runner
     from localai_tpu.server.openapi import register_openapi
+    from localai_tpu.services import AgentJobService
     from localai_tpu.server.realtime_api import RealtimeApi
     from localai_tpu.server.rerank_api import RerankApi
     from localai_tpu.server.webui import register_webui
@@ -224,6 +227,12 @@ def main(argv: list[str] | None = None) -> int:
         galleries=[Gallery(name=g["name"], url=g["url"]) for g in app_cfg.galleries],
     )
     GalleryApi(gallery_service, manager=manager).register(router)
+    jobs = AgentJobService(
+        os.path.join(app_cfg.models_dir, "agent_jobs.json"),
+        make_job_runner(manager),
+    )
+    jobs.start()
+    McpApi(manager, oai, jobs=jobs).register(router)
     register_openapi(router)
     register_webui(router)
 
@@ -234,9 +243,7 @@ def main(argv: list[str] | None = None) -> int:
     server = create_server(app_cfg, router)
 
     # Join a federation when asked (worker mode or --federator).
-    federator = getattr(args, "federator", None) or __import__("os").environ.get(
-        "LOCALAI_FEDERATOR"
-    )
+    federator = getattr(args, "federator", None) or os.environ.get("LOCALAI_FEDERATOR")
     if federator:
         import socket
 
@@ -248,6 +255,7 @@ def main(argv: list[str] | None = None) -> int:
 
     def _stop(signum, frame):
         log.info("shutting down")
+        jobs.stop()
         manager.shutdown()
         raise SystemExit(0)
 

@@ -1,0 +1,4 @@
+"""MCP (Model Context Protocol) client + agent loop."""
+
+from localai_tpu.mcp.client import MCPClient, MCPError, StdioMCPClient  # noqa: F401
+from localai_tpu.mcp.agent import agent_loop, collect_tools  # noqa: F401

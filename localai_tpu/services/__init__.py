@@ -1,0 +1,4 @@
+"""Application services (reference: core/services — gallery installs, agent
+jobs, metrics)."""
+
+from localai_tpu.services.agent_jobs import AgentJob, AgentJobService  # noqa: F401

@@ -1,0 +1,263 @@
+"""MCP client/agent-loop and agent-jobs tests.
+
+A fake MCP server (stdlib HTTP, JSON-RPC 2.0) provides a real tool; the
+agent loop is driven both by a scripted chat_fn (deterministic tool-call
+path) and end-to-end over HTTP with the tiny model (no-tool path).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+import yaml
+
+from localai_tpu.mcp import MCPClient, agent_loop, collect_tools
+from localai_tpu.services.agent_jobs import AgentJobService, cron_matches
+
+
+class FakeMCPServer:
+    """JSON-RPC MCP server with one `add` tool; records calls."""
+
+    def __init__(self):
+        self.calls = []
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                req = json.loads(self.rfile.read(n))
+                method = req.get("method")
+                result = {}
+                if method == "initialize":
+                    result = {"protocolVersion": "2024-11-05",
+                              "serverInfo": {"name": "fake"}}
+                elif method == "tools/list":
+                    result = {"tools": [{
+                        "name": "add",
+                        "description": "Add two integers",
+                        "inputSchema": {
+                            "type": "object",
+                            "properties": {"a": {"type": "integer"},
+                                           "b": {"type": "integer"}},
+                            "required": ["a", "b"],
+                        },
+                    }]}
+                elif method == "tools/call":
+                    p = req.get("params", {})
+                    outer.calls.append(p)
+                    a = p["arguments"]["a"]
+                    b = p["arguments"]["b"]
+                    result = {"content": [{"type": "text", "text": str(a + b)}]}
+                body = json.dumps(
+                    {"jsonrpc": "2.0", "id": req.get("id"), "result": result}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}/mcp"
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()
+
+
+@pytest.fixture(scope="module")
+def mcp_server():
+    s = FakeMCPServer()
+    yield s
+    s.stop()
+
+
+def test_mcp_client_protocol(mcp_server):
+    c = MCPClient(mcp_server.url, name="fake")
+    tools = c.list_tools()
+    assert tools[0]["name"] == "add"
+    out = c.call_tool("add", {"a": 2, "b": 40})
+    assert out == "42"
+
+
+def test_collect_tools_builds_openai_specs(mcp_server):
+    specs, owners = collect_tools([MCPClient(mcp_server.url)])
+    assert specs[0]["type"] == "function"
+    assert specs[0]["function"]["name"] == "add"
+    assert "add" in owners
+
+
+def test_agent_loop_executes_tools_then_answers(mcp_server):
+    c = MCPClient(mcp_server.url)
+    state = {"round": 0}
+
+    def chat_fn(messages, tools):
+        state["round"] += 1
+        if state["round"] == 1:
+            assert tools and tools[0]["function"]["name"] == "add"
+            return {"role": "assistant", "content": None, "tool_calls": [{
+                "id": "call_1", "type": "function",
+                "function": {"name": "add", "arguments": json.dumps({"a": 3, "b": 4})},
+            }]}
+        # Second round sees the tool result in history.
+        tool_msgs = [m for m in messages if m.get("role") == "tool"]
+        assert tool_msgs and tool_msgs[-1]["content"] == "7"
+        return {"role": "assistant", "content": "the answer is 7"}
+
+    result = agent_loop(chat_fn, [{"role": "user", "content": "3+4?"}], [c])
+    assert result["message"]["content"] == "the answer is 7"
+    assert result["iterations"] == 2
+    assert result["tool_calls"][0]["result"] == "7"
+
+
+def test_agent_loop_unknown_tool_and_max_iterations(mcp_server):
+    c = MCPClient(mcp_server.url)
+
+    def chat_fn(messages, tools):
+        return {"role": "assistant", "content": None, "tool_calls": [{
+            "id": "x", "type": "function",
+            "function": {"name": "nope", "arguments": "{}"},
+        }]}
+
+    result = agent_loop(chat_fn, [{"role": "user", "content": "q"}], [c],
+                        max_iterations=2)
+    assert result["iterations"] == 2
+    assert all("error" in t for t in result["tool_calls"])
+
+
+# --------------------------------------------------------------------------- #
+# Agent jobs
+# --------------------------------------------------------------------------- #
+
+
+def test_cron_matcher():
+    t = time.struct_time((2026, 7, 30, 14, 30, 0, 2, 211, -1))  # Wed 14:30
+    assert cron_matches("30 14 * * *", t)
+    assert cron_matches("*/15 * * * *", t)
+    assert cron_matches("* * * * 2", t)  # tm_wday 2 = Wednesday
+    assert not cron_matches("31 14 * * *", t)
+    assert cron_matches("25-35 14 30 7 *", t)
+    with pytest.raises(ValueError):
+        cron_matches("* * *", t)
+
+
+def test_jobs_crud_persistence_and_schedule(tmp_path):
+    store = str(tmp_path / "jobs.json")
+    runs = []
+
+    def runner(job):
+        runs.append(job.id)
+        return f"ran {job.name}"
+
+    svc = AgentJobService(store, runner, tick_s=0.05)
+    job = svc.create(name="j1", model="m", prompt="do it", schedule="@every 0.2s")
+    assert svc.get(job.id).name == "j1"
+
+    svc.start()
+    deadline = time.time() + 10
+    while len(runs) < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    svc.stop()
+    assert len(runs) >= 2
+    hist = svc.get(job.id).history
+    assert hist and hist[0]["ok"] and hist[0]["result"] == "ran j1"
+
+    # Manual run + failure recorded
+    def bad_runner(job):
+        raise RuntimeError("boom")
+
+    svc2 = AgentJobService(store, bad_runner)
+    assert svc2.get(job.id) is not None, "jobs persist across restarts"
+    entry = svc2.run_now(job.id)
+    assert entry["ok"] is False and "boom" in entry["error"]
+
+    # Update + delete
+    svc2.update(job.id, enabled=False, name="j2")
+    assert svc2.get(job.id).name == "j2"
+    assert svc2.delete(job.id)
+    assert svc2.get(job.id) is None
+
+    with pytest.raises(ValueError):
+        svc2.create(name="bad", model="m", prompt="p", schedule="not a schedule")
+
+
+# --------------------------------------------------------------------------- #
+# HTTP endpoints
+# --------------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def api(tmp_path_factory, mcp_server):
+    from localai_tpu.config import ApplicationConfig
+    from localai_tpu.server import ModelManager, Router, create_server
+    from localai_tpu.server.mcp_api import McpApi, make_job_runner
+    from localai_tpu.server.openai_api import OpenAIApi
+
+    d = tmp_path_factory.mktemp("mcp-models")
+    (d / "m.yaml").write_text(yaml.safe_dump({
+        "name": "m", "model": "tiny", "context_size": 128, "max_tokens": 8,
+        "temperature": 0.0, "template": {"family": "chatml"},
+        "options": {"mcp": {"remote": [{"name": "fake", "url": mcp_server.url}]}},
+    }))
+    app_cfg = ApplicationConfig(address="127.0.0.1", port=0, models_dir=str(d))
+    manager = ModelManager(app_cfg)
+    router = Router()
+    oai = OpenAIApi(manager)
+    oai.register(router)
+    jobs = AgentJobService(str(d / "agent_jobs.json"), make_job_runner(manager))
+    McpApi(manager, oai, jobs=jobs).register(router)
+    server = create_server(app_cfg, router)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{port}"
+    server.shutdown()
+    manager.shutdown()
+
+
+def _req(base, path, payload=None, method=None):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        base + path, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return json.loads(r.read())
+
+
+def test_mcp_chat_endpoint(api):
+    out = _req(api, "/mcp/v1/chat/completions", {
+        "model": "m", "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 6,
+    })
+    assert out["object"] == "chat.completion"
+    assert out["choices"][0]["message"]["role"] == "assistant"
+    assert out["agent"]["iterations"] >= 1
+
+
+def test_agent_jobs_endpoints(api):
+    job = _req(api, "/agent-jobs", {
+        "name": "daily", "model": "m", "prompt": "say hi", "schedule": "",
+    })
+    assert job["name"] == "daily"
+    jid = job["id"]
+
+    listing = _req(api, "/agent-jobs")
+    assert any(j["id"] == jid for j in listing["jobs"])
+
+    entry = _req(api, f"/agent-jobs/{jid}/run", {})
+    assert entry["ok"] is True
+
+    hist = _req(api, f"/agent-jobs/{jid}/history")
+    assert len(hist["history"]) == 1
+
+    updated = _req(api, f"/agent-jobs/{jid}", {"enabled": False}, method="PUT")
+    assert updated["enabled"] is False
+
+    out = _req(api, f"/agent-jobs/{jid}", method="DELETE")
+    assert out["status"] == "deleted"
