@@ -101,6 +101,13 @@ class ModelConfig:
     draft_model: str = ""  # arch preset or checkpoint dir; empty = off
     n_draft: int = 5
 
+    # Output post-processing (reference Finetune, core/backend/llm.go:217-265).
+    echo: bool = False
+    cutstrings: list = dataclasses.field(default_factory=list)
+    extract_regex: list = dataclasses.field(default_factory=list)
+    trim_space: list = dataclasses.field(default_factory=list)
+    trim_suffix: list = dataclasses.field(default_factory=list)
+
     # Capabilities.
     embeddings: bool = False
     template: TemplateConfig = dataclasses.field(default_factory=TemplateConfig)

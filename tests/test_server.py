@@ -302,3 +302,42 @@ def test_completions_multiprompt_parallel_and_logprobs(api):
     # offsets monotonically increase within a choice
     offs = out["choices"][0]["logprobs"]["text_offset"]
     assert offs == sorted(offs)
+
+
+def test_finetune_postprocessing(api, tmp_path_factory):
+    """Reference Finetune chain (llm.go:217-265): cutstrings + trim applied
+    to non-stream predictions."""
+    import yaml as _yaml
+
+    from localai_tpu.config import ModelConfig
+
+    base, manager = api
+    cfg = ModelConfig.from_dict({
+        "name": "ft", "model": "tiny", "context_size": 64, "max_tokens": 6,
+        "temperature": 0.0, "cutstrings": ["[A-Za-z]"],
+    })
+    manager.configs.register(cfg)
+    try:
+        out = _post(base, "/v1/chat/completions", {
+            "model": "ft", "messages": [{"role": "user", "content": "hi"}],
+        })
+        content = out["choices"][0]["message"]["content"]
+        assert not any(c.isalpha() for c in content), content
+    finally:
+        manager.unload("ft")
+
+
+def test_model_from_query_param(api):
+    base, _ = api
+    out = _post(base, "/v1/chat/completions?model=tiny-chat", {
+        "messages": [{"role": "user", "content": "x"}], "max_tokens": 2,
+    })
+    assert out["model"] == "tiny-chat"
+
+
+def test_model_from_bearer_token(api):
+    base, _ = api
+    out = _post(base, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "x"}], "max_tokens": 2,
+    }, headers={"Authorization": "Bearer tiny-chat"})
+    assert out["model"] == "tiny-chat"
