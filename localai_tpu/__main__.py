@@ -182,6 +182,11 @@ def main(argv: list[str] | None = None) -> int:
         overrides["debug"] = True
 
     app_cfg = ApplicationConfig.from_env(**overrides)
+    if not app_cfg.runtime_settings_path:
+        app_cfg.runtime_settings_path = os.path.join(
+            app_cfg.models_dir, "runtime_settings.json"
+        )
+    app_cfg.apply_runtime_settings()
     logging.basicConfig(
         level=logging.DEBUG if app_cfg.debug else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
@@ -208,6 +213,7 @@ def main(argv: list[str] | None = None) -> int:
     from localai_tpu.services import AgentJobService
     from localai_tpu.server.realtime_api import RealtimeApi
     from localai_tpu.server.rerank_api import RerankApi
+    from localai_tpu.server.settings_api import SettingsApi
     from localai_tpu.server.webui import register_webui
     from localai_tpu.server.openai_api import OpenAIApi
     from localai_tpu.server.stores_api import StoresApi
@@ -233,6 +239,7 @@ def main(argv: list[str] | None = None) -> int:
     )
     jobs.start()
     McpApi(manager, oai, jobs=jobs).register(router)
+    SettingsApi(app_cfg, manager).register(router)
     register_openapi(router)
     register_webui(router)
 

@@ -53,6 +53,50 @@ class ApplicationConfig:
 
     machine_tag: str = ""  # echoed as a response header when set
 
+    # Config hot-reload (reference: fsnotify watcher, startup.go:209-319).
+    watch_configs: bool = False
+    config_watch_interval_s: float = 2.0
+
+    # Mutable-at-runtime settings persisted to this JSON (reference:
+    # runtime_settings.json applied at boot + settings API).
+    runtime_settings_path: str = ""
+
+    RUNTIME_MUTABLE = (
+        "max_active_models",
+        "watchdog_idle_timeout_s",
+        "watchdog_busy_timeout_s",
+        "watchdog_interval_s",
+        "default_context_size",
+        "machine_tag",
+        "cors",
+    )
+
+    def apply_runtime_settings(self) -> dict:
+        """Load runtime_settings.json over this config (boot-time tier —
+        env < file < API updates). Returns the applied dict."""
+        import json
+
+        if not self.runtime_settings_path or not os.path.exists(self.runtime_settings_path):
+            return {}
+        with open(self.runtime_settings_path) as f:
+            data = json.load(f)
+        applied = {}
+        for k in self.RUNTIME_MUTABLE:
+            if k in data:
+                field_type = type(getattr(self, k))
+                setattr(self, k, field_type(data[k]))
+                applied[k] = data[k]
+        return applied
+
+    def save_runtime_settings(self) -> None:
+        import json
+
+        if not self.runtime_settings_path:
+            return
+        os.makedirs(os.path.dirname(self.runtime_settings_path) or ".", exist_ok=True)
+        with open(self.runtime_settings_path, "w") as f:
+            json.dump({k: getattr(self, k) for k in self.RUNTIME_MUTABLE}, f, indent=1)
+
     @classmethod
     def from_env(cls, **overrides) -> "ApplicationConfig":
         cfg = cls(

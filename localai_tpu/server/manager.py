@@ -90,6 +90,9 @@ class ModelManager:
                 target=self._watchdog_loop, daemon=True, name="watchdog"
             )
             self._wd_thread.start()
+        self._cw_thread: Optional[threading.Thread] = None
+        if app_cfg.watch_configs:
+            self.start_config_watcher(app_cfg.config_watch_interval_s)
 
     # ------------------------------------------------------------------ #
 
@@ -183,6 +186,76 @@ class ModelManager:
             self._loaded.clear()
         for lm in loaded:
             self._teardown(lm)
+
+    # ------------------------------------------------------------------ #
+    # Config hot-reload (reference: startup.go:209-319 fsnotify watcher on
+    # the models dir; here mtime polling — no inotify dependency, works on
+    # network filesystems TPU pods actually mount)
+    # ------------------------------------------------------------------ #
+
+    def ensure_watchdog(self) -> None:
+        """Start the watchdog thread if settings enabled it at runtime."""
+        if self._wd_thread is None:
+            self._wd_thread = threading.Thread(
+                target=self._watchdog_loop, daemon=True, name="watchdog"
+            )
+            self._wd_thread.start()
+
+    def start_config_watcher(self, interval_s: float = 2.0) -> None:
+        if self._cw_thread is not None:
+            return
+        # Baseline taken synchronously: changes made after construction are
+        # always detected, even if the thread is slow to start.
+        baseline = self._config_snapshot()
+        self._cw_thread = threading.Thread(
+            target=self._config_watch_loop, args=(interval_s, baseline),
+            daemon=True, name="config-watcher",
+        )
+        self._cw_thread.start()
+
+    def _config_snapshot(self) -> dict[str, float]:
+        import os
+
+        out: dict[str, float] = {}
+        try:
+            for fname in os.listdir(self.app_cfg.models_dir):
+                if fname.endswith((".yaml", ".yml")):
+                    path = os.path.join(self.app_cfg.models_dir, fname)
+                    try:
+                        out[path] = os.stat(path).st_mtime
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        return out
+
+    def _config_watch_loop(self, interval_s: float, last: dict[str, float]) -> None:
+        while not self._wd_stop.wait(interval_s):
+            snap = self._config_snapshot()
+            if snap == last:
+                continue
+            last = snap
+            try:
+                self.reload_configs()
+            except Exception:  # noqa: BLE001 — a bad yaml must not kill the loop
+                log.exception("config reload failed")
+
+    def reload_configs(self) -> int:
+        """Re-read every model YAML; evict loaded models whose config changed
+        or disappeared (the next request reloads them fresh). Returns the
+        number of evictions."""
+        old = {n: self.configs.get(n) for n in self.configs.names()}
+        self.configs.load_all()
+        evicted = 0
+        with self._lock:
+            loaded = list(self._loaded.keys())
+        for name in loaded:
+            new_cfg = self.configs.get(name)
+            if new_cfg is None or new_cfg != old.get(name):
+                log.info("config for %s changed — evicting for reload", name)
+                self.unload(name, drain_s=10.0)
+                evicted += 1
+        return evicted
 
     # ------------------------------------------------------------------ #
     # Watchdog (reference: pkg/model/watchdog.go:197-279)

@@ -205,3 +205,75 @@ def test_unlimited_budget_default(tmp_path):
         mgr.get(f"m{i}")
     assert len(mgr.loaded_names()) == 3  # nothing evicted
     mgr.shutdown()
+
+
+def test_config_hot_reload_evicts_changed_models(tmp_path):
+    """Reference: startup.go fsnotify watcher — edited YAML reloads the
+    config and evicts the stale loaded engine."""
+    d = tmp_path / "models"
+    d.mkdir()
+    path = d / "hot.yaml"
+    path.write_text(yaml.safe_dump({
+        "name": "hot", "model": "tiny", "context_size": 64, "max_tokens": 4,
+    }))
+    mgr = ModelManager(ApplicationConfig(models_dir=str(d)))
+    lm = mgr.get("hot")
+    assert mgr.configs.get("hot").max_tokens == 4
+
+    path.write_text(yaml.safe_dump({
+        "name": "hot", "model": "tiny", "context_size": 64, "max_tokens": 9,
+    }))
+    evicted = mgr.reload_configs()
+    assert evicted == 1
+    assert mgr.configs.get("hot").max_tokens == 9
+    deadline = time.monotonic() + 15
+    while mgr.peek("hot") is not None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert mgr.peek("hot") is None
+    lm2 = mgr.get("hot")
+    assert lm2 is not lm and lm2.cfg.max_tokens == 9
+    # Unchanged config → no eviction
+    assert mgr.reload_configs() == 0
+    assert mgr.peek("hot") is lm2
+    mgr.shutdown()
+
+
+def test_config_watcher_thread_detects_mtime(tmp_path):
+    import os
+
+    d = tmp_path / "models"
+    d.mkdir()
+    path = d / "w.yaml"
+    path.write_text(yaml.safe_dump({"name": "w", "model": "tiny", "max_tokens": 4}))
+    mgr = ModelManager(ApplicationConfig(
+        models_dir=str(d), watch_configs=True, config_watch_interval_s=0.1,
+    ))
+    assert mgr.configs.get("w").max_tokens == 4
+    path.write_text(yaml.safe_dump({"name": "w", "model": "tiny", "max_tokens": 7}))
+    os.utime(path)  # make sure mtime moves even on coarse filesystems
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        cfg = mgr.configs.get("w")
+        if cfg is not None and cfg.max_tokens == 7:
+            break
+        time.sleep(0.05)
+    assert mgr.configs.get("w").max_tokens == 7
+    mgr.shutdown()
+
+
+def test_runtime_settings_round_trip(tmp_path):
+    import json
+
+    from localai_tpu.config import ApplicationConfig as AC
+
+    p = str(tmp_path / "runtime_settings.json")
+    cfg = AC(models_dir=str(tmp_path), runtime_settings_path=p,
+             max_active_models=1)
+    cfg.max_active_models = 3
+    cfg.save_runtime_settings()
+    assert json.load(open(p))["max_active_models"] == 3
+
+    cfg2 = AC(models_dir=str(tmp_path), runtime_settings_path=p)
+    applied = cfg2.apply_runtime_settings()
+    assert cfg2.max_active_models == 3
+    assert "max_active_models" in applied

@@ -341,3 +341,40 @@ def test_model_from_bearer_token(api):
         "messages": [{"role": "user", "content": "x"}], "max_tokens": 2,
     }, headers={"Authorization": "Bearer tiny-chat"})
     assert out["model"] == "tiny-chat"
+
+
+def test_settings_api(api, tmp_path_factory):
+    from localai_tpu.server.app import Router as _R  # noqa: F401 (doc anchor)
+
+    base, manager = api
+    # The module fixture's router doesn't mount SettingsApi; spin a scoped one.
+    import threading as _t
+
+    from localai_tpu.config import ApplicationConfig as _AC
+    from localai_tpu.server import Router, create_server
+    from localai_tpu.server.settings_api import SettingsApi
+
+    d = tmp_path_factory.mktemp("settings")
+    cfg = _AC(address="127.0.0.1", port=0, models_dir=str(d),
+              runtime_settings_path=str(d / "runtime_settings.json"))
+    router = Router()
+    SettingsApi(cfg, manager).register(router)
+    server = create_server(cfg, router)
+    port = server.server_address[1]
+    _t.Thread(target=server.serve_forever, daemon=True).start()
+    sbase = f"http://127.0.0.1:{port}"
+    try:
+        body, _ = _get(sbase, "/settings")
+        assert "max_active_models" in json.loads(body)
+        out = _post(sbase, "/settings", {"max_active_models": 5, "machine_tag": "tpu-1"})
+        assert out["max_active_models"] == 5
+        assert cfg.max_active_models == 5
+        assert json.load(open(cfg.runtime_settings_path))["machine_tag"] == "tpu-1"
+        # unknown key rejected
+        try:
+            _post(sbase, "/settings", {"api_keys": ["x"]})
+            assert False
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        server.shutdown()
