@@ -1,0 +1,177 @@
+"""Out-of-process backends: remote HTTP proxy + supervised subprocess.
+
+The reference's L7 seam is gRPC: every backend is a separate process
+speaking backend.proto, spawned and respawned by the model loader
+(pkg/model/initializers.go:50-154, loader.go:236-270 crash respawn). The
+TPU-native equivalent keeps hot models in-process (devices are owned by one
+runtime), but this module restores the seam where it matters:
+
+- `RemoteEngine` (backend: remote): requests for the model relay to another
+  serving process's OpenAI-compatible HTTP API — any localai_tpu worker,
+  llama.cpp server, or vLLM. Config: options.url, options.remote_model,
+  options.api_key.
+- `SubprocessEngine` (backend: subprocess): the manager SPAWNS a child
+  `python -m localai_tpu run` with its own models dir and supervises it —
+  a crash in the child (bad checkpoint, OOM, XLA fault) errors requests and
+  triggers a respawn instead of taking the main server down.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Optional
+
+log = logging.getLogger("localai_tpu.remote")
+
+
+class RemoteEngine:
+    """Marker + transport for a proxied model. The API layer checks
+    `isinstance(lm.engine, RemoteEngine)` and relays the HTTP request."""
+
+    def __init__(self, url: str, remote_model: str = "", api_key: str = ""):
+        self.base_url = url.rstrip("/")
+        self.remote_model = remote_model
+        self.api_key = api_key
+        self.params = {}  # lifecycle shims
+        self.cache = None
+        self.m_requests = 0
+
+    # lifecycle surface shared with in-process engines
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+    def cancel_all(self) -> int:
+        return 0
+
+    def ensure_up(self) -> None:
+        """Hook for supervised variants; plain remotes assume the peer."""
+
+    def metrics(self) -> dict[str, float]:
+        return {"requests": float(self.m_requests), "remote": 1.0}
+
+    # ------------------------------------------------------------------ #
+
+    def request(self, path: str, body: Optional[dict], method: str = "POST",
+                stream: bool = False):
+        """Forward one API call; returns the live HTTPResponse."""
+        self.ensure_up()
+        headers = {"Content-Type": "application/json"}
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
+        data = None
+        if body is not None:
+            body = dict(body)
+            if self.remote_model:
+                body["model"] = self.remote_model
+            else:
+                body.pop("model", None)  # let the remote pick its default
+            data = json.dumps(body).encode()
+        req = urllib.request.Request(
+            self.base_url + path, data=data, headers=headers, method=method
+        )
+        self.m_requests += 1
+        return urllib.request.urlopen(req, timeout=600)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class SubprocessEngine(RemoteEngine):
+    """A localai_tpu child process owning one model, supervised by the
+    parent: spawn on load, health-gate on first use, respawn after a crash
+    (reference: loader.go:236-270)."""
+
+    STARTUP_TIMEOUT_S = 180.0
+
+    def __init__(self, name: str, child_config: dict[str, Any],
+                 workdir: str, env_extra: Optional[dict] = None):
+        self.name = name
+        self.child_config = child_config
+        self.workdir = workdir
+        self.env_extra = env_extra or {}
+        self._proc: Optional[subprocess.Popen] = None
+        self._lock = threading.Lock()
+        self.m_respawns = 0
+        super().__init__(url="http://127.0.0.1:0")
+
+    def _spawn_locked(self) -> None:
+        import yaml
+
+        port = _free_port()
+        os.makedirs(self.workdir, exist_ok=True)
+        cfg = dict(self.child_config)
+        cfg.setdefault("name", self.name)
+        with open(os.path.join(self.workdir, f"{self.name}.yaml"), "w") as f:
+            yaml.safe_dump(cfg, f)
+        env = {**os.environ, **self.env_extra}
+        self._proc = subprocess.Popen(
+            [sys.executable, "-m", "localai_tpu", "run",
+             "--address", "127.0.0.1", "--port", str(port),
+             "--models-path", self.workdir],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        self.base_url = f"http://127.0.0.1:{port}"
+        deadline = time.monotonic() + self.STARTUP_TIMEOUT_S
+        while time.monotonic() < deadline:
+            if self._proc.poll() is not None:
+                raise RuntimeError(
+                    f"backend subprocess for {self.name!r} exited rc={self._proc.returncode}"
+                )
+            try:
+                with urllib.request.urlopen(self.base_url + "/readyz", timeout=2):
+                    log.info("backend subprocess %s ready at %s", self.name, self.base_url)
+                    return
+            except (urllib.error.URLError, ConnectionError, OSError):
+                time.sleep(0.2)
+        raise RuntimeError(f"backend subprocess for {self.name!r} did not become ready")
+
+    def ensure_up(self) -> None:
+        with self._lock:
+            if self._proc is None:
+                self._spawn_locked()
+            elif self._proc.poll() is not None:
+                # Crash containment: the child died — respawn it
+                # (reference loader.go respawn-on-crash semantics).
+                log.warning(
+                    "backend subprocess %s died rc=%s — respawning",
+                    self.name, self._proc.returncode,
+                )
+                self.m_respawns += 1
+                self._spawn_locked()
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._proc is not None and self._proc.poll() is None:
+                self._proc.terminate()
+                try:
+                    self._proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    self._proc.kill()
+            self._proc = None
+
+    def metrics(self) -> dict[str, float]:
+        alive = self._proc is not None and self._proc.poll() is None
+        return {
+            "requests": float(self.m_requests),
+            "subprocess_alive": float(alive),
+            "respawns": float(self.m_respawns),
+        }

@@ -348,6 +348,8 @@ class ModelManager:
             "diffusers": self._load_diffusion,
             "stablediffusion": self._load_diffusion,
             "detection": self._load_detection,
+            "remote": self._load_remote,
+            "subprocess": self._load_subprocess,
         }
         loader = backend_loaders.get(cfg.backend)
         if loader is None and cfg.backend == "llama" and (
@@ -499,6 +501,35 @@ class ModelManager:
         from localai_tpu.engine.audio_engine import VADEngine
 
         return LoadedModel(cfg, VADEngine(), None)
+
+    def _load_remote(self, cfg: ModelConfig) -> LoadedModel:
+        from localai_tpu.engine.remote import RemoteEngine
+
+        url = cfg.options.get("url")
+        if not url:
+            raise ValueError(f"model {cfg.name!r}: backend remote needs options.url")
+        eng = RemoteEngine(
+            url,
+            remote_model=cfg.options.get("remote_model", ""),
+            api_key=cfg.options.get("api_key", ""),
+        )
+        return LoadedModel(cfg, eng, None)
+
+    def _load_subprocess(self, cfg: ModelConfig) -> LoadedModel:
+        import os
+
+        from localai_tpu.engine.remote import SubprocessEngine
+
+        child = dict(cfg.options.get("child") or {})
+        if not child:
+            child = {"model": cfg.model, "context_size": cfg.context_size,
+                     "max_tokens": cfg.max_tokens, "max_slots": cfg.max_slots}
+        eng = SubprocessEngine(
+            cfg.name, child,
+            workdir=os.path.join(self.app_cfg.models_dir, f".subprocess-{cfg.name}"),
+            env_extra=cfg.options.get("env") or {},
+        )
+        return LoadedModel(cfg, eng, None)
 
     def _load_detection(self, cfg: ModelConfig) -> LoadedModel:
         import os

@@ -95,6 +95,49 @@ class OpenAIApi:
         except KeyError:
             raise ApiError(404, f"model {name!r} not found") from None
 
+    def _proxy_remote(self, req: Request, lm: LoadedModel, lease) -> Response | SSEStream:
+        """Relay a request to an out-of-process backend (backend: remote or
+        subprocess — the L7 seam; reference: every backend is a separate
+        gRPC process, initializers.go:50-154)."""
+        import urllib.error
+
+        eng = lm.engine
+        stream = bool((req.body or {}).get("stream"))
+        try:
+            resp = eng.request(req.path, req.body, method=req.method)
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            lease.release()
+            return Response(
+                status=e.code, body=body,
+                content_type=e.headers.get("Content-Type", "application/json"),
+            )
+        except Exception as e:  # noqa: BLE001
+            lease.release()
+            raise ApiError(502, f"remote backend failed: {e}", "server_error") from None
+        if stream and "event-stream" in (resp.headers.get("Content-Type") or ""):
+            def events():
+                try:
+                    for raw in resp:
+                        line = raw.decode("utf-8", "replace").strip()
+                        if line.startswith("data: "):
+                            payload = line[6:]
+                            if payload != "[DONE]":  # our writer adds its own
+                                yield payload
+                finally:
+                    resp.close()
+                    lease.release()
+
+            return SSEStream(events())
+        try:
+            data = resp.read()
+        finally:
+            resp.close()
+            lease.release()
+        return Response(
+            body=data, content_type=resp.headers.get("Content-Type", "application/json")
+        )
+
     def _gen_request(self, lm: LoadedModel, body: dict[str, Any], prompt_ids: list[int],
                      extra_stop: Optional[list[str]] = None) -> GenRequest:
         cfg = lm.cfg
@@ -254,6 +297,10 @@ class OpenAIApi:
         if not messages or not isinstance(messages, list):
             raise ApiError(400, "messages is required and must be a non-empty array")
         lm, lease = self._resolve(req, Usecase.CHAT)
+        from localai_tpu.engine.remote import RemoteEngine
+
+        if isinstance(lm.engine, RemoteEngine):
+            return self._proxy_remote(req, lm, lease)
         try:
             return self._chat_inner(req, lm, lease, body)
         except BaseException:
@@ -446,6 +493,10 @@ class OpenAIApi:
         if not prompts or not all(isinstance(p, str) for p in prompts):
             raise ApiError(400, "prompt must be a string or array of strings")
         lm, lease = self._resolve(req, Usecase.COMPLETION)
+        from localai_tpu.engine.remote import RemoteEngine
+
+        if isinstance(lm.engine, RemoteEngine):
+            return self._proxy_remote(req, lm, lease)
         rid = f"cmpl-{uuid.uuid4().hex[:28]}"
         created = _now()
         extra_usage = "extra-usage" in req.headers
@@ -605,6 +656,10 @@ class OpenAIApi:
         if not isinstance(inputs, list) or not inputs:
             raise ApiError(400, "input must be a non-empty string or array")
         lm, lease = self._resolve(req, Usecase.EMBEDDINGS)
+        from localai_tpu.engine.remote import RemoteEngine
+
+        if isinstance(lm.engine, RemoteEngine):
+            return self._proxy_remote(req, lm, lease)
         try:
             tok = lm.engine.tokenizer
             ids_batch: list[list[int]] = []
@@ -632,6 +687,10 @@ class OpenAIApi:
         body = req.body or {}
         content = body.get("content", "")
         lm, lease = self._resolve(req, Usecase.TOKENIZE)
+        from localai_tpu.engine.remote import RemoteEngine
+
+        if isinstance(lm.engine, RemoteEngine):
+            return self._proxy_remote(req, lm, lease)
         try:
             ids = lm.engine.tokenizer.encode(content)
         finally:

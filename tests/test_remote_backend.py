@@ -1,0 +1,166 @@
+"""Out-of-process backend tests: remote HTTP proxying (non-stream + SSE) and
+the supervised subprocess backend with crash respawn (reference:
+initializers.go backend spawn + loader.go:236-270 respawn)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+import yaml
+
+from localai_tpu.config import ApplicationConfig
+from localai_tpu.server import ModelManager, Router, create_server
+from localai_tpu.server.openai_api import OpenAIApi
+
+
+def _serve(models_dir: str):
+    app_cfg = ApplicationConfig(address="127.0.0.1", port=0, models_dir=models_dir)
+    manager = ModelManager(app_cfg)
+    router = Router()
+    OpenAIApi(manager).register(router)
+    server = create_server(app_cfg, router)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, manager, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+@pytest.fixture(scope="module")
+def remote_pair(tmp_path_factory):
+    # Worker: hosts the actual model.
+    wd = tmp_path_factory.mktemp("worker-models")
+    (wd / "real.yaml").write_text(yaml.safe_dump({
+        "name": "real", "model": "tiny", "context_size": 64,
+        "max_slots": 2, "max_tokens": 6, "temperature": 0.0,
+        "embeddings": True,
+    }))
+    wsrv, wmgr, wurl = _serve(str(wd))
+
+    # Front: a remote-backend config pointing at the worker.
+    fd = tmp_path_factory.mktemp("front-models")
+    (fd / "proxied.yaml").write_text(yaml.safe_dump({
+        "name": "proxied", "model": "remote", "backend": "remote",
+        "embeddings": True,
+        "options": {"url": wurl, "remote_model": "real"},
+    }))
+    fsrv, fmgr, furl = _serve(str(fd))
+    yield furl, wurl
+    fsrv.shutdown()
+    wsrv.shutdown()
+    fmgr.shutdown()
+    wmgr.shutdown()
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return json.loads(r.read())
+
+
+def test_remote_chat_proxied(remote_pair):
+    furl, _ = remote_pair
+    out = _post(furl, "/v1/chat/completions", {
+        "model": "proxied",
+        "messages": [{"role": "user", "content": "hi"}], "max_tokens": 4,
+    })
+    assert out["object"] == "chat.completion"
+    assert out["model"] == "real"  # the worker answered
+    assert out["choices"][0]["message"]["role"] == "assistant"
+
+
+def test_remote_stream_proxied(remote_pair):
+    furl, _ = remote_pair
+    req = urllib.request.Request(
+        furl + "/v1/chat/completions",
+        data=json.dumps({
+            "model": "proxied", "stream": True, "max_tokens": 4,
+            "messages": [{"role": "user", "content": "hi"}],
+        }).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    frames = []
+    with urllib.request.urlopen(req, timeout=300) as r:
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        for line in r:
+            line = line.decode().strip()
+            if line.startswith("data: "):
+                frames.append(line[6:])
+    assert frames[-1] == "[DONE]"
+    chunks = [json.loads(f) for f in frames[:-1]]
+    assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+
+
+def test_remote_embeddings_and_tokenize_proxied(remote_pair):
+    furl, _ = remote_pair
+    out = _post(furl, "/v1/embeddings", {"model": "proxied", "input": "abc"})
+    assert out["data"][0]["embedding"]
+    out2 = _post(furl, "/v1/tokenize", {"model": "proxied", "content": "abc"})
+    assert out2["tokens"]
+
+
+def test_remote_down_is_contained(tmp_path):
+    """A dead remote backend 502s that model — the server itself survives."""
+    d = tmp_path / "models"
+    d.mkdir()
+    (d / "dead.yaml").write_text(yaml.safe_dump({
+        "name": "dead", "model": "remote", "backend": "remote",
+        "options": {"url": "http://127.0.0.1:1"},  # nothing listens
+    }))
+    (d / "live.yaml").write_text(yaml.safe_dump({
+        "name": "live", "model": "tiny", "context_size": 64, "max_tokens": 4,
+    }))
+    srv, mgr, url = _serve(str(d))
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(url, "/v1/chat/completions", {
+                "model": "dead", "messages": [{"role": "user", "content": "x"}],
+            })
+        assert e.value.code == 502
+        out = _post(url, "/v1/chat/completions", {
+            "model": "live", "messages": [{"role": "user", "content": "x"}],
+        })
+        assert out["object"] == "chat.completion"
+    finally:
+        srv.shutdown()
+        mgr.shutdown()
+
+
+import urllib.error  # noqa: E402
+
+
+@pytest.mark.slow
+def test_subprocess_backend_spawn_and_respawn(tmp_path):
+    """The manager spawns a child serving process, proxies to it, and
+    respawns it after a crash (kill -9) — full crash containment."""
+    d = tmp_path / "models"
+    d.mkdir()
+    (d / "boxed.yaml").write_text(yaml.safe_dump({
+        "name": "boxed", "model": "subprocess", "backend": "subprocess",
+        "options": {"child": {
+            "name": "boxed", "model": "tiny", "context_size": 64,
+            "max_slots": 2, "max_tokens": 4, "temperature": 0.0,
+        }},
+    }))
+    srv, mgr, url = _serve(str(d))
+    try:
+        out = _post(url, "/v1/chat/completions", {
+            "model": "boxed", "messages": [{"role": "user", "content": "hi"}],
+        })
+        assert out["object"] == "chat.completion"
+
+        eng = mgr.peek("boxed").engine
+        assert eng.metrics()["subprocess_alive"] == 1.0
+        eng._proc.kill()
+        eng._proc.wait()
+        # Next request transparently respawns the child.
+        out2 = _post(url, "/v1/chat/completions", {
+            "model": "boxed", "messages": [{"role": "user", "content": "again"}],
+        })
+        assert out2["object"] == "chat.completion"
+        assert eng.m_respawns == 1
+    finally:
+        srv.shutdown()
+        mgr.shutdown()
