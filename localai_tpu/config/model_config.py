@@ -141,6 +141,11 @@ class ModelConfig:
             return Usecase.VAD
         if b == "diffusion" or b in ("diffusers", "stablediffusion"):
             return Usecase.IMAGE | Usecase.VIDEO
+        if b == "bert":
+            uc = Usecase.EMBEDDINGS | Usecase.TOKENIZE
+            if "rerank" in self.model.lower() or "rerank" in self.name.lower():
+                uc |= Usecase.RERANK
+            return uc
         if b == "rerank" or "rerank" in self.name.lower():
             return Usecase.RERANK
         if b == "detection":

@@ -1,0 +1,108 @@
+"""Resident engine for BERT-family encoders (embeddings + cross-encoder
+rerank). Same lifecycle surface as the other engines."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.models import bert as bert_model
+
+
+def _bucket(n: int, lo: int = 16, hi: int = 512) -> int:
+    b = lo
+    while b < min(n, hi):
+        b *= 2
+    return min(b, hi)
+
+
+class BertEngine:
+    def __init__(self, cfg: bert_model.BertConfig, params: Any, tokenizer):
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.cache = None
+        self._lock = threading.Lock()
+        self._embed_fn = jax.jit(
+            lambda p, t, l: bert_model.embed(cfg, p, t, l)
+        )
+        self._score_fn = (
+            jax.jit(lambda p, t, l, tt: bert_model.score_pairs(cfg, p, t, l, tt))
+            if cfg.num_labels > 0 else None
+        )
+        self.m_requests = 0
+        self._busy_time = 0.0
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+    def cancel_all(self) -> int:
+        return 0
+
+    def metrics(self) -> dict[str, float]:
+        return {"requests": float(self.m_requests), "busy_seconds": self._busy_time}
+
+    def embed(self, ids_batch: list[list[int]]) -> np.ndarray:
+        t0 = time.monotonic()
+        S = _bucket(max(len(x) for x in ids_batch), hi=self.cfg.max_position)
+        N = len(ids_batch)
+        toks = np.zeros((N, S), np.int32)
+        lens = np.zeros((N,), np.int32)
+        for i, ids in enumerate(ids_batch):
+            ids = ids[:S]
+            toks[i, : len(ids)] = ids
+            lens[i] = len(ids)
+        with self._lock:
+            out = np.asarray(self._embed_fn(self.params, jnp.asarray(toks), jnp.asarray(lens)))
+        self.m_requests += 1
+        self._busy_time += time.monotonic() - t0
+        return out
+
+    def rerank(self, query_ids: list[int], docs_ids: list[list[int]]) -> np.ndarray:
+        """Cross-encoder scores [N] over [CLS] q [SEP] d [SEP] rows."""
+        if self._score_fn is None:
+            raise RuntimeError(f"model {self.cfg.name!r} has no classification head")
+        t0 = time.monotonic()
+        sep = getattr(self.tokenizer, "sep_id", None)
+        cls = getattr(self.tokenizer, "cls_id", None)
+        rows, types = [], []
+        limit = self.cfg.max_position
+        q = list(query_ids)[: limit // 2]
+        for d in docs_ids:
+            d = list(d)[: limit - len(q) - 3] or [0]
+            row = ([cls] if cls is not None else []) + q
+            tt = [0] * len(row)
+            if sep is not None:
+                row += [sep]
+                tt += [0]
+            row += d
+            tt += [1] * len(d)
+            if sep is not None:
+                row += [sep]
+                tt += [1]
+            rows.append(row[:limit])
+            types.append(tt[:limit])
+        S = _bucket(max(len(r) for r in rows), hi=limit)
+        N = len(rows)
+        toks = np.zeros((N, S), np.int32)
+        tt = np.zeros((N, S), np.int32)
+        lens = np.zeros((N,), np.int32)
+        for i, (r, t) in enumerate(zip(rows, types)):
+            toks[i, : len(r)] = r
+            tt[i, : len(t)] = t
+            lens[i] = len(r)
+        with self._lock:
+            out = np.asarray(self._score_fn(
+                self.params, jnp.asarray(toks), jnp.asarray(lens), jnp.asarray(tt)
+            ))
+        self.m_requests += 1
+        self._busy_time += time.monotonic() - t0
+        return out

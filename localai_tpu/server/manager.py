@@ -350,6 +350,7 @@ class ModelManager:
             "detection": self._load_detection,
             "remote": self._load_remote,
             "subprocess": self._load_subprocess,
+            "bert": self._load_bert,
         }
         loader = backend_loaders.get(cfg.backend)
         if loader is None and cfg.backend == "llama" and (
@@ -501,6 +502,32 @@ class ModelManager:
         from localai_tpu.engine.audio_engine import VADEngine
 
         return LoadedModel(cfg, VADEngine(), None)
+
+    def _load_bert(self, cfg: ModelConfig) -> LoadedModel:
+        import os
+
+        import jax as _jax
+
+        from localai_tpu.engine.bert_engine import BertEngine
+        from localai_tpu.models import bert as B
+
+        if cfg.model in B.BERT_PRESETS:
+            bcfg = B.BERT_PRESETS[cfg.model]
+            params = B.init_params(bcfg, _jax.random.key(0))
+            tok_path = cfg.tokenizer or None
+        else:
+            ckpt_dir = self._resolve_ckpt_dir(cfg.model)
+            if not os.path.isdir(ckpt_dir):
+                raise FileNotFoundError(
+                    f"model {cfg.name!r}: bert checkpoint {ckpt_dir!r} not found"
+                )
+            bcfg = B.bert_config_from_hf(ckpt_dir)
+            params = B.load_hf_bert(bcfg, ckpt_dir)
+            tok_path = cfg.tokenizer or ckpt_dir
+        if tok_path and not _has_tokenizer_files(tok_path):
+            tok_path = None
+        tokenizer = load_tokenizer(tok_path, vocab_size=bcfg.vocab_size)
+        return LoadedModel(cfg, BertEngine(bcfg, params, tokenizer), None)
 
     def _load_remote(self, cfg: ModelConfig) -> LoadedModel:
         from localai_tpu.engine.remote import RemoteEngine

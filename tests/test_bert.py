@@ -1,0 +1,137 @@
+"""BERT-family encoder tests: masking invariants, pooling, HF round-trip,
+cross-encoder scoring, and the embeddings/rerank endpoints over the bert
+backend."""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import yaml
+
+from localai_tpu.models import bert
+
+
+@pytest.fixture(scope="module")
+def bcfg():
+    return bert.BERT_PRESETS["bert-test"]
+
+
+@pytest.fixture(scope="module")
+def bparams(bcfg):
+    return bert.init_params(bcfg, jax.random.key(0))
+
+
+def test_embed_shape_norm_and_padding_invariance(bcfg, bparams):
+    toks = jnp.zeros((2, 16), jnp.int32).at[0, :4].set(jnp.array([5, 6, 7, 8]))
+    toks = toks.at[1, :4].set(jnp.array([5, 6, 7, 8]))
+    # Row 1 has garbage in the padding region — mask must hide it.
+    toks = toks.at[1, 4:].set(99)
+    lens = jnp.array([4, 4], jnp.int32)
+    out = bert.embed(bcfg, bparams, toks, lens)
+    assert out.shape == (2, bcfg.hidden_size)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(out), axis=-1), 1.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(out[1]), atol=1e-5)
+
+
+def test_mean_pooling_differs_from_cls(bcfg, bparams):
+    import dataclasses
+
+    mean_cfg = dataclasses.replace(bcfg, pooling="mean")
+    toks = jnp.zeros((1, 16), jnp.int32).at[0, :5].set(jnp.arange(1, 6))
+    lens = jnp.array([5], jnp.int32)
+    a = bert.embed(bcfg, bparams, toks, lens)
+    b = bert.embed(mean_cfg, bparams, toks, lens)
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
+def test_hf_round_trip(bcfg, bparams, tmp_path):
+    d = str(tmp_path / "bert-ckpt")
+    bert.save_hf_bert(bcfg, bparams, d)
+    cfg2 = bert.bert_config_from_hf(d)
+    assert cfg2.hidden_size == bcfg.hidden_size
+    params2 = bert.load_hf_bert(cfg2, d)
+    toks = jnp.zeros((1, 16), jnp.int32).at[0, :3].set(jnp.array([9, 10, 11]))
+    lens = jnp.array([3], jnp.int32)
+    a = bert.embed(bcfg, bparams, toks, lens)
+    b = bert.embed(cfg2, params2, toks, lens)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_cross_encoder_scoring(tmp_path):
+    cfg = bert.BERT_PRESETS["bert-rerank-test"]
+    params = bert.init_params(cfg, jax.random.key(1))
+    toks = jnp.zeros((2, 16), jnp.int32).at[:, :6].set(
+        jnp.array([[1, 2, 3, 4, 5, 6], [1, 2, 3, 9, 9, 9]])
+    )
+    lens = jnp.array([6, 6], jnp.int32)
+    tt = jnp.zeros((2, 16), jnp.int32).at[:, 3:6].set(1)
+    scores = bert.score_pairs(cfg, params, toks, lens, tt)
+    assert scores.shape == (2,)
+    assert np.isfinite(np.asarray(scores)).all()
+    # round-trip with the classification head
+    d = str(tmp_path / "rr-ckpt")
+    bert.save_hf_bert(cfg, params, d)
+    params2 = bert.load_hf_bert(cfg, d)
+    s2 = bert.score_pairs(cfg, params2, toks, lens, tt)
+    np.testing.assert_allclose(np.asarray(scores), np.asarray(s2), atol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def api(tmp_path_factory):
+    from localai_tpu.config import ApplicationConfig
+    from localai_tpu.server import ModelManager, Router, create_server
+    from localai_tpu.server.openai_api import OpenAIApi
+    from localai_tpu.server.rerank_api import RerankApi
+
+    d = tmp_path_factory.mktemp("bert-models")
+    (d / "embedder.yaml").write_text(yaml.safe_dump({
+        "name": "embedder", "model": "bert-test", "backend": "bert",
+    }))
+    (d / "xranker.yaml").write_text(yaml.safe_dump({
+        "name": "xranker", "model": "bert-rerank-test", "backend": "bert",
+    }))
+    app_cfg = ApplicationConfig(address="127.0.0.1", port=0, models_dir=str(d))
+    manager = ModelManager(app_cfg)
+    router = Router()
+    oai = OpenAIApi(manager)
+    oai.register(router)
+    RerankApi(manager, oai).register(router)
+    server = create_server(app_cfg, router)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+    manager.shutdown()
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return json.loads(r.read())
+
+
+def test_bert_embeddings_endpoint(api):
+    out = _post(api, "/v1/embeddings", {
+        "model": "embedder", "input": ["hello world", "goodbye"],
+    })
+    assert len(out["data"]) == 2
+    vec = out["data"][0]["embedding"]
+    assert len(vec) == bert.BERT_PRESETS["bert-test"].hidden_size
+    assert abs(sum(v * v for v in vec) - 1.0) < 1e-3
+
+
+def test_bert_rerank_endpoint(api):
+    out = _post(api, "/v1/rerank", {
+        "model": "xranker", "query": "what is a cat",
+        "documents": ["cats are felines", "airplane engines", "dogs"],
+        "top_n": 3,
+    })
+    assert len(out["results"]) == 3
+    scores = [r["relevance_score"] for r in out["results"]]
+    assert scores == sorted(scores, reverse=True)
