@@ -2,8 +2,10 @@
 
 Reference: pkg/downloader/uri.go (schemes huggingface://, file://, http(s)
 at uri.go:27-37; `.partial` + HTTP Range resume + SHA verification at
-uri.go:373-459). OCI/ollama pulls are out of scope for the TPU rebuild's
-first rounds (models are HF safetensors, not container layers).
+uri.go:373-459), pkg/downloader/huggingface.go (Hub API), pkg/oci
+(ollama/OCI registry pulls).
 """
 
 from localai_tpu.downloader.uri import DownloadError, download, resolve_uri  # noqa: F401
+from localai_tpu.downloader.hf_api import fetch_hf_model, list_repo_files  # noqa: F401
+from localai_tpu.downloader.oci import pull_ollama, resolve_model_uri  # noqa: F401

@@ -74,6 +74,7 @@ def download(
     sha256: Optional[str] = None,
     progress: Optional[ProgressCb] = None,
     timeout: float = 60.0,
+    headers: Optional[dict] = None,
 ) -> str:
     """Fetch `uri` to `dest` with resume + checksum verify; returns dest.
 
@@ -102,10 +103,10 @@ def download(
             progress(size, size)
     elif url.startswith(("http://", "https://")):
         offset = os.path.getsize(partial) if os.path.exists(partial) else 0
-        headers = {"User-Agent": "localai-tpu"}
+        hdrs = {"User-Agent": "localai-tpu", **(headers or {})}
         if offset:
-            headers["Range"] = f"bytes={offset}-"
-        req = urllib.request.Request(url, headers=headers)
+            hdrs["Range"] = f"bytes={offset}-"
+        req = urllib.request.Request(url, headers=hdrs)
         try:
             resp = urllib.request.urlopen(req, timeout=timeout)
         except urllib.error.HTTPError as e:

@@ -1,0 +1,183 @@
+"""HF Hub API client and OCI/ollama puller tests against local fake servers
+(zero-egress environment — the protocol, not the internet, is under test)."""
+
+import hashlib
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from localai_tpu.downloader import fetch_hf_model, list_repo_files, pull_ollama
+from localai_tpu.downloader.hf_api import checkpoint_files
+from localai_tpu.downloader.oci import resolve_model_uri
+
+
+class FakeHub:
+    """Minimal HF Hub: /api/models/<repo>/tree/<branch> + resolve files."""
+
+    FILES = {
+        "config.json": b'{"model_type": "llama"}',
+        "model.safetensors": b"WEIGHTS" * 100,
+        "tokenizer.json": b'{"version": "1.0"}',
+        "README.md": b"# nope",  # must be skipped
+        "tf_model.safetensors": b"tensorflow",  # must be skipped
+    }
+
+    def __init__(self):
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path.startswith("/api/models/"):
+                    entries = [
+                        {"type": "file", "path": name, "size": len(blob)}
+                        for name, blob in outer.FILES.items()
+                    ]
+                    body = json.dumps(entries).encode()
+                    ctype = "application/json"
+                else:  # /owner/repo/resolve/main/<file>
+                    name = self.path.rsplit("/", 1)[-1]
+                    body = outer.FILES.get(name, b"")
+                    if not body:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    ctype = "application/octet-stream"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()
+
+
+class FakeRegistry:
+    """OCI distribution subset: token auth, manifest, blobs."""
+
+    def __init__(self, require_auth=True):
+        blob = b"GGUFMODELDATA" * 64
+        digest = "sha256:" + hashlib.sha256(blob).hexdigest()
+        self.blob, self.digest = blob, digest
+        self.token_requests = 0
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code, obj, headers=None):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                authed = self.headers.get("Authorization") == "Bearer testtoken"
+                if self.path.startswith("/token"):
+                    outer.token_requests += 1
+                    self._json(200, {"token": "testtoken"})
+                elif self.path.startswith("/v2/") and "/manifests/" in self.path:
+                    if require_auth and not authed:
+                        self._json(401, {"errors": []}, {
+                            "WWW-Authenticate":
+                                f'Bearer realm="http://127.0.0.1:{outer.port}/token",'
+                                f'service="reg"',
+                        })
+                        return
+                    self._json(200, {
+                        "schemaVersion": 2,
+                        "layers": [
+                            {"mediaType": "application/vnd.ollama.image.template",
+                             "digest": "sha256:dead", "size": 10},
+                            {"mediaType": "application/vnd.ollama.image.model",
+                             "digest": outer.digest, "size": len(outer.blob)},
+                        ],
+                    })
+                elif "/blobs/" in self.path:
+                    if require_auth and not authed:
+                        self.send_response(401)
+                        self.end_headers()
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(outer.blob)))
+                    self.end_headers()
+                    self.wfile.write(outer.blob)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()
+
+
+def test_hf_api_listing_and_fetch(tmp_path, monkeypatch):
+    hub = FakeHub()
+    try:
+        monkeypatch.setenv("HF_ENDPOINT", hub.url)
+        files = list_repo_files("acme/tiny-llm")
+        assert {f["path"] for f in files} == set(FakeHub.FILES)
+        wanted = checkpoint_files(files)
+        assert "README.md" not in wanted and "tf_model.safetensors" not in wanted
+        assert set(wanted) == {"config.json", "model.safetensors", "tokenizer.json"}
+
+        seen = []
+        out = fetch_hf_model("acme/tiny-llm", str(tmp_path / "ckpt"),
+                             progress=lambda p, d, t: seen.append(p))
+        assert len(out) == 3
+        assert (tmp_path / "ckpt" / "model.safetensors").read_bytes() == FakeHub.FILES["model.safetensors"]
+        assert seen, "progress callback must fire"
+    finally:
+        hub.stop()
+
+
+def test_ollama_pull_with_token_auth(tmp_path, monkeypatch):
+    reg = FakeRegistry(require_auth=True)
+    try:
+        monkeypatch.setenv("OLLAMA_REGISTRY", reg.url)
+        path = pull_ollama("tinymodel:latest", str(tmp_path))
+        assert open(path, "rb").read() == reg.blob
+        assert reg.token_requests >= 1, "anonymous token dance must run"
+        assert path.endswith("tinymodel-latest.bin")
+    finally:
+        reg.stop()
+
+
+def test_oci_uri_scheme(tmp_path):
+    reg = FakeRegistry(require_auth=False)
+    try:
+        host = reg.url[len("http://"):]
+        # resolve_model_uri builds https:// for oci://; patch via direct call
+        from localai_tpu.downloader.oci import pull_oci_blob
+
+        path = pull_oci_blob(reg.url, "acme/model", "v1", str(tmp_path))
+        assert open(path, "rb").read() == reg.blob
+    finally:
+        reg.stop()
+
+
+def test_oci_bad_uri_rejected(tmp_path):
+    from localai_tpu.downloader import DownloadError
+
+    with pytest.raises(DownloadError):
+        resolve_model_uri("oci://no-slash", str(tmp_path))
+    with pytest.raises(DownloadError):
+        resolve_model_uri("weird://x", str(tmp_path))
