@@ -110,18 +110,53 @@ def main() -> None:
     )
     eng.stop()
 
-    print(
-        json.dumps(
-            {
-                "metric": f"decode_tokens_per_sec_{arch}_bs{slots}",
-                "value": round(decode_tps, 2),
-                "unit": "tok/s",
-                "vs_baseline": None,
-                "p50_ttft_ms": round(p50_ttft * 1000, 1),
-                "pct_of_hbm_roofline": round(pct, 1),
-            }
+    out = {
+        "metric": f"decode_tokens_per_sec_{arch}_bs{slots}",
+        "value": round(decode_tps, 2),
+        "unit": "tok/s",
+        "vs_baseline": None,
+        "p50_ttft_ms": round(p50_ttft * 1000, 1),
+        "pct_of_hbm_roofline": round(pct, 1),
+    }
+
+    # Long-context row (VERDICT #7): one near-max-bucket prompt through the
+    # flash prefill path; second run reported (first pays the compile).
+    default_long = "8192" if jax.default_backend() == "tpu" else "0"
+    long_ctx = int(os.environ.get("BENCH_LONG_CTX", default_long))
+    if long_ctx:
+        # Free the main engine's cache before allocating the long one.
+        eng.cache = None
+        eng.params = None
+        import gc
+
+        gc.collect()
+        eng_long = Engine(
+            cfg,
+            params,
+            ByteTokenizer(cfg.vocab_size),
+            engine_cfg=EngineConfig(max_slots=1, max_seq=long_ctx),
         )
-    )
+        long_prompt = [(j % 255) + 1 for j in range(long_ctx - 32)]
+        try:
+            # warmup stabilizes state avals — without it every admission at
+            # this bucket retraces and the row measures the compiler.
+            eng_long.warmup(len(long_prompt))
+            _, ev = eng_long.generate(long_prompt, max_new_tokens=8, ignore_eos=True)
+            out["long_ctx_prompt_tokens"] = len(long_prompt)
+            out["long_ctx_prefill_ms"] = round(ev.timing_prompt_processing * 1000, 1)
+            out["long_ctx_prefill_tok_per_s"] = round(
+                len(long_prompt) / max(ev.timing_prompt_processing, 1e-9), 1
+            )
+            print(
+                f"long-context: {len(long_prompt)} tokens prefill in "
+                f"{ev.timing_prompt_processing * 1000:.1f}ms",
+                file=sys.stderr,
+            )
+        except Exception as e:  # noqa: BLE001 — long row is best-effort
+            print(f"long-context row failed: {type(e).__name__}: {e}", file=sys.stderr)
+        eng_long.stop()
+
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -209,6 +209,7 @@ def main(argv: list[str] | None = None) -> int:
     from localai_tpu.server.gallery_api import GalleryApi
     from localai_tpu.server.image_api import ImageApi
     from localai_tpu.server.mcp_api import McpApi, make_job_runner
+    from localai_tpu.server.models_api import ModelsApi
     from localai_tpu.server.openapi import register_openapi
     from localai_tpu.services import AgentJobService
     from localai_tpu.server.realtime_api import RealtimeApi
@@ -240,6 +241,7 @@ def main(argv: list[str] | None = None) -> int:
     jobs.start()
     McpApi(manager, oai, jobs=jobs).register(router)
     SettingsApi(app_cfg, manager).register(router)
+    ModelsApi(manager).register(router)
     register_openapi(router)
     register_webui(router)
 

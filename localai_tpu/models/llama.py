@@ -137,8 +137,11 @@ def _attn_proj_qkv(cfg: ArchConfig, lp: Params, x: jnp.ndarray):
 
 
 def _unembed(cfg: ArchConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
+    # bf16 operands with f32 MXU accumulation: casting the [V, D] matrix to
+    # f32 would double its HBM traffic on every decode step (the unembed is
+    # the single largest weight read at 128k vocabs).
     w = params["embed"] if cfg.tie_embeddings else params["lm_head"]
-    return (h.astype(jnp.float32) @ w.astype(jnp.float32).T)
+    return jnp.dot(h.astype(w.dtype), w.T, preferred_element_type=jnp.float32)
 
 
 def _forward_hidden(

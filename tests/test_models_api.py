@@ -1,0 +1,142 @@
+"""Model import/edit/reload API tests (reference: import_model.go,
+edit_model.go, ReloadModelsEndpoint)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+import yaml
+
+from localai_tpu.config import ApplicationConfig
+from localai_tpu.server import ModelManager, Router, create_server
+from localai_tpu.server.models_api import ModelsApi
+from localai_tpu.server.openai_api import OpenAIApi
+
+
+@pytest.fixture()
+def api(tmp_path):
+    d = tmp_path / "models"
+    d.mkdir()
+    (d / "base.yaml").write_text(yaml.safe_dump({
+        "name": "base", "model": "tiny", "context_size": 64, "max_tokens": 4,
+        "temperature": 0.0,
+    }))
+    app_cfg = ApplicationConfig(address="127.0.0.1", port=0, models_dir=str(d))
+    manager = ModelManager(app_cfg)
+    router = Router()
+    OpenAIApi(manager).register(router)
+    ModelsApi(manager).register(router)
+    server = create_server(app_cfg, router)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{server.server_address[1]}", manager, d
+    server.shutdown()
+    manager.shutdown()
+
+
+def _post(base, path, payload=None, method=None):
+    data = json.dumps(payload).encode() if payload is not None else b"{}"
+    req = urllib.request.Request(
+        base + path, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return json.loads(r.read()), r.status
+
+
+def test_import_model_and_serve(api):
+    base, manager, d = api
+    out, status = _post(base, "/models/import", {
+        "name": "imported", "model": "tiny", "context_size": 64,
+        "max_tokens": 4, "temperature": 0.0,
+    })
+    assert status == 201
+    assert (d / "imported.yaml").exists()
+    # Served immediately, no restart.
+    out, _ = _post(base, "/v1/chat/completions", {
+        "model": "imported", "messages": [{"role": "user", "content": "x"}],
+    })
+    assert out["model"] == "imported"
+
+
+def test_import_uri_preset_and_file(api, tmp_path):
+    base, manager, d = api
+    out, status = _post(base, "/models/import-uri", {"uri": "tiny", "name": "quick"})
+    assert status == 201 and out["status"] == "installed"
+    assert manager.configs.get("quick") is not None
+
+    # file:// checkpoint dir
+    import jax
+
+    from localai_tpu.engine.weights import save_hf_checkpoint
+    from localai_tpu.models import get_arch
+    from localai_tpu.models.llama import init_params
+
+    cfg = get_arch("tiny")
+    ckpt = tmp_path / "ckpt"
+    save_hf_checkpoint(cfg, init_params(cfg, jax.random.key(0)), str(ckpt))
+    out, status = _post(base, "/models/import-uri", {
+        "uri": f"file://{ckpt}", "name": "fromdisk",
+        "preferences": {"context_size": 64, "max_tokens": 4},
+    })
+    assert status == 201
+    out, _ = _post(base, "/v1/chat/completions", {
+        "model": "fromdisk", "messages": [{"role": "user", "content": "x"}],
+    })
+    assert out["model"] == "fromdisk"
+
+
+def test_import_uri_hf_async_job(api, tmp_path, monkeypatch):
+    """huggingface:// imports run as polled async jobs backed by the HF API
+    client — here against the fake hub from test_hf_oci."""
+    from tests.test_hf_oci import FakeHub
+
+    hub = FakeHub()
+    try:
+        monkeypatch.setenv("HF_ENDPOINT", hub.url)
+        base, manager, d = api
+        out, status = _post(base, "/models/import-uri", {
+            "uri": "huggingface://acme/tiny-llm", "name": "hf-model",
+        })
+        assert status == 202
+        uid = out["uuid"]
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            job, _ = _post(base, f"/models/import-jobs/{uid}", method="GET")
+            if job["processed"]:
+                break
+            time.sleep(0.1)
+        assert job["processed"] and job["error"] is None, job
+        assert manager.configs.get("hf-model") is not None
+        assert (d / "hf-model" / "model.safetensors").exists()
+    finally:
+        hub.stop()
+
+
+def test_edit_model_evicts_and_applies(api):
+    base, manager, d = api
+    lm = manager.get("base")
+    out, _ = _post(base, "/models/edit/base", {"max_tokens": 9})
+    assert out["max_tokens"] == 9
+    assert manager.configs.get("base").max_tokens == 9
+    deadline = time.time() + 15
+    while manager.peek("base") is not None and time.time() < deadline:
+        time.sleep(0.05)
+    assert manager.peek("base") is None, "stale engine must be evicted"
+    # persisted
+    on_disk = yaml.safe_load((d / "base.yaml").read_text())
+    assert on_disk["max_tokens"] == 9
+
+
+def test_edit_unknown_and_reload(api):
+    base, manager, d = api
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(base, "/models/edit/nope", {"max_tokens": 2})
+    assert e.value.code == 404
+    (d / "extra.yaml").write_text(yaml.safe_dump({
+        "name": "extra", "model": "tiny", "max_tokens": 2,
+    }))
+    out, _ = _post(base, "/models/reload")
+    assert "extra" in out["models"]
