@@ -1,0 +1,178 @@
+"""Fast byte-level BPE encode path: exact GPT-2/llama-3 pre-tokenization in
+Python (`regex`), merge loop in C++ (localai_tpu.native.bpe).
+
+Reference: llama.cpp's C++ tokenizer (llm_tokenizer_bpe) is the encode hot
+path behind every request; here the same split — the regex and byte mapping
+are cheap and stay in Python, the quadratic merge loop goes native.
+
+Safety: FastBPE SELF-VALIDATES against the HF tokenizer on a canary suite at
+construction; any mismatch disables it (HFTokenizer silently keeps the
+transformers path). LOCALAI_NATIVE_BPE=0 opts out entirely.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from functools import lru_cache
+from typing import Optional
+
+log = logging.getLogger("localai_tpu.bpe")
+
+# GPT-2's pattern; llama-3 ships its own (read from tokenizer.json when set).
+GPT2_PATTERN = (
+    r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"
+)
+
+_CANARIES = (
+    "Hello, world!",
+    "  leading spaces and\ttabs\nnewlines",
+    "mixedCASE word123 456",
+    "unicode: Ωμέγα — 你好, мир! 🙂",
+    "code: def f(x): return x*2  # comment",
+    "don't can't I'll we've",
+    "",
+    " ",
+)
+
+
+@lru_cache(maxsize=1)
+def _bytes_to_unicode() -> dict[int, str]:
+    bs = list(range(ord("!"), ord("~") + 1)) + list(range(0xA1, 0xAD)) + list(range(0xAE, 0x100))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {b: chr(c) for b, c in zip(bs, cs)}
+
+
+def _extract_split_pattern(pre_tok: Optional[dict]) -> tuple[str, bool]:
+    """(regex pattern, add_prefix_space) from a tokenizer.json pre_tokenizer."""
+    pattern = GPT2_PATTERN
+    add_prefix_space = False
+    if not pre_tok:
+        return pattern, add_prefix_space
+    nodes = pre_tok.get("pretokenizers", [pre_tok])
+    for node in nodes:
+        t = node.get("type")
+        if t == "Split":
+            pat = node.get("pattern") or {}
+            pattern = pat.get("Regex") or pat.get("String") or pattern
+        elif t == "ByteLevel":
+            add_prefix_space = bool(node.get("add_prefix_space", False))
+            if not node.get("use_regex", True):
+                continue
+    return pattern, add_prefix_space
+
+
+class FastBPE:
+    """Encode-only byte-level BPE mirroring an HF fast tokenizer."""
+
+    def __init__(self, tokenizer_json_path: str):
+        import regex
+
+        from localai_tpu.native import NativeBPE
+
+        with open(tokenizer_json_path) as f:
+            tj = json.load(f)
+        model = tj.get("model") or {}
+        if model.get("type") != "BPE":
+            raise ValueError("not a BPE tokenizer")
+        pre = tj.get("pre_tokenizer") or {}
+        kinds = {n.get("type") for n in pre.get("pretokenizers", [pre])}
+        if "ByteLevel" not in kinds:
+            raise ValueError("not byte-level BPE")
+        vocab: dict[str, int] = model["vocab"]
+        merges_raw = model.get("merges") or []
+        merges = [
+            tuple(m) if isinstance(m, list) else tuple(m.split(" ", 1))
+            for m in merges_raw
+        ]
+        self._native = NativeBPE(vocab, merges)  # raises when lib unavailable
+        pattern, self.add_prefix_space = _extract_split_pattern(pre)
+        self._split = regex.compile(pattern)
+        self._b2u = _bytes_to_unicode()
+        # Added/special tokens split the text before BPE runs.
+        self._added = {
+            t["content"]: int(t["id"])
+            for t in tj.get("added_tokens") or []
+        }
+        self._added_sorted = sorted(self._added, key=len, reverse=True)
+        self._piece_cache: dict[str, list[int]] = {}
+
+    # ------------------------------------------------------------------ #
+
+    def _encode_plain(self, text: str) -> list[int]:
+        out: list[int] = []
+        cache = self._piece_cache
+        b2u = self._b2u
+        for piece in self._split.findall(text):
+            ids = cache.get(piece)
+            if ids is None:
+                mapped = "".join(b2u[b] for b in piece.encode("utf-8"))
+                ids = self._native.encode_piece(mapped)
+                if len(cache) < 200_000:
+                    cache[piece] = ids
+            out.extend(ids)
+        return out
+
+    def encode(self, text: str) -> list[int]:
+        if self.add_prefix_space and text and not text.startswith(" "):
+            text = " " + text
+        if not self._added:
+            return self._encode_plain(text)
+        out: list[int] = []
+        rest = text
+        while rest:
+            # Earliest occurrence of any added token wins; longest at a tie.
+            best_pos, best_tok = -1, None
+            for tok in self._added_sorted:
+                pos = rest.find(tok)
+                if pos != -1 and (best_pos == -1 or pos < best_pos):
+                    best_pos, best_tok = pos, tok
+            if best_tok is None:
+                out.extend(self._encode_plain(rest))
+                break
+            if best_pos:
+                out.extend(self._encode_plain(rest[:best_pos]))
+            out.append(self._added[best_tok])
+            rest = rest[best_pos + len(best_tok):]
+        return out
+
+    # ------------------------------------------------------------------ #
+
+    @classmethod
+    def for_hf_dir(cls, path: str, hf_tokenizer) -> Optional["FastBPE"]:
+        """Build + self-validate against the HF tokenizer; None on any
+        mismatch or missing prerequisites."""
+        if os.environ.get("LOCALAI_NATIVE_BPE", "1") == "0":
+            return None
+        tj = os.path.join(path, "tokenizer.json")
+        if not os.path.exists(tj):
+            return None
+        try:
+            fast = cls(tj)
+        except Exception as e:  # noqa: BLE001 — fall back quietly
+            log.debug("FastBPE unavailable for %s: %s", path, e)
+            return None
+        canaries = list(_CANARIES) + [
+            f"system {t} user" for t in list(fast._added)[:4]
+        ]
+        for text in canaries:
+            try:
+                want = hf_tokenizer.encode(text, add_special_tokens=False)
+                got = fast.encode(text)
+            except Exception:  # noqa: BLE001
+                return None
+            if got != want:
+                log.info(
+                    "FastBPE disabled for %s (mismatch on %r: %s != %s)",
+                    path, text[:40], got[:8], want[:8],
+                )
+                return None
+        log.info("native BPE encode active for %s", path)
+        return fast

@@ -68,6 +68,11 @@ class HFTokenizer:
 
         self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
         self.vocab_size = len(self._tok)
+        # Native-C++ BPE encode hot path (self-validated; None on any
+        # mismatch or when the toolchain/library is unavailable).
+        from localai_tpu.engine.bpe_fast import FastBPE
+
+        self._fast = FastBPE.for_hf_dir(path, self._tok)
         self.bos_id = self._tok.bos_token_id
         eos = self._tok.eos_token_id
         eos_ids = [eos] if isinstance(eos, int) else list(eos or [])
@@ -79,7 +84,10 @@ class HFTokenizer:
         self.eos_ids = tuple(eos_ids)
 
     def encode(self, text: str, add_bos: bool = False) -> list[int]:
-        ids = self._tok.encode(text, add_special_tokens=False)
+        if self._fast is not None:
+            ids = self._fast.encode(text)
+        else:
+            ids = self._tok.encode(text, add_special_tokens=False)
         if add_bos and self.bos_id is not None:
             ids = [self.bos_id] + ids
         return ids

@@ -1,0 +1,111 @@
+"""Native (C++) runtime components, built on demand with the system g++.
+
+The TPU compute path is already native (XLA-compiled); this package covers
+host-side hot paths the reference implements in C++ — currently the
+byte-level BPE merge engine (reference: llama.cpp's llm_tokenizer_bpe via
+backend/cpp/llama-cpp). Build artifacts land in ~/.cache/localai_tpu/native
+keyed by source hash; a missing/failed toolchain degrades to the pure-Python
+paths, never to an error.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+log = logging.getLogger("localai_tpu.native")
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+_lock = threading.Lock()
+_lib_cache: dict[str, Optional[ctypes.CDLL]] = {}
+
+
+def _build(name: str) -> Optional[ctypes.CDLL]:
+    """Compile native/<name>.cpp → cached .so; None when unbuildable."""
+    src = os.path.join(_SRC_DIR, f"{name}.cpp")
+    if not os.path.exists(src):
+        return None
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = os.path.expanduser("~/.cache/localai_tpu/native")
+    os.makedirs(cache_dir, exist_ok=True)
+    so_path = os.path.join(cache_dir, f"lib{name}-{digest}.so")
+    if not os.path.exists(so_path):
+        tmp = so_path + ".tmp"
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so_path)
+        except (subprocess.SubprocessError, OSError) as e:
+            log.warning("native build of %s failed (%s); using Python path", name, e)
+            return None
+    try:
+        return ctypes.CDLL(so_path)
+    except OSError as e:
+        log.warning("could not load %s: %s", so_path, e)
+        return None
+
+
+def load_library(name: str) -> Optional[ctypes.CDLL]:
+    with _lock:
+        if name not in _lib_cache:
+            _lib_cache[name] = _build(name)
+        return _lib_cache[name]
+
+
+class NativeBPE:
+    """ctypes wrapper over the C++ BPE merge engine.
+
+    Raises RuntimeError when the native library is unavailable — callers
+    (engine.bpe_fast.FastBPE) fall back to Python.
+    """
+
+    def __init__(self, vocab: dict[str, int], merges: list[tuple[str, str]]):
+        lib = load_library("bpe")
+        if lib is None:
+            raise RuntimeError("native bpe library unavailable")
+        lib.bpe_new.restype = ctypes.c_void_p
+        lib.bpe_new.argtypes = [ctypes.c_char_p, ctypes.c_long,
+                                ctypes.c_char_p, ctypes.c_long]
+        lib.bpe_encode_piece.restype = ctypes.c_int
+        lib.bpe_encode_piece.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ]
+        lib.bpe_free.argtypes = [ctypes.c_void_p]
+        self._lib = lib
+
+        # id = line number: emit vocab ordered by id (dense ids expected).
+        n = max(vocab.values()) + 1 if vocab else 0
+        by_id = [""] * n
+        for tok, i in vocab.items():
+            if 0 <= i < n:
+                by_id[i] = tok
+        vocab_blob = "\n".join(by_id).encode("utf-8")
+        merges_blob = "\n".join(f"{a} {b}" for a, b in merges).encode("utf-8")
+        self._handle = lib.bpe_new(vocab_blob, len(vocab_blob),
+                                   merges_blob, len(merges_blob))
+        if not self._handle:
+            raise RuntimeError("bpe_new failed")
+        self._out = (ctypes.c_int32 * 4096)()
+
+    def encode_piece(self, piece: str) -> list[int]:
+        data = piece.encode("utf-8")
+        n = self._lib.bpe_encode_piece(self._handle, data, len(data),
+                                       self._out, len(self._out))
+        if n < 0:
+            raise ValueError(f"native BPE could not encode piece {piece!r}")
+        return list(self._out[:n])
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            try:
+                self._lib.bpe_free(handle)
+            except Exception:  # noqa: BLE001 — interpreter teardown
+                pass

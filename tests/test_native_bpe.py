@@ -1,0 +1,121 @@
+"""Native C++ BPE tests: byte-for-byte parity with a real HF byte-level BPE
+tokenizer (trained in-test with the tokenizers library — zero egress), fuzz
+over random strings, special-token splitting, fallback behavior, and the
+HFTokenizer wiring."""
+
+import json
+import os
+import random
+import string
+
+import pytest
+
+pytest.importorskip("tokenizers")
+
+
+@pytest.fixture(scope="module")
+def hf_dir(tmp_path_factory):
+    """Train a small byte-level BPE and save HF-loadable files."""
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+    from tokenizers.trainers import BpeTrainer
+
+    corpus = [
+        "the quick brown fox jumps over the lazy dog",
+        "hello world, hello tokens, hello merges",
+        "def function(x): return x + 1  # python code",
+        "numbers 123 456 7890 and punctuation!?",
+        "unicode Ωμέγα 你好 мир",
+        "don't can't won't we've they'll",
+    ] * 50
+    tok = Tokenizer(models.BPE())
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = BpeTrainer(
+        vocab_size=700,
+        special_tokens=["<|end|>", "<|sys|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    tok.train_from_iterator(corpus, trainer)
+    d = tmp_path_factory.mktemp("bpe-tok")
+    tok.save(str(d / "tokenizer.json"))
+    (d / "tokenizer_config.json").write_text(json.dumps({
+        "tokenizer_class": "PreTrainedTokenizerFast",
+        "eos_token": "<|end|>",
+    }))
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def hf_tok(hf_dir):
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(hf_dir, local_files_only=True)
+
+
+def test_native_library_builds():
+    from localai_tpu.native import load_library
+
+    lib = load_library("bpe")
+    assert lib is not None, "g++ build of the native BPE library failed"
+
+
+def test_fastbpe_parity_and_fuzz(hf_dir, hf_tok):
+    from localai_tpu.engine.bpe_fast import FastBPE
+
+    fast = FastBPE.for_hf_dir(hf_dir, hf_tok)
+    assert fast is not None, "self-validation rejected the fast path"
+
+    rng = random.Random(0)
+    alphabet = string.ascii_letters + string.digits + " .,!?'\t\n()#+-*/" + "Ωμ你好м"
+    samples = [
+        "the quick brown fox",
+        "   spaces   everywhere   ",
+        "don't stop",
+        "x" * 500,
+        "",
+    ] + [
+        "".join(rng.choice(alphabet) for _ in range(rng.randrange(1, 120)))
+        for _ in range(200)
+    ]
+    for text in samples:
+        assert fast.encode(text) == hf_tok.encode(text, add_special_tokens=False), repr(text)
+
+
+def test_fastbpe_special_token_splitting(hf_dir, hf_tok):
+    from localai_tpu.engine.bpe_fast import FastBPE
+
+    fast = FastBPE.for_hf_dir(hf_dir, hf_tok)
+    text = "<|sys|>You are terse.<|end|>hello<|end|>"
+    assert fast.encode(text) == hf_tok.encode(text, add_special_tokens=False)
+
+
+def test_hftokenizer_uses_fast_path(hf_dir):
+    from localai_tpu.engine.tokenizer import HFTokenizer
+
+    t = HFTokenizer(hf_dir)
+    assert t._fast is not None
+    text = "hello world <|end|> again"
+    assert t.encode(text) == t._tok.encode(text, add_special_tokens=False)
+    # env kill-switch falls back cleanly
+    os.environ["LOCALAI_NATIVE_BPE"] = "0"
+    try:
+        t2 = HFTokenizer(hf_dir)
+        assert t2._fast is None
+        assert t2.encode(text) == t.encode(text)
+    finally:
+        os.environ.pop("LOCALAI_NATIVE_BPE")
+
+
+def test_validation_rejects_mismatched_tokenizer(hf_dir, hf_tok, tmp_path):
+    """Corrupt merges → canary mismatch → fast path disabled, not wrong."""
+    import shutil
+
+    from localai_tpu.engine.bpe_fast import FastBPE
+
+    d = tmp_path / "broken"
+    shutil.copytree(hf_dir, d)
+    tj = json.loads((d / "tokenizer.json").read_text())
+    tj["model"]["merges"] = tj["model"]["merges"][::-1]  # scramble ranks
+    (d / "tokenizer.json").write_text(json.dumps(tj))
+    fast = FastBPE.for_hf_dir(str(d), hf_tok)
+    assert fast is None
