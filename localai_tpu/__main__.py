@@ -52,6 +52,13 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     fed.add_argument("--debug", action="store_true")
 
+    exp = sub.add_parser("explorer", help="run the federation directory server")
+    exp.add_argument("--address", default="0.0.0.0")
+    exp.add_argument("--port", type=int, default=8090)
+    exp.add_argument("--db", default="explorer.json")
+    exp.add_argument("--discovery-interval", type=float, default=30.0)
+    exp.add_argument("--debug", action="store_true")
+
     models = sub.add_parser("models", help="list configured models")
     models.add_argument("--models-path", default=None)
 
@@ -163,6 +170,20 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.command == "federated":
         return _run_federated(args)
+
+    if args.command == "explorer":
+        logging.basicConfig(level=logging.DEBUG if args.debug else logging.INFO)
+        from localai_tpu.explorer import ExplorerServer
+
+        ex = ExplorerServer(args.db, address=args.address, port=args.port,
+                            discovery_interval_s=args.discovery_interval)
+        ex.start()
+        logging.getLogger("localai_tpu").info(
+            "explorer on %s:%d (db: %s)", args.address, ex.port, args.db
+        )
+        signal.sigwait({signal.SIGINT, signal.SIGTERM})
+        ex.stop()
+        return 0
 
     if args.command in ("transcribe", "tts"):
         return _run_local_audio(args)

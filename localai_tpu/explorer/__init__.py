@@ -1,0 +1,8 @@
+"""Explorer: a public directory of serving federations."""
+
+from localai_tpu.explorer.explorer import (  # noqa: F401
+    Database,
+    DiscoveryService,
+    NetworkEntry,
+)
+from localai_tpu.explorer.server import ExplorerServer  # noqa: F401

@@ -1,0 +1,154 @@
+"""Explorer database + discovery.
+
+Reference: core/explorer/database.go (JSON-persisted token directory) and
+discovery.go (periodic liveness probes; entries past a failure threshold are
+dropped). TPU redesign: the directory lists FEDERATIONS (router URLs) rather
+than libp2p network tokens — a TPU fleet's discoverable unit is an HTTP
+front door, not a DHT swarm. Probes collect worker and model counts so the
+dashboard can show capacity at a glance.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+import time
+import urllib.request
+from typing import Optional
+
+log = logging.getLogger("localai_tpu.explorer")
+
+
+@dataclasses.dataclass
+class NetworkEntry:
+    name: str
+    url: str  # federation router base URL
+    description: str = ""
+    added_at: float = 0.0
+    online: bool = False
+    failures: int = 0
+    workers: int = 0
+    models: list = dataclasses.field(default_factory=list)
+    last_checked: float = 0.0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Database:
+    """JSON-persisted directory (database.go semantics: Get/Set/Delete/List
+    with atomic save on every mutation)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._entries: dict[str, NetworkEntry] = {}
+        self._load()
+
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+            for d in data.get("networks", []):
+                e = NetworkEntry(**d)
+                self._entries[e.name] = e
+        except (json.JSONDecodeError, TypeError) as e:
+            log.warning("could not load explorer db %s: %s", self.path, e)
+
+    def _save_locked(self) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"networks": [e.to_dict() for e in self._entries.values()]}, f, indent=1
+            )
+        os.replace(tmp, self.path)
+
+    def get(self, name: str) -> Optional[NetworkEntry]:
+        with self._lock:
+            return self._entries.get(name)
+
+    def set(self, entry: NetworkEntry) -> None:
+        if not entry.added_at:
+            entry.added_at = time.time()
+        with self._lock:
+            self._entries[entry.name] = entry
+            self._save_locked()
+
+    def delete(self, name: str) -> bool:
+        with self._lock:
+            if self._entries.pop(name, None) is None:
+                return False
+            self._save_locked()
+            return True
+
+    def list(self) -> list[NetworkEntry]:
+        with self._lock:
+            return sorted(self._entries.values(), key=lambda e: e.name)
+
+
+class DiscoveryService:
+    """Periodic liveness probing (discovery.go): each network's federation
+    endpoint is polled; `failure_threshold` consecutive failures drop it."""
+
+    def __init__(self, db: Database, interval_s: float = 30.0,
+                 failure_threshold: int = 3):
+        self.db = db
+        self.interval_s = interval_s
+        self.failure_threshold = failure_threshold
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="explorer-discovery")
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def probe(self, entry: NetworkEntry) -> NetworkEntry:
+        """One liveness check; mutates + persists the entry."""
+        base = entry.url.rstrip("/")
+        try:
+            with urllib.request.urlopen(base + "/federation/workers", timeout=5) as r:
+                fed = json.loads(r.read())
+            entry.workers = sum(1 for w in fed.get("workers", []) if w.get("healthy"))
+            entry.online = True
+            entry.failures = 0
+            try:
+                with urllib.request.urlopen(base + "/v1/models", timeout=5) as r:
+                    models = json.loads(r.read())
+                entry.models = sorted({m["id"] for m in models.get("data", [])})
+            except Exception:  # noqa: BLE001 — models listing is best-effort
+                pass
+        except Exception:  # noqa: BLE001 — probe failure
+            entry.failures += 1
+            entry.online = False
+        entry.last_checked = time.time()
+        if entry.failures >= self.failure_threshold:
+            log.info("explorer: dropping %s after %d failures", entry.name, entry.failures)
+            self.db.delete(entry.name)
+        else:
+            self.db.set(entry)
+        return entry
+
+    def probe_all(self) -> None:
+        for entry in self.db.list():
+            self.probe(entry)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.probe_all()
+            except Exception:  # noqa: BLE001
+                log.exception("explorer discovery tick failed")

@@ -1,0 +1,129 @@
+"""Explorer HTTP server: dashboard + network directory API.
+
+Reference: core/http/endpoints/explorer/dashboard.go + the explorer run mode
+(core/cli/explorer.go). Routes:
+  GET  /                   dashboard (no external assets)
+  GET  /networks           directory listing
+  POST /networks           {name, url, description} — joins the directory
+  DELETE /networks/:name
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from localai_tpu.explorer.explorer import Database, DiscoveryService, NetworkEntry
+
+_NAME_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+
+class ExplorerServer:
+    def __init__(self, db_path: str, address: str = "127.0.0.1", port: int = 8090,
+                 discovery_interval_s: float = 30.0, failure_threshold: int = 3):
+        self.db = Database(db_path)
+        self.discovery = DiscoveryService(
+            self.db, interval_s=discovery_interval_s,
+            failure_threshold=failure_threshold,
+        )
+        self._server = self._build(address, port)
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        self.discovery.start()
+
+    def stop(self) -> None:
+        self.discovery.stop()
+        self._server.shutdown()
+
+    def _build(self, address: str, port: int) -> ThreadingHTTPServer:
+        ex = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, status: int, body) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _html(self, body: str) -> None:
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path in ("/", "/index.html"):
+                    self._html(_DASHBOARD)
+                elif self.path == "/networks":
+                    self._json(200, {"networks": [e.to_dict() for e in ex.db.list()]})
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/networks":
+                    self._json(404, {"error": "not found"})
+                    return
+                n = int(self.headers.get("Content-Length") or 0)
+                try:
+                    body = json.loads(self.rfile.read(n)) if n else {}
+                except json.JSONDecodeError:
+                    self._json(400, {"error": "invalid JSON"})
+                    return
+                name = body.get("name") or ""
+                url = body.get("url") or ""
+                if not _NAME_RE.match(name) or not url.startswith(("http://", "https://")):
+                    self._json(400, {"error": "valid name and http(s) url required"})
+                    return
+                entry = NetworkEntry(
+                    name=name, url=url, description=body.get("description", "")
+                )
+                # Probe immediately so a bogus registration never shows online.
+                ex.discovery.probe(entry)
+                self._json(201, entry.to_dict())
+
+            def do_DELETE(self):
+                if not self.path.startswith("/networks/"):
+                    self._json(404, {"error": "not found"})
+                    return
+                name = self.path[len("/networks/"):]
+                if ex.db.delete(name):
+                    self._json(200, {"status": "deleted"})
+                else:
+                    self._json(404, {"error": f"{name} not found"})
+
+        return ThreadingHTTPServer((address, port), H)
+
+
+_DASHBOARD = """<!doctype html><html><head><meta charset="utf-8">
+<title>localai-tpu explorer</title><style>
+body{font-family:system-ui,sans-serif;max-width:900px;margin:2rem auto;padding:0 1rem}
+table{width:100%;border-collapse:collapse}td,th{text-align:left;padding:.5rem;border-bottom:1px solid #e3e3e3}
+.on{color:#0a7}.off{color:#a33}.small{color:#777;font-size:.85rem}
+</style></head><body><h1>Federation explorer</h1>
+<table id="t"><tr><th>network</th><th>status</th><th>workers</th><th>models</th><th></th></tr></table>
+<script>
+fetch('/networks').then(r=>r.json()).then(d=>{
+  const t=document.getElementById('t');
+  for(const n of d.networks){const tr=document.createElement('tr');
+    tr.innerHTML=`<td><b>${n.name}</b><div class="small">${n.url} — ${n.description||''}</div></td>
+    <td class="${n.online?'on':'off'}">${n.online?'online':'offline'}</td>
+    <td>${n.workers}</td><td class="small">${(n.models||[]).join(', ')}</td>`;
+    t.appendChild(tr);}});
+</script></body></html>"""

@@ -1,0 +1,98 @@
+"""Explorer tests: directory persistence, discovery probes against a real
+federation router, failure-threshold removal, and the HTTP API/dashboard."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from localai_tpu.explorer import Database, DiscoveryService, ExplorerServer, NetworkEntry
+from localai_tpu.federation import FederatedServer
+
+
+def test_database_persistence(tmp_path):
+    path = str(tmp_path / "explorer.json")
+    db = Database(path)
+    db.set(NetworkEntry(name="tpu-west", url="http://x:9090", description="west pod"))
+    db.set(NetworkEntry(name="tpu-east", url="http://y:9090"))
+    assert [e.name for e in db.list()] == ["tpu-east", "tpu-west"]
+
+    db2 = Database(path)
+    assert db2.get("tpu-west").description == "west pod"
+    assert db2.delete("tpu-west")
+    assert not db2.delete("tpu-west")
+    assert Database(path).get("tpu-west") is None
+
+
+@pytest.fixture()
+def federation():
+    fed = FederatedServer(address="127.0.0.1", port=0, health_interval_s=0)
+    fed.registry.add("w1", "http://127.0.0.1:1")  # unhealthy is fine for listing
+    fed.start()
+    yield fed, f"http://127.0.0.1:{fed.port}"
+    fed.stop()
+
+
+def test_discovery_probe_online_and_threshold(tmp_path, federation):
+    fed, url = federation
+    db = Database(str(tmp_path / "db.json"))
+    disc = DiscoveryService(db, failure_threshold=2)
+
+    entry = NetworkEntry(name="live", url=url)
+    disc.probe(entry)
+    assert entry.online
+    assert db.get("live") is not None
+
+    dead = NetworkEntry(name="dead", url="http://127.0.0.1:1")
+    db.set(dead)
+    disc.probe(dead)
+    assert not dead.online and dead.failures == 1
+    assert db.get("dead") is not None  # below threshold
+    disc.probe(dead)
+    assert db.get("dead") is None  # dropped at threshold
+
+
+def test_explorer_http_api(tmp_path, federation):
+    _fed, fed_url = federation
+    ex = ExplorerServer(str(tmp_path / "db.json"), address="127.0.0.1", port=0,
+                        discovery_interval_s=0)
+    ex.start()
+    base = f"http://127.0.0.1:{ex.port}"
+    try:
+        req = urllib.request.Request(
+            base + "/networks",
+            data=json.dumps({"name": "pod-a", "url": fed_url,
+                             "description": "test pod"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            created = json.loads(r.read())
+        assert created["online"] is True
+
+        with urllib.request.urlopen(base + "/networks", timeout=10) as r:
+            listing = json.loads(r.read())
+        assert [n["name"] for n in listing["networks"]] == ["pod-a"]
+
+        with urllib.request.urlopen(base + "/", timeout=10) as r:
+            html = r.read().decode()
+        assert "Federation explorer" in html
+
+        req = urllib.request.Request(base + "/networks/pod-a", method="DELETE")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json.loads(r.read())["status"] == "deleted"
+
+        # invalid registrations rejected
+        bad = urllib.request.Request(
+            base + "/networks",
+            data=json.dumps({"name": "x y", "url": "ftp://nope"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(bad, timeout=10)
+        assert e.value.code == 400
+    finally:
+        ex.stop()
+
+
+import urllib.error  # noqa: E402
