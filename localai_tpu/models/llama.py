@@ -26,7 +26,11 @@ import jax
 import jax.numpy as jnp
 
 from localai_tpu.models.config import ArchConfig
-from localai_tpu.ops.attention import decode_attention, prefill_attention
+from localai_tpu.ops.attention import (
+    decode_attention,  # noqa: F401 — public, used by tests/benchmarks
+    decode_attention_appended,
+    prefill_attention,
+)
 from localai_tpu.ops.norm import rms_norm
 from localai_tpu.ops.rope import apply_rope, rope_frequencies
 
@@ -262,11 +266,18 @@ def decode_step(
     Writes the new k/v at `positions` and attends over [0, positions]. Returns
     (logits [B, V] f32, new_cache). The engine jits this with the cache donated
     so XLA updates it in place in HBM.
+
+    HBM-traffic design (found by profiling the serving engine on a v5e): the
+    layer scan must NOT carry or re-emit the cache — stacking per-layer cache
+    outputs rewrites the entire [L,B,S,K,Hd] buffer every token (hundreds of
+    MB of pure waste). Instead each layer reads its cache slice (scan `xs`,
+    a view), attends over `cache ⊕ current token` with the current k/v kept
+    separate, and emits only the new [B,K,Hd] row; ONE scatter after the scan
+    writes all L rows into the stacked cache in place.
     """
     B = tokens.shape[0]
     inv_freq = rope_frequencies(cfg)
     h = params["embed"][tokens]  # [B, D]
-    cache_len = positions + 1
     batch_idx = jnp.arange(B)
 
     def layer(h, xs):
@@ -275,18 +286,19 @@ def decode_step(
         q, k, v = _attn_proj_qkv(cfg, lp, x)  # q [B,H,Hd], k/v [B,K,Hd]
         q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
-        kc = kc.at[batch_idx, positions].set(k.astype(kc.dtype))
-        vc = vc.at[batch_idx, positions].set(v.astype(vc.dtype))
-        attn = decode_attention(q, kc, vc, cache_len)
+        attn = decode_attention_appended(q, kc, vc, k, v, positions)
         h = h + attn.reshape(B, -1) @ lp["wo"]
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
         h = h + _mlp(cfg, lp, x)
-        return h, (kc, vc)
+        return h, (k, v)
 
-    h, (ks, vs) = jax.lax.scan(layer, h, (params["layers"], cache.k, cache.v))
+    h, (new_k, new_v) = jax.lax.scan(layer, h, (params["layers"], cache.k, cache.v))
+    # One scatter: cache[l, b, positions[b]] = new row, all layers at once.
+    k = cache.k.at[:, batch_idx, positions].set(new_k.astype(cache.k.dtype))
+    v = cache.v.at[:, batch_idx, positions].set(new_v.astype(cache.v.dtype))
     h = rms_norm(h, params["final_norm"], cfg.rms_eps)
     logits = _unembed(cfg, params, h)
-    return logits, KVCache(k=ks, v=vs)
+    return logits, KVCache(k=k, v=v)
 
 
 def decode_chunk(

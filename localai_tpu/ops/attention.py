@@ -75,6 +75,44 @@ def causal_prefill_attention(
     return out.reshape(B, S, H, D).astype(q.dtype)
 
 
+def decode_attention_appended(
+    q: jnp.ndarray,  # [B, H, D] query for the single new token per slot
+    k_cache: jnp.ndarray,  # [B, S_max, K, D] — cache WITHOUT the current token
+    v_cache: jnp.ndarray,
+    k_new: jnp.ndarray,  # [B, K, D] current token's key (not yet in the cache)
+    v_new: jnp.ndarray,
+    positions: jnp.ndarray,  # [B] int32 position of the current token
+) -> jnp.ndarray:
+    """Decode attention over `cache[0:pos] ⊕ current token`. Returns [B, H, D].
+
+    The current token's k/v ride as separate operands so the cache write can
+    happen ONCE outside the per-layer scan — rewriting the full cache per
+    layer per token is the dominant HBM waste in a naive decode loop (see
+    models/llama.py decode_step)."""
+    B, H, D = q.shape
+    S = k_cache.shape[1]
+    K = k_cache.shape[2]
+    G = H // K
+    scale = 1.0 / (D**0.5)
+
+    qf = q.astype(jnp.float32).reshape(B, K, G, D)
+    scores = jnp.einsum(
+        "bkgd,bskd->bkgs", qf, k_cache.astype(jnp.float32)
+    ) * scale  # [B, K, G, S]
+    # Cache rows at/after `positions` are stale (the current row is written
+    # after the layer scan); mask them and score the current token separately.
+    valid = jnp.arange(S)[None, :] < positions[:, None]  # [B, S]
+    scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
+    cur = jnp.einsum(
+        "bkgd,bkd->bkg", qf, k_new.astype(jnp.float32)
+    )[..., None] * scale  # [B, K, G, 1]
+    probs = jax.nn.softmax(jnp.concatenate([scores, cur], axis=-1), axis=-1)
+    out = jnp.einsum(
+        "bkgs,bskd->bkgd", probs[..., :S], v_cache.astype(jnp.float32)
+    ) + probs[..., S:] * v_new.astype(jnp.float32)[:, :, None, :]
+    return out.reshape(B, H, D).astype(q.dtype)
+
+
 def decode_attention(
     q: jnp.ndarray,  # [B, H, D] query for the single new token per slot
     k_cache: jnp.ndarray,  # [B, S_max, K, D]
