@@ -311,13 +311,17 @@ def decode_chunk(
     """Multi-token decode: write T new k/v per slot and return logits for all
     T positions — the verify pass of speculative decoding (the reference
     passes draft tokens to llama.cpp's batch decode; model_config.go:211
-    draft_model). Token t attends to the whole cache plus in-window tokens at
-    earlier positions; returns (logits [B, T, V] f32, new_cache)."""
+    draft_model). Positions must be contiguous per slot. Token t attends to
+    the cache prefix (< positions[b, 0]) plus in-window tokens causally; the
+    window k/v stay separate operands so — as in decode_step — the layer
+    scan never re-emits the cache, and one scatter writes all L×T rows."""
     B, T = tokens.shape
     inv_freq = rope_frequencies(cfg)
     h = params["embed"][tokens]  # [B, T, D]
     batch_idx = jnp.arange(B)[:, None].repeat(T, axis=1)  # [B, T]
     S = cache.k.shape[2]
+    scale = cfg.head_dim_**-0.5
+    causal = jnp.tril(jnp.ones((T, T), bool))
 
     def layer(h, xs):
         lp, kc, vc = xs
@@ -325,29 +329,33 @@ def decode_chunk(
         q, k, v = _attn_proj_qkv(cfg, lp, x)  # q [B,T,H,Hd], k/v [B,T,K,Hd]
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
-        kc = kc.at[batch_idx, positions].set(k.astype(kc.dtype))
-        vc = vc.at[batch_idx, positions].set(v.astype(vc.dtype))
-        # Mask: key slot s visible to query t iff s <= positions[b, t]
-        # (cache rows beyond a slot's window hold stale bytes — never newer
-        # positions — so position masking alone is sufficient).
-        valid = jnp.arange(S)[None, None, :] <= positions[:, :, None]  # [B, T, S]
         K_h = kc.shape[2]
         G = q.shape[2] // K_h
-        qf = (q.astype(jnp.float32) * (cfg.head_dim_**-0.5)).reshape(B, T, K_h, G, cfg.head_dim_)
-        scores = jnp.einsum("btkgd,bskd->bkgts", qf, kc.astype(jnp.float32))
-        scores = jnp.where(valid[:, None, None], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("bkgts,bskd->btkgd", probs, vc.astype(jnp.float32))
+        qf = (q.astype(jnp.float32) * scale).reshape(B, T, K_h, G, cfg.head_dim_)
+        # Cache prefix: rows before the window start (later rows are stale).
+        prefix = jnp.arange(S)[None, :] < positions[:, :1]  # [B, S]
+        sc = jnp.einsum("btkgd,bskd->bkgts", qf, kc.astype(jnp.float32))
+        sc = jnp.where(prefix[:, None, None, None], sc, -1e30)
+        # In-window causal attention against the fresh k.
+        kw = k.astype(jnp.float32)
+        sw = jnp.einsum("btkgd,bukd->bkgtu", qf, kw)  # [B,K,G,T,T]
+        sw = jnp.where(causal[None, None, None], sw, -1e30)
+        probs = jax.nn.softmax(jnp.concatenate([sc, sw], axis=-1), axis=-1)
+        attn = jnp.einsum(
+            "bkgts,bskd->btkgd", probs[..., :S], vc.astype(jnp.float32)
+        ) + jnp.einsum("bkgtu,bukd->btkgd", probs[..., S:], v.astype(jnp.float32))
         attn = attn.reshape(B, T, -1).astype(h.dtype)
         h = h + attn @ lp["wo"]
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
         h = h + _mlp(cfg, lp, x)
-        return h, (kc, vc)
+        return h, (k, v)
 
-    h, (ks, vs) = jax.lax.scan(layer, h, (params["layers"], cache.k, cache.v))
+    h, (new_k, new_v) = jax.lax.scan(layer, h, (params["layers"], cache.k, cache.v))
+    k = cache.k.at[:, batch_idx, positions].set(new_k.astype(cache.k.dtype))
+    v = cache.v.at[:, batch_idx, positions].set(new_v.astype(cache.v.dtype))
     h = rms_norm(h, params["final_norm"], cfg.rms_eps)
     logits = _unembed(cfg, params, h)  # [B, T, V]
-    return logits, KVCache(k=ks, v=vs)
+    return logits, KVCache(k=k, v=v)
 
 
 def write_prefill_to_cache(
