@@ -119,6 +119,51 @@ def main() -> None:
         "pct_of_hbm_roofline": round(pct, 1),
     }
 
+    # int8 weight-only row (reference parity: quantized GGUF serving is the
+    # reference's standard practice; here per-channel int8 with dequant fused
+    # into the matmuls — models/quant.py).
+    if os.environ.get("BENCH_INT8", "1") != "0":
+        try:
+            eng.cache = None
+            eng.params = None
+            import gc
+
+            gc.collect()
+            eng_q = Engine(
+                cfg, params, ByteTokenizer(cfg.vocab_size),
+                engine_cfg=EngineConfig(max_slots=slots, max_seq=max_seq),
+                quantization="int8",
+            )
+            eng_q.warmup(prompt_len)
+            eng_q._decode_time = 0.0
+            eng_q._decode_tokens = 0
+            qthreads = []
+            for i in range(slots):
+                ids = [(i * 37 + j) % 255 + 1 for j in range(prompt_len)]
+                t = threading.Thread(
+                    target=lambda ids=ids: eng_q.generate(
+                        ids, max_new_tokens=gen_len, ignore_eos=True
+                    )
+                )
+                qthreads.append(t)
+            qwall0 = time.time()
+            for t in qthreads:
+                t.start()
+            for t in qthreads:
+                t.join()
+            qtps = (
+                eng_q._decode_tokens / eng_q._decode_time
+                if eng_q._decode_time else 0.0
+            )
+            out["decode_tokens_per_sec_int8"] = round(qtps, 2)
+            print(f"int8 row: decode {qtps:.1f} tok/s", file=sys.stderr)
+            eng_q.stop()
+            eng_q.cache = None
+            eng_q.params = None
+            gc.collect()
+        except Exception as e:  # noqa: BLE001 — extra row is best-effort
+            print(f"int8 row failed: {type(e).__name__}: {e}", file=sys.stderr)
+
     # Long-context row (VERDICT #7): one near-max-bucket prompt through the
     # flash prefill path; second run reported (first pays the compile).
     default_long = "8192" if jax.default_backend() == "tpu" else "0"

@@ -101,6 +101,10 @@ class ModelConfig:
     draft_model: str = ""  # arch preset or checkpoint dir; empty = off
     n_draft: int = 5
 
+    # Weight-only quantization at load ("int8"; reference analogue:
+    # quantized GGUF serving). Halves weight HBM traffic + footprint.
+    quantization: str = ""
+
     # Output post-processing (reference Finetune, core/backend/llm.go:217-265).
     echo: bool = False
     cutstrings: list = dataclasses.field(default_factory=list)

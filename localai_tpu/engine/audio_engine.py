@@ -177,6 +177,24 @@ class TTSEngine(_BaseAudioEngine):
         except ValueError:
             return 0
 
+    def synthesize_stream(self, text: str, voice: Optional[str] = None):
+        """Generator of float32 sample chunks (one per text segment) — the
+        streaming TTS path (reference: TTSStream RPC / tts.go:71-80). First
+        audio arrives after one segment's synthesis, not the whole text."""
+        data = text.encode("utf-8")[: self.cfg.max_text * 16] or b" "
+        vid = jnp.int32(self.voice_id(voice))
+        for i in range(0, len(data), self.cfg.max_text):
+            chunk = data[i: i + self.cfg.max_text]
+            ids = np.zeros((self.cfg.max_text,), np.int32)
+            ids[: len(chunk)] = np.frombuffer(chunk, np.uint8)
+            with self._lock:
+                audio, n = self._fn(self.params, jnp.asarray(ids),
+                                    jnp.int32(len(chunk)), vid)
+            samples = np.asarray(audio)[: int(n)]
+            self.m_audio_seconds += len(samples) / self.cfg.sample_rate
+            yield samples
+        self.m_requests += 1
+
     def synthesize(self, text: str, voice: Optional[str] = None) -> tuple[np.ndarray, int]:
         """Returns (float32 samples, sample_rate). Long text is chunked at
         max_text bytes and the waveforms concatenated."""

@@ -248,6 +248,7 @@ class Engine:
         draft_cfg: Optional[ArchConfig] = None,
         draft_params: Any = None,
         n_draft: int = 5,
+        quantization: str = "",
     ) -> None:
         _enable_compile_cache()
         self.cfg = cfg
@@ -272,6 +273,14 @@ class Engine:
             self.params = jax.tree.map(
                 lambda a, s: jax.device_put(a, s), params, pshard
             )
+            if quantization:
+                # Weight-only int8 AFTER sharded placement so q/s inherit
+                # the weight shardings (models/quant.py).
+                from localai_tpu.models.quant import quantize_params
+
+                self.params = jax.jit(
+                    lambda p: quantize_params(cfg, p, quantization)
+                )(self.params)
             kshard, vshard = cache_shardings(self.mesh)
             self.cache = llama.KVCache(
                 k=jax.device_put(

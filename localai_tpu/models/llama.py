@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from localai_tpu.models.config import ArchConfig
+from localai_tpu.models.quant import matmul, unembed_matmul
 from localai_tpu.ops.attention import (
     decode_attention,  # noqa: F401 — public, used by tests/benchmarks
     decode_attention_appended,
@@ -101,6 +102,14 @@ def init_params(cfg: ArchConfig, key: jnp.ndarray, scale: float = 0.02) -> Param
     return params
 
 
+def _moe_mm(x: jnp.ndarray, w, sub: str) -> jnp.ndarray:
+    """Per-expert matmul for plain or quantized expert weights."""
+    if isinstance(w, dict):
+        out = jnp.einsum(sub, x, w["q"].astype(x.dtype))
+        return out * w["s"].astype(x.dtype)[..., 0, :]
+    return jnp.einsum(sub, x, w)
+
+
 def _mlp(cfg: ArchConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
     """SwiGLU MLP; dense or sparse-MoE (Mixtral-style top-k routing).
 
@@ -109,8 +118,8 @@ def _mlp(cfg: ArchConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
     all_to_all dispatch optimization lives in localai_tpu.parallel.
     """
     if not cfg.is_moe:
-        gate = jax.nn.silu(x @ lp["w_gate"])
-        return ((gate * (x @ lp["w_up"])) @ lp["w_down"]).astype(x.dtype)
+        gate = jax.nn.silu(matmul(x, lp["w_gate"]))
+        return matmul(gate * matmul(x, lp["w_up"]), lp["w_down"]).astype(x.dtype)
 
     E, topk = cfg.num_experts, cfg.num_experts_per_token
     router_logits = (x @ lp["router"]).astype(jnp.float32)  # [..., E]
@@ -118,18 +127,18 @@ def _mlp(cfg: ArchConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
     weights = jax.nn.softmax(weights, axis=-1)
     onehot = jax.nn.one_hot(sel, E, dtype=jnp.float32)  # [..., topk, E]
     combine = jnp.einsum("...te,...t->...e", onehot, weights)
-    gate = jax.nn.silu(jnp.einsum("...d,edf->...ef", x, lp["w_gate"]))
-    up = jnp.einsum("...d,edf->...ef", x, lp["w_up"])
-    expert_out = jnp.einsum("...ef,efd->...ed", gate * up, lp["w_down"])  # [..., E, D]
+    gate = jax.nn.silu(_moe_mm(x, lp["w_gate"], "...d,edf->...ef"))
+    up = _moe_mm(x, lp["w_up"], "...d,edf->...ef")
+    expert_out = _moe_mm(gate * up, lp["w_down"], "...ef,efd->...ed")  # [..., E, D]
     return jnp.einsum("...ed,...e->...d", expert_out.astype(jnp.float32), combine).astype(x.dtype)
 
 
 def _attn_proj_qkv(cfg: ArchConfig, lp: Params, x: jnp.ndarray):
     """x: [..., D] -> q [..., H, Hd], k/v [..., K, Hd]."""
     H, K, Hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
-    q = x @ lp["wq"]
-    k = x @ lp["wk"]
-    v = x @ lp["wv"]
+    q = matmul(x, lp["wq"])
+    k = matmul(x, lp["wk"])
+    v = matmul(x, lp["wv"])
     if cfg.attn_qkv_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -141,11 +150,11 @@ def _attn_proj_qkv(cfg: ArchConfig, lp: Params, x: jnp.ndarray):
 
 
 def _unembed(cfg: ArchConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
-    # bf16 operands with f32 MXU accumulation: casting the [V, D] matrix to
-    # f32 would double its HBM traffic on every decode step (the unembed is
-    # the single largest weight read at 128k vocabs).
+    # bf16 (or int8-dequant) operands with f32 MXU accumulation: casting the
+    # [V, D] matrix to f32 would double its HBM traffic on every decode step
+    # (the unembed is the single largest weight read at 128k vocabs).
     w = params["embed"] if cfg.tie_embeddings else params["lm_head"]
-    return jnp.dot(h.astype(w.dtype), w.T, preferred_element_type=jnp.float32)
+    return unembed_matmul(h, w)
 
 
 def _forward_hidden(
@@ -186,7 +195,7 @@ def _forward_hidden(
             attn = ring_prefill_attention(q, k, v, lengths, mesh)
         else:
             attn = prefill_attention(q, k, v, length_mask, lengths)
-        h = h + attn.reshape(B, S, -1) @ lp["wo"]
+        h = h + matmul(attn.reshape(B, S, -1), lp["wo"])
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
         h = h + _mlp(cfg, lp, x)
         return h, ((k, v) if collect_kv else None)
@@ -287,7 +296,7 @@ def decode_step(
         q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
         attn = decode_attention_appended(q, kc, vc, k, v, positions)
-        h = h + attn.reshape(B, -1) @ lp["wo"]
+        h = h + matmul(attn.reshape(B, -1), lp["wo"])
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
         h = h + _mlp(cfg, lp, x)
         return h, (k, v)
@@ -345,7 +354,7 @@ def decode_chunk(
             "bkgts,bskd->btkgd", probs[..., :S], vc.astype(jnp.float32)
         ) + jnp.einsum("bkgtu,bukd->btkgd", probs[..., S:], v.astype(jnp.float32))
         attn = attn.reshape(B, T, -1).astype(h.dtype)
-        h = h + attn @ lp["wo"]
+        h = h + matmul(attn, lp["wo"])
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
         h = h + _mlp(cfg, lp, x)
         return h, (k, v)

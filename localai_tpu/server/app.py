@@ -79,6 +79,15 @@ class Response:
     headers: dict[str, str] = field(default_factory=dict)
 
 
+class RawStream:
+    """Handler return value that streams raw byte chunks (chunked encoding) —
+    e.g. streaming TTS audio (reference: TTSStreamEndpoint, tts.go:71-80)."""
+
+    def __init__(self, chunks: Iterator[bytes], content_type: str = "application/octet-stream"):
+        self.chunks = chunks
+        self.content_type = content_type
+
+
 class SSEStream:
     """Handler return value that streams `data:` frames from a generator.
 
@@ -297,6 +306,25 @@ def create_server(app_cfg: ApplicationConfig, router: Router) -> ThreadingHTTPSe
                 ws.close()
                 self.close_connection = True
 
+        def _respond_raw_stream(self, stream: "RawStream") -> None:
+            self.send_response(200)
+            self.send_header("Content-Type", stream.content_type)
+            self.send_header("Transfer-Encoding", "chunked")
+            for k, v in self._common_headers().items():
+                self.send_header(k, v)
+            self.end_headers()
+            try:
+                for chunk in stream.chunks:
+                    if chunk:
+                        self.wfile.write(f"{len(chunk):X}\r\n".encode() + chunk + b"\r\n")
+                        self.wfile.flush()
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError):
+                log.debug("raw-stream client disconnected")
+            finally:
+                if hasattr(stream.chunks, "close"):
+                    stream.chunks.close()
+
         def _handle(self) -> None:
             start = time.monotonic()
             parsed = urlparse(self.path)
@@ -366,6 +394,8 @@ def create_server(app_cfg: ApplicationConfig, router: Router) -> ThreadingHTTPSe
 
             if isinstance(result, SSEStream):
                 self._respond_sse(result)
+            elif isinstance(result, RawStream):
+                self._respond_raw_stream(result)
             else:
                 self._respond(result)
 

@@ -28,6 +28,10 @@ class AudioApi:
         r.add("POST", "/v1/audio/translations", self.translate)
         r.add("POST", "/v1/audio/speech", self.speech)
         r.add("POST", "/tts", self.speech)  # LocalAI native route
+        r.add("POST", "/v1/audio/speech/stream", self.speech_stream)
+        r.add("POST", "/tts/stream", self.speech_stream)
+        # elevenlabs-compatible aliases (reference: routes/elevenlabs.go)
+        r.add("POST", "/v1/text-to-speech/:voice_id", self.speech_elevenlabs)
         r.add("POST", "/v1/sound-generation", self.sound_generation)
         r.add("POST", "/vad", self.vad)
         r.add("POST", "/v1/vad", self.vad)
@@ -115,6 +119,46 @@ class AudioApi:
 
     def speech(self, req: Request) -> Response:
         return self._tts_impl(req, Usecase.TTS)
+
+    def speech_elevenlabs(self, req: Request) -> Response:
+        """elevenlabs contract: voice in the route, text in body `text`."""
+        body = dict(req.body or {})
+        body.setdefault("voice", req.params.get("voice_id"))
+        patched = Request(
+            method=req.method, path=req.path, params=req.params,
+            query=req.query, headers=req.headers, body=body,
+        )
+        return self._tts_impl(patched, Usecase.TTS)
+
+    def speech_stream(self, req: Request):
+        """Chunked streaming TTS: WAV header + PCM chunks as each text
+        segment is synthesized (reference: TTSStreamEndpoint)."""
+        import struct
+
+        from localai_tpu.server.app import RawStream
+
+        body = req.body or {}
+        text = body.get("input") or body.get("text")
+        if not text or not isinstance(text, str):
+            raise ApiError(400, "input text is required")
+        lm, lease = self._base._resolve(req, Usecase.TTS)
+        sr = lm.engine.cfg.sample_rate
+
+        def chunks():
+            try:
+                # Streaming WAV: RIFF/data sizes set to the unknown-length
+                # sentinel (players and ffmpeg accept this for live streams).
+                hdr = (b"RIFF" + struct.pack("<I", 0xFFFFFFFF) + b"WAVE"
+                       + b"fmt " + struct.pack("<IHHIIHH", 16, 1, 1, sr, sr * 2, 2, 16)
+                       + b"data" + struct.pack("<I", 0xFFFFFFFF))
+                yield hdr
+                for samples in lm.engine.synthesize_stream(text, voice=body.get("voice")):
+                    pcm16 = (np.clip(samples, -1, 1) * 32767.0).astype(np.int16)
+                    yield pcm16.tobytes()
+            finally:
+                lease.release()
+
+        return RawStream(chunks(), content_type="audio/wav")
 
     def sound_generation(self, req: Request) -> Response:
         return self._tts_impl(req, Usecase.SOUND_GENERATION)

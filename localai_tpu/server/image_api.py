@@ -32,6 +32,7 @@ class ImageApi:
     def register(self, r: Router) -> None:
         r.add("POST", "/v1/images/generations", self.generations)
         r.add("POST", "/images/generations", self.generations)
+        r.add("POST", "/v1/images/inpainting", self.inpainting)
         r.add("POST", "/v1/videos", self.videos)
         r.add("GET", "/generated-images/:name", self.serve_image)
         r.add("GET", "/generated-videos/:name", self.serve_video)
@@ -86,6 +87,65 @@ class ImageApi:
                 with open(os.path.join(self.content_dir, name), "wb") as f:
                     f.write(png)
                 data.append({"url": f"/generated-images/{name}"})
+        return Response(body={"created": int(time.time()), "data": data})
+
+    def inpainting(self, req: Request) -> Response:
+        """Image inpainting: multipart form with `image` and `mask` files
+        (white = repaint), `prompt`, optional `steps`/`seed`/`model`
+        (reference: endpoints/openai/inpainting.go)."""
+        import numpy as np
+        from PIL import Image
+        import io as _io
+
+        form = req.form()
+        for field in ("image", "mask"):
+            if field not in form:
+                raise ApiError(400, f"missing form field {field!r}")
+
+        def text_field(name: str, default: str = "") -> str:
+            return form[name][1].decode("utf-8", "replace").strip() if name in form else default
+
+        prompt = text_field("prompt")
+        if not prompt:
+            raise ApiError(400, "prompt is required")
+        try:
+            img = np.asarray(Image.open(_io.BytesIO(form["image"][1])).convert("RGB"))
+            mask = np.asarray(Image.open(_io.BytesIO(form["mask"][1])).convert("L"))
+        except Exception as e:  # noqa: BLE001
+            raise ApiError(400, f"could not decode image/mask: {e}") from None
+        if mask.shape != img.shape[:2]:
+            mask = np.asarray(
+                Image.fromarray(mask).resize((img.shape[1], img.shape[0]), Image.NEAREST)
+            )
+        steps = int(text_field("steps", "") or 25)
+        seed = text_field("seed", "")
+        model = text_field("model", "")
+        response_format = text_field("response_format", "url")
+
+        fake = Request(
+            method=req.method, path=req.path, params=req.params, query=req.query,
+            headers=req.headers, body={"model": model} if model else {},
+        )
+        lm, lease = self._base._resolve(fake, Usecase.IMAGE)
+        try:
+            out = lm.engine.inpaint(
+                prompt, img, mask, steps=steps,
+                seed=int(seed) if seed else None,
+            )
+        finally:
+            lease.release()
+
+        buf = io.BytesIO()
+        Image.fromarray(out).save(buf, format="PNG")
+        png = buf.getvalue()
+        if response_format == "b64_json":
+            data = [{"b64_json": base64.b64encode(png).decode()}]
+        else:
+            os.makedirs(self.content_dir, exist_ok=True)
+            name = f"{uuid.uuid4().hex}.png"
+            with open(os.path.join(self.content_dir, name), "wb") as f:
+                f.write(png)
+            data = [{"url": f"/generated-images/{name}"}]
         return Response(body={"created": int(time.time()), "data": data})
 
     def videos(self, req: Request) -> Response:

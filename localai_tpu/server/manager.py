@@ -438,6 +438,7 @@ class ModelManager:
             draft_cfg=draft_arch,
             draft_params=draft_params,
             n_draft=cfg.n_draft,
+            quantization=cfg.quantization,
         )
         engine.start()
         evaluator = Evaluator(cfg, tokenizer)

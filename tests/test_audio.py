@@ -270,3 +270,32 @@ def test_vad_endpoint(audio_api):
     assert len(out["segments"]) == 1
     seg = out["segments"][0]
     assert 0.3 < seg["start"] < 0.6 < 0.9 < seg["end"] < 1.2
+
+
+def test_tts_streaming_endpoint(audio_api):
+    """Chunked WAV stream: header first, PCM as segments complete."""
+    long_text = "hello world " * 20  # multiple max_text segments
+    req = urllib.request.Request(
+        audio_api + "/v1/audio/speech/stream",
+        data=json.dumps({"model": "voice", "input": long_text}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        assert r.headers["Content-Type"] == "audio/wav"
+        blob = r.read()
+    assert blob[:4] == b"RIFF" and blob[8:12] == b"WAVE"
+    pcm = np.frombuffer(blob[44:], np.int16)
+    assert len(pcm) > 0
+
+
+def test_tts_elevenlabs_route(audio_api):
+    req = urllib.request.Request(
+        audio_api + "/v1/text-to-speech/voice-1",
+        data=json.dumps({"model": "voice", "text": "hi"}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        assert r.headers["Content-Type"] == "audio/wav"
+        blob = r.read()
+    samples, sr = read_wav(blob)
+    assert len(samples) > 0

@@ -138,3 +138,50 @@ def test_videos_endpoint_gif(image_api):
     img.seek(3)  # 4 frames exist
     with pytest.raises(EOFError):
         img.seek(4)
+
+
+def test_inpainting_endpoint(image_api):
+    """Masked region repainted, kept region preserved (RePaint replay)."""
+    import urllib.error
+    import uuid as _uuid
+
+    from PIL import Image
+
+    base, _ = image_api
+    # Original: solid mid-gray; mask: repaint the left half.
+    orig = np.full((16, 16, 3), 128, np.uint8)
+    mask = np.zeros((16, 16), np.uint8)
+    mask[:, :8] = 255
+    bufs = {}
+    for name, arr in (("image", orig), ("mask", mask)):
+        b = io.BytesIO()
+        Image.fromarray(arr).save(b, format="PNG")
+        bufs[name] = b.getvalue()
+
+    boundary = _uuid.uuid4().hex
+    out = io.BytesIO()
+    fields = {"model": "pix", "prompt": "a red square", "steps": "3",
+              "seed": "5", "response_format": "b64_json"}
+    for k, v in fields.items():
+        out.write(f'--{boundary}\r\nContent-Disposition: form-data; name="{k}"\r\n\r\n{v}\r\n'.encode())
+    for k in ("image", "mask"):
+        out.write(f'--{boundary}\r\nContent-Disposition: form-data; name="{k}"; filename="{k}.png"\r\n'
+                  f"Content-Type: image/png\r\n\r\n".encode())
+        out.write(bufs[k])
+        out.write(b"\r\n")
+    out.write(f"--{boundary}--\r\n".encode())
+
+    req = urllib.request.Request(
+        base + "/v1/images/inpainting", data=out.getvalue(),
+        headers={"Content-Type": f"multipart/form-data; boundary={boundary}"},
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        resp = json.loads(r.read())
+    png = base64.b64decode(resp["data"][0]["b64_json"])
+    img = np.asarray(Image.open(io.BytesIO(png)))
+    assert img.shape == (16, 16, 3)
+    # Kept (right) half stays near the original gray; repainted half diverges.
+    kept_err = np.abs(img[:, 8:].astype(int) - 128).mean()
+    painted_err = np.abs(img[:, :8].astype(int) - 128).mean()
+    assert kept_err < 25, f"kept region drifted: {kept_err}"
+    assert painted_err > kept_err, "masked region was not repainted"

@@ -1,0 +1,110 @@
+"""Weight-only int8 quantization tests: numerical closeness, engine serving
+(dense + MoE + tp mesh), rerank/embeddings paths, and config plumbing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import yaml
+
+from localai_tpu.engine import ByteTokenizer, Engine, EngineConfig
+from localai_tpu.models import get_arch
+from localai_tpu.models.llama import init_params, prefill
+from localai_tpu.models.quant import matmul, quantize_params, quantize_tensor, unembed_matmul
+from localai_tpu.parallel.mesh import MeshPlan
+
+
+def test_quantize_tensor_roundtrip_error():
+    w = jax.random.normal(jax.random.key(0), (64, 128), jnp.float32) * 0.1
+    qt = quantize_tensor(w)
+    assert qt["q"].dtype == jnp.int8
+    deq = qt["q"].astype(jnp.float32) * qt["s"]
+    rel = float(jnp.abs(deq - w).max() / jnp.abs(w).max())
+    assert rel < 0.01  # per-channel int8: <1% of the channel max
+
+    x = jax.random.normal(jax.random.key(1), (4, 64), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(matmul(x, qt)), np.asarray(x @ w), rtol=0.1, atol=0.05
+    )
+
+
+def test_unembed_matmul_quantized_close():
+    w = jax.random.normal(jax.random.key(0), (512, 64), jnp.float32) * 0.1  # [V, D]
+    s = jnp.max(jnp.abs(w), axis=-1, keepdims=True) / 127.0
+    q = jnp.clip(jnp.round(w / jnp.maximum(s, 1e-9)), -127, 127).astype(jnp.int8)
+    h = jax.random.normal(jax.random.key(1), (3, 64), jnp.float32)
+    got = unembed_matmul(h, {"q": q, "s": s})
+    want = unembed_matmul(h, w)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=0.15, atol=0.1)
+
+
+@pytest.mark.parametrize("arch", ["tiny", "tiny-moe"])
+def test_quantized_prefill_close_to_full(arch):
+    cfg = get_arch(arch)
+    params = init_params(cfg, jax.random.key(0))
+    qparams = quantize_params(cfg, params, "int8")
+    toks = jnp.zeros((1, 32), jnp.int32).at[0, :6].set(jnp.arange(1, 7))
+    lens = jnp.array([6], jnp.int32)
+    full, _, _ = prefill(cfg, params, toks, lens)
+    quant, _, _ = prefill(cfg, qparams, toks, lens)
+    cos = float(jnp.sum(full * quant) / (jnp.linalg.norm(full) * jnp.linalg.norm(quant)))
+    assert cos > 0.99, f"quantized logits diverged (cos={cos})"
+
+
+def test_quantized_engine_serves_and_matches_mostly():
+    cfg = get_arch("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    full = Engine(cfg, params, ByteTokenizer(cfg.vocab_size),
+                  engine_cfg=EngineConfig(max_slots=2, max_seq=128, min_prefill_bucket=16))
+    quant = Engine(cfg, params, ByteTokenizer(cfg.vocab_size),
+                   engine_cfg=EngineConfig(max_slots=2, max_seq=128, min_prefill_bucket=16),
+                   quantization="int8")
+    full.start(); quant.start()
+    try:
+        t_full, ev_f = full.generate([65, 66, 67], max_new_tokens=8, ignore_eos=True)
+        t_quant, ev_q = quant.generate([65, 66, 67], max_new_tokens=8, ignore_eos=True)
+        assert ev_q.completion_tokens == 8
+        # int8 rounding may flip near-tie argmaxes on random init; require a
+        # matching prefix rather than full equality.
+        assert t_quant[:2] == t_full[:2]
+        # rerank/embeddings paths run on quantized weights too
+        scores = quant.rerank([65, 66], [[67, 68], [1, 2]])
+        assert scores.shape == (2,)
+        vecs = quant.embed([[65, 66, 67]])
+        assert np.isfinite(vecs).all()
+    finally:
+        full.stop()
+        quant.stop()
+
+
+def test_quantized_tp_mesh_serves():
+    cfg = get_arch("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    eng = Engine(cfg, params, ByteTokenizer(cfg.vocab_size),
+                 mesh_plan=MeshPlan(tp=2),
+                 engine_cfg=EngineConfig(max_slots=2, max_seq=128, min_prefill_bucket=16),
+                 quantization="int8")
+    eng.start()
+    try:
+        _, ev = eng.generate([10, 20], max_new_tokens=6, ignore_eos=True)
+        assert ev.completion_tokens == 6
+    finally:
+        eng.stop()
+
+
+def test_quantization_config_plumbs_through(tmp_path):
+    from localai_tpu.config import ApplicationConfig
+    from localai_tpu.server import ModelManager
+
+    d = tmp_path / "models"
+    d.mkdir()
+    (d / "q.yaml").write_text(yaml.safe_dump({
+        "name": "q", "model": "tiny", "context_size": 64, "max_tokens": 4,
+        "quantization": "int8",
+    }))
+    mgr = ModelManager(ApplicationConfig(models_dir=str(d)))
+    lm = mgr.get("q")
+    assert isinstance(lm.engine.params["layers"]["wq"], dict)  # quantized form
+    text, ev = lm.engine.generate([65], max_new_tokens=2, ignore_eos=True)
+    assert ev.kind == "done"
+    mgr.shutdown()
