@@ -141,6 +141,11 @@ class GenRequest:
     # (with user bias), before penalties/temperature, matching OpenAI
     # semantics (reference: Reply logprobs in backend.proto / chat.go).
     logprobs: int = 0
+    # Multimodal (VLM): projected image features [N, hidden] injected over
+    # prompt_ids[image_offset : image_offset+N] at prefill (llava semantics;
+    # the placeholder ids under the span are ignored).
+    image_embeds: Optional[Any] = None
+    image_offset: int = 0
 
 
 @dataclasses.dataclass
@@ -464,15 +469,19 @@ class Engine:
         return fn
 
     def _get_admit(self, m: int, bucket: int, has_bias: bool, with_topk: bool,
-                   with_lp: bool = False):
+                   with_lp: bool = False, n_img: int = 0):
         """Fused admission program: prefill M prompts, write their KV/state
         into their slots, and sample each first token — one dispatch.
 
         Host control arrives packed: `aux` [3, M] i32 (lens, slot ids, seeds)
         and `samp_pack` [7, M] f32 (sampling params), so an admission costs
         three H2D transfers (prompts, aux, samp) instead of twelve.
+
+        n_img > 0 (multimodal, always m=1): the program takes projected
+        image features [m, n_img, D] + offsets [m] injected into the prompt
+        embeddings before the layer stack (llava path).
         """
-        key = (m, bucket, has_bias, with_topk, with_lp)
+        key = (m, bucket, has_bias, with_topk, with_lp, n_img)
         fn = self._admit_cache.get(key)
         if fn is not None:
             return fn
@@ -487,15 +496,17 @@ class Engine:
         tok_v = min(getattr(self.tokenizer, "vocab_size", V) or V, V)
 
         def admit(params, cache, counts, rngs, bias, d_tokens, d_positions,
-                  prompt_toks, aux, samp_pack, bias_rows):
+                  prompt_toks, aux, samp_pack, bias_rows, img_embeds=None,
+                  img_offsets=None):
             lens, slot_ids, seeds = aux[0], aux[1], aux[2]
             samp = SamplingParams(
                 temperature=samp_pack[0], top_k=samp_pack[1].astype(jnp.int32),
                 top_p=samp_pack[2], min_p=samp_pack[3], repeat_penalty=samp_pack[4],
                 presence_penalty=samp_pack[5], frequency_penalty=samp_pack[6],
             )
+            inject = (img_embeds, img_offsets) if img_embeds is not None else None
             logits, ks, vs = llama.prefill(
-                cfg, params, prompt_toks, lens, mesh=self._ring_mesh
+                cfg, params, prompt_toks, lens, mesh=self._ring_mesh, inject=inject
             )
             valid = (jnp.arange(bucket)[None, :] < lens[:, None]).astype(jnp.int32)
             rows = jnp.zeros((m, V), jnp.int32)
@@ -664,6 +675,17 @@ class Engine:
             log.warning(
                 "prompt truncated to %d tokens (max_seq=%d)", limit, self.ecfg.max_seq
             )
+        if request.image_embeds is not None:
+            if self.draft_cfg is not None:
+                raise ValueError(
+                    "multimodal requests are not supported with a draft model"
+                )
+            n = int(np.asarray(request.image_embeds).shape[0])
+            if request.image_offset < 0 or request.image_offset + n > len(request.prompt_ids):
+                raise ValueError(
+                    f"image span [{request.image_offset}, {request.image_offset + n}) "
+                    f"outside the prompt ({len(request.prompt_ids)} tokens)"
+                )
         if request.grammar is not None and self._tok_strs is None:
             self._token_str(0)  # build the table here, not in the engine loop
         handle = RequestHandle()
@@ -949,7 +971,10 @@ class Engine:
             # admit them as singletons so only the (m=1, ...) variants ever
             # compile — those are warmed.
             def _special(r: GenRequest) -> bool:
-                return bool(r.logit_bias) or r.grammar is not None or r.logprobs > 0
+                return (
+                    bool(r.logit_bias) or r.grammar is not None
+                    or r.logprobs > 0 or r.image_embeds is not None
+                )
 
             special = [gh for gh in group if _special(gh[0])]
             plain = [gh for gh in group if not _special(gh[0])]
@@ -1015,14 +1040,22 @@ class Engine:
                 with_lp = True
 
         has_bias = bias_rows is not None
+        # Multimodal admissions are singletons (m == 1, see _special).
+        n_img = 0
+        if m == 1 and chunk[0][0].image_embeds is not None:
+            n_img = int(np.asarray(chunk[0][0].image_embeds).shape[0])
         trace = os.environ.get("LOCALAI_ENGINE_TRACE", "0") == "1"
         t_a = time.monotonic()
-        fn = self._get_admit(m, bucket, has_bias, with_topk, with_lp)
+        fn = self._get_admit(m, bucket, has_bias, with_topk, with_lp, n_img)
         t_b = time.monotonic()
         args_in = (
             jnp.asarray(prompt_toks), jnp.asarray(aux), jnp.asarray(samp_pack),
             jnp.asarray(bias_rows) if has_bias else jnp.zeros((m, V), jnp.float32),
         )
+        if n_img:
+            embeds = np.asarray(chunk[0][0].image_embeds, np.float32)[None]  # [1, N, D]
+            offsets = np.asarray([chunk[0][0].image_offset], np.int32)
+            args_in = args_in + (jnp.asarray(embeds), jnp.asarray(offsets))
         t_c = time.monotonic()
         if self.draft_cfg is None:
             (

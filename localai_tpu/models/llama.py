@@ -164,6 +164,7 @@ def _forward_hidden(
     lengths: jnp.ndarray,  # [B] int32 valid lengths
     collect_kv: bool,
     mesh=None,  # jax.sharding.Mesh with an "sp" axis > 1 → ring attention
+    inject=None,  # (embeds [B, N, D], offsets [B]) — VLM image features
 ):
     """Shared full-sequence forward. Returns (h [B,S,D] after final norm,
     length_mask [B,S], (ks, vs) or None). Single source of truth for the layer
@@ -183,6 +184,15 @@ def _forward_hidden(
     length_mask = jnp.arange(S)[None, :] < lengths[:, None]
 
     h = params["embed"][tokens]  # [B, S, D]
+    if inject is not None:
+        # Multimodal: overwrite the placeholder span with projected image
+        # features (models/vision.py) — the llava injection point.
+        embeds, offsets = inject
+        h = jax.vmap(
+            lambda hb, eb, ob: jax.lax.dynamic_update_slice(
+                hb, eb.astype(hb.dtype), (ob, 0)
+            )
+        )(h, embeds, offsets)
 
     def layer(h, lp):
         x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
@@ -211,9 +221,12 @@ def prefill(
     tokens: jnp.ndarray,  # [B, S] int32, right-padded
     lengths: jnp.ndarray,  # [B] int32 valid lengths
     mesh=None,  # Mesh with sp>1 → ring attention (sequence parallel)
+    inject=None,  # (embeds [B, N, D], offsets [B]) — VLM image features
 ):
     """Prompt processing. Returns (last_logits [B, V] f32, k [L,B,S,K,Hd], v)."""
-    h, _, (ks, vs) = _forward_hidden(cfg, params, tokens, lengths, collect_kv=True, mesh=mesh)
+    h, _, (ks, vs) = _forward_hidden(
+        cfg, params, tokens, lengths, collect_kv=True, mesh=mesh, inject=inject
+    )
     last_idx = jnp.maximum(lengths - 1, 0)  # empty prompt reads position 0, not wrap to S-1
     last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]  # [B, D]
     logits = _unembed(cfg, params, last)

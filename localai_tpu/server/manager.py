@@ -352,8 +352,9 @@ class ModelManager:
             "subprocess": self._load_subprocess,
             "bert": self._load_bert,
         }
-        loader = backend_loaders.get(cfg.backend)
-        if loader is None and cfg.backend == "llama" and (
+        vlm = cfg.backend in ("llava", "vlm", "multimodal")
+        loader = backend_loaders.get(cfg.backend) if not vlm else None
+        if loader is None and not vlm and cfg.backend == "llama" and (
             cfg.model in whisper_presets() or "whisper" in cfg.model
         ):
             loader = self._load_whisper
@@ -442,11 +443,36 @@ class ModelManager:
         )
         engine.start()
         evaluator = Evaluator(cfg, tokenizer)
+        lm = LoadedModel(cfg, engine, evaluator)
+        if vlm:
+            # Multimodal (llava-style): attach the vision tower; the chat
+            # handler injects projected image tokens at admission.
+            from localai_tpu.models import vision as V
+
+            varch = cfg.options.get("vision", "")
+            if varch in V.VISION_PRESETS:
+                vcfg = V.VISION_PRESETS[varch]
+                vparams = V.init_params(vcfg, jax.random.key(2))
+            elif ckpt_dir is not None:
+                vcfg = V.vision_config_from_hf(ckpt_dir)
+                vparams = V.load_hf_vision(vcfg, ckpt_dir)
+            else:
+                raise ValueError(
+                    f"model {cfg.name!r}: vlm backend needs options.vision "
+                    f"(preset) or a checkpoint with a vision tower"
+                )
+            if vcfg.llm_dim != arch.hidden_size:
+                raise ValueError(
+                    f"vision projector dim {vcfg.llm_dim} != LLM hidden "
+                    f"{arch.hidden_size}"
+                )
+            lm.vision = V.VisionEncoder(vcfg, vparams)
         log.info(
-            "loaded model %s (arch=%s mesh=%s) in %.1fs",
-            cfg.name, arch.name, plan, time.monotonic() - t0,
+            "loaded model %s (arch=%s mesh=%s%s) in %.1fs",
+            cfg.name, arch.name, plan, " +vision" if vlm else "",
+            time.monotonic() - t0,
         )
-        return LoadedModel(cfg, engine, evaluator)
+        return lm
 
     # ------------------------------------------------------------------ #
     # Audio backends

@@ -26,6 +26,35 @@ def _now() -> int:
     return int(time.time())
 
 
+def _extract_images(messages: list) -> list:
+    """Decode image_url content parts (data: URIs) → uint8 arrays
+    (reference: message.go content-part parsing feeding multimodal
+    backends)."""
+    import base64
+    import io
+
+    out = []
+    for m in messages:
+        content = m.get("content")
+        if not isinstance(content, list):
+            continue
+        for part in content:
+            if not isinstance(part, dict) or part.get("type") != "image_url":
+                continue
+            url = (part.get("image_url") or {}).get("url", "")
+            if not url.startswith("data:"):
+                continue  # zero-egress: only inline data URIs
+            try:
+                raw = base64.b64decode(url.split(",", 1)[-1])
+                from PIL import Image
+                import numpy as np
+
+                out.append(np.asarray(Image.open(io.BytesIO(raw)).convert("RGB")))
+            except Exception:  # noqa: BLE001 — bad image part is skipped
+                continue
+    return out
+
+
 def _fingerprint() -> str:
     return f"localai-tpu-{__version__}"
 
@@ -346,6 +375,18 @@ class OpenAIApi:
         n = self._n_choices(body)
         lp_n = self._chat_logprobs(body)
 
+        # Multimodal: project the first image and reserve a placeholder span
+        # right after BOS (llava injection — models/vision.py).
+        image_embeds = None
+        image_offset = 0
+        images = _extract_images(body["messages"])
+        vision = getattr(lm, "vision", None)
+        if images and vision is not None:
+            image_embeds = vision.encode(images[0])
+            image_offset = 1 if (add_bos and ids) else 0
+            filler = [0] * image_embeds.shape[0]
+            ids = ids[:image_offset] + filler + ids[image_offset:]
+
         # Independent GenRequest per choice: fresh grammar machine (the
         # pushdown state is mutable), decorrelated seeds when one was given.
         gens = []
@@ -353,6 +394,8 @@ class OpenAIApi:
             g = self._gen_request(lm, body, ids, extra_stop=lm.evaluator.stop_sequences())
             g.grammar = make_grammar() if make_grammar else None
             g.logprobs = lp_n
+            g.image_embeds = image_embeds
+            g.image_offset = image_offset
             if g.seed is not None and n > 1:
                 g.seed = int(g.seed) + i
             gens.append(g)
