@@ -273,16 +273,19 @@ class Engine:
             )
 
         B, S, V = self.ecfg.max_slots, self.ecfg.max_seq, cfg.vocab_size
+        from localai_tpu.models.quant import is_prequantized, quantize_params
+        from localai_tpu.parallel.sharding import param_shardings_for
+
         with self.mesh:
-            pshard = param_shardings(cfg, self.mesh)
+            pshard = param_shardings_for(cfg, self.mesh, params)
             self.params = jax.tree.map(
                 lambda a, s: jax.device_put(a, s), params, pshard
             )
-            if quantization:
+            if quantization and not is_prequantized(params):
                 # Weight-only int8 AFTER sharded placement so q/s inherit
-                # the weight shardings (models/quant.py).
-                from localai_tpu.models.quant import quantize_params
-
+                # the weight shardings (models/quant.py). Checkpoints too big
+                # for HBM in bf16 arrive pre-quantized from the loader
+                # instead (load_hf_checkpoint quantize=).
                 self.params = jax.jit(
                     lambda p: quantize_params(cfg, p, quantization)
                 )(self.params)

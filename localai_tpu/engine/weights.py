@@ -85,17 +85,33 @@ def load_hf_checkpoint(
     cfg: ArchConfig,
     ckpt_dir: str,
     put: Callable[[str, np.ndarray], jnp.ndarray] | None = None,
+    quantize: str = "",
 ) -> Params:
     """Load an HF-format Llama-family checkpoint into the stacked param tree.
 
     `put(path, np_array) -> device array` lets the caller place each tensor
     with its target sharding as it is read (engine passes a mesh-aware
     device_put); default is plain jnp.asarray in cfg.dtype.
+
+    `quantize="int8"` quantizes the matmul weights ON THE HOST as they are
+    read (models/quant.py layout) — the bf16 tree never materializes on
+    device, so checkpoints up to ~2x HBM serve from one chip.
     """
     dt = jnp.dtype(cfg.dtype)
     reader = _ShardReader(ckpt_dir)
     if put is None:
         put = lambda path, arr: jnp.asarray(arr, dt)
+
+    def place(path: str, arr: np.ndarray, can_quant: bool, qaxis: int = -2):
+        if quantize and can_quant:
+            from localai_tpu.models.quant import quantize_tensor_np
+
+            qt = quantize_tensor_np(arr, qaxis)
+            # q stays int8, s stays f32 — never routed through `put`'s cast.
+            return {"q": jnp.asarray(qt["q"]), "s": jnp.asarray(qt["s"])}
+        return put(path, arr)
+
+    _QUANT_KEYS = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
 
     def grab(name: str, transpose: bool) -> np.ndarray:
         arr = reader.get(name)
@@ -118,7 +134,10 @@ def load_hf_checkpoint(
         probe = f"model.layers.0.{suffix}"
         if probe not in reader:
             continue  # optional tensors (qkv bias)
-        layers[our] = put(f"layers/{our}", stack_layers(our, suffix, transpose))
+        layers[our] = place(
+            f"layers/{our}", stack_layers(our, suffix, transpose),
+            can_quant=our in _QUANT_KEYS,
+        )
 
     if cfg.is_moe:
         layers["router"] = put(
@@ -133,7 +152,7 @@ def load_hf_checkpoint(
                     for e in range(cfg.num_experts)
                 ]
                 per_layer.append(np.stack(experts))
-            layers[our] = put(f"layers/{our}", np.stack(per_layer))
+            layers[our] = place(f"layers/{our}", np.stack(per_layer), can_quant=True)
 
     params: Params = {
         "embed": put("embed", grab("model.embed_tokens.weight", False)),
@@ -143,7 +162,9 @@ def load_hf_checkpoint(
     if not cfg.tie_embeddings:
         name = "lm_head.weight"
         if name in reader:
-            params["lm_head"] = put("lm_head", grab(name, False))
+            params["lm_head"] = place(
+                "lm_head", grab(name, False), can_quant=True, qaxis=-1
+            )
         else:  # some checkpoints tie without declaring it
             params["lm_head"] = params["embed"]
     return params

@@ -47,6 +47,23 @@ def is_quantized(w) -> bool:
     return isinstance(w, dict) and "q" in w
 
 
+def quantize_tensor_np(arr, axis: int = -2) -> dict:
+    """numpy variant for host-side load-time quantization (streaming a
+    checkpoint too big for HBM in bf16 — e.g. 8B on one v5e chip)."""
+    import numpy as np
+
+    wf = np.asarray(arr, np.float32)
+    s = np.max(np.abs(wf), axis=axis, keepdims=True) / 127.0
+    s = np.maximum(s, 1e-9)
+    q = np.clip(np.round(wf / s), -127, 127).astype(np.int8)
+    return {"q": q, "s": s.astype(np.float32)}
+
+
+def is_prequantized(params: Params) -> bool:
+    layers = params.get("layers") or {}
+    return any(isinstance(layers.get(k), dict) for k in QUANT_LAYER_KEYS)
+
+
 def quantize_params(cfg, params: Params, mode: str = "int8") -> Params:
     """Quantize a llama-family param tree's matmul weights (jit-friendly;
     run AFTER device_put so outputs inherit shardings)."""
@@ -67,7 +84,7 @@ def quantize_params(cfg, params: Params, mode: str = "int8") -> Params:
         s = jnp.max(jnp.abs(w), axis=-1, keepdims=True) / 127.0  # [V, 1]
         s = jnp.maximum(s, 1e-9)
         q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
-        out["lm_head"] = {"q": q, "s": s, "transposed": jnp.ones((), jnp.int8)}
+        out["lm_head"] = {"q": q, "s": s}
     return out
 
 

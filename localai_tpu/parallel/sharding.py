@@ -74,6 +74,32 @@ def param_shardings(cfg: ArchConfig, mesh: Mesh) -> Params:
     )
 
 
+def param_shardings_for(cfg: ArchConfig, mesh: Mesh, params: Params) -> Params:
+    """Sharding tree structurally aligned to `params`, which may contain
+    quantized {"q", "s"} leaves (models/quant.py). q keeps the weight's
+    spec; the scale drops spec axes where its dimension is 1 (the kept
+    reduction axis cannot be sharded)."""
+    specs = param_specs(cfg)
+
+    def align(spec, leaf):
+        if isinstance(leaf, dict):  # quantized tensor
+            s_shape = leaf["s"].shape
+            spec_t = tuple(spec) + (None,) * (len(s_shape) - len(tuple(spec)))
+            s_spec = P(*[
+                None if s_shape[i] == 1 else spec_t[i] for i in range(len(s_shape))
+            ])
+            return {
+                "q": NamedSharding(mesh, spec),
+                "s": NamedSharding(mesh, s_spec),
+            }
+        return NamedSharding(mesh, spec)
+
+    return jax.tree.map(
+        align, specs, params,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
 def cache_specs() -> tuple[P, P]:
     # [L, B_slots, S_max, K, Hd]: slots over dp, kv heads over tp.
     spec = P(None, "dp", None, "tp", None)

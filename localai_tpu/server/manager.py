@@ -408,7 +408,9 @@ class ModelManager:
         if ckpt_dir is not None:
             from localai_tpu.engine.weights import load_hf_checkpoint
 
-            params = load_hf_checkpoint(arch, ckpt_dir)
+            # Load-time host quantization: the bf16 tree never touches HBM,
+            # so int8 checkpoints up to ~2x HBM serve from one chip.
+            params = load_hf_checkpoint(arch, ckpt_dir, quantize=cfg.quantization)
         else:
             params = jax.jit(lambda k: init_params(arch, k))(jax.random.key(0))
 

@@ -108,3 +108,63 @@ def test_quantization_config_plumbs_through(tmp_path):
     text, ev = lm.engine.generate([65], max_new_tokens=2, ignore_eos=True)
     assert ev.kind == "done"
     mgr.shutdown()
+
+
+def test_load_time_host_quantization(tmp_path):
+    """Checkpoint → host-side int8 → engine placement without a bf16 tree
+    ever materializing on device (the 8B-on-one-chip path)."""
+    import jax as _jax
+
+    from localai_tpu.engine.weights import load_hf_checkpoint, save_hf_checkpoint
+    from localai_tpu.models.quant import is_prequantized
+
+    cfg = get_arch("tiny")
+    params = init_params(cfg, _jax.random.key(0))
+    d = str(tmp_path / "ckpt")
+    save_hf_checkpoint(cfg, params, d)
+
+    qparams = load_hf_checkpoint(cfg, d, quantize="int8")
+    assert is_prequantized(qparams)
+    assert qparams["layers"]["wq"]["q"].dtype == jnp.int8
+    assert qparams["lm_head"]["q"].dtype == jnp.int8
+
+    eng = Engine(cfg, qparams, ByteTokenizer(cfg.vocab_size),
+                 engine_cfg=EngineConfig(max_slots=2, max_seq=128, min_prefill_bucket=16),
+                 quantization="int8")
+    eng.start()
+    try:
+        _, ev = eng.generate([65, 66], max_new_tokens=6, ignore_eos=True)
+        assert ev.completion_tokens == 6
+        # Device-quantized engine from the same weights behaves the same.
+        eng2 = Engine(cfg, params, ByteTokenizer(cfg.vocab_size),
+                      engine_cfg=EngineConfig(max_slots=2, max_seq=128, min_prefill_bucket=16),
+                      quantization="int8")
+        eng2.start()
+        try:
+            t1, _ = eng.generate([7, 8, 9], max_new_tokens=6, ignore_eos=True)
+            t2, _ = eng2.generate([7, 8, 9], max_new_tokens=6, ignore_eos=True)
+            assert t1 == t2
+        finally:
+            eng2.stop()
+    finally:
+        eng.stop()
+
+
+def test_prequantized_tp_mesh_placement(tmp_path):
+    from localai_tpu.engine.weights import load_hf_checkpoint, save_hf_checkpoint
+
+    cfg = get_arch("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    d = str(tmp_path / "ckpt")
+    save_hf_checkpoint(cfg, params, d)
+    qparams = load_hf_checkpoint(cfg, d, quantize="int8")
+    eng = Engine(cfg, qparams, ByteTokenizer(cfg.vocab_size),
+                 mesh_plan=MeshPlan(tp=2),
+                 engine_cfg=EngineConfig(max_slots=2, max_seq=128, min_prefill_bucket=16),
+                 quantization="int8")
+    eng.start()
+    try:
+        _, ev = eng.generate([10, 20], max_new_tokens=4, ignore_eos=True)
+        assert ev.completion_tokens == 4
+    finally:
+        eng.stop()
