@@ -50,9 +50,14 @@ class RealtimeSession:
             "output_sample_rate": 24_000,
             "temperature": 0.7,
             "max_response_output_tokens": 512,
+            # {"type": "server_vad", "silence_duration_ms": 500} enables
+            # automatic turn detection (reference: realtime.go server VAD
+            # via silero; here audio/vad.py energy detection).
+            "turn_detection": None,
         }
         self.conversation: list[dict[str, str]] = []
         self.audio_buffer = bytearray()
+        self._speech_started = False
 
     # ------------------------------------------------------------------ #
 
@@ -80,6 +85,7 @@ class RealtimeSession:
             ws.send_json({"type": "session.updated", "session": self.config})
         elif kind == "input_audio_buffer.append":
             self.audio_buffer.extend(base64.b64decode(ev.get("audio") or ""))
+            self._maybe_auto_commit(ws)
         elif kind == "input_audio_buffer.clear":
             self.audio_buffer.clear()
             ws.send_json({"type": "input_audio_buffer.cleared"})
@@ -110,6 +116,32 @@ class RealtimeSession:
             }})
 
     # ------------------------------------------------------------------ #
+
+    def _maybe_auto_commit(self, ws: WebSocket) -> None:
+        """Server-VAD turn detection: commit + respond once speech is
+        followed by enough trailing silence."""
+        td = self.config.get("turn_detection") or {}
+        if td.get("type") != "server_vad" or not self.audio_buffer:
+            return
+        from localai_tpu.audio import resample
+        from localai_tpu.audio.vad import energy_vad
+
+        sr = int(self.config["input_sample_rate"])
+        pcm = np.frombuffer(bytes(self.audio_buffer), np.int16).astype(np.float32) / 32768.0
+        audio16 = resample(pcm, sr, 16_000)
+        segs = energy_vad(audio16, 16_000)
+        if not segs:
+            return
+        if not self._speech_started:
+            self._speech_started = True
+            ws.send_json({"type": "input_audio_buffer.speech_started"})
+        silence_s = float(td.get("silence_duration_ms", 500)) / 1000.0
+        trailing = len(audio16) / 16_000.0 - segs[-1].end
+        if trailing >= silence_s:
+            ws.send_json({"type": "input_audio_buffer.speech_stopped"})
+            self._speech_started = False
+            self._commit_audio(ws)
+            self._respond(ws, {})
 
     def _commit_audio(self, ws: WebSocket) -> None:
         from localai_tpu.audio import resample

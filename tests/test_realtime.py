@@ -228,3 +228,44 @@ def test_empty_commit_is_an_error(rt_server):
         assert "empty" in err["error"]["message"]
     finally:
         ws.close()
+
+
+def test_server_vad_auto_turn(rt_server):
+    """server_vad turn detection: speech + trailing silence auto-commits and
+    triggers a response without an explicit commit."""
+    host, port = rt_server
+    ws = WSClient(host, port, "/v1/realtime?model=chat")
+    try:
+        assert ws.recv_json()["type"] == "session.created"
+        ws.send_json({"type": "session.update", "session": {
+            "modalities": ["text"],
+            "turn_detection": {"type": "server_vad", "silence_duration_ms": 300},
+        }})
+        assert ws.recv_json()["type"] == "session.updated"
+
+        sr = 24_000
+        t = np.arange(int(sr * 0.5)) / sr
+        speech = (0.4 * np.sin(2 * np.pi * 300 * t) * 32767).astype(np.int16)
+        silence = np.zeros(int(sr * 0.6), np.int16)
+
+        # The energy VAD needs silence contrast before speech stands out, so
+        # events only start once the silent tail arrives.
+        ws.send_json({"type": "input_audio_buffer.append",
+                      "audio": base64.b64encode(speech.tobytes()).decode()})
+        ws.send_json({"type": "input_audio_buffer.append",
+                      "audio": base64.b64encode(silence.tobytes()).decode()})
+        seen = []
+        while True:
+            ev = ws.recv_json()
+            seen.append(ev["type"])
+            if ev["type"] == "response.done":
+                break
+        assert "input_audio_buffer.speech_started" in seen
+        assert "input_audio_buffer.speech_stopped" in seen
+        assert "input_audio_buffer.committed" in seen
+        assert "response.created" in seen
+        assert seen.index("input_audio_buffer.speech_started") < seen.index(
+            "input_audio_buffer.committed"
+        )
+    finally:
+        ws.close()
