@@ -222,11 +222,15 @@ def decode_step(
     xk: jnp.ndarray,  # [L, B, T_a, H, Dh]
     xv: jnp.ndarray,
 ):
-    """One decoder step. Returns (logits [B, V] f32, new cache)."""
+    """One decoder step. Returns (logits [B, V] f32, new cache).
+
+    Same HBM-traffic shape as models/llama.py decode_step: the layer scan
+    never re-emits the cache — it attends over cache-prefix ⊕ current token
+    and outputs only the new [B, H, Dh] row; one scatter updates all layers.
+    """
     B = tokens.shape[0]
     h = params["embed"][tokens] + params["dec_pos"][pos]  # [B, d]
     batch_idx = jnp.arange(B)
-    cache_len = pos + 1
     T = cache.k.shape[2]
 
     def layer(h, xs):
@@ -235,15 +239,18 @@ def decode_step(
         q = _heads(cfg, x @ lp["q_w"] + lp["q_b"])  # [B, H, Dh]
         k = _heads(cfg, x @ lp["k_w"])
         v = _heads(cfg, x @ lp["v_w"] + lp["v_b"])
-        kc = kc.at[batch_idx, pos].set(k)
-        vc = vc.at[batch_idx, pos].set(v)
-        valid = jnp.arange(T)[None, :] < cache_len[:, None]  # [B, T]
+        valid = jnp.arange(T)[None, :] < pos[:, None]  # strictly before `pos`
         scores = jnp.einsum(
             "bhd,bthd->bht", q.astype(jnp.float32), kc.astype(jnp.float32)
         ) * cfg.head_dim**-0.5
         scores = jnp.where(valid[:, None], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("bht,bthd->bhd", probs, vc.astype(jnp.float32))
+        cur = jnp.einsum(
+            "bhd,bhd->bh", q.astype(jnp.float32), k.astype(jnp.float32)
+        )[..., None] * cfg.head_dim**-0.5  # [B, H, 1]
+        probs = jax.nn.softmax(jnp.concatenate([scores, cur], axis=-1), axis=-1)
+        attn = jnp.einsum(
+            "bht,bthd->bhd", probs[..., :T], vc.astype(jnp.float32)
+        ) + probs[..., T:] * v.astype(jnp.float32)
         h = h + attn.reshape(B, cfg.d_model).astype(h.dtype) @ lp["o_w"] + lp["o_b"]
 
         x = _ln(h, lp["lnx_w"], lp["lnx_b"])
@@ -257,9 +264,13 @@ def decode_step(
 
         x = _ln(h, lp["ln2_w"], lp["ln2_b"])
         h = h + jax.nn.gelu(x @ lp["fc1_w"] + lp["fc1_b"], approximate=False) @ lp["fc2_w"] + lp["fc2_b"]
-        return h, (kc, vc)
+        return h, (k, v)
 
-    h, (ks, vs) = jax.lax.scan(layer, h, (params["dec"], cache.k, cache.v, xk, xv))
+    h, (new_k, new_v) = jax.lax.scan(
+        layer, h, (params["dec"], cache.k, cache.v, xk, xv)
+    )
+    ks = cache.k.at[:, batch_idx, pos].set(new_k)
+    vs = cache.v.at[:, batch_idx, pos].set(new_v)
     h = _ln(h, params["dec_ln_w"], params["dec_ln_b"])
     logits = h.astype(jnp.float32) @ params["embed"].astype(jnp.float32).T
     return logits, SelfCache(k=ks, v=vs)
