@@ -404,6 +404,13 @@ def create_server(app_cfg: ApplicationConfig, router: Router) -> ThreadingHTTPSe
 
         do_POST = do_DELETE = do_PUT = do_HEAD = do_OPTIONS = do_GET
 
-    server = ThreadingHTTPServer((app_cfg.address, app_cfg.port), RequestHandlerImpl)
+    class Server(ThreadingHTTPServer):
+        # The socketserver default backlog of 5 RSTs connection bursts —
+        # any concurrent client fan-in (n>1 requests, federation, stress)
+        # trips it. Match a production accept queue.
+        request_queue_size = 128
+        daemon_threads = True
+
+    server = Server((app_cfg.address, app_cfg.port), RequestHandlerImpl)
     server.daemon_threads = True
     return server
