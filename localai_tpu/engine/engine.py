@@ -429,9 +429,21 @@ class Engine:
             tokens = jnp.where(omask, overrides, tokens)
             act_i32 = active.astype(jnp.int32)
 
-            def body(carry, _):
-                tokens, positions, cache, counts, rngs = carry
-                logits, cache = llama.decode_step(cfg, params, tokens, positions, cache)
+            # Block-local KV window: the cache stays READ-ONLY inside the
+            # scan (profiling showed a carried cache costs one full cache
+            # copy per token); the window scatters into the cache once.
+            start_pos = positions
+            local_k = jnp.zeros(
+                (cfg.num_layers, B, n, cfg.num_kv_heads, cfg.head_dim_),
+                cache.k.dtype,
+            )
+            local_v = jnp.zeros_like(local_k)
+
+            def body(carry, step):
+                tokens, positions, counts, rngs, lk, lv = carry
+                logits, lk, lv = llama.decode_step_windowed(
+                    cfg, params, tokens, positions, cache, lk, lv, step
+                )
                 split = jax.vmap(lambda k: jax.random.split(k, 2))(rngs)
                 rngs, draw = split[:, 0], split[:, 1]
                 if variant == "greedy":
@@ -457,11 +469,13 @@ class Engine:
                 # Clamp so idle/overshooting slots keep writing inside their
                 # own cache row instead of out-of-bounds.
                 positions = jnp.minimum(positions + 1, S - 1)
-                return (nxt, positions, cache, counts, rngs), out
+                return (nxt, positions, counts, rngs, lk, lv), out
 
-            (tokens, positions, cache, counts, rngs), outs = jax.lax.scan(
-                body, (tokens, positions, cache, counts, rngs), None, length=n
+            (tokens, positions, counts, rngs, local_k, local_v), outs = jax.lax.scan(
+                body, (tokens, positions, counts, rngs, local_k, local_v),
+                jnp.arange(n),
             )
+            cache = llama.write_block_to_cache(cache, local_k, local_v, start_pos)
             toks_block = outs[0]  # [n, B]
             tk_block = outs[1] if variant == "grammar" else None
             lp_block = tuple(outs[-3:]) if with_lp else None  # ([n,B],[n,B,LK],[n,B,LK])
@@ -918,7 +932,18 @@ class Engine:
 
             if active and nblocks < depth and not (grammar and self._inflight):
                 t0 = time.monotonic()
-                self._dispatch_block(grammar)
+                try:
+                    self._dispatch_block(grammar)
+                except Exception as e:  # noqa: BLE001 — fail requests, not the loop
+                    log.exception("decode block dispatch failed")
+                    for i in range(self.ecfg.max_slots):
+                        slot = self.slots[i]
+                        if slot is not None:
+                            slot.handle._q.put(TokenEvent(
+                                kind="error", error=f"{type(e).__name__}: {e}"
+                            ))
+                            self._release(i)
+                    continue
                 if trace:
                     print(f"[eng {time.monotonic():.3f}] dispatch block n={self._inflight[-1].n} "
                           f"took {(time.monotonic()-t0)*1000:.1f}ms inflight={len(self._inflight)}")

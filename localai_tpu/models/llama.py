@@ -30,6 +30,7 @@ from localai_tpu.models.quant import matmul, unembed_matmul
 from localai_tpu.ops.attention import (
     decode_attention,  # noqa: F401 — public, used by tests/benchmarks
     decode_attention_appended,
+    decode_attention_windowed,
     prefill_attention,
 )
 from localai_tpu.ops.norm import rms_norm
@@ -321,6 +322,70 @@ def decode_step(
     h = rms_norm(h, params["final_norm"], cfg.rms_eps)
     logits = _unembed(cfg, params, h)
     return logits, KVCache(k=k, v=v)
+
+
+def decode_step_windowed(
+    cfg: ArchConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [B] current token per slot
+    positions: jnp.ndarray,  # [B] its position
+    cache: KVCache,  # READ-ONLY within a decode block
+    local_k: jnp.ndarray,  # [L, B, n, K, Hd] — block-local KV window
+    local_v: jnp.ndarray,
+    step: jnp.ndarray,  # scalar index within the block
+):
+    """One step of a fused decode block with a block-local KV window.
+
+    The cache is never written here — each layer emits its new row, which is
+    appended to the local window; the engine scatters the whole window into
+    the cache once per block. Returns (logits [B, V] f32, local_k, local_v).
+    """
+    B = tokens.shape[0]
+    inv_freq = rope_frequencies(cfg)
+    h = params["embed"][tokens]
+
+    def layer(h, xs):
+        lp, kc, vc, lk, lv = xs
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _attn_proj_qkv(cfg, lp, x)
+        q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
+        k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
+        attn = decode_attention_windowed(
+            q, kc, vc, lk, lv, k, v, positions, step
+        )
+        h = h + matmul(attn.reshape(B, -1), lp["wo"])
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
+        h = h + _mlp(cfg, lp, x)
+        return h, (k, v)
+
+    h, (new_k, new_v) = jax.lax.scan(
+        layer, h, (params["layers"], cache.k, cache.v, local_k, local_v)
+    )
+    local_k = jax.lax.dynamic_update_index_in_dim(
+        local_k, new_k.astype(local_k.dtype), step, axis=2
+    )
+    local_v = jax.lax.dynamic_update_index_in_dim(
+        local_v, new_v.astype(local_v.dtype), step, axis=2
+    )
+    h = rms_norm(h, params["final_norm"], cfg.rms_eps)
+    return _unembed(cfg, params, h), local_k, local_v
+
+
+def write_block_to_cache(
+    cache: KVCache,
+    local_k: jnp.ndarray,  # [L, B, n, K, Hd]
+    local_v: jnp.ndarray,
+    start_positions: jnp.ndarray,  # [B] — block start per slot
+) -> KVCache:
+    """Scatter a decode block's local KV window into the cache (once per
+    block). Overshooting rows clamp to S-1 (host discards those tokens)."""
+    L, B, n = local_k.shape[:3]
+    S = cache.k.shape[2]
+    span = jnp.minimum(start_positions[:, None] + jnp.arange(n)[None, :], S - 1)
+    bi = jnp.arange(B)[:, None]
+    k = cache.k.at[:, bi, span].set(local_k.astype(cache.k.dtype))
+    v = cache.v.at[:, bi, span].set(local_v.astype(cache.v.dtype))
+    return KVCache(k=k, v=v)
 
 
 def decode_chunk(

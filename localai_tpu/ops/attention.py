@@ -113,6 +113,49 @@ def decode_attention_appended(
     return out.reshape(B, H, D).astype(q.dtype)
 
 
+def decode_attention_windowed(
+    q: jnp.ndarray,  # [B, H, D] current token's query
+    k_cache: jnp.ndarray,  # [B, S, K, D] — READ-ONLY cache (pre-block rows)
+    v_cache: jnp.ndarray,
+    k_local: jnp.ndarray,  # [B, n, K, D] — this decode block's earlier tokens
+    v_local: jnp.ndarray,
+    k_new: jnp.ndarray,  # [B, K, D] current token
+    v_new: jnp.ndarray,
+    positions: jnp.ndarray,  # [B] current token's position
+    step: jnp.ndarray,  # scalar: index of the current token within the block
+) -> jnp.ndarray:
+    """Decode attention over `cache[0:block_start] ⊕ local[0:step] ⊕ current`.
+
+    Inside a fused N-step decode block the cache stays READ-ONLY (its
+    in-block rows live in the local window), so the block's lax.scan carries
+    only the tiny local buffer — the full cache is written ONCE per block.
+    Profiling showed the carried-cache alternative costs a full cache copy
+    per token (engine VERDICT-weak decode path)."""
+    B, H, D = q.shape
+    S = k_cache.shape[1]
+    n = k_local.shape[1]
+    K = k_cache.shape[2]
+    G = H // K
+    scale = 1.0 / (D**0.5)
+
+    qf = (q.astype(jnp.float32) * scale).reshape(B, K, G, D)
+    block_start = positions - step  # [B]
+    sc = jnp.einsum("bkgd,bskd->bkgs", qf, k_cache.astype(jnp.float32))
+    valid_c = jnp.arange(S)[None, :] < block_start[:, None]
+    sc = jnp.where(valid_c[:, None, None, :], sc, NEG_INF)
+    sl = jnp.einsum("bkgd,bnkd->bkgn", qf, k_local.astype(jnp.float32))
+    valid_l = jnp.arange(n) < step  # [n] — same for every slot
+    sl = jnp.where(valid_l[None, None, None, :], sl, NEG_INF)
+    cur = jnp.einsum("bkgd,bkd->bkg", qf, k_new.astype(jnp.float32))[..., None]
+    probs = jax.nn.softmax(jnp.concatenate([sc, sl, cur], axis=-1), axis=-1)
+    out = (
+        jnp.einsum("bkgs,bskd->bkgd", probs[..., :S], v_cache.astype(jnp.float32))
+        + jnp.einsum("bkgn,bnkd->bkgd", probs[..., S:S + n], v_local.astype(jnp.float32))
+        + probs[..., S + n:] * v_new.astype(jnp.float32)[:, :, None, :]
+    )
+    return out.reshape(B, H, D).astype(q.dtype)
+
+
 def decode_attention(
     q: jnp.ndarray,  # [B, H, D] query for the single new token per slot
     k_cache: jnp.ndarray,  # [B, S_max, K, D]
