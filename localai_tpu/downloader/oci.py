@@ -134,7 +134,13 @@ def resolve_model_uri(uri: str, dest_dir: str,
         return pull_ollama(uri[len("ollama://"):], dest_dir, progress)
     if uri.startswith("oci://"):
         rest = uri[len("oci://"):]
-        hostrepo, _, tag = rest.partition(":")
+        # The tag separator is the last ':' AFTER the last '/' — a colon
+        # before the first slash is a registry port (oci://host:5000/repo:tag).
+        idx = rest.rfind(":")
+        if idx > rest.rfind("/"):
+            hostrepo, tag = rest[:idx], rest[idx + 1:]
+        else:
+            hostrepo, tag = rest, ""
         if "/" not in hostrepo:
             raise DownloadError(f"oci:// URI needs registry/repo:tag, got {uri!r}")
         host, _, repo = hostrepo.partition("/")

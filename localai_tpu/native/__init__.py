@@ -92,15 +92,19 @@ class NativeBPE:
                                    merges_blob, len(merges_blob))
         if not self._handle:
             raise RuntimeError("bpe_new failed")
-        self._out = (ctypes.c_int32 * 4096)()
 
     def encode_piece(self, piece: str) -> list[int]:
         data = piece.encode("utf-8")
+        # Per-call buffer: the server tokenizes from many threads and ctypes
+        # releases the GIL during the foreign call, so a shared buffer races.
+        # Byte-level BPE yields at most one id per input byte (merges only
+        # shrink), so len(data) capacity can never be exceeded.
+        out = (ctypes.c_int32 * max(64, len(data)))()
         n = self._lib.bpe_encode_piece(self._handle, data, len(data),
-                                       self._out, len(self._out))
+                                       out, len(out))
         if n < 0:
             raise ValueError(f"native BPE could not encode piece {piece!r}")
-        return list(self._out[:n])
+        return out[:n]
 
     def __del__(self):
         handle = getattr(self, "_handle", None)

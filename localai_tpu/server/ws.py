@@ -18,6 +18,10 @@ _GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 
 OP_CONT, OP_TEXT, OP_BIN, OP_CLOSE, OP_PING, OP_PONG = 0x0, 0x1, 0x2, 0x8, 0x9, 0xA
 
+# Largest accepted message (single frame or fragmented total). Realtime audio
+# chunks are well under this; anything bigger is a memory-exhaustion attempt.
+MAX_MESSAGE_BYTES = 16 * 1024 * 1024
+
 
 class WebSocketUpgrade:
     """Handler return value: accept the upgrade, then run `session(ws)`."""
@@ -74,6 +78,19 @@ class WebSocket:
                 (ln,) = struct.unpack(">H", self._read_exact(2))
             elif ln == 127:
                 (ln,) = struct.unpack(">Q", self._read_exact(8))
+            # The length field is client-controlled; cap it (and the
+            # accumulated fragmented message) before buffering anything, or a
+            # client claiming a multi-GB payload drives unbounded allocation.
+            # Control frames may interleave a fragmented message and never
+            # join it — but RFC 6455 §5.5 bounds them to 125 bytes (protocol
+            # error beyond that, 1002), which also blocks ping amplification.
+            if op >= 0x8:
+                if ln > 125:
+                    self.close(code=1002)
+                    return None
+            elif ln + len(message) > MAX_MESSAGE_BYTES:
+                self.close(code=1009)  # Message Too Big
+                return None
             mask = self._read_exact(4) if masked else None
             payload = self._read_exact(ln)
             if mask:
@@ -137,7 +154,7 @@ class WebSocket:
     def send_bytes(self, data: bytes) -> None:
         self._send_frame(OP_BIN, data)
 
-    def close(self) -> None:
+    def close(self, code: int = 1000) -> None:
         if self.open:
-            self._send_frame(OP_CLOSE, b"")
+            self._send_frame(OP_CLOSE, struct.pack(">H", code))
             self.open = False

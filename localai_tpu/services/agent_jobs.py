@@ -61,7 +61,16 @@ def cron_matches(expr: str, t: time.struct_time) -> bool:
         and _cron_field_matches(hour, t.tm_hour)
         and _cron_field_matches(dom, t.tm_mday)
         and _cron_field_matches(mon, t.tm_mon)
-        and _cron_field_matches(dow, t.tm_wday)  # 0 = Monday (python)
+        and _cron_dow_matches(dow, t.tm_wday)
+    )
+
+
+def _cron_dow_matches(field: str, tm_wday: int) -> bool:
+    # cron day-of-week is 0=Sunday (7 also accepted as Sunday); python
+    # tm_wday is 0=Monday. Convert, and let Sunday match either spelling.
+    v = (tm_wday + 1) % 7
+    return _cron_field_matches(field, v) or (
+        v == 0 and _cron_field_matches(field, 7)
     )
 
 

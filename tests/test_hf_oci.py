@@ -174,6 +174,25 @@ def test_oci_uri_scheme(tmp_path):
         reg.stop()
 
 
+def test_oci_uri_with_registry_port(tmp_path, monkeypatch):
+    """oci://host:5000/repo:tag — the port colon is not the tag separator."""
+    import localai_tpu.downloader.oci as oci_mod
+
+    calls = []
+
+    def fake_pull(base, repo, tag, dest_dir, progress=None):
+        calls.append((base, repo, tag))
+        return "ok"
+
+    monkeypatch.setattr(oci_mod, "pull_oci_blob", fake_pull)
+    resolve_model_uri("oci://localhost:5000/team/model:v2", str(tmp_path))
+    resolve_model_uri("oci://localhost:5000/team/model", str(tmp_path))
+    assert calls == [
+        ("https://localhost:5000", "team/model", "v2"),
+        ("https://localhost:5000", "team/model", "latest"),
+    ]
+
+
 def test_oci_bad_uri_rejected(tmp_path):
     from localai_tpu.downloader import DownloadError
 

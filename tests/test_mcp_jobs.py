@@ -140,11 +140,23 @@ def test_cron_matcher():
     t = time.struct_time((2026, 7, 30, 14, 30, 0, 2, 211, -1))  # Wed 14:30
     assert cron_matches("30 14 * * *", t)
     assert cron_matches("*/15 * * * *", t)
-    assert cron_matches("* * * * 2", t)  # tm_wday 2 = Wednesday
+    assert cron_matches("* * * * 3", t)  # cron dow 3 = Wednesday (0=Sunday)
+    assert not cron_matches("* * * * 2", t)  # 2 = Tuesday, not today
     assert not cron_matches("31 14 * * *", t)
     assert cron_matches("25-35 14 30 7 *", t)
     with pytest.raises(ValueError):
         cron_matches("* * *", t)
+
+
+def test_cron_dow_uses_sunday_zero():
+    # 2026-08-02 is a Sunday (tm_wday 6); cron spells Sunday 0 or 7.
+    sun = time.struct_time((2026, 8, 2, 9, 0, 0, 6, 214, -1))
+    assert cron_matches("0 9 * * 0", sun)
+    assert cron_matches("0 9 * * 7", sun)
+    assert not cron_matches("0 9 * * 1", sun)
+    mon = time.struct_time((2026, 8, 3, 9, 0, 0, 0, 215, -1))
+    assert cron_matches("0 9 * * 1", mon)
+    assert not cron_matches("0 9 * * 0", mon)
 
 
 def test_jobs_crud_persistence_and_schedule(tmp_path):

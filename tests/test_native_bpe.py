@@ -106,6 +106,48 @@ def test_hftokenizer_uses_fast_path(hf_dir):
         os.environ.pop("LOCALAI_NATIVE_BPE")
 
 
+def test_fastbpe_threaded_encode_is_race_free(hf_dir, hf_tok):
+    """8 threads encoding distinct texts concurrently must each get their own
+    ids — a shared native out-buffer would cross-contaminate results (the
+    foreign call releases the GIL)."""
+    import threading
+
+    from localai_tpu.engine.bpe_fast import FastBPE
+
+    fast = FastBPE.for_hf_dir(hf_dir, hf_tok)
+    assert fast is not None
+    texts = [
+        f"thread {i}: the quick brown fox {i} jumps " + "abc" * (10 + i)
+        for i in range(8)
+    ]
+    want = [hf_tok.encode(t, add_special_tokens=False) for t in texts]
+    errors = []
+
+    def worker(idx):
+        for _ in range(300):
+            fast._piece_cache.clear()  # force the native call every round
+            if fast.encode(texts[idx]) != want[idx]:
+                errors.append(idx)
+                return
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, f"cross-thread corruption in threads {sorted(set(errors))}"
+
+
+def test_fastbpe_huge_single_piece(hf_dir, hf_tok):
+    """A piece that encodes to >4096 ids (e.g. a long symbol run kept whole by
+    the split regex) must encode, not 500."""
+    from localai_tpu.engine.bpe_fast import FastBPE
+
+    fast = FastBPE.for_hf_dir(hf_dir, hf_tok)
+    text = "?!" * 5000  # one punctuation-run piece, 10k bytes
+    assert fast.encode(text) == hf_tok.encode(text, add_special_tokens=False)
+
+
 def test_validation_rejects_mismatched_tokenizer(hf_dir, hf_tok, tmp_path):
     """Corrupt merges → canary mismatch → fast path disabled, not wrong."""
     import shutil

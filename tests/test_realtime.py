@@ -269,3 +269,25 @@ def test_server_vad_auto_turn(rt_server):
         )
     finally:
         ws.close()
+
+
+def test_oversized_frame_rejected_with_1009(rt_server):
+    """A client claiming a payload above MAX_MESSAGE_BYTES gets a 1009 close
+    before the server buffers anything."""
+    host, port = rt_server
+    ws = WSClient(host, port, "/v1/realtime?model=chat")
+    try:
+        assert ws.recv_json()["type"] == "session.created"
+        # Hand-craft a masked text frame header claiming 1 GiB, send no body.
+        mask = os.urandom(4)
+        header = bytes([0x81, 0x80 | 127]) + struct.pack(">Q", 1 << 30) + mask
+        ws.sock.sendall(header)
+        # Server must close (1009) instead of trying to read the gigabyte.
+        b1, b2 = ws._read_exact(2)
+        assert (b1 & 0x0F) == 0x8, "expected close frame"
+        ln = b2 & 0x7F
+        payload = ws._read_exact(ln)
+        (code,) = struct.unpack(">H", payload[:2])
+        assert code == 1009
+    finally:
+        ws.close()
