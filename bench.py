@@ -122,7 +122,9 @@ def main() -> None:
     # int8 weight-only row (reference parity: quantized GGUF serving is the
     # reference's standard practice; here per-channel int8 with dequant fused
     # into the matmuls — models/quant.py).
-    if os.environ.get("BENCH_INT8", "1") != "0":
+    for mode in ("int8", "int4"):
+        if os.environ.get(f"BENCH_{mode.upper()}", "1") == "0":
+            continue
         try:
             eng.cache = None
             eng.params = None
@@ -132,7 +134,7 @@ def main() -> None:
             eng_q = Engine(
                 cfg, params, ByteTokenizer(cfg.vocab_size),
                 engine_cfg=EngineConfig(max_slots=slots, max_seq=max_seq),
-                quantization="int8",
+                quantization=mode,
             )
             eng_q.warmup(prompt_len)
             eng_q._decode_time = 0.0
@@ -146,7 +148,6 @@ def main() -> None:
                     )
                 )
                 qthreads.append(t)
-            qwall0 = time.time()
             for t in qthreads:
                 t.start()
             for t in qthreads:
@@ -155,14 +156,14 @@ def main() -> None:
                 eng_q._decode_tokens / eng_q._decode_time
                 if eng_q._decode_time else 0.0
             )
-            out["decode_tokens_per_sec_int8"] = round(qtps, 2)
-            print(f"int8 row: decode {qtps:.1f} tok/s", file=sys.stderr)
+            out[f"decode_tokens_per_sec_{mode}"] = round(qtps, 2)
+            print(f"{mode} row: decode {qtps:.1f} tok/s", file=sys.stderr)
             eng_q.stop()
             eng_q.cache = None
             eng_q.params = None
             gc.collect()
         except Exception as e:  # noqa: BLE001 — extra row is best-effort
-            print(f"int8 row failed: {type(e).__name__}: {e}", file=sys.stderr)
+            print(f"{mode} row failed: {type(e).__name__}: {e}", file=sys.stderr)
 
     # Long-context row (VERDICT #7): one near-max-bucket prompt through the
     # flash prefill path; second run reported (first pays the compile).
@@ -200,8 +201,218 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — long row is best-effort
             print(f"long-context row failed: {type(e).__name__}: {e}", file=sys.stderr)
         eng_long.stop()
+        eng_long.params = eng_long.cache = None
+
+    # North-star row (BASELINE.md): llama-3-8b int8, served end-to-end over
+    # HTTP POST /v1/chat/completions with stream:true. Synthetic weights
+    # (zero egress) on the real 8B arch; decode tok/s from the engine's
+    # steady-state counters, TTFT measured at the HTTP client.
+    default_8b = "1" if jax.default_backend() == "tpu" else "0"
+    if os.environ.get("BENCH_HTTP_8B", default_8b) != "0":
+        # Drop every live reference to the earlier engines' HBM before the
+        # 8 GB int8 tree loads.
+        del params
+        eng.params = eng.cache = None
+        try:
+            row = _http_8b_row(slots=slots, prompt_len=prompt_len,
+                               gen_len=gen_len, max_seq=max_seq)
+        except Exception as e:  # noqa: BLE001 — keep the 1B metric on failure
+            import traceback
+
+            traceback.print_exc()
+            print(f"8B HTTP row failed: {type(e).__name__}: {e}", file=sys.stderr)
+            row = None
+        if row:
+            # The 8B HTTP number becomes the primary metric; the 1B row
+            # stays as a named secondary key.
+            out[out.pop("metric")] = out.pop("value")
+            out.pop("unit", None)
+            out = {**row, **out}
 
     print(json.dumps(out))
+
+
+def _http_8b_row(slots: int, prompt_len: int, gen_len: int, max_seq: int):
+    """Serve llama-3-8b (int8) through the real HTTP stack and measure it."""
+    import gc
+    import http.client
+    import tempfile
+
+    import jax
+    import yaml
+
+    gc.collect()
+
+    from localai_tpu.config import ApplicationConfig
+    from localai_tpu.server import ModelManager, Router, create_server
+    from localai_tpu.server.openai_api import OpenAIApi
+
+    arch_name = os.environ.get("BENCH_HTTP_ARCH", "llama-3-8b")
+    with tempfile.TemporaryDirectory() as d:
+        with open(os.path.join(d, "m.yaml"), "w") as f:
+            yaml.safe_dump({
+                "name": arch_name, "model": arch_name,
+                "quantization": "int8", "max_slots": slots,
+                "context_size": max_seq, "max_tokens": gen_len,
+                "temperature": 0.0,
+                "template": {"family": "chatml"},
+            }, f)
+        app_cfg = ApplicationConfig(address="127.0.0.1", port=0,
+                                    models_dir=d, max_active_models=1)
+        manager = ModelManager(app_cfg)
+        router = Router()
+        OpenAIApi(manager).register(router)
+        server = create_server(app_cfg, router)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+
+        body_tpl = {
+            "model": arch_name, "stream": True, "ignore_eos": True,
+            "max_tokens": gen_len,
+            "messages": [{"role": "user", "content": "x" * prompt_len}],
+        }
+
+        results: list[dict] = []
+        errors: list[str] = []
+        lock = threading.Lock()
+
+        def one(i: int) -> None:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=600)
+            try:
+                t0 = time.time()
+                conn.request(
+                    "POST", "/v1/chat/completions",
+                    body=json.dumps(body_tpl),
+                    headers={"Content-Type": "application/json",
+                             "Extra-Usage": "1"},
+                )
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    raise RuntimeError(f"HTTP {resp.status}: {resp.read()[:200]}")
+                ttft = None
+                n_tokens = 0
+                usage = {}
+                buf = b""
+                while True:
+                    chunk = resp.read(1)
+                    if not chunk:
+                        # Stream ended without [DONE]: the request must count
+                        # as failed, not silently vanish from the stats.
+                        raise RuntimeError("stream closed before [DONE]")
+                    buf += chunk
+                    while b"\n" in buf:
+                        line, _, buf = buf.partition(b"\n")
+                        line = line.strip()
+                        if not line.startswith(b"data:"):
+                            continue
+                        data = line[len(b"data:"):].strip()
+                        if data == b"[DONE]":
+                            with lock:
+                                results.append({
+                                    "ttft": ttft, "tokens": n_tokens,
+                                    "wall": time.time() - t0, "usage": usage,
+                                })
+                            return
+                        ev = json.loads(data)
+                        if ev.get("usage"):
+                            usage = ev["usage"]
+                        delta = (ev.get("choices") or [{}])[0].get("delta") or {}
+                        # One chunk per generated token (empty text included);
+                        # the initial role chunk carries "role" and is skipped.
+                        if "content" in delta and "role" not in delta:
+                            if ttft is None:
+                                ttft = time.time() - t0
+                            n_tokens += 1
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(f"req {i}: {type(e).__name__}: {e}")
+            finally:
+                conn.close()
+
+        def round_(tag: str) -> float:
+            threads = [threading.Thread(target=one, args=(i,)) for i in range(slots)]
+            w0 = time.time()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.time() - w0
+            print(f"8B HTTP {tag}: {wall:.1f}s "
+                  f"({len(results)} ok, {len(errors)} err)", file=sys.stderr)
+            return wall
+
+        t0 = time.time()
+        lm = manager.get(arch_name)  # load + quantize before timing requests
+        print(f"8B load: {time.time() - t0:.1f}s", file=sys.stderr)
+        # Staggered admission means different block shapes compile across the
+        # first rounds; warm until the round wall stops shrinking.
+        prev = float("inf")
+        for w in range(int(os.environ.get("BENCH_HTTP_WARMUP", "4"))):
+            wall = round_(f"warmup{w}")
+            if errors:
+                raise RuntimeError("; ".join(errors[:3]))
+            results.clear()
+            if wall > 0.7 * prev:
+                break
+            prev = wall
+        eng = lm.engine
+        eng._decode_time = 0.0
+        eng._decode_tokens = 0
+        wall = round_("measured")
+        if errors:
+            raise RuntimeError("; ".join(errors[:3]))
+
+        decode_tps = eng._decode_tokens / eng._decode_time if eng._decode_time else 0.0
+        total_tokens = sum(r["tokens"] for r in results)
+        usage_tokens = sum((r["usage"] or {}).get("completion_tokens", 0) for r in results)
+        if usage_tokens and usage_tokens != total_tokens:
+            print(f"8B row: chunk count {total_tokens} != usage {usage_tokens}",
+                  file=sys.stderr)
+            total_tokens = usage_tokens
+        # Client-side first-content time exists only when the model emits
+        # decodable text (synthetic weights rarely do); engine prefill timing
+        # (timing_prompt_processing, the reference's TTFT proxy —
+        # BASELINE.md) is always present.
+        ttfts = sorted(r["ttft"] for r in results if r["ttft"] is not None)
+        p50_ttft = ttfts[len(ttfts) // 2] if ttfts else None
+        prefill_s = [
+            (r["usage"] or {}).get("timing_prompt_processing") for r in results
+        ]
+        prefill_s = sorted(v for v in prefill_s if v is not None)
+        p50_prefill_ms = (
+            round(prefill_s[len(prefill_s) // 2] * 1000, 1) if prefill_s else None
+        )
+
+        param_bytes = sum(
+            a.size * a.dtype.itemsize for a in jax.tree.leaves(eng.params)
+        )
+        cfg = eng.cfg
+        avg_len = prompt_len + gen_len / 2
+        kv_bytes = (2 * cfg.num_layers * slots * avg_len
+                    * cfg.num_kv_heads * cfg.head_dim_ * 2)
+        roofline_tps = 819e9 / (param_bytes + kv_bytes) * slots
+        pct = 100.0 * decode_tps / roofline_tps if roofline_tps else 0.0
+        print(
+            f"8B HTTP row: decode={decode_tps:.1f} tok/s "
+            f"e2e={total_tokens / wall:.1f} tok/s p50_prefill={p50_prefill_ms}ms "
+            f"roofline={roofline_tps:.0f} achieved={pct:.1f}%",
+            file=sys.stderr,
+        )
+        server.shutdown()
+        manager.shutdown()
+        row = {
+            "metric": f"decode_tokens_per_sec_{arch_name}-int8_http_bs{slots}",
+            "value": round(decode_tps, 2),
+            "unit": "tok/s",
+            "vs_baseline": None,  # reference publishes no numbers (SURVEY §6)
+            "p50_ttft_ms": p50_prefill_ms,
+            "p50_first_content_ms_http": (
+                round(p50_ttft * 1000, 1) if p50_ttft is not None else None
+            ),
+            "e2e_tokens_per_sec_http": round(total_tokens / wall, 2),
+            "pct_of_hbm_roofline_8b": round(pct, 1),
+        }
+        return row
 
 
 if __name__ == "__main__":

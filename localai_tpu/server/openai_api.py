@@ -200,6 +200,9 @@ class OpenAIApi:
             stop=stop,
             seed=body.get("seed", cfg.seed),
             logit_bias=logit_bias,
+            # vLLM-style extension: benchmarking/testing wants fixed-length
+            # generations regardless of what the model samples.
+            ignore_eos=bool(body.get("ignore_eos", False)),
         )
 
     @staticmethod
