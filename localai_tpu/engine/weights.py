@@ -101,14 +101,25 @@ def load_hf_checkpoint(
     reader = _ShardReader(ckpt_dir)
     if put is None:
         put = lambda path, arr: jnp.asarray(arr, dt)
+    if quantize not in ("", "none", None, "int8", "int4"):
+        raise ValueError(f"unsupported quantization mode {quantize!r}")
+    do_quant = quantize in ("int8", "int4")
 
     def place(path: str, arr: np.ndarray, can_quant: bool, qaxis: int = -2):
-        if quantize and can_quant:
-            from localai_tpu.models.quant import quantize_tensor_np
+        if do_quant and can_quant:
+            from localai_tpu.models.quant import (
+                quantize_tensor_np,
+                quantize_tensor_np_g4,
+            )
 
-            qt = quantize_tensor_np(arr, qaxis)
-            # q stays int8, s stays f32 — never routed through `put`'s cast.
-            return {"q": jnp.asarray(qt["q"]), "s": jnp.asarray(qt["s"])}
+            # lm_head (qaxis=-1) always goes per-channel int8 — the unembed
+            # path's form; int4 applies to the grouped matmul weights.
+            if quantize == "int4" and qaxis == -2:
+                qt = quantize_tensor_np_g4(arr)
+            else:
+                qt = quantize_tensor_np(arr, qaxis)
+            # payload stays int, scales stay f32 — never `put`'s cast.
+            return {k: jnp.asarray(v) for k, v in qt.items()}
         return put(path, arr)
 
     _QUANT_KEYS = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}

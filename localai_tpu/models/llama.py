@@ -106,9 +106,37 @@ def init_params(cfg: ArchConfig, key: jnp.ndarray, scale: float = 0.02) -> Param
 def _moe_mm(x: jnp.ndarray, w, sub: str) -> jnp.ndarray:
     """Per-expert matmul for plain or quantized expert weights."""
     if isinstance(w, dict):
-        out = jnp.einsum(sub, x, w["q"].astype(x.dtype))
-        return out * w["s"].astype(x.dtype)[..., 0, :]
+        if "q" in w:
+            out = jnp.einsum(sub, x, w["q"].astype(x.dtype))
+            return out * w["s"].astype(x.dtype)[..., 0, :]
+        return _moe_grouped_mm(x, w, sub)
     return jnp.einsum(sub, x, w)
+
+
+def _moe_grouped_mm(x: jnp.ndarray, w: dict, sub: str) -> jnp.ndarray:
+    """Grouped int4/int8 expert weights [E, G, gs(, packed), out] for the two
+    MoE einsum shapes (see quant.grouped_matmul for the dequant math)."""
+    from localai_tpu.models.quant import _grouped_values
+
+    qv = _grouped_values(w, x.dtype)  # [E, G, gs, out]
+    s = w["gs"].astype(x.dtype)[..., 0, :]  # [E, G, out]
+    z = w["gz"].astype(x.dtype)[..., 0, :] if "gz" in w else None
+    e, g, gs, n_out = qv.shape
+    if sub == "...d,edf->...ef":  # x [..., D] shared across experts
+        xg = x.reshape(*x.shape[:-1], g, gs)
+        y = jnp.einsum("...gi,egin->...egn", xg, qv)
+        out = (y * s).sum(axis=-2)
+        if z is not None:
+            out = out - jnp.einsum("...g,egn->...en", xg.sum(-1), z)
+        return out
+    if sub == "...ef,efd->...ed":  # x already per-expert [..., E, F]
+        xg = x.reshape(*x.shape[:-2], e, g, gs)
+        y = jnp.einsum("...egi,egin->...egn", xg, qv)
+        out = (y * s).sum(axis=-2)
+        if z is not None:
+            out = out - jnp.einsum("...eg,egn->...en", xg.sum(-1), z)
+        return out
+    raise ValueError(f"unsupported MoE einsum {sub!r} for grouped weights")
 
 
 def _mlp(cfg: ArchConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:

@@ -1,17 +1,26 @@
-"""Weight-only int8 quantization for serving.
+"""Weight-only quantization for serving: per-channel int8 and grouped int4/int8.
 
 Reference capability: quantized GGUFs are llama.cpp's bread and butter (the
 reference serves Q4/Q8 checkpoints everywhere). TPU-native shape: weight-only
-per-output-channel symmetric int8, dequantized INSIDE the matmul — XLA fuses
-the int8→bf16 convert into the dot's operand load, so HBM streams one byte
-per weight instead of two. Measured on v5e (llama-3.2-1b bs8 decode):
-~17% faster steps and half the weight footprint; quality cost is the usual
-weight-only-int8 rounding (≈1e-2 relative per matmul).
+quantization with dequant fused INSIDE the matmul — XLA folds the int→bf16
+convert into the dot's operand load, so HBM streams 1 byte (int8) or 0.5+ε
+bytes (packed int4) per weight instead of two. Measured on v5e
+(llama-3.2-1b bs8 decode): ~17% faster steps at int8 and half the weight
+footprint; int4 halves it again (llama.cpp Q4-class memory envelope).
 
-A quantized tensor is the pytree {"q": int8 [..., in, out], "s": f32
-[..., 1, out]}; `matmul(x, w)` in models/llama.py consumes either form.
+Representations consumed by `matmul` / `unembed_matmul`:
+- {"q": int8 [..., in, out], "s": f32 [..., 1, out]} — per-output-channel
+  symmetric int8 (mode "int8").
+- {"gq": int8 [..., G, gs, out], "gs": f32 [..., G, 1, out]} — group-wise
+  symmetric int8 (GGUF q8_0 repacks losslessly; q5/q6_K regrid here).
+- {"g4": uint8 [..., G, gs//2, out], "gs": ..., "gz": f32 [..., G, 1, out]}
+  — group-wise affine 4-bit, two nibbles per byte along the in-group axis
+  (low nibbles = first gs/2 elements). value = nibble * gs - gz. GGUF
+  q4_0/q4_K blocks repack losslessly (mode "int4" for our own weights).
+
 Quantization happens on device AFTER sharded placement, so the q/s arrays
-inherit the weight's sharding and no sharding-spec plumbing changes.
+inherit the weight's sharding (parallel/sharding.py aligns specs to either
+form).
 """
 
 from __future__ import annotations
@@ -36,15 +45,73 @@ def quantize_tensor(w: jnp.ndarray) -> dict[str, jnp.ndarray]:
     return {"q": q, "s": s}
 
 
+GROUP_SIZE = 32  # matches GGUF q4_0/q8_0 blocks → lossless repack
+
+
+def quantize_tensor_g4(w: jnp.ndarray, group: int = GROUP_SIZE) -> dict[str, jnp.ndarray]:
+    """Group-wise affine 4-bit over the reduction (-2) axis; value =
+    nibble * gs - gz, nibbles packed two per byte (low = first half of the
+    group). jit-friendly."""
+    *lead, n_in, n_out = w.shape
+    if n_in % group:
+        raise ValueError(f"in dim {n_in} not divisible by group {group}")
+    g = n_in // group
+    wf = w.astype(jnp.float32).reshape(*lead, g, group, n_out)
+    mn = wf.min(axis=-2, keepdims=True)
+    mx = wf.max(axis=-2, keepdims=True)
+    s = jnp.maximum((mx - mn) / 15.0, 1e-9)
+    nib = jnp.clip(jnp.round((wf - mn) / s), 0, 15).astype(jnp.uint8)
+    half = group // 2
+    packed = nib[..., :half, :] | (nib[..., half:, :] << 4)
+    return {"g4": packed, "gs": s, "gz": -mn}
+
+
+def _grouped_values(w, dtype) -> jnp.ndarray:
+    """[..., G, gs, out] values (still un-scaled) from a grouped dict."""
+    if "g4" in w:
+        qp = w["g4"]
+        lo = qp & jnp.uint8(0xF)
+        hi = qp >> jnp.uint8(4)
+        return jnp.concatenate([lo, hi], axis=-2).astype(dtype)
+    return w["gq"].astype(dtype)
+
+
+def grouped_matmul(x: jnp.ndarray, w: dict) -> jnp.ndarray:
+    """x [..., in] @ grouped-quantized w [G, gs(, packed), out] → [..., out].
+
+    One batched dot per group with the scale applied on the group partials —
+    XLA fuses the unpack/convert into the dot's operand load, so HBM streams
+    the packed bytes. The affine zero-point contributes Σ_i x_i · z per
+    group, a cheap rank-1 correction."""
+    qv = _grouped_values(w, x.dtype)  # [G, gs, out]
+    g, gs, n_out = qv.shape
+    xg = x.reshape(*x.shape[:-1], g, gs)
+    y = jnp.einsum("...gi,gin->...gn", xg, qv)
+    y = y * w["gs"].astype(x.dtype)[..., 0, :]
+    out = y.sum(axis=-2)
+    if "gz" in w:
+        xsum = xg.sum(axis=-1)  # [..., G]
+        out = out - jnp.einsum(
+            "...g,gn->...n", xsum, w["gz"].astype(x.dtype)[..., 0, :]
+        )
+    return out
+
+
 def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
     """x @ w for plain or quantized w (dequant fused into the dot)."""
     if isinstance(w, dict):
-        return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)[..., 0, :]
+        if "q" in w:
+            return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)[..., 0, :]
+        return grouped_matmul(x, w)
     return x @ w
 
 
 def is_quantized(w) -> bool:
-    return isinstance(w, dict) and "q" in w
+    return isinstance(w, dict) and ("q" in w or "gq" in w or "g4" in w)
+
+
+def is_grouped(w) -> bool:
+    return isinstance(w, dict) and ("gq" in w or "g4" in w)
 
 
 def quantize_tensor_np(arr, axis: int = -2) -> dict:
@@ -59,9 +126,43 @@ def quantize_tensor_np(arr, axis: int = -2) -> dict:
     return {"q": q, "s": s.astype(np.float32)}
 
 
+def quantize_tensor_np_g4(arr, group: int = GROUP_SIZE) -> dict:
+    """numpy variant of `quantize_tensor_g4` (host-side int4 load path).
+    arr [..., in, out] → grouped affine 4-bit over the in axis."""
+    import numpy as np
+
+    wf = np.asarray(arr, np.float32)
+    *lead, n_in, n_out = wf.shape
+    if n_in % group:
+        raise ValueError(f"in dim {n_in} not divisible by group {group}")
+    g = n_in // group
+    wf = wf.reshape(*lead, g, group, n_out)
+    mn = wf.min(axis=-2, keepdims=True)
+    mx = wf.max(axis=-2, keepdims=True)
+    s = np.maximum((mx - mn) / 15.0, 1e-9)
+    nib = np.clip(np.round((wf - mn) / s), 0, 15).astype(np.uint8)
+    half = group // 2
+    packed = nib[..., :half, :] | (nib[..., half:, :] << 4)
+    return {"g4": packed, "gs": s.astype(np.float32), "gz": (-mn).astype(np.float32)}
+
+
 def is_prequantized(params: Params) -> bool:
     layers = params.get("layers") or {}
     return any(isinstance(layers.get(k), dict) for k in QUANT_LAYER_KEYS)
+
+
+def dequantize_tensor(w) -> jnp.ndarray:
+    """Back to a dense float tensor (tests / debugging)."""
+    if not isinstance(w, dict):
+        return w
+    if "q" in w:
+        return w["q"].astype(jnp.float32) * w["s"]
+    qv = _grouped_values(w, jnp.float32)  # [..., G, gs, out]
+    vals = qv * w["gs"]
+    if "gz" in w:
+        vals = vals - w["gz"]
+    *lead, g, gs, n_out = vals.shape
+    return vals.reshape(*lead, g * gs, n_out)
 
 
 def quantize_params(cfg, params: Params, mode: str = "int8") -> Params:
@@ -69,12 +170,16 @@ def quantize_params(cfg, params: Params, mode: str = "int8") -> Params:
     run AFTER device_put so outputs inherit shardings)."""
     if mode in ("", "none", None):
         return params
-    if mode != "int8":
+    if mode == "int8":
+        qfn = quantize_tensor
+    elif mode == "int4":
+        qfn = quantize_tensor_g4
+    else:
         raise ValueError(f"unsupported quantization mode {mode!r}")
     layers = dict(params["layers"])
     for key in QUANT_LAYER_KEYS:
         if key in layers:
-            layers[key] = quantize_tensor(layers[key])
+            layers[key] = qfn(layers[key])
     out = dict(params)
     out["layers"] = layers
     # lm_head [V, D] is used transposed (h @ W.T): quantize over D so the
@@ -86,6 +191,64 @@ def quantize_params(cfg, params: Params, mode: str = "int8") -> Params:
         q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
         out["lm_head"] = {"q": q, "s": s}
     return out
+
+
+def init_params_quantized(
+    cfg, key: jnp.ndarray, scale: float = 0.02, mode: str = "int8"
+) -> Params:
+    """Random init that lands directly in the quantized representation.
+
+    Builds the same tree `quantize_params(mode=...)` would produce, but leaf
+    by leaf: the bf16 tensor only ever exists as a transient inside one jit,
+    so peak HBM ≈ the quantized tree + the largest single weight. This is how
+    a synthetic llama-3-8b serves from a single 16 GB chip (a whole-tree bf16
+    init is 2x HBM and OOMs before quantization could run).
+    """
+    from jax import tree_util as jtu
+
+    from localai_tpu.models.llama import init_params
+
+    if mode == "int8":
+        qfn = quantize_tensor
+    elif mode == "int4":
+        qfn = quantize_tensor_g4
+    else:
+        raise ValueError(f"unsupported quantization mode {mode!r}")
+    structure = jax.eval_shape(lambda k: init_params(cfg, k, scale), key)
+    flat, treedef = jtu.tree_flatten_with_path(structure)
+    keys = iter(jax.random.split(key, len(flat)))
+
+    def leaf_name(path) -> str:
+        last = path[-1]
+        return getattr(last, "key", str(last))
+
+    def build(path, sd):
+        name = leaf_name(path)
+        if "norm" in name:
+            return jnp.ones(sd.shape, sd.dtype)
+        if name in ("bq", "bk", "bv"):
+            return jnp.zeros(sd.shape, sd.dtype)
+        k = next(keys)
+        if name in QUANT_LAYER_KEYS:
+            return jax.jit(lambda kk: qfn(
+                jax.random.normal(kk, sd.shape, jnp.float32) * scale
+            ))(k)
+        if name == "lm_head" and not cfg.tie_embeddings:
+            def head(kk):
+                w = jax.random.normal(kk, sd.shape, jnp.float32) * scale
+                s = jnp.maximum(
+                    jnp.max(jnp.abs(w), axis=-1, keepdims=True) / 127.0, 1e-9
+                )
+                q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
+                return {"q": q, "s": s}
+
+            return jax.jit(head)(k)
+        return jax.jit(lambda kk: (
+            jax.random.normal(kk, sd.shape, jnp.float32) * scale
+        ).astype(sd.dtype))(k)
+
+    leaves = [build(path, sd) for path, sd in flat]
+    return jtu.tree_unflatten(treedef, leaves)
 
 
 def unembed_matmul(h: jnp.ndarray, w) -> jnp.ndarray:

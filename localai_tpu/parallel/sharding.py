@@ -76,22 +76,37 @@ def param_shardings(cfg: ArchConfig, mesh: Mesh) -> Params:
 
 def param_shardings_for(cfg: ArchConfig, mesh: Mesh, params: Params) -> Params:
     """Sharding tree structurally aligned to `params`, which may contain
-    quantized {"q", "s"} leaves (models/quant.py). q keeps the weight's
-    spec; the scale drops spec axes where its dimension is 1 (the kept
-    reduction axis cannot be sharded)."""
+    quantized {"q", "s"} or grouped {"g4"/"gq", "gs"[, "gz"]} leaves
+    (models/quant.py). The quantized payload keeps the weight's spec (grouped
+    forms shard the group axis the way the in axis was sharded; the
+    within-group axis never shards); scales drop spec axes where their
+    dimension is 1."""
     specs = param_specs(cfg)
 
+    def scale_spec(base: tuple, shape: tuple) -> P:
+        spec_t = tuple(base) + (None,) * (len(shape) - len(tuple(base)))
+        return P(*[
+            None if shape[i] == 1 else spec_t[i] for i in range(len(shape))
+        ])
+
     def align(spec, leaf):
-        if isinstance(leaf, dict):  # quantized tensor
-            s_shape = leaf["s"].shape
-            spec_t = tuple(spec) + (None,) * (len(s_shape) - len(tuple(spec)))
-            s_spec = P(*[
-                None if s_shape[i] == 1 else spec_t[i] for i in range(len(s_shape))
-            ])
+        if isinstance(leaf, dict) and "q" in leaf:
             return {
                 "q": NamedSharding(mesh, spec),
-                "s": NamedSharding(mesh, s_spec),
+                "s": NamedSharding(mesh, scale_spec(spec, leaf["s"].shape)),
             }
+        if isinstance(leaf, dict):  # grouped quantized tensor
+            gspec = tuple(spec)[:-1] + (None, tuple(spec)[-1])
+            out = {
+                k: NamedSharding(mesh, P(*gspec))
+                for k in ("g4", "gq") if k in leaf
+            }
+            for k in ("gs", "gz"):
+                if k in leaf:
+                    out[k] = NamedSharding(
+                        mesh, scale_spec(gspec, leaf[k].shape)
+                    )
+            return out
         return NamedSharding(mesh, spec)
 
     return jax.tree.map(

@@ -370,7 +370,19 @@ class ModelManager:
         t0 = time.monotonic()
 
         ckpt_dir: Optional[str] = None
-        if cfg.model in PRESETS:
+        gguf_params = None
+        gguf_tok_dir = None
+        if cfg.model.endswith(".gguf"):
+            # GGUF ingestion (reference: gguf.go:15-60 introspection +
+            # grpc-server.cpp GGUF serving). Quantized tensors keep their
+            # bits via grouped repack — engine/gguf.py.
+            from localai_tpu.engine.gguf import load_gguf_checkpoint
+
+            path = self._resolve_ckpt_dir(cfg.model)
+            if not os.path.isfile(path):
+                raise FileNotFoundError(f"model {cfg.name!r}: {path!r} not found")
+            arch, gguf_params, gguf_tok_dir = load_gguf_checkpoint(path)
+        elif cfg.model in PRESETS:
             arch = get_arch(cfg.model)
         else:
             ckpt_dir = cfg.model
@@ -393,7 +405,7 @@ class ModelManager:
         tp = par.tp or max_valid_tp(arch, max(1, avail))
         plan = MeshPlan(dp=par.dp, tp=max(1, tp), ep=par.ep, sp=par.sp)
 
-        tok_path = cfg.tokenizer or (ckpt_dir if ckpt_dir else None)
+        tok_path = cfg.tokenizer or gguf_tok_dir or (ckpt_dir if ckpt_dir else None)
         if tok_path and not _has_tokenizer_files(tok_path):
             tok_path = None
         tokenizer = load_tokenizer(tok_path, vocab_size=arch.vocab_size)
@@ -405,12 +417,22 @@ class ModelManager:
                 cfg.name, tv, arch.vocab_size,
             )
 
-        if ckpt_dir is not None:
+        if gguf_params is not None:
+            params = gguf_params
+        elif ckpt_dir is not None:
             from localai_tpu.engine.weights import load_hf_checkpoint
 
             # Load-time host quantization: the bf16 tree never touches HBM,
             # so int8 checkpoints up to ~2x HBM serve from one chip.
             params = load_hf_checkpoint(arch, ckpt_dir, quantize=cfg.quantization)
+        elif cfg.quantization and cfg.quantization not in ("none",):
+            # Synthetic preset + quantization: init leaf-wise into the
+            # quantized form so big archs fit (same ~2x HBM envelope).
+            from localai_tpu.models.quant import init_params_quantized
+
+            params = init_params_quantized(
+                arch, jax.random.key(0), mode=cfg.quantization
+            )
         else:
             params = jax.jit(lambda k: init_params(arch, k))(jax.random.key(0))
 

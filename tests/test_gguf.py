@@ -1,0 +1,535 @@
+"""GGUF ingestion tests: container parsing, block dequantization against
+scalar reference implementations (transcribed from the public ggml spec),
+lossless grouped repack, tokenizer synthesis, and end-to-end serving of a
+synthetic quantized GGUF through the manager.
+
+The writer below is test-only and independent of the reader (it packs blocks
+from the spec), so reader bugs can't self-confirm.
+"""
+
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+import yaml
+
+import jax
+
+from localai_tpu.engine.gguf import (
+    GGUFFile,
+    _deq_q4_k,
+    _deq_q5_k,
+    _deq_q6_k,
+    arch_from_gguf,
+    load_gguf_checkpoint,
+    tokenizer_json_from_gguf,
+)
+
+# --------------------------------------------------------------------------- #
+# Test-side GGUF writer
+# --------------------------------------------------------------------------- #
+
+_T_U32, _T_F32, _T_STR, _T_ARR, _T_U64 = 4, 6, 8, 9, 10
+_T_I32, _T_BOOL = 5, 7
+
+
+def _w_str(s: str) -> bytes:
+    b = s.encode("utf-8")
+    return struct.pack("<Q", len(b)) + b
+
+
+def _w_value(v) -> bytes:
+    if isinstance(v, bool):
+        return struct.pack("<I", _T_BOOL) + struct.pack("<B", int(v))
+    if isinstance(v, int):
+        return struct.pack("<I", _T_U32) + struct.pack("<I", v)
+    if isinstance(v, float):
+        return struct.pack("<I", _T_F32) + struct.pack("<f", v)
+    if isinstance(v, str):
+        return struct.pack("<I", _T_STR) + _w_str(v)
+    if isinstance(v, list):
+        if v and isinstance(v[0], str):
+            body = b"".join(_w_str(s) for s in v)
+            return (struct.pack("<I", _T_ARR) + struct.pack("<IQ", _T_STR, len(v))
+                    + body)
+        body = b"".join(struct.pack("<i", int(x)) for x in v)
+        return (struct.pack("<I", _T_ARR) + struct.pack("<IQ", _T_I32, len(v))
+                + body)
+    raise TypeError(type(v))
+
+
+def pack_q4_0(w: np.ndarray) -> bytes:
+    """[rows, cols] → q4_0 blocks (spec: x = d * (nib - 8))."""
+    rows, cols = w.shape
+    assert cols % 32 == 0
+    blocks = w.reshape(rows * cols // 32, 32).astype(np.float32)
+    out = bytearray()
+    for blk in blocks:
+        amax_i = np.argmax(np.abs(blk))
+        d = blk[amax_i] / -8.0
+        inv = 1.0 / d if d else 0.0
+        q = np.clip(np.round(blk * inv) + 8, 0, 15).astype(np.uint8)
+        out += np.float16(d).tobytes()
+        out += (q[:16] | (q[16:] << 4)).tobytes()
+    return bytes(out)
+
+
+def pack_q8_0(w: np.ndarray) -> bytes:
+    rows, cols = w.shape
+    blocks = w.reshape(rows * cols // 32, 32).astype(np.float32)
+    out = bytearray()
+    for blk in blocks:
+        d = np.abs(blk).max() / 127.0
+        inv = 1.0 / d if d else 0.0
+        q = np.clip(np.round(blk * inv), -127, 127).astype(np.int8)
+        out += np.float16(d).tobytes()
+        out += q.tobytes()
+    return bytes(out)
+
+
+_GGML_IDS = {"F32": 0, "F16": 1, "Q4_0": 2, "Q8_0": 8, "Q4_K": 12, "Q5_K": 13, "Q6_K": 14}
+
+
+def write_gguf(path: str, kv: dict, tensors: dict) -> None:
+    """tensors: name -> (ggml_type_name, ne tuple, raw bytes)."""
+    align = 32
+    out = bytearray()
+    out += struct.pack("<II", 0x46554747, 3)
+    out += struct.pack("<QQ", len(tensors), len(kv))
+    for k, v in kv.items():
+        out += _w_str(k) + _w_value(v)
+    offset = 0
+    blobs = []
+    for name, (tname, ne, raw) in tensors.items():
+        out += _w_str(name)
+        out += struct.pack("<I", len(ne))
+        out += struct.pack(f"<{len(ne)}Q", *ne)
+        out += struct.pack("<IQ", _GGML_IDS[tname], offset)
+        blobs.append(raw)
+        offset += len(raw)
+        offset = (offset + align - 1) // align * align
+    data_start = (len(out) + align - 1) // align * align
+    out += b"\0" * (data_start - len(out))
+    for raw in blobs:
+        out += raw
+        pad = (-len(out)) % align
+        out += b"\0" * pad
+    with open(path, "wb") as f:
+        f.write(out)
+
+
+# --------------------------------------------------------------------------- #
+# Scalar reference dequantizers (straight transcription of the spec loops)
+# --------------------------------------------------------------------------- #
+
+
+def _scale_min_k4(j, q):
+    if j < 4:
+        return q[j] & 63, q[j + 4] & 63
+    d = (q[j + 4] & 0xF) | ((q[j - 4] >> 6) << 4)
+    m = (q[j + 4] >> 4) | ((q[j] >> 6) << 4)
+    return d, m
+
+
+def ref_deq_q4_k(raw: bytes, n: int) -> np.ndarray:
+    out = []
+    bsz = 144
+    for b in range(len(raw) // bsz):
+        blk = raw[b * bsz:(b + 1) * bsz]
+        d = np.frombuffer(blk[0:2], np.float16)[0].astype(np.float32)
+        dmin = np.frombuffer(blk[2:4], np.float16)[0].astype(np.float32)
+        scales = blk[4:16]
+        qs = blk[16:144]
+        for j in range(4):
+            sc1, m1 = _scale_min_k4(2 * j, scales)
+            sc2, m2 = _scale_min_k4(2 * j + 1, scales)
+            chunk = qs[32 * j:32 * j + 32]
+            for c in chunk:
+                out.append(d * sc1 * (c & 0xF) - dmin * m1)
+            for c in chunk:
+                out.append(d * sc2 * (c >> 4) - dmin * m2)
+    return np.array(out[:n], np.float32)
+
+
+def ref_deq_q5_k(raw: bytes, n: int) -> np.ndarray:
+    out = []
+    bsz = 176
+    for b in range(len(raw) // bsz):
+        blk = raw[b * bsz:(b + 1) * bsz]
+        d = np.frombuffer(blk[0:2], np.float16)[0].astype(np.float32)
+        dmin = np.frombuffer(blk[2:4], np.float16)[0].astype(np.float32)
+        scales = blk[4:16]
+        qh = blk[16:48]
+        qs = blk[48:176]
+        for j in range(4):
+            sc1, m1 = _scale_min_k4(2 * j, scales)
+            sc2, m2 = _scale_min_k4(2 * j + 1, scales)
+            u1, u2 = 1 << (2 * j), 1 << (2 * j + 1)
+            chunk = qs[32 * j:32 * j + 32]
+            for l, c in enumerate(chunk):
+                out.append(d * sc1 * ((c & 0xF) + (16 if qh[l] & u1 else 0)) - dmin * m1)
+            for l, c in enumerate(chunk):
+                out.append(d * sc2 * ((c >> 4) + (16 if qh[l] & u2 else 0)) - dmin * m2)
+    return np.array(out[:n], np.float32)
+
+
+def ref_deq_q6_k(raw: bytes, n: int) -> np.ndarray:
+    out = np.zeros((len(raw) // 210) * 256, np.float32)
+    bsz = 210
+    for b in range(len(raw) // bsz):
+        blk = raw[b * bsz:(b + 1) * bsz]
+        ql = blk[0:128]
+        qh = blk[128:192]
+        scales = np.frombuffer(blk[192:208], np.int8)
+        d = np.frombuffer(blk[208:210], np.float16)[0].astype(np.float32)
+        y = b * 256
+        for half in range(2):
+            qlh = ql[64 * half:64 * half + 64]
+            qhh = qh[32 * half:32 * half + 32]
+            sc = scales[8 * half:8 * half + 8]
+            for l in range(32):
+                is_ = l // 16
+                q1 = ((qlh[l] & 0xF) | ((qhh[l] & 3) << 4)) - 32
+                q2 = ((qlh[l + 32] & 0xF) | (((qhh[l] >> 2) & 3) << 4)) - 32
+                q3 = ((qlh[l] >> 4) | (((qhh[l] >> 4) & 3) << 4)) - 32
+                q4 = ((qlh[l + 32] >> 4) | (((qhh[l] >> 6) & 3) << 4)) - 32
+                base = y + 128 * half
+                out[base + l] = d * sc[is_] * q1
+                out[base + 32 + l] = d * sc[2 + is_] * q2
+                out[base + 64 + l] = d * sc[4 + is_] * q3
+                out[base + 96 + l] = d * sc[6 + is_] * q4
+    return out[:n]
+
+
+# --------------------------------------------------------------------------- #
+# Tests
+# --------------------------------------------------------------------------- #
+
+
+def test_q4_0_q8_0_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((8, 64), np.float32)
+    path = str(tmp_path / "t.gguf")
+    write_gguf(path, {"general.architecture": "llama"}, {
+        "a": ("Q4_0", (64, 8), pack_q4_0(w)),
+        "b": ("Q8_0", (64, 8), pack_q8_0(w)),
+        "c": ("F32", (64, 8), w.astype(np.float32).tobytes()),
+    })
+    gf = GGUFFile(path)
+    a = gf.tensor("a")
+    assert a.shape == (8, 64)
+    # q4_0 grid is coarse: relative error bounded by half a step
+    assert np.abs(a - w).max() <= np.abs(w).max() / 8 + 1e-3
+    b = gf.tensor("b")
+    assert np.abs(b - w).max() <= np.abs(w).max() / 127 + 1e-3
+    np.testing.assert_array_equal(gf.tensor("c"), w)
+
+
+@pytest.mark.parametrize("tname,bsz,vec,ref", [
+    ("Q4_K", 144, _deq_q4_k, ref_deq_q4_k),
+    ("Q5_K", 176, _deq_q5_k, ref_deq_q5_k),
+    ("Q6_K", 210, _deq_q6_k, ref_deq_q6_k),
+])
+def test_kquant_vectorized_matches_scalar_reference(tname, bsz, vec, ref):
+    rng = np.random.default_rng(1)
+    raw = rng.integers(0, 256, size=bsz * 4, dtype=np.uint8).tobytes()
+    n = 256 * 4
+    got = vec(np.frombuffer(raw, np.uint8), n)
+    want = ref(raw, n)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_grouped_repack_matches_dequant(tmp_path):
+    """grouped() must represent exactly the same values tensor() dequantizes
+    (for the lossless types), via the models/quant dequant math."""
+    from localai_tpu.models.quant import dequantize_tensor
+
+    rng = np.random.default_rng(2)
+    w = rng.standard_normal((16, 128), np.float32)
+    kraw = rng.integers(0, 256, size=(128 * 16 // 256) * 144, dtype=np.uint8).tobytes()
+    path = str(tmp_path / "t.gguf")
+    write_gguf(path, {"general.architecture": "llama"}, {
+        "a": ("Q4_0", (128, 16), pack_q4_0(w)),
+        "b": ("Q8_0", (128, 16), pack_q8_0(w)),
+        "k": ("Q4_K", (128, 16), kraw),
+    })
+    gf = GGUFFile(path)
+    for name in ("a", "b", "k"):
+        grouped = gf.grouped(name)
+        assert grouped is not None
+        deq = np.asarray(dequantize_tensor(
+            {k: jax.numpy.asarray(v) for k, v in grouped.items()}
+        ), np.float32)  # [in, out]
+        want = gf.tensor(name).astype(np.float32).T
+        np.testing.assert_allclose(deq, want, rtol=2e-3, atol=2e-3), name
+
+
+def _tiny_gguf(path: str) -> None:
+    """A 2-layer llama-family GGUF with q4_0/q8_0 weights and a byte vocab."""
+    rng = np.random.default_rng(3)
+    D, F, H, HD, V, L = 64, 128, 2, 32, 256, 2
+    s = 0.05
+
+    def w(r, c):
+        return (rng.standard_normal((r, c), np.float32) * s).astype(np.float32)
+
+    tensors = {
+        "token_embd.weight": ("F32", (D, V), w(V, D).tobytes()),
+        "output_norm.weight": ("F32", (D,), np.ones(D, np.float32).tobytes()),
+        "output.weight": ("Q8_0", (D, V), pack_q8_0(w(V, D))),
+    }
+    for i in range(L):
+        tensors.update({
+            f"blk.{i}.attn_norm.weight": ("F32", (D,), np.ones(D, np.float32).tobytes()),
+            f"blk.{i}.attn_q.weight": ("Q4_0", (D, H * HD), pack_q4_0(w(H * HD, D))),
+            f"blk.{i}.attn_k.weight": ("Q4_0", (D, H * HD), pack_q4_0(w(H * HD, D))),
+            f"blk.{i}.attn_v.weight": ("Q8_0", (D, H * HD), pack_q8_0(w(H * HD, D))),
+            f"blk.{i}.attn_output.weight": ("Q8_0", (H * HD, D), pack_q8_0(w(D, H * HD))),
+            f"blk.{i}.ffn_norm.weight": ("F32", (D,), np.ones(D, np.float32).tobytes()),
+            f"blk.{i}.ffn_gate.weight": ("Q4_0", (D, F), pack_q4_0(w(F, D))),
+            f"blk.{i}.ffn_up.weight": ("Q4_0", (D, F), pack_q4_0(w(F, D))),
+            f"blk.{i}.ffn_down.weight": ("Q4_0", (F, D), pack_q4_0(w(D, F))),
+        })
+    # byte-ish BPE vocab: 256 single-char tokens, no merges
+    byte_tokens = [chr(33 + i) if 33 + i < 127 else f"<0x{i:02X}>" for i in range(254)]
+    tokens = ["<s>", "</s>"] + byte_tokens
+    kv = {
+        "general.architecture": "llama",
+        "llama.block_count": L,
+        "llama.embedding_length": D,
+        "llama.feed_forward_length": F,
+        "llama.attention.head_count": H,
+        "llama.attention.head_count_kv": H,
+        "llama.attention.layer_norm_rms_epsilon": 1e-5,
+        "llama.rope.freq_base": 10000.0,
+        "llama.context_length": 512,
+        "tokenizer.ggml.model": "gpt2",
+        "tokenizer.ggml.pre": "gpt-2",
+        "tokenizer.ggml.tokens": tokens,
+        "tokenizer.ggml.merges": [],
+        "tokenizer.ggml.token_type": [3, 3] + [1] * 254,
+        "tokenizer.ggml.bos_token_id": 0,
+        "tokenizer.ggml.eos_token_id": 1,
+    }
+    write_gguf(path, kv, tensors)
+
+
+def test_arch_and_tokenizer_from_gguf(tmp_path):
+    path = str(tmp_path / "m.gguf")
+    _tiny_gguf(path)
+    gf = GGUFFile(path)
+    arch = arch_from_gguf(gf)
+    assert arch.num_layers == 2
+    assert arch.hidden_size == 64
+    assert arch.vocab_size == 256
+    assert not arch.tie_embeddings
+    tj = tokenizer_json_from_gguf(gf)
+    assert tj is not None
+    assert len(tj["model"]["vocab"]) == 256
+    assert tj["added_tokens"][0]["content"] == "<s>"
+
+
+def test_load_gguf_checkpoint_tree(tmp_path):
+    from localai_tpu.models.quant import dequantize_tensor
+
+    path = str(tmp_path / "m.gguf")
+    _tiny_gguf(path)
+    arch, params, tok_dir = load_gguf_checkpoint(path)
+    assert params["embed"].shape == (256, 64)
+    wq = params["layers"]["wq"]
+    assert isinstance(wq, dict) and "g4" in wq  # q4_0 kept its bits
+    assert wq["g4"].shape == (2, 2, 16, 64)  # [L, G=64/32, 16, out]
+    wv = params["layers"]["wv"]
+    assert isinstance(wv, dict) and "gq" in wv  # q8_0 → grouped int8
+    assert isinstance(params["lm_head"], dict)
+    assert tok_dir is not None and os.path.exists(
+        os.path.join(tok_dir, "tokenizer.json")
+    )
+    # per-layer dequant sanity: finite, reasonable scale
+    deq = np.asarray(dequantize_tensor(
+        {k: jax.numpy.asarray(v[0]) for k, v in wq.items()}
+    ))
+    assert np.isfinite(deq).all() and np.abs(deq).max() < 1.0
+
+
+def test_gguf_serves_chat_e2e(tmp_path):
+    """Manager loads a .gguf model and serves /v1-style generation; greedy
+    tokens match an engine built from the dequantized dense weights."""
+    from localai_tpu.config import ApplicationConfig
+    from localai_tpu.engine import Engine, EngineConfig
+    from localai_tpu.engine.tokenizer import load_tokenizer
+    from localai_tpu.models.quant import dequantize_tensor
+    from localai_tpu.server import ModelManager
+
+    d = tmp_path / "models"
+    d.mkdir()
+    _tiny_gguf(str(d / "m.gguf"))
+    (d / "g.yaml").write_text(yaml.safe_dump({
+        "name": "g", "model": "m.gguf", "context_size": 128,
+        "max_slots": 2, "max_tokens": 8, "temperature": 0.0,
+        "template": {"family": "chatml"},
+    }))
+    mgr = ModelManager(ApplicationConfig(models_dir=str(d)))
+    try:
+        lm = mgr.get("g")
+        prompt = lm.engine.tokenizer.encode("hello")
+        assert prompt, "GGUF tokenizer produced no ids"
+        text, ev = lm.engine.generate(prompt, max_new_tokens=8, ignore_eos=True)
+        assert ev.kind == "done" and ev.completion_tokens == 8
+
+        # dense reference from the same (dequantized) values
+        arch, params, tok_dir = load_gguf_checkpoint(str(d / "m.gguf"))
+        import ml_dtypes
+
+        dense = {
+            "embed": params["embed"],
+            "final_norm": params["final_norm"],
+            "lm_head": np.asarray(
+                dequantize_tensor(
+                    {k: jax.numpy.asarray(v) for k, v in params["lm_head"].items()}
+                )
+            ).astype(ml_dtypes.bfloat16),
+            "layers": {},
+        }
+        # lm_head dequant comes back [in(V?)...] — per-channel int8 keeps
+        # [V, D] orientation, so no transpose here.
+        for k, v in params["layers"].items():
+            if isinstance(v, dict):
+                per_layer = [
+                    np.asarray(dequantize_tensor(
+                        {kk: jax.numpy.asarray(vv[i]) for kk, vv in v.items()}
+                    )).astype(ml_dtypes.bfloat16)
+                    for i in range(arch.num_layers)
+                ]
+                dense["layers"][k] = np.stack(per_layer)
+            else:
+                dense["layers"][k] = v
+        tok = load_tokenizer(tok_dir, vocab_size=arch.vocab_size)
+        ref = Engine(arch, dense, tok,
+                     engine_cfg=EngineConfig(max_slots=2, max_seq=128,
+                                             min_prefill_bucket=16))
+        ref.start()
+        try:
+            ref_text, rev = ref.generate(prompt, max_new_tokens=8, ignore_eos=True)
+        finally:
+            ref.stop()
+        # grouped-dequant vs dense numerics can flip near-tie argmaxes on
+        # random weights; the leading tokens must agree.
+        assert text[:2] == ref_text[:2], (text, ref_text)
+    finally:
+        mgr.shutdown()
+
+
+def test_mixed_quant_types_across_layers_regrid(tmp_path):
+    """Q4_K_M-style files mix types per layer for the same weight; the loader
+    must regrid to one representation instead of crashing."""
+    from localai_tpu.models.quant import dequantize_tensor
+
+    rng = np.random.default_rng(5)
+    D, H, HD = 64, 2, 32
+    w0 = (rng.standard_normal((H * HD, D), np.float32) * 0.05).astype(np.float32)
+    w1 = (rng.standard_normal((H * HD, D), np.float32) * 0.05).astype(np.float32)
+    path = str(tmp_path / "mix.gguf")
+    tensors = {
+        "token_embd.weight": ("F32", (D, 256),
+                              (rng.standard_normal((256, D), np.float32) * 0.05
+                               ).astype(np.float32).tobytes()),
+        "output_norm.weight": ("F32", (D,), np.ones(D, np.float32).tobytes()),
+    }
+    for i, (w, t, pack) in enumerate(
+        ((w0, "Q4_0", pack_q4_0), (w1, "Q8_0", pack_q8_0))
+    ):
+        tensors.update({
+            f"blk.{i}.attn_norm.weight": ("F32", (D,), np.ones(D, np.float32).tobytes()),
+            f"blk.{i}.attn_q.weight": ("Q4_0", (D, H * HD), pack_q4_0(w)),
+            f"blk.{i}.attn_k.weight": ("Q4_0", (D, H * HD), pack_q4_0(w)),
+            f"blk.{i}.attn_v.weight": (t, (D, H * HD), pack(w)),  # mixed!
+            f"blk.{i}.attn_output.weight": ("Q8_0", (H * HD, D), pack_q8_0(w.T.copy())),
+            f"blk.{i}.ffn_norm.weight": ("F32", (D,), np.ones(D, np.float32).tobytes()),
+            f"blk.{i}.ffn_gate.weight": ("Q4_0", (D, 128), pack_q4_0(
+                (rng.standard_normal((128, D)) * 0.05).astype(np.float32))),
+            f"blk.{i}.ffn_up.weight": ("Q4_0", (D, 128), pack_q4_0(
+                (rng.standard_normal((128, D)) * 0.05).astype(np.float32))),
+            f"blk.{i}.ffn_down.weight": ("Q4_0", (128, D), pack_q4_0(
+                (rng.standard_normal((D, 128)) * 0.05).astype(np.float32))),
+        })
+    kv = {
+        "general.architecture": "llama",
+        "llama.block_count": 2,
+        "llama.embedding_length": D,
+        "llama.feed_forward_length": 128,
+        "llama.attention.head_count": H,
+        "llama.attention.head_count_kv": H,
+        "llama.vocab_size": 256,
+    }
+    write_gguf(path, kv, tensors)
+    arch, params, _ = load_gguf_checkpoint(path)
+    wv = params["layers"]["wv"]
+    assert isinstance(wv, dict) and "gq" in wv  # regridded to grouped int8
+    assert wv["gq"].shape[0] == 2  # both layers present
+    # regrid preserves the values (int8 grid on 4/8-bit data)
+    deq0 = np.asarray(dequantize_tensor(
+        {k: jax.numpy.asarray(v[0]) for k, v in wv.items()}
+    ), np.float32)
+    want0 = GGUFFile(path).tensor("blk.0.attn_v.weight").astype(np.float32).T
+    # un-permute was applied to wv? (no — only wq/wk); direct compare
+    np.testing.assert_allclose(deq0, want0, rtol=0.05, atol=0.01)
+
+
+def test_moe_gguf_loads_and_serves(tmp_path):
+    from localai_tpu.engine import Engine, EngineConfig
+    from localai_tpu.engine.tokenizer import ByteTokenizer
+
+    rng = np.random.default_rng(6)
+    D, F, H, HD, V, L, E = 64, 128, 2, 32, 256, 2, 4
+    s = 0.05
+
+    def f32(shape):
+        return (rng.standard_normal(shape, np.float32) * s).astype(np.float32)
+
+    tensors = {
+        "token_embd.weight": ("F32", (D, V), f32((V, D)).tobytes()),
+        "output_norm.weight": ("F32", (D,), np.ones(D, np.float32).tobytes()),
+    }
+    for i in range(L):
+        tensors.update({
+            f"blk.{i}.attn_norm.weight": ("F32", (D,), np.ones(D, np.float32).tobytes()),
+            f"blk.{i}.attn_q.weight": ("Q4_0", (D, H * HD), pack_q4_0(f32((H * HD, D)))),
+            f"blk.{i}.attn_k.weight": ("Q4_0", (D, H * HD), pack_q4_0(f32((H * HD, D)))),
+            f"blk.{i}.attn_v.weight": ("Q8_0", (D, H * HD), pack_q8_0(f32((H * HD, D)))),
+            f"blk.{i}.attn_output.weight": ("Q8_0", (H * HD, D), pack_q8_0(f32((D, H * HD)))),
+            f"blk.{i}.ffn_norm.weight": ("F32", (D,), np.ones(D, np.float32).tobytes()),
+            f"blk.{i}.ffn_gate_inp.weight": ("F32", (D, E), f32((E, D)).tobytes()),
+            f"blk.{i}.ffn_gate_exps.weight": ("F32", (D, F, E), f32((E, F, D)).tobytes()),
+            f"blk.{i}.ffn_up_exps.weight": ("F32", (D, F, E), f32((E, F, D)).tobytes()),
+            f"blk.{i}.ffn_down_exps.weight": ("F32", (F, D, E), f32((E, D, F)).tobytes()),
+        })
+    kv = {
+        "general.architecture": "llama",
+        "llama.block_count": L,
+        "llama.embedding_length": D,
+        "llama.feed_forward_length": F,
+        "llama.attention.head_count": H,
+        "llama.attention.head_count_kv": H,
+        "llama.expert_count": E,
+        "llama.expert_used_count": 2,
+        "llama.vocab_size": V,
+    }
+    path = str(tmp_path / "moe.gguf")
+    write_gguf(path, kv, tensors)
+    arch, params, _ = load_gguf_checkpoint(path)
+    assert arch.is_moe and arch.num_experts == E
+    assert params["layers"]["router"].shape == (L, D, E)
+    wg = params["layers"]["w_gate"]
+    assert isinstance(wg, dict) and wg["gq"].shape == (L, E, D // 32, 32, F)
+    eng = Engine(arch, params, ByteTokenizer(arch.vocab_size),
+                 engine_cfg=EngineConfig(max_slots=2, max_seq=128,
+                                         min_prefill_bucket=16))
+    eng.start()
+    try:
+        _, ev = eng.generate([65, 66, 67], max_new_tokens=6, ignore_eos=True)
+        assert ev.completion_tokens == 6
+    finally:
+        eng.stop()

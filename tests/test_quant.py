@@ -168,3 +168,133 @@ def test_prequantized_tp_mesh_placement(tmp_path):
         assert ev.completion_tokens == 4
     finally:
         eng.stop()
+
+
+def test_init_params_quantized_matches_quantize_params_structure():
+    """Leaf-wise quantized init builds the exact tree shape quantize_params
+    produces (so shardings/engine treat both identically), without ever
+    materializing the full bf16 tree."""
+    from localai_tpu.models.quant import init_params_quantized, quantize_params
+
+    for arch in ("tiny", "tiny-moe"):
+        cfg = get_arch(arch)
+        want = quantize_params(cfg, init_params(cfg, jax.random.key(0)))
+        got = init_params_quantized(cfg, jax.random.key(0))
+        ws = jax.tree.structure(want)
+        gs = jax.tree.structure(got)
+        assert ws == gs, f"{arch}: {ws} != {gs}"
+        for (pw, w), (pg, g) in zip(
+            jax.tree_util.tree_flatten_with_path(want)[0],
+            jax.tree_util.tree_flatten_with_path(got)[0],
+        ):
+            assert pw == pg
+            assert w.shape == g.shape, f"{arch} {pw}: {w.shape} != {g.shape}"
+            assert w.dtype == g.dtype, f"{arch} {pw}: {w.dtype} != {g.dtype}"
+
+
+def test_init_params_quantized_serves():
+    from localai_tpu.models.quant import init_params_quantized
+
+    cfg = get_arch("tiny")
+    eng = Engine(cfg, init_params_quantized(cfg, jax.random.key(0)),
+                 ByteTokenizer(cfg.vocab_size),
+                 engine_cfg=EngineConfig(max_slots=2, max_seq=128,
+                                         min_prefill_bucket=16))
+    eng.start()
+    try:
+        _, ev = eng.generate([65, 66, 67], max_new_tokens=6, ignore_eos=True)
+        assert ev.completion_tokens == 6
+    finally:
+        eng.stop()
+
+
+def test_int4_grouped_matmul_close():
+    from localai_tpu.models.quant import dequantize_tensor, matmul, quantize_tensor_g4
+
+    w = init_params(get_arch("tiny"), jax.random.key(3))["layers"]["w_up"][0]
+    q = quantize_tensor_g4(w)
+    assert q["g4"].dtype == jnp.uint8
+    assert q["g4"].shape == (w.shape[0] // 32, 16, w.shape[1])
+    deq = dequantize_tensor(q)
+    rel = float(jnp.abs(deq - w.astype(jnp.float32)).max() / jnp.abs(w).max())
+    assert rel < 0.1, rel  # 4-bit grid on random normals
+    x = jax.random.normal(jax.random.key(4), (4, w.shape[0]), jnp.bfloat16)
+    got = matmul(x, q)
+    want = x @ w
+    relmm = float(jnp.abs(got - want).max() / jnp.abs(want).max())
+    assert relmm < 0.2, relmm
+
+
+def test_int4_engine_serves_dense_and_moe():
+    for arch in ("tiny", "tiny-moe"):
+        cfg = get_arch(arch)
+        eng = Engine(cfg, init_params(cfg, jax.random.key(0)),
+                     ByteTokenizer(cfg.vocab_size),
+                     engine_cfg=EngineConfig(max_slots=2, max_seq=128,
+                                             min_prefill_bucket=16),
+                     quantization="int4")
+        eng.start()
+        try:
+            _, ev = eng.generate([65, 66, 67], max_new_tokens=6, ignore_eos=True)
+            assert ev.completion_tokens == 6, arch
+        finally:
+            eng.stop()
+
+
+def test_int4_tp_mesh_serves():
+    cfg = get_arch("tiny")
+    eng = Engine(cfg, init_params(cfg, jax.random.key(0)),
+                 ByteTokenizer(cfg.vocab_size),
+                 mesh_plan=MeshPlan(tp=2),
+                 engine_cfg=EngineConfig(max_slots=2, max_seq=128,
+                                         min_prefill_bucket=16),
+                 quantization="int4")
+    eng.start()
+    try:
+        _, ev = eng.generate([10, 20], max_new_tokens=6, ignore_eos=True)
+        assert ev.completion_tokens == 6
+    finally:
+        eng.stop()
+
+
+def test_int4_load_time_host_quantization(tmp_path):
+    """HF checkpoint + quantization: int4 → grouped-4bit weights on load
+    (not silently int8)."""
+    from localai_tpu.engine.weights import load_hf_checkpoint, save_hf_checkpoint
+
+    cfg = get_arch("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    d = str(tmp_path / "ckpt")
+    save_hf_checkpoint(cfg, params, d)
+    loaded = load_hf_checkpoint(cfg, d, quantize="int4")
+    wq = loaded["layers"]["wq"]
+    assert isinstance(wq, dict) and "g4" in wq
+    assert isinstance(loaded["lm_head"], dict) and "q" in loaded["lm_head"]
+    with pytest.raises(ValueError):
+        load_hf_checkpoint(cfg, d, quantize="int5")
+
+
+def test_manager_preset_int4_and_none(tmp_path):
+    from localai_tpu.config import ApplicationConfig
+    from localai_tpu.server import ModelManager
+
+    d = tmp_path / "models"
+    d.mkdir()
+    (d / "q4.yaml").write_text(yaml.safe_dump({
+        "name": "q4", "model": "tiny", "context_size": 64, "max_tokens": 4,
+        "quantization": "int4",
+    }))
+    (d / "qn.yaml").write_text(yaml.safe_dump({
+        "name": "qn", "model": "tiny", "context_size": 64, "max_tokens": 4,
+        "quantization": "none",
+    }))
+    mgr = ModelManager(ApplicationConfig(models_dir=str(d), max_active_models=4))
+    try:
+        lm = mgr.get("q4")
+        assert "g4" in lm.engine.params["layers"]["wq"]  # actually int4
+        _, ev = lm.engine.generate([65], max_new_tokens=2, ignore_eos=True)
+        assert ev.kind == "done"
+        lm2 = mgr.get("qn")
+        assert not isinstance(lm2.engine.params["layers"]["wq"], dict)
+    finally:
+        mgr.shutdown()
