@@ -171,6 +171,11 @@ class GGUFFile:
             import ml_dtypes
 
             return raw.view(ml_dtypes.bfloat16).reshape(shape)
+        if tname not in _DEQUANT:
+            raise GGUFReadError(
+                f"{self.path}: tensor {name!r} uses quant type {tname}, which "
+                f"has no dequantizer yet (supported: {sorted(_DEQUANT)})"
+            )
         flat = _DEQUANT[tname](raw, ti.n_elements)
         return flat.reshape(shape)
 
@@ -434,10 +439,17 @@ def arch_from_gguf(gf: GGUFFile):
         kv.get("tokenizer.ggml.tokens", []) or []
     )
     rope_scaling = None
+    scaling_factor = float(k("rope.scaling.factor", 0) or 0)
+    orig_ctx = int(k("rope.scaling.original_context_length", 0) or 0)
     if str(k("rope.scaling.type", "")) == "linear":
         rope_scaling = "linear"
-    elif f"{a}.rope.scaling.original_context_length" in kv:
-        rope_scaling = "llama3"  # llama.cpp stores llama3 scaling this way
+        scaling_factor = scaling_factor or 1.0
+    elif orig_ctx or "rope_freqs.weight" in gf.tensors:
+        # llama-3.1-style scaling: llama.cpp records the original context
+        # (and sometimes only a rope_freqs tensor); factor defaults to the
+        # published llama-3.1 value when the key is absent.
+        rope_scaling = "llama3"
+        scaling_factor = scaling_factor or 8.0
     return ArchConfig(
         name=os.path.basename(gf.path),
         vocab_size=vocab,
@@ -451,7 +463,8 @@ def arch_from_gguf(gf: GGUFFile):
         rms_eps=float(k("attention.layer_norm_rms_epsilon", 1e-5)),
         max_position=int(k("context_length", 4096)),
         rope_scaling=rope_scaling,
-        rope_scaling_factor=float(k("rope.scaling.factor", 1.0) or 1.0),
+        rope_scaling_factor=scaling_factor or 1.0,
+        rope_original_max_position=orig_ctx or 8192,
         tie_embeddings="output.weight" not in gf.tensors,
         attn_qkv_bias="blk.0.attn_q.bias" in gf.tensors,
         num_experts=int(k("expert_count", 0) or 0),
@@ -580,13 +593,14 @@ _LAYER_MAP = {
 
 
 def _unpermute_rows(w_out_in: np.ndarray, n_head: int) -> np.ndarray:
-    """Undo llama.cpp's q/k row permutation (convert_hf_to_gguf `permute`):
-    GGUF stores interleaved-rope row order; our rope uses the HF half-split
-    layout. Operates on the out (row) axis of [out, in]."""
+    """Undo llama.cpp's q/k row permutation (convert_hf_to_gguf `permute`,
+    which is reshape(H, 2, hd/2).swapaxes(1,2)): GGUF stores interleaved-rope
+    row order; our rope uses the HF half-split layout. This is the INVERSE
+    transform — reshape(H, hd/2, 2).swapaxes(1,2) — on the out (row) axis."""
     n_out, n_in = w_out_in.shape
     hd = n_out // n_head
     return (
-        w_out_in.reshape(n_head, 2, hd // 2, n_in)
+        w_out_in.reshape(n_head, hd // 2, 2, n_in)
         .swapaxes(1, 2)
         .reshape(n_out, n_in)
     )
@@ -596,8 +610,9 @@ def _permutation_indices(n_out: int, n_head: int) -> np.ndarray:
     """Row indices equivalent to `_unpermute_rows` (for permuting packed
     grouped forms along their out axis)."""
     idx = np.arange(n_out)
+    hd = n_out // n_head
     return (
-        idx.reshape(n_head, 2, (n_out // n_head) // 2)
+        idx.reshape(n_head, hd // 2, 2)
         .swapaxes(1, 2)
         .reshape(-1)
     )
@@ -618,6 +633,10 @@ def load_gguf_params(gf: GGUFFile, arch) -> dict:
     bf16 = ml_dtypes.bfloat16
     L = arch.num_layers
     layers: dict[str, Any] = {}
+    # llama.cpp's convert script permutes q/k rows ONLY for the llama family
+    # (rope type NORM); qwen2/gemma-class exports (rope type NEOX) keep the
+    # HF row order.
+    permute_qk = gf.kv.get("general.architecture", "llama") in ("llama", "mistral")
 
     def stack(key: str, parts: list) -> None:
         if any(p is None for p in parts):
@@ -653,7 +672,7 @@ def load_gguf_params(gf: GGUFFile, arch) -> dict:
                 per_key.setdefault(ours, []).append(None)
                 continue
             if is_mm:
-                w = _load_matmul_weight(gf, tname, arch, ours)
+                w = _load_matmul_weight(gf, tname, arch, ours, permute_qk)
             else:
                 w = gf.tensor(tname).astype(np.float32).astype(bf16)
             per_key.setdefault(ours, []).append(w)
@@ -661,7 +680,7 @@ def load_gguf_params(gf: GGUFFile, arch) -> dict:
             tname = f"blk.{i}.{bname}.bias"
             if tname in gf.tensors:
                 b = gf.tensor(tname).astype(np.float32)
-                if bname in ("attn_q", "attn_k"):
+                if permute_qk and bname in ("attn_q", "attn_k"):
                     heads = arch.num_heads if bname == "attn_q" else arch.num_kv_heads
                     b = b[_permutation_indices(b.shape[0], heads)]
                 per_key.setdefault(ours, []).append(b.astype(bf16))
@@ -711,12 +730,16 @@ def load_gguf_params(gf: GGUFFile, arch) -> dict:
     return params
 
 
-def _load_matmul_weight(gf: GGUFFile, tname: str, arch, ours: str):
+def _load_matmul_weight(gf: GGUFFile, tname: str, arch, ours: str,
+                        permute_qk: bool = True):
     """One 2D matmul weight → grouped quant dict [G, ..., out] or bf16
-    [in, out]; q/k rows un-permuted back to the HF rope layout."""
+    [in, out]; q/k rows un-permuted back to the HF rope layout when the
+    export permuted them (llama family)."""
     import ml_dtypes
 
     heads = {"wq": arch.num_heads, "wk": arch.num_kv_heads}.get(ours)
+    if not permute_qk:
+        heads = None
     grouped = gf.grouped(tname)
     if grouped is not None:
         if heads is not None:
@@ -730,11 +753,27 @@ def _load_matmul_weight(gf: GGUFFile, tname: str, arch, ours: str):
     return np.ascontiguousarray(w.T).astype(ml_dtypes.bfloat16)
 
 
+def _tokenizer_cache_dir(path: str) -> str:
+    """Synthesized-tokenizer location: next to the model when writable
+    (keeps things inspectable), else a content-keyed cache dir — model
+    volumes are often read-only mounts."""
+    local = path + ".tokenizer"
+    parent = os.path.dirname(os.path.abspath(path))
+    if os.access(parent, os.W_OK):
+        return local
+    import hashlib
+
+    digest = hashlib.sha256(os.path.abspath(path).encode()).hexdigest()[:16]
+    return os.path.join(
+        os.path.expanduser("~/.cache/localai_tpu/gguf-tok"), digest
+    )
+
+
 def load_gguf_checkpoint(path: str):
     """(arch, params, tokenizer_dir_or_None) for a .gguf file — the TPU
     equivalent of the reference's GGUF load (grpc-server.cpp:379-527)."""
     gf = GGUFFile(path)
     arch = arch_from_gguf(gf)
     params = load_gguf_params(gf, arch)
-    tok_dir = write_hf_tokenizer(gf, path + ".tokenizer")
+    tok_dir = write_hf_tokenizer(gf, _tokenizer_cache_dir(path))
     return arch, params, tok_dir

@@ -263,3 +263,186 @@ class DiffusionEngine:
         self.m_images += n_frames
         self._busy_time += time.monotonic() - t0
         return out
+
+
+class LatentDiffusionEngine:
+    """Resident engine for real latent-diffusion checkpoints (SD-1.5-class,
+    diffusers layout — models/latent_diffusion.py). Same surface as
+    DiffusionEngine so the image/video APIs work with either."""
+
+    def __init__(self, cfg, params, tokenizer, default_scheduler: str = "ddim"):
+        from localai_tpu.models import latent_diffusion as ld
+
+        self._ld = ld
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.default_scheduler = default_scheduler
+        self.cache = None
+        self._lock = threading.Lock()
+        self._jit: dict[tuple, Any] = {}
+        self.m_requests = 0
+        self.m_images = 0
+        self._busy_time = 0.0
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+    def cancel_all(self) -> int:
+        return 0
+
+    def metrics(self) -> dict[str, float]:
+        return {
+            "requests": float(self.m_requests),
+            "images_generated": float(self.m_images),
+            "busy_seconds": self._busy_time,
+        }
+
+    # ------------------------------------------------------------------ #
+
+    def _ids(self, prompt: str, batch: int) -> jnp.ndarray:
+        S = self.cfg.text.max_position_embeddings
+        enc = self.tokenizer(
+            prompt, padding="max_length", max_length=S, truncation=True,
+        )["input_ids"]
+        return jnp.broadcast_to(jnp.asarray(enc, jnp.int32), (batch, S))
+
+    def _native_size(self) -> int:
+        return int(self.cfg.unet.sample_size) * self.cfg.vae.spatial_scale
+
+    def _round_size(self, size) -> tuple[int, int]:
+        if size is None:
+            s = self._native_size()
+            return s, s
+        # pixel granularity: latents must survive the UNet's down/up ladder
+        gran = self.cfg.vae.spatial_scale * (
+            2 ** (len(self.cfg.unet.block_out_channels) - 1)
+        )
+        w, h = size
+        return max(gran, (w // gran) * gran), max(gran, (h // gran) * gran)
+
+    def generate(
+        self,
+        prompt: str,
+        n: int = 1,
+        steps: int = 20,
+        seed: Optional[int] = None,
+        guidance: float = 7.5,
+        size: Optional[tuple[int, int]] = None,
+        negative_prompt: str = "",
+        scheduler: Optional[str] = None,
+        _init_noise=None,
+        _known=None,  # (known_latent, known_mask) for inpainting
+    ) -> list[np.ndarray]:
+        from PIL import Image
+
+        t0 = time.monotonic()
+        sched = scheduler or self.default_scheduler
+        gw, gh = self._round_size(size)
+        cond = self._ids(prompt, n)
+        uncond = self._ids(negative_prompt or "", n)
+        key = jax.random.key(0 if seed is None else int(seed) & 0x7FFFFFFF)
+        with self._lock:
+            jkey = (n, steps, gw, gh, sched, _known is not None,
+                    _init_noise is not None)
+            fn = self._jit.get(jkey)
+            if fn is None:
+                cfg, ld = self.cfg, self._ld
+
+                def run(p, c, u, k, g, noise=None, kl=None, km=None):
+                    return ld.generate(
+                        cfg, p, c, u, k, steps=steps, guidance=g,
+                        height=gh, width=gw, scheduler=sched,
+                        init_noise=noise, known_latent=kl, known_mask=km,
+                    )
+
+                fn = jax.jit(run)
+                # (n, steps, size, scheduler) are client-controlled: bound
+                # the executable cache or a size-sweeping client grows
+                # host+device memory without limit.
+                if len(self._jit) >= 8:
+                    self._jit.pop(next(iter(self._jit)))
+                self._jit[jkey] = fn
+            else:  # refresh LRU position
+                self._jit.pop(jkey)
+                self._jit[jkey] = fn
+            args = [self.params, cond, uncond, key, jnp.float32(guidance)]
+            kw = {}
+            if _init_noise is not None:
+                kw["noise"] = _init_noise
+            if _known is not None:
+                kw["kl"], kw["km"] = _known
+            imgs = np.asarray(fn(*args, **kw))
+        out = []
+        for i in range(n):
+            img = (imgs[i] * 255.0 + 0.5).astype(np.uint8)
+            if size is not None and size != (gw, gh):
+                img = np.asarray(Image.fromarray(img).resize(size, Image.BILINEAR))
+            out.append(img)
+        self.m_requests += 1
+        self.m_images += n
+        self._busy_time += time.monotonic() - t0
+        return out
+
+    def inpaint(
+        self,
+        prompt: str,
+        image: np.ndarray,  # uint8 [H, W, 3]
+        mask: np.ndarray,  # uint8 [H, W] — nonzero = repaint
+        steps: int = 20,
+        seed: Optional[int] = None,
+        guidance: float = 7.5,
+    ) -> np.ndarray:
+        from PIL import Image
+
+        H, W = image.shape[:2]
+        s = self._native_size()
+        img = np.asarray(Image.fromarray(image).resize((s, s), Image.BILINEAR),
+                         np.float32) / 255.0
+        vs = self.cfg.vae.spatial_scale
+        m = np.asarray(Image.fromarray(mask).resize((s // vs, s // vs), Image.NEAREST),
+                       np.float32)
+        m = (m > 127).astype(np.float32) if m.max() > 1.0 else (m > 0.5).astype(np.float32)
+        known = self._ld.vae_encode(
+            self.cfg.vae, self.params["vae"], jnp.asarray(img[None])
+        )
+        out = self.generate(
+            prompt, n=1, steps=steps, seed=seed, guidance=guidance,
+            size=(s, s), scheduler="ddim",
+            _known=(known, jnp.asarray(m[None, :, :, None])),
+        )[0]
+        if (W, H) != (s, s):
+            out = np.asarray(Image.fromarray(out).resize((W, H), Image.BILINEAR))
+        return out
+
+    def generate_video(
+        self,
+        prompt: str,
+        n_frames: int = 8,
+        steps: int = 12,
+        seed: Optional[int] = None,
+        guidance: float = 7.5,
+    ) -> list[np.ndarray]:
+        """Latent-space slerp between two seed noises over n_frames — the
+        smooth-sweep video capability (reference: diffusers video pipelines)."""
+        s = self._native_size()
+        vs = self.cfg.vae.spatial_scale
+        lat = (n_frames, s // vs, s // vs, self.cfg.unet.in_channels)
+        base = jax.random.key(0 if seed is None else int(seed) & 0x7FFFFFFF)
+        k0, k1 = jax.random.split(base)
+        n0 = jax.random.normal(k0, lat[1:], jnp.float32)
+        n1 = jax.random.normal(k1, lat[1:], jnp.float32)
+        ts = np.linspace(0.0, 1.0, n_frames, dtype=np.float32)
+        dot = float(jnp.sum(n0 * n1) / (jnp.linalg.norm(n0) * jnp.linalg.norm(n1)))
+        theta = np.arccos(np.clip(dot, -1.0, 1.0))
+        frames_noise = jnp.stack([
+            (np.sin((1 - t) * theta) * n0 + np.sin(t * theta) * n1) / max(np.sin(theta), 1e-6)
+            for t in ts
+        ])
+        return self.generate(
+            prompt, n=n_frames, steps=steps, seed=seed, guidance=guidance,
+            size=(s, s), scheduler="ddim", _init_noise=frames_noise,
+        )

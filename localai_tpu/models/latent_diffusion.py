@@ -1,0 +1,677 @@
+"""Latent diffusion (Stable-Diffusion-1.5-class) in JAX, loading real HF
+diffusers-layout checkpoints.
+
+Reference: the diffusers backend's dynamic pipeline registry
+(/root/reference/backend/python/diffusers/backend.py:27-120) serves SD/SDXL
+class models; the GGML SD backend (backend/go/stablediffusion-ggml) covers
+the same ground natively. TPU-native shape: the three submodels (CLIP text
+encoder, UNet2DCondition, VAE) are plain jitted functions over NHWC arrays —
+convs lower to MXU through XLA, the denoise step jits once per (batch, size)
+and lax.scan's over scheduler steps on device.
+
+Checkpoint layout (diffusers): model_index.json + {text_encoder,unet,vae}/
+config.json + *.safetensors with torch names. Weights load into flat
+name→array dicts (1:1 with the published names, so parity is auditable);
+convs transpose OIHW→HWIO, linears transpose to [in, out] at load.
+
+Schedulers: DDIM (eta=0) and Euler-ancestral, both over the scaled-linear
+beta schedule the SD family trains with.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+log = logging.getLogger("localai_tpu.latent_diffusion")
+
+Params = dict[str, jnp.ndarray]
+
+
+# --------------------------------------------------------------------------- #
+# Configs (subset of the diffusers configs we consume)
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 77
+    hidden_act: str = "quick_gelu"
+    layer_norm_eps: float = 1e-5
+
+
+@dataclass
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    sample_size: int = 64
+    block_out_channels: tuple = (320, 640, 1280, 1280)
+    down_block_types: tuple = (
+        "CrossAttnDownBlock2D", "CrossAttnDownBlock2D",
+        "CrossAttnDownBlock2D", "DownBlock2D",
+    )
+    up_block_types: tuple = (
+        "UpBlock2D", "CrossAttnUpBlock2D",
+        "CrossAttnUpBlock2D", "CrossAttnUpBlock2D",
+    )
+    layers_per_block: int = 2
+    attention_head_dim: Any = 8  # int or per-block list
+    cross_attention_dim: int = 768
+    norm_num_groups: int = 32
+    flip_sin_to_cos: bool = True
+    freq_shift: int = 0
+
+    def heads_for(self, block_idx: int) -> int:
+        # diffusers quirk: UNet2DConditionModel's `attention_head_dim` is
+        # used as the NUMBER of heads (upstream keeps the misnomer for
+        # back-compat; SD1.5's 8 and SDXL's [5,10,20] are head counts).
+        if isinstance(self.attention_head_dim, (list, tuple)):
+            return int(self.attention_head_dim[block_idx])
+        return int(self.attention_head_dim)
+
+
+@dataclass
+class VAEConfig:
+    in_channels: int = 3
+    out_channels: int = 3
+    latent_channels: int = 4
+    block_out_channels: tuple = (128, 256, 512, 512)
+    layers_per_block: int = 2
+    norm_num_groups: int = 32
+    scaling_factor: float = 0.18215
+
+    @property
+    def spatial_scale(self) -> int:
+        """Pixel-per-latent factor: one 2x resampler between each block
+        pair (8 for the SD family's 4-block VAE)."""
+        return 2 ** (len(self.block_out_channels) - 1)
+
+
+@dataclass
+class SDPipelineConfig:
+    text: CLIPTextConfig = field(default_factory=CLIPTextConfig)
+    unet: UNetConfig = field(default_factory=UNetConfig)
+    vae: VAEConfig = field(default_factory=VAEConfig)
+    # scaled-linear schedule (SD family)
+    num_train_timesteps: int = 1000
+    beta_start: float = 0.00085
+    beta_end: float = 0.012
+    prediction_type: str = "epsilon"  # | "v_prediction"
+
+
+# --------------------------------------------------------------------------- #
+# Primitive layers (NHWC)
+# --------------------------------------------------------------------------- #
+
+
+def _conv(x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray,
+          stride: int = 1, pad: int = 1) -> jnp.ndarray:
+    y = jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding=[(pad, pad), (pad, pad)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return y + b.astype(x.dtype)
+
+
+def _linear(x: jnp.ndarray, p: Params, name: str) -> jnp.ndarray:
+    return x @ p[f"{name}.weight"].astype(x.dtype) + p[f"{name}.bias"].astype(x.dtype)
+
+
+def _group_norm(x: jnp.ndarray, w, b, groups: int = 32, eps: float = 1e-6) -> jnp.ndarray:
+    c = x.shape[-1]
+    g = groups
+    # normalize over all spatial positions and the in-group channels
+    xf = x.astype(jnp.float32).reshape(x.shape[0], -1, g, c // g)
+    mean = xf.mean(axis=(1, 3), keepdims=True)
+    var = xf.var(axis=(1, 3), keepdims=True)
+    xn = (xf - mean) * jax.lax.rsqrt(var + eps)
+    xn = xn.reshape(x.shape).astype(x.dtype)
+    return xn * w.astype(x.dtype) + b.astype(x.dtype)
+
+
+def _layer_norm(x: jnp.ndarray, w, b, eps: float = 1e-5) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y.astype(x.dtype)) * w.astype(x.dtype) + b.astype(x.dtype)
+
+
+def _attention(q, k, v, heads: int) -> jnp.ndarray:
+    """q [B, Nq, C], k/v [B, Nk, C] → [B, Nq, C]."""
+    B, Nq, C = q.shape
+    hd = C // heads
+    q = q.reshape(B, Nq, heads, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(B, -1, heads, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, -1, heads, hd).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / np.sqrt(hd)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return out.transpose(0, 2, 1, 3).reshape(B, Nq, C)
+
+
+def get_timestep_embedding(t: jnp.ndarray, dim: int,
+                           flip_sin_to_cos: bool = True,
+                           freq_shift: float = 0.0) -> jnp.ndarray:
+    """diffusers get_timestep_embedding semantics (t [B] → [B, dim])."""
+    half = dim // 2
+    exponent = -np.log(10000.0) * jnp.arange(half, dtype=jnp.float32)
+    exponent = exponent / (half - freq_shift)
+    emb = jnp.exp(exponent)[None, :] * t.astype(jnp.float32)[:, None]
+    sin, cos = jnp.sin(emb), jnp.cos(emb)
+    return jnp.concatenate([cos, sin] if flip_sin_to_cos else [sin, cos], axis=-1)
+
+
+# --------------------------------------------------------------------------- #
+# CLIP text encoder (causal; quick-gelu)
+# --------------------------------------------------------------------------- #
+
+
+def clip_encode(cfg: CLIPTextConfig, p: Params, ids: jnp.ndarray) -> jnp.ndarray:
+    """[B, 77] int32 → last hidden state [B, 77, C] (what SD conditions on)."""
+    B, S = ids.shape
+    h = p["text_model.embeddings.token_embedding.weight"][ids]
+    h = h + p["text_model.embeddings.position_embedding.weight"][None, :S]
+    mask = jnp.triu(jnp.full((S, S), -jnp.inf, jnp.float32), k=1)
+
+    def act(x):
+        if cfg.hidden_act == "quick_gelu":
+            return x * jax.nn.sigmoid(1.702 * x)
+        return jax.nn.gelu(x)
+
+    for i in range(cfg.num_hidden_layers):
+        pre = f"text_model.encoder.layers.{i}"
+        r = h
+        h = _layer_norm(h, p[f"{pre}.layer_norm1.weight"], p[f"{pre}.layer_norm1.bias"],
+                        cfg.layer_norm_eps)
+        q = _linear(h, p, f"{pre}.self_attn.q_proj")
+        k = _linear(h, p, f"{pre}.self_attn.k_proj")
+        v = _linear(h, p, f"{pre}.self_attn.v_proj")
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        qh = q.reshape(B, S, -1, hd).transpose(0, 2, 1, 3)
+        kh = k.reshape(B, S, -1, hd).transpose(0, 2, 1, 3)
+        vh = v.reshape(B, S, -1, hd).transpose(0, 2, 1, 3)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh).astype(jnp.float32) / np.sqrt(hd)
+        probs = jax.nn.softmax(scores + mask, axis=-1).astype(vh.dtype)
+        a = jnp.einsum("bhqk,bhkd->bhqd", probs, vh).transpose(0, 2, 1, 3).reshape(B, S, -1)
+        h = r + _linear(a, p, f"{pre}.self_attn.out_proj")
+        r = h
+        h = _layer_norm(h, p[f"{pre}.layer_norm2.weight"], p[f"{pre}.layer_norm2.bias"],
+                        cfg.layer_norm_eps)
+        h = r + _linear(act(_linear(h, p, f"{pre}.mlp.fc1")), p, f"{pre}.mlp.fc2")
+    return _layer_norm(
+        h, p["text_model.final_layer_norm.weight"],
+        p["text_model.final_layer_norm.bias"], cfg.layer_norm_eps,
+    )
+
+
+# --------------------------------------------------------------------------- #
+# UNet2DCondition
+# --------------------------------------------------------------------------- #
+
+
+def _resnet(p: Params, pre: str, x: jnp.ndarray, temb: jnp.ndarray,
+            groups: int) -> jnp.ndarray:
+    h = _group_norm(x, p[f"{pre}.norm1.weight"], p[f"{pre}.norm1.bias"], groups)
+    h = _conv(jax.nn.silu(h), p[f"{pre}.conv1.weight"], p[f"{pre}.conv1.bias"])
+    if f"{pre}.time_emb_proj.weight" in p:
+        t = _linear(jax.nn.silu(temb), p, f"{pre}.time_emb_proj")
+        h = h + t[:, None, None, :]
+    h = _group_norm(h, p[f"{pre}.norm2.weight"], p[f"{pre}.norm2.bias"], groups)
+    h = _conv(jax.nn.silu(h), p[f"{pre}.conv2.weight"], p[f"{pre}.conv2.bias"])
+    if f"{pre}.conv_shortcut.weight" in p:
+        x = _conv(x, p[f"{pre}.conv_shortcut.weight"], p[f"{pre}.conv_shortcut.bias"], pad=0)
+    return x + h
+
+
+def _basic_transformer(p: Params, pre: str, h: jnp.ndarray, ctx: jnp.ndarray,
+                       heads: int) -> jnp.ndarray:
+    # self-attention
+    r = h
+    n = _layer_norm(h, p[f"{pre}.norm1.weight"], p[f"{pre}.norm1.bias"])
+    q = n @ p[f"{pre}.attn1.to_q.weight"].astype(h.dtype)
+    k = n @ p[f"{pre}.attn1.to_k.weight"].astype(h.dtype)
+    v = n @ p[f"{pre}.attn1.to_v.weight"].astype(h.dtype)
+    h = r + _linear(_attention(q, k, v, heads), p, f"{pre}.attn1.to_out.0")
+    # cross-attention over text states
+    r = h
+    n = _layer_norm(h, p[f"{pre}.norm2.weight"], p[f"{pre}.norm2.bias"])
+    q = n @ p[f"{pre}.attn2.to_q.weight"].astype(h.dtype)
+    k = ctx @ p[f"{pre}.attn2.to_k.weight"].astype(ctx.dtype)
+    v = ctx @ p[f"{pre}.attn2.to_v.weight"].astype(ctx.dtype)
+    h = r + _linear(_attention(q, k.astype(h.dtype), v.astype(h.dtype), heads),
+                    p, f"{pre}.attn2.to_out.0")
+    # geglu feed-forward
+    r = h
+    n = _layer_norm(h, p[f"{pre}.norm3.weight"], p[f"{pre}.norm3.bias"])
+    proj = _linear(n, p, f"{pre}.ff.net.0.proj")
+    a, gate = jnp.split(proj, 2, axis=-1)
+    return r + _linear(a * jax.nn.gelu(gate), p, f"{pre}.ff.net.2")
+
+
+def _spatial_transformer(p: Params, pre: str, x: jnp.ndarray, ctx: jnp.ndarray,
+                         heads: int, groups: int) -> jnp.ndarray:
+    B, H, W, C = x.shape
+    r = x
+    h = _group_norm(x, p[f"{pre}.norm.weight"], p[f"{pre}.norm.bias"], groups)
+    use_linear = p[f"{pre}.proj_in.weight"].ndim == 2
+    if use_linear:
+        h = h.reshape(B, H * W, C)
+        h = _linear(h, p, f"{pre}.proj_in")
+    else:
+        h = _conv(h, p[f"{pre}.proj_in.weight"], p[f"{pre}.proj_in.bias"], pad=0)
+        h = h.reshape(B, H * W, C)
+    h = _basic_transformer(p, f"{pre}.transformer_blocks.0", h, ctx, heads)
+    if use_linear:
+        h = _linear(h, p, f"{pre}.proj_out").reshape(B, H, W, C)
+    else:
+        h = h.reshape(B, H, W, C)
+        h = _conv(h, p[f"{pre}.proj_out.weight"], p[f"{pre}.proj_out.bias"], pad=0)
+    return h + r
+
+
+def unet_forward(cfg: UNetConfig, p: Params, sample: jnp.ndarray,
+                 t: jnp.ndarray, ctx: jnp.ndarray) -> jnp.ndarray:
+    """sample [B, H, W, C_lat], t [B], ctx [B, S, C_txt] → eps/v pred."""
+    g = cfg.norm_num_groups
+    temb = get_timestep_embedding(
+        t, cfg.block_out_channels[0], cfg.flip_sin_to_cos, cfg.freq_shift
+    ).astype(sample.dtype)
+    temb = _linear(temb, p, "time_embedding.linear_1")
+    temb = _linear(jax.nn.silu(temb), p, "time_embedding.linear_2")
+
+    h = _conv(sample, p["conv_in.weight"], p["conv_in.bias"])
+    skips = [h]
+    for bi, btype in enumerate(cfg.down_block_types):
+        pre = f"down_blocks.{bi}"
+        heads = cfg.heads_for(bi)
+        for li in range(cfg.layers_per_block):
+            h = _resnet(p, f"{pre}.resnets.{li}", h, temb, g)
+            if btype == "CrossAttnDownBlock2D":
+                h = _spatial_transformer(
+                    p, f"{pre}.attentions.{li}", h, ctx, heads, g,
+                )
+            skips.append(h)
+        if f"{pre}.downsamplers.0.conv.weight" in p:
+            h = _conv(h, p[f"{pre}.downsamplers.0.conv.weight"],
+                      p[f"{pre}.downsamplers.0.conv.bias"], stride=2)
+            skips.append(h)
+
+    h = _resnet(p, "mid_block.resnets.0", h, temb, g)
+    h = _spatial_transformer(
+        p, "mid_block.attentions.0", h, ctx,
+        cfg.heads_for(len(cfg.block_out_channels) - 1), g,
+    )
+    h = _resnet(p, "mid_block.resnets.1", h, temb, g)
+
+    for bi, btype in enumerate(cfg.up_block_types):
+        pre = f"up_blocks.{bi}"
+        heads = cfg.heads_for(len(cfg.block_out_channels) - 1 - bi)
+        for li in range(cfg.layers_per_block + 1):
+            skip = skips.pop()
+            h = jnp.concatenate([h, skip], axis=-1)
+            h = _resnet(p, f"{pre}.resnets.{li}", h, temb, g)
+            if btype == "CrossAttnUpBlock2D":
+                h = _spatial_transformer(
+                    p, f"{pre}.attentions.{li}", h, ctx, heads, g,
+                )
+        if f"{pre}.upsamplers.0.conv.weight" in p:
+            B, H, W, C = h.shape
+            h = jax.image.resize(h, (B, H * 2, W * 2, C), "nearest")
+            h = _conv(h, p[f"{pre}.upsamplers.0.conv.weight"],
+                      p[f"{pre}.upsamplers.0.conv.bias"])
+
+    h = _group_norm(h, p["conv_norm_out.weight"], p["conv_norm_out.bias"], g)
+    return _conv(jax.nn.silu(h), p["conv_out.weight"], p["conv_out.bias"])
+
+
+# --------------------------------------------------------------------------- #
+# VAE
+# --------------------------------------------------------------------------- #
+
+
+def _vae_attn(p: Params, pre: str, x: jnp.ndarray, groups: int) -> jnp.ndarray:
+    B, H, W, C = x.shape
+    h = _group_norm(x, p[f"{pre}.group_norm.weight"], p[f"{pre}.group_norm.bias"], groups)
+    h = h.reshape(B, H * W, C)
+    q = _linear(h, p, f"{pre}.to_q")
+    k = _linear(h, p, f"{pre}.to_k")
+    v = _linear(h, p, f"{pre}.to_v")
+    h = _attention(q, k, v, heads=1)
+    h = _linear(h, p, f"{pre}.to_out.0").reshape(B, H, W, C)
+    return x + h
+
+
+def vae_decode(cfg: VAEConfig, p: Params, latents: jnp.ndarray) -> jnp.ndarray:
+    """[B, h, w, C_lat] (already unscaled) → images [B, 8h, 8w, 3] in [0,1]."""
+    g = cfg.norm_num_groups
+    zero_t = jnp.zeros((latents.shape[0],), latents.dtype)
+    h = _conv(latents, p["post_quant_conv.weight"], p["post_quant_conv.bias"], pad=0)
+    h = _conv(h, p["decoder.conv_in.weight"], p["decoder.conv_in.bias"])
+    h = _resnet(p, "decoder.mid_block.resnets.0", h, zero_t, g)
+    h = _vae_attn(p, "decoder.mid_block.attentions.0", h, g)
+    h = _resnet(p, "decoder.mid_block.resnets.1", h, zero_t, g)
+    n_blocks = len(cfg.block_out_channels)
+    for bi in range(n_blocks):
+        pre = f"decoder.up_blocks.{bi}"
+        for li in range(cfg.layers_per_block + 1):
+            h = _resnet(p, f"{pre}.resnets.{li}", h, zero_t, g)
+        if f"{pre}.upsamplers.0.conv.weight" in p:
+            B, H, W, C = h.shape
+            h = jax.image.resize(h, (B, H * 2, W * 2, C), "nearest")
+            h = _conv(h, p[f"{pre}.upsamplers.0.conv.weight"],
+                      p[f"{pre}.upsamplers.0.conv.bias"])
+    h = _group_norm(h, p["decoder.conv_norm_out.weight"],
+                    p["decoder.conv_norm_out.bias"], g)
+    img = _conv(jax.nn.silu(h), p["decoder.conv_out.weight"], p["decoder.conv_out.bias"])
+    return jnp.clip(img.astype(jnp.float32) / 2.0 + 0.5, 0.0, 1.0)
+
+
+def vae_encode(cfg: VAEConfig, p: Params, img: jnp.ndarray,
+               key: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """images [B, H, W, 3] in [0,1] → scaled latents [B, H/8, W/8, C_lat].
+    Deterministic (mode) unless a key is given."""
+    g = cfg.norm_num_groups
+    x = img.astype(jnp.float32) * 2.0 - 1.0
+    zero_t = jnp.zeros((x.shape[0],), x.dtype)
+    h = _conv(x, p["encoder.conv_in.weight"], p["encoder.conv_in.bias"])
+    n_blocks = len(cfg.block_out_channels)
+    for bi in range(n_blocks):
+        pre = f"encoder.down_blocks.{bi}"
+        for li in range(cfg.layers_per_block):
+            h = _resnet(p, f"{pre}.resnets.{li}", h, zero_t, g)
+        if f"{pre}.downsamplers.0.conv.weight" in p:
+            # diffusers pads asymmetrically (0,1,0,1) for stride-2 convs
+            h = jnp.pad(h, ((0, 0), (0, 1), (0, 1), (0, 0)))
+            h = jax.lax.conv_general_dilated(
+                h, p[f"{pre}.downsamplers.0.conv.weight"].astype(h.dtype),
+                window_strides=(2, 2), padding=[(0, 0), (0, 0)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            ) + p[f"{pre}.downsamplers.0.conv.bias"].astype(h.dtype)
+    h = _resnet(p, "encoder.mid_block.resnets.0", h, zero_t, g)
+    h = _vae_attn(p, "encoder.mid_block.attentions.0", h, g)
+    h = _resnet(p, "encoder.mid_block.resnets.1", h, zero_t, g)
+    h = _group_norm(h, p["encoder.conv_norm_out.weight"],
+                    p["encoder.conv_norm_out.bias"], g)
+    h = _conv(jax.nn.silu(h), p["encoder.conv_out.weight"], p["encoder.conv_out.bias"])
+    moments = _conv(h, p["quant_conv.weight"], p["quant_conv.bias"], pad=0)
+    mean, logvar = jnp.split(moments, 2, axis=-1)
+    if key is not None:
+        mean = mean + jnp.exp(0.5 * jnp.clip(logvar, -30, 20)) * jax.random.normal(
+            key, mean.shape, mean.dtype
+        )
+    return mean * cfg.scaling_factor
+
+
+# --------------------------------------------------------------------------- #
+# Schedulers
+# --------------------------------------------------------------------------- #
+
+
+def alphas_cumprod(cfg: SDPipelineConfig) -> np.ndarray:
+    betas = np.linspace(
+        cfg.beta_start ** 0.5, cfg.beta_end ** 0.5, cfg.num_train_timesteps,
+        dtype=np.float64,
+    ) ** 2  # "scaled_linear"
+    return np.cumprod(1.0 - betas).astype(np.float32)
+
+
+def ddim_timesteps(cfg: SDPipelineConfig, steps: int) -> np.ndarray:
+    ratio = cfg.num_train_timesteps // steps
+    return (np.arange(steps) * ratio).round()[::-1].astype(np.int32)  # "leading"
+
+
+def _pred_x0_eps(cfg: SDPipelineConfig, model_out, x, acp_t):
+    """(x0, eps) from the model output under the configured prediction type."""
+    sq_a, sq_1ma = jnp.sqrt(acp_t), jnp.sqrt(1.0 - acp_t)
+    if cfg.prediction_type == "v_prediction":
+        x0 = sq_a * x - sq_1ma * model_out
+        eps = sq_a * model_out + sq_1ma * x
+    else:
+        x0 = (x - sq_1ma * model_out) / sq_a
+        eps = model_out
+    return x0, eps
+
+
+def ddim_step(cfg: SDPipelineConfig, acp: jnp.ndarray, model_out: jnp.ndarray,
+              t: jnp.ndarray, t_prev: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    acp_t = acp[t]
+    acp_prev = jnp.where(t_prev >= 0, acp[jnp.maximum(t_prev, 0)], 1.0)
+    x0, eps = _pred_x0_eps(cfg, model_out.astype(jnp.float32), x.astype(jnp.float32), acp_t)
+    return (jnp.sqrt(acp_prev) * x0 + jnp.sqrt(1.0 - acp_prev) * eps).astype(x.dtype)
+
+
+def euler_a_sigmas(cfg: SDPipelineConfig, steps: int) -> np.ndarray:
+    acp = alphas_cumprod(cfg)
+    sig = np.sqrt((1 - acp) / acp)
+    ts = ddim_timesteps(cfg, steps).astype(np.float64)
+    sigmas = np.interp(ts, np.arange(len(sig)), sig)
+    return np.append(sigmas, 0.0).astype(np.float32)
+
+
+def euler_a_step(model_out, x, sigma, sigma_next, noise):
+    """k-diffusion Euler-ancestral over eps-prediction in sigma space."""
+    mo = model_out.astype(jnp.float32)
+    xf = x.astype(jnp.float32)
+    x0 = xf - sigma * mo
+    s2, sn2 = sigma ** 2, sigma_next ** 2
+    sigma_up = jnp.sqrt(jnp.maximum(sn2 * (s2 - sn2) / jnp.maximum(s2, 1e-12), 0.0))
+    sigma_down = jnp.sqrt(jnp.maximum(sn2 - sigma_up ** 2, 0.0))
+    d = (xf - x0) / jnp.maximum(sigma, 1e-12)
+    xf = xf + d * (sigma_down - sigma) + noise * sigma_up
+    return xf.astype(x.dtype)
+
+
+# --------------------------------------------------------------------------- #
+# Generation
+# --------------------------------------------------------------------------- #
+
+
+def generate(
+    cfg: SDPipelineConfig,
+    params: dict[str, Params],  # {"text": ..., "unet": ..., "vae": ...}
+    cond_ids: jnp.ndarray,  # [B, 77]
+    uncond_ids: jnp.ndarray,
+    key: jnp.ndarray,
+    steps: int = 20,
+    guidance: float = 7.5,
+    height: int = 512,
+    width: int = 512,
+    scheduler: str = "ddim",
+    init_noise: Optional[jnp.ndarray] = None,  # [B, h/8, w/8, C] unit normal
+    known_latent: Optional[jnp.ndarray] = None,  # scaled latents to keep
+    known_mask: Optional[jnp.ndarray] = None,  # [B, h/8, w/8, 1]; 1 = repaint
+) -> jnp.ndarray:
+    """Full text→image pipeline; returns [B, H, W, 3] float32 in [0,1].
+    jit-able: shapes depend only on (B, steps, H, W, scheduler).
+
+    With known_latent/known_mask set, runs SD-style inpainting on a vanilla
+    checkpoint: after every step the preserved region is replaced with the
+    source latent re-noised to the current timestep (diffusers'
+    StableDiffusionInpaintPipelineLegacy behavior)."""
+    B = cond_ids.shape[0]
+    ctx_c = clip_encode(cfg.text, params["text"], cond_ids)
+    ctx_u = clip_encode(cfg.text, params["text"], uncond_ids)
+    ctx = jnp.concatenate([ctx_u, ctx_c], axis=0)
+    vs = cfg.vae.spatial_scale
+    lat_h, lat_w = height // vs, width // vs
+    acp = jnp.asarray(alphas_cumprod(cfg))
+    key, nk = jax.random.split(key)
+    lat_c = cfg.unet.in_channels
+    x = init_noise if init_noise is not None else jax.random.normal(
+        nk, (B, lat_h, lat_w, lat_c), jnp.float32
+    )
+
+    def cfg_eps(x_in, t):
+        both = jnp.concatenate([x_in, x_in], axis=0)
+        tt = jnp.full((2 * B,), t, jnp.float32)
+        out = unet_forward(cfg.unet, params["unet"], both, tt, ctx)
+        eps_u, eps_c = jnp.split(out, 2, axis=0)
+        return eps_u + guidance * (eps_c - eps_u)
+
+    inpainting = known_latent is not None and known_mask is not None
+
+    def blend(xc, t_prev, k):
+        """Replace the preserved region with the source re-noised to t_prev."""
+        if not inpainting:
+            return xc
+        acp_prev = jnp.where(t_prev >= 0, acp[jnp.maximum(t_prev, 0)], 1.0)
+        noise = jax.random.normal(k, xc.shape, jnp.float32)
+        noised = jnp.sqrt(acp_prev) * known_latent + jnp.sqrt(1.0 - acp_prev) * noise
+        return known_mask * xc + (1.0 - known_mask) * noised.astype(xc.dtype)
+
+    if scheduler == "euler_a":
+        sigmas = jnp.asarray(euler_a_sigmas(cfg, steps))
+        ts = jnp.asarray(ddim_timesteps(cfg, steps).astype(np.float32))
+        x = x * sigmas[0]
+
+        def step(carry, i):
+            xc, k = carry
+            k, nk2 = jax.random.split(k)
+            sig, sig_n = sigmas[i], sigmas[i + 1]
+            x_in = xc / jnp.sqrt(sig ** 2 + 1.0)
+            eps = cfg_eps(x_in, ts[i])
+            noise = jax.random.normal(nk2, xc.shape, jnp.float32)
+            return (euler_a_step(eps, xc, sig, sig_n, noise), k), None
+
+        (x, _), _ = jax.lax.scan(step, (x, key), jnp.arange(steps))
+    else:
+        ts = jnp.asarray(ddim_timesteps(cfg, steps))
+        ratio = cfg.num_train_timesteps // steps
+
+        def step(carry, i):
+            xc, k = carry
+            k, bk = jax.random.split(k)
+            t = ts[i]
+            eps = cfg_eps(xc, t.astype(jnp.float32))
+            xn = ddim_step(cfg, acp, eps, t, t - ratio, xc)
+            return (blend(xn, t - ratio, bk), k), None
+
+        (x, _), _ = jax.lax.scan(step, (x, key), jnp.arange(steps))
+
+    return vae_decode(cfg.vae, params["vae"], x / cfg.vae.scaling_factor)
+
+
+# --------------------------------------------------------------------------- #
+# Checkpoint loading (diffusers layout)
+# --------------------------------------------------------------------------- #
+
+
+def is_diffusers_dir(path: str) -> bool:
+    return os.path.isfile(os.path.join(path, "model_index.json"))
+
+
+def _load_safetensors_dir(subdir: str) -> dict[str, np.ndarray]:
+    from safetensors import safe_open
+
+    out: dict[str, np.ndarray] = {}
+    files = sorted(
+        f for f in os.listdir(subdir)
+        if f.endswith(".safetensors")
+    )
+    if not files:
+        raise FileNotFoundError(f"no .safetensors under {subdir}")
+    for fname in files:
+        with safe_open(os.path.join(subdir, fname), framework="numpy") as f:
+            for name in f.keys():
+                out[name] = f.get_tensor(name)
+    return out
+
+
+def _prep(tensors: dict[str, np.ndarray], dtype) -> Params:
+    """torch layouts → ours: convs OIHW→HWIO, 2D linears [out,in]→[in,out]."""
+    out: Params = {}
+    lookup_tables = ("token_embedding", "position_embedding")
+    for name, arr in tensors.items():
+        if arr.ndim == 4:
+            arr = arr.transpose(2, 3, 1, 0)
+        elif (arr.ndim == 2 and name.endswith(".weight")
+              and not any(t in name for t in lookup_tables)):
+            arr = arr.T
+        out[name] = jnp.asarray(np.ascontiguousarray(arr), dtype)
+    return out
+
+
+def _cfg_json(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def load_pipeline(ckpt_dir: str, dtype=jnp.float32):
+    """(SDPipelineConfig, params, tokenizer) from a diffusers checkpoint dir.
+
+    Matches the reference's dynamic pipeline load
+    (backend/python/diffusers/backend.py) for the SD-1.5 class; the tokenizer
+    is the checkpoint's own CLIPTokenizer(Fast) via transformers.
+    """
+    tc = _cfg_json(os.path.join(ckpt_dir, "text_encoder", "config.json"))
+    uc = _cfg_json(os.path.join(ckpt_dir, "unet", "config.json"))
+    vc = _cfg_json(os.path.join(ckpt_dir, "vae", "config.json"))
+    sched_path = os.path.join(ckpt_dir, "scheduler", "scheduler_config.json")
+    sc = _cfg_json(sched_path) if os.path.isfile(sched_path) else {}
+
+    cfg = SDPipelineConfig(
+        text=CLIPTextConfig(
+            vocab_size=tc.get("vocab_size", 49408),
+            hidden_size=tc.get("hidden_size", 768),
+            intermediate_size=tc.get("intermediate_size", 3072),
+            num_hidden_layers=tc.get("num_hidden_layers", 12),
+            num_attention_heads=tc.get("num_attention_heads", 12),
+            max_position_embeddings=tc.get("max_position_embeddings", 77),
+            hidden_act=tc.get("hidden_act", "quick_gelu"),
+        ),
+        unet=UNetConfig(
+            in_channels=uc.get("in_channels", 4),
+            out_channels=uc.get("out_channels", 4),
+            sample_size=uc.get("sample_size", 64),
+            block_out_channels=tuple(uc.get("block_out_channels", (320, 640, 1280, 1280))),
+            down_block_types=tuple(uc.get("down_block_types", ())),
+            up_block_types=tuple(uc.get("up_block_types", ())),
+            layers_per_block=uc.get("layers_per_block", 2),
+            attention_head_dim=uc.get("attention_head_dim", 8),
+            cross_attention_dim=uc.get("cross_attention_dim", 768),
+            norm_num_groups=uc.get("norm_num_groups", 32),
+            flip_sin_to_cos=uc.get("flip_sin_to_cos", True),
+            freq_shift=uc.get("freq_shift", 0),
+        ),
+        vae=VAEConfig(
+            in_channels=vc.get("in_channels", 3),
+            out_channels=vc.get("out_channels", 3),
+            latent_channels=vc.get("latent_channels", 4),
+            block_out_channels=tuple(vc.get("block_out_channels", (128, 256, 512, 512))),
+            layers_per_block=vc.get("layers_per_block", 2),
+            norm_num_groups=vc.get("norm_num_groups", 32),
+            scaling_factor=vc.get("scaling_factor", 0.18215),
+        ),
+        num_train_timesteps=sc.get("num_train_timesteps", 1000),
+        beta_start=sc.get("beta_start", 0.00085),
+        beta_end=sc.get("beta_end", 0.012),
+        prediction_type=sc.get("prediction_type", "epsilon"),
+    )
+    params = {
+        "text": _prep(_load_safetensors_dir(os.path.join(ckpt_dir, "text_encoder")), dtype),
+        "unet": _prep(_load_safetensors_dir(os.path.join(ckpt_dir, "unet")), dtype),
+        "vae": _prep(_load_safetensors_dir(os.path.join(ckpt_dir, "vae")), dtype),
+    }
+    from transformers import AutoTokenizer, CLIPTokenizer
+
+    tok_dir = os.path.join(ckpt_dir, "tokenizer")
+    try:
+        tokenizer = AutoTokenizer.from_pretrained(tok_dir, local_files_only=True)
+    except Exception:  # noqa: BLE001 — vocab.json/merges.txt direct load
+        tokenizer = CLIPTokenizer.from_pretrained(tok_dir, local_files_only=True)
+    return cfg, params, tokenizer

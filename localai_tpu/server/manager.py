@@ -646,6 +646,21 @@ class ModelManager:
                 raise FileNotFoundError(
                     f"model {cfg.name!r}: diffusion checkpoint {ckpt_dir!r} not found"
                 )
+            from localai_tpu.models import latent_diffusion as LD
+
+            if LD.is_diffusers_dir(ckpt_dir):
+                # Real published checkpoint (SD-1.5-class diffusers layout) —
+                # reference: backend/python/diffusers/backend.py:27-120.
+                from localai_tpu.engine.image_engine import LatentDiffusionEngine
+
+                ldcfg, ldparams, tok = LD.load_pipeline(ckpt_dir)
+                eng = LatentDiffusionEngine(
+                    ldcfg, ldparams, tok,
+                    default_scheduler=str(
+                        cfg.options.get("scheduler", "ddim")
+                    ),
+                )
+                return LoadedModel(cfg, eng, None)
             dcfg, params = D.load_diffusion(ckpt_dir)
         return LoadedModel(cfg, DiffusionEngine(dcfg, params), None)
 

@@ -533,3 +533,88 @@ def test_moe_gguf_loads_and_serves(tmp_path):
         assert ev.completion_tokens == 6
     finally:
         eng.stop()
+
+
+def test_unpermute_inverts_llamacpp_permute():
+    """_unpermute_rows must be the exact inverse of convert_hf_to_gguf's
+    `permute` (reshape(H, 2, hd//2).swapaxes(1, 2)), and the index variant
+    must agree with it."""
+    from localai_tpu.engine.gguf import _permutation_indices, _unpermute_rows
+
+    rng = np.random.default_rng(7)
+    H, HD, IN = 4, 8, 16
+    w = rng.standard_normal((H * HD, IN), np.float32)
+    # forward permute as llama.cpp's convert script defines it
+    permuted = w.reshape(H, 2, HD // 2, IN).swapaxes(1, 2).reshape(H * HD, IN)
+    back = _unpermute_rows(permuted, H)
+    np.testing.assert_array_equal(back, w)
+    idx = _permutation_indices(H * HD, H)
+    np.testing.assert_array_equal(permuted[idx], w)
+
+
+def test_qwen2_arch_skips_qk_permute(tmp_path):
+    """NEOX-rope exports (qwen2) keep HF row order — loader must not permute."""
+    rng = np.random.default_rng(8)
+    D, H, HD, V = 64, 2, 32, 256
+    wq = (rng.standard_normal((H * HD, D)) * 0.05).astype(np.float32)
+    tensors = {
+        "token_embd.weight": ("F32", (D, V),
+                              (rng.standard_normal((V, D)) * 0.05
+                               ).astype(np.float32).tobytes()),
+        "output_norm.weight": ("F32", (D,), np.ones(D, np.float32).tobytes()),
+        "blk.0.attn_norm.weight": ("F32", (D,), np.ones(D, np.float32).tobytes()),
+        "blk.0.attn_q.weight": ("F32", (D, H * HD), wq.tobytes()),
+        "blk.0.attn_k.weight": ("F32", (D, H * HD), wq.tobytes()),
+        "blk.0.attn_v.weight": ("F32", (D, H * HD), wq.tobytes()),
+        "blk.0.attn_output.weight": ("F32", (H * HD, D),
+                                     wq.T.copy().tobytes()),
+        "blk.0.ffn_norm.weight": ("F32", (D,), np.ones(D, np.float32).tobytes()),
+        "blk.0.ffn_gate.weight": ("F32", (D, 64),
+                                  (rng.standard_normal((64, D)) * 0.05
+                                   ).astype(np.float32).tobytes()),
+        "blk.0.ffn_up.weight": ("F32", (D, 64),
+                                (rng.standard_normal((64, D)) * 0.05
+                                 ).astype(np.float32).tobytes()),
+        "blk.0.ffn_down.weight": ("F32", (64, D),
+                                  (rng.standard_normal((D, 64)) * 0.05
+                                   ).astype(np.float32).tobytes()),
+    }
+    kv = {
+        "general.architecture": "qwen2",
+        "qwen2.block_count": 1,
+        "qwen2.embedding_length": D,
+        "qwen2.feed_forward_length": 64,
+        "qwen2.attention.head_count": H,
+        "qwen2.attention.head_count_kv": H,
+        "qwen2.vocab_size": V,
+    }
+    path = str(tmp_path / "q.gguf")
+    write_gguf(path, kv, tensors)
+    arch, params, _ = load_gguf_checkpoint(path)
+    got = np.asarray(params["layers"]["wq"][0], np.float32)
+    np.testing.assert_allclose(got, wq.T, rtol=1e-2, atol=1e-2)  # bf16 cast
+
+
+def test_unsupported_quant_type_raises_clean_error(tmp_path):
+    from localai_tpu.engine.gguf import GGUFReadError
+
+    raw = np.zeros(84, np.uint8).tobytes()  # one Q2_K block
+    path = str(tmp_path / "q2.gguf")
+    write_gguf_raw_type(path, raw)
+    gf = GGUFFile(path)
+    with pytest.raises(GGUFReadError, match="quant type Q2_K"):
+        gf.tensor("t")
+
+
+def write_gguf_raw_type(path, raw):
+    align = 32
+    out = bytearray()
+    out += struct.pack("<II", 0x46554747, 3)
+    out += struct.pack("<QQ", 1, 1)
+    out += _w_str("general.architecture") + _w_value("llama")
+    out += _w_str("t") + struct.pack("<I", 1) + struct.pack("<Q", 256)
+    out += struct.pack("<IQ", 10, 0)  # Q2_K
+    data_start = (len(out) + align - 1) // align * align
+    out += b"\0" * (data_start - len(out)) + raw
+    with open(path, "wb") as f:
+        f.write(out)
