@@ -580,15 +580,22 @@ class Engine:
         return fn
 
     def _get_spec_block(self):
-        """Speculative greedy block: n_draft draft-model steps propose a
-        token window, one target decode_chunk verifies all of them, and an
-        accept-scan (with penalties/bias, matching the plain greedy block's
-        sampling exactly) emits the longest agreeing prefix plus the target's
-        own next token. Generates 1..n_draft+1 tokens per dispatch.
+        """Speculative block with stochastic verify: n_draft draft-model
+        steps SAMPLE a token window from the draft's processed distribution
+        q, one target decode_chunk scores it, and an accept-scan applies the
+        canonical speculative-sampling test — accept draft token x with
+        probability min(1, p(x)/q(x)), on rejection resample from
+        normalize(max(p - q, 0)), and append one bonus sample from p when
+        the whole window survives. Unbiased for ANY q, so temperature>0
+        requests (llama.cpp's stochastic speculative sampling) keep the
+        draft speedup; temperature==0 degenerates to exact greedy (q and p
+        become one-hots and the test reduces to argmax agreement).
 
-        Device-state contract matches the normal blocks: everything stays
-        resident; only the token window [B, k+1] and accepted counts [B]
-        come back to the host.
+        p and q both come from ops/sampling.processed_logprobs — the same
+        penalties/bias/filter/temperature chain the plain blocks sample
+        from, which is what makes the verify exact. Generates 1..n_draft+1
+        tokens per dispatch; device-state contract matches the normal
+        blocks.
         """
         fn = self._block_cache.get(("spec",))
         if fn is not None:
@@ -596,69 +603,99 @@ class Engine:
         cfg, dcfg = self.cfg, self.draft_cfg
         B, S, V = self.ecfg.max_slots, self.ecfg.max_seq, self.cfg.vocab_size
         k = self.n_draft
-        from localai_tpu.ops.sampling import apply_penalties
+        from localai_tpu.ops.sampling import processed_logprobs, update_counts
 
-        def spec(params, dparams, cache, dcache, counts, bias, tokens, positions, pack):
+        def spec(params, dparams, cache, dcache, counts, rngs, bias,
+                 tokens, positions, pack):
             active = pack[0] > 0
-            act_i32 = active.astype(jnp.int32)
             samp = SamplingParams(
                 temperature=pack[1], top_k=pack[2].astype(jnp.int32),
                 top_p=pack[3], min_p=pack[4], repeat_penalty=pack[5],
                 presence_penalty=pack[6], frequency_penalty=pack[7],
             )
+            counts0 = counts  # round-start counts condition the draft's q
 
-            # 1. Draft proposes k tokens greedily. k+1 steps run so the LAST
-            # proposal's kv is also in the draft cache — on a fully-accepted
-            # window the next round continues from position pos+k+1, which
-            # must see d_k's kv row (the extra step's own proposal is
-            # discarded).
+            # 1. Draft samples k proposals from its own processed
+            # distribution.
             def dstep(carry, i):
-                cur, dcache = carry
+                cur, dcache, rngs = carry
                 pos_i = jnp.minimum(positions + i, S - 1)
                 logits, dcache = llama.decode_step(dcfg, dparams, cur, pos_i, dcache)
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                return (nxt, dcache), nxt
+                ql = processed_logprobs(logits, samp, counts0, bias)  # [B, V]
+                split = jax.vmap(lambda kk: jax.random.split(kk, 2))(rngs)
+                rngs, draw = split[:, 0], split[:, 1]
+                nxt = jax.vmap(jax.random.categorical)(draw, ql).astype(jnp.int32)
+                return (nxt, dcache, rngs), (nxt, ql)
 
-            (_, dcache), drafts = jax.lax.scan(
-                dstep, (tokens, dcache), jnp.arange(k + 1)
+            (last, dcache, rngs), (drafts, qlogs) = jax.lax.scan(
+                dstep, (tokens, dcache, rngs), jnp.arange(k)
+            )  # drafts [k, B]; qlogs [k, B, V]
+            # One more KV-only step so a fully-accepted window's next round
+            # (position pos+k+1) sees the last proposal's kv row; its logits
+            # and proposal are irrelevant, so no sampling work here.
+            _, dcache = llama.decode_step(
+                dcfg, dparams, last, jnp.minimum(positions + k, S - 1), dcache
             )
-            drafts = drafts[:k]  # [k, B]
 
-            # 2. Target verifies the whole window in one chunked decode.
+            # 2. Target scores the whole window in one chunked decode.
             chunk = jnp.concatenate([tokens[:, None], drafts.T], axis=1)  # [B, k+1]
             pos_chunk = jnp.minimum(positions[:, None] + jnp.arange(k + 1)[None, :], S - 1)
             logits_all, cache = llama.decode_chunk(cfg, params, chunk, pos_chunk, cache)
 
-            # 3. Accept-scan: greedy with penalties, counts updated token by
-            # token so repeat/presence/frequency semantics match the plain
-            # greedy block exactly.
+            # 3. Accept-scan with counts updated token by token, so
+            # repeat/presence/frequency semantics match the plain blocks.
             def vstep(carry, t):
-                counts, still, cur_tok = carry
+                counts, still, cur_tok, rngs = carry
                 lt = jax.lax.dynamic_index_in_dim(
                     logits_all, t, axis=1, keepdims=False
-                ).astype(jnp.float32)  # [B, V]
-                lt = apply_penalties(lt, counts, samp) + bias
-                g = jnp.argmax(lt, axis=-1).astype(jnp.int32)
-                emit = still & active
-                counts = counts.at[jnp.arange(B), g].add(emit.astype(jnp.int32) * act_i32)
-                cur_tok = jnp.where(emit, g, cur_tok)
-                nxt_draft = jax.lax.dynamic_index_in_dim(
-                    chunk, jnp.minimum(t + 1, k), axis=1, keepdims=False
-                )
-                still = still & (t < k) & (g == nxt_draft)
-                return (counts, still, cur_tok), jnp.where(emit, g, -1)
+                )  # [B, V]
+                pl = processed_logprobs(lt, samp, counts, bias)
+                split = jax.vmap(lambda kk: jax.random.split(kk, 3))(rngs)
+                rngs, k_u, k_res = split[:, 0], split[:, 1], split[:, 2]
 
-            (counts, _, cur_tok), toks_out = jax.lax.scan(
+                x = jax.lax.dynamic_index_in_dim(
+                    chunk, jnp.minimum(t + 1, k), axis=1, keepdims=False
+                )  # draft token under test (valid for t < k)
+                ql = jax.lax.dynamic_index_in_dim(
+                    qlogs, jnp.minimum(t, k - 1), axis=0, keepdims=False
+                )
+                idx = jnp.arange(B)
+                ratio = pl[idx, x] - ql[idx, x]
+                u = jax.vmap(lambda kk: jax.random.uniform(kk))(k_u)
+                accepted = jnp.log(jnp.maximum(u, 1e-38)) < ratio
+
+                # rejection draw: normalize(max(p - q, 0)); exact-match rows
+                # (residual mass ~0) fall back to p itself
+                res = jnp.maximum(jnp.exp(pl) - jnp.exp(ql), 0.0)
+                res_mass = res.sum(axis=-1, keepdims=True)
+                res_log = jnp.where(
+                    res_mass > 1e-9,
+                    jnp.log(res / jnp.maximum(res_mass, 1e-9) + 1e-38),
+                    pl,
+                )
+                is_bonus = t >= k  # past the window: sample from p directly
+                draw_log = jnp.where(is_bonus, pl, res_log)
+                y = jax.vmap(jax.random.categorical)(k_res, draw_log).astype(jnp.int32)
+
+                take_draft = accepted & ~is_bonus
+                emit_tok = jnp.where(take_draft, x, y)
+                emit = still & active
+                counts = update_counts(counts, emit_tok, emit)
+                cur_tok = jnp.where(emit, emit_tok, cur_tok)
+                still = still & take_draft  # reject or bonus ends the window
+                return (counts, still, cur_tok, rngs), jnp.where(emit, emit_tok, -1)
+
+            (counts, _, cur_tok, rngs), toks_out = jax.lax.scan(
                 vstep,
-                (counts, jnp.ones((B,), bool), tokens),
+                (counts, jnp.ones((B,), bool), tokens, rngs),
                 jnp.arange(k + 1),
             )  # toks_out [k+1, B], -1 where not emitted
             acc = jnp.sum((toks_out >= 0).astype(jnp.int32), axis=0)  # [B]
             new_tokens = jnp.where(active, cur_tok, tokens)
             new_positions = jnp.minimum(positions + acc, S - 1)
-            return cache, dcache, counts, new_tokens, new_positions, toks_out, acc
+            return cache, dcache, counts, rngs, new_tokens, new_positions, toks_out, acc
 
-        fn = jax.jit(spec, donate_argnums=(2, 3, 4, 6, 7))
+        fn = jax.jit(spec, donate_argnums=(2, 3, 4, 5, 7, 8))
         self._block_cache[("spec",)] = fn
         return fn
 
@@ -1171,10 +1208,12 @@ class Engine:
             n = self._pick_block_size()
 
         with_lp = self._lp_active()
+        # Stochastic verify keeps speculation exact for sampled requests too
+        # (greedy degenerates to the old argmax-agreement test), so every
+        # non-grammar, non-logprobs variant rides the draft model.
         if (
             self.draft_cfg is not None
             and not grammar
-            and variant == "greedy"
             and not with_lp
             and not self.h_override_mask.any()
         ):
@@ -1220,11 +1259,11 @@ class Engine:
             pack[1 + fi] = self.h_sampling[k]
         fn = self._get_spec_block()
         (
-            self.cache, self.d_cache, self.counts, self.d_tokens,
+            self.cache, self.d_cache, self.counts, self.rngs, self.d_tokens,
             self.d_positions, toks_out, acc,
         ) = fn(
             self.params, self.draft_params, self.cache, self.d_cache,
-            self.counts, self.bias, self.d_tokens, self.d_positions,
+            self.counts, self.rngs, self.bias, self.d_tokens, self.d_positions,
             jnp.asarray(pack),
         )
         _host_copy_async(toks_out)

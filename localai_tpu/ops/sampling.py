@@ -195,3 +195,47 @@ def update_counts(counts: jnp.ndarray, tokens: jnp.ndarray, active: jnp.ndarray)
     B = counts.shape[0]
     inc = active.astype(counts.dtype)
     return counts.at[jnp.arange(B), tokens].add(inc)
+
+
+def processed_logprobs(
+    logits: jnp.ndarray,  # [B, V] any float dtype
+    params: SamplingParams,
+    counts: jnp.ndarray | None = None,  # [B, V] i32
+    logit_bias: jnp.ndarray | None = None,  # [B, V] f32
+    num_candidates: int = 64,
+) -> jnp.ndarray:
+    """Full post-chain sampling distribution as log-probs [B, V] f32.
+
+    Exactly the distribution `sample` draws from — penalties, bias, the
+    top-k/top-p/min-p chain over the partial candidate set, temperature, and
+    the temperature==0 greedy degenerate (one-hot). Speculative decoding's
+    stochastic verify (accept w.p. min(1, p/q), resample from max(p-q, 0))
+    needs the *distributions* of both models, and using one shared
+    implementation for p and q is what makes the acceptance test exact.
+    """
+    logits = logits.astype(jnp.float32)
+    if counts is not None:
+        logits = apply_penalties(logits, counts, params)
+    if logit_bias is not None:
+        logits = logits + logit_bias
+    B, V = logits.shape
+
+    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
+    has_filter = (
+        (params.top_k > 0) | (params.top_p < 1.0) | (params.min_p > 0.0)
+    )[:, None]
+
+    K = min(num_candidates, V)
+    sorted_logits, sorted_idx = jax.lax.top_k(logits, K)
+    filtered = _filter_sorted(sorted_logits, params)
+    scattered = jnp.full((B, V), NEG_INF, jnp.float32)
+    scattered = scattered.at[jnp.arange(B)[:, None], sorted_idx].set(filtered)
+
+    eff = jnp.where(has_filter, scattered, logits) / temp
+    # temperature == 0 → degenerate one-hot on the argmax (greedy)
+    greedy_tok = jnp.argmax(logits, axis=-1)
+    onehot = jnp.where(
+        jnp.arange(V)[None, :] == greedy_tok[:, None], 0.0, NEG_INF
+    )
+    eff = jnp.where((params.temperature <= 0.0)[:, None], onehot, eff)
+    return jax.nn.log_softmax(eff, axis=-1)

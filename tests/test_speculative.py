@@ -1,8 +1,11 @@
 """Speculative decoding tests.
 
-Exactness is the contract: speculative greedy output must be byte-identical
-to plain greedy output for any draft model (acceptance only changes speed),
-including with repeat penalties. Reference knobs: draft_model/n_draft
+Exactness is the contract, in two tiers: speculative greedy output must be
+byte-identical to plain greedy output for any draft model (acceptance only
+changes speed), including with repeat penalties; and sampled requests ride
+speculation via stochastic verify (accept w.p. min(1, p/q), resample from
+the residual) whose output distribution is exactly the target's — proven on
+the algebra directly below. Reference knobs: draft_model/n_draft
 (core/config/model_config.go:211-212).
 """
 
@@ -92,7 +95,7 @@ def test_spec_with_repeat_penalty_matches_plain(setup):
 
 def test_spec_concurrent_slots_and_sampled_fallback(setup):
     """Two greedy requests run speculatively together; a sampled request
-    forces the normal block path and still works."""
+    rides speculation too (stochastic verify)."""
     cfg, params, draft_cfg, draft_params = setup
     spec = _mk(cfg, params, draft_cfg=draft_cfg, draft_params=draft_params, n_draft=3)
     try:
@@ -104,10 +107,12 @@ def test_spec_concurrent_slots_and_sampled_fallback(setup):
         # solo runs match
         t1s, _ = spec.generate([10, 11], max_new_tokens=10, ignore_eos=True)
         assert t1 == t1s
-        # sampled request falls back to normal blocks
+        # sampled requests now ride speculation too (stochastic verify)
+        rounds_before = spec.m_spec_rounds
         t3, e3 = spec.generate([30, 31], max_new_tokens=8, ignore_eos=True,
                                temperature=0.8, top_k=20, seed=4)
         assert e3.completion_tokens == 8
+        assert spec.m_spec_rounds > rounds_before
     finally:
         spec.stop()
 
@@ -127,3 +132,71 @@ def test_spec_eos_and_max_tokens(setup):
     finally:
         spec.stop()
         plain.stop()
+
+
+def test_stochastic_verify_recovers_target_distribution():
+    """The accept/resample algebra (accept w.p. min(1, p/q), resample from
+    normalize(max(p - q, 0))) must yield samples distributed exactly as p,
+    for p and q produced by the same processed_logprobs chain the engine
+    uses. Empirical total-variation over 40k draws stays under noise."""
+    import jax.numpy as jnp
+
+    from localai_tpu.ops.sampling import SamplingParams, processed_logprobs
+
+    V = 8
+    rng = np.random.default_rng(0)
+    p_logits = jnp.asarray(rng.standard_normal((1, V)) * 2, jnp.float32)
+    q_logits = jnp.asarray(rng.standard_normal((1, V)) * 2, jnp.float32)
+    params = SamplingParams.make(1, temperature=0.9, top_k=0, top_p=1.0)
+    pl = np.asarray(processed_logprobs(p_logits, params))[0]
+    ql = np.asarray(processed_logprobs(q_logits, params))[0]
+    p, q = np.exp(pl), np.exp(ql)
+
+    n = 40_000
+    xs = rng.choice(V, size=n, p=q / q.sum())
+    us = rng.random(n)
+    accept = us < np.minimum(1.0, p[xs] / np.maximum(q[xs], 1e-12))
+    res = np.maximum(p - q, 0.0)
+    res = res / res.sum()
+    ys = rng.choice(V, size=n, p=res)
+    out = np.where(accept, xs, ys)
+    emp = np.bincount(out, minlength=V) / n
+    tv = 0.5 * np.abs(emp - p / p.sum()).sum()
+    assert tv < 0.02, (tv, emp, p)
+
+
+def test_spec_sampled_seeded_run_is_reproducible(setup):
+    """temperature>0 through the spec path: correct token counts and a
+    fresh engine with the same base seed reproduces the output."""
+    cfg, params, draft_cfg, draft_params = setup
+    outs = []
+    for _ in range(2):
+        eng = _mk(cfg, params, draft_cfg=draft_cfg, draft_params=draft_params,
+                  n_draft=3)
+        try:
+            t, ev = eng.generate([40, 41, 42], max_new_tokens=12,
+                                 ignore_eos=True, temperature=1.0, seed=11)
+            assert ev.completion_tokens == 12
+            assert eng.m_spec_rounds > 0  # speculation engaged while sampling
+            m = eng.metrics()
+            assert 0.0 < m["spec_accept_rate"] <= 1.0
+            outs.append(t)
+        finally:
+            eng.stop()
+    assert outs[0] == outs[1]
+
+
+def test_spec_sampled_filtered_top_k(setup):
+    """top-k filtering under speculation: emitted tokens must respect the
+    filter (every sampled token within the target's top-k set is enforced
+    by construction; here we just prove the path serves and finishes)."""
+    cfg, params, draft_cfg, draft_params = setup
+    eng = _mk(cfg, params, draft_cfg=draft_cfg, draft_params=draft_params,
+              n_draft=3)
+    try:
+        t, ev = eng.generate([50, 51], max_new_tokens=10, ignore_eos=True,
+                             temperature=0.8, top_k=5, top_p=0.9, seed=2)
+        assert ev.completion_tokens == 10
+        assert eng.m_spec_rounds > 0
+    finally:
+        eng.stop()
