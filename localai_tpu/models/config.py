@@ -44,6 +44,9 @@ class ArchConfig:
     # Mixture-of-experts (Mixtral/DeepSeek-style); 0 experts = dense MLP
     num_experts: int = 0
     num_experts_per_token: int = 2
+    # Capacity factor for the expert-parallel (ep>1) GShard dispatch path:
+    # each expert processes at most ceil(top_k·N/E·cf) tokens per block.
+    moe_capacity_factor: float = 2.0
     dtype: str = "bfloat16"
 
     @property

@@ -139,27 +139,127 @@ def _moe_grouped_mm(x: jnp.ndarray, w: dict, sub: str) -> jnp.ndarray:
     raise ValueError(f"unsupported MoE einsum {sub!r} for grouped weights")
 
 
-def _mlp(cfg: ArchConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
-    """SwiGLU MLP; dense or sparse-MoE (Mixtral-style top-k routing).
-
-    x: [..., D]. The MoE branch computes all experts and combines with routing
-    weights — correct and mesh-shardable on the expert axis; the
-    all_to_all dispatch optimization lives in localai_tpu.parallel.
-    """
-    if not cfg.is_moe:
-        gate = jax.nn.silu(matmul(x, lp["w_gate"]))
-        return matmul(gate * matmul(x, lp["w_up"]), lp["w_down"]).astype(x.dtype)
-
-    E, topk = cfg.num_experts, cfg.num_experts_per_token
+def _moe_route(cfg: ArchConfig, lp: Params, x: jnp.ndarray):
+    """Top-k router: returns (softmaxed weights [..., k] f32, sel [..., k])."""
     router_logits = (x @ lp["router"]).astype(jnp.float32)  # [..., E]
-    weights, sel = jax.lax.top_k(router_logits, topk)  # [..., topk]
-    weights = jax.nn.softmax(weights, axis=-1)
+    weights, sel = jax.lax.top_k(router_logits, cfg.num_experts_per_token)
+    return jax.nn.softmax(weights, axis=-1), sel
+
+
+def _moe_dense(cfg: ArchConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """All-experts MoE: every expert runs on every token, outputs combined by
+    routing weight. FLOPs ∝ E, but the only path that works on quantized
+    (int8/int4 grouped) expert weights without materializing a dequantized
+    copy, and trivially shardable over "ep". Decode batches are tiny and
+    weight-HBM-bound (every expert's weights are read regardless), so for
+    quantized decode this is near-optimal anyway."""
+    E = cfg.num_experts
+    weights, sel = _moe_route(cfg, lp, x)
     onehot = jax.nn.one_hot(sel, E, dtype=jnp.float32)  # [..., topk, E]
     combine = jnp.einsum("...te,...t->...e", onehot, weights)
     gate = jax.nn.silu(_moe_mm(x, lp["w_gate"], "...d,edf->...ef"))
     up = _moe_mm(x, lp["w_up"], "...d,edf->...ef")
     expert_out = _moe_mm(gate * up, lp["w_down"], "...ef,efd->...ed")  # [..., E, D]
     return jnp.einsum("...ed,...e->...d", expert_out.astype(jnp.float32), combine).astype(x.dtype)
+
+
+def _moe_ragged(cfg: ArchConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Exact top-k MoE via sort + `lax.ragged_dot`: per-token FLOPs ∝ top_k,
+    not E (4× fewer than dense for Mixtral top-2-of-8).
+
+    The (token, choice) pairs are stably sorted by expert id so each expert's
+    rows are contiguous, then one grouped matmul per projection runs all
+    experts without any capacity factor — no token is ever dropped, so the
+    output is bit-comparable to the dense branch (up to f32 reduction order).
+    The reference gets this for free from llama.cpp's per-expert CPU loops
+    (ggml MoE graph); on TPU ragged_dot maps the grouped contraction onto the
+    MXU with static shapes.
+    """
+    E, k = cfg.num_experts, cfg.num_experts_per_token
+    lead, D = x.shape[:-1], x.shape[-1]
+    xf = x.reshape(-1, D)
+    N = xf.shape[0]
+    weights, sel = _moe_route(cfg, lp, xf)  # [N, k]
+    M = N * k
+    e_flat = sel.reshape(M)
+    order = jnp.argsort(e_flat, stable=True)  # expert-major, token-minor
+    tok = order // k  # source token of each sorted row
+    xg = jnp.take(xf, tok, axis=0)  # [M, D]
+    gs = jnp.bincount(e_flat, length=E)  # rows per expert (sums to M)
+    gate = jax.nn.silu(jax.lax.ragged_dot(xg, lp["w_gate"], gs))
+    up = jax.lax.ragged_dot(xg, lp["w_up"], gs)
+    dn = jax.lax.ragged_dot((gate * up).astype(xg.dtype), lp["w_down"], gs)  # [M, D]
+    wf = jnp.take(weights.reshape(M), order)
+    y = jnp.zeros((N, D), jnp.float32).at[tok].add(dn.astype(jnp.float32) * wf[:, None])
+    return y.reshape(*lead, D).astype(x.dtype)
+
+
+def _moe_capacity(cfg: ArchConfig, lp: Params, x: jnp.ndarray, block: int = 1024) -> jnp.ndarray:
+    """GShard-style capacity-bucketed dispatch for expert-parallel meshes.
+
+    Tokens are chunked into blocks; each block builds one-hot dispatch/combine
+    tensors [Nb, E, C] with C = ceil(k·Nb/E · capacity_factor), so the expert
+    contraction 'ecd,edf->ecf' has a static [E, C, D] operand whose E axis the
+    SPMD partitioner places on the chips holding the "ep"-sharded weights —
+    each chip computes only its local experts' rows and the combine einsum
+    psums the outputs back. Total expert FLOPs ∝ k·cf, not E. Tokens past an
+    expert's capacity are dropped (their routing weight renormalizes over the
+    kept choices; if every choice drops, the residual passes through) — the
+    standard GShard trade; capacity_factor=2 makes drops rare at inference.
+    """
+    E, k = cfg.num_experts, cfg.num_experts_per_token
+    lead, D = x.shape[:-1], x.shape[-1]
+    xf = x.reshape(-1, D)
+    N = xf.shape[0]
+    Nb = min(N, block)
+    nblk = -(-N // Nb)
+    pad = nblk * Nb - N
+    if pad:
+        xf = jnp.concatenate([xf, jnp.zeros((pad, D), xf.dtype)], axis=0)
+    C = max(k, int(-(-k * Nb * cfg.moe_capacity_factor // E)))
+    C = min(C, Nb)
+
+    def blk(xb):  # [Nb, D]
+        w, sel = _moe_route(cfg, lp, xb)  # [Nb, k] f32 / int
+        oh = jax.nn.one_hot(sel, E, dtype=jnp.int32)  # [Nb, k, E]
+        # Position of each (token, choice) in its expert's queue, in
+        # flattened token-major order (earlier tokens win capacity).
+        pos = jnp.cumsum(oh.reshape(Nb * k, E), axis=0).reshape(Nb, k, E) * oh - 1
+        keep = (pos >= 0) & (pos < C)  # [Nb, k, E]
+        slot = jax.nn.one_hot(jnp.where(keep, pos, 0), C, dtype=xb.dtype)
+        slot = slot * keep[..., None].astype(xb.dtype)  # [Nb, k, E, C]
+        disp = slot.sum(axis=1)  # [Nb, E, C] 0/1
+        kept_k = keep.sum(axis=-1).astype(jnp.float32)  # [Nb, k] 0/1
+        denom = jnp.maximum((w * kept_k).sum(axis=-1, keepdims=True), 1e-9)
+        wr = w * kept_k / denom  # renormalized over kept choices
+        comb = jnp.einsum("nk,nkec->nec", wr, slot.astype(jnp.float32))
+        xe = jnp.einsum("nec,nd->ecd", disp, xb)  # [E, C, D]
+        gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, lp["w_gate"]))
+        up = jnp.einsum("ecd,edf->ecf", xe, lp["w_up"])
+        dn = jnp.einsum("ecf,efd->ecd", gate * up, lp["w_down"])
+        return jnp.einsum("nec,ecd->nd", comb, dn.astype(jnp.float32))
+
+    y = jax.lax.map(blk, xf.reshape(nblk, Nb, D)).reshape(nblk * Nb, D)[:N]
+    return y.reshape(*lead, D).astype(x.dtype)
+
+
+def _mlp(cfg: ArchConfig, lp: Params, x: jnp.ndarray, ep: int = 1) -> jnp.ndarray:
+    """SwiGLU MLP; dense or sparse-MoE (Mixtral-style top-k routing).
+
+    x: [..., D]. MoE picks its implementation statically:
+    - quantized expert weights → dense all-experts (the grouped-int kernels
+      in models/quant.py only exist for the dense einsum shapes);
+    - ep > 1 → GShard capacity dispatch (shards over the "ep" mesh axis);
+    - otherwise → exact sort+ragged_dot top-k (FLOPs ∝ top_k).
+    """
+    if not cfg.is_moe:
+        gate = jax.nn.silu(matmul(x, lp["w_gate"]))
+        return matmul(gate * matmul(x, lp["w_up"]), lp["w_down"]).astype(x.dtype)
+    if isinstance(lp["w_gate"], dict):
+        return _moe_dense(cfg, lp, x)
+    if ep > 1:
+        return _moe_capacity(cfg, lp, x)
+    return _moe_ragged(cfg, lp, x)
 
 
 def _attn_proj_qkv(cfg: ArchConfig, lp: Params, x: jnp.ndarray):
@@ -194,6 +294,7 @@ def _forward_hidden(
     collect_kv: bool,
     mesh=None,  # jax.sharding.Mesh with an "sp" axis > 1 → ring attention
     inject=None,  # (embeds [B, N, D], offsets [B]) — VLM image features
+    ep: int = 1,  # expert-parallel degree (MoE implementation choice)
 ):
     """Shared full-sequence forward. Returns (h [B,S,D] after final norm,
     length_mask [B,S], (ks, vs) or None). Single source of truth for the layer
@@ -236,7 +337,7 @@ def _forward_hidden(
             attn = prefill_attention(q, k, v, length_mask, lengths)
         h = h + matmul(attn.reshape(B, S, -1), lp["wo"])
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-        h = h + _mlp(cfg, lp, x)
+        h = h + _mlp(cfg, lp, x, ep)
         return h, ((k, v) if collect_kv else None)
 
     h, kv = jax.lax.scan(layer, h, params["layers"])
@@ -251,10 +352,11 @@ def prefill(
     lengths: jnp.ndarray,  # [B] int32 valid lengths
     mesh=None,  # Mesh with sp>1 → ring attention (sequence parallel)
     inject=None,  # (embeds [B, N, D], offsets [B]) — VLM image features
+    ep: int = 1,
 ):
     """Prompt processing. Returns (last_logits [B, V] f32, k [L,B,S,K,Hd], v)."""
     h, _, (ks, vs) = _forward_hidden(
-        cfg, params, tokens, lengths, collect_kv=True, mesh=mesh, inject=inject
+        cfg, params, tokens, lengths, collect_kv=True, mesh=mesh, inject=inject, ep=ep
     )
     last_idx = jnp.maximum(lengths - 1, 0)  # empty prompt reads position 0, not wrap to S-1
     last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]  # [B, D]
@@ -268,6 +370,7 @@ def encode(
     tokens: jnp.ndarray,  # [B, S] int32, right-padded
     lengths: jnp.ndarray,  # [B] int32
     mesh=None,
+    ep: int = 1,
 ) -> jnp.ndarray:
     """Sentence embedding: masked mean-pool of final hidden states, L2-normed.
 
@@ -275,7 +378,7 @@ def encode(
     Embedding; backend/python/transformers SentenceTransformer branch) from the
     same decoder weights.
     """
-    h, length_mask, _ = _forward_hidden(cfg, params, tokens, lengths, collect_kv=False, mesh=mesh)
+    h, length_mask, _ = _forward_hidden(cfg, params, tokens, lengths, collect_kv=False, mesh=mesh, ep=ep)
     h = h.astype(jnp.float32)
     mask = length_mask[..., None].astype(jnp.float32)
     pooled = (h * mask).sum(axis=1) / jnp.maximum(mask.sum(axis=1), 1.0)
@@ -289,12 +392,13 @@ def sequence_logprob(
     lengths: jnp.ndarray,  # [B] int32 total valid length
     cond_lengths: jnp.ndarray,  # [B] int32 — score only positions >= cond_len
     mesh=None,
+    ep: int = 1,
 ) -> jnp.ndarray:
     """Mean log P(tokens[cond_len:len] | tokens[:cond_len]) per row — the
     scoring primitive behind reranking (reference capability: core/backend/
     rerank.go RPC to a cross-encoder; here relevance is measured as the
     document's conditional likelihood under the LLM given the query)."""
-    h, _, _ = _forward_hidden(cfg, params, tokens, lengths, collect_kv=False, mesh=mesh)
+    h, _, _ = _forward_hidden(cfg, params, tokens, lengths, collect_kv=False, mesh=mesh, ep=ep)
     logits = _unembed(cfg, params, h[:, :-1])  # [B, S-1, V] predicts tokens[1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     tgt = tokens[:, 1:]  # [B, S-1]
@@ -311,6 +415,8 @@ def decode_step(
     tokens: jnp.ndarray,  # [B] int32 current token per slot
     positions: jnp.ndarray,  # [B] int32 position of `tokens` in each sequence
     cache: KVCache,
+    ep: int = 1,
+    mesh=None,  # Mesh with sp>1 → the cache's sequence axis is sp-sharded
 ):
     """One decode step for the whole slot batch.
 
@@ -327,6 +433,7 @@ def decode_step(
     writes all L rows into the stacked cache in place.
     """
     B = tokens.shape[0]
+    use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
     inv_freq = rope_frequencies(cfg)
     h = params["embed"][tokens]  # [B, D]
     batch_idx = jnp.arange(B)
@@ -337,10 +444,15 @@ def decode_step(
         q, k, v = _attn_proj_qkv(cfg, lp, x)  # q [B,H,Hd], k/v [B,K,Hd]
         q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
-        attn = decode_attention_appended(q, kc, vc, k, v, positions)
+        if use_sp:
+            from localai_tpu.ops.attention import decode_attention_appended_sp
+
+            attn = decode_attention_appended_sp(q, kc, vc, k, v, positions, mesh)
+        else:
+            attn = decode_attention_appended(q, kc, vc, k, v, positions)
         h = h + matmul(attn.reshape(B, -1), lp["wo"])
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-        h = h + _mlp(cfg, lp, x)
+        h = h + _mlp(cfg, lp, x, ep)
         return h, (k, v)
 
     h, (new_k, new_v) = jax.lax.scan(layer, h, (params["layers"], cache.k, cache.v))
@@ -361,6 +473,8 @@ def decode_step_windowed(
     local_k: jnp.ndarray,  # [L, B, n, K, Hd] — block-local KV window
     local_v: jnp.ndarray,
     step: jnp.ndarray,  # scalar index within the block
+    ep: int = 1,
+    mesh=None,  # Mesh with sp>1 → the cache's sequence axis is sp-sharded
 ):
     """One step of a fused decode block with a block-local KV window.
 
@@ -369,6 +483,7 @@ def decode_step_windowed(
     the cache once per block. Returns (logits [B, V] f32, local_k, local_v).
     """
     B = tokens.shape[0]
+    use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
     inv_freq = rope_frequencies(cfg)
     h = params["embed"][tokens]
 
@@ -378,12 +493,19 @@ def decode_step_windowed(
         q, k, v = _attn_proj_qkv(cfg, lp, x)
         q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
-        attn = decode_attention_windowed(
-            q, kc, vc, lk, lv, k, v, positions, step
-        )
+        if use_sp:
+            from localai_tpu.ops.attention import decode_attention_windowed_sp
+
+            attn = decode_attention_windowed_sp(
+                q, kc, vc, lk, lv, k, v, positions, step, mesh
+            )
+        else:
+            attn = decode_attention_windowed(
+                q, kc, vc, lk, lv, k, v, positions, step
+            )
         h = h + matmul(attn.reshape(B, -1), lp["wo"])
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-        h = h + _mlp(cfg, lp, x)
+        h = h + _mlp(cfg, lp, x, ep)
         return h, (k, v)
 
     h, (new_k, new_v) = jax.lax.scan(
@@ -422,6 +544,7 @@ def decode_chunk(
     tokens: jnp.ndarray,  # [B, T] — T new tokens per slot (draft window)
     positions: jnp.ndarray,  # [B, T] int32 — their positions (contiguous per slot)
     cache: KVCache,
+    ep: int = 1,
 ):
     """Multi-token decode: write T new k/v per slot and return logits for all
     T positions — the verify pass of speculative decoding (the reference
@@ -462,7 +585,7 @@ def decode_chunk(
         attn = attn.reshape(B, T, -1).astype(h.dtype)
         h = h + matmul(attn, lp["wo"])
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-        h = h + _mlp(cfg, lp, x)
+        h = h + _mlp(cfg, lp, x, ep)
         return h, (k, v)
 
     h, (new_k, new_v) = jax.lax.scan(layer, h, (params["layers"], cache.k, cache.v))
@@ -471,6 +594,67 @@ def decode_chunk(
     h = rms_norm(h, params["final_norm"], cfg.rms_eps)
     logits = _unembed(cfg, params, h)  # [B, T, V]
     return logits, KVCache(k=k, v=v)
+
+
+def prefill_tail(
+    cfg: ArchConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [B, T] int32 tail tokens, right-padded
+    lengths: jnp.ndarray,  # [B] int32 valid tail lengths
+    offsets: jnp.ndarray,  # [B] int32 cached-prefix lengths (tail starts here)
+    prefix_k: jnp.ndarray,  # [L, B, P, K, Hd] cached prefix KV; rows >= offsets[b] ignored
+    prefix_v: jnp.ndarray,
+    ep: int = 1,
+):
+    """Prefill a prompt *tail* against cached prefix KV — the compute half of
+    the prompt/prefix cache (reference: `cache_prompt`,
+    backend/cpp/llama-cpp/grpc-server.cpp:125; `prompt_cache_path`,
+    core/config/model_config.go:185-187). Token t of the tail attends to the
+    prefix rows [0, offsets) plus the tail causally; RoPE positions are offset
+    by the prefix length so the result is identical to prefilling the whole
+    prompt. Returns (last_logits [B, V] f32, tail_ks [L, B, T, K, Hd],
+    tail_vs) — the engine writes the tail rows after the cached span.
+    """
+    B, T = tokens.shape
+    P = prefix_k.shape[2]
+    inv_freq = rope_frequencies(cfg)
+    positions = offsets[:, None] + jnp.arange(T)[None, :]  # [B, T] global
+    length_mask = jnp.arange(T)[None, :] < lengths[:, None]
+    h = params["embed"][tokens]  # [B, T, D]
+    scale = cfg.head_dim_**-0.5
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    pvalid = jnp.arange(P)[None, :] < offsets[:, None]  # [B, P]
+
+    def layer(h, xs):
+        lp, kc, vc = xs  # kc/vc [B, P, K, Hd]
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _attn_proj_qkv(cfg, lp, x)  # q [B,T,H,Hd], k/v [B,T,K,Hd]
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        K_h = kc.shape[2]
+        G = q.shape[2] // K_h
+        qf = (q.astype(jnp.float32) * scale).reshape(B, T, K_h, G, cfg.head_dim_)
+        sc = jnp.einsum("btkgd,bskd->bkgts", qf, kc.astype(jnp.float32))
+        sc = jnp.where(pvalid[:, None, None, None], sc, -1e30)
+        sw = jnp.einsum("btkgd,bukd->bkgtu", qf, k.astype(jnp.float32))
+        wmask = causal[None, None, None] & length_mask[:, None, None, None, :]
+        sw = jnp.where(wmask, sw, -1e30)
+        probs = jax.nn.softmax(jnp.concatenate([sc, sw], axis=-1), axis=-1)
+        attn = jnp.einsum(
+            "bkgts,bskd->btkgd", probs[..., :P], vc.astype(jnp.float32)
+        ) + jnp.einsum("bkgtu,bukd->btkgd", probs[..., P:], v.astype(jnp.float32))
+        attn = attn.reshape(B, T, -1).astype(h.dtype)
+        h = h + matmul(attn, lp["wo"])
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
+        h = h + _mlp(cfg, lp, x, ep)
+        return h, (k, v)
+
+    h, (ks, vs) = jax.lax.scan(layer, h, (params["layers"], prefix_k, prefix_v))
+    h = rms_norm(h, params["final_norm"], cfg.rms_eps)
+    last_idx = jnp.maximum(lengths - 1, 0)
+    last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]  # [B, D]
+    logits = _unembed(cfg, params, last)
+    return logits, ks, vs
 
 
 def write_prefill_to_cache(
