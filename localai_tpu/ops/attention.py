@@ -156,6 +156,140 @@ def decode_attention_windowed(
     return out.reshape(B, H, D).astype(q.dtype)
 
 
+def _sp_cache_partials(q, k_cache, v_cache, limits, mesh):
+    """Online-softmax partial attention over an "sp"-sharded cache.
+
+    The KV cache's sequence axis is sharded over the mesh's "sp" axis (see
+    parallel/sharding.py cache_specs), so each chip holds S/sp rows and HBM
+    residency — the serving-side half of the long-context story whose compute
+    half is ring prefill (parallel/ring.py). Each shard computes its local
+    (max, sum-exp, weighted-acc) over rows with global index < limits[b] and
+    the three small partials combine with one pmax + two psums over "sp" —
+    flash-decoding across chips, riding ICI.
+
+    q: [B, H, D]; k/v_cache: [B, S, K, D] (S sp-sharded); limits: [B] row
+    bound per slot. Returns (acc [B, K, G, D], m [B, K, G, 1], l [B, K, G, 1])
+    replicated over sp, f32, with the 1/sqrt(D) scale already applied to q.
+    """
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    B, H, D = q.shape
+    K = k_cache.shape[2]
+    scale = 1.0 / (D**0.5)
+
+    def local(qb, kc, vc, lim):
+        Bl, Hl, D_ = qb.shape
+        Kl = kc.shape[2]
+        G = Hl // Kl
+        S_l = kc.shape[1]
+        my = jax.lax.axis_index("sp")
+        gpos = my * S_l + jnp.arange(S_l)  # global row indices of this shard
+        qf = (qb.astype(jnp.float32) * scale).reshape(Bl, Kl, G, D_)
+        sc = jnp.einsum("bkgd,bskd->bkgs", qf, kc.astype(jnp.float32))
+        valid = gpos[None, :] < lim[:, None]
+        sc = jnp.where(valid[:, None, None, :], sc, NEG_INF)
+        m = jnp.max(sc, axis=-1, keepdims=True)
+        p = jnp.exp(sc - m)  # exp(NEG_INF - NEG_INF) rows zeroed by valid below
+        p = jnp.where(valid[:, None, None, :], p, 0.0)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        acc = jnp.einsum("bkgs,bskd->bkgd", p, vc.astype(jnp.float32))
+        m_g = jax.lax.pmax(m, "sp")
+        alpha = jnp.exp(jnp.maximum(m - m_g, -80.0))  # -inf - -inf guard
+        alpha = jnp.where(l > 0, alpha, 0.0)
+        l_g = jax.lax.psum(l * alpha, "sp")
+        acc_g = jax.lax.psum(acc * alpha, "sp")
+        return acc_g, m_g, l_g
+
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P("dp", "tp", None),
+            P("dp", "sp", "tp", None),
+            P("dp", "sp", "tp", None),
+            P("dp"),
+        ),
+        out_specs=(
+            P("dp", "tp", None, None),
+            P("dp", "tp", None, None),
+            P("dp", "tp", None, None),
+        ),
+        check_vma=False,
+    )
+    return fn(q, k_cache, v_cache, limits)
+
+
+def _merge_partials(q, acc_g, m_g, l_g, extra_k, extra_v, extra_mask):
+    """Merge sharded-cache partials with a small dense tail (local window
+    and/or the current token). extra_k: [B, E, K, D]; extra_mask: [B, E] or
+    [E]. Returns [B, H, D] in q's dtype."""
+    B, H, D = q.shape
+    K = extra_k.shape[2]
+    G = H // K
+    scale = 1.0 / (D**0.5)
+    qf = (q.astype(jnp.float32) * scale).reshape(B, K, G, D)
+    se = jnp.einsum("bkgd,bekd->bkge", qf, extra_k.astype(jnp.float32))
+    if extra_mask.ndim == 1:
+        extra_mask = extra_mask[None, :]
+    se = jnp.where(extra_mask[:, None, None, :], se, NEG_INF)
+    m_e = jnp.max(se, axis=-1, keepdims=True)
+    m_tot = jnp.maximum(m_g, m_e)
+    p_e = jnp.exp(se - m_tot)
+    p_e = jnp.where(extra_mask[:, None, None, :], p_e, 0.0)
+    w_c = jnp.exp(jnp.maximum(m_g - m_tot, -80.0))
+    w_c = jnp.where(l_g > 0, w_c, 0.0)
+    num = acc_g * w_c + jnp.einsum("bkge,bekd->bkgd", p_e, extra_v.astype(jnp.float32))
+    den = l_g * w_c + jnp.sum(p_e, axis=-1, keepdims=True)
+    out = num / jnp.maximum(den, 1e-30)
+    return out.reshape(B, H, D).astype(q.dtype)
+
+
+def decode_attention_appended_sp(
+    q: jnp.ndarray,  # [B, H, D]
+    k_cache: jnp.ndarray,  # [B, S, K, D] — sequence axis sharded over "sp"
+    v_cache: jnp.ndarray,
+    k_new: jnp.ndarray,  # [B, K, D]
+    v_new: jnp.ndarray,
+    positions: jnp.ndarray,  # [B]
+    mesh,
+) -> jnp.ndarray:
+    """`decode_attention_appended` for an sp-sharded cache (see
+    _sp_cache_partials). The current token is merged host-of-shard-map side
+    since it is replicated over sp."""
+    acc_g, m_g, l_g = _sp_cache_partials(q, k_cache, v_cache, positions, mesh)
+    ones = jnp.ones((q.shape[0], 1), bool)
+    return _merge_partials(q, acc_g, m_g, l_g, k_new[:, None], v_new[:, None], ones)
+
+
+def decode_attention_windowed_sp(
+    q: jnp.ndarray,  # [B, H, D]
+    k_cache: jnp.ndarray,  # [B, S, K, D] — sequence axis sharded over "sp"
+    v_cache: jnp.ndarray,
+    k_local: jnp.ndarray,  # [B, n, K, D] block-local window (replicated)
+    v_local: jnp.ndarray,
+    k_new: jnp.ndarray,  # [B, K, D]
+    v_new: jnp.ndarray,
+    positions: jnp.ndarray,  # [B]
+    step: jnp.ndarray,  # scalar
+    mesh,
+) -> jnp.ndarray:
+    """`decode_attention_windowed` for an sp-sharded cache: sharded partials
+    over cache[0:block_start], dense merge of the block-local window and the
+    current token (both tiny and replicated)."""
+    n = k_local.shape[1]
+    acc_g, m_g, l_g = _sp_cache_partials(
+        q, k_cache, v_cache, positions - step, mesh
+    )
+    ek = jnp.concatenate([k_local, k_new[:, None]], axis=1)  # [B, n+1, K, D]
+    ev = jnp.concatenate([v_local, v_new[:, None]], axis=1)
+    mask = jnp.concatenate(
+        [jnp.arange(n) < step, jnp.ones((1,), bool)], axis=0
+    )  # [n+1] — same for every slot
+    return _merge_partials(q, acc_g, m_g, l_g, ek, ev, mask)
+
+
 def decode_attention(
     q: jnp.ndarray,  # [B, H, D] query for the single new token per slot
     k_cache: jnp.ndarray,  # [B, S_max, K, D]

@@ -115,14 +115,18 @@ def param_shardings_for(cfg: ArchConfig, mesh: Mesh, params: Params) -> Params:
     )
 
 
-def cache_specs() -> tuple[P, P]:
-    # [L, B_slots, S_max, K, Hd]: slots over dp, kv heads over tp.
-    spec = P(None, "dp", None, "tp", None)
+def cache_specs(sp: int = 1) -> tuple[P, P]:
+    # [L, B_slots, S_max, K, Hd]: slots over dp, kv heads over tp. With sp>1
+    # the sequence axis shards over "sp" so per-chip KV residency is S/sp —
+    # the serving-side guarantee behind ring prefill (parallel/ring.py) and
+    # sp decode attention (ops/attention.py decode_attention_*_sp): servable
+    # context scales with the sp degree, not just prefill compute.
+    spec = P(None, "dp", "sp" if sp > 1 else None, "tp", None)
     return spec, spec
 
 
-def cache_shardings(mesh: Mesh) -> tuple[NamedSharding, NamedSharding]:
-    ks, vs = cache_specs()
+def cache_shardings(mesh: Mesh, sp: int = 1) -> tuple[NamedSharding, NamedSharding]:
+    ks, vs = cache_specs(sp)
     return NamedSharding(mesh, ks), NamedSharding(mesh, vs)
 
 
