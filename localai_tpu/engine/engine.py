@@ -104,6 +104,19 @@ class EngineConfig:
     block_sizes: tuple[int, ...] = (16, 4, 1)
     # Decode blocks kept in flight while the host processes earlier results.
     pipeline_depth: int = 3
+    # Prompt/prefix KV cache (reference: cache_prompt, grpc-server.cpp:125):
+    # device-resident LRU of prefilled KV spans keyed by token prefixes.
+    # Admissions that share a prefix (system prompts, multi-turn chat) copy
+    # the cached span and prefill only the tail. 0 disables.
+    prefix_cache_entries: int = 8
+    # Minimum matched/saved prefix length in tokens — shorter prefixes are
+    # cheaper to re-prefill than to manage.
+    prefix_cache_min: int = 32
+    # HBM budget for stored spans. Entry count alone is not a bound: one
+    # max_seq span of an 8B model is ~1 GiB of KV, so 8 entries could eat
+    # half a chip. Eviction honors whichever limit trips first; a span
+    # bigger than the whole budget is simply not saved.
+    prefix_cache_bytes: int = 1 << 30
 
     def buckets(self) -> list[int]:
         out, b = [], self.min_prefill_bucket
@@ -262,6 +275,18 @@ class Engine:
         self.plan = mesh_plan or MeshPlan(dp=1, tp=1)
         validate_plan(cfg, self.plan.tp, self.plan.ep)
         self.mesh = build_mesh(self.plan, devices)
+        if self.plan.sp > 1:
+            ecfg_ = engine_cfg or EngineConfig()
+            if ecfg_.max_seq % self.plan.sp or ecfg_.min_prefill_bucket % self.plan.sp:
+                raise ValueError(
+                    f"max_seq={ecfg_.max_seq} and min_prefill_bucket="
+                    f"{ecfg_.min_prefill_bucket} must divide by sp={self.plan.sp}"
+                )
+            if draft_cfg is not None:
+                raise ValueError(
+                    "speculative decoding with a sequence-sharded KV cache "
+                    "(sp>1) is not supported yet — drop the draft model or sp"
+                )
         # Speculative decoding (reference: draft_model/n_draft,
         # model_config.go:211-212 passed into llama.cpp's batch decode).
         self.draft_cfg = draft_cfg
@@ -289,7 +314,7 @@ class Engine:
                 self.params = jax.jit(
                     lambda p: quantize_params(cfg, p, quantization)
                 )(self.params)
-            kshard, vshard = cache_shardings(self.mesh)
+            kshard, vshard = cache_shardings(self.mesh, self.plan.sp)
             self.cache = llama.KVCache(
                 k=jax.device_put(
                     jnp.zeros((cfg.num_layers, B, S, cfg.num_kv_heads, cfg.head_dim_), jnp.dtype(cfg.dtype)),
@@ -362,6 +387,14 @@ class Engine:
 
         self._block_cache: dict[tuple, Any] = {}
         self._admit_cache: dict[tuple, Any] = {}
+        # Prompt/prefix KV cache: list of dicts (most-recent-first), each
+        # {"key": np.int32[n] tokens, "valid": int rows valid, "pb": bucket,
+        #  "k"/"v": [L, 1, pb, K, Hd] device arrays}. Disabled alongside a
+        # draft model (the draft's KV cache would miss the cached span).
+        self._prefix_entries: list[dict] = []
+        self._snap_cache: dict[int, Any] = {}
+        self.m_prefix_hits = 0
+        self.m_prefix_tokens = 0
         self._build_programs()
 
     # ------------------------------------------------------------------ #
@@ -377,16 +410,17 @@ class Engine:
 
         @partial(jax.jit, static_argnames=())
         def _prefill(params, tokens, lengths):
-            return llama.prefill(cfg, params, tokens, lengths, mesh=ring_mesh)
+            return llama.prefill(cfg, params, tokens, lengths, mesh=ring_mesh, ep=self.plan.ep)
 
         @partial(jax.jit)
         def _embed(params, tokens, lengths):
-            return llama.encode(cfg, params, tokens, lengths, mesh=ring_mesh)
+            return llama.encode(cfg, params, tokens, lengths, mesh=ring_mesh, ep=self.plan.ep)
 
         @partial(jax.jit)
         def _score(params, tokens, lengths, cond_lengths):
             return llama.sequence_logprob(
-                cfg, params, tokens, lengths, cond_lengths, mesh=ring_mesh
+                cfg, params, tokens, lengths, cond_lengths, mesh=ring_mesh,
+                ep=self.plan.ep,
             )
 
         self._prefill_fn = _prefill
@@ -442,7 +476,8 @@ class Engine:
             def body(carry, step):
                 tokens, positions, counts, rngs, lk, lv = carry
                 logits, lk, lv = llama.decode_step_windowed(
-                    cfg, params, tokens, positions, cache, lk, lv, step
+                    cfg, params, tokens, positions, cache, lk, lv, step,
+                    ep=self.plan.ep, mesh=self._ring_mesh,
                 )
                 split = jax.vmap(lambda k: jax.random.split(k, 2))(rngs)
                 rngs, draw = split[:, 0], split[:, 1]
@@ -523,7 +558,8 @@ class Engine:
             )
             inject = (img_embeds, img_offsets) if img_embeds is not None else None
             logits, ks, vs = llama.prefill(
-                cfg, params, prompt_toks, lens, mesh=self._ring_mesh, inject=inject
+                cfg, params, prompt_toks, lens, mesh=self._ring_mesh,
+                inject=inject, ep=self.plan.ep,
             )
             valid = (jnp.arange(bucket)[None, :] < lens[:, None]).astype(jnp.int32)
             rows = jnp.zeros((m, V), jnp.int32)
@@ -568,7 +604,7 @@ class Engine:
                             d_positions, prompt_toks, aux, samp_pack, bias_rows)
                 # Prefill the draft model too so its KV cache matches the
                 # prompt before the first speculative round.
-                _, dks, dvs = llama.prefill(dcfg, dparams, prompt_toks, aux[0])
+                _, dks, dvs = llama.prefill(dcfg, dparams, prompt_toks, aux[0], ep=self.plan.ep)
                 for j in range(m):
                     dcache = llama.write_prefill_to_cache(
                         dcache, dks[:, j:j + 1], dvs[:, j:j + 1], aux[1][j]
@@ -578,6 +614,236 @@ class Engine:
             fn = jax.jit(admit_spec, donate_argnums=(1, 2, 3, 4, 5, 6, 8))
         self._admit_cache[key] = fn
         return fn
+
+    def _get_admit_cached(self, pb: int, tb: int, has_bias: bool,
+                          with_topk: bool, with_lp: bool):
+        """Cached admission: copy a stored prefix KV span into the slot and
+        prefill only the prompt tail (models/llama.py prefill_tail) — the
+        prompt cache fast path (reference: cache_prompt, grpc-server.cpp:125).
+        Always m=1. `aux` is [4] i32 (tail_len, slot, seed, prefix_len);
+        penalty counts for the full prompt arrive precomputed as `count_row`
+        [1, V] i32 because the prefix tokens never reach the device."""
+        key = ("cached", pb, tb, has_bias, with_topk, with_lp)
+        fn = self._admit_cache.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        V = cfg.vocab_size
+        K = min(self.GRAMMAR_TOPK, V)
+        LK = min(self.LOGPROB_TOPK, V)
+        tok_v = min(getattr(self.tokenizer, "vocab_size", V) or V, V)
+
+        def admit_cached(params, cache, counts, rngs, bias, d_tokens,
+                         d_positions, pk, pv, tail_toks, count_row, aux,
+                         samp_pack, bias_rows):
+            tail_len, slot, seed, plen = aux[0], aux[1], aux[2], aux[3]
+            samp = SamplingParams(
+                temperature=samp_pack[0], top_k=samp_pack[1].astype(jnp.int32),
+                top_p=samp_pack[2], min_p=samp_pack[3], repeat_penalty=samp_pack[4],
+                presence_penalty=samp_pack[5], frequency_penalty=samp_pack[6],
+            )
+            logits, tks, tvs = llama.prefill_tail(
+                cfg, params, tail_toks, aux[0:1], aux[3:4], pk, pv,
+                ep=self.plan.ep,
+            )
+            rows = count_row  # [1, V] i32 — host-side bincount of the prompt
+            brows = bias_rows if has_bias else jnp.zeros((1, V), jnp.float32)
+            if tok_v < V:
+                from localai_tpu.ops.sampling import NEG_INF
+
+                brows = jnp.where(jnp.arange(V)[None, :] >= tok_v, NEG_INF, brows)
+            keys0 = jax.vmap(jax.random.key)(aux[2:3].astype(jnp.uint32))
+            draws = jax.vmap(lambda kk: jax.random.fold_in(kk, 0))(keys0)
+            toks = sample(logits, draws, samp, rows, brows)  # [1]
+            rows = rows.at[jnp.arange(1), toks].add(1)
+            tk = jax.lax.top_k(logits + brows, K)[1] if with_topk else None
+            lp = None
+            if with_lp:
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32) + brows, axis=-1)
+                lp_vals, lp_ids = jax.lax.top_k(logp, LK)
+                tok_lp = jnp.take_along_axis(logp, toks[:, None], axis=-1)[:, 0]
+                lp = (tok_lp, lp_ids, lp_vals)
+            k = jax.lax.dynamic_update_slice(cache.k, pk.astype(cache.k.dtype),
+                                             (0, slot, 0, 0, 0))
+            v = jax.lax.dynamic_update_slice(cache.v, pv.astype(cache.v.dtype),
+                                             (0, slot, 0, 0, 0))
+            k = jax.lax.dynamic_update_slice(k, tks.astype(k.dtype),
+                                             (0, slot, plen, 0, 0))
+            v = jax.lax.dynamic_update_slice(v, tvs.astype(v.dtype),
+                                             (0, slot, plen, 0, 0))
+            cache = llama.KVCache(k=k, v=v)
+            counts = counts.at[slot].set(rows[0])
+            rngs = rngs.at[slot].set(keys0[0])
+            bias = bias.at[slot].set(brows[0])
+            d_tokens = d_tokens.at[slot].set(toks[0])
+            d_positions = d_positions.at[slot].set(plen + tail_len)
+            return cache, counts, rngs, bias, d_tokens, d_positions, toks, tk, lp
+
+        fn = jax.jit(admit_cached, donate_argnums=(1, 2, 3, 4, 5, 6))
+        self._admit_cache[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------ #
+    # Prompt/prefix KV cache (host side)
+    # ------------------------------------------------------------------ #
+
+    @property
+    def _prefix_enabled(self) -> bool:
+        return self.ecfg.prefix_cache_entries > 0 and self.draft_cfg is None
+
+    def _prefix_find(self, prompt_ids: list[int]):
+        """Longest-common-prefix match against the stored spans. Returns
+        (entry, match_len) or None. A partial match is fine — any prefix of a
+        cached span is valid KV for that prefix (causality)."""
+        if not self._prefix_enabled or len(prompt_ids) < 2:
+            return None
+        prompt = np.asarray(prompt_ids, np.int32)
+        cap = len(prompt_ids) - 1  # always prefill >= 1 tail token for logits
+        best, best_len = None, 0
+        for entry in self._prefix_entries:
+            n = min(entry["valid"], cap, len(entry["key"]))
+            if n <= best_len:
+                continue
+            eq = entry["key"][:n] == prompt[:n]
+            match = n if eq.all() else int(np.argmin(eq))
+            if match > best_len:
+                best, best_len = entry, match
+        if best is None or best_len < max(self.ecfg.prefix_cache_min, 1):
+            return None
+        # The tail must fit between the prefix and the cache end.
+        tb = self._bucket_for(len(prompt_ids) - best_len)
+        if best_len + tb > self.ecfg.max_seq:
+            return None
+        return best, best_len
+
+    def _get_snapshot(self, pb: int):
+        fn = self._snap_cache.get(pb)
+        if fn is None:
+            L = self.cfg.num_layers
+            K, Hd = self.cfg.num_kv_heads, self.cfg.head_dim_
+
+            def snap(cache, slot):
+                k = jax.lax.dynamic_slice(
+                    cache.k, (0, slot, 0, 0, 0), (L, 1, pb, K, Hd))
+                v = jax.lax.dynamic_slice(
+                    cache.v, (0, slot, 0, 0, 0), (L, 1, pb, K, Hd))
+                return k, v
+
+            fn = jax.jit(snap)
+            self._snap_cache[pb] = fn
+        return fn
+
+    def _prefix_save(self, slot_idx: int, key_tokens, valid_len: int) -> None:
+        """Snapshot the slot's KV rows [0:valid_len] under `key_tokens`.
+
+        Called right after an admission dispatch (prompt KV) and at finish
+        (prompt+generated KV — the next chat turn's prefix). Device-to-device
+        slice; never blocks the loop."""
+        if not self._prefix_enabled or valid_len < self.ecfg.prefix_cache_min:
+            return
+        key = np.asarray(key_tokens, np.int32)[:valid_len]
+        # Skip if an existing entry already covers this span; drop entries
+        # this span subsumes.
+        kept = []
+        for e in self._prefix_entries:
+            n = min(len(key), e["valid"])
+            if e["valid"] >= valid_len and (e["key"][:n] == key[:n]).all():
+                return  # covered by a longer (or equal) stored span
+            if e["valid"] <= valid_len and (e["key"][:e["valid"]] == key[:e["valid"]]).all():
+                continue  # subsumed by the new span
+            kept.append(e)
+        pb = self._bucket_for(valid_len)
+        nbytes = self._prefix_span_bytes(pb)
+        if nbytes > self.ecfg.prefix_cache_bytes:
+            self._prefix_entries = kept
+            return
+        k, v = self._get_snapshot(pb)(self.cache, jnp.int32(slot_idx))
+        kept.insert(0, {"key": key, "valid": valid_len, "pb": pb, "k": k, "v": v})
+        del kept[self.ecfg.prefix_cache_entries:]
+        total = 0
+        for idx, e in enumerate(kept):
+            total += self._prefix_span_bytes(e["pb"])
+            if total > self.ecfg.prefix_cache_bytes:
+                del kept[idx:]
+                break
+        self._prefix_entries = kept
+
+    def _prefix_span_bytes(self, pb: int) -> int:
+        """Device bytes of one stored span (k+v) with a pb-row sequence."""
+        cfg = self.cfg
+        return (
+            2 * cfg.num_layers * pb * cfg.num_kv_heads * cfg.head_dim_
+            * jnp.dtype(cfg.dtype).itemsize
+        )
+
+    def _dispatch_admit_cached(self, request: GenRequest, handle: RequestHandle,
+                               slot_idx: int, entry: dict, match_len: int) -> None:
+        """Admission via the prompt cache: ship only the tail tokens."""
+        t0 = time.monotonic()
+        V = self.cfg.vocab_size
+        ids = request.prompt_ids
+        tail = ids[match_len:]
+        tb = self._bucket_for(len(tail))
+        tail_toks = np.zeros((1, tb), np.int32)
+        tail_toks[0, : len(tail)] = tail
+        counts = np.bincount(
+            np.asarray(ids, np.int32), minlength=V
+        )[:V].astype(np.int32)[None]
+        aux = np.zeros((4,), np.int32)
+        aux[0] = len(tail)
+        aux[1] = slot_idx
+        aux[2] = (
+            request.seed & 0x7FFFFFFF if request.seed is not None
+            else int.from_bytes(os.urandom(4), "little") & 0x7FFFFFFF
+        )
+        aux[3] = match_len
+        samp_pack = np.zeros((7, 1), np.float32)
+        for fi, kf in enumerate(_SAMPLING_FIELDS):
+            samp_pack[fi, 0] = getattr(request, kf)
+        has_bias = bool(request.logit_bias)
+        bias_rows = np.zeros((1, V), np.float32)
+        if has_bias:
+            for tid, bval in request.logit_bias.items():
+                if 0 <= int(tid) < V:
+                    bias_rows[0, int(tid)] = bval
+        with_topk = request.grammar is not None
+        with_lp = request.logprobs > 0
+        fn = self._get_admit_cached(entry["pb"], tb, has_bias, with_topk, with_lp)
+        (
+            self.cache, self.counts, self.rngs, self.bias,
+            self.d_tokens, self.d_positions, toks, tk, lp,
+        ) = fn(
+            self.params, self.cache, self.counts, self.rngs, self.bias,
+            self.d_tokens, self.d_positions, entry["k"], entry["v"],
+            jnp.asarray(tail_toks), jnp.asarray(counts), jnp.asarray(aux),
+            jnp.asarray(samp_pack), jnp.asarray(bias_rows),
+        )
+        _host_copy_async(toks)
+        # LRU bump + metrics. Identity scan, not `in`: dict == would compare
+        # the numpy key arrays elementwise (and raises on length mismatch).
+        for idx, e in enumerate(self._prefix_entries):
+            if e is entry:
+                self._prefix_entries.pop(idx)
+                self._prefix_entries.insert(0, entry)
+                break
+        self.m_prefix_hits += 1
+        self.m_prefix_tokens += match_len
+        for kf in _SAMPLING_FIELDS:
+            self.h_sampling[kf][slot_idx] = getattr(request, kf)
+        self._slot_gen[slot_idx] += 1
+        self.slots[slot_idx] = _Slot(
+            request=request, handle=handle, prompt_len=len(ids), scheduled=1,
+            t_submit=t0,
+        )
+        self.h_active[slot_idx] = True
+        self.h_override_mask[slot_idx] = False
+        self._inflight.append(_Entry(
+            kind="admit", toks=toks, tk=tk, lp=lp, gen=list(self._slot_gen),
+            items=[(slot_idx, request, handle, len(ids), t0)],
+        ))
+        # The freshly-assembled prompt span is itself the best prefix for the
+        # next request in the conversation.
+        self._prefix_save(slot_idx, ids, len(ids))
 
     def _get_spec_block(self):
         """Speculative block with stochastic verify: n_draft draft-model
@@ -620,7 +886,7 @@ class Engine:
             def dstep(carry, i):
                 cur, dcache, rngs = carry
                 pos_i = jnp.minimum(positions + i, S - 1)
-                logits, dcache = llama.decode_step(dcfg, dparams, cur, pos_i, dcache)
+                logits, dcache = llama.decode_step(dcfg, dparams, cur, pos_i, dcache, ep=self.plan.ep)
                 ql = processed_logprobs(logits, samp, counts0, bias)  # [B, V]
                 split = jax.vmap(lambda kk: jax.random.split(kk, 2))(rngs)
                 rngs, draw = split[:, 0], split[:, 1]
@@ -634,13 +900,14 @@ class Engine:
             # (position pos+k+1) sees the last proposal's kv row; its logits
             # and proposal are irrelevant, so no sampling work here.
             _, dcache = llama.decode_step(
-                dcfg, dparams, last, jnp.minimum(positions + k, S - 1), dcache
+                dcfg, dparams, last, jnp.minimum(positions + k, S - 1), dcache,
+                ep=self.plan.ep,
             )
 
             # 2. Target scores the whole window in one chunked decode.
             chunk = jnp.concatenate([tokens[:, None], drafts.T], axis=1)  # [B, k+1]
             pos_chunk = jnp.minimum(positions[:, None] + jnp.arange(k + 1)[None, :], S - 1)
-            logits_all, cache = llama.decode_chunk(cfg, params, chunk, pos_chunk, cache)
+            logits_all, cache = llama.decode_chunk(cfg, params, chunk, pos_chunk, cache, ep=self.plan.ep)
 
             # 3. Accept-scan with counts updated token by token, so
             # repeat/presence/frequency semantics match the plain blocks.
@@ -809,6 +1076,10 @@ class Engine:
             "active_slots": float(int(self.h_active.sum())),
             "queue_depth": float(len(self._pending)),
         }
+        if self._prefix_enabled:
+            out["prefix_cache_hits"] = float(self.m_prefix_hits)
+            out["prefix_tokens_reused"] = float(self.m_prefix_tokens)
+            out["prefix_cache_entries"] = float(len(self._prefix_entries))
         if self.draft_cfg is not None:
             out["spec_rounds"] = float(self.m_spec_rounds)
             out["spec_tokens_accepted"] = float(self.m_spec_accepted)
@@ -1035,11 +1306,19 @@ class Engine:
             # different program variants (has_bias / with_topk / with_lp);
             # admit them as singletons so only the (m=1, ...) variants ever
             # compile — those are warmed.
+            prefix_hits: dict[int, tuple] = {}  # id(request) -> (entry, len)
+
             def _special(r: GenRequest) -> bool:
-                return (
-                    bool(r.logit_bias) or r.grammar is not None
-                    or r.logprobs > 0 or r.image_embeds is not None
-                )
+                if (bool(r.logit_bias) or r.grammar is not None
+                        or r.logprobs > 0 or r.image_embeds is not None):
+                    return True
+                # One LCP scan per request per round; hits are handed to
+                # _dispatch_admit rather than re-searched there. A memoized
+                # MISS deliberately re-checks at dispatch: an earlier chunk
+                # in the same round may have just saved the matching span.
+                if self._prefix_enabled and id(r) not in prefix_hits:
+                    prefix_hits[id(r)] = self._prefix_find(r.prompt_ids)
+                return prefix_hits.get(id(r)) is not None
 
             special = [gh for gh in group if _special(gh[0])]
             plain = [gh for gh in group if not _special(gh[0])]
@@ -1056,9 +1335,13 @@ class Engine:
                 idx += m
             for chunk in chunks:
                 try:
-                    self._dispatch_admit(chunk, bucket, [free.pop(0) for _ in chunk])
+                    self._dispatch_admit(
+                        chunk, bucket, [free.pop(0) for _ in chunk],
+                        prefix_hit=prefix_hits.get(id(chunk[0][0])),
+                    )
                     admitted = True
                 except Exception as e:  # noqa: BLE001 — surface to callers, keep serving
+                    log.exception("admission dispatch failed (m=%d)", len(chunk))
                     for request, handle in chunk:
                         handle._q.put(
                             TokenEvent(kind="error", error=f"{type(e).__name__}: {e}")
@@ -1069,9 +1352,24 @@ class Engine:
         chunk: list[tuple[GenRequest, RequestHandle]],
         bucket: int,
         slot_ids: list[int],
+        prefix_hit: tuple | None = None,
     ) -> None:
         m = len(chunk)
         V = self.cfg.vocab_size
+        if m == 1 and chunk[0][0].image_embeds is None:
+            # Without a hit from the admission round, scan here: covers
+            # direct callers (tests, warmup) and round-memoized misses whose
+            # span an earlier chunk this round may have just saved. The scan
+            # is numpy over ≤prefix_cache_entries keys — trivial next to the
+            # dispatch it precedes.
+            hit = prefix_hit if prefix_hit is not None else self._prefix_find(
+                chunk[0][0].prompt_ids
+            )
+            if hit is not None:
+                self._dispatch_admit_cached(
+                    chunk[0][0], chunk[0][1], slot_ids[0], *hit
+                )
+                return
         t0 = time.monotonic()
         prompt_toks = np.zeros((m, bucket), np.int32)
         aux = np.zeros((3, m), np.int32)  # lens, slot ids, seeds
@@ -1156,6 +1454,8 @@ class Engine:
             self.h_active[slot_idx] = True
             self.h_override_mask[slot_idx] = False
             items.append((slot_idx, r, handle, int(aux[0, j]), t0))
+            if r.image_embeds is None:
+                self._prefix_save(slot_idx, r.prompt_ids, int(aux[0, j]))
         self._inflight.append(
             _Entry(kind="admit", toks=toks, tk=tk, lp=lp, gen=list(self._slot_gen), items=items)
         )
@@ -1529,6 +1829,14 @@ class Engine:
     def _finish(self, slot_idx: int, reason: str) -> None:
         slot = self.slots[slot_idx]
         assert slot is not None
+        if self._prefix_enabled and slot.request.image_embeds is None:
+            # Rows for prompt + all but the last generated token are
+            # guaranteed written (a token's KV row lands when it is consumed
+            # as the next step's input).
+            valid = slot.prompt_len + max(0, len(slot.generated) - 1)
+            self._prefix_save(
+                slot_idx, list(slot.request.prompt_ids) + slot.generated, valid
+            )
         now = time.monotonic()
         t_first = slot.t_first or now
         slot.handle._q.put(
