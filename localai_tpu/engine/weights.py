@@ -86,6 +86,7 @@ def load_hf_checkpoint(
     ckpt_dir: str,
     put: Callable[[str, np.ndarray], jnp.ndarray] | None = None,
     quantize: str = "",
+    lora: list[tuple[str, float]] | None = None,
 ) -> Params:
     """Load an HF-format Llama-family checkpoint into the stacked param tree.
 
@@ -96,6 +97,10 @@ def load_hf_checkpoint(
     `quantize="int8"` quantizes the matmul weights ON THE HOST as they are
     read (models/quant.py layout) — the bf16 tree never materializes on
     device, so checkpoints up to ~2x HBM serve from one chip.
+
+    `lora=[(adapter_dir, weight), ...]` merges PEFT adapters into each
+    stacked tensor ON THE HOST before placement/quantization — LoRA and the
+    int8/int4 HBM envelope compose (merge first, then quantize, one pass).
     """
     dt = jnp.dtype(cfg.dtype)
     reader = _ShardReader(ckpt_dir)
@@ -104,6 +109,28 @@ def load_hf_checkpoint(
     if quantize not in ("", "none", None, "int8", "int4"):
         raise ValueError(f"unsupported quantization mode {quantize!r}")
     do_quant = quantize in ("int8", "int4")
+    lora_deltas: dict[str, dict[int, np.ndarray]] = {}
+    for adir, w in lora or []:
+        for our, per_layer in load_lora_deltas(adir, w).items():
+            tgt = lora_deltas.setdefault(our, {})
+            for li, d in per_layer.items():
+                if li >= cfg.num_layers:
+                    raise ValueError(
+                        f"lora delta for {our!r} targets layer {li}, model "
+                        f"has {cfg.num_layers}"
+                    )
+                tgt[li] = tgt[li] + d if li in tgt else d
+
+    def merge_lora(our: str, stacked: np.ndarray) -> np.ndarray:
+        # Per-layer f32 add — never a full-model-shaped f32 buffer.
+        for li, d in lora_deltas.get(our, {}).items():
+            if d.shape != stacked.shape[1:]:
+                raise ValueError(
+                    f"lora delta for {our!r} layer {li} has shape {d.shape}, "
+                    f"model expects {stacked.shape[1:]}"
+                )
+            stacked[li] = (stacked[li].astype(np.float32) + d).astype(stacked.dtype)
+        return stacked
 
     def place(path: str, arr: np.ndarray, can_quant: bool, qaxis: int = -2):
         if do_quant and can_quant:
@@ -146,7 +173,7 @@ def load_hf_checkpoint(
         if probe not in reader:
             continue  # optional tensors (qkv bias)
         layers[our] = place(
-            f"layers/{our}", stack_layers(our, suffix, transpose),
+            f"layers/{our}", merge_lora(our, stack_layers(our, suffix, transpose)),
             can_quant=our in _QUANT_KEYS,
         )
 
@@ -179,6 +206,105 @@ def load_hf_checkpoint(
         else:  # some checkpoints tie without declaring it
             params["lm_head"] = params["embed"]
     return params
+
+
+# PEFT target-module suffix -> our stacked layer key.
+_LORA_TARGETS = {
+    "self_attn.q_proj": "wq",
+    "self_attn.k_proj": "wk",
+    "self_attn.v_proj": "wv",
+    "self_attn.o_proj": "wo",
+    "mlp.gate_proj": "w_gate",
+    "mlp.up_proj": "w_up",
+    "mlp.down_proj": "w_down",
+    # short names PEFT configs commonly use
+    "q_proj": "wq", "k_proj": "wk", "v_proj": "wv", "o_proj": "wo",
+    "gate_proj": "w_gate", "up_proj": "w_up", "down_proj": "w_down",
+}
+
+
+def load_lora_deltas(
+    adapter_dir: str, weight: float = 1.0
+) -> dict[str, dict[int, np.ndarray]]:
+    """Read a PEFT-format adapter into per-key per-layer f32 weight deltas.
+
+    Returns {our_key: {layer: [in, out] f32 delta}} where each delta is
+    weight · (alpha/r) · (B@A)^T (PEFT stores A [r, in], B [out, r]; our
+    weights are [in, out]). Reads `adapter_config.json` +
+    `adapter_model.safetensors` (names like
+    `base_model.model.model.layers.N.self_attn.q_proj.lora_A.weight`).
+    Only the small rank-r factors and one [in, out] delta per targeted
+    (key, layer) ever materialize.
+    """
+    import re
+
+    from safetensors import safe_open
+
+    with open(os.path.join(adapter_dir, "adapter_config.json")) as f:
+        acfg = json.load(f)
+    r = int(acfg.get("r", 8))
+    alpha = float(acfg.get("lora_alpha", r))
+    scale = weight * alpha / max(r, 1)
+
+    path = os.path.join(adapter_dir, "adapter_model.safetensors")
+    tensors: dict[str, np.ndarray] = {}
+    with safe_open(path, framework="numpy") as f:
+        for name in f.keys():
+            tensors[name] = np.asarray(f.get_tensor(name), np.float32)
+
+    pat = re.compile(r"layers\.(\d+)\.(.+)\.lora_A\.weight$")
+    per_key: dict[str, dict[int, np.ndarray]] = {}
+    for name, a in tensors.items():
+        m = pat.search(name)
+        if m is None:
+            continue
+        layer, module = int(m.group(1)), m.group(2)
+        our = _LORA_TARGETS.get(module) or _LORA_TARGETS.get(module.split(".")[-1])
+        if our is None:
+            continue  # embeddings/norm targets are not served; skip quietly
+        b = tensors.get(name[: -len("lora_A.weight")] + "lora_B.weight")
+        if b is None:
+            continue
+        delta = (b @ a).T * scale
+        tgt = per_key.setdefault(our, {})
+        tgt[layer] = tgt[layer] + delta if layer in tgt else delta
+    return per_key
+
+
+def apply_lora(
+    cfg: ArchConfig, params: Params, adapter_dir: str, weight: float = 1.0
+) -> Params:
+    """Merge a PEFT-format LoRA adapter into the stacked param tree.
+
+    W += weight · (alpha/r) · B@A per targeted module, exactly what the
+    reference does at load time (grpc-server.cpp params_parse lora adapters;
+    backend.proto LoraAdapter/LoraScale). Quantized trees are rejected —
+    merge before quantizing (`load_hf_checkpoint(lora=...)` does both in one
+    host pass). Updates are per-layer `at[].add`s, so no full-model-shaped
+    f32 buffer ever materializes. Returns the updated tree.
+    """
+    per_key = load_lora_deltas(adapter_dir, weight)
+    layers = dict(params["layers"])
+    for our, deltas in per_key.items():
+        leaf = layers.get(our)
+        if leaf is None:
+            raise KeyError(f"lora targets {our!r} absent from the model tree")
+        if isinstance(leaf, dict):
+            raise ValueError(
+                "cannot merge a LoRA adapter into quantized weights — load "
+                "the checkpoint unquantized and quantize after merging"
+            )
+        for layer, delta in deltas.items():
+            if layer >= cfg.num_layers or delta.shape != leaf.shape[1:]:
+                raise ValueError(
+                    f"lora delta for {our!r} layer {layer} has shape "
+                    f"{delta.shape}, model expects {leaf.shape[1:]}"
+                )
+            leaf = leaf.at[layer].add(jnp.asarray(delta, leaf.dtype))
+        layers[our] = leaf
+    out = dict(params)
+    out["layers"] = layers
+    return out
 
 
 def save_hf_checkpoint(cfg: ArchConfig, params: Params, ckpt_dir: str) -> None:
