@@ -218,18 +218,121 @@ class TTSEngine(_BaseAudioEngine):
         return wav, self.cfg.sample_rate
 
 
-class VADEngine(_BaseAudioEngine):
-    """Voice-activity detection (energy detector — audio/vad.py)."""
+class VitsEngine(_BaseAudioEngine):
+    """Text → waveform on a real published VITS voice (models/vits.py) —
+    same synthesize interface as TTSEngine so the manager and the
+    /v1/audio/speech + /tts handlers treat both uniformly (reference: piper
+    voices are VITS models; backend/go/piper/piper.go)."""
 
-    def __init__(self) -> None:
+    # Static (token, frame) budgets — jit compiles once per bucket pair, not
+    # once per text length (ids/dur_noise are padded to the token bucket and
+    # masked inside the model via n_tokens).
+    TOKEN_BUCKETS = (64, 256, 1024)
+    FRAME_BUCKETS = (256, 1024, 4096)
+    FRAMES_PER_TOKEN = 16  # generous upper estimate used to pick a bucket
+
+    def __init__(self, cfg, params, tokenizer, voices: Optional[list[str]] = None):
+        from localai_tpu.models import vits as vits_model
+
         super().__init__()
-        self.params = {}  # weightless
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.voices = voices or ["default"]
+        self._model = vits_model
+        self._jit: dict[int, Any] = {}
+        self._seed = 0
+
+    @property
+    def sample_rate(self) -> int:
+        return self.cfg.sampling_rate
+
+    def _program(self, tokens: int, frames: int):
+        fn = self._jit.get((tokens, frames))
+        if fn is None:
+            cfg = self.cfg
+
+            def run(params, ids, n_tok, dur_noise, prior_noise, rate):
+                return self._model.synthesize(
+                    cfg, params, ids, frames, dur_noise, prior_noise,
+                    speaking_rate=rate, n_tokens=n_tok,
+                )
+
+            fn = jax.jit(run, static_argnums=(5,))
+            self._jit[(tokens, frames)] = fn
+        return fn
+
+    def synthesize(self, text: str, voice: Optional[str] = None,
+                   speaking_rate: Optional[float] = None) -> tuple[np.ndarray, int]:
+        t0 = time.monotonic()
+        ids = self.tokenizer.encode(text or " ")
+        rate = float(speaking_rate or self.cfg.speaking_rate)
+        tb = next((b for b in self.TOKEN_BUCKETS if b >= len(ids)),
+                  -(-len(ids) // self.TOKEN_BUCKETS[-1]) * self.TOKEN_BUCKETS[-1])
+        want = int(self.FRAMES_PER_TOKEN * len(ids) / max(rate, 0.25))
+        # Past the table, round up (multiples of the largest bucket) instead
+        # of capping — capping would truncate long text mid-sentence (the
+        # model clamps durations into the static frame budget).
+        frames = next((b for b in self.FRAME_BUCKETS if b >= want),
+                      -(-want // self.FRAME_BUCKETS[-1]) * self.FRAME_BUCKETS[-1])
+        padded = np.zeros((1, tb), np.int32)
+        padded[0, : len(ids)] = ids
+        with self._lock:
+            self._seed += 1
+            key = jax.random.key(self._seed)
+            k1, k2 = jax.random.split(key)
+            dur_noise = (
+                jax.random.normal(k1, (1, 2, tb))
+                * self.cfg.noise_scale_duration
+            )
+            prior_noise = (
+                jax.random.normal(k2, (1, frames, self.cfg.flow_size))
+                * self.cfg.noise_scale
+            )
+            wav, n = self._program(tb, frames)(
+                self.params, jnp.asarray(padded),
+                jnp.asarray([len(ids)], jnp.int32), dur_noise,
+                prior_noise, rate,
+            )
+        samples = np.asarray(wav[0][: int(n[0])], np.float32)
+        self.m_requests += 1
+        self.m_audio_seconds += len(samples) / self.sample_rate
+        self._busy_time += time.monotonic() - t0
+        return samples, self.sample_rate
+
+    def synthesize_stream(self, text: str, voice: Optional[str] = None):
+        """Sentence-chunked streaming: first audio after the first clause."""
+        import re
+
+        parts = [p for p in re.split(r"(?<=[.!?;:\n])\s+", text or " ") if p.strip()]
+        for part in parts or [" "]:
+            samples, _sr = self.synthesize(part, voice)
+            yield samples
+
+
+class VADEngine(_BaseAudioEngine):
+    """Voice-activity detection.
+
+    With a weights file (audio/learned_vad.py conv+GRU net — the silero-vad
+    role, reference backend/go/silero-vad/vad.go:13-33) detection is learned;
+    otherwise the adaptive energy detector (audio/vad.py) serves weightless.
+    """
+
+    def __init__(self, vad_cfg=None, params: Optional[Any] = None) -> None:
+        super().__init__()
+        self.vad_cfg = vad_cfg
+        self.params = params if params is not None else {}
 
     def detect(self, audio: np.ndarray, sample_rate: int = 16_000) -> list[dict]:
-        from localai_tpu.audio.vad import energy_vad
-
         t0 = time.monotonic()
-        segs = energy_vad(audio, sample_rate)
+        if self.vad_cfg is not None and self.params:
+            from localai_tpu.audio.learned_vad import detect as learned_detect
+
+            segs = learned_detect(self.vad_cfg, self.params, audio, sample_rate)
+        else:
+            from localai_tpu.audio.vad import energy_vad
+
+            segs = energy_vad(audio, sample_rate)
         self.m_requests += 1
         self.m_audio_seconds += len(audio) / sample_rate
         self._busy_time += time.monotonic() - t0
