@@ -18,6 +18,55 @@ import numpy as np
 from localai_tpu.models import diffusion as dit
 
 
+class YolosEngine:
+    """Resident YOLOS detector on a real published HF checkpoint
+    (models/yolos.py; hustvl/yolos-tiny class). Same detect() contract as
+    DetectionEngine — [{x, y, width, height, confidence, class_name}] in
+    pixels of the input image."""
+
+    def __init__(self, cfg, params: Any):
+        from localai_tpu.models import yolos as Y
+
+        self.cfg = cfg
+        self.params = params
+        self.cache = None
+        self._lock = threading.Lock()
+        self._model = Y
+        self._fn = jax.jit(lambda p, img: Y.forward(cfg, p, img))
+        self.m_requests = 0
+        self._busy_time = 0.0
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+    def cancel_all(self) -> int:
+        return 0
+
+    def metrics(self) -> dict[str, float]:
+        return {"requests": float(self.m_requests), "busy_seconds": self._busy_time}
+
+    def detect(self, img: np.ndarray, threshold: float = 0.5) -> list[dict]:
+        t0 = time.monotonic()
+        H, W = img.shape[:2]
+        pixels = self._model.preprocess(img, self.cfg)
+        with self._lock:
+            logits, boxes = self._fn(self.params, jnp.asarray(pixels))
+        dets = self._model.postprocess(
+            self.cfg, np.asarray(logits[0]), np.asarray(boxes[0]), threshold
+        )
+        for d in dets:  # normalized → input-image pixels
+            d["x"] *= W
+            d["width"] *= W
+            d["y"] *= H
+            d["height"] *= H
+        self.m_requests += 1
+        self._busy_time += time.monotonic() - t0
+        return dets
+
+
 class DetectionEngine:
     """Resident DETR-style detector (models/detection.py)."""
 

@@ -299,3 +299,69 @@ def test_tts_elevenlabs_route(audio_api):
         blob = r.read()
     samples, sr = read_wav(blob)
     assert len(samples) > 0
+
+
+def test_learned_vad_trains_and_detects(tmp_path):
+    """VERDICT r2 item 9c: a learned (conv+GRU) VAD replaces the energy
+    heuristic — trained offline on synthetic speech/noise, it must separate
+    planted speech bursts from silence and round-trip through safetensors +
+    the manager's vad backend."""
+    import numpy as np
+    import yaml
+
+    from localai_tpu.audio import learned_vad as LV
+    from localai_tpu.config import ApplicationConfig
+    from localai_tpu.server import ModelManager
+
+    cfg = LV.VadNetConfig()
+    params = LV.train_synthetic(cfg, steps=120, seed=0)
+
+    # Held-out synthetic clip: known speech span in the middle.
+    rng = np.random.default_rng(99)
+    sr = 16_000
+    clip = rng.normal(0, 0.02, 2 * sr).astype(np.float32)
+    t = np.arange(int(0.6 * sr)) / sr
+    f0 = 140 * (1 + 0.1 * np.sin(2 * np.pi * 3 * t))
+    sig = sum(
+        0.6 / h * np.sin(2 * np.pi * h * np.cumsum(f0) / sr) for h in range(1, 5)
+    )
+    env = 0.3 * np.abs(np.sin(2 * np.pi * 4 * t)) + 0.1
+    s0 = int(0.7 * sr)
+    clip[s0: s0 + len(t)] += (sig * env).astype(np.float32)
+
+    segs = LV.detect(cfg, params, clip, sr)
+    assert segs, "learned VAD found no speech in a clip with a planted burst"
+    # The detected span must overlap the planted one and not cover everything.
+    overlap = any(s.start < 1.3 and s.end > 0.7 for s in segs)
+    assert overlap, [(s.start, s.end) for s in segs]
+    covered = sum(s.end - s.start for s in segs)
+    assert covered < 1.6, f"VAD fired on {covered:.2f}s of a 2s mostly-noise clip"
+
+    # safetensors round-trip + manager integration (backend: vad).
+    mdir = tmp_path / "vadmodel"
+    mdir.mkdir()
+    LV.save_params(str(mdir / "vad.safetensors"), params)
+    (tmp_path / "myvad.yaml").write_text(yaml.safe_dump({
+        "name": "myvad", "backend": "vad", "model": str(mdir),
+    }))
+    manager = ModelManager(ApplicationConfig(models_dir=str(tmp_path)))
+    try:
+        lm = manager.get("myvad")
+        assert lm.engine.vad_cfg is not None  # learned path active
+        out = lm.engine.detect(clip, sr)
+        assert out and any(d["start"] < 1.3 and d["end"] > 0.7 for d in out)
+    finally:
+        manager.shutdown()
+
+
+def test_learned_vad_config_recovered_from_weights():
+    """A checkpoint trained with non-default shapes must load with those
+    shapes (the config is derived from the weights, not assumed default)."""
+    import jax
+
+    from localai_tpu.audio import learned_vad as LV
+
+    cfg = LV.VadNetConfig(n_mels=64, conv_channels=24, hidden=32)
+    params = LV.init_params(cfg, jax.random.key(0))
+    got = LV.config_from_params(params)
+    assert (got.n_mels, got.conv_channels, got.hidden) == (64, 24, 32)
