@@ -115,7 +115,17 @@ class FederatedServer:
         strategy: str = "least-used",
         workers: Optional[list[tuple[str, str]]] = None,
         health_interval_s: float = 5.0,
+        token: Optional[str] = None,
     ):
+        # Shared-token gate on the control plane (reference parity:
+        # core/p2p/p2p.go:31-64 — the libp2p overlay requires a shared
+        # TOKEN). Without it any host reaching the front door could insert
+        # itself as a worker and receive proxied user traffic. Defaults to
+        # $LOCALAI_P2P_TOKEN; empty string/None leaves registration open
+        # (single-trust-domain deployments).
+        import os as _os
+
+        self.token = token if token is not None else _os.environ.get("LOCALAI_P2P_TOKEN", "")
         self.registry = WorkerRegistry()
         self.strategy = strategy
         for name, url in workers or []:
@@ -170,6 +180,21 @@ class FederatedServer:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _authorized(self) -> bool:
+                if not fed.token:
+                    return True
+                import hmac
+
+                auth = self.headers.get("Authorization", "")
+                bearer = auth[7:] if auth.startswith("Bearer ") else ""
+                supplied = self.headers.get("LocalAI-P2P-Token", bearer)
+                # bytes, not str: compare_digest raises TypeError on
+                # non-ASCII str input (headers are latin-1 decoded).
+                return hmac.compare_digest(
+                    supplied.encode("utf-8", "surrogateescape"),
+                    fed.token.encode("utf-8", "surrogateescape"),
+                )
+
             def _control(self) -> bool:
                 if self.path == "/federation/workers" and self.command == "GET":
                     self._json(200, {"workers": [
@@ -181,7 +206,13 @@ class FederatedServer:
                     ], "strategy": fed.strategy})
                     return True
                 if self.path == "/federation/register" and self.command == "POST":
+                    # Read the body before any response: leaving it unread
+                    # would corrupt the next request on a keep-alive
+                    # connection (protocol_version is HTTP/1.1).
                     body = self._read_json()
+                    if not self._authorized():
+                        self._json(401, {"error": "federation token required"})
+                        return True
                     if not body or "name" not in body or "url" not in body:
                         self._json(400, {"error": "name and url required"})
                         return True
@@ -189,7 +220,10 @@ class FederatedServer:
                     self._json(200, {"status": "registered"})
                     return True
                 if self.path == "/federation/unregister" and self.command == "POST":
-                    body = self._read_json()
+                    body = self._read_json()  # drain before responding (as above)
+                    if not self._authorized():
+                        self._json(401, {"error": "federation token required"})
+                        return True
                     ok = bool(body) and fed.registry.remove(body.get("name", ""))
                     self._json(200 if ok else 404, {"status": "ok" if ok else "unknown"})
                     return True
@@ -276,13 +310,21 @@ class FederatedServer:
         return ThreadingHTTPServer((address, port), Proxy)
 
 
-def register_with_federator(federator_url: str, name: str, my_url: str) -> bool:
+def register_with_federator(
+    federator_url: str, name: str, my_url: str, token: Optional[str] = None
+) -> bool:
     """Worker-side join (reference: p2p node announcing on the DHT)."""
+    import os as _os
+
+    token = token if token is not None else _os.environ.get("LOCALAI_P2P_TOKEN", "")
     try:
+        headers = {"Content-Type": "application/json"}
+        if token:
+            headers["LocalAI-P2P-Token"] = token
         req = urllib.request.Request(
             federator_url.rstrip("/") + "/federation/register",
             data=json.dumps({"name": name, "url": my_url}).encode(),
-            headers={"Content-Type": "application/json"},
+            headers=headers,
         )
         with urllib.request.urlopen(req, timeout=10):
             return True

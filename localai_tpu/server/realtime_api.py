@@ -117,6 +117,22 @@ class RealtimeSession:
 
     # ------------------------------------------------------------------ #
 
+    def _vad_segments(self, audio16: np.ndarray) -> list[tuple[float, float]]:
+        """(start, end) speech spans. Uses a configured vad-backend model —
+        the learned conv+GRU net fills the silero role (reference:
+        realtime.go server VAD via silero) — falling back to the weightless
+        energy detector when none is configured."""
+        cfg = self.api.manager.configs.first_with(Usecase.VAD)
+        if cfg is not None:
+            lm, lease = self.api.manager.lease(cfg.name)
+            try:
+                return [(d["start"], d["end"]) for d in lm.engine.detect(audio16, 16_000)]
+            finally:
+                lease.release()
+        from localai_tpu.audio.vad import energy_vad
+
+        return [(s.start, s.end) for s in energy_vad(audio16, 16_000)]
+
     def _maybe_auto_commit(self, ws: WebSocket) -> None:
         """Server-VAD turn detection: commit + respond once speech is
         followed by enough trailing silence."""
@@ -124,19 +140,18 @@ class RealtimeSession:
         if td.get("type") != "server_vad" or not self.audio_buffer:
             return
         from localai_tpu.audio import resample
-        from localai_tpu.audio.vad import energy_vad
 
         sr = int(self.config["input_sample_rate"])
         pcm = np.frombuffer(bytes(self.audio_buffer), np.int16).astype(np.float32) / 32768.0
         audio16 = resample(pcm, sr, 16_000)
-        segs = energy_vad(audio16, 16_000)
+        segs = self._vad_segments(audio16)
         if not segs:
             return
         if not self._speech_started:
             self._speech_started = True
             ws.send_json({"type": "input_audio_buffer.speech_started"})
         silence_s = float(td.get("silence_duration_ms", 500)) / 1000.0
-        trailing = len(audio16) / 16_000.0 - segs[-1].end
+        trailing = len(audio16) / 16_000.0 - segs[-1][1]
         if trailing >= silence_s:
             ws.send_json({"type": "input_audio_buffer.speech_stopped"})
             self._speech_started = False

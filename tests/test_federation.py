@@ -144,3 +144,41 @@ def test_sse_streams_through_federation(federation):
     chunks = [json.loads(f) for f in frames[:-1]]
     assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
     assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+
+
+def test_federation_register_requires_token():
+    """With a shared token set, unauthorized register/unregister are rejected
+    (reference parity: core/p2p/p2p.go:31-64 token-gated overlay)."""
+    import urllib.error
+
+    fed = FederatedServer(port=0, health_interval_s=0, token="s3cret")
+    fed.start()
+    base = f"http://127.0.0.1:{fed.port}"
+    try:
+        body = json.dumps({"name": "evil", "url": "http://127.0.0.1:1"}).encode()
+        req = urllib.request.Request(
+            base + "/federation/register", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc.value.code == 401
+        assert fed.registry.list() == []
+
+        # Correct token (either header form) is accepted.
+        assert register_with_federator(base, "good", "http://127.0.0.1:2", token="s3cret")
+        assert [w.name for w in fed.registry.list()] == ["good"]
+
+        req = urllib.request.Request(
+            base + "/federation/unregister",
+            data=json.dumps({"name": "good"}).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "Authorization": "Bearer s3cret",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 200
+        assert fed.registry.list() == []
+    finally:
+        fed.stop()
