@@ -101,6 +101,12 @@ class ModelConfig:
     draft_model: str = ""  # arch preset or checkpoint dir; empty = off
     n_draft: int = 5
 
+    # LoRA adapters merged into the base weights at load (reference:
+    # backend.proto LoraAdapter/LoraScale; grpc-server.cpp params_parse).
+    # Entries: "path" or {"path": ..., "weight": 1.0}; paths resolve like
+    # `model` (absolute or under models_dir).
+    lora_adapters: list = dataclasses.field(default_factory=list)
+
     # Weight-only quantization at load ("int8"; reference analogue:
     # quantized GGUF serving). Halves weight HBM traffic + footprint.
     quantization: str = ""

@@ -417,14 +417,35 @@ class ModelManager:
                 cfg.name, tv, arch.vocab_size,
             )
 
+        if cfg.lora_adapters and ckpt_dir is None:
+            # Adapters need bf16 base tensors to merge into; GGUF payloads
+            # are already quantized and synthetic presets have no checkpoint.
+            # Failing loudly beats silently serving the unmodified base.
+            raise ValueError(
+                f"model {cfg.name!r}: lora_adapters require an HF safetensors "
+                "checkpoint (not GGUF or a synthetic preset)"
+            )
         if gguf_params is not None:
             params = gguf_params
         elif ckpt_dir is not None:
             from localai_tpu.engine.weights import load_hf_checkpoint
 
+            lora = []
+            for entry in cfg.lora_adapters:
+                if isinstance(entry, dict):
+                    adir, w = entry.get("path", ""), float(entry.get("weight", 1.0))
+                else:
+                    adir, w = str(entry), 1.0
+                lora.append((self._resolve_ckpt_dir(adir), w))
             # Load-time host quantization: the bf16 tree never touches HBM,
-            # so int8 checkpoints up to ~2x HBM serve from one chip.
-            params = load_hf_checkpoint(arch, ckpt_dir, quantize=cfg.quantization)
+            # so int8 checkpoints up to ~2x HBM serve from one chip. LoRA
+            # deltas merge on the host in the same pass, before quantizing.
+            params = load_hf_checkpoint(
+                arch, ckpt_dir, quantize=cfg.quantization, lora=lora or None
+            )
+            for adir, w in lora:
+                log.info("model %s: merged lora adapter %s (weight=%.2f)",
+                         cfg.name, adir, w)
         elif cfg.quantization and cfg.quantization not in ("none",):
             # Synthetic preset + quantization: init leaf-wise into the
             # quantized form so big archs fit (same ~2x HBM envelope).
@@ -546,12 +567,51 @@ class ModelManager:
                 raise FileNotFoundError(
                     f"model {cfg.name!r}: tts checkpoint {ckpt_dir!r} not found"
                 )
+            from localai_tpu.models import vits as V
+
+            if V.is_vits_dir(ckpt_dir):
+                # Real published voice (facebook/mms-tts-*, vits-ljs) in the
+                # HF VITS layout — the neural path; Griffin-Lim stays the
+                # fallback for own-format checkpoints.
+                from localai_tpu.engine.audio_engine import VitsEngine
+
+                vcfg, vparams, vtok = V.load_vits(ckpt_dir)
+                return LoadedModel(
+                    cfg,
+                    VitsEngine(vcfg, vparams, vtok, voices=cfg.options.get("voices")),
+                    None,
+                )
             tcfg, params = T.load_tts(ckpt_dir)
         return LoadedModel(cfg, TTSEngine(tcfg, params, voices=cfg.options.get("voices")), None)
 
     def _load_vad(self, cfg: ModelConfig) -> LoadedModel:
+        import os
+
         from localai_tpu.engine.audio_engine import VADEngine
 
+        if cfg.model:
+            # A configured checkpoint that can't be found is an error, not a
+            # silent fall-through to the weightless energy detector (same
+            # standard as the tts/detection loaders above).
+            ckpt_dir = self._resolve_ckpt_dir(cfg.model)
+            if not os.path.isdir(ckpt_dir):
+                raise FileNotFoundError(
+                    f"model {cfg.name!r}: vad checkpoint {ckpt_dir!r} not found"
+                )
+            from localai_tpu.audio import learned_vad as LV
+
+            weights = LV.find_weights(ckpt_dir)
+            if not weights:
+                raise FileNotFoundError(
+                    f"model {cfg.name!r}: no vad.safetensors/model.safetensors "
+                    f"in {ckpt_dir!r}"
+                )
+            # Learned VAD net (silero role) from safetensors; the net shape
+            # is recovered from the weights themselves.
+            params = LV.load_params(weights)
+            return LoadedModel(
+                cfg, VADEngine(LV.config_from_params(params), params), None
+            )
         return LoadedModel(cfg, VADEngine(), None)
 
     def _load_bert(self, cfg: ModelConfig) -> LoadedModel:
@@ -626,6 +686,14 @@ class ModelManager:
                 raise FileNotFoundError(
                     f"model {cfg.name!r}: detection checkpoint {ckpt_dir!r} not found"
                 )
+            from localai_tpu.models import yolos as Y
+
+            if Y.is_yolos_dir(ckpt_dir):
+                # Real published detector (hustvl/yolos-*) in the HF layout.
+                from localai_tpu.engine.image_engine import YolosEngine
+
+                ycfg, yparams = Y.load_yolos(ckpt_dir)
+                return LoadedModel(cfg, YolosEngine(ycfg, yparams), None)
             dcfg, params = Det.load_detection(ckpt_dir)
         return LoadedModel(cfg, DetectionEngine(dcfg, params), None)
 

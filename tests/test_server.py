@@ -378,3 +378,4 @@ def test_settings_api(api, tmp_path_factory):
             assert e.code == 400
     finally:
         server.shutdown()
+
