@@ -121,6 +121,15 @@ class ApplicationConfig:
         if preload:
             cfg.preload_models = [m.strip() for m in preload.split(",") if m.strip()]
         galleries = os.environ.get("LOCALAI_GALLERIES", "")
+        if not galleries:
+            # Built-in starter gallery of TPU-servable (HF safetensors)
+            # models (reference ships gallery/index.yaml, ~1254 entries, as
+            # its default — core/cli/run.go Galleries default).
+            from localai_tpu.gallery import builtin_gallery_url
+
+            cfg.galleries = [
+                {"name": "localai-tpu", "url": builtin_gallery_url()}
+            ]
         if galleries:
             import json
 

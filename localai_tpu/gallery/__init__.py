@@ -10,3 +10,10 @@ engine, not per-model binaries — so only the *model* gallery is ported.
 
 from localai_tpu.gallery.gallery import Gallery, GalleryEntry, load_index  # noqa: F401
 from localai_tpu.gallery.service import GalleryService, InstallJob  # noqa: F401
+
+
+def builtin_gallery_url() -> str:
+    """file:// URL of the packaged starter index (localai_tpu/gallery/index.yaml)."""
+    import os
+
+    return "file://" + os.path.join(os.path.dirname(os.path.abspath(__file__)), "index.yaml")

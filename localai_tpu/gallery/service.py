@@ -234,6 +234,26 @@ class GalleryService:
         cfg.update(entry.overrides)
         cfg.update(overrides)
         cfg["name"] = name  # overrides must not detach the config from the job
+        for field in ("model", "tokenizer", "draft_model"):
+            val = cfg.get(field)
+            if isinstance(val, str) and val.startswith("hf://"):
+                # Whole-repo HF checkpoint: fetch config + safetensors +
+                # tokenizer with resume (downloader/hf_api.py) instead of
+                # enumerating shard filenames in the index.
+                from localai_tpu.downloader.hf_api import fetch_hf_model
+
+                repo = val[len("hf://"):]
+                job.message = f"fetching {repo}"
+
+                def progress(path, done, total):
+                    job.message = f"downloading {os.path.basename(path)}"
+
+                sub = target_dir if field == "model" else os.path.join(
+                    target_dir, field
+                )
+                fetch_hf_model(repo, sub, progress=progress)
+                job.downloaded_files.append(sub)
+                cfg[field] = sub
         with open(os.path.join(self.models_dir, f"{name}.yaml"), "w") as f:
             yaml.safe_dump(cfg, f)
         if self.config_loader is not None:
