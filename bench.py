@@ -145,6 +145,46 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — extra row is best-effort
             print(f"prefix row failed: {type(e).__name__}: {e}", file=sys.stderr)
 
+    # Grammar-constrained decode row: on-device DFA masking vs the host
+    # candidate-walk fallback (same schema, greedy). The DFA path keeps full
+    # block depth and no per-token host round-trip (functions/dfa.py).
+    if os.environ.get("BENCH_GRAMMAR", "1") != "0":
+        try:
+            from localai_tpu.functions.jsonschema import GrammarConstraint
+
+            g_schema = {
+                "type": "object",
+                "properties": {"a": {"type": "integer"}, "b": {"type": "boolean"},
+                               "c": {"type": "string"}},
+                "required": ["a", "b", "c"],
+            }
+
+            def g_run(env_val, n=3):
+                os.environ["LOCALAI_GRAMMAR_DFA"] = env_val
+                eng.generate([1, 2, 3], max_new_tokens=96, ignore_eos=False,
+                             grammar=GrammarConstraint(g_schema))  # compile
+                t0 = time.time()
+                toks0 = eng.m_generated_tokens
+                for i in range(n):
+                    eng.generate([1, 2, 3 + i], max_new_tokens=96,
+                                 grammar=GrammarConstraint(g_schema))
+                toks = max(eng.m_generated_tokens - toks0, 1)
+                return toks / (time.time() - t0)
+
+            tps_dfa = g_run("1")
+            tps_walk = g_run("0")
+            os.environ["LOCALAI_GRAMMAR_DFA"] = "1"
+            out["grammar_dfa_tps"] = round(tps_dfa, 1)
+            out["grammar_hostwalk_tps"] = round(tps_walk, 1)
+            out["grammar_dfa_speedup"] = round(tps_dfa / max(tps_walk, 1e-9), 2)
+            print(
+                f"grammar: dfa {tps_dfa:.1f} tok/s vs host-walk {tps_walk:.1f} "
+                f"tok/s -> {tps_dfa / max(tps_walk, 1e-9):.2f}x",
+                file=sys.stderr,
+            )
+        except Exception as e:  # noqa: BLE001 — extra row is best-effort
+            print(f"grammar row failed: {type(e).__name__}: {e}", file=sys.stderr)
+
     eng.stop()
 
 

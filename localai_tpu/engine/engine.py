@@ -218,6 +218,9 @@ class _Slot:
     scheduled: int = 0  # decode steps dispatched (>= len(generated))
     t_submit: float = 0.0
     t_first: float = 0.0
+    # Grammar enforced on device via DFA tables (functions/dfa.py): the host
+    # never walks candidates and the slot runs in full-depth fused blocks.
+    dfa: bool = False
 
 
 def _host_copy_async(arr: Any) -> None:
@@ -371,6 +374,15 @@ class Engine:
         self._slot_gen = [0] * B
         self._tok_strs: Optional[list[str]] = None  # lazy grammar cache
         self.grammar_topk = self.GRAMMAR_TOPK
+        # On-device grammar DFA (functions/dfa.py): per-slot automaton state
+        # + one active table set (schemas repeat, so one is usually enough;
+        # a second concurrent schema falls back to the host walk).
+        self.h_gmask = np.zeros((B,), np.float32)  # 1 = slot DFA-constrained
+        self.d_gstate = jnp.zeros((B,), jnp.int32)
+        self._dfa: Optional[dict] = None  # {key, mask_bits, trans, tok_cls, host}
+        self._dfa_building: set = set()  # schema keys compiling off-thread
+        self._tok_fp: Optional[str] = None
+        self.m_dfa_tokens = 0
 
         self._pending: deque[tuple[GenRequest, RequestHandle]] = deque()
         self._pending_lock = threading.Lock()
@@ -427,7 +439,8 @@ class Engine:
         self._embed_fn = _embed
         self._score_fn = _score
 
-    def _get_block(self, variant: str, n: int, with_lp: bool = False):
+    def _get_block(self, variant: str, n: int, with_lp: bool = False,
+                   with_dfa: bool = False):
         """Fused n-step decode block program for one sampling variant.
 
         variant: "greedy" | "simple" | "filtered" | "grammar".
@@ -441,17 +454,26 @@ class Engine:
         with_lp additionally returns, per step, the sampled token's logprob
         and the top-LOGPROB_TOPK (ids, logprobs) from log_softmax(logits +
         bias) — the OpenAI logprobs contract (pre-penalty, pre-temperature).
+
+        with_dfa runs the grammar DFA on device for slots whose pack row 10
+        is set: their logits are masked to the legal set of the slot's
+        automaton state, and the state advances by walking the sampled
+        token's char classes — no host round-trip, so constrained requests
+        keep full block depth and pipeline alongside unconstrained slots
+        (which run through the FREE state, an all-legal fixed point).
         """
-        key = (variant, n, with_lp)
+        key = (variant, n, with_lp, with_dfa)
         fn = self._block_cache.get(key)
         if fn is not None:
             return fn
         cfg = self.cfg
         B, S = self.ecfg.max_slots, self.ecfg.max_seq
-        K = min(self.GRAMMAR_TOPK, cfg.vocab_size)
-        LK = min(self.LOGPROB_TOPK, cfg.vocab_size)
+        V = cfg.vocab_size
+        K = min(self.GRAMMAR_TOPK, V)
+        LK = min(self.LOGPROB_TOPK, V)
 
-        def block(params, cache, counts, rngs, bias, tokens, positions, pack):
+        def block(params, cache, counts, rngs, bias, tokens, positions, pack,
+                  mask_bits=None, gtrans=None, tok_cls=None, gstate=None):
             active = pack[0] > 0
             samp = SamplingParams(
                 temperature=pack[1], top_k=pack[2].astype(jnp.int32),
@@ -462,6 +484,9 @@ class Engine:
             omask = pack[9] > 0
             tokens = jnp.where(omask, overrides, tokens)
             act_i32 = active.astype(jnp.int32)
+            if with_dfa:
+                gmask = pack[10] > 0
+                gstate = jnp.where(gmask, gstate, 0)  # FREE for unconstrained
 
             # Block-local KV window: the cache stays READ-ONLY inside the
             # scan (profiling showed a carried cache costs one full cache
@@ -474,20 +499,30 @@ class Engine:
             local_v = jnp.zeros_like(local_k)
 
             def body(carry, step):
-                tokens, positions, counts, rngs, lk, lv = carry
+                tokens, positions, counts, rngs, lk, lv, gs = carry
                 logits, lk, lv = llama.decode_step_windowed(
                     cfg, params, tokens, positions, cache, lk, lv, step,
                     ep=self.plan.ep, mesh=self._ring_mesh,
                 )
                 split = jax.vmap(lambda k: jax.random.split(k, 2))(rngs)
                 rngs, draw = split[:, 0], split[:, 1]
-                if variant == "greedy":
-                    nxt = sample_greedy(logits, samp, counts, bias)
-                elif variant == "simple":
-                    nxt = sample_simple(logits, draw, samp, counts, bias)
+                if with_dfa:
+                    from localai_tpu.ops.sampling import NEG_INF
+
+                    allowed = self._dfa_allowed(mask_bits, gs, V)
+                    slogits = jnp.where(allowed, logits, NEG_INF)
                 else:
-                    nxt = sample(logits, draw, samp, counts, bias)
+                    slogits = logits
+                if variant == "greedy":
+                    nxt = sample_greedy(slogits, samp, counts, bias)
+                elif variant == "simple":
+                    nxt = sample_simple(slogits, draw, samp, counts, bias)
+                else:
+                    nxt = sample(slogits, draw, samp, counts, bias)
                 counts = counts.at[jnp.arange(B), nxt].add(act_i32)
+                if with_dfa:
+                    ns = self._dfa_next_state(gtrans, tok_cls, gs, nxt)
+                    gs = jnp.where(active, ns, gs)  # FREE rows self-loop
                 nxt = jnp.where(active, nxt, 0)
                 if variant == "grammar":
                     _, tk = jax.lax.top_k(logits + bias, K)
@@ -495,6 +530,8 @@ class Engine:
                 else:
                     out = (nxt,)
                 if with_lp:
+                    # The model's own distribution (pre-grammar-mask), per
+                    # the OpenAI logprobs contract.
                     logp = jax.nn.log_softmax(
                         logits.astype(jnp.float32) + bias, axis=-1
                     )
@@ -504,24 +541,30 @@ class Engine:
                 # Clamp so idle/overshooting slots keep writing inside their
                 # own cache row instead of out-of-bounds.
                 positions = jnp.minimum(positions + 1, S - 1)
-                return (nxt, positions, counts, rngs, lk, lv), out
+                return (nxt, positions, counts, rngs, lk, lv, gs), out
 
-            (tokens, positions, counts, rngs, local_k, local_v), outs = jax.lax.scan(
-                body, (tokens, positions, counts, rngs, local_k, local_v),
+            gs0 = gstate if with_dfa else jnp.zeros((B,), jnp.int32)
+            (tokens, positions, counts, rngs, local_k, local_v, gs), outs = jax.lax.scan(
+                body, (tokens, positions, counts, rngs, local_k, local_v, gs0),
                 jnp.arange(n),
             )
             cache = llama.write_block_to_cache(cache, local_k, local_v, start_pos)
             toks_block = outs[0]  # [n, B]
             tk_block = outs[1] if variant == "grammar" else None
             lp_block = tuple(outs[-3:]) if with_lp else None  # ([n,B],[n,B,LK],[n,B,LK])
-            return cache, counts, rngs, tokens, positions, toks_block, tk_block, lp_block
+            out = (cache, counts, rngs, tokens, positions, toks_block, tk_block, lp_block)
+            if with_dfa:
+                out = out + (gs,)
+            return out
 
-        fn = jax.jit(block, donate_argnums=(1, 2, 3, 5, 6))
+        donate = (1, 2, 3, 5, 6) + ((11,) if with_dfa else ())
+        fn = jax.jit(block, donate_argnums=donate)
         self._block_cache[key] = fn
         return fn
 
     def _get_admit(self, m: int, bucket: int, has_bias: bool, with_topk: bool,
-                   with_lp: bool = False, n_img: int = 0):
+                   with_lp: bool = False, n_img: int = 0,
+                   with_dfa: bool = False):
         """Fused admission program: prefill M prompts, write their KV/state
         into their slots, and sample each first token — one dispatch.
 
@@ -532,8 +575,14 @@ class Engine:
         n_img > 0 (multimodal, always m=1): the program takes projected
         image features [m, n_img, D] + offsets [m] injected into the prompt
         embeddings before the layer stack (llava path).
+
+        with_dfa (grammar DFA, m == 1): the first sampled token is masked to
+        the start state's legal set (gmask0, additive -inf rows) and the
+        slot's device automaton state is initialized by walking that token's
+        char classes — so follow-up decode blocks can pipeline immediately
+        with no host round-trip.
         """
-        key = (m, bucket, has_bias, with_topk, with_lp, n_img)
+        key = (m, bucket, has_bias, with_topk, with_lp, n_img, with_dfa)
         fn = self._admit_cache.get(key)
         if fn is not None:
             return fn
@@ -549,7 +598,8 @@ class Engine:
 
         def admit(params, cache, counts, rngs, bias, d_tokens, d_positions,
                   prompt_toks, aux, samp_pack, bias_rows, img_embeds=None,
-                  img_offsets=None):
+                  img_offsets=None, gmask0=None, gtrans=None, tok_cls=None,
+                  ginit=None, d_gstate=None):
             lens, slot_ids, seeds = aux[0], aux[1], aux[2]
             samp = SamplingParams(
                 temperature=samp_pack[0], top_k=samp_pack[1].astype(jnp.int32),
@@ -571,7 +621,8 @@ class Engine:
                 brows = jnp.where(jnp.arange(V)[None, :] >= tok_v, NEG_INF, brows)
             keys0 = jax.vmap(jax.random.key)(seeds.astype(jnp.uint32))
             draws = jax.vmap(lambda k: jax.random.fold_in(k, 0))(keys0)
-            toks = sample(logits, draws, samp, rows, brows)  # [m]
+            srows = brows + gmask0 if with_dfa else brows
+            toks = sample(logits, draws, samp, rows, srows)  # [m]
             rows = rows.at[jnp.arange(m), toks].add(1)
             tk = jax.lax.top_k(logits + brows, K)[1] if with_topk else None
             lp = None
@@ -580,6 +631,8 @@ class Engine:
                 lp_vals, lp_ids = jax.lax.top_k(logp, LK)
                 tok_lp = jnp.take_along_axis(logp, toks[:, None], axis=-1)[:, 0]
                 lp = (tok_lp, lp_ids, lp_vals)
+            if with_dfa:
+                gnext = self._dfa_next_state(gtrans, tok_cls, ginit, toks)  # [m]
             for j in range(m):  # m is static and small — unrolled
                 s = slot_ids[j]
                 cache = llama.write_prefill_to_cache(
@@ -590,18 +643,46 @@ class Engine:
                 bias = bias.at[s].set(brows[j])
                 d_tokens = d_tokens.at[s].set(toks[j])
                 d_positions = d_positions.at[s].set(lens[j])
-            return cache, counts, rngs, bias, d_tokens, d_positions, toks, tk, lp
+                if with_dfa:
+                    d_gstate = d_gstate.at[s].set(gnext[j])
+            out = (cache, counts, rngs, bias, d_tokens, d_positions, toks, tk, lp)
+            if with_dfa:
+                out = out + (d_gstate,)
+            return out
 
         if self.draft_cfg is None:
-            fn = jax.jit(admit, donate_argnums=(1, 2, 3, 4, 5, 6))
+            donate = (1, 2, 3, 4, 5, 6)
+            if with_dfa:
+                def admit_dfa(params, cache, counts, rngs, bias, d_tokens,
+                              d_positions, d_gstate, prompt_toks, aux,
+                              samp_pack, bias_rows, gmask0, gtrans, tok_cls,
+                              ginit):
+                    return admit(params, cache, counts, rngs, bias, d_tokens,
+                                 d_positions, prompt_toks, aux, samp_pack,
+                                 bias_rows, gmask0=gmask0, gtrans=gtrans,
+                                 tok_cls=tok_cls, ginit=ginit,
+                                 d_gstate=d_gstate)
+
+                fn = jax.jit(admit_dfa, donate_argnums=donate + (7,))
+            else:
+                fn = jax.jit(admit, donate_argnums=donate)
         else:
             dcfg = self.draft_cfg
 
             def admit_spec(params, cache, counts, rngs, bias, d_tokens,
                            d_positions, dparams, dcache, prompt_toks, aux,
-                           samp_pack, bias_rows):
-                out = admit(params, cache, counts, rngs, bias, d_tokens,
-                            d_positions, prompt_toks, aux, samp_pack, bias_rows)
+                           samp_pack, bias_rows, *gargs):
+                if with_dfa:
+                    gmask0, gtrans, tok_cls, ginit, d_gstate = gargs
+                    out = admit(params, cache, counts, rngs, bias, d_tokens,
+                                d_positions, prompt_toks, aux, samp_pack,
+                                bias_rows, gmask0=gmask0, gtrans=gtrans,
+                                tok_cls=tok_cls, ginit=ginit,
+                                d_gstate=d_gstate)
+                else:
+                    out = admit(params, cache, counts, rngs, bias, d_tokens,
+                                d_positions, prompt_toks, aux, samp_pack,
+                                bias_rows)
                 # Prefill the draft model too so its KV cache matches the
                 # prompt before the first speculative round.
                 _, dks, dvs = llama.prefill(dcfg, dparams, prompt_toks, aux[0], ep=self.plan.ep)
@@ -611,19 +692,23 @@ class Engine:
                     )
                 return out + (dcache,)
 
-            fn = jax.jit(admit_spec, donate_argnums=(1, 2, 3, 4, 5, 6, 8))
+            donate = (1, 2, 3, 4, 5, 6, 8)
+            if with_dfa:
+                donate = donate + (17,)  # d_gstate (last of *gargs)
+            fn = jax.jit(admit_spec, donate_argnums=donate)
         self._admit_cache[key] = fn
         return fn
 
     def _get_admit_cached(self, pb: int, tb: int, has_bias: bool,
-                          with_topk: bool, with_lp: bool):
+                          with_topk: bool, with_lp: bool,
+                          with_dfa: bool = False):
         """Cached admission: copy a stored prefix KV span into the slot and
         prefill only the prompt tail (models/llama.py prefill_tail) — the
         prompt cache fast path (reference: cache_prompt, grpc-server.cpp:125).
         Always m=1. `aux` is [4] i32 (tail_len, slot, seed, prefix_len);
         penalty counts for the full prompt arrive precomputed as `count_row`
         [1, V] i32 because the prefix tokens never reach the device."""
-        key = ("cached", pb, tb, has_bias, with_topk, with_lp)
+        key = ("cached", pb, tb, has_bias, with_topk, with_lp, with_dfa)
         fn = self._admit_cache.get(key)
         if fn is not None:
             return fn
@@ -635,7 +720,8 @@ class Engine:
 
         def admit_cached(params, cache, counts, rngs, bias, d_tokens,
                          d_positions, pk, pv, tail_toks, count_row, aux,
-                         samp_pack, bias_rows):
+                         samp_pack, bias_rows, gmask0=None, gtrans=None,
+                         tok_cls=None, ginit=None, d_gstate=None):
             tail_len, slot, seed, plen = aux[0], aux[1], aux[2], aux[3]
             samp = SamplingParams(
                 temperature=samp_pack[0], top_k=samp_pack[1].astype(jnp.int32),
@@ -654,7 +740,8 @@ class Engine:
                 brows = jnp.where(jnp.arange(V)[None, :] >= tok_v, NEG_INF, brows)
             keys0 = jax.vmap(jax.random.key)(aux[2:3].astype(jnp.uint32))
             draws = jax.vmap(lambda kk: jax.random.fold_in(kk, 0))(keys0)
-            toks = sample(logits, draws, samp, rows, brows)  # [1]
+            srows = brows + gmask0 if with_dfa else brows
+            toks = sample(logits, draws, samp, rows, srows)  # [1]
             rows = rows.at[jnp.arange(1), toks].add(1)
             tk = jax.lax.top_k(logits + brows, K)[1] if with_topk else None
             lp = None
@@ -677,9 +764,27 @@ class Engine:
             bias = bias.at[slot].set(brows[0])
             d_tokens = d_tokens.at[slot].set(toks[0])
             d_positions = d_positions.at[slot].set(plen + tail_len)
-            return cache, counts, rngs, bias, d_tokens, d_positions, toks, tk, lp
+            out = (cache, counts, rngs, bias, d_tokens, d_positions, toks, tk, lp)
+            if with_dfa:
+                gnext = self._dfa_next_state(gtrans, tok_cls, ginit, toks)
+                out = out + (d_gstate.at[slot].set(gnext[0]),)
+            return out
 
-        fn = jax.jit(admit_cached, donate_argnums=(1, 2, 3, 4, 5, 6))
+        if with_dfa:
+            def admit_cached_dfa(params, cache, counts, rngs, bias, d_tokens,
+                                 d_positions, d_gstate, pk, pv, tail_toks,
+                                 count_row, aux, samp_pack, bias_rows, gmask0,
+                                 gtrans, tok_cls, ginit):
+                return admit_cached(params, cache, counts, rngs, bias,
+                                    d_tokens, d_positions, pk, pv, tail_toks,
+                                    count_row, aux, samp_pack, bias_rows,
+                                    gmask0=gmask0, gtrans=gtrans,
+                                    tok_cls=tok_cls, ginit=ginit,
+                                    d_gstate=d_gstate)
+
+            fn = jax.jit(admit_cached_dfa, donate_argnums=(1, 2, 3, 4, 5, 6, 7))
+        else:
+            fn = jax.jit(admit_cached, donate_argnums=(1, 2, 3, 4, 5, 6))
         self._admit_cache[key] = fn
         return fn
 
@@ -777,7 +882,8 @@ class Engine:
         )
 
     def _dispatch_admit_cached(self, request: GenRequest, handle: RequestHandle,
-                               slot_idx: int, entry: dict, match_len: int) -> None:
+                               slot_idx: int, entry: dict, match_len: int,
+                               dfa_tables: Optional[dict] = None) -> None:
         """Admission via the prompt cache: ship only the tail tokens."""
         t0 = time.monotonic()
         V = self.cfg.vocab_size
@@ -806,18 +912,40 @@ class Engine:
             for tid, bval in request.logit_bias.items():
                 if 0 <= int(tid) < V:
                     bias_rows[0, int(tid)] = bval
-        with_topk = request.grammar is not None
+        with_dfa = dfa_tables is not None
+        with_topk = request.grammar is not None and not with_dfa
         with_lp = request.logprobs > 0
-        fn = self._get_admit_cached(entry["pb"], tb, has_bias, with_topk, with_lp)
-        (
-            self.cache, self.counts, self.rngs, self.bias,
-            self.d_tokens, self.d_positions, toks, tk, lp,
-        ) = fn(
-            self.params, self.cache, self.counts, self.rngs, self.bias,
-            self.d_tokens, self.d_positions, entry["k"], entry["v"],
+        fn = self._get_admit_cached(entry["pb"], tb, has_bias, with_topk,
+                                    with_lp, with_dfa)
+        args = (
+            entry["k"], entry["v"],
             jnp.asarray(tail_toks), jnp.asarray(counts), jnp.asarray(aux),
             jnp.asarray(samp_pack), jnp.asarray(bias_rows),
         )
+        if with_dfa:
+            host = dfa_tables["host"]
+            row = np.unpackbits(
+                host.mask_bits[host.init_state], bitorder="little"
+            )[:V].astype(bool)
+            gmask0 = np.where(row, 0.0, -1e30).astype(np.float32)[None, :]
+            ginit = np.full((1,), host.init_state, np.int32)
+            out = fn(
+                self.params, self.cache, self.counts, self.rngs, self.bias,
+                self.d_tokens, self.d_positions, self.d_gstate, *args,
+                jnp.asarray(gmask0), dfa_tables["trans"],
+                dfa_tables["tok_cls"], jnp.asarray(ginit),
+            )
+        else:
+            out = fn(
+                self.params, self.cache, self.counts, self.rngs, self.bias,
+                self.d_tokens, self.d_positions, *args,
+            )
+        (
+            self.cache, self.counts, self.rngs, self.bias,
+            self.d_tokens, self.d_positions, toks, tk, lp,
+        ) = out[:9]
+        if with_dfa:
+            self.d_gstate = out[9]
         _host_copy_async(toks)
         # LRU bump + metrics. Identity scan, not `in`: dict == would compare
         # the numpy key arrays elementwise (and raises on length mismatch).
@@ -833,10 +961,11 @@ class Engine:
         self._slot_gen[slot_idx] += 1
         self.slots[slot_idx] = _Slot(
             request=request, handle=handle, prompt_len=len(ids), scheduled=1,
-            t_submit=t0,
+            t_submit=t0, dfa=with_dfa,
         )
         self.h_active[slot_idx] = True
         self.h_override_mask[slot_idx] = False
+        self.h_gmask[slot_idx] = 1.0 if with_dfa else 0.0
         self._inflight.append(_Entry(
             kind="admit", toks=toks, tk=tk, lp=lp, gen=list(self._slot_gen),
             items=[(slot_idx, request, handle, len(ids), t0)],
@@ -1080,6 +1209,8 @@ class Engine:
             out["prefix_cache_hits"] = float(self.m_prefix_hits)
             out["prefix_tokens_reused"] = float(self.m_prefix_tokens)
             out["prefix_cache_entries"] = float(len(self._prefix_entries))
+        if self.m_dfa_tokens:
+            out["grammar_dfa_tokens"] = float(self.m_dfa_tokens)
         if self.draft_cfg is not None:
             out["spec_rounds"] = float(self.m_spec_rounds)
             out["spec_tokens_accepted"] = float(self.m_spec_accepted)
@@ -1209,12 +1340,141 @@ class Engine:
                 return b
         return self.ecfg.max_seq
 
-    def _grammar_active(self) -> bool:
+    def _legacy_grammar_active(self) -> bool:
+        """Any active slot whose grammar needs the host candidate walk
+        (schema didn't compile to a DFA) — forces single-step blocks."""
         return any(
             self.h_active[i] and self.slots[i] is not None
             and self.slots[i].request.grammar is not None
+            and not self.slots[i].dfa
             for i in range(self.ecfg.max_slots)
         )
+
+    def _dfa_grammar_active(self) -> bool:
+        return any(
+            self.h_active[i] and self.slots[i] is not None and self.slots[i].dfa
+            for i in range(self.ecfg.max_slots)
+        )
+
+    # ------------------------------------------------------------------ #
+    # On-device grammar DFA (functions/dfa.py)
+    # ------------------------------------------------------------------ #
+
+    # Pad table shapes so programs compile once per bucket, not per schema.
+    _DFA_STATE_BUCKETS = (64, 256, 1024, 3073)
+    _DFA_CLASS_BUCKETS = (128, 256)
+
+    def _dfa_for(self, request: GenRequest) -> Optional[dict]:
+        """Device tables for this request's grammar, or None → host walk.
+
+        One table set is active at a time (schemas repeat across requests —
+        tool-calling reuses one for a whole deployment); it can only be
+        swapped while no DFA-constrained slot is live, because in-flight
+        per-slot states index the active set. A second concurrent schema
+        falls back to the host walk rather than waiting.
+        """
+        if request.grammar is None:
+            return None
+        if os.environ.get("LOCALAI_GRAMMAR_DFA", "1") == "0":
+            return None
+        schema = getattr(request.grammar, "schema", None)
+        from localai_tpu.functions import dfa as dfa_mod
+
+        key = dfa_mod.schema_key(schema)
+        if self._dfa is not None and self._dfa["key"] == key:
+            return self._dfa
+        if self._dfa_grammar_active():
+            return None  # active slots pin the current table set
+        if self._tok_strs is None:
+            self._tok_strs = self.tokenizer.token_strings()
+        # Table compilation takes seconds for large schemas. On an idle
+        # engine that only delays the requesting stream, so build inline;
+        # with other streams live, build on a worker thread and serve THIS
+        # request via the host-walk fallback — in-flight token streams never
+        # stall on a schema compile.
+        if key in self._dfa_building:
+            return None
+        if self.h_active.any() and not dfa_mod.is_cached(
+            schema, self._tok_fingerprint(), self.cfg.vocab_size
+        ):
+            self._dfa_building.add(key)
+
+            def build():
+                try:
+                    dfa_mod.tables_for(
+                        schema, self._tok_strs, set(self.tokenizer.eos_ids),
+                        self.cfg.vocab_size, tokenizer_id=self._tok_fingerprint(),
+                    )
+                finally:
+                    self._dfa_building.discard(key)
+                    self._wake.set()
+
+            threading.Thread(target=build, daemon=True,
+                             name="grammar-dfa-build").start()
+            return None
+        tables = dfa_mod.tables_for(
+            schema, self._tok_strs, set(self.tokenizer.eos_ids),
+            self.cfg.vocab_size, tokenizer_id=self._tok_fingerprint(),
+        )
+        if tables is None:
+            return None
+        S1, C = tables.trans.shape
+        S_pad = next((b for b in self._DFA_STATE_BUCKETS if b >= S1), None)
+        C_pad = next((b for b in self._DFA_CLASS_BUCKETS if b >= C), None)
+        if S_pad is None or C_pad is None:
+            return None
+        mask_bits = np.zeros((S_pad, tables.mask_bits.shape[1]), np.uint8)
+        mask_bits[:S1] = tables.mask_bits
+        trans = np.zeros((S_pad, C_pad), np.int16)
+        trans[:S1, :C] = tables.trans
+        self._dfa = {
+            "key": key,
+            "mask_bits": jnp.asarray(mask_bits),
+            "trans": jnp.asarray(trans),
+            "tok_cls": jnp.asarray(tables.tok_cls),
+            "host": tables,
+        }
+        log.info("grammar DFA ready: %d states (padded %d), schema %.60s...",
+                 S1, S_pad, key)
+        return self._dfa
+
+    def _tok_fingerprint(self) -> str:
+        """Stable identity of the tokenizer's string table for the DFA table
+        cache — id() can be reused after GC and would alias two different
+        tokenizers' tables."""
+        if self._tok_fp is None:
+            import hashlib
+
+            if self._tok_strs is None:
+                self._tok_strs = self.tokenizer.token_strings()
+            h = hashlib.md5()
+            h.update(str(len(self._tok_strs)).encode())
+            for s in self._tok_strs:
+                h.update(s.encode("utf-8", "surrogateescape"))
+                h.update(b"\x00")
+            self._tok_fp = h.hexdigest()
+        return self._tok_fp
+
+    @staticmethod
+    def _dfa_next_state(trans, tok_cls, state, tok):
+        """Walk each sampled token's char classes through the transition
+        table: state [B] i32, tok [B] i32 → next state [B] i32. The FREE row
+        (0) self-loops, so unconstrained slots are fixed points."""
+        seq = tok_cls[tok]  # [B, L] i16, -1 padded
+
+        def step(s, c):
+            nxt = trans[jnp.maximum(s, 0), jnp.maximum(c, 0).astype(jnp.int32)]
+            return jnp.where(c >= 0, nxt.astype(jnp.int32), s), None
+
+        s, _ = jax.lax.scan(step, state, seq.T)
+        return s
+
+    @staticmethod
+    def _dfa_allowed(mask_bits, state, V):
+        """Unpack per-state legality bits: state [B] → bool [B, V]."""
+        rows = mask_bits[state]  # [B, ceil(V/8)] u8
+        bits = (rows[:, :, None] >> jnp.arange(8, dtype=jnp.uint8)[None, None, :]) & 1
+        return bits.reshape(state.shape[0], -1)[:, :V].astype(bool)
 
     def _lp_active(self) -> bool:
         return any(
@@ -1233,7 +1493,9 @@ class Engine:
             last = now
 
             admitted = self._admit_pending()
-            grammar = self._grammar_active()
+            # Only host-walk grammars force single-step, serialized blocks;
+            # DFA-constrained slots pipeline at full depth like everyone else.
+            grammar = self._legacy_grammar_active()
             depth = 1 if grammar else self.ecfg.pipeline_depth
             nblocks = sum(1 for e in self._inflight if e.kind == "block")
             active = bool(self.h_active.any())
@@ -1356,6 +1618,9 @@ class Engine:
     ) -> None:
         m = len(chunk)
         V = self.cfg.vocab_size
+        dfa_tables = None
+        if m == 1 and chunk[0][0].grammar is not None and chunk[0][0].image_embeds is None:
+            dfa_tables = self._dfa_for(chunk[0][0])
         if m == 1 and chunk[0][0].image_embeds is None:
             # Without a hit from the admission round, scan here: covers
             # direct callers (tests, warmup) and round-memoized misses whose
@@ -1367,7 +1632,8 @@ class Engine:
             )
             if hit is not None:
                 self._dispatch_admit_cached(
-                    chunk[0][0], chunk[0][1], slot_ids[0], *hit
+                    chunk[0][0], chunk[0][1], slot_ids[0], *hit,
+                    dfa_tables=dfa_tables,
                 )
                 return
         t0 = time.monotonic()
@@ -1397,7 +1663,7 @@ class Engine:
                 for tid, bval in r.logit_bias.items():
                     if 0 <= int(tid) < V:
                         bias_rows[j, int(tid)] = bval
-            if r.grammar is not None:
+            if r.grammar is not None and dfa_tables is None:
                 with_topk = True
             if r.logprobs > 0:
                 with_lp = True
@@ -1409,7 +1675,9 @@ class Engine:
             n_img = int(np.asarray(chunk[0][0].image_embeds).shape[0])
         trace = os.environ.get("LOCALAI_ENGINE_TRACE", "0") == "1"
         t_a = time.monotonic()
-        fn = self._get_admit(m, bucket, has_bias, with_topk, with_lp, n_img)
+        with_dfa = dfa_tables is not None
+        fn = self._get_admit(m, bucket, has_bias, with_topk, with_lp, n_img,
+                             with_dfa=with_dfa)
         t_b = time.monotonic()
         args_in = (
             jnp.asarray(prompt_toks), jnp.asarray(aux), jnp.asarray(samp_pack),
@@ -1419,24 +1687,43 @@ class Engine:
             embeds = np.asarray(chunk[0][0].image_embeds, np.float32)[None]  # [1, N, D]
             offsets = np.asarray([chunk[0][0].image_offset], np.int32)
             args_in = args_in + (jnp.asarray(embeds), jnp.asarray(offsets))
+        if with_dfa:
+            host = dfa_tables["host"]
+            row = np.unpackbits(
+                host.mask_bits[host.init_state], bitorder="little"
+            )[:V].astype(bool)
+            gmask0 = np.where(row, 0.0, -1e30).astype(np.float32)[None, :]
+            ginit = np.full((m,), host.init_state, np.int32)
+            args_in = args_in + (
+                jnp.asarray(gmask0), dfa_tables["trans"], dfa_tables["tok_cls"],
+                jnp.asarray(ginit),
+            )
         t_c = time.monotonic()
         if self.draft_cfg is None:
-            (
-                self.cache, self.counts, self.rngs, self.bias,
-                self.d_tokens, self.d_positions, toks, tk, lp,
-            ) = fn(
-                self.params, self.cache, self.counts, self.rngs, self.bias,
-                self.d_tokens, self.d_positions, *args_in,
-            )
+            pre = (self.params, self.cache, self.counts, self.rngs, self.bias,
+                   self.d_tokens, self.d_positions)
+            if with_dfa:
+                pre = pre + (self.d_gstate,)
+            out = fn(*pre, *args_in)
         else:
-            (
-                self.cache, self.counts, self.rngs, self.bias,
-                self.d_tokens, self.d_positions, toks, tk, lp, self.d_cache,
-            ) = fn(
-                self.params, self.cache, self.counts, self.rngs, self.bias,
-                self.d_tokens, self.d_positions, self.draft_params, self.d_cache,
-                *args_in,
-            )
+            pre = (self.params, self.cache, self.counts, self.rngs, self.bias,
+                   self.d_tokens, self.d_positions, self.draft_params,
+                   self.d_cache)
+            if with_dfa:
+                # admit_spec takes the dfa inputs after bias_rows, d_gstate last.
+                out = fn(*pre, *args_in, self.d_gstate)
+            else:
+                out = fn(*pre, *args_in)
+        (
+            self.cache, self.counts, self.rngs, self.bias,
+            self.d_tokens, self.d_positions, toks, tk, lp,
+        ) = out[:9]
+        rest = out[9:]
+        if with_dfa:
+            self.d_gstate = rest[0]
+            rest = rest[1:]
+        if self.draft_cfg is not None:
+            self.d_cache = rest[0]
         t_d = time.monotonic()
         _host_copy_async(toks)
         if trace:
@@ -1449,10 +1736,12 @@ class Engine:
                 self.h_sampling[k][slot_idx] = getattr(r, k)
             self._slot_gen[slot_idx] += 1
             self.slots[slot_idx] = _Slot(
-                request=r, handle=handle, prompt_len=int(aux[0, j]), scheduled=1, t_submit=t0
+                request=r, handle=handle, prompt_len=int(aux[0, j]), scheduled=1,
+                t_submit=t0, dfa=with_dfa,
             )
             self.h_active[slot_idx] = True
             self.h_override_mask[slot_idx] = False
+            self.h_gmask[slot_idx] = 1.0 if with_dfa else 0.0
             items.append((slot_idx, r, handle, int(aux[0, j]), t0))
             if r.image_embeds is None:
                 self._prefix_save(slot_idx, r.prompt_ids, int(aux[0, j]))
@@ -1506,6 +1795,7 @@ class Engine:
             any_temp = any(hs["temperature"][i] > 0 for i in act)
             variant = "filtered" if needs_filter else ("simple" if any_temp else "greedy")
             n = self._pick_block_size()
+        with_dfa = self._dfa_grammar_active()
 
         with_lp = self._lp_active()
         # Stochastic verify keeps speculation exact for sampled requests too
@@ -1514,26 +1804,41 @@ class Engine:
         if (
             self.draft_cfg is not None
             and not grammar
+            and not with_dfa
             and not with_lp
             and not self.h_override_mask.any()
         ):
             self._dispatch_spec_block()
             return
         active_snapshot = self.h_active.copy()
-        pack = np.zeros((10, B), np.float32)
+        pack = np.zeros((11 if with_dfa else 10, B), np.float32)
         pack[0] = active_snapshot
         for fi, k in enumerate(_SAMPLING_FIELDS):
             pack[1 + fi] = self.h_sampling[k]
         pack[8] = self.h_override_tok
         pack[9] = self.h_override_mask
-        fn = self._get_block(variant, n, with_lp)
-        (
-            self.cache, self.counts, self.rngs, self.d_tokens, self.d_positions,
-            toks_block, tk_block, lp_block,
-        ) = fn(
-            self.params, self.cache, self.counts, self.rngs, self.bias,
-            self.d_tokens, self.d_positions, jnp.asarray(pack),
-        )
+        if with_dfa:
+            pack[10] = self.h_gmask
+        fn = self._get_block(variant, n, with_lp, with_dfa)
+        if with_dfa:
+            d = self._dfa
+            (
+                self.cache, self.counts, self.rngs, self.d_tokens,
+                self.d_positions, toks_block, tk_block, lp_block, self.d_gstate,
+            ) = fn(
+                self.params, self.cache, self.counts, self.rngs, self.bias,
+                self.d_tokens, self.d_positions, jnp.asarray(pack),
+                d["mask_bits"], d["trans"], d["tok_cls"], self.d_gstate,
+            )
+            self.m_dfa_tokens += n * int((self.h_gmask * active_snapshot).sum())
+        else:
+            (
+                self.cache, self.counts, self.rngs, self.d_tokens, self.d_positions,
+                toks_block, tk_block, lp_block,
+            ) = fn(
+                self.params, self.cache, self.counts, self.rngs, self.bias,
+                self.d_tokens, self.d_positions, jnp.asarray(pack),
+            )
         _host_copy_async(toks_block)
         if tk_block is not None:
             _host_copy_async(tk_block)
@@ -1620,7 +1925,7 @@ class Engine:
                 if slot is None:
                     continue
                 tok = int(toks[j])
-                if request.grammar is not None:
+                if request.grammar is not None and not slot.dfa:
                     chosen = self._grammar_choose(request, tok, tk[j])
                     if chosen is None:
                         handle._q.put(TokenEvent(
@@ -1648,7 +1953,7 @@ class Engine:
                 if slot is None:
                     continue
                 tok = int(toks[step, i])
-                if slot.request.grammar is not None:
+                if slot.request.grammar is not None and not slot.dfa:
                     chosen = self._grammar_choose(slot.request, tok, tk[step, i])
                     if chosen is None:
                         slot.handle._q.put(TokenEvent(
@@ -1763,8 +2068,10 @@ class Engine:
             logprob = float(tok_lp)
             # Grammar overrides replace the sampled token; recover the
             # emitted token's logprob from the top-LK list when possible.
+            # (DFA slots sample directly from the masked distribution, so
+            # their tok_lp already describes the emitted token.)
             ids = lp_ids.tolist()
-            if r.grammar is not None:
+            if r.grammar is not None and not slot.dfa:
                 logprob = float(lp_vals[ids.index(tok)]) if tok in ids else None
             top_logprobs = [
                 (int(i), float(v)) for i, v in zip(ids[: r.logprobs], lp_vals[: r.logprobs])
@@ -1793,7 +2100,11 @@ class Engine:
             if cut is not None:
                 new = text[slot.emitted_len: cut]
                 finish = "stop"
-        if finish is None and r.grammar is not None and r.grammar.strictly_complete():
+        # DFA slots have no host-side machine to consult; they finish via
+        # EOS instead (a strictly-complete automaton state masks everything
+        # but EOS, so the very next sample ends the request).
+        if (finish is None and r.grammar is not None and not slot.dfa
+                and r.grammar.strictly_complete()):
             finish = "stop"  # constrained output can no longer be extended — done
         if finish is None and (
             len(slot.generated) >= r.max_new_tokens
@@ -1855,3 +2166,4 @@ class Engine:
         self.slots[slot_idx] = None
         self.h_active[slot_idx] = False
         self.h_override_mask[slot_idx] = False
+        self.h_gmask[slot_idx] = 0.0

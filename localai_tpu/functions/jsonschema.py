@@ -517,6 +517,7 @@ class GrammarConstraint:
     """
 
     def __init__(self, schema: Any = None):
+        self.schema = schema  # retained for DFA compilation (functions/dfa.py)
         self.machine = JsonSchemaMachine(schema)
 
     def allowed(self, token_text: str) -> bool:

@@ -1,0 +1,265 @@
+"""On-device grammar DFA (functions/dfa.py + engine integration).
+
+The schema→DFA compiler must agree character-for-character with the
+pushdown machine it is compiled from (functions/jsonschema.py), and the
+engine's DFA path must produce schema-valid output with NO host candidate
+walk — constrained slots run in full-depth fused blocks (SURVEY §7:
+"grammar decode without host round-trips per token").
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from localai_tpu.engine.engine import Engine, EngineConfig, GenRequest
+from localai_tpu.engine.tokenizer import ByteTokenizer
+from localai_tpu.functions.dfa import (
+    DfaUnsupported,
+    build_token_tables,
+    compile_schema_dfa,
+    tables_for,
+)
+from localai_tpu.functions.jsonschema import GrammarConstraint, JsonSchemaMachine
+from localai_tpu.models import get_arch
+from localai_tpu.models.llama import init_params
+
+TOOL_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "name": {"enum": ["get_weather", "search_web"]},
+        "arguments": {
+            "type": "object",
+            "properties": {
+                "location": {"type": "string"},
+                "unit": {"enum": ["celsius", "fahrenheit"]},
+                "days": {"type": "integer"},
+            },
+            "required": ["location"],
+        },
+    },
+    "required": ["name", "arguments"],
+}
+
+SCHEMAS = [
+    TOOL_SCHEMA,
+    {"type": "object", "properties": {"a": {"type": "integer"}, "b": {"type": "boolean"}},
+     "required": ["a", "b"]},
+    {"type": "array", "items": {"type": "number"}, "minItems": 1},
+    {"enum": ["yes", "no", "maybe"]},
+    {"type": "string"},
+]
+
+PROBES = [
+    '{"name": "get_weather", "arguments": {"location": "NYC", "days": 3}}',
+    '{"name": "bogus"',
+    '{"a": -12, "b": false}',
+    '{"a": 1.5}',
+    '[1, 2.5, -3e2]',
+    '[]',
+    '"yes"',
+    '"maybe',
+    '"hello \\"world\\" \\u00e9"',
+    '"ctrl \x02 inside"',  # raw control chars are illegal in JSON strings
+    '"tab\there"',
+    '{  "a" : 1 }',
+    'true',
+]
+
+
+@pytest.mark.parametrize("schema", SCHEMAS, ids=[str(i) for i in range(len(SCHEMAS))])
+def test_dfa_matches_machine_char_by_char(schema):
+    dfa = compile_schema_dfa(schema)
+    for text in PROBES:
+        m = JsonSchemaMachine(schema)
+        s = 0
+        for i, ch in enumerate(text):
+            ok_m = m.feed(ch)
+            s2 = int(dfa.trans[s, dfa.class_of(ch)])
+            assert ok_m == (s2 >= 0), (text, i, ch, ok_m)
+            if not ok_m:
+                break
+            s = s2
+        else:
+            assert bool(dfa.accept[s]) == m.is_complete(), text
+
+
+def test_unbounded_array_stays_finite():
+    dfa = compile_schema_dfa({"type": "array", "items": {"type": "integer"}})
+    assert dfa.trans.shape[0] < 40
+    m = JsonSchemaMachine({"type": "array", "items": {"type": "integer"}})
+    s = 0
+    for ch in "[1, 22, 333, 4, 5, 6, 7, 8, 9, 10, 11]":
+        assert m.feed(ch)
+        s = int(dfa.trans[s, dfa.class_of(ch)])
+        assert s >= 0, ch
+    assert bool(dfa.accept[s]) and m.is_complete()
+
+
+def test_state_budget_raises():
+    with pytest.raises(DfaUnsupported):
+        compile_schema_dfa(TOOL_SCHEMA, max_states=16)
+
+
+def test_token_tables_follow_machine():
+    """Byte-level vocab: every char of a valid document must be legal at its
+    state, EOS exactly at accept, FREE row all-legal and self-looping."""
+    dfa = compile_schema_dfa(TOOL_SCHEMA)
+    tok_strs = [chr(c) for c in range(256)] + ['{"', 'get_weather', " " * 64, ""]
+    V = len(tok_strs)
+    eos_ids = {V - 1}
+    tt = build_token_tables(dfa, tok_strs, eos_ids, V)
+
+    def unpack(row):
+        return np.unpackbits(row, bitorder="little")[:V].astype(bool)
+
+    def walk(s, t):
+        for c in tt.tok_cls[t]:
+            if c < 0:
+                break
+            s = int(tt.trans[s, c])
+        return s
+
+    text = '{"name": "search_web", "arguments": {"location": "SF"}}'
+    s = tt.init_state
+    g = GrammarConstraint(TOOL_SCHEMA)
+    for ch in text:
+        t = ord(ch)
+        assert unpack(tt.mask_bits[s])[t], (ch, s)
+        assert g.allowed(ch)
+        g.advance(ch)
+        s = walk(s, t)
+    assert unpack(tt.mask_bits[s])[V - 1] and g.complete()
+    assert not unpack(tt.mask_bits[tt.init_state])[ord("}")]
+    assert unpack(tt.mask_bits[tt.init_state])[256]  # multi-char '{"'
+    assert not unpack(tt.mask_bits[tt.init_state])[258]  # 64 spaces > MAX_TOK_LEN
+    assert unpack(tt.mask_bits[0]).all()  # FREE row
+    assert walk(0, 256) == 0
+
+
+def test_tables_for_caches_and_rejects():
+    toks = [chr(c) for c in range(256)]
+    a = tables_for({"type": "boolean"}, toks, {255}, 256, tokenizer_id="t")
+    b = tables_for({"type": "boolean"}, toks, {255}, 256, tokenizer_id="t")
+    assert a is b  # cached
+    assert tables_for(TOOL_SCHEMA, toks, {255}, 256, tokenizer_id="t",
+                      max_states=16) is None  # over budget → fallback signal
+
+
+# --------------------------------------------------------------------- #
+# Engine integration
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = get_arch("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    eng = Engine(cfg, params, ByteTokenizer(cfg.vocab_size),
+                 engine_cfg=EngineConfig(max_slots=4, max_seq=256))
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def _gen(eng, schema, **kw):
+    kw.setdefault("max_new_tokens", 120)
+    h = eng.submit(GenRequest(prompt_ids=[10, 20, 30],
+                              grammar=GrammarConstraint(schema), **kw))
+    return h.result()
+
+
+def test_engine_dfa_greedy_valid_json(engine):
+    before = engine.m_dfa_tokens
+    text, ev = _gen(engine, SCHEMAS[1], temperature=0.0)
+    assert ev.kind == "done" and ev.finish_reason == "stop"
+    obj = json.loads(text)
+    assert isinstance(obj["a"], int) and isinstance(obj["b"], bool)
+    assert engine.m_dfa_tokens > before, "DFA path did not engage"
+    assert engine.metrics().get("grammar_dfa_tokens", 0) > 0
+
+
+def test_engine_dfa_sampled_and_mixed_batch(engine):
+    h_plain = engine.submit(GenRequest(prompt_ids=[1, 2, 3], max_new_tokens=24,
+                                       temperature=0.9, seed=5))
+    text, ev = _gen(engine, SCHEMAS[1], temperature=0.8, seed=11)
+    t_plain, e_plain = h_plain.result()
+    assert ev.kind == "done" and e_plain.kind == "done"
+    obj = json.loads(text)
+    assert isinstance(obj["a"], int) and isinstance(obj["b"], bool)
+    assert len(t_plain) > 0  # unconstrained slot unaffected
+
+
+def test_engine_dfa_seeded_reproducible(engine):
+    t1, ev1 = _gen(engine, TOOL_SCHEMA, temperature=0.7, seed=42)
+    t2, _ = _gen(engine, TOOL_SCHEMA, temperature=0.7, seed=42)
+    assert t1 == t2
+    # A random-weights model may exhaust max_new_tokens mid-string; the
+    # invariant is that every emitted char is schema-valid (a legal prefix).
+    m = JsonSchemaMachine(TOOL_SCHEMA)
+    assert m.feed_text(t1), t1
+    if ev1.finish_reason == "stop":
+        obj = json.loads(t1)
+        assert obj["name"] in ("get_weather", "search_web")
+
+
+def test_engine_dfa_with_prefix_cache(engine):
+    """A grammar request whose prompt hits the prefix cache admits through
+    the cached+DFA program and still produces valid constrained output."""
+    shared = list(range(2, 60))
+    # Seed the span with a plain request.
+    h = engine.submit(GenRequest(prompt_ids=shared + [99], max_new_tokens=4,
+                                 temperature=0.0))
+    h.result()
+    hits = engine.m_prefix_hits
+    h2 = engine.submit(GenRequest(prompt_ids=shared + [98, 97], max_new_tokens=120,
+                                  temperature=0.0,
+                                  grammar=GrammarConstraint(SCHEMAS[1])))
+    text, ev = h2.result()
+    assert ev.kind == "done"
+    assert engine.m_prefix_hits > hits, "prefix cache did not engage"
+    obj = json.loads(text)
+    assert isinstance(obj["a"], int) and isinstance(obj["b"], bool)
+
+
+def test_engine_dfa_async_build_when_busy(engine):
+    """A novel schema arriving while other streams are live must not stall
+    the loop: the first request serves via the host walk while tables build
+    on a worker thread; once cached, the same schema runs on the DFA."""
+    import time as _t
+
+    from localai_tpu.functions import dfa as dfa_mod
+
+    schema = {"type": "object", "properties": {"z": {"type": "integer"}},
+              "required": ["z"]}
+    h_long = engine.submit(GenRequest(prompt_ids=[1, 2, 3], max_new_tokens=200,
+                                      temperature=0.9, seed=3))
+    text, ev = _gen(engine, schema, temperature=0.0)
+    assert ev.kind == "done"
+    assert JsonSchemaMachine(schema).feed_text(text), text
+    h_long.result()
+    deadline = _t.monotonic() + 15
+    while _t.monotonic() < deadline and not dfa_mod.is_cached(
+        schema, engine._tok_fingerprint(), engine.cfg.vocab_size
+    ):
+        _t.sleep(0.05)
+    assert dfa_mod.is_cached(schema, engine._tok_fingerprint(),
+                             engine.cfg.vocab_size)
+    before = engine.m_dfa_tokens
+    text2, ev2 = _gen(engine, schema, temperature=0.0)
+    assert ev2.kind == "done"
+    assert json.loads(text2)["z"] is not None
+    assert engine.m_dfa_tokens > before
+
+
+def test_engine_legacy_fallback(engine, monkeypatch):
+    """With the DFA disabled, the host candidate walk still serves the
+    request (and stays the path for schemas that exceed the state budget)."""
+    monkeypatch.setenv("LOCALAI_GRAMMAR_DFA", "0")
+    before = engine.m_dfa_tokens
+    text, ev = _gen(engine, SCHEMAS[1], temperature=0.0)
+    assert ev.kind == "done"
+    obj = json.loads(text)
+    assert isinstance(obj["a"], int) and isinstance(obj["b"], bool)
+    assert engine.m_dfa_tokens == before  # DFA untouched
