@@ -187,6 +187,57 @@ def main() -> None:
 
     eng.stop()
 
+    # Paged-KV row (SURVEY §7 ragged/paged KV): same arch/params served from
+    # a shared page pool at 60% of the dense cache budget — decode tok/s
+    # must hold while HBM scales with live context instead of slots×max_seq.
+    if os.environ.get("BENCH_PAGED", "1") != "0" and max_seq % 128 == 0:
+        peng = None
+        try:
+            # Release the stopped dense engine's HBM (cache + sharded params
+            # + prefix spans) first — the paged pool must not have to fit ON
+            # TOP of the dense cache it is meant to replace.
+            eng.cache = None
+            eng.params = None
+            eng._prefix_entries = []
+            page = 128
+            pool = max(2, int(slots * (max_seq // page) * 0.6))
+            peng = Engine(
+                cfg, params, ByteTokenizer(cfg.vocab_size),
+                engine_cfg=EngineConfig(max_slots=slots, max_seq=max_seq,
+                                        kv_pages=pool, kv_page_size=page),
+            )
+            peng.start()
+            # Full warmup (every admission size + block size), like the main
+            # engine: a mid-measurement admission compile would otherwise be
+            # booked into decode time and crater the row.
+            peng.warmup(prompt_len)
+            peng._decode_time = 0.0
+            peng._decode_tokens = 0
+
+            def pone(i: int) -> None:
+                ids = [(i * 37 + j) % 255 + 1 for j in range(prompt_len)]
+                peng.generate(ids, max_new_tokens=gen_len, ignore_eos=True)
+
+            pthreads = [threading.Thread(target=pone, args=(i,)) for i in range(slots)]
+            for t in pthreads:
+                t.start()
+            for t in pthreads:
+                t.join()
+            ptps = (peng._decode_tokens / peng._decode_time
+                    if peng._decode_time else 0.0)
+            out["decode_tokens_per_sec_paged"] = round(ptps, 2)
+            out["paged_pool_fraction_of_dense"] = 0.6
+            out["paged_vs_dense_tps"] = round(ptps / max(decode_tps, 1e-9), 2)
+            print(
+                f"paged kv: {ptps:.1f} tok/s at 60% of the dense cache "
+                f"({pool} pages x {page}) vs dense {decode_tps:.1f}",
+                file=sys.stderr,
+            )
+        except Exception as e:  # noqa: BLE001 — extra row is best-effort
+            print(f"paged row failed: {type(e).__name__}: {e}", file=sys.stderr)
+        finally:
+            if peng is not None:
+                peng.stop()
 
     # MoE dispatch row (VERDICT r2 item 5): one Mixtral-shaped layer's MLP,
     # dense all-experts vs exact top-k ragged_dot, same inputs.

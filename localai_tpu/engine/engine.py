@@ -117,6 +117,15 @@ class EngineConfig:
     # half a chip. Eviction honors whichever limit trips first; a span
     # bigger than the whole budget is simply not saved.
     prefix_cache_bytes: int = 1 << 30
+    # Paged KV cache (SURVEY §7 ragged/paged KV; vLLM PagedAttention role):
+    # kv_pages > 0 replaces the dense [slots, max_seq] cache with a shared
+    # page pool — HBM scales with live context, not slots × max_seq, so many
+    # short chats and one long one share a pool neither could afford dense.
+    # Admission reserves a request's worst case (prompt + max_new_tokens)
+    # up front: pool exhaustion queues new requests (backpressure) instead
+    # of preempting live ones. 0 = dense cache.
+    kv_pages: int = 0
+    kv_page_size: int = 128
 
     def buckets(self) -> list[int]:
         out, b = [], self.min_prefill_bucket
@@ -317,17 +326,51 @@ class Engine:
                 self.params = jax.jit(
                     lambda p: quantize_params(cfg, p, quantization)
                 )(self.params)
-            kshard, vshard = cache_shardings(self.mesh, self.plan.sp)
-            self.cache = llama.KVCache(
-                k=jax.device_put(
-                    jnp.zeros((cfg.num_layers, B, S, cfg.num_kv_heads, cfg.head_dim_), jnp.dtype(cfg.dtype)),
-                    kshard,
-                ),
-                v=jax.device_put(
-                    jnp.zeros((cfg.num_layers, B, S, cfg.num_kv_heads, cfg.head_dim_), jnp.dtype(cfg.dtype)),
-                    vshard,
-                ),
-            )
+            if self.ecfg.kv_pages > 0:
+                # Paged pool [L, P, page, K, Hd]: kv-heads shard over tp;
+                # pages are shared across slots, so dp/sp don't apply.
+                if self.plan.dp > 1 or self.plan.sp > 1:
+                    raise ValueError(
+                        "paged KV cache (kv_pages > 0) requires dp == sp == 1"
+                    )
+                if draft_cfg is not None:
+                    raise ValueError(
+                        "paged KV cache with a draft model is not supported "
+                        "yet — drop kv_pages or the draft"
+                    )
+                if S % self.ecfg.kv_page_size:
+                    raise ValueError(
+                        f"max_seq={S} must divide by kv_page_size="
+                        f"{self.ecfg.kv_page_size}"
+                    )
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                pool_shard = NamedSharding(self.mesh, P(None, None, None, "tp", None))
+                # +1: the last page is SCRATCH — every unassigned/stale page
+                # table entry points there, so idle slots and end-of-request
+                # overshoot rows (the decode block writes all B slots every
+                # step) land in a page nobody attends instead of corrupting
+                # a live request's pages.
+                pool = llama.paged_cache_zeros(
+                    cfg, self.ecfg.kv_pages + 1, self.ecfg.kv_page_size
+                )
+                self.cache = llama.KVCache(
+                    k=jax.device_put(pool.k, pool_shard),
+                    v=jax.device_put(pool.v, pool_shard),
+                )
+            else:
+                kshard, vshard = cache_shardings(self.mesh, self.plan.sp)
+                self.cache = llama.KVCache(
+                    k=jax.device_put(
+                        jnp.zeros((cfg.num_layers, B, S, cfg.num_kv_heads, cfg.head_dim_), jnp.dtype(cfg.dtype)),
+                        kshard,
+                    ),
+                    v=jax.device_put(
+                        jnp.zeros((cfg.num_layers, B, S, cfg.num_kv_heads, cfg.head_dim_), jnp.dtype(cfg.dtype)),
+                        vshard,
+                    ),
+                )
         self.draft_params = None
         self.d_cache = None
         if draft_cfg is not None:
@@ -407,7 +450,51 @@ class Engine:
         self._snap_cache: dict[int, Any] = {}
         self.m_prefix_hits = 0
         self.m_prefix_tokens = 0
+        # Paged KV: host-side page accounting. h_ptable mirrors each slot's
+        # page list (shipped to the device with every dispatch — [B, MP] i32
+        # is tiny); _free_pages is the allocator.
+        self._max_pages = (
+            self.ecfg.max_seq // self.ecfg.kv_page_size
+            if self.ecfg.kv_pages else 0
+        )
+        self._scratch_page = self.ecfg.kv_pages  # pool row nobody attends
+        self.h_ptable = np.full(
+            (B, max(self._max_pages, 1)), self._scratch_page, np.int32
+        )
+        self._free_pages: list[int] = list(range(self.ecfg.kv_pages))
+        self._slot_pages: list[list[int]] = [[] for _ in range(B)]
         self._build_programs()
+
+    @property
+    def _paged(self) -> bool:
+        return self.ecfg.kv_pages > 0
+
+    def _pages_needed(self, request: GenRequest) -> int:
+        """Worst-case pages for a request: the prefill writes a full bucket
+        of rows (padding included), and decode extends to prompt+max_new."""
+        plen = len(request.prompt_ids)
+        rows = max(self._bucket_for(plen),
+                   min(plen + request.max_new_tokens, self.ecfg.max_seq))
+        return -(-rows // self.ecfg.kv_page_size)
+
+    def _pages_alloc(self, slot_idx: int, n: int) -> Optional[np.ndarray]:
+        if len(self._free_pages) < n:
+            return None
+        pages = [self._free_pages.pop() for _ in range(n)]
+        self._slot_pages[slot_idx] = pages
+        # Unused tail entries point at SCRATCH so any row past the slot's
+        # reservation (end-of-request block overshoot) lands harmlessly.
+        row = np.full((self._max_pages,), self._scratch_page, np.int32)
+        row[: n] = pages
+        self.h_ptable[slot_idx] = row
+        return row
+
+    def _pages_free(self, slot_idx: int) -> None:
+        self._free_pages.extend(self._slot_pages[slot_idx])
+        self._slot_pages[slot_idx] = []
+        # The slot stays in every decode block's scatter until re-admitted —
+        # its stale table must not alias pages handed to the next request.
+        self.h_ptable[slot_idx] = self._scratch_page
 
     # ------------------------------------------------------------------ #
     # Compiled programs
@@ -472,8 +559,11 @@ class Engine:
         K = min(self.GRAMMAR_TOPK, V)
         LK = min(self.LOGPROB_TOPK, V)
 
+        paged = self._paged
+
         def block(params, cache, counts, rngs, bias, tokens, positions, pack,
-                  mask_bits=None, gtrans=None, tok_cls=None, gstate=None):
+                  ptable=None, mask_bits=None, gtrans=None, tok_cls=None,
+                  gstate=None):
             active = pack[0] > 0
             samp = SamplingParams(
                 temperature=pack[1], top_k=pack[2].astype(jnp.int32),
@@ -500,10 +590,22 @@ class Engine:
 
             def body(carry, step):
                 tokens, positions, counts, rngs, lk, lv, gs = carry
-                logits, lk, lv = llama.decode_step_windowed(
-                    cfg, params, tokens, positions, cache, lk, lv, step,
-                    ep=self.plan.ep, mesh=self._ring_mesh,
-                )
+                if paged:
+                    # Idle/released slots' positions keep ratcheting toward
+                    # S-1 (the carry advances every slot); left unmasked
+                    # they would drive the paged fori_loop bound to the full
+                    # table forever. Their compute is discarded anyway, so
+                    # pin them to 0 for this step's attention.
+                    pos_eff = jnp.where(active, positions, 0)
+                    logits, lk, lv = llama.decode_step_windowed(
+                        cfg, params, tokens, pos_eff, cache, lk, lv, step,
+                        ep=self.plan.ep, ptable=ptable,
+                    )
+                else:
+                    logits, lk, lv = llama.decode_step_windowed(
+                        cfg, params, tokens, positions, cache, lk, lv, step,
+                        ep=self.plan.ep, mesh=self._ring_mesh,
+                    )
                 split = jax.vmap(lambda k: jax.random.split(k, 2))(rngs)
                 rngs, draw = split[:, 0], split[:, 1]
                 if with_dfa:
@@ -548,7 +650,12 @@ class Engine:
                 body, (tokens, positions, counts, rngs, local_k, local_v, gs0),
                 jnp.arange(n),
             )
-            cache = llama.write_block_to_cache(cache, local_k, local_v, start_pos)
+            if paged:
+                cache = llama.write_block_to_pool(
+                    cache, ptable, local_k, local_v, start_pos
+                )
+            else:
+                cache = llama.write_block_to_cache(cache, local_k, local_v, start_pos)
             toks_block = outs[0]  # [n, B]
             tk_block = outs[1] if variant == "grammar" else None
             lp_block = tuple(outs[-3:]) if with_lp else None  # ([n,B],[n,B,LK],[n,B,LK])
@@ -557,8 +664,24 @@ class Engine:
                 out = out + (gs,)
             return out
 
-        donate = (1, 2, 3, 5, 6) + ((11,) if with_dfa else ())
-        fn = jax.jit(block, donate_argnums=donate)
+        # Positional wrapper: [8 base] [ptable?] [dfa: mask, trans, cls,
+        # gstate] — mirrors _dispatch_block's argument assembly.
+        def wrapped(*args):
+            i = 8
+            ptable = None
+            if paged:
+                ptable = args[i]
+                i += 1
+            mask_bits = gtrans = tok_cls = gstate = None
+            if with_dfa:
+                mask_bits, gtrans, tok_cls, gstate = args[i: i + 4]
+            return block(*args[:8], ptable=ptable, mask_bits=mask_bits,
+                         gtrans=gtrans, tok_cls=tok_cls, gstate=gstate)
+
+        donate = (1, 2, 3, 5, 6)
+        if with_dfa:
+            donate = donate + (8 + (1 if paged else 0) + 3,)
+        fn = jax.jit(wrapped, donate_argnums=donate)
         self._block_cache[key] = fn
         return fn
 
@@ -599,7 +722,7 @@ class Engine:
         def admit(params, cache, counts, rngs, bias, d_tokens, d_positions,
                   prompt_toks, aux, samp_pack, bias_rows, img_embeds=None,
                   img_offsets=None, gmask0=None, gtrans=None, tok_cls=None,
-                  ginit=None, d_gstate=None):
+                  ginit=None, d_gstate=None, ptable=None):
             lens, slot_ids, seeds = aux[0], aux[1], aux[2]
             samp = SamplingParams(
                 temperature=samp_pack[0], top_k=samp_pack[1].astype(jnp.int32),
@@ -635,9 +758,12 @@ class Engine:
                 gnext = self._dfa_next_state(gtrans, tok_cls, ginit, toks)  # [m]
             for j in range(m):  # m is static and small — unrolled
                 s = slot_ids[j]
-                cache = llama.write_prefill_to_cache(
-                    cache, ks[:, j:j + 1], vs[:, j:j + 1], s
-                )
+                if ptable is not None:
+                    cache = llama.write_prefill_to_pool(cache, ptable[j], ks, vs, j)
+                else:
+                    cache = llama.write_prefill_to_cache(
+                        cache, ks[:, j:j + 1], vs[:, j:j + 1], s
+                    )
                 counts = counts.at[s].set(rows[j])
                 rngs = rngs.at[s].set(keys0[j])
                 bias = bias.at[s].set(brows[j])
@@ -650,22 +776,38 @@ class Engine:
                 out = out + (d_gstate,)
             return out
 
+        paged = self._paged
         if self.draft_cfg is None:
-            donate = (1, 2, 3, 4, 5, 6)
-            if with_dfa:
-                def admit_dfa(params, cache, counts, rngs, bias, d_tokens,
-                              d_positions, d_gstate, prompt_toks, aux,
-                              samp_pack, bias_rows, gmask0, gtrans, tok_cls,
-                              ginit):
-                    return admit(params, cache, counts, rngs, bias, d_tokens,
-                                 d_positions, prompt_toks, aux, samp_pack,
-                                 bias_rows, gmask0=gmask0, gtrans=gtrans,
-                                 tok_cls=tok_cls, ginit=ginit,
-                                 d_gstate=d_gstate)
+            # Uniform positional wrapper: [7 state] [d_gstate?] [4 request]
+            # [img 2?] [dfa 4?] [ptable?] — mirrors _dispatch_admit's arg
+            # assembly so every flag combination shares one code path.
+            def wrapped(*args):
+                i = 7
+                params, cache, counts, rngs, bias, d_tokens, d_positions = args[:7]
+                d_gstate = None
+                if with_dfa:
+                    d_gstate = args[i]
+                    i += 1
+                prompt_toks, aux, samp_pack, bias_rows = args[i: i + 4]
+                i += 4
+                img_embeds = img_offsets = None
+                if n_img:
+                    img_embeds, img_offsets = args[i: i + 2]
+                    i += 2
+                gmask0 = gtrans = tok_cls = ginit = None
+                if with_dfa:
+                    gmask0, gtrans, tok_cls, ginit = args[i: i + 4]
+                    i += 4
+                ptable = args[i] if paged else None
+                return admit(params, cache, counts, rngs, bias, d_tokens,
+                             d_positions, prompt_toks, aux, samp_pack,
+                             bias_rows, img_embeds=img_embeds,
+                             img_offsets=img_offsets, gmask0=gmask0,
+                             gtrans=gtrans, tok_cls=tok_cls, ginit=ginit,
+                             d_gstate=d_gstate, ptable=ptable)
 
-                fn = jax.jit(admit_dfa, donate_argnums=donate + (7,))
-            else:
-                fn = jax.jit(admit, donate_argnums=donate)
+            donate = (1, 2, 3, 4, 5, 6) + ((7,) if with_dfa else ())
+            fn = jax.jit(wrapped, donate_argnums=donate)
         else:
             dcfg = self.draft_cfg
 
@@ -794,7 +936,11 @@ class Engine:
 
     @property
     def _prefix_enabled(self) -> bool:
-        return self.ecfg.prefix_cache_entries > 0 and self.draft_cfg is None
+        # Paged mode: spans live in pool pages owned by slots, so the dense
+        # snapshot/copy-back machinery doesn't apply (copy-on-write page
+        # sharing is the paged-native follow-up).
+        return (self.ecfg.prefix_cache_entries > 0 and self.draft_cfg is None
+                and not self._paged)
 
     def _prefix_find(self, prompt_ids: list[int]):
         """Longest-common-prefix match against the stored spans. Returns
@@ -1125,6 +1271,12 @@ class Engine:
             log.warning(
                 "prompt truncated to %d tokens (max_seq=%d)", limit, self.ecfg.max_seq
             )
+        if self._paged and self._pages_needed(request) > self.ecfg.kv_pages:
+            raise ValueError(
+                f"request needs {self._pages_needed(request)} KV pages, pool "
+                f"has {self.ecfg.kv_pages} — lower max_new_tokens or grow "
+                "kv_pages"
+            )
         if request.image_embeds is not None:
             if self.draft_cfg is not None:
                 raise ValueError(
@@ -1211,6 +1363,9 @@ class Engine:
             out["prefix_cache_entries"] = float(len(self._prefix_entries))
         if self.m_dfa_tokens:
             out["grammar_dfa_tokens"] = float(self.m_dfa_tokens)
+        if self._paged:
+            out["kv_pages_total"] = float(self.ecfg.kv_pages)
+            out["kv_pages_free"] = float(len(self._free_pages))
         if self.draft_cfg is not None:
             out["spec_rounds"] = float(self.m_spec_rounds)
             out["spec_tokens_accepted"] = float(self.m_spec_accepted)
@@ -1289,13 +1444,16 @@ class Engine:
         pack = np.zeros((10, B), np.float32)
         pack[3] = 1.0  # top_p
         pack[5] = 1.0  # repeat_penalty
-        (
-            self.cache, self.counts, self.rngs, self.d_tokens, self.d_positions,
-            toks, _tk, _lp,
-        ) = fn(
+        args = (
             self.params, self.cache, self.counts, self.rngs, self.bias,
             self.d_tokens, self.d_positions, jnp.asarray(pack),
         )
+        if self._paged:
+            args = args + (jnp.asarray(self.h_ptable),)
+        (
+            self.cache, self.counts, self.rngs, self.d_tokens, self.d_positions,
+            toks, _tk, _lp,
+        ) = fn(*args)
         jax.block_until_ready(toks)
 
     def _warm_admit(self, m: int, bucket: int, has_bias: bool = False,
@@ -1311,6 +1469,10 @@ class Engine:
             jnp.zeros((m, bucket), jnp.int32), jnp.asarray(aux), jnp.asarray(samp_pack),
             jnp.zeros((m, self.cfg.vocab_size), jnp.float32),
         )
+        if self._paged:
+            # Warm against the scratch page so throwaway writes land nowhere.
+            args = args + (jnp.full((m, self._max_pages), self._scratch_page,
+                                    jnp.int32),)
         if self.draft_cfg is None:
             (
                 self.cache, self.counts, self.rngs, self.bias,
@@ -1549,6 +1711,7 @@ class Engine:
                 return admitted
             group: list[tuple[GenRequest, RequestHandle]] = []
             bucket = 0
+            pages_planned = 0
             with self._pending_lock:
                 while self._pending and len(group) < len(free):
                     request, handle = self._pending[0]
@@ -1556,6 +1719,11 @@ class Engine:
                         self._pending.popleft()
                         handle._q.put(TokenEvent(kind="done", finish_reason="stop"))
                         continue
+                    if self._paged:
+                        need = self._pages_needed(request)
+                        if pages_planned + need > len(self._free_pages):
+                            break  # pool backpressure — wait for a finish
+                        pages_planned += need
                     b = self._bucket_for(len(request.prompt_ids))
                     if not group:
                         bucket = b
@@ -1698,22 +1866,41 @@ class Engine:
                 jnp.asarray(gmask0), dfa_tables["trans"], dfa_tables["tok_cls"],
                 jnp.asarray(ginit),
             )
+        allocated_slots: list[int] = []
+        if self._paged:
+            rows_tbl = np.zeros((m, self._max_pages), np.int32)
+            for j, (r, _h) in enumerate(chunk):
+                prow = self._pages_alloc(slot_ids[j], self._pages_needed(r))
+                if prow is None:  # admission is page-gated; belt-and-braces
+                    for s in allocated_slots:
+                        self._pages_free(s)
+                    raise RuntimeError("KV page pool exhausted at dispatch")
+                allocated_slots.append(slot_ids[j])
+                rows_tbl[j] = prow
+            args_in = args_in + (jnp.asarray(rows_tbl),)
         t_c = time.monotonic()
-        if self.draft_cfg is None:
-            pre = (self.params, self.cache, self.counts, self.rngs, self.bias,
-                   self.d_tokens, self.d_positions)
-            if with_dfa:
-                pre = pre + (self.d_gstate,)
-            out = fn(*pre, *args_in)
-        else:
-            pre = (self.params, self.cache, self.counts, self.rngs, self.bias,
-                   self.d_tokens, self.d_positions, self.draft_params,
-                   self.d_cache)
-            if with_dfa:
-                # admit_spec takes the dfa inputs after bias_rows, d_gstate last.
-                out = fn(*pre, *args_in, self.d_gstate)
-            else:
+        try:
+            if self.draft_cfg is None:
+                pre = (self.params, self.cache, self.counts, self.rngs, self.bias,
+                       self.d_tokens, self.d_positions)
+                if with_dfa:
+                    pre = pre + (self.d_gstate,)
                 out = fn(*pre, *args_in)
+            else:
+                pre = (self.params, self.cache, self.counts, self.rngs, self.bias,
+                       self.d_tokens, self.d_positions, self.draft_params,
+                       self.d_cache)
+                if with_dfa:
+                    # admit_spec takes the dfa inputs after bias_rows, d_gstate last.
+                    out = fn(*pre, *args_in, self.d_gstate)
+                else:
+                    out = fn(*pre, *args_in)
+        except Exception:
+            # Slots were never claimed, so _release won't run — return the
+            # reserved pages before surfacing the error.
+            for s in allocated_slots:
+                self._pages_free(s)
+            raise
         (
             self.cache, self.counts, self.rngs, self.bias,
             self.d_tokens, self.d_positions, toks, tk, lp,
@@ -1820,25 +2007,24 @@ class Engine:
         if with_dfa:
             pack[10] = self.h_gmask
         fn = self._get_block(variant, n, with_lp, with_dfa)
+        args = (
+            self.params, self.cache, self.counts, self.rngs, self.bias,
+            self.d_tokens, self.d_positions, jnp.asarray(pack),
+        )
+        if self._paged:
+            args = args + (jnp.asarray(self.h_ptable),)
         if with_dfa:
             d = self._dfa
             (
                 self.cache, self.counts, self.rngs, self.d_tokens,
                 self.d_positions, toks_block, tk_block, lp_block, self.d_gstate,
-            ) = fn(
-                self.params, self.cache, self.counts, self.rngs, self.bias,
-                self.d_tokens, self.d_positions, jnp.asarray(pack),
-                d["mask_bits"], d["trans"], d["tok_cls"], self.d_gstate,
-            )
+            ) = fn(*args, d["mask_bits"], d["trans"], d["tok_cls"], self.d_gstate)
             self.m_dfa_tokens += n * int((self.h_gmask * active_snapshot).sum())
         else:
             (
                 self.cache, self.counts, self.rngs, self.d_tokens, self.d_positions,
                 toks_block, tk_block, lp_block,
-            ) = fn(
-                self.params, self.cache, self.counts, self.rngs, self.bias,
-                self.d_tokens, self.d_positions, jnp.asarray(pack),
-            )
+            ) = fn(*args)
         _host_copy_async(toks_block)
         if tk_block is not None:
             _host_copy_async(tk_block)
@@ -2167,3 +2353,5 @@ class Engine:
         self.h_active[slot_idx] = False
         self.h_override_mask[slot_idx] = False
         self.h_gmask[slot_idx] = 0.0
+        if self._paged:
+            self._pages_free(slot_idx)

@@ -194,6 +194,14 @@ def compile_schema_dfa(schema: Any, max_states: int = 3072,
             row[cid] = j
     trans = np.stack(rows)
     accept = np.asarray([s.is_complete() for s in states], bool)
+    # Strictly-complete states (empty stack) admit ONLY EOS: the machine
+    # itself tolerates one trailing whitespace char, but emitting it would
+    # append junk to structured output — the host-walk path avoids that by
+    # finishing at strictly_complete(), and the DFA must match ('false\r'
+    # is not 'false'). Trailing-number states keep their digits (non-empty
+    # stack), so "12" can still extend to "123".
+    strict = np.asarray([not s.stack for s in states], bool)
+    trans[strict] = -1
     return CharDFA(trans, accept, classes, other_class, classes[_CTRL_REP])
 
 

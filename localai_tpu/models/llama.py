@@ -475,12 +475,15 @@ def decode_step_windowed(
     step: jnp.ndarray,  # scalar index within the block
     ep: int = 1,
     mesh=None,  # Mesh with sp>1 → the cache's sequence axis is sp-sharded
+    ptable=None,  # [B, MP] int32 → `cache` is a page pool (paged KV mode)
 ):
     """One step of a fused decode block with a block-local KV window.
 
     The cache is never written here — each layer emits its new row, which is
     appended to the local window; the engine scatters the whole window into
     the cache once per block. Returns (logits [B, V] f32, local_k, local_v).
+    One layer body serves all three cache layouts (dense / sp-sharded /
+    paged) — only the attention call differs.
     """
     B = tokens.shape[0]
     use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
@@ -493,7 +496,13 @@ def decode_step_windowed(
         q, k, v = _attn_proj_qkv(cfg, lp, x)
         q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
-        if use_sp:
+        if ptable is not None:
+            from localai_tpu.ops.attention import decode_attention_windowed_paged
+
+            attn = decode_attention_windowed_paged(
+                q, kc, vc, ptable, lk, lv, k, v, positions, step
+            )
+        elif use_sp:
             from localai_tpu.ops.attention import decode_attention_windowed_sp
 
             attn = decode_attention_windowed_sp(
@@ -669,4 +678,70 @@ def write_prefill_to_cache(
     """
     k = jax.lax.dynamic_update_slice(cache.k, ks[:, :1], (0, slot, 0, 0, 0))
     v = jax.lax.dynamic_update_slice(cache.v, vs[:, :1], (0, slot, 0, 0, 0))
+    return KVCache(k=k, v=v)
+
+
+# --------------------------------------------------------------------------- #
+# Paged KV cache (page pool + per-slot page tables — ops/attention.py paged)
+# --------------------------------------------------------------------------- #
+
+
+def paged_cache_zeros(cfg: ArchConfig, num_pages: int, page_size: int,
+                      dtype=None) -> KVCache:
+    """Page pool: k/v [L, P, page, K, Hd]. One pool serves every slot; the
+    engine assigns pages to slots and passes per-slot tables to each program.
+    HBM scales with pages in use, not slots × max_seq (SURVEY §7 ragged KV)."""
+    dtype = jnp.dtype(cfg.dtype) if dtype is None else dtype
+    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim_)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def write_block_to_pool(
+    pool: KVCache,
+    table: jnp.ndarray,  # [B, MP] int32
+    local_k: jnp.ndarray,  # [L, B, n, K, Hd]
+    local_v: jnp.ndarray,
+    start_positions: jnp.ndarray,  # [B]
+) -> KVCache:
+    """Scatter a decode block's window into the page pool (once per block).
+    Rows may straddle pages; each (slot, step) row lands at
+    (table[b, row // page], row % page). Every slot is written every step —
+    idle slots and rows past a slot's reservation resolve through the
+    engine's SCRATCH-filled table entries to a page nobody attends, so they
+    can never corrupt a live request."""
+    L, B, n = local_k.shape[:3]
+    page = pool.k.shape[2]
+    MP = table.shape[1]
+    row = jnp.minimum(start_positions[:, None] + jnp.arange(n)[None, :],
+                      MP * page - 1)  # [B, n]
+    pid = jnp.take_along_axis(table, row // page, axis=1)  # [B, n]
+    off = row % page
+    k = pool.k.at[:, pid, off].set(local_k.astype(pool.k.dtype))
+    v = pool.v.at[:, pid, off].set(local_v.astype(pool.v.dtype))
+    return KVCache(k=k, v=v)
+
+
+def write_prefill_to_pool(
+    pool: KVCache,
+    table_row: jnp.ndarray,  # [MP] int32 — the destination slot's pages
+    ks: jnp.ndarray,  # [L, B_new, Sb, K, Hd] from prefill
+    vs: jnp.ndarray,
+    j: int,  # batch row within ks/vs (static)
+) -> KVCache:
+    """Copy one prefilled request's KV into its pages. The prompt starts at
+    row 0, so writes are page-aligned; the (static) trailing partial page
+    writes whatever fits."""
+    Sb = ks.shape[2]
+    page = pool.k.shape[2]
+    k, v = pool.k, pool.v
+    for p in range(-(-Sb // page)):  # static page count for this bucket
+        lo = p * page
+        chunk_k = ks[:, j, lo: lo + page]  # [L, c, K, Hd], c static
+        chunk_v = vs[:, j, lo: lo + page]
+        k = jax.lax.dynamic_update_slice(
+            k, chunk_k[:, None].astype(k.dtype), (0, table_row[p], 0, 0, 0)
+        )
+        v = jax.lax.dynamic_update_slice(
+            v, chunk_v[:, None].astype(v.dtype), (0, table_row[p], 0, 0, 0)
+        )
     return KVCache(k=k, v=v)

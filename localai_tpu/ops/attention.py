@@ -313,3 +313,81 @@ def decode_attention(
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgs,bskd->bkgd", probs, vf)
     return out.reshape(B, H, D).astype(q.dtype)
+
+
+# --------------------------------------------------------------------------- #
+# Paged KV cache (vLLM-style page pool, XLA-native flash-decoding over pages)
+# --------------------------------------------------------------------------- #
+
+
+def _paged_cache_partials(q, k_pool, v_pool, table, limits):
+    """Online-softmax partials over a paged cache — the static-shape TPU
+    answer to ragged/paged KV (SURVEY §7; reference: llama.cpp's per-slot
+    contiguous cache, vLLM's PagedAttention): HBM holds one shared page pool
+    [P, page, K, D] and each slot attends only the pages its table lists.
+    A fori_loop walks the table one page-column at a time, gathering ONE
+    [B, page, K, D] tile per step — the dense [B, S] view never
+    materializes, and the trip count is bounded by the LONGEST live context
+    in the batch (ceil(max(limits)/page)), so per-step bandwidth scales
+    with what is actually resident, not max_seq.
+
+    q: [B, H, D]; k/v_pool: [P, page, K, D]; table: [B, MP] int32 page ids;
+    limits: [B] — rows with global index >= limits[b] are masked. Returns
+    (acc [B, K, G, D], m [B, K, G, 1], l [B, K, G, 1]) f32, scale applied.
+    """
+    B, H, D = q.shape
+    page = k_pool.shape[1]
+    K = k_pool.shape[2]
+    G = H // K
+    MP = table.shape[1]
+    scale = 1.0 / (D**0.5)
+    qf = (q.astype(jnp.float32) * scale).reshape(B, K, G, D)
+
+    def body(p, carry):
+        m, l, acc = carry
+        pids = table[:, p]  # [B]
+        kp = k_pool[pids].astype(jnp.float32)  # [B, page, K, D]
+        vp = v_pool[pids].astype(jnp.float32)
+        sc = jnp.einsum("bkgd,bskd->bkgs", qf, kp)
+        gpos = p * page + jnp.arange(page)  # global rows of this column
+        valid = gpos[None, :] < limits[:, None]
+        sc = jnp.where(valid[:, None, None, :], sc, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(sc, axis=-1, keepdims=True))
+        alpha = jnp.exp(jnp.maximum(m - m_new, -80.0))
+        pr = jnp.exp(sc - m_new)
+        pr = jnp.where(valid[:, None, None, :], pr, 0.0)
+        l = l * alpha + jnp.sum(pr, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("bkgs,bskd->bkgd", pr, vp)
+        return m_new, l, acc
+
+    m0 = jnp.full((B, K, G, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, K, G, 1), jnp.float32)
+    a0 = jnp.zeros((B, K, G, D), jnp.float32)
+    p_hi = jnp.minimum(
+        (jnp.max(limits) + page - 1) // page, MP
+    ).astype(jnp.int32)
+    m, l, acc = jax.lax.fori_loop(0, p_hi, body, (m0, l0, a0))
+    return acc, m, l
+
+
+def decode_attention_windowed_paged(
+    q: jnp.ndarray,  # [B, H, D]
+    k_pool: jnp.ndarray,  # [P, page, K, D] shared page pool
+    v_pool: jnp.ndarray,
+    table: jnp.ndarray,  # [B, MP] int32 page ids per slot
+    k_local: jnp.ndarray,  # [B, n, K, D] block-local window
+    v_local: jnp.ndarray,
+    k_new: jnp.ndarray,  # [B, K, D]
+    v_new: jnp.ndarray,
+    positions: jnp.ndarray,  # [B]
+    step: jnp.ndarray,  # scalar
+) -> jnp.ndarray:
+    """`decode_attention_windowed` over a paged pool: paged partials for
+    rows [0, block_start), dense merge of the (tiny) local window + current
+    token."""
+    n = k_local.shape[1]
+    acc, m, l = _paged_cache_partials(q, k_pool, v_pool, table, positions - step)
+    ek = jnp.concatenate([k_local, k_new[:, None]], axis=1)  # [B, n+1, K, D]
+    ev = jnp.concatenate([v_local, v_new[:, None]], axis=1)
+    mask = jnp.concatenate([jnp.arange(n) < step, jnp.ones((1,), bool)], axis=0)
+    return _merge_partials(q, acc, m, l, ek, ev, mask)

@@ -589,10 +589,11 @@ class ModelManager:
 
         from localai_tpu.engine.audio_engine import VADEngine
 
-        if cfg.model:
-            # A configured checkpoint that can't be found is an error, not a
-            # silent fall-through to the weightless energy detector (same
-            # standard as the tts/detection loaders above).
+        if cfg.model and cfg.model != "energy":
+            # `model: energy` explicitly selects the weightless detector;
+            # any other configured checkpoint that can't be found is an
+            # error, not a silent fall-through (same standard as the
+            # tts/detection loaders above).
             ckpt_dir = self._resolve_ckpt_dir(cfg.model)
             if not os.path.isdir(ckpt_dir):
                 raise FileNotFoundError(
