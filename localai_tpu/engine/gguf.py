@@ -427,8 +427,12 @@ def arch_from_gguf(gf: GGUFFile):
 
     kv = gf.kv
     a = kv.get("general.architecture", "llama")
-    if a not in ("llama", "qwen2", "qwen3", "mistral", "gemma2", "granite"):
+    # phi3 GGUFs store fused attn_qkv/ffn_up tensors this loader's tensor
+    # map doesn't split yet, and gemma2 adds pre/post-ffw norms + softcap +
+    # sliding windows — neither belongs in the silently-accepted set.
+    if a not in ("llama", "qwen2", "qwen3", "mistral", "gemma", "granite"):
         log.warning("GGUF arch %r not in the known set; mapping as llama-family", a)
+    gemma = a == "gemma"
 
     def k(suffix: str, default=None):
         return kv.get(f"{a}.{suffix}", default)
@@ -467,6 +471,10 @@ def arch_from_gguf(gf: GGUFFile):
         rope_original_max_position=orig_ctx or 8192,
         tie_embeddings="output.weight" not in gf.tensors,
         attn_qkv_bias="blk.0.attn_q.bias" in gf.tensors,
+        # Gemma GGUFs arrive with the (1+w) norm fold already applied by
+        # llama.cpp's converter, so only the runtime quirks are flagged.
+        activation=("gelu_tanh" if gemma else "silu"),
+        embed_scale=gemma,
         num_experts=int(k("expert_count", 0) or 0),
         num_experts_per_token=int(k("expert_used_count", 2) or 2),
     )

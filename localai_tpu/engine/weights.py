@@ -10,6 +10,7 @@ by-shard onto the mesh so a 70B never materializes unsharded in host RAM.
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Any, Callable
 
@@ -18,6 +19,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from localai_tpu.models.config import ArchConfig
+
+log = logging.getLogger("localai_tpu.weights")
 
 Params = dict[str, Any]
 
@@ -151,10 +154,70 @@ def load_hf_checkpoint(
 
     _QUANT_KEYS = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
 
+    # Phi-3 fuses qkv and gate/up into single tensors; serve the per-head
+    # names by row-block slicing so the rest of the loader stays uniform.
+    H, Kh, Hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    F = cfg.intermediate_size
+    _FUSED = {
+        "self_attn.q_proj.weight": ("self_attn.qkv_proj.weight",
+                                    [H * Hd, Kh * Hd, Kh * Hd], 0),
+        "self_attn.k_proj.weight": ("self_attn.qkv_proj.weight",
+                                    [H * Hd, Kh * Hd, Kh * Hd], 1),
+        "self_attn.v_proj.weight": ("self_attn.qkv_proj.weight",
+                                    [H * Hd, Kh * Hd, Kh * Hd], 2),
+        "mlp.gate_proj.weight": ("mlp.gate_up_proj.weight", [F, F], 0),
+        "mlp.up_proj.weight": ("mlp.gate_up_proj.weight", [F, F], 1),
+    }
+
+    def _fused_source(name: str):
+        for suf, (fused_suf, sizes, idx) in _FUSED.items():
+            if name.endswith(suf):
+                fused = name[: -len(suf)] + fused_suf
+                if fused in reader:
+                    return fused, sizes, idx
+        return None
+
+    def has_tensor(name: str) -> bool:
+        return name in reader or _fused_source(name) is not None
+
+    _fused_slices: dict[str, np.ndarray] = {}
+
+    def read_tensor(name: str) -> np.ndarray:
+        if name in reader:
+            return reader.get(name)
+        hit = _fused_slices.pop(name, None)
+        if hit is not None:
+            return hit
+        src = _fused_source(name)
+        if src is None:
+            raise KeyError(name)
+        fused, sizes, idx = src
+        # The loader walks key-major (all layers' q, then all k, ...), so a
+        # fused tensor's sibling slices are wanted much later — split once
+        # and stash the siblings under their virtual names (they would be
+        # materialized in the tree anyway) instead of re-reading the fused
+        # tensor once per slice.
+        arr = reader.get(fused)
+        offs = np.cumsum([0] + sizes)
+        want = None
+        for suf, (fsuf, _sizes, fidx) in _FUSED.items():
+            if not fused.endswith(fsuf):
+                continue
+            part = arr[offs[fidx]: offs[fidx + 1]]
+            if fidx == idx:
+                want = part
+            else:
+                _fused_slices[fused[: -len(fsuf)] + suf] = part
+        return want
+
     def grab(name: str, transpose: bool) -> np.ndarray:
-        arr = reader.get(name)
+        arr = read_tensor(name)
         if transpose and arr.ndim == 2:
             arr = arr.T
+        if cfg.norm_plus_one and name.endswith("norm.weight"):
+            # Gemma stores RMSNorm weights as w with (1+w) applied at run
+            # time; fold the +1 here so ops/norm.py stays family-agnostic.
+            arr = (arr.astype(np.float32) + 1.0).astype(arr.dtype)
         return np.ascontiguousarray(arr)
 
     def stack_layers(our: str, hf_suffix: str, transpose: bool) -> np.ndarray:
@@ -170,7 +233,7 @@ def load_hf_checkpoint(
             layer_map.pop(k)
     for our, (suffix, transpose) in layer_map.items():
         probe = f"model.layers.0.{suffix}"
-        if probe not in reader:
+        if not has_tensor(probe):
             continue  # optional tensors (qkv bias)
         layers[our] = place(
             f"layers/{our}", merge_lora(our, stack_layers(our, suffix, transpose)),
@@ -322,6 +385,8 @@ def save_hf_checkpoint(cfg: ArchConfig, params: Params, ckpt_dir: str) -> None:
         a = np.asarray(jnp.asarray(arr, jnp.float32))
         if transpose and a.ndim == 2:
             a = a.T
+        if cfg.norm_plus_one and name.endswith("norm.weight"):
+            a = a - 1.0  # inverse of the load-time (1+w) fold — gemma layout
         tensors[name] = np.ascontiguousarray(a)
 
     layers = params["layers"]
@@ -351,8 +416,18 @@ def save_hf_checkpoint(cfg: ArchConfig, params: Params, ckpt_dir: str) -> None:
 
     save_file(tensors, os.path.join(ckpt_dir, "model.safetensors"))
 
+    if cfg.is_moe:
+        model_type = "mixtral"
+    elif cfg.embed_scale or cfg.norm_plus_one:
+        model_type = "gemma"
+    elif cfg.attn_qkv_bias:
+        model_type = "qwen2"
+    else:
+        model_type = "llama"
     hf_config = {
-        "model_type": "mixtral" if cfg.is_moe else ("qwen2" if cfg.attn_qkv_bias else "llama"),
+        "model_type": model_type,
+        "hidden_act": ("gelu_pytorch_tanh" if cfg.activation == "gelu_tanh"
+                       else "silu"),
         "vocab_size": cfg.vocab_size,
         "hidden_size": cfg.hidden_size,
         "intermediate_size": cfg.intermediate_size,
@@ -381,12 +456,36 @@ def save_hf_checkpoint(cfg: ArchConfig, params: Params, ckpt_dir: str) -> None:
 
 
 def arch_from_hf_config(ckpt_dir: str) -> ArchConfig:
-    """Build an ArchConfig from an HF config.json (llama/mistral/qwen2/mixtral)."""
+    """Build an ArchConfig from an HF config.json
+    (llama/mistral/qwen2/mixtral/gemma/phi3)."""
     with open(os.path.join(ckpt_dir, "config.json")) as f:
         hf = json.load(f)
     rope_scaling = hf.get("rope_scaling") or {}
     scaling_type = rope_scaling.get("rope_type") or rope_scaling.get("type")
+    max_position = hf.get("max_position_embeddings", 8192)
+    if scaling_type in ("longrope", "su", "yarn"):
+        # Per-frequency long-context interpolation isn't implemented; serve
+        # the unscaled rope AND clamp the advertised context to the original
+        # window — otherwise the server would accept prompts the unscaled
+        # rope cannot place.
+        orig = int(rope_scaling.get("original_max_position_embeddings",
+                                    max_position))
+        log.warning("rope_scaling type %r not supported — serving unscaled "
+                    "rope with context clamped to %d", scaling_type, orig)
+        max_position = orig
+        scaling_type = None
+        rope_scaling = {}
     model_type = hf.get("model_type", "llama")
+    if model_type in ("gemma2", "gemma3", "gemma3_text"):
+        # Gemma-2/3 add pre/post-ffw norms, attention softcapping, and
+        # alternating sliding windows — loading them with gemma-1 semantics
+        # would produce fluent-looking garbage. Fail loudly instead.
+        raise ValueError(
+            f"model_type {model_type!r} is not supported yet (gemma-1, "
+            "llama, mistral, qwen2, mixtral, phi3 are)"
+        )
+    gemma = model_type == "gemma"
+    act = hf.get("hidden_activation") or hf.get("hidden_act") or "silu"
     return ArchConfig(
         name=hf.get("_name_or_path", model_type) or model_type,
         vocab_size=hf["vocab_size"],
@@ -404,10 +503,14 @@ def arch_from_hf_config(ckpt_dir: str) -> ArchConfig:
         rope_original_max_position=rope_scaling.get(
             "original_max_position_embeddings", hf.get("max_position_embeddings", 8192)
         ),
-        max_position=hf.get("max_position_embeddings", 8192),
+        max_position=max_position,
         rms_eps=hf.get("rms_norm_eps", 1e-5),
-        tie_embeddings=hf.get("tie_word_embeddings", False),
+        # Gemma ties embeddings but its configs often omit the flag.
+        tie_embeddings=hf.get("tie_word_embeddings", gemma),
         attn_qkv_bias=(model_type == "qwen2"),
+        activation=("gelu_tanh" if "gelu" in act else "silu"),
+        embed_scale=gemma,
+        norm_plus_one=gemma,
         num_experts=hf.get("num_local_experts", 0),
         num_experts_per_token=hf.get("num_experts_per_tok", 2),
     )

@@ -41,6 +41,13 @@ class ArchConfig:
     rms_eps: float = 1e-5
     tie_embeddings: bool = False
     attn_qkv_bias: bool = False  # Qwen2-style
+    # Gemma-family: GeGLU MLP ("gelu_tanh"), embeddings scaled by sqrt(D)
+    # at lookup (the tied unembed reads the raw matrix), and (1+w) RMSNorm
+    # weights — the +1 is folded into the tree at load, so only the first
+    # two need runtime branches.
+    activation: str = "silu"  # "silu" | "gelu_tanh"
+    embed_scale: bool = False
+    norm_plus_one: bool = False  # load-time fold (engine/weights.py)
     # Mixture-of-experts (Mixtral/DeepSeek-style); 0 experts = dense MLP
     num_experts: int = 0
     num_experts_per_token: int = 2

@@ -157,7 +157,7 @@ def _moe_dense(cfg: ArchConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
     weights, sel = _moe_route(cfg, lp, x)
     onehot = jax.nn.one_hot(sel, E, dtype=jnp.float32)  # [..., topk, E]
     combine = jnp.einsum("...te,...t->...e", onehot, weights)
-    gate = jax.nn.silu(_moe_mm(x, lp["w_gate"], "...d,edf->...ef"))
+    gate = _act(cfg, _moe_mm(x, lp["w_gate"], "...d,edf->...ef"))
     up = _moe_mm(x, lp["w_up"], "...d,edf->...ef")
     expert_out = _moe_mm(gate * up, lp["w_down"], "...ef,efd->...ed")  # [..., E, D]
     return jnp.einsum("...ed,...e->...d", expert_out.astype(jnp.float32), combine).astype(x.dtype)
@@ -186,7 +186,7 @@ def _moe_ragged(cfg: ArchConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
     tok = order // k  # source token of each sorted row
     xg = jnp.take(xf, tok, axis=0)  # [M, D]
     gs = jnp.bincount(e_flat, length=E)  # rows per expert (sums to M)
-    gate = jax.nn.silu(jax.lax.ragged_dot(xg, lp["w_gate"], gs))
+    gate = _act(cfg, jax.lax.ragged_dot(xg, lp["w_gate"], gs))
     up = jax.lax.ragged_dot(xg, lp["w_up"], gs)
     dn = jax.lax.ragged_dot((gate * up).astype(xg.dtype), lp["w_down"], gs)  # [M, D]
     wf = jnp.take(weights.reshape(M), order)
@@ -234,7 +234,7 @@ def _moe_capacity(cfg: ArchConfig, lp: Params, x: jnp.ndarray, block: int = 1024
         wr = w * kept_k / denom  # renormalized over kept choices
         comb = jnp.einsum("nk,nkec->nec", wr, slot.astype(jnp.float32))
         xe = jnp.einsum("nec,nd->ecd", disp, xb)  # [E, C, D]
-        gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, lp["w_gate"]))
+        gate = _act(cfg, jnp.einsum("ecd,edf->ecf", xe, lp["w_gate"]))
         up = jnp.einsum("ecd,edf->ecf", xe, lp["w_up"])
         dn = jnp.einsum("ecf,efd->ecd", gate * up, lp["w_down"])
         return jnp.einsum("nec,ecd->nd", comb, dn.astype(jnp.float32))
@@ -253,7 +253,7 @@ def _mlp(cfg: ArchConfig, lp: Params, x: jnp.ndarray, ep: int = 1) -> jnp.ndarra
     - otherwise → exact sort+ragged_dot top-k (FLOPs ∝ top_k).
     """
     if not cfg.is_moe:
-        gate = jax.nn.silu(matmul(x, lp["w_gate"]))
+        gate = _act(cfg, matmul(x, lp["w_gate"]))
         return matmul(gate * matmul(x, lp["w_up"]), lp["w_down"]).astype(x.dtype)
     if isinstance(lp["w_gate"], dict):
         return _moe_dense(cfg, lp, x)
@@ -276,6 +276,22 @@ def _attn_proj_qkv(cfg: ArchConfig, lp: Params, x: jnp.ndarray):
     k = k.reshape(*x.shape[:-1], K, Hd)
     v = v.reshape(*x.shape[:-1], K, Hd)
     return q, k, v
+
+
+def _embed(cfg: ArchConfig, params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Token embedding lookup; Gemma scales hidden states by sqrt(D) here
+    while the tied unembed reads the raw matrix."""
+    h = params["embed"][tokens]
+    if cfg.embed_scale:
+        h = (h.astype(jnp.float32) * (cfg.hidden_size**0.5)).astype(h.dtype)
+    return h
+
+
+def _act(cfg: ArchConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """Gated-MLP activation: SwiGLU (llama family) or GeGLU (gemma)."""
+    if cfg.activation == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
 
 
 def _unembed(cfg: ArchConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
@@ -313,7 +329,7 @@ def _forward_hidden(
     positions = jnp.arange(S)[None, :].repeat(B, axis=0)  # [B, S]
     length_mask = jnp.arange(S)[None, :] < lengths[:, None]
 
-    h = params["embed"][tokens]  # [B, S, D]
+    h = _embed(cfg, params, tokens)  # [B, S, D]
     if inject is not None:
         # Multimodal: overwrite the placeholder span with projected image
         # features (models/vision.py) — the llava injection point.
@@ -435,7 +451,7 @@ def decode_step(
     B = tokens.shape[0]
     use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
     inv_freq = rope_frequencies(cfg)
-    h = params["embed"][tokens]  # [B, D]
+    h = _embed(cfg, params, tokens)  # [B, D]
     batch_idx = jnp.arange(B)
 
     def layer(h, xs):
@@ -488,7 +504,7 @@ def decode_step_windowed(
     B = tokens.shape[0]
     use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
     inv_freq = rope_frequencies(cfg)
-    h = params["embed"][tokens]
+    h = _embed(cfg, params, tokens)
 
     def layer(h, xs):
         lp, kc, vc, lk, lv = xs
@@ -564,7 +580,7 @@ def decode_chunk(
     scan never re-emits the cache, and one scatter writes all L×T rows."""
     B, T = tokens.shape
     inv_freq = rope_frequencies(cfg)
-    h = params["embed"][tokens]  # [B, T, D]
+    h = _embed(cfg, params, tokens)  # [B, T, D]
     batch_idx = jnp.arange(B)[:, None].repeat(T, axis=1)  # [B, T]
     S = cache.k.shape[2]
     scale = cfg.head_dim_**-0.5
@@ -629,7 +645,7 @@ def prefill_tail(
     inv_freq = rope_frequencies(cfg)
     positions = offsets[:, None] + jnp.arange(T)[None, :]  # [B, T] global
     length_mask = jnp.arange(T)[None, :] < lengths[:, None]
-    h = params["embed"][tokens]  # [B, T, D]
+    h = _embed(cfg, params, tokens)  # [B, T, D]
     scale = cfg.head_dim_**-0.5
     causal = jnp.tril(jnp.ones((T, T), bool))
     pvalid = jnp.arange(P)[None, :] < offsets[:, None]  # [B, P]
