@@ -299,6 +299,16 @@ class Engine:
                     "speculative decoding with a sequence-sharded KV cache "
                     "(sp>1) is not supported yet — drop the draft model or sp"
                 )
+        if draft_cfg is not None and any(
+            c.attn_softcap or c.sliding_window for c in (cfg, draft_cfg)
+        ):
+            # Applies to the DRAFT too: draft proposals run through
+            # decode_step, which has no softcap/sliding support — a gemma-2
+            # draft would silently collapse the acceptance rate.
+            raise ValueError(
+                "speculative decoding is not supported for softcap/"
+                "sliding-window (gemma-2) models yet — drop the draft model"
+            )
         # Speculative decoding (reference: draft_model/n_draft,
         # model_config.go:211-212 passed into llama.cpp's batch decode).
         self.draft_cfg = draft_cfg
@@ -938,9 +948,11 @@ class Engine:
     def _prefix_enabled(self) -> bool:
         # Paged mode: spans live in pool pages owned by slots, so the dense
         # snapshot/copy-back machinery doesn't apply (copy-on-write page
-        # sharing is the paged-native follow-up).
+        # sharing is the paged-native follow-up). Gemma-2 (softcap/sliding
+        # windows): prefill_tail doesn't implement those yet.
         return (self.ecfg.prefix_cache_entries > 0 and self.draft_cfg is None
-                and not self._paged)
+                and not self._paged
+                and not self.cfg.attn_softcap and not self.cfg.sliding_window)
 
     def _prefix_find(self, prompt_ids: list[int]):
         """Longest-common-prefix match against the stored spans. Returns

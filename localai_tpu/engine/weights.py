@@ -231,6 +231,12 @@ def load_hf_checkpoint(
     if cfg.is_moe:
         for k in ("w_gate", "w_up", "w_down"):
             layer_map.pop(k)
+    if cfg.post_norms:
+        # Gemma-2 sandwich norms: our mlp_norm is the PRE-feedforward norm
+        # (post_attention_layernorm plays a different role there).
+        layer_map["mlp_norm"] = ("pre_feedforward_layernorm.weight", False)
+        layer_map["post_attn_norm"] = ("post_attention_layernorm.weight", False)
+        layer_map["post_ffw_norm"] = ("post_feedforward_layernorm.weight", False)
     for our, (suffix, transpose) in layer_map.items():
         probe = f"model.layers.0.{suffix}"
         if not has_tensor(probe):
@@ -394,6 +400,10 @@ def save_hf_checkpoint(cfg: ArchConfig, params: Params, ckpt_dir: str) -> None:
     if cfg.is_moe:
         for k in ("w_gate", "w_up", "w_down"):
             layer_map.pop(k)
+    if cfg.post_norms:
+        layer_map["mlp_norm"] = ("pre_feedforward_layernorm.weight", False)
+        layer_map["post_attn_norm"] = ("post_attention_layernorm.weight", False)
+        layer_map["post_ffw_norm"] = ("post_feedforward_layernorm.weight", False)
     for our, (suffix, transpose) in layer_map.items():
         if our not in layers:
             continue
@@ -418,6 +428,8 @@ def save_hf_checkpoint(cfg: ArchConfig, params: Params, ckpt_dir: str) -> None:
 
     if cfg.is_moe:
         model_type = "mixtral"
+    elif cfg.post_norms:
+        model_type = "gemma2"
     elif cfg.embed_scale or cfg.norm_plus_one:
         model_type = "gemma"
     elif cfg.attn_qkv_bias:
@@ -443,6 +455,11 @@ def save_hf_checkpoint(cfg: ArchConfig, params: Params, ckpt_dir: str) -> None:
     if cfg.is_moe:
         hf_config["num_local_experts"] = cfg.num_experts
         hf_config["num_experts_per_tok"] = cfg.num_experts_per_token
+    if cfg.post_norms:
+        hf_config["attn_logit_softcapping"] = cfg.attn_softcap or None
+        hf_config["final_logit_softcapping"] = cfg.final_softcap or None
+        hf_config["query_pre_attn_scalar"] = cfg.query_scale or cfg.head_dim_
+        hf_config["sliding_window"] = cfg.sliding_window or None
     if cfg.rope_scaling:
         hf_config["rope_scaling"] = {
             "rope_type": cfg.rope_scaling,
@@ -476,15 +493,15 @@ def arch_from_hf_config(ckpt_dir: str) -> ArchConfig:
         scaling_type = None
         rope_scaling = {}
     model_type = hf.get("model_type", "llama")
-    if model_type in ("gemma2", "gemma3", "gemma3_text"):
-        # Gemma-2/3 add pre/post-ffw norms, attention softcapping, and
-        # alternating sliding windows — loading them with gemma-1 semantics
-        # would produce fluent-looking garbage. Fail loudly instead.
+    if model_type in ("gemma3", "gemma3_text"):
+        # Gemma-3 adds q/k norms and a different sliding pattern — loading
+        # it with gemma-2 semantics would produce fluent-looking garbage.
         raise ValueError(
-            f"model_type {model_type!r} is not supported yet (gemma-1, "
+            f"model_type {model_type!r} is not supported yet (gemma-1/2, "
             "llama, mistral, qwen2, mixtral, phi3 are)"
         )
-    gemma = model_type == "gemma"
+    gemma = model_type in ("gemma", "gemma2")
+    gemma2 = model_type == "gemma2"
     act = hf.get("hidden_activation") or hf.get("hidden_act") or "silu"
     return ArchConfig(
         name=hf.get("_name_or_path", model_type) or model_type,
@@ -511,6 +528,11 @@ def arch_from_hf_config(ckpt_dir: str) -> ArchConfig:
         activation=("gelu_tanh" if "gelu" in act else "silu"),
         embed_scale=gemma,
         norm_plus_one=gemma,
+        post_norms=gemma2,
+        attn_softcap=float(hf.get("attn_logit_softcapping") or 0.0) if gemma2 else 0.0,
+        final_softcap=float(hf.get("final_logit_softcapping") or 0.0) if gemma2 else 0.0,
+        query_scale=float(hf.get("query_pre_attn_scalar") or 0.0) if gemma2 else 0.0,
+        sliding_window=int(hf.get("sliding_window") or 0) if gemma2 else 0,
         num_experts=hf.get("num_local_experts", 0),
         num_experts_per_token=hf.get("num_experts_per_tok", 2),
     )

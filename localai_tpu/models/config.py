@@ -48,6 +48,15 @@ class ArchConfig:
     activation: str = "silu"  # "silu" | "gelu_tanh"
     embed_scale: bool = False
     norm_plus_one: bool = False  # load-time fold (engine/weights.py)
+    # Gemma-2: sandwich norms (post-attention and post-feedforward RMSNorms
+    # inside the residual adds), tanh softcapping on attention scores and
+    # final logits, q scaled by query_pre_attn_scalar^-0.5 instead of
+    # head_dim^-0.5, and sliding-window attention on even layers.
+    post_norms: bool = False
+    attn_softcap: float = 0.0  # 0 = off
+    final_softcap: float = 0.0
+    query_scale: float = 0.0  # 0 = default head_dim^-0.5
+    sliding_window: int = 0  # 0 = full attention on every layer
     # Mixture-of-experts (Mixtral/DeepSeek-style); 0 experts = dense MLP
     num_experts: int = 0
     num_experts_per_token: int = 2

@@ -78,6 +78,9 @@ def init_params(cfg: ArchConfig, key: jnp.ndarray, scale: float = 0.02) -> Param
         "wo": rnd(next(keys), (L, H * Hd, D)),
         "mlp_norm": jnp.ones((L, D), dt),
     }
+    if cfg.post_norms:
+        layers["post_attn_norm"] = jnp.ones((L, D), dt)
+        layers["post_ffw_norm"] = jnp.ones((L, D), dt)
     if cfg.attn_qkv_bias:
         layers["bq"] = jnp.zeros((L, H * Hd), dt)
         layers["bk"] = jnp.zeros((L, K * Hd), dt)
@@ -262,6 +265,32 @@ def _mlp(cfg: ArchConfig, lp: Params, x: jnp.ndarray, ep: int = 1) -> jnp.ndarra
     return _moe_ragged(cfg, lp, x)
 
 
+def _attn_out(cfg: ArchConfig, lp: Params, attn_flat: jnp.ndarray) -> jnp.ndarray:
+    """Output projection + optional gemma-2 post-attention sandwich norm.
+    Shared by every layer body so per-arch structure changes in ONE place."""
+    a = matmul(attn_flat, lp["wo"])
+    if cfg.post_norms:
+        a = rms_norm(a, lp["post_attn_norm"], cfg.rms_eps)
+    return a
+
+
+def _mlp_out(cfg: ArchConfig, lp: Params, x: jnp.ndarray, ep: int = 1) -> jnp.ndarray:
+    """MLP + optional gemma-2 post-feedforward sandwich norm."""
+    m = _mlp(cfg, lp, x, ep)
+    if cfg.post_norms:
+        m = rms_norm(m, lp["post_ffw_norm"], cfg.rms_eps)
+    return m
+
+
+def _layer_sliding(cfg: ArchConfig, li: jnp.ndarray):
+    """Gemma-2 alternates: even layers use the sliding window, odd layers
+    attend globally. Returns a traced bool scalar (or None when the arch has
+    no sliding windows)."""
+    if not cfg.sliding_window:
+        return None
+    return (li % 2) == 0
+
+
 def _attn_proj_qkv(cfg: ArchConfig, lp: Params, x: jnp.ndarray):
     """x: [..., D] -> q [..., H, Hd], k/v [..., K, Hd]."""
     H, K, Hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
@@ -275,6 +304,11 @@ def _attn_proj_qkv(cfg: ArchConfig, lp: Params, x: jnp.ndarray):
     q = q.reshape(*x.shape[:-1], H, Hd)
     k = k.reshape(*x.shape[:-1], K, Hd)
     v = v.reshape(*x.shape[:-1], K, Hd)
+    if cfg.query_scale:
+        # Gemma-2 scales attention by query_pre_attn_scalar^-0.5; the
+        # attention kernels divide by sqrt(head_dim), so pre-multiply q by
+        # the ratio (commutes with RoPE — a rotation).
+        q = q * float((cfg.head_dim_ / cfg.query_scale) ** 0.5)
     return q, k, v
 
 
@@ -299,7 +333,10 @@ def _unembed(cfg: ArchConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
     # [V, D] matrix to f32 would double its HBM traffic on every decode step
     # (the unembed is the single largest weight read at 128k vocabs).
     w = params["embed"] if cfg.tie_embeddings else params["lm_head"]
-    return unembed_matmul(h, w)
+    logits = unembed_matmul(h, w)
+    if cfg.final_softcap:
+        logits = cfg.final_softcap * jnp.tanh(logits / cfg.final_softcap)
+    return logits
 
 
 def _forward_hidden(
@@ -340,7 +377,14 @@ def _forward_hidden(
             )
         )(h, embeds, offsets)
 
-    def layer(h, lp):
+    if (cfg.attn_softcap or cfg.sliding_window) and use_ring:
+        raise ValueError(
+            "attention softcapping / sliding windows (gemma-2) are not "
+            "supported with ring (sp>1) prefill"
+        )
+
+    def layer(h, xs):
+        lp, li = xs  # li: layer index (sliding windows alternate by layer)
         x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
         q, k, v = _attn_proj_qkv(cfg, lp, x)
         q = apply_rope(q, positions, inv_freq)
@@ -350,13 +394,19 @@ def _forward_hidden(
 
             attn = ring_prefill_attention(q, k, v, lengths, mesh)
         else:
-            attn = prefill_attention(q, k, v, length_mask, lengths)
-        h = h + matmul(attn.reshape(B, S, -1), lp["wo"])
+            attn = prefill_attention(
+                q, k, v, length_mask, lengths,
+                softcap=cfg.attn_softcap, window=cfg.sliding_window,
+                sliding=_layer_sliding(cfg, li),
+            )
+        h = h + _attn_out(cfg, lp, attn.reshape(B, S, -1))
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-        h = h + _mlp(cfg, lp, x, ep)
+        h = h + _mlp_out(cfg, lp, x, ep)
         return h, ((k, v) if collect_kv else None)
 
-    h, kv = jax.lax.scan(layer, h, params["layers"])
+    h, kv = jax.lax.scan(
+        layer, h, (params["layers"], jnp.arange(cfg.num_layers))
+    )
     h = rms_norm(h, params["final_norm"], cfg.rms_eps)
     return h, length_mask, kv
 
@@ -466,9 +516,9 @@ def decode_step(
             attn = decode_attention_appended_sp(q, kc, vc, k, v, positions, mesh)
         else:
             attn = decode_attention_appended(q, kc, vc, k, v, positions)
-        h = h + matmul(attn.reshape(B, -1), lp["wo"])
+        h = h + _attn_out(cfg, lp, attn.reshape(B, -1))
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-        h = h + _mlp(cfg, lp, x, ep)
+        h = h + _mlp_out(cfg, lp, x, ep)
         return h, (k, v)
 
     h, (new_k, new_v) = jax.lax.scan(layer, h, (params["layers"], cache.k, cache.v))
@@ -503,11 +553,16 @@ def decode_step_windowed(
     """
     B = tokens.shape[0]
     use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
+    if (cfg.attn_softcap or cfg.sliding_window) and (use_sp or ptable is not None):
+        raise ValueError(
+            "attention softcapping / sliding windows (gemma-2) are not "
+            "supported with sp-sharded or paged KV caches"
+        )
     inv_freq = rope_frequencies(cfg)
     h = _embed(cfg, params, tokens)
 
     def layer(h, xs):
-        lp, kc, vc, lk, lv = xs
+        lp, li, kc, vc, lk, lv = xs
         x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
         q, k, v = _attn_proj_qkv(cfg, lp, x)
         q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
@@ -526,15 +581,19 @@ def decode_step_windowed(
             )
         else:
             attn = decode_attention_windowed(
-                q, kc, vc, lk, lv, k, v, positions, step
+                q, kc, vc, lk, lv, k, v, positions, step,
+                softcap=cfg.attn_softcap, window=cfg.sliding_window,
+                sliding=_layer_sliding(cfg, li),
             )
-        h = h + matmul(attn.reshape(B, -1), lp["wo"])
+        h = h + _attn_out(cfg, lp, attn.reshape(B, -1))
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-        h = h + _mlp(cfg, lp, x, ep)
+        h = h + _mlp_out(cfg, lp, x, ep)
         return h, (k, v)
 
     h, (new_k, new_v) = jax.lax.scan(
-        layer, h, (params["layers"], cache.k, cache.v, local_k, local_v)
+        layer, h,
+        (params["layers"], jnp.arange(cfg.num_layers), cache.k, cache.v,
+         local_k, local_v),
     )
     local_k = jax.lax.dynamic_update_index_in_dim(
         local_k, new_k.astype(local_k.dtype), step, axis=2
@@ -608,9 +667,9 @@ def decode_chunk(
             "bkgts,bskd->btkgd", probs[..., :S], vc.astype(jnp.float32)
         ) + jnp.einsum("bkgtu,bukd->btkgd", probs[..., S:], v.astype(jnp.float32))
         attn = attn.reshape(B, T, -1).astype(h.dtype)
-        h = h + matmul(attn, lp["wo"])
+        h = h + _attn_out(cfg, lp, attn)
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-        h = h + _mlp(cfg, lp, x, ep)
+        h = h + _mlp_out(cfg, lp, x, ep)
         return h, (k, v)
 
     h, (new_k, new_v) = jax.lax.scan(layer, h, (params["layers"], cache.k, cache.v))
@@ -669,9 +728,9 @@ def prefill_tail(
             "bkgts,bskd->btkgd", probs[..., :P], vc.astype(jnp.float32)
         ) + jnp.einsum("bkgtu,bukd->btkgd", probs[..., P:], v.astype(jnp.float32))
         attn = attn.reshape(B, T, -1).astype(h.dtype)
-        h = h + matmul(attn, lp["wo"])
+        h = h + _attn_out(cfg, lp, attn)
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-        h = h + _mlp(cfg, lp, x, ep)
+        h = h + _mlp_out(cfg, lp, x, ep)
         return h, (k, v)
 
     h, (ks, vs) = jax.lax.scan(layer, h, (params["layers"], prefix_k, prefix_v))

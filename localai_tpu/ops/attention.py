@@ -24,18 +24,30 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def softcap_scores(sc: jnp.ndarray, cap: float) -> jnp.ndarray:
+    """Gemma-2 attention-logit softcapping: cap·tanh(sc/cap). Applied BEFORE
+    masking (tanh of NEG_INF would be finite and corrupt the mask)."""
+    return cap * jnp.tanh(sc / cap)
+
+
 def prefill_attention(
     q: jnp.ndarray,  # [B, S, H, D]
     k: jnp.ndarray,  # [B, S, K, D]
     v: jnp.ndarray,  # [B, S, K, D]
     length_mask: jnp.ndarray | None,  # [B, S] bool
     lengths: jnp.ndarray | None = None,  # [B] int32 (enables flash path)
+    softcap: float = 0.0,
+    window: int = 0,
+    sliding=None,  # traced bool scalar: this layer uses the sliding window
 ) -> jnp.ndarray:
     """Prefill attention dispatcher: Pallas flash kernel on TPU by default
-    (opt out with LOCALAI_FLASH=0), dense math otherwise."""
+    (opt out with LOCALAI_FLASH=0), dense math otherwise. Softcapping /
+    sliding windows (gemma-2) force the dense path."""
     S = q.shape[1]
     if (
         lengths is not None
+        and not softcap
+        and not window
         and os.environ.get("LOCALAI_FLASH", "1") != "0"
         and jax.default_backend() == "tpu"
         and (S & (S - 1)) == 0  # power-of-two bucket, divisible by any block
@@ -44,7 +56,8 @@ def prefill_attention(
 
         blk = min(128, S)
         return flash_prefill_attention(q, k, v, lengths, block_q=blk, block_k=blk)
-    return causal_prefill_attention(q, k, v, length_mask)
+    return causal_prefill_attention(q, k, v, length_mask, softcap=softcap,
+                                    window=window, sliding=sliding)
 
 
 def causal_prefill_attention(
@@ -52,6 +65,9 @@ def causal_prefill_attention(
     k: jnp.ndarray,  # [B, S, K, D]
     v: jnp.ndarray,  # [B, S, K, D]
     length_mask: jnp.ndarray | None = None,  # [B, S] bool, True = valid token
+    softcap: float = 0.0,
+    window: int = 0,
+    sliding=None,
 ) -> jnp.ndarray:
     """Dense causal attention for prompt processing. Returns [B, S, H, D]."""
     B, S, H, D = q.shape
@@ -65,7 +81,12 @@ def causal_prefill_attention(
 
     # scores: [B, K, G, S_q, S_k]
     scores = jnp.einsum("bqkgd,bskd->bkgqs", qf, kf) * scale
+    if softcap:
+        scores = softcap_scores(scores, softcap)
     causal = jnp.tril(jnp.ones((S, S), dtype=bool))
+    if window and sliding is not None:
+        dist = jnp.arange(S)[:, None] - jnp.arange(S)[None, :]  # q_pos - k_pos
+        causal = causal & (~sliding | (dist < window))
     mask = causal[None, None, None, :, :]
     if length_mask is not None:
         mask = jnp.logical_and(mask, length_mask[:, None, None, None, :])
@@ -123,6 +144,9 @@ def decode_attention_windowed(
     v_new: jnp.ndarray,
     positions: jnp.ndarray,  # [B] current token's position
     step: jnp.ndarray,  # scalar: index of the current token within the block
+    softcap: float = 0.0,
+    window: int = 0,
+    sliding=None,  # traced bool scalar: this layer uses the sliding window
 ) -> jnp.ndarray:
     """Decode attention over `cache[0:block_start] ⊕ local[0:step] ⊕ current`.
 
@@ -141,12 +165,25 @@ def decode_attention_windowed(
     qf = (q.astype(jnp.float32) * scale).reshape(B, K, G, D)
     block_start = positions - step  # [B]
     sc = jnp.einsum("bkgd,bskd->bkgs", qf, k_cache.astype(jnp.float32))
+    if softcap:
+        sc = softcap_scores(sc, softcap)
     valid_c = jnp.arange(S)[None, :] < block_start[:, None]
+    if window and sliding is not None:
+        # q position is `positions`; cache row s sits at position s.
+        dist_c = positions[:, None] - jnp.arange(S)[None, :]
+        valid_c = valid_c & (~sliding | (dist_c < window))
     sc = jnp.where(valid_c[:, None, None, :], sc, NEG_INF)
     sl = jnp.einsum("bkgd,bnkd->bkgn", qf, k_local.astype(jnp.float32))
+    if softcap:
+        sl = softcap_scores(sl, softcap)
     valid_l = jnp.arange(n) < step  # [n] — same for every slot
+    if window and sliding is not None:
+        # local row i sits at distance step - i from the current token.
+        valid_l = valid_l & (~sliding | ((step - jnp.arange(n)) < window))
     sl = jnp.where(valid_l[None, None, None, :], sl, NEG_INF)
     cur = jnp.einsum("bkgd,bkd->bkg", qf, k_new.astype(jnp.float32))[..., None]
+    if softcap:
+        cur = softcap_scores(cur, softcap)
     probs = jax.nn.softmax(jnp.concatenate([sc, sl, cur], axis=-1), axis=-1)
     out = (
         jnp.einsum("bkgs,bskd->bkgd", probs[..., :S], v_cache.astype(jnp.float32))

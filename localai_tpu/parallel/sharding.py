@@ -39,6 +39,9 @@ def _layer_specs(cfg: ArchConfig) -> dict[str, P]:
         "wo": P(None, "tp", None),
         "mlp_norm": P(None, None),
     }
+    if cfg.post_norms:  # gemma-2 sandwich norms — replicated like the rest
+        specs["post_attn_norm"] = P(None, None)
+        specs["post_ffw_norm"] = P(None, None)
     if cfg.attn_qkv_bias:
         specs["bq"] = P(None, "tp")
         specs["bk"] = P(None, "tp")

@@ -125,15 +125,47 @@ def test_gemma_save_round_trip(tmp_path):
     assert np.allclose(wq1, wq2, atol=1e-2)
 
 
-def test_gemma2_rejected_loudly(tmp_path):
+def test_gemma3_rejected_loudly(tmp_path):
     cfg_path = tmp_path / "config.json"
     cfg_path.write_text(json.dumps({
-        "model_type": "gemma2", "vocab_size": 64, "hidden_size": 16,
+        "model_type": "gemma3", "vocab_size": 64, "hidden_size": 16,
         "intermediate_size": 32, "num_hidden_layers": 2,
         "num_attention_heads": 2,
     }))
-    with pytest.raises(ValueError, match="gemma2"):
+    with pytest.raises(ValueError, match="gemma3"):
         arch_from_hf_config(str(tmp_path))
+
+
+def test_gemma2_checkpoint_matches_torch(tmp_path):
+    """Gemma-2: sandwich norms, attn/final softcapping, query_pre_attn
+    scale, and alternating sliding windows — the tiny window here (4) is
+    smaller than the sequence so the sliding mask is actually exercised."""
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+
+    cfg_hf = Gemma2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=128, rms_norm_eps=1e-6,
+        hidden_act="gelu_pytorch_tanh", hidden_activation="gelu_pytorch_tanh",
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        query_pre_attn_scalar=24.0, sliding_window=4,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(4)
+    model = Gemma2ForCausalLM(cfg_hf)
+    model.eval()
+    d = tmp_path / "gemma2"
+    model.save_pretrained(str(d), safe_serialization=True)
+
+    cfg = arch_from_hf_config(str(d))
+    assert cfg.post_norms and cfg.attn_softcap == 50.0
+    assert cfg.final_softcap == 30.0 and cfg.query_scale == 24.0
+    assert cfg.sliding_window == 4
+    params = load_hf_checkpoint(cfg, str(d))
+    params = jax.tree.map(lambda a: jnp.asarray(a, jnp.float32), params)
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": "float32"})
+    ids = [3, 17, 92, 5, 41, 8, 77, 13, 60, 2, 19, 33]  # len 12 > window 4
+    _logits_match(cfg, params, model, ids, atol=5e-3)
 
 
 def test_longrope_clamps_context(tmp_path):
@@ -148,6 +180,39 @@ def test_longrope_clamps_context(tmp_path):
     cfg = arch_from_hf_config(str(tmp_path))
     assert cfg.rope_scaling is None
     assert cfg.max_position == 4096  # unscaled rope → original window only
+
+
+def test_gemma2_serves_through_engine(tmp_path):
+    """Engine creation exercises the sharding spec tree (post-norm keys) and
+    the softcap/sliding decode path end to end."""
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+
+    from localai_tpu.engine.engine import Engine, EngineConfig
+    from localai_tpu.engine.tokenizer import ByteTokenizer
+
+    cfg_hf = Gemma2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=128,
+        hidden_act="gelu_pytorch_tanh", hidden_activation="gelu_pytorch_tanh",
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        query_pre_attn_scalar=24.0, sliding_window=8,
+    )
+    torch.manual_seed(5)
+    d = tmp_path / "g2"
+    Gemma2ForCausalLM(cfg_hf).save_pretrained(str(d), safe_serialization=True)
+    cfg = arch_from_hf_config(str(d))
+    params = load_hf_checkpoint(cfg, str(d))
+    eng = Engine(cfg, params, ByteTokenizer(cfg.vocab_size),
+                 engine_cfg=EngineConfig(max_slots=2, max_seq=64))
+    eng.start()
+    try:
+        text, ev = eng.generate(list(range(3, 20)), max_new_tokens=8,
+                                ignore_eos=True)
+        assert ev.kind == "done" and len(text) > 0
+        assert not eng._prefix_enabled  # prefill_tail lacks softcap/sliding
+    finally:
+        eng.stop()
 
 
 def test_gemma_serves_through_manager(tmp_path):
