@@ -99,9 +99,11 @@ class EngineConfig:
     min_prefill_bucket: int = 32
     base_seed: int = 0
     # Decode-block sizes the scheduler chooses from (descending). Bigger
-    # blocks amortize dispatch overhead; smaller ones bound end-of-request
-    # overshoot.
-    block_sizes: tuple[int, ...] = (16, 4, 1)
+    # blocks amortize dispatch overhead (which includes a network RTT on
+    # remote-tunneled chips — a 64-block measured ~15% more decode tok/s
+    # than a 16-block on llama-3.2-1b); smaller ones bound end-of-request
+    # overshoot and keep streaming/stop-sequence reaction granular.
+    block_sizes: tuple[int, ...] = (64, 16, 4, 1)
     # Decode blocks kept in flight while the host processes earlier results.
     pipeline_depth: int = 3
     # Prompt/prefix KV cache (reference: cache_prompt, grpc-server.cpp:125):
@@ -449,6 +451,8 @@ class Engine:
         self.m_generated_tokens = 0
         self._decode_time = 0.0
         self._decode_tokens = 0
+        self._charge_last = 0.0
+        self._charge_was_active = False
 
         self._block_cache: dict[tuple, Any] = {}
         self._admit_cache: dict[tuple, Any] = {}
@@ -1659,12 +1663,10 @@ class Engine:
 
     def _loop(self) -> None:
         trace = os.environ.get("LOCALAI_ENGINE_TRACE", "0") == "1"
-        last = time.monotonic()
+        self._charge_last = time.monotonic()
+        self._charge_was_active = False
         while not self._shutdown.is_set():
-            now = time.monotonic()
-            if self.h_active.any():
-                self._decode_time += now - last
-            last = now
+            self._charge()
 
             admitted = self._admit_pending()
             # Only host-walk grammars force single-step, serialized blocks;
@@ -2086,12 +2088,30 @@ class Engine:
     # Result processing (host bookkeeping)
     # ------------------------------------------------------------------ #
 
+    def _charge(self) -> None:
+        """Account wall time toward decode throughput. An interval counts if
+        slots were active at EITHER end — the iteration that processes a
+        block's results (and deactivates finished slots) spends the block's
+        whole execution inside np.asarray, and charging by the end state
+        alone would drop it, inflating tok/s most for large blocks. Runs on
+        the loop thread only."""
+        now = time.monotonic()
+        active = bool(self.h_active.any())
+        if self._charge_was_active or active:
+            self._decode_time += now - self._charge_last
+        self._charge_last = now
+        self._charge_was_active = active
+
     def _process_entry(self, e: _Entry) -> None:
         toks = np.asarray(e.toks)
         tk = np.asarray(e.tk) if e.tk is not None else None
         lp = (
             tuple(np.asarray(a) for a in e.lp) if e.lp is not None else None
         )  # (tok_lp, lp_ids, lp_vals)
+        # Charge the just-completed block's interval BEFORE any done events
+        # post: a caller reading the throughput counters right after
+        # result() returns must see this block's time in the denominator.
+        self._charge()
         if e.kind == "spec":
             # toks [k+1, B] with -1 marking not-emitted; tk holds accepted
             # counts per slot. Only slots that actually emit count toward the
