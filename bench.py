@@ -238,6 +238,12 @@ def main() -> None:
         finally:
             if peng is not None:
                 peng.stop()
+                # Drop the pool + sharded-param HBM now: nulling the attrs
+                # releases it even if a straggler thread still holds a
+                # reference to the engine object past stop()'s join.
+                peng.params = None
+                peng.cache = None
+                peng = None
 
     # MoE dispatch row (VERDICT r2 item 5): one Mixtral-shaped layer's MLP,
     # dense all-experts vs exact top-k ragged_dot, same inputs.
