@@ -95,6 +95,10 @@ class ModelConfig:
     # Engine shape knobs.
     max_slots: int = 8
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    # Paged KV cache (engine/engine.py kv_pages): pool HBM scales with live
+    # context instead of max_slots × context_size. 0 = dense cache.
+    kv_pages: int = 0
+    kv_page_size: int = 128
 
     # Speculative decoding (reference: draft_model/n_draft,
     # core/config/model_config.go:211-212).

@@ -480,7 +480,10 @@ class ModelManager:
             params,
             tokenizer,
             mesh_plan=plan,
-            engine_cfg=EngineConfig(max_slots=cfg.max_slots, max_seq=cfg.context_size),
+            engine_cfg=EngineConfig(
+                max_slots=cfg.max_slots, max_seq=cfg.context_size,
+                kv_pages=cfg.kv_pages, kv_page_size=cfg.kv_page_size,
+            ),
             draft_cfg=draft_arch,
             draft_params=draft_params,
             n_draft=cfg.n_draft,

@@ -172,6 +172,30 @@ def test_paged_rejects_bad_combos():
                                        kv_page_size=64))
 
 
+def test_paged_via_model_yaml(tmp_path):
+    """`kv_pages` in a model YAML reaches the engine through the manager —
+    the user-facing switch for the paged cache."""
+    import yaml
+
+    from localai_tpu.config import ApplicationConfig
+    from localai_tpu.server import ModelManager
+
+    (tmp_path / "m.yaml").write_text(yaml.safe_dump({
+        "name": "m", "model": "tiny", "context_size": 256,
+        "max_slots": 2, "kv_pages": 6, "kv_page_size": 64,
+    }))
+    manager = ModelManager(ApplicationConfig(models_dir=str(tmp_path)))
+    try:
+        lm = manager.get("m")
+        assert lm.engine._paged and lm.engine.ecfg.kv_pages == 6
+        text, ev = lm.engine.generate([1, 2, 3], max_new_tokens=4,
+                                      ignore_eos=True)
+        assert ev.kind == "done"
+        assert lm.engine.metrics()["kv_pages_total"] == 6.0
+    finally:
+        manager.shutdown()
+
+
 def test_paged_grammar_dfa_compose(engines):
     """On-device grammar masking and the paged cache are orthogonal."""
     import json
