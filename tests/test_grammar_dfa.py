@@ -138,6 +138,35 @@ def test_token_tables_follow_machine():
     assert walk(0, 256) == 0
 
 
+def test_next_tok_table_matches_char_walk():
+    """The fast [S, V] next-token table (small automata) must agree with the
+    char-walk transition for every (state, legal token) pair."""
+    schema = {"type": "array", "items": {"type": "integer"}, "minItems": 1}
+    dfa = compile_schema_dfa(schema)
+    tok_strs = [chr(c) for c in range(128)] + ["[1", ", 2", "12", "]", ""]
+    V = len(tok_strs)
+    tt = build_token_tables(dfa, tok_strs, {V - 1}, V)
+    assert tt.next_tok is not None  # small automaton → fast table built
+    for s in range(tt.trans.shape[0]):
+        am = np.unpackbits(tt.mask_bits[s], bitorder="little")[:V]
+        for t in np.nonzero(am)[0]:
+            if t == V - 1:
+                continue  # EOS ends the request; value unused
+            w = s
+            for c in tt.tok_cls[t]:
+                if c < 0:
+                    break
+                w = int(tt.trans[w, c])
+            assert w == int(tt.next_tok[s, t]), (s, t)
+
+
+def test_large_automaton_skips_next_tok():
+    dfa = compile_schema_dfa(TOOL_SCHEMA)  # ~678 states > NEXT_TOK_MAX_STATES
+    tok_strs = [chr(c) for c in range(256)] + [""]
+    tt = build_token_tables(dfa, tok_strs, {256}, 257)
+    assert tt.next_tok is None
+
+
 def test_tables_for_caches_and_rejects():
     toks = [chr(c) for c in range(256)]
     a = tables_for({"type": "boolean"}, toks, {255}, 256, tokenizer_id="t")
