@@ -89,14 +89,15 @@ class WSClient:
             pass
 
 
-@pytest.fixture(scope="module")
-def rt_server(tmp_path_factory):
+def _start_rt_server(models_dir):
+    """Boot the realtime stack over `models_dir`. Single source of the
+    server topology for the fixture and per-test servers."""
     from localai_tpu.config import ApplicationConfig
     from localai_tpu.server import ModelManager, Router, create_server
     from localai_tpu.server.openai_api import OpenAIApi
     from localai_tpu.server.realtime_api import RealtimeApi
 
-    d = tmp_path_factory.mktemp("rt-models")
+    d = models_dir
     (d / "chat.yaml").write_text(yaml.safe_dump({
         "name": "chat", "model": "tiny", "context_size": 128,
         "max_slots": 2, "max_tokens": 8, "temperature": 0.0,
@@ -119,6 +120,13 @@ def rt_server(tmp_path_factory):
     server = create_server(app_cfg, router)
     port = server.server_address[1]
     threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, manager, port
+
+
+@pytest.fixture(scope="module")
+def rt_server(tmp_path_factory):
+    d = tmp_path_factory.mktemp("rt-models")
+    server, manager, port = _start_rt_server(d)
     yield "127.0.0.1", port
     server.shutdown()
     manager.shutdown()
@@ -291,3 +299,62 @@ def test_oversized_frame_rejected_with_1009(rt_server):
         assert code == 1009
     finally:
         ws.close()
+
+
+def test_server_vad_uses_learned_model_when_configured(tmp_path):
+    """With a vad-backend model configured, realtime turn detection routes
+    through the learned net (silero role) instead of the energy heuristic —
+    asserted via the VAD engine's request counter."""
+    from localai_tpu.audio import learned_vad as LV
+
+    d = tmp_path
+    vcfg = LV.VadNetConfig()
+    vparams = LV.train_synthetic(vcfg, steps=120, seed=0)
+    mdir = d / "vadnet"
+    mdir.mkdir()
+    LV.save_params(str(mdir / "vad.safetensors"), vparams)
+    (d / "myvad.yaml").write_text(yaml.safe_dump({
+        "name": "myvad", "backend": "vad", "model": str(mdir),
+    }))
+    server, manager, port = _start_rt_server(d)
+    try:
+        ws = WSClient("127.0.0.1", port, "/v1/realtime?model=chat")
+        try:
+            assert ws.recv_json()["type"] == "session.created"
+            ws.send_json({"type": "session.update", "session": {
+                "modalities": ["text"],
+                "turn_detection": {"type": "server_vad",
+                                   "silence_duration_ms": 300},
+            }})
+            assert ws.recv_json()["type"] == "session.updated"
+
+            # Speech-like burst (harmonics with pitch modulation — what the
+            # synthetic trainer teaches) followed by trailing silence.
+            sr = 24_000
+            t = np.arange(int(sr * 0.6)) / sr
+            f0 = 140 * (1 + 0.1 * np.sin(2 * np.pi * 3 * t))
+            sig = sum(0.5 / h * np.sin(2 * np.pi * h * np.cumsum(f0) / sr)
+                      for h in range(1, 5))
+            env = 0.4 * np.abs(np.sin(2 * np.pi * 4 * t)) + 0.2
+            speech = np.clip(sig * env * 32767, -32768, 32767).astype(np.int16)
+            silence = np.zeros(int(sr * 0.6), np.int16)
+            ws.send_json({"type": "input_audio_buffer.append",
+                          "audio": base64.b64encode(speech.tobytes()).decode()})
+            ws.send_json({"type": "input_audio_buffer.append",
+                          "audio": base64.b64encode(silence.tobytes()).decode()})
+            seen = []
+            while True:
+                ev = ws.recv_json()
+                seen.append(ev["type"])
+                if ev["type"] == "response.done":
+                    break
+            assert "input_audio_buffer.speech_started" in seen
+            assert "input_audio_buffer.committed" in seen
+        finally:
+            ws.close()
+        lm = manager.peek("myvad")
+        assert lm is not None and lm.engine.vad_cfg is not None
+        assert lm.engine.m_requests > 0, "learned VAD was never consulted"
+    finally:
+        server.shutdown()
+        manager.shutdown()
