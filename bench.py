@@ -159,6 +159,8 @@ def main() -> None:
                 "required": ["a", "b", "c"],
             }
 
+            eng.prewarm_grammar(g_schema)  # sync table build (async otherwise)
+
             def g_run(env_val, n=3):
                 os.environ["LOCALAI_GRAMMAR_DFA"] = env_val
                 eng.generate([1, 2, 3], max_new_tokens=96, ignore_eos=False,

@@ -1272,6 +1272,12 @@ class Engine:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        if self._tok_fp is not None:
+            # Release grammar tables prewarm pinned against this engine's
+            # tokenizer — they can never hit again after the model swaps.
+            from localai_tpu.functions import dfa as dfa_mod
+
+            dfa_mod.unpin(self._tok_fp)
 
     def submit(self, request: GenRequest) -> RequestHandle:
         if not request.prompt_ids:
@@ -1534,6 +1540,24 @@ class Engine:
             for i in range(self.ecfg.max_slots)
         )
 
+    def prewarm_grammar(self, schema: Any) -> bool:
+        """Synchronously compile a schema's grammar tables into the module
+        cache so the FIRST request for it already runs on the device DFA
+        (uncached schemas otherwise build off-thread while their first
+        request serves via the host walk). Call at deployment warmup with
+        the tool schemas a service will use. Returns True when the DFA will
+        serve this schema, False when it will fall back to the host walk."""
+        from localai_tpu.functions import dfa as dfa_mod
+
+        if self._tok_strs is None:
+            self._tok_strs = self.tokenizer.token_strings()
+        tables = dfa_mod.tables_for(
+            schema, self._tok_strs, set(self.tokenizer.eos_ids),
+            self.cfg.vocab_size, tokenizer_id=self._tok_fingerprint(),
+            pin=True,  # prewarmed schemas are exempt from the LRU bound
+        )
+        return tables is not None
+
     # ------------------------------------------------------------------ #
     # On-device grammar DFA (functions/dfa.py)
     # ------------------------------------------------------------------ #
@@ -1565,14 +1589,15 @@ class Engine:
             return None  # active slots pin the current table set
         if self._tok_strs is None:
             self._tok_strs = self.tokenizer.token_strings()
-        # Table compilation takes seconds for large schemas. On an idle
-        # engine that only delays the requesting stream, so build inline;
-        # with other streams live, build on a worker thread and serve THIS
-        # request via the host-walk fallback — in-flight token streams never
-        # stall on a schema compile.
+        # Table compilation takes seconds for large schemas and this runs on
+        # the engine loop thread — an inline build would stall admission of
+        # EVERY request arriving meanwhile, not just the requesting stream.
+        # Always build uncached tables on a worker thread and serve this
+        # request via the host-walk fallback; the loop thread never blocks
+        # on a schema compile.
         if key in self._dfa_building:
             return None
-        if self.h_active.any() and not dfa_mod.is_cached(
+        if not dfa_mod.is_cached(
             schema, self._tok_fingerprint(), self.cfg.vocab_size
         ):
             self._dfa_building.add(key)
@@ -1590,9 +1615,14 @@ class Engine:
             threading.Thread(target=build, daemon=True,
                              name="grammar-dfa-build").start()
             return None
+        # cached_only: even if the entry was LRU-evicted between the
+        # is_cached check above and here, the loop thread must never become
+        # the builder — a miss host-walks this request and the next request
+        # re-triggers the async build.
         tables = dfa_mod.tables_for(
             schema, self._tok_strs, set(self.tokenizer.eos_ids),
             self.cfg.vocab_size, tokenizer_id=self._tok_fingerprint(),
+            cached_only=True,
         )
         if tables is None:
             return None

@@ -428,8 +428,21 @@ def arch_from_gguf(gf: GGUFFile):
     kv = gf.kv
     a = kv.get("general.architecture", "llama")
     # phi3 GGUFs store fused attn_qkv/ffn_up tensors this loader's tensor
-    # map doesn't split yet, and gemma2 adds pre/post-ffw norms + softcap +
-    # sliding windows — neither belongs in the silently-accepted set.
+    # map doesn't split yet, and gemma2/gemma3 add pre/post-ffw norms +
+    # softcap/qk-norm + sliding windows — mapping those as plain llama
+    # produces fluent-looking garbage, so they hard-error (matching
+    # arch_from_hf_config's strictness) instead of warning.
+    _WRONG_SEMANTICS = {
+        "phi3": "fused qkv/ffn_up tensors",
+        "gemma2": "post-norms + attn/final softcap + sliding windows",
+        "gemma3": "qk-norms + local/global rope + sliding windows",
+    }
+    if a in _WRONG_SEMANTICS:
+        raise ValueError(
+            f"GGUF arch {a!r} needs {_WRONG_SEMANTICS[a]} which this loader "
+            "does not implement — serving it with llama semantics would "
+            "produce wrong output. Use the HF safetensors checkpoint instead."
+        )
     if a not in ("llama", "qwen2", "qwen3", "mistral", "gemma", "granite"):
         log.warning("GGUF arch %r not in the known set; mapping as llama-family", a)
     gemma = a == "gemma"

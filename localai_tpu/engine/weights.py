@@ -114,23 +114,26 @@ def load_hf_checkpoint(
     do_quant = quantize in ("int8", "int4")
     lora_deltas: dict[str, dict[int, np.ndarray]] = {}
     for adir, w in lora or []:
-        for our, per_layer in load_lora_deltas(adir, w).items():
+        for our, per_layer in load_lora_deltas(adir, w, cfg).items():
             tgt = lora_deltas.setdefault(our, {})
             for li, d in per_layer.items():
-                if li >= cfg.num_layers:
+                layer_i = li[0] if isinstance(li, tuple) else li
+                if layer_i >= cfg.num_layers:
                     raise ValueError(
-                        f"lora delta for {our!r} targets layer {li}, model "
-                        f"has {cfg.num_layers}"
+                        f"lora delta for {our!r} targets layer {layer_i}, "
+                        f"model has {cfg.num_layers}"
                     )
                 tgt[li] = tgt[li] + d if li in tgt else d
 
     def merge_lora(our: str, stacked: np.ndarray) -> np.ndarray:
         # Per-layer f32 add — never a full-model-shaped f32 buffer.
+        # Index is the layer int, or (layer, expert) for MoE projections.
         for li, d in lora_deltas.get(our, {}).items():
-            if d.shape != stacked.shape[1:]:
+            _check_lora_index(our, li, stacked.shape)
+            if d.shape != stacked[li].shape:
                 raise ValueError(
-                    f"lora delta for {our!r} layer {li} has shape {d.shape}, "
-                    f"model expects {stacked.shape[1:]}"
+                    f"lora delta for {our!r} index {li} has shape {d.shape}, "
+                    f"model expects {stacked[li].shape}"
                 )
             stacked[li] = (stacked[li].astype(np.float32) + d).astype(stacked.dtype)
         return stacked
@@ -259,7 +262,9 @@ def load_hf_checkpoint(
                     for e in range(cfg.num_experts)
                 ]
                 per_layer.append(np.stack(experts))
-            layers[our] = place(f"layers/{our}", np.stack(per_layer), can_quant=True)
+            layers[our] = place(
+                f"layers/{our}", merge_lora(our, np.stack(per_layer)), can_quant=True
+            )
 
     params: Params = {
         "embed": put("embed", grab("model.embed_tokens.weight", False)),
@@ -292,16 +297,50 @@ _LORA_TARGETS = {
 }
 
 
+# PEFT fused-module targets (phi-3 layout): delta columns split into the same
+# row blocks _FUSED uses at checkpoint load, so adapters trained against the
+# fused projections land on the per-head tensors we actually serve.
+_LORA_FUSED = {
+    "qkv_proj": ("wq", "wk", "wv"),
+    "gate_up_proj": ("w_gate", "w_up"),
+}
+# Mixtral-style per-expert projections: w1/w3/w2 -> (key, expert) slices of
+# the stacked [L, E, in, out] expert tensors.
+_LORA_EXPERT = {"w1": "w_gate", "w3": "w_up", "w2": "w_down"}
+# Targets that genuinely have no served matmul (skip quietly, not an error).
+_LORA_IGNORED = ("embed_tokens", "lm_head", "norm")
+
+
+def _check_lora_index(our: str, idx: Any, shape: tuple) -> None:
+    """Every leading index (layer, and expert for MoE keys) must be in
+    range — jnp's clamped gather would otherwise merge a mis-indexed delta
+    into the wrong expert silently."""
+    parts = idx if isinstance(idx, tuple) else (idx,)
+    for ax, j in enumerate(parts):
+        if not 0 <= j < shape[ax]:
+            raise ValueError(
+                f"lora delta for {our!r} index {idx} is out of range for "
+                f"model shape {shape}"
+            )
+
+
 def load_lora_deltas(
-    adapter_dir: str, weight: float = 1.0
-) -> dict[str, dict[int, np.ndarray]]:
+    adapter_dir: str, weight: float = 1.0, cfg: ArchConfig | None = None
+) -> dict[str, dict[Any, np.ndarray]]:
     """Read a PEFT-format adapter into per-key per-layer f32 weight deltas.
 
-    Returns {our_key: {layer: [in, out] f32 delta}} where each delta is
+    Returns {our_key: {index: [in, out] f32 delta}} where each delta is
     weight · (alpha/r) · (B@A)^T (PEFT stores A [r, in], B [out, r]; our
-    weights are [in, out]). Reads `adapter_config.json` +
-    `adapter_model.safetensors` (names like
+    weights are [in, out]). `index` is the layer int for dense keys, or a
+    (layer, expert) tuple for MoE expert projections. Reads
+    `adapter_config.json` + `adapter_model.safetensors` (names like
     `base_model.model.model.layers.N.self_attn.q_proj.lora_A.weight`).
+
+    Fused phi-3 targets (`qkv_proj`, `gate_up_proj`) are split into the
+    per-head deltas by the same row blocks the checkpoint loader's _FUSED
+    table uses — `cfg` is required for the qkv split (head sizes). Adapters
+    whose targets include no served matmul raise instead of silently
+    applying nothing (the server must not claim "merged" for a no-op).
     Only the small rank-r factors and one [in, out] delta per targeted
     (key, layer) ever materialize.
     """
@@ -322,21 +361,88 @@ def load_lora_deltas(
             tensors[name] = np.asarray(f.get_tensor(name), np.float32)
 
     pat = re.compile(r"layers\.(\d+)\.(.+)\.lora_A\.weight$")
-    per_key: dict[str, dict[int, np.ndarray]] = {}
+    expert_pat = re.compile(r"experts\.(\d+)\.(w[123])$")
+    per_key: dict[str, dict[Any, np.ndarray]] = {}
+    unmatched: list[str] = []
+
+    def add(our: str, idx: Any, delta: np.ndarray) -> None:
+        tgt = per_key.setdefault(our, {})
+        tgt[idx] = tgt[idx] + delta if idx in tgt else delta
+
+    ignored: list[str] = []
     for name, a in tensors.items():
+        if not name.endswith("lora_A.weight"):
+            continue
         m = pat.search(name)
         if m is None:
+            # Non-layer targets (embed_tokens / lm_head / final norm) have
+            # no served per-layer matmul — recognized but skipped.
+            if any(tag in name for tag in _LORA_IGNORED):
+                ignored.append(name)
+            else:
+                unmatched.append(name)
             continue
         layer, module = int(m.group(1)), m.group(2)
-        our = _LORA_TARGETS.get(module) or _LORA_TARGETS.get(module.split(".")[-1])
-        if our is None:
-            continue  # embeddings/norm targets are not served; skip quietly
         b = tensors.get(name[: -len("lora_A.weight")] + "lora_B.weight")
         if b is None:
+            unmatched.append(f"{module} (no lora_B)")
             continue
-        delta = (b @ a).T * scale
-        tgt = per_key.setdefault(our, {})
-        tgt[layer] = tgt[layer] + delta if layer in tgt else delta
+        short = module.split(".")[-1]
+        our = _LORA_TARGETS.get(module) or _LORA_TARGETS.get(short)
+        if our is not None:
+            add(our, layer, (b @ a).T * scale)
+            continue
+        em = expert_pat.search(module)
+        if em is not None:
+            add(_LORA_EXPERT[em.group(2)], (layer, int(em.group(1))),
+                (b @ a).T * scale)
+            continue
+        if short in _LORA_FUSED:
+            delta = (b @ a).T * scale  # [in, out_total]
+            if short == "qkv_proj":
+                if cfg is None:
+                    raise ValueError(
+                        f"adapter {adapter_dir!r} targets fused {short!r}; "
+                        "splitting it needs the model's head sizes (cfg)"
+                    )
+                sizes = [cfg.num_heads * cfg.head_dim_,
+                         cfg.num_kv_heads * cfg.head_dim_,
+                         cfg.num_kv_heads * cfg.head_dim_]
+            else:  # gate_up_proj: two equal halves
+                sizes = [delta.shape[1] // 2] * 2
+            if delta.shape[1] != sum(sizes):
+                raise ValueError(
+                    f"lora delta for fused {short!r} layer {layer} has "
+                    f"{delta.shape[1]} output cols, expected {sum(sizes)}"
+                )
+            off = 0
+            for part_key, size in zip(_LORA_FUSED[short], sizes):
+                add(part_key, layer, delta[:, off: off + size])
+                off += size
+            continue
+        if any(tag in module for tag in _LORA_IGNORED):
+            ignored.append(module)  # per-layer norms are not served matmuls
+            continue
+        unmatched.append(module)
+
+    if unmatched:
+        log.warning(
+            "lora adapter %s: unrecognized target modules skipped: %s",
+            adapter_dir, sorted(set(unmatched)),
+        )
+    if not per_key:
+        detail = []
+        if unmatched:
+            detail.append(f"unrecognized targets: {sorted(set(unmatched))}")
+        if ignored:
+            detail.append(
+                f"targets with no served matmul (embed/lm_head/norm): "
+                f"{sorted(set(ignored))}"
+            )
+        raise ValueError(
+            f"lora adapter {adapter_dir!r} matched no served weight — "
+            + ("; ".join(detail) or "no lora_A tensors found")
+        )
     return per_key
 
 
@@ -352,7 +458,7 @@ def apply_lora(
     host pass). Updates are per-layer `at[].add`s, so no full-model-shaped
     f32 buffer ever materializes. Returns the updated tree.
     """
-    per_key = load_lora_deltas(adapter_dir, weight)
+    per_key = load_lora_deltas(adapter_dir, weight, cfg)
     layers = dict(params["layers"])
     for our, deltas in per_key.items():
         leaf = layers.get(our)
@@ -363,13 +469,14 @@ def apply_lora(
                 "cannot merge a LoRA adapter into quantized weights — load "
                 "the checkpoint unquantized and quantize after merging"
             )
-        for layer, delta in deltas.items():
-            if layer >= cfg.num_layers or delta.shape != leaf.shape[1:]:
+        for idx, delta in deltas.items():
+            _check_lora_index(our, idx, leaf.shape)
+            if delta.shape != leaf[idx].shape:
                 raise ValueError(
-                    f"lora delta for {our!r} layer {layer} has shape "
-                    f"{delta.shape}, model expects {leaf.shape[1:]}"
+                    f"lora delta for {our!r} index {idx} has shape "
+                    f"{delta.shape}, model expects {leaf[idx].shape}"
                 )
-            leaf = leaf.at[layer].add(jnp.asarray(delta, leaf.dtype))
+            leaf = leaf.at[idx].add(jnp.asarray(delta, leaf.dtype))
         layers[our] = leaf
     out = dict(params)
     out["layers"] = layers

@@ -27,6 +27,7 @@ class NetworkEntry:
     name: str
     url: str  # federation router base URL
     description: str = ""
+    token: str = ""  # shared federation token, sent on liveness probes
     added_at: float = 0.0
     online: bool = False
     failures: int = 0
@@ -34,8 +35,14 @@ class NetworkEntry:
     models: list = dataclasses.field(default_factory=list)
     last_checked: float = 0.0
 
-    def to_dict(self) -> dict:
-        return dataclasses.asdict(self)
+    def to_dict(self, redact_token: bool = False) -> dict:
+        """Full dict for persistence; `redact_token=True` for HTTP responses
+        — publishing the admission token would let any directory visitor
+        register rogue workers with the listed federation."""
+        d = dataclasses.asdict(self)
+        if redact_token and d.get("token"):
+            d["token"] = "***"
+        return d
 
 
 class Database:
@@ -120,7 +127,10 @@ class DiscoveryService:
         """One liveness check; mutates + persists the entry."""
         base = entry.url.rstrip("/")
         try:
-            with urllib.request.urlopen(base + "/federation/workers", timeout=5) as r:
+            req = urllib.request.Request(base + "/federation/workers")
+            if entry.token:
+                req.add_header("LocalAI-P2P-Token", entry.token)
+            with urllib.request.urlopen(req, timeout=5) as r:
                 fed = json.loads(r.read())
             entry.workers = sum(1 for w in fed.get("workers", []) if w.get("healthy"))
             entry.online = True

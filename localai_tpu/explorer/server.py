@@ -72,7 +72,8 @@ class ExplorerServer:
                 if self.path in ("/", "/index.html"):
                     self._html(_DASHBOARD)
                 elif self.path == "/networks":
-                    self._json(200, {"networks": [e.to_dict() for e in ex.db.list()]})
+                    self._json(200, {"networks": [e.to_dict(redact_token=True)
+                                                  for e in ex.db.list()]})
                 else:
                     self._json(404, {"error": "not found"})
 
@@ -91,12 +92,17 @@ class ExplorerServer:
                 if not _NAME_RE.match(name) or not url.startswith(("http://", "https://")):
                     self._json(400, {"error": "valid name and http(s) url required"})
                     return
+                # The token is stored so the liveness probe can reach the
+                # token-gated /federation/workers; it is REDACTED from all
+                # HTTP responses (publishing it would let any visitor
+                # register rogue workers with the listed federation).
                 entry = NetworkEntry(
-                    name=name, url=url, description=body.get("description", "")
+                    name=name, url=url, description=body.get("description", ""),
+                    token=body.get("token", ""),
                 )
                 # Probe immediately so a bogus registration never shows online.
                 ex.discovery.probe(entry)
-                self._json(201, entry.to_dict())
+                self._json(201, entry.to_dict(redact_token=True))
 
             def do_DELETE(self):
                 if not self.path.startswith("/networks/"):

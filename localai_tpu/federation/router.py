@@ -197,6 +197,13 @@ class FederatedServer:
 
             def _control(self) -> bool:
                 if self.path == "/federation/workers" and self.command == "GET":
+                    # The listing leaks worker names/URLs/load — gate it with
+                    # the same shared token as join/leave (the reference's
+                    # token gates the whole p2p overlay, p2p.go:31-64;
+                    # explorer/worker callers already hold it).
+                    if not self._authorized():
+                        self._json(401, {"error": "federation token required"})
+                        return True
                     self._json(200, {"workers": [
                         {
                             "name": w.name, "url": w.url, "healthy": w.healthy,

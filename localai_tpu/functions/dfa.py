@@ -346,11 +346,26 @@ _CACHE: dict[tuple, TokenTables] = {}
 _CACHE_MAX = 8
 _FAILED: dict[tuple, bool] = {}  # insertion-ordered — evicted FIFO
 _FAILED_MAX = 256
+_PINNED: set = set()  # prewarmed keys exempt from LRU eviction (operator-controlled)
+_BUILDING: dict = {}  # key -> threading.Event, dedupes concurrent builds
 _LOCK = threading.Lock()
 
 
 def schema_key(schema: Any) -> str:
     return json.dumps(schema, sort_keys=True, separators=(",", ":"), default=str)
+
+
+def unpin(tokenizer_id: Any = None) -> None:
+    """Drop pinned tables (all, or those for one tokenizer fingerprint).
+
+    Engines call this with their fingerprint at stop so prewarmed tables
+    keyed to a dead tokenizer don't leak for the process lifetime across
+    model hot-swaps."""
+    with _LOCK:
+        for key in list(_PINNED):
+            if tokenizer_id is None or key[1] == tokenizer_id:
+                _PINNED.discard(key)
+                _CACHE.pop(key, None)
 
 
 def is_cached(schema: Any, tokenizer_id: Any, vocab_size: int) -> bool:
@@ -362,27 +377,59 @@ def is_cached(schema: Any, tokenizer_id: Any, vocab_size: int) -> bool:
 
 def tables_for(schema: Any, tok_strs: list[str], eos_ids: set[int],
                vocab_size: int, tokenizer_id: Any = None,
-               max_states: int = 3072) -> Optional[TokenTables]:
-    """Cached TokenTables for a schema, or None if unsupported."""
+               max_states: int = 3072, pin: bool = False,
+               cached_only: bool = False) -> Optional[TokenTables]:
+    """Cached TokenTables for a schema, or None if unsupported.
+
+    Concurrent calls for the same key build once: the second caller blocks
+    on the first build's completion instead of burning a redundant
+    multi-second compile. `pin=True` (prewarm path) exempts the entry from
+    LRU eviction so a warmed schema stays resident regardless of how many
+    request-driven schemas churn through the bounded cache. `cached_only`
+    never builds — it returns the hit or None, so latency-critical threads
+    (the engine loop) cannot become the builder even if the entry was
+    evicted between an `is_cached` check and this call.
+    """
     key = (schema_key(schema), tokenizer_id, vocab_size)
-    with _LOCK:
-        if key in _FAILED:
-            return None
-        hit = _CACHE.pop(key, None)
-        if hit is not None:
-            _CACHE[key] = hit  # LRU bump
-            return hit
-    try:
-        dfa = compile_schema_dfa(schema, max_states=max_states)
-        tables = build_token_tables(dfa, tok_strs, eos_ids, vocab_size)
-    except DfaUnsupported:
+    while True:
         with _LOCK:
-            _FAILED[key] = True
-            while len(_FAILED) > _FAILED_MAX:
-                _FAILED.pop(next(iter(_FAILED)))
-        return None
-    with _LOCK:
-        _CACHE[key] = tables
-        while len(_CACHE) > _CACHE_MAX:
-            _CACHE.pop(next(iter(_CACHE)))
-    return tables
+            if key in _FAILED:
+                return None
+            hit = _CACHE.pop(key, None)
+            if hit is not None:
+                _CACHE[key] = hit  # LRU bump
+                if pin:
+                    _PINNED.add(key)
+                return hit
+            if cached_only:
+                return None
+            ev = _BUILDING.get(key)
+            if ev is None:
+                ev = threading.Event()
+                _BUILDING[key] = ev
+                break  # we build
+        ev.wait()  # someone else is building this key; wait and re-check
+    try:
+        try:
+            dfa = compile_schema_dfa(schema, max_states=max_states)
+            tables = build_token_tables(dfa, tok_strs, eos_ids, vocab_size)
+        except DfaUnsupported:
+            with _LOCK:
+                _FAILED[key] = True
+                while len(_FAILED) > _FAILED_MAX:
+                    _FAILED.pop(next(iter(_FAILED)))
+            return None
+        with _LOCK:
+            _CACHE[key] = tables
+            if pin:
+                _PINNED.add(key)
+            # Request-driven (unpinned) entries stay bounded; pinned entries
+            # are operator-controlled and never evicted.
+            evictable = [k for k in _CACHE if k not in _PINNED]
+            while len(evictable) > _CACHE_MAX:
+                _CACHE.pop(evictable.pop(0))
+        return tables
+    finally:
+        with _LOCK:
+            _BUILDING.pop(key, None)
+        ev.set()

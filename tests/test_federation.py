@@ -165,6 +165,17 @@ def test_federation_register_requires_token():
         assert exc.value.code == 401
         assert fed.registry.list() == []
 
+        # The workers listing leaks topology/load — it is token-gated too.
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/federation/workers", timeout=5)
+        assert exc.value.code == 401
+        req = urllib.request.Request(
+            base + "/federation/workers",
+            headers={"Authorization": "Bearer s3cret"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert json.loads(r.read())["workers"] == []
+
         # Correct token (either header form) is accepted.
         assert register_with_federator(base, "good", "http://127.0.0.1:2", token="s3cret")
         assert [w.name for w in fed.registry.list()] == ["good"]

@@ -188,6 +188,10 @@ def engine():
     eng = Engine(cfg, params, ByteTokenizer(cfg.vocab_size),
                  engine_cfg=EngineConfig(max_slots=4, max_seq=256))
     eng.start()
+    # Uncached schemas build off-thread (their first request host-walks);
+    # prewarm the ones these tests assert DFA engagement on.
+    assert eng.prewarm_grammar(SCHEMAS[1])
+    assert eng.prewarm_grammar(TOOL_SCHEMA)
     yield eng
     eng.stop()
 
