@@ -99,6 +99,10 @@ class ModelConfig:
     # context instead of max_slots × context_size. 0 = dense cache.
     kv_pages: int = 0
     kv_page_size: int = 128
+    # KV-cache storage dtype (reference: cache_type_k/cache_type_v →
+    # CacheTypeKey/Value, backend.proto:261-262). "fp8" halves KV HBM — 2x
+    # servable context at the same pool size. Empty = model dtype.
+    kv_cache_dtype: str = ""
 
     # Speculative decoding (reference: draft_model/n_draft,
     # core/config/model_config.go:211-212).
@@ -114,6 +118,14 @@ class ModelConfig:
     # Weight-only quantization at load ("int8"; reference analogue:
     # quantized GGUF serving). Halves weight HBM traffic + footprint.
     quantization: str = ""
+
+    # RoPE overrides (reference: core/config/model_config.go:231-237
+    # rope_scaling / rope_freq_base forwarded to engines). Keys mirror HF
+    # rope_scaling: rope_type (linear|llama3|yarn|longrope), factor,
+    # original_max_position_embeddings, low/high_freq_factor,
+    # beta_fast/beta_slow, long_factor/short_factor, attention_factor.
+    rope_scaling: Optional[dict] = None
+    rope_freq_base: float = 0.0  # overrides rope_theta when > 0
 
     # Output post-processing (reference Finetune, core/backend/llm.go:217-265).
     echo: bool = False

@@ -128,6 +128,30 @@ class EngineConfig:
     # of preempting live ones. 0 = dense cache.
     kv_pages: int = 0
     kv_page_size: int = 128
+    # KV-cache storage dtype (reference: CacheTypeKey/CacheTypeValue,
+    # backend/backend.proto:261-262, llama.cpp q8 KV). "" = model dtype;
+    # "fp8" (e4m3) / "fp8_e5m2" halve KV bytes — the TPU-native equivalent
+    # of q8 (cast-only, no scale bookkeeping; XLA fuses the converts into
+    # the cache reads/writes). Composes with dense/paged/sp/spec/prefix:
+    # every kernel reads via astype(f32) and writes via astype(cache dtype).
+    kv_cache_dtype: str = ""
+
+    def cache_dtype(self, model_dtype):
+        import jax.numpy as _jnp
+
+        table = {
+            "": None,
+            "fp8": _jnp.float8_e4m3fn,
+            "fp8_e4m3": _jnp.float8_e4m3fn,
+            "fp8_e5m2": _jnp.float8_e5m2,
+        }
+        if self.kv_cache_dtype not in table:
+            raise ValueError(
+                f"kv_cache_dtype {self.kv_cache_dtype!r} not supported — "
+                "use 'fp8' (e4m3) or 'fp8_e5m2'"
+            )
+        dt = table[self.kv_cache_dtype]
+        return _jnp.dtype(model_dtype) if dt is None else dt
 
     def buckets(self) -> list[int]:
         out, b = [], self.min_prefill_bucket
@@ -301,16 +325,6 @@ class Engine:
                     "speculative decoding with a sequence-sharded KV cache "
                     "(sp>1) is not supported yet — drop the draft model or sp"
                 )
-        if draft_cfg is not None and any(
-            c.attn_softcap or c.sliding_window for c in (cfg, draft_cfg)
-        ):
-            # Applies to the DRAFT too: draft proposals run through
-            # decode_step, which has no softcap/sliding support — a gemma-2
-            # draft would silently collapse the acceptance rate.
-            raise ValueError(
-                "speculative decoding is not supported for softcap/"
-                "sliding-window (gemma-2) models yet — drop the draft model"
-            )
         # Speculative decoding (reference: draft_model/n_draft,
         # model_config.go:211-212 passed into llama.cpp's batch decode).
         self.draft_cfg = draft_cfg
@@ -345,11 +359,6 @@ class Engine:
                     raise ValueError(
                         "paged KV cache (kv_pages > 0) requires dp == sp == 1"
                     )
-                if draft_cfg is not None:
-                    raise ValueError(
-                        "paged KV cache with a draft model is not supported "
-                        "yet — drop kv_pages or the draft"
-                    )
                 if S % self.ecfg.kv_page_size:
                     raise ValueError(
                         f"max_seq={S} must divide by kv_page_size="
@@ -365,7 +374,8 @@ class Engine:
                 # step) land in a page nobody attends instead of corrupting
                 # a live request's pages.
                 pool = llama.paged_cache_zeros(
-                    cfg, self.ecfg.kv_pages + 1, self.ecfg.kv_page_size
+                    cfg, self.ecfg.kv_pages + 1, self.ecfg.kv_page_size,
+                    dtype=self.ecfg.cache_dtype(cfg.dtype),
                 )
                 self.cache = llama.KVCache(
                     k=jax.device_put(pool.k, pool_shard),
@@ -373,13 +383,14 @@ class Engine:
                 )
             else:
                 kshard, vshard = cache_shardings(self.mesh, self.plan.sp)
+                cache_dt = self.ecfg.cache_dtype(cfg.dtype)
                 self.cache = llama.KVCache(
                     k=jax.device_put(
-                        jnp.zeros((cfg.num_layers, B, S, cfg.num_kv_heads, cfg.head_dim_), jnp.dtype(cfg.dtype)),
+                        jnp.zeros((cfg.num_layers, B, S, cfg.num_kv_heads, cfg.head_dim_), cache_dt),
                         kshard,
                     ),
                     v=jax.device_put(
-                        jnp.zeros((cfg.num_layers, B, S, cfg.num_kv_heads, cfg.head_dim_), jnp.dtype(cfg.dtype)),
+                        jnp.zeros((cfg.num_layers, B, S, cfg.num_kv_heads, cfg.head_dim_), cache_dt),
                         vshard,
                     ),
                 )
@@ -477,6 +488,11 @@ class Engine:
         )
         self._free_pages: list[int] = list(range(self.ecfg.kv_pages))
         self._slot_pages: list[list[int]] = [[] for _ in range(B)]
+        # Page refcounts: a page may be referenced by its owning slot AND by
+        # prefix-cache entries (copy-on-write sharing — spans live in pool
+        # pages mapped read-only into later admissions' tables). A page
+        # returns to the free list only at refcount 0.
+        self._page_refs = np.zeros((max(self.ecfg.kv_pages, 1),), np.int32)
         self._build_programs()
 
     @property
@@ -491,20 +507,48 @@ class Engine:
                    min(plen + request.max_new_tokens, self.ecfg.max_seq))
         return -(-rows // self.ecfg.kv_page_size)
 
-    def _pages_alloc(self, slot_idx: int, n: int) -> Optional[np.ndarray]:
+    def _pages_needed_cached(self, request: GenRequest, match_len: int) -> int:
+        """Fresh pages for a prefix-hit admission: the span's pages are
+        shared (zero cost), only the tail bucket + decode growth allocate."""
+        page = self.ecfg.kv_page_size
+        plen = len(request.prompt_ids)
+        tb = self._bucket_for(plen - match_len)
+        total = max(match_len + tb,
+                    min(plen + request.max_new_tokens, self.ecfg.max_seq))
+        return -(-total // page) - match_len // page
+
+    def _pages_alloc(self, slot_idx: int, n: int,
+                     shared: Optional[list[int]] = None) -> Optional[np.ndarray]:
+        """Build a slot's page table: `shared` read-only prefix pages (a
+        prefix-cache span — refcounted, never written by this slot because
+        all its writes land at rows past the shared span) followed by `n`
+        freshly-allocated pages."""
         if len(self._free_pages) < n:
             return None
-        pages = [self._free_pages.pop() for _ in range(n)]
+        shared = shared or []
+        fresh = [self._free_pages.pop() for _ in range(n)]
+        for p in fresh:
+            self._page_refs[p] = 1
+        for p in shared:
+            self._page_refs[p] += 1
+        pages = shared + fresh
         self._slot_pages[slot_idx] = pages
         # Unused tail entries point at SCRATCH so any row past the slot's
         # reservation (end-of-request block overshoot) lands harmlessly.
         row = np.full((self._max_pages,), self._scratch_page, np.int32)
-        row[: n] = pages
+        row[: len(pages)] = pages
         self.h_ptable[slot_idx] = row
         return row
 
+    def _pages_release(self, pages: list[int]) -> None:
+        for p in pages:
+            self._page_refs[p] -= 1
+            if self._page_refs[p] <= 0:
+                self._page_refs[p] = 0
+                self._free_pages.append(p)
+
     def _pages_free(self, slot_idx: int) -> None:
-        self._free_pages.extend(self._slot_pages[slot_idx])
+        self._pages_release(self._slot_pages[slot_idx])
         self._slot_pages[slot_idx] = []
         # The slot stays in every decode block's scatter until re-admitted —
         # its stale table must not alias pages handed to the next request.
@@ -827,20 +871,28 @@ class Engine:
 
             def admit_spec(params, cache, counts, rngs, bias, d_tokens,
                            d_positions, dparams, dcache, prompt_toks, aux,
-                           samp_pack, bias_rows, *gargs):
+                           samp_pack, bias_rows, *rest):
+                # rest mirrors _dispatch_admit's assembly: [dfa 4?]
+                # [ptable?] [d_gstate? — appended last].
+                i = 0
+                gmask0 = gtrans = tok_cls = ginit = d_gstate = None
                 if with_dfa:
-                    gmask0, gtrans, tok_cls, ginit, d_gstate = gargs
-                    out = admit(params, cache, counts, rngs, bias, d_tokens,
-                                d_positions, prompt_toks, aux, samp_pack,
-                                bias_rows, gmask0=gmask0, gtrans=gtrans,
-                                tok_cls=tok_cls, ginit=ginit,
-                                d_gstate=d_gstate)
-                else:
-                    out = admit(params, cache, counts, rngs, bias, d_tokens,
-                                d_positions, prompt_toks, aux, samp_pack,
-                                bias_rows)
+                    gmask0, gtrans, tok_cls, ginit = rest[i: i + 4]
+                    i += 4
+                ptable = None
+                if paged:
+                    ptable = rest[i]
+                    i += 1
+                if with_dfa:
+                    d_gstate = rest[i]
+                out = admit(params, cache, counts, rngs, bias, d_tokens,
+                            d_positions, prompt_toks, aux, samp_pack,
+                            bias_rows, gmask0=gmask0, gtrans=gtrans,
+                            tok_cls=tok_cls, ginit=ginit,
+                            d_gstate=d_gstate, ptable=ptable)
                 # Prefill the draft model too so its KV cache matches the
-                # prompt before the first speculative round.
+                # prompt before the first speculative round (the draft's own
+                # cache stays dense — it is small).
                 _, dks, dvs = llama.prefill(dcfg, dparams, prompt_toks, aux[0], ep=self.plan.ep)
                 for j in range(m):
                     dcache = llama.write_prefill_to_cache(
@@ -850,7 +902,9 @@ class Engine:
 
             donate = (1, 2, 3, 4, 5, 6, 8)
             if with_dfa:
-                donate = donate + (17,)  # d_gstate (last of *gargs)
+                # d_gstate is the LAST positional arg (after the 13 fixed,
+                # the 4 dfa tables, and the optional ptable).
+                donate = donate + (13 + 4 + (1 if paged else 0),)
             fn = jax.jit(admit_spec, donate_argnums=donate)
         self._admit_cache[key] = fn
         return fn
@@ -944,24 +998,111 @@ class Engine:
         self._admit_cache[key] = fn
         return fn
 
+    def _get_admit_cached_paged(self, npg: int, tb: int, has_bias: bool,
+                                with_topk: bool, with_lp: bool,
+                                with_dfa: bool = False):
+        """Cached admission against the PAGE POOL: the span's pages are
+        mapped read-only into the slot's table (no copy — copy-on-write
+        sharing), gathered once for the tail's attention, and the freshly
+        prefilled tail rows scatter into the slot's own fresh pages. Always
+        m=1; `aux` is [4] i32 (tail_len, slot, seed, prefix_len) with
+        prefix_len page-aligned; `pages` is the [npg] span page list
+        (SCRATCH-padded — rows past prefix_len are masked by prefill_tail)."""
+        key = ("cached-paged", npg, tb, has_bias, with_topk, with_lp, with_dfa)
+        fn = self._admit_cache.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        V = cfg.vocab_size
+        K = min(self.GRAMMAR_TOPK, V)
+        LK = min(self.LOGPROB_TOPK, V)
+        tok_v = min(getattr(self.tokenizer, "vocab_size", V) or V, V)
+
+        def admit_cached_paged(params, cache, counts, rngs, bias, d_tokens,
+                               d_positions, pages, table_row, tail_toks,
+                               count_row, aux, samp_pack, bias_rows,
+                               gmask0=None, gtrans=None, tok_cls=None,
+                               ginit=None, d_gstate=None):
+            tail_len, slot, seed, plen = aux[0], aux[1], aux[2], aux[3]
+            samp = SamplingParams(
+                temperature=samp_pack[0], top_k=samp_pack[1].astype(jnp.int32),
+                top_p=samp_pack[2], min_p=samp_pack[3], repeat_penalty=samp_pack[4],
+                presence_penalty=samp_pack[5], frequency_penalty=samp_pack[6],
+            )
+            pk, pv = llama.gather_pages(cache, pages)  # [L, 1, npg*page, K, Hd]
+            logits, tks, tvs = llama.prefill_tail(
+                cfg, params, tail_toks, aux[0:1], aux[3:4], pk, pv,
+                ep=self.plan.ep,
+            )
+            rows = count_row  # [1, V] i32 — host-side bincount of the prompt
+            brows = bias_rows if has_bias else jnp.zeros((1, V), jnp.float32)
+            if tok_v < V:
+                from localai_tpu.ops.sampling import NEG_INF
+
+                brows = jnp.where(jnp.arange(V)[None, :] >= tok_v, NEG_INF, brows)
+            keys0 = jax.vmap(jax.random.key)(aux[2:3].astype(jnp.uint32))
+            draws = jax.vmap(lambda kk: jax.random.fold_in(kk, 0))(keys0)
+            srows = brows + gmask0 if with_dfa else brows
+            toks = sample(logits, draws, samp, rows, srows)  # [1]
+            rows = rows.at[jnp.arange(1), toks].add(1)
+            tk = jax.lax.top_k(logits + brows, K)[1] if with_topk else None
+            lp = None
+            if with_lp:
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32) + brows, axis=-1)
+                lp_vals, lp_ids = jax.lax.top_k(logp, LK)
+                tok_lp = jnp.take_along_axis(logp, toks[:, None], axis=-1)[:, 0]
+                lp = (tok_lp, lp_ids, lp_vals)
+            # Only the tail rows are written — the span's pages stay
+            # untouched (they may back other slots and the entry itself).
+            cache = llama.write_rows_to_pool(cache, table_row, tks, tvs, plen)
+            counts = counts.at[slot].set(rows[0])
+            rngs = rngs.at[slot].set(keys0[0])
+            bias = bias.at[slot].set(brows[0])
+            d_tokens = d_tokens.at[slot].set(toks[0])
+            d_positions = d_positions.at[slot].set(plen + tail_len)
+            out = (cache, counts, rngs, bias, d_tokens, d_positions, toks, tk, lp)
+            if with_dfa:
+                gnext = self._dfa_advance(with_dfa, gtrans, tok_cls, ginit, toks)
+                out = out + (d_gstate.at[slot].set(gnext[0]),)
+            return out
+
+        if with_dfa:
+            def admit_cp_dfa(params, cache, counts, rngs, bias, d_tokens,
+                             d_positions, d_gstate, pages, table_row,
+                             tail_toks, count_row, aux, samp_pack, bias_rows,
+                             gmask0, gtrans, tok_cls, ginit):
+                return admit_cached_paged(params, cache, counts, rngs, bias,
+                                          d_tokens, d_positions, pages,
+                                          table_row, tail_toks, count_row,
+                                          aux, samp_pack, bias_rows,
+                                          gmask0=gmask0, gtrans=gtrans,
+                                          tok_cls=tok_cls, ginit=ginit,
+                                          d_gstate=d_gstate)
+
+            fn = jax.jit(admit_cp_dfa, donate_argnums=(1, 2, 3, 4, 5, 6, 7))
+        else:
+            fn = jax.jit(admit_cached_paged, donate_argnums=(1, 2, 3, 4, 5, 6))
+        self._admit_cache[key] = fn
+        return fn
+
     # ------------------------------------------------------------------ #
     # Prompt/prefix KV cache (host side)
     # ------------------------------------------------------------------ #
 
     @property
     def _prefix_enabled(self) -> bool:
-        # Paged mode: spans live in pool pages owned by slots, so the dense
-        # snapshot/copy-back machinery doesn't apply (copy-on-write page
-        # sharing is the paged-native follow-up). Gemma-2 (softcap/sliding
-        # windows): prefill_tail doesn't implement those yet.
-        return (self.ecfg.prefix_cache_entries > 0 and self.draft_cfg is None
-                and not self._paged
-                and not self.cfg.attn_softcap and not self.cfg.sliding_window)
+        # Draft models stay excluded: a cached admission skips the draft's
+        # prompt prefill, so its KV cache would miss the span and the verify
+        # would be scored against garbage draft proposals.
+        return self.ecfg.prefix_cache_entries > 0 and self.draft_cfg is None
 
     def _prefix_find(self, prompt_ids: list[int]):
         """Longest-common-prefix match against the stored spans. Returns
         (entry, match_len) or None. A partial match is fine — any prefix of a
-        cached span is valid KV for that prefix (causality)."""
+        cached span is valid KV for that prefix (causality). Under the paged
+        cache the match rounds DOWN to a page boundary: shared pages are
+        mapped read-only into the new slot's table, and the tail prefill must
+        only ever write fresh pages."""
         if not self._prefix_enabled or len(prompt_ids) < 2:
             return None
         prompt = np.asarray(prompt_ids, np.int32)
@@ -973,6 +1114,8 @@ class Engine:
                 continue
             eq = entry["key"][:n] == prompt[:n]
             match = n if eq.all() else int(np.argmin(eq))
+            if self._paged:
+                match = (match // self.ecfg.kv_page_size) * self.ecfg.kv_page_size
             if match > best_len:
                 best, best_len = entry, match
         if best is None or best_len < max(self.ecfg.prefix_cache_min, 1):
@@ -1000,14 +1143,35 @@ class Engine:
             self._snap_cache[pb] = fn
         return fn
 
-    def _prefix_save(self, slot_idx: int, key_tokens, valid_len: int) -> None:
-        """Snapshot the slot's KV rows [0:valid_len] under `key_tokens`.
+    def _prefix_save(self, slot_idx: int, key_tokens, valid_len: int,
+                     final: bool = False) -> None:
+        """Store the slot's KV rows [0:valid_len] under `key_tokens`.
 
         Called right after an admission dispatch (prompt KV) and at finish
-        (prompt+generated KV — the next chat turn's prefix). Device-to-device
-        slice; never blocks the loop."""
+        (prompt+generated KV — the next chat turn's prefix). Dense cache:
+        device-to-device snapshot slice. Paged cache: NO copy — the entry
+        takes a refcount on the slot's pages (copy-on-write sharing; later
+        admissions map them read-only and prefill tails into fresh pages).
+        Never blocks the loop.
+
+        `final` marks the finish-time save, when the slot will never write
+        again: the partial last page is safe to share then. Admission-time
+        saves share only pages strictly below the first row the live slot
+        will still write (valid_len rounds down to a page boundary)."""
         if not self._prefix_enabled or valid_len < self.ecfg.prefix_cache_min:
             return
+        if self._paged:
+            page = self.ecfg.kv_page_size
+            if final:
+                n_pages = -(-valid_len // page)  # done writing — share all
+            else:
+                n_pages = valid_len // page  # full pages only
+                valid_len = n_pages * page
+            if valid_len < self.ecfg.prefix_cache_min or n_pages == 0:
+                return
+            page_bytes = self._prefix_span_bytes(page)
+            if n_pages * page_bytes > self.ecfg.prefix_cache_bytes:
+                return
         key = np.asarray(key_tokens, np.int32)[:valid_len]
         # Skip if an existing entry already covers this span; drop entries
         # this span subsumes.
@@ -1017,8 +1181,32 @@ class Engine:
             if e["valid"] >= valid_len and (e["key"][:n] == key[:n]).all():
                 return  # covered by a longer (or equal) stored span
             if e["valid"] <= valid_len and (e["key"][:e["valid"]] == key[:e["valid"]]).all():
+                self._prefix_drop(e)
                 continue  # subsumed by the new span
             kept.append(e)
+        if self._paged:
+            pages = self._slot_pages[slot_idx][: n_pages]
+            if len(pages) < n_pages:
+                self._prefix_entries = kept
+                return  # slot reservation shorter than the span (shouldn't happen)
+            for p in pages:
+                self._page_refs[p] += 1
+            kept.insert(0, {"key": key, "valid": valid_len, "pages": list(pages)})
+            while len(kept) > self.ecfg.prefix_cache_entries:
+                self._prefix_drop(kept.pop())
+            budget = self.ecfg.prefix_cache_bytes // max(
+                self._prefix_span_bytes(self.ecfg.kv_page_size), 1
+            )
+            total = 0
+            for idx, e in enumerate(kept):
+                total += len(e["pages"])
+                if total > budget:
+                    for drop in kept[idx:]:
+                        self._prefix_drop(drop)
+                    del kept[idx:]
+                    break
+            self._prefix_entries = kept
+            return
         pb = self._bucket_for(valid_len)
         nbytes = self._prefix_span_bytes(pb)
         if nbytes > self.ecfg.prefix_cache_bytes:
@@ -1035,23 +1223,70 @@ class Engine:
                 break
         self._prefix_entries = kept
 
+    def _prefix_drop(self, entry: dict) -> None:
+        """Release one prefix entry's resources (paged entries hold page
+        refcounts; dense snapshots just GC)."""
+        if self._paged and "pages" in entry:
+            self._pages_release(entry["pages"])
+            entry["pages"] = []
+
+    def _prefix_evict_for_pages(self, need: int,
+                                protect: Optional[list] = None) -> None:
+        """Free pool pages by evicting LRU prefix entries until `need` pages
+        are available (or only protected entries remain). Live requests
+        always outrank cached spans — a span can be re-prefilled, a queued
+        request cannot be served otherwise. `protect` lists entries this
+        admission round is about to map (evicting them would turn the hits
+        into misses that need MORE pages)."""
+        protect = protect or []
+        idx = len(self._prefix_entries) - 1
+        while len(self._free_pages) < need and idx >= 0:
+            e = self._prefix_entries[idx]
+            if any(e is p for p in protect):
+                idx -= 1
+                continue
+            self._prefix_drop(e)
+            self._prefix_entries.pop(idx)
+            idx -= 1
+
     def _prefix_span_bytes(self, pb: int) -> int:
-        """Device bytes of one stored span (k+v) with a pb-row sequence."""
+        """Device bytes of one stored span (k+v) with a pb-row sequence.
+        Sized by the cache's STORAGE dtype — under fp8 KV the budget must
+        count half-size rows, or spans would be refused/evicted at half the
+        configured capacity."""
         cfg = self.cfg
         return (
             2 * cfg.num_layers * pb * cfg.num_kv_heads * cfg.head_dim_
-            * jnp.dtype(cfg.dtype).itemsize
+            * jnp.dtype(self.ecfg.cache_dtype(cfg.dtype)).itemsize
         )
 
     def _dispatch_admit_cached(self, request: GenRequest, handle: RequestHandle,
                                slot_idx: int, entry: dict, match_len: int,
-                               dfa_tables: Optional[dict] = None) -> None:
-        """Admission via the prompt cache: ship only the tail tokens."""
+                               dfa_tables: Optional[dict] = None) -> bool:
+        """Admission via the prompt cache: ship only the tail tokens.
+        Returns False (caller falls through to a full admission) when the
+        entry was evicted or the paged pool can't cover the fresh pages."""
         t0 = time.monotonic()
         V = self.cfg.vocab_size
         ids = request.prompt_ids
         tail = ids[match_len:]
         tb = self._bucket_for(len(tail))
+        paged_alloc: Optional[np.ndarray] = None
+        if self._paged:
+            # The entry must still be live (pressure eviction may have
+            # released its pages between the find and this dispatch).
+            if not any(e is entry for e in self._prefix_entries):
+                return False
+            page = self.ecfg.kv_page_size
+            shared = entry["pages"][: match_len // page]
+            total_rows = max(
+                match_len + tb,
+                min(len(ids) + request.max_new_tokens, self.ecfg.max_seq),
+            )
+            fresh = -(-total_rows // page) - len(shared)
+            paged_alloc = self._pages_alloc(slot_idx, fresh, shared=shared)
+            if paged_alloc is None:
+                return False  # pool pressure — full admission will backpressure
         tail_toks = np.zeros((1, tb), np.int32)
         tail_toks[0, : len(tail)] = tail
         counts = np.bincount(
@@ -1077,31 +1312,49 @@ class Engine:
         with_dfa = self._dfa_mode_of(dfa_tables)
         with_topk = request.grammar is not None and not with_dfa
         with_lp = request.logprobs > 0
-        fn = self._get_admit_cached(entry["pb"], tb, has_bias, with_topk,
-                                    with_lp, with_dfa)
-        args = (
-            entry["k"], entry["v"],
-            jnp.asarray(tail_toks), jnp.asarray(counts), jnp.asarray(aux),
-            jnp.asarray(samp_pack), jnp.asarray(bias_rows),
-        )
-        if with_dfa:
-            host = dfa_tables["host"]
-            row = np.unpackbits(
-                host.mask_bits[host.init_state], bitorder="little"
-            )[:V].astype(bool)
-            gmask0 = np.where(row, 0.0, -1e30).astype(np.float32)[None, :]
-            ginit = np.full((1,), host.init_state, np.int32)
-            out = fn(
-                self.params, self.cache, self.counts, self.rngs, self.bias,
-                self.d_tokens, self.d_positions, self.d_gstate, *args,
-                jnp.asarray(gmask0), self._dfa_table(dfa_tables, with_dfa),
-                dfa_tables["tok_cls"], jnp.asarray(ginit),
+        if self._paged:
+            page = self.ecfg.kv_page_size
+            npg = -(-self._bucket_for(max(match_len, 1)) // page)
+            pages_arr = np.full((npg,), self._scratch_page, np.int32)
+            pages_arr[: len(shared)] = shared
+            fn = self._get_admit_cached_paged(npg, tb, has_bias, with_topk,
+                                              with_lp, with_dfa)
+            args = (
+                jnp.asarray(pages_arr), jnp.asarray(self.h_ptable[slot_idx]),
+                jnp.asarray(tail_toks), jnp.asarray(counts), jnp.asarray(aux),
+                jnp.asarray(samp_pack), jnp.asarray(bias_rows),
             )
         else:
-            out = fn(
-                self.params, self.cache, self.counts, self.rngs, self.bias,
-                self.d_tokens, self.d_positions, *args,
+            fn = self._get_admit_cached(entry["pb"], tb, has_bias, with_topk,
+                                        with_lp, with_dfa)
+            args = (
+                entry["k"], entry["v"],
+                jnp.asarray(tail_toks), jnp.asarray(counts), jnp.asarray(aux),
+                jnp.asarray(samp_pack), jnp.asarray(bias_rows),
             )
+        try:
+            if with_dfa:
+                host = dfa_tables["host"]
+                row = np.unpackbits(
+                    host.mask_bits[host.init_state], bitorder="little"
+                )[:V].astype(bool)
+                gmask0 = np.where(row, 0.0, -1e30).astype(np.float32)[None, :]
+                ginit = np.full((1,), host.init_state, np.int32)
+                out = fn(
+                    self.params, self.cache, self.counts, self.rngs, self.bias,
+                    self.d_tokens, self.d_positions, self.d_gstate, *args,
+                    jnp.asarray(gmask0), self._dfa_table(dfa_tables, with_dfa),
+                    dfa_tables["tok_cls"], jnp.asarray(ginit),
+                )
+            else:
+                out = fn(
+                    self.params, self.cache, self.counts, self.rngs, self.bias,
+                    self.d_tokens, self.d_positions, *args,
+                )
+        except Exception:
+            if paged_alloc is not None:
+                self._pages_free(slot_idx)
+            raise
         (
             self.cache, self.counts, self.rngs, self.bias,
             self.d_tokens, self.d_positions, toks, tk, lp,
@@ -1135,6 +1388,7 @@ class Engine:
         # The freshly-assembled prompt span is itself the best prefix for the
         # next request in the conversation.
         self._prefix_save(slot_idx, ids, len(ids))
+        return True
 
     def _get_spec_block(self):
         """Speculative block with stochastic verify: n_draft draft-model
@@ -1160,10 +1414,11 @@ class Engine:
         cfg, dcfg = self.cfg, self.draft_cfg
         B, S, V = self.ecfg.max_slots, self.ecfg.max_seq, self.cfg.vocab_size
         k = self.n_draft
+        paged = self._paged
         from localai_tpu.ops.sampling import processed_logprobs, update_counts
 
         def spec(params, dparams, cache, dcache, counts, rngs, bias,
-                 tokens, positions, pack):
+                 tokens, positions, pack, ptable=None):
             active = pack[0] > 0
             samp = SamplingParams(
                 temperature=pack[1], top_k=pack[2].astype(jnp.int32),
@@ -1195,10 +1450,22 @@ class Engine:
                 ep=self.plan.ep,
             )
 
-            # 2. Target scores the whole window in one chunked decode.
+            # 2. Target scores the whole window in one chunked decode
+            # (paged mode walks the page pool and writes through the table).
             chunk = jnp.concatenate([tokens[:, None], drafts.T], axis=1)  # [B, k+1]
-            pos_chunk = jnp.minimum(positions[:, None] + jnp.arange(k + 1)[None, :], S - 1)
-            logits_all, cache = llama.decode_chunk(cfg, params, chunk, pos_chunk, cache, ep=self.plan.ep)
+            if paged:
+                # Idle slots' positions keep ratcheting; unpinned they would
+                # drive the paged fori_loop bound to the full table. Their
+                # writes resolve through SCRATCH tables, their outputs are
+                # discarded — pin to 0 for this chunk only.
+                pos_base = jnp.where(active, positions, 0)
+            else:
+                pos_base = positions
+            pos_chunk = jnp.minimum(pos_base[:, None] + jnp.arange(k + 1)[None, :], S - 1)
+            logits_all, cache = llama.decode_chunk(
+                cfg, params, chunk, pos_chunk, cache, ep=self.plan.ep,
+                ptable=ptable,
+            )
 
             # 3. Accept-scan with counts updated token by token, so
             # repeat/presence/frequency semantics match the plain blocks.
@@ -1253,7 +1520,15 @@ class Engine:
             new_positions = jnp.minimum(positions + acc, S - 1)
             return cache, dcache, counts, rngs, new_tokens, new_positions, toks_out, acc
 
-        fn = jax.jit(spec, donate_argnums=(2, 3, 4, 5, 7, 8))
+        if paged:
+            def spec_paged(params, dparams, cache, dcache, counts, rngs, bias,
+                           tokens, positions, pack, ptable):
+                return spec(params, dparams, cache, dcache, counts, rngs,
+                            bias, tokens, positions, pack, ptable=ptable)
+
+            fn = jax.jit(spec_paged, donate_argnums=(2, 3, 4, 5, 7, 8))
+        else:
+            fn = jax.jit(spec, donate_argnums=(2, 3, 4, 5, 7, 8))
         self._block_cache[("spec",)] = fn
         return fn
 
@@ -1789,6 +2064,7 @@ class Engine:
             group: list[tuple[GenRequest, RequestHandle]] = []
             bucket = 0
             pages_planned = 0
+            prefix_hits: dict[int, tuple] = {}  # id(request) -> (entry, len)
             with self._pending_lock:
                 while self._pending and len(group) < len(free):
                     request, handle = self._pending[0]
@@ -1797,7 +2073,23 @@ class Engine:
                         handle._q.put(TokenEvent(kind="done", finish_reason="stop"))
                         continue
                     if self._paged:
-                        need = self._pages_needed(request)
+                        # A prefix hit shares the span's pages — gate on the
+                        # reduced (tail-only) need.
+                        hit = self._prefix_find(request.prompt_ids)
+                        if hit is not None:
+                            prefix_hits[id(request)] = hit
+                            need = self._pages_needed_cached(request, hit[1])
+                        else:
+                            need = self._pages_needed(request)
+                        if pages_planned + need > len(self._free_pages):
+                            # Cached spans can be re-prefilled; a queued
+                            # request can't be served any other way — evict
+                            # LRU prefix entries (sparing ones this round's
+                            # admissions will map) before backpressuring.
+                            keep = [h[0] for h in prefix_hits.values()]
+                            self._prefix_evict_for_pages(
+                                pages_planned + need, protect=keep
+                            )
                         if pages_planned + need > len(self._free_pages):
                             break  # pool backpressure — wait for a finish
                         pages_planned += need
@@ -1813,7 +2105,6 @@ class Engine:
             # different program variants (has_bias / with_topk / with_lp);
             # admit them as singletons so only the (m=1, ...) variants ever
             # compile — those are warmed.
-            prefix_hits: dict[int, tuple] = {}  # id(request) -> (entry, len)
 
             def _special(r: GenRequest) -> bool:
                 if (bool(r.logit_bias) or r.grammar is not None
@@ -1876,11 +2167,22 @@ class Engine:
                 chunk[0][0].prompt_ids
             )
             if hit is not None:
-                self._dispatch_admit_cached(
+                if self._dispatch_admit_cached(
                     chunk[0][0], chunk[0][1], slot_ids[0], *hit,
                     dfa_tables=dfa_tables,
-                )
-                return
+                ):
+                    return
+                if self._paged:
+                    # Stale hit under pool churn (the span was evicted or its
+                    # fresh pages can't be covered): requeue so the next
+                    # planning round re-budgets and re-scans — only the
+                    # planning loop enforces pool backpressure, so an
+                    # unbudgeted full admission here could hard-fail a
+                    # request that merely needed to wait.
+                    with self._pending_lock:
+                        self._pending.appendleft(chunk[0])
+                    self._wake.set()
+                    return
         t0 = time.monotonic()
         prompt_toks = np.zeros((m, bucket), np.int32)
         aux = np.zeros((3, m), np.int32)  # lens, slot ids, seeds
@@ -2127,14 +2429,17 @@ class Engine:
         for fi, k in enumerate(_SAMPLING_FIELDS):
             pack[1 + fi] = self.h_sampling[k]
         fn = self._get_spec_block()
-        (
-            self.cache, self.d_cache, self.counts, self.rngs, self.d_tokens,
-            self.d_positions, toks_out, acc,
-        ) = fn(
+        args = (
             self.params, self.draft_params, self.cache, self.d_cache,
             self.counts, self.rngs, self.bias, self.d_tokens, self.d_positions,
             jnp.asarray(pack),
         )
+        if self._paged:
+            args = args + (jnp.asarray(self.h_ptable),)
+        (
+            self.cache, self.d_cache, self.counts, self.rngs, self.d_tokens,
+            self.d_positions, toks_out, acc,
+        ) = fn(*args)
         _host_copy_async(toks_out)
         _host_copy_async(acc)
         for i in range(B):
@@ -2428,7 +2733,8 @@ class Engine:
             # as the next step's input).
             valid = slot.prompt_len + max(0, len(slot.generated) - 1)
             self._prefix_save(
-                slot_idx, list(slot.request.prompt_ids) + slot.generated, valid
+                slot_idx, list(slot.request.prompt_ids) + slot.generated, valid,
+                final=True,  # slot will never write again — partial page shareable
             )
         now = time.monotonic()
         t_first = slot.t_first or now

@@ -458,8 +458,14 @@ def arch_from_gguf(gf: GGUFFile):
     rope_scaling = None
     scaling_factor = float(k("rope.scaling.factor", 0) or 0)
     orig_ctx = int(k("rope.scaling.original_context_length", 0) or 0)
-    if str(k("rope.scaling.type", "")) == "linear":
+    scaling_type = str(k("rope.scaling.type", ""))
+    if scaling_type == "linear":
         rope_scaling = "linear"
+        scaling_factor = scaling_factor or 1.0
+    elif scaling_type == "yarn":
+        # llama.cpp yarn GGUFs: factor + original context (beta_fast/slow
+        # keys are llama.cpp runtime params, not stored — HF defaults apply).
+        rope_scaling = "yarn"
         scaling_factor = scaling_factor or 1.0
     elif orig_ctx or "rope_freqs.weight" in gf.tensors:
         # llama-3.1-style scaling: llama.cpp records the original context

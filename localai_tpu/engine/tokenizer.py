@@ -60,6 +60,33 @@ class ByteTokenizer:
         return out
 
 
+class SyntheticByteTokenizer(ByteTokenizer):
+    """ByteTokenizer whose ids above the specials decode to printable ASCII
+    (`chr(id % 95 + 32)`) instead of nothing.
+
+    Purpose: synthetic-weight benchmarks on real vocab sizes (e.g. 128k).
+    A plain ByteTokenizer decodes ids ≥ 256 as empty strings, so a random
+    model's stream carries zero content deltas and client-observed TTFT /
+    chunk cadence are unmeasurable (BENCH_r03's `p50_first_content_ms_http:
+    null`). Every non-special id maps to ONE printable ASCII char (never a
+    partial UTF-8 sequence), so the streamer holds nothing back and content
+    chunks match generated tokens 1:1. Select with `tokenizer:
+    synthetic-bytes` in a model YAML."""
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return "".join(
+            chr((i % 95) + 32) for i in ids
+            if i >= 0 and i not in (self.bos_id, self.eos_ids[0], self.PAD)
+        )
+
+    def token_strings(self) -> list[str]:
+        specials = {self.bos_id, self.eos_ids[0], self.PAD}
+        return [
+            "" if i in specials else chr((i % 95) + 32)
+            for i in range(self.vocab_size)
+        ]
+
+
 class HFTokenizer:
     """Local HuggingFace tokenizer (no network access; path must exist)."""
 
@@ -157,7 +184,11 @@ def _gpt2_byte_decoder() -> dict[str, int]:
 
 
 def load_tokenizer(path: str | None, vocab_size: int = 512) -> Tokenizer:
-    """Factory: HF tokenizer when a local path is given, byte-level otherwise."""
+    """Factory: HF tokenizer when a local path is given, byte-level otherwise.
+    The sentinel path "synthetic-bytes" selects the benchmark tokenizer whose
+    whole vocab decodes to visible text (see SyntheticByteTokenizer)."""
+    if path == "synthetic-bytes":
+        return SyntheticByteTokenizer(vocab_size=vocab_size)
     if path:
         return HFTokenizer(path)
     return ByteTokenizer(vocab_size=vocab_size)

@@ -240,6 +240,11 @@ def load_hf_checkpoint(
         layer_map["mlp_norm"] = ("pre_feedforward_layernorm.weight", False)
         layer_map["post_attn_norm"] = ("post_attention_layernorm.weight", False)
         layer_map["post_ffw_norm"] = ("post_feedforward_layernorm.weight", False)
+    if cfg.qk_norm:
+        # Gemma-3 per-head q/k norms ((1+w) fold applies — they end in
+        # "norm.weight").
+        layer_map["q_norm"] = ("self_attn.q_norm.weight", False)
+        layer_map["k_norm"] = ("self_attn.k_norm.weight", False)
     for our, (suffix, transpose) in layer_map.items():
         probe = f"model.layers.0.{suffix}"
         if not has_tensor(probe):
@@ -511,6 +516,9 @@ def save_hf_checkpoint(cfg: ArchConfig, params: Params, ckpt_dir: str) -> None:
         layer_map["mlp_norm"] = ("pre_feedforward_layernorm.weight", False)
         layer_map["post_attn_norm"] = ("post_attention_layernorm.weight", False)
         layer_map["post_ffw_norm"] = ("post_feedforward_layernorm.weight", False)
+    if cfg.qk_norm:
+        layer_map["q_norm"] = ("self_attn.q_norm.weight", False)
+        layer_map["k_norm"] = ("self_attn.k_norm.weight", False)
     for our, (suffix, transpose) in layer_map.items():
         if our not in layers:
             continue
@@ -581,35 +589,51 @@ def save_hf_checkpoint(cfg: ArchConfig, params: Params, ckpt_dir: str) -> None:
 
 def arch_from_hf_config(ckpt_dir: str) -> ArchConfig:
     """Build an ArchConfig from an HF config.json
-    (llama/mistral/qwen2/mixtral/gemma/phi3)."""
+    (llama/mistral/qwen2/mixtral/gemma/gemma-2/gemma-3/phi3), including every
+    rope-scaling family the reference forwards to its engines
+    (model_config.go:231-237): linear, llama3, yarn, longrope."""
     with open(os.path.join(ckpt_dir, "config.json")) as f:
         hf = json.load(f)
+    if isinstance(hf.get("text_config"), dict):
+        # Multimodal wrappers (gemma-3 vision+text) nest the decoder config.
+        hf = {**hf, **hf["text_config"]}
     rope_scaling = hf.get("rope_scaling") or {}
     scaling_type = rope_scaling.get("rope_type") or rope_scaling.get("type")
-    max_position = hf.get("max_position_embeddings", 8192)
-    if scaling_type in ("longrope", "su", "yarn"):
-        # Per-frequency long-context interpolation isn't implemented; serve
-        # the unscaled rope AND clamp the advertised context to the original
-        # window — otherwise the server would accept prompts the unscaled
-        # rope cannot place.
-        orig = int(rope_scaling.get("original_max_position_embeddings",
-                                    max_position))
-        log.warning("rope_scaling type %r not supported — serving unscaled "
-                    "rope with context clamped to %d", scaling_type, orig)
-        max_position = orig
+    if scaling_type == "su":
+        scaling_type = "longrope"  # phi-3's original name for the same math
+    if scaling_type == "default":
         scaling_type = None
-        rope_scaling = {}
+    max_position = hf.get("max_position_embeddings", 8192)
+    if scaling_type not in (None, "linear", "llama3", "yarn", "longrope"):
+        raise ValueError(f"rope_scaling type {scaling_type!r} is not supported")
+    orig_pos = int(
+        rope_scaling.get("original_max_position_embeddings")
+        or hf.get("original_max_position_embeddings")  # phi-3 keeps it top-level
+        or max_position
+    )
+    long_factor = rope_scaling.get("long_factor")
+    short_factor = rope_scaling.get("short_factor")
+    attn_factor = rope_scaling.get("attention_factor")
+    if attn_factor is None:
+        attn_factor = rope_scaling.get("mscale")
     model_type = hf.get("model_type", "llama")
-    if model_type in ("gemma3", "gemma3_text"):
-        # Gemma-3 adds q/k norms and a different sliding pattern — loading
-        # it with gemma-2 semantics would produce fluent-looking garbage.
-        raise ValueError(
-            f"model_type {model_type!r} is not supported yet (gemma-1/2, "
-            "llama, mistral, qwen2, mixtral, phi3 are)"
-        )
-    gemma = model_type in ("gemma", "gemma2")
+    gemma3 = model_type in ("gemma3", "gemma3_text")
+    gemma = model_type in ("gemma", "gemma2") or gemma3
     gemma2 = model_type == "gemma2"
+    # Gemma-3 sliding layout: 5 local : 1 global. Newer HF configs publish a
+    # layer_types list; older ones a sliding_window_pattern int.
+    sliding_pattern = 2
+    if gemma3:
+        lt = hf.get("layer_types")
+        if isinstance(lt, list) and "full_attention" in lt:
+            sliding_pattern = lt.index("full_attention") + 1
+        else:
+            sliding_pattern = int(
+                hf.get("sliding_window_pattern")
+                or hf.get("_sliding_window_pattern") or 6
+            )
     act = hf.get("hidden_activation") or hf.get("hidden_act") or "silu"
+    softcaps = gemma2 or gemma3  # gemma-3 configs carry the keys but None
     return ArchConfig(
         name=hf.get("_name_or_path", model_type) or model_type,
         vocab_size=hf["vocab_size"],
@@ -620,13 +644,17 @@ def arch_from_hf_config(ckpt_dir: str) -> ArchConfig:
         num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
         head_dim=hf.get("head_dim"),
         rope_theta=hf.get("rope_theta", 10000.0),
-        rope_scaling=("llama3" if scaling_type == "llama3" else ("linear" if scaling_type else None)),
+        rope_scaling=scaling_type,
         rope_scaling_factor=rope_scaling.get("factor", 1.0),
         rope_low_freq_factor=rope_scaling.get("low_freq_factor", 1.0),
         rope_high_freq_factor=rope_scaling.get("high_freq_factor", 4.0),
-        rope_original_max_position=rope_scaling.get(
-            "original_max_position_embeddings", hf.get("max_position_embeddings", 8192)
-        ),
+        rope_original_max_position=orig_pos,
+        rope_beta_fast=float(rope_scaling.get("beta_fast", 32.0)),
+        rope_beta_slow=float(rope_scaling.get("beta_slow", 1.0)),
+        rope_long_factor=tuple(long_factor) if long_factor else None,
+        rope_short_factor=tuple(short_factor) if short_factor else None,
+        rope_attn_factor=float(attn_factor) if attn_factor is not None else None,
+        rope_local_theta=float(hf.get("rope_local_base_freq") or 0.0) if gemma3 else 0.0,
         max_position=max_position,
         rms_eps=hf.get("rms_norm_eps", 1e-5),
         # Gemma ties embeddings but its configs often omit the flag.
@@ -635,11 +663,13 @@ def arch_from_hf_config(ckpt_dir: str) -> ArchConfig:
         activation=("gelu_tanh" if "gelu" in act else "silu"),
         embed_scale=gemma,
         norm_plus_one=gemma,
-        post_norms=gemma2,
-        attn_softcap=float(hf.get("attn_logit_softcapping") or 0.0) if gemma2 else 0.0,
-        final_softcap=float(hf.get("final_logit_softcapping") or 0.0) if gemma2 else 0.0,
-        query_scale=float(hf.get("query_pre_attn_scalar") or 0.0) if gemma2 else 0.0,
-        sliding_window=int(hf.get("sliding_window") or 0) if gemma2 else 0,
+        post_norms=gemma2 or gemma3,
+        qk_norm=gemma3,
+        attn_softcap=float(hf.get("attn_logit_softcapping") or 0.0) if softcaps else 0.0,
+        final_softcap=float(hf.get("final_logit_softcapping") or 0.0) if softcaps else 0.0,
+        query_scale=float(hf.get("query_pre_attn_scalar") or 0.0) if softcaps else 0.0,
+        sliding_window=int(hf.get("sliding_window") or 0) if softcaps else 0,
+        sliding_pattern=sliding_pattern,
         num_experts=hf.get("num_local_experts", 0),
         num_experts_per_token=hf.get("num_experts_per_tok", 2),
     )

@@ -31,12 +31,26 @@ class ArchConfig:
     num_kv_heads: int = 32
     head_dim: Optional[int] = None  # defaults to hidden_size // num_heads
     rope_theta: float = 10000.0
-    rope_scaling: Optional[str] = None  # None | "linear" | "llama3"
+    # None | "linear" | "llama3" | "yarn" | "longrope" — the reference
+    # forwards the same knob set to llama.cpp (model_config.go:231-237).
+    rope_scaling: Optional[str] = None
     rope_scaling_factor: float = 1.0
     # llama3-style rope scaling extras
     rope_low_freq_factor: float = 1.0
     rope_high_freq_factor: float = 4.0
     rope_original_max_position: int = 8192
+    # yarn extras (NTK-by-parts ramp bounds, HF defaults)
+    rope_beta_fast: float = 32.0
+    rope_beta_slow: float = 1.0
+    # longrope (phi-3 "su") per-frequency rescale tables [head_dim/2]
+    rope_long_factor: Optional[tuple] = None
+    rope_short_factor: Optional[tuple] = None
+    # Explicit attention-amplitude factor (yarn mscale / longrope scaling);
+    # None = derive from the scaling type's published formula.
+    rope_attn_factor: Optional[float] = None
+    # Gemma-3: local (sliding) layers run their own unscaled rope base while
+    # global layers use rope_theta (+ scaling). 0 = single schedule.
+    rope_local_theta: float = 0.0
     max_position: int = 8192
     rms_eps: float = 1e-5
     tie_embeddings: bool = False
@@ -57,6 +71,11 @@ class ArchConfig:
     final_softcap: float = 0.0
     query_scale: float = 0.0  # 0 = default head_dim^-0.5
     sliding_window: int = 0  # 0 = full attention on every layer
+    # Which layers slide: layer li is sliding iff li % pattern != pattern-1.
+    # Gemma-2 alternates (2); gemma-3 runs 5 local : 1 global (6).
+    sliding_pattern: int = 2
+    # Gemma-3: per-head RMS norms on q and k (after projection, before rope).
+    qk_norm: bool = False
     # Mixture-of-experts (Mixtral/DeepSeek-style); 0 experts = dense MLP
     num_experts: int = 0
     num_experts_per_token: int = 2

@@ -34,7 +34,12 @@ from localai_tpu.ops.attention import (
     prefill_attention,
 )
 from localai_tpu.ops.norm import rms_norm
-from localai_tpu.ops.rope import apply_rope, rope_frequencies
+from localai_tpu.ops.rope import (
+    apply_rope,
+    rope_frequencies,
+    rope_frequencies_local,
+    rope_query_amp,
+)
 
 Params = dict[str, Any]
 
@@ -81,6 +86,9 @@ def init_params(cfg: ArchConfig, key: jnp.ndarray, scale: float = 0.02) -> Param
     if cfg.post_norms:
         layers["post_attn_norm"] = jnp.ones((L, D), dt)
         layers["post_ffw_norm"] = jnp.ones((L, D), dt)
+    if cfg.qk_norm:
+        layers["q_norm"] = jnp.ones((L, Hd), dt)
+        layers["k_norm"] = jnp.ones((L, Hd), dt)
     if cfg.attn_qkv_bias:
         layers["bq"] = jnp.zeros((L, H * Hd), dt)
         layers["bk"] = jnp.zeros((L, K * Hd), dt)
@@ -283,12 +291,23 @@ def _mlp_out(cfg: ArchConfig, lp: Params, x: jnp.ndarray, ep: int = 1) -> jnp.nd
 
 
 def _layer_sliding(cfg: ArchConfig, li: jnp.ndarray):
-    """Gemma-2 alternates: even layers use the sliding window, odd layers
-    attend globally. Returns a traced bool scalar (or None when the arch has
-    no sliding windows)."""
+    """Which layers slide: li % pattern != pattern-1. Gemma-2 alternates
+    (pattern 2: even layers slide, odd attend globally); gemma-3 runs
+    5 local : 1 global (pattern 6). Returns a traced bool scalar (or None
+    when the arch has no sliding windows)."""
     if not cfg.sliding_window:
         return None
-    return (li % 2) == 0
+    p = cfg.sliding_pattern
+    return (li % p) != (p - 1)
+
+
+def _layer_inv_freq(cfg: ArchConfig, inv_global, inv_local, li):
+    """Per-layer rope schedule: gemma-3's sliding layers run their own
+    unscaled local base while global layers use rope_theta (+ scaling)."""
+    if inv_local is None:
+        return inv_global
+    sliding = _layer_sliding(cfg, li)
+    return jnp.where(sliding, inv_local, inv_global)
 
 
 def _attn_proj_qkv(cfg: ArchConfig, lp: Params, x: jnp.ndarray):
@@ -304,11 +323,20 @@ def _attn_proj_qkv(cfg: ArchConfig, lp: Params, x: jnp.ndarray):
     q = q.reshape(*x.shape[:-1], H, Hd)
     k = k.reshape(*x.shape[:-1], K, Hd)
     v = v.reshape(*x.shape[:-1], K, Hd)
+    if cfg.qk_norm:
+        # Gemma-3: per-head RMS norms on q/k before rope.
+        q = rms_norm(q, lp["q_norm"], cfg.rms_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_eps)
     if cfg.query_scale:
         # Gemma-2 scales attention by query_pre_attn_scalar^-0.5; the
         # attention kernels divide by sqrt(head_dim), so pre-multiply q by
         # the ratio (commutes with RoPE — a rotation).
         q = q * float((cfg.head_dim_ / cfg.query_scale) ** 0.5)
+    amp = rope_query_amp(cfg)
+    if amp != 1.0:
+        # yarn/longrope attention-amplitude correction (m on both cos/sin
+        # tables ≡ m² on q alone; K stays unmodified in the cache).
+        q = q * float(amp)
     return q, k, v
 
 
@@ -363,6 +391,7 @@ def _forward_hidden(
     if use_ring and S % mesh.shape["sp"] != 0:
         raise ValueError(f"sequence bucket {S} not divisible by sp={mesh.shape['sp']}")
     inv_freq = rope_frequencies(cfg)
+    inv_local = rope_frequencies_local(cfg)
     positions = jnp.arange(S)[None, :].repeat(B, axis=0)  # [B, S]
     length_mask = jnp.arange(S)[None, :] < lengths[:, None]
 
@@ -377,22 +406,21 @@ def _forward_hidden(
             )
         )(h, embeds, offsets)
 
-    if (cfg.attn_softcap or cfg.sliding_window) and use_ring:
-        raise ValueError(
-            "attention softcapping / sliding windows (gemma-2) are not "
-            "supported with ring (sp>1) prefill"
-        )
-
     def layer(h, xs):
         lp, li = xs  # li: layer index (sliding windows alternate by layer)
         x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
         q, k, v = _attn_proj_qkv(cfg, lp, x)
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
+        inv = _layer_inv_freq(cfg, inv_freq, inv_local, li)
+        q = apply_rope(q, positions, inv)
+        k = apply_rope(k, positions, inv)
         if use_ring:
             from localai_tpu.parallel.ring import ring_prefill_attention
 
-            attn = ring_prefill_attention(q, k, v, lengths, mesh)
+            attn = ring_prefill_attention(
+                q, k, v, lengths, mesh,
+                softcap=cfg.attn_softcap, window=cfg.sliding_window,
+                sliding=_layer_sliding(cfg, li),
+            )
         else:
             attn = prefill_attention(
                 q, k, v, length_mask, lengths,
@@ -501,27 +529,40 @@ def decode_step(
     B = tokens.shape[0]
     use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
     inv_freq = rope_frequencies(cfg)
+    inv_local = rope_frequencies_local(cfg)
     h = _embed(cfg, params, tokens)  # [B, D]
     batch_idx = jnp.arange(B)
 
     def layer(h, xs):
-        lp, kc, vc = xs
+        lp, li, kc, vc = xs
         x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
         q, k, v = _attn_proj_qkv(cfg, lp, x)  # q [B,H,Hd], k/v [B,K,Hd]
-        q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
-        k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
+        inv = _layer_inv_freq(cfg, inv_freq, inv_local, li)
+        q = apply_rope(q[:, None], positions[:, None], inv)[:, 0]
+        k = apply_rope(k[:, None], positions[:, None], inv)[:, 0]
         if use_sp:
             from localai_tpu.ops.attention import decode_attention_appended_sp
 
-            attn = decode_attention_appended_sp(q, kc, vc, k, v, positions, mesh)
+            attn = decode_attention_appended_sp(
+                q, kc, vc, k, v, positions, mesh,
+                softcap=cfg.attn_softcap, window=cfg.sliding_window,
+                sliding=_layer_sliding(cfg, li),
+            )
         else:
-            attn = decode_attention_appended(q, kc, vc, k, v, positions)
+            attn = decode_attention_appended(
+                q, kc, vc, k, v, positions,
+                softcap=cfg.attn_softcap, window=cfg.sliding_window,
+                sliding=_layer_sliding(cfg, li),
+            )
         h = h + _attn_out(cfg, lp, attn.reshape(B, -1))
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
         h = h + _mlp_out(cfg, lp, x, ep)
         return h, (k, v)
 
-    h, (new_k, new_v) = jax.lax.scan(layer, h, (params["layers"], cache.k, cache.v))
+    h, (new_k, new_v) = jax.lax.scan(
+        layer, h,
+        (params["layers"], jnp.arange(cfg.num_layers), cache.k, cache.v),
+    )
     # One scatter: cache[l, b, positions[b]] = new row, all layers at once.
     k = cache.k.at[:, batch_idx, positions].set(new_k.astype(cache.k.dtype))
     v = cache.v.at[:, batch_idx, positions].set(new_v.astype(cache.v.dtype))
@@ -553,31 +594,32 @@ def decode_step_windowed(
     """
     B = tokens.shape[0]
     use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
-    if (cfg.attn_softcap or cfg.sliding_window) and (use_sp or ptable is not None):
-        raise ValueError(
-            "attention softcapping / sliding windows (gemma-2) are not "
-            "supported with sp-sharded or paged KV caches"
-        )
     inv_freq = rope_frequencies(cfg)
+    inv_local = rope_frequencies_local(cfg)
     h = _embed(cfg, params, tokens)
 
     def layer(h, xs):
         lp, li, kc, vc, lk, lv = xs
         x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
         q, k, v = _attn_proj_qkv(cfg, lp, x)
-        q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
-        k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
+        inv = _layer_inv_freq(cfg, inv_freq, inv_local, li)
+        q = apply_rope(q[:, None], positions[:, None], inv)[:, 0]
+        k = apply_rope(k[:, None], positions[:, None], inv)[:, 0]
         if ptable is not None:
             from localai_tpu.ops.attention import decode_attention_windowed_paged
 
             attn = decode_attention_windowed_paged(
-                q, kc, vc, ptable, lk, lv, k, v, positions, step
+                q, kc, vc, ptable, lk, lv, k, v, positions, step,
+                softcap=cfg.attn_softcap, window=cfg.sliding_window,
+                sliding=_layer_sliding(cfg, li),
             )
         elif use_sp:
             from localai_tpu.ops.attention import decode_attention_windowed_sp
 
             attn = decode_attention_windowed_sp(
-                q, kc, vc, lk, lv, k, v, positions, step, mesh
+                q, kc, vc, lk, lv, k, v, positions, step, mesh,
+                softcap=cfg.attn_softcap, window=cfg.sliding_window,
+                sliding=_layer_sliding(cfg, li),
             )
         else:
             attn = decode_attention_windowed(
@@ -629,6 +671,7 @@ def decode_chunk(
     positions: jnp.ndarray,  # [B, T] int32 — their positions (contiguous per slot)
     cache: KVCache,
     ep: int = 1,
+    ptable=None,  # [B, MP] int32 → `cache` is a page pool (paged KV mode)
 ):
     """Multi-token decode: write T new k/v per slot and return logits for all
     T positions — the verify pass of speculative decoding (the reference
@@ -636,48 +679,90 @@ def decode_chunk(
     draft_model). Positions must be contiguous per slot. Token t attends to
     the cache prefix (< positions[b, 0]) plus in-window tokens causally; the
     window k/v stay separate operands so — as in decode_step — the layer
-    scan never re-emits the cache, and one scatter writes all L×T rows."""
+    scan never re-emits the cache, and one scatter writes all L×T rows.
+    With `ptable`, the prefix read walks the page pool (online-softmax
+    partials) and the write routes through the table — speculative decoding
+    composes with the paged cache."""
     B, T = tokens.shape
     inv_freq = rope_frequencies(cfg)
     h = _embed(cfg, params, tokens)  # [B, T, D]
     batch_idx = jnp.arange(B)[:, None].repeat(T, axis=1)  # [B, T]
-    S = cache.k.shape[2]
+    inv_local = rope_frequencies_local(cfg)
     scale = cfg.head_dim_**-0.5
     causal = jnp.tril(jnp.ones((T, T), bool))
+    S = None if ptable is not None else cache.k.shape[2]
+    # In-window distance t-u (positions are contiguous per slot), for the
+    # gemma-2 sliding mask.
+    win_dist = jnp.arange(T)[:, None] - jnp.arange(T)[None, :]
 
     def layer(h, xs):
-        lp, kc, vc = xs
+        lp, li, kc, vc = xs
+        sliding = _layer_sliding(cfg, li)
         x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
         q, k, v = _attn_proj_qkv(cfg, lp, x)  # q [B,T,H,Hd], k/v [B,T,K,Hd]
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
+        inv = _layer_inv_freq(cfg, inv_freq, inv_local, li)
+        q = apply_rope(q, positions, inv)
+        k = apply_rope(k, positions, inv)
         K_h = kc.shape[2]
         G = q.shape[2] // K_h
-        qf = (q.astype(jnp.float32) * scale).reshape(B, T, K_h, G, cfg.head_dim_)
-        # Cache prefix: rows before the window start (later rows are stale).
-        prefix = jnp.arange(S)[None, :] < positions[:, :1]  # [B, S]
-        sc = jnp.einsum("btkgd,bskd->bkgts", qf, kc.astype(jnp.float32))
-        sc = jnp.where(prefix[:, None, None, None], sc, -1e30)
-        # In-window causal attention against the fresh k.
-        kw = k.astype(jnp.float32)
-        sw = jnp.einsum("btkgd,bukd->bkgtu", qf, kw)  # [B,K,G,T,T]
-        sw = jnp.where(causal[None, None, None], sw, -1e30)
-        probs = jax.nn.softmax(jnp.concatenate([sc, sw], axis=-1), axis=-1)
-        attn = jnp.einsum(
-            "bkgts,bskd->btkgd", probs[..., :S], vc.astype(jnp.float32)
-        ) + jnp.einsum("bkgtu,bukd->btkgd", probs[..., S:], v.astype(jnp.float32))
-        attn = attn.reshape(B, T, -1).astype(h.dtype)
+        wmask = causal  # [T, T]
+        if cfg.sliding_window and sliding is not None:
+            wmask = wmask & (~sliding | (win_dist < cfg.sliding_window))
+        if ptable is not None:
+            from localai_tpu.ops.attention import (
+                _merge_partials_mq,
+                _paged_cache_partials_mq,
+            )
+
+            acc, m, l = _paged_cache_partials_mq(
+                q, kc, vc, ptable, positions[:, 0],
+                softcap=cfg.attn_softcap, window=cfg.sliding_window,
+                sliding=sliding, q_pos=positions,
+            )
+            attn = _merge_partials_mq(
+                q, acc, m, l, k, v,
+                jnp.broadcast_to(wmask[None], (B, T, T)),
+                softcap=cfg.attn_softcap,
+            ).reshape(B, T, -1).astype(h.dtype)
+        else:
+            qf = (q.astype(jnp.float32) * scale).reshape(B, T, K_h, G, cfg.head_dim_)
+            # Cache prefix: rows before the window start (later rows stale).
+            sc = jnp.einsum("btkgd,bskd->bkgts", qf, kc.astype(jnp.float32))
+            if cfg.attn_softcap:
+                sc = cfg.attn_softcap * jnp.tanh(sc / cfg.attn_softcap)
+            prefix = jnp.arange(S)[None, None, :] < positions[:, :1, None]  # [B,1,S]
+            if cfg.sliding_window and sliding is not None:
+                dist = positions[:, :, None] - jnp.arange(S)[None, None, :]
+                prefix = prefix & (~sliding | (dist < cfg.sliding_window))
+            sc = jnp.where(prefix[:, None, None], sc, -1e30)
+            # In-window causal attention against the fresh k.
+            sw = jnp.einsum("btkgd,bukd->bkgtu", qf, k.astype(jnp.float32))
+            if cfg.attn_softcap:
+                sw = cfg.attn_softcap * jnp.tanh(sw / cfg.attn_softcap)
+            sw = jnp.where(wmask[None, None, None], sw, -1e30)
+            probs = jax.nn.softmax(jnp.concatenate([sc, sw], axis=-1), axis=-1)
+            attn = jnp.einsum(
+                "bkgts,bskd->btkgd", probs[..., :S], vc.astype(jnp.float32)
+            ) + jnp.einsum("bkgtu,bukd->btkgd", probs[..., S:], v.astype(jnp.float32))
+            attn = attn.reshape(B, T, -1).astype(h.dtype)
         h = h + _attn_out(cfg, lp, attn)
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
         h = h + _mlp_out(cfg, lp, x, ep)
         return h, (k, v)
 
-    h, (new_k, new_v) = jax.lax.scan(layer, h, (params["layers"], cache.k, cache.v))
-    k = cache.k.at[:, batch_idx, positions].set(new_k.astype(cache.k.dtype))
-    v = cache.v.at[:, batch_idx, positions].set(new_v.astype(cache.v.dtype))
+    h, (new_k, new_v) = jax.lax.scan(
+        layer, h,
+        (params["layers"], jnp.arange(cfg.num_layers), cache.k, cache.v),
+    )
+    if ptable is not None:
+        cache = write_chunk_to_pool(cache, ptable, new_k, new_v, positions)
+    else:
+        k = cache.k.at[:, batch_idx, positions].set(new_k.astype(cache.k.dtype))
+        v = cache.v.at[:, batch_idx, positions].set(new_v.astype(cache.v.dtype))
+        cache = KVCache(k=k, v=v)
     h = rms_norm(h, params["final_norm"], cfg.rms_eps)
     logits = _unembed(cfg, params, h)  # [B, T, V]
-    return logits, KVCache(k=k, v=v)
+    return logits, cache
 
 
 def prefill_tail(
@@ -702,26 +787,41 @@ def prefill_tail(
     B, T = tokens.shape
     P = prefix_k.shape[2]
     inv_freq = rope_frequencies(cfg)
+    inv_local = rope_frequencies_local(cfg)
     positions = offsets[:, None] + jnp.arange(T)[None, :]  # [B, T] global
     length_mask = jnp.arange(T)[None, :] < lengths[:, None]
     h = _embed(cfg, params, tokens)  # [B, T, D]
     scale = cfg.head_dim_**-0.5
     causal = jnp.tril(jnp.ones((T, T), bool))
     pvalid = jnp.arange(P)[None, :] < offsets[:, None]  # [B, P]
+    win_dist = jnp.arange(T)[:, None] - jnp.arange(T)[None, :]  # in-tail t-u
 
     def layer(h, xs):
-        lp, kc, vc = xs  # kc/vc [B, P, K, Hd]
+        lp, li, kc, vc = xs  # kc/vc [B, P, K, Hd]
+        sliding = _layer_sliding(cfg, li)
         x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
         q, k, v = _attn_proj_qkv(cfg, lp, x)  # q [B,T,H,Hd], k/v [B,T,K,Hd]
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
+        inv = _layer_inv_freq(cfg, inv_freq, inv_local, li)
+        q = apply_rope(q, positions, inv)
+        k = apply_rope(k, positions, inv)
         K_h = kc.shape[2]
         G = q.shape[2] // K_h
         qf = (q.astype(jnp.float32) * scale).reshape(B, T, K_h, G, cfg.head_dim_)
         sc = jnp.einsum("btkgd,bskd->bkgts", qf, kc.astype(jnp.float32))
-        sc = jnp.where(pvalid[:, None, None, None], sc, -1e30)
+        if cfg.attn_softcap:
+            sc = cfg.attn_softcap * jnp.tanh(sc / cfg.attn_softcap)
+        pmask = pvalid[:, None, :]  # [B, 1, P]
+        if cfg.sliding_window and sliding is not None:
+            dist = positions[:, :, None] - jnp.arange(P)[None, None, :]
+            pmask = pmask & (~sliding | (dist < cfg.sliding_window))
+        sc = jnp.where(pmask[:, None, None], sc, -1e30)
         sw = jnp.einsum("btkgd,bukd->bkgtu", qf, k.astype(jnp.float32))
-        wmask = causal[None, None, None] & length_mask[:, None, None, None, :]
+        if cfg.attn_softcap:
+            sw = cfg.attn_softcap * jnp.tanh(sw / cfg.attn_softcap)
+        cmask = causal
+        if cfg.sliding_window and sliding is not None:
+            cmask = cmask & (~sliding | (win_dist < cfg.sliding_window))
+        wmask = cmask[None, None, None] & length_mask[:, None, None, None, :]
         sw = jnp.where(wmask, sw, -1e30)
         probs = jax.nn.softmax(jnp.concatenate([sc, sw], axis=-1), axis=-1)
         attn = jnp.einsum(
@@ -733,7 +833,10 @@ def prefill_tail(
         h = h + _mlp_out(cfg, lp, x, ep)
         return h, (k, v)
 
-    h, (ks, vs) = jax.lax.scan(layer, h, (params["layers"], prefix_k, prefix_v))
+    h, (ks, vs) = jax.lax.scan(
+        layer, h,
+        (params["layers"], jnp.arange(cfg.num_layers), prefix_k, prefix_v),
+    )
     h = rms_norm(h, params["final_norm"], cfg.rms_eps)
     last_idx = jnp.maximum(lengths - 1, 0)
     last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]  # [B, D]
@@ -751,8 +854,12 @@ def write_prefill_to_cache(
 
     jit-friendly: dynamic_update_slice along the slot axis.
     """
-    k = jax.lax.dynamic_update_slice(cache.k, ks[:, :1], (0, slot, 0, 0, 0))
-    v = jax.lax.dynamic_update_slice(cache.v, vs[:, :1], (0, slot, 0, 0, 0))
+    k = jax.lax.dynamic_update_slice(
+        cache.k, ks[:, :1].astype(cache.k.dtype), (0, slot, 0, 0, 0)
+    )
+    v = jax.lax.dynamic_update_slice(
+        cache.v, vs[:, :1].astype(cache.v.dtype), (0, slot, 0, 0, 0)
+    )
     return KVCache(k=k, v=v)
 
 
@@ -794,6 +901,66 @@ def write_block_to_pool(
     k = pool.k.at[:, pid, off].set(local_k.astype(pool.k.dtype))
     v = pool.v.at[:, pid, off].set(local_v.astype(pool.v.dtype))
     return KVCache(k=k, v=v)
+
+
+def write_chunk_to_pool(
+    pool: KVCache,
+    table: jnp.ndarray,  # [B, MP] int32
+    new_k: jnp.ndarray,  # [L, B, T, K, Hd]
+    new_v: jnp.ndarray,
+    positions: jnp.ndarray,  # [B, T] row indices (contiguous per slot)
+) -> KVCache:
+    """Scatter a speculative verify chunk's rows into the page pool (the
+    paged counterpart of decode_chunk's dense scatter). Rows resolve through
+    the table like write_block_to_pool — rejected-window overshoot rows land
+    in later pages of the same slot's reservation and are overwritten by the
+    next round's writes at the same positions."""
+    page = pool.k.shape[2]
+    MP = table.shape[1]
+    row = jnp.minimum(positions, MP * page - 1)  # [B, T]
+    pid = jnp.take_along_axis(table, row // page, axis=1)  # [B, T]
+    off = row % page
+    k = pool.k.at[:, pid, off].set(new_k.astype(pool.k.dtype))
+    v = pool.v.at[:, pid, off].set(new_v.astype(pool.v.dtype))
+    return KVCache(k=k, v=v)
+
+
+def write_rows_to_pool(
+    pool: KVCache,
+    table_row: jnp.ndarray,  # [MP] int32 — the destination slot's pages
+    ks: jnp.ndarray,  # [L, 1, R, K, Hd]
+    vs: jnp.ndarray,
+    start_row: jnp.ndarray,  # scalar int32 — first destination row
+) -> KVCache:
+    """Scatter R contiguous rows starting at `start_row` into one slot's
+    pages (cached-admission tail rows, which start mid-sequence and are not
+    page-aligned)."""
+    R = ks.shape[2]
+    page = pool.k.shape[2]
+    MP = table_row.shape[0]
+    row = jnp.minimum(start_row + jnp.arange(R), MP * page - 1)  # [R]
+    pid = table_row[row // page]  # [R]
+    off = row % page
+    k = pool.k.at[:, pid, off].set(ks[:, 0].astype(pool.k.dtype))
+    v = pool.v.at[:, pid, off].set(vs[:, 0].astype(pool.v.dtype))
+    return KVCache(k=k, v=v)
+
+
+def gather_pages(
+    pool: KVCache,
+    pages: jnp.ndarray,  # [NP] int32 page ids (SCRATCH-padded past the span)
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Materialize a page list as contiguous KV rows [L, 1, NP*page, K, Hd]
+    — the read half of prefix-span sharing under the paged cache (the span's
+    pages are mapped read-only; prefill_tail consumes a dense prefix
+    operand)."""
+    k = pool.k[:, pages]  # [L, NP, page, K, Hd]
+    v = pool.v[:, pages]
+    L, NP, page, K, Hd = k.shape
+    return (
+        k.reshape(L, 1, NP * page, K, Hd),
+        v.reshape(L, 1, NP * page, K, Hd),
+    )
 
 
 def write_prefill_to_pool(

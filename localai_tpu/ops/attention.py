@@ -103,6 +103,9 @@ def decode_attention_appended(
     k_new: jnp.ndarray,  # [B, K, D] current token's key (not yet in the cache)
     v_new: jnp.ndarray,
     positions: jnp.ndarray,  # [B] int32 position of the current token
+    softcap: float = 0.0,
+    window: int = 0,
+    sliding=None,
 ) -> jnp.ndarray:
     """Decode attention over `cache[0:pos] ⊕ current token`. Returns [B, H, D].
 
@@ -120,13 +123,20 @@ def decode_attention_appended(
     scores = jnp.einsum(
         "bkgd,bskd->bkgs", qf, k_cache.astype(jnp.float32)
     ) * scale  # [B, K, G, S]
+    if softcap:
+        scores = softcap_scores(scores, softcap)
     # Cache rows at/after `positions` are stale (the current row is written
     # after the layer scan); mask them and score the current token separately.
     valid = jnp.arange(S)[None, :] < positions[:, None]  # [B, S]
+    if window and sliding is not None:
+        dist = positions[:, None] - jnp.arange(S)[None, :]
+        valid = valid & (~sliding | (dist < window))
     scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
     cur = jnp.einsum(
         "bkgd,bkd->bkg", qf, k_new.astype(jnp.float32)
     )[..., None] * scale  # [B, K, G, 1]
+    if softcap:
+        cur = softcap_scores(cur, softcap)
     probs = jax.nn.softmax(jnp.concatenate([scores, cur], axis=-1), axis=-1)
     out = jnp.einsum(
         "bkgs,bskd->bkgd", probs[..., :S], v_cache.astype(jnp.float32)
@@ -193,7 +203,9 @@ def decode_attention_windowed(
     return out.reshape(B, H, D).astype(q.dtype)
 
 
-def _sp_cache_partials(q, k_cache, v_cache, limits, mesh):
+def _sp_cache_partials(q, k_cache, v_cache, limits, mesh,
+                       softcap: float = 0.0, window: int = 0, sliding=None,
+                       q_pos=None):
     """Online-softmax partial attention over an "sp"-sharded cache.
 
     The KV cache's sequence axis is sharded over the mesh's "sp" axis (see
@@ -205,8 +217,11 @@ def _sp_cache_partials(q, k_cache, v_cache, limits, mesh):
     flash-decoding across chips, riding ICI.
 
     q: [B, H, D]; k/v_cache: [B, S, K, D] (S sp-sharded); limits: [B] row
-    bound per slot. Returns (acc [B, K, G, D], m [B, K, G, 1], l [B, K, G, 1])
-    replicated over sp, f32, with the 1/sqrt(D) scale already applied to q.
+    bound per slot. softcap/window/sliding are the gemma-2 semantics
+    (softcap BEFORE masking; sliding layers mask rows further than `window`
+    below the query's position `q_pos` [B]). Returns (acc [B, K, G, D],
+    m [B, K, G, 1], l [B, K, G, 1]) replicated over sp, f32, with the
+    1/sqrt(D) scale already applied to q.
     """
     from functools import partial
 
@@ -215,8 +230,10 @@ def _sp_cache_partials(q, k_cache, v_cache, limits, mesh):
     B, H, D = q.shape
     K = k_cache.shape[2]
     scale = 1.0 / (D**0.5)
+    if q_pos is None:
+        q_pos = limits  # plain decode: the query sits right after the rows
 
-    def local(qb, kc, vc, lim):
+    def local(qb, kc, vc, lim, qp, sl):
         Bl, Hl, D_ = qb.shape
         Kl = kc.shape[2]
         G = Hl // Kl
@@ -225,7 +242,12 @@ def _sp_cache_partials(q, k_cache, v_cache, limits, mesh):
         gpos = my * S_l + jnp.arange(S_l)  # global row indices of this shard
         qf = (qb.astype(jnp.float32) * scale).reshape(Bl, Kl, G, D_)
         sc = jnp.einsum("bkgd,bskd->bkgs", qf, kc.astype(jnp.float32))
+        if softcap:
+            sc = softcap_scores(sc, softcap)
         valid = gpos[None, :] < lim[:, None]
+        if window and sliding is not None:
+            dist = qp[:, None] - gpos[None, :]
+            valid = valid & (~sl | (dist < window))
         sc = jnp.where(valid[:, None, None, :], sc, NEG_INF)
         m = jnp.max(sc, axis=-1, keepdims=True)
         p = jnp.exp(sc - m)  # exp(NEG_INF - NEG_INF) rows zeroed by valid below
@@ -239,6 +261,10 @@ def _sp_cache_partials(q, k_cache, v_cache, limits, mesh):
         acc_g = jax.lax.psum(acc * alpha, "sp")
         return acc_g, m_g, l_g
 
+    # The sliding flag is a traced per-layer scalar — it rides as an explicit
+    # replicated operand (closure capture of tracers is not valid under
+    # shard_map).
+    sl_in = sliding if sliding is not None else jnp.zeros((), bool)
     fn = jax.shard_map(
         local,
         mesh=mesh,
@@ -247,6 +273,8 @@ def _sp_cache_partials(q, k_cache, v_cache, limits, mesh):
             P("dp", "sp", "tp", None),
             P("dp", "sp", "tp", None),
             P("dp"),
+            P("dp"),
+            P(),
         ),
         out_specs=(
             P("dp", "tp", None, None),
@@ -255,10 +283,11 @@ def _sp_cache_partials(q, k_cache, v_cache, limits, mesh):
         ),
         check_vma=False,
     )
-    return fn(q, k_cache, v_cache, limits)
+    return fn(q, k_cache, v_cache, limits, q_pos, sl_in)
 
 
-def _merge_partials(q, acc_g, m_g, l_g, extra_k, extra_v, extra_mask):
+def _merge_partials(q, acc_g, m_g, l_g, extra_k, extra_v, extra_mask,
+                    softcap: float = 0.0):
     """Merge sharded-cache partials with a small dense tail (local window
     and/or the current token). extra_k: [B, E, K, D]; extra_mask: [B, E] or
     [E]. Returns [B, H, D] in q's dtype."""
@@ -268,6 +297,8 @@ def _merge_partials(q, acc_g, m_g, l_g, extra_k, extra_v, extra_mask):
     scale = 1.0 / (D**0.5)
     qf = (q.astype(jnp.float32) * scale).reshape(B, K, G, D)
     se = jnp.einsum("bkgd,bekd->bkge", qf, extra_k.astype(jnp.float32))
+    if softcap:
+        se = softcap_scores(se, softcap)
     if extra_mask.ndim == 1:
         extra_mask = extra_mask[None, :]
     se = jnp.where(extra_mask[:, None, None, :], se, NEG_INF)
@@ -291,13 +322,20 @@ def decode_attention_appended_sp(
     v_new: jnp.ndarray,
     positions: jnp.ndarray,  # [B]
     mesh,
+    softcap: float = 0.0,
+    window: int = 0,
+    sliding=None,
 ) -> jnp.ndarray:
     """`decode_attention_appended` for an sp-sharded cache (see
     _sp_cache_partials). The current token is merged host-of-shard-map side
     since it is replicated over sp."""
-    acc_g, m_g, l_g = _sp_cache_partials(q, k_cache, v_cache, positions, mesh)
+    acc_g, m_g, l_g = _sp_cache_partials(
+        q, k_cache, v_cache, positions, mesh,
+        softcap=softcap, window=window, sliding=sliding, q_pos=positions,
+    )
     ones = jnp.ones((q.shape[0], 1), bool)
-    return _merge_partials(q, acc_g, m_g, l_g, k_new[:, None], v_new[:, None], ones)
+    return _merge_partials(q, acc_g, m_g, l_g, k_new[:, None], v_new[:, None],
+                           ones, softcap=softcap)
 
 
 def decode_attention_windowed_sp(
@@ -311,20 +349,34 @@ def decode_attention_windowed_sp(
     positions: jnp.ndarray,  # [B]
     step: jnp.ndarray,  # scalar
     mesh,
+    softcap: float = 0.0,
+    window: int = 0,
+    sliding=None,
 ) -> jnp.ndarray:
     """`decode_attention_windowed` for an sp-sharded cache: sharded partials
     over cache[0:block_start], dense merge of the block-local window and the
     current token (both tiny and replicated)."""
     n = k_local.shape[1]
     acc_g, m_g, l_g = _sp_cache_partials(
-        q, k_cache, v_cache, positions - step, mesh
+        q, k_cache, v_cache, positions - step, mesh,
+        softcap=softcap, window=window, sliding=sliding, q_pos=positions,
     )
-    ek = jnp.concatenate([k_local, k_new[:, None]], axis=1)  # [B, n+1, K, D]
-    ev = jnp.concatenate([v_local, v_new[:, None]], axis=1)
+    # f32 concat: the block-local window may live in the cache's storage
+    # dtype (fp8 KV) while the current token is model-dtype.
+    ek = jnp.concatenate([k_local.astype(jnp.float32),
+                          k_new[:, None].astype(jnp.float32)], axis=1)
+    ev = jnp.concatenate([v_local.astype(jnp.float32),
+                          v_new[:, None].astype(jnp.float32)], axis=1)
     mask = jnp.concatenate(
         [jnp.arange(n) < step, jnp.ones((1,), bool)], axis=0
     )  # [n+1] — same for every slot
-    return _merge_partials(q, acc_g, m_g, l_g, ek, ev, mask)
+    if window and sliding is not None:
+        # Local row i sits `step - i` behind the query; the current token is
+        # distance 0. (The window bound never trips for these in practice —
+        # n << window — but the mask keeps the semantics exact.)
+        dist = jnp.concatenate([step - jnp.arange(n), jnp.zeros((1,), jnp.int32)])
+        mask = mask & (~sliding | (dist < window))
+    return _merge_partials(q, acc_g, m_g, l_g, ek, ev, mask, softcap=softcap)
 
 
 def decode_attention(
@@ -357,7 +409,9 @@ def decode_attention(
 # --------------------------------------------------------------------------- #
 
 
-def _paged_cache_partials(q, k_pool, v_pool, table, limits):
+def _paged_cache_partials(q, k_pool, v_pool, table, limits,
+                          softcap: float = 0.0, window: int = 0, sliding=None,
+                          q_pos=None):
     """Online-softmax partials over a paged cache — the static-shape TPU
     answer to ragged/paged KV (SURVEY §7; reference: llama.cpp's per-slot
     contiguous cache, vLLM's PagedAttention): HBM holds one shared page pool
@@ -369,8 +423,11 @@ def _paged_cache_partials(q, k_pool, v_pool, table, limits):
     with what is actually resident, not max_seq.
 
     q: [B, H, D]; k/v_pool: [P, page, K, D]; table: [B, MP] int32 page ids;
-    limits: [B] — rows with global index >= limits[b] are masked. Returns
-    (acc [B, K, G, D], m [B, K, G, 1], l [B, K, G, 1]) f32, scale applied.
+    limits: [B] — rows with global index >= limits[b] are masked.
+    softcap/window/sliding: gemma-2 semantics (softcap BEFORE masking;
+    sliding layers mask rows further than `window` below `q_pos` [B]).
+    Returns (acc [B, K, G, D], m [B, K, G, 1], l [B, K, G, 1]) f32, scale
+    applied.
     """
     B, H, D = q.shape
     page = k_pool.shape[1]
@@ -379,6 +436,8 @@ def _paged_cache_partials(q, k_pool, v_pool, table, limits):
     MP = table.shape[1]
     scale = 1.0 / (D**0.5)
     qf = (q.astype(jnp.float32) * scale).reshape(B, K, G, D)
+    if q_pos is None:
+        q_pos = limits
 
     def body(p, carry):
         m, l, acc = carry
@@ -386,8 +445,13 @@ def _paged_cache_partials(q, k_pool, v_pool, table, limits):
         kp = k_pool[pids].astype(jnp.float32)  # [B, page, K, D]
         vp = v_pool[pids].astype(jnp.float32)
         sc = jnp.einsum("bkgd,bskd->bkgs", qf, kp)
+        if softcap:
+            sc = softcap_scores(sc, softcap)
         gpos = p * page + jnp.arange(page)  # global rows of this column
         valid = gpos[None, :] < limits[:, None]
+        if window and sliding is not None:
+            dist = q_pos[:, None] - gpos[None, :]
+            valid = valid & (~sliding | (dist < window))
         sc = jnp.where(valid[:, None, None, :], sc, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(sc, axis=-1, keepdims=True))
         alpha = jnp.exp(jnp.maximum(m - m_new, -80.0))
@@ -418,13 +482,99 @@ def decode_attention_windowed_paged(
     v_new: jnp.ndarray,
     positions: jnp.ndarray,  # [B]
     step: jnp.ndarray,  # scalar
+    softcap: float = 0.0,
+    window: int = 0,
+    sliding=None,
 ) -> jnp.ndarray:
     """`decode_attention_windowed` over a paged pool: paged partials for
     rows [0, block_start), dense merge of the (tiny) local window + current
     token."""
     n = k_local.shape[1]
-    acc, m, l = _paged_cache_partials(q, k_pool, v_pool, table, positions - step)
-    ek = jnp.concatenate([k_local, k_new[:, None]], axis=1)  # [B, n+1, K, D]
-    ev = jnp.concatenate([v_local, v_new[:, None]], axis=1)
+    acc, m, l = _paged_cache_partials(
+        q, k_pool, v_pool, table, positions - step,
+        softcap=softcap, window=window, sliding=sliding, q_pos=positions,
+    )
+    # f32 concat: the block-local window may live in the cache's storage
+    # dtype (fp8 KV) while the current token is model-dtype.
+    ek = jnp.concatenate([k_local.astype(jnp.float32),
+                          k_new[:, None].astype(jnp.float32)], axis=1)
+    ev = jnp.concatenate([v_local.astype(jnp.float32),
+                          v_new[:, None].astype(jnp.float32)], axis=1)
     mask = jnp.concatenate([jnp.arange(n) < step, jnp.ones((1,), bool)], axis=0)
-    return _merge_partials(q, acc, m, l, ek, ev, mask)
+    if window and sliding is not None:
+        dist = jnp.concatenate([step - jnp.arange(n), jnp.zeros((1,), jnp.int32)])
+        mask = mask & (~sliding | (dist < window))
+    return _merge_partials(q, acc, m, l, ek, ev, mask, softcap=softcap)
+
+
+def _paged_cache_partials_mq(q, k_pool, v_pool, table, limits,
+                             softcap: float = 0.0, window: int = 0,
+                             sliding=None, q_pos=None):
+    """Multi-query `_paged_cache_partials` for the speculative verify chunk:
+    q [B, T, H, D] (T = draft window + 1), one page walk shared by all T
+    queries. limits [B] bounds the cache prefix every query may see (the
+    chunk's in-window causal part is merged separately). Returns
+    (acc [B, K, G, T, D], m [B, K, G, T, 1], l [B, K, G, T, 1])."""
+    B, T, H, D = q.shape
+    page = k_pool.shape[1]
+    K = k_pool.shape[2]
+    G = H // K
+    MP = table.shape[1]
+    scale = 1.0 / (D**0.5)
+    qf = (q.astype(jnp.float32) * scale).reshape(B, T, K, G, D)
+
+    def body(p, carry):
+        m, l, acc = carry
+        pids = table[:, p]
+        kp = k_pool[pids].astype(jnp.float32)  # [B, page, K, D]
+        vp = v_pool[pids].astype(jnp.float32)
+        sc = jnp.einsum("btkgd,bskd->bkgts", qf, kp)  # [B, K, G, T, page]
+        if softcap:
+            sc = softcap_scores(sc, softcap)
+        gpos = p * page + jnp.arange(page)
+        valid = gpos[None, None, :] < limits[:, None, None]  # [B, 1, page]
+        if window and sliding is not None:
+            dist = q_pos[:, :, None] - gpos[None, None, :]  # [B, T, page]
+            valid = valid & (~sliding | (dist < window))
+        vmask = valid[:, None, None]  # [B, 1, 1, T|1, page]
+        sc = jnp.where(vmask, sc, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(sc, axis=-1, keepdims=True))
+        alpha = jnp.exp(jnp.maximum(m - m_new, -80.0))
+        pr = jnp.exp(sc - m_new)
+        pr = jnp.where(vmask, pr, 0.0)
+        l = l * alpha + jnp.sum(pr, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("bkgts,bskd->bkgtd", pr, vp)
+        return m_new, l, acc
+
+    m0 = jnp.full((B, K, G, T, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, K, G, T, 1), jnp.float32)
+    a0 = jnp.zeros((B, K, G, T, D), jnp.float32)
+    p_hi = jnp.minimum((jnp.max(limits) + page - 1) // page, MP).astype(jnp.int32)
+    m, l, acc = jax.lax.fori_loop(0, p_hi, body, (m0, l0, a0))
+    return acc, m, l
+
+
+def _merge_partials_mq(q, acc_g, m_g, l_g, extra_k, extra_v, extra_mask,
+                       softcap: float = 0.0):
+    """Multi-query `_merge_partials`: q [B, T, H, D], partials [..., T, ...],
+    extra_k/v [B, E, K, D], extra_mask [B, T, E]. Returns [B, T, H, D]."""
+    B, T, H, D = q.shape
+    K = extra_k.shape[2]
+    G = H // K
+    scale = 1.0 / (D**0.5)
+    qf = (q.astype(jnp.float32) * scale).reshape(B, T, K, G, D)
+    se = jnp.einsum("btkgd,bekd->bkgte", qf, extra_k.astype(jnp.float32))
+    if softcap:
+        se = softcap_scores(se, softcap)
+    emask = extra_mask[:, None, None]  # [B, 1, 1, T, E]
+    se = jnp.where(emask, se, NEG_INF)
+    m_e = jnp.max(se, axis=-1, keepdims=True)
+    m_tot = jnp.maximum(m_g, m_e)
+    p_e = jnp.exp(se - m_tot)
+    p_e = jnp.where(emask, p_e, 0.0)
+    w_c = jnp.exp(jnp.maximum(m_g - m_tot, -80.0))
+    w_c = jnp.where(l_g > 0, w_c, 0.0)
+    num = acc_g * w_c + jnp.einsum("bkgte,bekd->bkgtd", p_e, extra_v.astype(jnp.float32))
+    den = l_g * w_c + jnp.sum(p_e, axis=-1, keepdims=True)
+    out = num / jnp.maximum(den, 1e-30)
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, T, H, D).astype(q.dtype)

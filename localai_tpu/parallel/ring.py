@@ -21,11 +21,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _local_ring(q, k, v, lengths, *, axis: str, n_shards: int):
+def _local_ring(q, k, v, lengths, *, axis: str, n_shards: int,
+                softcap: float = 0.0, window: int = 0, sliding=None):
     """Per-shard body under shard_map.
 
     q: [B, S_l, H, D], k/v: [B, S_l, K, D] — the local sequence block.
-    lengths: [B] global valid lengths (replicated).
+    lengths: [B] global valid lengths (replicated). softcap/window/sliding
+    are the gemma-2 semantics (softcap BEFORE masking; sliding layers only
+    attend within `window` positions back).
     """
     B, S_l, H, D = q.shape
     K = k.shape[2]
@@ -48,7 +51,12 @@ def _local_ring(q, k, v, lengths, *, axis: str, n_shards: int):
         scores = jnp.einsum(
             "bqkgd,bskd->bkgqs", qf, k_blk.astype(jnp.float32)
         )  # [B, K, G, S_q, S_kv]
+        if softcap:
+            scores = softcap * jnp.tanh(scores / softcap)
         causal = kv_pos[None, :] <= q_pos[:, None]  # [S_q, S_kv]
+        if window and sliding is not None:
+            dist = q_pos[:, None] - kv_pos[None, :]
+            causal = causal & (~sliding | (dist < window))
         valid = kv_pos[None, :] < lengths[:, None]  # [B, S_kv]
         full_mask = causal[None, None, None] & valid[:, None, None, None, :]
         scores = jnp.where(full_mask, scores, NEG_INF)
@@ -82,15 +90,32 @@ def ring_prefill_attention(
     lengths: jnp.ndarray,  # [B]
     mesh: Mesh,
     axis: str = "sp",
+    softcap: float = 0.0,
+    window: int = 0,
+    sliding=None,
 ) -> jnp.ndarray:
     """Causal GQA attention with the sequence axis sharded over `axis`."""
     n = mesh.shape[axis]
     seq_spec = P(None, axis, None, None)
+    if sliding is None:
+        fn = jax.shard_map(
+            partial(_local_ring, axis=axis, n_shards=n, softcap=softcap),
+            mesh=mesh,
+            in_specs=(seq_spec, seq_spec, seq_spec, P(None)),
+            out_specs=seq_spec,
+            check_vma=False,
+        )
+        return fn(q, k, v, lengths)
+    # `sliding` is a traced bool scalar (layer alternation) — it rides as a
+    # replicated operand so one shard_map serves both layer kinds.
     fn = jax.shard_map(
-        partial(_local_ring, axis=axis, n_shards=n),
+        lambda q_, k_, v_, l_, sl_: _local_ring(
+            q_, k_, v_, l_, axis=axis, n_shards=n, softcap=softcap,
+            window=window, sliding=sl_,
+        ),
         mesh=mesh,
-        in_specs=(seq_spec, seq_spec, seq_spec, P(None)),
+        in_specs=(seq_spec, seq_spec, seq_spec, P(None), P()),
         out_specs=seq_spec,
         check_vma=False,
     )
-    return fn(q, k, v, lengths)
+    return fn(q, k, v, lengths, sliding)

@@ -397,6 +397,8 @@ class ModelManager:
 
             arch = arch_from_hf_config(ckpt_dir)
 
+        arch = _apply_rope_overrides(arch, cfg)
+
         from localai_tpu.parallel.sharding import max_valid_tp
 
         n_devices = len(jax.devices())
@@ -406,7 +408,8 @@ class ModelManager:
         plan = MeshPlan(dp=par.dp, tp=max(1, tp), ep=par.ep, sp=par.sp)
 
         tok_path = cfg.tokenizer or gguf_tok_dir or (ckpt_dir if ckpt_dir else None)
-        if tok_path and not _has_tokenizer_files(tok_path):
+        if (tok_path and tok_path != "synthetic-bytes"
+                and not _has_tokenizer_files(tok_path)):
             tok_path = None
         tokenizer = load_tokenizer(tok_path, vocab_size=arch.vocab_size)
         tv = getattr(tokenizer, "vocab_size", None)
@@ -483,6 +486,7 @@ class ModelManager:
             engine_cfg=EngineConfig(
                 max_slots=cfg.max_slots, max_seq=cfg.context_size,
                 kv_pages=cfg.kv_pages, kv_page_size=cfg.kv_page_size,
+                kv_cache_dtype=cfg.kv_cache_dtype,
             ),
             draft_cfg=draft_arch,
             draft_params=draft_params,
@@ -741,6 +745,50 @@ def whisper_presets() -> dict:
     from localai_tpu.models.whisper import WHISPER_PRESETS
 
     return WHISPER_PRESETS
+
+
+def _apply_rope_overrides(arch, cfg):
+    """YAML rope knobs override the checkpoint's (reference parity:
+    model_config.go rope_scaling/rope_freq_base are user config, forwarded
+    over the checkpoint's own values)."""
+    import dataclasses as _dc
+
+    updates = {}
+    if cfg.rope_freq_base:
+        updates["rope_theta"] = float(cfg.rope_freq_base)
+    rs = cfg.rope_scaling
+    if rs:
+        stype = rs.get("rope_type") or rs.get("type")
+        if stype == "su":
+            stype = "longrope"
+        updates["rope_scaling"] = stype
+        if "factor" in rs:
+            updates["rope_scaling_factor"] = float(rs["factor"])
+        if "original_max_position_embeddings" in rs:
+            updates["rope_original_max_position"] = int(
+                rs["original_max_position_embeddings"]
+            )
+        if "low_freq_factor" in rs:
+            updates["rope_low_freq_factor"] = float(rs["low_freq_factor"])
+        if "high_freq_factor" in rs:
+            updates["rope_high_freq_factor"] = float(rs["high_freq_factor"])
+        if "beta_fast" in rs:
+            updates["rope_beta_fast"] = float(rs["beta_fast"])
+        if "beta_slow" in rs:
+            updates["rope_beta_slow"] = float(rs["beta_slow"])
+        if rs.get("long_factor"):
+            updates["rope_long_factor"] = tuple(rs["long_factor"])
+        if rs.get("short_factor"):
+            updates["rope_short_factor"] = tuple(rs["short_factor"])
+        if rs.get("attention_factor") is not None:
+            updates["rope_attn_factor"] = float(rs["attention_factor"])
+        # A scaled rope serves past the checkpoint's advertised window; lift
+        # max_position to the deployment context so longrope's long/short
+        # choice and prompt admission agree with the YAML.
+        updates["max_position"] = max(arch.max_position, cfg.context_size)
+    if not updates:
+        return arch
+    return _dc.replace(arch, **updates)
 
 
 def _has_tokenizer_files(path: str) -> bool:

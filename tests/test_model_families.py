@@ -125,15 +125,37 @@ def test_gemma_save_round_trip(tmp_path):
     assert np.allclose(wq1, wq2, atol=1e-2)
 
 
-def test_gemma3_rejected_loudly(tmp_path):
-    cfg_path = tmp_path / "config.json"
-    cfg_path.write_text(json.dumps({
-        "model_type": "gemma3", "vocab_size": 64, "hidden_size": 16,
-        "intermediate_size": 32, "num_hidden_layers": 2,
-        "num_attention_heads": 2,
-    }))
-    with pytest.raises(ValueError, match="gemma3"):
-        arch_from_hf_config(str(tmp_path))
+def test_gemma3_checkpoint_matches_torch(tmp_path):
+    """Gemma-3 (r4): q/k per-head norms, 5-local:1-global sliding pattern,
+    and a dual rope schedule (local layers on rope_local_base_freq, global
+    layers on rope_theta + linear scaling)."""
+    from transformers import Gemma3ForCausalLM, Gemma3TextConfig
+
+    cfg_hf = Gemma3TextConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=6, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=256,
+        rope_theta=1_000_000.0, rope_local_base_freq=10_000.0,
+        rope_scaling={"rope_type": "linear", "factor": 8.0},
+        sliding_window=8, query_pre_attn_scalar=24.0, rms_norm_eps=1e-6,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(6)
+    model = Gemma3ForCausalLM(cfg_hf)
+    model.eval()
+    d = tmp_path / "gemma3"
+    model.save_pretrained(str(d), safe_serialization=True)
+
+    cfg = arch_from_hf_config(str(d))
+    assert cfg.qk_norm and cfg.post_norms and cfg.sliding_pattern == 6
+    assert cfg.rope_local_theta == 10_000.0
+    assert cfg.rope_scaling == "linear" and cfg.rope_scaling_factor == 8.0
+    assert cfg.sliding_window == 8 and not cfg.attn_softcap
+    params = load_hf_checkpoint(cfg, str(d))
+    params = jax.tree.map(lambda a: jnp.asarray(a, jnp.float32), params)
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": "float32"})
+    ids = [3, 17, 92, 5, 41, 8, 77, 13, 60, 2, 19, 33]  # len 12 > window 8
+    _logits_match(cfg, params, model, ids, atol=5e-3)
 
 
 def test_gemma2_checkpoint_matches_torch(tmp_path):
@@ -168,18 +190,70 @@ def test_gemma2_checkpoint_matches_torch(tmp_path):
     _logits_match(cfg, params, model, ids, atol=5e-3)
 
 
-def test_longrope_clamps_context(tmp_path):
-    (tmp_path / "config.json").write_text(json.dumps({
-        "model_type": "phi3", "vocab_size": 64, "hidden_size": 16,
-        "intermediate_size": 32, "num_hidden_layers": 2,
-        "num_attention_heads": 2, "max_position_embeddings": 131072,
-        "rope_scaling": {"type": "longrope",
-                         "original_max_position_embeddings": 4096,
-                         "short_factor": [1.0], "long_factor": [1.0]},
-    }))
-    cfg = arch_from_hf_config(str(tmp_path))
-    assert cfg.rope_scaling is None
-    assert cfg.max_position == 4096  # unscaled rope → original window only
+def test_qwen2_yarn_matches_torch(tmp_path):
+    """YaRN rope scaling (r4): NTK-by-parts frequency ramp + mscale
+    attention-amplitude correction, pinned against torch."""
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    cfg_hf = Qwen2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                      "original_max_position_embeddings": 32},
+        attn_implementation="eager",
+    )
+    torch.manual_seed(7)
+    model = Qwen2ForCausalLM(cfg_hf)
+    model.eval()
+    d = tmp_path / "qwen2-yarn"
+    model.save_pretrained(str(d), safe_serialization=True)
+
+    cfg = arch_from_hf_config(str(d))
+    assert cfg.rope_scaling == "yarn" and cfg.rope_scaling_factor == 4.0
+    assert cfg.rope_original_max_position == 32
+    assert cfg.max_position == 128  # extended window served, not clamped
+    params = load_hf_checkpoint(cfg, str(d))
+    params = jax.tree.map(lambda a: jnp.asarray(a, jnp.float32), params)
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": "float32"})
+    _logits_match(cfg, params, model, [3, 17, 92, 5, 41, 8, 77, 13], atol=5e-3)
+
+
+def test_phi3_longrope_matches_torch(tmp_path):
+    """Phi-3 LongRoPE (r4): per-frequency rescale tables + attention factor.
+    The input exceeds the original window so BOTH implementations pick the
+    long-factor table (torch switches on runtime seq_len; we statically
+    serve the deployment window)."""
+    from transformers import Phi3Config, Phi3ForCausalLM
+
+    rng = np.random.default_rng(1)
+    short = [1.0] * 8
+    long = [round(float(f), 3) for f in 1.0 + rng.uniform(0.2, 3.0, size=8)]
+    cfg_hf = Phi3Config(
+        vocab_size=120, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=256,
+        original_max_position_embeddings=16,
+        rope_scaling={"type": "longrope", "short_factor": short,
+                      "long_factor": long},
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(8)
+    model = Phi3ForCausalLM(cfg_hf)
+    model.eval()
+    d = tmp_path / "phi3-longrope"
+    model.save_pretrained(str(d), safe_serialization=True)
+
+    cfg = arch_from_hf_config(str(d))
+    assert cfg.rope_scaling == "longrope"
+    assert cfg.rope_long_factor == tuple(long)
+    assert cfg.rope_original_max_position == 16
+    params = load_hf_checkpoint(cfg, str(d))
+    params = jax.tree.map(lambda a: jnp.asarray(a, jnp.float32), params)
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": "float32"})
+    ids = [(j * 13) % 119 + 1 for j in range(24)]  # 24 > original window 16
+    _logits_match(cfg, params, model, ids, atol=5e-3)
 
 
 def test_gemma2_serves_through_engine(tmp_path):
@@ -210,7 +284,7 @@ def test_gemma2_serves_through_engine(tmp_path):
         text, ev = eng.generate(list(range(3, 20)), max_new_tokens=8,
                                 ignore_eos=True)
         assert ev.kind == "done" and len(text) > 0
-        assert not eng._prefix_enabled  # prefill_tail lacks softcap/sliding
+        assert eng._prefix_enabled  # softcap/sliding compose with prefix (r4)
     finally:
         eng.stop()
 
@@ -241,6 +315,35 @@ def test_gemma_serves_through_manager(tmp_path):
         lm = manager.get("g")
         ids = [3, 17, 92, 5]
         text, ev = lm.engine.generate(ids, max_new_tokens=4, ignore_eos=True)
+        assert ev.kind == "done"
+    finally:
+        manager.shutdown()
+
+
+def test_yaml_rope_overrides_reach_engine(tmp_path):
+    """`rope_scaling` / `rope_freq_base` in a model YAML override the arch
+    (reference: model_config.go:231-237 user rope knobs forwarded over the
+    checkpoint's)."""
+    import yaml
+
+    from localai_tpu.config import ApplicationConfig
+    from localai_tpu.server import ModelManager
+
+    (tmp_path / "m.yaml").write_text(yaml.safe_dump({
+        "name": "m", "model": "tiny", "context_size": 128,
+        "rope_freq_base": 50_000.0,
+        "rope_scaling": {"rope_type": "yarn", "factor": 4.0,
+                         "original_max_position_embeddings": 64},
+    }))
+    manager = ModelManager(ApplicationConfig(models_dir=str(tmp_path)))
+    try:
+        lm = manager.get("m")
+        arch = lm.engine.cfg
+        assert arch.rope_theta == 50_000.0
+        assert arch.rope_scaling == "yarn" and arch.rope_scaling_factor == 4.0
+        assert arch.rope_original_max_position == 64
+        text, ev = lm.engine.generate([1, 2, 3, 4], max_new_tokens=4,
+                                      ignore_eos=True)
         assert ev.kind == "done"
     finally:
         manager.shutdown()
