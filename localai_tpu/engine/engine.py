@@ -1143,30 +1143,24 @@ class Engine:
             self._snap_cache[pb] = fn
         return fn
 
-    def _prefix_save(self, slot_idx: int, key_tokens, valid_len: int,
-                     final: bool = False) -> None:
+    def _prefix_save(self, slot_idx: int, key_tokens, valid_len: int) -> None:
         """Store the slot's KV rows [0:valid_len] under `key_tokens`.
 
         Called right after an admission dispatch (prompt KV) and at finish
         (prompt+generated KV — the next chat turn's prefix). Dense cache:
         device-to-device snapshot slice. Paged cache: NO copy — the entry
-        takes a refcount on the slot's pages (copy-on-write sharing; later
-        admissions map them read-only and prefill tails into fresh pages).
-        Never blocks the loop.
-
-        `final` marks the finish-time save, when the slot will never write
-        again: the partial last page is safe to share then. Admission-time
-        saves share only pages strictly below the first row the live slot
-        will still write (valid_len rounds down to a page boundary)."""
+        takes a refcount on the slot's FULL pages below valid_len
+        (copy-on-write sharing; later admissions map them read-only and
+        prefill tails into fresh pages). Never blocks the loop."""
         if not self._prefix_enabled or valid_len < self.ecfg.prefix_cache_min:
             return
         if self._paged:
             page = self.ecfg.kv_page_size
-            if final:
-                n_pages = -(-valid_len // page)  # done writing — share all
-            else:
-                n_pages = valid_len // page  # full pages only
-                valid_len = n_pages * page
+            # Full pages only — matches always round DOWN to a page boundary
+            # (_prefix_find), so pinning a partial last page would withhold
+            # it from the pool without it ever being mappable.
+            n_pages = valid_len // page
+            valid_len = n_pages * page
             if valid_len < self.ecfg.prefix_cache_min or n_pages == 0:
                 return
             page_bytes = self._prefix_span_bytes(page)
@@ -2733,8 +2727,7 @@ class Engine:
             # as the next step's input).
             valid = slot.prompt_len + max(0, len(slot.generated) - 1)
             self._prefix_save(
-                slot_idx, list(slot.request.prompt_ids) + slot.generated, valid,
-                final=True,  # slot will never write again — partial page shareable
+                slot_idx, list(slot.request.prompt_ids) + slot.generated, valid
             )
         now = time.monotonic()
         t_first = slot.t_first or now

@@ -730,11 +730,36 @@ class ModelManager:
                 from localai_tpu.engine.image_engine import LatentDiffusionEngine
 
                 ldcfg, ldparams, tok = LD.load_pipeline(ckpt_dir)
+                # AnimateDiff-class motion adapter: a `motion_adapter` dir in
+                # the model YAML, or one bundled inside the checkpoint (the
+                # diffusers AnimateDiffPipeline save layout) — /v1/videos
+                # then runs a real temporal model instead of the latent sweep
+                # (reference: diffusers backend.py:226-253 video pipelines).
+                from localai_tpu.models import video_diffusion as VD
+
+                motion = None
+                mdir = cfg.options.get("motion_adapter") or ""
+                if mdir:
+                    mdir = self._resolve_ckpt_dir(str(mdir))
+                elif VD.is_motion_adapter_dir(
+                    os.path.join(ckpt_dir, "motion_adapter")
+                ):
+                    mdir = os.path.join(ckpt_dir, "motion_adapter")
+                if mdir:
+                    if not VD.is_motion_adapter_dir(mdir):
+                        raise FileNotFoundError(
+                            f"model {cfg.name!r}: motion_adapter {mdir!r} is "
+                            "not a diffusers MotionAdapter dir"
+                        )
+                    motion = VD.load_motion_adapter(mdir)
+                    log.info("model %s: motion adapter loaded from %s",
+                             cfg.name, mdir)
                 eng = LatentDiffusionEngine(
                     ldcfg, ldparams, tok,
                     default_scheduler=str(
                         cfg.options.get("scheduler", "ddim")
                     ),
+                    motion=motion,
                 )
                 return LoadedModel(cfg, eng, None)
             dcfg, params = D.load_diffusion(ckpt_dir)
@@ -761,6 +786,14 @@ def _apply_rope_overrides(arch, cfg):
         stype = rs.get("rope_type") or rs.get("type")
         if stype == "su":
             stype = "longrope"
+        if stype not in ("linear", "llama3", "yarn", "longrope"):
+            # Fail at LOAD, not at first admission trace — and never let a
+            # factor-only dict silently null the checkpoint's own scaling
+            # while still lifting the window.
+            raise ValueError(
+                f"model {cfg.name!r}: rope_scaling needs rope_type in "
+                f"linear/llama3/yarn/longrope (got {stype!r})"
+            )
         updates["rope_scaling"] = stype
         if "factor" in rs:
             updates["rope_scaling_factor"] = float(rs["factor"])

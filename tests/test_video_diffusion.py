@@ -1,0 +1,213 @@
+"""AnimateDiff-class video generation (VERDICT r3 #2): motion modules in
+the diffusers MotionAdapter layout load and correlate frames through real
+temporal attention — /v1/videos is no longer a latent slerp.
+
+Reference: diffusers video pipelines (backend/python/diffusers/backend.py:
+226-253) dispatched via core/backend/video.go.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytest.importorskip("transformers")
+
+from localai_tpu.models import latent_diffusion as ld  # noqa: E402
+from localai_tpu.models import video_diffusion as vd  # noqa: E402
+from tests.test_latent_diffusion import (  # noqa: E402
+    GROUPS,
+    TEXT_DIM,
+    UNET_BLOCKS,
+    _Gen,
+    _save_st,
+    sd_dir,  # noqa: F401 — fixture reuse
+)
+
+
+def gen_motion(zero_proj_out: bool = False, seed: int = 20) -> dict[str, np.ndarray]:
+    """Fabricate MotionAdapter weights with the exact published diffusers
+    names for the tiny test UNet (layers_per_block=1, blocks 32/64)."""
+    g = _Gen(seed)
+    b0, b1 = UNET_BLOCKS
+
+    def module(pre, c):
+        g.norm(f"{pre}.norm", c)
+        g.lin(f"{pre}.proj_in", c, c)
+        tb = f"{pre}.transformer_blocks.0"
+        g.norm(f"{tb}.norm1", c)
+        for nm in ("to_q", "to_k", "to_v"):
+            g.lin(f"{tb}.attn1.{nm}", c, c, bias=False)
+        g.lin(f"{tb}.attn1.to_out.0", c, c)
+        g.norm(f"{tb}.norm2", c)
+        for nm in ("to_q", "to_k", "to_v"):
+            g.lin(f"{tb}.attn2.{nm}", c, c, bias=False)
+        g.lin(f"{tb}.attn2.to_out.0", c, c)
+        g.norm(f"{tb}.norm3", c)
+        g.lin(f"{tb}.ff.net.0.proj", c, 8 * c)  # geglu
+        g.lin(f"{tb}.ff.net.2", 4 * c, c)
+        g.lin(f"{pre}.proj_out", c, c)
+        if zero_proj_out:
+            g.P[f"{pre}.proj_out.weight"][:] = 0.0
+            g.P[f"{pre}.proj_out.bias"][:] = 0.0
+
+    module("down_blocks.0.motion_modules.0", b0)
+    module("down_blocks.1.motion_modules.0", b1)
+    module("mid_block.motion_modules.0", b1)
+    for li in range(2):  # layers_per_block + 1
+        module(f"up_blocks.0.motion_modules.{li}", b1)
+        module(f"up_blocks.1.motion_modules.{li}", b0)
+    return g.P
+
+
+def _write_adapter(path: str, tensors: dict) -> None:
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump({
+            "_class_name": "MotionAdapter",
+            "block_out_channels": list(UNET_BLOCKS),
+            "motion_layers_per_block": 1,
+            "motion_mid_block_layers_per_block": 1,
+            "motion_num_attention_heads": 4,
+            "motion_max_seq_length": 16,
+            "motion_norm_num_groups": GROUPS,
+            "use_motion_mid_block": True,
+        }, f)
+    _save_st(os.path.join(path, "diffusion_pytorch_model.safetensors"), tensors)
+
+
+@pytest.fixture(scope="module")
+def adapter_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("motion") / "adapter"
+    _write_adapter(str(d), gen_motion())
+    return str(d)
+
+
+def test_motion_adapter_loads(adapter_dir):
+    assert vd.is_motion_adapter_dir(adapter_dir)
+    mcfg, mp = vd.load_motion_adapter(adapter_dir)
+    assert mcfg.num_heads == 4 and mcfg.max_seq_length == 16
+    assert mcfg.norm_num_groups == GROUPS
+    # torch [out, in] linears arrive transposed to [in, out]
+    b0 = UNET_BLOCKS[0]
+    assert mp["down_blocks.0.motion_modules.0.proj_in.weight"].shape == (b0, b0)
+
+
+def test_zero_init_adapter_reduces_to_image_pipeline(sd_dir, tmp_path):
+    """AnimateDiff adapters train zero-initialized so the base model's
+    behavior is preserved at init: with proj_out == 0 every motion module is
+    an identity and the video pipeline must reproduce the per-frame image
+    pipeline EXACTLY (same noise, same DDIM math)."""
+    cfg, params, tok = ld.load_pipeline(sd_dir)
+    zdir = tmp_path / "zero-adapter"
+    _write_adapter(str(zdir), gen_motion(zero_proj_out=True))
+    mcfg, mp = vd.load_motion_adapter(str(zdir))
+
+    S = cfg.text.max_position_embeddings
+    enc = tok("a photo of a cat", padding="max_length", max_length=S,
+              truncation=True)["input_ids"]
+    cond = jnp.asarray(enc, jnp.int32)[None]
+    unc = jnp.asarray(tok("", padding="max_length", max_length=S,
+                          truncation=True)["input_ids"], jnp.int32)[None]
+    F, steps, size = 3, 3, 64
+    key = jax.random.key(7)
+    video = vd.generate_video(cfg, params, mcfg, mp, cond, unc, key,
+                              frames=F, steps=steps, guidance=5.0,
+                              height=size, width=size)
+    # Reproduce the image path with the identical per-frame noise.
+    _, nk = jax.random.split(key)
+    noise = jax.random.normal(
+        nk, (F, size // cfg.vae.spatial_scale, size // cfg.vae.spatial_scale,
+             cfg.unet.in_channels), jnp.float32)
+    imgs = ld.generate(
+        cfg, params, jnp.broadcast_to(cond, (F, S)),
+        jnp.broadcast_to(unc, (F, S)), key, steps=steps, guidance=5.0,
+        height=size, width=size, scheduler="ddim", init_noise=noise,
+    )
+    assert np.allclose(np.asarray(video), np.asarray(imgs), atol=1e-4), (
+        np.abs(np.asarray(video) - np.asarray(imgs)).max()
+    )
+
+
+def test_motion_modules_couple_frames(sd_dir, adapter_dir):
+    """Temporal information must FLOW: perturbing one frame's latent changes
+    the motion UNet's output for OTHER frames (the latent-slerp sweep this
+    replaces had fully independent frames)."""
+    cfg, params, _tok = ld.load_pipeline(sd_dir)
+    mcfg, mp = vd.load_motion_adapter(adapter_dir)
+    F, size = 4, 64
+    lat = size // cfg.vae.spatial_scale
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(0, 1, (F, lat, lat, 4)), jnp.float32)
+    ctx = jnp.asarray(rng.normal(0, 0.1, (F, 77, TEXT_DIM)), jnp.float32)
+    t = jnp.full((F,), 500.0, jnp.float32)
+    base = vd.motion_unet_forward(cfg.unet, mcfg, params["unet"], mp,
+                                  x, t, ctx, frames=F)
+    x2 = x.at[1].add(0.5)  # perturb frame 1 only
+    pert = vd.motion_unet_forward(cfg.unet, mcfg, params["unet"], mp,
+                                  x2, t, ctx, frames=F)
+    d0 = float(np.abs(np.asarray(pert[0]) - np.asarray(base[0])).max())
+    assert d0 > 1e-5, "frame 0 unaffected by frame 1 — no temporal coupling"
+
+    # The plain (motion-less) UNet must NOT couple frames (sanity check that
+    # the coupling above comes from the motion modules).
+    ub = ld.unet_forward(cfg.unet, params["unet"], x, t, ctx)
+    up = ld.unet_forward(cfg.unet, params["unet"], x2, t, ctx)
+    assert np.allclose(np.asarray(ub[0]), np.asarray(up[0]), atol=1e-5)
+
+
+def test_videos_api_with_motion_adapter(sd_dir, adapter_dir, tmp_path):
+    """End-to-end: a model YAML pointing at the SD checkpoint + motion
+    adapter serves /v1/videos through the real temporal pipeline."""
+    import io
+    import threading
+    import urllib.request
+
+    import yaml
+    from PIL import Image
+
+    from localai_tpu.config import ApplicationConfig
+    from localai_tpu.server import ModelManager, Router, create_server
+    from localai_tpu.server.image_api import ImageApi
+    from localai_tpu.server.openai_api import OpenAIApi
+
+    (tmp_path / "vid.yaml").write_text(yaml.safe_dump({
+        "name": "vid", "model": sd_dir, "backend": "diffusion",
+        "motion_adapter": adapter_dir,
+    }))
+    content = tmp_path / "generated"
+    content.mkdir()
+    app_cfg = ApplicationConfig(address="127.0.0.1", port=0,
+                                models_dir=str(tmp_path))
+    manager = ModelManager(app_cfg)
+    router = Router()
+    oai = OpenAIApi(manager)
+    oai.register(router)
+    ImageApi(manager, oai, str(content)).register(router)
+    server = create_server(app_cfg, router)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        lm = manager.get("vid")
+        assert lm.engine.motion is not None  # adapter reached the engine
+        req = urllib.request.Request(
+            base + "/v1/videos",
+            data=json.dumps({"model": "vid", "prompt": "a cat",
+                             "n_frames": 3, "steps": 2, "seed": 5}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=600) as r:
+            out = json.loads(r.read())
+        with urllib.request.urlopen(base + out["data"][0]["url"],
+                                    timeout=30) as r:
+            gif = r.read()
+        img = Image.open(io.BytesIO(gif))
+        # tiny test pipeline: sample_size 8 × VAE scale 2 = 16px native
+        assert img.format == "GIF" and img.size == (16, 16)
+        img.seek(2)  # 3 frames exist
+    finally:
+        server.shutdown()
+        manager.shutdown()
