@@ -163,6 +163,8 @@ class ModelConfig:
             return Usecase.TRANSCRIPT
         if b == "tts" or b in ("piper", "bark"):
             return Usecase.TTS | Usecase.SOUND_GENERATION
+        if b in ("musicgen", "soundgen", "sound-generation"):
+            return Usecase.SOUND_GENERATION
         if b == "vad" or "silero" in self.model:
             return Usecase.VAD
         if b == "diffusion" or b in ("diffusers", "stablediffusion"):

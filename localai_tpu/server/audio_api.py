@@ -161,7 +161,61 @@ class AudioApi:
         return RawStream(chunks(), content_type="audio/wav")
 
     def sound_generation(self, req: Request) -> Response:
-        return self._tts_impl(req, Usecase.SOUND_GENERATION)
+        """Prompt → audio (music/sfx). ElevenLabs-shaped request like the
+        reference (schema.ElevenLabsSoundGenerationRequest: text, model_id,
+        duration_seconds, prompt_influence, do_sample); served by a MusicGen
+        engine when one resolves, with TTS synthesis as the fallback for
+        voice-only deployments."""
+        from localai_tpu.audio import write_wav
+
+        body = dict(req.body or {})
+        text = body.get("text") or body.get("input")
+        if not text or not isinstance(text, str):
+            raise ApiError(400, "text is required")
+        fmt = (body.get("response_format") or "wav").lower()
+        if fmt not in ("wav", "pcm"):
+            raise ApiError(400, f"response_format {fmt!r} not supported (wav, pcm)")
+        if body.get("model_id") and not body.get("model"):
+            body["model"] = body["model_id"]
+        seed = body.get("seed")
+        if seed is not None:
+            try:
+                seed = int(seed)
+            except (TypeError, ValueError):
+                raise ApiError(400, "seed must be an integer") from None
+        duration = body.get("duration_seconds")
+        if duration is None:
+            duration = body.get("duration")
+        patched = Request(
+            method=req.method, path=req.path, params=req.params,
+            query=req.query, headers=req.headers, body=body,
+        )
+        lm, lease = self._base._resolve(patched, Usecase.SOUND_GENERATION)
+        try:
+            if hasattr(lm.engine, "generate_sound"):
+                # The reference's python backend maps `temperature` onto
+                # MusicGen's guidance scale (transformers backend.py:527-529);
+                # prompt_influence is the elevenlabs field name for it.
+                guidance = body.get("prompt_influence", body.get("temperature"))
+                try:
+                    samples, sr = lm.engine.generate_sound(
+                        text,
+                        duration_s=None if duration is None else float(duration),
+                        do_sample=bool(body.get("do_sample", True)),
+                        guidance_scale=None if guidance is None else float(guidance),
+                        seed=seed,
+                    )
+                except ValueError as e:
+                    raise ApiError(400, str(e)) from None
+            else:
+                samples, sr = lm.engine.synthesize(text, voice=body.get("voice"))
+        finally:
+            lease.release()
+        if fmt == "pcm":
+            pcm16 = (np.clip(samples, -1, 1) * 32767.0).astype(np.int16)
+            return Response(body=pcm16.tobytes(), content_type="audio/pcm",
+                            headers={"X-Sample-Rate": str(sr)})
+        return Response(body=write_wav(samples, sr), content_type="audio/wav")
 
     # ------------------------------------------------------------------ #
     # VAD

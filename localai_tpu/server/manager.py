@@ -348,6 +348,9 @@ class ModelManager:
             "diffusers": self._load_diffusion,
             "stablediffusion": self._load_diffusion,
             "detection": self._load_detection,
+            "musicgen": self._load_musicgen,
+            "soundgen": self._load_musicgen,
+            "sound-generation": self._load_musicgen,
             "remote": self._load_remote,
             "subprocess": self._load_subprocess,
             "bert": self._load_bert,
@@ -574,8 +577,13 @@ class ModelManager:
                 raise FileNotFoundError(
                     f"model {cfg.name!r}: tts checkpoint {ckpt_dir!r} not found"
                 )
+            from localai_tpu.models import musicgen as MG
             from localai_tpu.models import vits as V
 
+            if MG.is_musicgen_dir(ckpt_dir):
+                # A MusicGen checkpoint configured under the tts/soundgen
+                # usecase — route to the sound-generation engine.
+                return self._load_musicgen(cfg)
             if V.is_vits_dir(ckpt_dir):
                 # Real published voice (facebook/mms-tts-*, vits-ljs) in the
                 # HF VITS layout — the neural path; Griffin-Lim stays the
@@ -590,6 +598,34 @@ class ModelManager:
                 )
             tcfg, params = T.load_tts(ckpt_dir)
         return LoadedModel(cfg, TTSEngine(tcfg, params, voices=cfg.options.get("voices")), None)
+
+    def _load_musicgen(self, cfg: ModelConfig) -> LoadedModel:
+        """Text-to-music (SoundGeneration): published MusicGen checkpoints
+        (reference: backend/python/transformers/backend.py:489-539)."""
+        import os
+
+        from localai_tpu.engine.audio_engine import MusicgenEngine
+        from localai_tpu.models import musicgen as MG
+
+        ckpt_dir = self._resolve_ckpt_dir(cfg.model)
+        if not os.path.isdir(ckpt_dir):
+            raise FileNotFoundError(
+                f"model {cfg.name!r}: musicgen checkpoint {ckpt_dir!r} not found"
+            )
+        if not MG.is_musicgen_dir(ckpt_dir):
+            raise ValueError(
+                f"model {cfg.name!r}: {ckpt_dir!r} is not a musicgen checkpoint "
+                "(config.json model_type must be 'musicgen')"
+            )
+        if not _has_tokenizer_files(ckpt_dir):
+            raise FileNotFoundError(
+                f"model {cfg.name!r}: musicgen checkpoint {ckpt_dir!r} has no "
+                "text tokenizer files (tokenizer.json / tokenizer_config.json)"
+            )
+        from localai_tpu.engine.tokenizer import HFTokenizer
+
+        mcfg, params = MG.load_musicgen(ckpt_dir)
+        return LoadedModel(cfg, MusicgenEngine(mcfg, params, HFTokenizer(ckpt_dir)), None)
 
     def _load_vad(self, cfg: ModelConfig) -> LoadedModel:
         import os
