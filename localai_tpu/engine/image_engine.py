@@ -257,6 +257,8 @@ class DiffusionEngine:
         steps: int = 12,
         seed: Optional[int] = None,
         guidance: float = 4.0,
+        negative_prompt: str = "",  # accepted for API parity; own-format
+        # checkpoints have no text encoder to condition negatively on
     ) -> list[np.ndarray]:
         """Frame sequence: one batched diffusion over n_frames with the seed
         noise spherically interpolated between two endpoints, giving a smooth
@@ -505,7 +507,8 @@ class LatentDiffusionEngine:
         ])
         return self.generate(
             prompt, n=n_frames, steps=steps, seed=seed, guidance=guidance,
-            size=(s, s), scheduler="ddim", _init_noise=frames_noise,
+            negative_prompt=negative_prompt, size=(s, s), scheduler="ddim",
+            _init_noise=frames_noise,
         )
 
     def _generate_video_motion(
@@ -521,7 +524,11 @@ class LatentDiffusionEngine:
 
         t0 = time.monotonic()
         mcfg, mparams = self.motion
-        n_frames = min(n_frames, mcfg.max_seq_length)
+        if n_frames > mcfg.max_seq_length:
+            raise ValueError(
+                f"n_frames={n_frames} exceeds the motion adapter's trained "
+                f"window ({mcfg.max_seq_length} frames)"
+            )
         s = self._native_size()
         cond = self._ids(prompt, 1)
         uncond = self._ids(negative_prompt or "", 1)

@@ -164,7 +164,11 @@ class ImageApi:
         try:
             frames = lm.engine.generate_video(
                 prompt, n_frames=n_frames, steps=steps, seed=body.get("seed"),
+                negative_prompt=str(body.get("negative_prompt") or ""),
             )
+        except ValueError as e:
+            # e.g. n_frames beyond the motion adapter's trained window
+            raise ApiError(400, str(e)) from None
         finally:
             lease.release()
 
