@@ -157,7 +157,15 @@ class CharDFA:
 
 def compile_schema_dfa(schema: Any, max_states: int = 3072,
                        max_ws_run: int = 1) -> CharDFA:
-    """BFS over reachable machine configurations → char-class DFA."""
+    """BFS over reachable machine configurations → char-class DFA.
+
+    A `{"__gbnf__": <text>}` marker (functions/gbnf.py GbnfConstraint.schema)
+    routes to the GBNF machine's compiler — raw grammars ride the same
+    token-table path and cache as JSON schemas."""
+    if isinstance(schema, dict) and "__gbnf__" in schema:
+        from localai_tpu.functions.gbnf import compile_gbnf_dfa
+
+        return compile_gbnf_dfa(schema["__gbnf__"], max_states=max_states)
     extra = sorted({ch for s in _schema_strings(schema) for ch in s
                     if ord(ch) > 0x7E})
     reps = _PRINTABLE + ["\t", "\n", "\r", _CTRL_REP] + extra + [_OTHER_REP]

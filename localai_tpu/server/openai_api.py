@@ -362,6 +362,23 @@ class OpenAIApi:
         elif rf.get("type") == "json_schema":
             schema = (rf.get("json_schema") or {}).get("schema") or {}
             make_grammar = lambda: GrammarConstraint(schema)
+        # Raw GBNF grammar (reference: backend.proto:139 `Grammar` forwarded
+        # verbatim to llama.cpp; pkg/functions/grammars authors the same
+        # format). Takes precedence over response_format, like the reference
+        # passes an explicit grammar through untouched.
+        gbnf_text = body.get("grammar")
+        if isinstance(gbnf_text, str) and gbnf_text.strip():
+            from localai_tpu.functions.gbnf import (
+                CompiledGrammar,
+                GbnfConstraint,
+                GbnfParseError,
+            )
+
+            try:
+                compiled = CompiledGrammar(gbnf_text)
+            except GbnfParseError as e:
+                raise ApiError(400, f"invalid grammar: {e}") from None
+            make_grammar = lambda: GbnfConstraint(compiled)
         if tools and (tool_choice == "required" or isinstance(tool_choice, dict)):
             selected = tools
             if isinstance(tool_choice, dict):
@@ -583,6 +600,23 @@ class OpenAIApi:
         n = self._n_choices(body)
         lp_n = self._completion_lp(body)
 
+        # Raw GBNF grammar on completions too (the reference's Grammar field
+        # rides PredictOptions for every text endpoint).
+        make_grammar: Optional[Callable[[], Any]] = None
+        gbnf_text = body.get("grammar")
+        if isinstance(gbnf_text, str) and gbnf_text.strip():
+            from localai_tpu.functions.gbnf import (
+                CompiledGrammar,
+                GbnfConstraint,
+                GbnfParseError,
+            )
+
+            try:
+                compiled = CompiledGrammar(gbnf_text)
+            except GbnfParseError as e:
+                raise ApiError(400, f"invalid grammar: {e}") from None
+            make_grammar = lambda: GbnfConstraint(compiled)
+
         # One GenRequest per (prompt, choice): all submitted up front so free
         # slots run them concurrently (multi-prompt requests previously ran
         # serially — VERDICT weak #7).
@@ -594,6 +628,7 @@ class OpenAIApi:
             ids = lm.engine.tokenizer.encode(templated, add_bos=True)
             for j in range(n):
                 g = self._gen_request(lm, body, ids)
+                g.grammar = make_grammar() if make_grammar else None
                 g.logprobs = lp_n
                 if g.seed is not None and n > 1:
                     g.seed = int(g.seed) + j

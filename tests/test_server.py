@@ -84,6 +84,37 @@ def test_chat_completion(api):
     assert "timing_prompt_processing" in out["usage"]
 
 
+def test_chat_raw_gbnf_grammar(api):
+    """A raw GBNF `grammar` string constrains chat output (reference:
+    backend.proto:139 Grammar forwarded verbatim to llama.cpp)."""
+    from localai_tpu.functions.gbnf import CompiledGrammar, initial_state, step_state
+
+    gram = 'root ::= ("yes" | "no") "!"'
+    out = _post(base := api[0], "/v1/chat/completions", {
+        "model": "tiny-chat",
+        "messages": [{"role": "user", "content": "answer"}],
+        "max_tokens": 16, "grammar": gram, "temperature": 0.0,
+    })
+    text = out["choices"][0]["message"]["content"]
+    g = CompiledGrammar(gram)
+    st = initial_state(g)
+    for ch in text:
+        st = step_state(g, st, ch)
+        assert st, f"output {text!r} violates the grammar at {ch!r}"
+    if out["choices"][0]["finish_reason"] == "stop":
+        assert text in ("yes!", "no!")
+
+    # malformed grammar → 400, not a server error
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(base, "/v1/chat/completions", {
+            "model": "tiny-chat", "grammar": 'root ::= "x',
+            "messages": [{"role": "user", "content": "hi"}], "max_tokens": 4,
+        })
+    assert ei.value.code == 400
+
+
 def test_chat_default_model(api):
     base, _ = api
     out = _post(base, "/v1/chat/completions", {
