@@ -1849,6 +1849,14 @@ class Engine:
         if os.environ.get("LOCALAI_GRAMMAR_DFA", "1") == "0":
             return None
         schema = getattr(request.grammar, "schema", None)
+        if isinstance(schema, dict) and "__gbnf__" in schema:
+            # Only a GbnfConstraint may carry the GBNF marker: a USER JSON
+            # schema containing that key would compile a GBNF DFA on device
+            # while the host walk runs the JSON machine — desynced masks.
+            from localai_tpu.functions.gbnf import GbnfConstraint
+
+            if not isinstance(request.grammar, GbnfConstraint):
+                return None
         from localai_tpu.functions import dfa as dfa_mod
 
         key = dfa_mod.schema_key(schema)

@@ -427,6 +427,19 @@ def tables_for(schema: Any, tok_strs: list[str], eos_ids: set[int],
                 while len(_FAILED) > _FAILED_MAX:
                     _FAILED.pop(next(iter(_FAILED)))
             return None
+        except Exception:  # noqa: BLE001 — a compiler bug on one input must
+            # not leave the key permanently "building": record the failure so
+            # the engine stops respawning doomed background builds for it.
+            with _LOCK:
+                _FAILED[key] = True
+                while len(_FAILED) > _FAILED_MAX:
+                    _FAILED.pop(next(iter(_FAILED)))
+            import logging
+
+            logging.getLogger("localai_tpu.dfa").exception(
+                "grammar DFA build failed unexpectedly"
+            )
+            return None
         with _LOCK:
             _CACHE[key] = tables
             if pin:

@@ -115,7 +115,10 @@ class _Parser:
             if len(hexs) != n:
                 raise GbnfParseError(f"bad \\{e} escape")
             self.pos += n
-            return int(hexs, 16)
+            cp = int(hexs, 16)
+            if cp > 0x10FFFF:
+                raise GbnfParseError(f"\\{e}{hexs} is beyond U+10FFFF")
+            return cp
         raise GbnfParseError(f"unknown escape \\{e}")
 
     # -- grammar productions ----------------------------------------------- #
@@ -126,7 +129,9 @@ class _Parser:
             name = self._name()
             self._ws(False)
             self._expect("::=")
-            self._ws(False)
+            # llama.cpp allows the rule body to start on the next line
+            # (parse_space after "::=" has newline_ok=true).
+            self._ws(True)
             alts = self._alternates(name)
             if name in self.rules:
                 raise GbnfParseError(f"duplicate rule {name!r}")
@@ -321,20 +326,25 @@ class CompiledGrammar:
                     edges[rid].add(e[1])
                     if not nullable[e[1]]:
                         break
-        state = [0] * len(self.rules)  # 0 unvisited, 1 in-stack, 2 done
-
-        def dfs(r: int) -> None:
-            state[r] = 1
-            for s in edges[r]:
-                if state[s] == 1:
+        # Iterative cycle check (user-supplied rule chains must not be able
+        # to blow the Python stack): 0 unvisited, 1 in-stack, 2 done.
+        state = [0] * len(self.rules)
+        for r0 in range(len(self.rules)):
+            if state[r0]:
+                continue
+            work: list[tuple[int, Any]] = [(r0, iter(edges[r0]))]
+            state[r0] = 1
+            while work:
+                r, it = work[-1]
+                nxt = next(it, None)
+                if nxt is None:
+                    state[r] = 2
+                    work.pop()
+                elif state[nxt] == 1:
                     raise GbnfParseError("left-recursive grammar is not supported")
-                if state[s] == 0:
-                    dfs(s)
-            state[r] = 2
-
-        for r in range(len(self.rules)):
-            if state[r] == 0:
-                dfs(r)
+                elif state[nxt] == 0:
+                    state[nxt] = 1
+                    work.append((nxt, iter(edges[nxt])))
 
 
 # --------------------------------------------------------------------------- #
@@ -350,18 +360,21 @@ def _match(elem: tuple, cp: int) -> bool:
 
 def _expand(g: CompiledGrammar, stack: tuple, out: set, seen: set) -> None:
     """Resolve leading rule refs until the top element is a char matcher (or
-    the stack is empty). Branches into one stack per viable alternate."""
-    if not stack or stack[0][0] == "c":
-        if len(stack) <= MAX_STACK_DEPTH:
-            out.add(stack)
-        return
-    if stack in seen:
-        return  # ε-cycle (e.g. r ::= s, s ::= r): already being expanded
-    seen.add(stack)
-    rid = stack[0][1]
-    rest = stack[1:]
-    for alt in g.rules[rid]:
-        _expand(g, alt + rest, out, seen)
+    the stack is empty). Branches into one stack per viable alternate.
+    Iterative: grammar depth must not be able to blow the Python stack."""
+    work = [stack]
+    while work:
+        st = work.pop()
+        if not st or st[0][0] == "c":
+            if len(st) <= MAX_STACK_DEPTH:
+                out.add(st)
+            continue
+        if st in seen:
+            continue  # ε-cycle (e.g. r ::= s, s ::= r): already expanding
+        seen.add(st)
+        rest = st[1:]
+        for alt in g.rules[st[0][1]]:
+            work.append(alt + rest)
 
 
 def initial_state(g: CompiledGrammar) -> frozenset:

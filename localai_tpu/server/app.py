@@ -217,7 +217,12 @@ def create_server(app_cfg: ApplicationConfig, router: Router) -> ThreadingHTTPSe
             # Constant-time compare over bytes (reference: auth.go constant-
             # time option); bytes form tolerates non-ASCII header values.
             tb = token.encode("utf-8", "surrogateescape")
-            return any(hmac.compare_digest(tb, k.encode()) for k in app_cfg.api_keys)
+            if any(hmac.compare_digest(tb, k.encode()) for k in app_cfg.api_keys):
+                return True
+            # Minted realtime client secrets admit realtime paths only
+            # (RealtimeApi attaches the registry at route registration).
+            eph = getattr(router, "ephemeral_keys", None)
+            return eph is not None and eph.valid(token, path)
 
         def _common_headers(self) -> dict[str, str]:
             h = {}

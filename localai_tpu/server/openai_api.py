@@ -339,6 +339,25 @@ class OpenAIApi:
             lease.release()  # idempotent — safe even if the inner path released
             raise
 
+    @staticmethod
+    def _gbnf_factory(body: dict[str, Any]) -> Optional[Callable[[], Any]]:
+        """Factory for a raw `grammar` (GBNF) body field, or None. Malformed
+        grammars — including pathological depth — are a 400, not a 500."""
+        gbnf_text = body.get("grammar")
+        if not (isinstance(gbnf_text, str) and gbnf_text.strip()):
+            return None
+        from localai_tpu.functions.gbnf import (
+            CompiledGrammar,
+            GbnfConstraint,
+            GbnfParseError,
+        )
+
+        try:
+            compiled = CompiledGrammar(gbnf_text)
+        except (GbnfParseError, RecursionError, MemoryError) as e:
+            raise ApiError(400, f"invalid grammar: {e}") from None
+        return lambda: GbnfConstraint(compiled)
+
     def _chat_inner(self, req: Request, lm: LoadedModel, lease, body: dict[str, Any]) -> Response | SSEStream:
         from localai_tpu.functions import tools_prompt_for, parse_function_calls
         from localai_tpu.functions.jsonschema import GrammarConstraint, tool_call_schema
@@ -362,23 +381,6 @@ class OpenAIApi:
         elif rf.get("type") == "json_schema":
             schema = (rf.get("json_schema") or {}).get("schema") or {}
             make_grammar = lambda: GrammarConstraint(schema)
-        # Raw GBNF grammar (reference: backend.proto:139 `Grammar` forwarded
-        # verbatim to llama.cpp; pkg/functions/grammars authors the same
-        # format). Takes precedence over response_format, like the reference
-        # passes an explicit grammar through untouched.
-        gbnf_text = body.get("grammar")
-        if isinstance(gbnf_text, str) and gbnf_text.strip():
-            from localai_tpu.functions.gbnf import (
-                CompiledGrammar,
-                GbnfConstraint,
-                GbnfParseError,
-            )
-
-            try:
-                compiled = CompiledGrammar(gbnf_text)
-            except GbnfParseError as e:
-                raise ApiError(400, f"invalid grammar: {e}") from None
-            make_grammar = lambda: GbnfConstraint(compiled)
         if tools and (tool_choice == "required" or isinstance(tool_choice, dict)):
             selected = tools
             if isinstance(tool_choice, dict):
@@ -388,6 +390,11 @@ class OpenAIApi:
                     raise ApiError(400, f"tool_choice names unknown function {fname!r}")
                 selected = named
             make_grammar = lambda: GrammarConstraint(tool_call_schema(selected))
+        # Raw GBNF grammar (reference: backend.proto:139 `Grammar` forwarded
+        # verbatim to llama.cpp). Checked LAST: an explicit grammar takes
+        # precedence over response_format AND tool_choice, like the
+        # reference passes an explicit grammar through untouched.
+        make_grammar = self._gbnf_factory(body) or make_grammar
 
         prompt = lm.evaluator.template_messages(body["messages"], tools_prompt=tprompt)
         add_bos = not lm.cfg.template.use_tokenizer_template
@@ -602,20 +609,7 @@ class OpenAIApi:
 
         # Raw GBNF grammar on completions too (the reference's Grammar field
         # rides PredictOptions for every text endpoint).
-        make_grammar: Optional[Callable[[], Any]] = None
-        gbnf_text = body.get("grammar")
-        if isinstance(gbnf_text, str) and gbnf_text.strip():
-            from localai_tpu.functions.gbnf import (
-                CompiledGrammar,
-                GbnfConstraint,
-                GbnfParseError,
-            )
-
-            try:
-                compiled = CompiledGrammar(gbnf_text)
-            except GbnfParseError as e:
-                raise ApiError(400, f"invalid grammar: {e}") from None
-            make_grammar = lambda: GbnfConstraint(compiled)
+        make_grammar = self._gbnf_factory(body)
 
         # One GenRequest per (prompt, choice): all submitted up front so free
         # slots run them concurrently (multi-prompt requests previously ran

@@ -54,6 +54,8 @@ class RealtimeSession:
             # automatic turn detection (reference: realtime.go server VAD
             # via silero; here audio/vad.py energy detection).
             "turn_detection": None,
+            "transcription_model": None,
+            "tts_model": None,
         }
         self.conversation: list[dict[str, str]] = []
         self.audio_buffer = bytearray()
@@ -270,13 +272,79 @@ class RealtimeSession:
         ws.send_json({"type": "response.audio.done", "response_id": resp_id})
 
 
+class EphemeralKeys:
+    """Short-lived client secrets for realtime connects.
+
+    POST /v1/realtime/sessions mints one; the WS handshake (and nothing
+    else) accepts it as a bearer token. The reference stubs this endpoint
+    with a 501 (realtime.go:185-189); OpenAI's real contract returns a
+    session object whose client_secret.value expires in ~60 s — that is
+    what browsers need to connect without the server API key.
+    """
+
+    TTL_S = 60.0
+    # Exactly the WS connect path: admitting /v1/realtime/sessions would let
+    # an ephemeral secret mint its own replacement forever.
+    WS_PATH = "/v1/realtime"
+
+    def __init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+        self._keys: dict[str, tuple[float, dict]] = {}  # secret -> (expiry, session cfg)
+
+    def mint(self, session_cfg: dict) -> tuple[str, int]:
+        import secrets
+        import time
+
+        value = "ek_" + secrets.token_hex(16)
+        expires = time.time() + self.TTL_S
+        with self._lock:
+            now = time.time()
+            for k in [k for k, (exp, _) in self._keys.items() if exp < now]:
+                del self._keys[k]
+            self._keys[value] = (expires, session_cfg)
+        return value, int(expires)
+
+    def valid(self, token: str, path: str) -> bool:
+        """Auth-hook: a live ephemeral key admits the WS connect only."""
+        import time
+
+        if path != self.WS_PATH:
+            return False
+        with self._lock:
+            entry = self._keys.get(token)
+            return entry is not None and entry[0] >= time.time()
+
+    def session_for(self, token: str) -> Optional[dict]:
+        import time
+
+        with self._lock:
+            entry = self._keys.get(token)
+            if entry is None or entry[0] < time.time():
+                return None
+            return entry[1]
+
+
 class RealtimeApi:
     def __init__(self, manager: ModelManager, base: OpenAIApi):
         self.manager = manager
         self._base = base
+        self.ephemeral = EphemeralKeys()
 
     def register(self, r: Router) -> None:
         r.add("GET", "/v1/realtime", self.realtime)
+        # REST session endpoints (reference routes openai.go:21-22; its
+        # handler is a 501 stub — this is the real OpenAI contract).
+        r.add("POST", "/v1/realtime/sessions", self.create_session)
+        r.add("POST", "/v1/realtime/transcription_session",
+              self.create_transcription_session)
+        # OpenAI's documented path is the plural form; the reference
+        # registers the singular (openai.go:22) — serve both.
+        r.add("POST", "/v1/realtime/transcription_sessions",
+              self.create_transcription_session)
+        # create_server's auth consults this for realtime-scoped bearers.
+        r.ephemeral_keys = self.ephemeral
 
     def _lease(self, usecase: Usecase, name: Optional[str]):
         if not name:
@@ -286,7 +354,43 @@ class RealtimeApi:
             name = cfg.name
         return self.manager.lease(name)
 
+    def _mint_session(self, body: dict, obj: str) -> "Response":
+        from localai_tpu.server.app import Response
+
+        template = RealtimeSession(self, body.get("model"))
+        for k, v in (body or {}).items():
+            if k in template.config and k != "id":
+                template.config[k] = v
+        secret, expires_at = self.ephemeral.mint(dict(template.config))
+        session = dict(template.config)
+        session["object"] = obj
+        session["client_secret"] = {"value": secret, "expires_at": expires_at}
+        return Response(body=session)
+
+    def create_session(self, req: Request) -> "Response":
+        return self._mint_session(req.body or {}, "realtime.session")
+
+    def create_transcription_session(self, req: Request) -> "Response":
+        body = dict(req.body or {})
+        # transcription sessions carry the STT model in input_audio_transcription
+        iat = body.get("input_audio_transcription") or {}
+        if iat.get("model"):
+            body["transcription_model"] = iat["model"]
+        resp = self._mint_session(body, "realtime.transcription_session")
+        resp.body["input_audio_transcription"] = iat or {"model": ""}
+        return resp
+
     def realtime(self, req: Request) -> WebSocketUpgrade:
         model = (req.query.get("model") or [None])[0]
         session = RealtimeSession(self, model)
+        # A connect with a minted client_secret resumes its session config.
+        header = req.headers.get("authorization", "") or req.headers.get("Authorization", "")
+        token = header[7:] if header.startswith("Bearer ") else header
+        stored = self.ephemeral.session_for(token) if token else None
+        if stored:
+            sid = session.config["id"]
+            session.config.update(stored)
+            session.config["id"] = sid if not stored.get("id") else stored["id"]
+            if model:
+                session.config["model"] = model
         return WebSocketUpgrade(session.run)

@@ -135,9 +135,28 @@ def test_parse_errors():
         'root ::= "x" {2,1}',
         'root ::= root "x" | "y"',      # left recursion
         'root ::= other\nother ::= other "a" | "b"',  # indirect left rec
+        r'root ::= [\U00110000-\U0011FFFF]',  # beyond U+10FFFF
     ):
         with pytest.raises(GbnfParseError):
             CompiledGrammar(bad)
+
+
+def test_rule_body_may_start_on_next_line():
+    """llama.cpp's shipped grammars (json.gbnf) put the body after a
+    newline — parse_space after '::=' has newline_ok=true."""
+    g = CompiledGrammar('root ::=\n  "a" | "b"\nother ::= "c"')
+    assert accepts(g, "a") and accepts(g, "b") and not accepts(g, "c")
+
+
+def test_deep_rule_chain_is_not_a_crash():
+    """A long (non-left-recursive) rule chain must parse and run without
+    hitting Python's recursion limit (500s on user input otherwise)."""
+    n = 3000
+    lines = ["root ::= r0"]
+    lines += [f"r{i} ::= r{i + 1}" for i in range(n - 1)]
+    lines += [f"r{n - 1} ::= \"x\""]
+    g = CompiledGrammar("\n".join(lines))
+    assert accepts(g, "x") and not prefix_ok(g, "y")
 
 
 def test_arithmetic_grammar_semantics():

@@ -21,18 +21,23 @@ _GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 
 
 class WSClient:
-    def __init__(self, host: str, port: int, path: str):
+    def __init__(self, host: str, port: int, path: str,
+                 headers: dict | None = None, expect_status: str = "101"):
         self.sock = socket.create_connection((host, port), timeout=120)
         key = base64.b64encode(os.urandom(16)).decode()
+        extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
         req = (
             f"GET {path} HTTP/1.1\r\nHost: {host}:{port}\r\n"
             "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"{extra}"
             f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
         )
         self.sock.sendall(req.encode())
         self.f = self.sock.makefile("rb")
         status = self.f.readline().decode()
-        assert "101" in status, f"unexpected status: {status}"
+        assert expect_status in status, f"unexpected status: {status}"
+        if expect_status != "101":
+            return
         accept = None
         while True:
             line = self.f.readline().decode().strip()
@@ -355,6 +360,131 @@ def test_server_vad_uses_learned_model_when_configured(tmp_path):
         lm = manager.peek("myvad")
         assert lm is not None and lm.engine.vad_cfg is not None
         assert lm.engine.m_requests > 0, "learned VAD was never consulted"
+    finally:
+        server.shutdown()
+        manager.shutdown()
+
+
+# --------------------------------------------------------------------------- #
+# REST session endpoints (reference routes openai.go:21-22 — its handler is a
+# 501 stub; here the real OpenAI contract: session object + ephemeral
+# client_secret that authorizes the WS connect and nothing else)
+# --------------------------------------------------------------------------- #
+
+
+def _post_json(host, port, path, payload, token=None):
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json",
+                 **({"Authorization": f"Bearer {token}"} if token else {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read()), r.status
+
+
+def test_rest_session_minting(rt_server):
+    host, port = rt_server
+    body, status = _post_json(host, port, "/v1/realtime/sessions", {
+        "model": "chat", "voice": "alloy", "instructions": "be brief",
+        "turn_detection": {"type": "server_vad", "silence_duration_ms": 400},
+    })
+    assert status == 200
+    assert body["object"] == "realtime.session"
+    assert body["model"] == "chat" and body["voice"] == "alloy"
+    secret = body["client_secret"]
+    assert secret["value"].startswith("ek_")
+    import time
+
+    assert secret["expires_at"] > time.time()
+
+    tbody, _ = _post_json(host, port, "/v1/realtime/transcription_session", {
+        "input_audio_transcription": {"model": "stt"},
+    })
+    assert tbody["object"] == "realtime.transcription_session"
+    assert tbody["input_audio_transcription"]["model"] == "stt"
+    assert tbody["transcription_model"] == "stt"
+
+
+def test_session_secret_seeds_ws_config(rt_server):
+    host, port = rt_server
+    body, _ = _post_json(host, port, "/v1/realtime/sessions", {
+        "instructions": "minted-instructions", "temperature": 0.3,
+    })
+    token = body["client_secret"]["value"]
+    ws = WSClient(host, port, "/v1/realtime",
+                  headers={"Authorization": f"Bearer {token}"})
+    try:
+        created = ws.recv_json()
+        assert created["type"] == "session.created"
+        assert created["session"]["instructions"] == "minted-instructions"
+        assert created["session"]["temperature"] == 0.3
+    finally:
+        ws.close()
+
+
+def test_ephemeral_secret_scope_under_api_keys(tmp_path):
+    """With server API keys set: minting requires the real key, the minted
+    secret opens the WS, and the secret is rejected everywhere else."""
+    import urllib.error
+    import urllib.request
+
+    from localai_tpu.config import ApplicationConfig
+    from localai_tpu.server import ModelManager, Router, create_server
+    from localai_tpu.server.openai_api import OpenAIApi
+    from localai_tpu.server.realtime_api import RealtimeApi
+
+    (tmp_path / "chat.yaml").write_text(yaml.safe_dump({
+        "name": "chat", "model": "tiny", "context_size": 128,
+        "max_tokens": 4, "template": {"family": "chatml"},
+    }))
+    app_cfg = ApplicationConfig(address="127.0.0.1", port=0,
+                                models_dir=str(tmp_path), api_keys=["sekret"])
+    manager = ModelManager(app_cfg)
+    router = Router()
+    oai = OpenAIApi(manager)
+    oai.register(router)
+    RealtimeApi(manager, oai).register(router)
+    server = create_server(app_cfg, router)
+    host, port = server.server_address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        # minting without the API key → 401
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_json(host, port, "/v1/realtime/sessions", {})
+        assert ei.value.code == 401
+
+        body, _ = _post_json(host, port, "/v1/realtime/sessions", {},
+                             token="sekret")
+        secret = body["client_secret"]["value"]
+
+        # the minted secret opens the realtime WS...
+        ws = WSClient(host, port, "/v1/realtime",
+                      headers={"Authorization": f"Bearer {secret}"})
+        assert ws.recv_json()["type"] == "session.created"
+        ws.close()
+
+        # ...but is rejected on every other route
+        req = urllib.request.Request(
+            f"http://{host}:{port}/v1/models",
+            headers={"Authorization": f"Bearer {secret}"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 401
+
+        # ...including the mint endpoints: an ephemeral secret must not be
+        # able to mint its own replacement (infinite self-renewal)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_json(host, port, "/v1/realtime/sessions", {}, token=secret)
+        assert ei.value.code == 401
+
+        # and a bogus ek_ token does not open the WS
+        WSClient(host, port, "/v1/realtime",
+                 headers={"Authorization": "Bearer ek_bogus"},
+                 expect_status="401")
     finally:
         server.shutdown()
         manager.shutdown()
