@@ -421,24 +421,19 @@ def tables_for(schema: Any, tok_strs: list[str], eos_ids: set[int],
         try:
             dfa = compile_schema_dfa(schema, max_states=max_states)
             tables = build_token_tables(dfa, tok_strs, eos_ids, vocab_size)
-        except DfaUnsupported:
-            with _LOCK:
-                _FAILED[key] = True
-                while len(_FAILED) > _FAILED_MAX:
-                    _FAILED.pop(next(iter(_FAILED)))
-            return None
-        except Exception:  # noqa: BLE001 — a compiler bug on one input must
-            # not leave the key permanently "building": record the failure so
-            # the engine stops respawning doomed background builds for it.
-            with _LOCK:
-                _FAILED[key] = True
-                while len(_FAILED) > _FAILED_MAX:
-                    _FAILED.pop(next(iter(_FAILED)))
-            import logging
+        except Exception as ex:  # noqa: BLE001 — any build failure (incl. a
+            # compiler bug on one input) must record the key as failed, or
+            # the engine respawns doomed background builds for it forever.
+            if not isinstance(ex, DfaUnsupported):
+                import logging
 
-            logging.getLogger("localai_tpu.dfa").exception(
-                "grammar DFA build failed unexpectedly"
-            )
+                logging.getLogger("localai_tpu.dfa").exception(
+                    "grammar DFA build failed unexpectedly"
+                )
+            with _LOCK:
+                _FAILED[key] = True
+                while len(_FAILED) > _FAILED_MAX:
+                    _FAILED.pop(next(iter(_FAILED)))
             return None
         with _LOCK:
             _CACHE[key] = tables

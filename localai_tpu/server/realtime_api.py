@@ -388,9 +388,7 @@ class RealtimeApi:
         token = header[7:] if header.startswith("Bearer ") else header
         stored = self.ephemeral.session_for(token) if token else None
         if stored:
-            sid = session.config["id"]
-            session.config.update(stored)
-            session.config["id"] = sid if not stored.get("id") else stored["id"]
+            session.config.update(stored)  # minted configs carry their own id
             if model:
                 session.config["model"] = model
         return WebSocketUpgrade(session.run)

@@ -275,3 +275,35 @@ def test_sp_sharded_kv_cache(devices8):
     finally:
         eng_d.stop()
     assert text_sp == text_d
+
+
+def test_kv_windowed_blocks_bit_match_full():
+    """The read-side KV window (kv_win buckets) must not change output: a
+    max_seq big enough to trigger windowing produces the same greedy tokens
+    as a window-disabled engine, and the windowed program is actually used."""
+    cfg = get_arch("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    prompt = [7, 11, 13] * 20  # plen 60; block 64: positions stay < 256
+
+    def run(min_win):
+        eng = Engine(
+            cfg, params, ByteTokenizer(cfg.vocab_size),
+            engine_cfg=EngineConfig(max_slots=2, max_seq=1024,
+                                    min_prefill_bucket=16),
+        )
+        eng._KV_WIN_MIN = min_win
+        eng.start()
+        try:
+            text, ev = eng.generate(prompt, max_new_tokens=80, ignore_eos=True)
+            keys = list(eng._block_cache.keys())
+        finally:
+            eng.stop()
+        return text, ev, keys
+
+    # min_win 2048 > max_seq → every bucket search lands at full cache
+    text_full, ev_full, keys_full = run(2048)
+    assert all(k[4] is None for k in keys_full)
+    text_win, ev_win, keys_win = run(256)
+    assert any(k[4] == 256 for k in keys_win), "windowed program never ran"
+    assert text_win == text_full
+    assert ev_win.completion_tokens == ev_full.completion_tokens == 80
