@@ -2486,6 +2486,7 @@ class Engine:
             variant = "filtered" if needs_filter else ("simple" if any_temp else "greedy")
             n = self._pick_block_size()
         with_dfa = self._dfa_mode() if self._dfa_grammar_active() else False
+        with_lp = self._lp_active()
 
         # Read-side KV window: smallest warmed bucket covering every ACTIVE
         # slot's current position (idle rows' reads are discarded, so any
@@ -2510,7 +2511,6 @@ class Engine:
             if w < self.ecfg.max_seq:
                 kv_win = w
 
-        with_lp = self._lp_active()
         # Stochastic verify keeps speculation exact for sampled requests too
         # (greedy degenerates to the old argmax-agreement test), so every
         # non-grammar, non-logprobs variant rides the draft model.
