@@ -148,7 +148,18 @@ def detect(
 def save_params(path: str, p: Params) -> None:
     from safetensors.numpy import save_file
 
-    save_file({k: np.asarray(v) for k, v in p.items()}, path)
+    # Host copies go through a jitted device-side flatten into a FRESH
+    # canonical buffer. On the tunneled-TPU platform, directly np.array-ing
+    # a jit-output buffer (which carries an XLA-chosen layout) intermittently
+    # serialized garbage for one tensor — a fresh default-layout buffer
+    # produced on device transfers correctly.
+    canon = jax.jit(lambda a: jnp.reshape(a, (-1,)))
+
+    def pull(v):
+        arr = jnp.asarray(v)
+        return np.array(canon(arr), copy=True).reshape(arr.shape)
+
+    save_file({k: pull(v) for k, v in p.items()}, path)
 
 
 def load_params(path: str) -> Params:
@@ -157,7 +168,11 @@ def load_params(path: str) -> Params:
     out: Params = {}
     with safe_open(path, framework="numpy") as f:
         for name in f.keys():
-            out[name] = jnp.asarray(f.get_tensor(name))
+            # copy=True: get_tensor returns a view into safetensors' own
+            # buffer; the runtime's h2d upload may be deferred past this
+            # context's exit, after which the view reads freed memory
+            # (observed as one tensor loading garbage).
+            out[name] = jnp.asarray(np.array(f.get_tensor(name), copy=True))
     return out
 
 
@@ -180,6 +195,15 @@ def find_weights(model_dir: str) -> Optional[str]:
         if os.path.isfile(path):
             return path
     return None
+
+
+def packaged_weights() -> Optional[str]:
+    """The in-tree pretrained artifact (assets/vad-base.safetensors), trained
+    offline by train_formant on the formant-synthesis corpus — the zero-
+    egress stand-in for silero's published weights. None if not shipped."""
+    path = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                        "assets", "vad-base.safetensors")
+    return path if os.path.isfile(path) else None
 
 
 def synth_batch(cfg: VadNetConfig, rng: np.random.Generator, n: int = 8,
@@ -215,13 +239,10 @@ def synth_batch(cfg: VadNetConfig, rng: np.random.Generator, n: int = 8,
     return mels, y
 
 
-def train_synthetic(cfg: VadNetConfig, steps: int = 120, seed: int = 0,
-                    lr: float = 3e-3) -> Params:
-    """Fit the net on synthetic speech/noise (offline substitute for the
-    silero training corpus). Returns trained params."""
+def _fit(cfg: VadNetConfig, make_batch, steps: int, seed: int, lr: float,
+         refresh_every: int) -> Params:
     import optax
 
-    rng = np.random.default_rng(seed)
     params = init_params(cfg, jax.random.key(seed))
     tx = optax.adam(lr)
     opt = tx.init(params)
@@ -239,9 +260,72 @@ def train_synthetic(cfg: VadNetConfig, steps: int = 120, seed: int = 0,
         updates, opt = tx.update(grads, opt, p)
         return optax.apply_updates(p, updates), opt, loss
 
-    mel, y = synth_batch(cfg, rng, n=16)
+    mel, y = make_batch()
     for i in range(steps):
-        if i % 30 == 29:  # refresh data to avoid memorizing one batch
-            mel, y = synth_batch(cfg, rng, n=16)
-        params, opt, loss = step(params, opt, mel, y)
+        if refresh_every and i % refresh_every == refresh_every - 1:
+            mel, y = make_batch()  # fresh data — don't memorize one batch
+        params, opt, _loss = step(params, opt, mel, y)
     return params
+
+
+def train_synthetic(cfg: VadNetConfig, steps: int = 120, seed: int = 0,
+                    lr: float = 3e-3) -> Params:
+    """Fit the net on quick synthetic speech/noise bursts (smoke-level; the
+    shipped artifact uses train_formant)."""
+    rng = np.random.default_rng(seed)
+    return _fit(cfg, lambda: synth_batch(cfg, rng, n=16), steps, seed, lr, 30)
+
+
+def frame_labels(ys: list, n_frames: int):
+    """Sample labels → per-mel-frame targets [B, n_frames]."""
+    from localai_tpu.audio.features import HOP
+
+    out = []
+    for label in ys:
+        frames = label[: (len(label) // HOP) * HOP].reshape(-1, HOP)
+        f = (frames.mean(axis=1) > 0.5).astype(np.float32)
+        out.append(f[:n_frames])
+    return jnp.asarray(np.stack(out))
+
+
+def train_formant(cfg: VadNetConfig, steps: int = 600, seed: int = 0,
+                  lr: float = 3e-3, batch_pos: int = 12, batch_neg: int = 6):
+    """Train on the formant-synthesis corpus (audio/formant_speech.py):
+    glottal-source + formant-resonator utterances with word-internal pauses,
+    mixed into white/pink/babble/hum noise at 0-30 dB SNR, against hard
+    negatives (tones, chords, mains hum, clicks). This is what the shipped
+    assets/vad-base.safetensors artifact was produced by."""
+    from localai_tpu.audio import formant_speech as FS
+
+    rng = np.random.default_rng(seed)
+
+    def make_batch():
+        xs, ys = FS.corpus_batch(rng, n_pos=batch_pos, n_neg=batch_neg)
+        mels = jnp.concatenate([features(x, cfg) for x in xs], axis=0)
+        y = frame_labels(ys, mels.shape[1])
+        return mels, y
+
+    return _fit(cfg, make_batch, steps, seed, lr, refresh_every=10)
+
+
+def evaluate(cfg: VadNetConfig, p: Params, seed: int = 999,
+             n_clips: int = 24) -> dict:
+    """Held-out frame metrics on fresh formant-corpus clips: returns
+    {"f1", "precision", "recall", "neg_fp_rate"}."""
+    from localai_tpu.audio import formant_speech as FS
+
+    rng = np.random.default_rng(seed)
+    xs, ys = FS.corpus_batch(rng, n_pos=n_clips, n_neg=n_clips // 2)
+    mels = jnp.concatenate([features(x, cfg) for x in xs], axis=0)
+    y = np.asarray(frame_labels(ys, mels.shape[1]))
+    probs = np.asarray(forward(cfg, p, mels))[:, : y.shape[1]]
+    pred = probs > 0.5
+    pos = y[:n_clips] > 0.5
+    tp = float((pred[:n_clips] & pos).sum())
+    fp = float((pred[:n_clips] & ~pos).sum())
+    fn = float((~pred[:n_clips] & pos).sum())
+    prec = tp / max(tp + fp, 1.0)
+    rec = tp / max(tp + fn, 1.0)
+    f1 = 2 * prec * rec / max(prec + rec, 1e-9)
+    neg_fp = float(pred[n_clips:].mean()) if len(pred) > n_clips else 0.0
+    return {"f1": f1, "precision": prec, "recall": rec, "neg_fp_rate": neg_fp}

@@ -632,9 +632,21 @@ class ModelManager:
 
         from localai_tpu.engine.audio_engine import VADEngine
 
+        from localai_tpu.audio import learned_vad as _LV
+
+        if cfg.model in ("", "builtin", "base", "vad-base", "silero"):
+            # Default: the shipped pretrained net (assets/vad-base.safetensors,
+            # trained offline on the formant-synthesis corpus — the silero
+            # role, reference vad.go:13-33). `model: energy` still selects
+            # the weightless detector explicitly.
+            packaged = _LV.packaged_weights()
+            if packaged is not None:
+                params = _LV.load_params(packaged)
+                return LoadedModel(
+                    cfg, VADEngine(_LV.config_from_params(params), params), None
+                )
         if cfg.model and cfg.model != "energy":
-            # `model: energy` explicitly selects the weightless detector;
-            # any other configured checkpoint that can't be found is an
+            # Any other configured checkpoint that can't be found is an
             # error, not a silent fall-through (same standard as the
             # tts/detection loaders above).
             ckpt_dir = self._resolve_ckpt_dir(cfg.model)

@@ -131,6 +131,18 @@ class RealtimeSession:
                 return [(d["start"], d["end"]) for d in lm.engine.detect(audio16, 16_000)]
             finally:
                 lease.release()
+        from localai_tpu.audio import learned_vad as LV
+
+        packaged = LV.packaged_weights()
+        if packaged is not None:
+            # No VAD model configured: the shipped pretrained net (silero
+            # role) still beats the energy heuristic for turn detection.
+            if not hasattr(self.api, "_builtin_vad"):
+                params = LV.load_params(packaged)
+                self.api._builtin_vad = (LV.config_from_params(params), params)
+            vcfg, params = self.api._builtin_vad
+            return [(s.start, s.end)
+                    for s in LV.detect(vcfg, params, audio16, 16_000)]
         from localai_tpu.audio.vad import energy_vad
 
         return [(s.start, s.end) for s in energy_vad(audio16, 16_000)]

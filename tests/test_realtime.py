@@ -256,13 +256,17 @@ def test_server_vad_auto_turn(rt_server):
         }})
         assert ws.recv_json()["type"] == "session.updated"
 
+        # Formant-synthesized speech: the default turn detector is now the
+        # shipped pretrained net, which (correctly) rejects pure tones as
+        # non-speech — the stimulus must actually sound like speech.
+        from localai_tpu.audio import resample
+        from localai_tpu.audio.formant_speech import synth_utterance
+
         sr = 24_000
-        t = np.arange(int(sr * 0.5)) / sr
-        speech = (0.4 * np.sin(2 * np.pi * 300 * t) * 32767).astype(np.int16)
+        sp16, _ = synth_utterance(np.random.default_rng(11), 0.8, 16_000)
+        speech = (np.clip(resample(sp16, 16_000, sr), -1, 1) * 32767).astype(np.int16)
         silence = np.zeros(int(sr * 0.6), np.int16)
 
-        # The energy VAD needs silence contrast before speech stands out, so
-        # events only start once the silent tail arrives.
         ws.send_json({"type": "input_audio_buffer.append",
                       "audio": base64.b64encode(speech.tobytes()).decode()})
         ws.send_json({"type": "input_audio_buffer.append",
