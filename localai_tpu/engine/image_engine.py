@@ -328,7 +328,11 @@ class LatentDiffusionEngine:
         self._ld = ld
         self.cfg = cfg
         self.params = params
-        self.tokenizer = tokenizer
+        # SDXL pipelines carry (tokenizer, tokenizer_2).
+        if isinstance(tokenizer, tuple):
+            self.tokenizer, self.tokenizer2 = tokenizer
+        else:
+            self.tokenizer, self.tokenizer2 = tokenizer, None
         self.default_scheduler = default_scheduler
         # (MotionConfig, params) — AnimateDiff-class temporal modules; when
         # present generate_video runs the real motion UNet.
@@ -358,9 +362,11 @@ class LatentDiffusionEngine:
 
     # ------------------------------------------------------------------ #
 
-    def _ids(self, prompt: str, batch: int) -> jnp.ndarray:
+    def _ids(self, prompt: str, batch: int, second: bool = False) -> jnp.ndarray:
+        tok = self.tokenizer2 if (second and self.tokenizer2 is not None) \
+            else self.tokenizer
         S = self.cfg.text.max_position_embeddings
-        enc = self.tokenizer(
+        enc = tok(
             prompt, padding="max_length", max_length=S, truncation=True,
         )["input_ids"]
         return jnp.broadcast_to(jnp.asarray(enc, jnp.int32), (batch, S))
@@ -399,6 +405,9 @@ class LatentDiffusionEngine:
         gw, gh = self._round_size(size)
         cond = self._ids(prompt, n)
         uncond = self._ids(negative_prompt or "", n)
+        is_xl = self.cfg.is_xl
+        cond2 = self._ids(prompt, n, second=True) if is_xl else None
+        uncond2 = self._ids(negative_prompt or "", n, second=True) if is_xl else None
         key = jax.random.key(0 if seed is None else int(seed) & 0x7FFFFFFF)
         with self._lock:
             jkey = (n, steps, gw, gh, sched, _known is not None,
@@ -407,11 +416,13 @@ class LatentDiffusionEngine:
             if fn is None:
                 cfg, ld = self.cfg, self._ld
 
-                def run(p, c, u, k, g, noise=None, kl=None, km=None):
+                def run(p, c, u, k, g, noise=None, kl=None, km=None,
+                        c2=None, u2=None):
                     return ld.generate(
                         cfg, p, c, u, k, steps=steps, guidance=g,
                         height=gh, width=gw, scheduler=sched,
                         init_noise=noise, known_latent=kl, known_mask=km,
+                        cond_ids2=c2, uncond_ids2=u2,
                     )
 
                 fn = jax.jit(run)
@@ -430,6 +441,8 @@ class LatentDiffusionEngine:
                 kw["noise"] = _init_noise
             if _known is not None:
                 kw["kl"], kw["km"] = _known
+            if is_xl:
+                kw["c2"], kw["u2"] = cond2, uncond2
             imgs = np.asarray(fn(*args, **kw))
         out = []
         for i in range(n):

@@ -50,6 +50,8 @@ class CLIPTextConfig:
     max_position_embeddings: int = 77
     hidden_act: str = "quick_gelu"
     layer_norm_eps: float = 1e-5
+    projection_dim: int = 0  # >0: CLIPTextModelWithProjection (SDXL encoder 2)
+    eos_token_id: int = 49407  # pooling position (HF CLIP semantics)
 
 
 @dataclass
@@ -72,6 +74,13 @@ class UNetConfig:
     norm_num_groups: int = 32
     flip_sin_to_cos: bool = True
     freq_shift: int = 0
+    # SDXL: transformer depth per level ([1, 2, 10] for the base model) and
+    # the "text_time" micro-conditioning pathway (pooled text embedding +
+    # six size/crop ids fourier-embedded into the time embedding).
+    transformer_layers_per_block: Any = 1  # int or per-block list
+    addition_embed_type: str = ""  # "" | "text_time"
+    addition_time_embed_dim: int = 256
+    projection_class_embeddings_input_dim: int = 0
 
     def heads_for(self, block_idx: int) -> int:
         # diffusers quirk: UNet2DConditionModel's `attention_head_dim` is
@@ -80,6 +89,11 @@ class UNetConfig:
         if isinstance(self.attention_head_dim, (list, tuple)):
             return int(self.attention_head_dim[block_idx])
         return int(self.attention_head_dim)
+
+    def tx_depth_for(self, block_idx: int) -> int:
+        if isinstance(self.transformer_layers_per_block, (list, tuple)):
+            return int(self.transformer_layers_per_block[block_idx])
+        return int(self.transformer_layers_per_block)
 
 
 @dataclass
@@ -104,11 +118,17 @@ class SDPipelineConfig:
     text: CLIPTextConfig = field(default_factory=CLIPTextConfig)
     unet: UNetConfig = field(default_factory=UNetConfig)
     vae: VAEConfig = field(default_factory=VAEConfig)
+    # SDXL second text encoder (OpenCLIP bigG class); None for the SD family.
+    text2: Optional[CLIPTextConfig] = None
     # scaled-linear schedule (SD family)
     num_train_timesteps: int = 1000
     beta_start: float = 0.00085
     beta_end: float = 0.012
     prediction_type: str = "epsilon"  # | "v_prediction"
+
+    @property
+    def is_xl(self) -> bool:
+        return self.text2 is not None
 
 
 # --------------------------------------------------------------------------- #
@@ -181,8 +201,13 @@ def get_timestep_embedding(t: jnp.ndarray, dim: int,
 # --------------------------------------------------------------------------- #
 
 
-def clip_encode(cfg: CLIPTextConfig, p: Params, ids: jnp.ndarray) -> jnp.ndarray:
-    """[B, 77] int32 → last hidden state [B, 77, C] (what SD conditions on)."""
+def clip_hidden_states(cfg: CLIPTextConfig, p: Params,
+                       ids: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[B, 77] int32 → (penultimate hidden [B, 77, C], final normed [B, 77, C]).
+
+    The penultimate state (hidden_states[-2], no final norm) is what SDXL
+    conditions on from both encoders; the final normed state is SD1.5's
+    context and the source of the pooled projection."""
     B, S = ids.shape
     h = p["text_model.embeddings.token_embedding.weight"][ids]
     h = h + p["text_model.embeddings.position_embedding.weight"][None, :S]
@@ -191,9 +216,12 @@ def clip_encode(cfg: CLIPTextConfig, p: Params, ids: jnp.ndarray) -> jnp.ndarray
     def act(x):
         if cfg.hidden_act == "quick_gelu":
             return x * jax.nn.sigmoid(1.702 * x)
-        return jax.nn.gelu(x)
+        return jax.nn.gelu(x, approximate=False)
 
+    penultimate = h
     for i in range(cfg.num_hidden_layers):
+        if i == cfg.num_hidden_layers - 1:
+            penultimate = h  # hidden_states[-2]: before the last layer
         pre = f"text_model.encoder.layers.{i}"
         r = h
         h = _layer_norm(h, p[f"{pre}.layer_norm1.weight"], p[f"{pre}.layer_norm1.bias"],
@@ -213,10 +241,32 @@ def clip_encode(cfg: CLIPTextConfig, p: Params, ids: jnp.ndarray) -> jnp.ndarray
         h = _layer_norm(h, p[f"{pre}.layer_norm2.weight"], p[f"{pre}.layer_norm2.bias"],
                         cfg.layer_norm_eps)
         h = r + _linear(act(_linear(h, p, f"{pre}.mlp.fc1")), p, f"{pre}.mlp.fc2")
-    return _layer_norm(
+    final = _layer_norm(
         h, p["text_model.final_layer_norm.weight"],
         p["text_model.final_layer_norm.bias"], cfg.layer_norm_eps,
     )
+    return penultimate, final
+
+
+def clip_encode(cfg: CLIPTextConfig, p: Params, ids: jnp.ndarray) -> jnp.ndarray:
+    """[B, 77] int32 → last hidden state [B, 77, C] (what SD conditions on)."""
+    return clip_hidden_states(cfg, p, ids)[1]
+
+
+def clip_pooled_projection(cfg: CLIPTextConfig, p: Params, ids: jnp.ndarray,
+                           final: jnp.ndarray) -> jnp.ndarray:
+    """CLIPTextModelWithProjection pooling: the first EOS position's final
+    hidden state through text_projection (no bias). HF semantics: legacy
+    configs (eos_token_id == 2) take argmax of the ids (EOS is the highest
+    id in the CLIP vocab); otherwise the first eos_token_id occurrence."""
+    if cfg.eos_token_id == 2:
+        eos_pos = jnp.argmax(ids, axis=-1)
+    else:
+        eos_pos = jnp.argmax((ids == cfg.eos_token_id).astype(jnp.int32), axis=-1)
+    pooled = jnp.take_along_axis(final, eos_pos[:, None, None], axis=1)[:, 0]
+    if "text_projection.weight" in p:
+        pooled = pooled @ p["text_projection.weight"].astype(pooled.dtype)
+    return pooled
 
 
 # --------------------------------------------------------------------------- #
@@ -264,7 +314,7 @@ def _basic_transformer(p: Params, pre: str, h: jnp.ndarray, ctx: jnp.ndarray,
 
 
 def _spatial_transformer(p: Params, pre: str, x: jnp.ndarray, ctx: jnp.ndarray,
-                         heads: int, groups: int) -> jnp.ndarray:
+                         heads: int, groups: int, depth: int = 1) -> jnp.ndarray:
     B, H, W, C = x.shape
     r = x
     h = _group_norm(x, p[f"{pre}.norm.weight"], p[f"{pre}.norm.bias"], groups)
@@ -275,7 +325,8 @@ def _spatial_transformer(p: Params, pre: str, x: jnp.ndarray, ctx: jnp.ndarray,
     else:
         h = _conv(h, p[f"{pre}.proj_in.weight"], p[f"{pre}.proj_in.bias"], pad=0)
         h = h.reshape(B, H * W, C)
-    h = _basic_transformer(p, f"{pre}.transformer_blocks.0", h, ctx, heads)
+    for d in range(depth):  # SDXL stacks up to 10 blocks per attention
+        h = _basic_transformer(p, f"{pre}.transformer_blocks.{d}", h, ctx, heads)
     if use_linear:
         h = _linear(h, p, f"{pre}.proj_out").reshape(B, H, W, C)
     else:
@@ -285,14 +336,31 @@ def _spatial_transformer(p: Params, pre: str, x: jnp.ndarray, ctx: jnp.ndarray,
 
 
 def unet_forward(cfg: UNetConfig, p: Params, sample: jnp.ndarray,
-                 t: jnp.ndarray, ctx: jnp.ndarray) -> jnp.ndarray:
-    """sample [B, H, W, C_lat], t [B], ctx [B, S, C_txt] → eps/v pred."""
+                 t: jnp.ndarray, ctx: jnp.ndarray,
+                 added_text: Optional[jnp.ndarray] = None,
+                 added_time_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """sample [B, H, W, C_lat], t [B], ctx [B, S, C_txt] → eps/v pred.
+
+    SDXL micro-conditioning (addition_embed_type "text_time"): added_text
+    [B, 1280] (encoder-2 pooled projection) and added_time_ids [B, 6]
+    (orig_h, orig_w, crop_top, crop_left, target_h, target_w) are fourier-
+    embedded and added into the time embedding."""
     g = cfg.norm_num_groups
     temb = get_timestep_embedding(
         t, cfg.block_out_channels[0], cfg.flip_sin_to_cos, cfg.freq_shift
     ).astype(sample.dtype)
     temb = _linear(temb, p, "time_embedding.linear_1")
     temb = _linear(jax.nn.silu(temb), p, "time_embedding.linear_2")
+    if cfg.addition_embed_type == "text_time":
+        B = sample.shape[0]
+        tids = get_timestep_embedding(
+            added_time_ids.reshape(-1), cfg.addition_time_embed_dim,
+            cfg.flip_sin_to_cos, cfg.freq_shift,
+        ).reshape(B, -1).astype(sample.dtype)  # [B, 6*addition_dim]
+        add = jnp.concatenate([added_text.astype(sample.dtype), tids], axis=-1)
+        aug = _linear(add, p, "add_embedding.linear_1")
+        aug = _linear(jax.nn.silu(aug), p, "add_embedding.linear_2")
+        temb = temb + aug
 
     h = _conv(sample, p["conv_in.weight"], p["conv_in.bias"])
     skips = [h]
@@ -304,6 +372,7 @@ def unet_forward(cfg: UNetConfig, p: Params, sample: jnp.ndarray,
             if btype == "CrossAttnDownBlock2D":
                 h = _spatial_transformer(
                     p, f"{pre}.attentions.{li}", h, ctx, heads, g,
+                    cfg.tx_depth_for(bi),
                 )
             skips.append(h)
         if f"{pre}.downsamplers.0.conv.weight" in p:
@@ -311,16 +380,17 @@ def unet_forward(cfg: UNetConfig, p: Params, sample: jnp.ndarray,
                       p[f"{pre}.downsamplers.0.conv.bias"], stride=2)
             skips.append(h)
 
+    last = len(cfg.block_out_channels) - 1
     h = _resnet(p, "mid_block.resnets.0", h, temb, g)
     h = _spatial_transformer(
         p, "mid_block.attentions.0", h, ctx,
-        cfg.heads_for(len(cfg.block_out_channels) - 1), g,
+        cfg.heads_for(last), g, cfg.tx_depth_for(last),
     )
     h = _resnet(p, "mid_block.resnets.1", h, temb, g)
 
     for bi, btype in enumerate(cfg.up_block_types):
         pre = f"up_blocks.{bi}"
-        heads = cfg.heads_for(len(cfg.block_out_channels) - 1 - bi)
+        heads = cfg.heads_for(last - bi)
         for li in range(cfg.layers_per_block + 1):
             skip = skips.pop()
             h = jnp.concatenate([h, skip], axis=-1)
@@ -328,6 +398,7 @@ def unet_forward(cfg: UNetConfig, p: Params, sample: jnp.ndarray,
             if btype == "CrossAttnUpBlock2D":
                 h = _spatial_transformer(
                     p, f"{pre}.attentions.{li}", h, ctx, heads, g,
+                    cfg.tx_depth_for(last - bi),
                 )
         if f"{pre}.upsamplers.0.conv.weight" in p:
             B, H, W, C = h.shape
@@ -476,6 +547,38 @@ def euler_a_step(model_out, x, sigma, sigma_next, noise):
     return xf.astype(x.dtype)
 
 
+def _denoised_sigma(cfg: SDPipelineConfig, model_out, x, sigma):
+    """k-diffusion denoiser output D(x, σ) for the configured prediction
+    type (eps: D = x − σ·ε; v: D = x/(σ²+1) − σ/√(σ²+1)·v)."""
+    mo = model_out.astype(jnp.float32)
+    xf = x.astype(jnp.float32)
+    if cfg.prediction_type == "v_prediction":
+        return xf / (sigma**2 + 1.0) - sigma / jnp.sqrt(sigma**2 + 1.0) * mo
+    return xf - sigma * mo
+
+
+def lms_coefficients(sigmas: np.ndarray, order: int = 4) -> np.ndarray:
+    """Adams-Bashforth coefficients over the (static) sigma trajectory:
+    ∫ over [σ_i, σ_{i+1}] of each Lagrange basis through the last `order`
+    sigmas (k-diffusion sample_lms). Host-side, per compile."""
+    from scipy.integrate import quad
+
+    steps = len(sigmas) - 1
+    co = np.zeros((steps, order), np.float64)
+    for i in range(steps):
+        cur = min(i + 1, order)
+        for j in range(cur):
+            def basis(tau, j=j, cur=cur, i=i):
+                prod = 1.0
+                for k in range(cur):
+                    if k != j:
+                        prod *= (tau - sigmas[i - k]) / (sigmas[i - j] - sigmas[i - k])
+                return prod
+
+            co[i, j] = quad(basis, sigmas[i], sigmas[i + 1], epsrel=1e-5)[0]
+    return co.astype(np.float32)
+
+
 # --------------------------------------------------------------------------- #
 # Generation
 # --------------------------------------------------------------------------- #
@@ -495,18 +598,46 @@ def generate(
     init_noise: Optional[jnp.ndarray] = None,  # [B, h/8, w/8, C] unit normal
     known_latent: Optional[jnp.ndarray] = None,  # scaled latents to keep
     known_mask: Optional[jnp.ndarray] = None,  # [B, h/8, w/8, 1]; 1 = repaint
+    cond_ids2: Optional[jnp.ndarray] = None,  # SDXL: tokenizer_2 ids
+    uncond_ids2: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Full text→image pipeline; returns [B, H, W, 3] float32 in [0,1].
     jit-able: shapes depend only on (B, steps, H, W, scheduler).
+
+    SDXL checkpoints (cfg.text2 set) condition on the CONCATENATED
+    penultimate states of both encoders plus encoder 2's pooled projection
+    and size/crop time-ids (StableDiffusionXLPipeline semantics).
 
     With known_latent/known_mask set, runs SD-style inpainting on a vanilla
     checkpoint: after every step the preserved region is replaced with the
     source latent re-noised to the current timestep (diffusers'
     StableDiffusionInpaintPipelineLegacy behavior)."""
     B = cond_ids.shape[0]
-    ctx_c = clip_encode(cfg.text, params["text"], cond_ids)
-    ctx_u = clip_encode(cfg.text, params["text"], uncond_ids)
-    ctx = jnp.concatenate([ctx_u, ctx_c], axis=0)
+    added = None
+    if cfg.is_xl:
+        ids2_c = cond_ids if cond_ids2 is None else cond_ids2
+        ids2_u = uncond_ids if uncond_ids2 is None else uncond_ids2
+        pen1_c, _ = clip_hidden_states(cfg.text, params["text"], cond_ids)
+        pen1_u, _ = clip_hidden_states(cfg.text, params["text"], uncond_ids)
+        pen2_c, fin2_c = clip_hidden_states(cfg.text2, params["text2"], ids2_c)
+        pen2_u, fin2_u = clip_hidden_states(cfg.text2, params["text2"], ids2_u)
+        ctx = jnp.concatenate([
+            jnp.concatenate([pen1_u, pen2_u], axis=-1),
+            jnp.concatenate([pen1_c, pen2_c], axis=-1),
+        ], axis=0)
+        pooled = jnp.concatenate([
+            clip_pooled_projection(cfg.text2, params["text2"], ids2_u, fin2_u),
+            clip_pooled_projection(cfg.text2, params["text2"], ids2_c, fin2_c),
+        ], axis=0)
+        time_ids = jnp.broadcast_to(
+            jnp.asarray([height, width, 0, 0, height, width], jnp.float32),
+            (2 * B, 6),
+        )
+        added = (pooled, time_ids)
+    else:
+        ctx_c = clip_encode(cfg.text, params["text"], cond_ids)
+        ctx_u = clip_encode(cfg.text, params["text"], uncond_ids)
+        ctx = jnp.concatenate([ctx_u, ctx_c], axis=0)
     vs = cfg.vae.spatial_scale
     lat_h, lat_w = height // vs, width // vs
     acp = jnp.asarray(alphas_cumprod(cfg))
@@ -519,7 +650,11 @@ def generate(
     def cfg_eps(x_in, t):
         both = jnp.concatenate([x_in, x_in], axis=0)
         tt = jnp.full((2 * B,), t, jnp.float32)
-        out = unet_forward(cfg.unet, params["unet"], both, tt, ctx)
+        out = unet_forward(
+            cfg.unet, params["unet"], both, tt, ctx,
+            added_text=added[0] if added else None,
+            added_time_ids=added[1] if added else None,
+        )
         eps_u, eps_c = jnp.split(out, 2, axis=0)
         return eps_u + guidance * (eps_c - eps_u)
 
@@ -534,21 +669,93 @@ def generate(
         noised = jnp.sqrt(acp_prev) * known_latent + jnp.sqrt(1.0 - acp_prev) * noise
         return known_mask * xc + (1.0 - known_mask) * noised.astype(xc.dtype)
 
-    if scheduler == "euler_a":
-        sigmas = jnp.asarray(euler_a_sigmas(cfg, steps))
+    k_schedulers = ("euler_a", "dpmpp_2m", "heun", "lms")
+    if scheduler not in k_schedulers + ("ddim",):
+        raise ValueError(
+            f"unknown scheduler {scheduler!r} (supported: ddim, "
+            + ", ".join(k_schedulers) + ")"
+        )
+    if scheduler in k_schedulers:
+        sigmas_np = euler_a_sigmas(cfg, steps)
+        sigmas = jnp.asarray(sigmas_np)
         ts = jnp.asarray(ddim_timesteps(cfg, steps).astype(np.float32))
         x = x * sigmas[0]
 
-        def step(carry, i):
-            xc, k = carry
-            k, nk2 = jax.random.split(k)
-            sig, sig_n = sigmas[i], sigmas[i + 1]
-            x_in = xc / jnp.sqrt(sig ** 2 + 1.0)
-            eps = cfg_eps(x_in, ts[i])
-            noise = jax.random.normal(nk2, xc.shape, jnp.float32)
-            return (euler_a_step(eps, xc, sig, sig_n, noise), k), None
+        def denoised_at(xc, i):
+            sig = sigmas[i]
+            x_in = xc.astype(jnp.float32) / jnp.sqrt(sig**2 + 1.0)
+            out = cfg_eps(x_in, ts[i])
+            return _denoised_sigma(cfg, out, xc, sig)
 
-        (x, _), _ = jax.lax.scan(step, (x, key), jnp.arange(steps))
+        if scheduler == "euler_a":
+
+            def step(carry, i):
+                xc, k = carry
+                k, nk2 = jax.random.split(k)
+                sig, sig_n = sigmas[i], sigmas[i + 1]
+                x_in = xc / jnp.sqrt(sig ** 2 + 1.0)
+                eps = cfg_eps(x_in, ts[i])
+                noise = jax.random.normal(nk2, xc.shape, jnp.float32)
+                return (euler_a_step(eps, xc, sig, sig_n, noise), k), None
+
+            (x, _), _ = jax.lax.scan(step, (x, key), jnp.arange(steps))
+        elif scheduler == "dpmpp_2m":
+            # DPM-Solver++(2M): deterministic multistep over λ = −log σ
+            # (k-diffusion sample_dpmpp_2m; first and last steps are 1st
+            # order).
+            def step(carry, i):
+                xc, old_d = carry
+                den = denoised_at(xc, i)
+                sig, sig_n = sigmas[i], sigmas[i + 1]
+                t_c, t_n = -jnp.log(sig), -jnp.log(jnp.maximum(sig_n, 1e-10))
+                h = t_n - t_c
+                sig_prev = sigmas[jnp.maximum(i - 1, 0)]
+                h_last = t_c - (-jnp.log(sig_prev))
+                r = h_last / h
+                den_d = (1 + 1 / (2 * r)) * den - (1 / (2 * r)) * old_d
+                use_first = (i == 0) | (sig_n == 0.0)
+                den_use = jnp.where(use_first, den, den_d)
+                xn = (sig_n / sig) * xc.astype(jnp.float32) \
+                    - jnp.expm1(-h) * den_use
+                return (xn.astype(xc.dtype), den), None
+
+            (x, _), _ = jax.lax.scan(step, (x, jnp.zeros_like(x)),
+                                     jnp.arange(steps))
+        elif scheduler == "heun":
+            # Heun's 2nd order (k-diffusion sample_heun, churn 0): trapezoid
+            # correction with a second model eval; plain Euler when the next
+            # sigma is 0 (the correction's slope is undefined there).
+            def step(carry, i):
+                xc, _ = carry
+                sig, sig_n = sigmas[i], sigmas[i + 1]
+                den = denoised_at(xc, i)
+                d = (xc.astype(jnp.float32) - den) / sig
+                dt = sig_n - sig
+                x_eul = xc.astype(jnp.float32) + d * dt
+                den2 = denoised_at(x_eul.astype(xc.dtype),
+                                   jnp.minimum(i + 1, steps - 1))
+                d2 = (x_eul - den2) / jnp.maximum(sig_n, 1e-10)
+                x_heun = xc.astype(jnp.float32) + (d + d2) / 2 * dt
+                xn = jnp.where(sig_n == 0.0, x_eul, x_heun)
+                return (xn.astype(xc.dtype), 0.0), None
+
+            (x, _), _ = jax.lax.scan(step, (x, 0.0), jnp.arange(steps))
+        else:  # lms
+            order = min(4, steps)
+            co = jnp.asarray(lms_coefficients(sigmas_np, order))
+
+            def step(carry, i):
+                xc, hist = carry
+                den = denoised_at(xc, i)
+                d = (xc.astype(jnp.float32) - den) / sigmas[i]
+                hist = jnp.concatenate([d[None], hist[:-1]], axis=0)
+                xn = xc.astype(jnp.float32) + jnp.einsum(
+                    "j,j...->...", co[i], hist
+                )
+                return (xn.astype(xc.dtype), hist), None
+
+            hist0 = jnp.zeros((order,) + x.shape, jnp.float32)
+            (x, _), _ = jax.lax.scan(step, (x, hist0), jnp.arange(steps))
     else:
         ts = jnp.asarray(ddim_timesteps(cfg, steps))
         ratio = cfg.num_train_timesteps // steps
@@ -647,6 +854,12 @@ def load_pipeline(ckpt_dir: str, dtype=jnp.float32):
             norm_num_groups=uc.get("norm_num_groups", 32),
             flip_sin_to_cos=uc.get("flip_sin_to_cos", True),
             freq_shift=uc.get("freq_shift", 0),
+            transformer_layers_per_block=uc.get("transformer_layers_per_block", 1),
+            addition_embed_type=uc.get("addition_embed_type") or "",
+            addition_time_embed_dim=uc.get("addition_time_embed_dim", 256),
+            projection_class_embeddings_input_dim=uc.get(
+                "projection_class_embeddings_input_dim", 0
+            ),
         ),
         vae=VAEConfig(
             in_channels=vc.get("in_channels", 3),
@@ -669,9 +882,32 @@ def load_pipeline(ckpt_dir: str, dtype=jnp.float32):
     }
     from transformers import AutoTokenizer, CLIPTokenizer
 
-    tok_dir = os.path.join(ckpt_dir, "tokenizer")
-    try:
-        tokenizer = AutoTokenizer.from_pretrained(tok_dir, local_files_only=True)
-    except Exception:  # noqa: BLE001 — vocab.json/merges.txt direct load
-        tokenizer = CLIPTokenizer.from_pretrained(tok_dir, local_files_only=True)
+    def load_tok(sub: str):
+        tok_dir = os.path.join(ckpt_dir, sub)
+        try:
+            return AutoTokenizer.from_pretrained(tok_dir, local_files_only=True)
+        except Exception:  # noqa: BLE001 — vocab.json/merges.txt direct load
+            return CLIPTokenizer.from_pretrained(tok_dir, local_files_only=True)
+
+    tokenizer = load_tok("tokenizer")
+
+    # SDXL layout: a second (OpenCLIP-bigG-class) text encoder + tokenizer.
+    te2 = os.path.join(ckpt_dir, "text_encoder_2")
+    if os.path.isdir(te2):
+        t2 = _cfg_json(os.path.join(te2, "config.json"))
+        cfg.text2 = CLIPTextConfig(
+            vocab_size=t2.get("vocab_size", 49408),
+            hidden_size=t2.get("hidden_size", 1280),
+            intermediate_size=t2.get("intermediate_size", 5120),
+            num_hidden_layers=t2.get("num_hidden_layers", 32),
+            num_attention_heads=t2.get("num_attention_heads", 20),
+            max_position_embeddings=t2.get("max_position_embeddings", 77),
+            hidden_act=t2.get("hidden_act", "gelu"),
+            projection_dim=t2.get("projection_dim", 1280),
+            eos_token_id=t2.get("eos_token_id", 49407),
+        )
+        params["text2"] = _prep(_load_safetensors_dir(te2), dtype)
+        tok2_dir = os.path.join(ckpt_dir, "tokenizer_2")
+        tok2 = load_tok("tokenizer_2") if os.path.isdir(tok2_dir) else tokenizer
+        return cfg, params, (tokenizer, tok2)
     return cfg, params, tokenizer
