@@ -63,6 +63,7 @@ class ModelsApi:
         r.add("POST", "/models/import", self.import_model)
         r.add("POST", "/models/import-uri", self.import_uri)
         r.add("GET", "/models/import-jobs/:uuid", self.import_job)
+        r.add("GET", "/models/config/:name", self.get_config)
         r.add("POST", "/models/edit/:name", self.edit_model)
         r.add("PUT", "/models/edit/:name", self.edit_model)
         r.add("POST", "/models/reload", self.reload)
@@ -141,6 +142,14 @@ class ModelsApi:
         return Response(body=job)
 
     # ------------------------------------------------------------------ #
+
+    def get_config(self, req: Request) -> Response:
+        """Full persisted config for one model (the WebUI editor's source)."""
+        name = req.params["name"]
+        cfg = self.manager.configs.get(name)
+        if cfg is None:
+            raise ApiError(404, f"model {name!r} not found")
+        return Response(body=cfg.to_dict())
 
     def edit_model(self, req: Request) -> Response:
         """Patch + persist a model config; the loaded engine is evicted so

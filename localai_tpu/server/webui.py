@@ -1,11 +1,14 @@
 """Embedded WebUI.
 
-Reference: core/http/views (23 templates: chat, models, gallery install,
-tts, image generation, talk) + ui.go/ui_api.go routes. Here: one
-dependency-free single-page app served at `/` that drives the same public
-APIs the CLI and SDKs use — chat with SSE streaming, model list + load
-state, gallery browse/install with job polling, TTS playback, image
-generation. No build step, no external assets (air-gapped TPU pods).
+Reference: core/http/views (23 templates: chat, models, model editor,
+gallery install, agent jobs, tts, image generation, talk) + ui.go/
+ui_api.go routes. Here: one dependency-free single-page app served at `/`
+that drives the same public APIs the CLI and SDKs use — chat with SSE
+streaming, realtime talk over the /v1/realtime WebSocket (text turns +
+mic streaming with server-VAD), model list + load state, a model config
+editor (import/edit/delete/reload), gallery browse/install with job
+polling, an agent-jobs panel (create/run/toggle/history), TTS playback,
+image generation. No build step, no external assets (air-gapped TPU pods).
 """
 
 from __future__ import annotations
@@ -51,7 +54,7 @@ table{width:100%;border-collapse:collapse}td,th{text-align:left;padding:.45rem;b
 <span style="flex:1"></span><a class="small" href="/swagger">API docs</a></header>
 <main id="main"></main>
 <script>
-const TABS={chat:Chat,models:Models,gallery:GalleryTab,tts:TTS,image:Images};
+const TABS={chat:Chat,talk:Talk,models:Models,editor:Editor,gallery:GalleryTab,jobs:Jobs,tts:TTS,image:Images};
 let tab='chat';
 function nav(){const n=document.getElementById('nav');n.innerHTML='';
  for(const t of Object.keys(TABS)){const b=document.createElement('button');
@@ -128,6 +131,145 @@ function TTS(el){
    body:JSON.stringify({model:document.getElementById('tmodel').value,input:document.getElementById('txt').value})});
   if(!r.ok){alert('tts failed: '+(await r.text()));return}
   document.getElementById('au').src=URL.createObjectURL(await r.blob())};
+}
+
+async function Editor(el){
+ // Model config editor (reference: views/model-editor.html): pick a config,
+ // edit the persisted dict as JSON, save via /models/edit, create new via
+ // /models/import, delete, hot-reload all configs.
+ el.innerHTML=`<div class="card"><div class="row"><div style="flex:1" id="es"></div>
+ <button class="act" id="new">New</button><button class="act" id="reload">Reload configs</button></div>
+ <textarea id="cfg" rows="16" spellcheck="false" style="font-family:monospace;margin-top:.6rem"></textarea>
+ <div class="row"><button class="act" id="save">Save</button>
+ <button class="act" id="del" style="background:#a33">Delete</button>
+ <span class="small" id="emsg"></span></div></div>`;
+ const msg=t=>{document.getElementById('emsg').textContent=t};
+ async function pick(name){
+  const r=await fetch('/models/config/'+encodeURIComponent(name));
+  if(!r.ok){msg('load failed: '+await r.text());return}
+  document.getElementById('cfg').value=JSON.stringify(await r.json(),null,2)}
+ async function refresh(){
+  const ms=await models();
+  document.getElementById('es').innerHTML=sel(ms,'emodel');
+  document.getElementById('emodel').onchange=e=>pick(e.target.value);
+  if(ms.length)pick(ms[0])}
+ refresh();
+ document.getElementById('new').onclick=()=>{
+  document.getElementById('cfg').value=JSON.stringify({name:'my-model',model:'',backend:'llama'},null,2)};
+ document.getElementById('save').onclick=async()=>{
+  let d;try{d=JSON.parse(document.getElementById('cfg').value)}catch(e){msg('invalid JSON: '+e);return}
+  const exists=(await models()).includes(d.name);
+  const r=exists?await fetch('/models/edit/'+encodeURIComponent(d.name),{method:'POST',
+    headers:{'Content-Type':'application/json'},body:JSON.stringify(d)})
+   :await fetch('/models/import',{method:'POST',headers:{'Content-Type':'application/json'},body:JSON.stringify(d)});
+  msg(r.ok?'saved':'save failed: '+await r.text());if(r.ok)refresh()};
+ document.getElementById('del').onclick=async()=>{
+  const n=document.getElementById('emodel').value;if(!n)return;
+  const r=await fetch('/models/delete/'+encodeURIComponent(n),{method:'POST'});
+  msg(r.ok?'deleted '+n:'delete failed: '+await r.text());refresh()};
+ document.getElementById('reload').onclick=async()=>{
+  const r=await fetch('/models/reload',{method:'POST'});
+  msg(r.ok?'reloaded':'reload failed')};
+}
+
+async function Jobs(el){
+ // Agent jobs panel (reference: views/agent-jobs.html): list, create with a
+ // cron schedule, run now, enable/disable, delete, view run history.
+ el.innerHTML=`<div class="card"><table id="jt"><tr><th>name</th><th>model</th><th>schedule</th><th>enabled</th><th></th></tr></table>
+ <div class="row"><input id="jn" placeholder="name"><input id="jm" placeholder="model">
+ <input id="js" placeholder="schedule e.g. */5 * * * *"></div>
+ <div class="row"><textarea id="jp" rows="2" placeholder="prompt"></textarea>
+ <button class="act" id="jc">Create</button></div>
+ <pre class="small" id="jh"></pre></div>`;
+ async function refresh(){
+  const r=await fetch('/agent-jobs');
+  if(!r.ok){document.getElementById('jt').outerHTML='<div class="small">agent jobs unavailable (no MCP/agent service)</div>';return}
+  const jobs=(await r.json()).jobs||[];const t=document.getElementById('jt');
+  t.innerHTML='<tr><th>name</th><th>model</th><th>schedule</th><th>enabled</th><th></th></tr>';
+  for(const j of jobs){const tr=document.createElement('tr');
+   tr.innerHTML=`<td>${j.name}</td><td class="small">${j.model}</td><td class="small">${j.schedule||''}</td>
+   <td><button class="act" data-a="toggle" data-id="${j.id}" data-en="${j.enabled}">${j.enabled?'on':'off'}</button></td>
+   <td><button class="act" data-a="run" data-id="${j.id}">run</button>
+   <button class="act" data-a="hist" data-id="${j.id}">history</button>
+   <button class="act" data-a="del" data-id="${j.id}" style="background:#a33">x</button></td>`;
+   t.appendChild(tr)}
+  t.onclick=async e=>{const a=e.target.dataset&&e.target.dataset.a;if(!a)return;
+   const id=e.target.dataset.id;
+   if(a==='run')await fetch('/agent-jobs/'+id+'/run',{method:'POST'});
+   if(a==='del')await fetch('/agent-jobs/'+id,{method:'DELETE'});
+   if(a==='toggle')await fetch('/agent-jobs/'+id,{method:'PUT',headers:{'Content-Type':'application/json'},
+    body:JSON.stringify({enabled:e.target.dataset.en!=='true'})});
+   if(a==='hist'){const h=await(await fetch('/agent-jobs/'+id+'/history')).json();
+    document.getElementById('jh').textContent=JSON.stringify(h,null,2);return}
+   refresh()};
+ }
+ refresh();
+ document.getElementById('jc').onclick=async()=>{
+  const r=await fetch('/agent-jobs',{method:'POST',headers:{'Content-Type':'application/json'},
+   body:JSON.stringify({name:document.getElementById('jn').value,model:document.getElementById('jm').value,
+    prompt:document.getElementById('jp').value,schedule:document.getElementById('js').value})});
+  if(!r.ok)alert('create failed: '+await r.text());refresh()};
+}
+
+function Talk(el){
+ // Realtime talk page (reference: views/talk.html) against the existing
+ // WS /v1/realtime: text turns always work; the mic button streams pcm16
+ // with server-VAD turn detection; response audio deltas play back.
+ el.innerHTML=`<div class="card"><div class="row"><div style="flex:1" id="tsl"></div>
+ <button class="act" id="conn">Connect</button><button class="act" id="mic" disabled>Mic</button></div>
+ <div id="log"></div>
+ <div class="row"><textarea id="tinp" rows="2" placeholder="Type a turn…"></textarea>
+ <button class="act" id="tsend" disabled>Send</button></div>
+ <div class="small" id="tst">disconnected</div></div>`;
+ models().then(ms=>{document.getElementById('tsl').innerHTML=sel(ms,'tkmodel')});
+ let ws=null,ac=null,micNode=null,playT=0,out=null;
+ const st=t=>{document.getElementById('tst').textContent=t};
+ const log=document.getElementById('log');
+ function playPcm(b64){
+  if(!ac)ac=new AudioContext({sampleRate:24000});
+  const raw=atob(b64);const n=raw.length/2;const f=new Float32Array(n);
+  for(let i=0;i<n;i++){let v=(raw.charCodeAt(2*i)|(raw.charCodeAt(2*i+1)<<8));if(v>=32768)v-=65536;f[i]=v/32768}
+  const buf=ac.createBuffer(1,n,24000);buf.copyToChannel(f,0);
+  const src=ac.createBufferSource();src.buffer=buf;src.connect(ac.destination);
+  playT=Math.max(playT,ac.currentTime);src.start(playT);playT+=n/24000}
+ document.getElementById('conn').onclick=()=>{
+  if(ws){ws.close();return}
+  const m=document.getElementById('tkmodel').value;
+  ws=new WebSocket((location.protocol==='https:'?'wss://':'ws://')+location.host+'/v1/realtime?model='+encodeURIComponent(m));
+  ws.onopen=()=>{st('connected');document.getElementById('tsend').disabled=false;
+   document.getElementById('mic').disabled=false;
+   ws.send(JSON.stringify({type:'session.update',session:{turn_detection:{type:'server_vad',silence_duration_ms:500}}}))};
+  ws.onclose=()=>{st('disconnected');ws=null;document.getElementById('tsend').disabled=true;
+   document.getElementById('mic').disabled=true};
+  ws.onmessage=e=>{const ev=JSON.parse(e.data);
+   if(ev.type==='conversation.item.created'&&ev.item.role==='user'){
+    const c=ev.item.content[0];const txt=c.transcript!==undefined?c.transcript:c.text;
+    log.insertAdjacentHTML('beforeend','<div class="msg user"></div>');log.lastChild.textContent=txt}
+   if(ev.type==='response.created'){log.insertAdjacentHTML('beforeend','<div class="msg assistant"></div>');
+    out=log.lastChild}
+   if((ev.type==='response.text.delta'||ev.type==='response.audio_transcript.delta')&&out){
+    out.textContent+=ev.delta;log.scrollTop=log.scrollHeight}
+   if(ev.type==='response.audio.delta')playPcm(ev.delta);
+   if(ev.type==='error')st('error: '+ev.error.message)}};
+ document.getElementById('tsend').onclick=()=>{
+  const t=document.getElementById('tinp').value.trim();if(!t||!ws)return;
+  document.getElementById('tinp').value='';
+  ws.send(JSON.stringify({type:'conversation.item.create',item:{type:'message',role:'user',
+   content:[{type:'input_text',text:t}]}}));
+  ws.send(JSON.stringify({type:'response.create'}))};
+ document.getElementById('mic').onclick=async()=>{
+  if(micNode){micNode.disconnect();micNode=null;document.getElementById('mic').textContent='Mic';return}
+  const stream=await navigator.mediaDevices.getUserMedia({audio:true});
+  if(!ac)ac=new AudioContext({sampleRate:24000});
+  const src=ac.createMediaStreamSource(stream);
+  micNode=ac.createScriptProcessor(4096,1,1);
+  micNode.onaudioprocess=e=>{if(!ws)return;
+   const f=e.inputBuffer.getChannelData(0);const b=new Int16Array(f.length);
+   for(let i=0;i<f.length;i++)b[i]=Math.max(-32768,Math.min(32767,f[i]*32768));
+   const u8=new Uint8Array(b.buffer);let s='';for(let i=0;i<u8.length;i++)s+=String.fromCharCode(u8[i]);
+   ws.send(JSON.stringify({type:'input_audio_buffer.append',audio:btoa(s)}))};
+  src.connect(micNode);micNode.connect(ac.destination);
+  document.getElementById('mic').textContent='Stop'};
 }
 
 function Images(el){

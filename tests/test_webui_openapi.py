@@ -23,7 +23,11 @@ def api(tmp_path_factory):
     app_cfg = ApplicationConfig(address="127.0.0.1", port=0, models_dir=str(d))
     manager = ModelManager(app_cfg)
     router = Router()
-    OpenAIApi(manager).register(router)
+    oai = OpenAIApi(manager)
+    oai.register(router)
+    from localai_tpu.server.models_api import ModelsApi
+
+    ModelsApi(manager).register(router)
     register_openapi(router)
     register_webui(router)
     server = create_server(app_cfg, router)
@@ -88,3 +92,55 @@ def test_build_openapi_offline():
     op = doc["paths"]["/x/{id}"]["get"]
     assert op["summary"] == "Test summary line."
     assert op["parameters"][0]["name"] == "id"
+
+
+def test_webui_new_tabs_drive_real_apis(api):
+    """Editor / jobs / talk tabs reference the live endpoints (VERDICT r3
+    item 10); the editor's backing routes round-trip a config edit."""
+    base, _ = api
+    body, _ = _get(base, "/")
+    # editor
+    for needle in ("/models/config/", "/models/edit/", "/models/import",
+                   "/models/reload", "/models/delete/"):
+        assert needle in body, needle
+    # agent jobs panel
+    for needle in ("/agent-jobs", "/run", "/history"):
+        assert needle in body, needle
+    # talk page drives the realtime WS protocol
+    for needle in ("/v1/realtime", "conversation.item.create",
+                   "input_audio_buffer.append", "server_vad",
+                   "response.audio.delta"):
+        assert needle in body, needle
+
+
+def test_model_config_editor_flow(api):
+    """The exact request sequence the editor tab makes: read config →
+    patch → re-read shows the patch → reload configs."""
+    import json as _json
+    import urllib.request
+
+    base, _ = api
+    cfg, _ = _get(base, "/models/config/m")
+    d = _json.loads(cfg)
+    assert d["name"] == "m" and d["model"] == "tiny"
+
+    req = urllib.request.Request(
+        base + "/models/edit/m",
+        data=_json.dumps({"max_tokens": 9}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 200
+    cfg2, _ = _get(base, "/models/config/m")
+    assert _json.loads(cfg2)["max_tokens"] == 9
+
+    req = urllib.request.Request(base + "/models/reload", data=b"{}",
+                                 headers={"Content-Type": "application/json"},
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 200
+
+    # unknown name → 404 (what the editor surfaces as 'load failed')
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(base, "/models/config/nope")
+    assert ei.value.code == 404
