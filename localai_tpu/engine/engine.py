@@ -1795,6 +1795,19 @@ class Engine:
                             self._warm_block(variant, self.ecfg.block_sizes[0],
                                              with_lp=True, kv_win=w)
                     w *= 2
+        # Prefix-save snapshot programs compile per bucket ON THE LOOP
+        # THREAD at the first save of that bucket — a finish-time save of an
+        # unwarmed bucket otherwise stalls serving mid-measurement (~0.75 s
+        # observed inside the bench's decode window). Touch every bucket.
+        if self._prefix_enabled and not self._paged:
+            pb = self._bucket_for(self.ecfg.prefix_cache_min)
+            while True:
+                jax.block_until_ready(
+                    self._get_snapshot(pb)(self.cache, jnp.int32(0))
+                )
+                if pb >= self.ecfg.max_seq:
+                    break
+                pb = self._bucket_for(pb + 1)
         self._lp_warmed = self._lp_warmed or logprobs
         _, ev = self.generate([1] * prompt_len, max_new_tokens=2)
         assert ev.kind == "done"

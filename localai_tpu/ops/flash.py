@@ -6,6 +6,12 @@ online-softmax recurrence, so HBM traffic is O(S) per query block and the
 matmuls hit the MXU at block size 128. Reference equivalent: llama.cpp's
 flash-attn path (grpc-server.cpp params_parse `flash_attention`).
 
+The KV axis is a GRID dimension (innermost, with the softmax running state
+carried in VMEM scratch across its iterations) — NOT a whole-sequence VMEM
+block with an in-kernel loop. A [S, D] KV block is 4 MB per operand at
+S=32k, which double-buffered blows the 16 MB scoped-VMEM limit; per-block
+tiles keep VMEM usage constant in S, so 32k+ contexts compile.
+
 Layout: q [B, H, S, D] (head-major so a (q-block, head) grid step is one
 contiguous VMEM tile), kv [B, K_heads, S, D]; GQA maps query head h to kv
 head h // (H // K). Causal + per-row validity masking via the `lengths` [B]
@@ -25,62 +31,70 @@ NEG_INF = -1e30
 def _flash_kernel(
     lengths_ref,  # scalar-prefetch [B]
     q_ref,  # [1, 1, BQ, D]
-    k_ref,  # [1, 1, S, D]
-    v_ref,  # [1, 1, S, D]
+    k_ref,  # [1, 1, BK, D]
+    v_ref,  # [1, 1, BK, D]
     o_ref,  # [1, 1, BQ, D]
+    acc_ref,  # VMEM scratch [BQ, D] f32
+    m_ref,  # VMEM scratch [BQ, 1] f32
+    l_ref,  # VMEM scratch [BQ, 1] f32
     *,
     block_q: int,
     block_k: int,
-    seq_len: int,
+    num_kv_blocks: int,
     scale: float,
 ):
     import jax.experimental.pallas as pl
 
     b = pl.program_id(0)
     qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
     length = lengths_ref[b]
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # [BQ, D]
-    bq = q.shape[0]
+    bq = q_ref.shape[2]
 
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-
-    num_kv_blocks = pl.cdiv(
-        jnp.minimum((qi + 1) * block_q, seq_len), block_k
-    )
-
-    def body(ck, carry):
-        acc, m, l = carry
-        k_blk = k_ref[0, 0, pl.ds(ck * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(ck * block_k, block_k), :].astype(jnp.float32)
+    # Causal: kv blocks entirely above this q block contribute nothing —
+    # skip their (masked-to-NEG_INF) compute.
+    @pl.when(ki * block_k < (qi + 1) * block_q)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # [BQ, D]
+        k_blk = k_ref[0, 0].astype(jnp.float32)  # [BK, D]
+        v_blk = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [BQ, BK]
-        kv_pos = ck * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+        kv_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
         mask = (kv_pos <= q_pos) & (kv_pos < length)
         s = jnp.where(mask, s, NEG_INF)
 
+        m = m_ref[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return acc_new, m_new, l_new
+        m_ref[...] = m_new
 
-    d = q.shape[-1]
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, num_kv_blocks, body, (acc0, m0, l0))
-
-    # Padding query rows (q_pos >= length) attend over the valid prefix and
-    # would emit finite garbage; zero them explicitly so the output contract
-    # is "padded rows are zeros" for any downstream pooling without a mask.
-    q_row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
-    o = jnp.where(q_row < length, acc / jnp.maximum(l, 1e-30), 0.0)
-    o_ref[0, 0] = o.astype(o_ref.dtype)
+    @pl.when(ki == num_kv_blocks - 1)
+    def _finish():
+        # Padding query rows (q_pos >= length) attend over the valid prefix
+        # and would emit finite garbage; zero them explicitly so the output
+        # contract is "padded rows are zeros" for any downstream pooling.
+        q_row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+        o = jnp.where(
+            q_row < length,
+            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30),
+            0.0,
+        )
+        o_ref[0, 0] = o.astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -111,9 +125,11 @@ def flash_prefill_attention(
     kh = k.transpose(0, 2, 1, 3)  # [B, K, S, D]
     vh = v.transpose(0, 2, 1, 3)
 
-    grid = (B, H, S // block_q)
+    num_kv_blocks = S // block_k
+    grid = (B, H, S // block_q, num_kv_blocks)
     kernel = functools.partial(
-        _flash_kernel, block_q=block_q, block_k=block_k, seq_len=S, scale=scale
+        _flash_kernel, block_q=block_q, block_k=block_k,
+        num_kv_blocks=num_kv_blocks, scale=scale,
     )
     out = pl.pallas_call(
         kernel,
@@ -122,11 +138,18 @@ def flash_prefill_attention(
             grid=grid,
             in_specs=[
                 # index maps take (*grid_ids, *scalar_prefetch_refs)
-                pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, *_: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, S, D), lambda b, h, i, *_: (b, h // G, 0, 0)),
-                pl.BlockSpec((1, 1, S, D), lambda b, h, i, *_: (b, h // G, 0, 0)),
+                pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j, *_: (b, h // G, j, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j, *_: (b, h // G, j, 0)),
             ],
-            out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, *_: (b, h, i, 0)),
+            out_specs=pl.BlockSpec(
+                (1, 1, block_q, D), lambda b, h, i, j, *_: (b, h, i, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, D), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+            ],
         ),
         out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
         interpret=interpret,

@@ -61,7 +61,10 @@ function nav(){const n=document.getElementById('nav');n.innerHTML='';
   b.textContent=t;b.className=t===tab?'on':'';b.onclick=()=>{tab=t;render()};n.appendChild(b)}}
 function render(){nav();document.getElementById('main').innerHTML='';TABS[tab](document.getElementById('main'))}
 async function models(uc){const r=await fetch('/v1/models');const d=await r.json();return d.data.map(m=>m.id)}
-function sel(opts,id){return `<select id="${id}">`+opts.map(o=>`<option>${o}</option>`).join('')+`</select>`}
+// All server-sourced strings (model names, gallery entries, job fields) go
+// through esc() before any innerHTML interpolation — they are API-writable.
+function esc(s){return String(s==null?'':s).replace(/[&<>"']/g,c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
+function sel(opts,id){return `<select id="${id}">`+opts.map(o=>`<option>${esc(o)}</option>`).join('')+`</select>`}
 
 function Chat(el){
  el.innerHTML=`<div class="card"><div class="row"><div style="flex:1" id="mslot"></div></div>
@@ -96,9 +99,9 @@ async function Models(el){
  const list=await(await fetch('/v1/models')).json();
  const t=document.getElementById('mt');
  for(const m of list.data){const tr=document.createElement('tr');
-  tr.innerHTML=`<td>${m.id}</td><td class="small">${m.owned_by}</td>
+  tr.innerHTML=`<td>${esc(m.id)}</td><td class="small">${esc(m.owned_by)}</td>
   <td><span class="pill ${loaded.has(m.id)?'loaded':''}">${loaded.has(m.id)?'loaded':'idle'}</span></td>
-  <td>${loaded.has(m.id)?`<button class="act" data-m="${m.id}">unload</button>`:''}</td>`;
+  <td>${loaded.has(m.id)?`<button class="act" data-m="${esc(m.id)}">unload</button>`:''}</td>`;
   t.appendChild(tr)}
  t.onclick=async e=>{const m=e.target.dataset&&e.target.dataset.m;if(!m)return;
   await fetch('/backend/shutdown',{method:'POST',headers:{'Content-Type':'application/json'},body:JSON.stringify({model:m})});
@@ -111,8 +114,8 @@ async function GalleryTab(el){
  try{
   const d=await(await fetch('/models/available')).json();
   if(!d.length){g.textContent='no galleries configured';return}
-  g.innerHTML=`<table>`+d.map(m=>`<tr><td>${m.name}</td><td class="small">${m.description||''}</td>
-   <td><button class="act" data-n="${m.gallery?m.gallery+'@':''}${m.name}">install</button></td></tr>`).join('')+`</table><div id="job"></div>`;
+  g.innerHTML=`<table>`+d.map(m=>`<tr><td>${esc(m.name)}</td><td class="small">${esc(m.description||'')}</td>
+   <td><button class="act" data-n="${esc(m.gallery?m.gallery+'@':'')}${esc(m.name)}">install</button></td></tr>`).join('')+`</table><div id="job"></div>`;
   g.onclick=async e=>{const n=e.target.dataset&&e.target.dataset.n;if(!n)return;
    const r=await(await fetch('/models/apply',{method:'POST',headers:{'Content-Type':'application/json'},body:JSON.stringify({id:n})})).json();
    const poll=async()=>{const s=await(await fetch('/models/jobs/'+r.uuid)).json();
@@ -182,16 +185,17 @@ async function Jobs(el){
  <button class="act" id="jc">Create</button></div>
  <pre class="small" id="jh"></pre></div>`;
  async function refresh(){
+  const t=document.getElementById('jt');
   const r=await fetch('/agent-jobs');
-  if(!r.ok){document.getElementById('jt').outerHTML='<div class="small">agent jobs unavailable (no MCP/agent service)</div>';return}
-  const jobs=(await r.json()).jobs||[];const t=document.getElementById('jt');
+  if(!r.ok){t.innerHTML='<tr><td class="small">agent jobs unavailable (no MCP/agent service)</td></tr>';return}
+  const jobs=(await r.json()).jobs||[];
   t.innerHTML='<tr><th>name</th><th>model</th><th>schedule</th><th>enabled</th><th></th></tr>';
-  for(const j of jobs){const tr=document.createElement('tr');
-   tr.innerHTML=`<td>${j.name}</td><td class="small">${j.model}</td><td class="small">${j.schedule||''}</td>
-   <td><button class="act" data-a="toggle" data-id="${j.id}" data-en="${j.enabled}">${j.enabled?'on':'off'}</button></td>
-   <td><button class="act" data-a="run" data-id="${j.id}">run</button>
-   <button class="act" data-a="hist" data-id="${j.id}">history</button>
-   <button class="act" data-a="del" data-id="${j.id}" style="background:#a33">x</button></td>`;
+  for(const j of jobs){const tr=document.createElement('tr');const id=esc(j.id);
+   tr.innerHTML=`<td>${esc(j.name)}</td><td class="small">${esc(j.model)}</td><td class="small">${esc(j.schedule||'')}</td>
+   <td><button class="act" data-a="toggle" data-id="${id}" data-en="${j.enabled}">${j.enabled?'on':'off'}</button></td>
+   <td><button class="act" data-a="run" data-id="${id}">run</button>
+   <button class="act" data-a="hist" data-id="${id}">history</button>
+   <button class="act" data-a="del" data-id="${id}" style="background:#a33">x</button></td>`;
    t.appendChild(tr)}
   t.onclick=async e=>{const a=e.target.dataset&&e.target.dataset.a;if(!a)return;
    const id=e.target.dataset.id;
@@ -222,7 +226,10 @@ function Talk(el){
  <button class="act" id="tsend" disabled>Send</button></div>
  <div class="small" id="tst">disconnected</div></div>`;
  models().then(ms=>{document.getElementById('tsl').innerHTML=sel(ms,'tkmodel')});
- let ws=null,ac=null,micNode=null,playT=0,out=null;
+ let ws=null,ac=null,micNode=null,micStream=null,playT=0,out=null;
+ function micOff(){if(micNode){micNode.disconnect();micNode=null}
+  if(micStream){micStream.getTracks().forEach(t=>t.stop());micStream=null}
+  const b=document.getElementById('mic');if(b)b.textContent='Mic'}
  const st=t=>{document.getElementById('tst').textContent=t};
  const log=document.getElementById('log');
  function playPcm(b64){
@@ -239,7 +246,8 @@ function Talk(el){
   ws.onopen=()=>{st('connected');document.getElementById('tsend').disabled=false;
    document.getElementById('mic').disabled=false;
    ws.send(JSON.stringify({type:'session.update',session:{turn_detection:{type:'server_vad',silence_duration_ms:500}}}))};
-  ws.onclose=()=>{st('disconnected');ws=null;document.getElementById('tsend').disabled=true;
+  ws.onclose=()=>{st('disconnected');ws=null;micOff();
+   document.getElementById('tsend').disabled=true;
    document.getElementById('mic').disabled=true};
   ws.onmessage=e=>{const ev=JSON.parse(e.data);
    if(ev.type==='conversation.item.created'&&ev.item.role==='user'){
@@ -258,10 +266,10 @@ function Talk(el){
    content:[{type:'input_text',text:t}]}}));
   ws.send(JSON.stringify({type:'response.create'}))};
  document.getElementById('mic').onclick=async()=>{
-  if(micNode){micNode.disconnect();micNode=null;document.getElementById('mic').textContent='Mic';return}
-  const stream=await navigator.mediaDevices.getUserMedia({audio:true});
+  if(micNode){micOff();return}
+  micStream=await navigator.mediaDevices.getUserMedia({audio:true});
   if(!ac)ac=new AudioContext({sampleRate:24000});
-  const src=ac.createMediaStreamSource(stream);
+  const src=ac.createMediaStreamSource(micStream);
   micNode=ac.createScriptProcessor(4096,1,1);
   micNode.onaudioprocess=e=>{if(!ws)return;
    const f=e.inputBuffer.getChannelData(0);const b=new Int16Array(f.length);

@@ -222,7 +222,7 @@ def test_builtin_starter_gallery_parses():
     known_backends = {
         "llama", "bert", "whisper", "tts", "vad", "diffusers", "diffusion",
         "stablediffusion", "detection", "llava", "vlm", "multimodal",
-        "remote", "subprocess",
+        "musicgen", "remote", "subprocess",
     }
     names = set()
     for e in entries:
