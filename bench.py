@@ -506,9 +506,12 @@ def main() -> None:
             eng_long.warmup(len(long_prompt))
             eng_long._decode_time = 0.0
             eng_long._decode_tokens = 0
-            _, ev = eng_long.generate(long_prompt, max_new_tokens=32, ignore_eos=True)
-            ltps = (eng_long._decode_tokens / eng_long._decode_time
-                    if eng_long._decode_time else 0.0)
+            _, ev = eng_long.generate(long_prompt, max_new_tokens=64, ignore_eos=True)
+            # decode_time spans the whole active window INCLUDING the
+            # multi-second 32k prefill; subtract it or the row reports the
+            # prefill, not decode-at-full-context.
+            ldec = max(eng_long._decode_time - ev.timing_prompt_processing, 1e-9)
+            ltps = eng_long._decode_tokens / ldec
             out["long_ctx_prompt_tokens"] = len(long_prompt)
             out["long_ctx_paged"] = True
             out["long_ctx_prefill_ms"] = round(ev.timing_prompt_processing * 1000, 1)
