@@ -226,16 +226,21 @@ def main() -> None:
             mixed_round()  # compile/warm the dfa+filtered block variants
             eng._decode_time = 0.0
             eng._decode_tokens = 0
+            dfa0 = eng.m_dfa_tokens
             t0 = time.time()
             mixed_round()
             mixed_wall = time.time() - t0
             mtps = (eng._decode_tokens / eng._decode_time
                     if eng._decode_time else 0.0)
             out["grammar_mixed_bs_decode_tps"] = round(mtps, 1)
+            # Attribution for run variance: did every constrained slot ride
+            # the device DFA (tokens accrue), or did one fall to the
+            # host-walk path (single-step serialized blocks)?
+            out["grammar_mixed_dfa_tokens"] = int(eng.m_dfa_tokens - dfa0)
             print(
                 f"mixed constrained bs{slots}: {mtps:.1f} tok/s decode "
                 f"({slots // 2} DFA + {slots - slots // 2} free slots, "
-                f"wall {mixed_wall:.2f}s)",
+                f"wall {mixed_wall:.2f}s, dfa_tokens {eng.m_dfa_tokens - dfa0})",
                 file=sys.stderr,
             )
         except Exception as e:  # noqa: BLE001 — extra row is best-effort

@@ -54,8 +54,13 @@ def prefill_attention(
     ):
         from localai_tpu.ops.flash import flash_prefill_attention
 
-        blk = min(128, S)
-        return flash_prefill_attention(q, k, v, lengths, block_q=blk, block_k=blk)
+        # Bigger tiles at long context: the kernel grid is
+        # B·H·(S/bq)·(S/bk) steps, and per-step fixed cost dominates past
+        # ~8k (a 32k prefill at 128x128 tiles is ~1M grid steps). VMEM per
+        # step stays tiny (bq·D + 2·bk·D floats).
+        bq = min(256, S)
+        bk = min(512, S)
+        return flash_prefill_attention(q, k, v, lengths, block_q=bq, block_k=bk)
     return causal_prefill_attention(q, k, v, length_mask, softcap=softcap,
                                     window=window, sliding=sliding)
 

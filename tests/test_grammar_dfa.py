@@ -296,3 +296,29 @@ def test_engine_legacy_fallback(engine, monkeypatch):
     obj = json.loads(text)
     assert isinstance(obj["a"], int) and isinstance(obj["b"], bool)
     assert engine.m_dfa_tokens == before  # DFA untouched
+
+
+def test_mixed_batch_always_rides_the_dfa(engine):
+    """Stress the suspected race behind BENCH's mixed-row variance: rounds
+    of simultaneous constrained + unconstrained submissions must ALWAYS
+    engage the device DFA for the constrained slots (a single slot falling
+    to the host walk serializes everyone into single-step blocks)."""
+    import threading
+
+    assert engine.prewarm_grammar(SCHEMAS[1])
+    for rnd in range(6):
+        before = engine.m_dfa_tokens
+        ths = []
+        for i in range(4):
+            kw = dict(max_new_tokens=24, ignore_eos=True, temperature=0.0)
+            if i % 2 == 0:
+                kw = dict(max_new_tokens=24,
+                          grammar=GrammarConstraint(SCHEMAS[1]))
+            ids = [3 + rnd, 5 + i, 9]
+            ths.append(threading.Thread(
+                target=lambda ids=ids, kw=kw: engine.generate(ids, **kw)))
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        assert engine.m_dfa_tokens > before, f"round {rnd}: DFA never engaged"
