@@ -395,6 +395,8 @@ class LatentDiffusionEngine:
         size: Optional[tuple[int, int]] = None,
         negative_prompt: str = "",
         scheduler: Optional[str] = None,
+        control_image: Optional[np.ndarray] = None,  # uint8 [H, W, 3]
+        control_scale: float = 1.0,
         _init_noise=None,
         _known=None,  # (known_latent, known_mask) for inpainting
     ) -> list[np.ndarray]:
@@ -408,21 +410,30 @@ class LatentDiffusionEngine:
         is_xl = self.cfg.is_xl
         cond2 = self._ids(prompt, n, second=True) if is_xl else None
         uncond2 = self._ids(negative_prompt or "", n, second=True) if is_xl else None
+        ctrl = None
+        if control_image is not None:
+            if "controlnet" not in self.params:
+                raise ValueError("this checkpoint has no controlnet/ weights")
+            ci = np.asarray(
+                Image.fromarray(np.asarray(control_image, np.uint8))
+                .resize((gw, gh), Image.BILINEAR), np.float32) / 255.0
+            ctrl = jnp.broadcast_to(jnp.asarray(ci)[None], (n, gh, gw, 3))
         key = jax.random.key(0 if seed is None else int(seed) & 0x7FFFFFFF)
         with self._lock:
             jkey = (n, steps, gw, gh, sched, _known is not None,
-                    _init_noise is not None)
+                    _init_noise is not None, ctrl is not None)
             fn = self._jit.get(jkey)
             if fn is None:
                 cfg, ld = self.cfg, self._ld
 
                 def run(p, c, u, k, g, noise=None, kl=None, km=None,
-                        c2=None, u2=None):
+                        c2=None, u2=None, ci=None, cs=1.0):
                     return ld.generate(
                         cfg, p, c, u, k, steps=steps, guidance=g,
                         height=gh, width=gw, scheduler=sched,
                         init_noise=noise, known_latent=kl, known_mask=km,
                         cond_ids2=c2, uncond_ids2=u2,
+                        control_image=ci, control_scale=cs,
                     )
 
                 fn = jax.jit(run)
@@ -443,6 +454,8 @@ class LatentDiffusionEngine:
                 kw["kl"], kw["km"] = _known
             if is_xl:
                 kw["c2"], kw["u2"] = cond2, uncond2
+            if ctrl is not None:
+                kw["ci"], kw["cs"] = ctrl, jnp.float32(control_scale)
             imgs = np.asarray(fn(*args, **kw))
         out = []
         for i in range(n):

@@ -338,13 +338,18 @@ def _spatial_transformer(p: Params, pre: str, x: jnp.ndarray, ctx: jnp.ndarray,
 def unet_forward(cfg: UNetConfig, p: Params, sample: jnp.ndarray,
                  t: jnp.ndarray, ctx: jnp.ndarray,
                  added_text: Optional[jnp.ndarray] = None,
-                 added_time_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 added_time_ids: Optional[jnp.ndarray] = None,
+                 ctrl_residuals: Optional[tuple] = None) -> jnp.ndarray:
     """sample [B, H, W, C_lat], t [B], ctx [B, S, C_txt] → eps/v pred.
 
     SDXL micro-conditioning (addition_embed_type "text_time"): added_text
     [B, 1280] (encoder-2 pooled projection) and added_time_ids [B, 6]
     (orig_h, orig_w, crop_top, crop_left, target_h, target_w) are fourier-
-    embedded and added into the time embedding."""
+    embedded and added into the time embedding.
+
+    ctrl_residuals: (down_residuals list, mid_residual) from
+    controlnet_forward — added to the matching skip connections and the mid
+    block output (diffusers ControlNetModel consumption contract)."""
     g = cfg.norm_num_groups
     temb = get_timestep_embedding(
         t, cfg.block_out_channels[0], cfg.flip_sin_to_cos, cfg.freq_shift
@@ -380,6 +385,10 @@ def unet_forward(cfg: UNetConfig, p: Params, sample: jnp.ndarray,
                       p[f"{pre}.downsamplers.0.conv.bias"], stride=2)
             skips.append(h)
 
+    if ctrl_residuals is not None:
+        down_res, mid_res = ctrl_residuals
+        skips = [s + r for s, r in zip(skips, down_res)]
+
     last = len(cfg.block_out_channels) - 1
     h = _resnet(p, "mid_block.resnets.0", h, temb, g)
     h = _spatial_transformer(
@@ -387,6 +396,8 @@ def unet_forward(cfg: UNetConfig, p: Params, sample: jnp.ndarray,
         cfg.heads_for(last), g, cfg.tx_depth_for(last),
     )
     h = _resnet(p, "mid_block.resnets.1", h, temb, g)
+    if ctrl_residuals is not None:
+        h = h + mid_res
 
     for bi, btype in enumerate(cfg.up_block_types):
         pre = f"up_blocks.{bi}"
@@ -408,6 +419,76 @@ def unet_forward(cfg: UNetConfig, p: Params, sample: jnp.ndarray,
 
     h = _group_norm(h, p["conv_norm_out.weight"], p["conv_norm_out.bias"], g)
     return _conv(jax.nn.silu(h), p["conv_out.weight"], p["conv_out.bias"])
+
+
+def controlnet_forward(cfg: UNetConfig, p: Params, sample: jnp.ndarray,
+                       t: jnp.ndarray, ctx: jnp.ndarray, cond: jnp.ndarray,
+                       scale: float = 1.0) -> tuple:
+    """diffusers ControlNetModel: a copy of the UNet encoder whose skip
+    outputs pass through zero-initialized 1x1 convs, plus a small conv
+    tower embedding the PIXEL-SPACE condition image into latent resolution.
+
+    sample [B, h, w, C_lat]; cond [B, 8·h?, 8·w?, 3] in [0, 1] (the control
+    image at pixel resolution); returns (down_residuals, mid_residual) for
+    unet_forward's ctrl_residuals."""
+    g = cfg.norm_num_groups
+    temb = get_timestep_embedding(
+        t, cfg.block_out_channels[0], cfg.flip_sin_to_cos, cfg.freq_shift
+    ).astype(sample.dtype)
+    temb = _linear(temb, p, "time_embedding.linear_1")
+    temb = _linear(jax.nn.silu(temb), p, "time_embedding.linear_2")
+
+    # Condition embedding tower: stride-2 conv pairs down to latent res,
+    # final conv zero-initialized at training start.
+    c = _conv(cond.astype(sample.dtype),
+              p["controlnet_cond_embedding.conv_in.weight"],
+              p["controlnet_cond_embedding.conv_in.bias"])
+    c = jax.nn.silu(c)
+    nblk = 0
+    while f"controlnet_cond_embedding.blocks.{nblk}.weight" in p:
+        nblk += 1
+    for i in range(nblk):
+        stride = 2 if i % 2 == 1 else 1  # diffusers alternates ch-up, down-2
+        c = _conv(c, p[f"controlnet_cond_embedding.blocks.{i}.weight"],
+                  p[f"controlnet_cond_embedding.blocks.{i}.bias"], stride=stride)
+        c = jax.nn.silu(c)
+    c = _conv(c, p["controlnet_cond_embedding.conv_out.weight"],
+              p["controlnet_cond_embedding.conv_out.bias"])
+
+    h = _conv(sample, p["conv_in.weight"], p["conv_in.bias"]) + c
+    skips = [h]
+    for bi, btype in enumerate(cfg.down_block_types):
+        pre = f"down_blocks.{bi}"
+        heads = cfg.heads_for(bi)
+        for li in range(cfg.layers_per_block):
+            h = _resnet(p, f"{pre}.resnets.{li}", h, temb, g)
+            if btype == "CrossAttnDownBlock2D":
+                h = _spatial_transformer(
+                    p, f"{pre}.attentions.{li}", h, ctx, heads, g,
+                    cfg.tx_depth_for(bi),
+                )
+            skips.append(h)
+        if f"{pre}.downsamplers.0.conv.weight" in p:
+            h = _conv(h, p[f"{pre}.downsamplers.0.conv.weight"],
+                      p[f"{pre}.downsamplers.0.conv.bias"], stride=2)
+            skips.append(h)
+
+    last = len(cfg.block_out_channels) - 1
+    h = _resnet(p, "mid_block.resnets.0", h, temb, g)
+    h = _spatial_transformer(
+        p, "mid_block.attentions.0", h, ctx,
+        cfg.heads_for(last), g, cfg.tx_depth_for(last),
+    )
+    h = _resnet(p, "mid_block.resnets.1", h, temb, g)
+
+    down = [
+        scale * _conv(s, p[f"controlnet_down_blocks.{i}.weight"],
+                      p[f"controlnet_down_blocks.{i}.bias"], pad=0)
+        for i, s in enumerate(skips)
+    ]
+    mid = scale * _conv(h, p["controlnet_mid_block.weight"],
+                        p["controlnet_mid_block.bias"], pad=0)
+    return down, mid
 
 
 # --------------------------------------------------------------------------- #
@@ -600,6 +681,8 @@ def generate(
     known_mask: Optional[jnp.ndarray] = None,  # [B, h/8, w/8, 1]; 1 = repaint
     cond_ids2: Optional[jnp.ndarray] = None,  # SDXL: tokenizer_2 ids
     uncond_ids2: Optional[jnp.ndarray] = None,
+    control_image: Optional[jnp.ndarray] = None,  # [B, H, W, 3] in [0,1]
+    control_scale: float = 1.0,
 ) -> jnp.ndarray:
     """Full text→image pipeline; returns [B, H, W, 3] float32 in [0,1].
     jit-able: shapes depend only on (B, steps, H, W, scheduler).
@@ -647,13 +730,24 @@ def generate(
         nk, (B, lat_h, lat_w, lat_c), jnp.float32
     )
 
+    use_ctrl = control_image is not None and "controlnet" in params
+    ctrl_cond2 = (jnp.concatenate([control_image, control_image], axis=0)
+                  if use_ctrl else None)
+
     def cfg_eps(x_in, t):
         both = jnp.concatenate([x_in, x_in], axis=0)
         tt = jnp.full((2 * B,), t, jnp.float32)
+        ctrl = None
+        if use_ctrl:
+            ctrl = controlnet_forward(
+                cfg.unet, params["controlnet"], both, tt, ctx, ctrl_cond2,
+                scale=control_scale,
+            )
         out = unet_forward(
             cfg.unet, params["unet"], both, tt, ctx,
             added_text=added[0] if added else None,
             added_time_ids=added[1] if added else None,
+            ctrl_residuals=ctrl,
         )
         eps_u, eps_c = jnp.split(out, 2, axis=0)
         return eps_u + guidance * (eps_c - eps_u)
@@ -890,6 +984,13 @@ def load_pipeline(ckpt_dir: str, dtype=jnp.float32):
             return CLIPTokenizer.from_pretrained(tok_dir, local_files_only=True)
 
     tokenizer = load_tok("tokenizer")
+
+    # ControlNet: a `controlnet/` subdir in the checkpoint (the diffusers
+    # StableDiffusionControlNetPipeline save layout). Its encoder copies the
+    # UNet's geometry, so cfg.unet describes both.
+    ctrl_dir = os.path.join(ckpt_dir, "controlnet")
+    if os.path.isdir(ctrl_dir):
+        params["controlnet"] = _prep(_load_safetensors_dir(ctrl_dir), dtype)
 
     # SDXL layout: a second (OpenCLIP-bigG-class) text encoder + tokenizer.
     te2 = os.path.join(ckpt_dir, "text_encoder_2")

@@ -65,12 +65,30 @@ class ImageApi:
         size = self._parse_size(body)
         response_format = body.get("response_format") or "url"
 
+        kw = {}
+        if body.get("control_image"):
+            # ControlNet conditioning (diffusers ControlNet pipelines; the
+            # checkpoint must ship a controlnet/ subdir): base64 PNG/JPEG.
+            import numpy as np
+
+            try:
+                blob = base64.b64decode(body["control_image"])
+                kw["control_image"] = np.asarray(
+                    Image.open(io.BytesIO(blob)).convert("RGB"))
+            except Exception as e:  # noqa: BLE001
+                raise ApiError(400, f"control_image is not a decodable image: {e}") from None
+            if body.get("control_scale") is not None:
+                kw["control_scale"] = float(body["control_scale"])
+
         lm, lease = self._base._resolve(req, Usecase.IMAGE)
         try:
             images = lm.engine.generate(
                 prompt, n=n, steps=steps, seed=body.get("seed"), size=size,
-                guidance=float(body.get("guidance_scale") or 4.0),
+                guidance=float(body.get("guidance_scale") or 4.0), **kw,
             )
+        except (ValueError, TypeError) as e:
+            # e.g. control_image against a checkpoint without controlnet/
+            raise ApiError(400, str(e)) from None
         finally:
             lease.release()
 

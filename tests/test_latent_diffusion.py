@@ -532,3 +532,115 @@ def test_sdxl_engine_end_to_end(sdxl_dir):
     assert imgs[0].shape == (32, 32, 3) and imgs[0].dtype == np.uint8
     imgs2 = eng.generate("a cat", n=1, steps=2, seed=5, size=(32, 32))
     np.testing.assert_array_equal(imgs[0], imgs2[0])
+
+
+# --------------------------------------------------------------------------- #
+# ControlNet (diffusers ControlNetModel layout; VERDICT r3 missing #5 tail)
+# --------------------------------------------------------------------------- #
+
+
+def gen_controlnet() -> dict[str, np.ndarray]:
+    """Tiny ControlNet matching the sd_dir UNet's encoder geometry, with the
+    published tensor names: cond-embedding tower, encoder copy, zero convs."""
+    g = _Gen(30)
+    b0, b1 = UNET_BLOCKS
+    temb = b0 * 4
+    g.lin("time_embedding.linear_1", b0, temb)
+    g.lin("time_embedding.linear_2", temb, temb)
+    g.conv("conv_in", 4, b0)
+    # cond embedding: conv_in 3->8, blocks (8->8, 8->16 s2), conv_out 16->b0
+    g.conv("controlnet_cond_embedding.conv_in", 3, 8)
+    g.conv("controlnet_cond_embedding.blocks.0", 8, 8)
+    g.conv("controlnet_cond_embedding.blocks.1", 8, 16)
+    g.conv("controlnet_cond_embedding.conv_out", 16, b0)
+    # encoder copy (mirrors gen_unet's down path)
+    skips = [b0]
+    g.resnet("down_blocks.0.resnets.0", b0, b0, temb)
+    g.spatial_transformer("down_blocks.0.attentions.0", b0, TEXT_DIM)
+    skips.append(b0)
+    g.conv("down_blocks.0.downsamplers.0.conv", b0, b0)
+    skips.append(b0)
+    g.resnet("down_blocks.1.resnets.0", b0, b1, temb)
+    skips.append(b1)
+    g.resnet("mid_block.resnets.0", b1, b1, temb)
+    g.spatial_transformer("mid_block.attentions.0", b1, TEXT_DIM)
+    g.resnet("mid_block.resnets.1", b1, b1, temb)
+    for i, c in enumerate(skips):
+        g.conv(f"controlnet_down_blocks.{i}", c, c, k=1)
+    g.conv("controlnet_mid_block", b1, b1, k=1)
+    return g.P
+
+
+@pytest.fixture(scope="module")
+def sd_controlnet_dir(sd_dir, tmp_path_factory):
+    """sd_dir + a controlnet/ subdir (StableDiffusionControlNetPipeline
+    save layout)."""
+    import shutil
+
+    d = tmp_path_factory.mktemp("tiny-sd-ctrl")
+    shutil.copytree(sd_dir, str(d), dirs_exist_ok=True)
+    _save_st(str(d / "controlnet" / "diffusion_pytorch_model.safetensors"),
+             gen_controlnet())
+    (d / "controlnet" / "config.json").write_text(json.dumps(
+        {"_class_name": "ControlNetModel"}))
+    return str(d)
+
+
+def test_controlnet_conditions_the_image(sd_controlnet_dir):
+    """A control image must change the output (and a zeroed zero-conv set
+    must NOT — the ControlNet residual contract); deterministic per seed."""
+    cfg, params, tok = ld.load_pipeline(sd_controlnet_dir)
+    assert "controlnet" in params
+    ids = jnp.asarray(tok("a photo of a cat", padding="max_length",
+                          max_length=77, truncation=True)["input_ids"],
+                      jnp.int32)[None]
+    un = jnp.asarray(tok("", padding="max_length", max_length=77,
+                         truncation=True)["input_ids"], jnp.int32)[None]
+    rngimg = np.random.default_rng(0)
+    ctrl = jnp.asarray(rngimg.random((1, 64, 64, 3)), jnp.float32)
+
+    base = np.asarray(ld.generate(cfg, params, ids, un, jax.random.key(5),
+                                  steps=2, height=64, width=64))
+    with_ctrl = np.asarray(ld.generate(
+        cfg, params, ids, un, jax.random.key(5), steps=2, height=64,
+        width=64, control_image=ctrl))
+    assert with_ctrl.shape == base.shape
+    assert np.isfinite(with_ctrl).all()
+    assert np.abs(with_ctrl - base).max() > 1e-4, "controlnet had no effect"
+    again = np.asarray(ld.generate(
+        cfg, params, ids, un, jax.random.key(5), steps=2, height=64,
+        width=64, control_image=ctrl))
+    np.testing.assert_array_equal(with_ctrl, again)
+
+    # zero the output convs: residuals vanish -> exactly the base image
+    import copy as _copy
+
+    pz = dict(params)
+    pz["controlnet"] = {
+        k: (jnp.zeros_like(v) if "controlnet_down_blocks" in k
+            or "controlnet_mid_block" in k else v)
+        for k, v in params["controlnet"].items()
+    }
+    zeroed = np.asarray(ld.generate(
+        cfg, pz, ids, un, jax.random.key(5), steps=2, height=64,
+        width=64, control_image=ctrl))
+    np.testing.assert_allclose(zeroed, base, atol=1e-5)
+
+
+def test_controlnet_engine_and_api(sd_controlnet_dir):
+    from localai_tpu.engine.image_engine import LatentDiffusionEngine
+
+    cfg, params, tok = ld.load_pipeline(sd_controlnet_dir)
+    eng = LatentDiffusionEngine(cfg, params, tok)
+    ctrl = (np.random.default_rng(1).random((48, 48, 3)) * 255).astype(np.uint8)
+    a = eng.generate("a cat", n=1, steps=2, seed=3, size=(64, 64),
+                     control_image=ctrl)
+    b = eng.generate("a cat", n=1, steps=2, seed=3, size=(64, 64))
+    assert a[0].shape == b[0].shape == (64, 64, 3)
+    assert np.abs(a[0].astype(int) - b[0].astype(int)).max() > 0
+
+    # control_image against a checkpoint without controlnet weights -> error
+    p2 = {k: v for k, v in params.items() if k != "controlnet"}
+    eng2 = LatentDiffusionEngine(cfg, p2, tok)
+    with pytest.raises(ValueError):
+        eng2.generate("a cat", n=1, steps=2, control_image=ctrl)
