@@ -615,6 +615,29 @@ def euler_a_sigmas(cfg: SDPipelineConfig, steps: int) -> np.ndarray:
     return np.append(sigmas, 0.0).astype(np.float32)
 
 
+def k_schedule(cfg: SDPipelineConfig, steps: int, karras: bool):
+    """(sigmas [steps+1], timesteps [steps]) for the k-diffusion samplers.
+
+    karras=True uses the Karras et al. (2022) rho-7 spacing over the
+    model's trained sigma range (diffusers use_karras_sigmas; what the
+    *_karras scheduler names select); timesteps come back from inverting
+    the training sigma table so the model is queried at the right t."""
+    acp = alphas_cumprod(cfg)
+    sig = np.sqrt((1 - acp) / acp)
+    if not karras:
+        ts = ddim_timesteps(cfg, steps).astype(np.float64)
+        sigmas = np.interp(ts, np.arange(len(sig)), sig)
+        return (np.append(sigmas, 0.0).astype(np.float32),
+                ts.astype(np.float32))
+    rho = 7.0
+    smin, smax = float(sig[0]), float(sig[-1])
+    ramp = np.linspace(0.0, 1.0, steps)
+    sigmas = (smax ** (1 / rho) + ramp * (smin ** (1 / rho) - smax ** (1 / rho))) ** rho
+    # invert the (monotonic) training sigma table: sigma -> fractional t
+    ts = np.interp(np.log(sigmas), np.log(sig), np.arange(len(sig)))
+    return (np.append(sigmas, 0.0).astype(np.float32), ts.astype(np.float32))
+
+
 def euler_a_step(model_out, x, sigma, sigma_next, noise):
     """k-diffusion Euler-ancestral over eps-prediction in sigma space."""
     mo = model_out.astype(jnp.float32)
@@ -764,15 +787,19 @@ def generate(
         return known_mask * xc + (1.0 - known_mask) * noised.astype(xc.dtype)
 
     k_schedulers = ("euler_a", "dpmpp_2m", "heun", "lms")
-    if scheduler not in k_schedulers + ("ddim",):
+    karras = scheduler.endswith("_karras")
+    base_sched = scheduler[: -len("_karras")] if karras else scheduler
+    if base_sched not in k_schedulers + ("ddim",) or (karras and base_sched == "ddim"):
         raise ValueError(
             f"unknown scheduler {scheduler!r} (supported: ddim, "
-            + ", ".join(k_schedulers) + ")"
+            + ", ".join(k_schedulers)
+            + ", " + ", ".join(s + "_karras" for s in k_schedulers) + ")"
         )
+    scheduler = base_sched
     if scheduler in k_schedulers:
-        sigmas_np = euler_a_sigmas(cfg, steps)
+        sigmas_np, ts_np = k_schedule(cfg, steps, karras)
         sigmas = jnp.asarray(sigmas_np)
-        ts = jnp.asarray(ddim_timesteps(cfg, steps).astype(np.float32))
+        ts = jnp.asarray(ts_np)
         x = x * sigmas[0]
 
         def denoised_at(xc, i):

@@ -272,7 +272,8 @@ def test_generate_shapes_determinism_and_schedulers(sd_dir):
                       jnp.int32)[None]
     un = jnp.asarray(tok("", padding="max_length", max_length=77,
                          truncation=True)["input_ids"], jnp.int32)[None]
-    for sched in ("ddim", "euler_a", "dpmpp_2m", "heun", "lms"):
+    for sched in ("ddim", "euler_a", "dpmpp_2m", "heun", "lms",
+                  "dpmpp_2m_karras", "euler_a_karras", "lms_karras"):
         img1 = np.asarray(ld.generate(
             cfg, params, ids, un, jax.random.key(7), steps=4,
             height=64, width=64, scheduler=sched,
@@ -285,9 +286,10 @@ def test_generate_shapes_determinism_and_schedulers(sd_dir):
             height=64, width=64, scheduler=sched,
         ))
         np.testing.assert_array_equal(img1, img2)  # same seed → same image
-    with pytest.raises(ValueError):
-        ld.generate(cfg, params, ids, un, jax.random.key(7), steps=2,
-                    height=64, width=64, scheduler="pndm-nope")
+    for bad in ("pndm-nope", "ddim_karras"):
+        with pytest.raises(ValueError):
+            ld.generate(cfg, params, ids, un, jax.random.key(7), steps=2,
+                        height=64, width=64, scheduler=bad)
 
 
 def test_vae_encode_decode_roundtrip_shapes(sd_dir):
