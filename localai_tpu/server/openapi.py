@@ -29,6 +29,8 @@ _BODY_SCHEMAS: dict[str, dict[str, Any]] = {
             "response_format": {"type": "object"},
             "seed": {"type": "integer"},
             "stop": {"type": "array", "items": {"type": "string"}},
+            "grammar": {"type": "string",
+                        "description": "raw GBNF grammar constraining output"},
         },
     },
     "/v1/completions": {
@@ -41,6 +43,8 @@ _BODY_SCHEMAS: dict[str, dict[str, Any]] = {
             "n": {"type": "integer"},
             "logprobs": {"type": "integer"},
             "echo": {"type": "boolean"},
+            "grammar": {"type": "string",
+                        "description": "raw GBNF grammar constraining output"},
         },
     },
     "/v1/embeddings": {
@@ -57,6 +61,19 @@ _BODY_SCHEMAS: dict[str, dict[str, Any]] = {
             "n": {"type": "integer"}, "size": {"type": "string"},
             "steps": {"type": "integer"}, "seed": {"type": "integer"},
             "response_format": {"type": "string", "enum": ["url", "b64_json"]},
+            "control_image": {"type": "string",
+                              "description": "base64 PNG/JPEG ControlNet condition"},
+            "control_scale": {"type": "number"},
+        },
+    },
+    "/v1/sound-generation": {
+        "required": ["text"],
+        "properties": {
+            "model_id": {"type": "string"}, "text": {"type": "string"},
+            "duration_seconds": {"type": "number"},
+            "prompt_influence": {"type": "number"},
+            "do_sample": {"type": "boolean"}, "seed": {"type": "integer"},
+            "response_format": {"type": "string", "enum": ["wav", "pcm"]},
         },
     },
     "/v1/audio/speech": {
