@@ -167,13 +167,17 @@ def main() -> None:
             eng.prewarm_grammar(g_schema)  # sync table build (async otherwise)
 
             def g_run(env_val, n=3):
+                # greedy: constrained completion length is content-dependent
+                # and unseeded sampling made this row swing 3x run-to-run
                 os.environ["LOCALAI_GRAMMAR_DFA"] = env_val
                 eng.generate([1, 2, 3], max_new_tokens=96, ignore_eos=False,
+                             temperature=0.0,
                              grammar=GrammarConstraint(g_schema))  # compile
                 t0 = time.time()
                 toks0 = eng.m_generated_tokens
                 for i in range(n):
                     eng.generate([1, 2, 3 + i], max_new_tokens=96,
+                                 temperature=0.0,
                                  grammar=GrammarConstraint(g_schema))
                 toks = max(eng.m_generated_tokens - toks0, 1)
                 return toks / (time.time() - t0)
@@ -211,9 +215,11 @@ def main() -> None:
             def mixed_round():
                 hs = []
                 for i in range(slots):
-                    kw = dict(max_new_tokens=gen_len, ignore_eos=True)
+                    kw = dict(max_new_tokens=gen_len, ignore_eos=True,
+                              temperature=0.0)
                     if i % 2 == 0:
-                        kw = dict(max_new_tokens=gen_len,
+                        # greedy: run-to-run comparability (see g_run note)
+                        kw = dict(max_new_tokens=gen_len, temperature=0.0,
                                   grammar=GrammarConstraint(g_schema))
                     ids = [(i * 31 + j) % 255 + 1 for j in range(8)]
                     hs.append(threading.Thread(
