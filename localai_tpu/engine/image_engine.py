@@ -397,6 +397,8 @@ class LatentDiffusionEngine:
         scheduler: Optional[str] = None,
         control_image: Optional[np.ndarray] = None,  # uint8 [H, W, 3]
         control_scale: float = 1.0,
+        init_image: Optional[np.ndarray] = None,  # img2img source, uint8
+        strength: float = 0.8,
         _init_noise=None,
         _known=None,  # (known_latent, known_mask) for inpainting
     ) -> list[np.ndarray]:
@@ -418,22 +420,34 @@ class LatentDiffusionEngine:
                 Image.fromarray(np.asarray(control_image, np.uint8))
                 .resize((gw, gh), Image.BILINEAR), np.float32) / 255.0
             ctrl = jnp.broadcast_to(jnp.asarray(ci)[None], (n, gh, gw, 3))
+        init = None
+        if init_image is not None:
+            strength = min(max(float(strength), 0.0), 1.0)
+            src = np.asarray(
+                Image.fromarray(np.asarray(init_image, np.uint8))
+                .resize((gw, gh), Image.BILINEAR), np.float32) / 255.0
+            init = jnp.broadcast_to(jnp.asarray(src)[None], (n, gh, gw, 3))
         key = jax.random.key(0 if seed is None else int(seed) & 0x7FFFFFFF)
         with self._lock:
+            # strength is static under jit (it fixes the scan range)
             jkey = (n, steps, gw, gh, sched, _known is not None,
-                    _init_noise is not None, ctrl is not None)
+                    _init_noise is not None, ctrl is not None,
+                    (round(strength, 3) if init is not None else None))
             fn = self._jit.get(jkey)
             if fn is None:
                 cfg, ld = self.cfg, self._ld
 
+                stren = float(strength)
+
                 def run(p, c, u, k, g, noise=None, kl=None, km=None,
-                        c2=None, u2=None, ci=None, cs=1.0):
+                        c2=None, u2=None, ci=None, cs=1.0, src=None):
                     return ld.generate(
                         cfg, p, c, u, k, steps=steps, guidance=g,
                         height=gh, width=gw, scheduler=sched,
                         init_noise=noise, known_latent=kl, known_mask=km,
                         cond_ids2=c2, uncond_ids2=u2,
                         control_image=ci, control_scale=cs,
+                        init_image=src, strength=stren,
                     )
 
                 fn = jax.jit(run)
@@ -456,6 +470,8 @@ class LatentDiffusionEngine:
                 kw["c2"], kw["u2"] = cond2, uncond2
             if ctrl is not None:
                 kw["ci"], kw["cs"] = ctrl, jnp.float32(control_scale)
+            if init is not None:
+                kw["src"] = init
             imgs = np.asarray(fn(*args, **kw))
         out = []
         for i in range(n):

@@ -706,6 +706,8 @@ def generate(
     uncond_ids2: Optional[jnp.ndarray] = None,
     control_image: Optional[jnp.ndarray] = None,  # [B, H, W, 3] in [0,1]
     control_scale: float = 1.0,
+    init_image: Optional[jnp.ndarray] = None,  # img2img source [B, H, W, 3]
+    strength: float = 0.8,  # img2img: fraction of the schedule re-noised
 ) -> jnp.ndarray:
     """Full text→image pipeline; returns [B, H, W, 3] float32 in [0,1].
     jit-able: shapes depend only on (B, steps, H, W, scheduler).
@@ -752,6 +754,14 @@ def generate(
     x = init_noise if init_noise is not None else jax.random.normal(
         nk, (B, lat_h, lat_w, lat_c), jnp.float32
     )
+    # img2img: encode the source, start `strength` of the way up the noise
+    # schedule and run only the remaining steps (diffusers
+    # StableDiffusionImg2ImgPipeline semantics; reference backend.py:198).
+    i0 = 0
+    init_lat = None
+    if init_image is not None:
+        i0 = steps - max(1, min(steps, int(round(steps * strength))))
+        init_lat = vae_encode(cfg.vae, params["vae"], init_image)
 
     use_ctrl = control_image is not None and "controlnet" in params
     ctrl_cond2 = (jnp.concatenate([control_image, control_image], axis=0)
@@ -800,7 +810,10 @@ def generate(
         sigmas_np, ts_np = k_schedule(cfg, steps, karras)
         sigmas = jnp.asarray(sigmas_np)
         ts = jnp.asarray(ts_np)
-        x = x * sigmas[0]
+        if init_lat is not None:
+            x = init_lat + x * sigmas[i0]
+        else:
+            x = x * sigmas[0]
 
         def denoised_at(xc, i):
             sig = sigmas[i]
@@ -819,7 +832,7 @@ def generate(
                 noise = jax.random.normal(nk2, xc.shape, jnp.float32)
                 return (euler_a_step(eps, xc, sig, sig_n, noise), k), None
 
-            (x, _), _ = jax.lax.scan(step, (x, key), jnp.arange(steps))
+            (x, _), _ = jax.lax.scan(step, (x, key), jnp.arange(i0, steps))
         elif scheduler == "dpmpp_2m":
             # DPM-Solver++(2M): deterministic multistep over λ = −log σ
             # (k-diffusion sample_dpmpp_2m; first and last steps are 1st
@@ -834,14 +847,14 @@ def generate(
                 h_last = t_c - (-jnp.log(sig_prev))
                 r = h_last / h
                 den_d = (1 + 1 / (2 * r)) * den - (1 / (2 * r)) * old_d
-                use_first = (i == 0) | (sig_n == 0.0)
+                use_first = (i == i0) | (sig_n == 0.0)
                 den_use = jnp.where(use_first, den, den_d)
                 xn = (sig_n / sig) * xc.astype(jnp.float32) \
                     - jnp.expm1(-h) * den_use
                 return (xn.astype(xc.dtype), den), None
 
             (x, _), _ = jax.lax.scan(step, (x, jnp.zeros_like(x)),
-                                     jnp.arange(steps))
+                                     jnp.arange(i0, steps))
         elif scheduler == "heun":
             # Heun's 2nd order (k-diffusion sample_heun, churn 0): trapezoid
             # correction with a second model eval; plain Euler when the next
@@ -860,10 +873,12 @@ def generate(
                 xn = jnp.where(sig_n == 0.0, x_eul, x_heun)
                 return (xn.astype(xc.dtype), 0.0), None
 
-            (x, _), _ = jax.lax.scan(step, (x, 0.0), jnp.arange(steps))
+            (x, _), _ = jax.lax.scan(step, (x, 0.0), jnp.arange(i0, steps))
         else:  # lms
-            order = min(4, steps)
-            co = jnp.asarray(lms_coefficients(sigmas_np, order))
+            # coefficients over the REMAINING trajectory: starting mid-
+            # schedule (img2img) must not weight history that never ran
+            order = min(4, steps - i0)
+            co = jnp.asarray(lms_coefficients(sigmas_np[i0:], order))
 
             def step(carry, i):
                 xc, hist = carry
@@ -871,15 +886,18 @@ def generate(
                 d = (xc.astype(jnp.float32) - den) / sigmas[i]
                 hist = jnp.concatenate([d[None], hist[:-1]], axis=0)
                 xn = xc.astype(jnp.float32) + jnp.einsum(
-                    "j,j...->...", co[i], hist
+                    "j,j...->...", co[i - i0], hist
                 )
                 return (xn.astype(xc.dtype), hist), None
 
             hist0 = jnp.zeros((order,) + x.shape, jnp.float32)
-            (x, _), _ = jax.lax.scan(step, (x, hist0), jnp.arange(steps))
+            (x, _), _ = jax.lax.scan(step, (x, hist0), jnp.arange(i0, steps))
     else:
         ts = jnp.asarray(ddim_timesteps(cfg, steps))
         ratio = cfg.num_train_timesteps // steps
+        if init_lat is not None:
+            acp0 = acp[ts[i0]]
+            x = jnp.sqrt(acp0) * init_lat + jnp.sqrt(1.0 - acp0) * x
 
         def step(carry, i):
             xc, k = carry
@@ -889,7 +907,7 @@ def generate(
             xn = ddim_step(cfg, acp, eps, t, t - ratio, xc)
             return (blend(xn, t - ratio, bk), k), None
 
-        (x, _), _ = jax.lax.scan(step, (x, key), jnp.arange(steps))
+        (x, _), _ = jax.lax.scan(step, (x, key), jnp.arange(i0, steps))
 
     return vae_decode(cfg.vae, params["vae"], x / cfg.vae.scaling_factor)
 

@@ -66,6 +66,19 @@ class ImageApi:
         response_format = body.get("response_format") or "url"
 
         kw = {}
+        if body.get("image") or body.get("src"):
+            # img2img: base64 source + strength (reference: request.src ->
+            # StableDiffusionImg2ImgPipeline, diffusers backend.py:198)
+            import numpy as np
+
+            try:
+                blob = base64.b64decode(body.get("image") or body.get("src"))
+                kw["init_image"] = np.asarray(
+                    Image.open(io.BytesIO(blob)).convert("RGB"))
+            except Exception as e:  # noqa: BLE001
+                raise ApiError(400, f"image is not a decodable image: {e}") from None
+            if body.get("strength") is not None:
+                kw["strength"] = float(body["strength"])
         if body.get("control_image"):
             # ControlNet conditioning (diffusers ControlNet pipelines; the
             # checkpoint must ship a controlnet/ subdir): base64 PNG/JPEG.

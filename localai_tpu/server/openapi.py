@@ -64,6 +64,9 @@ _BODY_SCHEMAS: dict[str, dict[str, Any]] = {
             "control_image": {"type": "string",
                               "description": "base64 PNG/JPEG ControlNet condition"},
             "control_scale": {"type": "number"},
+            "image": {"type": "string",
+                      "description": "base64 img2img source (alias: src)"},
+            "strength": {"type": "number"},
         },
     },
     "/v1/sound-generation": {

@@ -646,3 +646,52 @@ def test_controlnet_engine_and_api(sd_controlnet_dir):
     eng2 = LatentDiffusionEngine(cfg, p2, tok)
     with pytest.raises(ValueError):
         eng2.generate("a cat", n=1, steps=2, control_image=ctrl)
+
+
+def test_img2img_strength_controls_fidelity(sd_dir):
+    """img2img: low strength stays near the source, high strength moves
+    further; deterministic per seed; runs on k-samplers and DDIM."""
+    cfg, params, tok = ld.load_pipeline(sd_dir)
+    ids = jnp.asarray(tok("a photo of a cat", padding="max_length",
+                          max_length=77, truncation=True)["input_ids"],
+                      jnp.int32)[None]
+    un = jnp.asarray(tok("", padding="max_length", max_length=77,
+                         truncation=True)["input_ids"], jnp.int32)[None]
+    src = jnp.asarray(np.random.default_rng(3).random((1, 64, 64, 3)),
+                      jnp.float32)
+    roundtrip = np.asarray(ld.vae_decode(
+        cfg.vae, params["vae"],
+        ld.vae_encode(cfg.vae, params["vae"], src) / cfg.vae.scaling_factor))
+
+    outs = {}
+    for sched in ("ddim", "euler_a", "dpmpp_2m"):
+        for strength in (0.2, 0.9):
+            img = np.asarray(ld.generate(
+                cfg, params, ids, un, jax.random.key(4), steps=5,
+                height=64, width=64, scheduler=sched,
+                init_image=src, strength=strength))
+            assert img.shape == (1, 64, 64, 3), (sched, strength)
+            assert np.isfinite(img).all(), (sched, strength)
+            outs[(sched, strength)] = img
+        lo = np.abs(outs[(sched, 0.2)] - roundtrip).mean()
+        hi = np.abs(outs[(sched, 0.9)] - roundtrip).mean()
+        assert lo < hi, (sched, lo, hi)
+    again = np.asarray(ld.generate(
+        cfg, params, ids, un, jax.random.key(4), steps=5, height=64,
+        width=64, scheduler="ddim", init_image=src, strength=0.2))
+    np.testing.assert_array_equal(outs[("ddim", 0.2)], again)
+
+
+def test_img2img_engine_and_jit_key(sd_dir):
+    from localai_tpu.engine.image_engine import LatentDiffusionEngine
+
+    cfg, params, tok = ld.load_pipeline(sd_dir)
+    eng = LatentDiffusionEngine(cfg, params, tok)
+    src = (np.random.default_rng(2).random((50, 50, 3)) * 255).astype(np.uint8)
+    a = eng.generate("a cat", n=1, steps=3, seed=1, size=(64, 64),
+                     init_image=src, strength=0.3)
+    b = eng.generate("a cat", n=1, steps=3, seed=1, size=(64, 64),
+                     init_image=src, strength=0.9)
+    c = eng.generate("a cat", n=1, steps=3, seed=1, size=(64, 64))
+    assert a[0].shape == b[0].shape == c[0].shape == (64, 64, 3)
+    assert np.abs(a[0].astype(int) - b[0].astype(int)).max() > 0
