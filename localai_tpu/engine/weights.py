@@ -229,6 +229,13 @@ def load_hf_checkpoint(
         ]
         return np.stack(rows)
 
+    if cfg.is_mla:
+        if lora:
+            raise ValueError(
+                "LoRA merge into DeepSeek checkpoints is not supported yet"
+            )
+        return _load_deepseek(cfg, grab, place, put, reader)
+
     layers: Params = {}
     layer_map = dict(_LAYER_MAP)
     if cfg.is_moe:
@@ -283,6 +290,157 @@ def load_hf_checkpoint(
                 "lm_head", grab(name, False), can_quant=True, qaxis=-1
             )
         else:  # some checkpoints tie without declaring it
+            params["lm_head"] = params["embed"]
+    return params
+
+
+def _deinterleave(arr: np.ndarray, rot: int, block: int) -> np.ndarray:
+    """De-interleave rope columns of a [in, out] weight whose output axis is
+    per-head blocks of `block` cols with the LAST `rot` cols rotary. HF
+    deepseek applies complex/interleaved rope (pairs (2i, 2i+1)); permuting
+    those columns to half-split order here makes the runtime's single neox
+    rope implementation exact (the inverse of DeepseekV3's
+    apply_rotary_pos_emb_interleave view-transpose)."""
+    out = arr.reshape(arr.shape[0], -1, block).copy()
+    rope = out[..., block - rot:]
+    out[..., block - rot:] = np.concatenate([rope[..., 0::2], rope[..., 1::2]], -1)
+    return out.reshape(arr.shape[0], -1)
+
+
+def _load_deepseek(cfg: ArchConfig, grab, place, put, reader) -> Params:
+    """DeepSeek-V2/V3 checkpoint → the two-stack MLA/MoE param tree.
+
+    HF layout (transformers modeling_deepseek_v3.py): q through an optional
+    lora bottleneck (q_a/q_b) or direct q_proj; kv_a_proj_with_mqa emits the
+    [kv_lora_rank | k_pe] latent; kv_b_proj [H·(nope+v), r] splits per head
+    into w_kb/w_vb (kept in HF [out, in] orientation — the absorbed einsums
+    contract the shared r axis); mlp.gate(.e_score_correction_bias) routes
+    mlp.experts.N.* with always-on mlp.shared_experts.*; the first
+    first_k_dense layers carry a plain mlp. Reference serves this family via
+    vLLM passthrough (backend/python/vllm/backend.py:92-141)."""
+    H = cfg.num_heads
+    n, rot, vd = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+    r = cfg.kv_lora_rank
+    kd = cfg.first_k_dense if cfg.is_moe else 0
+    L = cfg.num_layers
+
+    def stack(our: str, suffix: str, lo: int, hi: int, transpose: bool,
+              rope_block: int = 0) -> np.ndarray:
+        rows = []
+        for i in range(lo, hi):
+            a = grab(f"model.layers.{i}.{suffix}", transpose)
+            if rope_block and cfg.rope_interleave:
+                a = _deinterleave(a, rot, rope_block)
+            rows.append(a)
+        return np.stack(rows)
+
+    def attn_stack(lo: int, hi: int) -> Params:
+        out: Params = {
+            "attn_norm": stack("attn_norm", "input_layernorm.weight", lo, hi, False),
+            "mlp_norm": stack("mlp_norm", "post_attention_layernorm.weight", lo, hi, False),
+            "kv_norm": stack("kv_norm", "self_attn.kv_a_layernorm.weight", lo, hi, False),
+            "wo": place(f"layers/wo@{lo}", stack("wo", "self_attn.o_proj.weight", lo, hi, True), True),
+        }
+        if cfg.q_lora_rank:
+            out["wq_a"] = place(
+                f"layers/wq_a@{lo}", stack("wq_a", "self_attn.q_a_proj.weight", lo, hi, True), True
+            )
+            out["q_norm_a"] = put(
+                f"layers/q_norm_a@{lo}",
+                stack("q_norm_a", "self_attn.q_a_layernorm.weight", lo, hi, False),
+            )
+            out["wq_b"] = place(
+                f"layers/wq_b@{lo}",
+                stack("wq_b", "self_attn.q_b_proj.weight", lo, hi, True, rope_block=n + rot),
+                True,
+            )
+        else:
+            out["wq"] = place(
+                f"layers/wq@{lo}",
+                stack("wq", "self_attn.q_proj.weight", lo, hi, True, rope_block=n + rot),
+                True,
+            )
+        out["wkv_a"] = place(
+            f"layers/wkv_a@{lo}",
+            stack("wkv_a", "self_attn.kv_a_proj_with_mqa.weight", lo, hi, True,
+                  rope_block=r + rot),
+            True,
+        )
+        out["attn_norm"] = put(f"layers/attn_norm@{lo}", out["attn_norm"])
+        out["mlp_norm"] = put(f"layers/mlp_norm@{lo}", out["mlp_norm"])
+        out["kv_norm"] = put(f"layers/kv_norm@{lo}", out["kv_norm"])
+        # kv_b_proj [H·(n+v), r] → per-head k/v up-projections (never
+        # quantized: they ride einsum paths with no grouped-int kernel).
+        kbs, vbs = [], []
+        for i in range(lo, hi):
+            kb = grab(f"model.layers.{i}.self_attn.kv_b_proj.weight", False)
+            kb = kb.reshape(H, n + vd, r)
+            kbs.append(kb[:, :n])
+            vbs.append(kb[:, n:])
+        out["w_kb"] = put(f"layers/w_kb@{lo}", np.stack(kbs))
+        out["w_vb"] = put(f"layers/w_vb@{lo}", np.stack(vbs))
+        return out
+
+    # wkv_a's rope permute operates on the whole [D, r+rot] output (one
+    # pseudo-head of block r+rot with the last rot cols rotary) — matches
+    # rope_block=r + rot above. wq(_b) blocks are per head (n+rot).
+    layers = attn_stack(kd, L)
+    if cfg.is_moe:
+        E, Lm = cfg.num_experts, L - kd
+        layers["router"] = put(
+            "layers/router", stack("router", "mlp.gate.weight", kd, L, True)
+        )
+        probe = f"model.layers.{kd}.mlp.gate.e_score_correction_bias"
+        if probe in reader:
+            layers["router_bias"] = jnp.asarray(
+                stack("router_bias", "mlp.gate.e_score_correction_bias", kd, L, False),
+                jnp.float32,
+            )
+        for our, suffix in (("w_gate", "gate_proj"), ("w_up", "up_proj"),
+                            ("w_down", "down_proj")):
+            per_layer = []
+            for i in range(kd, L):
+                experts = [
+                    grab(f"model.layers.{i}.mlp.experts.{e}.{suffix}.weight", True)
+                    for e in range(E)
+                ]
+                per_layer.append(np.stack(experts))
+            layers[our] = place(f"layers/{our}", np.stack(per_layer), True)
+        if cfg.n_shared_experts:
+            for our, suffix in (("shared_gate", "gate_proj"),
+                                ("shared_up", "up_proj"),
+                                ("shared_down", "down_proj")):
+                layers[our] = place(
+                    f"layers/{our}",
+                    stack(our, f"mlp.shared_experts.{suffix}.weight", kd, L, True),
+                    True,
+                )
+    else:
+        for our, suffix in (("w_gate", "gate_proj"), ("w_up", "up_proj"),
+                            ("w_down", "down_proj")):
+            layers[our] = place(
+                f"layers/{our}", stack(our, f"mlp.{suffix}.weight", 0, L, True), True
+            )
+
+    params: Params = {
+        "embed": put("embed", grab("model.embed_tokens.weight", False)),
+        "layers": layers,
+        "final_norm": put("final_norm", grab("model.norm.weight", False)),
+    }
+    if kd:
+        dense = attn_stack(0, kd)
+        for our, suffix in (("w_gate", "gate_proj"), ("w_up", "up_proj"),
+                            ("w_down", "down_proj")):
+            dense[our] = place(
+                f"dense_layers/{our}", stack(our, f"mlp.{suffix}.weight", 0, kd, True), True
+            )
+        params["dense_layers"] = dense
+    if not cfg.tie_embeddings:
+        if "lm_head.weight" in reader:
+            params["lm_head"] = place(
+                "lm_head", grab("lm_head.weight", False), True, qaxis=-1
+            )
+        else:
             params["lm_head"] = params["embed"]
     return params
 
@@ -507,6 +665,10 @@ def save_hf_checkpoint(cfg: ArchConfig, params: Params, ckpt_dir: str) -> None:
             a = a - 1.0  # inverse of the load-time (1+w) fold — gemma layout
         tensors[name] = np.ascontiguousarray(a)
 
+    if cfg.is_mla:
+        _save_deepseek(cfg, params, ckpt_dir, tensors, emit)
+        return
+
     layers = params["layers"]
     layer_map = dict(_LAYER_MAP)
     if cfg.is_moe:
@@ -587,6 +749,118 @@ def save_hf_checkpoint(cfg: ArchConfig, params: Params, ckpt_dir: str) -> None:
         json.dump(hf_config, f, indent=1)
 
 
+def _save_deepseek(cfg: ArchConfig, params: Params, ckpt_dir: str,
+                   tensors: dict, emit) -> None:
+    """Emit the two-stack deepseek tree as an HF deepseek_v2/v3 checkpoint
+    (inverse of _load_deepseek; rope_interleave is written as false so the
+    emitted layout matches our half-split columns verbatim)."""
+    kd = cfg.first_k_dense if cfg.is_moe else 0
+
+    def emit_attn(stack: Params, lo: int) -> None:
+        n = stack["attn_norm"].shape[0]
+        for j in range(n):
+            i = lo + j
+            pre = f"model.layers.{i}."
+            emit(pre + "input_layernorm.weight", stack["attn_norm"][j], False)
+            emit(pre + "post_attention_layernorm.weight", stack["mlp_norm"][j], False)
+            emit(pre + "self_attn.kv_a_layernorm.weight", stack["kv_norm"][j], False)
+            emit(pre + "self_attn.o_proj.weight", stack["wo"][j], True)
+            emit(pre + "self_attn.kv_a_proj_with_mqa.weight", stack["wkv_a"][j], True)
+            if cfg.q_lora_rank:
+                emit(pre + "self_attn.q_a_proj.weight", stack["wq_a"][j], True)
+                emit(pre + "self_attn.q_a_layernorm.weight", stack["q_norm_a"][j], False)
+                emit(pre + "self_attn.q_b_proj.weight", stack["wq_b"][j], True)
+            else:
+                emit(pre + "self_attn.q_proj.weight", stack["wq"][j], True)
+            kb = np.concatenate(
+                [np.asarray(jnp.asarray(stack["w_kb"][j], jnp.float32)),
+                 np.asarray(jnp.asarray(stack["w_vb"][j], jnp.float32))], axis=1
+            )  # [H, n+v, r]
+            tensors[f"{pre}self_attn.kv_b_proj.weight"] = np.ascontiguousarray(
+                kb.reshape(-1, cfg.kv_lora_rank)
+            )
+
+    layers = params["layers"]
+    emit_attn(layers, kd)
+    if kd:
+        dense = params["dense_layers"]
+        emit_attn(dense, 0)
+        for j in range(kd):
+            for our, suffix in (("w_gate", "gate_proj"), ("w_up", "up_proj"),
+                                ("w_down", "down_proj")):
+                emit(f"model.layers.{j}.mlp.{suffix}.weight", dense[our][j], True)
+    if cfg.is_moe:
+        for j in range(cfg.num_layers - kd):
+            i = kd + j
+            emit(f"model.layers.{i}.mlp.gate.weight", layers["router"][j], True)
+            if "router_bias" in layers:
+                emit(f"model.layers.{i}.mlp.gate.e_score_correction_bias",
+                     layers["router_bias"][j], False)
+            for e in range(cfg.num_experts):
+                for our, suffix in (("w_gate", "gate_proj"), ("w_up", "up_proj"),
+                                    ("w_down", "down_proj")):
+                    emit(f"model.layers.{i}.mlp.experts.{e}.{suffix}.weight",
+                         layers[our][j, e], True)
+            if cfg.n_shared_experts:
+                for our, suffix in (("shared_gate", "gate_proj"),
+                                    ("shared_up", "up_proj"),
+                                    ("shared_down", "down_proj")):
+                    emit(f"model.layers.{i}.mlp.shared_experts.{suffix}.weight",
+                         layers[our][j], True)
+    else:
+        for j in range(cfg.num_layers):
+            for our, suffix in (("w_gate", "gate_proj"), ("w_up", "up_proj"),
+                                ("w_down", "down_proj")):
+                emit(f"model.layers.{j}.mlp.{suffix}.weight", layers[our][j], True)
+
+    emit("model.embed_tokens.weight", params["embed"], False)
+    emit("model.norm.weight", params["final_norm"], False)
+    if not cfg.tie_embeddings and "lm_head" in params:
+        emit("lm_head.weight", params["lm_head"], False)
+
+    from safetensors.numpy import save_file
+
+    save_file(tensors, os.path.join(ckpt_dir, "model.safetensors"))
+    v3 = cfg.scoring_func == "sigmoid"
+    hf_config = {
+        "model_type": "deepseek_v3" if v3 else "deepseek_v2",
+        "hidden_act": "silu",
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "intermediate_size": cfg.intermediate_size,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads,
+        "rope_theta": cfg.rope_theta,
+        "max_position_embeddings": cfg.max_position,
+        "rms_norm_eps": cfg.rms_eps,
+        "tie_word_embeddings": cfg.tie_embeddings,
+        "kv_lora_rank": cfg.kv_lora_rank,
+        "q_lora_rank": cfg.q_lora_rank,
+        "qk_nope_head_dim": cfg.qk_nope_head_dim,
+        "qk_rope_head_dim": cfg.qk_rope_head_dim,
+        "v_head_dim": cfg.v_head_dim,
+        "head_dim": cfg.qk_rope_head_dim,
+        "rope_interleave": False,
+        "n_routed_experts": cfg.num_experts or None,
+        "num_experts_per_tok": cfg.num_experts_per_token if cfg.is_moe else None,
+        "first_k_dense_replace": cfg.first_k_dense,
+        "n_shared_experts": cfg.n_shared_experts or None,
+        "moe_intermediate_size": cfg.moe_inter_size,
+        "routed_scaling_factor": cfg.routed_scaling_factor,
+        "norm_topk_prob": cfg.norm_topk_prob,
+        "n_group": cfg.n_group,
+        "topk_group": cfg.topk_group,
+    }
+    if not v3:
+        hf_config["scoring_func"] = cfg.scoring_func
+        hf_config["topk_method"] = (
+            "group_limited_greedy" if cfg.n_group > 1 else "greedy"
+        )
+    with open(os.path.join(ckpt_dir, "config.json"), "w") as f:
+        json.dump(hf_config, f, indent=1)
+
+
 def arch_from_hf_config(ckpt_dir: str) -> ArchConfig:
     """Build an ArchConfig from an HF config.json
     (llama/mistral/qwen2/mixtral/gemma/gemma-2/gemma-3/phi3), including every
@@ -634,6 +908,49 @@ def arch_from_hf_config(ckpt_dir: str) -> ArchConfig:
             )
     act = hf.get("hidden_activation") or hf.get("hidden_act") or "silu"
     softcaps = gemma2 or gemma3  # gemma-3 configs carry the keys but None
+    if model_type in ("deepseek_v2", "deepseek_v3"):
+        v3 = model_type == "deepseek_v3"
+        return ArchConfig(
+            name=hf.get("_name_or_path", model_type) or model_type,
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+            head_dim=hf.get("qk_rope_head_dim", 64),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            rope_scaling=scaling_type,
+            rope_scaling_factor=rope_scaling.get("factor", 1.0),
+            rope_original_max_position=orig_pos,
+            rope_beta_fast=float(rope_scaling.get("beta_fast", 32.0)),
+            rope_beta_slow=float(rope_scaling.get("beta_slow", 1.0)),
+            rope_attn_factor=float(attn_factor) if attn_factor is not None else None,
+            max_position=max_position,
+            rms_eps=hf.get("rms_norm_eps", 1e-6),
+            tie_embeddings=hf.get("tie_word_embeddings", False),
+            num_experts=hf.get("n_routed_experts") or 0,
+            num_experts_per_token=hf.get("num_experts_per_tok") or 2,
+            moe_family="deepseek",
+            first_k_dense=(hf.get("first_k_dense_replace", 0)
+                           if hf.get("n_routed_experts") else 0),
+            n_shared_experts=hf.get("n_shared_experts") or 0,
+            moe_intermediate_size=hf.get("moe_intermediate_size"),
+            routed_scaling_factor=hf.get("routed_scaling_factor", 1.0),
+            scoring_func="sigmoid" if v3 else hf.get("scoring_func", "softmax"),
+            router_bias=v3,
+            norm_topk_prob=bool(hf.get("norm_topk_prob", False)),
+            n_group=hf.get("n_group") or 1,
+            topk_group=hf.get("topk_group") or 1,
+            kv_lora_rank=hf["kv_lora_rank"],
+            q_lora_rank=hf.get("q_lora_rank"),
+            qk_nope_head_dim=hf.get("qk_nope_head_dim", 128),
+            qk_rope_head_dim=hf.get("qk_rope_head_dim", 64),
+            v_head_dim=hf.get("v_head_dim", 128),
+            # V2 applies complex (pair-interleaved) rope unconditionally;
+            # V3 checkpoints carry the flag (default true).
+            rope_interleave=bool(hf.get("rope_interleave", True)),
+        )
     return ArchConfig(
         name=hf.get("_name_or_path", model_type) or model_type,
         vocab_size=hf["vocab_size"],

@@ -82,6 +82,45 @@ class ArchConfig:
     # Capacity factor for the expert-parallel (ep>1) GShard dispatch path:
     # each expert processes at most ceil(top_k·N/E·cf) tokens per block.
     moe_capacity_factor: float = 2.0
+    # DeepSeek-V2/V3 MoE layout (HF DeepseekV2Config/DeepseekV3Config;
+    # reference serves these via vLLM passthrough, vllm/backend.py:92-141):
+    # the first `first_k_dense` layers run a dense MLP, the rest route
+    # `num_experts_per_token` of `num_experts` routed experts (intermediate
+    # size `moe_intermediate_size`) plus an always-on shared-expert MLP of
+    # size n_shared_experts·moe_intermediate_size.
+    first_k_dense: int = 0
+    n_shared_experts: int = 0
+    moe_intermediate_size: Optional[int] = None
+    routed_scaling_factor: float = 1.0
+    # Router family: "mixtral" softmaxes the top-k logits; "deepseek"
+    # scores ALL experts (softmax/sigmoid per scoring_func) and then
+    # selects — the two orders give different weights, so this is explicit.
+    moe_family: str = "mixtral"
+    # Router scoring: "softmax" (Mixtral/DeepSeek-V2) or "sigmoid"
+    # (DeepSeek-V3/R1, selection biased by a learned per-expert correction).
+    scoring_func: str = "softmax"
+    router_bias: bool = False  # V3 e_score_correction_bias
+    norm_topk_prob: bool = False  # V3: renormalize the selected weights
+    # Group-limited routing (device-limited in the paper): experts are split
+    # into n_group groups; selection is restricted to the topk_group
+    # best-scoring groups (V2 scores a group by its max, V3 by the sum of
+    # its top-2 biased scores).
+    n_group: int = 1
+    topk_group: int = 1
+    # Multi-head Latent Attention (DeepSeek-V2/V3): q/kv project through
+    # low-rank bottlenecks and the KV cache stores ONE latent row per token
+    # ([kv_lora_rank | roped qk_rope_head_dim]) instead of per-head k/v.
+    # kv_lora_rank > 0 switches the whole attention stack to MLA.
+    kv_lora_rank: int = 0
+    q_lora_rank: Optional[int] = None  # None = direct q projection (V2-Lite)
+    qk_nope_head_dim: int = 128
+    qk_rope_head_dim: int = 64
+    v_head_dim: int = 128
+    # HF deepseek checkpoints store the rope dims pair-interleaved (V2
+    # always — complex rope; V3 per config.rope_interleave). The loader
+    # de-interleaves the affected projection columns so runtime rope stays
+    # the one half-split (neox) implementation.
+    rope_interleave: bool = False
     dtype: str = "bfloat16"
 
     @property
@@ -91,6 +130,36 @@ class ArchConfig:
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0
+
+    @property
+    def is_mla(self) -> bool:
+        return self.kv_lora_rank > 0
+
+    @property
+    def qk_head_dim(self) -> int:
+        """Per-head q/k width under MLA (nope ⊕ rope)."""
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+    # Cache layout: the engine, pool allocator, and sharding planner size the
+    # KV cache from these three, so MLA's latent layout (one pseudo-head of
+    # [kv_lora_rank + rope] per token, no separate V — values are read back
+    # out of the same latent) threads through every cache variant (dense /
+    # windowed / paged / fp8) without per-call-site branches.
+    @property
+    def cache_kv_heads(self) -> int:
+        return 1 if self.is_mla else self.num_kv_heads
+
+    @property
+    def cache_k_dim(self) -> int:
+        return self.kv_lora_rank + self.qk_rope_head_dim if self.is_mla else self.head_dim_
+
+    @property
+    def cache_v_dim(self) -> int:
+        return 0 if self.is_mla else self.head_dim_
+
+    @property
+    def moe_inter_size(self) -> int:
+        return self.moe_intermediate_size or self.intermediate_size
 
 
 # ---------------------------------------------------------------------------
@@ -123,6 +192,37 @@ PRESETS: dict[str, ArchConfig] = {
         max_position=256,
         num_experts=4,
         num_experts_per_token=2,
+    ),
+    "tiny-mla": ArchConfig(
+        # DeepSeek-V3-shaped tiny: MLA with q-lora, sigmoid router with
+        # correction bias, group-limited top-k, shared expert, dense-first
+        # layer — every R1 mechanism at test scale.
+        name="tiny-mla",
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=3,
+        num_heads=4,
+        num_kv_heads=4,
+        head_dim=16,  # rope table width = qk_rope_head_dim
+        max_position=256,
+        moe_family="deepseek",
+        num_experts=8,
+        num_experts_per_token=3,
+        first_k_dense=1,
+        n_shared_experts=1,
+        moe_intermediate_size=48,
+        routed_scaling_factor=2.5,
+        scoring_func="sigmoid",
+        router_bias=True,
+        norm_topk_prob=True,
+        n_group=4,
+        topk_group=2,
+        kv_lora_rank=32,
+        q_lora_rank=24,
+        qk_nope_head_dim=24,
+        qk_rope_head_dim=16,
+        v_head_dim=24,
     ),
     "llama-3.2-1b": ArchConfig(
         name="llama-3.2-1b",
@@ -185,6 +285,68 @@ PRESETS: dict[str, ArchConfig] = {
         max_position=32768,
         num_experts=8,
         num_experts_per_token=2,
+    ),
+    "deepseek-v2-lite": ArchConfig(
+        # Published card: 27 layers, 16B total / 2.4B active, MLA without
+        # q-lora, 64 routed + 2 shared experts, first layer dense.
+        name="deepseek-v2-lite",
+        vocab_size=102400,
+        hidden_size=2048,
+        intermediate_size=10944,
+        num_layers=27,
+        num_heads=16,
+        num_kv_heads=16,
+        head_dim=64,
+        rope_theta=10000.0,
+        max_position=163840,
+        moe_family="deepseek",
+        num_experts=64,
+        num_experts_per_token=6,
+        first_k_dense=1,
+        n_shared_experts=2,
+        moe_intermediate_size=1408,
+        routed_scaling_factor=1.0,
+        scoring_func="softmax",
+        rope_interleave=True,
+        kv_lora_rank=512,
+        q_lora_rank=None,
+        qk_nope_head_dim=128,
+        qk_rope_head_dim=64,
+        v_head_dim=128,
+    ),
+    "deepseek-r1": ArchConfig(
+        # DeepSeek-V3/R1 (BASELINE.json configs[4]): 61 layers (3 dense),
+        # 256 routed experts top-8 in 8 groups, sigmoid router with
+        # correction bias, MLA with q-lora. Serving shapes for the EP mesh
+        # dryrun and decode benchmarks; full weights need a multi-host pod.
+        name="deepseek-r1",
+        vocab_size=129280,
+        hidden_size=7168,
+        intermediate_size=18432,
+        num_layers=61,
+        num_heads=128,
+        num_kv_heads=128,
+        head_dim=64,
+        rope_theta=10000.0,
+        max_position=163840,
+        moe_family="deepseek",
+        num_experts=256,
+        num_experts_per_token=8,
+        first_k_dense=3,
+        n_shared_experts=1,
+        moe_intermediate_size=2048,
+        routed_scaling_factor=2.5,
+        scoring_func="sigmoid",
+        router_bias=True,
+        norm_topk_prob=True,
+        n_group=8,
+        topk_group=4,
+        rope_interleave=True,
+        kv_lora_rank=512,
+        q_lora_rank=1536,
+        qk_nope_head_dim=128,
+        qk_rope_head_dim=64,
+        v_head_dim=128,
     ),
 }
 

@@ -49,6 +49,14 @@ class KVCache(NamedTuple):
 
     k, v: [L, B_slots, S_max, K_heads, head_dim]. Slot occupancy/lengths are
     tracked by the engine; shapes stay static under jit.
+
+    Under MLA (DeepSeek-V2/V3, cfg.is_mla) the cache holds ONE latent row
+    per token instead of per-head k/v: k is [L, B, S, 1, kv_lora_rank+rope]
+    = [RMSNorm(c_kv) | RoPE(k_pe)] and v is zero-width ([..., 1, 0]) — the
+    value read is served out of the same latent (absorbed-weight attention),
+    so HBM per token is the published MLA number, not 2x it. Every write
+    helper below is shape-generic, so the paged/windowed/fp8 machinery
+    serves both layouts.
     """
 
     k: jnp.ndarray
@@ -57,32 +65,46 @@ class KVCache(NamedTuple):
     @staticmethod
     def zeros(cfg: ArchConfig, num_slots: int, max_seq: int, dtype=None) -> "KVCache":
         dtype = jnp.dtype(cfg.dtype) if dtype is None else dtype
-        shape = (cfg.num_layers, num_slots, max_seq, cfg.num_kv_heads, cfg.head_dim_)
-        return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+        base = (cfg.num_layers, num_slots, max_seq, cfg.cache_kv_heads)
+        return KVCache(
+            k=jnp.zeros(base + (cfg.cache_k_dim,), dtype),
+            v=jnp.zeros(base + (cfg.cache_v_dim,), dtype),
+        )
 
 
 def _dtype(cfg: ArchConfig):
     return jnp.dtype(cfg.dtype)
 
 
-def init_params(cfg: ArchConfig, key: jnp.ndarray, scale: float = 0.02) -> Params:
-    """Random init with HF-compatible tree structure (stacked layers)."""
+def _init_attn_layers(cfg: ArchConfig, rnd, keys, L: int) -> Params:
+    """Attention + norm keys for a stack of L layers (standard or MLA)."""
     dt = _dtype(cfg)
-    L, D, F = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    D = cfg.hidden_size
     H, K, Hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
-    keys = iter(jax.random.split(key, 16))
-
-    def rnd(k, shape):
-        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
-
-    layers: Params = {
-        "attn_norm": jnp.ones((L, D), dt),
-        "wq": rnd(next(keys), (L, D, H * Hd)),
-        "wk": rnd(next(keys), (L, D, K * Hd)),
-        "wv": rnd(next(keys), (L, D, K * Hd)),
-        "wo": rnd(next(keys), (L, H * Hd, D)),
-        "mlp_norm": jnp.ones((L, D), dt),
-    }
+    layers: Params = {"attn_norm": jnp.ones((L, D), dt),
+                      "mlp_norm": jnp.ones((L, D), dt)}
+    if cfg.is_mla:
+        r, rot = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+        n, vd = cfg.qk_nope_head_dim, cfg.v_head_dim
+        if cfg.q_lora_rank:
+            layers["wq_a"] = rnd(next(keys), (L, D, cfg.q_lora_rank))
+            layers["q_norm_a"] = jnp.ones((L, cfg.q_lora_rank), dt)
+            layers["wq_b"] = rnd(next(keys), (L, cfg.q_lora_rank, H * (n + rot)))
+        else:
+            layers["wq"] = rnd(next(keys), (L, D, H * (n + rot)))
+        layers["wkv_a"] = rnd(next(keys), (L, D, r + rot))
+        layers["kv_norm"] = jnp.ones((L, r), dt)
+        # HF kv_b_proj [H·(n+v), r] split per head: w_kb maps latent→k_nope,
+        # w_vb maps latent→v. Stored in HF's [out, in] orientation so the
+        # absorbed einsums contract the shared r axis directly.
+        layers["w_kb"] = rnd(next(keys), (L, H, n, r))
+        layers["w_vb"] = rnd(next(keys), (L, H, vd, r))
+        layers["wo"] = rnd(next(keys), (L, H * vd, D))
+        return layers
+    layers["wq"] = rnd(next(keys), (L, D, H * Hd))
+    layers["wk"] = rnd(next(keys), (L, D, K * Hd))
+    layers["wv"] = rnd(next(keys), (L, D, K * Hd))
+    layers["wo"] = rnd(next(keys), (L, H * Hd, D))
     if cfg.post_norms:
         layers["post_attn_norm"] = jnp.ones((L, D), dt)
         layers["post_ffw_norm"] = jnp.ones((L, D), dt)
@@ -93,12 +115,40 @@ def init_params(cfg: ArchConfig, key: jnp.ndarray, scale: float = 0.02) -> Param
         layers["bq"] = jnp.zeros((L, H * Hd), dt)
         layers["bk"] = jnp.zeros((L, K * Hd), dt)
         layers["bv"] = jnp.zeros((L, K * Hd), dt)
+    return layers
+
+
+def init_params(cfg: ArchConfig, key: jnp.ndarray, scale: float = 0.02) -> Params:
+    """Random init with HF-compatible tree structure (stacked layers).
+
+    DeepSeek-style models (first_k_dense > 0) split into two stacks:
+    params["dense_layers"] holds the leading dense-MLP layers and
+    params["layers"] the MoE layers (+ shared experts) — `_scan_layers`
+    runs them as two scans with a shared layer body.
+    """
+    dt = _dtype(cfg)
+    L, D, F = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    keys = iter(jax.random.split(key, 32))
+
+    def rnd(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    kd = cfg.first_k_dense if cfg.is_moe else 0
+    Lm = L - kd
+    layers = _init_attn_layers(cfg, rnd, keys, Lm)
     if cfg.is_moe:
-        E = cfg.num_experts
-        layers["router"] = rnd(next(keys), (L, D, E))
-        layers["w_gate"] = rnd(next(keys), (L, E, D, F))
-        layers["w_up"] = rnd(next(keys), (L, E, D, F))
-        layers["w_down"] = rnd(next(keys), (L, E, F, D))
+        E, Fm = cfg.num_experts, cfg.moe_inter_size
+        layers["router"] = rnd(next(keys), (Lm, D, E))
+        if cfg.router_bias:
+            layers["router_bias"] = jnp.zeros((Lm, E), jnp.float32)
+        layers["w_gate"] = rnd(next(keys), (Lm, E, D, Fm))
+        layers["w_up"] = rnd(next(keys), (Lm, E, D, Fm))
+        layers["w_down"] = rnd(next(keys), (Lm, E, Fm, D))
+        if cfg.n_shared_experts:
+            Fs = cfg.n_shared_experts * Fm
+            layers["shared_gate"] = rnd(next(keys), (Lm, D, Fs))
+            layers["shared_up"] = rnd(next(keys), (Lm, D, Fs))
+            layers["shared_down"] = rnd(next(keys), (Lm, Fs, D))
     else:
         layers["w_gate"] = rnd(next(keys), (L, D, F))
         layers["w_up"] = rnd(next(keys), (L, D, F))
@@ -109,9 +159,39 @@ def init_params(cfg: ArchConfig, key: jnp.ndarray, scale: float = 0.02) -> Param
         "layers": layers,
         "final_norm": jnp.ones((D,), dt),
     }
+    if kd:
+        dense = _init_attn_layers(cfg, rnd, keys, kd)
+        dense["w_gate"] = rnd(next(keys), (kd, D, F))
+        dense["w_up"] = rnd(next(keys), (kd, D, F))
+        dense["w_down"] = rnd(next(keys), (kd, F, D))
+        params["dense_layers"] = dense
     if not cfg.tie_embeddings:
         params["lm_head"] = rnd(next(keys), (cfg.vocab_size, D))
     return params
+
+
+def _scan_layers(cfg: ArchConfig, params: Params, h, layer_fn, extras=()):
+    """Scan the layer stack with a shared body. Homogeneous models run one
+    scan; DeepSeek layouts run the dense-prefix stack then the MoE stack
+    (the body's MLP branch keys statically on each stack's param tree), and
+    per-layer outputs are re-concatenated to one [L, ...] stack. `extras`
+    are per-layer arrays (cache slices) with a leading L axis."""
+    L = cfg.num_layers
+    kd = cfg.first_k_dense if ("dense_layers" in params) else 0
+    if kd == 0:
+        return jax.lax.scan(
+            layer_fn, h, (params["layers"], jnp.arange(L)) + tuple(extras)
+        )
+    head = tuple(e[:kd] for e in extras)
+    tail = tuple(e[kd:] for e in extras)
+    h, out_d = jax.lax.scan(
+        layer_fn, h, (params["dense_layers"], jnp.arange(kd)) + head
+    )
+    h, out_m = jax.lax.scan(
+        layer_fn, h, (params["layers"], jnp.arange(kd, L)) + tail
+    )
+    out = jax.tree.map(lambda a, b: jnp.concatenate([a, b], axis=0), out_d, out_m)
+    return h, out
 
 
 def _moe_mm(x: jnp.ndarray, w, sub: str) -> jnp.ndarray:
@@ -151,10 +231,45 @@ def _moe_grouped_mm(x: jnp.ndarray, w: dict, sub: str) -> jnp.ndarray:
 
 
 def _moe_route(cfg: ArchConfig, lp: Params, x: jnp.ndarray):
-    """Top-k router: returns (softmaxed weights [..., k] f32, sel [..., k])."""
+    """Top-k router dispatch: returns (weights [..., k] f32, sel [..., k])."""
+    if cfg.moe_family == "deepseek":
+        return _deepseek_route(cfg, lp, x)
     router_logits = (x @ lp["router"]).astype(jnp.float32)  # [..., E]
     weights, sel = jax.lax.top_k(router_logits, cfg.num_experts_per_token)
     return jax.nn.softmax(weights, axis=-1), sel
+
+
+def _deepseek_route(cfg: ArchConfig, lp: Params, x: jnp.ndarray):
+    """DeepSeek-V2/V3 router (HF DeepseekV2MoEGate / DeepseekV3TopkRouter
+    semantics): score ALL experts in f32 — softmax (V2) or sigmoid (V3) —
+    then select top-k, optionally restricted to the topk_group best of
+    n_group expert groups. V3 biases SELECTION by a learned per-expert
+    correction (e_score_correction_bias) but weights by the unbiased scores,
+    renormalized when norm_topk_prob. Returns weights already scaled by
+    routed_scaling_factor."""
+    E, k = cfg.num_experts, cfg.num_experts_per_token
+    logits = jnp.einsum(
+        "...d,de->...e", x.astype(jnp.float32), lp["router"].astype(jnp.float32)
+    )
+    sigmoid = cfg.scoring_func == "sigmoid"
+    scores = jax.nn.sigmoid(logits) if sigmoid else jax.nn.softmax(logits, axis=-1)
+    choice = scores + lp["router_bias"] if "router_bias" in lp else scores
+    if cfg.n_group > 1:
+        g = cfg.n_group
+        cg = choice.reshape(*choice.shape[:-1], g, E // g)
+        if sigmoid:  # V3: a group's score is the sum of its top-2 biased scores
+            gscore = jax.lax.top_k(cg, 2)[0].sum(axis=-1)
+        else:  # V2 group_limited_greedy: group max
+            gscore = cg.max(axis=-1)
+        _, gidx = jax.lax.top_k(gscore, cfg.topk_group)  # [..., topk_group]
+        gmask = jax.nn.one_hot(gidx, g, dtype=jnp.float32).sum(axis=-2)  # [..., g]
+        keep = jnp.repeat(gmask, E // g, axis=-1) > 0
+        choice = jnp.where(keep, choice, 0.0)
+    _, sel = jax.lax.top_k(choice, k)
+    weights = jnp.take_along_axis(scores, sel, axis=-1)
+    if sigmoid and cfg.norm_topk_prob:
+        weights = weights / (weights.sum(axis=-1, keepdims=True) + 1e-20)
+    return weights * cfg.routed_scaling_factor, sel
 
 
 def _moe_dense(cfg: ArchConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
@@ -196,10 +311,27 @@ def _moe_ragged(cfg: ArchConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
     order = jnp.argsort(e_flat, stable=True)  # expert-major, token-minor
     tok = order // k  # source token of each sorted row
     xg = jnp.take(xf, tok, axis=0)  # [M, D]
-    gs = jnp.bincount(e_flat, length=E)  # rows per expert (sums to M)
-    gate = _act(cfg, jax.lax.ragged_dot(xg, lp["w_gate"], gs))
-    up = jax.lax.ragged_dot(xg, lp["w_up"], gs)
-    dn = jax.lax.ragged_dot((gate * up).astype(xg.dtype), lp["w_down"], gs)  # [M, D]
+    if M < E:
+        # Decode-scale batches can touch at most M of E experts. Gathering
+        # just the active experts' weights bounds HBM weight traffic by
+        # 2·M/E of the dense read — the mechanism that makes top-8-of-256
+        # (DeepSeek-R1 class) MoE decode genuinely sparse, where
+        # top-2-of-8 at batch ≥ 8 touches every expert anyway. `uniq` is
+        # sorted, so the expert-major row order maps 1:1 onto gathered
+        # group slots; pad slots (fill E, clipped for the gather) count
+        # zero rows and contribute nothing.
+        uniq = jnp.unique(e_flat, size=M, fill_value=E)  # [M] sorted ids
+        gs = jnp.bincount(jnp.searchsorted(uniq, e_flat), length=M)
+        gidx = jnp.minimum(uniq, E - 1)
+        w_gate = jnp.take(lp["w_gate"], gidx, axis=0)
+        w_up = jnp.take(lp["w_up"], gidx, axis=0)
+        w_down = jnp.take(lp["w_down"], gidx, axis=0)
+    else:
+        gs = jnp.bincount(e_flat, length=E)  # rows per expert (sums to M)
+        w_gate, w_up, w_down = lp["w_gate"], lp["w_up"], lp["w_down"]
+    gate = _act(cfg, jax.lax.ragged_dot(xg, w_gate, gs))
+    up = jax.lax.ragged_dot(xg, w_up, gs)
+    dn = jax.lax.ragged_dot((gate * up).astype(xg.dtype), w_down, gs)  # [M, D]
     wf = jnp.take(weights.reshape(M), order)
     y = jnp.zeros((N, D), jnp.float32).at[tok].add(dn.astype(jnp.float32) * wf[:, None])
     return y.reshape(*lead, D).astype(x.dtype)
@@ -255,22 +387,33 @@ def _moe_capacity(cfg: ArchConfig, lp: Params, x: jnp.ndarray, block: int = 1024
 
 
 def _mlp(cfg: ArchConfig, lp: Params, x: jnp.ndarray, ep: int = 1) -> jnp.ndarray:
-    """SwiGLU MLP; dense or sparse-MoE (Mixtral-style top-k routing).
+    """SwiGLU MLP; dense or sparse-MoE (Mixtral/DeepSeek top-k routing).
 
-    x: [..., D]. MoE picks its implementation statically:
+    x: [..., D]. MoE is detected per-stack ("router" in lp) so DeepSeek's
+    dense-prefix layers run the plain branch under the same body. MoE picks
+    its implementation statically:
     - quantized expert weights → dense all-experts (the grouped-int kernels
       in models/quant.py only exist for the dense einsum shapes);
     - ep > 1 → GShard capacity dispatch (shards over the "ep" mesh axis);
-    - otherwise → exact sort+ragged_dot top-k (FLOPs ∝ top_k).
+    - otherwise → exact sort+ragged_dot top-k (FLOPs ∝ top_k; at decode
+      batch sizes only the ACTIVE experts' weights are gathered, which is
+      where top-8-of-256 models win — see _moe_ragged).
+    DeepSeek MoE layers add an always-on shared-expert MLP (HF
+    DeepseekV3MoE.shared_experts).
     """
-    if not cfg.is_moe:
+    if "router" not in lp:
         gate = _act(cfg, matmul(x, lp["w_gate"]))
         return matmul(gate * matmul(x, lp["w_up"]), lp["w_down"]).astype(x.dtype)
     if isinstance(lp["w_gate"], dict):
-        return _moe_dense(cfg, lp, x)
-    if ep > 1:
-        return _moe_capacity(cfg, lp, x)
-    return _moe_ragged(cfg, lp, x)
+        y = _moe_dense(cfg, lp, x)
+    elif ep > 1:
+        y = _moe_capacity(cfg, lp, x)
+    else:
+        y = _moe_ragged(cfg, lp, x)
+    if "shared_gate" in lp:
+        sg = _act(cfg, matmul(x, lp["shared_gate"]))
+        y = y + matmul(sg * matmul(x, lp["shared_up"]), lp["shared_down"]).astype(x.dtype)
+    return y
 
 
 def _attn_out(cfg: ArchConfig, lp: Params, attn_flat: jnp.ndarray) -> jnp.ndarray:
@@ -338,6 +481,91 @@ def _attn_proj_qkv(cfg: ArchConfig, lp: Params, x: jnp.ndarray):
         # tables ≡ m² on q alone; K stays unmodified in the cache).
         q = q * float(amp)
     return q, k, v
+
+
+# --------------------------------------------------------------------------- #
+# Multi-head Latent Attention (DeepSeek-V2/V3; HF DeepseekV3Attention parity)
+#
+# Prefill runs full-rank: per-head k = [W_kb·c_kv | rope(k_pe)] and
+# v = W_vb·c_kv are materialized (compute-bound phase, standard MHA shapes).
+# Decode runs the absorbed-weight identity: q·k = [W_kbᵀq_nope | q_pe] ·
+# [c_kv | k_pe], so attention is MQA against the cached LATENT rows, and the
+# value read is served by passing the same latent array as the v operand —
+# the output's first kv_lora_rank dims equal probs·c_kv, which W_vb lifts
+# back to per-head values. One latent row per token is all HBM ever holds.
+# --------------------------------------------------------------------------- #
+
+
+def _mla_q(cfg: ArchConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Query projection [..., H, qk_head_dim] (nope|rope concat, pre-rope);
+    through the q-lora bottleneck when configured (V3) or direct (V2-Lite)."""
+    if cfg.q_lora_rank:
+        ql = rms_norm(matmul(x, lp["wq_a"]), lp["q_norm_a"], cfg.rms_eps)
+        q = matmul(ql, lp["wq_b"])
+    else:
+        q = matmul(x, lp["wq"])
+    return q.reshape(*x.shape[:-1], cfg.num_heads, cfg.qk_head_dim)
+
+
+def _mla_rows(cfg: ArchConfig, lp: Params, x: jnp.ndarray,
+              positions: jnp.ndarray, inv: jnp.ndarray) -> jnp.ndarray:
+    """Latent cache rows [B, T, 1, r+rot] = [RMSNorm(c_kv) | RoPE(k_pe)] for
+    tokens x [B, T, D] at `positions` [B, T]. This is the ONLY thing MLA
+    writes to the KV cache."""
+    r = cfg.kv_lora_rank
+    ckv = matmul(x, lp["wkv_a"])  # [B, T, r+rot]
+    c = rms_norm(ckv[..., :r], lp["kv_norm"], cfg.rms_eps)
+    k_pe = apply_rope(ckv[..., None, r:], positions, inv)  # [B, T, 1, rot]
+    return jnp.concatenate([c[..., None, :], k_pe], axis=-1)
+
+
+def _mla_full_qkv(cfg: ArchConfig, lp: Params, x: jnp.ndarray,
+                  positions: jnp.ndarray, inv: jnp.ndarray):
+    """Full-rank MLA projections for prefill. x [B, T, D] →
+    (q [B,T,H,Dq], k [B,T,H,Dq], v [B,T,H,Dq] zero-padded from v_head_dim,
+    rows [B,T,1,r+rot]). The ops reshape outputs to q's head dim, so v rides
+    zero-padded and the caller slices [..., :v_head_dim]."""
+    H = cfg.num_heads
+    n, rot, vd = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+    r = cfg.kv_lora_rank
+    q = _mla_q(cfg, lp, x)
+    q = jnp.concatenate([q[..., :n], apply_rope(q[..., n:], positions, inv)], axis=-1)
+    amp = rope_query_amp(cfg)
+    if amp != 1.0:
+        q = q * float(amp)
+    rows = _mla_rows(cfg, lp, x, positions, inv)
+    c, k_pe = rows[..., 0, :r], rows[..., :, r:]  # [B,T,r], [B,T,1,rot]
+    k_nope = jnp.einsum("btr,hnr->bthn", c, lp["w_kb"]).astype(x.dtype)
+    k = jnp.concatenate(
+        [k_nope, jnp.broadcast_to(k_pe, (*k_pe.shape[:2], H, rot)).astype(x.dtype)],
+        axis=-1,
+    )
+    v = jnp.einsum("btr,hvr->bthv", c, lp["w_vb"]).astype(x.dtype)
+    v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, cfg.qk_head_dim - vd)))
+    return q, k, v, rows
+
+
+def _mla_absorbed_q(cfg: ArchConfig, lp: Params, x: jnp.ndarray,
+                    positions: jnp.ndarray, inv: jnp.ndarray) -> jnp.ndarray:
+    """Absorbed decode query [B, T, H, r+rot] scoring directly against the
+    latent cache. The attention ops scale by the OPERAND width (r+rot), so
+    the sqrt((r+rot)/qk_head_dim) ratio is folded in here to restore the
+    true 1/sqrt(qk_head_dim) softmax scale (same trick as query_scale)."""
+    n = cfg.qk_nope_head_dim
+    q = _mla_q(cfg, lp, x)
+    q_pe = apply_rope(q[..., n:], positions, inv)
+    q_lat = jnp.einsum("bthn,hnr->bthr", q[..., :n], lp["w_kb"]).astype(x.dtype)
+    q_eff = jnp.concatenate([q_lat, q_pe.astype(x.dtype)], axis=-1)
+    scale = ((cfg.kv_lora_rank + cfg.qk_rope_head_dim) / cfg.qk_head_dim) ** 0.5
+    return q_eff * jnp.asarray(scale * rope_query_amp(cfg), x.dtype)
+
+
+def _mla_unlatent(cfg: ArchConfig, lp: Params, attn: jnp.ndarray) -> jnp.ndarray:
+    """Absorbed attention output [..., H, r+rot] → flat per-head values
+    [..., H·v_head_dim] via W_vb (the deferred value up-projection)."""
+    lat = attn[..., : cfg.kv_lora_rank]
+    out = jnp.einsum("...hr,hvr->...hv", lat, lp["w_vb"].astype(lat.dtype))
+    return out.reshape(*attn.shape[:-2], -1)
 
 
 def _embed(cfg: ArchConfig, params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
@@ -409,8 +637,26 @@ def _forward_hidden(
     def layer(h, xs):
         lp, li = xs  # li: layer index (sliding windows alternate by layer)
         x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
-        q, k, v = _attn_proj_qkv(cfg, lp, x)
         inv = _layer_inv_freq(cfg, inv_freq, inv_local, li)
+        if cfg.is_mla:
+            if use_ring:
+                raise NotImplementedError(
+                    "MLA + sequence parallelism is excluded this round "
+                    "(PARITY.md: ring rotation of latent rows needs its own "
+                    "kernel); shard MLA models over tp/ep instead"
+                )
+            q, k, v, rows = _mla_full_qkv(cfg, lp, x, positions, inv)
+            # Dense path (no `lengths`): the flash kernel tiles head_dim in
+            # 128-lane blocks and MLA's qk width (192) is not a multiple.
+            attn = prefill_attention(q, k, v, length_mask)
+            attn = attn[..., : cfg.v_head_dim]
+            h = h + _attn_out(cfg, lp, attn.reshape(B, S, -1))
+            x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
+            h = h + _mlp_out(cfg, lp, x, ep)
+            return h, (
+                (rows, rows[..., :0]) if collect_kv else None
+            )
+        q, k, v = _attn_proj_qkv(cfg, lp, x)
         q = apply_rope(q, positions, inv)
         k = apply_rope(k, positions, inv)
         if use_ring:
@@ -432,9 +678,7 @@ def _forward_hidden(
         h = h + _mlp_out(cfg, lp, x, ep)
         return h, ((k, v) if collect_kv else None)
 
-    h, kv = jax.lax.scan(
-        layer, h, (params["layers"], jnp.arange(cfg.num_layers))
-    )
+    h, kv = _scan_layers(cfg, params, h, layer)
     h = rms_norm(h, params["final_norm"], cfg.rms_eps)
     return h, length_mask, kv
 
@@ -536,8 +780,21 @@ def decode_step(
     def layer(h, xs):
         lp, li, kc, vc = xs
         x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
-        q, k, v = _attn_proj_qkv(cfg, lp, x)  # q [B,H,Hd], k/v [B,K,Hd]
         inv = _layer_inv_freq(cfg, inv_freq, inv_local, li)
+        if cfg.is_mla:
+            if use_sp:
+                raise NotImplementedError("MLA + sp is excluded (PARITY.md)")
+            x1 = x[:, None]  # [B, 1, D]
+            q_eff = _mla_absorbed_q(cfg, lp, x1, positions[:, None], inv)[:, 0]
+            rows = _mla_rows(cfg, lp, x1, positions[:, None], inv)[:, 0]  # [B,1,r+rot]
+            # The latent rides as BOTH k and v operands; [..., :r] of the
+            # output is probs·c_kv (see the MLA section header).
+            attn = decode_attention_appended(q_eff, kc, kc, rows, rows, positions)
+            h = h + _attn_out(cfg, lp, _mla_unlatent(cfg, lp, attn))
+            x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
+            h = h + _mlp_out(cfg, lp, x, ep)
+            return h, (rows, rows[..., :0])
+        q, k, v = _attn_proj_qkv(cfg, lp, x)  # q [B,H,Hd], k/v [B,K,Hd]
         q = apply_rope(q[:, None], positions[:, None], inv)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], inv)[:, 0]
         if use_sp:
@@ -559,9 +816,8 @@ def decode_step(
         h = h + _mlp_out(cfg, lp, x, ep)
         return h, (k, v)
 
-    h, (new_k, new_v) = jax.lax.scan(
-        layer, h,
-        (params["layers"], jnp.arange(cfg.num_layers), cache.k, cache.v),
+    h, (new_k, new_v) = _scan_layers(
+        cfg, params, h, layer, (cache.k, cache.v)
     )
     # One scatter: cache[l, b, positions[b]] = new row, all layers at once.
     k = cache.k.at[:, batch_idx, positions].set(new_k.astype(cache.k.dtype))
@@ -601,8 +857,30 @@ def decode_step_windowed(
     def layer(h, xs):
         lp, li, kc, vc, lk, lv = xs
         x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
-        q, k, v = _attn_proj_qkv(cfg, lp, x)
         inv = _layer_inv_freq(cfg, inv_freq, inv_local, li)
+        if cfg.is_mla:
+            if use_sp:
+                raise NotImplementedError("MLA + sp is excluded (PARITY.md)")
+            x1 = x[:, None]
+            q_eff = _mla_absorbed_q(cfg, lp, x1, positions[:, None], inv)[:, 0]
+            rows = _mla_rows(cfg, lp, x1, positions[:, None], inv)[:, 0]
+            if ptable is not None:
+                from localai_tpu.ops.attention import (
+                    decode_attention_windowed_paged,
+                )
+
+                attn = decode_attention_windowed_paged(
+                    q_eff, kc, kc, ptable, lk, lk, rows, rows, positions, step,
+                )
+            else:
+                attn = decode_attention_windowed(
+                    q_eff, kc, kc, lk, lk, rows, rows, positions, step,
+                )
+            h = h + _attn_out(cfg, lp, _mla_unlatent(cfg, lp, attn))
+            x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
+            h = h + _mlp_out(cfg, lp, x, ep)
+            return h, (rows, rows[..., :0])
+        q, k, v = _attn_proj_qkv(cfg, lp, x)
         q = apply_rope(q[:, None], positions[:, None], inv)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], inv)[:, 0]
         if ptable is not None:
@@ -632,10 +910,8 @@ def decode_step_windowed(
         h = h + _mlp_out(cfg, lp, x, ep)
         return h, (k, v)
 
-    h, (new_k, new_v) = jax.lax.scan(
-        layer, h,
-        (params["layers"], jnp.arange(cfg.num_layers), cache.k, cache.v,
-         local_k, local_v),
+    h, (new_k, new_v) = _scan_layers(
+        cfg, params, h, layer, (cache.k, cache.v, local_k, local_v)
     )
     local_k = jax.lax.dynamic_update_index_in_dim(
         local_k, new_k.astype(local_k.dtype), step, axis=2
@@ -699,8 +975,47 @@ def decode_chunk(
         lp, li, kc, vc = xs
         sliding = _layer_sliding(cfg, li)
         x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
-        q, k, v = _attn_proj_qkv(cfg, lp, x)  # q [B,T,H,Hd], k/v [B,T,K,Hd]
         inv = _layer_inv_freq(cfg, inv_freq, inv_local, li)
+        if cfg.is_mla:
+            # Absorbed MLA verify chunk: q_eff scores the latent cache and
+            # the window's fresh latent rows; values come back out of the
+            # same latents ([..., :r] → W_vb).
+            q_eff = _mla_absorbed_q(cfg, lp, x, positions, inv)  # [B,T,H,De]
+            rows = _mla_rows(cfg, lp, x, positions, inv)  # [B,T,1,De]
+            if ptable is not None:
+                from localai_tpu.ops.attention import (
+                    _merge_partials_mq,
+                    _paged_cache_partials_mq,
+                )
+
+                acc, m, l = _paged_cache_partials_mq(
+                    q_eff, kc, kc, ptable, positions[:, 0], q_pos=positions
+                )
+                attn = _merge_partials_mq(
+                    q_eff, acc, m, l, rows, rows,  # [B, T, 1, De] = [B, E, K, D]
+                    jnp.broadcast_to(causal[None], (B, T, T)),
+                )
+            else:
+                De = q_eff.shape[-1]
+                qf = (q_eff.astype(jnp.float32) / De**0.5)
+                kcf = kc[..., 0, :].astype(jnp.float32)  # [B, S, De]
+                rf = rows[..., 0, :].astype(jnp.float32)  # [B, T, De]
+                sc = jnp.einsum("bthd,bsd->bhts", qf, kcf)
+                prefix = jnp.arange(S)[None, None, :] < positions[:, :1, None]
+                sc = jnp.where(prefix[:, None], sc, -1e30)
+                sw = jnp.einsum("bthd,bud->bhtu", qf, rf)
+                sw = jnp.where(causal[None, None], sw, -1e30)
+                probs = jax.nn.softmax(jnp.concatenate([sc, sw], axis=-1), axis=-1)
+                attn = jnp.einsum("bhts,bsd->bthd", probs[..., :S], kcf) + jnp.einsum(
+                    "bhtu,bud->bthd", probs[..., S:], rf
+                )
+                attn = attn.astype(h.dtype)
+            attn = _mla_unlatent(cfg, lp, attn)  # [B, T, H·v]
+            h = h + _attn_out(cfg, lp, attn)
+            x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
+            h = h + _mlp_out(cfg, lp, x, ep)
+            return h, (rows, rows[..., :0])
+        q, k, v = _attn_proj_qkv(cfg, lp, x)  # q [B,T,H,Hd], k/v [B,T,K,Hd]
         q = apply_rope(q, positions, inv)
         k = apply_rope(k, positions, inv)
         K_h = kc.shape[2]
@@ -750,9 +1065,8 @@ def decode_chunk(
         h = h + _mlp_out(cfg, lp, x, ep)
         return h, (k, v)
 
-    h, (new_k, new_v) = jax.lax.scan(
-        layer, h,
-        (params["layers"], jnp.arange(cfg.num_layers), cache.k, cache.v),
+    h, (new_k, new_v) = _scan_layers(
+        cfg, params, h, layer, (cache.k, cache.v)
     )
     if ptable is not None:
         cache = write_chunk_to_pool(cache, ptable, new_k, new_v, positions)
@@ -800,8 +1114,32 @@ def prefill_tail(
         lp, li, kc, vc = xs  # kc/vc [B, P, K, Hd]
         sliding = _layer_sliding(cfg, li)
         x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
-        q, k, v = _attn_proj_qkv(cfg, lp, x)  # q [B,T,H,Hd], k/v [B,T,K,Hd]
         inv = _layer_inv_freq(cfg, inv_freq, inv_local, li)
+        if cfg.is_mla:
+            # Absorbed tail prefill against cached LATENT prefix rows: the
+            # identity q·k = q_eff·latent holds for the in-tail tokens too,
+            # so both segments score in latent space.
+            q_eff = _mla_absorbed_q(cfg, lp, x, positions, inv)  # [B,T,H,De]
+            rows = _mla_rows(cfg, lp, x, positions, inv)  # [B,T,1,De]
+            De = q_eff.shape[-1]
+            qf = q_eff.astype(jnp.float32) / De**0.5
+            kcf = kc[..., 0, :].astype(jnp.float32)  # [B, P, De]
+            rf = rows[..., 0, :].astype(jnp.float32)  # [B, T, De]
+            sc = jnp.einsum("bthd,bsd->bhts", qf, kcf)
+            sc = jnp.where(pvalid[:, None, None], sc, -1e30)
+            sw = jnp.einsum("bthd,bud->bhtu", qf, rf)
+            wm = causal[None, None] & length_mask[:, None, None, :]
+            sw = jnp.where(wm, sw, -1e30)
+            probs = jax.nn.softmax(jnp.concatenate([sc, sw], axis=-1), axis=-1)
+            attn = jnp.einsum("bhts,bsd->bthd", probs[..., :P], kcf) + jnp.einsum(
+                "bhtu,bud->bthd", probs[..., P:], rf
+            )
+            attn = _mla_unlatent(cfg, lp, attn.astype(h.dtype))
+            h = h + _attn_out(cfg, lp, attn)
+            x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
+            h = h + _mlp_out(cfg, lp, x, ep)
+            return h, (rows, rows[..., :0])
+        q, k, v = _attn_proj_qkv(cfg, lp, x)  # q [B,T,H,Hd], k/v [B,T,K,Hd]
         q = apply_rope(q, positions, inv)
         k = apply_rope(k, positions, inv)
         K_h = kc.shape[2]
@@ -833,9 +1171,8 @@ def prefill_tail(
         h = h + _mlp_out(cfg, lp, x, ep)
         return h, (k, v)
 
-    h, (ks, vs) = jax.lax.scan(
-        layer, h,
-        (params["layers"], jnp.arange(cfg.num_layers), prefix_k, prefix_v),
+    h, (ks, vs) = _scan_layers(
+        cfg, params, h, layer, (prefix_k, prefix_v)
     )
     h = rms_norm(h, params["final_norm"], cfg.rms_eps)
     last_idx = jnp.maximum(lengths - 1, 0)
@@ -872,10 +1209,14 @@ def paged_cache_zeros(cfg: ArchConfig, num_pages: int, page_size: int,
                       dtype=None) -> KVCache:
     """Page pool: k/v [L, P, page, K, Hd]. One pool serves every slot; the
     engine assigns pages to slots and passes per-slot tables to each program.
-    HBM scales with pages in use, not slots × max_seq (SURVEY §7 ragged KV)."""
+    HBM scales with pages in use, not slots × max_seq (SURVEY §7 ragged KV).
+    MLA pools hold latent rows (see KVCache docstring)."""
     dtype = jnp.dtype(cfg.dtype) if dtype is None else dtype
-    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim_)
-    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+    base = (cfg.num_layers, num_pages, page_size, cfg.cache_kv_heads)
+    return KVCache(
+        k=jnp.zeros(base + (cfg.cache_k_dim,), dtype),
+        v=jnp.zeros(base + (cfg.cache_v_dim,), dtype),
+    )
 
 
 def write_block_to_pool(

@@ -24,7 +24,9 @@ def rope_frequencies(cfg: ArchConfig) -> jnp.ndarray:
     grpc-server.cpp params_parse): linear, llama-3 NTK-by-parts, yarn, and
     phi-3 longrope. The matching attention-amplitude factor (yarn mscale /
     longrope scaling) is served by `rope_query_amp`."""
-    hd = cfg.head_dim_
+    # Under MLA only the qk_rope_head_dim slice of q/k rotates (HF deepseek
+    # configs set head_dim to the same value, but don't rely on it).
+    hd = cfg.qk_rope_head_dim if cfg.is_mla else cfg.head_dim_
     dims = jnp.arange(0, hd, 2, dtype=jnp.float32)
     inv_freq = 1.0 / (cfg.rope_theta ** (dims / hd))
     if cfg.rope_scaling == "linear":
