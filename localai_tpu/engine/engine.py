@@ -330,6 +330,11 @@ class Engine:
         validate_plan(cfg, self.plan.tp, self.plan.ep)
         self.mesh = build_mesh(self.plan, devices)
         if self.plan.sp > 1:
+            if cfg.is_mla:
+                raise ValueError(
+                    "MLA models exclude sp>1 this round (PARITY.md) — "
+                    "shard over tp/ep instead"
+                )
             ecfg_ = engine_cfg or EngineConfig()
             if ecfg_.max_seq % self.plan.sp or ecfg_.min_prefill_bucket % self.plan.sp:
                 raise ValueError(
@@ -383,7 +388,10 @@ class Engine:
                 from jax.sharding import NamedSharding
                 from jax.sharding import PartitionSpec as P
 
-                pool_shard = NamedSharding(self.mesh, P(None, None, None, "tp", None))
+                pool_shard = NamedSharding(
+                    self.mesh,
+                    P(None, None, None, None if cfg.is_mla else "tp", None),
+                )
                 # +1: the last page is SCRATCH — every unassigned/stale page
                 # table entry points there, so idle slots and end-of-request
                 # overshoot rows (the decode block writes all B slots every
@@ -398,16 +406,17 @@ class Engine:
                     v=jax.device_put(pool.v, pool_shard),
                 )
             else:
-                kshard, vshard = cache_shardings(self.mesh, self.plan.sp)
+                kshard, vshard = cache_shardings(
+                    self.mesh, self.plan.sp, cfg.is_mla
+                )
                 cache_dt = self.ecfg.cache_dtype(cfg.dtype)
+                base = (cfg.num_layers, B, S, cfg.cache_kv_heads)
                 self.cache = llama.KVCache(
                     k=jax.device_put(
-                        jnp.zeros((cfg.num_layers, B, S, cfg.num_kv_heads, cfg.head_dim_), cache_dt),
-                        kshard,
+                        jnp.zeros(base + (cfg.cache_k_dim,), cache_dt), kshard
                     ),
                     v=jax.device_put(
-                        jnp.zeros((cfg.num_layers, B, S, cfg.num_kv_heads, cfg.head_dim_), cache_dt),
-                        vshard,
+                        jnp.zeros(base + (cfg.cache_v_dim,), cache_dt), vshard
                     ),
                 )
         self.draft_params = None
@@ -677,10 +686,13 @@ class Engine:
                 )
             start_pos = positions
             local_k = jnp.zeros(
-                (cfg.num_layers, B, n, cfg.num_kv_heads, cfg.head_dim_),
+                (cfg.num_layers, B, n, cfg.cache_kv_heads, cfg.cache_k_dim),
                 cache.k.dtype,
             )
-            local_v = jnp.zeros_like(local_k)
+            local_v = jnp.zeros(
+                (cfg.num_layers, B, n, cfg.cache_kv_heads, cfg.cache_v_dim),
+                cache.v.dtype,
+            )
 
             def body(carry, step):
                 tokens, positions, counts, rngs, lk, lv, gs = carry
@@ -1166,13 +1178,14 @@ class Engine:
         fn = self._snap_cache.get(pb)
         if fn is None:
             L = self.cfg.num_layers
-            K, Hd = self.cfg.num_kv_heads, self.cfg.head_dim_
+            K = self.cfg.cache_kv_heads
+            kd, vd = self.cfg.cache_k_dim, self.cfg.cache_v_dim
 
             def snap(cache, slot):
                 k = jax.lax.dynamic_slice(
-                    cache.k, (0, slot, 0, 0, 0), (L, 1, pb, K, Hd))
+                    cache.k, (0, slot, 0, 0, 0), (L, 1, pb, K, kd))
                 v = jax.lax.dynamic_slice(
-                    cache.v, (0, slot, 0, 0, 0), (L, 1, pb, K, Hd))
+                    cache.v, (0, slot, 0, 0, 0), (L, 1, pb, K, vd))
                 return k, v
 
             fn = jax.jit(snap)
@@ -1286,7 +1299,8 @@ class Engine:
         configured capacity."""
         cfg = self.cfg
         return (
-            2 * cfg.num_layers * pb * cfg.num_kv_heads * cfg.head_dim_
+            cfg.num_layers * pb * cfg.cache_kv_heads
+            * (cfg.cache_k_dim + cfg.cache_v_dim)
             * jnp.dtype(self.ecfg.cache_dtype(cfg.dtype)).itemsize
         )
 

@@ -443,12 +443,16 @@ def arch_from_gguf(gf: GGUFFile):
             "does not implement — serving it with llama semantics would "
             "produce wrong output. Use the HF safetensors checkpoint instead."
         )
-    if a not in ("llama", "qwen2", "qwen3", "mistral", "gemma", "granite"):
+    if a not in ("llama", "qwen2", "qwen3", "mistral", "gemma", "granite",
+                 "deepseek2"):
         log.warning("GGUF arch %r not in the known set; mapping as llama-family", a)
     gemma = a == "gemma"
 
     def k(suffix: str, default=None):
         return kv.get(f"{a}.{suffix}", default)
+
+    if a == "deepseek2":
+        return _arch_from_deepseek2_gguf(gf, k)
 
     n_heads = int(k("attention.head_count", 32))
     head_dim = int(k("attention.key_length", 0)) or None
@@ -496,6 +500,78 @@ def arch_from_gguf(gf: GGUFFile):
         embed_scale=gemma,
         num_experts=int(k("expert_count", 0) or 0),
         num_experts_per_token=int(k("expert_used_count", 2) or 2),
+    )
+
+
+def _arch_from_deepseek2_gguf(gf: GGUFFile, k):
+    """DeepSeek-V2/V3 GGUF metadata → ArchConfig (llama.cpp deepseek2 keys;
+    the reference serves these GGUFs via the llama.cpp backend). llama.cpp
+    treats deepseek2 as a NORM-rope (pair-interleaved) arch with unpermuted
+    HF-layout tensors, so rope_interleave=True routes the same column
+    de-interleave the HF loader applies."""
+    from localai_tpu.models.config import ArchConfig
+
+    n_heads = int(k("attention.head_count", 16))
+    rope_dim = int(k("rope.dimension_count", 64))
+    key_len = int(k("attention.key_length", 192))
+    q_lora = int(k("attention.q_lora_rank", 0) or 0) or None
+    n_experts = int(k("expert_count", 0) or 0)
+    kd = int(k("leading_dense_block_count", 0) or 0) if n_experts else 0
+    gating = int(k("expert_gating_func", 1) or 1)  # 1=softmax, 2=sigmoid
+    # the correction bias lives on MoE blocks — the first is blk.{kd}
+    has_bias = f"blk.{kd}.exp_probs_b.bias" in gf.tensors
+    sigmoid = gating == 2 or has_bias
+    vocab = int(gf.kv.get("deepseek2.vocab_size", 0)) or len(
+        gf.kv.get("tokenizer.ggml.tokens", []) or []
+    )
+    scaling_factor = float(k("rope.scaling.factor", 0) or 0)
+    yarn = str(k("rope.scaling.type", "")) == "yarn"
+    orig_ctx = int(k("rope.scaling.original_context_length", 0) or 0)
+    # llama.cpp records yarn log-multiplier = 0.1·mscale_all_dim; the net
+    # deepseek amplitude (see weights.arch_from_hf_config) is
+    # 0.1·mscale·ln(factor)+1 — GGUFs carry mscale==mscale_all_dim models
+    # (V2/V3/R1 all do), so the recorded multiplier reproduces it.
+    logmul = k("rope.scaling.yarn_log_multiplier", None)
+    attn_factor = None
+    if yarn and logmul is not None and scaling_factor > 1:
+        import math
+
+        attn_factor = float(logmul) * math.log(scaling_factor) + 1.0
+    return ArchConfig(
+        name=os.path.basename(gf.path),
+        vocab_size=vocab,
+        hidden_size=int(k("embedding_length", 2048)),
+        intermediate_size=int(k("feed_forward_length", 10944)),
+        num_layers=int(k("block_count", 27)),
+        num_heads=n_heads,
+        num_kv_heads=n_heads,
+        head_dim=rope_dim,
+        rope_theta=float(k("rope.freq_base", 10000.0)),
+        rope_scaling="yarn" if yarn else None,
+        rope_scaling_factor=scaling_factor or 1.0,
+        rope_original_max_position=orig_ctx or 4096,
+        rope_attn_factor=attn_factor,
+        rms_eps=float(k("attention.layer_norm_rms_epsilon", 1e-6)),
+        max_position=int(k("context_length", 4096)),
+        tie_embeddings="output.weight" not in gf.tensors,
+        num_experts=n_experts,
+        num_experts_per_token=int(k("expert_used_count", 6) or 6),
+        moe_family="deepseek",
+        first_k_dense=kd,
+        n_shared_experts=int(k("expert_shared_count", 0) or 0),
+        moe_intermediate_size=int(k("expert_feed_forward_length", 0) or 0) or None,
+        routed_scaling_factor=float(k("expert_weights_scale", 1.0) or 1.0),
+        scoring_func="sigmoid" if sigmoid else "softmax",
+        router_bias=has_bias,
+        norm_topk_prob=bool(k("expert_weights_norm", False)),
+        n_group=int(k("expert_group_count", 1) or 1),
+        topk_group=int(k("expert_group_used_count", 1) or 1),
+        kv_lora_rank=int(k("attention.kv_lora_rank", 512)),
+        q_lora_rank=q_lora,
+        qk_nope_head_dim=key_len - rope_dim,
+        qk_rope_head_dim=rope_dim,
+        v_head_dim=int(k("attention.value_length", 128)),
+        rope_interleave=True,
     )
 
 
@@ -658,6 +734,8 @@ def load_gguf_params(gf: GGUFFile, arch) -> dict:
     from localai_tpu.models.quant import quantize_tensor_np
 
     bf16 = ml_dtypes.bfloat16
+    if arch.is_mla:
+        return _load_gguf_deepseek(gf, arch)
     L = arch.num_layers
     layers: dict[str, Any] = {}
     # llama.cpp's convert script permutes q/k rows ONLY for the llama family
@@ -751,6 +829,150 @@ def load_gguf_params(gf: GGUFFile, arch) -> dict:
         "layers": layers,
         "final_norm": gf.tensor("output_norm.weight").astype(np.float32).astype(bf16),
     }
+    if "output.weight" in gf.tensors:
+        w = gf.tensor("output.weight").astype(np.float32)  # [V, D]
+        params["lm_head"] = quantize_tensor_np(w, axis=-1)
+    return params
+
+
+def _load_gguf_deepseek(gf: GGUFFile, arch) -> dict:
+    """DeepSeek-V2/V3 GGUF → the two-stack MLA/MoE param tree.
+
+    llama.cpp deepseek2 tensor names (fused-expert layout): attn_q(_a/_b),
+    attn_kv_a_mqa, attn_kv_a_norm, attn_kv_b, attn_output; ffn_gate_inp +
+    exp_probs_b + ffn_{gate,up,down}_exps + ffn_{gate,up,down}_shexp for MoE
+    blocks; plain ffn_{gate,up,down} for the leading dense blocks. Tensors
+    keep the HF column layout (NORM/interleaved rope), so the rope columns
+    de-interleave exactly as in engine/weights._load_deepseek. Attention
+    tensors dequantize to bf16 (small next to the experts); fused expert
+    tensors repack to grouped int8 per expert (the dense-MoE quantized
+    path); kv_b splits per head into w_kb/w_vb.
+    """
+    import ml_dtypes
+
+    from localai_tpu.engine.weights import _deinterleave
+    from localai_tpu.models.quant import quantize_tensor_np
+
+    bf16 = ml_dtypes.bfloat16
+    L = arch.num_layers
+    kd = arch.first_k_dense if arch.is_moe else 0
+    H = arch.num_heads
+    n, rot, vd = arch.qk_nope_head_dim, arch.qk_rope_head_dim, arch.v_head_dim
+    r = arch.kv_lora_rank
+
+    def mm(i: int, gname: str, rope_block: int = 0) -> np.ndarray:
+        """[in, out] bf16 matmul weight, rope columns de-interleaved."""
+        w = gf.tensor(f"blk.{i}.{gname}.weight").astype(np.float32).T
+        w = np.ascontiguousarray(w)
+        if rope_block:
+            w = _deinterleave(w, rot, rope_block)
+        return w.astype(bf16)
+
+    def vec(i: int, gname: str) -> np.ndarray:
+        return gf.tensor(f"blk.{i}.{gname}.weight").astype(np.float32).astype(bf16)
+
+    def attn_stack(lo: int, hi: int) -> dict:
+        out: dict[str, Any] = {
+            "attn_norm": np.stack([vec(i, "attn_norm") for i in range(lo, hi)]),
+            "mlp_norm": np.stack([vec(i, "ffn_norm") for i in range(lo, hi)]),
+            "kv_norm": np.stack([vec(i, "attn_kv_a_norm") for i in range(lo, hi)]),
+            "wo": np.stack([mm(i, "attn_output") for i in range(lo, hi)]),
+            "wkv_a": np.stack(
+                [mm(i, "attn_kv_a_mqa", rope_block=r + rot) for i in range(lo, hi)]
+            ),
+        }
+        if arch.q_lora_rank:
+            out["wq_a"] = np.stack([mm(i, "attn_q_a") for i in range(lo, hi)])
+            out["q_norm_a"] = np.stack(
+                [vec(i, "attn_q_a_norm") for i in range(lo, hi)]
+            )
+            out["wq_b"] = np.stack(
+                [mm(i, "attn_q_b", rope_block=n + rot) for i in range(lo, hi)]
+            )
+        else:
+            out["wq"] = np.stack(
+                [mm(i, "attn_q", rope_block=n + rot) for i in range(lo, hi)]
+            )
+        kbs, vbs = [], []
+        for i in range(lo, hi):
+            name = f"blk.{i}.attn_kv_b.weight"
+            if name not in gf.tensors:
+                raise GGUFReadError(
+                    f"deepseek2 GGUF missing {name!r} — exports that ship "
+                    "only the pre-split attn_k_b/attn_v_b are not supported"
+                )
+            kb = gf.tensor(name).astype(np.float32).reshape(H, n + vd, r)
+            kbs.append(kb[:, :n].astype(bf16))
+            vbs.append(kb[:, n:].astype(bf16))
+        out["w_kb"] = np.stack(kbs)
+        out["w_vb"] = np.stack(vbs)
+        return out
+
+    layers = attn_stack(kd, L)
+    if arch.is_moe:
+        E = arch.num_experts
+        routers, biases = [], []
+        moe_parts: dict[str, list] = {"w_gate": [], "w_up": [], "w_down": []}
+        names = {"w_gate": "ffn_gate_exps", "w_up": "ffn_up_exps",
+                 "w_down": "ffn_down_exps"}
+        has_bias = f"blk.{kd}.exp_probs_b.bias" in gf.tensors
+        for i in range(kd, L):
+            routers.append(
+                np.ascontiguousarray(
+                    gf.tensor(f"blk.{i}.ffn_gate_inp.weight").astype(np.float32).T
+                ).astype(bf16)
+            )
+            if has_bias:
+                biases.append(
+                    gf.tensor(f"blk.{i}.exp_probs_b.bias").astype(np.float32)
+                )
+            # All three projections must share one representation (the MLP
+            # branches on w_gate's type): grouped int8 only when every
+            # in-dim is groupable, else bf16 dense (test-scale shapes).
+            groupable = (arch.hidden_size % 32 == 0
+                         and arch.moe_inter_size % 32 == 0)
+            for ours, nm in names.items():
+                t3 = gf.tensor(f"blk.{i}.{nm}.weight").astype(np.float32)
+                if groupable:
+                    per_e = [grouped_int8_from_dense(t3[e]) for e in range(E)]
+                    moe_parts[ours].append(
+                        {kk: np.stack([p[kk] for p in per_e]) for kk in per_e[0]}
+                    )
+                else:
+                    moe_parts[ours].append(
+                        np.ascontiguousarray(t3.swapaxes(-1, -2)).astype(bf16)
+                    )
+        layers["router"] = np.stack(routers)
+        if has_bias:
+            layers["router_bias"] = np.stack(biases)
+        for ours, parts in moe_parts.items():
+            if isinstance(parts[0], dict):
+                layers[ours] = {
+                    kk: np.stack([p[kk] for p in parts]) for kk in parts[0]
+                }
+            else:
+                layers[ours] = np.stack(parts)
+        if arch.n_shared_experts:
+            for ours, nm in (("shared_gate", "ffn_gate_shexp"),
+                             ("shared_up", "ffn_up_shexp"),
+                             ("shared_down", "ffn_down_shexp")):
+                layers[ours] = np.stack([mm(i, nm) for i in range(kd, L)])
+    else:
+        for ours, nm in (("w_gate", "ffn_gate"), ("w_up", "ffn_up"),
+                         ("w_down", "ffn_down")):
+            layers[ours] = np.stack([mm(i, nm) for i in range(L)])
+
+    params: dict[str, Any] = {
+        "embed": gf.tensor("token_embd.weight").astype(np.float32).astype(bf16),
+        "layers": layers,
+        "final_norm": gf.tensor("output_norm.weight").astype(np.float32).astype(bf16),
+    }
+    if kd:
+        dense = attn_stack(0, kd)
+        for ours, nm in (("w_gate", "ffn_gate"), ("w_up", "ffn_up"),
+                         ("w_down", "ffn_down")):
+            dense[ours] = np.stack([mm(i, nm) for i in range(kd)])
+        params["dense_layers"] = dense
     if "output.weight" in gf.tensors:
         w = gf.tensor("output.weight").astype(np.float32)  # [V, D]
         params["lm_head"] = quantize_tensor_np(w, axis=-1)

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import os
 from typing import Any, Callable
 
@@ -307,6 +308,20 @@ def _deinterleave(arr: np.ndarray, rot: int, block: int) -> np.ndarray:
     return out.reshape(arr.shape[0], -1)
 
 
+def _interleave(arr: np.ndarray, rot: int, block: int) -> np.ndarray:
+    """Inverse of _deinterleave: back to HF pair-interleaved rope columns
+    (deepseek_v2 exports — the V2 modeling code applies complex rope
+    unconditionally, so V2 checkpoints MUST ship interleaved)."""
+    out = arr.reshape(arr.shape[0], -1, block).copy()
+    rope = out[..., block - rot:]
+    half = rot // 2
+    inter = np.empty_like(rope)
+    inter[..., 0::2] = rope[..., :half]
+    inter[..., 1::2] = rope[..., half:]
+    out[..., block - rot:] = inter
+    return out.reshape(arr.shape[0], -1)
+
+
 def _load_deepseek(cfg: ArchConfig, grab, place, put, reader) -> Params:
     """DeepSeek-V2/V3 checkpoint → the two-stack MLA/MoE param tree.
 
@@ -324,7 +339,7 @@ def _load_deepseek(cfg: ArchConfig, grab, place, put, reader) -> Params:
     kd = cfg.first_k_dense if cfg.is_moe else 0
     L = cfg.num_layers
 
-    def stack(our: str, suffix: str, lo: int, hi: int, transpose: bool,
+    def stack(suffix: str, lo: int, hi: int, transpose: bool,
               rope_block: int = 0) -> np.ndarray:
         rows = []
         for i in range(lo, hi):
@@ -336,33 +351,33 @@ def _load_deepseek(cfg: ArchConfig, grab, place, put, reader) -> Params:
 
     def attn_stack(lo: int, hi: int) -> Params:
         out: Params = {
-            "attn_norm": stack("attn_norm", "input_layernorm.weight", lo, hi, False),
-            "mlp_norm": stack("mlp_norm", "post_attention_layernorm.weight", lo, hi, False),
-            "kv_norm": stack("kv_norm", "self_attn.kv_a_layernorm.weight", lo, hi, False),
-            "wo": place(f"layers/wo@{lo}", stack("wo", "self_attn.o_proj.weight", lo, hi, True), True),
+            "attn_norm": stack("input_layernorm.weight", lo, hi, False),
+            "mlp_norm": stack("post_attention_layernorm.weight", lo, hi, False),
+            "kv_norm": stack("self_attn.kv_a_layernorm.weight", lo, hi, False),
+            "wo": place(f"layers/wo@{lo}", stack("self_attn.o_proj.weight", lo, hi, True), True),
         }
         if cfg.q_lora_rank:
             out["wq_a"] = place(
-                f"layers/wq_a@{lo}", stack("wq_a", "self_attn.q_a_proj.weight", lo, hi, True), True
+                f"layers/wq_a@{lo}", stack("self_attn.q_a_proj.weight", lo, hi, True), True
             )
             out["q_norm_a"] = put(
                 f"layers/q_norm_a@{lo}",
-                stack("q_norm_a", "self_attn.q_a_layernorm.weight", lo, hi, False),
+                stack("self_attn.q_a_layernorm.weight", lo, hi, False),
             )
             out["wq_b"] = place(
                 f"layers/wq_b@{lo}",
-                stack("wq_b", "self_attn.q_b_proj.weight", lo, hi, True, rope_block=n + rot),
+                stack("self_attn.q_b_proj.weight", lo, hi, True, rope_block=n + rot),
                 True,
             )
         else:
             out["wq"] = place(
                 f"layers/wq@{lo}",
-                stack("wq", "self_attn.q_proj.weight", lo, hi, True, rope_block=n + rot),
+                stack("self_attn.q_proj.weight", lo, hi, True, rope_block=n + rot),
                 True,
             )
         out["wkv_a"] = place(
             f"layers/wkv_a@{lo}",
-            stack("wkv_a", "self_attn.kv_a_proj_with_mqa.weight", lo, hi, True,
+            stack("self_attn.kv_a_proj_with_mqa.weight", lo, hi, True,
                   rope_block=r + rot),
             True,
         )
@@ -386,14 +401,14 @@ def _load_deepseek(cfg: ArchConfig, grab, place, put, reader) -> Params:
     # rope_block=r + rot above. wq(_b) blocks are per head (n+rot).
     layers = attn_stack(kd, L)
     if cfg.is_moe:
-        E, Lm = cfg.num_experts, L - kd
+        E = cfg.num_experts
         layers["router"] = put(
-            "layers/router", stack("router", "mlp.gate.weight", kd, L, True)
+            "layers/router", stack("mlp.gate.weight", kd, L, True)
         )
         probe = f"model.layers.{kd}.mlp.gate.e_score_correction_bias"
         if probe in reader:
             layers["router_bias"] = jnp.asarray(
-                stack("router_bias", "mlp.gate.e_score_correction_bias", kd, L, False),
+                stack("mlp.gate.e_score_correction_bias", kd, L, False),
                 jnp.float32,
             )
         for our, suffix in (("w_gate", "gate_proj"), ("w_up", "up_proj"),
@@ -412,14 +427,14 @@ def _load_deepseek(cfg: ArchConfig, grab, place, put, reader) -> Params:
                                 ("shared_down", "down_proj")):
                 layers[our] = place(
                     f"layers/{our}",
-                    stack(our, f"mlp.shared_experts.{suffix}.weight", kd, L, True),
+                    stack(f"mlp.shared_experts.{suffix}.weight", kd, L, True),
                     True,
                 )
     else:
         for our, suffix in (("w_gate", "gate_proj"), ("w_up", "up_proj"),
                             ("w_down", "down_proj")):
             layers[our] = place(
-                f"layers/{our}", stack(our, f"mlp.{suffix}.weight", 0, L, True), True
+                f"layers/{our}", stack(f"mlp.{suffix}.weight", 0, L, True), True
             )
 
     params: Params = {
@@ -432,7 +447,7 @@ def _load_deepseek(cfg: ArchConfig, grab, place, put, reader) -> Params:
         for our, suffix in (("w_gate", "gate_proj"), ("w_up", "up_proj"),
                             ("w_down", "down_proj")):
             dense[our] = place(
-                f"dense_layers/{our}", stack(our, f"mlp.{suffix}.weight", 0, kd, True), True
+                f"dense_layers/{our}", stack(f"mlp.{suffix}.weight", 0, kd, True), True
             )
         params["dense_layers"] = dense
     if not cfg.tie_embeddings:
@@ -752,9 +767,17 @@ def save_hf_checkpoint(cfg: ArchConfig, params: Params, ckpt_dir: str) -> None:
 def _save_deepseek(cfg: ArchConfig, params: Params, ckpt_dir: str,
                    tensors: dict, emit) -> None:
     """Emit the two-stack deepseek tree as an HF deepseek_v2/v3 checkpoint
-    (inverse of _load_deepseek; rope_interleave is written as false so the
-    emitted layout matches our half-split columns verbatim)."""
+    (inverse of _load_deepseek). V3 exports keep our half-split rope
+    columns and declare rope_interleave=false; V2 exports RE-interleave
+    them, because the V2 modeling code (HF and vLLM) applies complex
+    pair-interleaved rope unconditionally."""
     kd = cfg.first_k_dense if cfg.is_moe else 0
+    v3 = cfg.scoring_func == "sigmoid"
+    rot = cfg.qk_rope_head_dim
+
+    def rope_cols(arr, block):
+        a = np.asarray(jnp.asarray(arr, jnp.float32))  # [in, out]
+        return _interleave(a, rot, block) if not v3 else a
 
     def emit_attn(stack: Params, lo: int) -> None:
         n = stack["attn_norm"].shape[0]
@@ -765,13 +788,16 @@ def _save_deepseek(cfg: ArchConfig, params: Params, ckpt_dir: str,
             emit(pre + "post_attention_layernorm.weight", stack["mlp_norm"][j], False)
             emit(pre + "self_attn.kv_a_layernorm.weight", stack["kv_norm"][j], False)
             emit(pre + "self_attn.o_proj.weight", stack["wo"][j], True)
-            emit(pre + "self_attn.kv_a_proj_with_mqa.weight", stack["wkv_a"][j], True)
+            emit(pre + "self_attn.kv_a_proj_with_mqa.weight",
+                 rope_cols(stack["wkv_a"][j], cfg.kv_lora_rank + rot), True)
             if cfg.q_lora_rank:
                 emit(pre + "self_attn.q_a_proj.weight", stack["wq_a"][j], True)
                 emit(pre + "self_attn.q_a_layernorm.weight", stack["q_norm_a"][j], False)
-                emit(pre + "self_attn.q_b_proj.weight", stack["wq_b"][j], True)
+                emit(pre + "self_attn.q_b_proj.weight",
+                     rope_cols(stack["wq_b"][j], cfg.qk_head_dim), True)
             else:
-                emit(pre + "self_attn.q_proj.weight", stack["wq"][j], True)
+                emit(pre + "self_attn.q_proj.weight",
+                     rope_cols(stack["wq"][j], cfg.qk_head_dim), True)
             kb = np.concatenate(
                 [np.asarray(jnp.asarray(stack["w_kb"][j], jnp.float32)),
                  np.asarray(jnp.asarray(stack["w_vb"][j], jnp.float32))], axis=1
@@ -821,7 +847,6 @@ def _save_deepseek(cfg: ArchConfig, params: Params, ckpt_dir: str,
     from safetensors.numpy import save_file
 
     save_file(tensors, os.path.join(ckpt_dir, "model.safetensors"))
-    v3 = cfg.scoring_func == "sigmoid"
     hf_config = {
         "model_type": "deepseek_v3" if v3 else "deepseek_v2",
         "hidden_act": "silu",
@@ -841,7 +866,7 @@ def _save_deepseek(cfg: ArchConfig, params: Params, ckpt_dir: str,
         "qk_rope_head_dim": cfg.qk_rope_head_dim,
         "v_head_dim": cfg.v_head_dim,
         "head_dim": cfg.qk_rope_head_dim,
-        "rope_interleave": False,
+        "rope_interleave": not v3,  # V3: half-split as stored; V2: re-interleaved
         "n_routed_experts": cfg.num_experts or None,
         "num_experts_per_tok": cfg.num_experts_per_token if cfg.is_moe else None,
         "first_k_dense_replace": cfg.first_k_dense,
@@ -857,6 +882,19 @@ def _save_deepseek(cfg: ArchConfig, params: Params, ckpt_dir: str,
         hf_config["topk_method"] = (
             "group_limited_greedy" if cfg.n_group > 1 else "greedy"
         )
+    if cfg.rope_scaling:
+        hf_config["rope_scaling"] = {
+            "rope_type": cfg.rope_scaling,
+            "factor": cfg.rope_scaling_factor,
+            "original_max_position_embeddings": cfg.rope_original_max_position,
+            "beta_fast": cfg.rope_beta_fast,
+            "beta_slow": cfg.rope_beta_slow,
+            # rope_attn_factor already folds the deepseek mscale product
+            # (see arch_from_hf_config); round-trips through the
+            # attention_factor branch exactly.
+            **({"attention_factor": cfg.rope_attn_factor}
+               if cfg.rope_attn_factor is not None else {}),
+        }
     with open(os.path.join(ckpt_dir, "config.json"), "w") as f:
         json.dump(hf_config, f, indent=1)
 
@@ -910,6 +948,25 @@ def arch_from_hf_config(ckpt_dir: str) -> ArchConfig:
     softcaps = gemma2 or gemma3  # gemma-3 configs carry the keys but None
     if model_type in ("deepseek_v2", "deepseek_v3"):
         v3 = model_type == "deepseek_v3"
+        if scaling_type == "yarn":
+            # DeepSeek yarn: the cos/sin attention_factor (mscale /
+            # mscale_all_dim ratio) COMBINES with the extra softmax-scale
+            # term yarn_get_mscale(factor, mscale_all_dim)² applied in
+            # DeepseekV3Attention.__init__ — the product collapses to
+            # yarn_get_mscale(factor, mscale), which rope_query_amp squares.
+            factor = float(rope_scaling.get("factor", 1.0))
+
+            def _gm(m):
+                return 0.1 * m * math.log(factor) + 1.0 if factor > 1 else 1.0
+
+            af = rope_scaling.get("attention_factor")
+            msad = rope_scaling.get("mscale_all_dim")
+            if af is not None:
+                attn_factor = float(af) * (_gm(float(msad)) if msad else 1.0)
+            elif rope_scaling.get("mscale") is not None and msad:
+                attn_factor = _gm(float(rope_scaling["mscale"]))
+            else:
+                attn_factor = None  # default 0.1·ln(factor)+1 in rope_query_amp
         return ArchConfig(
             name=hf.get("_name_or_path", model_type) or model_type,
             vocab_size=hf["vocab_size"],
@@ -947,9 +1004,10 @@ def arch_from_hf_config(ckpt_dir: str) -> ArchConfig:
             qk_nope_head_dim=hf.get("qk_nope_head_dim", 128),
             qk_rope_head_dim=hf.get("qk_rope_head_dim", 64),
             v_head_dim=hf.get("v_head_dim", 128),
-            # V2 applies complex (pair-interleaved) rope unconditionally;
-            # V3 checkpoints carry the flag (default true).
-            rope_interleave=bool(hf.get("rope_interleave", True)),
+            # V2 applies complex (pair-interleaved) rope unconditionally
+            # (the modeling code ignores any flag); V3 checkpoints carry
+            # the flag (default true).
+            rope_interleave=True if not v3 else bool(hf.get("rope_interleave", True)),
         )
     return ArchConfig(
         name=hf.get("_name_or_path", model_type) or model_type,

@@ -267,9 +267,15 @@ def _deepseek_route(cfg: ArchConfig, lp: Params, x: jnp.ndarray):
         choice = jnp.where(keep, choice, 0.0)
     _, sel = jax.lax.top_k(choice, k)
     weights = jnp.take_along_axis(scores, sel, axis=-1)
-    if sigmoid and cfg.norm_topk_prob:
+    if cfg.norm_topk_prob and k > 1:
         weights = weights / (weights.sum(axis=-1, keepdims=True) + 1e-20)
-    return weights * cfg.routed_scaling_factor, sel
+        # Original DeepseekV2MoEGate: normalization REPLACES the scaling
+        # factor on the softmax path; V3 (sigmoid) normalizes AND scales.
+        if sigmoid:
+            weights = weights * cfg.routed_scaling_factor
+    else:
+        weights = weights * cfg.routed_scaling_factor
+    return weights, sel
 
 
 def _moe_dense(cfg: ArchConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
@@ -373,8 +379,15 @@ def _moe_capacity(cfg: ArchConfig, lp: Params, x: jnp.ndarray, block: int = 1024
         slot = slot * keep[..., None].astype(xb.dtype)  # [Nb, k, E, C]
         disp = slot.sum(axis=1)  # [Nb, E, C] 0/1
         kept_k = keep.sum(axis=-1).astype(jnp.float32)  # [Nb, k] 0/1
+        # Drop handling preserves each token's ORIGINAL routing-weight mass
+        # (kept weights scale by total/kept): for Mixtral (softmaxed, total
+        # = 1) this is the classic renormalization; for DeepSeek the
+        # weights deliberately do NOT sum to 1 (sigmoid + routed_scaling),
+        # so normalizing to 1 would corrupt every MoE output even with
+        # nothing dropped.
+        total = w.sum(axis=-1, keepdims=True)
         denom = jnp.maximum((w * kept_k).sum(axis=-1, keepdims=True), 1e-9)
-        wr = w * kept_k / denom  # renormalized over kept choices
+        wr = w * kept_k * (total / denom)  # mass-preserving over kept choices
         comb = jnp.einsum("nk,nkec->nec", wr, slot.astype(jnp.float32))
         xe = jnp.einsum("nec,nd->ecd", disp, xb)  # [E, C, D]
         gate = _act(cfg, jnp.einsum("ecd,edf->ecf", xe, lp["w_gate"]))

@@ -33,7 +33,11 @@ import jax.numpy as jnp
 Params = dict[str, Any]
 
 # 2D-matmul weights that benefit; embeddings stay bf16 (gather path).
-QUANT_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+# w_kb/w_vb (MLA latent up-projections) stay unquantized: they ride
+# einsum paths with no grouped-int kernel and are small next to the MoE.
+QUANT_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                    "wq_a", "wq_b", "wkv_a",
+                    "shared_gate", "shared_up", "shared_down")
 
 
 def quantize_tensor(w: jnp.ndarray) -> dict[str, jnp.ndarray]:
@@ -176,12 +180,15 @@ def quantize_params(cfg, params: Params, mode: str = "int8") -> Params:
         qfn = quantize_tensor_g4
     else:
         raise ValueError(f"unsupported quantization mode {mode!r}")
-    layers = dict(params["layers"])
-    for key in QUANT_LAYER_KEYS:
-        if key in layers:
-            layers[key] = qfn(layers[key])
     out = dict(params)
-    out["layers"] = layers
+    for stack in ("layers", "dense_layers"):
+        if stack not in params:
+            continue
+        layers = dict(params[stack])
+        for key in QUANT_LAYER_KEYS:
+            if key in layers:
+                layers[key] = qfn(layers[key])
+        out[stack] = layers
     # lm_head [V, D] is used transposed (h @ W.T): quantize over D so the
     # scale lands on the output (vocab) axis of the transposed matmul.
     if "lm_head" in params and not cfg.tie_embeddings:

@@ -28,33 +28,75 @@ from localai_tpu.models.config import ArchConfig
 Params = dict[str, Any]
 
 
-def _layer_specs(cfg: ArchConfig) -> dict[str, P]:
-    # Leading axis of every layer param is the stacked layer dim (never sharded:
-    # lax.scan iterates over it).
+def _attn_specs(cfg: ArchConfig) -> dict[str, P]:
+    """Attention-side specs shared by both layer stacks. MLA shards the
+    per-head tensors over "tp" on the HEAD axis (q_b columns, w_kb/w_vb
+    leading head dim, wo rows); the low-rank a-projections and the latent
+    cache are replicated — they are the whole point of MLA (tiny)."""
     specs: dict[str, P] = {
         "attn_norm": P(None, None),
+        "mlp_norm": P(None, None),
+    }
+    if cfg.is_mla:
+        if cfg.q_lora_rank:
+            specs["wq_a"] = P(None, None, None)
+            specs["q_norm_a"] = P(None, None)
+            specs["wq_b"] = P(None, None, "tp")
+        else:
+            specs["wq"] = P(None, None, "tp")
+        specs["wkv_a"] = P(None, None, None)
+        specs["kv_norm"] = P(None, None)
+        specs["w_kb"] = P(None, "tp", None, None)
+        specs["w_vb"] = P(None, "tp", None, None)
+        specs["wo"] = P(None, "tp", None)
+        return specs
+    specs.update({
         "wq": P(None, None, "tp"),
         "wk": P(None, None, "tp"),
         "wv": P(None, None, "tp"),
         "wo": P(None, "tp", None),
-        "mlp_norm": P(None, None),
-    }
+    })
     if cfg.post_norms:  # gemma-2 sandwich norms — replicated like the rest
         specs["post_attn_norm"] = P(None, None)
         specs["post_ffw_norm"] = P(None, None)
+    if cfg.qk_norm:
+        specs["q_norm"] = P(None, None)
+        specs["k_norm"] = P(None, None)
     if cfg.attn_qkv_bias:
         specs["bq"] = P(None, "tp")
         specs["bk"] = P(None, "tp")
         specs["bv"] = P(None, "tp")
+    return specs
+
+
+def _layer_specs(cfg: ArchConfig) -> dict[str, P]:
+    # Leading axis of every layer param is the stacked layer dim (never sharded:
+    # lax.scan iterates over it).
+    specs = _attn_specs(cfg)
     if cfg.is_moe:
         specs["router"] = P(None, None, None)
+        if cfg.router_bias:
+            specs["router_bias"] = P(None, None)
         specs["w_gate"] = P(None, "ep", None, "tp")
         specs["w_up"] = P(None, "ep", None, "tp")
         specs["w_down"] = P(None, "ep", "tp", None)
+        if cfg.n_shared_experts:
+            specs["shared_gate"] = P(None, None, "tp")
+            specs["shared_up"] = P(None, None, "tp")
+            specs["shared_down"] = P(None, "tp", None)
     else:
         specs["w_gate"] = P(None, None, "tp")
         specs["w_up"] = P(None, None, "tp")
         specs["w_down"] = P(None, "tp", None)
+    return specs
+
+
+def _dense_layer_specs(cfg: ArchConfig) -> dict[str, P]:
+    """DeepSeek dense-prefix stack: attention like the MoE stack, plain MLP."""
+    specs = _attn_specs(cfg)
+    specs["w_gate"] = P(None, None, "tp")
+    specs["w_up"] = P(None, None, "tp")
+    specs["w_down"] = P(None, "tp", None)
     return specs
 
 
@@ -64,6 +106,8 @@ def param_specs(cfg: ArchConfig) -> Params:
         "layers": _layer_specs(cfg),
         "final_norm": P(None),
     }
+    if cfg.is_moe and cfg.first_k_dense:
+        specs["dense_layers"] = _dense_layer_specs(cfg)
     if not cfg.tie_embeddings:
         specs["lm_head"] = P("tp", None)
     return specs
@@ -118,18 +162,22 @@ def param_shardings_for(cfg: ArchConfig, mesh: Mesh, params: Params) -> Params:
     )
 
 
-def cache_specs(sp: int = 1) -> tuple[P, P]:
+def cache_specs(sp: int = 1, mla: bool = False) -> tuple[P, P]:
     # [L, B_slots, S_max, K, Hd]: slots over dp, kv heads over tp. With sp>1
     # the sequence axis shards over "sp" so per-chip KV residency is S/sp —
     # the serving-side guarantee behind ring prefill (parallel/ring.py) and
     # sp decode attention (ops/attention.py decode_attention_*_sp): servable
     # context scales with the sp degree, not just prefill compute.
-    spec = P(None, "dp", "sp" if sp > 1 else None, "tp", None)
+    # MLA caches hold ONE latent pseudo-head — replicated over tp (every
+    # chip's head shard scores against the full latent; it is 1/2·H·Hd/576
+    # the size of a dense cache, so replication is the cheap choice).
+    spec = P(None, "dp", "sp" if sp > 1 else None, None if mla else "tp", None)
     return spec, spec
 
 
-def cache_shardings(mesh: Mesh, sp: int = 1) -> tuple[NamedSharding, NamedSharding]:
-    ks, vs = cache_specs(sp)
+def cache_shardings(mesh: Mesh, sp: int = 1,
+                    mla: bool = False) -> tuple[NamedSharding, NamedSharding]:
+    ks, vs = cache_specs(sp, mla)
     return NamedSharding(mesh, ks), NamedSharding(mesh, vs)
 
 
@@ -150,7 +198,9 @@ def max_valid_tp(cfg: ArchConfig, n_devices: int) -> int:
 
 def validate_plan(cfg: ArchConfig, tp: int, ep: int = 1) -> None:
     """Fail fast on shapes that cannot shard evenly (XLA would pad silently)."""
-    if cfg.num_kv_heads % tp != 0:
+    if not cfg.is_mla and cfg.num_kv_heads % tp != 0:
+        # MLA has no per-head kv cache to shard — the latent replicates and
+        # only the H-axis tensors (q_b, w_kb/w_vb, wo) split over tp.
         raise ValueError(
             f"num_kv_heads={cfg.num_kv_heads} not divisible by tp={tp}; "
             f"choose tp in divisors of kv heads for {cfg.name}"
@@ -164,5 +214,15 @@ def validate_plan(cfg: ArchConfig, tp: int, ep: int = 1) -> None:
             f"vocab_size={cfg.vocab_size} not divisible by tp={tp} "
             "(embed/lm_head are vocab-parallel)"
         )
-    if cfg.is_moe and cfg.num_experts % ep != 0:
-        raise ValueError(f"num_experts={cfg.num_experts} not divisible by ep={ep}")
+    if cfg.is_moe:
+        if cfg.num_experts % ep != 0:
+            raise ValueError(f"num_experts={cfg.num_experts} not divisible by ep={ep}")
+        if cfg.moe_inter_size % tp != 0:
+            raise ValueError(
+                f"moe_intermediate_size={cfg.moe_inter_size} not divisible by tp={tp}"
+            )
+        if cfg.n_shared_experts and (cfg.n_shared_experts * cfg.moe_inter_size) % tp != 0:
+            raise ValueError(
+                f"shared-expert width {cfg.n_shared_experts * cfg.moe_inter_size} "
+                f"not divisible by tp={tp}"
+            )

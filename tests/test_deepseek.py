@@ -203,6 +203,260 @@ def test_deepseek_save_round_trip(tmp_path):
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+def test_deepseek_v2_export_reloads_in_torch(tmp_path):
+    """deepseek_v2 exports must re-interleave rope columns: the V2 modeling
+    code applies complex rope unconditionally, so a half-split export would
+    be numerically wrong everywhere but here. Round-trip through torch
+    proves the layout."""
+    from transformers import DeepseekV2ForCausalLM
+
+    cfg = get_arch("tiny-mla")
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": "float32",
+                           "scoring_func": "softmax", "router_bias": False,
+                           "norm_topk_prob": False, "n_group": 1,
+                           "topk_group": 1})
+    params = L.init_params(cfg, jax.random.key(9))
+    params = jax.tree.map(lambda a: jnp.asarray(a, jnp.float32), params)
+    d = tmp_path / "v2x"
+    save_hf_checkpoint(cfg, params, str(d))
+    import json
+
+    hf = json.load(open(d / "config.json"))
+    assert hf["model_type"] == "deepseek_v2" and hf["rope_interleave"]
+
+    model = DeepseekV2ForCausalLM.from_pretrained(str(d))
+    model.eval()
+    _logits_match(cfg, params, model, [3, 100, 55, 7, 260], atol=2e-3)
+
+
+def test_deepseek_yarn_mscale_ingestion(tmp_path):
+    """R1's published rope_scaling (yarn factor 40, mscale=mscale_all_dim=1)
+    must land as net attention amplitude yarn_get_mscale(40, 1)² — the
+    product of HF's cos/sin attention_factor and the extra softmax-scale
+    term in DeepseekV3Attention.__init__."""
+    import json
+    import math
+
+    d = tmp_path / "cfg"
+    d.mkdir()
+    hf = {
+        "model_type": "deepseek_v3", "vocab_size": 100, "hidden_size": 32,
+        "intermediate_size": 64, "num_hidden_layers": 1,
+        "num_attention_heads": 2, "kv_lora_rank": 16, "q_lora_rank": None,
+        "qk_nope_head_dim": 8, "qk_rope_head_dim": 8, "v_head_dim": 8,
+        "rope_scaling": {"type": "yarn", "factor": 40.0, "mscale": 1.0,
+                         "mscale_all_dim": 1.0, "beta_fast": 32,
+                         "beta_slow": 1,
+                         "original_max_position_embeddings": 4096},
+        "max_position_embeddings": 163840,
+    }
+    json.dump(hf, open(d / "config.json", "w"))
+    cfg = arch_from_hf_config(str(d))
+    expect = 0.1 * math.log(40.0) + 1.0
+    assert cfg.rope_attn_factor == pytest.approx(expect)
+    from localai_tpu.ops.rope import rope_query_amp
+
+    assert rope_query_amp(cfg) == pytest.approx(expect * expect)
+
+
+@pytest.fixture(scope="module")
+def served():
+    """f32 tiny-mla engine outputs (f32 kills the bf16 reduction-order ulps
+    that flip argmax on a random tiny model — the real-checkpoint analogue
+    is trained logit gaps)."""
+    from localai_tpu.engine import ByteTokenizer, Engine, EngineConfig
+
+    cfg = get_arch("tiny-mla")
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": "float32"})
+    params = L.init_params(cfg, jax.random.key(0), scale=0.06)
+    params = jax.tree.map(lambda a: jnp.asarray(a, jnp.float32), params)
+    prompts = [[65, 66, 67], [100, 5], [7, 8, 9, 10, 11]]
+
+    def run(**ek):
+        eng = Engine(
+            cfg, params, ByteTokenizer(cfg.vocab_size),
+            engine_cfg=EngineConfig(max_slots=4, max_seq=128,
+                                    min_prefill_bucket=16, **ek),
+        )
+        eng.start()
+        try:
+            return [
+                eng.generate(p, max_new_tokens=10, ignore_eos=True)[0]
+                for p in prompts
+            ]
+        finally:
+            eng.stop()
+
+    return cfg, params, run
+
+
+def test_deepseek_engine_dense(served):
+    cfg, params, run = served
+    out = run()
+    # greedy parity vs plain prefill re-forward
+    seq = [65, 66, 67]
+    for _ in range(10):
+        toks = jnp.array([seq + [0] * (32 - len(seq))], jnp.int32)
+        lg, _, _ = L.prefill(cfg, params, toks, jnp.array([len(seq)], jnp.int32))
+        seq.append(int(jnp.argmax(lg[0])))
+    from localai_tpu.engine import ByteTokenizer
+
+    assert out[0] == ByteTokenizer(cfg.vocab_size).decode(seq[3:])
+
+
+def test_deepseek_engine_paged_matches_dense(served):
+    """The MLA latent pool IS the paged pool — one 48-wide pseudo-head row
+    per token, zero-width v — and must serve identically to the dense slot
+    cache."""
+    _, _, run = served
+    assert run() == run(kv_pages=32, kv_page_size=16)
+
+
+def test_deepseek_tp_ep_sharded_matches_single(served, devices8):
+    """tp=2 × ep=2: MLA head-sharded projections + expert-sharded deepseek
+    MoE (GShard capacity dispatch, no-drop factor — the
+    test_moe_ep_sharded_matches_single standard) reproduce the unsharded
+    prefill."""
+    import dataclasses
+
+    from localai_tpu.parallel.mesh import MeshPlan, build_mesh
+    from localai_tpu.parallel.sharding import param_shardings, validate_plan
+
+    cfg, params, _ = served
+    cfg = dataclasses.replace(cfg, moe_capacity_factor=float(cfg.num_experts))
+    validate_plan(cfg, tp=2, ep=2)
+    mesh = build_mesh(MeshPlan(dp=1, tp=2, ep=2))
+    sharded = jax.device_put(params, param_shardings(cfg, mesh))
+
+    tokens = jnp.array([[65, 66, 67, 4, 0, 0, 0, 0], [9, 8, 7, 0, 0, 0, 0, 0]], jnp.int32)
+    lengths = jnp.array([4, 3], jnp.int32)
+    ref, _, _ = L.prefill(cfg, params, tokens, lengths, ep=1)
+    fn = jax.jit(lambda p, t, l: L.prefill(cfg, p, t, l, ep=2)[0])
+    out = fn(sharded, tokens, lengths)
+    assert jnp.allclose(out, ref, atol=5e-2), float(jnp.abs(out - ref).max())
+
+
+def test_deepseek_gguf_ingestion(tmp_path):
+    """deepseek2 GGUF (llama.cpp fused-expert layout, NORM/interleaved rope
+    columns) loads to the same logits as the HF checkpoint the GGUF was
+    derived from. Reference serves these GGUFs via llama.cpp
+    (backend/cpp/llama-cpp); tensor/metadata names follow the public GGUF
+    deepseek2 schema."""
+    from transformers import DeepseekV3ForCausalLM
+
+    from localai_tpu.engine.gguf import GGUFFile, arch_from_gguf, load_gguf_params
+    from tests.test_gguf import write_gguf
+
+    cfg_hf = _tiny_v3()
+    torch.manual_seed(5)
+    model = DeepseekV3ForCausalLM(cfg_hf)
+    with torch.no_grad():
+        for layer in model.model.layers[cfg_hf.first_k_dense_replace:]:
+            layer.mlp.gate.e_score_correction_bias.uniform_(-0.2, 0.2)
+    model.eval()
+    sd = {k: v.float().numpy() for k, v in model.state_dict().items()}
+
+    def f32(name, arr):
+        a = np.ascontiguousarray(arr, np.float32)
+        return name, ("F32", tuple(reversed(a.shape)), a.tobytes())
+
+    tensors = {}
+
+    def put(name, arr):
+        k, v = f32(name, arr)
+        tensors[k] = v
+
+    L = cfg_hf.num_hidden_layers
+    put("token_embd.weight", sd["model.embed_tokens.weight"])
+    put("output_norm.weight", sd["model.norm.weight"])
+    put("output.weight", sd["lm_head.weight"])
+    kd = cfg_hf.first_k_dense_replace
+    for i in range(L):
+        p = f"model.layers.{i}."
+        g = f"blk.{i}."
+        put(g + "attn_norm.weight", sd[p + "input_layernorm.weight"])
+        put(g + "ffn_norm.weight", sd[p + "post_attention_layernorm.weight"])
+        put(g + "attn_q_a.weight", sd[p + "self_attn.q_a_proj.weight"])
+        put(g + "attn_q_a_norm.weight", sd[p + "self_attn.q_a_layernorm.weight"])
+        put(g + "attn_q_b.weight", sd[p + "self_attn.q_b_proj.weight"])
+        put(g + "attn_kv_a_mqa.weight", sd[p + "self_attn.kv_a_proj_with_mqa.weight"])
+        put(g + "attn_kv_a_norm.weight", sd[p + "self_attn.kv_a_layernorm.weight"])
+        put(g + "attn_kv_b.weight", sd[p + "self_attn.kv_b_proj.weight"])
+        put(g + "attn_output.weight", sd[p + "self_attn.o_proj.weight"])
+        if i < kd:
+            put(g + "ffn_gate.weight", sd[p + "mlp.gate_proj.weight"])
+            put(g + "ffn_up.weight", sd[p + "mlp.up_proj.weight"])
+            put(g + "ffn_down.weight", sd[p + "mlp.down_proj.weight"])
+        else:
+            put(g + "ffn_gate_inp.weight", sd[p + "mlp.gate.weight"])
+            put(g + "exp_probs_b.bias", sd[p + "mlp.gate.e_score_correction_bias"])
+            for nm, suffix in (("ffn_gate_exps", "gate_proj"),
+                               ("ffn_up_exps", "up_proj"),
+                               ("ffn_down_exps", "down_proj")):
+                fused = np.stack([
+                    sd[f"{p}mlp.experts.{e}.{suffix}.weight"]
+                    for e in range(cfg_hf.n_routed_experts)
+                ])
+                put(g + nm + ".weight", fused)
+            put(g + "ffn_gate_shexp.weight", sd[p + "mlp.shared_experts.gate_proj.weight"])
+            put(g + "ffn_up_shexp.weight", sd[p + "mlp.shared_experts.up_proj.weight"])
+            put(g + "ffn_down_shexp.weight", sd[p + "mlp.shared_experts.down_proj.weight"])
+
+    kv = {
+        "general.architecture": "deepseek2",
+        "deepseek2.block_count": L,
+        "deepseek2.embedding_length": cfg_hf.hidden_size,
+        "deepseek2.feed_forward_length": cfg_hf.intermediate_size,
+        "deepseek2.attention.head_count": cfg_hf.num_attention_heads,
+        "deepseek2.attention.head_count_kv": cfg_hf.num_attention_heads,
+        "deepseek2.attention.layer_norm_rms_epsilon": cfg_hf.rms_norm_eps,
+        "deepseek2.attention.q_lora_rank": cfg_hf.q_lora_rank,
+        "deepseek2.attention.kv_lora_rank": cfg_hf.kv_lora_rank,
+        "deepseek2.attention.key_length": cfg_hf.qk_nope_head_dim + cfg_hf.qk_rope_head_dim,
+        "deepseek2.attention.value_length": cfg_hf.v_head_dim,
+        "deepseek2.rope.dimension_count": cfg_hf.qk_rope_head_dim,
+        "deepseek2.rope.freq_base": cfg_hf.rope_theta,
+        "deepseek2.context_length": 128,
+        "deepseek2.vocab_size": cfg_hf.vocab_size,
+        "deepseek2.expert_count": cfg_hf.n_routed_experts,
+        "deepseek2.expert_used_count": cfg_hf.num_experts_per_tok,
+        "deepseek2.expert_shared_count": cfg_hf.n_shared_experts,
+        "deepseek2.expert_feed_forward_length": cfg_hf.moe_intermediate_size,
+        "deepseek2.expert_weights_scale": cfg_hf.routed_scaling_factor,
+        "deepseek2.expert_weights_norm": cfg_hf.norm_topk_prob,
+        "deepseek2.expert_gating_func": 2,
+        "deepseek2.expert_group_count": cfg_hf.n_group,
+        "deepseek2.expert_group_used_count": cfg_hf.topk_group,
+        "deepseek2.leading_dense_block_count": kd,
+    }
+    path = str(tmp_path / "tiny-ds.gguf")
+    write_gguf(path, kv, tensors)
+
+    gf = GGUFFile(path)
+    cfg = arch_from_gguf(gf)
+    assert cfg.is_mla and cfg.moe_family == "deepseek"
+    assert cfg.scoring_func == "sigmoid" and cfg.router_bias
+    assert cfg.first_k_dense == kd and cfg.qk_nope_head_dim == 24
+    assert cfg.rope_interleave
+    params = load_gguf_params(gf, cfg)
+    params = jax.tree.map(jnp.asarray, params)
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": "float32"})
+
+    ids = [3, 17, 92, 5, 41, 8]
+    with torch.no_grad():
+        ref = model(input_ids=torch.tensor([ids])).logits[0, -1].float().numpy()
+    toks = jnp.zeros((1, 16), jnp.int32).at[0, : len(ids)].set(jnp.asarray(ids))
+    lg, _, _ = L_prefill(cfg, params, toks, jnp.asarray([len(ids)], jnp.int32))
+    got = np.asarray(lg[0], np.float32)
+    # experts repack to grouped int8 (the serving form) — compare shape of
+    # the distribution, not exact floats
+    assert np.abs(got - ref).max() < 0.15
+    assert int(got.argmax()) == int(ref.argmax())
+
+
+L_prefill = L.prefill
+
+
 def test_deepseek_r1_preset_shapes():
     cfg = get_arch("deepseek-r1")
     assert cfg.num_experts == 256 and cfg.num_experts_per_token == 8
