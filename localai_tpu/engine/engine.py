@@ -428,14 +428,18 @@ class Engine:
                 self.draft_params = jax.tree.map(
                     lambda a, s: jax.device_put(a, s), draft_params, dshard
                 )
-                dk, dv = cache_shardings(self.mesh)
-                dc_shape = (
-                    draft_cfg.num_layers, B, S, draft_cfg.num_kv_heads,
-                    draft_cfg.head_dim_,
+                dk, dv = cache_shardings(self.mesh, mla=draft_cfg.is_mla)
+                dbase = (
+                    draft_cfg.num_layers, B, S, draft_cfg.cache_kv_heads,
                 )
+                ddt = jnp.dtype(draft_cfg.dtype)
                 self.d_cache = llama.KVCache(
-                    k=jax.device_put(jnp.zeros(dc_shape, jnp.dtype(draft_cfg.dtype)), dk),
-                    v=jax.device_put(jnp.zeros(dc_shape, jnp.dtype(draft_cfg.dtype)), dv),
+                    k=jax.device_put(
+                        jnp.zeros(dbase + (draft_cfg.cache_k_dim,), ddt), dk
+                    ),
+                    v=jax.device_put(
+                        jnp.zeros(dbase + (draft_cfg.cache_v_dim,), ddt), dv
+                    ),
                 )
         # Metrics for speculative acceptance (tokens accepted / window).
         self.m_spec_rounds = 0

@@ -915,7 +915,12 @@ def _load_gguf_deepseek(gf: GGUFFile, arch) -> dict:
         moe_parts: dict[str, list] = {"w_gate": [], "w_up": [], "w_down": []}
         names = {"w_gate": "ffn_gate_exps", "w_up": "ffn_up_exps",
                  "w_down": "ffn_down_exps"}
-        has_bias = f"blk.{kd}.exp_probs_b.bias" in gf.tensors
+        has_bias = arch.router_bias  # derived once in _arch_from_deepseek2_gguf
+        # All three projections must share one representation (the MLP
+        # branches on w_gate's type): grouped int8 only when every in-dim
+        # is groupable, else bf16 dense (test-scale shapes).
+        groupable = (arch.hidden_size % 32 == 0
+                     and arch.moe_inter_size % 32 == 0)
         for i in range(kd, L):
             routers.append(
                 np.ascontiguousarray(
@@ -926,11 +931,6 @@ def _load_gguf_deepseek(gf: GGUFFile, arch) -> dict:
                 biases.append(
                     gf.tensor(f"blk.{i}.exp_probs_b.bias").astype(np.float32)
                 )
-            # All three projections must share one representation (the MLP
-            # branches on w_gate's type): grouped int8 only when every
-            # in-dim is groupable, else bf16 dense (test-scale shapes).
-            groupable = (arch.hidden_size % 32 == 0
-                         and arch.moe_inter_size % 32 == 0)
             for ours, nm in names.items():
                 t3 = gf.tensor(f"blk.{i}.{nm}.weight").astype(np.float32)
                 if groupable:

@@ -366,12 +366,12 @@ def test_deepseek_gguf_ingestion(tmp_path):
         k, v = f32(name, arr)
         tensors[k] = v
 
-    L = cfg_hf.num_hidden_layers
+    n_layers = cfg_hf.num_hidden_layers
     put("token_embd.weight", sd["model.embed_tokens.weight"])
     put("output_norm.weight", sd["model.norm.weight"])
     put("output.weight", sd["lm_head.weight"])
     kd = cfg_hf.first_k_dense_replace
-    for i in range(L):
+    for i in range(n_layers):
         p = f"model.layers.{i}."
         g = f"blk.{i}."
         put(g + "attn_norm.weight", sd[p + "input_layernorm.weight"])
@@ -404,7 +404,7 @@ def test_deepseek_gguf_ingestion(tmp_path):
 
     kv = {
         "general.architecture": "deepseek2",
-        "deepseek2.block_count": L,
+        "deepseek2.block_count": n_layers,
         "deepseek2.embedding_length": cfg_hf.hidden_size,
         "deepseek2.feed_forward_length": cfg_hf.intermediate_size,
         "deepseek2.attention.head_count": cfg_hf.num_attention_heads,
@@ -446,15 +446,13 @@ def test_deepseek_gguf_ingestion(tmp_path):
     with torch.no_grad():
         ref = model(input_ids=torch.tensor([ids])).logits[0, -1].float().numpy()
     toks = jnp.zeros((1, 16), jnp.int32).at[0, : len(ids)].set(jnp.asarray(ids))
-    lg, _, _ = L_prefill(cfg, params, toks, jnp.asarray([len(ids)], jnp.int32))
+    lg, _, _ = L.prefill(cfg, params, toks, jnp.asarray([len(ids)], jnp.int32))
     got = np.asarray(lg[0], np.float32)
     # experts repack to grouped int8 (the serving form) — compare shape of
     # the distribution, not exact floats
     assert np.abs(got - ref).max() < 0.15
     assert int(got.argmax()) == int(ref.argmax())
 
-
-L_prefill = L.prefill
 
 
 def test_deepseek_r1_preset_shapes():
