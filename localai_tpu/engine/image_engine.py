@@ -18,6 +18,37 @@ import numpy as np
 from localai_tpu.models import diffusion as dit
 
 
+def _jit_lru(cache: dict, key, build, cap: int = 8):
+    """Bounded compiled-program cache shared by the image engines: (n,
+    steps, size, scheduler, ...) are client-controlled, so an unbounded
+    cache lets a size-sweeping client grow host+device memory without
+    limit. LRU: hits refresh position, misses evict the oldest."""
+    fn = cache.get(key)
+    if fn is None:
+        fn = build()
+        if len(cache) >= cap:
+            cache.pop(next(iter(cache)))
+    else:
+        cache.pop(key)
+    cache[key] = fn
+    return fn
+
+
+def _prep_source_image(img: np.ndarray, w: int, h: int) -> np.ndarray:
+    """uint8 [H, W, 3] → float32 [h, w, 3] in [0, 1] at generation size."""
+    from PIL import Image
+
+    return np.asarray(
+        Image.fromarray(np.asarray(img, np.uint8)).resize((w, h), Image.BILINEAR),
+        np.float32) / 255.0
+
+
+def _img2img_i0(steps: int, strength: float) -> int:
+    """First executed step of a `strength`-truncated schedule (diffusers
+    img2img semantics); the jit-cache key uses this derived value."""
+    return steps - max(1, min(steps, int(round(steps * float(strength)))))
+
+
 class YolosEngine:
     """Resident YOLOS detector on a real published HF checkpoint
     (models/yolos.py; hustvl/yolos-tiny class). Same detect() contract as
@@ -259,11 +290,18 @@ class DiffusionEngine:
         guidance: float = 4.0,
         negative_prompt: str = "",  # accepted for API parity; own-format
         # checkpoints have no text encoder to condition negatively on
+        init_image: Optional[np.ndarray] = None,
+        strength: float = 0.8,
     ) -> list[np.ndarray]:
         """Frame sequence: one batched diffusion over n_frames with the seed
         noise spherically interpolated between two endpoints, giving a smooth
         latent-space sweep (the capability behind /v1/videos; the reference
         shells out to diffusers video pipelines)."""
+        if init_image is not None:
+            raise ValueError(
+                "image-to-video needs a latent-diffusion checkpoint (this "
+                "own-format model has no VAE to encode the source image)"
+            )
         t0 = time.monotonic()
         cfg = self.cfg
         ids = np.broadcast_to(self._text_ids(prompt), (n_frames, cfg.text_ctx))
@@ -312,6 +350,136 @@ class DiffusionEngine:
         out = [(f * 255.0 + 0.5).astype(np.uint8) for f in frames]
         self.m_requests += 1
         self.m_images += n_frames
+        self._busy_time += time.monotonic() - t0
+        return out
+
+
+class FluxEngine:
+    """Resident engine for Flux.1-class rectified-flow checkpoints
+    (models/flux.py; diffusers FluxPipeline layout). Same generate()
+    surface as LatentDiffusionEngine so /v1/images/generations works with
+    either. Flux is guidance-distilled: there is no CFG pass and no
+    negative-prompt conditioning (guidance_scale becomes the embedded
+    guidance value); ControlNet and inpainting are SD/SDXL features."""
+
+    def __init__(self, cfg, params, tokenizers):
+        from localai_tpu.models import flux as fx
+
+        self._fx = fx
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer, self.tokenizer2 = tokenizers
+        self.cache = None
+        self._lock = threading.Lock()
+        self._jit: dict[tuple, Any] = {}
+        self.m_requests = 0
+        self.m_images = 0
+        self._busy_time = 0.0
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+    def cancel_all(self) -> int:
+        return 0
+
+    def metrics(self) -> dict[str, float]:
+        return {
+            "requests": float(self.m_requests),
+            "images_generated": float(self.m_images),
+            "busy_seconds": self._busy_time,
+        }
+
+    def inpaint(self, *args, **kwargs):
+        raise ValueError(
+            "Flux checkpoints do not serve inpainting (an SD/SDXL feature)"
+        )
+
+    def generate_video(self, *args, **kwargs):
+        raise ValueError(
+            "Flux checkpoints do not serve video generation; use an SD "
+            "checkpoint with a motion adapter"
+        )
+
+    def _round_size(self, size) -> tuple[int, int]:
+        if size is None:
+            return 1024, 1024
+        # latents pack 2x2, so pixels must be multiples of 2 * vae scale
+        gran = 2 * self.cfg.vae.spatial_scale
+        w, h = size
+        return max(gran, (w // gran) * gran), max(gran, (h // gran) * gran)
+
+    def generate(
+        self,
+        prompt: str,
+        n: int = 1,
+        steps: int = 20,
+        seed: Optional[int] = None,
+        guidance: float = 3.5,
+        size: Optional[tuple[int, int]] = None,
+        negative_prompt: str = "",
+        scheduler: Optional[str] = None,
+        init_image: Optional[np.ndarray] = None,  # img2img source, uint8
+        strength: float = 0.8,
+        **unsupported,
+    ) -> list[np.ndarray]:
+        from PIL import Image
+
+        if unsupported.get("control_image") is not None:
+            raise ValueError("Flux checkpoints do not take control_image")
+        if scheduler not in (None, "", "euler", "flow_euler", "flow_match_euler"):
+            raise ValueError(
+                f"Flux serves the flow-matching euler schedule only (got "
+                f"{scheduler!r})"
+            )
+        t0 = time.monotonic()
+        gw, gh = self._round_size(size)
+        S = self.cfg.clip.max_position_embeddings
+        clip_ids = jnp.broadcast_to(jnp.asarray(self.tokenizer(
+            prompt, padding="max_length", max_length=S, truncation=True,
+        )["input_ids"], jnp.int32), (n, S))
+        T = self.cfg.t5_max_length
+        t5_ids = jnp.broadcast_to(jnp.asarray(self.tokenizer2(
+            prompt, padding="max_length", max_length=T, truncation=True,
+        )["input_ids"], jnp.int32), (n, T))
+        init = None
+        if init_image is not None:
+            strength = min(max(float(strength), 0.0), 1.0)
+            src = _prep_source_image(init_image, gw, gh)
+            init = jnp.broadcast_to(jnp.asarray(src)[None], (n, gh, gw, 3))
+        key = jax.random.key(0 if seed is None else int(seed) & 0x7FFFFFFF)
+        with self._lock:
+            # strength only truncates the schedule; key on the derived i0 so
+            # strengths compiling the same program share a slot and distinct
+            # ones never collide.
+            i0 = _img2img_i0(steps, strength) if init is not None else None
+
+            def build():
+                cfg, fx = self.cfg, self._fx
+                stren = float(strength)
+
+                def run(p, cids, tids, k, g, src=None):
+                    return fx.generate(
+                        cfg, p, cids, tids, k, steps=steps, guidance=g,
+                        height=gh, width=gw, init_image=src, strength=stren,
+                    )
+
+                return jax.jit(run)
+
+            fn = _jit_lru(self._jit, (n, steps, gw, gh, i0), build)
+            args = [self.params, clip_ids, t5_ids, key, jnp.float32(guidance)]
+            kw = {"src": init} if init is not None else {}
+            imgs = np.asarray(fn(*args, **kw))
+        out = []
+        for i in range(n):
+            img = (imgs[i] * 255.0 + 0.5).astype(np.uint8)
+            if size is not None and size != (gw, gh):
+                img = np.asarray(Image.fromarray(img).resize(size, Image.BILINEAR))
+            out.append(img)
+        self.m_requests += 1
+        self.m_images += n
         self._busy_time += time.monotonic() - t0
         return out
 
@@ -416,27 +584,24 @@ class LatentDiffusionEngine:
         if control_image is not None:
             if "controlnet" not in self.params:
                 raise ValueError("this checkpoint has no controlnet/ weights")
-            ci = np.asarray(
-                Image.fromarray(np.asarray(control_image, np.uint8))
-                .resize((gw, gh), Image.BILINEAR), np.float32) / 255.0
+            ci = _prep_source_image(control_image, gw, gh)
             ctrl = jnp.broadcast_to(jnp.asarray(ci)[None], (n, gh, gw, 3))
         init = None
         if init_image is not None:
             strength = min(max(float(strength), 0.0), 1.0)
-            src = np.asarray(
-                Image.fromarray(np.asarray(init_image, np.uint8))
-                .resize((gw, gh), Image.BILINEAR), np.float32) / 255.0
+            src = _prep_source_image(init_image, gw, gh)
             init = jnp.broadcast_to(jnp.asarray(src)[None], (n, gh, gw, 3))
         key = jax.random.key(0 if seed is None else int(seed) & 0x7FFFFFFF)
         with self._lock:
-            # strength is static under jit (it fixes the scan range)
+            # strength is static under jit (it only truncates the scan range
+            # to i0); key on the derived i0 so strengths that compile the
+            # same program share a cache slot and distinct ones never collide
+            i0 = _img2img_i0(steps, strength) if init is not None else None
             jkey = (n, steps, gw, gh, sched, _known is not None,
-                    _init_noise is not None, ctrl is not None,
-                    (round(strength, 3) if init is not None else None))
-            fn = self._jit.get(jkey)
-            if fn is None:
-                cfg, ld = self.cfg, self._ld
+                    _init_noise is not None, ctrl is not None, i0)
 
+            def build():
+                cfg, ld = self.cfg, self._ld
                 stren = float(strength)
 
                 def run(p, c, u, k, g, noise=None, kl=None, km=None,
@@ -450,16 +615,9 @@ class LatentDiffusionEngine:
                         init_image=src, strength=stren,
                     )
 
-                fn = jax.jit(run)
-                # (n, steps, size, scheduler) are client-controlled: bound
-                # the executable cache or a size-sweeping client grows
-                # host+device memory without limit.
-                if len(self._jit) >= 8:
-                    self._jit.pop(next(iter(self._jit)))
-                self._jit[jkey] = fn
-            else:  # refresh LRU position
-                self._jit.pop(jkey)
-                self._jit[jkey] = fn
+                return jax.jit(run)
+
+            fn = _jit_lru(self._jit, jkey, build)
             args = [self.params, cond, uncond, key, jnp.float32(guidance)]
             kw = {}
             if _init_noise is not None:
@@ -523,15 +681,22 @@ class LatentDiffusionEngine:
         seed: Optional[int] = None,
         guidance: float = 7.5,
         negative_prompt: str = "",
+        init_image: Optional[np.ndarray] = None,  # img2vid source, uint8
+        strength: float = 0.8,
     ) -> list[np.ndarray]:
         """Text→video. With a loaded motion adapter: AnimateDiff — temporal
         transformer modules inside the UNet correlate independently-noised
         frames into coherent motion (reference: diffusers video pipelines,
         backend.py:226-253). Without one: latent-space slerp sweep
-        (the r3 fallback, kept for motion-adapter-less checkpoints)."""
+        (the r3 fallback, kept for motion-adapter-less checkpoints).
+
+        init_image: image→video — the source anchors every frame's init
+        latent (motion path: real img2vid conditioning; fallback path:
+        img2img per frame over the slerp noise)."""
         if self.motion is not None:
             return self._generate_video_motion(
-                prompt, n_frames, steps, seed, guidance, negative_prompt
+                prompt, n_frames, steps, seed, guidance, negative_prompt,
+                init_image=init_image, strength=strength,
             )
         s = self._native_size()
         vs = self.cfg.vae.spatial_scale
@@ -547,10 +712,14 @@ class LatentDiffusionEngine:
             (np.sin((1 - t) * theta) * n0 + np.sin(t * theta) * n1) / max(np.sin(theta), 1e-6)
             for t in ts
         ])
+        kw = {}
+        if init_image is not None:
+            kw["init_image"] = init_image
+            kw["strength"] = strength
         return self.generate(
             prompt, n=n_frames, steps=steps, seed=seed, guidance=guidance,
             negative_prompt=negative_prompt, size=(s, s), scheduler="ddim",
-            _init_noise=frames_noise,
+            _init_noise=frames_noise, **kw,
         )
 
     def _generate_video_motion(
@@ -561,6 +730,8 @@ class LatentDiffusionEngine:
         seed: Optional[int],
         guidance: float,
         negative_prompt: str = "",
+        init_image: Optional[np.ndarray] = None,
+        strength: float = 0.8,
     ) -> list[np.ndarray]:
         from localai_tpu.models import video_diffusion as vd
 
@@ -574,28 +745,32 @@ class LatentDiffusionEngine:
         s = self._native_size()
         cond = self._ids(prompt, 1)
         uncond = self._ids(negative_prompt or "", 1)
+        init = None
+        if init_image is not None:
+            strength = min(max(float(strength), 0.0), 1.0)
+            init = jnp.asarray(_prep_source_image(init_image, s, s))[None]
         key = jax.random.key(0 if seed is None else int(seed) & 0x7FFFFFFF)
         with self._lock:
-            jkey = ("motion-video", n_frames, steps, s)
-            fn = self._jit.get(jkey)
-            if fn is None:
-                cfg = self.cfg
+            i0 = _img2img_i0(steps, strength) if init is not None else None
 
-                def run(p, mp, c, u, k, g):
+            def build():
+                cfg = self.cfg
+                stren = float(strength)
+
+                def run(p, mp, c, u, k, g, src=None):
                     return vd.generate_video(
                         cfg, p, mcfg, mp, c, u, k, frames=n_frames,
                         steps=steps, guidance=g, height=s, width=s,
+                        init_image=src, strength=stren,
                     )
 
-                fn = jax.jit(run)
-                if len(self._jit) >= 8:
-                    self._jit.pop(next(iter(self._jit)))
-                self._jit[jkey] = fn
-            else:  # refresh LRU position
-                self._jit.pop(jkey)
-                self._jit[jkey] = fn
+                return jax.jit(run)
+
+            fn = _jit_lru(self._jit, ("motion-video", n_frames, steps, s, i0),
+                          build)
+            kw = {"src": init} if init is not None else {}
             frames = np.asarray(fn(self.params, mparams, cond, uncond, key,
-                                   jnp.float32(guidance)))
+                                   jnp.float32(guidance), **kw))
         out = [(f * 255.0 + 0.5).astype(np.uint8) for f in frames]
         self.m_requests += 1
         self.m_images += n_frames

@@ -105,6 +105,8 @@ class VAEConfig:
     layers_per_block: int = 2
     norm_num_groups: int = 32
     scaling_factor: float = 0.18215
+    # Flux-class VAEs recenter latents: z_model = (z - shift) * scale.
+    shift_factor: float = 0.0
 
     @property
     def spatial_scale(self) -> int:
@@ -512,7 +514,9 @@ def vae_decode(cfg: VAEConfig, p: Params, latents: jnp.ndarray) -> jnp.ndarray:
     """[B, h, w, C_lat] (already unscaled) → images [B, 8h, 8w, 3] in [0,1]."""
     g = cfg.norm_num_groups
     zero_t = jnp.zeros((latents.shape[0],), latents.dtype)
-    h = _conv(latents, p["post_quant_conv.weight"], p["post_quant_conv.bias"], pad=0)
+    h = latents
+    if "post_quant_conv.weight" in p:  # Flux-class VAEs omit the quant convs
+        h = _conv(h, p["post_quant_conv.weight"], p["post_quant_conv.bias"], pad=0)
     h = _conv(h, p["decoder.conv_in.weight"], p["decoder.conv_in.bias"])
     h = _resnet(p, "decoder.mid_block.resnets.0", h, zero_t, g)
     h = _vae_attn(p, "decoder.mid_block.attentions.0", h, g)
@@ -559,8 +563,10 @@ def vae_encode(cfg: VAEConfig, p: Params, img: jnp.ndarray,
     h = _resnet(p, "encoder.mid_block.resnets.1", h, zero_t, g)
     h = _group_norm(h, p["encoder.conv_norm_out.weight"],
                     p["encoder.conv_norm_out.bias"], g)
-    h = _conv(jax.nn.silu(h), p["encoder.conv_out.weight"], p["encoder.conv_out.bias"])
-    moments = _conv(h, p["quant_conv.weight"], p["quant_conv.bias"], pad=0)
+    moments = _conv(jax.nn.silu(h), p["encoder.conv_out.weight"],
+                    p["encoder.conv_out.bias"])
+    if "quant_conv.weight" in p:  # Flux-class VAEs omit the quant convs
+        moments = _conv(moments, p["quant_conv.weight"], p["quant_conv.bias"], pad=0)
     mean, logvar = jnp.split(moments, 2, axis=-1)
     if key is not None:
         mean = mean + jnp.exp(0.5 * jnp.clip(logvar, -30, 20)) * jax.random.normal(

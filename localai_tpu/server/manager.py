@@ -770,8 +770,16 @@ class ModelManager:
                 raise FileNotFoundError(
                     f"model {cfg.name!r}: diffusion checkpoint {ckpt_dir!r} not found"
                 )
+            from localai_tpu.models import flux as FX
             from localai_tpu.models import latent_diffusion as LD
 
+            if FX.is_flux_dir(ckpt_dir):
+                # Flux.1-class rectified-flow checkpoint (reference:
+                # diffusers backend.py:218-224, :594-603).
+                from localai_tpu.engine.image_engine import FluxEngine
+
+                fcfg, fparams, ftoks = FX.load_flux_pipeline(ckpt_dir)
+                return LoadedModel(cfg, FluxEngine(fcfg, fparams, ftoks), None)
             if LD.is_diffusers_dir(ckpt_dir):
                 # Real published checkpoint (SD-1.5-class diffusers layout) —
                 # reference: backend/python/diffusers/backend.py:27-120.
