@@ -22,6 +22,7 @@ import json
 import logging
 import os
 from dataclasses import dataclass
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +46,7 @@ from localai_tpu.models.latent_diffusion import (
     ddim_timesteps,
     get_timestep_embedding,
     vae_decode,
+    vae_encode,
 )
 
 log = logging.getLogger("localai_tpu.video_diffusion")
@@ -233,11 +235,21 @@ def generate_video(
     guidance: float = 7.5,
     height: int = 512,
     width: int = 512,
+    init_image: Optional[jnp.ndarray] = None,  # [1, H, W, 3] in [0, 1]
+    strength: float = 0.8,
 ) -> jnp.ndarray:
     """Text→video: DDIM over the motion UNet, shared text condition, one
     noise sample PER FRAME (the motion modules correlate frames — unlike the
     old latent-slerp sweep there is a real temporal model between them).
-    Returns [frames, H, W, 3] float32 in [0, 1]."""
+    Returns [frames, H, W, 3] float32 in [0, 1].
+
+    Image→video (init_image set): the source is VAE-encoded and broadcast
+    as every frame's init latent, re-noised `strength` of the way up the
+    schedule with INDEPENDENT per-frame noise — the motion modules then
+    animate around the anchored content while only the remaining steps run
+    (init-latent img2vid; reference serves the same capability through
+    WanImageToVideoPipeline / StableVideoDiffusionPipeline,
+    diffusers backend.py:242-250, :280-284)."""
     if frames > mcfg.max_seq_length:
         raise ValueError(
             f"frames={frames} exceeds the motion adapter's max sequence "
@@ -256,6 +268,17 @@ def generate_video(
     key, nk = jax.random.split(key)
     x = jax.random.normal(nk, (F, lat_h, lat_w, cfg.unet.in_channels), jnp.float32)
 
+    ts = jnp.asarray(ddim_timesteps(cfg, steps))
+    ratio = cfg.num_train_timesteps // steps
+
+    i0 = 0
+    if init_image is not None:
+        strength = min(max(float(strength), 0.0), 1.0)
+        i0 = steps - max(1, min(steps, int(round(steps * strength))))
+        lat0 = vae_encode(cfg.vae, params["vae"], init_image)  # [1, h, w, C]
+        a0 = acp[ts[i0]]
+        x = jnp.sqrt(a0) * lat0 + jnp.sqrt(1.0 - a0) * x  # per-frame noise
+
     def cfg_eps(x_in, t):
         both = jnp.concatenate([x_in, x_in], axis=0)  # [2F, ...]
         tt = jnp.full((2 * F,), t, jnp.float32)
@@ -264,13 +287,10 @@ def generate_video(
         eps_u, eps_c = jnp.split(out, 2, axis=0)
         return eps_u + guidance * (eps_c - eps_u)
 
-    ts = jnp.asarray(ddim_timesteps(cfg, steps))
-    ratio = cfg.num_train_timesteps // steps
-
     def step(xc, i):
         t = ts[i]
         eps = cfg_eps(xc, t.astype(jnp.float32))
         return ddim_step(cfg, acp, eps, t, t - ratio, xc), None
 
-    x, _ = jax.lax.scan(step, x, jnp.arange(steps))
+    x, _ = jax.lax.scan(step, x, jnp.arange(i0, steps))
     return vae_decode(cfg.vae, params["vae"], x / cfg.vae.scaling_factor)

@@ -51,6 +51,31 @@ class ImageApi:
             raise ApiError(400, "size out of range")
         return (w, h)
 
+    @staticmethod
+    def _decode_b64_image(body: dict, *keys: str, field: str = "image"):
+        """First present key → decoded RGB np array; malformed input → 400."""
+        from PIL import Image
+        import numpy as np
+
+        blob64 = next((body[k] for k in keys if body.get(k)), None)
+        if blob64 is None:
+            return None
+        try:
+            blob = base64.b64decode(blob64)
+            return np.asarray(Image.open(io.BytesIO(blob)).convert("RGB"))
+        except Exception as e:  # noqa: BLE001
+            raise ApiError(400, f"{field} is not a decodable image: {e}") from None
+
+    @staticmethod
+    def _num_field(body: dict, key: str) -> float | None:
+        """Numeric body field or 400 (never a 500 from a bad string)."""
+        if body.get(key) is None:
+            return None
+        try:
+            return float(body[key])
+        except (TypeError, ValueError):
+            raise ApiError(400, f"{key} must be a number") from None
+
     def generations(self, req: Request) -> Response:
         from PIL import Image
 
@@ -66,32 +91,23 @@ class ImageApi:
         response_format = body.get("response_format") or "url"
 
         kw = {}
-        if body.get("image") or body.get("src"):
+        init = self._decode_b64_image(body, "image", "src")
+        if init is not None:
             # img2img: base64 source + strength (reference: request.src ->
             # StableDiffusionImg2ImgPipeline, diffusers backend.py:198)
-            import numpy as np
-
-            try:
-                blob = base64.b64decode(body.get("image") or body.get("src"))
-                kw["init_image"] = np.asarray(
-                    Image.open(io.BytesIO(blob)).convert("RGB"))
-            except Exception as e:  # noqa: BLE001
-                raise ApiError(400, f"image is not a decodable image: {e}") from None
-            if body.get("strength") is not None:
-                kw["strength"] = float(body["strength"])
-        if body.get("control_image"):
+            kw["init_image"] = init
+            strength = self._num_field(body, "strength")
+            if strength is not None:
+                kw["strength"] = strength
+        ctrl = self._decode_b64_image(body, "control_image",
+                                      field="control_image")
+        if ctrl is not None:
             # ControlNet conditioning (diffusers ControlNet pipelines; the
             # checkpoint must ship a controlnet/ subdir): base64 PNG/JPEG.
-            import numpy as np
-
-            try:
-                blob = base64.b64decode(body["control_image"])
-                kw["control_image"] = np.asarray(
-                    Image.open(io.BytesIO(blob)).convert("RGB"))
-            except Exception as e:  # noqa: BLE001
-                raise ApiError(400, f"control_image is not a decodable image: {e}") from None
-            if body.get("control_scale") is not None:
-                kw["control_scale"] = float(body["control_scale"])
+            kw["control_image"] = ctrl
+            scale = self._num_field(body, "control_scale")
+            if scale is not None:
+                kw["control_scale"] = scale
 
         lm, lease = self._base._resolve(req, Usecase.IMAGE)
         try:
@@ -163,6 +179,9 @@ class ImageApi:
                 prompt, img, mask, steps=steps,
                 seed=int(seed) if seed else None,
             )
+        except ValueError as e:
+            # e.g. a Flux checkpoint (no inpainting path)
+            raise ApiError(400, str(e)) from None
         finally:
             lease.release()
 
@@ -190,12 +209,26 @@ class ImageApi:
         if not 2 <= n_frames <= 64:
             raise ApiError(400, "n_frames must be between 2 and 64")
         steps = int(body.get("step") or body.get("steps") or 12)
+        fmt = str(body.get("format") or "mp4")
+        if fmt not in ("mp4", "gif"):
+            raise ApiError(400, "format must be mp4 or gif")
+
+        kw = {}
+        init = self._decode_b64_image(body, "image", "file", "src")
+        if init is not None:
+            # image→video: base64 source anchors every frame's init latent
+            # (reference: WanImageToVideoPipeline / SVD img2vid,
+            # diffusers backend.py:242-250, :280-284).
+            kw["init_image"] = init
+            strength = self._num_field(body, "strength")
+            if strength is not None:
+                kw["strength"] = strength
 
         lm, lease = self._base._resolve(req, Usecase.VIDEO)
         try:
             frames = lm.engine.generate_video(
                 prompt, n_frames=n_frames, steps=steps, seed=body.get("seed"),
-                negative_prompt=str(body.get("negative_prompt") or ""),
+                negative_prompt=str(body.get("negative_prompt") or ""), **kw,
             )
         except ValueError as e:
             # e.g. n_frames beyond the motion adapter's trained window
@@ -203,14 +236,11 @@ class ImageApi:
         finally:
             lease.release()
 
-        os.makedirs(self.content_dir, exist_ok=True)
-        pil_frames = [Image.fromarray(f) for f in frames]
-        name = f"{uuid.uuid4().hex}.gif"
-        path = os.path.join(self.content_dir, name)
-        pil_frames[0].save(
-            path, format="GIF", save_all=True, append_images=pil_frames[1:],
-            duration=int(body.get("frame_ms") or 125), loop=0,
-        )
+        from localai_tpu.utils.video_io import write_video
+
+        frame_ms = int(self._num_field(body, "frame_ms") or 125)
+        name, _ctype = write_video(self.content_dir, frames,
+                                   frame_ms=frame_ms, fmt=fmt)
         return Response(body={
             "created": int(time.time()),
             "data": [{"url": f"/generated-videos/{name}"}],
@@ -231,4 +261,8 @@ class ImageApi:
         return self._serve(req.params["name"], "image/png")
 
     def serve_video(self, req: Request) -> Response:
-        return self._serve(req.params["name"], "image/gif")
+        from localai_tpu.utils.video_io import CONTENT_TYPES
+
+        name = req.params["name"]
+        ext = os.path.splitext(name)[1]
+        return self._serve(name, CONTENT_TYPES.get(ext, "video/mp4"))

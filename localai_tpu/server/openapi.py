@@ -69,6 +69,21 @@ _BODY_SCHEMAS: dict[str, dict[str, Any]] = {
             "strength": {"type": "number"},
         },
     },
+    "/v1/videos": {
+        "required": ["prompt"],
+        "properties": {
+            "model": {"type": "string"}, "prompt": {"type": "string"},
+            "n_frames": {"type": "integer"}, "steps": {"type": "integer"},
+            "seed": {"type": "integer"},
+            "negative_prompt": {"type": "string"},
+            "image": {"type": "string",
+                      "description": "base64 image→video source (aliases: "
+                                     "file, src)"},
+            "strength": {"type": "number"},
+            "format": {"type": "string", "enum": ["mp4", "gif"]},
+            "frame_ms": {"type": "integer"},
+        },
+    },
     "/v1/sound-generation": {
         "required": ["text"],
         "properties": {

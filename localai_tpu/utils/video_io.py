@@ -1,0 +1,70 @@
+"""Video container writing for /v1/videos.
+
+Reference: the diffusers backend writes real video files via
+export_to_video (/root/reference/backend/python/diffusers/backend.py:38);
+LocalAI clients receive an .mp4 URL. Here: OpenCV's built-in MPEG-4
+encoder (no ffmpeg binary needed) with animated GIF as the dependency-free
+fallback.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import uuid
+
+import numpy as np
+
+log = logging.getLogger("localai_tpu.video_io")
+
+CONTENT_TYPES = {".mp4": "video/mp4", ".gif": "image/gif"}
+
+
+def write_video(
+    content_dir: str,
+    frames: list[np.ndarray],  # uint8 RGB [H, W, 3]
+    frame_ms: int = 125,
+    fmt: str = "mp4",
+) -> tuple[str, str]:
+    """Write frames to content_dir; returns (filename, content_type).
+    fmt "mp4" (default) encodes MPEG-4 via OpenCV and falls back to GIF if
+    the encoder is unavailable; fmt "gif" writes an animated GIF. frame_ms
+    is honored exactly in the GIF; mp4 stores the equivalent (fractional)
+    fps."""
+    os.makedirs(content_dir, exist_ok=True)
+    name = uuid.uuid4().hex
+    frame_ms = max(1, int(frame_ms))
+    if fmt == "mp4":
+        try:
+            import cv2
+
+            h, w = frames[0].shape[:2]
+            fname = f"{name}.mp4"
+            path = os.path.join(content_dir, fname)
+            writer = cv2.VideoWriter(
+                path, cv2.VideoWriter_fourcc(*"mp4v"), 1000.0 / frame_ms,
+                (w, h),
+            )
+            if not writer.isOpened():
+                raise RuntimeError("VideoWriter failed to open")
+            for f in frames:
+                writer.write(np.ascontiguousarray(f[..., ::-1]))  # RGB→BGR
+            writer.release()
+            if os.path.getsize(path) == 0:
+                raise RuntimeError("VideoWriter produced an empty file")
+            return fname, "video/mp4"
+        except Exception as e:  # noqa: BLE001 — fall back to GIF
+            log.warning("mp4 encode unavailable (%s); falling back to GIF", e)
+            try:
+                os.remove(os.path.join(content_dir, f"{name}.mp4"))
+            except OSError:
+                pass
+    from PIL import Image
+
+    fname = f"{name}.gif"
+    pil = [Image.fromarray(f) for f in frames]
+    pil[0].save(
+        os.path.join(content_dir, fname), format="GIF", save_all=True,
+        append_images=pil[1:], duration=frame_ms, loop=0,
+    )
+    return fname, "image/gif"

@@ -129,6 +129,7 @@ def test_videos_endpoint_gif(image_api):
     base, _ = image_api
     out = _post(base, "/v1/videos", {
         "model": "pix", "prompt": "sweep", "n_frames": 4, "steps": 2, "seed": 3,
+        "format": "gif",
     })
     url = out["data"][0]["url"]
     with urllib.request.urlopen(base + url, timeout=30) as r:
@@ -138,6 +139,21 @@ def test_videos_endpoint_gif(image_api):
     img.seek(3)  # 4 frames exist
     with pytest.raises(EOFError):
         img.seek(4)
+
+
+def test_videos_endpoint_mp4_default(image_api):
+    """Default container is a real .mp4 (reference: export_to_video,
+    diffusers backend.py:38)."""
+    base, _ = image_api
+    out = _post(base, "/v1/videos", {
+        "model": "pix", "prompt": "sweep", "n_frames": 4, "steps": 2, "seed": 3,
+    })
+    url = out["data"][0]["url"]
+    assert url.endswith(".mp4"), url
+    with urllib.request.urlopen(base + url, timeout=30) as r:
+        blob = r.read()
+        assert r.headers["Content-Type"] == "video/mp4"
+    assert blob[4:8] == b"ftyp", blob[:16]
 
 
 def test_inpainting_endpoint(image_api):

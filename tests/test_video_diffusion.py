@@ -196,7 +196,8 @@ def test_videos_api_with_motion_adapter(sd_dir, adapter_dir, tmp_path):
         req = urllib.request.Request(
             base + "/v1/videos",
             data=json.dumps({"model": "vid", "prompt": "a cat",
-                             "n_frames": 3, "steps": 2, "seed": 5}).encode(),
+                             "n_frames": 3, "steps": 2, "seed": 5,
+                             "format": "gif"}).encode(),
             headers={"Content-Type": "application/json"},
         )
         with urllib.request.urlopen(req, timeout=600) as r:
@@ -208,6 +209,70 @@ def test_videos_api_with_motion_adapter(sd_dir, adapter_dir, tmp_path):
         # tiny test pipeline: sample_size 8 × VAE scale 2 = 16px native
         assert img.format == "GIF" and img.size == (16, 16)
         img.seek(2)  # 3 frames exist
+
+        # image→video + mp4 (VERDICT r4 item 4): a base64 source conditions
+        # the motion pipeline; default container is a real .mp4
+        # (reference: export_to_video, diffusers backend.py:38; img2vid
+        # :242-250, :280-284).
+        import base64
+
+        src = Image.fromarray(
+            (np.random.default_rng(0).random((16, 16, 3)) * 255).astype(np.uint8))
+        buf = io.BytesIO()
+        src.save(buf, format="PNG")
+        req = urllib.request.Request(
+            base + "/v1/videos",
+            data=json.dumps({
+                "model": "vid", "prompt": "a cat", "n_frames": 3, "steps": 2,
+                "seed": 5, "image": base64.b64encode(buf.getvalue()).decode(),
+                "strength": 0.5,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=600) as r:
+            out = json.loads(r.read())
+        url = out["data"][0]["url"]
+        assert url.endswith(".mp4"), url
+        with urllib.request.urlopen(base + url, timeout=30) as r:
+            blob = r.read()
+            ctype = r.headers["Content-Type"]
+        assert ctype == "video/mp4"
+        assert blob[4:8] == b"ftyp", blob[:16]  # ISO BMFF signature
     finally:
         server.shutdown()
         manager.shutdown()
+
+
+def test_img2vid_init_latent_anchors_content(sd_dir, adapter_dir):
+    """Image conditioning must BIND the output to the source: at low
+    strength the frames sit closer to the source's VAE roundtrip than a
+    full-strength run from the same seed, and the truncated schedule runs
+    fewer steps (init-latent semantics, diffusers img2img contract)."""
+    cfg, params, tok = ld.load_pipeline(sd_dir)
+    mcfg, mp = vd.load_motion_adapter(adapter_dir)
+    S = cfg.text.max_position_embeddings
+    cond = jnp.asarray(tok("a cat", padding="max_length", max_length=S,
+                           truncation=True)["input_ids"], jnp.int32)[None]
+    unc = jnp.asarray(tok("", padding="max_length", max_length=S,
+                          truncation=True)["input_ids"], jnp.int32)[None]
+    rng = np.random.default_rng(9)
+    src = jnp.asarray(rng.random((1, 64, 64, 3)), jnp.float32)
+    key = jax.random.key(11)
+    F, steps = 3, 4
+
+    # VAE roundtrip of the source = the "anchor" appearance
+    anchor = np.asarray(ld.vae_decode(
+        cfg.vae, params["vae"],
+        ld.vae_encode(cfg.vae, params["vae"], src) / cfg.vae.scaling_factor))
+
+    weak = np.asarray(vd.generate_video(
+        cfg, params, mcfg, mp, cond, unc, key, frames=F, steps=steps,
+        height=64, width=64, init_image=src, strength=0.25))
+    strong = np.asarray(vd.generate_video(
+        cfg, params, mcfg, mp, cond, unc, key, frames=F, steps=steps,
+        height=64, width=64, init_image=src, strength=1.0))
+    d_weak = np.abs(weak - anchor).mean()
+    d_strong = np.abs(strong - anchor).mean()
+    assert d_weak < d_strong, (d_weak, d_strong)
+    # per-frame noise still differentiates frames (motion can act)
+    assert np.abs(weak[0] - weak[1]).max() > 1e-6
