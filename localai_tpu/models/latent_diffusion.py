@@ -963,6 +963,92 @@ def _cfg_json(path: str) -> dict:
         return json.load(f)
 
 
+def load_diffusion_lora(path: str, params: dict[str, Params],
+                        multiplier: float = 1.0) -> int:
+    """Merge a kohya-format LoRA safetensors file (the Civitai SD-LoRA
+    ecosystem format: `lora_unet_*` / `lora_te_*` layers with
+    `lora_down.weight` / `lora_up.weight` / `alpha`) into an already-loaded
+    pipeline's params IN PLACE, scaled by `multiplier`. Returns the number
+    of base tensors patched.
+
+    Reference: the diffusers backend's load_lora_weights walks the module
+    tree merging up@down*alpha/rank*multiplier into each target
+    (/root/reference/backend/python/diffusers/backend.py:456-533); here the
+    flat name→array dicts make the walk a direct name lookup. SDXL LoRAs
+    use lora_te1_/lora_te2_ for the two encoders."""
+    from safetensors import safe_open
+
+    tensors: dict[str, np.ndarray] = {}
+    with safe_open(path, framework="numpy") as f:
+        for name in f.keys():
+            tensors[name] = f.get_tensor(name)
+
+    # group "lora_unet_..._to_q.lora_down.weight" by the layer part;
+    # Civitai files sometimes bundle extra top-level tensors (textual
+    # inversions etc.) — skip anything that isn't layer.elem shaped
+    groups: dict[str, dict[str, np.ndarray]] = {}
+    for name, arr in tensors.items():
+        if "." not in name:
+            log.warning("lora: ignoring non-LoRA tensor %r", name)
+            continue
+        layer, elem = name.split(".", 1)
+        groups.setdefault(layer, {})[elem] = arr
+
+    # kohya flattens module paths with "_": undo it by name lookup against
+    # the loaded params (keys are the published dotted names).
+    lookups: dict[str, dict[str, str]] = {}
+
+    def lookup_for(part: str) -> dict[str, str]:
+        if part not in lookups:
+            lookups[part] = {
+                k[: -len(".weight")].replace(".", "_"): k
+                for k in params.get(part, {}) if k.endswith(".weight")
+            }
+        return lookups[part]
+
+    prefixes = (
+        ("lora_unet_", "unet"), ("lora_te1_", "text"),
+        ("lora_te2_", "text2"), ("lora_te_", "text"),
+    )
+    merged = 0
+    for layer, elems in groups.items():
+        target = None
+        for pref, part in prefixes:
+            if layer.startswith(pref):
+                target, rest = part, layer[len(pref):]
+                break
+        if target is None or target not in params:
+            continue
+        key = lookup_for(target).get(rest)
+        down = elems.get("lora_down.weight")
+        up = elems.get("lora_up.weight")
+        if key is None or down is None or up is None:
+            if key is None:
+                log.warning("lora: no target for %s (skipped)", layer)
+            continue
+        rank = down.shape[0]
+        alpha = float(elems["alpha"]) if "alpha" in elems else float(rank)
+        scale = multiplier * alpha / rank
+        base = params[target][key]
+        if down.ndim == 4:  # conv: up [O,r,1,1] @ down [r,I,kh,kw]
+            delta = np.einsum(
+                "or,rikl->oikl", up.reshape(up.shape[0], rank),
+                down.astype(np.float32),
+            ) * scale
+            delta = delta.transpose(2, 3, 1, 0)  # OIHW → HWIO (as _prep)
+        else:  # linear: [out,r] @ [r,in] → [out,in]; ours is [in,out]
+            delta = (up.astype(np.float32) @ down.astype(np.float32)).T * scale
+        if delta.shape != base.shape:
+            log.warning("lora: %s shape %s != base %s (skipped)",
+                        layer, delta.shape, base.shape)
+            continue
+        params[target][key] = (
+            base.astype(jnp.float32) + jnp.asarray(delta)
+        ).astype(base.dtype)
+        merged += 1
+    return merged
+
+
 def load_pipeline(ckpt_dir: str, dtype=jnp.float32):
     """(SDPipelineConfig, params, tokenizer) from a diffusers checkpoint dir.
 

@@ -212,6 +212,8 @@ class ImageApi:
         fmt = str(body.get("format") or "mp4")
         if fmt not in ("mp4", "gif"):
             raise ApiError(400, "format must be mp4 or gif")
+        # validate BEFORE generating — a bad value must not waste the run
+        frame_ms = int(self._num_field(body, "frame_ms") or 125)
 
         kw = {}
         init = self._decode_b64_image(body, "image", "file", "src")
@@ -238,7 +240,6 @@ class ImageApi:
 
         from localai_tpu.utils.video_io import write_video
 
-        frame_ms = int(self._num_field(body, "frame_ms") or 125)
         name, _ctype = write_video(self.content_dir, frames,
                                    frame_ms=frame_ms, fmt=fmt)
         return Response(body={

@@ -331,6 +331,24 @@ class ModelManager:
             ckpt_dir = os.path.join(self.app_cfg.models_dir, ckpt_dir)
         return ckpt_dir
 
+    def _parse_lora_entries(self, cfg: ModelConfig) -> list[tuple[str, float]]:
+        """lora_adapters YAML entries → [(resolved_path, weight)] (entries:
+        "path" or {"path": ..., "weight": 1.0}; reference: backend.proto
+        LoraAdapter/LoraScale)."""
+        out = []
+        for entry in cfg.lora_adapters:
+            if isinstance(entry, dict):
+                apath = str(entry.get("path", ""))
+                w = float(entry.get("weight", 1.0))
+            else:
+                apath, w = str(entry), 1.0
+            if not apath:
+                raise ValueError(
+                    f"model {cfg.name!r}: lora_adapters entry missing a path"
+                )
+            out.append((self._resolve_ckpt_dir(apath), w))
+        return out
+
     def _load(self, cfg: ModelConfig) -> LoadedModel:
         import os
 
@@ -436,13 +454,7 @@ class ModelManager:
         elif ckpt_dir is not None:
             from localai_tpu.engine.weights import load_hf_checkpoint
 
-            lora = []
-            for entry in cfg.lora_adapters:
-                if isinstance(entry, dict):
-                    adir, w = entry.get("path", ""), float(entry.get("weight", 1.0))
-                else:
-                    adir, w = str(entry), 1.0
-                lora.append((self._resolve_ckpt_dir(adir), w))
+            lora = self._parse_lora_entries(cfg)
             # Load-time host quantization: the bf16 tree never touches HBM,
             # so int8 checkpoints up to ~2x HBM serve from one chip. LoRA
             # deltas merge on the host in the same pass, before quantizing.
@@ -762,6 +774,13 @@ class ModelManager:
         from localai_tpu.models import diffusion as D
 
         if cfg.model in D.DIFFUSION_PRESETS:
+            if cfg.lora_adapters:
+                # Failing loudly beats silently serving the unmodified base
+                # (same contract as the LLM loader above).
+                raise ValueError(
+                    f"model {cfg.name!r}: lora_adapters need a diffusers-"
+                    "layout SD/SDXL checkpoint (not a synthetic preset)"
+                )
             dcfg = D.DIFFUSION_PRESETS[cfg.model]
             params = D.init_params(dcfg, _jax.random.key(0))
         else:
@@ -778,6 +797,11 @@ class ModelManager:
                 # diffusers backend.py:218-224, :594-603).
                 from localai_tpu.engine.image_engine import FluxEngine
 
+                if cfg.lora_adapters:
+                    raise ValueError(
+                        f"model {cfg.name!r}: lora_adapters target SD/SDXL "
+                        "checkpoints (kohya format); Flux LoRA is unsupported"
+                    )
                 fcfg, fparams, ftoks = FX.load_flux_pipeline(ckpt_dir)
                 return LoadedModel(cfg, FluxEngine(fcfg, fparams, ftoks), None)
             if LD.is_diffusers_dir(ckpt_dir):
@@ -786,6 +810,17 @@ class ModelManager:
                 from localai_tpu.engine.image_engine import LatentDiffusionEngine
 
                 ldcfg, ldparams, tok = LD.load_pipeline(ckpt_dir)
+                # Civitai-style SD/SDXL LoRA (kohya format) merged at load
+                # (reference: diffusers backend.py:456-533 load_lora_weights).
+                for apath, w in self._parse_lora_entries(cfg):
+                    n_merged = LD.load_diffusion_lora(apath, ldparams, w)
+                    if n_merged == 0:
+                        raise ValueError(
+                            f"model {cfg.name!r}: lora adapter {apath!r} "
+                            "matched no unet/text-encoder tensors"
+                        )
+                    log.info("model %s: merged %d lora tensors from %s "
+                             "(weight=%.2f)", cfg.name, n_merged, apath, w)
                 # AnimateDiff-class motion adapter: a `motion_adapter` dir in
                 # the model YAML, or one bundled inside the checkpoint (the
                 # diffusers AnimateDiffPipeline save layout) — /v1/videos
@@ -818,6 +853,12 @@ class ModelManager:
                     motion=motion,
                 )
                 return LoadedModel(cfg, eng, None)
+            if cfg.lora_adapters:
+                raise ValueError(
+                    f"model {cfg.name!r}: lora_adapters need a diffusers-"
+                    "layout SD/SDXL checkpoint (this is an own-format "
+                    "diffusion checkpoint)"
+                )
             dcfg, params = D.load_diffusion(ckpt_dir)
         return LoadedModel(cfg, DiffusionEngine(dcfg, params), None)
 
