@@ -124,6 +124,13 @@ class EngineConfig:
     # Minimum matched/saved prefix length in tokens — shorter prefixes are
     # cheaper to re-prefill than to manage.
     prefix_cache_min: int = 32
+    # First hit of a (prefix-bucket, tail-bucket) shape needs its own XLA
+    # program. True (default): compile it on a BACKGROUND thread and serve
+    # that request through the ordinary full admission — a prefix hit is an
+    # optimization, never worth a multi-second serving stall (observed 6.2 s
+    # for the first cached admit on TPU). False: compile synchronously on
+    # the loop thread (deterministic hits; used by tests and benches).
+    prefix_admit_async_compile: bool = True
     # HBM budget for stored spans. Entry count alone is not a bound: one
     # max_seq span of an 8B model is ~1 GiB of KV, so 8 entries could eat
     # half a chip. Eviction honors whichever limit trips first; a span
@@ -204,6 +211,9 @@ class GenRequest:
     # the placeholder ids under the span are ignored).
     image_embeds: Optional[Any] = None
     image_offset: int = 0
+    # Qwen2-VL m-rope: [3, len(prompt_ids)] (t, h, w) position streams
+    # (models/qwen2_vl.mrope_positions_for_span). None → standard rope.
+    mrope_positions: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -465,6 +475,11 @@ class Engine:
         }
         self.h_override_tok = np.zeros((B,), np.int32)
         self.h_override_mask = np.zeros((B,), bool)
+        # Qwen2-VL m-rope: per-slot decode rope offset (rope position =
+        # cache row + delta; models/llama.py decode_step_windowed). Only
+        # threaded into block programs when the arch declares mrope.
+        self._mrope = bool(getattr(cfg, "mrope_section", ()))
+        self.h_rope_delta = np.zeros((B,), np.int32)
         self.slots: list[Optional[_Slot]] = [None] * B
         self._slot_gen = [0] * B
         self._tok_strs: Optional[list[str]] = None  # lazy grammar cache
@@ -500,6 +515,10 @@ class Engine:
 
         self._block_cache: dict[tuple, Any] = {}
         self._admit_cache: dict[tuple, Any] = {}
+        # Cached-admit programs compiling on background threads (keys), and
+        # the lock guarding both structures (prefix_admit_async_compile).
+        self._admit_compiling: set = set()
+        self._admit_compile_lock = threading.Lock()
         # Prompt/prefix KV cache: list of dicts (most-recent-first), each
         # {"key": np.int32[n] tokens, "valid": int rows valid, "pb": bucket,
         #  "k"/"v": [L, 1, pb, K, Hd] device arrays}. Disabled alongside a
@@ -659,9 +678,11 @@ class Engine:
 
         paged = self._paged
 
+        mrope = self._mrope
+
         def block(params, cache, counts, rngs, bias, tokens, positions, pack,
-                  ptable=None, mask_bits=None, gtrans=None, tok_cls=None,
-                  gstate=None):
+                  rope_delta=None, ptable=None, mask_bits=None, gtrans=None,
+                  tok_cls=None, gstate=None):
             active = pack[0] > 0
             samp = SamplingParams(
                 temperature=pack[1], top_k=pack[2].astype(jnp.int32),
@@ -709,12 +730,13 @@ class Engine:
                     pos_eff = jnp.where(active, positions, 0)
                     logits, lk, lv = llama.decode_step_windowed(
                         cfg, params, tokens, pos_eff, cache, lk, lv, step,
-                        ep=self.plan.ep, ptable=ptable,
+                        ep=self.plan.ep, ptable=ptable, rope_delta=rope_delta,
                     )
                 else:
                     logits, lk, lv = llama.decode_step_windowed(
                         cfg, params, tokens, positions, read_cache, lk, lv, step,
                         ep=self.plan.ep, mesh=self._ring_mesh,
+                        rope_delta=rope_delta,
                     )
                 split = jax.vmap(lambda k: jax.random.split(k, 2))(rngs)
                 rngs, draw = split[:, 0], split[:, 1]
@@ -774,10 +796,14 @@ class Engine:
                 out = out + (gs,)
             return out
 
-        # Positional wrapper: [8 base] [ptable?] [dfa: mask, trans, cls,
-        # gstate] — mirrors _dispatch_block's argument assembly.
+        # Positional wrapper: [8 base] [rope_delta?] [ptable?] [dfa: mask,
+        # trans, cls, gstate] — mirrors _dispatch_block's argument assembly.
         def wrapped(*args):
             i = 8
+            rope_delta = None
+            if mrope:
+                rope_delta = args[i]
+                i += 1
             ptable = None
             if paged:
                 ptable = args[i]
@@ -785,19 +811,20 @@ class Engine:
             mask_bits = gtrans = tok_cls = gstate = None
             if with_dfa:
                 mask_bits, gtrans, tok_cls, gstate = args[i: i + 4]
-            return block(*args[:8], ptable=ptable, mask_bits=mask_bits,
-                         gtrans=gtrans, tok_cls=tok_cls, gstate=gstate)
+            return block(*args[:8], rope_delta=rope_delta, ptable=ptable,
+                         mask_bits=mask_bits, gtrans=gtrans, tok_cls=tok_cls,
+                         gstate=gstate)
 
         donate = (1, 2, 3, 5, 6)
         if with_dfa:
-            donate = donate + (8 + (1 if paged else 0) + 3,)
+            donate = donate + (8 + (1 if mrope else 0) + (1 if paged else 0) + 3,)
         fn = jax.jit(wrapped, donate_argnums=donate)
         self._block_cache[key] = fn
         return fn
 
     def _get_admit(self, m: int, bucket: int, has_bias: bool, with_topk: bool,
                    with_lp: bool = False, n_img: int = 0,
-                   with_dfa: bool = False):
+                   with_dfa: bool = False, with_mrope: bool = False):
         """Fused admission program: prefill M prompts, write their KV/state
         into their slots, and sample each first token — one dispatch.
 
@@ -815,7 +842,8 @@ class Engine:
         char classes — so follow-up decode blocks can pipeline immediately
         with no host round-trip.
         """
-        key = (m, bucket, has_bias, with_topk, with_lp, n_img, with_dfa)
+        key = (m, bucket, has_bias, with_topk, with_lp, n_img, with_dfa,
+               with_mrope)
         fn = self._admit_cache.get(key)
         if fn is not None:
             return fn
@@ -831,8 +859,8 @@ class Engine:
 
         def admit(params, cache, counts, rngs, bias, d_tokens, d_positions,
                   prompt_toks, aux, samp_pack, bias_rows, img_embeds=None,
-                  img_offsets=None, gmask0=None, gtrans=None, tok_cls=None,
-                  ginit=None, d_gstate=None, ptable=None):
+                  img_offsets=None, mrope_pos=None, gmask0=None, gtrans=None,
+                  tok_cls=None, ginit=None, d_gstate=None, ptable=None):
             lens, slot_ids, seeds = aux[0], aux[1], aux[2]
             samp = SamplingParams(
                 temperature=samp_pack[0], top_k=samp_pack[1].astype(jnp.int32),
@@ -842,7 +870,7 @@ class Engine:
             inject = (img_embeds, img_offsets) if img_embeds is not None else None
             logits, ks, vs = llama.prefill(
                 cfg, params, prompt_toks, lens, mesh=self._ring_mesh,
-                inject=inject, ep=self.plan.ep,
+                inject=inject, ep=self.plan.ep, mrope=mrope_pos,
             )
             valid = (jnp.arange(bucket)[None, :] < lens[:, None]).astype(jnp.int32)
             rows = jnp.zeros((m, V), jnp.int32)
@@ -889,8 +917,9 @@ class Engine:
         paged = self._paged
         if self.draft_cfg is None:
             # Uniform positional wrapper: [7 state] [d_gstate?] [4 request]
-            # [img 2?] [dfa 4?] [ptable?] — mirrors _dispatch_admit's arg
-            # assembly so every flag combination shares one code path.
+            # [img 2?] [mrope?] [dfa 4?] [ptable?] — mirrors
+            # _dispatch_admit's arg assembly so every flag combination
+            # shares one code path.
             def wrapped(*args):
                 i = 7
                 params, cache, counts, rngs, bias, d_tokens, d_positions = args[:7]
@@ -904,6 +933,10 @@ class Engine:
                 if n_img:
                     img_embeds, img_offsets = args[i: i + 2]
                     i += 2
+                mrope_pos = None
+                if with_mrope:
+                    mrope_pos = args[i]
+                    i += 1
                 gmask0 = gtrans = tok_cls = ginit = None
                 if with_dfa:
                     gmask0, gtrans, tok_cls, ginit = args[i: i + 4]
@@ -912,7 +945,8 @@ class Engine:
                 return admit(params, cache, counts, rngs, bias, d_tokens,
                              d_positions, prompt_toks, aux, samp_pack,
                              bias_rows, img_embeds=img_embeds,
-                             img_offsets=img_offsets, gmask0=gmask0,
+                             img_offsets=img_offsets, mrope_pos=mrope_pos,
+                             gmask0=gmask0,
                              gtrans=gtrans, tok_cls=tok_cls, ginit=ginit,
                              d_gstate=d_gstate, ptable=ptable)
 
@@ -963,7 +997,7 @@ class Engine:
 
     def _get_admit_cached(self, pb: int, tb: int, has_bias: bool,
                           with_topk: bool, with_lp: bool,
-                          with_dfa: bool = False):
+                          with_dfa: bool = False, build_only: bool = False):
         """Cached admission: copy a stored prefix KV span into the slot and
         prefill only the prompt tail (models/llama.py prefill_tail) — the
         prompt cache fast path (reference: cache_prompt, grpc-server.cpp:125).
@@ -1047,12 +1081,14 @@ class Engine:
             fn = jax.jit(admit_cached_dfa, donate_argnums=(1, 2, 3, 4, 5, 6, 7))
         else:
             fn = jax.jit(admit_cached, donate_argnums=(1, 2, 3, 4, 5, 6))
-        self._admit_cache[key] = fn
+        if not build_only:
+            self._admit_cache[key] = fn
         return fn
 
     def _get_admit_cached_paged(self, npg: int, tb: int, has_bias: bool,
                                 with_topk: bool, with_lp: bool,
-                                with_dfa: bool = False):
+                                with_dfa: bool = False,
+                                build_only: bool = False):
         """Cached admission against the PAGE POOL: the span's pages are
         mapped read-only into the slot's table (no copy — copy-on-write
         sharing), gathered once for the tail's attention, and the freshly
@@ -1134,7 +1170,8 @@ class Engine:
             fn = jax.jit(admit_cp_dfa, donate_argnums=(1, 2, 3, 4, 5, 6, 7))
         else:
             fn = jax.jit(admit_cached_paged, donate_argnums=(1, 2, 3, 4, 5, 6))
-        self._admit_cache[key] = fn
+        if not build_only:
+            self._admit_cache[key] = fn
         return fn
 
     # ------------------------------------------------------------------ #
@@ -1308,12 +1345,46 @@ class Engine:
             * jnp.dtype(self.ecfg.cache_dtype(cfg.dtype)).itemsize
         )
 
+    def _spawn_admit_compile(self, key: tuple, full_args: tuple) -> None:
+        """AOT-compile a cached-admit program shape on a daemon thread and
+        publish it into _admit_cache; until then hits of this shape fall
+        back to full admission (prefix_admit_async_compile). Avals are
+        taken from the actual dispatch args, so the compiled executable is
+        byte-compatible with the live serving state."""
+        with self._admit_compile_lock:
+            if key in self._admit_cache or key in self._admit_compiling:
+                return
+            self._admit_compiling.add(key)
+        avals = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), full_args
+        )
+
+        def work():
+            try:
+                if key[0] == "cached":
+                    fn = self._get_admit_cached(*key[1:], build_only=True)
+                else:
+                    fn = self._get_admit_cached_paged(*key[1:], build_only=True)
+                compiled = fn.lower(*avals).compile()
+                with self._admit_compile_lock:
+                    self._admit_cache.setdefault(key, compiled)
+            except Exception:  # noqa: BLE001 — hits keep falling back
+                log.exception("background cached-admit compile failed (%s)",
+                              key)
+            finally:
+                with self._admit_compile_lock:
+                    self._admit_compiling.discard(key)
+
+        threading.Thread(target=work, daemon=True,
+                         name="prefix-admit-compile").start()
+
     def _dispatch_admit_cached(self, request: GenRequest, handle: RequestHandle,
                                slot_idx: int, entry: dict, match_len: int,
-                               dfa_tables: Optional[dict] = None) -> bool:
+                               dfa_tables: Optional[dict] = None):
         """Admission via the prompt cache: ship only the tail tokens.
-        Returns False (caller falls through to a full admission) when the
-        entry was evicted or the paged pool can't cover the fresh pages."""
+        Returns True (admitted), False (stale hit / pool pressure — paged
+        callers requeue), or "full" (cached program still compiling in the
+        background — caller must serve via full admission NOW)."""
         t0 = time.monotonic()
         V = self.cfg.vocab_size
         ids = request.prompt_ids
@@ -1365,40 +1436,58 @@ class Engine:
             npg = -(-self._bucket_for(max(match_len, 1)) // page)
             pages_arr = np.full((npg,), self._scratch_page, np.int32)
             pages_arr[: len(shared)] = shared
-            fn = self._get_admit_cached_paged(npg, tb, has_bias, with_topk,
-                                              with_lp, with_dfa)
+            key = ("cached-paged", npg, tb, has_bias, with_topk, with_lp,
+                   with_dfa)
+            getter = self._get_admit_cached_paged
             args = (
                 jnp.asarray(pages_arr), jnp.asarray(self.h_ptable[slot_idx]),
                 jnp.asarray(tail_toks), jnp.asarray(counts), jnp.asarray(aux),
                 jnp.asarray(samp_pack), jnp.asarray(bias_rows),
             )
         else:
-            fn = self._get_admit_cached(entry["pb"], tb, has_bias, with_topk,
-                                        with_lp, with_dfa)
+            key = ("cached", entry["pb"], tb, has_bias, with_topk, with_lp,
+                   with_dfa)
+            getter = self._get_admit_cached
             args = (
                 entry["k"], entry["v"],
                 jnp.asarray(tail_toks), jnp.asarray(counts), jnp.asarray(aux),
                 jnp.asarray(samp_pack), jnp.asarray(bias_rows),
             )
+        if with_dfa:
+            host = dfa_tables["host"]
+            row = np.unpackbits(
+                host.mask_bits[host.init_state], bitorder="little"
+            )[:V].astype(bool)
+            gmask0 = np.where(row, 0.0, -1e30).astype(np.float32)[None, :]
+            ginit = np.full((1,), host.init_state, np.int32)
+            full_args = (
+                self.params, self.cache, self.counts, self.rngs, self.bias,
+                self.d_tokens, self.d_positions, self.d_gstate, *args,
+                jnp.asarray(gmask0), self._dfa_table(dfa_tables, with_dfa),
+                dfa_tables["tok_cls"], jnp.asarray(ginit),
+            )
+        else:
+            full_args = (
+                self.params, self.cache, self.counts, self.rngs, self.bias,
+                self.d_tokens, self.d_positions, *args,
+            )
+        if (self.ecfg.prefix_admit_async_compile
+                and key not in self._admit_cache):
+            # A prefix hit is an optimization — never worth a multi-second
+            # XLA compile stall on the serving thread. Compile this shape in
+            # the background and serve the request via full admission ("full"
+            # tells the caller to fall through rather than requeue — a paged
+            # requeue would re-find the hit and busy-spin until the compile
+            # lands).
+            self._spawn_admit_compile(key, full_args)
+            if paged_alloc is not None:
+                self._pages_free(slot_idx)
+            return "full"
+        fn = self._admit_cache.get(key)
+        if fn is None:
+            fn = getter(*key[1:])
         try:
-            if with_dfa:
-                host = dfa_tables["host"]
-                row = np.unpackbits(
-                    host.mask_bits[host.init_state], bitorder="little"
-                )[:V].astype(bool)
-                gmask0 = np.where(row, 0.0, -1e30).astype(np.float32)[None, :]
-                ginit = np.full((1,), host.init_state, np.int32)
-                out = fn(
-                    self.params, self.cache, self.counts, self.rngs, self.bias,
-                    self.d_tokens, self.d_positions, self.d_gstate, *args,
-                    jnp.asarray(gmask0), self._dfa_table(dfa_tables, with_dfa),
-                    dfa_tables["tok_cls"], jnp.asarray(ginit),
-                )
-            else:
-                out = fn(
-                    self.params, self.cache, self.counts, self.rngs, self.bias,
-                    self.d_tokens, self.d_positions, *args,
-                )
+            out = fn(*full_args)
         except Exception:
             if paged_alloc is not None:
                 self._pages_free(slot_idx)
@@ -1421,6 +1510,8 @@ class Engine:
         self.m_prefix_tokens += match_len
         for kf in _SAMPLING_FIELDS:
             self.h_sampling[kf][slot_idx] = getattr(request, kf)
+        if self._mrope:
+            self.h_rope_delta[slot_idx] = 0  # cached path is text-only
         self._slot_gen[slot_idx] += 1
         self.slots[slot_idx] = _Slot(
             request=request, handle=handle, prompt_len=len(ids), scheduled=1,
@@ -1675,6 +1766,18 @@ class Engine:
                     f"image span [{request.image_offset}, {request.image_offset + n}) "
                     f"outside the prompt ({len(request.prompt_ids)} tokens)"
                 )
+        if request.mrope_positions is not None:
+            if self.draft_cfg is not None:
+                # The draft admit path has no mrope arg slot (and multimodal
+                # is excluded with drafts anyway — see above).
+                raise ValueError(
+                    "mrope requests are not supported with a draft model"
+                )
+            p3 = np.asarray(request.mrope_positions)
+            if p3.shape != (3, len(request.prompt_ids)):
+                raise ValueError(
+                    f"mrope_positions shape {p3.shape} != (3, prompt_len)"
+                )
         if request.grammar is not None and self._tok_strs is None:
             self._token_str(0)  # build the table here, not in the engine loop
         handle = RequestHandle()
@@ -1863,6 +1966,8 @@ class Engine:
             self.params, self.cache, self.counts, self.rngs, self.bias,
             self.d_tokens, self.d_positions, jnp.asarray(pack),
         )
+        if self._mrope:
+            args = args + (jnp.asarray(self.h_rope_delta),)
         if self._paged:
             args = args + (jnp.asarray(self.h_ptable),)
         (
@@ -2309,12 +2414,25 @@ class Engine:
                 chunk[0][0].prompt_ids
             )
             if hit is not None:
-                if self._dispatch_admit_cached(
+                res = self._dispatch_admit_cached(
                     chunk[0][0], chunk[0][1], slot_ids[0], *hit,
                     dfa_tables=dfa_tables,
-                ):
+                )
+                if res is True:
                     return
-                if self._paged:
+                if res == "full":
+                    # Cached-admit program still compiling in the background:
+                    # serve via full admission NOW. Under the paged pool the
+                    # planner only budgeted the tail pages, so re-check the
+                    # full need first and requeue if the pool can't cover it.
+                    if (self._paged
+                            and self._pages_needed(chunk[0][0])
+                            > len(self._free_pages)):
+                        with self._pending_lock:
+                            self._pending.appendleft(chunk[0])
+                        self._wake.set()
+                        return
+                elif self._paged:
                     # Stale hit under pool churn (the span was evicted or its
                     # fresh pages can't be covered): requeue so the next
                     # planning round re-budgets and re-scans — only the
@@ -2362,11 +2480,12 @@ class Engine:
         n_img = 0
         if m == 1 and chunk[0][0].image_embeds is not None:
             n_img = int(np.asarray(chunk[0][0].image_embeds).shape[0])
+        with_mrope = (m == 1 and chunk[0][0].mrope_positions is not None)
         trace = os.environ.get("LOCALAI_ENGINE_TRACE", "0") == "1"
         t_a = time.monotonic()
         with_dfa = self._dfa_mode_of(dfa_tables)
         fn = self._get_admit(m, bucket, has_bias, with_topk, with_lp, n_img,
-                             with_dfa=with_dfa)
+                             with_dfa=with_dfa, with_mrope=with_mrope)
         t_b = time.monotonic()
         args_in = (
             jnp.asarray(prompt_toks), jnp.asarray(aux), jnp.asarray(samp_pack),
@@ -2376,6 +2495,19 @@ class Engine:
             embeds = np.asarray(chunk[0][0].image_embeds, np.float32)[None]  # [1, N, D]
             offsets = np.asarray([chunk[0][0].image_offset], np.int32)
             args_in = args_in + (jnp.asarray(embeds), jnp.asarray(offsets))
+        if with_mrope:
+            # [1, 3, bucket]: the prompt's 3D streams, padding continued
+            # sequentially (padded rows are masked out of attention anyway).
+            p3 = np.asarray(chunk[0][0].mrope_positions, np.int32)
+            L3 = p3.shape[1]
+            mrope_full = np.zeros((1, 3, bucket), np.int32)
+            mrope_full[0, :, :L3] = p3
+            if bucket > L3:
+                last = p3[:, -1] if L3 else np.zeros((3,), np.int32)
+                mrope_full[0, :, L3:] = (
+                    last[:, None] + 1 + np.arange(bucket - L3)[None, :]
+                )
+            args_in = args_in + (jnp.asarray(mrope_full),)
         if with_dfa:
             host = dfa_tables["host"]
             row = np.unpackbits(
@@ -2442,6 +2574,13 @@ class Engine:
         for j, ((r, handle), slot_idx) in enumerate(zip(chunk, slot_ids)):
             for k in _SAMPLING_FIELDS:
                 self.h_sampling[k][slot_idx] = getattr(r, k)
+            if self._mrope:
+                # decode rope position = cache row + delta (0 for text-only)
+                p3 = r.mrope_positions
+                self.h_rope_delta[slot_idx] = (
+                    int(np.asarray(p3).max()) + 1 - len(r.prompt_ids)
+                    if p3 is not None else 0
+                )
             self._slot_gen[slot_idx] += 1
             self.slots[slot_idx] = _Slot(
                 request=r, handle=handle, prompt_len=int(aux[0, j]), scheduled=1,
@@ -2568,6 +2707,8 @@ class Engine:
             self.params, self.cache, self.counts, self.rngs, self.bias,
             self.d_tokens, self.d_positions, jnp.asarray(pack),
         )
+        if self._mrope:
+            args = args + (jnp.asarray(self.h_rope_delta),)
         if self._paged:
             args = args + (jnp.asarray(self.h_ptable),)
         if with_dfa:

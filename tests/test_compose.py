@@ -46,6 +46,7 @@ def _mk(cfg, params, *, paged=False, draft=False, prefix=True, sp=1,
             kv_pages=(slots * max_seq) // PAGE if paged else 0,
             kv_page_size=PAGE,
             prefix_cache_entries=8 if prefix else 0,
+            prefix_admit_async_compile=False,  # deterministic hits
         ),
         draft_cfg=cfg if draft else None,
         draft_params=params if draft else None,
