@@ -71,6 +71,22 @@ class _ShardReader:
     def __init__(self, ckpt_dir: str):
         self.dir = ckpt_dir
         self.weight_map = _index(ckpt_dir)
+        # Multimodal wrappers (Qwen2-VL et al.): newer transformers nests
+        # the decoder under model.language_model.* and the tower under
+        # model.visual.*, while published checkpoints use model.* /
+        # visual.*. Alias both spellings so every loader addresses either
+        # layout; real names win on collision.
+        self._alias: dict[str, str] = {}
+        for name in list(self.weight_map):
+            if name.startswith("model.language_model."):
+                short = "model." + name[len("model.language_model."):]
+            elif name.startswith("model.visual."):
+                short = name[len("model."):]
+            else:
+                continue
+            if short not in self.weight_map:
+                self._alias[short] = name
+                self.weight_map[short] = self.weight_map[name]
         self._open: dict[str, Any] = {}
 
     def __contains__(self, name: str) -> bool:
@@ -82,7 +98,7 @@ class _ShardReader:
         fname = self.weight_map[name]
         if fname not in self._open:
             self._open[fname] = safe_open(os.path.join(self.dir, fname), framework="numpy")
-        return self._open[fname].get_tensor(name)
+        return self._open[fname].get_tensor(self._alias.get(name, name))
 
 
 def load_hf_checkpoint(
@@ -915,6 +931,16 @@ def arch_from_hf_config(ckpt_dir: str) -> ArchConfig:
         scaling_type = "longrope"  # phi-3's original name for the same math
     if scaling_type == "default":
         scaling_type = None
+    # Qwen2-VL: "mrope" is a position-id SHAPE (3 streams), not a frequency
+    # rescale — frequencies stay unscaled; the section split rides on
+    # ArchConfig.mrope_section (vllm passthrough in the reference,
+    # backend/python/vllm/backend.py:211-243). Newer transformers
+    # serializes it as rope_type "default" + an mrope_section key, so
+    # detect by the key, not the type name.
+    mrope_section: tuple = ()
+    if scaling_type == "mrope" or rope_scaling.get("mrope_section"):
+        mrope_section = tuple(rope_scaling.get("mrope_section") or ())
+        scaling_type = None
     max_position = hf.get("max_position_embeddings", 8192)
     if scaling_type not in (None, "linear", "llama3", "yarn", "longrope"):
         raise ValueError(f"rope_scaling type {scaling_type!r} is not supported")
@@ -1034,7 +1060,8 @@ def arch_from_hf_config(ckpt_dir: str) -> ArchConfig:
         rms_eps=hf.get("rms_norm_eps", 1e-5),
         # Gemma ties embeddings but its configs often omit the flag.
         tie_embeddings=hf.get("tie_word_embeddings", gemma),
-        attn_qkv_bias=(model_type == "qwen2"),
+        attn_qkv_bias=(model_type in ("qwen2", "qwen2_vl", "qwen2_vl_text")),
+        mrope_section=mrope_section,
         activation=("gelu_tanh" if "gelu" in act else "silu"),
         embed_scale=gemma,
         norm_plus_one=gemma,

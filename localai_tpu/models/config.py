@@ -121,6 +121,10 @@ class ArchConfig:
     # de-interleaves the affected projection columns so runtime rope stays
     # the one half-split (neox) implementation.
     rope_interleave: bool = False
+    # Qwen2-VL multimodal rope: (t, h, w) section split of head_dim/2.
+    # Non-empty → image-bearing prompts prefill with 3D position streams
+    # (ops/rope.mrope_angles); text-only paths reduce to plain rope.
+    mrope_section: tuple = ()
     dtype: str = "bfloat16"
 
     @property

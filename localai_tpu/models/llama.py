@@ -617,6 +617,7 @@ def _forward_hidden(
     mesh=None,  # jax.sharding.Mesh with an "sp" axis > 1 → ring attention
     inject=None,  # (embeds [B, N, D], offsets [B]) — VLM image features
     ep: int = 1,  # expert-parallel degree (MoE implementation choice)
+    mrope=None,  # [B, 3, S] (t, h, w) position streams — Qwen2-VL m-rope
 ):
     """Shared full-sequence forward. Returns (h [B,S,D] after final norm,
     length_mask [B,S], (ks, vs) or None). Single source of truth for the layer
@@ -635,6 +636,17 @@ def _forward_hidden(
     inv_local = rope_frequencies_local(cfg)
     positions = jnp.arange(S)[None, :].repeat(B, axis=0)  # [B, S]
     length_mask = jnp.arange(S)[None, :] < lengths[:, None]
+    mrope_ang = None
+    if mrope is not None:
+        # Qwen2-VL m-rope (HF get_rope_index semantics): section-selected
+        # per-frequency position streams; same split-half rotation.
+        if not cfg.mrope_section:
+            raise ValueError("mrope positions passed but cfg.mrope_section empty")
+        if inv_local is not None:
+            raise ValueError("mrope + per-layer local rope is unsupported")
+        from localai_tpu.ops.rope import mrope_angles
+
+        mrope_ang = mrope_angles(mrope, inv_freq, tuple(cfg.mrope_section))
 
     h = _embed(cfg, params, tokens)  # [B, S, D]
     if inject is not None:
@@ -670,8 +682,14 @@ def _forward_hidden(
                 (rows, rows[..., :0]) if collect_kv else None
             )
         q, k, v = _attn_proj_qkv(cfg, lp, x)
-        q = apply_rope(q, positions, inv)
-        k = apply_rope(k, positions, inv)
+        if mrope_ang is not None:
+            from localai_tpu.ops.rope import rope_rotate
+
+            q = rope_rotate(q, mrope_ang)
+            k = rope_rotate(k, mrope_ang)
+        else:
+            q = apply_rope(q, positions, inv)
+            k = apply_rope(k, positions, inv)
         if use_ring:
             from localai_tpu.parallel.ring import ring_prefill_attention
 
@@ -704,10 +722,12 @@ def prefill(
     mesh=None,  # Mesh with sp>1 → ring attention (sequence parallel)
     inject=None,  # (embeds [B, N, D], offsets [B]) — VLM image features
     ep: int = 1,
+    mrope=None,  # [B, 3, S] m-rope position streams (Qwen2-VL)
 ):
     """Prompt processing. Returns (last_logits [B, V] f32, k [L,B,S,K,Hd], v)."""
     h, _, (ks, vs) = _forward_hidden(
-        cfg, params, tokens, lengths, collect_kv=True, mesh=mesh, inject=inject, ep=ep
+        cfg, params, tokens, lengths, collect_kv=True, mesh=mesh, inject=inject,
+        ep=ep, mrope=mrope,
     )
     last_idx = jnp.maximum(lengths - 1, 0)  # empty prompt reads position 0, not wrap to S-1
     last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]  # [B, D]
@@ -852,6 +872,10 @@ def decode_step_windowed(
     ep: int = 1,
     mesh=None,  # Mesh with sp>1 → the cache's sequence axis is sp-sharded
     ptable=None,  # [B, MP] int32 → `cache` is a page pool (paged KV mode)
+    rope_delta=None,  # [B] int32 — m-rope: rope at positions+delta (cache
+    # rows stay at positions). After a Qwen2-VL image prefill the 3D
+    # position streams are all equal and offset from the row index by a
+    # per-request constant, so plain rope at the shifted position is exact.
 ):
     """One step of a fused decode block with a block-local KV window.
 
@@ -865,6 +889,7 @@ def decode_step_windowed(
     use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
     inv_freq = rope_frequencies(cfg)
     inv_local = rope_frequencies_local(cfg)
+    rope_pos = positions if rope_delta is None else positions + rope_delta
     h = _embed(cfg, params, tokens)
 
     def layer(h, xs):
@@ -894,8 +919,8 @@ def decode_step_windowed(
             h = h + _mlp_out(cfg, lp, x, ep)
             return h, (rows, rows[..., :0])
         q, k, v = _attn_proj_qkv(cfg, lp, x)
-        q = apply_rope(q[:, None], positions[:, None], inv)[:, 0]
-        k = apply_rope(k[:, None], positions[:, None], inv)[:, 0]
+        q = apply_rope(q[:, None], rope_pos[:, None], inv)[:, 0]
+        k = apply_rope(k[:, None], rope_pos[:, None], inv)[:, 0]
         if ptable is not None:
             from localai_tpu.ops.attention import decode_attention_windowed_paged
 

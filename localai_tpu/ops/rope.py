@@ -123,11 +123,38 @@ def rope_query_amp(cfg: ArchConfig) -> float:
     return 1.0
 
 
-def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray) -> jnp.ndarray:
-    """Rotate half-pairs. x: [..., seq, heads, head_dim], positions: [..., seq]."""
-    angles = positions[..., :, None].astype(jnp.float32) * inv_freq  # [..., seq, hd/2]
+def rope_rotate(x: jnp.ndarray, angles: jnp.ndarray) -> jnp.ndarray:
+    """Split-half rotation from precomputed angles [..., seq, head_dim/2];
+    x: [..., seq, heads, head_dim]."""
     cos = jnp.cos(angles)[..., None, :]  # [..., seq, 1, hd/2]
     sin = jnp.sin(angles)[..., None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray) -> jnp.ndarray:
+    """Rotate half-pairs. x: [..., seq, heads, head_dim], positions: [..., seq]."""
+    angles = positions[..., :, None].astype(jnp.float32) * inv_freq  # [..., seq, hd/2]
+    return rope_rotate(x, angles)
+
+
+def mrope_angles(pos3: jnp.ndarray, inv_freq: jnp.ndarray,
+                 sections: tuple) -> jnp.ndarray:
+    """Qwen2-VL multimodal rope angles.
+
+    pos3 [B, 3, S] carries (temporal, height, width) position streams per
+    token; `sections` (e.g. (16, 24, 24), summing to head_dim/2) assigns
+    each frequency index to one stream — HF Qwen2VLAttention splits the
+    duplicated cos/sin tables into mrope_section*2 chunks and takes chunk i
+    from stream i%3, which reduces to per-frequency stream selection over
+    the first half. Returns angles [B, S, head_dim/2] for rope_rotate.
+    Text-only prompts (all three streams equal) reduce exactly to
+    apply_rope; that is what makes plain-rope decode with a per-slot
+    position delta valid after a multimodal prefill."""
+    import numpy as np
+
+    assert sum(sections) == inv_freq.shape[0], (sections, inv_freq.shape)
+    axis_of = jnp.asarray(np.repeat(np.arange(3), sections))  # [hd/2]
+    pos_sel = jnp.take(pos3, axis_of, axis=1)  # [B, hd/2, S]
+    return pos_sel.transpose(0, 2, 1).astype(jnp.float32) * inv_freq

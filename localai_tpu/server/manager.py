@@ -512,28 +512,42 @@ class ModelManager:
         evaluator = Evaluator(cfg, tokenizer)
         lm = LoadedModel(cfg, engine, evaluator)
         if vlm:
-            # Multimodal (llava-style): attach the vision tower; the chat
-            # handler injects projected image tokens at admission.
+            # Multimodal: attach the vision tower; the chat handler injects
+            # projected image tokens at admission. Two families —
+            # llava-style (fixed-grid CLIP tower) and Qwen2-VL (native
+            # resolution + m-rope; reference: vllm/backend.py:211-243).
+            from localai_tpu.models import qwen2_vl as QV
             from localai_tpu.models import vision as V
 
             varch = cfg.options.get("vision", "")
-            if varch in V.VISION_PRESETS:
-                vcfg = V.VISION_PRESETS[varch]
-                vparams = V.init_params(vcfg, jax.random.key(2))
-            elif ckpt_dir is not None:
-                vcfg = V.vision_config_from_hf(ckpt_dir)
-                vparams = V.load_hf_vision(vcfg, ckpt_dir)
+            if ckpt_dir is not None and QV.is_qwen2_vl_dir(ckpt_dir):
+                qcfg = QV.vision_config_from_hf(ckpt_dir)
+                if qcfg.hidden_size != arch.hidden_size:
+                    raise ValueError(
+                        f"qwen2-vl merger dim {qcfg.hidden_size} != LLM "
+                        f"hidden {arch.hidden_size}"
+                    )
+                lm.vision = QV.Qwen2VLVisionEncoder(
+                    qcfg, QV.load_hf_qwen2_vl_vision(qcfg, ckpt_dir)
+                )
             else:
-                raise ValueError(
-                    f"model {cfg.name!r}: vlm backend needs options.vision "
-                    f"(preset) or a checkpoint with a vision tower"
-                )
-            if vcfg.llm_dim != arch.hidden_size:
-                raise ValueError(
-                    f"vision projector dim {vcfg.llm_dim} != LLM hidden "
-                    f"{arch.hidden_size}"
-                )
-            lm.vision = V.VisionEncoder(vcfg, vparams)
+                if varch in V.VISION_PRESETS:
+                    vcfg = V.VISION_PRESETS[varch]
+                    vparams = V.init_params(vcfg, jax.random.key(2))
+                elif ckpt_dir is not None:
+                    vcfg = V.vision_config_from_hf(ckpt_dir)
+                    vparams = V.load_hf_vision(vcfg, ckpt_dir)
+                else:
+                    raise ValueError(
+                        f"model {cfg.name!r}: vlm backend needs options.vision "
+                        f"(preset) or a checkpoint with a vision tower"
+                    )
+                if vcfg.llm_dim != arch.hidden_size:
+                    raise ValueError(
+                        f"vision projector dim {vcfg.llm_dim} != LLM hidden "
+                        f"{arch.hidden_size}"
+                    )
+                lm.vision = V.VisionEncoder(vcfg, vparams)
         log.info(
             "loaded model %s (arch=%s mesh=%s%s) in %.1fs",
             cfg.name, arch.name, plan, " +vision" if vlm else "",

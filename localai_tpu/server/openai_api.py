@@ -403,16 +403,30 @@ class OpenAIApi:
         lp_n = self._chat_logprobs(body)
 
         # Multimodal: project the first image and reserve a placeholder span
-        # right after BOS (llava injection — models/vision.py).
+        # right after BOS (llava injection — models/vision.py). Qwen2-VL
+        # encoders additionally yield the native-resolution grid, from which
+        # the 3D m-rope position streams are derived
+        # (models/qwen2_vl.mrope_positions_for_span).
         image_embeds = None
         image_offset = 0
+        mrope_positions = None
         images = _extract_images(body["messages"])
         vision = getattr(lm, "vision", None)
         if images and vision is not None:
-            image_embeds = vision.encode(images[0])
             image_offset = 1 if (add_bos and ids) else 0
+            grid = None
+            if getattr(vision, "kind", "") == "qwen2_vl":
+                image_embeds, grid = vision.encode_with_grid(images[0])
+            else:
+                image_embeds = vision.encode(images[0])
             filler = [0] * image_embeds.shape[0]
             ids = ids[:image_offset] + filler + ids[image_offset:]
+            if grid is not None:
+                from localai_tpu.models.qwen2_vl import mrope_positions_for_span
+
+                mrope_positions, _delta = mrope_positions_for_span(
+                    len(ids), image_offset, grid, merge=vision.merge
+                )
 
         # Independent GenRequest per choice: fresh grammar machine (the
         # pushdown state is mutable), decorrelated seeds when one was given.
@@ -423,6 +437,7 @@ class OpenAIApi:
             g.logprobs = lp_n
             g.image_embeds = image_embeds
             g.image_offset = image_offset
+            g.mrope_positions = mrope_positions
             if g.seed is not None and n > 1:
                 g.seed = int(g.seed) + i
             gens.append(g)
