@@ -34,6 +34,18 @@ log = logging.getLogger("localai_tpu.latent_diffusion")
 
 Params = dict[str, jnp.ndarray]
 
+# The serving scheduler surface (reference: diffusers backend.py:100-168
+# A1111 name mapping). "_karras" suffix and "k_" prefix both select Karras
+# sigma spacing for the k-diffusion family.
+K_SCHEDULERS = ("euler", "euler_a", "dpmpp_2m", "heun", "lms", "dpm_2",
+                "dpm_2_a", "dpmpp_sde", "dpmpp_2m_sde")
+T_SCHEDULERS = ("ddim", "pndm", "unipc")
+SUPPORTED_SCHEDULERS = frozenset(
+    T_SCHEDULERS + K_SCHEDULERS
+    + tuple(f"{s}_karras" for s in K_SCHEDULERS)
+    + tuple(f"k_{s}" for s in K_SCHEDULERS)
+)
+
 
 # --------------------------------------------------------------------------- #
 # Configs (subset of the diffusers configs we consume)
@@ -644,14 +656,21 @@ def k_schedule(cfg: SDPipelineConfig, steps: int, karras: bool):
     return (np.append(sigmas, 0.0).astype(np.float32), ts.astype(np.float32))
 
 
+def ancestral_sigmas(sigma, sigma_next):
+    """(sigma_down, sigma_up) for an eta-1 ancestral step (k-diffusion
+    get_ancestral_step)."""
+    s2, sn2 = sigma ** 2, sigma_next ** 2
+    sigma_up = jnp.sqrt(jnp.maximum(sn2 * (s2 - sn2) / jnp.maximum(s2, 1e-12), 0.0))
+    sigma_down = jnp.sqrt(jnp.maximum(sn2 - sigma_up ** 2, 0.0))
+    return sigma_down, sigma_up
+
+
 def euler_a_step(model_out, x, sigma, sigma_next, noise):
     """k-diffusion Euler-ancestral over eps-prediction in sigma space."""
     mo = model_out.astype(jnp.float32)
     xf = x.astype(jnp.float32)
     x0 = xf - sigma * mo
-    s2, sn2 = sigma ** 2, sigma_next ** 2
-    sigma_up = jnp.sqrt(jnp.maximum(sn2 * (s2 - sn2) / jnp.maximum(s2, 1e-12), 0.0))
-    sigma_down = jnp.sqrt(jnp.maximum(sn2 - sigma_up ** 2, 0.0))
+    sigma_down, sigma_up = ancestral_sigmas(sigma, sigma_next)
     d = (xf - x0) / jnp.maximum(sigma, 1e-12)
     xf = xf + d * (sigma_down - sigma) + noise * sigma_up
     return xf.astype(x.dtype)
@@ -792,6 +811,10 @@ def generate(
         return eps_u + guidance * (eps_c - eps_u)
 
     inpainting = known_latent is not None and known_mask is not None
+    if inpainting and scheduler != "ddim":
+        # The preserved-region replay (blend) is DDIM-space math; silently
+        # ignoring the mask under another sampler would "inpaint" nothing.
+        raise ValueError("inpainting requires the ddim scheduler")
 
     def blend(xc, t_prev, k):
         """Replace the preserved region with the source re-noised to t_prev."""
@@ -802,14 +825,22 @@ def generate(
         noised = jnp.sqrt(acp_prev) * known_latent + jnp.sqrt(1.0 - acp_prev) * noise
         return known_mask * xc + (1.0 - known_mask) * noised.astype(xc.dtype)
 
-    k_schedulers = ("euler_a", "dpmpp_2m", "heun", "lms")
-    karras = scheduler.endswith("_karras")
-    base_sched = scheduler[: -len("_karras")] if karras else scheduler
-    if base_sched not in k_schedulers + ("ddim",) or (karras and base_sched == "ddim"):
+    k_schedulers, t_schedulers = K_SCHEDULERS, T_SCHEDULERS
+    karras = False
+    if scheduler.startswith("k_"):
+        karras = True
+        scheduler = scheduler[2:]
+    if scheduler.endswith("_karras"):
+        karras = True
+        scheduler = scheduler[: -len("_karras")]
+    base_sched = scheduler
+    if (base_sched not in k_schedulers + t_schedulers
+            or (karras and base_sched in t_schedulers)):
         raise ValueError(
-            f"unknown scheduler {scheduler!r} (supported: ddim, "
-            + ", ".join(k_schedulers)
-            + ", " + ", ".join(s + "_karras" for s in k_schedulers) + ")"
+            f"unknown scheduler {scheduler!r} (supported: "
+            + ", ".join(t_schedulers + k_schedulers)
+            + ", plus _karras/k_ variants of "
+            + ", ".join(k_schedulers) + ")"
         )
     scheduler = base_sched
     if scheduler in k_schedulers:
@@ -827,7 +858,119 @@ def generate(
             out = cfg_eps(x_in, ts[i])
             return _denoised_sigma(cfg, out, xc, sig)
 
-        if scheduler == "euler_a":
+        # For samplers that query the model at off-grid sigmas (dpm_2* mid-
+        # points, dpmpp_sde half-steps): invert the training sigma table to
+        # a fractional timestep on device.
+        sig_train = jnp.sqrt((1.0 - acp) / acp)
+        log_sig_train = jnp.log(sig_train)
+        t_grid = jnp.arange(sig_train.shape[0], dtype=jnp.float32)
+
+        def denoised_at_sigma(xc, sig):
+            t = jnp.interp(jnp.log(jnp.maximum(sig, 1e-10)),
+                           log_sig_train, t_grid)
+            x_in = xc.astype(jnp.float32) / jnp.sqrt(sig**2 + 1.0)
+            out = cfg_eps(x_in, t)
+            return _denoised_sigma(cfg, out, xc, sig)
+
+        ancestral = ancestral_sigmas
+
+        if scheduler == "euler":
+            # k-diffusion sample_euler (churn 0): one deterministic slope
+            # step per sigma interval.
+            def step(xc, i):
+                sig, sig_n = sigmas[i], sigmas[i + 1]
+                den = denoised_at(xc, i)
+                d = (xc.astype(jnp.float32) - den) / sig
+                return (xc.astype(jnp.float32) + d * (sig_n - sig)).astype(xc.dtype), None
+
+            x, _ = jax.lax.scan(step, x, jnp.arange(i0, steps))
+        elif scheduler in ("dpm_2", "dpm_2_a"):
+            # k-diffusion sample_dpm_2(_ancestral): midpoint (log-sigma
+            # lerp 0.5) second-order correction; the ancestral variant
+            # steps to sigma_down and re-noises by sigma_up.
+            anc = scheduler == "dpm_2_a"
+
+            def step(carry, i):
+                xc, k = carry
+                k, nk2 = jax.random.split(k)
+                xcf = xc.astype(jnp.float32)
+                sig, sig_n = sigmas[i], sigmas[i + 1]
+                den = denoised_at(xc, i)
+                d = (xcf - den) / sig
+                x_eul = xcf + d * (sig_n - sig)  # final-step fallback
+                tgt, su = (ancestral(sig, sig_n) if anc
+                           else (sig_n, jnp.float32(0.0)))
+                sig_mid = jnp.exp(0.5 * (
+                    jnp.log(sig) + jnp.log(jnp.maximum(tgt, 1e-10))))
+                x_2 = xcf + d * (sig_mid - sig)
+                den2 = denoised_at_sigma(x_2.astype(xc.dtype), sig_mid)
+                d2 = (x_2 - den2) / sig_mid
+                xn = xcf + d2 * (tgt - sig)
+                if anc:
+                    xn = xn + jax.random.normal(nk2, xc.shape, jnp.float32) * su
+                xn = jnp.where(sig_n == 0.0, x_eul, xn)
+                return (xn.astype(xc.dtype), k), None
+
+            (x, _), _ = jax.lax.scan(step, (x, key), jnp.arange(i0, steps))
+        elif scheduler == "dpmpp_sde":
+            # k-diffusion sample_dpmpp_sde (r=1/2, eta=1): an SDE half-step
+            # to the ancestral midpoint, then a full step from the midpoint
+            # estimate (fac = 1/(2r) = 1 → the second eval carries it).
+            def step(carry, i):
+                xc, k = carry
+                k, k1, k2 = jax.random.split(k, 3)
+                xcf = xc.astype(jnp.float32)
+                sig, sig_n = sigmas[i], sigmas[i + 1]
+                den = denoised_at(xc, i)
+                t_c = -jnp.log(sig)
+                s_mid = jnp.exp(-(t_c + 0.5 * (
+                    -jnp.log(jnp.maximum(sig_n, 1e-10)) - t_c)))
+                sd1, su1 = ancestral(sig, s_mid)
+                s_ = -jnp.log(jnp.maximum(sd1, 1e-10))
+                x_2 = (sd1 / sig) * xcf - jnp.expm1(t_c - s_) * den
+                x_2 = x_2 + jax.random.normal(k1, xc.shape, jnp.float32) * su1
+                den2 = denoised_at_sigma(x_2.astype(xc.dtype), s_mid)
+                sd2, su2 = ancestral(sig, sig_n)
+                t_n_ = -jnp.log(jnp.maximum(sd2, 1e-10))
+                xn = (sd2 / sig) * xcf - jnp.expm1(t_c - t_n_) * den2
+                xn = xn + jax.random.normal(k2, xc.shape, jnp.float32) * su2
+                # k-diffusion falls back to a plain step when σ_next == 0;
+                # x − σ·d = denoised exactly there.
+                xn = jnp.where(sig_n == 0.0, den, xn)
+                return (xn.astype(xc.dtype), k), None
+
+            (x, _), _ = jax.lax.scan(step, (x, key), jnp.arange(i0, steps))
+        elif scheduler == "dpmpp_2m_sde":
+            # k-diffusion sample_dpmpp_2m_sde (eta=1, midpoint solver):
+            # exponential-integrator SDE multistep over λ = -log σ.
+            def step(carry, i):
+                xc, old_den, k = carry
+                k, nk2 = jax.random.split(k)
+                xcf = xc.astype(jnp.float32)
+                sig, sig_n = sigmas[i], sigmas[i + 1]
+                den = denoised_at(xc, i)
+                t_c = -jnp.log(sig)
+                t_n = -jnp.log(jnp.maximum(sig_n, 1e-10))
+                h = t_n - t_c  # eta_h = h (eta = 1)
+                xn = (sig_n / sig) * jnp.exp(-h) * xcf \
+                    - jnp.expm1(-2.0 * h) * den
+                sig_prev = sigmas[jnp.maximum(i - 1, 0)]
+                h_last = t_c - (-jnp.log(sig_prev))
+                r = h_last / h
+                second = -0.5 * jnp.expm1(-2.0 * h) * (1.0 / r) * (den - old_den)
+                xn = xn + jnp.where(i == i0, 0.0, second)
+                noise = jax.random.normal(nk2, xc.shape, jnp.float32)
+                xn = xn + noise * sig_n * jnp.sqrt(
+                    jnp.maximum(-jnp.expm1(-2.0 * h), 0.0))
+                # Final σ = 0 step: the multistep correction's 1/r blows up
+                # (h → ∞); the exact limit of the update is the denoised
+                # sample itself.
+                xn = jnp.where(sig_n == 0.0, den, xn)
+                return (xn.astype(xc.dtype), den, k), None
+
+            (x, _, _), _ = jax.lax.scan(
+                step, (x, jnp.zeros_like(x), key), jnp.arange(i0, steps))
+        elif scheduler == "euler_a":
 
             def step(carry, i):
                 xc, k = carry
@@ -905,15 +1048,107 @@ def generate(
             acp0 = acp[ts[i0]]
             x = jnp.sqrt(acp0) * init_lat + jnp.sqrt(1.0 - acp0) * x
 
-        def step(carry, i):
-            xc, k = carry
-            k, bk = jax.random.split(k)
-            t = ts[i]
-            eps = cfg_eps(xc, t.astype(jnp.float32))
-            xn = ddim_step(cfg, acp, eps, t, t - ratio, xc)
-            return (blend(xn, t - ratio, bk), k), None
+        if scheduler == "pndm":
+            # PLMS (Liu et al. 2022): Adams-Bashforth eps history (orders
+            # 1→4 warmup) through the pseudo-linear transfer function
+            # (diffusers PNDMScheduler._get_prev_sample). Deliberate
+            # difference from diffusers' skip_prk warmup: the first
+            # timestep runs ONE order-1 step instead of diffusers'
+            # duplicated-timestep two-eval average — steps model evals
+            # total, converging to the same trajectory as history fills.
+            def transfer(xcf, eps, t, t_prev):
+                a_t = acp[t]
+                a_p = jnp.where(t_prev >= 0, acp[jnp.maximum(t_prev, 0)], 1.0)
+                coeff = jnp.sqrt(a_p / a_t)
+                denom = a_t * jnp.sqrt(1.0 - a_p) + jnp.sqrt(
+                    a_t * (1.0 - a_t) * a_p)
+                return coeff * xcf - (a_p - a_t) * eps / denom
 
-        (x, _), _ = jax.lax.scan(step, (x, key), jnp.arange(i0, steps))
+            def step(carry, idx):
+                xc, e1, e2, e3, cnt = carry  # e1 newest
+                t = ts[idx]
+                eps = cfg_eps(xc, t.astype(jnp.float32)).astype(jnp.float32)
+                if cfg.prediction_type == "v_prediction":
+                    # diffusers PNDMScheduler converts v → eps before the
+                    # transfer function: eps = √ᾱ·v + √(1−ᾱ)·x
+                    a_t = acp[t]
+                    eps = (jnp.sqrt(a_t) * eps
+                           + jnp.sqrt(1.0 - a_t) * xc.astype(jnp.float32))
+                ep = jnp.where(
+                    cnt == 0, eps, jnp.where(
+                        cnt == 1, (3.0 * eps - e1) / 2.0, jnp.where(
+                            cnt == 2, (23.0 * eps - 16.0 * e1 + 5.0 * e2) / 12.0,
+                            (55.0 * eps - 59.0 * e1 + 37.0 * e2 - 9.0 * e3) / 24.0,
+                        )))
+                xn = transfer(xc.astype(jnp.float32), ep, t, t - ratio)
+                return (xn.astype(xc.dtype), eps, e1, e2, cnt + 1), None
+
+            z = jnp.zeros_like(x)
+            (x, _, _, _, _), _ = jax.lax.scan(
+                step, (x, z, z, z, jnp.int32(0)), jnp.arange(i0, steps))
+        elif scheduler == "unipc":
+            # UniPC (Zhao et al. 2023), bh2 variant: data-prediction
+            # multistep over λ = log(α/σ) with a p=2 predictor and a
+            # single-order corrector applied to the previous step once this
+            # step's model output is known (the predictor-corrector
+            # framework of diffusers UniPCMultistepScheduler, order 2).
+            alphas = jnp.sqrt(acp)
+            sigmas_t = jnp.sqrt(1.0 - acp)
+
+            def at(t):
+                a = jnp.where(t >= 0, alphas[jnp.maximum(t, 0)], 1.0)
+                s = jnp.where(t >= 0, sigmas_t[jnp.maximum(t, 0)], 0.0)
+                lam = jnp.log(a) - jnp.log(jnp.maximum(s, 1e-10))
+                return a, jnp.maximum(s, 1e-10), lam
+
+            def x0_of(xc, t):
+                eps = cfg_eps(xc, t.astype(jnp.float32)).astype(jnp.float32)
+                a_t, s_t, _ = at(t)
+                if cfg.prediction_type == "v_prediction":
+                    return a_t * xc.astype(jnp.float32) - s_t * eps
+                return (xc.astype(jnp.float32) - s_t * eps) / a_t
+
+            def step(carry, idx):
+                xc, x_prev, m_prev, t_prev_step, cnt = carry
+                t = ts[idx]
+                a_t, s_t, lam_t = at(t)
+                m_t = x0_of(xc, t)
+                # UniC: correct THIS sample using the fresh model output
+                # (rhos_c = 1/2, B_h = h_phi_1 for bh2).
+                _, s_p, lam_p = at(t_prev_step)
+                h_c = lam_t - lam_p
+                phi_c = jnp.expm1(-h_c)
+                x_corr = (s_t / s_p) * x_prev.astype(jnp.float32) \
+                    - a_t * phi_c * m_prev \
+                    - a_t * phi_c * 0.5 * (m_t - m_prev)
+                xcf = jnp.where(cnt > 0, x_corr, xc.astype(jnp.float32))
+                # UniP to the next timestep: p=1 on the first step, p=2 after.
+                t_n = t - ratio
+                a_n, s_n, lam_n = at(t_n)
+                h = lam_n - lam_t
+                phi = jnp.expm1(-h)
+                x1 = (s_n / s_t) * xcf - a_n * phi * m_t
+                r0 = (lam_p - lam_t) / h
+                d1 = (m_prev - m_t) / jnp.where(cnt > 0, r0, 1.0)
+                x2 = x1 - a_n * phi * 0.5 * d1
+                xn = jnp.where(cnt > 0, x2, x1)
+                return (xn.astype(xc.dtype), xcf.astype(xc.dtype), m_t, t,
+                        cnt + 1), None
+
+            (x, _, _, _, _), _ = jax.lax.scan(
+                step, (x, x, jnp.zeros_like(x), ts[i0], jnp.int32(0)),
+                jnp.arange(i0, steps))
+        else:  # ddim
+
+            def step(carry, i):
+                xc, k = carry
+                k, bk = jax.random.split(k)
+                t = ts[i]
+                eps = cfg_eps(xc, t.astype(jnp.float32))
+                xn = ddim_step(cfg, acp, eps, t, t - ratio, xc)
+                return (blend(xn, t - ratio, bk), k), None
+
+            (x, _), _ = jax.lax.scan(step, (x, key), jnp.arange(i0, steps))
 
     return vae_decode(cfg.vae, params["vae"], x / cfg.vae.scaling_factor)
 

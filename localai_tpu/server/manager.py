@@ -859,11 +859,16 @@ class ModelManager:
                     motion = VD.load_motion_adapter(mdir)
                     log.info("model %s: motion adapter loaded from %s",
                              cfg.name, mdir)
+                sched = str(cfg.options.get("scheduler", "ddim"))
+                if sched not in LD.SUPPORTED_SCHEDULERS:
+                    # Fail at LOAD, not at the first generation request.
+                    raise ValueError(
+                        f"model {cfg.name!r}: unknown scheduler {sched!r} "
+                        f"(supported: {', '.join(sorted(LD.SUPPORTED_SCHEDULERS))})"
+                    )
                 eng = LatentDiffusionEngine(
                     ldcfg, ldparams, tok,
-                    default_scheduler=str(
-                        cfg.options.get("scheduler", "ddim")
-                    ),
+                    default_scheduler=sched,
                     motion=motion,
                 )
                 return LoadedModel(cfg, eng, None)

@@ -272,8 +272,13 @@ def test_generate_shapes_determinism_and_schedulers(sd_dir):
                       jnp.int32)[None]
     un = jnp.asarray(tok("", padding="max_length", max_length=77,
                          truncation=True)["input_ids"], jnp.int32)[None]
-    for sched in ("ddim", "euler_a", "dpmpp_2m", "heun", "lms",
-                  "dpmpp_2m_karras", "euler_a_karras", "lms_karras"):
+    # The reference's full A1111-mapped surface (diffusers backend.py:
+    # 100-168) in both spellings: our "_karras" suffix and its "k_" prefix.
+    for sched in ("ddim", "pndm", "unipc", "euler", "euler_a", "dpmpp_2m",
+                  "heun", "lms", "dpm_2", "dpm_2_a", "dpmpp_sde",
+                  "dpmpp_2m_sde", "dpmpp_2m_karras", "euler_a_karras",
+                  "lms_karras", "k_euler", "k_dpm_2", "k_dpm_2_a",
+                  "k_dpmpp_sde", "k_dpmpp_2m_sde"):
         img1 = np.asarray(ld.generate(
             cfg, params, ids, un, jax.random.key(7), steps=4,
             height=64, width=64, scheduler=sched,
@@ -286,7 +291,13 @@ def test_generate_shapes_determinism_and_schedulers(sd_dir):
             height=64, width=64, scheduler=sched,
         ))
         np.testing.assert_array_equal(img1, img2)  # same seed → same image
-    for bad in ("pndm-nope", "ddim_karras"):
+    # Karras spacing actually changes the trajectory.
+    a = np.asarray(ld.generate(cfg, params, ids, un, jax.random.key(7),
+                               steps=4, height=64, width=64, scheduler="euler"))
+    b = np.asarray(ld.generate(cfg, params, ids, un, jax.random.key(7),
+                               steps=4, height=64, width=64, scheduler="k_euler"))
+    assert np.abs(a - b).max() > 0
+    for bad in ("pndm-nope", "ddim_karras", "k_unipc"):
         with pytest.raises(ValueError):
             ld.generate(cfg, params, ids, un, jax.random.key(7), steps=2,
                         height=64, width=64, scheduler=bad)
