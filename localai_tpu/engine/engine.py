@@ -997,14 +997,23 @@ class Engine:
 
     def _get_admit_cached(self, pb: int, tb: int, has_bias: bool,
                           with_topk: bool, with_lp: bool,
-                          with_dfa: bool = False, build_only: bool = False):
+                          with_dfa: bool = False, fb: int = 0,
+                          build_only: bool = False):
         """Cached admission: copy a stored prefix KV span into the slot and
         prefill only the prompt tail (models/llama.py prefill_tail) — the
         prompt cache fast path (reference: cache_prompt, grpc-server.cpp:125).
         Always m=1. `aux` is [4] i32 (tail_len, slot, seed, prefix_len);
         penalty counts for the full prompt arrive precomputed as `count_row`
-        [1, V] i32 because the prefix tokens never reach the device."""
-        key = ("cached", pb, tb, has_bias, with_topk, with_lp, with_dfa)
+        [1, V] i32 because the prefix tokens never reach the device.
+
+        fb > 0 (draft model configured): the program additionally takes the
+        FULL prompt in an fb-token bucket and prefills the DRAFT model with
+        it — the draft's small cache has no span to reuse, and speculative
+        verify needs its KV aligned with the target's (llama.cpp serves
+        cache_prompt and a draft together; grpc-server.cpp:125 +
+        params_parse). The target still skips its own prefix compute, which
+        is where the admission time goes."""
+        key = ("cached", pb, tb, has_bias, with_topk, with_lp, with_dfa, fb)
         fn = self._admit_cache.get(key)
         if fn is not None:
             return fn
@@ -1079,6 +1088,26 @@ class Engine:
                                     d_gstate=d_gstate)
 
             fn = jax.jit(admit_cached_dfa, donate_argnums=(1, 2, 3, 4, 5, 6, 7))
+        elif fb:
+            dcfg = self.draft_cfg
+
+            def admit_cached_draft(params, cache, counts, rngs, bias,
+                                   d_tokens, d_positions, dparams, dcache,
+                                   pk, pv, tail_toks, full_toks, count_row,
+                                   aux, samp_pack, bias_rows):
+                out = admit_cached(params, cache, counts, rngs, bias,
+                                   d_tokens, d_positions, pk, pv, tail_toks,
+                                   count_row, aux, samp_pack, bias_rows)
+                flen = aux[0:1] + aux[3:4]  # tail + prefix = full prompt
+                _, dks, dvs = llama.prefill(dcfg, dparams, full_toks, flen,
+                                            ep=self.plan.ep)
+                dcache = llama.write_prefill_to_cache(
+                    dcache, dks[:, 0:1], dvs[:, 0:1], aux[1]
+                )
+                return out + (dcache,)
+
+            fn = jax.jit(admit_cached_draft,
+                         donate_argnums=(1, 2, 3, 4, 5, 6, 8))
         else:
             fn = jax.jit(admit_cached, donate_argnums=(1, 2, 3, 4, 5, 6))
         if not build_only:
@@ -1087,7 +1116,7 @@ class Engine:
 
     def _get_admit_cached_paged(self, npg: int, tb: int, has_bias: bool,
                                 with_topk: bool, with_lp: bool,
-                                with_dfa: bool = False,
+                                with_dfa: bool = False, fb: int = 0,
                                 build_only: bool = False):
         """Cached admission against the PAGE POOL: the span's pages are
         mapped read-only into the slot's table (no copy — copy-on-write
@@ -1096,7 +1125,8 @@ class Engine:
         m=1; `aux` is [4] i32 (tail_len, slot, seed, prefix_len) with
         prefix_len page-aligned; `pages` is the [npg] span page list
         (SCRATCH-padded — rows past prefix_len are masked by prefill_tail)."""
-        key = ("cached-paged", npg, tb, has_bias, with_topk, with_lp, with_dfa)
+        key = ("cached-paged", npg, tb, has_bias, with_topk, with_lp,
+               with_dfa, fb)
         fn = self._admit_cache.get(key)
         if fn is not None:
             return fn
@@ -1168,6 +1198,26 @@ class Engine:
                                           d_gstate=d_gstate)
 
             fn = jax.jit(admit_cp_dfa, donate_argnums=(1, 2, 3, 4, 5, 6, 7))
+        elif fb:
+            dcfg = self.draft_cfg
+
+            def admit_cp_draft(params, cache, counts, rngs, bias, d_tokens,
+                               d_positions, dparams, dcache, pages, table_row,
+                               tail_toks, full_toks, count_row, aux,
+                               samp_pack, bias_rows):
+                out = admit_cached_paged(params, cache, counts, rngs, bias,
+                                         d_tokens, d_positions, pages,
+                                         table_row, tail_toks, count_row,
+                                         aux, samp_pack, bias_rows)
+                flen = aux[0:1] + aux[3:4]
+                _, dks, dvs = llama.prefill(dcfg, dparams, full_toks, flen,
+                                            ep=self.plan.ep)
+                dcache = llama.write_prefill_to_cache(
+                    dcache, dks[:, 0:1], dvs[:, 0:1], aux[1]
+                )
+                return out + (dcache,)
+
+            fn = jax.jit(admit_cp_draft, donate_argnums=(1, 2, 3, 4, 5, 6, 8))
         else:
             fn = jax.jit(admit_cached_paged, donate_argnums=(1, 2, 3, 4, 5, 6))
         if not build_only:
@@ -1180,10 +1230,22 @@ class Engine:
 
     @property
     def _prefix_enabled(self) -> bool:
-        # Draft models stay excluded: a cached admission skips the draft's
-        # prompt prefill, so its KV cache would miss the span and the verify
-        # would be scored against garbage draft proposals.
-        return self.ecfg.prefix_cache_entries > 0 and self.draft_cfg is None
+        # Composes with draft models too (r5): the cached-admit program
+        # prefills the DRAFT with the full prompt (its small cache has no
+        # span to reuse) while the target still skips its prefix compute —
+        # llama.cpp serves cache_prompt + draft together (grpc-server.cpp:125).
+        return self.ecfg.prefix_cache_entries > 0
+
+    def _cached_admit_ok(self, request: GenRequest) -> bool:
+        """Whether this request may admit through the prefix-cache shortcut.
+        Grammar/logprob requests on DRAFT engines have no draft-composed
+        cached variant — they must be decided at PLANNING time (treated as
+        misses) so the paged planner budgets FULL pages; deciding at
+        dispatch would leave a tail-only budget for a full admission
+        (pool-gate break / requeue livelock)."""
+        if self.draft_cfg is None:
+            return True
+        return request.grammar is None and request.logprobs <= 0
 
     def _prefix_find(self, prompt_ids: list[int]):
         """Longest-common-prefix match against the stored spans. Returns
@@ -1390,6 +1452,13 @@ class Engine:
         ids = request.prompt_ids
         tail = ids[match_len:]
         tb = self._bucket_for(len(tail))
+        draft = self.draft_cfg is not None
+        if not self._cached_admit_ok(request):
+            # Unreachable from the engine loop (planning and _dispatch_admit
+            # both gate on _cached_admit_ok); direct callers get the same
+            # full-admission answer.
+            return "full"
+        fb = self._bucket_for(len(ids)) if draft else 0
         paged_alloc: Optional[np.ndarray] = None
         if self._paged:
             # The entry must still be live (pressure eviction may have
@@ -1437,22 +1506,27 @@ class Engine:
             pages_arr = np.full((npg,), self._scratch_page, np.int32)
             pages_arr[: len(shared)] = shared
             key = ("cached-paged", npg, tb, has_bias, with_topk, with_lp,
-                   with_dfa)
+                   with_dfa, fb)
             getter = self._get_admit_cached_paged
             args = (
                 jnp.asarray(pages_arr), jnp.asarray(self.h_ptable[slot_idx]),
-                jnp.asarray(tail_toks), jnp.asarray(counts), jnp.asarray(aux),
-                jnp.asarray(samp_pack), jnp.asarray(bias_rows),
+                jnp.asarray(tail_toks),
             )
         else:
             key = ("cached", entry["pb"], tb, has_bias, with_topk, with_lp,
-                   with_dfa)
+                   with_dfa, fb)
             getter = self._get_admit_cached
             args = (
-                entry["k"], entry["v"],
-                jnp.asarray(tail_toks), jnp.asarray(counts), jnp.asarray(aux),
-                jnp.asarray(samp_pack), jnp.asarray(bias_rows),
+                entry["k"], entry["v"], jnp.asarray(tail_toks),
             )
+        if fb:
+            full_toks = np.zeros((1, fb), np.int32)
+            full_toks[0, : len(ids)] = ids
+            args = args + (jnp.asarray(full_toks),)
+        args = args + (
+            jnp.asarray(counts), jnp.asarray(aux),
+            jnp.asarray(samp_pack), jnp.asarray(bias_rows),
+        )
         if with_dfa:
             host = dfa_tables["host"]
             row = np.unpackbits(
@@ -1465,6 +1539,12 @@ class Engine:
                 self.d_tokens, self.d_positions, self.d_gstate, *args,
                 jnp.asarray(gmask0), self._dfa_table(dfa_tables, with_dfa),
                 dfa_tables["tok_cls"], jnp.asarray(ginit),
+            )
+        elif fb:
+            full_args = (
+                self.params, self.cache, self.counts, self.rngs, self.bias,
+                self.d_tokens, self.d_positions, self.draft_params,
+                self.d_cache, *args,
             )
         else:
             full_args = (
@@ -1498,6 +1578,8 @@ class Engine:
         ) = out[:9]
         if with_dfa:
             self.d_gstate = out[9]
+        elif fb:
+            self.d_cache = out[9]
         _host_copy_async(toks)
         # LRU bump + metrics. Identity scan, not `in`: dict == would compare
         # the numpy key arrays elementwise (and raises on length mismatch).
@@ -2321,8 +2403,10 @@ class Engine:
                         continue
                     if self._paged:
                         # A prefix hit shares the span's pages — gate on the
-                        # reduced (tail-only) need.
-                        hit = self._prefix_find(request.prompt_ids)
+                        # reduced (tail-only) need. Requests the cached path
+                        # can't serve budget as misses (full pages).
+                        hit = (self._prefix_find(request.prompt_ids)
+                               if self._cached_admit_ok(request) else None)
                         if hit is not None:
                             prefix_hits[id(request)] = hit
                             need = self._pages_needed_cached(request, hit[1])
@@ -2404,7 +2488,8 @@ class Engine:
         dfa_tables = None
         if m == 1 and chunk[0][0].grammar is not None and chunk[0][0].image_embeds is None:
             dfa_tables = self._dfa_for(chunk[0][0])
-        if m == 1 and chunk[0][0].image_embeds is None:
+        if (m == 1 and chunk[0][0].image_embeds is None
+                and self._cached_admit_ok(chunk[0][0])):
             # Without a hit from the admission round, scan here: covers
             # direct callers (tests, warmup) and round-memoized misses whose
             # span an earlier chunk this round may have just saved. The scan
@@ -2524,10 +2609,18 @@ class Engine:
             rows_tbl = np.zeros((m, self._max_pages), np.int32)
             for j, (r, _h) in enumerate(chunk):
                 prow = self._pages_alloc(slot_ids[j], self._pages_needed(r))
-                if prow is None:  # admission is page-gated; belt-and-braces
+                if prow is None:
+                    # Admission is page-gated at planning, but a cached-path
+                    # fallback earlier this round may have spent more than
+                    # its tail-only budget. Requeue the chunk (graceful
+                    # backpressure) instead of killing the engine loop.
                     for s in allocated_slots:
                         self._pages_free(s)
-                    raise RuntimeError("KV page pool exhausted at dispatch")
+                    with self._pending_lock:
+                        for item in reversed(chunk):
+                            self._pending.appendleft(item)
+                    self._wake.set()
+                    return
                 allocated_slots.append(slot_ids[j])
                 rows_tbl[j] = prow
             args_in = args_in + (jnp.asarray(rows_tbl),)

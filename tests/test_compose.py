@@ -165,6 +165,48 @@ def test_paged_spec_compose(tiny):
         ps.stop()
 
 
+def test_prefix_spec_compose(tiny):
+    """Prefix cache WITH a draft model (r5 — the r4 exclusion removed): a
+    cached admission skips the target's prefix compute and prefills the
+    draft with the full prompt, so speculative verify still scores against
+    aligned draft KV. Greedy output is bit-identical to the plain dense
+    engine (llama.cpp serves cache_prompt + draft together)."""
+    cfg, params = tiny
+    shared, prompts = _prompts(31)
+    ref = _mk(cfg, params, prefix=False)
+    eng = _mk(cfg, params, prefix=True, draft=True)
+    try:
+        want = _texts(ref, prompts)
+        assert _texts(eng, [prompts[0]]) == [want[0]]  # seeds the span
+        hits0 = eng.m_prefix_hits
+        assert _texts(eng, [prompts[1]]) == [want[1]]
+        assert eng.m_prefix_hits > hits0, "prefix cache did not engage"
+        assert eng.m_spec_rounds > 0, "speculative path did not engage"
+        assert _texts(eng, [prompts[2]]) == [want[2]]
+    finally:
+        ref.stop()
+        eng.stop()
+
+
+def test_paged_prefix_spec_compose(tiny):
+    """All three at once: paged pool + prefix span sharing + speculative
+    decoding, bit-identical greedy output."""
+    cfg, params = tiny
+    _, prompts = _prompts(37)
+    ref = _mk(cfg, params, prefix=False)
+    eng = _mk(cfg, params, paged=True, prefix=True, draft=True)
+    try:
+        want = _texts(ref, prompts)
+        assert _texts(eng, [prompts[0]]) == [want[0]]
+        hits0 = eng.m_prefix_hits
+        assert _texts(eng, [prompts[1]]) == [want[1]]
+        assert eng.m_prefix_hits > hits0, "prefix cache did not engage"
+        assert eng.m_spec_rounds > 0, "speculative path did not engage"
+    finally:
+        ref.stop()
+        eng.stop()
+
+
 def test_paged_spec_sampled_seeded(tiny):
     """Sampled requests through the paged spec path complete and are
     seed-reproducible (stochastic verify is unbiased; determinism per seed)."""
