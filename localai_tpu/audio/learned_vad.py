@@ -288,24 +288,110 @@ def frame_labels(ys: list, n_frames: int):
     return jnp.asarray(np.stack(out))
 
 
+def real_noise_clips(sr: int = 16_000) -> list:
+    """Real RECORDED non-speech audio available in the zero-egress image
+    (pygame's example clips: music, door slams, impacts) — used as hard
+    negatives and as mixing backgrounds so the net doesn't fire on real-
+    world acoustics the formant synthesizer can't produce. Returns [] when
+    unavailable (training then falls back to synthetic-only noise)."""
+    import glob
+
+    from localai_tpu.audio.wav import resample
+
+    try:
+        import pygame.examples  # noqa: F401 — locate the data dir
+
+        base = os.path.join(os.path.dirname(pygame.examples.__file__), "data")
+    except Exception:  # noqa: BLE001 — optional corpus
+        return []
+    from scipy.io import wavfile
+
+    out = []
+    for f in sorted(glob.glob(os.path.join(base, "*.wav"))):
+        try:
+            import warnings
+
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                rate, x = wavfile.read(f)
+        except Exception:  # noqa: BLE001 — ADPCM etc.
+            continue
+        x = np.asarray(x, np.float32)
+        if x.ndim == 2:
+            x = x.mean(axis=1)
+        peak = float(np.abs(x).max()) or 1.0
+        x = x / peak * 0.5
+        if rate != sr:
+            x = resample(x, rate, sr)
+        if len(x) >= sr // 4:
+            out.append(x.astype(np.float32))
+    return out
+
+
+def _crop_to(clip: np.ndarray, n: int, rng: np.random.Generator) -> np.ndarray:
+    if len(clip) >= n:
+        s = int(rng.integers(0, len(clip) - n + 1))
+        return clip[s: s + n]
+    reps = -(-n // len(clip))
+    return np.tile(clip, reps)[:n]
+
+
 def train_formant(cfg: VadNetConfig, steps: int = 600, seed: int = 0,
-                  lr: float = 3e-3, batch_pos: int = 12, batch_neg: int = 6):
+                  lr: float = 3e-3, batch_pos: int = 12, batch_neg: int = 6,
+                  real_noise: Optional[list] = None):
     """Train on the formant-synthesis corpus (audio/formant_speech.py):
     glottal-source + formant-resonator utterances with word-internal pauses,
     mixed into white/pink/babble/hum noise at 0-30 dB SNR, against hard
-    negatives (tones, chords, mains hum, clicks). This is what the shipped
-    assets/vad-base.safetensors artifact was produced by."""
+    negatives (tones, chords, mains hum, clicks).
+
+    real_noise (r5): real RECORDED clips (real_noise_clips) are mixed as
+    additional backgrounds UNDER half the positives and appended as pure
+    negatives — the r4 artifact fired on real music (28% of frames on an
+    instrumental clip) because every negative it ever saw was synthetic.
+    This is what the shipped assets/vad-base.safetensors artifact was
+    produced by (see tools/train_vad.py)."""
     from localai_tpu.audio import formant_speech as FS
 
     rng = np.random.default_rng(seed)
+    real = real_noise or []
 
     def make_batch():
         xs, ys = FS.corpus_batch(rng, n_pos=batch_pos, n_neg=batch_neg)
+        if real:
+            # Real backgrounds under half the positives (labels unchanged).
+            for i in range(0, batch_pos, 2):
+                clip = real[int(rng.integers(0, len(real)))]
+                bg = _crop_to(clip, len(xs[i]), rng)
+                snr = rng.uniform(0.1, 0.5)  # background well below speech
+                xs[i] = (xs[i] + snr * bg).astype(np.float32)
+            # Pure real negatives.
+            for _ in range(max(2, batch_neg // 2)):
+                clip = real[int(rng.integers(0, len(real)))]
+                n = len(xs[0])
+                xs.append(_crop_to(clip, n, rng) * float(rng.uniform(0.5, 1.5)))
+                ys.append(np.zeros(n, np.float32))
         mels = jnp.concatenate([features(x, cfg) for x in xs], axis=0)
         y = frame_labels(ys, mels.shape[1])
         return mels, y
 
     return _fit(cfg, make_batch, steps, seed, lr, refresh_every=10)
+
+
+def evaluate_real_negatives(cfg: VadNetConfig, p: Params,
+                            clips: Optional[list] = None) -> dict:
+    """Frame false-positive rate on real recorded non-speech audio.
+    Returns {"fp_rate", "n_clips", "worst"}; n_clips 0 when no real audio
+    is available in the image."""
+    clips = real_noise_clips() if clips is None else clips
+    if not clips:
+        return {"fp_rate": 0.0, "n_clips": 0, "worst": 0.0}
+    rates = []
+    for x in clips:
+        mel = features(x, cfg)
+        probs = np.asarray(forward(cfg, p, mel)[0])
+        rates.append(float((probs > 0.5).mean()))
+    return {"fp_rate": float(np.mean(rates)), "n_clips": len(clips),
+            "worst": float(np.max(rates))}
 
 
 def evaluate(cfg: VadNetConfig, p: Params, seed: int = 999,

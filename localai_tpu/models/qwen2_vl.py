@@ -270,12 +270,10 @@ def load_hf_qwen2_vl_vision(cfg: Qwen2VLVisionConfig, ckpt_dir: str) -> Params:
     the conv3d patch embed flattens to a [patch_dim, embed_dim] matmul."""
     from localai_tpu.engine.weights import _ShardReader
 
+    # _ShardReader aliases model.visual.* → visual.*, so one spelling
+    # addresses both the published and the nested transformers layouts.
     reader = _ShardReader(ckpt_dir)
     prefix = "visual."
-    try:
-        reader.get(prefix + "patch_embed.proj.weight")
-    except Exception:  # newer transformers nests under model.
-        prefix = "model.visual."
     out: Params = {}
     w = reader.get(prefix + "patch_embed.proj.weight")  # [D, C, tps, p, p]
     out["patch_embed.weight"] = jnp.asarray(

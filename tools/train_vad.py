@@ -1,0 +1,39 @@
+"""Produce assets/vad-base.safetensors — the shipped VAD artifact.
+
+Recipe (r5): formant-synthesis corpus (audio/formant_speech.py) + real
+recorded backgrounds/negatives from the image's pygame example clips
+(learned_vad.real_noise_clips). Run from the repo root:
+
+    python tools/train_vad.py [steps]
+
+Prints held-out synthetic metrics and the real-audio frame-FP rate; only
+overwrite the asset when both look good (synthetic F1 >= 0.93, real FP
+<= 0.05).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from localai_tpu.audio import learned_vad as LV
+
+
+def main() -> None:
+    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 900
+    cfg = LV.VadNetConfig()
+    real = LV.real_noise_clips()
+    print(f"real noise clips: {len(real)}")
+    params = LV.train_formant(cfg, steps=steps, seed=0, real_noise=real)
+    m = LV.evaluate(cfg, params)
+    rn = LV.evaluate_real_negatives(cfg, params, real)
+    print("synthetic held-out:", m)
+    print("real negatives:", rn)
+    out = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                       "localai_tpu", "assets", "vad-base.safetensors")
+    LV.save_params(out, params)
+    print("wrote", out)
+
+
+if __name__ == "__main__":
+    main()
