@@ -265,6 +265,13 @@ def main(argv: list[str] | None = None) -> int:
     ModelsApi(manager).register(router)
     register_openapi(router)
     register_webui(router)
+    from localai_tpu.server.p2p_api import P2pApi
+
+    P2pApi(
+        federator=getattr(args, "federator", None)
+        or os.environ.get("LOCALAI_FEDERATOR"),
+        worker_name=getattr(args, "worker_name", None),
+    ).register(router)
 
     for name in app_cfg.preload_models:
         log.info("preloading model %s", name)

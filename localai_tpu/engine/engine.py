@@ -2390,6 +2390,31 @@ class Engine:
             free = [i for i, s in enumerate(self.slots) if s is None]
             if not free:
                 return admitted
+            # Submit-burst coalescing (r5): a cold burst arrives staggered
+            # over a few ms; admitting eagerly splits it into several
+            # prefill programs (observed m=2+4+2 for an 8-request burst,
+            # each paying ~60 ms of dispatch overhead on the tunnel). While
+            # the ENGINE IS IDLE and submits are still arriving, hold
+            # admission until the burst settles (bounded by 4x the window)
+            # so the whole burst prefills as ONE program. Never holds while
+            # decoding — those admissions ride between blocks anyway.
+            if (self.ecfg.admit_coalesce_ms > 0 and not self.h_active.any()):
+                now = time.monotonic()
+                with self._pending_lock:
+                    npend = len(self._pending)
+                if npend == 0:
+                    self._admit_hold_start = 0.0
+                elif npend < len(free):
+                    if self._admit_hold_start == 0.0:
+                        self._admit_hold_start = now
+                    window = self.ecfg.admit_coalesce_ms / 1000.0
+                    if ((now - self._last_submit_t) < window
+                            and (now - self._admit_hold_start) < 4 * window):
+                        time.sleep(window / 8)
+                        return admitted
+                    self._admit_hold_start = 0.0
+                else:
+                    self._admit_hold_start = 0.0
             group: list[tuple[GenRequest, RequestHandle]] = []
             bucket = 0
             pages_planned = 0

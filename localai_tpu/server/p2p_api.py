@@ -1,0 +1,63 @@
+"""Federation / P2P status for the WebUI and operators.
+
+Reference: the LocalAI WebUI's p2p views (core/http/views/p2p.html +
+core/p2p) show the swarm this node belongs to. Here the swarm is the
+token-gated federation router (localai_tpu/federation) plus the explorer
+directory; this endpoint aggregates both SERVER-SIDE (the browser never
+talks cross-origin, and only the CONFIGURED urls are fetched — no
+client-supplied targets, so no SSRF surface).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.request
+from typing import Optional
+
+from localai_tpu.server.app import Request, Response, Router
+
+
+class P2pApi:
+    def __init__(self, federator: Optional[str] = None,
+                 worker_name: Optional[str] = None,
+                 explorer: Optional[str] = None):
+        self._federator = federator
+        self._worker_name = worker_name
+        self._explorer = explorer
+
+    def register(self, r: Router) -> None:
+        r.add("GET", "/p2p/status", self.status)
+
+    def _fetch_json(self, url: str):
+        req = urllib.request.Request(url, headers={"Accept": "application/json"})
+        token = os.environ.get("LOCALAI_FEDERATION_TOKEN", "")
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        with urllib.request.urlopen(req, timeout=3) as resp:
+            return json.loads(resp.read())
+
+    def status(self, req: Request) -> Response:
+        federator = self._federator or os.environ.get("LOCALAI_FEDERATOR") or None
+        explorer = self._explorer or os.environ.get("LOCALAI_EXPLORER") or None
+        body = {
+            "federator": federator,
+            "worker_name": self._worker_name,
+            "explorer": explorer,
+            "workers": [],
+            "networks": [],
+            "errors": [],
+        }
+        if federator:
+            try:
+                d = self._fetch_json(federator.rstrip("/") + "/federation/workers")
+                body["workers"] = d.get("workers", d) or []
+            except Exception as e:  # noqa: BLE001 — status stays best-effort
+                body["errors"].append(f"federator: {type(e).__name__}: {e}")
+        if explorer:
+            try:
+                d = self._fetch_json(explorer.rstrip("/") + "/networks")
+                body["networks"] = d.get("networks", d) or []
+            except Exception as e:  # noqa: BLE001
+                body["errors"].append(f"explorer: {type(e).__name__}: {e}")
+        return Response(body=body)
