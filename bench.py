@@ -22,6 +22,34 @@ import threading
 import time
 
 
+def _join_or_die(threads, eng, what: str, timeout: float = 900.0) -> None:
+    """Join request threads with a deadline instead of hanging to the
+    harness timeout (BENCH_r05 was rc=124 exactly this way). The engine's
+    loop-guard already errors out every live handle when the loop thread
+    dies (so the request threads unblock and the row reports rc=1 with the
+    error list); this is the backstop for anything it misses — a dead loop
+    thread or a blown deadline fails the bench NOW with a message."""
+    deadline = time.time() + timeout
+    for t in threads:
+        while t.is_alive():
+            t.join(timeout=5.0)
+            loop = eng._thread
+            if t.is_alive() and loop is not None and not loop.is_alive():
+                print(
+                    f"{what}: engine loop thread died "
+                    f"({getattr(eng, '_loop_dead', None)!r}) — failing fast",
+                    file=sys.stderr,
+                )
+                sys.exit(1)
+            if t.is_alive() and time.time() > deadline:
+                print(
+                    f"{what}: request threads still running after "
+                    f"{timeout:.0f}s — failing fast",
+                    file=sys.stderr,
+                )
+                sys.exit(1)
+
+
 def main() -> None:
     import jax
 
@@ -81,8 +109,7 @@ def main() -> None:
     wall0 = time.time()
     for t in threads:
         t.start()
-    for t in threads:
-        t.join()
+    _join_or_die(threads, eng, "main decode row")
     wall = time.time() - wall0
 
     if errors:
@@ -348,8 +375,7 @@ def main() -> None:
             pthreads = [threading.Thread(target=pone, args=(i,)) for i in range(slots)]
             for t in pthreads:
                 t.start()
-            for t in pthreads:
-                t.join()
+            _join_or_die(pthreads, peng, "paged row")
             ptps = (peng._decode_tokens / peng._decode_time
                     if peng._decode_time else 0.0)
             out["decode_tokens_per_sec_paged"] = round(ptps, 2)
@@ -631,8 +657,7 @@ def main() -> None:
                 qthreads.append(t)
             for t in qthreads:
                 t.start()
-            for t in qthreads:
-                t.join()
+            _join_or_die(qthreads, eng_q, f"{mode} row")
             qtps = (
                 eng_q._decode_tokens / eng_q._decode_time
                 if eng_q._decode_time else 0.0

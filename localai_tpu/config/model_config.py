@@ -103,6 +103,10 @@ class ModelConfig:
     # CacheTypeKey/Value, backend.proto:261-262). "fp8" halves KV HBM — 2x
     # servable context at the same pool size. Empty = model dtype.
     kv_cache_dtype: str = ""
+    # Paged decode attention kernel (docs/PAGED_ATTENTION.md): "auto" runs
+    # the fused ragged paged-attention Pallas kernel on TPU and the XLA
+    # reference elsewhere; "pallas"/"xla" force one.
+    paged_kernel: str = "auto"
 
     # Speculative decoding (reference: draft_model/n_draft,
     # core/config/model_config.go:211-212).

@@ -145,6 +145,13 @@ class EngineConfig:
     # of preempting live ones. 0 = dense cache.
     kv_pages: int = 0
     kv_page_size: int = 128
+    # Paged decode attention implementation (ops/paged_flash): "auto" runs
+    # the fused ragged paged-attention Pallas kernel on TPU (page-table walk
+    # in-kernel, KV pages streamed HBM→VMEM once, per-slot ragged bounds)
+    # and the XLA gather walk elsewhere; "pallas"/"xla" force one (pallas
+    # off-TPU runs in interpret mode — tests only). LOCALAI_PAGED_KERNEL
+    # env var overrides.
+    paged_kernel: str = "auto"
     # KV-cache storage dtype (reference: CacheTypeKey/CacheTypeValue,
     # backend/backend.proto:261-262, llama.cpp q8 KV). "" = model dtype;
     # "fp8" (e4m3) / "fp8_e5m2" halve KV bytes — the TPU-native equivalent
@@ -395,6 +402,11 @@ class Engine:
                         f"max_seq={S} must divide by kv_page_size="
                         f"{self.ecfg.kv_page_size}"
                     )
+                if self.ecfg.paged_kernel not in ("auto", "pallas", "xla"):
+                    raise ValueError(
+                        f"paged_kernel={self.ecfg.paged_kernel!r}: use "
+                        "auto|pallas|xla"
+                    )
                 from jax.sharding import NamedSharding
                 from jax.sharding import PartitionSpec as P
 
@@ -498,6 +510,13 @@ class Engine:
         self._pending_lock = threading.Lock()
         self._inflight: deque[_Entry] = deque()
         self._last_admit_t = 0.0  # admission-coalescing reference (monotonic)
+        # Submit-burst coalescing state (_admit_pending): last submit() time
+        # and the start of the current idle-engine admission hold. BENCH_r05
+        # died (rc=124) because these were read before ever being assigned —
+        # the loop thread hit AttributeError on the first idle admission.
+        self._last_submit_t = 0.0
+        self._admit_hold_start = 0.0
+        self._loop_dead: Optional[str] = None  # set by _loop_guard on crash
         self._drain_thread: Optional[threading.Thread] = None
         self._drain_q: "queue.Queue[Optional[_Entry]]" = queue.Queue()
         self._lp_warmed = False  # warmup(logprobs=True) compiled lp kv_win blocks
@@ -730,7 +749,9 @@ class Engine:
                     pos_eff = jnp.where(active, positions, 0)
                     logits, lk, lv = llama.decode_step_windowed(
                         cfg, params, tokens, pos_eff, cache, lk, lv, step,
-                        ep=self.plan.ep, ptable=ptable, rope_delta=rope_delta,
+                        ep=self.plan.ep, ptable=ptable,
+                        paged_impl=self.ecfg.paged_kernel,
+                        rope_delta=rope_delta,
                     )
                 else:
                     logits, lk, lv = llama.decode_step_windowed(
@@ -995,25 +1016,32 @@ class Engine:
         self._admit_cache[key] = fn
         return fn
 
-    def _get_admit_cached(self, pb: int, tb: int, has_bias: bool,
+    def _get_admit_cached(self, pb: int, tb: int, fbp: int, has_bias: bool,
                           with_topk: bool, with_lp: bool,
-                          with_dfa: bool = False, fb: int = 0,
+                          with_dfa: bool = False, draft: bool = False,
                           build_only: bool = False):
         """Cached admission: copy a stored prefix KV span into the slot and
         prefill only the prompt tail (models/llama.py prefill_tail) — the
         prompt cache fast path (reference: cache_prompt, grpc-server.cpp:125).
-        Always m=1. `aux` is [4] i32 (tail_len, slot, seed, prefix_len);
-        penalty counts for the full prompt arrive precomputed as `count_row`
-        [1, V] i32 because the prefix tokens never reach the device.
+        Always m=1. `aux` is [4] i32 (tail_len, slot, seed, prefix_len).
 
-        fb > 0 (draft model configured): the program additionally takes the
-        FULL prompt in an fb-token bucket and prefills the DRAFT model with
-        it — the draft's small cache has no span to reuse, and speculative
-        verify needs its KV aligned with the target's (llama.cpp serves
-        cache_prompt and a draft together; grpc-server.cpp:125 +
-        params_parse). The target still skips its own prefix compute, which
-        is where the admission time goes."""
-        key = ("cached", pb, tb, has_bias, with_topk, with_lp, with_dfa, fb)
+        Host→device traffic is deliberately minimal: the penalty count row
+        is computed ON DEVICE from the full prompt ids in an fbp-token
+        bucket (~16 KB at a 4k prompt) — shipping a precomputed [1, V]
+        bincount instead costs ~0.5 MB per hit at a llama vocab, which on a
+        tunneled runtime is most of the latency the cache exists to save
+        (BENCH_r04's dense hit measured 3x a cold admit). bias_rows rides
+        only when the request actually has logit bias.
+
+        draft (draft model configured): the program additionally prefills
+        the DRAFT model with the same full-prompt bucket — the draft's small
+        cache has no span to reuse, and speculative verify needs its KV
+        aligned with the target's (llama.cpp serves cache_prompt and a
+        draft together; grpc-server.cpp:125 + params_parse). The target
+        still skips its own prefix compute, which is where the admission
+        time goes."""
+        key = ("cached", pb, tb, fbp, has_bias, with_topk, with_lp, with_dfa,
+               draft)
         fn = self._admit_cache.get(key)
         if fn is not None:
             return fn
@@ -1024,8 +1052,8 @@ class Engine:
         tok_v = min(getattr(self.tokenizer, "vocab_size", V) or V, V)
 
         def admit_cached(params, cache, counts, rngs, bias, d_tokens,
-                         d_positions, pk, pv, tail_toks, count_row, aux,
-                         samp_pack, bias_rows, gmask0=None, gtrans=None,
+                         d_positions, pk, pv, tail_toks, full_toks, aux,
+                         samp_pack, bias_rows=None, gmask0=None, gtrans=None,
                          tok_cls=None, ginit=None, d_gstate=None):
             tail_len, slot, seed, plen = aux[0], aux[1], aux[2], aux[3]
             samp = SamplingParams(
@@ -1037,7 +1065,12 @@ class Engine:
                 cfg, params, tail_toks, aux[0:1], aux[3:4], pk, pv,
                 ep=self.plan.ep,
             )
-            rows = count_row  # [1, V] i32 — host-side bincount of the prompt
+            # Penalty counts from the full prompt, on device (_get_admit's
+            # exact recipe — the prefix tokens DO reach the device here, as
+            # a token bucket two orders of magnitude smaller than a [V] row).
+            fvalid = (jnp.arange(fbp)[None, :] < (plen + tail_len)).astype(jnp.int32)
+            rows = jnp.zeros((1, V), jnp.int32)
+            rows = rows.at[jnp.arange(1)[:, None], full_toks].add(fvalid)
             brows = bias_rows if has_bias else jnp.zeros((1, V), jnp.float32)
             if tok_v < V:
                 from localai_tpu.ops.sampling import NEG_INF
@@ -1075,48 +1108,61 @@ class Engine:
                 out = out + (d_gstate.at[slot].set(gnext[0]),)
             return out
 
-        if with_dfa:
-            def admit_cached_dfa(params, cache, counts, rngs, bias, d_tokens,
-                                 d_positions, d_gstate, pk, pv, tail_toks,
-                                 count_row, aux, samp_pack, bias_rows, gmask0,
-                                 gtrans, tok_cls, ginit):
-                return admit_cached(params, cache, counts, rngs, bias,
-                                    d_tokens, d_positions, pk, pv, tail_toks,
-                                    count_row, aux, samp_pack, bias_rows,
-                                    gmask0=gmask0, gtrans=gtrans,
-                                    tok_cls=tok_cls, ginit=ginit,
-                                    d_gstate=d_gstate)
+        dcfg = self.draft_cfg
 
-            fn = jax.jit(admit_cached_dfa, donate_argnums=(1, 2, 3, 4, 5, 6, 7))
-        elif fb:
-            dcfg = self.draft_cfg
-
-            def admit_cached_draft(params, cache, counts, rngs, bias,
-                                   d_tokens, d_positions, dparams, dcache,
-                                   pk, pv, tail_toks, full_toks, count_row,
-                                   aux, samp_pack, bias_rows):
-                out = admit_cached(params, cache, counts, rngs, bias,
-                                   d_tokens, d_positions, pk, pv, tail_toks,
-                                   count_row, aux, samp_pack, bias_rows)
+        def wrapped(*args):
+            # Positional assembly mirrors _dispatch_admit_cached: [7 state]
+            # [d_gstate?] [dparams, dcache?] [pk, pv] [tail, full, aux,
+            # samp] [bias_rows?] [dfa 4?].
+            i = 7
+            params, cache, counts, rngs, bias, d_tokens, d_positions = args[:7]
+            d_gstate = None
+            if with_dfa:
+                d_gstate = args[i]
+                i += 1
+            dparams = dcache = None
+            if draft:
+                dparams, dcache = args[i: i + 2]
+                i += 2
+            pk, pv, tail_toks, full_toks, aux, samp_pack = args[i: i + 6]
+            i += 6
+            bias_rows = None
+            if has_bias:
+                bias_rows = args[i]
+                i += 1
+            gmask0 = gtrans = tok_cls = ginit = None
+            if with_dfa:
+                gmask0, gtrans, tok_cls, ginit = args[i: i + 4]
+                i += 4
+            out = admit_cached(params, cache, counts, rngs, bias, d_tokens,
+                               d_positions, pk, pv, tail_toks, full_toks,
+                               aux, samp_pack, bias_rows=bias_rows,
+                               gmask0=gmask0, gtrans=gtrans, tok_cls=tok_cls,
+                               ginit=ginit, d_gstate=d_gstate)
+            if draft:
                 flen = aux[0:1] + aux[3:4]  # tail + prefix = full prompt
                 _, dks, dvs = llama.prefill(dcfg, dparams, full_toks, flen,
                                             ep=self.plan.ep)
                 dcache = llama.write_prefill_to_cache(
                     dcache, dks[:, 0:1], dvs[:, 0:1], aux[1]
                 )
-                return out + (dcache,)
+                out = out + (dcache,)
+            return out
 
-            fn = jax.jit(admit_cached_draft,
-                         donate_argnums=(1, 2, 3, 4, 5, 6, 8))
-        else:
-            fn = jax.jit(admit_cached, donate_argnums=(1, 2, 3, 4, 5, 6))
+        donate = (1, 2, 3, 4, 5, 6)
+        if with_dfa:
+            donate = donate + (7,)
+        if draft:
+            donate = donate + (7 + (1 if with_dfa else 0) + 1,)  # dcache
+        fn = jax.jit(wrapped, donate_argnums=donate)
         if not build_only:
             self._admit_cache[key] = fn
         return fn
 
-    def _get_admit_cached_paged(self, npg: int, tb: int, has_bias: bool,
-                                with_topk: bool, with_lp: bool,
-                                with_dfa: bool = False, fb: int = 0,
+    def _get_admit_cached_paged(self, npg: int, tb: int, fbp: int,
+                                has_bias: bool, with_topk: bool,
+                                with_lp: bool, with_dfa: bool = False,
+                                draft: bool = False,
                                 build_only: bool = False):
         """Cached admission against the PAGE POOL: the span's pages are
         mapped read-only into the slot's table (no copy — copy-on-write
@@ -1124,9 +1170,11 @@ class Engine:
         prefilled tail rows scatter into the slot's own fresh pages. Always
         m=1; `aux` is [4] i32 (tail_len, slot, seed, prefix_len) with
         prefix_len page-aligned; `pages` is the [npg] span page list
-        (SCRATCH-padded — rows past prefix_len are masked by prefill_tail)."""
-        key = ("cached-paged", npg, tb, has_bias, with_topk, with_lp,
-               with_dfa, fb)
+        (SCRATCH-padded — rows past prefix_len are masked by prefill_tail).
+        Penalty counts/bias ride as in _get_admit_cached: full-prompt token
+        bucket on device, bias row only when the request has one."""
+        key = ("cached-paged", npg, tb, fbp, has_bias, with_topk, with_lp,
+               with_dfa, draft)
         fn = self._admit_cache.get(key)
         if fn is not None:
             return fn
@@ -1138,7 +1186,7 @@ class Engine:
 
         def admit_cached_paged(params, cache, counts, rngs, bias, d_tokens,
                                d_positions, pages, table_row, tail_toks,
-                               count_row, aux, samp_pack, bias_rows,
+                               full_toks, aux, samp_pack, bias_rows=None,
                                gmask0=None, gtrans=None, tok_cls=None,
                                ginit=None, d_gstate=None):
             tail_len, slot, seed, plen = aux[0], aux[1], aux[2], aux[3]
@@ -1152,7 +1200,9 @@ class Engine:
                 cfg, params, tail_toks, aux[0:1], aux[3:4], pk, pv,
                 ep=self.plan.ep,
             )
-            rows = count_row  # [1, V] i32 — host-side bincount of the prompt
+            fvalid = (jnp.arange(fbp)[None, :] < (plen + tail_len)).astype(jnp.int32)
+            rows = jnp.zeros((1, V), jnp.int32)
+            rows = rows.at[jnp.arange(1)[:, None], full_toks].add(fvalid)
             brows = bias_rows if has_bias else jnp.zeros((1, V), jnp.float32)
             if tok_v < V:
                 from localai_tpu.ops.sampling import NEG_INF
@@ -1184,42 +1234,53 @@ class Engine:
                 out = out + (d_gstate.at[slot].set(gnext[0]),)
             return out
 
-        if with_dfa:
-            def admit_cp_dfa(params, cache, counts, rngs, bias, d_tokens,
-                             d_positions, d_gstate, pages, table_row,
-                             tail_toks, count_row, aux, samp_pack, bias_rows,
-                             gmask0, gtrans, tok_cls, ginit):
-                return admit_cached_paged(params, cache, counts, rngs, bias,
-                                          d_tokens, d_positions, pages,
-                                          table_row, tail_toks, count_row,
-                                          aux, samp_pack, bias_rows,
-                                          gmask0=gmask0, gtrans=gtrans,
-                                          tok_cls=tok_cls, ginit=ginit,
-                                          d_gstate=d_gstate)
+        dcfg = self.draft_cfg
 
-            fn = jax.jit(admit_cp_dfa, donate_argnums=(1, 2, 3, 4, 5, 6, 7))
-        elif fb:
-            dcfg = self.draft_cfg
-
-            def admit_cp_draft(params, cache, counts, rngs, bias, d_tokens,
-                               d_positions, dparams, dcache, pages, table_row,
-                               tail_toks, full_toks, count_row, aux,
-                               samp_pack, bias_rows):
-                out = admit_cached_paged(params, cache, counts, rngs, bias,
-                                         d_tokens, d_positions, pages,
-                                         table_row, tail_toks, count_row,
-                                         aux, samp_pack, bias_rows)
+        def wrapped(*args):
+            # Same positional assembly as _get_admit_cached, with the span
+            # operands (pages, table_row) in place of (pk, pv).
+            i = 7
+            params, cache, counts, rngs, bias, d_tokens, d_positions = args[:7]
+            d_gstate = None
+            if with_dfa:
+                d_gstate = args[i]
+                i += 1
+            dparams = dcache = None
+            if draft:
+                dparams, dcache = args[i: i + 2]
+                i += 2
+            pages, table_row, tail_toks, full_toks, aux, samp_pack = args[i: i + 6]
+            i += 6
+            bias_rows = None
+            if has_bias:
+                bias_rows = args[i]
+                i += 1
+            gmask0 = gtrans = tok_cls = ginit = None
+            if with_dfa:
+                gmask0, gtrans, tok_cls, ginit = args[i: i + 4]
+                i += 4
+            out = admit_cached_paged(params, cache, counts, rngs, bias,
+                                     d_tokens, d_positions, pages, table_row,
+                                     tail_toks, full_toks, aux, samp_pack,
+                                     bias_rows=bias_rows, gmask0=gmask0,
+                                     gtrans=gtrans, tok_cls=tok_cls,
+                                     ginit=ginit, d_gstate=d_gstate)
+            if draft:
                 flen = aux[0:1] + aux[3:4]
                 _, dks, dvs = llama.prefill(dcfg, dparams, full_toks, flen,
                                             ep=self.plan.ep)
                 dcache = llama.write_prefill_to_cache(
                     dcache, dks[:, 0:1], dvs[:, 0:1], aux[1]
                 )
-                return out + (dcache,)
+                out = out + (dcache,)
+            return out
 
-            fn = jax.jit(admit_cp_draft, donate_argnums=(1, 2, 3, 4, 5, 6, 8))
-        else:
-            fn = jax.jit(admit_cached_paged, donate_argnums=(1, 2, 3, 4, 5, 6))
+        donate = (1, 2, 3, 4, 5, 6)
+        if with_dfa:
+            donate = donate + (7,)
+        if draft:
+            donate = donate + (7 + (1 if with_dfa else 0) + 1,)  # dcache
+        fn = jax.jit(wrapped, donate_argnums=donate)
         if not build_only:
             self._admit_cache[key] = fn
         return fn
@@ -1417,9 +1478,17 @@ class Engine:
             if key in self._admit_cache or key in self._admit_compiling:
                 return
             self._admit_compiling.add(key)
-        avals = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), full_args
-        )
+
+        def aval(x):
+            # Shardings must ride into the AOT avals: params/cache are
+            # device_put with NamedShardings on multi-device plans, and an
+            # executable compiled for default placement raises an input-
+            # sharding mismatch on its first real call (ADVICE r5 medium).
+            return jax.ShapeDtypeStruct(
+                np.shape(x), x.dtype, sharding=getattr(x, "sharding", None)
+            )
+
+        avals = jax.tree.map(aval, full_args)
 
         def work():
             try:
@@ -1427,7 +1496,8 @@ class Engine:
                     fn = self._get_admit_cached(*key[1:], build_only=True)
                 else:
                     fn = self._get_admit_cached_paged(*key[1:], build_only=True)
-                compiled = fn.lower(*avals).compile()
+                with self.mesh:
+                    compiled = fn.lower(*avals).compile()
                 with self._admit_compile_lock:
                     self._admit_cache.setdefault(key, compiled)
             except Exception:  # noqa: BLE001 — hits keep falling back
@@ -1458,7 +1528,7 @@ class Engine:
             # both gate on _cached_admit_ok); direct callers get the same
             # full-admission answer.
             return "full"
-        fb = self._bucket_for(len(ids)) if draft else 0
+        fbp = self._bucket_for(len(ids))  # full-prompt bucket (count row/draft)
         paged_alloc: Optional[np.ndarray] = None
         if self._paged:
             # The entry must still be live (pressure eviction may have
@@ -1477,9 +1547,8 @@ class Engine:
                 return False  # pool pressure — full admission will backpressure
         tail_toks = np.zeros((1, tb), np.int32)
         tail_toks[0, : len(tail)] = tail
-        counts = np.bincount(
-            np.asarray(ids, np.int32), minlength=V
-        )[:V].astype(np.int32)[None]
+        full_toks = np.zeros((1, fbp), np.int32)
+        full_toks[0, : len(ids)] = ids
         aux = np.zeros((4,), np.int32)
         aux[0] = len(tail)
         aux[1] = slot_idx
@@ -1492,11 +1561,6 @@ class Engine:
         for fi, kf in enumerate(_SAMPLING_FIELDS):
             samp_pack[fi, 0] = getattr(request, kf)
         has_bias = bool(request.logit_bias)
-        bias_rows = np.zeros((1, V), np.float32)
-        if has_bias:
-            for tid, bval in request.logit_bias.items():
-                if 0 <= int(tid) < V:
-                    bias_rows[0, int(tid)] = bval
         with_dfa = self._dfa_mode_of(dfa_tables)
         with_topk = request.grammar is not None and not with_dfa
         with_lp = request.logprobs > 0
@@ -1505,28 +1569,27 @@ class Engine:
             npg = -(-self._bucket_for(max(match_len, 1)) // page)
             pages_arr = np.full((npg,), self._scratch_page, np.int32)
             pages_arr[: len(shared)] = shared
-            key = ("cached-paged", npg, tb, has_bias, with_topk, with_lp,
-                   with_dfa, fb)
+            key = ("cached-paged", npg, tb, fbp, has_bias, with_topk, with_lp,
+                   with_dfa, draft)
             getter = self._get_admit_cached_paged
             args = (
                 jnp.asarray(pages_arr), jnp.asarray(self.h_ptable[slot_idx]),
-                jnp.asarray(tail_toks),
             )
         else:
-            key = ("cached", entry["pb"], tb, has_bias, with_topk, with_lp,
-                   with_dfa, fb)
+            key = ("cached", entry["pb"], tb, fbp, has_bias, with_topk,
+                   with_lp, with_dfa, draft)
             getter = self._get_admit_cached
-            args = (
-                entry["k"], entry["v"], jnp.asarray(tail_toks),
-            )
-        if fb:
-            full_toks = np.zeros((1, fb), np.int32)
-            full_toks[0, : len(ids)] = ids
-            args = args + (jnp.asarray(full_toks),)
+            args = (entry["k"], entry["v"])
         args = args + (
-            jnp.asarray(counts), jnp.asarray(aux),
-            jnp.asarray(samp_pack), jnp.asarray(bias_rows),
+            jnp.asarray(tail_toks), jnp.asarray(full_toks), jnp.asarray(aux),
+            jnp.asarray(samp_pack),
         )
+        if has_bias:
+            bias_rows = np.zeros((1, V), np.float32)
+            for tid, bval in request.logit_bias.items():
+                if 0 <= int(tid) < V:
+                    bias_rows[0, int(tid)] = bval
+            args = args + (jnp.asarray(bias_rows),)
         if with_dfa:
             host = dfa_tables["host"]
             row = np.unpackbits(
@@ -1534,23 +1597,19 @@ class Engine:
             )[:V].astype(bool)
             gmask0 = np.where(row, 0.0, -1e30).astype(np.float32)[None, :]
             ginit = np.full((1,), host.init_state, np.int32)
-            full_args = (
-                self.params, self.cache, self.counts, self.rngs, self.bias,
-                self.d_tokens, self.d_positions, self.d_gstate, *args,
+            args = args + (
                 jnp.asarray(gmask0), self._dfa_table(dfa_tables, with_dfa),
                 dfa_tables["tok_cls"], jnp.asarray(ginit),
             )
-        elif fb:
-            full_args = (
-                self.params, self.cache, self.counts, self.rngs, self.bias,
-                self.d_tokens, self.d_positions, self.draft_params,
-                self.d_cache, *args,
-            )
-        else:
-            full_args = (
-                self.params, self.cache, self.counts, self.rngs, self.bias,
-                self.d_tokens, self.d_positions, *args,
-            )
+        state = (
+            self.params, self.cache, self.counts, self.rngs, self.bias,
+            self.d_tokens, self.d_positions,
+        )
+        if with_dfa:
+            state = state + (self.d_gstate,)
+        if draft:
+            state = state + (self.draft_params, self.d_cache)
+        full_args = state + args
         if (self.ecfg.prefix_admit_async_compile
                 and key not in self._admit_cache):
             # A prefix hit is an optimization — never worth a multi-second
@@ -1571,6 +1630,20 @@ class Engine:
         except Exception:
             if paged_alloc is not None:
                 self._pages_free(slot_idx)
+            if isinstance(fn, jax.stages.Compiled):
+                # A background-published AOT executable that cannot run
+                # against the live state (it raises on input validation,
+                # before any donation) would fail every future hit of this
+                # shape — evict it and serve THIS request via full admission
+                # instead of erroring forever (ADVICE r5 medium).
+                log.exception(
+                    "published cached-admit executable failed; evicting %s",
+                    key,
+                )
+                with self._admit_compile_lock:
+                    if self._admit_cache.get(key) is fn:
+                        del self._admit_cache[key]
+                return "full"
             raise
         (
             self.cache, self.counts, self.rngs, self.bias,
@@ -1578,7 +1651,7 @@ class Engine:
         ) = out[:9]
         if with_dfa:
             self.d_gstate = out[9]
-        elif fb:
+        elif draft:
             self.d_cache = out[9]
         _host_copy_async(toks)
         # LRU bump + metrics. Identity scan, not `in`: dict == would compare
@@ -1686,7 +1759,7 @@ class Engine:
             pos_chunk = jnp.minimum(pos_base[:, None] + jnp.arange(k + 1)[None, :], S - 1)
             logits_all, cache = llama.decode_chunk(
                 cfg, params, chunk, pos_chunk, cache, ep=self.plan.ep,
-                ptable=ptable,
+                ptable=ptable, paged_impl=self.ecfg.paged_kernel,
             )
 
             # 3. Accept-scan with counts updated token by token, so
@@ -1760,7 +1833,9 @@ class Engine:
 
     def start(self) -> None:
         if self._thread is None:
-            self._thread = threading.Thread(target=self._loop, daemon=True, name="engine-loop")
+            self._thread = threading.Thread(
+                target=self._loop_guard, daemon=True, name="engine-loop"
+            )
             self._thread.start()
         if self._drain_thread is None:
             self._drain_thread = threading.Thread(
@@ -1810,6 +1885,20 @@ class Engine:
             self._drain_q.put(None)
             self._drain_thread.join(timeout=30)
             self._drain_thread = None
+        # No consumer may hang across stop(): the loop is gone, so any
+        # request still holding a slot or sitting in the queue would never
+        # get a terminal event (observed: the manager watchdog's busy-kill
+        # can fire inside the admission gap — cancel_all() sees neither
+        # pending nor slot — then evict the engine, leaving the caller
+        # blocked on the stream forever). Duplicate done events on already-
+        # finished streams are harmless (the consumer stopped reading).
+        for slot in self.slots:
+            if slot is not None:
+                slot.handle._q.put(TokenEvent(kind="done", finish_reason="stop"))
+        with self._pending_lock:
+            pending, self._pending = list(self._pending), deque()
+        for _req, handle in pending:
+            handle._q.put(TokenEvent(kind="done", finish_reason="stop"))
         if self._tok_fp is not None:
             # Release grammar tables prewarm pinned against this engine's
             # tokenizer — they can never hit again after the model swaps.
@@ -1863,8 +1952,13 @@ class Engine:
         if request.grammar is not None and self._tok_strs is None:
             self._token_str(0)  # build the table here, not in the engine loop
         handle = RequestHandle()
+        if self._loop_dead is not None:
+            # The loop thread is gone — nothing will ever serve this request.
+            handle._q.put(TokenEvent(kind="error", error=self._loop_dead))
+            return handle
         with self._pending_lock:
             self._pending.append((request, handle))
+            self._last_submit_t = time.monotonic()
         self._wake.set()
         self.start()
         return handle
@@ -2311,6 +2405,29 @@ class Engine:
             and self.slots[i].request.logprobs > 0
             for i in range(self.ecfg.max_slots)
         )
+
+    def _loop_guard(self) -> None:
+        """Run the engine loop; if it dies of an unexpected exception, fail
+        every live and pending request with an error event instead of
+        leaving their callers blocked on queues forever (BENCH_r05 hung to
+        the harness timeout exactly this way — the loop thread died and
+        every generate() waited on a token that would never come)."""
+        try:
+            self._loop()
+        except BaseException as e:  # noqa: BLE001 — terminal: report and drain
+            log.exception("engine loop died; failing all live requests")
+            err = f"engine loop died: {type(e).__name__}: {e}"
+            self._loop_dead = err
+            for i in range(self.ecfg.max_slots):
+                slot = self.slots[i]
+                if slot is not None:
+                    slot.handle._q.put(TokenEvent(kind="error", error=err))
+            with self._pending_lock:
+                pending, self._pending = list(self._pending), deque()
+            for _req, handle in pending:
+                handle._q.put(TokenEvent(kind="error", error=err))
+            # No re-raise: the failure is fully reported (log + error events);
+            # an unhandled thread exception would only add noise.
 
     def _loop(self) -> None:
         trace = os.environ.get("LOCALAI_ENGINE_TRACE", "0") == "1"
@@ -3152,10 +3269,18 @@ class Engine:
             if new.endswith("�"):
                 hold = 1
             if r.stop:
+                # Trailing replacement chars may be INCOMPLETE sequences the
+                # next event re-renders — scan stop prefixes against the
+                # stable part only, or a stop landing just before the
+                # pending bytes slips out one event early (observed: held
+                # 0xDE rendered '\x05�', the '\x05' flushed, and the stop
+                # '\x05ޠ' was found only after emitted_len passed its cut).
+                stable = new.rstrip("�")
+                pend = len(new) - len(stable)
                 for s in r.stop:
-                    for k in range(min(len(s) - 1, len(new)), 0, -1):
-                        if new.endswith(s[:k]):
-                            hold = max(hold, k)
+                    for k in range(min(len(s) - 1, len(stable)), 0, -1):
+                        if stable.endswith(s[:k]):
+                            hold = max(hold, pend + k)
                             break
             if hold:
                 new = new[: len(new) - hold]

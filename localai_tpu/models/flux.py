@@ -521,8 +521,13 @@ def _cfg_json(path: str) -> dict:
         return json.load(f)
 
 
-def load_flux_pipeline(ckpt_dir: str, dtype=jnp.float32):
-    """(FluxPipelineConfig, params, (clip_tokenizer, t5_tokenizer))."""
+def load_flux_pipeline(ckpt_dir: str, dtype=jnp.bfloat16):
+    """(FluxPipelineConfig, params, (clip_tokenizer, t5_tokenizer)).
+
+    bfloat16 by default: Flux.1-dev is a 12B MMDiT + 4.8B T5-XXL — fp32 is
+    ~68 GB of weights and can never fit single-chip HBM, while the module's
+    compute is bfloat16-friendly throughout. Pass jnp.float32 explicitly
+    for full-precision parity work (the reference-comparison tests do)."""
     tc = _cfg_json(os.path.join(ckpt_dir, "text_encoder", "config.json"))
     t5c = _cfg_json(os.path.join(ckpt_dir, "text_encoder_2", "config.json"))
     xc = _cfg_json(os.path.join(ckpt_dir, "transformer", "config.json"))

@@ -1131,7 +1131,13 @@ def generate(
                 r0 = (lam_p - lam_t) / h
                 d1 = (m_prev - m_t) / jnp.where(cnt > 0, r0, 1.0)
                 x2 = x1 - a_n * phi * 0.5 * d1
-                xn = jnp.where(cnt > 0, x2, x1)
+                # lower_order_final (diffusers UniPCMultistepScheduler): on
+                # the LAST step t_n < 0 clamps sigma to 1e-10, so h ≈ 20+
+                # while r0 = (lam_p - lam_t)/h is tiny — the D1 term then
+                # amplifies m_prev - m_t ~25x and corrupts the output
+                # latent. Order drops to 1 whenever the target time leaves
+                # the schedule.
+                xn = jnp.where((cnt > 0) & (t_n >= 0), x2, x1)
                 return (xn.astype(xc.dtype), xcf.astype(xc.dtype), m_t, t,
                         cnt + 1), None
 

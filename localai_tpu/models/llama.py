@@ -872,6 +872,7 @@ def decode_step_windowed(
     ep: int = 1,
     mesh=None,  # Mesh with sp>1 → the cache's sequence axis is sp-sharded
     ptable=None,  # [B, MP] int32 → `cache` is a page pool (paged KV mode)
+    paged_impl: str = "auto",  # paged attention kernel: auto|pallas|xla
     rope_delta=None,  # [B] int32 — m-rope: rope at positions+delta (cache
     # rows stay at positions). After a Qwen2-VL image prefill the 3D
     # position streams are all equal and offset from the row index by a
@@ -909,6 +910,7 @@ def decode_step_windowed(
 
                 attn = decode_attention_windowed_paged(
                     q_eff, kc, kc, ptable, lk, lk, rows, rows, positions, step,
+                    impl=paged_impl,
                 )
             else:
                 attn = decode_attention_windowed(
@@ -927,7 +929,7 @@ def decode_step_windowed(
             attn = decode_attention_windowed_paged(
                 q, kc, vc, ptable, lk, lv, k, v, positions, step,
                 softcap=cfg.attn_softcap, window=cfg.sliding_window,
-                sliding=_layer_sliding(cfg, li),
+                sliding=_layer_sliding(cfg, li), impl=paged_impl,
             )
         elif use_sp:
             from localai_tpu.ops.attention import decode_attention_windowed_sp
@@ -986,6 +988,7 @@ def decode_chunk(
     cache: KVCache,
     ep: int = 1,
     ptable=None,  # [B, MP] int32 → `cache` is a page pool (paged KV mode)
+    paged_impl: str = "auto",  # paged attention kernel: auto|pallas|xla
 ):
     """Multi-token decode: write T new k/v per slot and return logits for all
     T positions — the verify pass of speculative decoding (the reference
@@ -1023,11 +1026,12 @@ def decode_chunk(
             if ptable is not None:
                 from localai_tpu.ops.attention import (
                     _merge_partials_mq,
-                    _paged_cache_partials_mq,
+                    paged_partials_mq,
                 )
 
-                acc, m, l = _paged_cache_partials_mq(
-                    q_eff, kc, kc, ptable, positions[:, 0], q_pos=positions
+                acc, m, l = paged_partials_mq(
+                    q_eff, kc, kc, ptable, positions[:, 0], q_pos=positions,
+                    impl=paged_impl,
                 )
                 attn = _merge_partials_mq(
                     q_eff, acc, m, l, rows, rows,  # [B, T, 1, De] = [B, E, K, D]
@@ -1064,13 +1068,13 @@ def decode_chunk(
         if ptable is not None:
             from localai_tpu.ops.attention import (
                 _merge_partials_mq,
-                _paged_cache_partials_mq,
+                paged_partials_mq,
             )
 
-            acc, m, l = _paged_cache_partials_mq(
+            acc, m, l = paged_partials_mq(
                 q, kc, vc, ptable, positions[:, 0],
                 softcap=cfg.attn_softcap, window=cfg.sliding_window,
-                sliding=sliding, q_pos=positions,
+                sliding=sliding, q_pos=positions, impl=paged_impl,
             )
             attn = _merge_partials_mq(
                 q, acc, m, l, k, v,

@@ -270,7 +270,9 @@ def _sp_cache_partials(q, k_cache, v_cache, limits, mesh,
     # replicated operand (closure capture of tracers is not valid under
     # shard_map).
     sl_in = sliding if sliding is not None else jnp.zeros((), bool)
-    fn = jax.shard_map(
+    from localai_tpu.parallel.mesh import shard_map as _shard_map
+
+    fn = _shard_map(
         local,
         mesh=mesh,
         in_specs=(
@@ -490,6 +492,50 @@ def _paged_cache_partials(q, k_pool, v_pool, table, limits,
     return acc, m, l
 
 
+def paged_partials(q, k_pool, v_pool, table, limits, softcap: float = 0.0,
+                   window: int = 0, sliding=None, q_pos=None,
+                   impl: str = "auto"):
+    """Paged online-softmax partials, dispatched: the fused Pallas ragged
+    paged-attention kernel (ops/paged_flash — pages stream HBM→VMEM once,
+    walk bounded per slot) or the XLA gather walk below (reference path and
+    numeric oracle). Off-TPU the kernel runs in interpret mode, so CPU tier-1
+    tests exercise the same kernel code that compiles for TPU."""
+    from localai_tpu.ops.paged_flash import paged_decode_partials, use_pallas
+
+    if use_pallas(impl):
+        return paged_decode_partials(
+            q, k_pool, v_pool, table, limits, softcap=softcap, window=window,
+            sliding=sliding, q_pos=q_pos,
+            interpret=jax.default_backend() != "tpu",
+        )
+    return _paged_cache_partials(
+        q, k_pool, v_pool, table, limits,
+        softcap=softcap, window=window, sliding=sliding, q_pos=q_pos,
+    )
+
+
+def paged_partials_mq(q, k_pool, v_pool, table, limits, softcap: float = 0.0,
+                      window: int = 0, sliding=None, q_pos=None,
+                      impl: str = "auto"):
+    """Multi-query `paged_partials` (speculative verify chunk) — same
+    dispatch."""
+    from localai_tpu.ops.paged_flash import (
+        paged_decode_partials_mq,
+        use_pallas,
+    )
+
+    if use_pallas(impl):
+        return paged_decode_partials_mq(
+            q, k_pool, v_pool, table, limits, softcap=softcap, window=window,
+            sliding=sliding, q_pos=q_pos,
+            interpret=jax.default_backend() != "tpu",
+        )
+    return _paged_cache_partials_mq(
+        q, k_pool, v_pool, table, limits,
+        softcap=softcap, window=window, sliding=sliding, q_pos=q_pos,
+    )
+
+
 def decode_attention_windowed_paged(
     q: jnp.ndarray,  # [B, H, D]
     k_pool: jnp.ndarray,  # [P, page, K, D] shared page pool
@@ -504,14 +550,16 @@ def decode_attention_windowed_paged(
     softcap: float = 0.0,
     window: int = 0,
     sliding=None,
+    impl: str = "auto",
 ) -> jnp.ndarray:
     """`decode_attention_windowed` over a paged pool: paged partials for
     rows [0, block_start), dense merge of the (tiny) local window + current
     token."""
     n = k_local.shape[1]
-    acc, m, l = _paged_cache_partials(
+    acc, m, l = paged_partials(
         q, k_pool, v_pool, table, positions - step,
         softcap=softcap, window=window, sliding=sliding, q_pos=positions,
+        impl=impl,
     )
     # f32 concat: the block-local window may live in the cache's storage
     # dtype (fp8 KV) while the current token is model-dtype.

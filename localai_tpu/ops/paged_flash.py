@@ -1,0 +1,307 @@
+"""Fused ragged paged-attention decode kernel (Pallas, TPU).
+
+The paged decode hot op. The XLA reference path
+(ops/attention._paged_cache_partials) gathers page tiles into HBM scratch
+each fori_loop step — `k_pool[pids]` materializes a [B, CH·page, K, D]
+buffer per chunk, so every live KV byte is read from HBM, written back to
+HBM, and read again by the einsum (3x the traffic that the math needs), and
+the gather itself cannot overlap the matmul. BENCH_r04 put paged decode at
+0.73x of the dense cache for exactly this reason.
+
+This kernel walks each slot's page table IN-KERNEL ("Ragged Paged
+Attention", PAPERS.md): the pool stays in HBM (memory_space=ANY), and the
+kernel streams the listed pages through a double-buffered VMEM scratch with
+explicit async DMAs — page j+1 is in flight while page j is scored against
+the online-softmax running state. Each live KV byte crosses HBM→VMEM exactly
+once, the walk stops at the slot's OWN live-prefix bound (ragged, not the
+batch max), and idle slots (limits == 0) cost nothing.
+
+Shapes (matching the XLA reference):
+- q rows     [B, K, QR, Dk] f32, 1/sqrt(D) pre-applied; QR = G query rows
+  per kv head (G·T for the multi-query verify chunk).
+- k/v pool   [P, page, K, Dk|Dv] in the cache storage dtype (bf16/fp8 —
+  cast to f32 on read, same contract as every other cache reader).
+- table      [B, MP] int32 page ids (scalar-prefetch: the DMA descriptors
+  are computed from it before the body runs).
+- limits     [B] int32 — rows with global index >= limits[b] are masked;
+  the page walk is bounded by ceil(limits[b]/page).
+- qpos       [B, QR] int32 query positions (sliding-window distance).
+- sliding    [1] int32 — traced per-layer flag (gemma-2 alternates
+  sliding/global layers inside a scanned stack, so it cannot be static).
+
+Returns online-softmax partials (acc, m, l) — f32, exactly the reference's
+contract — which the existing _merge_partials/_merge_partials_mq fold with
+the block-local window and current token. Keeping the merge in XLA keeps
+ONE numeric tail for both paths, so the reference doubles as the kernel's
+oracle (tests/test_paged_flash.py runs this kernel in interpret mode on
+CPU against it).
+
+The m/l outputs are padded to 128 lanes (STAT_LANES) and sliced by the
+wrapper: a 1-wide lane dimension is a legal VMEM scratch shape but a
+pathological output tiling on real hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+STAT_LANES = 128
+
+
+def use_pallas(impl: str = "auto") -> bool:
+    """Resolve the paged-attention implementation choice.
+
+    impl: "auto" (Pallas on TPU, XLA reference elsewhere), "pallas", or
+    "xla". The LOCALAI_PAGED_KERNEL env var overrides — same escape hatch
+    as LOCALAI_FLASH for the prefill kernel. "pallas" off-TPU runs in
+    interpret mode (slow; tests only).
+    """
+    impl = os.environ.get("LOCALAI_PAGED_KERNEL", "") or impl or "auto"
+    if impl == "auto":
+        return jax.default_backend() == "tpu"
+    if impl not in ("pallas", "xla"):
+        raise ValueError(f"paged kernel impl {impl!r}: use auto|pallas|xla")
+    return impl == "pallas"
+
+
+def _ragged_paged_kernel(
+    table_ref,  # scalar-prefetch [B, MP] i32
+    limits_ref,  # scalar-prefetch [B] i32
+    sliding_ref,  # scalar-prefetch [1] i32
+    q_ref,  # [1, K, QR, Dk] f32 (scale applied)
+    qpos_ref,  # [1, QR] i32
+    k_hbm,  # [P, page, K, Dk] pool dtype, memory_space=ANY
+    v_hbm,  # [P, page, K, Dv]
+    acc_ref,  # out [1, K, QR, Dv] f32
+    m_ref,  # out [1, K, QR, STAT_LANES] f32
+    l_ref,  # out [1, K, QR, STAT_LANES] f32
+    kbuf,  # VMEM scratch [2, page, K, Dk] pool dtype
+    vbuf,  # VMEM scratch [2, page, K, Dv]
+    acc_s,  # VMEM scratch [K, QR, Dv] f32
+    m_s,  # VMEM scratch [K, QR, 1] f32
+    l_s,  # VMEM scratch [K, QR, 1] f32
+    sem,  # DMA semaphores [2, 2]
+    *,
+    page: int,
+    num_kv: int,
+    softcap: float,
+    window: int,
+):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b = pl.program_id(0)
+    QR = q_ref.shape[2]
+    lim = limits_ref[b]
+    # This slot's own page count (ragged), clamped to the table width so a
+    # bad limit can never index the table out of bounds.
+    np_live = jnp.minimum((lim + page - 1) // page, table_ref.shape[1])
+
+    def dma_k(slot, j):
+        return pltpu.make_async_copy(
+            k_hbm.at[table_ref[b, j]], kbuf.at[slot], sem.at[slot, 0]
+        )
+
+    def dma_v(slot, j):
+        return pltpu.make_async_copy(
+            v_hbm.at[table_ref[b, j]], vbuf.at[slot], sem.at[slot, 1]
+        )
+
+    acc_s[...] = jnp.zeros_like(acc_s)
+    m_s[...] = jnp.full_like(m_s, NEG_INF)
+    l_s[...] = jnp.zeros_like(l_s)
+
+    @pl.when(np_live > 0)
+    def _warmup():
+        dma_k(0, 0).start()
+        dma_v(0, 0).start()
+
+    def body(j, carry):
+        slot = j % 2
+
+        @pl.when(j + 1 < np_live)
+        def _prefetch():  # next page rides the wire while this one computes
+            dma_k((j + 1) % 2, j + 1).start()
+            dma_v((j + 1) % 2, j + 1).start()
+
+        dma_k(slot, j).wait()
+        dma_v(slot, j).wait()
+
+        # Global row indices covered by table column j.
+        gpos = j * page + jax.lax.broadcasted_iota(jnp.int32, (QR, page), 1)
+        valid = gpos < lim
+        if window:
+            qp = qpos_ref[0]  # [QR]
+            sl = sliding_ref[0] > 0
+            dist = qp[:, None] - gpos
+            valid = valid & (~sl | (dist < window))
+
+        for kh in range(num_kv):  # static unroll — one MXU pass per kv head
+            q = q_ref[0, kh]  # [QR, Dk]
+            kp = kbuf[slot, :, kh, :].astype(jnp.float32)  # [page, Dk]
+            s = jax.lax.dot_general(
+                q, kp, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [QR, page]
+            if softcap:
+                s = softcap * jnp.tanh(s / softcap)
+            s = jnp.where(valid, s, NEG_INF)
+            m_prev = m_s[kh]  # [QR, 1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(jnp.maximum(m_prev - m_new, -80.0))
+            p = jnp.exp(s - m_new)
+            p = jnp.where(valid, p, 0.0)
+            l_s[kh] = l_s[kh] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            vp = vbuf[slot, :, kh, :].astype(jnp.float32)  # [page, Dv]
+            acc_s[kh] = acc_s[kh] * alpha + jax.lax.dot_general(
+                p, vp, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_s[kh] = m_new
+        return carry
+
+    jax.lax.fori_loop(0, np_live, body, 0)
+
+    acc_ref[0] = acc_s[...]
+    m_ref[0] = jnp.broadcast_to(m_s[...], m_ref.shape[1:])
+    l_ref[0] = jnp.broadcast_to(l_s[...], l_ref.shape[1:])
+
+
+def _paged_partials_rows(
+    qr: jnp.ndarray,  # [B, K, QR, Dk] f32, scale applied
+    qpos_rows: jnp.ndarray,  # [B, QR] i32
+    k_pool: jnp.ndarray,  # [P, page, K, Dk]
+    v_pool: jnp.ndarray,  # [P, page, K, Dv]
+    table: jnp.ndarray,  # [B, MP] i32
+    limits: jnp.ndarray,  # [B] i32
+    softcap: float,
+    window: int,
+    sliding,
+    interpret: bool,
+):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, K, QR, Dk = qr.shape
+    page = k_pool.shape[1]
+    Dv = v_pool.shape[3]
+    sl_arr = jnp.asarray(
+        sliding if sliding is not None else False
+    ).reshape(1).astype(jnp.int32)
+    kernel = functools.partial(
+        _ragged_paged_kernel, page=page, num_kv=K,
+        softcap=float(softcap), window=int(window),
+    )
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec((1, K, QR, Dk), lambda b, *_: (b, 0, 0, 0)),
+                pl.BlockSpec((1, QR), lambda b, *_: (b, 0)),
+                pl.BlockSpec(memory_space=pltpu.ANY),  # pool stays in HBM
+                pl.BlockSpec(memory_space=pltpu.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, K, QR, Dv), lambda b, *_: (b, 0, 0, 0)),
+                pl.BlockSpec((1, K, QR, STAT_LANES), lambda b, *_: (b, 0, 0, 0)),
+                pl.BlockSpec((1, K, QR, STAT_LANES), lambda b, *_: (b, 0, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((2, page, K, Dk), k_pool.dtype),
+                pltpu.VMEM((2, page, K, Dv), v_pool.dtype),
+                pltpu.VMEM((K, QR, Dv), jnp.float32),
+                pltpu.VMEM((K, QR, 1), jnp.float32),
+                pltpu.VMEM((K, QR, 1), jnp.float32),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, K, QR, Dv), jnp.float32),
+            jax.ShapeDtypeStruct((B, K, QR, STAT_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((B, K, QR, STAT_LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        table.astype(jnp.int32), limits.astype(jnp.int32), sl_arr,
+        qr, qpos_rows.astype(jnp.int32), k_pool, v_pool,
+    )
+    return acc, m[..., :1], l[..., :1]
+
+
+def paged_decode_partials(
+    q: jnp.ndarray,  # [B, H, D]
+    k_pool: jnp.ndarray,  # [P, page, K, Dk]
+    v_pool: jnp.ndarray,  # [P, page, K, Dv]
+    table: jnp.ndarray,  # [B, MP] int32
+    limits: jnp.ndarray,  # [B] int32
+    softcap: float = 0.0,
+    window: int = 0,
+    sliding=None,
+    q_pos=None,
+    interpret: bool = False,
+):
+    """Drop-in for attention._paged_cache_partials: returns
+    (acc [B, K, G, Dv], m [B, K, G, 1], l [B, K, G, 1]) f32, scale applied."""
+    B, H, D = q.shape
+    K = k_pool.shape[2]
+    G = H // K
+    scale = 1.0 / (D**0.5)
+    if q_pos is None:
+        q_pos = limits
+    if sliding is None:
+        window = 0
+    qr = (q.astype(jnp.float32) * scale).reshape(B, K, G, D)
+    qpos_rows = jnp.broadcast_to(q_pos[:, None], (B, G))
+    return _paged_partials_rows(
+        qr, qpos_rows, k_pool, v_pool, table, limits,
+        softcap, window, sliding, interpret,
+    )
+
+
+def paged_decode_partials_mq(
+    q: jnp.ndarray,  # [B, T, H, D]
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    table: jnp.ndarray,
+    limits: jnp.ndarray,
+    softcap: float = 0.0,
+    window: int = 0,
+    sliding=None,
+    q_pos=None,  # [B, T]
+    interpret: bool = False,
+):
+    """Drop-in for attention._paged_cache_partials_mq (speculative verify
+    chunk): one page walk shared by all T queries. Returns
+    (acc [B, K, G, T, Dv], m [B, K, G, T, 1], l [B, K, G, T, 1])."""
+    B, T, H, D = q.shape
+    K = k_pool.shape[2]
+    G = H // K
+    Dv = v_pool.shape[3]
+    scale = 1.0 / (D**0.5)
+    if q_pos is None:
+        q_pos = jnp.broadcast_to(limits[:, None], (B, T))
+    if sliding is None:
+        window = 0
+    # Row r = t*G + g — all T queries fold into one kernel launch.
+    qr = (
+        (q.astype(jnp.float32) * scale)
+        .reshape(B, T, K, G, D)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(B, K, T * G, D)
+    )
+    qpos_rows = jnp.repeat(q_pos, G, axis=1)  # [B, T*G]
+    acc, m, l = _paged_partials_rows(
+        qr, qpos_rows, k_pool, v_pool, table, limits,
+        softcap, window, sliding, interpret,
+    )
+    acc = acc.reshape(B, K, T, G, Dv).transpose(0, 1, 3, 2, 4)
+    m = m.reshape(B, K, T, G, 1).transpose(0, 1, 3, 2, 4)
+    l = l.reshape(B, K, T, G, 1).transpose(0, 1, 3, 2, 4)
+    return acc, m, l

@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from localai_tpu.parallel.mesh import shard_map as _shard_map
+
 NEG_INF = -1e30
 
 
@@ -98,7 +100,7 @@ def ring_prefill_attention(
     n = mesh.shape[axis]
     seq_spec = P(None, axis, None, None)
     if sliding is None:
-        fn = jax.shard_map(
+        fn = _shard_map(
             partial(_local_ring, axis=axis, n_shards=n, softcap=softcap),
             mesh=mesh,
             in_specs=(seq_spec, seq_spec, seq_spec, P(None)),
@@ -108,7 +110,7 @@ def ring_prefill_attention(
         return fn(q, k, v, lengths)
     # `sliding` is a traced bool scalar (layer alternation) — it rides as a
     # replicated operand so one shard_map serves both layer kinds.
-    fn = jax.shard_map(
+    fn = _shard_map(
         lambda q_, k_, v_, l_, sl_: _local_ring(
             q_, k_, v_, l_, axis=axis, n_shards=n, softcap=softcap,
             window=window, sliding=sl_,

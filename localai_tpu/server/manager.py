@@ -502,6 +502,7 @@ class ModelManager:
                 max_slots=cfg.max_slots, max_seq=cfg.context_size,
                 kv_pages=cfg.kv_pages, kv_page_size=cfg.kv_page_size,
                 kv_cache_dtype=cfg.kv_cache_dtype,
+                paged_kernel=cfg.paged_kernel,
             ),
             draft_cfg=draft_arch,
             draft_params=draft_params,
@@ -816,7 +817,24 @@ class ModelManager:
                         f"model {cfg.name!r}: lora_adapters target SD/SDXL "
                         "checkpoints (kohya format); Flux LoRA is unsupported"
                     )
-                fcfg, fparams, ftoks = FX.load_flux_pipeline(ckpt_dir)
+                # bf16 by default (fp32 Flux.1-dev is ~68 GB — beyond any
+                # single chip); the model YAML may override via
+                # `options.dtype` like the LLM loader's quantization knob.
+                import jax.numpy as _jnp
+
+                dtypes = {
+                    "bfloat16": _jnp.bfloat16, "bf16": _jnp.bfloat16,
+                    "float32": _jnp.float32, "fp32": _jnp.float32,
+                }
+                opt = str(cfg.options.get("dtype", "bfloat16")).lower()
+                if opt not in dtypes:
+                    raise ValueError(
+                        f"model {cfg.name!r}: options.dtype {opt!r} — use "
+                        "bfloat16 or float32"
+                    )
+                fcfg, fparams, ftoks = FX.load_flux_pipeline(
+                    ckpt_dir, dtype=dtypes[opt]
+                )
                 return LoadedModel(cfg, FluxEngine(fcfg, fparams, ftoks), None)
             if LD.is_diffusers_dir(ckpt_dir):
                 # Real published checkpoint (SD-1.5-class diffusers layout) —

@@ -307,3 +307,68 @@ def test_kv_windowed_blocks_bit_match_full():
     assert any(k[4] == 256 for k in keys_win), "windowed program never ran"
     assert text_win == text_full
     assert ev_win.completion_tokens == ev_full.completion_tokens == 80
+
+
+def test_idle_coalesce_admission_keeps_loop_alive():
+    """Regression (BENCH_r05 rc=124): the idle-engine submit-burst coalesce
+    path reads _admit_hold_start/_last_submit_t on the FIRST admission of a
+    fresh engine (one pending request, more free slots) — unset attributes
+    killed the loop thread with AttributeError and every caller hung. The
+    request must complete AND the loop thread must survive it."""
+    cfg = get_arch("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    eng = Engine(
+        cfg, params, ByteTokenizer(cfg.vocab_size),
+        engine_cfg=EngineConfig(max_slots=4, max_seq=128,
+                                min_prefill_bucket=16, admit_coalesce_ms=6.0),
+    )
+    try:
+        assert eng.ecfg.admit_coalesce_ms > 0
+        text, ev = eng.generate([1, 2, 3], max_new_tokens=4, ignore_eos=True)
+        assert ev.kind == "done"
+        assert eng._thread is not None and eng._thread.is_alive(), (
+            "engine loop thread died during the idle-coalesce admission"
+        )
+    finally:
+        eng.stop()
+
+
+def test_loop_death_fails_requests_instead_of_hanging():
+    """If the engine loop dies of an unexpected exception, callers must get
+    an error event (not block forever on the token queue)."""
+    cfg = get_arch("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    eng = Engine(
+        cfg, params, ByteTokenizer(cfg.vocab_size),
+        engine_cfg=EngineConfig(max_slots=2, max_seq=128,
+                                min_prefill_bucket=16),
+    )
+    try:
+        eng._admit_pending = None  # simulate an unexpected loop crash
+        handle = eng.submit(GenRequest(prompt_ids=[1, 2, 3], max_new_tokens=4))
+        events = list(handle)
+        assert events and events[-1].kind == "error"
+        assert "engine loop died" in events[-1].error
+    finally:
+        eng.stop()
+
+
+def test_stop_terminates_live_streams():
+    """Regression: the manager watchdog's busy-kill can fire inside the
+    admission gap (cancel_all sees neither pending nor slot) and then evict
+    the engine — stop() must post terminal events to every live consumer so
+    nobody blocks on the stream forever (test_manager's wedged-kill test
+    hung tier-1 exactly this way)."""
+    cfg = get_arch("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    eng = Engine(
+        cfg, params, ByteTokenizer(cfg.vocab_size),
+        engine_cfg=EngineConfig(max_slots=2, max_seq=128,
+                                min_prefill_bucket=16),
+    )
+    handle = eng.submit(GenRequest(
+        prompt_ids=[1, 2, 3], max_new_tokens=10_000, ignore_eos=True,
+    ))
+    eng.stop()  # mid-admission or mid-decode — either way the stream ends
+    events = list(handle)
+    assert events and events[-1].kind in ("done", "error")

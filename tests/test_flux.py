@@ -235,7 +235,9 @@ def flux_dir(tmp_path_factory):
 
 @pytest.fixture(scope="module")
 def pipeline(flux_dir):
-    return fx.load_flux_pipeline(flux_dir)
+    # Explicit fp32: these are bit-level parity tests against transformers/
+    # diffusers; the serving default is bfloat16 (see load_flux_pipeline).
+    return fx.load_flux_pipeline(flux_dir, dtype=jnp.float32)
 
 
 # --------------------------------------------------------------------------- #
@@ -526,6 +528,10 @@ def test_flux_engine_and_images_api(flux_dir, tmp_path):
         from localai_tpu.engine.image_engine import FluxEngine
 
         assert isinstance(lm.engine, FluxEngine)
+        # Serving default is bf16 (ADVICE r5 low: fp32 Flux.1-dev is ~68 GB
+        # and can never fit single-chip HBM).
+        leaves = jax.tree.leaves(lm.engine.params["transformer"])
+        assert all(a.dtype == jnp.bfloat16 for a in leaves)
         imgs = lm.engine.generate("a cat", n=1, steps=2, seed=5,
                                   size=(16, 16))
         assert imgs[0].shape == (16, 16, 3)

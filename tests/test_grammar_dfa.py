@@ -186,7 +186,12 @@ def engine():
     cfg = get_arch("tiny")
     params = init_params(cfg, jax.random.key(0))
     eng = Engine(cfg, params, ByteTokenizer(cfg.vocab_size),
-                 engine_cfg=EngineConfig(max_slots=4, max_seq=256))
+                 engine_cfg=EngineConfig(max_slots=4, max_seq=256,
+                                         # deterministic prefix hits — the
+                                         # async default serves a shape's
+                                         # FIRST hit via full admission
+                                         # (documented test/bench mode)
+                                         prefix_admit_async_compile=False))
     eng.start()
     # Uncached schemas build off-thread (their first request host-walks);
     # prewarm the ones these tests assert DFA engagement on.

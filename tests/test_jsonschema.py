@@ -209,6 +209,12 @@ def test_engine_constrained_decode_with_sampling(engine):
         prompt_ids=[80, 81], max_new_tokens=64, temperature=0.9, seed=7,
         grammar=GrammarConstraint(schema),
     )).result()
-    parsed = json.loads(text)
-    assert isinstance(parsed, list) and 1 <= len(parsed) <= 3
-    assert all(isinstance(x, int) for x in parsed)
+    if final.finish_reason == "length":
+        # The grammar cannot force integers to terminate — a sampled run may
+        # extend digits past the token budget. Every emitted char must still
+        # be a valid prefix of schema-conforming JSON.
+        assert JsonSchemaMachine(schema).feed_text(text), text
+    else:
+        parsed = json.loads(text)
+        assert isinstance(parsed, list) and 1 <= len(parsed) <= 3
+        assert all(isinstance(x, int) for x in parsed)

@@ -81,7 +81,11 @@ def test_fp8_composes_with_paged_prefix_spec(tiny):
     eng = Engine(
         cfg, params, ByteTokenizer(cfg.vocab_size),
         engine_cfg=EngineConfig(max_slots=2, max_seq=256, kv_pages=8,
-                                kv_page_size=64, kv_cache_dtype="fp8"),
+                                kv_page_size=64, kv_cache_dtype="fp8",
+                                # deterministic prefix hits — the async
+                                # default serves a shape's FIRST hit via
+                                # full admission (documented test mode)
+                                prefix_admit_async_compile=False),
     )
     eng.start()
     try:

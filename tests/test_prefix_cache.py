@@ -93,10 +93,15 @@ def test_multi_turn_reuses_generated_kv(peng):
     """Turn 2's prompt = turn 1's prompt + answer + more → prefix hit covers
     the generated tokens too (saved at finish)."""
     prompt = SYS + [110, 111]
+    # logprobs=1 forces one token EVENT per generated token — without it,
+    # tokens whose bytes are held back as incomplete UTF-8 merge into the
+    # next event, and gen_ids would be a SUBSET of the real generated ids
+    # (turn 2 would then not actually extend turn 1's sequence).
     handle = peng.submit(GenRequest(
-        prompt_ids=prompt, max_new_tokens=8, ignore_eos=True
+        prompt_ids=prompt, max_new_tokens=8, ignore_eos=True, logprobs=1
     ))
     gen_ids = [ev.token_id for ev in handle if ev.kind == "token"]
+    assert len(gen_ids) == 8
     turn2 = prompt + gen_ids + [115, 116]
     before = peng.m_prefix_tokens
     text2, _ = peng.generate(turn2, max_new_tokens=4, ignore_eos=True)

@@ -200,3 +200,32 @@ def test_spec_sampled_filtered_top_k(setup):
         assert eng.m_spec_rounds > 0
     finally:
         eng.stop()
+
+
+def test_spec_prefix_cached_admit_matches_plain(setup):
+    """Draft-composed cached admission (the `draft=True` cached-admit
+    variant: target prefills only the tail against the cached span while the
+    draft prefills the full prompt): a prefix HIT must produce the same
+    greedy output as a draft engine admitted cold."""
+    cfg, params, draft_cfg, draft_params = setup
+    eng = Engine(
+        cfg, params, ByteTokenizer(cfg.vocab_size),
+        engine_cfg=EngineConfig(
+            max_slots=2, max_seq=128, min_prefill_bucket=16,
+            prefix_cache_entries=4, prefix_cache_min=24,
+            prefix_admit_async_compile=False,
+        ),
+        draft_cfg=draft_cfg, draft_params=draft_params, n_draft=4,
+    )
+    eng.start()
+    try:
+        sys_p = [65 + (i * 5) % 26 for i in range(40)]
+        t_cold, _ = eng.generate(sys_p + [100, 101], max_new_tokens=12,
+                                 ignore_eos=True)  # seeds the span
+        hits0 = eng.m_prefix_hits
+        t_hit, _ = eng.generate(sys_p + [100, 101], max_new_tokens=12,
+                                ignore_eos=True)
+        assert eng.m_prefix_hits > hits0, "no cached admission exercised"
+        assert t_hit == t_cold
+    finally:
+        eng.stop()
