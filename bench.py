@@ -411,7 +411,17 @@ def main() -> None:
     if os.environ.get("BENCH_PREFIX", "1") != "0":
         plen = int(os.environ.get("BENCH_PREFIX_LEN", "4000"))
         xmax = 8192
-        for paged_flag, rkey in ((False, "prefix"), (True, "paged_prefix")):
+        rows_spec = [
+            (False, "prefix", plen),
+            (True, "paged_prefix", plen),
+            # Legacy comparison row (ROADMAP re-measure item): the OLD
+            # 512-token shape r04 recorded 0.34 on. Kept deliberately so the
+            # dedicated 4000-token rows above have a release-over-release
+            # anchor; at 512 tokens cold and cached are both ~1 tunnel RTT,
+            # so ~1.0x here is expected, not a regression.
+            (False, "prefix512_legacy", 512),
+        ]
+        for paged_flag, rkey, rlen in rows_spec:
             xeng = None
             try:
                 xeng = Engine(
@@ -425,7 +435,7 @@ def main() -> None:
                 )
                 xeng.start()
                 mk = lambda seed: [(seed * 911 + j * 13) % 255 + 1
-                                   for j in range(plen)]
+                                   for j in range(rlen)]
                 # first calls compile (bucket prefill + block); second cold
                 # call is the measurement
                 xeng.generate(mk(1) + [7, 8], max_new_tokens=2, ignore_eos=True)
@@ -449,10 +459,10 @@ def main() -> None:
                 out[f"{rkey}_cached_ttft_ms"] = round(warm_ms, 1)
                 out[f"{rkey}_ttft_speedup"] = round(
                     cold_ms / max(warm_ms, 1e-6), 2)
-                out[f"{rkey}_len_tokens"] = plen
+                out[f"{rkey}_len_tokens"] = rlen
                 print(
                     f"{rkey} cache: cold {cold_ms:.1f}ms -> cached "
-                    f"{warm_ms:.1f}ms ({plen}-token prefix, "
+                    f"{warm_ms:.1f}ms ({rlen}-token prefix, "
                     f"{xeng.m_prefix_tokens} tokens reused)",
                     file=sys.stderr,
                 )
@@ -726,6 +736,106 @@ def main() -> None:
         eng_long.stop()
         eng_long.params = eng_long.cache = None
 
+    # TTFT-under-load row (ISSUE 2, chunked ragged prefill): decode slots
+    # must keep streaming while a 32k-token prefill is in flight. One slot
+    # streams tokens continuously; mid-stream a 32k prompt admits through
+    # the chunked path and a short probe lands right behind it. Reported:
+    # the probe's TTFT under load vs idle, the longest inter-token gap on
+    # the streaming slot during the prefill window (decode_stall_ms — the
+    # single-shot baseline stalls for the WHOLE prefill, BENCH_r04: 3560 ms
+    # at 32k), and how many tokens the streamer moved while the prefill ran.
+    ilv_ctx = int(os.environ.get("BENCH_INTERLEAVE_CTX", default_long))
+    if ilv_ctx:
+        import gc
+
+        from localai_tpu.engine import GenRequest
+
+        gc.collect()
+        ichunk = int(os.environ.get("BENCH_PREFILL_CHUNK", "512"))
+        ipage = 128
+        ieng = Engine(
+            cfg, params, ByteTokenizer(cfg.vocab_size),
+            engine_cfg=EngineConfig(
+                max_slots=4, max_seq=ilv_ctx,
+                kv_pages=(ilv_ctx + 3 * 4096) // ipage, kv_page_size=ipage,
+                prefill_chunk=ichunk,
+                prefix_cache_entries=0,  # measure raw chunked admission
+            ),
+        )
+        long_prompt = [(j % 255) + 1 for j in range(ilv_ctx - 64)]
+        short_ids = [(j * 17) % 255 + 1 for j in range(128)]
+        try:
+            ieng.start()
+            # Warm every shape the measurement touches: the short bucket +
+            # decode blocks, then the chunk programs and final-chunk shape.
+            ieng.generate(short_ids, max_new_tokens=8, ignore_eos=True)
+            _, evw = ieng.generate(long_prompt, max_new_tokens=4,
+                                   ignore_eos=True)
+            print(
+                f"interleave warm: {len(long_prompt)}-token chunked prefill "
+                f"{evw.timing_prompt_processing * 1000:.0f}ms "
+                f"({ieng.m_prefill_chunks} chunks)", file=sys.stderr,
+            )
+            idle = []
+            for _ in range(3):
+                _, ev = ieng.generate(short_ids, max_new_tokens=8,
+                                      ignore_eos=True)
+                idle.append(ev.timing_prompt_processing)
+            ttft_idle = sorted(idle)[1]
+
+            stamps: list[float] = []
+            sh = ieng.submit(GenRequest(
+                prompt_ids=short_ids, max_new_tokens=4096, ignore_eos=True,
+            ))
+
+            def drain() -> None:
+                for ev in sh:
+                    if ev.kind == "token":
+                        stamps.append(time.monotonic())
+
+            dthr = threading.Thread(target=drain)
+            dthr.start()
+            while len(stamps) < 20:  # streamer must be in steady state
+                time.sleep(0.005)
+            t_p0 = time.monotonic()
+            lh = ieng.submit(GenRequest(
+                prompt_ids=long_prompt, max_new_tokens=4, ignore_eos=True,
+            ))
+            time.sleep(0.2)  # probe lands while the prefill is in flight
+            _, ev_probe = ieng.submit(GenRequest(
+                prompt_ids=short_ids, max_new_tokens=8, ignore_eos=True,
+            )).result()
+            _, ev_long = lh.result()
+            t_p1 = t_p0 + ev_long.timing_prompt_processing
+            sh.cancel()
+            dthr.join(timeout=120)
+            in_win = [t for t in stamps if t_p0 <= t <= t_p1]
+            gaps = [b - a for a, b in zip(in_win, in_win[1:])]
+            out["ttft_under_load_ms"] = round(
+                ev_probe.timing_prompt_processing * 1000, 1)
+            out["ttft_idle_ms"] = round(ttft_idle * 1000, 1)
+            out["decode_stall_ms"] = (
+                round(max(gaps) * 1000, 1) if gaps else None)
+            out["decode_tokens_during_long_prefill"] = len(in_win)
+            out["interleaved_prefill_ms"] = round(
+                ev_long.timing_prompt_processing * 1000, 1)
+            out["prefill_chunk"] = ichunk
+            print(
+                f"interleave ({len(long_prompt)} tokens, chunk {ichunk}): "
+                f"probe ttft {out['ttft_under_load_ms']}ms under load vs "
+                f"{out['ttft_idle_ms']}ms idle; decode moved {len(in_win)} "
+                f"tokens during the prefill, max stall "
+                f"{out['decode_stall_ms']}ms (prefill "
+                f"{out['interleaved_prefill_ms']}ms)", file=sys.stderr,
+            )
+        except Exception as e:  # noqa: BLE001 — extra row is best-effort
+            print(f"interleave row failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+        finally:
+            ieng.stop()
+            ieng.params = ieng.cache = None
+            gc.collect()
+
     # North-star row (BASELINE.md): llama-3-8b int8, served end-to-end over
     # HTTP POST /v1/chat/completions with stream:true. Synthetic weights
     # (zero egress) on the real 8B arch; decode tok/s from the engine's
@@ -894,15 +1004,17 @@ def _http_8b_row(slots: int, prompt_len: int, gen_len: int, max_seq: int):
         total_tokens = sum(r["tokens"] for r in results)
         usage_tokens = sum((r["usage"] or {}).get("completion_tokens", 0) for r in results)
         if usage_tokens and usage_tokens != total_tokens:
-            # Expected with random byte-level outputs: a token whose bytes
-            # leave an INCOMPLETE UTF-8 sequence is held back and flushes
-            # with the next token's chunk (llama.cpp holds partial UTF-8 the
-            # same way; core/backend/llm.go:146-166). Chunks == tokens only
-            # when every token decodes to complete text.
-            print(f"8B row: {total_tokens} content chunks for {usage_tokens} "
-                  f"usage tokens ({usage_tokens - total_tokens} UTF-8 "
-                  f"holdback merges)", file=sys.stderr)
-            total_tokens = usage_tokens
+            # Hard contract since ISSUE 2: the engine posts exactly one
+            # token event per generated token (held-back stop/UTF-8 bytes
+            # ride as empty-content chunks and flush later), so streamed
+            # chunk count and usage completion_tokens must agree — a
+            # mismatch means tokens are being silently merged or dropped on
+            # the SSE path. Fail the row instead of fudging the count.
+            raise RuntimeError(
+                f"SSE chunk count {total_tokens} != usage completion_tokens "
+                f"{usage_tokens} — every generated token must emit exactly "
+                f"one content chunk"
+            )
         # Client-side first-content time exists only when the model emits
         # decodable text (synthetic weights rarely do); engine prefill timing
         # (timing_prompt_processing, the reference's TTFT proxy —

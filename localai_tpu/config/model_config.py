@@ -107,6 +107,12 @@ class ModelConfig:
     # the fused ragged paged-attention Pallas kernel on TPU and the XLA
     # reference elsewhere; "pallas"/"xla" force one.
     paged_kernel: str = "auto"
+    # Chunked ragged prefill (docs/CHUNKED_PREFILL.md): prompts longer than
+    # this admit in prefill_chunk-token chunks interleaved with decode
+    # blocks, so a long prompt never stalls running requests and TTFT for
+    # short prompts stops queueing behind long ones. Power of two; 0 = off
+    # (single-shot admission). LOCALAI_PREFILL_CHUNK env var overrides.
+    prefill_chunk: int = 0
 
     # Speculative decoding (reference: draft_model/n_draft,
     # core/config/model_config.go:211-212).

@@ -152,6 +152,18 @@ class EngineConfig:
     # off-TPU runs in interpret mode — tests only). LOCALAI_PAGED_KERNEL
     # env var overrides.
     paged_kernel: str = "auto"
+    # Chunked ragged prefill (docs/CHUNKED_PREFILL.md, ISSUE 2): prompts
+    # whose un-cached tail exceeds this many tokens admit in
+    # prefill_chunk-token chunks that the engine loop interleaves with
+    # decode blocks — a long prompt no longer monopolizes the device
+    # (BENCH_r04: one 32k prefill stalled every running decode for 3.5 s),
+    # and under the paged pool each chunk's K/V writes land DIRECTLY in the
+    # slot's pages (models/llama.prefill_chunk_paged) instead of routing
+    # through a dense full-bucket buffer + scatter. Must be a power of two
+    # >= min_prefill_bucket; page-aligned values (multiple of kv_page_size)
+    # give the cleanest page DMAs but are not required. 0 disables
+    # (single-shot admission). LOCALAI_PREFILL_CHUNK env var overrides.
+    prefill_chunk: int = 0
     # KV-cache storage dtype (reference: CacheTypeKey/CacheTypeValue,
     # backend/backend.proto:261-262, llama.cpp q8 KV). "" = model dtype;
     # "fp8" (e4m3) / "fp8_e5m2" halve KV bytes — the TPU-native equivalent
@@ -343,6 +355,18 @@ class Engine:
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.ecfg = engine_cfg or EngineConfig()
+        env_chunk = os.environ.get("LOCALAI_PREFILL_CHUNK")
+        if env_chunk is not None and env_chunk != "":
+            self.ecfg = dataclasses.replace(
+                self.ecfg, prefill_chunk=int(env_chunk)
+            )
+        C = self.ecfg.prefill_chunk
+        if C:
+            if C < self.ecfg.min_prefill_bucket or C & (C - 1):
+                raise ValueError(
+                    f"prefill_chunk={C} must be a power of two >= "
+                    f"min_prefill_bucket={self.ecfg.min_prefill_bucket}"
+                )
         self.plan = mesh_plan or MeshPlan(dp=1, tp=1)
         validate_plan(cfg, self.plan.tp, self.plan.ep)
         self.mesh = build_mesh(self.plan, devices)
@@ -559,6 +583,15 @@ class Engine:
         )
         self._free_pages: list[int] = list(range(self.ecfg.kv_pages))
         self._slot_pages: list[list[int]] = [[] for _ in range(B)]
+        # Chunked ragged prefill state (EngineConfig.prefill_chunk): each
+        # in-progress chunked admission holds a reserved slot (inactive —
+        # decode blocks skip it) and, under the paged pool, its page table
+        # ROW kept OFF h_ptable until the final chunk activates the slot, so
+        # interleaved decode-block writes for the idle slot keep resolving
+        # through SCRATCH instead of corrupting freshly-prefilled pages.
+        self._chunkings: list[dict] = []
+        self.m_prefill_chunks = 0
+        self.m_chunked_admits = 0
         # Page refcounts: a page may be referenced by its owning slot AND by
         # prefix-cache entries (copy-on-write sharing — spans live in pool
         # pages mapped read-only into later admissions' tables). A page
@@ -1284,6 +1317,489 @@ class Engine:
         if not build_only:
             self._admit_cache[key] = fn
         return fn
+
+    # ------------------------------------------------------------------ #
+    # Chunked ragged prefill (EngineConfig.prefill_chunk — ISSUE 2)
+    #
+    # A long admission runs as a sequence of fixed-size chunk programs the
+    # loop interleaves with decode blocks: chunk c attends the rows already
+    # written ([0, offset) — the slot's pages under the paged pool, a
+    # bucketed read window of the slot's dense rows otherwise) plus itself
+    # causally, and writes its K/V straight into the cache. The FINAL chunk
+    # additionally samples the first token and installs the slot's device
+    # state — after it, the request decodes like any other admission. At
+    # most ONE chunk dispatch is in flight at a time, so decode blocks slot
+    # between consecutive chunks on the device stream instead of queueing
+    # behind a monolithic multi-second prefill program.
+    # ------------------------------------------------------------------ #
+
+    @property
+    def _chunk_size(self) -> int:
+        """Effective chunk size: 0 when chunking is off or prefill runs
+        ring attention (sp>1 — the chunk path has no ring variant)."""
+        return 0 if self._ring_mesh is not None else self.ecfg.prefill_chunk
+
+    def _chunkable(self, request: GenRequest, match_len: int = 0) -> bool:
+        """Whether this request's (un-cached) prompt tail should admit
+        through the chunked state machine. Multimodal/mrope prompts keep
+        the single-shot path (their injection points assume a whole-prompt
+        prefill); draft engines mirror _cached_admit_ok's exclusions (no
+        grammar/logprob final-chunk variant composes with the draft)."""
+        C = self._chunk_size
+        if not C or len(request.prompt_ids) - match_len <= C:
+            return False
+        if request.image_embeds is not None or request.mrope_positions is not None:
+            return False
+        if self.draft_cfg is not None and (
+            request.grammar is not None or request.logprobs > 0
+        ):
+            return False
+        return True
+
+    def _get_chunk_mid(self, tb: int, pwin: Optional[int]):
+        """Mid-chunk program: prefill `tb` chunk tokens against the rows
+        already written for the slot and write their K/V directly into the
+        cache — no sampling, no unembed (the final chunk does both). pwin
+        is the dense prefix read window (None under the paged pool, where
+        the chunk walks a page-table operand instead — the slot's real
+        table rides here while h_ptable keeps the slot on SCRATCH).
+
+        d_positions rides through so the program can pin the idle slot's
+        carried position at S-1: decode blocks write EVERY slot's row each
+        step, and a stale carry from the slot's previous tenant could
+        otherwise land inside the rows this prefill is writing. (Paged idle
+        writes already resolve through SCRATCH; the pin is harmless there.)
+        """
+        key = ("chunk", tb, pwin)
+        fn = self._block_cache.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        S = self.ecfg.max_seq
+
+        if self._paged:
+            def chunk(params, cache, d_positions, toks, aux, table_row):
+                # aux: [chunk_len, slot, offset] i32
+                _, cache = llama.prefill_chunk_paged(
+                    cfg, params, toks, aux[0:1], aux[2:3], cache,
+                    table_row[None], ep=self.plan.ep,
+                    paged_impl=self.ecfg.paged_kernel, with_logits=False,
+                )
+                d_positions = d_positions.at[aux[1]].set(S - 1)
+                return cache, d_positions, aux
+        else:
+            L, K = cfg.num_layers, cfg.cache_kv_heads
+            kd, vd = cfg.cache_k_dim, cfg.cache_v_dim
+
+            def chunk(params, cache, d_positions, toks, aux):
+                slot = aux[1]
+                # Read-side slice of the slot's written prefix; rows past
+                # aux[2] are garbage and masked inside prefill_tail.
+                pk = jax.lax.dynamic_slice(
+                    cache.k, (0, slot, 0, 0, 0), (L, 1, pwin, K, kd))
+                pv = jax.lax.dynamic_slice(
+                    cache.v, (0, slot, 0, 0, 0), (L, 1, pwin, K, vd))
+                _, tks, tvs = llama.prefill_tail(
+                    cfg, params, toks, aux[0:1], aux[2:3], pk, pv,
+                    ep=self.plan.ep,
+                )
+                cache = llama.write_rows_to_cache(cache, slot, tks, tvs, aux[2])
+                d_positions = d_positions.at[slot].set(S - 1)
+                return cache, d_positions, aux
+
+        fn = jax.jit(chunk, donate_argnums=(1, 2))
+        self._block_cache[key] = fn
+        return fn
+
+    def _get_chunk_pin(self):
+        """Set one slot's carried decode position to S-1. Dispatched at
+        dense chunk start so every decode block dispatched afterwards writes
+        the idle slot's (discarded) row at S-1 instead of at a stale carry
+        from the slot's previous tenant — a stale position inside the copied
+        prefix span would corrupt rows no later chunk rewrites."""
+        fn = self._block_cache.get(("chunk-pin",))
+        if fn is None:
+            S = self.ecfg.max_seq
+
+            def pin(d_positions, slot):
+                return d_positions.at[slot].set(S - 1)
+
+            fn = jax.jit(pin, donate_argnums=(0,))
+            self._block_cache[("chunk-pin",)] = fn
+        return fn
+
+    def _get_span_copy(self, pb: int):
+        """Copy a stored dense prefix span into a slot's cache rows [0, pb)
+        — seeds a chunked prefix-hit admission (the chunk programs then
+        read the prefix from the slot itself)."""
+        key = ("span-copy", pb)
+        fn = self._block_cache.get(key)
+        if fn is None:
+            def copy(cache, pk, pv, slot):
+                k = jax.lax.dynamic_update_slice(
+                    cache.k, pk.astype(cache.k.dtype), (0, slot, 0, 0, 0))
+                v = jax.lax.dynamic_update_slice(
+                    cache.v, pv.astype(cache.v.dtype), (0, slot, 0, 0, 0))
+                return llama.KVCache(k=k, v=v)
+
+            fn = jax.jit(copy, donate_argnums=(0,))
+            self._block_cache[key] = fn
+        return fn
+
+    def _get_chunk_final_paged(self, tb: int, fbp: int, has_bias: bool,
+                               with_topk: bool, with_lp: bool,
+                               with_dfa=False, draft: bool = False):
+        """Final chunk of a paged chunked admission: prefill the last
+        ≤prefill_chunk tokens direct-to-page (prefix attention walks the
+        slot's OWN pages — no gather_pages materialization of a 32k
+        prefix), sample the first token and install the full per-slot
+        device state. _get_admit_cached_paged's contract with
+        prefill_chunk_paged in place of gather_pages + prefill_tail; `aux`
+        is [4] i32 (tail_len, slot, seed, prefix_len)."""
+        key = ("chunk-final", tb, fbp, has_bias, with_topk, with_lp,
+               with_dfa, draft)
+        fn = self._admit_cache.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        V = cfg.vocab_size
+        K = min(self.GRAMMAR_TOPK, V)
+        LK = min(self.LOGPROB_TOPK, V)
+        tok_v = min(getattr(self.tokenizer, "vocab_size", V) or V, V)
+
+        def admit_chunk(params, cache, counts, rngs, bias, d_tokens,
+                        d_positions, table_row, tail_toks, full_toks, aux,
+                        samp_pack, bias_rows=None, gmask0=None, gtrans=None,
+                        tok_cls=None, ginit=None, d_gstate=None):
+            tail_len, slot, seed, plen = aux[0], aux[1], aux[2], aux[3]
+            samp = SamplingParams(
+                temperature=samp_pack[0], top_k=samp_pack[1].astype(jnp.int32),
+                top_p=samp_pack[2], min_p=samp_pack[3], repeat_penalty=samp_pack[4],
+                presence_penalty=samp_pack[5], frequency_penalty=samp_pack[6],
+            )
+            logits, cache = llama.prefill_chunk_paged(
+                cfg, params, tail_toks, aux[0:1], aux[3:4], cache,
+                table_row[None], ep=self.plan.ep,
+                paged_impl=self.ecfg.paged_kernel,
+            )
+            fvalid = (jnp.arange(fbp)[None, :] < (plen + tail_len)).astype(jnp.int32)
+            rows = jnp.zeros((1, V), jnp.int32)
+            rows = rows.at[jnp.arange(1)[:, None], full_toks].add(fvalid)
+            brows = bias_rows if has_bias else jnp.zeros((1, V), jnp.float32)
+            if tok_v < V:
+                from localai_tpu.ops.sampling import NEG_INF
+
+                brows = jnp.where(jnp.arange(V)[None, :] >= tok_v, NEG_INF, brows)
+            keys0 = jax.vmap(jax.random.key)(aux[2:3].astype(jnp.uint32))
+            draws = jax.vmap(lambda kk: jax.random.fold_in(kk, 0))(keys0)
+            srows = brows + gmask0 if with_dfa else brows
+            toks = sample(logits, draws, samp, rows, srows)  # [1]
+            rows = rows.at[jnp.arange(1), toks].add(1)
+            tk = jax.lax.top_k(logits + brows, K)[1] if with_topk else None
+            lp = None
+            if with_lp:
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32) + brows, axis=-1)
+                lp_vals, lp_ids = jax.lax.top_k(logp, LK)
+                tok_lp = jnp.take_along_axis(logp, toks[:, None], axis=-1)[:, 0]
+                lp = (tok_lp, lp_ids, lp_vals)
+            counts = counts.at[slot].set(rows[0])
+            rngs = rngs.at[slot].set(keys0[0])
+            bias = bias.at[slot].set(brows[0])
+            d_tokens = d_tokens.at[slot].set(toks[0])
+            d_positions = d_positions.at[slot].set(plen + tail_len)
+            out = (cache, counts, rngs, bias, d_tokens, d_positions, toks, tk, lp)
+            if with_dfa:
+                gnext = self._dfa_advance(with_dfa, gtrans, tok_cls, ginit, toks)
+                out = out + (d_gstate.at[slot].set(gnext[0]),)
+            return out
+
+        dcfg = self.draft_cfg
+
+        def wrapped(*args):
+            # Positional assembly mirrors _get_admit_cached_paged with
+            # (table_row,) in place of (pages, table_row): [7 state]
+            # [d_gstate?] [dparams, dcache?] [table_row, tail, full, aux,
+            # samp] [bias_rows?] [dfa 4?].
+            i = 7
+            params, cache, counts, rngs, bias, d_tokens, d_positions = args[:7]
+            d_gstate = None
+            if with_dfa:
+                d_gstate = args[i]
+                i += 1
+            dparams = dcache = None
+            if draft:
+                dparams, dcache = args[i: i + 2]
+                i += 2
+            table_row, tail_toks, full_toks, aux, samp_pack = args[i: i + 5]
+            i += 5
+            bias_rows = None
+            if has_bias:
+                bias_rows = args[i]
+                i += 1
+            gmask0 = gtrans = tok_cls = ginit = None
+            if with_dfa:
+                gmask0, gtrans, tok_cls, ginit = args[i: i + 4]
+                i += 4
+            out = admit_chunk(params, cache, counts, rngs, bias, d_tokens,
+                              d_positions, table_row, tail_toks, full_toks,
+                              aux, samp_pack, bias_rows=bias_rows,
+                              gmask0=gmask0, gtrans=gtrans, tok_cls=tok_cls,
+                              ginit=ginit, d_gstate=d_gstate)
+            if draft:
+                # The draft's small dense cache has no chunked/paged span to
+                # reuse — prefill it with the full prompt in one program
+                # (same trade as the cached-admit draft branch).
+                flen = aux[0:1] + aux[3:4]
+                _, dks, dvs = llama.prefill(dcfg, dparams, full_toks, flen,
+                                            ep=self.plan.ep)
+                dcache = llama.write_prefill_to_cache(
+                    dcache, dks[:, 0:1], dvs[:, 0:1], aux[1]
+                )
+                out = out + (dcache,)
+            return out
+
+        donate = (1, 2, 3, 4, 5, 6)
+        if with_dfa:
+            donate = donate + (7,)
+        if draft:
+            donate = donate + (7 + (1 if with_dfa else 0) + 1,)  # dcache
+        fn = jax.jit(wrapped, donate_argnums=donate)
+        self._admit_cache[key] = fn
+        return fn
+
+    def _chunk_start(self, request: GenRequest, handle: RequestHandle,
+                     hit: Optional[tuple]) -> bool:
+        """Reserve a slot (and pages) for a chunked admission and enqueue
+        its state. Returns False on pool pressure (request requeued — the
+        caller must stop planning this round, backpressure)."""
+        t0 = time.monotonic()
+        ids = request.prompt_ids
+        slot_idx = next(i for i, s in enumerate(self.slots) if s is None)
+        entry, match_len = (hit if hit is not None else (None, 0))
+        if entry is not None and self._paged and not any(
+            e is entry for e in self._prefix_entries
+        ):
+            entry, match_len = None, 0  # evicted between find and start
+        table_row: Optional[np.ndarray] = None
+        if self._paged:
+            page = self.ecfg.kv_page_size
+            if entry is not None:
+                shared = entry["pages"][: match_len // page]
+                total_rows = max(
+                    match_len + self._bucket_for(len(ids) - match_len),
+                    min(len(ids) + request.max_new_tokens, self.ecfg.max_seq),
+                )
+                fresh = -(-total_rows // page) - len(shared)
+            else:
+                shared = []
+                fresh = self._pages_needed(request)
+            if len(self._free_pages) < fresh:
+                self._prefix_evict_for_pages(
+                    fresh, protect=[entry] if entry is not None else []
+                )
+            if self._pages_alloc(slot_idx, fresh, shared=shared) is None:
+                with self._pending_lock:
+                    self._pending.appendleft((request, handle))
+                return False
+            # Keep the slot on SCRATCH until the final chunk activates it:
+            # decode blocks write every slot every step, and the real table
+            # must not be reachable while this prefill owns the pages.
+            table_row = self.h_ptable[slot_idx].copy()
+            self.h_ptable[slot_idx] = self._scratch_page
+        else:
+            # Dense cache: pin the idle slot's carried position FIRST (see
+            # _get_chunk_pin — blocks dispatched from here on must not stamp
+            # stale-position rows into the slot). Paged idle writes resolve
+            # through SCRATCH instead, no pin needed.
+            self.d_positions = self._get_chunk_pin()(
+                self.d_positions, jnp.int32(slot_idx)
+            )
+            if entry is not None:
+                # Seed the slot's rows [0, pb) from the stored span so the
+                # chunk programs read the prefix from the slot itself.
+                self.cache = self._get_span_copy(entry["pb"])(
+                    self.cache, entry["k"], entry["v"], jnp.int32(slot_idx)
+                )
+        if entry is not None:
+            for idx, e in enumerate(self._prefix_entries):
+                if e is entry:
+                    self._prefix_entries.pop(idx)
+                    self._prefix_entries.insert(0, entry)
+                    break
+            self.m_prefix_hits += 1
+            self.m_prefix_tokens += match_len
+        self.slots[slot_idx] = _Slot(
+            request=request, handle=handle, prompt_len=len(ids), t_submit=t0,
+        )
+        self._chunkings.append({
+            "request": request, "handle": handle, "slot": slot_idx,
+            "ids": ids, "offset": match_len, "t0": t0,
+            "table_row": table_row,
+        })
+        return True
+
+    def _advance_chunked(self) -> bool:
+        """Dispatch the next chunk of the oldest in-progress chunked
+        admission — at most one chunk in flight engine-wide, so decode
+        blocks interleave between chunks on the device stream. Runs on the
+        loop thread only."""
+        if not self._chunkings:
+            return False
+        if any(e.kind == "chunk" for e in self._inflight):
+            return False
+        st = self._chunkings[0]
+        slot_idx = st["slot"]
+        if st["handle"].cancelled.is_set():
+            self._chunkings.pop(0)
+            st["handle"]._q.put(TokenEvent(kind="done", finish_reason="stop"))
+            self._release(slot_idx)
+            return True
+        C = self.ecfg.prefill_chunk
+        rem = len(st["ids"]) - st["offset"]
+        try:
+            if rem > C:
+                self._dispatch_chunk_mid(st, C)
+                st["offset"] += C
+            else:
+                self._chunkings.pop(0)
+                self._dispatch_chunk_final(st)
+        except Exception as e:  # noqa: BLE001 — fail the request, keep serving
+            log.exception("chunked prefill dispatch failed (slot %d)", slot_idx)
+            # Identity scan, not `in`: dict == would compare the numpy
+            # table_row arrays elementwise.
+            self._chunkings = [s for s in self._chunkings if s is not st]
+            st["handle"]._q.put(
+                TokenEvent(kind="error", error=f"{type(e).__name__}: {e}")
+            )
+            self._release(slot_idx)
+        return True
+
+    def _dispatch_chunk_mid(self, st: dict, n: int) -> None:
+        offset, slot_idx = st["offset"], st["slot"]
+        toks = np.zeros((1, n), np.int32)
+        toks[0] = st["ids"][offset: offset + n]
+        aux = np.asarray([n, slot_idx, offset], np.int32)
+        if self._paged:
+            fn = self._get_chunk_mid(n, None)
+            out = fn(self.params, self.cache, self.d_positions,
+                     jnp.asarray(toks), jnp.asarray(aux),
+                     jnp.asarray(st["table_row"]))
+        else:
+            pwin = self._bucket_for(max(offset, 1))
+            fn = self._get_chunk_mid(n, pwin)
+            out = fn(self.params, self.cache, self.d_positions,
+                     jnp.asarray(toks), jnp.asarray(aux))
+        self.cache, self.d_positions, marker = out
+        self.m_prefill_chunks += 1
+        self._track(_Entry(kind="chunk", toks=marker, tk=None,
+                           gen=list(self._slot_gen)))
+
+    def _dispatch_chunk_final(self, st: dict) -> None:
+        """The last ≤prefill_chunk tokens: prefill + first-token sample +
+        slot activation, mirroring _dispatch_admit_cached's glue with the
+        already-resident rows as the prefix."""
+        request, handle, slot_idx = st["request"], st["handle"], st["slot"]
+        ids, offset, t0 = st["ids"], st["offset"], st["t0"]
+        V = self.cfg.vocab_size
+        tail = ids[offset:]
+        tb = self._bucket_for(len(tail))
+        fbp = self._bucket_for(len(ids))
+        draft = self.draft_cfg is not None
+        dfa_tables = None
+        if request.grammar is not None:
+            dfa_tables = self._dfa_for(request)
+        with_dfa = self._dfa_mode_of(dfa_tables)
+        with_topk = request.grammar is not None and not with_dfa
+        with_lp = request.logprobs > 0
+        has_bias = bool(request.logit_bias)
+        tail_toks = np.zeros((1, tb), np.int32)
+        tail_toks[0, : len(tail)] = tail
+        full_toks = np.zeros((1, fbp), np.int32)
+        full_toks[0, : len(ids)] = ids
+        aux = np.zeros((4,), np.int32)
+        aux[0] = len(tail)
+        aux[1] = slot_idx
+        aux[2] = (
+            request.seed & 0x7FFFFFFF if request.seed is not None
+            else int.from_bytes(os.urandom(4), "little") & 0x7FFFFFFF
+        )
+        aux[3] = offset
+        samp_pack = np.zeros((7, 1), np.float32)
+        for fi, kf in enumerate(_SAMPLING_FIELDS):
+            samp_pack[fi, 0] = getattr(request, kf)
+        if self._paged:
+            fn = self._get_chunk_final_paged(tb, fbp, has_bias, with_topk,
+                                             with_lp, with_dfa, draft)
+            # Publish the real table NOW (loop thread): blocks dispatched
+            # from here on — all strictly after this program on the device
+            # stream — may read and write the slot's pages.
+            self.h_ptable[slot_idx] = st["table_row"]
+            args = (jnp.asarray(st["table_row"]),)
+        else:
+            pb = self._bucket_for(max(offset, 1))
+            pk, pv = self._get_snapshot(pb)(self.cache, jnp.int32(slot_idx))
+            fn = self._get_admit_cached(pb, tb, fbp, has_bias, with_topk,
+                                        with_lp, with_dfa, draft)
+            args = (pk, pv)
+        args = args + (
+            jnp.asarray(tail_toks), jnp.asarray(full_toks), jnp.asarray(aux),
+            jnp.asarray(samp_pack),
+        )
+        if has_bias:
+            bias_rows = np.zeros((1, V), np.float32)
+            for tid, bval in request.logit_bias.items():
+                if 0 <= int(tid) < V:
+                    bias_rows[0, int(tid)] = bval
+            args = args + (jnp.asarray(bias_rows),)
+        if with_dfa:
+            host = dfa_tables["host"]
+            row = np.unpackbits(
+                host.mask_bits[host.init_state], bitorder="little"
+            )[:V].astype(bool)
+            gmask0 = np.where(row, 0.0, -1e30).astype(np.float32)[None, :]
+            ginit = np.full((1,), host.init_state, np.int32)
+            args = args + (
+                jnp.asarray(gmask0), self._dfa_table(dfa_tables, with_dfa),
+                dfa_tables["tok_cls"], jnp.asarray(ginit),
+            )
+        state = (
+            self.params, self.cache, self.counts, self.rngs, self.bias,
+            self.d_tokens, self.d_positions,
+        )
+        if with_dfa:
+            state = state + (self.d_gstate,)
+        if draft:
+            state = state + (self.draft_params, self.d_cache)
+        out = fn(*state, *args)
+        (
+            self.cache, self.counts, self.rngs, self.bias,
+            self.d_tokens, self.d_positions, toks, tk, lp,
+        ) = out[:9]
+        if with_dfa:
+            self.d_gstate = out[9]
+        elif draft:
+            self.d_cache = out[9]
+        _host_copy_async(toks)
+        for kf in _SAMPLING_FIELDS:
+            self.h_sampling[kf][slot_idx] = getattr(request, kf)
+        if self._mrope:
+            self.h_rope_delta[slot_idx] = 0  # chunked path is text-only
+        self._slot_gen[slot_idx] += 1
+        self.slots[slot_idx] = _Slot(
+            request=request, handle=handle, prompt_len=len(ids), scheduled=1,
+            t_submit=t0, dfa=with_dfa,
+        )
+        self.h_active[slot_idx] = True
+        self.h_override_mask[slot_idx] = False
+        self.h_gmask[slot_idx] = 1.0 if with_dfa else 0.0
+        self.m_prefill_chunks += 1
+        self.m_chunked_admits += 1
+        self._track(_Entry(
+            kind="admit", toks=toks, tk=tk, lp=lp, gen=list(self._slot_gen),
+            items=[(slot_idx, request, handle, len(ids), t0)],
+        ))
+        self._last_admit_t = time.monotonic()
+        self._prefix_save(slot_idx, ids, len(ids))
 
     # ------------------------------------------------------------------ #
     # Prompt/prefix KV cache (host side)
@@ -2032,6 +2548,9 @@ class Engine:
         if self._paged:
             out["kv_pages_total"] = float(self.ecfg.kv_pages)
             out["kv_pages_free"] = float(len(self._free_pages))
+        if self.ecfg.prefill_chunk:
+            out["prefill_chunks"] = float(self.m_prefill_chunks)
+            out["chunked_admissions"] = float(self.m_chunked_admits)
         if self.draft_cfg is not None:
             out["spec_rounds"] = float(self.m_spec_rounds)
             out["spec_tokens_accepted"] = float(self.m_spec_accepted)
@@ -2479,6 +2998,12 @@ class Engine:
                           f"took {(time.monotonic()-t0)*1000:.1f}ms inflight={len(self._inflight)}")
                 nblocks += 1
 
+            # Chunked prefill rides between decode-block dispatches: one
+            # chunk in flight at a time, so the device alternates decode
+            # blocks and prefill chunks instead of stalling every live slot
+            # behind a monolithic long-prompt prefill.
+            self._advance_chunked()
+
             if self._inflight:
                 front = self._inflight[0]
                 fr = front.ready()
@@ -2535,6 +3060,7 @@ class Engine:
             group: list[tuple[GenRequest, RequestHandle]] = []
             bucket = 0
             pages_planned = 0
+            chunk_item = None  # ((request, handle), hit) → chunked admission
             prefix_hits: dict[int, tuple] = {}  # id(request) -> (entry, len)
             with self._pending_lock:
                 while self._pending and len(group) < len(free):
@@ -2543,12 +3069,29 @@ class Engine:
                         self._pending.popleft()
                         handle._q.put(TokenEvent(kind="done", finish_reason="stop"))
                         continue
+                    # Long prompts admit through the chunked state machine
+                    # (decode keeps streaming between chunks). A prefix hit
+                    # whose TAIL fits one chunk stays on the cheaper
+                    # single-shot cached path below.
+                    if self._chunk_size:
+                        hit0 = prefix_hits.get(id(request))
+                        if hit0 is None and self._cached_admit_ok(request):
+                            hit0 = self._prefix_find(request.prompt_ids)
+                            if hit0 is not None:
+                                prefix_hits[id(request)] = hit0
+                        if self._chunkable(request, hit0[1] if hit0 else 0):
+                            if group:
+                                break  # dispatch the batched group first
+                            chunk_item = (self._pending.popleft(), hit0)
+                            break
                     if self._paged:
                         # A prefix hit shares the span's pages — gate on the
                         # reduced (tail-only) need. Requests the cached path
                         # can't serve budget as misses (full pages).
-                        hit = (self._prefix_find(request.prompt_ids)
-                               if self._cached_admit_ok(request) else None)
+                        hit = prefix_hits.get(id(request))
+                        if hit is None:
+                            hit = (self._prefix_find(request.prompt_ids)
+                                   if self._cached_admit_ok(request) else None)
                         if hit is not None:
                             prefix_hits[id(request)] = hit
                             need = self._pages_needed_cached(request, hit[1])
@@ -2572,6 +3115,12 @@ class Engine:
                     elif b != bucket:
                         break  # different bucket — next round
                     group.append(self._pending.popleft())
+            if chunk_item is not None:
+                (request, handle), hit = chunk_item
+                if self._chunk_start(request, handle, hit):
+                    admitted = True
+                    continue  # re-plan the remaining queue
+                return admitted  # pool backpressure — wait for a finish
             if not group:
                 return admitted
             # Requests with logit_bias, a grammar, or logprobs select
@@ -3043,6 +3592,10 @@ class Engine:
         # post: a caller reading the throughput counters right after
         # result() returns must see this block's time in the denominator.
         self._charge()
+        if e.kind == "chunk":
+            # Mid prefill chunk: its KV landed on device, nothing to post —
+            # the FINAL chunk rides an "admit" entry with the first token.
+            return
         if e.kind == "spec":
             # toks [k+1, B] with -1 marking not-emitted; tk holds accepted
             # counts per slot. Only slots that actually emit count toward the
@@ -3285,7 +3838,13 @@ class Engine:
             if hold:
                 new = new[: len(new) - hold]
 
-        if new or (r.logprobs > 0 and lp is not None and not is_eos):
+        if not is_eos or new:
+            # EVERY generated token posts exactly one event, even when its
+            # bytes are all held back (incomplete UTF-8 / possible stop
+            # prefix): streamed SSE chunk count must equal usage
+            # completion_tokens — the 8B HTTP bench asserts it, and OpenAI
+            # stream consumers count content chunks as tokens. An EOS that
+            # flushes held-back text still posts that text (the `or new`).
             slot.emitted_len += len(new)
             handle._q.put(TokenEvent(
                 kind="token", text=new, token_id=tok,
@@ -3321,6 +3880,11 @@ class Engine:
 
     def _release(self, slot_idx: int) -> None:
         self.slots[slot_idx] = None
+        # A chunked prefill whose slot is being torn down (dispatch failure,
+        # stop) must not keep dispatching chunks into a freed slot.
+        self._chunkings = [
+            st for st in self._chunkings if st["slot"] != slot_idx
+        ]
         self.h_active[slot_idx] = False
         self.h_override_mask[slot_idx] = False
         self.h_gmask[slot_idx] = 0.0

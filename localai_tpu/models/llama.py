@@ -1346,6 +1346,122 @@ def gather_pages(
     )
 
 
+def prefill_chunk_paged(
+    cfg: ArchConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [B, T] int32 chunk tokens, right-padded
+    lengths: jnp.ndarray,  # [B] int32 valid chunk lengths
+    offsets: jnp.ndarray,  # [B] int32 rows already resident (chunk starts here)
+    pool: KVCache,
+    table: jnp.ndarray,  # [B, MP] int32 page tables (prefix + destination pages)
+    ep: int = 1,
+    paged_impl: str = "auto",
+    with_logits: bool = True,
+):
+    """One chunk of a ragged chunked prefill, direct-to-page (ISSUE 2).
+
+    Chunk token t attends the slot's already-written rows [0, offsets[b])
+    through the paged-partials walk — the same scalar-prefetch page-table
+    kernel as decode (ops/paged_flash, Pallas on TPU; the query-row axis is
+    tiled so a whole chunk's online-softmax state fits VMEM) — plus the
+    in-chunk causal window, and the chunk's fresh K/V rows scatter STRAIGHT
+    into the slot's pages at rows [offsets, offsets+T). Unlike
+    `prefill` + `write_prefill_to_pool` there is no dense full-bucket KV
+    intermediate and no bucket→page scatter: per-chunk HBM traffic is the
+    chunk itself plus one streamed read of the live prefix.
+
+    Padding rows (t >= lengths[b]) write garbage rows past the prompt inside
+    the slot's own reservation; decode overwrites each such row before any
+    query can attend it (same invariant as the dense bucket's padding).
+    Returns (last_logits [B, V] f32 | None, new_pool) — mid chunks skip the
+    unembed entirely (with_logits=False).
+    """
+    B, T = tokens.shape
+    from localai_tpu.ops.attention import (
+        _merge_partials_mq,
+        paged_prefill_partials,
+    )
+
+    inv_freq = rope_frequencies(cfg)
+    inv_local = rope_frequencies_local(cfg)
+    positions = offsets[:, None] + jnp.arange(T)[None, :]  # [B, T] global
+    length_mask = jnp.arange(T)[None, :] < lengths[:, None]
+    h = _embed(cfg, params, tokens)  # [B, T, D]
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    win_dist = jnp.arange(T)[:, None] - jnp.arange(T)[None, :]  # in-chunk t-u
+
+    def layer(h, xs):
+        lp, li, kc, vc = xs  # kc/vc: [P, page, K, Hd] pool slices
+        sliding = _layer_sliding(cfg, li)
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
+        inv = _layer_inv_freq(cfg, inv_freq, inv_local, li)
+        if cfg.is_mla:
+            # Absorbed MLA chunk: q_eff scores the latent prefix pages and
+            # the chunk's fresh latent rows (values come back out of the
+            # same latents — see decode_chunk's MLA branch).
+            q_eff = _mla_absorbed_q(cfg, lp, x, positions, inv)  # [B,T,H,De]
+            rows = _mla_rows(cfg, lp, x, positions, inv)  # [B,T,1,De]
+            acc, m, l = paged_prefill_partials(
+                q_eff, kc, kc, table, offsets, q_pos=positions,
+                impl=paged_impl,
+            )
+            wm = causal[None] & length_mask[:, None, :]  # [B, T, T]
+            attn = _merge_partials_mq(q_eff, acc, m, l, rows, rows, wm)
+            attn = _mla_unlatent(cfg, lp, attn)  # [B, T, H·v]
+            h = h + _attn_out(cfg, lp, attn)
+            x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
+            h = h + _mlp_out(cfg, lp, x, ep)
+            return h, (rows, rows[..., :0])
+        q, k, v = _attn_proj_qkv(cfg, lp, x)  # q [B,T,H,Hd], k/v [B,T,K,Hd]
+        q = apply_rope(q, positions, inv)
+        k = apply_rope(k, positions, inv)
+        wmask = causal[None] & length_mask[:, None, :]  # [B, T, T]
+        if cfg.sliding_window and sliding is not None:
+            wmask = wmask & (~sliding | (win_dist[None] < cfg.sliding_window))
+        acc, m, l = paged_prefill_partials(
+            q, kc, vc, table, offsets,
+            softcap=cfg.attn_softcap, window=cfg.sliding_window,
+            sliding=sliding, q_pos=positions, impl=paged_impl,
+        )
+        attn = _merge_partials_mq(
+            q, acc, m, l, k, v, wmask, softcap=cfg.attn_softcap,
+        ).reshape(B, T, -1).astype(h.dtype)
+        h = h + _attn_out(cfg, lp, attn)
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
+        h = h + _mlp_out(cfg, lp, x, ep)
+        return h, (k, v)
+
+    h, (new_k, new_v) = _scan_layers(
+        cfg, params, h, layer, (pool.k, pool.v)
+    )
+    pool = write_chunk_to_pool(pool, table, new_k, new_v, positions)
+    if not with_logits:
+        return None, pool
+    h = rms_norm(h, params["final_norm"], cfg.rms_eps)
+    last_idx = jnp.maximum(lengths - 1, 0)
+    last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]  # [B, D]
+    return _unembed(cfg, params, last), pool
+
+
+def write_rows_to_cache(
+    cache: KVCache,
+    slot: jnp.ndarray,  # scalar int32 — destination slot
+    ks: jnp.ndarray,  # [L, 1, T, K, Hd]
+    vs: jnp.ndarray,
+    start_row: jnp.ndarray,  # scalar int32 — first destination row
+) -> KVCache:
+    """Write T contiguous rows starting at `start_row` into one DENSE slot —
+    the dense-cache counterpart of write_rows_to_pool (chunked prefill
+    writes each chunk's rows mid-sequence)."""
+    k = jax.lax.dynamic_update_slice(
+        cache.k, ks[:, :1].astype(cache.k.dtype), (0, slot, start_row, 0, 0)
+    )
+    v = jax.lax.dynamic_update_slice(
+        cache.v, vs[:, :1].astype(cache.v.dtype), (0, slot, start_row, 0, 0)
+    )
+    return KVCache(k=k, v=v)
+
+
 def write_prefill_to_pool(
     pool: KVCache,
     table_row: jnp.ndarray,  # [MP] int32 — the destination slot's pages
@@ -1355,7 +1471,8 @@ def write_prefill_to_pool(
 ) -> KVCache:
     """Copy one prefilled request's KV into its pages. The prompt starts at
     row 0, so writes are page-aligned; the (static) trailing partial page
-    writes whatever fits."""
+    writes whatever fits. Chunked admission (EngineConfig.prefill_chunk)
+    bypasses this dense-bucket scatter entirely — see prefill_chunk_paged."""
     Sb = ks.shape[2]
     page = pool.k.shape[2]
     k, v = pool.k, pool.v

@@ -52,14 +52,9 @@ def prefill_attention(
         and jax.default_backend() == "tpu"
         and (S & (S - 1)) == 0  # power-of-two bucket, divisible by any block
     ):
-        from localai_tpu.ops.flash import flash_prefill_attention
+        from localai_tpu.ops.flash import flash_block_sizes, flash_prefill_attention
 
-        # Bigger tiles at long context: the kernel grid is
-        # B·H·(S/bq)·(S/bk) steps, and per-step fixed cost dominates past
-        # ~8k (a 32k prefill at 128x128 tiles is ~1M grid steps). VMEM per
-        # step stays tiny (bq·D + 2·bk·D floats).
-        bq = min(256, S)
-        bk = min(512, S)
+        bq, bk = flash_block_sizes(S)
         return flash_prefill_attention(q, k, v, lengths, block_q=bq, block_k=bk)
     return causal_prefill_attention(q, k, v, length_mask, softcap=softcap,
                                     window=window, sliding=sliding)
@@ -526,6 +521,31 @@ def paged_partials_mq(q, k_pool, v_pool, table, limits, softcap: float = 0.0,
 
     if use_pallas(impl):
         return paged_decode_partials_mq(
+            q, k_pool, v_pool, table, limits, softcap=softcap, window=window,
+            sliding=sliding, q_pos=q_pos,
+            interpret=jax.default_backend() != "tpu",
+        )
+    return _paged_cache_partials_mq(
+        q, k_pool, v_pool, table, limits,
+        softcap=softcap, window=window, sliding=sliding, q_pos=q_pos,
+    )
+
+
+def paged_prefill_partials(q, k_pool, v_pool, table, limits,
+                           softcap: float = 0.0, window: int = 0,
+                           sliding=None, q_pos=None, impl: str = "auto"):
+    """Paged partials for a PREFILL CHUNK (models/llama.prefill_chunk_paged):
+    q [B, T, H, D] covers a whole chunk, limits[b] is the rows already
+    resident (the chunk's start offset). Same dispatch as paged_partials_mq,
+    but the Pallas side tiles the chunk's query rows so any chunk size fits
+    the kernel's VMEM running state (ops/paged_flash.paged_prefill_partials_mq)."""
+    from localai_tpu.ops.paged_flash import (
+        paged_prefill_partials_mq,
+        use_pallas,
+    )
+
+    if use_pallas(impl):
+        return paged_prefill_partials_mq(
             q, k_pool, v_pool, table, limits, softcap=softcap, window=window,
             sliding=sliding, q_pos=q_pos,
             interpret=jax.default_backend() != "tpu",

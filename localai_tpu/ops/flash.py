@@ -28,6 +28,16 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def flash_block_sizes(S: int) -> tuple[int, int]:
+    """(block_q, block_k) for a length-S prefill. Bigger tiles at long
+    context: the grid is B·H·(S/bq)·(S/bk) steps and per-step fixed cost
+    dominates past ~8k (a 32k prefill at 128×128 tiles is ~1M grid steps);
+    VMEM per step stays tiny (bq·D + 2·bk·D floats). Shared by the dense
+    prefill dispatcher (ops/attention.prefill_attention) and the chunked
+    admission path so both pick identical tiles for a given bucket."""
+    return min(256, S), min(512, S)
+
+
 def _flash_kernel(
     lengths_ref,  # scalar-prefetch [B]
     q_ref,  # [1, 1, BQ, D]

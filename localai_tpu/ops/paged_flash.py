@@ -39,6 +39,12 @@ CPU against it).
 The m/l outputs are padded to 128 lanes (STAT_LANES) and sliced by the
 wrapper: a 1-wide lane dimension is a legal VMEM scratch shape but a
 pathological output tiling on real hardware.
+
+The same kernel also serves CHUNKED RAGGED PREFILL (docs/CHUNKED_PREFILL.md):
+paged_prefill_partials_mq tiles a prefill chunk's T·G query rows so the
+online-softmax running state fits VMEM, and models/llama.prefill_chunk_paged
+folds the partials with the in-chunk causal window and scatters the chunk's
+fresh K/V straight into the slot's pages — no dense-bucket intermediate.
 """
 
 from __future__ import annotations
@@ -305,3 +311,57 @@ def paged_decode_partials_mq(
     m = m.reshape(B, K, T, G, 1).transpose(0, 1, 3, 2, 4)
     l = l.reshape(B, K, T, G, 1).transpose(0, 1, 3, 2, 4)
     return acc, m, l
+
+
+# Query rows the ragged kernel may hold in VMEM at once. The kernel keeps
+# every query row's running (acc, m, l) in VMEM scratch for the whole page
+# walk — at 8 kv heads × Dv 128 that is ~4 KB of f32 per row, so a 512-token
+# prefill chunk with G=4 query rows per kv head (2048 rows ≈ 8 MB of acc
+# alone, plus the q tile) blows the 16 MB scoped-VMEM budget. Prefill chunks
+# therefore tile the token axis; each tile re-streams the prefix pages —
+# the same O(T/tile) prefix re-read the dense flash kernel pays per q block.
+PREFILL_MAX_QROWS = 512
+
+
+def paged_prefill_partials_mq(
+    q: jnp.ndarray,  # [B, T, H, D] — T = prefill-chunk tokens
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    table: jnp.ndarray,
+    limits: jnp.ndarray,  # [B] — rows already resident (the chunk's offset)
+    softcap: float = 0.0,
+    window: int = 0,
+    sliding=None,
+    q_pos=None,  # [B, T] global positions of the chunk tokens
+    interpret: bool = False,
+    max_qrows: int = PREFILL_MAX_QROWS,
+):
+    """`paged_decode_partials_mq` for prefill-chunk query counts: the T·G
+    query-row axis is tiled to `max_qrows` per kernel launch so the chunked
+    ragged prefill (models/llama.prefill_chunk_paged) rides the same
+    scalar-prefetch page-table kernel as decode at any chunk size. Tiles
+    are a static unroll (T and the tile are both static under jit); partials
+    concatenate back along T — each token's (acc, m, l) is independent, so
+    tiling is exact."""
+    B, T, H, D = q.shape
+    K = k_pool.shape[2]
+    G = H // K
+    if q_pos is None:
+        q_pos = jnp.broadcast_to(limits[:, None], (B, T))
+    tq = max(1, max_qrows // max(G, 1))  # tokens per tile
+    if T <= tq:
+        return paged_decode_partials_mq(
+            q, k_pool, v_pool, table, limits, softcap=softcap, window=window,
+            sliding=sliding, q_pos=q_pos, interpret=interpret,
+        )
+    parts = []
+    for lo in range(0, T, tq):
+        hi = min(lo + tq, T)
+        parts.append(paged_decode_partials_mq(
+            q[:, lo:hi], k_pool, v_pool, table, limits, softcap=softcap,
+            window=window, sliding=sliding, q_pos=q_pos[:, lo:hi],
+            interpret=interpret,
+        ))
+    return tuple(
+        jnp.concatenate([p[i] for p in parts], axis=3) for i in range(3)
+    )

@@ -503,6 +503,7 @@ class ModelManager:
                 kv_pages=cfg.kv_pages, kv_page_size=cfg.kv_page_size,
                 kv_cache_dtype=cfg.kv_cache_dtype,
                 paged_kernel=cfg.paged_kernel,
+                prefill_chunk=cfg.prefill_chunk,
             ),
             draft_cfg=draft_arch,
             draft_params=draft_params,

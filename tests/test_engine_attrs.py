@@ -1,0 +1,48 @@
+"""tools/check_engine_attrs wired into tier-1: the Engine class must never
+read a `self._x` attribute that construction does not assign — the exact
+loop-thread AttributeError class that turned BENCH_r05 into rc=124 (the
+admission path read _admit_hold_start/_last_submit_t before any assignment,
+the loop died, and every caller hung on its token queue forever)."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from check_engine_attrs import check_class  # noqa: E402
+
+ENGINE_PY = os.path.join(REPO, "localai_tpu", "engine", "engine.py")
+
+
+def test_engine_reads_are_all_initialized():
+    findings = check_class(ENGINE_PY, "Engine")
+    assert findings == [], (
+        "Engine reads attributes never assigned during construction "
+        "(loop-thread AttributeError — BENCH_r05 rc=124 bug class): "
+        + "; ".join(f"self.{a} in {m}() at line {ln}" for a, m, ln in findings)
+    )
+
+
+def test_checker_catches_the_bench_r05_bug_class(tmp_path):
+    """The detector itself must flag an uninitialized loop-path read (and
+    honor hasattr-guarded lazy caches + __init__-called helpers)."""
+    p = tmp_path / "synthetic.py"
+    p.write_text(
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self.a = 1\n"
+        "        self._build()\n"
+        "    def _build(self):\n"
+        "        self.b = 2\n"
+        "    def loop(self):\n"
+        "        if self._hold == 0.0:\n"   # the BENCH_r05 pattern
+        "            self._hold = 1.0\n"
+        "        self.c = self.b + self.a\n"
+        "    def lazy(self):\n"
+        "        if not hasattr(self, '_cache'):\n"
+        "            self._cache = {}\n"
+        "        return self._cache\n"
+    )
+    findings = check_class(str(p), "Engine")
+    assert [f[0] for f in findings] == ["_hold"], findings

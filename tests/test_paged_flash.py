@@ -190,6 +190,95 @@ def test_partials_fp8_pool():
     _assert_partials_close(got, want, tol=1e-3)
 
 
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_prefill_chunk_paged_matches_single_shot(impl):
+    """Chunked direct-to-page prefill (models/llama.prefill_chunk_paged) ==
+    single-shot prefill + write_prefill_to_pool: same last-position logits
+    and the same KV rows land in the pool — for both the XLA walk and the
+    Pallas kernel (interpret mode on CPU)."""
+    import os
+
+    from localai_tpu.models import get_arch
+    from localai_tpu.models.llama import (
+        init_params,
+        paged_cache_zeros,
+        prefill,
+        prefill_chunk_paged,
+        write_prefill_to_pool,
+    )
+
+    cfg = get_arch("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    page, MP, P = 16, 4, 12
+    plen, chunk = 50, 32
+    ids = [(j * 7) % 250 + 1 for j in range(plen)]
+    Sb = 64  # single-shot bucket
+
+    # Reference: one dense-bucket prefill scattered into pages.
+    toks = jnp.zeros((1, Sb), jnp.int32).at[0, :plen].set(jnp.asarray(ids))
+    ref_logits, ref_ks, ref_vs = prefill(
+        cfg, params, toks, jnp.asarray([plen], jnp.int32)
+    )
+    table = _table(1, MP, P, seed=7)
+    pool_ref = paged_cache_zeros(cfg, P, page)
+    pool_ref = write_prefill_to_pool(pool_ref, table[0], ref_ks, ref_vs, 0)
+
+    # Chunked: two ragged chunks (32 + 18) written directly to pages.
+    os.environ.pop("LOCALAI_PAGED_KERNEL", None)
+    pool = paged_cache_zeros(cfg, P, page)
+    logits = None
+    for lo in range(0, plen, chunk):
+        seg = ids[lo: lo + chunk]
+        tb = chunk if len(seg) == chunk else 32  # bucket the ragged tail
+        ctoks = jnp.zeros((1, tb), jnp.int32).at[0, : len(seg)].set(
+            jnp.asarray(seg)
+        )
+        logits, pool = prefill_chunk_paged(
+            cfg, params, ctoks, jnp.asarray([len(seg)], jnp.int32),
+            jnp.asarray([lo], jnp.int32), pool, table, paged_impl=impl,
+        )
+
+    assert jnp.allclose(logits, ref_logits, atol=5e-2), float(
+        jnp.abs(logits - ref_logits).max()
+    )
+    # Only rows the prompt actually wrote are comparable (padding rows
+    # differ by construction): gather the live rows through the table.
+    live = np.arange(plen)
+    pids = np.asarray(table[0])[live // page]
+    got_k = np.asarray(pool.k[:, pids, live % page], np.float32)
+    want_k = np.asarray(pool_ref.k[:, pids, live % page], np.float32)
+    got_v = np.asarray(pool.v[:, pids, live % page], np.float32)
+    want_v = np.asarray(pool_ref.v[:, pids, live % page], np.float32)
+    assert np.abs(got_k - want_k).max() < 2e-2
+    assert np.abs(got_v - want_v).max() < 2e-2
+
+
+def test_paged_prefill_partials_tiling_exact():
+    """The prefill wrapper's query-row tiling (VMEM bound) must be exact:
+    tiled partials == one-shot kernel partials for a chunk larger than the
+    tile."""
+    from localai_tpu.ops.paged_flash import (
+        paged_decode_partials_mq,
+        paged_prefill_partials_mq,
+    )
+
+    B, T, H, K, D, MP, P = 1, 12, 4, 2, 32, 4, 10
+    q = jax.random.normal(jax.random.key(20), (B, T, H, D))
+    k_pool, v_pool = _pool(jax.random.key(21), P, PAGE, K, D)
+    table = _table(B, MP, P, seed=8)
+    limits = jnp.array([40], jnp.int32)
+    q_pos = limits[:, None] + jnp.arange(T)[None, :]
+
+    want = paged_decode_partials_mq(
+        q, k_pool, v_pool, table, limits, q_pos=q_pos, interpret=True,
+    )
+    got = paged_prefill_partials_mq(
+        q, k_pool, v_pool, table, limits, q_pos=q_pos, interpret=True,
+        max_qrows=8,  # forces 3 tiles of 4 tokens (G=2 rows per token)
+    )
+    _assert_partials_close(got, want)
+
+
 def test_engine_paged_pallas_matches_xla_greedy():
     """End-to-end: a paged engine forced onto the Pallas kernel (interpret
     mode on CPU) decodes the same greedy tokens as the XLA reference."""

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Static pass: flag `self.x` attributes READ somewhere in a class but never
+assigned during construction.
+
+The exact bug class that killed BENCH_r05 (rc=124): the engine-loop
+admission path read `self._admit_hold_start` / `self._last_submit_t` before
+any code path had ever assigned them — the loop thread died of
+AttributeError on the first idle admission and every caller hung on a token
+queue forever. Python has no compiler to catch this; this AST pass does.
+
+Rule: every attribute the class loads (`self.x` in Load context, or reads
+via `self.x += ...`) must be assigned by construction — in `__init__`, in a
+method `__init__` (transitively) calls on self, or at class level — or be a
+method/property of the class. Attributes probed with `hasattr(self, "x")`
+anywhere in the class are exempt (lazy-init caches declare themselves that
+way).
+
+Usage:
+    python tools/check_engine_attrs.py [path] [ClassName]
+defaults to localai_tpu/engine/engine.py Engine. Exit 1 on findings; also
+wired into tier-1 via tests/test_engine_attrs.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+DEFAULT_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "localai_tpu", "engine", "engine.py",
+)
+
+
+def _self_name(fn: ast.FunctionDef) -> str | None:
+    """The instance-receiver arg name, or None for static/class methods
+    (a classmethod's first arg binds the type — attribute reads on it
+    resolve against class attributes, out of scope here)."""
+    for dec in fn.decorator_list:
+        name = dec.id if isinstance(dec, ast.Name) else getattr(dec, "attr", "")
+        if name in ("staticmethod", "classmethod"):
+            return None
+    args = fn.args.posonlyargs + fn.args.args
+    return args[0].arg if args else None
+
+
+def _attr_stores(fn: ast.FunctionDef) -> set[str]:
+    """Names assigned as `self.x = ...` (tuple targets included) anywhere in
+    the function. AugAssign does NOT count — `self.x += 1` requires a prior
+    binding, i.e. it is a read."""
+    me = _self_name(fn)
+    out: set[str] = set()
+    if me is None:
+        return out
+    for node in ast.walk(fn):
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        for t in targets:
+            for tt in ast.walk(t):
+                if (isinstance(tt, ast.Attribute)
+                        and isinstance(tt.value, ast.Name)
+                        and tt.value.id == me):
+                    out.add(tt.attr)
+    return out
+
+
+def _attr_reads(fn: ast.FunctionDef) -> dict[str, int]:
+    """{attr: first line} for `self.x` loads (and AugAssign reads)."""
+    me = _self_name(fn)
+    out: dict[str, int] = {}
+    if me is None:
+        return out
+    for node in ast.walk(fn):
+        attr = None
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == me):
+            if isinstance(node.ctx, ast.Load):
+                attr = node.attr
+            elif isinstance(node.ctx, ast.Store):
+                continue
+        if isinstance(node, ast.AugAssign):
+            t = node.target
+            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id == me):
+                attr = t.attr
+        if attr is not None:
+            out.setdefault(attr, node.lineno)
+    return out
+
+
+def _self_calls(fn: ast.FunctionDef) -> set[str]:
+    """Method names invoked as `self.m(...)` — the __init__ call graph."""
+    me = _self_name(fn)
+    out: set[str] = set()
+    if me is None:
+        return out
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == me):
+            out.add(node.func.attr)
+    return out
+
+
+def _hasattr_probes(cls: ast.ClassDef) -> set[str]:
+    """Attr names checked via hasattr(self, "x") anywhere in the class."""
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "hasattr" and len(node.args) == 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)):
+            out.add(node.args[1].value)
+    return out
+
+
+def check_class(path: str, class_name: str) -> list[tuple[str, str, int]]:
+    """Returns [(attr, method, line)] for attributes read but never
+    assigned during construction."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    cls = next(
+        (n for n in ast.walk(tree)
+         if isinstance(n, ast.ClassDef) and n.name == class_name),
+        None,
+    )
+    if cls is None:
+        raise SystemExit(f"class {class_name} not found in {path}")
+    methods = {
+        n.name: n for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    class_level: set[str] = set()
+    for n in cls.body:
+        if isinstance(n, ast.Assign):
+            class_level |= {t.id for t in n.targets if isinstance(t, ast.Name)}
+        elif isinstance(n, ast.AnnAssign) and isinstance(n.target, ast.Name):
+            class_level.add(n.target.id)
+
+    # Attributes assigned during construction: __init__ plus every method it
+    # (transitively) calls on self.
+    assigned: set[str] = set(class_level) | set(methods)
+    seen: set[str] = set()
+    frontier = ["__init__"]
+    while frontier:
+        name = frontier.pop()
+        if name in seen or name not in methods:
+            continue
+        seen.add(name)
+        assigned |= _attr_stores(methods[name])
+        frontier.extend(_self_calls(methods[name]))
+
+    exempt = _hasattr_probes(cls)
+    findings: list[tuple[str, str, int]] = []
+    for name, fn in methods.items():
+        for attr, line in sorted(_attr_reads(fn).items(), key=lambda kv: kv[1]):
+            if attr in assigned or attr in exempt:
+                continue
+            if attr.startswith("__") and attr.endswith("__"):
+                continue  # dunders resolve on the type
+            findings.append((attr, name, line))
+    return sorted(set(findings), key=lambda f: f[2])
+
+
+def main(argv: list[str]) -> int:
+    path = argv[1] if len(argv) > 1 else DEFAULT_PATH
+    class_name = argv[2] if len(argv) > 2 else "Engine"
+    findings = check_class(path, class_name)
+    for attr, method, line in findings:
+        print(
+            f"{path}:{line}: self.{attr} read in {class_name}.{method}() "
+            f"but never assigned in __init__ (loop-thread AttributeError "
+            f"waiting to happen — BENCH_r05 rc=124 was exactly this)"
+        )
+    if findings:
+        return 1
+    print(f"{class_name}: all attribute reads covered by construction")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))
