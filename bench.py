@@ -398,6 +398,82 @@ def main() -> None:
                 peng.cache = None
                 peng = None
 
+    # Over-subscription row (ISSUE 3 on-demand KV growth): 2×slots requests
+    # claim max_tokens near max_seq but produce SHORT real outputs (a stop
+    # string learned from a probe run) on a pool sized so the old up-front
+    # reservation planner admits only pool // worst_pages at a time. Emits
+    # the measured on-demand concurrency next to the old planner's, then a
+    # second, genuinely-overcommitted phase times the preempt/restore
+    # cycle. JSON contract: adds paged_upfront_concurrency,
+    # paged_ondemand_concurrency, paged_preempt_recover_ms.
+    if os.environ.get("BENCH_OVERSUB", "1") != "0" and max_seq % 128 == 0:
+        oeng = None
+        try:
+            page = 128
+            b = 1
+            while b < prompt_len:
+                b *= 2
+            prompt_pages = -(-b // page)
+            pool = slots * (prompt_pages + 1)
+            oeng = Engine(
+                cfg, params, ByteTokenizer(cfg.vocab_size),
+                engine_cfg=EngineConfig(max_slots=slots, max_seq=max_seq,
+                                        kv_pages=pool, kv_page_size=page),
+            )
+            oeng.start()
+            oeng.warmup(prompt_len)
+            near = max_seq - prompt_len - 1
+            worst = -(-min(prompt_len + near, max_seq) // page)
+            upfront = max(1, pool // worst)
+            probe_ids = [(j * 31) % 255 + 1 for j in range(prompt_len)]
+            probe, _ = oeng.generate(probe_ids, max_new_tokens=24,
+                                     ignore_eos=True)
+            ostop = [probe[8:14] or "\x00"]
+            oeng.m_peak_active = 0
+
+            def oone(i: int) -> None:
+                ids = [(i * 41 + j) % 255 + 1 for j in range(prompt_len)]
+                oeng.generate(ids, max_new_tokens=near, ignore_eos=True,
+                              stop=ostop)
+
+            othreads = [threading.Thread(target=oone, args=(i,))
+                        for i in range(2 * slots)]
+            for t in othreads:
+                t.start()
+            _join_or_die(othreads, oeng, "oversubscription row")
+            out["paged_upfront_concurrency"] = upfront
+            out["paged_ondemand_concurrency"] = int(oeng.m_peak_active)
+            # Phase 2: genuinely overcommit (slots × gen_len long outputs
+            # against the same small pool) so growth collides and the
+            # preempt → swap/recompute → resume cycle gets timed.
+            over = [threading.Thread(target=lambda i=i: oeng.generate(
+                [(i * 53 + j) % 255 + 1 for j in range(prompt_len)],
+                max_new_tokens=gen_len, ignore_eos=True,
+            )) for i in range(slots)]
+            for t in over:
+                t.start()
+            _join_or_die(over, oeng, "oversubscription preempt phase")
+            recov = (oeng.m_kv_preempt_recover_ms / oeng.m_kv_preemptions
+                     if oeng.m_kv_preemptions else 0.0)
+            out["paged_preempt_recover_ms"] = round(recov, 2)
+            out["paged_preemptions"] = int(oeng.m_kv_preemptions)
+            out["paged_pages_grown"] = int(oeng.m_kv_pages_grown)
+            print(
+                f"oversub: on-demand admits {out['paged_ondemand_concurrency']} "
+                f"vs up-front {upfront} on a {pool}-page pool; "
+                f"{oeng.m_kv_preemptions} preemptions, recover {recov:.1f} ms",
+                file=sys.stderr,
+            )
+        except Exception as e:  # noqa: BLE001 — extra row is best-effort
+            print(f"oversubscription row failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+        finally:
+            if oeng is not None:
+                oeng.stop()
+                oeng.params = None
+                oeng.cache = None
+                oeng = None
+
     # Prompt/prefix-cache rows (VERDICT r4 item 3), dense and paged: a LONG
     # shared prefix (4000 tokens, dedicated 8k-seq engines) so the prefill
     # saving (~0.5 s at measured rates) dominates tunnel-RTT noise — at a

@@ -99,6 +99,18 @@ class ModelConfig:
     # context instead of max_slots × context_size. 0 = dense cache.
     kv_pages: int = 0
     kv_page_size: int = 128
+    # On-demand KV page growth (docs/PAGED_ATTENTION.md): admission
+    # reserves only the prompt's pages + this headroom; decode grows the
+    # table as the context actually extends. LOCALAI_KV_PAGE_HEADROOM
+    # env var overrides.
+    kv_page_headroom: int = 1
+    # Mid-decode pool-exhaustion policy: swap | recompute | auto (see
+    # EngineConfig.kv_preempt). LOCALAI_KV_PREEMPT env var overrides.
+    kv_preempt: str = "auto"
+    # Host-RAM budget for preempt-swap images + spilled prefix-cache spans
+    # (the prefix cache's second level). 0 disables the tier.
+    # LOCALAI_KV_SWAP_BYTES env var overrides.
+    kv_swap_bytes: int = 256 << 20
     # KV-cache storage dtype (reference: cache_type_k/cache_type_v →
     # CacheTypeKey/Value, backend.proto:261-262). "fp8" halves KV HBM — 2x
     # servable context at the same pool size. Empty = model dtype.

@@ -140,11 +140,41 @@ class EngineConfig:
     # kv_pages > 0 replaces the dense [slots, max_seq] cache with a shared
     # page pool — HBM scales with live context, not slots × max_seq, so many
     # short chats and one long one share a pool neither could afford dense.
-    # Admission reserves a request's worst case (prompt + max_new_tokens)
-    # up front: pool exhaustion queues new requests (backpressure) instead
-    # of preempting live ones. 0 = dense cache.
+    # Admission reserves only the prompt's pages plus kv_page_headroom
+    # (ISSUE 3 on-demand growth); the decode loop grows each slot's table
+    # host-side as its context crosses page boundaries, and genuine pool
+    # exhaustion mid-decode preempts the youngest slot (kv_preempt) instead
+    # of deadlocking. 0 = dense cache.
     kv_pages: int = 0
     kv_page_size: int = 128
+    # Extra pages allocated beyond the prompt bucket at admission so the
+    # first decode blocks never stall on a host-side growth check. The
+    # difference between this and the old planner is the whole point of
+    # on-demand growth: reservation was ceil((prompt+max_new)/page), which
+    # for generous max_tokens gated concurrency on pages that were mostly
+    # never written. LOCALAI_KV_PAGE_HEADROOM env var overrides.
+    kv_page_headroom: int = 1
+    # What to do when on-demand growth finds the pool empty mid-decode
+    # (after evicting prefix-cache spans): preempt the youngest live slot.
+    #   "swap"      — copy the victim's pages to the bounded host-RAM tier
+    #                 (kv_swap_bytes) and restore them on re-admission; the
+    #                 victim resumes byte-exactly (RNG chain included).
+    #   "recompute" — drop the pages and re-admit prompt+generated through
+    #                 the ordinary (chunked) prefill path; byte-exact for
+    #                 greedy decoding, chain-preserving otherwise.
+    #   "auto"      — swap for short contexts (span fits a quarter of
+    #                 kv_swap_bytes), recompute for long ones.
+    # Engines with a draft model always recompute (the draft's dense KV has
+    # no swap image); grammar-constrained slots are preempted only as a
+    # last resort, always via recompute (the host machine is replayed).
+    # LOCALAI_KV_PREEMPT env var overrides.
+    kv_preempt: str = "auto"
+    # Byte budget for the pinned host-RAM tier shared by preempt-swap images
+    # and spilled prefix-cache spans (the prefix cache's second level:
+    # spans evicted for pool pressure land here and swap back in on a hit
+    # instead of being re-prefilled). 0 disables the tier (preempt falls
+    # back to recompute). LOCALAI_KV_SWAP_BYTES env var overrides.
+    kv_swap_bytes: int = 256 << 20
     # Paged decode attention implementation (ops/paged_flash): "auto" runs
     # the fused ragged paged-attention Pallas kernel on TPU (page-table walk
     # in-kernel, KV pages streamed HBM→VMEM once, per-slot ragged bounds)
@@ -233,6 +263,11 @@ class GenRequest:
     # Qwen2-VL m-rope: [3, len(prompt_ids)] (t, h, w) position streams
     # (models/qwen2_vl.mrope_positions_for_span). None → standard rope.
     mrope_positions: Optional[Any] = None
+    # INTERNAL — set by the engine when it preempts a slot (ISSUE 3).
+    # Carries the victim's host-side continuation state (generated tokens,
+    # RNG chain, swap image) so re-admission resumes the original stream
+    # instead of starting over. Never set by callers.
+    resume: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -290,6 +325,11 @@ class _Slot:
     generated: list[int] = dataclasses.field(default_factory=list)
     emitted_len: int = 0  # chars of decoded text already streamed
     scheduled: int = 0  # decode steps dispatched (>= len(generated))
+    # Upper bound on KV rows dispatched writes may touch (prompt rows +
+    # decode steps scheduled) — what on-demand page growth must cover
+    # BEFORE the next block dispatch (ISSUE 3). Spec rounds advance it by
+    # their whole window, a safe overestimate.
+    sched_rows: int = 0
     t_submit: float = 0.0
     t_first: float = 0.0
     # Grammar enforced on device via DFA tables (functions/dfa.py): the host
@@ -360,6 +400,20 @@ class Engine:
             self.ecfg = dataclasses.replace(
                 self.ecfg, prefill_chunk=int(env_chunk)
             )
+        for env, (fname, conv) in {
+            "LOCALAI_KV_PAGE_HEADROOM": ("kv_page_headroom", int),
+            "LOCALAI_KV_PREEMPT": ("kv_preempt", str),
+            "LOCALAI_KV_SWAP_BYTES": ("kv_swap_bytes", int),
+        }.items():
+            val = os.environ.get(env)
+            if val is not None and val != "":
+                self.ecfg = dataclasses.replace(self.ecfg, **{fname: conv(val)})
+        if self.ecfg.kv_preempt not in ("swap", "recompute", "auto"):
+            raise ValueError(
+                f"kv_preempt={self.ecfg.kv_preempt!r}: use swap|recompute|auto"
+            )
+        if self.ecfg.kv_page_headroom < 0:
+            raise ValueError("kv_page_headroom must be >= 0")
         C = self.ecfg.prefill_chunk
         if C:
             if C < self.ecfg.min_prefill_bucket or C & (C - 1):
@@ -597,36 +651,89 @@ class Engine:
         # pages mapped read-only into later admissions' tables). A page
         # returns to the free list only at refcount 0.
         self._page_refs = np.zeros((max(self.ecfg.kv_pages, 1),), np.int32)
+        # On-demand growth + preemption + host swap tier (ISSUE 3).
+        # _growth_blocked: a decode-block dispatch could not grow some
+        # slot's table — new admissions pause and, once the in-flight queue
+        # drains, the youngest slot is preempted. _prefix_host is the
+        # second (host-RAM) level of the prefix cache: spans evicted for
+        # pool pressure spill here (bounded by kv_swap_bytes, shared with
+        # preempt-swap images tracked in _host_bytes) and swap back into
+        # pool pages on a hit instead of being re-prefilled.
+        self._growth_blocked = False
+        self._prefix_host: list[dict] = []
+        self._host_bytes = 0
+        self.m_kv_pages_grown = 0
+        self.m_kv_preemptions = 0
+        self.m_kv_preempt_swaps = 0
+        self.m_kv_preempt_recomputes = 0
+        self.m_kv_swap_bytes_out = 0
+        self.m_kv_swap_bytes_in = 0
+        self.m_kv_preempt_recover_ms = 0.0
+        self.m_prefix_host_hits = 0
+        self.m_peak_active = 0
         self._build_programs()
 
     @property
     def _paged(self) -> bool:
         return self.ecfg.kv_pages > 0
 
-    def _pages_needed(self, request: GenRequest) -> int:
+    def _pages_worst(self, request: GenRequest) -> int:
         """Worst-case pages for a request: the prefill writes a full bucket
-        of rows (padding included), and decode extends to prompt+max_new."""
+        of rows (padding included), and decode may extend to prompt+max_new.
+        Used only as the can-this-EVER-be-served gate (submit) and as the
+        on-demand headroom cap — admission no longer reserves this."""
         plen = len(request.prompt_ids)
         rows = max(self._bucket_for(plen),
                    min(plen + request.max_new_tokens, self.ecfg.max_seq))
         return -(-rows // self.ecfg.kv_page_size)
 
-    def _pages_needed_cached(self, request: GenRequest, match_len: int) -> int:
-        """Fresh pages for a prefix-hit admission: the span's pages are
-        shared (zero cost), only the tail bucket + decode growth allocate."""
+    def _pages_needed(self, request: GenRequest) -> int:
+        """On-demand admission need (ISSUE 3): pages covering the prompt's
+        prefill bucket (the prefill writes the whole bucket, padding
+        included) plus kv_page_headroom for the first decode blocks —
+        decode growth allocates the rest as the context actually crosses
+        page boundaries. Headroom never pushes past the worst case."""
+        page = self.ecfg.kv_page_size
+        base = -(-self._bucket_for(len(request.prompt_ids)) // page)
+        cap = max(base, self._pages_worst(request))
+        return min(base + self.ecfg.kv_page_headroom, cap)
+
+    def _pages_needed_cached(self, request: GenRequest, match_len: int,
+                             host: bool = False) -> int:
+        """Fresh pages for a prefix-hit admission: device-tier spans are
+        shared (zero cost) and only the tail bucket + headroom allocate;
+        host-tier spans (spilled to RAM) must swap back into fresh pages,
+        so the span pages count too."""
         page = self.ecfg.kv_page_size
         plen = len(request.prompt_ids)
-        tb = self._bucket_for(plen - match_len)
-        total = max(match_len + tb,
-                    min(plen + request.max_new_tokens, self.ecfg.max_seq))
-        return -(-total // page) - match_len // page
+        shared = 0 if host else match_len // page
+        rows = match_len + self._bucket_for(plen - match_len)
+        base = -(-rows // page) - shared
+        worst = max(rows, min(plen + request.max_new_tokens, self.ecfg.max_seq))
+        cap = max(base, -(-worst // page) - shared)
+        return min(base + self.ecfg.kv_page_headroom, cap)
 
     def _pages_alloc(self, slot_idx: int, n: int,
                      shared: Optional[list[int]] = None) -> Optional[np.ndarray]:
         """Build a slot's page table: `shared` read-only prefix pages (a
         prefix-cache span — refcounted, never written by this slot because
         all its writes land at rows past the shared span) followed by `n`
-        freshly-allocated pages."""
+        freshly-allocated pages. A slot that already holds a table is a
+        caller bug — overwriting it would leak its pages' refcounts into
+        the pool forever, so the stale table is released first (and raised
+        under LOCALAI_ALLOC_DEBUG=1 / the test suite)."""
+        if self._slot_pages[slot_idx]:
+            if os.environ.get("LOCALAI_ALLOC_DEBUG", "0") == "1":
+                raise AssertionError(
+                    f"_pages_alloc: slot {slot_idx} already holds "
+                    f"{len(self._slot_pages[slot_idx])} pages"
+                )
+            log.error(
+                "_pages_alloc: slot %d already held a table (%d pages) — "
+                "releasing it to avoid a pool leak", slot_idx,
+                len(self._slot_pages[slot_idx]),
+            )
+            self._pages_free(slot_idx)
         if len(self._free_pages) < n:
             return None
         shared = shared or []
@@ -646,10 +753,65 @@ class Engine:
 
     def _pages_release(self, pages: list[int]) -> None:
         for p in pages:
-            self._page_refs[p] -= 1
             if self._page_refs[p] <= 0:
+                # Double release: the page is already free (or never
+                # allocated). Appending it to the free list AGAIN would let
+                # two slots pop the same page — clamp and flag instead.
+                if os.environ.get("LOCALAI_ALLOC_DEBUG", "0") == "1":
+                    raise AssertionError(f"double release of page {p}")
+                log.error("double release of page %d ignored", p)
                 self._page_refs[p] = 0
+                continue
+            self._page_refs[p] -= 1
+            if self._page_refs[p] == 0:
                 self._free_pages.append(p)
+
+    def _page_bytes(self) -> int:
+        """Host/device bytes of one page's K+V rows across all layers."""
+        return self._prefix_span_bytes(self.ecfg.kv_page_size)
+
+    def _pages_grow_slot(self, slot_idx: int, need_pages: int) -> bool:
+        """Extend a live slot's table to `need_pages` total pages — a HOST
+        array write (h_ptable ships with every dispatch), no recompile, no
+        device traffic. Evicts prefix-cache spans (spilling them to the
+        host tier) before reporting failure."""
+        need_pages = min(need_pages, self._max_pages)
+        have = len(self._slot_pages[slot_idx])
+        grow = need_pages - have
+        if grow <= 0:
+            return True
+        if len(self._free_pages) < grow:
+            self._prefix_evict_for_pages(grow)
+        if len(self._free_pages) < grow:
+            return False
+        fresh = [self._free_pages.pop() for _ in range(grow)]
+        for p in fresh:
+            self._page_refs[p] = 1
+        self._slot_pages[slot_idx].extend(fresh)
+        self.h_ptable[slot_idx, have:need_pages] = fresh
+        self.m_kv_pages_grown += grow
+        return True
+
+    def _grow_for_decode(self, steps: int) -> bool:
+        """Grow every active slot's table to cover the next `steps` decode
+        rows before a block is dispatched — rows written past a slot's last
+        allocated page would otherwise resolve through the SCRATCH tail and
+        be silently lost. Returns False (dispatch must not proceed) when
+        some slot cannot be grown; the loop then drains in-flight work and
+        preempts the youngest slot."""
+        if not self._paged:
+            return True
+        page = self.ecfg.kv_page_size
+        for i in range(self.ecfg.max_slots):
+            s = self.slots[i]
+            if s is None or not self.h_active[i]:
+                continue
+            rows = min(s.sched_rows + steps, self.ecfg.max_seq)
+            if not self._pages_grow_slot(i, -(-rows // page)):
+                self._growth_blocked = True
+                return False
+        self._growth_blocked = False
+        return True
 
     def _pages_free(self, slot_idx: int) -> None:
         self._pages_release(self._slot_pages[slot_idx])
@@ -657,6 +819,350 @@ class Engine:
         # The slot stays in every decode block's scatter until re-admitted —
         # its stale table must not alias pages handed to the next request.
         self.h_ptable[slot_idx] = self._scratch_page
+
+    # ------------------------------------------------------------------ #
+    # Preemption + host-RAM swap tier (ISSUE 3)
+    #
+    # When on-demand growth finds the pool empty (after spilling prefix
+    # spans), the loop drains all in-flight dispatches — every decode block
+    # writes EVERY slot's pages through the table it shipped, so a victim's
+    # pages cannot be recycled under an in-flight write — and preempts the
+    # youngest non-grammar slot. `swap` copies the victim's live pages to
+    # the bounded host tier and restores them (plus the slot's device rows,
+    # RNG chain included) on re-admission — byte-exact resume with no
+    # re-prefill. `recompute` re-admits prompt+generated through the
+    # ordinary (chunked) prefill path — byte-exact for greedy, RNG-chain-
+    # preserving otherwise. Either way the original stream continues: the
+    # resumed slot keeps its accumulated generated tokens and emitted text.
+    # ------------------------------------------------------------------ #
+
+    def _pow2_pages(self, n: int) -> int:
+        """Page-count bucket for the swap gather/scatter programs (compile
+        once per power of two, pad with SCRATCH/zeros)."""
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, max(self._max_pages, 1))
+
+    def _get_pages_gather(self, npgb: int):
+        key = ("pages-gather", npgb)
+        fn = self._block_cache.get(key)
+        if fn is None:
+            def gather(k, v, pages):
+                return k[:, pages], v[:, pages]
+
+            fn = jax.jit(gather)
+            self._block_cache[key] = fn
+        return fn
+
+    def _get_swap_in(self, npgb: int):
+        key = ("swap-in", npgb)
+        fn = self._block_cache.get(key)
+        if fn is None:
+            def swap_in(cache, pages, hk, hv):
+                k = cache.k.at[:, pages].set(hk.astype(cache.k.dtype))
+                v = cache.v.at[:, pages].set(hv.astype(cache.v.dtype))
+                return llama.KVCache(k=k, v=v)
+
+            fn = jax.jit(swap_in, donate_argnums=(0,))
+            self._block_cache[key] = fn
+        return fn
+
+    def _get_resume_restore(self):
+        """Reinstall a swapped-out slot's device rows in one dispatch."""
+        fn = self._block_cache.get(("resume-restore",))
+        if fn is None:
+            def restore(counts, rngs, bias, d_tokens, d_positions, slot,
+                        crow, brow, rngd, tok, pos):
+                counts = counts.at[slot].set(crow)
+                rngs = rngs.at[slot].set(jax.random.wrap_key_data(rngd))
+                bias = bias.at[slot].set(brow)
+                d_tokens = d_tokens.at[slot].set(tok)
+                d_positions = d_positions.at[slot].set(pos)
+                return counts, rngs, bias, d_tokens, d_positions
+
+            fn = jax.jit(restore, donate_argnums=(0, 1, 2, 3, 4))
+            self._block_cache[("resume-restore",)] = fn
+        return fn
+
+    def _get_rng_set(self):
+        fn = self._block_cache.get(("rng-set",))
+        if fn is None:
+            def setrng(rngs, slot, rngd):
+                return rngs.at[slot].set(jax.random.wrap_key_data(rngd))
+
+            fn = jax.jit(setrng, donate_argnums=(0,))
+            self._block_cache[("rng-set",)] = fn
+        return fn
+
+    def _swap_out_pages(self, pages: list[int]) -> tuple[np.ndarray, np.ndarray]:
+        """Pull a page span's K/V to host numpy. The gathered arrays are
+        device-side snapshots, so the pages themselves can be recycled the
+        moment this returns; the D2H copy is started async and awaited."""
+        npg = len(pages)
+        npgb = self._pow2_pages(npg)
+        idx = np.full((npgb,), self._scratch_page, np.int32)
+        idx[:npg] = pages
+        gk, gv = self._get_pages_gather(npgb)(
+            self.cache.k, self.cache.v, jnp.asarray(idx)
+        )
+        _host_copy_async(gk)
+        _host_copy_async(gv)
+        hk = np.ascontiguousarray(np.asarray(gk)[:, :npg])
+        hv = np.ascontiguousarray(np.asarray(gv)[:, :npg])
+        return hk, hv
+
+    def _swap_in_pages(self, pages: list[int], hk: np.ndarray,
+                       hv: np.ndarray) -> None:
+        """Scatter host K/V back into freshly-allocated pool pages."""
+        npg = len(pages)
+        npgb = self._pow2_pages(npg)
+        idx = np.full((npgb,), self._scratch_page, np.int32)
+        idx[:npg] = pages
+        if npgb > npg:
+            pad = ((0, 0), (0, npgb - npg), (0, 0), (0, 0), (0, 0))
+            hk = np.pad(hk, pad)
+            hv = np.pad(hv, pad)
+        self.cache = self._get_swap_in(npgb)(
+            self.cache, jnp.asarray(idx), jnp.asarray(hk), jnp.asarray(hv)
+        )
+
+    def _host_make_room(self, need: int) -> bool:
+        """Fit `need` bytes into the host tier by evicting LRU spilled
+        prefix spans. Pending swap images are never evicted — they are
+        required state, not cache."""
+        if need > self.ecfg.kv_swap_bytes:
+            return False
+        while (self._host_bytes + need > self.ecfg.kv_swap_bytes
+               and self._prefix_host):
+            dead = self._prefix_host.pop()
+            self._host_bytes -= dead["bytes"]
+        return self._host_bytes + need <= self.ecfg.kv_swap_bytes
+
+    def _host_bias_row(self, request: GenRequest) -> np.ndarray:
+        """The bias row the admission program would build — logit_bias plus
+        the padded-vocab mask — recomputed host-side for swap resume."""
+        from localai_tpu.ops.sampling import NEG_INF
+
+        V = self.cfg.vocab_size
+        row = np.zeros((V,), np.float32)
+        for tid, bval in request.logit_bias.items():
+            if 0 <= int(tid) < V:
+                row[int(tid)] = bval
+        tok_v = min(getattr(self.tokenizer, "vocab_size", V) or V, V)
+        if tok_v < V:
+            row[tok_v:] = NEG_INF
+        return row
+
+    def _resume_discard(self, request: GenRequest) -> None:
+        """Release a queued resume's host-tier bytes (cancellation path)."""
+        rec = request.resume
+        if rec is not None and "bytes" in rec:
+            self._host_bytes -= rec["bytes"]
+            rec.pop("hk", None)
+            rec.pop("hv", None)
+            rec["bytes"] = 0
+
+    def _preempt_youngest(self) -> None:
+        """Evict the youngest live slot so a growth-blocked older slot can
+        proceed. Caller guarantees the in-flight queue is EMPTY (drained by
+        the loop), so the victim's host/device state is a consistent
+        snapshot and its pages have no pending writes. Grammar-constrained
+        slots are preempted only as a last resort (recompute policy; a
+        device-DFA victim's host machine is re-seeded by replaying its
+        generated tokens) — their state is the most expensive to move."""
+        B = self.ecfg.max_slots
+        live = [i for i in range(B)
+                if self.h_active[i] and self.slots[i] is not None]
+        cands = [i for i in live if self.slots[i].request.grammar is None]
+        grammar_victim = False
+        if not cands:
+            cands = live
+            grammar_victim = True
+        if not cands:
+            return
+        victim = max(cands, key=lambda i: (self.slots[i].t_submit, i))
+        slot = self.slots[victim]
+        r = slot.request
+        page = self.ecfg.kv_page_size
+        ctx_rows = slot.prompt_len + len(slot.generated)
+        n_live = min(-(-ctx_rows // page), len(self._slot_pages[victim]))
+        span_bytes = n_live * self._page_bytes()
+        policy = self.ecfg.kv_preempt
+        if self.draft_cfg is not None:
+            policy = "recompute"  # the draft's dense KV has no swap image
+        elif grammar_victim:
+            # Swap cannot restore a DFA slot's device automaton row into a
+            # possibly-swapped table set; recompute re-admits through the
+            # host walk with the machine replayed below.
+            policy = "recompute"
+        elif policy == "auto":
+            policy = ("swap" if span_bytes * 4 <= self.ecfg.kv_swap_bytes
+                      else "recompute")
+        if policy == "swap" and (self.ecfg.kv_swap_bytes <= 0
+                                 or not self._host_make_room(span_bytes)):
+            policy = "recompute"
+        if grammar_victim and slot.dfa:
+            # The device DFA never advanced the host machine; replay the
+            # generated tokens so the host walk resumes from the right
+            # state (re-admission gates DFA off for resume requests).
+            for tok in slot.generated:
+                self._grammar_advance(r.grammar, int(tok))
+        rec = {
+            "mode": policy,
+            "orig_prompt_len": slot.prompt_len,
+            "generated": list(slot.generated),
+            "emitted_len": slot.emitted_len,
+            "t_submit": slot.t_submit,
+            "t_first": slot.t_first,
+            "t_preempt": time.monotonic(),
+            "rng": np.asarray(jax.random.key_data(self.rngs))[victim].copy(),
+            "rope_delta": int(self.h_rope_delta[victim]),
+        }
+        if policy == "swap":
+            pages = self._slot_pages[victim][:n_live]
+            hk, hv = self._swap_out_pages(pages)
+            rec.update({
+                "hk": hk, "hv": hv, "ctx_rows": ctx_rows,
+                "d_tok": int(np.asarray(self.d_tokens)[victim]),
+                "d_pos": int(np.asarray(self.d_positions)[victim]),
+                "bytes": span_bytes,
+            })
+            self._host_bytes += span_bytes
+            self.m_kv_swap_bytes_out += span_bytes
+            self.m_kv_preempt_swaps += 1
+        else:
+            self.m_kv_preempt_recomputes += 1
+        self.m_kv_preemptions += 1
+        resume_req = dataclasses.replace(
+            r, prompt_ids=list(r.prompt_ids) + list(slot.generated),
+            resume=rec,
+        )
+        handle = slot.handle
+        # Tear the slot down WITHOUT a terminal event — the handle lives on
+        # and the resumed slot keeps streaming into it. The generation bump
+        # makes any straggler result for this slot index drop on the floor.
+        self._slot_gen[victim] += 1
+        self.slots[victim] = None
+        self._chunkings = [st for st in self._chunkings
+                           if st["slot"] != victim]
+        self.h_active[victim] = False
+        self.h_override_mask[victim] = False
+        self.h_gmask[victim] = 0.0
+        self._pages_free(victim)
+        with self._pending_lock:
+            self._pending.appendleft((resume_req, handle))
+        # _growth_blocked stays SET: the freed pages belong to the growth-
+        # starved survivors first. Clearing it here would let the very next
+        # _admit_pending hand them straight back to this victim's resume
+        # (it sits at the queue head) and ping-pong the preemption forever;
+        # _grow_for_decode clears the flag once growth actually succeeds,
+        # and the loop clears it if every active slot drains away.
+        log.info("preempted slot %d (%s, ctx=%d rows) for page growth",
+                 victim, policy, ctx_rows)
+
+    def _resume_swap_pages(self, request: GenRequest) -> int:
+        """Pages a queued swap resume needs: its live span + headroom
+        (capped at the request's worst case)."""
+        rec = request.resume
+        page = self.ecfg.kv_page_size
+        n_live = rec["hk"].shape[1]
+        worst = -(-min(rec["orig_prompt_len"] + request.max_new_tokens,
+                       self.ecfg.max_seq) // page)
+        return min(n_live + self.ecfg.kv_page_headroom, max(n_live, worst))
+
+    def _dispatch_resume_swap(self, request: GenRequest,
+                              handle: RequestHandle, slot_idx: int) -> bool:
+        """Re-admit a swap-preempted request: allocate pages, scatter the
+        host image back, reinstall the slot's device rows — no prefill, no
+        sampling; the slot resumes decoding exactly where it stopped."""
+        rec = request.resume
+        total = self._resume_swap_pages(request)
+        row = self._pages_alloc(slot_idx, total)
+        if row is None:
+            return False
+        n_live = rec["hk"].shape[1]
+        self._swap_in_pages(self._slot_pages[slot_idx][:n_live],
+                            rec["hk"], rec["hv"])
+        V = self.cfg.vocab_size
+        crow = np.bincount(
+            np.asarray(request.prompt_ids, np.int64) % V, minlength=V
+        )[:V].astype(np.int32)
+        brow = self._host_bias_row(request)
+        (
+            self.counts, self.rngs, self.bias, self.d_tokens,
+            self.d_positions,
+        ) = self._get_resume_restore()(
+            self.counts, self.rngs, self.bias, self.d_tokens,
+            self.d_positions, jnp.int32(slot_idx), jnp.asarray(crow),
+            jnp.asarray(brow), jnp.asarray(rec["rng"]),
+            jnp.int32(rec["d_tok"]), jnp.int32(rec["d_pos"]),
+        )
+        for kf in _SAMPLING_FIELDS:
+            self.h_sampling[kf][slot_idx] = getattr(request, kf)
+        if self._mrope:
+            self.h_rope_delta[slot_idx] = rec["rope_delta"]
+        orig_req = dataclasses.replace(
+            request, prompt_ids=list(request.prompt_ids[: rec["orig_prompt_len"]]),
+            resume=None,
+        )
+        self._slot_gen[slot_idx] += 1
+        self.slots[slot_idx] = _Slot(
+            request=orig_req, handle=handle,
+            prompt_len=rec["orig_prompt_len"],
+            generated=list(rec["generated"]),
+            emitted_len=rec["emitted_len"],
+            scheduled=len(rec["generated"]),
+            sched_rows=rec["d_pos"],
+            t_submit=rec["t_submit"], t_first=rec["t_first"],
+        )
+        self.h_active[slot_idx] = True
+        self.h_override_mask[slot_idx] = False
+        self.h_gmask[slot_idx] = 0.0
+        self._host_bytes -= rec["bytes"]
+        self.m_kv_swap_bytes_in += rec["bytes"]
+        self.m_kv_preempt_recover_ms += (
+            (time.monotonic() - rec["t_preempt"]) * 1e3
+        )
+        self._last_admit_t = time.monotonic()
+        return True
+
+    def _apply_resume(self, slot_idx: int) -> None:
+        """Patch a freshly-admitted slot that is actually a recompute
+        resume: restore the original request identity, the accumulated
+        generated tokens and emitted text (stream continuity — the next
+        event continues the original handle mid-stream), and the RNG
+        chain."""
+        slot = self.slots[slot_idx]
+        rec = slot.request.resume if slot is not None else None
+        if rec is None:
+            return
+        orig = list(slot.request.prompt_ids[: rec["orig_prompt_len"]])
+        slot.request = dataclasses.replace(
+            slot.request, prompt_ids=orig, resume=None
+        )
+        slot.prompt_len = rec["orig_prompt_len"]
+        slot.generated = list(rec["generated"])
+        slot.emitted_len = rec["emitted_len"]
+        # The admission just sampled the NEXT token (it rides the tracked
+        # admit entry and will append to the restored list).
+        slot.scheduled = len(slot.generated) + 1
+        slot.t_submit = rec["t_submit"]
+        slot.t_first = rec["t_first"]
+        if self.draft_cfg is None:
+            # Continue the RNG chain: the uncontended run draws token g+2
+            # from split(k_{g+1}); the admission consumed its own fold_in
+            # draw for token g+1, so advance the saved key one split —
+            # every draw after the re-admission token then matches the
+            # uncontended run (greedy is byte-exact regardless).
+            key = jax.random.wrap_key_data(jnp.asarray(rec["rng"]))
+            nxt = jax.random.key_data(jax.random.split(key, 2)[0])
+            self.rngs = self._get_rng_set()(
+                self.rngs, jnp.int32(slot_idx), nxt
+            )
+        self.m_kv_preempt_recover_ms += (
+            (time.monotonic() - rec["t_preempt"]) * 1e3
+        )
 
     # ------------------------------------------------------------------ #
     # Compiled programs
@@ -1339,6 +1845,19 @@ class Engine:
         ring attention (sp>1 — the chunk path has no ring variant)."""
         return 0 if self._ring_mesh is not None else self.ecfg.prefill_chunk
 
+    def _chunk_admit_rows(self, total_len: int, match_len: int) -> int:
+        """Exact KV rows a chunked admission writes: the matched prefix,
+        the whole mid chunks (C tokens each), and the final tail's bucket
+        (padding rows included) — what on-demand page allocation must
+        cover at _chunk_start."""
+        C = self.ecfg.prefill_chunk
+        rem = total_len - match_len
+        mids = 0
+        while rem > C:
+            rem -= C
+            mids += 1
+        return match_len + mids * C + self._bucket_for(max(rem, 1))
+
     def _chunkable(self, request: GenRequest, match_len: int = 0) -> bool:
         """Whether this request's (un-cached) prompt tail should admit
         through the chunked state machine. Multimodal/mrope prompts keep
@@ -1576,6 +2095,13 @@ class Engine:
         ids = request.prompt_ids
         slot_idx = next(i for i, s in enumerate(self.slots) if s is None)
         entry, match_len = (hit if hit is not None else (None, 0))
+        if entry is not None and self._paged and "hk" in entry:
+            # Host-tier span: swap it back into pool pages before mapping.
+            # A failed promotion (pool pressure) degrades to a full chunked
+            # admission rather than busy-requeueing on the same hit.
+            entry = self._prefix_promote(entry)
+            if entry is None:
+                match_len = 0
         if entry is not None and self._paged and not any(
             e is entry for e in self._prefix_entries
         ):
@@ -1583,16 +2109,17 @@ class Engine:
         table_row: Optional[np.ndarray] = None
         if self._paged:
             page = self.ecfg.kv_page_size
-            if entry is not None:
-                shared = entry["pages"][: match_len // page]
-                total_rows = max(
-                    match_len + self._bucket_for(len(ids) - match_len),
-                    min(len(ids) + request.max_new_tokens, self.ecfg.max_seq),
-                )
-                fresh = -(-total_rows // page) - len(shared)
-            else:
-                shared = []
-                fresh = self._pages_needed(request)
+            shared = entry["pages"][: match_len // page] if entry is not None else []
+            # On-demand: pages covering exactly the rows the chunk programs
+            # will write (mid chunks are exact C-token writes; only the
+            # final tail is bucketed) + headroom; decode growth takes over
+            # after activation.
+            rows = self._chunk_admit_rows(len(ids), match_len)
+            base = -(-rows // page) - len(shared)
+            worst = max(rows, min(len(ids) + request.max_new_tokens,
+                                  self.ecfg.max_seq))
+            cap = max(base, -(-worst // page) - len(shared))
+            fresh = min(base + self.ecfg.kv_page_headroom, cap)
             if len(self._free_pages) < fresh:
                 self._prefix_evict_for_pages(
                     fresh, protect=[entry] if entry is not None else []
@@ -1630,6 +2157,7 @@ class Engine:
             self.m_prefix_tokens += match_len
         self.slots[slot_idx] = _Slot(
             request=request, handle=handle, prompt_len=len(ids), t_submit=t0,
+            sched_rows=len(ids),
         )
         self._chunkings.append({
             "request": request, "handle": handle, "slot": slot_idx,
@@ -1706,7 +2234,7 @@ class Engine:
         fbp = self._bucket_for(len(ids))
         draft = self.draft_cfg is not None
         dfa_tables = None
-        if request.grammar is not None:
+        if request.grammar is not None and request.resume is None:
             dfa_tables = self._dfa_for(request)
         with_dfa = self._dfa_mode_of(dfa_tables)
         with_topk = request.grammar is not None and not with_dfa
@@ -1787,8 +2315,9 @@ class Engine:
         self._slot_gen[slot_idx] += 1
         self.slots[slot_idx] = _Slot(
             request=request, handle=handle, prompt_len=len(ids), scheduled=1,
-            t_submit=t0, dfa=with_dfa,
+            t_submit=t0, dfa=with_dfa, sched_rows=len(ids),
         )
+        self._apply_resume(slot_idx)
         self.h_active[slot_idx] = True
         self.h_override_mask[slot_idx] = False
         self.h_gmask[slot_idx] = 1.0 if with_dfa else 0.0
@@ -1836,16 +2365,23 @@ class Engine:
         prompt = np.asarray(prompt_ids, np.int32)
         cap = len(prompt_ids) - 1  # always prefill >= 1 tail token for logits
         best, best_len = None, 0
-        for entry in self._prefix_entries:
-            n = min(entry["valid"], cap, len(entry["key"]))
-            if n <= best_len:
-                continue
-            eq = entry["key"][:n] == prompt[:n]
-            match = n if eq.all() else int(np.argmin(eq))
-            if self._paged:
-                match = (match // self.ecfg.kv_page_size) * self.ecfg.kv_page_size
-            if match > best_len:
-                best, best_len = entry, match
+        # Device tier first, then the host tier (spilled spans) — a host
+        # hit only wins on a strictly longer match, since it must swap its
+        # pages back in before it can be mapped.
+        tiers = [self._prefix_entries]
+        if self._paged:
+            tiers.append(self._prefix_host)
+        for tier in tiers:
+            for entry in tier:
+                n = min(entry["valid"], cap, len(entry["key"]))
+                if n <= best_len:
+                    continue
+                eq = entry["key"][:n] == prompt[:n]
+                match = n if eq.all() else int(np.argmin(eq))
+                if self._paged:
+                    match = (match // self.ecfg.kv_page_size) * self.ecfg.kv_page_size
+                if match > best_len:
+                    best, best_len = entry, match
         if best is None or best_len < max(self.ecfg.prefix_cache_min, 1):
             return None
         # The tail must fit between the prefix and the cache end.
@@ -1907,6 +2443,16 @@ class Engine:
                 self._prefix_drop(e)
                 continue  # subsumed by the new span
             kept.append(e)
+        if self._paged and self._prefix_host:
+            # Host-tier spans the new device span subsumes are dead weight.
+            keep_h = []
+            for e in self._prefix_host:
+                if (e["valid"] <= valid_len
+                        and (e["key"][:e["valid"]] == key[:e["valid"]]).all()):
+                    self._host_bytes -= e["bytes"]
+                    continue
+                keep_h.append(e)
+            self._prefix_host = keep_h
         if self._paged:
             pages = self._slot_pages[slot_idx][: n_pages]
             if len(pages) < n_pages:
@@ -1968,9 +2514,62 @@ class Engine:
             if any(e is p for p in protect):
                 idx -= 1
                 continue
+            # Second chance in host RAM: a later hit swaps the span back in
+            # instead of re-prefilling it (budget permitting).
+            self._prefix_spill(e)
             self._prefix_drop(e)
             self._prefix_entries.pop(idx)
             idx -= 1
+
+    def _prefix_spill(self, entry: dict) -> None:
+        """Copy an about-to-be-evicted span's pages to the host tier (the
+        prefix cache's second level, bounded by kv_swap_bytes)."""
+        if not self._paged or self.ecfg.kv_swap_bytes <= 0:
+            return
+        pages = entry.get("pages")
+        if not pages:
+            return
+        sz = len(pages) * self._page_bytes()
+        if not self._host_make_room(sz):
+            return
+        hk, hv = self._swap_out_pages(pages)
+        self._prefix_host.insert(0, {
+            "key": entry["key"], "valid": entry["valid"],
+            "hk": hk, "hv": hv, "bytes": sz,
+        })
+        self._host_bytes += sz
+        self.m_kv_swap_bytes_out += sz
+
+    def _prefix_promote(self, hentry: dict) -> Optional[dict]:
+        """Swap a host-tier span back into pool pages and re-enter it in
+        the device tier (serving a hit from RAM instead of re-prefilling).
+        Returns the device entry, or None when the pool cannot cover the
+        span right now (the hit degrades to a miss)."""
+        npg = hentry["hk"].shape[1]
+        # Claim the entry first so _host_make_room (run for spills during
+        # the eviction below) can never evict the span we are promoting.
+        self._prefix_host = [e for e in self._prefix_host if e is not hentry]
+        self._host_bytes -= hentry["bytes"]
+        if len(self._free_pages) < npg:
+            self._prefix_evict_for_pages(npg)
+        if len(self._free_pages) < npg:
+            self._prefix_host.insert(0, hentry)  # back to the tier, LRU-bumped
+            self._host_bytes += hentry["bytes"]
+            return None
+        pages = [self._free_pages.pop() for _ in range(npg)]
+        for p in pages:
+            self._page_refs[p] = 1
+        self._swap_in_pages(pages, hentry["hk"], hentry["hv"])
+        entry = {"key": hentry["key"], "valid": hentry["valid"],
+                 "pages": pages}
+        self._prefix_entries.insert(0, entry)
+        while len(self._prefix_entries) > self.ecfg.prefix_cache_entries:
+            dead = self._prefix_entries.pop()
+            self._prefix_spill(dead)
+            self._prefix_drop(dead)
+        self.m_kv_swap_bytes_in += hentry["bytes"]
+        self.m_prefix_host_hits += 1
+        return entry
 
     def _prefix_span_bytes(self, pb: int) -> int:
         """Device bytes of one stored span (k+v) with a pb-row sequence.
@@ -2046,6 +2645,14 @@ class Engine:
             return "full"
         fbp = self._bucket_for(len(ids))  # full-prompt bucket (count row/draft)
         paged_alloc: Optional[np.ndarray] = None
+        if self._paged and "hk" in entry:
+            # Host-tier hit: swap the span back into pool pages first. A
+            # failed promotion (pool pressure) serves via full admission —
+            # requeueing would re-find the same host hit and busy-spin.
+            promoted = self._prefix_promote(entry)
+            if promoted is None:
+                return "full"
+            entry = promoted
         if self._paged:
             # The entry must still be live (pressure eviction may have
             # released its pages between the find and this dispatch).
@@ -2053,11 +2660,9 @@ class Engine:
                 return False
             page = self.ecfg.kv_page_size
             shared = entry["pages"][: match_len // page]
-            total_rows = max(
-                match_len + tb,
-                min(len(ids) + request.max_new_tokens, self.ecfg.max_seq),
-            )
-            fresh = -(-total_rows // page) - len(shared)
+            # On-demand (ISSUE 3): only the tail bucket + headroom; decode
+            # growth allocates the rest as the context actually extends.
+            fresh = self._pages_needed_cached(request, match_len)
             paged_alloc = self._pages_alloc(slot_idx, fresh, shared=shared)
             if paged_alloc is None:
                 return False  # pool pressure — full admission will backpressure
@@ -2186,8 +2791,9 @@ class Engine:
         self._slot_gen[slot_idx] += 1
         self.slots[slot_idx] = _Slot(
             request=request, handle=handle, prompt_len=len(ids), scheduled=1,
-            t_submit=t0, dfa=with_dfa,
+            t_submit=t0, dfa=with_dfa, sched_rows=len(ids),
         )
+        self._apply_resume(slot_idx)
         self.h_active[slot_idx] = True
         self.h_override_mask[slot_idx] = False
         self.h_gmask[slot_idx] = 1.0 if with_dfa else 0.0
@@ -2436,11 +3042,14 @@ class Engine:
             log.warning(
                 "prompt truncated to %d tokens (max_seq=%d)", limit, self.ecfg.max_seq
             )
-        if self._paged and self._pages_needed(request) > self.ecfg.kv_pages:
+        if self._paged and self._pages_worst(request) > self.ecfg.kv_pages:
+            # Worst-case gate only: admission reserves prompt+headroom and
+            # grows on demand, but a request whose full context can NEVER
+            # fit the pool would preempt everyone and still starve.
             raise ValueError(
-                f"request needs {self._pages_needed(request)} KV pages, pool "
-                f"has {self.ecfg.kv_pages} — lower max_new_tokens or grow "
-                "kv_pages"
+                f"request needs up to {self._pages_worst(request)} KV pages, "
+                f"pool has {self.ecfg.kv_pages} — lower max_new_tokens or "
+                "grow kv_pages"
             )
         if request.image_embeds is not None:
             if self.draft_cfg is not None:
@@ -2548,6 +3157,17 @@ class Engine:
         if self._paged:
             out["kv_pages_total"] = float(self.ecfg.kv_pages)
             out["kv_pages_free"] = float(len(self._free_pages))
+            out["kv_pages_grown"] = float(self.m_kv_pages_grown)
+            out["kv_preemptions"] = float(self.m_kv_preemptions)
+            out["kv_preempt_swaps"] = float(self.m_kv_preempt_swaps)
+            out["kv_preempt_recomputes"] = float(self.m_kv_preempt_recomputes)
+            out["kv_preempt_recover_ms"] = float(self.m_kv_preempt_recover_ms)
+            out["kv_swap_bytes_out"] = float(self.m_kv_swap_bytes_out)
+            out["kv_swap_bytes_in"] = float(self.m_kv_swap_bytes_in)
+            out["kv_host_tier_bytes"] = float(self._host_bytes)
+            out["prefix_host_tier_entries"] = float(len(self._prefix_host))
+            out["prefix_host_tier_hits"] = float(self.m_prefix_host_hits)
+        out["peak_active_slots"] = float(self.m_peak_active)
         if self.ecfg.prefill_chunk:
             out["prefill_chunks"] = float(self.m_prefill_chunks)
             out["chunked_admissions"] = float(self.m_chunked_admits)
@@ -2955,6 +3575,11 @@ class Engine:
         while not self._shutdown.is_set():
             self._charge()
 
+            if self._growth_blocked and not self.h_active.any():
+                # The growth-starved slots are gone (finished or preempted
+                # during the drain) — nothing is waiting on pages anymore,
+                # so admission must unblock or the queue starves.
+                self._growth_blocked = False
             admitted = self._admit_pending()
             # Only host-walk grammars force single-step, serialized blocks;
             # DFA-constrained slots pipeline at full depth like everyone else.
@@ -2982,7 +3607,7 @@ class Engine:
             if dispatchable:
                 t0 = time.monotonic()
                 try:
-                    self._dispatch_block(grammar)
+                    did = self._dispatch_block(grammar)
                 except Exception as e:  # noqa: BLE001 — fail requests, not the loop
                     log.exception("decode block dispatch failed")
                     for i in range(self.ecfg.max_slots):
@@ -2993,10 +3618,17 @@ class Engine:
                             ))
                             self._release(i)
                     continue
-                if trace:
-                    print(f"[eng {time.monotonic():.3f}] dispatch block n={self._inflight[-1].n} "
-                          f"took {(time.monotonic()-t0)*1000:.1f}ms inflight={len(self._inflight)}")
-                nblocks += 1
+                if did:
+                    if trace:
+                        print(f"[eng {time.monotonic():.3f}] dispatch block n={self._inflight[-1].n} "
+                              f"took {(time.monotonic()-t0)*1000:.1f}ms inflight={len(self._inflight)}")
+                    nblocks += 1
+                elif not self._inflight:
+                    # Pool exhausted mid-decode and every in-flight dispatch
+                    # has drained (their writes target the victim's pages
+                    # through the tables they shipped): preempt the
+                    # youngest slot so the others stop stalling.
+                    self._preempt_youngest()
 
             # Chunked prefill rides between decode-block dispatches: one
             # chunk in flight at a time, so the device alternates decode
@@ -3028,6 +3660,10 @@ class Engine:
 
     def _admit_pending(self) -> bool:
         admitted = False
+        if self._growth_blocked:
+            # A live slot is waiting on pages — new admissions would steal
+            # the pool out from under the growth/preemption cycle.
+            return admitted
         while True:
             free = [i for i, s in enumerate(self.slots) if s is None]
             if not free:
@@ -3061,14 +3697,24 @@ class Engine:
             bucket = 0
             pages_planned = 0
             chunk_item = None  # ((request, handle), hit) → chunked admission
+            swap_item = None  # (request, handle) → swap-preempted resume
             prefix_hits: dict[int, tuple] = {}  # id(request) -> (entry, len)
             with self._pending_lock:
                 while self._pending and len(group) < len(free):
                     request, handle = self._pending[0]
                     if handle.cancelled.is_set():
                         self._pending.popleft()
+                        self._resume_discard(request)
                         handle._q.put(TokenEvent(kind="done", finish_reason="stop"))
                         continue
+                    if (self._paged and request.resume is not None
+                            and request.resume.get("mode") == "swap"):
+                        # Swap resumes dispatch alone (no prefill program to
+                        # batch); page budgeting happens outside the lock.
+                        if group:
+                            break
+                        swap_item = self._pending.popleft()
+                        break
                     # Long prompts admit through the chunked state machine
                     # (decode keeps streaming between chunks). A prefix hit
                     # whose TAIL fits one chunk stays on the cheaper
@@ -3094,7 +3740,9 @@ class Engine:
                                    if self._cached_admit_ok(request) else None)
                         if hit is not None:
                             prefix_hits[id(request)] = hit
-                            need = self._pages_needed_cached(request, hit[1])
+                            need = self._pages_needed_cached(
+                                request, hit[1], host="hk" in hit[0]
+                            )
                         else:
                             need = self._pages_needed(request)
                         if pages_planned + need > len(self._free_pages):
@@ -3115,6 +3763,18 @@ class Engine:
                     elif b != bucket:
                         break  # different bucket — next round
                     group.append(self._pending.popleft())
+            if swap_item is not None:
+                request, handle = swap_item
+                need = self._resume_swap_pages(request)
+                if len(self._free_pages) < need:
+                    self._prefix_evict_for_pages(need)
+                if (len(self._free_pages) >= need
+                        and self._dispatch_resume_swap(request, handle, free[0])):
+                    admitted = True
+                    continue  # re-plan the remaining queue
+                with self._pending_lock:
+                    self._pending.appendleft(swap_item)
+                return admitted  # pool backpressure — wait for a finish
             if chunk_item is not None:
                 (request, handle), hit = chunk_item
                 if self._chunk_start(request, handle, hit):
@@ -3177,7 +3837,11 @@ class Engine:
         m = len(chunk)
         V = self.cfg.vocab_size
         dfa_tables = None
-        if m == 1 and chunk[0][0].grammar is not None and chunk[0][0].image_embeds is None:
+        # Resume requests keep the HOST grammar walk: the machine object
+        # carries the mid-stream state a fresh device-DFA init would lose.
+        if (m == 1 and chunk[0][0].grammar is not None
+                and chunk[0][0].image_embeds is None
+                and chunk[0][0].resume is None):
             dfa_tables = self._dfa_for(chunk[0][0])
         if (m == 1 and chunk[0][0].image_embeds is None
                 and self._cached_admit_ok(chunk[0][0])):
@@ -3368,8 +4032,9 @@ class Engine:
             self._slot_gen[slot_idx] += 1
             self.slots[slot_idx] = _Slot(
                 request=r, handle=handle, prompt_len=int(aux[0, j]), scheduled=1,
-                t_submit=t0, dfa=with_dfa,
+                t_submit=t0, dfa=with_dfa, sched_rows=int(aux[0, j]),
             )
+            self._apply_resume(slot_idx)
             self.h_active[slot_idx] = True
             self.h_override_mask[slot_idx] = False
             self.h_gmask[slot_idx] = 1.0 if with_dfa else 0.0
@@ -3424,7 +4089,11 @@ class Engine:
             chosen = n
         return chosen
 
-    def _dispatch_block(self, grammar: bool) -> None:
+    def _dispatch_block(self, grammar: bool) -> bool:
+        """Dispatch one decode block (or speculative round). Returns False
+        without dispatching when the paged pool could not be grown to cover
+        the block's writes — the loop then drains in-flight work and
+        preempts the youngest slot (ISSUE 3)."""
         B = self.ecfg.max_slots
         if grammar:
             variant, n = "grammar", 1
@@ -3468,15 +4137,22 @@ class Engine:
         # Stochastic verify keeps speculation exact for sampled requests too
         # (greedy degenerates to the old argmax-agreement test), so every
         # non-grammar, non-logprobs variant rides the draft model.
-        if (
+        spec = (
             self.draft_cfg is not None
             and not grammar
             and not with_dfa
             and not with_lp
             and not self.h_override_mask.any()
-        ):
+        )
+        # On-demand page growth (ISSUE 3): the block's writes must resolve
+        # through real pages BEFORE dispatch — rows past a slot's table
+        # land in SCRATCH and would be silently lost.
+        if not self._grow_for_decode((self.n_draft + 1) if spec else n):
+            return False
+        self.m_peak_active = max(self.m_peak_active, int(self.h_active.sum()))
+        if spec:
             self._dispatch_spec_block()
-            return
+            return True
         active_snapshot = self.h_active.copy()
         pack = np.zeros((11 if with_dfa else 10, B), np.float32)
         pack[0] = active_snapshot
@@ -3515,12 +4191,14 @@ class Engine:
         for i in range(B):
             if active_snapshot[i] and self.slots[i] is not None:
                 self.slots[i].scheduled += n
+                self.slots[i].sched_rows += n
         self._track(
             _Entry(
                 kind="block", toks=toks_block, tk=tk_block, lp=lp_block,
                 gen=list(self._slot_gen), active=active_snapshot, n=n,
             )
         )
+        return True
 
     def _dispatch_spec_block(self) -> None:
         """One speculative round: draft k + verify. Emits 1..k+1 tokens per
@@ -3548,6 +4226,9 @@ class Engine:
         for i in range(B):
             if active_snapshot[i] and self.slots[i] is not None:
                 self.slots[i].scheduled += 1  # ≥1 token guaranteed per round
+                # Page growth must cover the whole verify window (k+1 rows
+                # are written even when fewer tokens are accepted).
+                self.slots[i].sched_rows += self.n_draft + 1
         self._track(
             _Entry(
                 kind="spec", toks=toks_out, tk=acc,
@@ -3640,7 +4321,10 @@ class Engine:
                         self.h_override_tok[slot_idx] = chosen
                         self.h_override_mask[slot_idx] = True
                     tok = chosen
-                slot.t_first = time.monotonic()
+                if not slot.t_first:
+                    # Resumed slots keep their original TTFT; only a truly
+                    # first token stamps it.
+                    slot.t_first = time.monotonic()
                 self.m_prompt_tokens += plen
                 lpj = (lp[0][j], lp[1][j], lp[2][j]) if lp is not None else None
                 self._post_token(slot_idx, tok, lpj)

@@ -116,10 +116,14 @@ class ApiError(Exception):
 
 
 class Metrics:
-    """api_call duration histogram, Prometheus text format.
+    """api_call duration histogram + engine gauges, Prometheus text format.
 
     Reference: core/services/metrics.go:28-46 (OTel histogram `api_call`).
-    """
+    Gauges come from two places: `gauge()` for values the server pushes,
+    and `add_gauge_source()` callbacks polled at scrape time — how the
+    per-model engine gauges (kv pages, queue depth, preemptions, swap
+    bytes, prefix host tier — Engine.metrics()) reach /metrics without the
+    HTTP layer holding engine references (ISSUE 3 satellite)."""
 
     BUCKETS = (0.005, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0, float("inf"))
 
@@ -128,6 +132,8 @@ class Metrics:
         self._hist: dict[str, list[int]] = {}
         self._sum: dict[str, float] = {}
         self._count: dict[str, int] = {}
+        self._gauges: dict[tuple[str, tuple], float] = {}
+        self._gauge_sources: list[Callable[[], Any]] = []
 
     def observe(self, path: str, seconds: float) -> None:
         with self._lock:
@@ -137,6 +143,26 @@ class Metrics:
                     h[i] += 1
             self._sum[path] = self._sum.get(path, 0.0) + seconds
             self._count[path] = self._count.get(path, 0) + 1
+
+    def gauge(self, name: str, value: float,
+              labels: Optional[dict[str, str]] = None) -> None:
+        """Set a gauge sample (push path). `name` should already carry the
+        localai_ prefix convention."""
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            self._gauges[key] = float(value)
+
+    def add_gauge_source(self, fn: Callable[[], Any]) -> None:
+        """Register a scrape-time callback yielding (name, labels, value)
+        triples — polled fresh on every /metrics render."""
+        self._gauge_sources.append(fn)
+
+    @staticmethod
+    def _fmt_labels(labels: tuple) -> str:
+        if not labels:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in labels)
+        return "{" + inner + "}"
 
     def render(self) -> str:
         lines = [
@@ -152,6 +178,21 @@ class Metrics:
                     )
                 lines.append(f'localai_api_call_sum{{path="{path}"}} {self._sum[path]}')
                 lines.append(f'localai_api_call_count{{path="{path}"}} {self._count[path]}')
+            samples = dict(self._gauges)
+        for src in self._gauge_sources:
+            try:
+                for name, labels, value in src():
+                    key = (name, tuple(sorted((labels or {}).items())))
+                    samples[key] = float(value)
+            except Exception:  # noqa: BLE001 — a scrape must never 500
+                log.exception("gauge source failed during /metrics render")
+        by_name: dict[str, list[tuple[tuple, float]]] = {}
+        for (name, labels), value in samples.items():
+            by_name.setdefault(name, []).append((labels, value))
+        for name in sorted(by_name):
+            lines.append(f"# TYPE {name} gauge")
+            for labels, value in sorted(by_name[name]):
+                lines.append(f"{name}{self._fmt_labels(labels)} {value}")
         return "\n".join(lines) + "\n"
 
 
@@ -190,6 +231,12 @@ AUTH_EXEMPT = {"/healthz", "/readyz", "/version"}
 
 def create_server(app_cfg: ApplicationConfig, router: Router) -> ThreadingHTTPServer:
     metrics = Metrics()
+    # Per-model engine gauges: an API layer that registered a gauge source
+    # on the router (OpenAIApi.register) gets polled at every scrape.
+    src = getattr(router, "gauge_source", None)
+    if src is not None:
+        metrics.add_gauge_source(src)
+    router.metrics = metrics
     router.add("GET", "/metrics", lambda req: Response(
         body=metrics.render(), content_type="text/plain; version=0.0.4"
     ))

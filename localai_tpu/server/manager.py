@@ -501,6 +501,9 @@ class ModelManager:
             engine_cfg=EngineConfig(
                 max_slots=cfg.max_slots, max_seq=cfg.context_size,
                 kv_pages=cfg.kv_pages, kv_page_size=cfg.kv_page_size,
+                kv_page_headroom=cfg.kv_page_headroom,
+                kv_preempt=cfg.kv_preempt,
+                kv_swap_bytes=cfg.kv_swap_bytes,
                 kv_cache_dtype=cfg.kv_cache_dtype,
                 paged_kernel=cfg.paged_kernel,
                 prefill_chunk=cfg.prefill_chunk,

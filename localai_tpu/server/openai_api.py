@@ -85,6 +85,12 @@ class OpenAIApi:
         r.add("GET", "/backend/monitor", self.backend_monitor)
         r.add("POST", "/backend/monitor", self.backend_monitor)
         r.add("POST", "/backend/shutdown", self.backend_shutdown)
+        # Engine gauges (kv pages free/total, queue depth, preemptions,
+        # swap bytes, prefix host tier, ...) ride the Prometheus scrape as
+        # localai_engine_*{model=...} — create_server polls this at every
+        # /metrics render (previously reachable only via the JSON
+        # backend-monitor endpoint).
+        r.gauge_source = self.engine_gauges
 
     # ------------------------------------------------------------------ #
     # Helpers
@@ -831,6 +837,23 @@ class OpenAIApi:
             "uptime_s": time.time() - self.started_at,
             "version": __version__,
         })
+
+    def engine_gauges(self):
+        """(name, labels, value) triples for every loaded model's engine —
+        the Prometheus face of Engine.metrics(). peek() only: a monitoring
+        scrape must never trigger a model load."""
+        out = []
+        for n in self.manager.loaded_names():
+            lm = self.manager.peek(n)
+            if lm is None:
+                continue
+            try:
+                gauges = lm.engine.metrics()
+            except Exception:  # noqa: BLE001 — scrape survives a dying engine
+                continue
+            for k, v in gauges.items():
+                out.append((f"localai_engine_{k}", {"model": n}, v))
+        return out
 
     def backend_monitor(self, req: Request) -> Response:
         body = req.body or {}

@@ -46,3 +46,38 @@ def test_checker_catches_the_bench_r05_bug_class(tmp_path):
     )
     findings = check_class(str(p), "Engine")
     assert [f[0] for f in findings] == ["_hold"], findings
+
+
+def test_metric_counter_pass_covers_engine():
+    from check_engine_attrs import check_metric_counters
+
+    findings = check_metric_counters(ENGINE_PY, "Engine")
+    assert findings == [], (
+        "Engine.metrics() reads m_* counters never initialized in "
+        "__init__: " + "; ".join(f"self.{a} at line {ln}" for a, ln in findings)
+    )
+
+
+def test_metric_counter_pass_catches_uninitialized_counter(tmp_path):
+    """A counter bumped at a dispatch site and read in metrics() but never
+    initialized in __init__ (the preempt/swap counters are the immediate
+    customers) must be flagged; init-covered and hasattr-guarded ones must
+    not."""
+    from check_engine_attrs import check_metric_counters
+
+    p = tmp_path / "synthetic.py"
+    p.write_text(
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self.m_ok = 0\n"
+        "        self._wire()\n"
+        "    def _wire(self):\n"
+        "        self.m_wired = 0\n"
+        "    def dispatch(self):\n"
+        "        self.m_preemptions += 1\n"   # assigned only at runtime
+        "    def metrics(self):\n"
+        "        return {'a': self.m_ok, 'b': self.m_wired,\n"
+        "                'c': self.m_preemptions}\n"
+    )
+    findings = check_metric_counters(str(p), "Engine")
+    assert [f[0] for f in findings] == ["m_preemptions"], findings

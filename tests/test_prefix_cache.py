@@ -221,3 +221,61 @@ def test_async_compile_paged_serves_via_full_admission():
         assert eng.m_prefix_hits >= 1
     finally:
         eng.stop()
+
+
+def test_prefix_host_tier_spill_and_rehit():
+    """ISSUE 3: a span evicted for pool pressure spills to the host-RAM
+    tier instead of being discarded, and a later hit swaps it back into
+    pool pages — no re-prefill of the span — with the same output the
+    device-tier hit would have produced."""
+    cfg = get_arch("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    eng = Engine(
+        cfg, params, ByteTokenizer(cfg.vocab_size),
+        engine_cfg=EngineConfig(
+            max_slots=4, max_seq=512, kv_pages=8, kv_page_size=64,
+            prefix_cache_entries=4, prefix_cache_min=32,
+            prefix_admit_async_compile=False,
+            kv_swap_bytes=64 << 20,
+        ),
+    )
+    eng.start()
+    try:
+        sys_ids = [65 + (i * 11) % 26 for i in range(100)]
+        eng.generate(sys_ids + [100, 101], max_new_tokens=4, ignore_eos=True)
+        assert eng._prefix_entries, "no span saved"
+
+        # A request whose prompt bucket needs the whole pool forces the
+        # planner to evict the span — which must SPILL, not discard.
+        big = [(j * 7) % 255 + 1 for j in range(300)]
+        eng.generate(big, max_new_tokens=4, ignore_eos=True)
+        assert eng._prefix_host, "evicted span was not spilled to host RAM"
+        assert eng.metrics()["prefix_host_tier_entries"] >= 1.0
+        assert eng._host_bytes > 0
+
+        # Drop device-tier spans saved meanwhile so the NEXT hit can only
+        # come from the host tier.
+        for e in list(eng._prefix_entries):
+            eng._prefix_drop(e)
+        eng._prefix_entries.clear()
+
+        p2 = sys_ids + [105, 106, 107]
+        hits0 = eng.m_prefix_hits
+        text2, ev2 = eng.generate(p2, max_new_tokens=6, ignore_eos=True)
+        assert ev2.kind == "done"
+        assert eng.m_prefix_host_hits >= 1, "host tier was never hit"
+        assert eng.m_prefix_hits > hits0
+        assert eng.metrics()["prefix_host_tier_hits"] >= 1.0
+        assert eng.m_kv_swap_bytes_in > 0
+
+        # Oracle: raw prefill + argmax over the full prompt.
+        seq = list(p2)
+        for _ in range(6):
+            S = 128
+            toks = jnp.array([seq + [0] * (S - len(seq))], jnp.int32)
+            logits, _, _ = prefill(cfg, eng.params, toks,
+                                   jnp.array([len(seq)], jnp.int32))
+            seq.append(int(jnp.argmax(logits[0])))
+        assert text2 == eng.tokenizer.decode(seq[len(p2):])
+    finally:
+        eng.stop()

@@ -29,6 +29,10 @@ def api(tmp_path_factory):
     (d / "tiny-2.yaml").write_text(yaml.safe_dump({
         "name": "tiny-2", "model": "tiny", "context_size": 64, "max_tokens": 8,
     }))
+    (d / "tiny-paged.yaml").write_text(yaml.safe_dump({
+        "name": "tiny-paged", "model": "tiny", "context_size": 128,
+        "max_tokens": 8, "kv_pages": 4, "kv_page_size": 64,
+    }))
     app_cfg = ApplicationConfig(address="127.0.0.1", port=0, models_dir=str(d), max_active_models=2)
     manager = ModelManager(app_cfg)
     router = Router()
@@ -60,7 +64,7 @@ def test_list_models(api):
     base, _ = api
     body, _ = _get(base, "/v1/models")
     ids = {m["id"] for m in json.loads(body)["data"]}
-    assert ids == {"tiny-chat", "tiny-2"}
+    assert ids == {"tiny-chat", "tiny-2", "tiny-paged"}
 
 
 def test_health_version(api):
@@ -410,3 +414,43 @@ def test_settings_api(api, tmp_path_factory):
     finally:
         server.shutdown()
 
+
+
+def test_metrics_gauge_unit():
+    """Metrics.gauge() + gauge sources render as Prometheus gauges."""
+    from localai_tpu.server.app import Metrics
+
+    m = Metrics()
+    m.gauge("localai_build_info", 1.0, {"version": "x"})
+    m.add_gauge_source(lambda: [("localai_engine_kv_pages_free",
+                                 {"model": "m1"}, 7.0)])
+    out = m.render()
+    assert "# TYPE localai_build_info gauge" in out
+    assert 'localai_build_info{version="x"} 1.0' in out
+    assert 'localai_engine_kv_pages_free{model="m1"} 7.0' in out
+
+
+def test_metrics_scrape_includes_engine_gauges(api):
+    """ISSUE 3 satellite: Engine.metrics() gauges reach the Prometheus
+    scrape per loaded model — previously only the JSON backend-monitor
+    endpoint exposed them. A paged model additionally exports the kv pool /
+    preemption / host-tier gauge family."""
+    base, manager = api
+    # Ensure a paged model is loaded alongside whatever earlier tests used.
+    _post(base, "/v1/chat/completions", {
+        "model": "tiny-paged",
+        "messages": [{"role": "user", "content": "hi"}], "max_tokens": 2,
+    })
+    body, _ = _get(base, "/metrics")
+    for name in manager.loaded_names():
+        assert f'localai_engine_tokens_generated{{model="{name}"}}' in body
+        assert f'localai_engine_queue_depth{{model="{name}"}}' in body
+        assert f'localai_engine_active_slots{{model="{name}"}}' in body
+    assert 'localai_engine_kv_pages_total{model="tiny-paged"}' in body
+    assert 'localai_engine_kv_pages_free{model="tiny-paged"}' in body
+    assert 'localai_engine_kv_preemptions{model="tiny-paged"}' in body
+    assert 'localai_engine_kv_swap_bytes_out{model="tiny-paged"}' in body
+    assert 'localai_engine_kv_pages_grown{model="tiny-paged"}' in body
+    assert 'localai_engine_prefix_host_tier_entries{model="tiny-paged"}' in body
+    # The histogram must still be there (regression guard).
+    assert "localai_api_call_bucket" in body

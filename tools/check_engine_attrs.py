@@ -168,6 +168,49 @@ def check_class(path: str, class_name: str) -> list[tuple[str, str, int]]:
     return sorted(set(findings), key=lambda f: f[2])
 
 
+def check_metric_counters(path: str, class_name: str) -> list[tuple[str, int]]:
+    """Stricter companion pass for the metrics surface: every `self.m_*`
+    counter the class's `metrics()` method reads must be UNCONDITIONALLY
+    initialized during construction (__init__ or a method it transitively
+    calls). The general pass already catches never-assigned reads; this one
+    exists because metric counters are the repeat offender (the BENCH_r05
+    rc=124 class) — they get added at a dispatch site, read in metrics(),
+    and the init line is what gets forgotten. Returns [(attr, line)]."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    cls = next(
+        (n for n in ast.walk(tree)
+         if isinstance(n, ast.ClassDef) and n.name == class_name),
+        None,
+    )
+    if cls is None:
+        raise SystemExit(f"class {class_name} not found in {path}")
+    methods = {
+        n.name: n for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    if "metrics" not in methods:
+        return []
+    init_assigned: set[str] = set()
+    seen: set[str] = set()
+    frontier = ["__init__"]
+    while frontier:
+        name = frontier.pop()
+        if name in seen or name not in methods:
+            continue
+        seen.add(name)
+        init_assigned |= _attr_stores(methods[name])
+        frontier.extend(_self_calls(methods[name]))
+    exempt = _hasattr_probes(cls)
+    return sorted(
+        (attr, line)
+        for attr, line in _attr_reads(methods["metrics"]).items()
+        if attr.startswith("m_")
+        and attr not in init_assigned
+        and attr not in exempt
+    )
+
+
 def main(argv: list[str]) -> int:
     path = argv[1] if len(argv) > 1 else DEFAULT_PATH
     class_name = argv[2] if len(argv) > 2 else "Engine"
@@ -178,7 +221,14 @@ def main(argv: list[str]) -> int:
             f"but never assigned in __init__ (loop-thread AttributeError "
             f"waiting to happen — BENCH_r05 rc=124 was exactly this)"
         )
-    if findings:
+    counter_findings = check_metric_counters(path, class_name)
+    for attr, line in counter_findings:
+        print(
+            f"{path}:{line}: metric counter self.{attr} read in "
+            f"{class_name}.metrics() but never initialized in __init__ — "
+            f"the scrape would AttributeError on a fresh engine"
+        )
+    if findings or counter_findings:
         return 1
     print(f"{class_name}: all attribute reads covered by construction")
     return 0
