@@ -474,6 +474,105 @@ def main() -> None:
                 oeng.cache = None
                 oeng = None
 
+    # Backpressure/shed row (ISSUE 4, docs/ROBUSTNESS.md): 2x-oversubscribed
+    # traffic (4x slots requests against max_pending = slots) with bounded
+    # admission ON vs OFF — shed (429) rate and p99 TTFT of the ADMITTED
+    # requests. The point of shedding is visible in the on/off delta: with
+    # the bound, admitted requests wait at most ~one queue generation; with
+    # an unbounded queue the tail request's TTFT includes every request in
+    # front of it. Then an injected loop death (testing/faults engine_loop
+    # site) timed through the manager's crash-only evict → reload → first
+    # served token: engine_restart_recover_ms.
+    if os.environ.get("BENCH_SHED", "1") != "0":
+        try:
+            from localai_tpu.engine import QueueFullError
+
+            N = 4 * slots
+            for tag, mp in (("on", slots), ("off", 0)):
+                seng = Engine(
+                    cfg, params, ByteTokenizer(cfg.vocab_size),
+                    engine_cfg=EngineConfig(max_slots=slots, max_seq=max_seq,
+                                            max_pending=mp),
+                )
+                seng.start()
+                seng.warmup(prompt_len)
+                sttfts: list[float] = []
+                sheds = [0]
+                slock = threading.Lock()
+
+                def sone(i: int, eng=seng) -> None:
+                    ids = [(i * 61 + j) % 255 + 1 for j in range(prompt_len)]
+                    try:
+                        _, ev = eng.generate(ids, max_new_tokens=gen_len,
+                                             ignore_eos=True)
+                        with slock:
+                            sttfts.append(ev.timing_prompt_processing)
+                    except QueueFullError:
+                        with slock:
+                            sheds[0] += 1
+
+                sthreads = [threading.Thread(target=sone, args=(i,))
+                            for i in range(N)]
+                for t in sthreads:
+                    t.start()
+                _join_or_die(sthreads, seng, f"shed row ({tag})")
+                seng.stop()
+                seng.params = None
+                seng.cache = None
+                sttfts.sort()
+                p99 = sttfts[min(len(sttfts) - 1,
+                                 int(len(sttfts) * 0.99))] if sttfts else 0.0
+                out[f"shed_rate_backpressure_{tag}"] = round(sheds[0] / N, 3)
+                out[f"p99_ttft_ms_backpressure_{tag}"] = round(p99 * 1000, 1)
+                print(
+                    f"shed({tag}): {sheds[0]}/{N} shed, "
+                    f"p99 TTFT {p99 * 1000:.1f} ms", file=sys.stderr,
+                )
+
+            # Injected loop death → crash-only restart recovery.
+            import tempfile
+
+            import yaml as _yaml
+
+            from localai_tpu.config import ApplicationConfig
+            from localai_tpu.server import ModelManager
+            from localai_tpu.testing import faults as _faults
+
+            md = tempfile.mkdtemp(prefix="bench-shed-models-")
+            with open(os.path.join(md, "bm.yaml"), "w") as f:
+                _yaml.safe_dump({
+                    "name": "bm", "model": arch, "context_size": max_seq,
+                    "max_slots": slots, "max_tokens": 8,
+                }, f)
+            mgr = ModelManager(ApplicationConfig(models_dir=md))
+            try:
+                lm = mgr.get("bm")
+                lm.engine.generate([1, 2, 3], max_new_tokens=2,
+                                   ignore_eos=True)
+                with _faults.active(_faults.FaultSchedule(
+                        seed=0, rate=1.0, sites=("engine_loop",),
+                        max_faults=1)):
+                    lm.engine._wake.set()
+                    deadline = time.time() + 120
+                    while not lm.engine.is_dead and time.time() < deadline:
+                        time.sleep(0.005)
+                if not lm.engine.is_dead:
+                    raise RuntimeError("injected loop death never landed")
+                t0 = time.time()
+                lm2 = mgr.get("bm")  # crash-only evict + reload
+                _, ev = lm2.engine.generate([1, 2, 3], max_new_tokens=2,
+                                            ignore_eos=True)
+                recover_ms = (time.time() - t0) * 1000
+                out["engine_restart_recover_ms"] = round(recover_ms, 1)
+                print(f"restart after injected loop death: "
+                      f"{recover_ms:.0f} ms to first served token",
+                      file=sys.stderr)
+            finally:
+                mgr.shutdown()
+        except Exception as e:  # noqa: BLE001 — extra row is best-effort
+            print(f"shed row failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
     # Prompt/prefix-cache rows (VERDICT r4 item 3), dense and paged: a LONG
     # shared prefix (4000 tokens, dedicated 8k-seq engines) so the prefill
     # saving (~0.5 s at measured rates) dominates tunnel-RTT noise — at a

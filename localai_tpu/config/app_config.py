@@ -39,6 +39,16 @@ class ApplicationConfig:
     watchdog_busy_timeout_s: float = 0.0
     watchdog_interval_s: float = 5.0  # reference ticks at 30s (watchdog.go:197)
 
+    # Crash-only restart budget (ISSUE 4, docs/ROBUSTNESS.md): when a
+    # model's engine loop dies, the manager evicts it and the next request
+    # transparently reloads — up to restart_budget deaths per
+    # restart_window_s. One more death inside the window quarantines the
+    # model for quarantine_s: requests get a clean typed 503 instead of
+    # feeding a reload/crash loop. restart_budget < 0 = never quarantine.
+    restart_budget: int = 3
+    restart_window_s: float = 300.0
+    quarantine_s: float = 300.0
+
     # Engine defaults.
     preload_models: list[str] = dataclasses.field(default_factory=list)
     default_context_size: int = 2048
@@ -108,6 +118,9 @@ class ApplicationConfig:
             watchdog_idle_timeout_s=_env("LOCALAI_WATCHDOG_IDLE_TIMEOUT", 0.0, float),
             watchdog_busy_timeout_s=_env("LOCALAI_WATCHDOG_BUSY_TIMEOUT", 0.0, float),
             watchdog_interval_s=_env("LOCALAI_WATCHDOG_INTERVAL", cls.watchdog_interval_s, float),
+            restart_budget=_env("LOCALAI_RESTART_BUDGET", cls.restart_budget, int),
+            restart_window_s=_env("LOCALAI_RESTART_WINDOW", cls.restart_window_s, float),
+            quarantine_s=_env("LOCALAI_QUARANTINE", cls.quarantine_s, float),
             default_context_size=_env("LOCALAI_CONTEXT_SIZE", cls.default_context_size, int),
             cors=_env("LOCALAI_CORS", True, bool),
             metrics=not _env("LOCALAI_DISABLE_METRICS", False, bool),

@@ -126,6 +126,16 @@ class ModelConfig:
     # (single-shot admission). LOCALAI_PREFILL_CHUNK env var overrides.
     prefill_chunk: int = 0
 
+    # Bounded admission + deadlines (ISSUE 4, docs/ROBUSTNESS.md). A full
+    # pending queue rejects at submit (HTTP 429 + Retry-After); requests
+    # queued past queue_timeout_s are shed with an error; deadline_s is the
+    # default end-to-end deadline for requests that don't carry their own.
+    # 0 disables each. LOCALAI_MAX_PENDING / LOCALAI_QUEUE_TIMEOUT /
+    # LOCALAI_DEADLINE env vars override.
+    max_pending: int = 0
+    queue_timeout_s: float = 0.0
+    deadline_s: float = 0.0
+
     # Speculative decoding (reference: draft_model/n_draft,
     # core/config/model_config.go:211-212).
     draft_model: str = ""  # arch preset or checkpoint dir; empty = off

@@ -8,5 +8,10 @@ KV-cache slots (the JAX equivalent of llama.cpp's server slots,
 backend/cpp/llama-cpp/grpc-server.cpp:679 PredictStream → slot queue).
 """
 
-from localai_tpu.engine.engine import Engine, EngineConfig, GenRequest  # noqa: F401
+from localai_tpu.engine.engine import (  # noqa: F401
+    Engine,
+    EngineConfig,
+    GenRequest,
+    QueueFullError,
+)
 from localai_tpu.engine.tokenizer import ByteTokenizer, load_tokenizer  # noqa: F401

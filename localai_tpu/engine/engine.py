@@ -66,8 +66,26 @@ from localai_tpu.ops.sampling import (
 )
 from localai_tpu.parallel.mesh import MeshPlan, build_mesh
 from localai_tpu.parallel.sharding import cache_shardings, param_shardings, validate_plan
+from localai_tpu.testing import faults
 
 log = logging.getLogger("localai_tpu.engine")
+
+
+class QueueFullError(RuntimeError):
+    """submit() rejected a request because the pending queue is at
+    EngineConfig.max_pending (crash-only backpressure, ISSUE 4): the server
+    sheds load at admission instead of queueing unboundedly. Carries a
+    Retry-After hint derived from the engine's observed admission latency so
+    the HTTP layer can map this to 429/503 + Retry-After."""
+
+    def __init__(self, depth: int, limit: int, retry_after_s: float) -> None:
+        super().__init__(
+            f"engine queue full ({depth} pending, max_pending={limit}) — "
+            f"retry in ~{retry_after_s:.0f}s"
+        )
+        self.depth = depth
+        self.limit = limit
+        self.retry_after_s = retry_after_s
 
 _SAMPLING_FIELDS = (
     "temperature",
@@ -194,6 +212,23 @@ class EngineConfig:
     # give the cleanest page DMAs but are not required. 0 disables
     # (single-shot admission). LOCALAI_PREFILL_CHUNK env var overrides.
     prefill_chunk: int = 0
+    # Bounded admission (ISSUE 4, docs/ROBUSTNESS.md): submit() raises
+    # QueueFullError once this many requests sit in the pending queue —
+    # load sheds at the door (HTTP 429 + Retry-After) instead of building
+    # an unbounded deque whose tail can never meet any latency target.
+    # 0 = unbounded (library/embedded use). LOCALAI_MAX_PENDING overrides.
+    max_pending: int = 0
+    # A request still PENDING after this many seconds is shed with an error
+    # event (it would have been admitted into a saturated engine only to
+    # blow its caller's timeout anyway). 0 disables.
+    # LOCALAI_QUEUE_TIMEOUT overrides.
+    queue_timeout_s: float = 0.0
+    # Default end-to-end deadline applied to requests that don't carry
+    # their own GenRequest.deadline_s: once exceeded, a pending request is
+    # shed and an active one is cancelled (its KV pages/host-tier bytes
+    # release on the next processed block). 0 disables.
+    # LOCALAI_DEADLINE overrides.
+    deadline_s: float = 0.0
     # KV-cache storage dtype (reference: CacheTypeKey/CacheTypeValue,
     # backend/backend.proto:261-262, llama.cpp q8 KV). "" = model dtype;
     # "fp8" (e4m3) / "fp8_e5m2" halve KV bytes — the TPU-native equivalent
@@ -263,6 +298,11 @@ class GenRequest:
     # Qwen2-VL m-rope: [3, len(prompt_ids)] (t, h, w) position streams
     # (models/qwen2_vl.mrope_positions_for_span). None → standard rope.
     mrope_positions: Optional[Any] = None
+    # End-to-end deadline in seconds from submit() (ISSUE 4): a request
+    # still pending past it is shed with an error event; an active one is
+    # cancelled and its slot/KV pages released. 0 = engine default
+    # (EngineConfig.deadline_s), which may itself be 0 (no deadline).
+    deadline_s: float = 0.0
     # INTERNAL — set by the engine when it preempts a slot (ISSUE 3).
     # Carries the victim's host-side continuation state (generated tokens,
     # RNG chain, swap image) so re-admission resumes the original stream
@@ -293,6 +333,11 @@ class RequestHandle:
     def __init__(self) -> None:
         self._q: "queue.Queue[TokenEvent]" = queue.Queue()
         self.cancelled = threading.Event()
+        # Stamped by submit(): admission-wait measurement + deadline/queue-
+        # timeout enforcement (ISSUE 4). 0.0 / None on handles built outside
+        # submit (warmup) — every consumer guards on that.
+        self.t_submit: float = 0.0
+        self.deadline: Optional[float] = None  # absolute monotonic
 
     def __iter__(self) -> Iterator[TokenEvent]:
         while True:
@@ -404,6 +449,9 @@ class Engine:
             "LOCALAI_KV_PAGE_HEADROOM": ("kv_page_headroom", int),
             "LOCALAI_KV_PREEMPT": ("kv_preempt", str),
             "LOCALAI_KV_SWAP_BYTES": ("kv_swap_bytes", int),
+            "LOCALAI_MAX_PENDING": ("max_pending", int),
+            "LOCALAI_QUEUE_TIMEOUT": ("queue_timeout_s", float),
+            "LOCALAI_DEADLINE": ("deadline_s", float),
         }.items():
             val = os.environ.get(env)
             if val is not None and val != "":
@@ -414,6 +462,13 @@ class Engine:
             )
         if self.ecfg.kv_page_headroom < 0:
             raise ValueError("kv_page_headroom must be >= 0")
+        if self.ecfg.max_pending < 0:
+            raise ValueError("max_pending must be >= 0 (0 = unbounded)")
+        if self.ecfg.queue_timeout_s < 0 or self.ecfg.deadline_s < 0:
+            raise ValueError("queue_timeout_s / deadline_s must be >= 0")
+        # Arm LOCALAI_FAULTS (deterministic fault injection — testing/faults)
+        # before the loop thread can hit any hook point.
+        faults.ensure_env_installed()
         C = self.ecfg.prefill_chunk
         if C:
             if C < self.ecfg.min_prefill_bucket or C & (C - 1):
@@ -595,6 +650,13 @@ class Engine:
         self._last_submit_t = 0.0
         self._admit_hold_start = 0.0
         self._loop_dead: Optional[str] = None  # set by _loop_guard on crash
+        # Bounded-admission / deadline accounting (ISSUE 4). _admit_wait_ewma
+        # tracks observed submit→admission latency (seconds) and feeds the
+        # Retry-After hint on QueueFullError.
+        self._admit_wait_ewma = 0.0
+        self.m_queue_shed = 0
+        self.m_queue_timeouts = 0
+        self.m_deadline_expired = 0
         self._drain_thread: Optional[threading.Thread] = None
         self._drain_q: "queue.Queue[Optional[_Entry]]" = queue.Queue()
         self._lp_warmed = False  # warmup(logprobs=True) compiled lp kv_win blocks
@@ -722,6 +784,9 @@ class Engine:
         caller bug — overwriting it would leak its pages' refcounts into
         the pool forever, so the stale table is released first (and raised
         under LOCALAI_ALLOC_DEBUG=1 / the test suite)."""
+        # Injected allocator failure fires BEFORE any mutation so pool
+        # accounting stays exact across the fault (testing/faults).
+        faults.fire("page_alloc")
         if self._slot_pages[slot_idx]:
             if os.environ.get("LOCALAI_ALLOC_DEBUG", "0") == "1":
                 raise AssertionError(
@@ -899,6 +964,7 @@ class Engine:
         """Pull a page span's K/V to host numpy. The gathered arrays are
         device-side snapshots, so the pages themselves can be recycled the
         moment this returns; the D2H copy is started async and awaited."""
+        faults.fire("host_swap")
         npg = len(pages)
         npgb = self._pow2_pages(npg)
         idx = np.full((npgb,), self._scratch_page, np.int32)
@@ -915,6 +981,7 @@ class Engine:
     def _swap_in_pages(self, pages: list[int], hk: np.ndarray,
                        hv: np.ndarray) -> None:
         """Scatter host K/V back into freshly-allocated pool pages."""
+        faults.fire("host_swap")
         npg = len(pages)
         npgb = self._pow2_pages(npg)
         idx = np.full((npgb,), self._scratch_page, np.int32)
@@ -3019,7 +3086,8 @@ class Engine:
                 slot.handle._q.put(TokenEvent(kind="done", finish_reason="stop"))
         with self._pending_lock:
             pending, self._pending = list(self._pending), deque()
-        for _req, handle in pending:
+        for req, handle in pending:
+            self._resume_discard(req)
             handle._q.put(TokenEvent(kind="done", finish_reason="stop"))
         if self._tok_fp is not None:
             # Release grammar tables prewarm pinned against this engine's
@@ -3077,16 +3145,61 @@ class Engine:
         if request.grammar is not None and self._tok_strs is None:
             self._token_str(0)  # build the table here, not in the engine loop
         handle = RequestHandle()
-        if self._loop_dead is not None:
-            # The loop thread is gone — nothing will ever serve this request.
-            handle._q.put(TokenEvent(kind="error", error=self._loop_dead))
-            return handle
+        handle.t_submit = time.monotonic()
+        deadline_s = request.deadline_s or self.ecfg.deadline_s
+        if deadline_s > 0:
+            handle.deadline = handle.t_submit + deadline_s
+        # Dead-check and append share _pending_lock with _loop_guard's
+        # set-dead-and-drain: either this submit observes the death (error
+        # event below) or its entry lands before the drain and is drained
+        # with an error event — never appended after it and orphaned.
         with self._pending_lock:
-            self._pending.append((request, handle))
-            self._last_submit_t = time.monotonic()
+            dead = self._loop_dead
+            if dead is None:
+                if (self.ecfg.max_pending
+                        and len(self._pending) >= self.ecfg.max_pending):
+                    # Shed at the door (ISSUE 4): a queue past max_pending
+                    # only manufactures timeouts. Raise a typed error the
+                    # HTTP layer maps to 429 + Retry-After.
+                    self.m_queue_shed += 1
+                    raise QueueFullError(
+                        len(self._pending), self.ecfg.max_pending,
+                        self.admission_wait_estimate(),
+                    )
+                self._pending.append((request, handle))
+                self._last_submit_t = handle.t_submit
+        if dead is not None:
+            # The loop thread is gone — nothing will ever serve this request.
+            handle._q.put(TokenEvent(kind="error", error=dead))
+            return handle
         self._wake.set()
         self.start()
         return handle
+
+    def admission_wait_estimate(self) -> float:
+        """Observed submit→admission latency (EWMA, seconds), floored at 1 —
+        the Retry-After hint for shed requests."""
+        return max(1.0, self._admit_wait_ewma)
+
+    def _note_admitted(self, handle: RequestHandle) -> None:
+        """Record one request's queue wait into the admission-latency EWMA
+        (loop thread only; handles built outside submit() carry no stamp)."""
+        if handle.t_submit <= 0.0:
+            return
+        wait = max(0.0, time.monotonic() - handle.t_submit)
+        if self._admit_wait_ewma == 0.0:
+            self._admit_wait_ewma = wait
+        else:
+            self._admit_wait_ewma = 0.8 * self._admit_wait_ewma + 0.2 * wait
+
+    @property
+    def is_dead(self) -> bool:
+        """True once the engine loop died of an unexpected exception. A dead
+        engine fails every submit with an error event and never recovers
+        in-process — the ModelManager observes this state, evicts the model
+        and transparently reloads it on the next request (crash-only
+        supervision, ISSUE 4 / docs/ROBUSTNESS.md)."""
+        return self._loop_dead is not None
 
     def generate(self, prompt_ids: list[int], **kw) -> tuple[str, TokenEvent]:
         return self.submit(GenRequest(prompt_ids=list(prompt_ids), **kw)).result()
@@ -3094,7 +3207,15 @@ class Engine:
     def cancel_all(self) -> int:
         """Cancel every active and pending request (watchdog busy-kill path —
         reference: watchdog.go:250-279 kills the wedged backend process; here
-        the slots drain via their cancelled handles). Returns count."""
+        the slots drain via their cancelled handles). Returns count.
+
+        Pending entries are not just flagged: the loop's _purge_pending pops
+        them and posts a terminal event, so a consumer blocked in result()
+        or a stream drain always unblocks — previously a cancelled entry sat
+        in _pending until a slot freed (or forever, with the loop dead) and
+        its caller hung (ISSUE 4 satellite). If no loop thread is alive to
+        purge (never started, stopped, or dead), drain here instead — there
+        is no thread to race with host-tier state then."""
         n = 0
         with self._pending_lock:
             for _req, handle in self._pending:
@@ -3105,6 +3226,13 @@ class Engine:
                 slot.handle.cancel()
                 n += 1
         self._wake.set()
+        loop = self._thread
+        if loop is None or not loop.is_alive():
+            with self._pending_lock:
+                pending, self._pending = list(self._pending), deque()
+            for request, handle in pending:
+                self._resume_discard(request)
+                handle._q.put(TokenEvent(kind="done", finish_reason="stop"))
         return n
 
     def embed(self, ids_batch: list[list[int]]) -> np.ndarray:
@@ -3147,6 +3275,12 @@ class Engine:
             "tokens_per_second": tps,
             "active_slots": float(int(self.h_active.sum())),
             "queue_depth": float(len(self._pending)),
+            # Request-lifecycle robustness gauges (ISSUE 4).
+            "queue_shed": float(self.m_queue_shed),
+            "queue_timeouts": float(self.m_queue_timeouts),
+            "deadline_expired": float(self.m_deadline_expired),
+            "admit_wait_ms": float(self._admit_wait_ewma * 1000.0),
+            "loop_dead": 1.0 if self._loop_dead is not None else 0.0,
         }
         if self._prefix_enabled:
             out["prefix_cache_hits"] = float(self.m_prefix_hits)
@@ -3556,24 +3690,65 @@ class Engine:
         except BaseException as e:  # noqa: BLE001 — terminal: report and drain
             log.exception("engine loop died; failing all live requests")
             err = f"engine loop died: {type(e).__name__}: {e}"
-            self._loop_dead = err
+            # Set-dead + drain atomically w.r.t. submit()'s check-and-append
+            # (same lock), so no entry can slip in AFTER this drain yet miss
+            # the dead-engine error event.
+            with self._pending_lock:
+                self._loop_dead = err
+                pending, self._pending = list(self._pending), deque()
             for i in range(self.ecfg.max_slots):
                 slot = self.slots[i]
                 if slot is not None:
                     slot.handle._q.put(TokenEvent(kind="error", error=err))
-            with self._pending_lock:
-                pending, self._pending = list(self._pending), deque()
-            for _req, handle in pending:
+            for request, handle in pending:
+                self._resume_discard(request)
                 handle._q.put(TokenEvent(kind="error", error=err))
+            # Crash-only teardown (ISSUE 4): release every per-request
+            # claim on the page pool and host tier so the dying engine's
+            # accounting quiesces clean — the manager will evict and reload,
+            # but the fault harness (and any monitoring scrape in between)
+            # must see a fully-accounted pool, not one wedged mid-request.
+            try:
+                self._release_all_state()
+            except Exception:  # noqa: BLE001 — best-effort on a dead engine
+                log.exception("post-death state release failed")
             # No re-raise: the failure is fully reported (log + error events);
             # an unhandled thread exception would only add noise.
+
+    def _release_all_state(self) -> None:
+        """Drop all slot/pool/host-tier request state after a loop death.
+        Every handle has already received its terminal event; this only
+        reconciles the allocator and host tier (loop thread — it is the
+        dying thread's last act, so nothing races it)."""
+        self._inflight.clear()
+        self._chunkings = []
+        self._growth_blocked = False
+        for i in range(self.ecfg.max_slots):
+            self.slots[i] = None
+            self.h_active[i] = False
+            self.h_override_mask[i] = False
+            self.h_gmask[i] = 0.0
+            if self._paged and self._slot_pages[i]:
+                self._pages_free(i)
+        if self._paged:
+            # Prefix spans hold pool-page references; the reloaded engine
+            # starts cold anyway.
+            for entry in self._prefix_entries:
+                if entry.get("pages"):
+                    self._pages_release(entry["pages"])
+        self._prefix_entries = []
+        self._prefix_host = []
+        self._host_bytes = 0
 
     def _loop(self) -> None:
         trace = os.environ.get("LOCALAI_ENGINE_TRACE", "0") == "1"
         self._charge_last = time.monotonic()
         self._charge_was_active = False
         while not self._shutdown.is_set():
+            faults.fire("engine_loop")  # injected loop death (ISSUE 4)
             self._charge()
+            self._purge_pending()
+            self._enforce_deadlines()
 
             if self._growth_blocked and not self.h_active.any():
                 # The growth-starved slots are gone (finished or preempted
@@ -3653,6 +3828,81 @@ class Engine:
             elif not active and not admitted:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
+
+    # ------------------------------------------------------------------ #
+    # Request-lifecycle enforcement (ISSUE 4, docs/ROBUSTNESS.md)
+    # ------------------------------------------------------------------ #
+
+    def _purge_pending(self) -> None:
+        """Drop cancelled / deadline-expired / queue-timed-out entries from
+        the pending queue, posting exactly one terminal event each (loop
+        thread, and stop()/cancel_all() after the loop is gone). Admission
+        also drops cancelled entries at the queue head, but only when a slot
+        is free — a saturated engine would otherwise hold a cancelled
+        caller's stream open indefinitely."""
+        if not self._pending:  # unlocked peek — len() is atomic in CPython
+            return
+        with self._pending_lock:
+            if not self._pending:
+                return
+            now = time.monotonic()
+            qt = self.ecfg.queue_timeout_s
+            kept: deque[tuple[GenRequest, RequestHandle]] = deque()
+            dropped: list[tuple[GenRequest, RequestHandle, Optional[str]]] = []
+            for request, handle in self._pending:
+                if handle.cancelled.is_set():
+                    dropped.append((request, handle, None))
+                elif handle.deadline is not None and now > handle.deadline:
+                    dropped.append((request, handle, "deadline"))
+                elif (qt > 0 and handle.t_submit > 0
+                        and now - handle.t_submit > qt):
+                    dropped.append((request, handle, "queue-timeout"))
+                else:
+                    kept.append((request, handle))
+            self._pending = kept
+        for request, handle, why in dropped:
+            self._resume_discard(request)
+            if why is None:
+                handle._q.put(TokenEvent(kind="done", finish_reason="stop"))
+                continue
+            if why == "deadline":
+                self.m_deadline_expired += 1
+                waited = now - handle.t_submit if handle.t_submit else 0.0
+                err = (f"deadline exceeded after {waited:.1f}s in queue "
+                       f"(deadline_s)")
+            else:
+                self.m_queue_timeouts += 1
+                err = (f"request timed out after "
+                       f"{self.ecfg.queue_timeout_s:.1f}s in queue "
+                       f"(queue_timeout_s) — server saturated")
+            handle.cancel()  # a racing admit must not serve it anyway
+            handle._q.put(TokenEvent(kind="error", error=err))
+
+    def _enforce_deadlines(self) -> None:
+        """Cancel ACTIVE slots whose deadline has passed (loop thread). The
+        cancelled handle drains through the ordinary paths — _post_token /
+        _advance_chunked finish the slot and release its KV pages / host-
+        tier bytes. When nothing is in flight (so no dispatched write can
+        still target the slot's pages) a cancelled slot is torn down right
+        here: a growth-blocked or otherwise stalled engine must not pin a
+        cancelled request's pages while waiting for traffic."""
+        now = time.monotonic()
+        for i in range(self.ecfg.max_slots):
+            slot = self.slots[i]
+            if slot is None:
+                continue
+            h = slot.handle
+            if (h.deadline is not None and now > h.deadline
+                    and not h.cancelled.is_set()):
+                self.m_deadline_expired += 1
+                h.cancel()
+        if not self._inflight:
+            chunking = {st["slot"] for st in self._chunkings}
+            for i in range(self.ecfg.max_slots):
+                slot = self.slots[i]
+                if (slot is not None and slot.handle.cancelled.is_set()
+                        and i not in chunking):
+                    self._finish(i, "stop")
 
     # ------------------------------------------------------------------ #
     # Admission
@@ -3770,6 +4020,7 @@ class Engine:
                     self._prefix_evict_for_pages(need)
                 if (len(self._free_pages) >= need
                         and self._dispatch_resume_swap(request, handle, free[0])):
+                    self._note_admitted(handle)
                     admitted = True
                     continue  # re-plan the remaining queue
                 with self._pending_lock:
@@ -3778,11 +4029,14 @@ class Engine:
             if chunk_item is not None:
                 (request, handle), hit = chunk_item
                 if self._chunk_start(request, handle, hit):
+                    self._note_admitted(handle)
                     admitted = True
                     continue  # re-plan the remaining queue
                 return admitted  # pool backpressure — wait for a finish
             if not group:
                 return admitted
+            for _req, gh in group:
+                self._note_admitted(gh)
             # Requests with logit_bias, a grammar, or logprobs select
             # different program variants (has_bias / with_topk / with_lp);
             # admit them as singletons so only the (m=1, ...) variants ever
@@ -3834,6 +4088,7 @@ class Engine:
         slot_ids: list[int],
         prefix_hit: tuple | None = None,
     ) -> None:
+        faults.fire("device_dispatch")
         m = len(chunk)
         V = self.cfg.vocab_size
         dfa_tables = None
@@ -4094,6 +4349,7 @@ class Engine:
         without dispatching when the paged pool could not be grown to cover
         the block's writes — the loop then drains in-flight work and
         preempts the youngest slot (ISSUE 3)."""
+        faults.fire("device_dispatch")
         B = self.ecfg.max_slots
         if grammar:
             variant, n = "grammar", 1

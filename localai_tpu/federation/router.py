@@ -42,12 +42,25 @@ class Worker:
     total_served: int = 0
     healthy: bool = True
     last_seen: float = field(default_factory=time.monotonic)
+    # Unhealthy re-probe backoff (ISSUE 4): consecutive failed probes and
+    # the earliest time the health loop may probe this worker again. A
+    # worker previously flapped straight back — every tick re-probed it and
+    # one lucky /healthz marked it healthy again mid-outage, routing user
+    # traffic into the failure.
+    fail_count: int = 0
+    next_probe: float = 0.0
+    # Health-transition counters (monitoring): healthy→unhealthy and back.
+    went_unhealthy: int = 0
+    went_healthy: int = 0
 
 
 class WorkerRegistry:
-    def __init__(self) -> None:
+    def __init__(self, backoff_base_s: float = 1.0,
+                 backoff_max_s: float = 60.0) -> None:
         self._lock = threading.Lock()
         self._workers: dict[str, Worker] = {}
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
 
     def add(self, name: str, url: str) -> None:
         with self._lock:
@@ -55,6 +68,8 @@ class WorkerRegistry:
             if w is not None:
                 w.url = url.rstrip("/")
                 w.healthy = True
+                w.fail_count = 0
+                w.next_probe = 0.0
                 w.last_seen = time.monotonic()
             else:
                 self._workers[name] = Worker(name=name, url=url.rstrip("/"))
@@ -92,9 +107,35 @@ class WorkerRegistry:
 
     def mark(self, w: Worker, healthy: bool) -> None:
         with self._lock:
-            w.healthy = healthy
             if healthy:
+                if not w.healthy:
+                    w.went_healthy += 1
+                    log.info("worker %s (%s) healthy again after %d failed "
+                             "probes", w.name, w.url, w.fail_count)
+                w.healthy = True
+                w.fail_count = 0
+                w.next_probe = 0.0
                 w.last_seen = time.monotonic()
+                return
+            if w.healthy:
+                w.went_unhealthy += 1
+            w.healthy = False
+            # Exponential re-probe backoff: 1 failure → base, then doubling
+            # to the cap. A mid-outage worker is probed ever more rarely
+            # instead of every tick (where one lucky probe flapped it back
+            # into rotation while still broken).
+            w.fail_count += 1
+            backoff = min(
+                self.backoff_base_s * (2 ** (w.fail_count - 1)),
+                self.backoff_max_s,
+            )
+            w.next_probe = time.monotonic() + backoff
+
+    def due_for_probe(self, w: Worker) -> bool:
+        """Healthy workers probe every tick; unhealthy ones only after
+        their current backoff expires."""
+        with self._lock:
+            return w.healthy or time.monotonic() >= w.next_probe
 
 
 class FederatedServer:
@@ -116,6 +157,8 @@ class FederatedServer:
         workers: Optional[list[tuple[str, str]]] = None,
         health_interval_s: float = 5.0,
         token: Optional[str] = None,
+        probe_backoff_s: float = 1.0,
+        probe_backoff_max_s: float = 60.0,
     ):
         # Shared-token gate on the control plane (reference parity:
         # core/p2p/p2p.go:31-64 — the libp2p overlay requires a shared
@@ -126,7 +169,9 @@ class FederatedServer:
         import os as _os
 
         self.token = token if token is not None else _os.environ.get("LOCALAI_P2P_TOKEN", "")
-        self.registry = WorkerRegistry()
+        self.registry = WorkerRegistry(
+            backoff_base_s=probe_backoff_s, backoff_max_s=probe_backoff_max_s
+        )
         self.strategy = strategy
         for name, url in workers or []:
             self.registry.add(name, url)
@@ -140,9 +185,12 @@ class FederatedServer:
         return self._server.server_address[1]
 
     def start(self) -> None:
-        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="fed-server").start()
         if self._health_interval > 0:
-            self._health_thread = threading.Thread(target=self._health_loop, daemon=True)
+            self._health_thread = threading.Thread(
+                target=self._health_loop, daemon=True, name="fed-health"
+            )
             self._health_thread.start()
 
     def stop(self) -> None:
@@ -154,12 +202,15 @@ class FederatedServer:
     def _health_loop(self) -> None:
         while not self._stop.wait(self._health_interval):
             for w in self.registry.list():
+                if not self.registry.due_for_probe(w):
+                    continue  # unhealthy and still inside its backoff
                 try:
                     with urllib.request.urlopen(w.url + "/healthz", timeout=3):
                         pass
                     self.registry.mark(w, True)
                 except Exception:  # noqa: BLE001
-                    log.warning("worker %s (%s) unhealthy", w.name, w.url)
+                    log.warning("worker %s (%s) unhealthy (probe #%d)",
+                                w.name, w.url, w.fail_count + 1)
                     self.registry.mark(w, False)
 
     def _build(self, address: str, port: int) -> ThreadingHTTPServer:
@@ -208,6 +259,9 @@ class FederatedServer:
                         {
                             "name": w.name, "url": w.url, "healthy": w.healthy,
                             "in_flight": w.in_flight,
+                            "fail_count": w.fail_count,
+                            "went_unhealthy": w.went_unhealthy,
+                            "went_healthy": w.went_healthy,
                         }
                         for w in fed.registry.list()
                     ], "strategy": fed.strategy})

@@ -5,5 +5,5 @@ Rebuilt on the Python stdlib (ThreadingHTTPServer) — no web framework
 dependency — with SSE streaming wired straight to the engine's token queues.
 """
 
-from localai_tpu.server.manager import ModelManager  # noqa: F401
+from localai_tpu.server.manager import ModelManager, ModelQuarantinedError  # noqa: F401
 from localai_tpu.server.app import create_server, Router  # noqa: F401

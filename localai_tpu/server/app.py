@@ -102,16 +102,25 @@ class SSEStream:
 
 
 class ApiError(Exception):
-    def __init__(self, status: int, message: str, kind: str = "invalid_request_error"):
+    def __init__(self, status: int, message: str, kind: str = "invalid_request_error",
+                 retry_after: Optional[float] = None):
         super().__init__(message)
         self.status = status
         self.kind = kind
+        # Backpressure/quarantine errors (429/503, ISSUE 4) carry a
+        # Retry-After hint derived from observed admission latency or the
+        # remaining quarantine window.
+        self.retry_after = retry_after
 
     def to_response(self) -> Response:
         # OpenAI-style error envelope (reference: core/http error handler).
+        headers = {}
+        if self.retry_after is not None:
+            headers["Retry-After"] = str(max(1, int(-(-self.retry_after // 1))))
         return Response(
             status=self.status,
             body={"error": {"message": str(self), "type": self.kind, "code": self.status}},
+            headers=headers,
         )
 
 

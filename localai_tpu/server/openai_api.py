@@ -17,9 +17,13 @@ from typing import Any, Callable, Iterator, Optional
 
 from localai_tpu import __version__
 from localai_tpu.config import Usecase
-from localai_tpu.engine import GenRequest
+from localai_tpu.engine import GenRequest, QueueFullError
 from localai_tpu.server.app import ApiError, Request, Response, Router, SSEStream
-from localai_tpu.server.manager import LoadedModel, ModelManager
+from localai_tpu.server.manager import (
+    LoadedModel,
+    ModelManager,
+    ModelQuarantinedError,
+)
 
 
 def _now() -> int:
@@ -129,6 +133,33 @@ class OpenAIApi:
             return self.manager.lease(name)
         except KeyError:
             raise ApiError(404, f"model {name!r} not found") from None
+        except ModelQuarantinedError as e:
+            # Crash-only supervision tripped its restart budget (ISSUE 4):
+            # a clean 503 with the remaining quarantine window, not a
+            # respawn loop.
+            raise ApiError(
+                503, str(e), "server_error", retry_after=e.retry_after_s
+            ) from None
+
+    @staticmethod
+    def _submit_all(lm: LoadedModel, gens: list) -> list:
+        """Submit every GenRequest, mapping engine backpressure to HTTP:
+        a full queue (QueueFullError) becomes 429 + Retry-After derived
+        from the engine's observed admission latency, and any handles
+        already submitted are cancelled so a partially-admitted multi-
+        choice request never leaks slots."""
+        handles = []
+        try:
+            for g in gens:
+                handles.append(lm.engine.submit(g))
+        except QueueFullError as e:
+            for h in handles:
+                h.cancel()
+            raise ApiError(
+                429, str(e), "rate_limit_exceeded",
+                retry_after=e.retry_after_s,
+            ) from None
+        return handles
 
     def _proxy_remote(self, req: Request, lm: LoadedModel, lease) -> Response | SSEStream:
         """Relay a request to an out-of-process backend (backend: remote or
@@ -209,6 +240,10 @@ class OpenAIApi:
             # vLLM-style extension: benchmarking/testing wants fixed-length
             # generations regardless of what the model samples.
             ignore_eos=bool(body.get("ignore_eos", False)),
+            # End-to-end deadline (ISSUE 4): body overrides the model
+            # YAML's default; past it, pending requests shed and active
+            # ones cancel (docs/ROBUSTNESS.md).
+            deadline_s=float(pick("deadline_s", cfg.deadline_s)),
         )
 
     @staticmethod
@@ -242,7 +277,8 @@ class OpenAIApi:
                 q.put((idx, ev))
 
         for idx, h in enumerate(handles):
-            threading.Thread(target=reader, args=(idx, h), daemon=True).start()
+            threading.Thread(target=reader, args=(idx, h), daemon=True,
+                             name=f"stream-reader-{idx}").start()
         done = 0
         while done < len(handles):
             idx, ev = q.get()
@@ -454,7 +490,7 @@ class OpenAIApi:
         extra_usage = "extra-usage" in req.headers
 
         if body.get("stream"):
-            handles = [lm.engine.submit(g) for g in gens]
+            handles = self._submit_all(lm, gens)
 
             def cancel_all() -> None:
                 for h in handles:
@@ -536,7 +572,7 @@ class OpenAIApi:
             return SSEStream(events(), on_disconnect=cancel_all)
 
         try:
-            handles = [lm.engine.submit(g) for g in gens]
+            handles = self._submit_all(lm, gens)
             try:
                 results = [self._collect(h) for h in handles]
             except BaseException:
@@ -650,7 +686,7 @@ class OpenAIApi:
                 gens.append(g)
 
         if body.get("stream"):
-            handles = [lm.engine.submit(g) for g in gens]
+            handles = self._submit_all(lm, gens)
 
             def cancel_all() -> None:
                 for h in handles:
@@ -686,7 +722,7 @@ class OpenAIApi:
             return SSEStream(events(), on_disconnect=cancel_all)
 
         try:
-            handles = [lm.engine.submit(g) for g in gens]
+            handles = self._submit_all(lm, gens)
             try:
                 results = [self._collect(h) for h in handles]
             except BaseException:
@@ -729,7 +765,7 @@ class OpenAIApi:
         try:
             prompt = lm.evaluator.template_edit(instruction, body.get("input", ""))
             ids = lm.engine.tokenizer.encode(prompt, add_bos=True)
-            text, final = lm.engine.submit(self._gen_request(lm, body, ids)).result()
+            text, final = self._submit_all(lm, [self._gen_request(lm, body, ids)])[0].result()
         finally:
             lease.release()
         if needs_finetune(lm.cfg):
@@ -853,6 +889,9 @@ class OpenAIApi:
                 continue
             for k, v in gauges.items():
                 out.append((f"localai_engine_{k}", {"model": n}, v))
+        # Supervision gauges (ISSUE 4): restart / quarantine counters live
+        # on the manager, not the (replaceable) engines.
+        out.extend(self.manager.health_gauges())
         return out
 
     def backend_monitor(self, req: Request) -> Response:
@@ -868,6 +907,7 @@ class OpenAIApi:
             "metrics": lm.engine.metrics(),
             "loaded_for_s": time.monotonic() - lm.loaded_at,
             "in_flight": lm.in_flight,
+            "supervision": self.manager.restart_stats(name),
         })
 
     def backend_shutdown(self, req: Request) -> Response:

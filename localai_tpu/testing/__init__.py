@@ -1,0 +1,5 @@
+"""Deterministic test instrumentation (fault injection — see faults.py).
+
+Nothing in here may import jax or any other heavy dependency: the hooks sit
+on serving hot paths and must cost one attribute load when disabled.
+"""

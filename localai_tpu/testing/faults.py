@@ -1,0 +1,220 @@
+"""Deterministic fault injection at the engine/manager seams (ISSUE 4).
+
+The reference LocalAI gets crash-only robustness for free from its process
+model (watchdog.go kills a wedged backend, the next request respawns it) and
+never needed a fault harness; our in-process port does. Every failure path
+shipped before this module existed was found by accident (the BENCH_r05
+loop-death hang, the 107k-preemption livelock). This module makes failure a
+first-class, *seeded* input: a `FaultSchedule` decides — reproducibly, per
+site — when a hook point raises `InjectedFault`, so the randomized churn
+test (tests/test_robustness.py) can drive hundreds of distinct failure
+interleavings and assert the invariant that matters: every submitted request
+terminates and the page pool + host tier stay fully accounted.
+
+Hook sites (each is one `faults.fire(SITE)` call in production code):
+
+  device_dispatch  — entry of Engine._dispatch_block/_dispatch_admit. Raising
+                     here exercises the per-request containment paths (the
+                     loop catches, posts error events, keeps serving).
+  engine_loop      — top of the Engine._loop iteration. Raising here is an
+                     UNCAUGHT loop death: exercises _loop_guard's drain +
+                     state release and the manager's restart/quarantine path.
+  page_alloc       — entry of Engine._pages_alloc (before any mutation, so
+                     accounting stays exact). Depending on the call path this
+                     either fails one admission or kills the loop.
+  host_swap        — entry of the swap-tier D2H/H2D copies
+                     (_swap_out_pages/_swap_in_pages).
+  manager_load     — entry of ModelManager._load: exercises the failed-load
+                     containment (RuntimeError to that one caller).
+
+Activation:
+  - programmatic: `with faults.active(FaultSchedule(seed=7)): ...`
+  - environment:  LOCALAI_FAULTS="seed:7[,rate:0.05][,max:4]
+                  [,sites:engine_loop|page_alloc]" — picked up lazily by the
+                  first fire() call (Engine/ModelManager construction also
+                  arms it explicitly via ensure_env_installed()).
+
+Determinism: each site gets its own RNG seeded from (seed, site), so the
+injection pattern at a site depends only on how many times that site has
+fired — not on cross-thread interleaving between sites.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import threading
+from typing import Iterator, Optional, Sequence
+
+SITES = (
+    "device_dispatch",
+    "engine_loop",
+    "page_alloc",
+    "host_swap",
+    "manager_load",
+)
+
+DEFAULT_RATE = 0.05
+
+
+class InjectedFault(Exception):
+    """Raised by fire() when the active schedule says this call fails.
+
+    Deliberately NOT a RuntimeError: containment code distinguishes its own
+    typed RuntimeErrors (re-raised verbatim) from generic backend failures
+    (wrapped) — an injected fault must take the generic-failure path, like
+    the XLA/device error it stands in for."""
+
+
+class FaultSchedule:
+    """Seed-driven decision source: which fire() calls raise.
+
+    rate        — per-call injection probability (site_rates overrides
+                  per site).
+    sites       — sites eligible for injection (default: all).
+    max_faults  — total injections before the schedule goes quiet
+                  (None = unbounded). Bounding it lets churn tests assert
+                  RECOVERY, not just failure: traffic after the last
+                  injection must succeed.
+    """
+
+    def __init__(
+        self,
+        seed: int,
+        rate: float = DEFAULT_RATE,
+        sites: Optional[Sequence[str]] = None,
+        max_faults: Optional[int] = None,
+        site_rates: Optional[dict[str, float]] = None,
+    ) -> None:
+        self.seed = int(seed)
+        self.rate = float(rate)
+        self.sites = tuple(sites) if sites is not None else SITES
+        unknown = set(self.sites) - set(SITES)
+        if unknown:
+            raise ValueError(f"unknown fault sites {sorted(unknown)} — use {SITES}")
+        self.max_faults = max_faults
+        self.site_rates = dict(site_rates or {})
+        self._lock = threading.Lock()
+        self._rngs = {s: random.Random(f"{self.seed}:{s}") for s in SITES}
+        self.calls: dict[str, int] = {s: 0 for s in SITES}
+        self.fired: dict[str, int] = {s: 0 for s in SITES}
+
+    def total_fired(self) -> int:
+        with self._lock:
+            return sum(self.fired.values())
+
+    def should_fire(self, site: str) -> bool:
+        with self._lock:
+            self.calls[site] = self.calls.get(site, 0) + 1
+            # Draw BEFORE eligibility filters so the per-site decision
+            # sequence is a pure function of (seed, site, call index) —
+            # narrowing `sites` or exhausting max_faults never reshuffles
+            # the pattern at other sites.
+            draw = self._rngs[site].random()
+            if site not in self.sites:
+                return False
+            if self.max_faults is not None and sum(self.fired.values()) >= self.max_faults:
+                return False
+            if draw >= self.site_rates.get(site, self.rate):
+                return False
+            self.fired[site] = self.fired.get(site, 0) + 1
+            return True
+
+    def __repr__(self) -> str:  # shows up in InjectedFault messages/logs
+        return (
+            f"FaultSchedule(seed={self.seed}, rate={self.rate}, "
+            f"sites={self.sites}, max_faults={self.max_faults})"
+        )
+
+
+_active: Optional[FaultSchedule] = None
+_env_checked = False
+_install_lock = threading.Lock()
+
+
+def install(schedule: Optional[FaultSchedule]) -> None:
+    """Make `schedule` the process-wide active schedule (None deactivates)."""
+    global _active, _env_checked
+    with _install_lock:
+        _active = schedule
+        # An explicit install wins over (and stops re-checking) the env.
+        _env_checked = True
+
+
+def uninstall() -> None:
+    install(None)
+
+
+@contextlib.contextmanager
+def active(schedule: FaultSchedule) -> Iterator[FaultSchedule]:
+    """Scoped activation for tests; restores the previous schedule."""
+    global _active
+    with _install_lock:
+        prev = _active
+        _active = schedule
+    try:
+        yield schedule
+    finally:
+        with _install_lock:
+            _active = prev
+
+
+def parse_env(spec: str) -> Optional[FaultSchedule]:
+    """Parse LOCALAI_FAULTS ("seed:7,rate:0.1,max:4,sites:a|b")."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    kw: dict = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, val = part.partition(":")
+        key = key.strip().lower()
+        val = val.strip()
+        if key == "seed":
+            kw["seed"] = int(val)
+        elif key == "rate":
+            kw["rate"] = float(val)
+        elif key == "max":
+            kw["max_faults"] = int(val)
+        elif key == "sites":
+            kw["sites"] = tuple(s.strip() for s in val.split("|") if s.strip())
+        else:
+            raise ValueError(f"LOCALAI_FAULTS: unknown key {key!r} in {spec!r}")
+    if "seed" not in kw:
+        raise ValueError(f"LOCALAI_FAULTS needs seed:N (got {spec!r})")
+    return FaultSchedule(**kw)
+
+
+def ensure_env_installed() -> None:
+    """Arm the schedule named by LOCALAI_FAULTS, once, if none is active."""
+    global _active, _env_checked
+    if _env_checked:
+        return
+    with _install_lock:
+        if _env_checked:
+            return
+        _env_checked = True
+        if _active is None:
+            _active = parse_env(os.environ.get("LOCALAI_FAULTS", ""))
+
+
+def fire(site: str) -> None:
+    """Hook point: raise InjectedFault when the active schedule says so.
+
+    Disabled cost: one global load + None check (plus a once-ever env probe).
+    """
+    s = _active
+    if s is None:
+        if not _env_checked:
+            ensure_env_installed()
+            s = _active
+        if s is None:
+            return
+    if s.should_fire(site):
+        raise InjectedFault(
+            f"injected fault at {site} "
+            f"(call #{s.calls.get(site, 0)}, seed {s.seed})"
+        )

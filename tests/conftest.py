@@ -26,3 +26,50 @@ def devices8():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {devs}"
     return devs
+
+
+# Thread-leak guard (ISSUE 4 satellite): the supervisor restart path is
+# exactly where stray engine threads would hide — a reloaded model whose
+# predecessor's loop/drain thread never exited would double-dispatch into
+# the same devices. After every test MODULE, any thread with one of these
+# names that did NOT exist when the module started must be gone. Module
+# granularity (not per-test) because module-scoped fixtures load engines
+# LAZILY — a server fixture's model loads during the first request, so its
+# engine threads legitimately appear mid-test and live until the fixture's
+# module teardown; that teardown runs before this guard's check.
+_GUARDED_THREAD_PREFIXES = (
+    "engine-loop",
+    "engine-drain",
+    "watchdog",
+    "config-watcher",
+    "stream-reader",
+    "fed-health",
+)
+
+
+def _guarded_threads():
+    import threading
+
+    return {
+        t for t in threading.enumerate()
+        if t.is_alive() and t.name.startswith(_GUARDED_THREAD_PREFIXES)
+    }
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _no_thread_leaks():
+    import time
+
+    before = _guarded_threads()
+    yield
+    # Grace window: stop()/shutdown() signal their threads but some exit on
+    # their next wait() tick (watchdog interval, drain join).
+    deadline = time.monotonic() + 10.0
+    leaked = _guarded_threads() - before
+    while leaked and time.monotonic() < deadline:
+        time.sleep(0.05)
+        leaked = _guarded_threads() - before
+    assert not leaked, (
+        "threads leaked past module teardown (engine not stopped / manager "
+        "not shut down?): " + ", ".join(sorted(t.name for t in leaked))
+    )

@@ -58,6 +58,45 @@ def test_metric_counter_pass_covers_engine():
     )
 
 
+def test_lock_discipline_pass_covers_engine():
+    """ISSUE 4: engine state read under _pending_lock must never be rebound
+    outside it at runtime (submit() and the loop thread share that state)."""
+    from check_engine_attrs import check_lock_discipline
+
+    findings = check_lock_discipline(ENGINE_PY, "Engine")
+    assert findings == [], (
+        "Engine rebinds lock-protected state without _pending_lock: "
+        + "; ".join(f"self.{a} in {m}() at line {ln}" for a, m, ln in findings)
+    )
+
+
+def test_lock_discipline_pass_catches_unlocked_rebind(tmp_path):
+    """The detector must flag an unlocked rebind of state that is read
+    under the lock elsewhere, and must NOT flag: locked rebinds,
+    construction-time assignment, or attributes never read under the
+    lock."""
+    from check_engine_attrs import check_lock_discipline
+
+    p = tmp_path / "synthetic.py"
+    p.write_text(
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self._pending_lock = object()\n"
+        "        self._pending = []\n"       # construction — exempt
+        "        self._other = 0\n"
+        "    def drain(self):\n"
+        "        with self._pending_lock:\n"
+        "            items, self._pending = self._pending, []\n"  # locked — fine
+        "        return items\n"
+        "    def bad_reset(self):\n"
+        "        self._pending = []\n"       # UNLOCKED rebind — flag
+        "    def unrelated(self):\n"
+        "        self._other = 1\n"          # never read under lock — fine
+    )
+    findings = check_lock_discipline(str(p), "Engine")
+    assert [(a, m) for a, m, _ in findings] == [("_pending", "bad_reset")], findings
+
+
 def test_metric_counter_pass_catches_uninitialized_counter(tmp_path):
     """A counter bumped at a dispatch site and read in metrics() but never
     initialized in __init__ (the preempt/swap counters are the immediate

@@ -146,6 +146,68 @@ def test_sse_streams_through_federation(federation):
     assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
 
 
+def test_unhealthy_worker_reprobe_backoff():
+    """ISSUE 4 satellite: an unhealthy worker must not flap straight back —
+    re-probes back off exponentially (1 failure → base, doubling to the
+    cap), due_for_probe gates the health loop, and a recovery resets the
+    clock. Health transitions are counted per worker."""
+    import time
+
+    from localai_tpu.federation.router import WorkerRegistry
+
+    reg = WorkerRegistry(backoff_base_s=0.2, backoff_max_s=1.0)
+    reg.add("w", "http://127.0.0.1:1")
+    w = reg.list()[0]
+    assert reg.due_for_probe(w)  # healthy: probed every tick
+
+    t0 = time.monotonic()
+    reg.mark(w, False)
+    assert not w.healthy and w.fail_count == 1 and w.went_unhealthy == 1
+    assert not reg.due_for_probe(w)  # inside the first backoff window
+    assert 0.0 < w.next_probe - t0 <= 0.2 + 0.05
+
+    # Consecutive failures double the backoff, capped at backoff_max_s.
+    for expect in (0.4, 0.8, 1.0, 1.0):
+        t = time.monotonic()
+        reg.mark(w, False)
+        assert w.next_probe - t <= expect + 0.05
+        assert w.next_probe - t > expect / 2
+    assert w.fail_count == 5
+    assert w.went_unhealthy == 1  # one transition, many failed probes
+
+    # After the backoff expires the worker is due again.
+    w.next_probe = time.monotonic() - 0.01
+    assert reg.due_for_probe(w)
+
+    # Recovery resets the backoff state and counts the transition.
+    reg.mark(w, True)
+    assert w.healthy and w.fail_count == 0 and w.next_probe == 0.0
+    assert w.went_healthy == 1
+    assert reg.due_for_probe(w)
+
+    # The next outage starts the backoff from the base again.
+    t = time.monotonic()
+    reg.mark(w, False)
+    assert w.fail_count == 1 and w.next_probe - t <= 0.2 + 0.05
+    assert w.went_unhealthy == 2
+
+
+def test_workers_listing_exposes_health_counters(federation):
+    fed, base, _ = federation
+    w1 = next(w for w in fed.registry.list() if w.name == "w1")
+    fed.registry.mark(w1, False)
+    try:
+        with urllib.request.urlopen(base + "/federation/workers", timeout=10) as r:
+            out = json.loads(r.read())
+        row = next(w for w in out["workers"] if w["name"] == "w1")
+        assert row["healthy"] is False
+        assert row["fail_count"] >= 1
+        assert row["went_unhealthy"] >= 1
+        assert "went_healthy" in row
+    finally:
+        fed.registry.mark(w1, True)
+
+
 def test_federation_register_requires_token():
     """With a shared token set, unauthorized register/unregister are rejected
     (reference parity: core/p2p/p2p.go:31-64 token-gated overlay)."""

@@ -211,6 +211,86 @@ def check_metric_counters(path: str, class_name: str) -> list[tuple[str, int]]:
     )
 
 
+def check_lock_discipline(
+    path: str, class_name: str, lock_attr: str = "_pending_lock"
+) -> list[tuple[str, str, int]]:
+    """Third pass (ISSUE 4): attributes READ inside `with self.<lock_attr>:`
+    somewhere in the class must never be REBOUND (`self.x = ...` /
+    `self.x += ...`) outside such a block at runtime — the lock exists
+    because another thread reads that state, so an unlocked rebind is a
+    torn-read waiting to happen (submit() and the loop thread share
+    _pending exactly this way). Construction (__init__ plus everything it
+    transitively calls on self) is exempt: no second thread exists yet.
+    Returns [(attr, method, line)] for unlocked rebinds."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    cls = next(
+        (n for n in ast.walk(tree)
+         if isinstance(n, ast.ClassDef) and n.name == class_name),
+        None,
+    )
+    if cls is None:
+        raise SystemExit(f"class {class_name} not found in {path}")
+    methods = {
+        n.name: n for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+    construction: set[str] = set()
+    seen: set[str] = set()
+    frontier = ["__init__"]
+    while frontier:
+        name = frontier.pop()
+        if name in seen or name not in methods:
+            continue
+        seen.add(name)
+        frontier.extend(_self_calls(methods[name]))
+    construction = seen
+
+    def _is_lock_with(node: ast.With, me: str) -> bool:
+        for item in node.items:
+            ctx = item.context_expr
+            if (isinstance(ctx, ast.Attribute)
+                    and isinstance(ctx.value, ast.Name)
+                    and ctx.value.id == me and ctx.attr == lock_attr):
+                return True
+        return False
+
+    reads_locked: set[str] = set()
+    # [(attr, method, line, locked)] for every rebind of a self attribute.
+    rebinds: list[tuple[str, str, int, bool]] = []
+
+    for mname, fn in methods.items():
+        me = _self_name(fn)
+        if me is None:
+            continue
+
+        def walk(node: ast.AST, locked: bool, mname=mname, me=me) -> None:
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == me):
+                if isinstance(node.ctx, ast.Load) and locked:
+                    reads_locked.add(node.attr)
+                elif isinstance(node.ctx, ast.Store):
+                    rebinds.append((node.attr, mname, node.lineno, locked))
+            child_locked = locked or (
+                isinstance(node, ast.With) and _is_lock_with(node, me)
+            )
+            for child in ast.iter_child_nodes(node):
+                walk(child, child_locked)
+
+        walk(fn, False)
+
+    # Method/property accesses under the lock are calls, not shared state.
+    protected = reads_locked - set(methods) - {lock_attr}
+    findings = [
+        (attr, mname, line)
+        for attr, mname, line, locked in rebinds
+        if attr in protected and not locked and mname not in construction
+    ]
+    return sorted(set(findings), key=lambda f: f[2])
+
+
 def main(argv: list[str]) -> int:
     path = argv[1] if len(argv) > 1 else DEFAULT_PATH
     class_name = argv[2] if len(argv) > 2 else "Engine"
@@ -228,7 +308,14 @@ def main(argv: list[str]) -> int:
             f"{class_name}.metrics() but never initialized in __init__ — "
             f"the scrape would AttributeError on a fresh engine"
         )
-    if findings or counter_findings:
+    lock_findings = check_lock_discipline(path, class_name)
+    for attr, method, line in lock_findings:
+        print(
+            f"{path}:{line}: self.{attr} rebound in {class_name}.{method}() "
+            f"WITHOUT _pending_lock, but it is read under that lock "
+            f"elsewhere — cross-thread torn read (ISSUE 4 lock discipline)"
+        )
+    if findings or counter_findings or lock_findings:
         return 1
     print(f"{class_name}: all attribute reads covered by construction")
     return 0
