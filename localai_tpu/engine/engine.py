@@ -799,14 +799,11 @@ class Engine:
                 len(self._slot_pages[slot_idx]),
             )
             self._pages_free(slot_idx)
-        if len(self._free_pages) < n:
+        fresh = self._pages_claim(n)
+        if fresh is None:
             return None
         shared = shared or []
-        fresh = [self._free_pages.pop() for _ in range(n)]
-        for p in fresh:
-            self._page_refs[p] = 1
-        for p in shared:
-            self._page_refs[p] += 1
+        self._pages_addref(shared)
         pages = shared + fresh
         self._slot_pages[slot_idx] = pages
         # Unused tail entries point at SCRATCH so any row past the slot's
@@ -815,6 +812,38 @@ class Engine:
         row[: len(pages)] = pages
         self.h_ptable[slot_idx] = row
         return row
+
+    def _pages_claim(self, n: int) -> Optional[list[int]]:
+        """Allocator primitive: pop `n` fresh pages from the free list, each
+        with refcount 1, or None (no mutation) when the pool cannot cover
+        it. Every fresh-page booking flows through here — the paired
+        primitive for sharing is _pages_addref — so the randomized
+        invariant walk (tests/test_paged_kv.py) and the page-refcount lint
+        pass see every reference the pool hands out."""
+        if n < 0 or len(self._free_pages) < n:
+            return None
+        fresh = [self._free_pages.pop() for _ in range(n)]
+        for p in fresh:
+            self._page_refs[p] = 1
+        return fresh
+
+    def _pages_addref(self, pages: list[int]) -> None:
+        """Allocator primitive: take one extra reference on already-
+        allocated pages (prefix-span copy-on-write sharing). Referencing a
+        FREE page would let it alias the next claim — clamp-and-heal like
+        _pages_release (raise under LOCALAI_ALLOC_DEBUG=1 / the tests)."""
+        for p in pages:
+            if self._page_refs[p] <= 0:
+                if os.environ.get("LOCALAI_ALLOC_DEBUG", "0") == "1":
+                    raise AssertionError(f"addref of free page {p}")
+                log.error("addref of free page %d — reclaiming it", p)
+                try:
+                    self._free_pages.remove(p)
+                except ValueError:
+                    pass
+                self._page_refs[p] = 1
+                continue
+            self._page_refs[p] += 1
 
     def _pages_release(self, pages: list[int]) -> None:
         for p in pages:
@@ -847,11 +876,9 @@ class Engine:
             return True
         if len(self._free_pages) < grow:
             self._prefix_evict_for_pages(grow)
-        if len(self._free_pages) < grow:
+        fresh = self._pages_claim(grow)
+        if fresh is None:
             return False
-        fresh = [self._free_pages.pop() for _ in range(grow)]
-        for p in fresh:
-            self._page_refs[p] = 1
         self._slot_pages[slot_idx].extend(fresh)
         self.h_ptable[slot_idx, have:need_pages] = fresh
         self.m_kv_pages_grown += grow
@@ -2525,8 +2552,7 @@ class Engine:
             if len(pages) < n_pages:
                 self._prefix_entries = kept
                 return  # slot reservation shorter than the span (shouldn't happen)
-            for p in pages:
-                self._page_refs[p] += 1
+            self._pages_addref(pages)
             kept.insert(0, {"key": key, "valid": valid_len, "pages": list(pages)})
             while len(kept) > self.ecfg.prefix_cache_entries:
                 self._prefix_drop(kept.pop())
@@ -2619,13 +2645,11 @@ class Engine:
         self._host_bytes -= hentry["bytes"]
         if len(self._free_pages) < npg:
             self._prefix_evict_for_pages(npg)
-        if len(self._free_pages) < npg:
+        pages = self._pages_claim(npg)
+        if pages is None:
             self._prefix_host.insert(0, hentry)  # back to the tier, LRU-bumped
             self._host_bytes += hentry["bytes"]
             return None
-        pages = [self._free_pages.pop() for _ in range(npg)]
-        for p in pages:
-            self._page_refs[p] = 1
         self._swap_in_pages(pages, hentry["hk"], hentry["hv"])
         entry = {"key": hentry["key"], "valid": hentry["valid"],
                  "pages": pages}
@@ -4184,6 +4208,7 @@ class Engine:
         t_b = time.monotonic()
         args_in = (
             jnp.asarray(prompt_toks), jnp.asarray(aux), jnp.asarray(samp_pack),
+            # lint: ignore[trace-safety] admit programs are compiled per (m, bucket) by design and warmed (warmup()); m is the admission group size, already bucketed by the batching loop
             jnp.asarray(bias_rows) if has_bias else jnp.zeros((m, V), jnp.float32),
         )
         if n_img:
@@ -4520,7 +4545,9 @@ class Engine:
             # Forced processing (depth pressure) before the drainer got
             # there: pull inline. np.asarray is idempotent, so the drainer
             # finishing its own copy later is harmless.
+            # lint: ignore[trace-safety] deliberate sync point: the drainer thread usually completed the copy (this is a cheap wait, not a walk), and when it has not, the loop NEEDS these results to schedule the next block
             toks = np.asarray(e.toks)
+            # lint: ignore[trace-safety] same drainer-backed pull as toks above
             tk = np.asarray(e.tk) if e.tk is not None else None
             lp = (
                 tuple(np.asarray(a) for a in e.lp) if e.lp is not None else None

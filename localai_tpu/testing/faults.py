@@ -37,6 +37,13 @@ Activation:
 Determinism: each site gets its own RNG seeded from (seed, site), so the
 injection pattern at a site depends only on how many times that site has
 fired — not on cross-thread interleaving between sites.
+
+Consistency: FaultSchedule rejects site names outside SITES at construction
+(a typo'd `sites:` spec fails loudly), and the `fault-sites` lint pass
+(tools/lint, tier-1 via tests/test_lint.py) verifies the other direction —
+every SITES entry corresponds to at least one literal `faults.fire(...)`
+call in localai_tpu/, so a renamed or deleted hook cannot leave a site that
+schedules target but that silently never fires.
 """
 
 from __future__ import annotations

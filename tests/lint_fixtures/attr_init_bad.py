@@ -1,0 +1,16 @@
+"""Known-bad fixture for the attr-init pass: the exact BENCH_r05 rc=124
+shape — a loop-path read of an attribute no construction path assigns."""
+
+
+class Engine:
+    def __init__(self):
+        self.a = 1
+        self._build()
+
+    def _build(self):
+        self.b = 2
+
+    def loop(self):
+        if self._hold == 0.0:  # read-before-any-assignment: MUST be flagged
+            self._hold = 1.0
+        self.c = self.b + self.a

@@ -1,0 +1,32 @@
+"""Known-good fixture for the attr-init pass: construction-assigned attrs,
+__init__-called helpers, hasattr-guarded lazy caches, same-module base
+classes, and method reads must all stay silent."""
+
+
+class Base:
+    def __init__(self):
+        self.inherited = 0
+
+
+class Engine(Base):
+    tunable = 4  # class-level
+
+    def __init__(self):
+        super().__init__()
+        self.a = 1
+        self._build()
+
+    def _build(self):
+        self.b = 2
+
+    def loop(self):
+        self.c = self.b + self.a + self.tunable + self.inherited
+        return self.helper()
+
+    def helper(self):
+        return self.a
+
+    def lazy(self):
+        if not hasattr(self, "_cache"):
+            self._cache = {}
+        return self._cache

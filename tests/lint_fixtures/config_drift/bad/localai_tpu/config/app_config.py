@@ -1,0 +1,16 @@
+import dataclasses
+import os
+
+
+@dataclasses.dataclass
+class ApplicationConfig:
+    port: int = 8080
+    secret_knob: float = 0.0  # undocumented application field
+
+    @classmethod
+    def from_env(cls):
+        return cls(
+            port=int(os.environ.get("LOCALAI_PORT", "8080")),
+            # read but undocumented:
+            secret_knob=float(os.environ.get("LOCALAI_SECRET_KNOB", "0")),
+        )

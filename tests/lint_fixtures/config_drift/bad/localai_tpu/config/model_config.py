@@ -1,0 +1,23 @@
+"""Bad config fixture: kv_shiny is a YAML key no doc row mentions, and the
+comment below claims an env override nothing reads."""
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    name: str = ""
+    max_slots: int = 8
+    kv_pages: int = 0
+    # LOCALAI_KV_SHINY env var overrides (it does not — orphaned claim).
+    kv_shiny: int = 0
+
+
+@dataclasses.dataclass
+class ParallelConfig:
+    tp: int = 0
+
+
+@dataclasses.dataclass
+class TemplateConfig:
+    chat: str = ""

@@ -1,0 +1,8 @@
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    max_slots: int = 8
+    kv_pages: int = 0
+    kv_shiny: int = 0  # mirrored in ModelConfig but never forwarded

@@ -1,0 +1,7 @@
+from ..engine.engine import EngineConfig
+
+
+class ModelManager:
+    def _load(self, cfg):
+        # kv_shiny is NOT forwarded: the YAML knob is dead (D5).
+        return EngineConfig(max_slots=cfg.max_slots, kv_pages=cfg.kv_pages)

@@ -1,0 +1,11 @@
+import dataclasses
+import os
+
+
+@dataclasses.dataclass
+class ApplicationConfig:
+    port: int = 8080
+
+    @classmethod
+    def from_env(cls):
+        return cls(port=int(os.environ.get("LOCALAI_PORT", "8080")))

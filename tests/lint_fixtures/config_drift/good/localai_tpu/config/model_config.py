@@ -1,0 +1,18 @@
+import dataclasses
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    name: str = ""
+    max_slots: int = 8
+    kv_pages: int = 0
+
+
+@dataclasses.dataclass
+class ParallelConfig:
+    tp: int = 0
+
+
+@dataclasses.dataclass
+class TemplateConfig:
+    chat: str = ""

@@ -1,0 +1,6 @@
+from ..engine.engine import EngineConfig
+
+
+class ModelManager:
+    def _load(self, cfg):
+        return EngineConfig(max_slots=cfg.max_slots, kv_pages=cfg.kv_pages)

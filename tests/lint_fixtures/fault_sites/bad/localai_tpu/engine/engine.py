@@ -1,0 +1,7 @@
+from ..testing import faults
+
+
+def loop(site_name):
+    faults.fire("engine_loop")  # declared + fired: fine
+    faults.fire("page_allok")  # typo'd site, not in SITES: flag
+    faults.fire(site_name)  # non-literal: flag (unverifiable)

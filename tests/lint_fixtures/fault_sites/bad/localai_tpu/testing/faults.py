@@ -1,0 +1,9 @@
+SITES = (
+    "engine_loop",
+    "page_alloc",
+    "ghost_site",  # declared, but no fire() call anywhere: flag
+)
+
+
+def fire(site):
+    pass

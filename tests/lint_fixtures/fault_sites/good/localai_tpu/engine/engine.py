@@ -1,0 +1,9 @@
+from ..testing import faults
+
+
+def loop():
+    faults.fire("engine_loop")
+
+
+def alloc():
+    faults.fire("page_alloc")

@@ -1,0 +1,8 @@
+SITES = (
+    "engine_loop",
+    "page_alloc",
+)
+
+
+def fire(site):
+    pass

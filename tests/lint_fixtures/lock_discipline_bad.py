@@ -1,0 +1,22 @@
+"""Known-bad fixture for the lock-discipline pass: state read under the
+class's lock is rebound outside it — the cross-thread torn-read shape."""
+
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._pending_lock = threading.Lock()
+        self._pending = []  # construction — exempt
+        self._other = 0
+
+    def drain(self):
+        with self._pending_lock:
+            items, self._pending = self._pending, []  # locked — fine
+        return items
+
+    def bad_reset(self):
+        self._pending = []  # UNLOCKED rebind: MUST be flagged
+
+    def unrelated(self):
+        self._other = 1  # never read under the lock — fine

@@ -1,0 +1,29 @@
+"""Known-good fixture for the lock-discipline pass: locked rebinds,
+construction-time assignment, the *_locked caller-holds-lock convention,
+and unprotected state must all stay silent."""
+
+import threading
+
+
+class Manager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.models = {}
+        self.free = 0
+
+    def evict(self, name):
+        with self._lock:
+            self.models = {
+                k: v for k, v in self.models.items() if k != name
+            }  # locked — fine
+
+    def _evict_lru_locked(self):
+        # *_locked convention: documented as "caller holds self._lock".
+        self.models = {}
+
+    def tick(self):
+        with self._lock:
+            self._evict_lru_locked()
+
+    def stats(self):
+        self.free = 1  # never read under the lock — fine

@@ -1,0 +1,18 @@
+"""Known-bad fixture for the metric-counters pass: a counter bumped at a
+dispatch site and read in metrics(), but the __init__ line was forgotten —
+the first scrape of a fresh instance raises AttributeError."""
+
+
+class Engine:
+    def __init__(self):
+        self.m_ok = 0
+        self._wire()
+
+    def _wire(self):
+        self.m_wired = 0
+
+    def dispatch(self):
+        self.m_preemptions += 1  # assigned only at runtime: MUST be flagged
+
+    def metrics(self):
+        return {"a": self.m_ok, "b": self.m_wired, "c": self.m_preemptions}

@@ -1,0 +1,34 @@
+"""Known-good fixture for the metric-counters pass: init-covered,
+helper-initialized, hasattr-guarded, and base-class-inherited counters all
+stay silent."""
+
+
+class BaseEngine:
+    def __init__(self):
+        self.m_requests = 0
+
+
+class Engine(BaseEngine):
+    def __init__(self):
+        super().__init__()
+        self.m_ok = 0
+        self._wire()
+
+    def _wire(self):
+        self.m_wired = 0
+
+    def dispatch(self):
+        self.m_ok += 1
+        self.m_requests += 1
+
+    def lazy(self):
+        if not hasattr(self, "m_lazy"):
+            self.m_lazy = 0
+
+    def metrics(self):
+        return {
+            "a": self.m_ok,
+            "b": self.m_wired,
+            "c": self.m_requests,
+            "d": getattr(self, "m_lazy", 0) if hasattr(self, "m_lazy") else 0,
+        }

@@ -1,0 +1,49 @@
+"""Known-bad fixture for the page-refcount pass: booking outside the
+allocator primitives, an unchecked alloc, and page ids escaping the
+tracked tables — the PR 3 leak/livelock shapes."""
+
+
+class Engine:
+    def __init__(self):
+        self._free_pages = list(range(16))
+        self._page_refs = [0] * 16
+        self._slot_pages = [[] for _ in range(4)]
+        self.h_ptable = None
+        self.slots = [None] * 4
+
+    def _pages_claim(self, n):
+        if len(self._free_pages) < n:
+            return None
+        fresh = [self._free_pages.pop() for _ in range(n)]
+        for p in fresh:
+            self._page_refs[p] = 1
+        return fresh
+
+    def _pages_alloc(self, slot_idx, n):
+        fresh = self._pages_claim(n)
+        if fresh is None:
+            return None
+        self._slot_pages[slot_idx] = fresh
+        return fresh
+
+    def _pages_release(self, pages):
+        for p in pages:
+            self._page_refs[p] -= 1
+            if self._page_refs[p] == 0:
+                self._free_pages.append(p)
+
+    def rogue_share(self, pages):
+        for p in pages:
+            self._page_refs[p] += 1  # refcount bump outside primitives: flag
+
+    def rogue_grab(self):
+        page = self._free_pages.pop()  # free-list pop outside primitives: flag
+        return page
+
+    def unchecked_admit(self, slot_idx, n):
+        row = self._pages_alloc(slot_idx, n)  # None never handled: flag
+        self.slots[slot_idx] = ("slot", row)
+
+    def stash(self, slot_idx):
+        # Page ids copied into an attribute no invariant walk tracks: flag.
+        self._my_secret_pages = self._slot_pages[slot_idx]

@@ -1,0 +1,72 @@
+"""Known-good fixture for the page-refcount pass: all booking flows through
+the primitives, allocs are None-checked, failure edges release, and page
+ids stay in the tracked tables."""
+
+
+class Engine:
+    def __init__(self):
+        self._free_pages = list(range(16))
+        self._page_refs = [0] * 16
+        self._slot_pages = [[] for _ in range(4)]
+        self.h_ptable = {}
+        self.slots = [None] * 4
+        self._pending = []
+        self._prefix_entries = []
+
+    def _pages_claim(self, n):
+        if len(self._free_pages) < n:
+            return None
+        fresh = [self._free_pages.pop() for _ in range(n)]
+        for p in fresh:
+            self._page_refs[p] = 1
+        return fresh
+
+    def _pages_addref(self, pages):
+        for p in pages:
+            self._page_refs[p] += 1
+
+    def _pages_alloc(self, slot_idx, n, shared=None):
+        fresh = self._pages_claim(n)
+        if fresh is None:
+            return None
+        self._pages_addref(shared or [])
+        self._slot_pages[slot_idx] = (shared or []) + fresh
+        return self._slot_pages[slot_idx]
+
+    def _pages_release(self, pages):
+        for p in pages:
+            self._page_refs[p] -= 1
+            if self._page_refs[p] == 0:
+                self._free_pages.append(p)
+
+    def _pages_free(self, slot_idx):
+        self._pages_release(self._slot_pages[slot_idx])
+        self._slot_pages[slot_idx] = []
+
+    def admit(self, slot_idx, n, req):
+        row = self._pages_alloc(slot_idx, n)
+        if row is None:
+            self._pending.append(req)  # requeue on pool-full: fine
+            return False
+        try:
+            self.dispatch(row)
+        except Exception:
+            self._pages_free(slot_idx)  # release on the error edge: fine
+            raise
+        self.slots[slot_idx] = ("slot", req)
+        return True
+
+    def grow(self, slot_idx, n):
+        fresh = self._pages_claim(n)
+        if fresh is None:
+            return False
+        self._slot_pages[slot_idx].extend(fresh)  # tracked table: fine
+        return True
+
+    def save_prefix(self, slot_idx, key):
+        pages = list(self._slot_pages[slot_idx])
+        self._pages_addref(pages)
+        self._prefix_entries.insert(0, {"key": key, "pages": pages})
+
+    def dispatch(self, row):
+        pass

@@ -1,0 +1,10 @@
+"""Framework fixture: a suppression WITHOUT a reason is itself a finding
+(pass id `lint`) — silence must always carry a written justification."""
+
+
+class Engine:
+    def __init__(self):
+        self.a = 1
+
+    def loop(self):
+        return self._patched_in  # lint: ignore[attr-init]

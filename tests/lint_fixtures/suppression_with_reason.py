@@ -1,0 +1,11 @@
+"""Framework fixture: a finding suppressed WITH a written reason is counted
+as a suppression and does not fail the run."""
+
+
+class Engine:
+    def __init__(self):
+        self.a = 1
+
+    def loop(self):
+        # lint: ignore[attr-init] fixture: attribute is monkeypatched onto the instance by the harness before loop() ever runs
+        return self._patched_in

@@ -1,0 +1,32 @@
+"""Known-bad fixture for the terminal-event pass: pending-queue removals
+and slot deactivation on paths that never post a terminal TokenEvent — the
+caller blocks on its stream forever (the PR 1 / PR 4 hang class)."""
+
+from collections import deque
+
+
+class TokenEvent:
+    def __init__(self, kind="", error=None, finish_reason=None):
+        self.kind = kind
+
+
+class Engine:
+    def __init__(self):
+        self._pending = deque()
+        self.slots = [None] * 4
+
+    def submit(self, req, handle):
+        self._pending.append((req, handle))
+
+    def bad_drop(self):
+        # Drops the head entry with no terminal event: MUST be flagged.
+        self._pending.popleft()
+
+    def bad_clear(self):
+        # Rebinds the queue away, orphaning every waiting caller.
+        self._pending = deque()
+
+    def bad_teardown(self, i):
+        # Deactivates the slot without telling the consumer; no caller of
+        # this method posts either.
+        self.slots[i] = None

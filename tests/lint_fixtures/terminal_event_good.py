@@ -1,0 +1,53 @@
+"""Known-good fixture for the terminal-event pass: direct posts, posting
+helpers, helper methods owned by posting callers, and re-enqueues must all
+stay silent."""
+
+from collections import deque
+
+
+class TokenEvent:
+    def __init__(self, kind="", error=None, finish_reason=None):
+        self.kind = kind
+
+
+class Engine:
+    def __init__(self):
+        self._pending = deque()
+        self.slots = [None] * 4
+
+    def submit(self, req, handle):
+        self._pending.append((req, handle))
+
+    def drain(self):
+        # Removal + direct terminal post: fine.
+        while self._pending:
+            _req, handle = self._pending.popleft()
+            handle._q.put(TokenEvent(kind="done", finish_reason="stop"))
+
+    def fail_all(self, err):
+        pending, self._pending = list(self._pending), deque()
+        for _req, handle in pending:
+            handle._q.put(TokenEvent(kind="error", error=err))
+
+    def finish(self, i, reason):
+        slot = self.slots[i]
+        slot.handle._q.put(TokenEvent(kind="done", finish_reason=reason))
+        self._release(i)
+
+    def _release(self, i):
+        # No post of its own, but its only caller (finish) posts: fine.
+        self.slots[i] = None
+
+    def requeue(self):
+        # Pop + put back is a re-order, not a drop... the entry survives.
+        item = self._pending.popleft()
+        self._pending.appendleft(item)
+        self.kick()
+
+    def kick(self):
+        # requeue() must still count as terminal-safe: it posts nothing,
+        # but neither does it drop — it calls a poster for liveness.
+        for _req, handle in list(self._pending):
+            if handle.cancelled:
+                self.fail_all("cancelled")
+                break

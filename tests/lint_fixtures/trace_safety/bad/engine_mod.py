@@ -1,0 +1,23 @@
+"""Known-bad engine-hot-path fixture: device pulls and per-request shapes
+inside the decode/admission critical path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Engine:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.d_tokens = jnp.zeros((8,), jnp.int32)
+        self.cache = None
+
+    def _dispatch_block(self, request):
+        m = len(request.prompt_ids)
+        pad = jnp.zeros((m, 4), jnp.float32)  # per-request shape: flag
+        toks = np.asarray(self.d_tokens)  # device pull in hot path: flag
+        jax.block_until_ready(self.cache)  # blocking sync: flag
+        return pad, toks
+
+    def _post_token(self, lp_ids):
+        return lp_ids.tolist()  # host numpy receiver — NOT flagged

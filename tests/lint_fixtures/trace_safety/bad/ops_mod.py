@@ -1,0 +1,16 @@
+"""Known-bad traced-module fixture: host syncs and python branching on
+traced values inside trace-context code."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bad_kernel(x):
+    y = jnp.exp(x)
+    if y.sum() > 0:  # python branch on a traced value: flag
+        y = y * 2
+    z = float(y)  # concretizes a tracer: flag
+    host = np.asarray(y)  # device→host pull in trace context: flag
+    jax.block_until_ready(y)  # host sync: flag
+    return y.tolist(), z, host  # .tolist(): flag

@@ -1,0 +1,26 @@
+"""Known-good traced-module fixture: numpy on static values (trace-time
+constant building), static-metadata branching, and proper jnp.where must
+all stay silent."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def good_kernel(x, sections, causal=True):
+    # numpy on STATIC python values builds trace-time constants: fine.
+    axis_of = jnp.asarray(np.repeat(np.arange(3), sections))
+    y = jnp.exp(x)
+    if causal:  # static python flag: fine
+        y = y * 2
+    if x.shape[0] > 4:  # static shape metadata: fine
+        y = y + 1
+    y = jnp.where(y > 0, y, 0.0)  # traced select done right
+    if jax.default_backend() == "tpu":  # host introspection, not traced
+        y = y * 1
+    return y, axis_of
+
+
+def host_wrapper(q):
+    S = int(q.shape[0])  # int() of static shape: fine
+    return good_kernel(q, (S, S, S))

@@ -1,0 +1,254 @@
+"""localai-lint (tools/lint, ISSUE 5) wired into tier-1: the full pass
+suite must be CLEAN on the repo on every PR, every pass must fire on its
+seeded known-bad fixture and stay silent on the known-good one, and the
+framework's suppression contract (reason required) must hold. The whole
+module is pure AST analysis — no jax import, must stay well under 10 s.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.lint import Repo, run_passes, run_repo  # noqa: E402
+from tools.lint.passes import all_passes  # noqa: E402
+from tools.lint.passes.attr_init import AttrInitPass  # noqa: E402
+from tools.lint.passes.config_drift import ConfigDriftPass  # noqa: E402
+from tools.lint.passes.fault_sites import FaultSitesPass  # noqa: E402
+from tools.lint.passes.lock_discipline import LockDisciplinePass  # noqa: E402
+from tools.lint.passes.metric_counters import MetricCountersPass  # noqa: E402
+from tools.lint.passes.page_refcount import PageRefcountPass  # noqa: E402
+from tools.lint.passes.terminal_event import TerminalEventPass  # noqa: E402
+from tools.lint.passes.trace_safety import TraceSafetyPass  # noqa: E402
+
+FIX = os.path.join(REPO, "tests", "lint_fixtures")
+
+
+_repo_result = None
+
+
+def _full_run():
+    """One shared full-suite run over the repo — three tests consume it."""
+    global _repo_result
+    if _repo_result is None:
+        t0 = time.monotonic()
+        _repo_result = (run_repo(REPO), time.monotonic() - t0)
+    return _repo_result
+
+
+# --------------------------------------------------------------------- #
+# The acceptance gate: the repo itself is clean under all 8 passes.
+# --------------------------------------------------------------------- #
+
+def test_repo_is_clean_under_all_passes():
+    result, elapsed = _full_run()
+    assert len(result.pass_ids) == 8, result.pass_ids
+    assert result.clean, "lint findings on the repo:\n" + "\n".join(
+        f.render() for f in result.active
+    )
+    # Tier-1 budget: the whole suite must stay fast (ISSUE 5: <10 s; the
+    # run itself gets a tighter bound so fixtures + CLI fit too).
+    assert elapsed < 8.0, f"lint suite took {elapsed:.1f}s"
+
+
+def test_cli_json_exits_zero():
+    """CLI plumbing (arg parsing, JSON shape, exit code) on a cheap pass
+    subset — the full-suite cleanliness is pinned in-process above."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--json",
+         "--pass", "attr-init,fault-sites"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["clean"] is True
+    assert set(payload["passes"]) >= {"attr-init", "fault-sites"}
+
+
+def test_suppression_count_never_grows():
+    """LINT_r01.json pins the suppression budget: future PRs may only
+    shrink it (fix the code instead of silencing the pass)."""
+    with open(os.path.join(REPO, "LINT_r01.json")) as f:
+        pinned = json.load(f)
+    result, _ = _full_run()
+    assert len(result.suppressed) <= pinned["total_suppressions"], (
+        "suppression count grew past the pinned budget "
+        f"({len(result.suppressed)} > {pinned['total_suppressions']}) — "
+        "fix the finding instead of suppressing it, or justify lowering "
+        "the bar by regenerating LINT_rNN.json in its own PR"
+    )
+
+
+# --------------------------------------------------------------------- #
+# Per-pass fixtures: every pass fires on its seeded bad case and stays
+# silent on the good one. No pass ships untested.
+# --------------------------------------------------------------------- #
+
+def _run_single(p, root=REPO):
+    return run_passes(Repo(root), [p])
+
+
+def test_attr_init_fixtures():
+    bad = AttrInitPass(targets=[(os.path.join(FIX, "attr_init_bad.py"), "Engine")])
+    r = _run_single(bad)
+    assert [f for f in r.active if "_hold" in f.message], r.findings
+    good = AttrInitPass(targets=[(os.path.join(FIX, "attr_init_good.py"), "Engine")])
+    assert _run_single(good).clean
+
+
+def test_metric_counters_fixtures():
+    bad = MetricCountersPass(globs=["tests/lint_fixtures/metric_counters_bad.py"])
+    r = _run_single(bad)
+    assert [f for f in r.active if "m_preemptions" in f.message], r.findings
+    good = MetricCountersPass(globs=["tests/lint_fixtures/metric_counters_good.py"])
+    assert _run_single(good).clean
+
+
+def test_lock_discipline_fixtures():
+    bad = LockDisciplinePass(globs=["tests/lint_fixtures/lock_discipline_bad.py"])
+    r = _run_single(bad)
+    assert [f for f in r.active
+            if "_pending" in f.message and "bad_reset" in f.message], r.findings
+    good = LockDisciplinePass(globs=["tests/lint_fixtures/lock_discipline_good.py"])
+    assert _run_single(good).clean
+
+
+def test_trace_safety_fixtures():
+    broot = os.path.join(FIX, "trace_safety", "bad")
+    bad = TraceSafetyPass(
+        traced_globs=["ops_mod.py"], engine_target=("engine_mod.py", "Engine"),
+    )
+    r = _run_single(bad, root=broot)
+    msgs = "\n".join(f.message for f in r.active)
+    assert "branch on a traced value" in msgs, msgs
+    assert "block_until_ready" in msgs, msgs
+    assert ".tolist()" in msgs, msgs
+    assert "traced local" in msgs, msgs  # float(y)
+    assert "recompile trigger" in msgs, msgs  # jnp.zeros((m, 4))
+    assert "device value in engine hot path" in msgs, msgs
+    groot = os.path.join(FIX, "trace_safety", "good")
+    good = TraceSafetyPass(
+        traced_globs=["ops_mod.py"], engine_target=("engine_mod.py", "Engine"),
+    )
+    assert _run_single(good, root=groot).clean
+
+
+def test_terminal_event_fixtures():
+    bad = TerminalEventPass(targets=[(
+        os.path.join(FIX, "terminal_event_bad.py"), "Engine", "_pending", "slots",
+    )])
+    r = _run_single(bad)
+    methods = {m for f in r.active for m in ("bad_drop", "bad_clear", "bad_teardown")
+               if m in f.message}
+    assert methods == {"bad_drop", "bad_clear", "bad_teardown"}, r.findings
+    good = TerminalEventPass(targets=[(
+        os.path.join(FIX, "terminal_event_good.py"), "Engine", "_pending", "slots",
+    )])
+    assert _run_single(good).clean
+
+
+def test_page_refcount_fixtures():
+    bad = PageRefcountPass(targets=[(
+        os.path.join(FIX, "page_refcount_bad.py"), "Engine",
+    )])
+    r = _run_single(bad)
+    msgs = "\n".join(f.message for f in r.active)
+    assert "rogue_share" in msgs, msgs      # refcount bump outside primitives
+    assert "rogue_grab" in msgs, msgs       # free-list pop outside primitives
+    assert "unchecked_admit" in msgs, msgs  # None never handled
+    assert "_my_secret_pages" in msgs, msgs  # escaped page ids
+    good = PageRefcountPass(targets=[(
+        os.path.join(FIX, "page_refcount_good.py"), "Engine",
+    )])
+    assert _run_single(good).clean
+
+
+def test_config_drift_fixtures():
+    broot = os.path.join(FIX, "config_drift", "bad")
+    bad = ConfigDriftPass(
+        engine_py="localai_tpu/engine/engine.py",
+        model_cfg_py="localai_tpu/config/model_config.py",
+        app_cfg_py="localai_tpu/config/app_config.py",
+        manager_py="localai_tpu/server/manager.py",
+        config_md="docs/CONFIG.md",
+    )
+    r = _run_single(bad, root=broot)
+    msgs = "\n".join(f.message for f in r.active)
+    assert "kv_shiny" in msgs, msgs          # undocumented YAML key (D1)
+    assert "secret_knob" in msgs, msgs       # undocumented app field (D1)
+    assert "kv_ghost_knob" in msgs, msgs     # dead doc row (D2)
+    assert "LOCALAI_SECRET_KNOB" in msgs, msgs  # read, undocumented (D3)
+    assert "LOCALAI_GHOST_VAR" in msgs, msgs    # documented, never read (D4)
+    assert "LOCALAI_KV_SHINY" in msgs, msgs     # comment claim, never read (D4)
+    assert ("does not forward" in msgs and "kv_shiny" in msgs), msgs  # D5
+    groot = os.path.join(FIX, "config_drift", "good")
+    good = ConfigDriftPass()
+    assert _run_single(good, root=groot).clean
+
+
+def test_fault_sites_fixtures():
+    broot = os.path.join(FIX, "fault_sites", "bad")
+    bad = FaultSitesPass()
+    r = _run_single(bad, root=broot)
+    msgs = "\n".join(f.message for f in r.active)
+    assert "ghost_site" in msgs, msgs   # declared but never fired
+    assert "page_allok" in msgs, msgs   # fired but undeclared (typo)
+    assert "non-literal" in msgs, msgs  # fire(variable)
+    groot = os.path.join(FIX, "fault_sites", "good")
+    good = FaultSitesPass()
+    assert _run_single(good, root=groot).clean
+
+
+# --------------------------------------------------------------------- #
+# Framework contracts: suppressions need reasons; unknown ids are errors.
+# --------------------------------------------------------------------- #
+
+def test_suppression_with_reason_counts_as_suppressed():
+    p = AttrInitPass(targets=[(
+        os.path.join(FIX, "suppression_with_reason.py"), "Engine",
+    )])
+    r = _run_single(p)
+    assert r.clean
+    assert len(r.suppressed) == 1
+    assert "monkeypatched" in r.suppressed[0].reason
+
+
+def test_suppression_without_reason_is_a_finding():
+    p = AttrInitPass(targets=[(
+        os.path.join(FIX, "suppression_no_reason.py"), "Engine",
+    )])
+    r = _run_single(p)
+    assert not r.clean
+    assert any(f.pass_id == "lint" and "no reason" in f.message
+               for f in r.active), r.findings
+
+
+def test_registry_has_the_eight_passes():
+    ids = [p.id for p in all_passes()]
+    assert ids == [
+        "attr-init", "metric-counters", "lock-discipline", "trace-safety",
+        "terminal-event", "page-refcount", "config-drift", "fault-sites",
+    ], ids
+    assert len(set(ids)) == 8
+
+
+# --------------------------------------------------------------------- #
+# Migrated-pass continuity: the deprecation shim still answers the old
+# API so nothing pinned to check_engine_attrs silently stops checking.
+# --------------------------------------------------------------------- #
+
+def test_check_engine_attrs_shim_still_works():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import check_engine_attrs as shim
+    finally:
+        sys.path.pop(0)
+    engine_py = os.path.join(REPO, "localai_tpu", "engine", "engine.py")
+    assert shim.check_class(engine_py, "Engine") == []
+    assert shim.check_metric_counters(engine_py, "Engine") == []
+    assert shim.check_lock_discipline(engine_py, "Engine") == []

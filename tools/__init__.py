@@ -1,0 +1,1 @@
+# Makes `tools` importable so `python -m tools.lint` works from the repo root.

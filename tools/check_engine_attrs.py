@@ -1,24 +1,14 @@
 #!/usr/bin/env python
-"""Static pass: flag `self.x` attributes READ somewhere in a class but never
-assigned during construction.
+"""DEPRECATED shim — the three passes that lived here (attr-init,
+metric-counters, lock-discipline) moved into the lint framework at
+tools/lint/ (ISSUE 5). Use:
 
-The exact bug class that killed BENCH_r05 (rc=124): the engine-loop
-admission path read `self._admit_hold_start` / `self._last_submit_t` before
-any code path had ever assigned them — the loop thread died of
-AttributeError on the first idle admission and every caller hung on a token
-queue forever. Python has no compiler to catch this; this AST pass does.
+    python -m tools.lint                  # all passes
+    python -m tools.lint --pass attr-init,metric-counters,lock-discipline
 
-Rule: every attribute the class loads (`self.x` in Load context, or reads
-via `self.x += ...`) must be assigned by construction — in `__init__`, in a
-method `__init__` (transitively) calls on self, or at class level — or be a
-method/property of the class. Attributes probed with `hasattr(self, "x")`
-anywhere in the class are exempt (lazy-init caches declare themselves that
-way).
-
-Usage:
-    python tools/check_engine_attrs.py [path] [ClassName]
-defaults to localai_tpu/engine/engine.py Engine. Exit 1 on findings; also
-wired into tier-1 via tests/test_engine_attrs.py.
+This file keeps the original function signatures for callers pinned to the
+old API (tests/test_engine_attrs.py predates the framework) and will be
+removed once nothing imports it.
 """
 
 from __future__ import annotations
@@ -27,271 +17,55 @@ import ast
 import os
 import sys
 
-DEFAULT_PATH = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "localai_tpu", "engine", "engine.py",
-)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.lint.passes.attr_init import uninitialized_reads  # noqa: E402
+from tools.lint.passes.lock_discipline import check_class_locks  # noqa: E402
+from tools.lint.passes.metric_counters import uninitialized_counters  # noqa: E402
+
+DEFAULT_PATH = os.path.join(_REPO, "localai_tpu", "engine", "engine.py")
 
 
-def _self_name(fn: ast.FunctionDef) -> str | None:
-    """The instance-receiver arg name, or None for static/class methods
-    (a classmethod's first arg binds the type — attribute reads on it
-    resolve against class attributes, out of scope here)."""
-    for dec in fn.decorator_list:
-        name = dec.id if isinstance(dec, ast.Name) else getattr(dec, "attr", "")
-        if name in ("staticmethod", "classmethod"):
-            return None
-    args = fn.args.posonlyargs + fn.args.args
-    return args[0].arg if args else None
-
-
-def _attr_stores(fn: ast.FunctionDef) -> set[str]:
-    """Names assigned as `self.x = ...` (tuple targets included) anywhere in
-    the function. AugAssign does NOT count — `self.x += 1` requires a prior
-    binding, i.e. it is a read."""
-    me = _self_name(fn)
-    out: set[str] = set()
-    if me is None:
-        return out
-    for node in ast.walk(fn):
-        targets: list[ast.expr] = []
-        if isinstance(node, ast.Assign):
-            targets = node.targets
-        elif isinstance(node, ast.AnnAssign) and node.value is not None:
-            targets = [node.target]
-        for t in targets:
-            for tt in ast.walk(t):
-                if (isinstance(tt, ast.Attribute)
-                        and isinstance(tt.value, ast.Name)
-                        and tt.value.id == me):
-                    out.add(tt.attr)
-    return out
-
-
-def _attr_reads(fn: ast.FunctionDef) -> dict[str, int]:
-    """{attr: first line} for `self.x` loads (and AugAssign reads)."""
-    me = _self_name(fn)
-    out: dict[str, int] = {}
-    if me is None:
-        return out
-    for node in ast.walk(fn):
-        attr = None
-        if (isinstance(node, ast.Attribute)
-                and isinstance(node.value, ast.Name)
-                and node.value.id == me):
-            if isinstance(node.ctx, ast.Load):
-                attr = node.attr
-            elif isinstance(node.ctx, ast.Store):
-                continue
-        if isinstance(node, ast.AugAssign):
-            t = node.target
-            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
-                    and t.value.id == me):
-                attr = t.attr
-        if attr is not None:
-            out.setdefault(attr, node.lineno)
-    return out
-
-
-def _self_calls(fn: ast.FunctionDef) -> set[str]:
-    """Method names invoked as `self.m(...)` — the __init__ call graph."""
-    me = _self_name(fn)
-    out: set[str] = set()
-    if me is None:
-        return out
-    for node in ast.walk(fn):
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and isinstance(node.func.value, ast.Name)
-                and node.func.value.id == me):
-            out.add(node.func.attr)
-    return out
-
-
-def _hasattr_probes(cls: ast.ClassDef) -> set[str]:
-    """Attr names checked via hasattr(self, "x") anywhere in the class."""
-    out: set[str] = set()
-    for node in ast.walk(cls):
-        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
-                and node.func.id == "hasattr" and len(node.args) == 2
-                and isinstance(node.args[1], ast.Constant)
-                and isinstance(node.args[1].value, str)):
-            out.add(node.args[1].value)
-    return out
+def _load(path: str, class_name: str):
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    classes = {
+        n.name: n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)
+    }
+    cls = classes.get(class_name)
+    if cls is None:
+        raise SystemExit(f"class {class_name} not found in {path}")
+    return cls, classes
 
 
 def check_class(path: str, class_name: str) -> list[tuple[str, str, int]]:
-    """Returns [(attr, method, line)] for attributes read but never
-    assigned during construction."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    cls = next(
-        (n for n in ast.walk(tree)
-         if isinstance(n, ast.ClassDef) and n.name == class_name),
-        None,
-    )
-    if cls is None:
-        raise SystemExit(f"class {class_name} not found in {path}")
-    methods = {
-        n.name: n for n in cls.body
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-    }
-    class_level: set[str] = set()
-    for n in cls.body:
-        if isinstance(n, ast.Assign):
-            class_level |= {t.id for t in n.targets if isinstance(t, ast.Name)}
-        elif isinstance(n, ast.AnnAssign) and isinstance(n.target, ast.Name):
-            class_level.add(n.target.id)
-
-    # Attributes assigned during construction: __init__ plus every method it
-    # (transitively) calls on self.
-    assigned: set[str] = set(class_level) | set(methods)
-    seen: set[str] = set()
-    frontier = ["__init__"]
-    while frontier:
-        name = frontier.pop()
-        if name in seen or name not in methods:
-            continue
-        seen.add(name)
-        assigned |= _attr_stores(methods[name])
-        frontier.extend(_self_calls(methods[name]))
-
-    exempt = _hasattr_probes(cls)
-    findings: list[tuple[str, str, int]] = []
-    for name, fn in methods.items():
-        for attr, line in sorted(_attr_reads(fn).items(), key=lambda kv: kv[1]):
-            if attr in assigned or attr in exempt:
-                continue
-            if attr.startswith("__") and attr.endswith("__"):
-                continue  # dunders resolve on the type
-            findings.append((attr, name, line))
-    return sorted(set(findings), key=lambda f: f[2])
+    """[(attr, method, line)] read-but-never-constructed attributes."""
+    cls, classes = _load(path, class_name)
+    return uninitialized_reads(cls, classes)
 
 
 def check_metric_counters(path: str, class_name: str) -> list[tuple[str, int]]:
-    """Stricter companion pass for the metrics surface: every `self.m_*`
-    counter the class's `metrics()` method reads must be UNCONDITIONALLY
-    initialized during construction (__init__ or a method it transitively
-    calls). The general pass already catches never-assigned reads; this one
-    exists because metric counters are the repeat offender (the BENCH_r05
-    rc=124 class) — they get added at a dispatch site, read in metrics(),
-    and the init line is what gets forgotten. Returns [(attr, line)]."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    cls = next(
-        (n for n in ast.walk(tree)
-         if isinstance(n, ast.ClassDef) and n.name == class_name),
-        None,
-    )
-    if cls is None:
-        raise SystemExit(f"class {class_name} not found in {path}")
-    methods = {
-        n.name: n for n in cls.body
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-    }
-    if "metrics" not in methods:
-        return []
-    init_assigned: set[str] = set()
-    seen: set[str] = set()
-    frontier = ["__init__"]
-    while frontier:
-        name = frontier.pop()
-        if name in seen or name not in methods:
-            continue
-        seen.add(name)
-        init_assigned |= _attr_stores(methods[name])
-        frontier.extend(_self_calls(methods[name]))
-    exempt = _hasattr_probes(cls)
-    return sorted(
-        (attr, line)
-        for attr, line in _attr_reads(methods["metrics"]).items()
-        if attr.startswith("m_")
-        and attr not in init_assigned
-        and attr not in exempt
-    )
+    """[(attr, line)] m_* counters metrics() reads but __init__ never set."""
+    cls, classes = _load(path, class_name)
+    return uninitialized_counters(cls, classes)
 
 
 def check_lock_discipline(
     path: str, class_name: str, lock_attr: str = "_pending_lock"
 ) -> list[tuple[str, str, int]]:
-    """Third pass (ISSUE 4): attributes READ inside `with self.<lock_attr>:`
-    somewhere in the class must never be REBOUND (`self.x = ...` /
-    `self.x += ...`) outside such a block at runtime — the lock exists
-    because another thread reads that state, so an unlocked rebind is a
-    torn-read waiting to happen (submit() and the loop thread share
-    _pending exactly this way). Construction (__init__ plus everything it
-    transitively calls on self) is exempt: no second thread exists yet.
-    Returns [(attr, method, line)] for unlocked rebinds."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    cls = next(
-        (n for n in ast.walk(tree)
-         if isinstance(n, ast.ClassDef) and n.name == class_name),
-        None,
-    )
-    if cls is None:
-        raise SystemExit(f"class {class_name} not found in {path}")
-    methods = {
-        n.name: n for n in cls.body
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-    }
-
-    construction: set[str] = set()
-    seen: set[str] = set()
-    frontier = ["__init__"]
-    while frontier:
-        name = frontier.pop()
-        if name in seen or name not in methods:
-            continue
-        seen.add(name)
-        frontier.extend(_self_calls(methods[name]))
-    construction = seen
-
-    def _is_lock_with(node: ast.With, me: str) -> bool:
-        for item in node.items:
-            ctx = item.context_expr
-            if (isinstance(ctx, ast.Attribute)
-                    and isinstance(ctx.value, ast.Name)
-                    and ctx.value.id == me and ctx.attr == lock_attr):
-                return True
-        return False
-
-    reads_locked: set[str] = set()
-    # [(attr, method, line, locked)] for every rebind of a self attribute.
-    rebinds: list[tuple[str, str, int, bool]] = []
-
-    for mname, fn in methods.items():
-        me = _self_name(fn)
-        if me is None:
-            continue
-
-        def walk(node: ast.AST, locked: bool, mname=mname, me=me) -> None:
-            if (isinstance(node, ast.Attribute)
-                    and isinstance(node.value, ast.Name)
-                    and node.value.id == me):
-                if isinstance(node.ctx, ast.Load) and locked:
-                    reads_locked.add(node.attr)
-                elif isinstance(node.ctx, ast.Store):
-                    rebinds.append((node.attr, mname, node.lineno, locked))
-            child_locked = locked or (
-                isinstance(node, ast.With) and _is_lock_with(node, me)
-            )
-            for child in ast.iter_child_nodes(node):
-                walk(child, child_locked)
-
-        walk(fn, False)
-
-    # Method/property accesses under the lock are calls, not shared state.
-    protected = reads_locked - set(methods) - {lock_attr}
-    findings = [
-        (attr, mname, line)
-        for attr, mname, line, locked in rebinds
-        if attr in protected and not locked and mname not in construction
-    ]
-    return sorted(set(findings), key=lambda f: f[2])
+    """[(attr, method, line)] unlocked rebinds of lock-protected state."""
+    cls, _ = _load(path, class_name)
+    return check_class_locks(cls, lock_attr)
 
 
 def main(argv: list[str]) -> int:
+    print(
+        "NOTE: tools/check_engine_attrs.py is a deprecation shim — "
+        "use `python -m tools.lint` (docs/STATIC_ANALYSIS.md)",
+        file=sys.stderr,
+    )
     path = argv[1] if len(argv) > 1 else DEFAULT_PATH
     class_name = argv[2] if len(argv) > 2 else "Engine"
     findings = check_class(path, class_name)

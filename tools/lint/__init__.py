@@ -1,0 +1,32 @@
+"""localai-lint: repo-native multi-pass static analysis (ISSUE 5).
+
+Usage:
+    python -m tools.lint            # human output, exit 1 on findings
+    python -m tools.lint --json     # machine output
+    python -m tools.lint --list     # show the pass registry
+
+See docs/STATIC_ANALYSIS.md for the pass catalogue, the incident each pass
+encodes, and the suppression syntax (`# lint: ignore[pass-id] reason`).
+"""
+
+from __future__ import annotations
+
+import os
+
+from .core import (  # noqa: F401 — public API
+    Finding,
+    Pass,
+    Repo,
+    RunResult,
+    apply_suppressions,
+    run_passes,
+    write_report,
+)
+from .passes import all_passes  # noqa: F401
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_repo(root: str = REPO_ROOT, only=None, skip=None) -> RunResult:
+    """Run the full registry over a repo checkout."""
+    return run_passes(Repo(root), all_passes(), only=only, skip=skip)

@@ -1,0 +1,59 @@
+"""CLI: python -m tools.lint [--json] [--list] [--pass a,b] [--skip a,b]
+[--root PATH] [--report FILE]. Exit 0 clean, 1 findings, 2 usage error."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import REPO_ROOT, run_repo
+from .core import write_report
+from .passes import all_passes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.lint",
+        description="localai-lint: repo-native multi-pass static analysis",
+    )
+    ap.add_argument("--root", default=REPO_ROOT, help="repo root to analyze")
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument("--list", action="store_true", help="list registered passes")
+    ap.add_argument("--pass", dest="only", default=None,
+                    help="comma-separated pass ids to run (default: all)")
+    ap.add_argument("--skip", default=None,
+                    help="comma-separated pass ids to skip")
+    ap.add_argument("--report", default=None,
+                    help="write the LINT_rNN.json counts report here")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for p in all_passes():
+            print(f"{p.id:16s} {p.description}")
+        return 0
+
+    only = args.only.split(",") if args.only else None
+    skip = args.skip.split(",") if args.skip else None
+    known = {p.id for p in all_passes()}
+    for pid in (only or []) + (skip or []):
+        if pid not in known:
+            print(f"unknown pass id {pid!r} (see --list)", file=sys.stderr)
+            return 2
+
+    result = run_repo(args.root, only=only, skip=skip)
+    if args.report:
+        write_report(result, args.report)
+    if args.json:
+        print(json.dumps(result.to_json(), indent=1))
+    else:
+        for f in result.findings:
+            print(f.render())
+        n, s = len(result.active), len(result.suppressed)
+        print(f"{len(result.pass_ids)} passes: "
+              f"{n} finding(s), {s} suppression(s)")
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,269 @@
+"""localai-lint core: pass registry, shared AST/module cache, suppressions.
+
+Every incident class this repo has hit traces to something Python's compiler
+cannot see (ISSUE 5): the engine loop died of an AttributeError on an
+unassigned `self._admit_hold_start` (BENCH_r05 rc=124); cancelled requests
+hung callers because a code path dropped a pending entry without posting a
+terminal event (bitten in PR 1 *and* PR 4); allocator leaks needed randomized
+churn to surface. This framework promotes the ad-hoc AST checks that caught
+those classes into a registry of passes that runs in tier-1 on every PR.
+
+Contracts:
+
+- A pass is a `Pass` subclass with a stable `id`, a `description`, and a
+  `run(repo) -> list[Finding]`. Passes are pure AST/text analyses — they must
+  never import the code under analysis (tier-1 runs them in <10 s on CPU and
+  they must work on broken code).
+- Findings are suppressed in source with a REQUIRED reason:
+
+      something_flagged()  # lint: ignore[pass-id] why this is actually fine
+
+  on the finding's line, or on a standalone comment line directly above it.
+  A suppression without a reason is itself a finding (pass id `lint`), so
+  silence always has a written justification next to the code.
+- Exit codes (CLI): 0 clean, 1 findings, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import json
+import os
+import re
+from typing import Iterable, Optional
+
+# Matches `# lint: ignore[pass-id] reason...` (reason may start with -, —, :).
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*ignore\[(?P<pid>[a-z0-9_-]+)\]\s*[-—:]?\s*(?P<reason>.*)$"
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    pass_id: str
+    path: str  # repo-relative
+    line: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""  # suppression reason when suppressed
+
+    def render(self) -> str:
+        tag = " [suppressed: %s]" % self.reason if self.suppressed else ""
+        return f"{self.path}:{self.line}: [{self.pass_id}] {self.message}{tag}"
+
+
+class Repo:
+    """Shared parse cache over the repository: each file is read and parsed
+    at most once no matter how many passes inspect it."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._src: dict[str, str] = {}
+        self._lines: dict[str, list[str]] = {}
+        self._tree: dict[str, ast.Module] = {}
+        self._files: dict[tuple, list[str]] = {}
+
+    def rel(self, path: str) -> str:
+        return os.path.relpath(os.path.join(self.root, path), self.root)
+
+    def abspath(self, path: str) -> str:
+        return path if os.path.isabs(path) else os.path.join(self.root, path)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(self.abspath(path))
+
+    def files(self, *patterns: str) -> list[str]:
+        """Repo-relative .py paths under root matching any glob pattern
+        (patterns are matched against the relative path, '/'-separated).
+        Cached per pattern set — several passes share the same globs."""
+        if patterns in self._files:
+            return self._files[patterns]
+        out = []
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            dirnames[:] = [
+                d for d in dirnames
+                if d not in ("__pycache__", ".git", ".claude", "node_modules")
+            ]
+            for fn in filenames:
+                if not fn.endswith(".py"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn), self.root)
+                rel = rel.replace(os.sep, "/")
+                if any(fnmatch.fnmatch(rel, p) for p in patterns):
+                    out.append(rel)
+        self._files[patterns] = sorted(out)
+        return self._files[patterns]
+
+    def source(self, path: str) -> str:
+        rel = path.replace(os.sep, "/")
+        if rel not in self._src:
+            with open(self.abspath(rel), encoding="utf-8") as f:
+                self._src[rel] = f.read()
+        return self._src[rel]
+
+    def lines(self, path: str) -> list[str]:
+        rel = path.replace(os.sep, "/")
+        if rel not in self._lines:
+            self._lines[rel] = self.source(rel).splitlines()
+        return self._lines[rel]
+
+    def tree(self, path: str) -> ast.Module:
+        rel = path.replace(os.sep, "/")
+        if rel not in self._tree:
+            self._tree[rel] = ast.parse(self.source(rel), filename=rel)
+        return self._tree[rel]
+
+    def classes(self, path: str) -> dict[str, ast.ClassDef]:
+        """All classes in a module (nested included), by name."""
+        return {
+            n.name: n
+            for n in ast.walk(self.tree(path))
+            if isinstance(n, ast.ClassDef)
+        }
+
+    def find_class(self, path: str, name: str) -> Optional[ast.ClassDef]:
+        return self.classes(path).get(name)
+
+
+class Pass:
+    """Base class for a lint pass. Subclasses set `id` and `description`
+    and implement run(). `default_on` lets future niche passes ship opt-in."""
+
+    id: str = ""
+    description: str = ""
+    default_on: bool = True
+
+    def run(self, repo: Repo) -> list[Finding]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def finding(self, path: str, line: int, message: str) -> Finding:
+        return Finding(pass_id=self.id, path=path, line=line, message=message)
+
+
+def _suppression_for(lines: list[str], line: int, pass_id: str):
+    """Return (found, reason) for a suppression governing `line` (1-based):
+    the marker may sit on the line itself or on a standalone comment line
+    directly above. Reason may be empty (caller turns that into a finding)."""
+    candidates = []
+    if 1 <= line <= len(lines):
+        candidates.append(lines[line - 1])
+    if line >= 2 and lines[line - 2].lstrip().startswith("#"):
+        candidates.append(lines[line - 2])
+    for text in candidates:
+        m = _SUPPRESS_RE.search(text)
+        if m and m.group("pid") == pass_id:
+            return True, m.group("reason").strip()
+    return False, ""
+
+
+def apply_suppressions(repo: Repo, findings: list[Finding],
+                       known_ids: Iterable[str]) -> list[Finding]:
+    """Mark suppressed findings in place; returns extra framework findings
+    (reasonless suppressions, unknown pass ids in markers)."""
+    extra: list[Finding] = []
+    known = set(known_ids) | {"lint"}
+    checked_files: set[str] = set()
+    for f in findings:
+        try:
+            lines = repo.lines(f.path)
+        except OSError:
+            continue
+        found, reason = _suppression_for(lines, f.line, f.pass_id)
+        if found:
+            if not reason:
+                extra.append(Finding(
+                    pass_id="lint", path=f.path, line=f.line,
+                    message=(
+                        f"suppression of [{f.pass_id}] has no reason — "
+                        "write WHY after the bracket: "
+                        f"`# lint: ignore[{f.pass_id}] <reason>`"
+                    ),
+                ))
+            else:
+                f.suppressed, f.reason = True, reason
+        checked_files.add(f.path)
+    # Malformed / unknown-pass markers anywhere in files we already loaded.
+    for path in sorted(checked_files):
+        for i, text in enumerate(repo.lines(path), start=1):
+            m = _SUPPRESS_RE.search(text)
+            if m and m.group("pid") not in known:
+                extra.append(Finding(
+                    pass_id="lint", path=path, line=i,
+                    message=f"suppression names unknown pass id "
+                            f"{m.group('pid')!r} (known: {sorted(known)})",
+                ))
+    return extra
+
+
+@dataclasses.dataclass
+class RunResult:
+    findings: list[Finding]  # all, suppressed included
+    pass_ids: list[str]  # passes that ran
+
+    @property
+    def active(self) -> list[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> list[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    @property
+    def clean(self) -> bool:
+        return not self.active
+
+    def by_pass(self) -> dict[str, dict[str, int]]:
+        out = {pid: {"findings": 0, "suppressions": 0} for pid in self.pass_ids}
+        for f in self.findings:
+            slot = out.setdefault(
+                f.pass_id, {"findings": 0, "suppressions": 0}
+            )
+            slot["suppressions" if f.suppressed else "findings"] += 1
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "clean": self.clean,
+            "passes": self.by_pass(),
+            "total_findings": len(self.active),
+            "total_suppressions": len(self.suppressed),
+            "findings": [dataclasses.asdict(f) for f in self.findings],
+        }
+
+    def report(self) -> dict:
+        """The LINT_rNN.json contract: pass → findings/suppressions counts.
+        Future PRs assert the suppression count only goes DOWN."""
+        return {
+            "clean": self.clean,
+            "passes": self.by_pass(),
+            "total_suppressions": len(self.suppressed),
+        }
+
+
+def run_passes(repo: Repo, passes: list[Pass],
+               only: Optional[Iterable[str]] = None,
+               skip: Optional[Iterable[str]] = None) -> RunResult:
+    only_set = set(only) if only is not None else None
+    skip_set = set(skip or ())
+    selected = [
+        p for p in passes
+        if (only_set is None and p.default_on or
+            only_set is not None and p.id in only_set)
+        and p.id not in skip_set
+    ]
+    findings: list[Finding] = []
+    for p in selected:
+        findings.extend(p.run(repo))
+    findings.extend(
+        apply_suppressions(repo, findings, [p.id for p in passes])
+    )
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_id))
+    return RunResult(findings=findings, pass_ids=[p.id for p in selected])
+
+
+def write_report(result: RunResult, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(result.report(), f, indent=1, sort_keys=True)
+        f.write("\n")

@@ -1,0 +1,27 @@
+"""Pass registry. Adding a pass = one module here + one entry in ALL_PASSES
+(+ fixtures under tests/lint_fixtures/ — no pass ships untested)."""
+
+from __future__ import annotations
+
+from .attr_init import AttrInitPass
+from .config_drift import ConfigDriftPass
+from .fault_sites import FaultSitesPass
+from .lock_discipline import LockDisciplinePass
+from .metric_counters import MetricCountersPass
+from .page_refcount import PageRefcountPass
+from .terminal_event import TerminalEventPass
+from .trace_safety import TraceSafetyPass
+
+
+def all_passes():
+    """Fresh pass instances with default (repo) targets."""
+    return [
+        AttrInitPass(),
+        MetricCountersPass(),
+        LockDisciplinePass(),
+        TraceSafetyPass(),
+        TerminalEventPass(),
+        PageRefcountPass(),
+        ConfigDriftPass(),
+        FaultSitesPass(),
+    ]

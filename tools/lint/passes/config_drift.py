@@ -1,0 +1,237 @@
+"""config-drift: the four config surfaces must agree.
+
+A knob exists four times: a dataclass field (`EngineConfig` /
+`ModelConfig` / `ApplicationConfig`), a YAML key (ModelConfig fields ARE
+the YAML schema via `from_dict`), an optional `LOCALAI_*` env override, and
+a row in docs/CONFIG.md. They drift independently — PR 3/4 each added knobs
+in three places and documented a different subset. Checks:
+
+D1  Every ModelConfig / ApplicationConfig field is documented in
+    docs/CONFIG.md (mentioned in backticks or as a table row). Nested
+    configs (parallel.*, template.*) count via their dotted spelling.
+D2  Every first-column entry of a CONFIG.md table names a real field —
+    rows for knobs that no longer exist must be deleted.
+D3  Every LOCALAI_* env var the code reads appears in docs/CONFIG.md.
+D4  Every LOCALAI_* name mentioned in docs or code comments is actually
+    read somewhere (string constant in localai_tpu/) — otherwise the
+    override is an orphan: users set it and nothing happens.
+D5  Every field name shared by ModelConfig and EngineConfig is forwarded in
+    the manager's EngineConfig(...) construction — a YAML knob that never
+    reaches the engine is dead.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Finding, Pass, Repo
+
+ENGINE_PY = "localai_tpu/engine/engine.py"
+MODEL_CFG_PY = "localai_tpu/config/model_config.py"
+APP_CFG_PY = "localai_tpu/config/app_config.py"
+MANAGER_PY = "localai_tpu/server/manager.py"
+CONFIG_MD = "docs/CONFIG.md"
+CODE_GLOBS = ["localai_tpu/**/*.py", "localai_tpu/*.py"]
+
+_ENV_RE = re.compile(r"LOCALAI_[A-Z0-9_]+")
+_TABLE_ROW_RE = re.compile(r"^\|\s*`([^`]+)`\s*\|")
+# Doc-only identifiers that are legitimately not config fields (table rows
+# describing request-body/API params or structural examples).
+_DOC_ROW_ALLOW = {"field", "backend", "options"}
+
+
+def dataclass_fields(tree: ast.Module, class_name: str) -> dict[str, int]:
+    """{field: line} of annotated assignments in a (data)class body."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            out = {}
+            for n in node.body:
+                if isinstance(n, ast.AnnAssign) and isinstance(n.target, ast.Name):
+                    if not n.target.id.isupper():  # skip class constants
+                        out[n.target.id] = n.lineno
+            return out
+    return {}
+
+
+class ConfigDriftPass(Pass):
+    id = "config-drift"
+    description = (
+        "dataclass fields ↔ YAML keys ↔ LOCALAI_* env vars ↔ docs/CONFIG.md "
+        "rows out of sync (undocumented, dead, or orphaned knobs)"
+    )
+
+    def __init__(self, engine_py=ENGINE_PY, model_cfg_py=MODEL_CFG_PY,
+                 app_cfg_py=APP_CFG_PY, manager_py=MANAGER_PY,
+                 config_md=CONFIG_MD, code_globs=None):
+        self.engine_py = engine_py
+        self.model_cfg_py = model_cfg_py
+        self.app_cfg_py = app_cfg_py
+        self.manager_py = manager_py
+        self.config_md = config_md
+        self.code_globs = CODE_GLOBS if code_globs is None else code_globs
+
+    def _env_constants(self, repo: Repo) -> dict[str, tuple[str, int]]:
+        """Env names that appear as string CONSTANTS in code (i.e. actually
+        read/used): {name: (path, line)} of first sighting."""
+        out: dict[str, tuple[str, int]] = {}
+        for path in repo.files(*self.code_globs):
+            for node in ast.walk(repo.tree(path)):
+                if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                    for m in _ENV_RE.finditer(node.value):
+                        out.setdefault(m.group(0), (path, node.lineno))
+        return out
+
+    def _env_mentions(self, repo: Repo) -> dict[str, tuple[str, int]]:
+        """Env names mentioned ANYWHERE in code text (comments/docstrings
+        included): {name: (path, line)}."""
+        out: dict[str, tuple[str, int]] = {}
+        for path in repo.files(*self.code_globs):
+            for i, text in enumerate(repo.lines(path), start=1):
+                for m in _ENV_RE.finditer(text):
+                    out.setdefault(m.group(0), (path, i))
+        return out
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        if not (repo.exists(self.model_cfg_py) and repo.exists(self.config_md)):
+            return out
+
+        model_fields = dataclass_fields(repo.tree(self.model_cfg_py), "ModelConfig")
+        parallel_fields = dataclass_fields(repo.tree(self.model_cfg_py), "ParallelConfig")
+        template_fields = dataclass_fields(repo.tree(self.model_cfg_py), "TemplateConfig")
+        app_fields = (dataclass_fields(repo.tree(self.app_cfg_py), "ApplicationConfig")
+                      if repo.exists(self.app_cfg_py) else {})
+        engine_fields = (dataclass_fields(repo.tree(self.engine_py), "EngineConfig")
+                         if repo.exists(self.engine_py) else {})
+
+        doc_text = repo.source(self.config_md)
+        doc_lines = repo.lines(self.config_md)
+        doc_backticked = set(re.findall(r"`([^`\n]+)`", doc_text))
+
+        def documented(name: str) -> bool:
+            if name in doc_backticked:
+                return True
+            # dotted/nested spellings and prose mentions
+            return bool(re.search(
+                r"(^|[^a-zA-Z0-9_])" + re.escape(name) + r"($|[^a-zA-Z0-9_])",
+                doc_text,
+            ))
+
+        # D1: undocumented knobs.
+        for fname, line in sorted(model_fields.items()):
+            if fname == "options":
+                continue  # free-form passthrough, documented as a section
+            if not documented(fname):
+                out.append(self.finding(
+                    self.model_cfg_py, line,
+                    f"ModelConfig.{fname} (a YAML key) is not documented in "
+                    f"{self.config_md} — add a row",
+                ))
+        for prefix, fields in (("parallel", parallel_fields),
+                               ("template", template_fields)):
+            for fname, line in sorted(fields.items()):
+                if not (documented(f"{prefix}.{fname}") or documented(fname)):
+                    out.append(self.finding(
+                        self.model_cfg_py, line,
+                        f"{prefix}.{fname} (a YAML key) is not documented in "
+                        f"{self.config_md} — add a row",
+                    ))
+        for fname, line in sorted(app_fields.items()):
+            if not documented(fname):
+                out.append(self.finding(
+                    self.app_cfg_py, line,
+                    f"ApplicationConfig.{fname} is not documented in "
+                    f"{self.config_md} (application-level section)",
+                ))
+
+        # D2: dead doc rows.
+        known = (set(model_fields) | set(app_fields) | set(engine_fields)
+                 | {f"parallel.{f}" for f in parallel_fields}
+                 | {f"template.{f}" for f in template_fields}
+                 | set(parallel_fields) | set(template_fields))
+        in_field_table = False
+        for i, text in enumerate(doc_lines, start=1):
+            stripped = text.strip()
+            if stripped.startswith("|"):
+                first_cell = stripped.strip("|").split("|")[0].strip()
+                if first_cell.strip("`") in ("field", "---"):
+                    # header / separator: tables whose first column is
+                    # `field` document config keys; others (backend option
+                    # tables etc.) are prose.
+                    if first_cell.strip("`") == "field":
+                        in_field_table = True
+                    continue
+            else:
+                in_field_table = False
+                continue
+            m = _TABLE_ROW_RE.match(stripped)
+            if not m or not in_field_table:
+                continue
+            # `embeddings: true` / `known_usecases: [...]` style rows name
+            # the field before the colon.
+            name = m.group(1).split(":")[0].strip()
+            base = name.split(".")[0]
+            if name in known or base in known or name in _DOC_ROW_ALLOW:
+                continue
+            if _ENV_RE.fullmatch(name):
+                continue  # env rows are checked by D3/D4
+            out.append(self.finding(
+                self.config_md, i,
+                f"doc table row `{name}` names no existing config field — "
+                f"delete the row or fix the name",
+            ))
+
+        # D3/D4: env var surface.
+        read = self._env_constants(repo)
+        mentioned = self._env_mentions(repo)
+        doc_envs = {m.group(0) for m in _ENV_RE.finditer(doc_text)}
+        for name, (path, line) in sorted(read.items()):
+            if name == "LOCALAI_":
+                continue
+            if name not in doc_envs:
+                out.append(self.finding(
+                    path, line,
+                    f"env var {name} is read by code but not documented in "
+                    f"{self.config_md}",
+                ))
+        for name in sorted(doc_envs - set(read)):
+            if name == "LOCALAI_":
+                continue
+            line = next(
+                (i for i, t in enumerate(doc_lines, start=1) if name in t), 1
+            )
+            out.append(self.finding(
+                self.config_md, line,
+                f"{self.config_md} documents env var {name} but no code "
+                f"reads it — orphaned knob (setting it does nothing)",
+            ))
+        for name, (path, line) in sorted(mentioned.items()):
+            if name in read or name == "LOCALAI_":
+                continue
+            out.append(self.finding(
+                path, line,
+                f"{name} appears in a comment/docstring but no code reads "
+                f"it — orphaned env var claim",
+            ))
+
+        # D5: shared ModelConfig/EngineConfig fields must be forwarded by
+        # the manager's EngineConfig(...) construction.
+        shared = set(model_fields) & set(engine_fields)
+        if shared and repo.exists(self.manager_py):
+            forwarded: set[str] = set()
+            ctor_line = 1
+            for node in ast.walk(repo.tree(self.manager_py)):
+                if (isinstance(node, ast.Call)
+                        and getattr(node.func, "id", getattr(node.func, "attr", ""))
+                        == "EngineConfig"):
+                    ctor_line = node.lineno
+                    forwarded |= {kw.arg for kw in node.keywords if kw.arg}
+            for fname in sorted(shared - forwarded):
+                out.append(self.finding(
+                    self.manager_py, ctor_line,
+                    f"ModelConfig.{fname} mirrors EngineConfig.{fname} but "
+                    f"the manager's EngineConfig(...) construction does not "
+                    f"forward it — the YAML knob is dead",
+                ))
+        return out

@@ -1,0 +1,101 @@
+"""fault-sites: every fault-injection site name maps to a real hook call.
+
+`LOCALAI_FAULTS=seed:N,sites:a|b` schedules injections per SITE NAME
+(localai_tpu/testing/faults.py). `FaultSchedule` already validates requested
+sites against `SITES`, but nothing validated `SITES` against reality: a site
+listed there whose `faults.fire("...")` call was renamed or deleted would
+silently never fire, and every schedule targeting it would "pass" while
+testing nothing. Both directions are checked:
+
+  * every name in `faults.SITES` has at least one `faults.fire("name")`
+    call site in production code (localai_tpu/, tests excluded — a site
+    that only tests can fire is equally dead);
+  * every `fire(...)` call uses a literal site name present in `SITES`
+    (a non-literal argument defeats static verification and is flagged).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import astutil
+from ..core import Finding, Pass, Repo
+
+FAULTS_PY = "localai_tpu/testing/faults.py"
+CODE_GLOBS = ["localai_tpu/**/*.py", "localai_tpu/*.py"]
+
+
+def declared_sites(repo: Repo, faults_py: str) -> dict[str, int]:
+    """{site: line} from the SITES tuple assignment in faults.py."""
+    for node in ast.walk(repo.tree(faults_py)):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "SITES"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            return {
+                elt.value: elt.lineno
+                for elt in node.value.elts
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+            }
+    return {}
+
+
+class FaultSitesPass(Pass):
+    id = "fault-sites"
+    description = (
+        "faults.SITES entries without a fire() call site, and fire() calls "
+        "with unknown/non-literal site names"
+    )
+
+    def __init__(self, faults_py=FAULTS_PY, code_globs=None):
+        self.faults_py = faults_py
+        self.code_globs = CODE_GLOBS if code_globs is None else code_globs
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        if not repo.exists(self.faults_py):
+            return out
+        sites = declared_sites(repo, self.faults_py)
+        fired: dict[str, list[tuple[str, int]]] = {}
+        for path in repo.files(*self.code_globs):
+            if path == self.faults_py:
+                continue  # the module's own fire() definition/docstring
+            for node in ast.walk(repo.tree(path)):
+                if not (isinstance(node, ast.Call)
+                        and astutil.dotted_name(node.func).split(".")[-1]
+                        == "fire"):
+                    continue
+                # Only faults.fire / fire — skip unrelated .fire() methods
+                # by requiring the receiver to be `faults` or a bare import.
+                root = astutil.dotted_name(node.func)
+                if root not in ("fire", "faults.fire"):
+                    continue
+                if not node.args or not (
+                    isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    out.append(self.finding(
+                        path, node.lineno,
+                        "fire(...) with a non-literal site name — the "
+                        "fault-site consistency check cannot verify it; "
+                        "use a string literal from faults.SITES",
+                    ))
+                    continue
+                name = node.args[0].value
+                fired.setdefault(name, []).append((path, node.lineno))
+                if name not in sites:
+                    out.append(self.finding(
+                        path, node.lineno,
+                        f"fire({name!r}) names a site missing from "
+                        f"faults.SITES — schedules can never target it and "
+                        f"parse_env would reject it",
+                    ))
+        for name, line in sorted(sites.items()):
+            if name not in fired:
+                out.append(self.finding(
+                    self.faults_py, line,
+                    f"faults.SITES entry {name!r} has no faults.fire({name!r}) "
+                    f"call site in localai_tpu/ — a schedule targeting it "
+                    f"silently never fires (the typo'd-site class)",
+                ))
+        return out

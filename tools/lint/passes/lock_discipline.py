@@ -1,0 +1,129 @@
+"""lock-discipline: state read under a lock must not be rebound outside it.
+
+Generalized (ISSUE 5) from the single hard-coded Engine/_pending_lock check:
+for EVERY class in the engine, manager, and federation-router modules, and
+for EVERY lock attribute the class constructs (`self.x = threading.Lock()` /
+`RLock()` / `Condition()`), attributes READ inside `with self.x:` somewhere
+in the class must never be REBOUND (`self.a = ...` / `self.a += ...`)
+outside such a block at runtime — the lock exists because another thread
+reads that state, so an unlocked rebind is a torn-read waiting to happen
+(Engine.submit() and the loop thread share _pending exactly this way).
+
+Construction (__init__ plus everything it transitively calls on self) is
+exempt: no second thread exists yet.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import astutil
+from ..core import Finding, Pass, Repo
+
+DEFAULT_GLOBS = [
+    "localai_tpu/engine/*.py",
+    "localai_tpu/server/manager.py",
+    "localai_tpu/federation/router.py",
+]
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+def _lock_attrs(cls: ast.ClassDef) -> set[str]:
+    """Attributes assigned from threading.Lock()/RLock()/Condition()
+    anywhere in the class."""
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        ctor = astutil.dotted_name(node.value.func)
+        if ctor.split(".")[-1] not in _LOCK_CTORS:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name):
+                out.add(t.attr)
+    return out
+
+
+def check_class_locks(cls: ast.ClassDef, lock_attr: str) -> list[tuple[str, str, int]]:
+    """[(attr, method, line)] unlocked rebinds of state read under lock_attr."""
+    methods = astutil.methods_of(cls)
+    construction = astutil.construction_methods(methods)
+
+    def _is_lock_with(node: ast.With, me: str) -> bool:
+        for item in node.items:
+            ctx = item.context_expr
+            if (isinstance(ctx, ast.Attribute)
+                    and isinstance(ctx.value, ast.Name)
+                    and ctx.value.id == me and ctx.attr == lock_attr):
+                return True
+        return False
+
+    reads_locked: set[str] = set()
+    rebinds: list[tuple[str, str, int, bool]] = []
+
+    for mname, fn in methods.items():
+        me = astutil.self_name(fn)
+        if me is None:
+            continue
+        # Repo convention: a method named *_locked is documented as "caller
+        # holds the lock" — its body runs in locked context.
+        held_by_caller = mname.endswith("_locked")
+
+        def walk(node: ast.AST, locked: bool, mname=mname, me=me) -> None:
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == me):
+                if isinstance(node.ctx, ast.Load) and locked:
+                    reads_locked.add(node.attr)
+                elif isinstance(node.ctx, ast.Store):
+                    rebinds.append((node.attr, mname, node.lineno, locked))
+            if isinstance(node, ast.AugAssign):
+                t = node.target
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == me):
+                    rebinds.append((t.attr, mname, node.lineno, locked))
+            child_locked = locked or (
+                isinstance(node, ast.With) and _is_lock_with(node, me)
+            )
+            for child in ast.iter_child_nodes(node):
+                walk(child, child_locked)
+
+        walk(fn, held_by_caller)
+
+    # Method/property accesses under the lock are calls, not shared state.
+    protected = reads_locked - set(methods) - {lock_attr}
+    findings = [
+        (attr, mname, line)
+        for attr, mname, line, locked in rebinds
+        if attr in protected and not locked and mname not in construction
+    ]
+    return sorted(set(findings), key=lambda f: f[2])
+
+
+class LockDisciplinePass(Pass):
+    id = "lock-discipline"
+    description = (
+        "state read under a class's lock rebound outside it "
+        "(cross-thread torn read)"
+    )
+
+    def __init__(self, globs=None):
+        self.globs = DEFAULT_GLOBS if globs is None else globs
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        for path in repo.files(*self.globs):
+            for cls in ast.walk(repo.tree(path)):
+                if not isinstance(cls, ast.ClassDef):
+                    continue
+                for lock_attr in sorted(_lock_attrs(cls)):
+                    for attr, mname, line in check_class_locks(cls, lock_attr):
+                        out.append(self.finding(
+                            path, line,
+                            f"self.{attr} rebound in {cls.name}.{mname}() "
+                            f"WITHOUT {lock_attr}, but it is read under that "
+                            f"lock elsewhere — cross-thread torn read",
+                        ))
+        return out

@@ -1,0 +1,246 @@
+"""page-refcount: allocator discipline for the paged-KV pool and host tier.
+
+The PR 3 allocator bugs (double releases, a stale table overwritten into a
+permanent pool leak, the 107k-preemption livelock) all reduced to page
+bookkeeping happening OUTSIDE the allocator primitives, where no invariant
+walk could see it. Rules, per class (default Engine):
+
+1. PRIMITIVES ONLY: `self._free_pages` and `self._page_refs` may be mutated
+   only inside the allocator primitives (`_pages_claim` / `_pages_addref` /
+   `_pages_release`, plus `_pages_alloc` composing them) and construction.
+   Any other method popping the free list or touching refcounts is
+   untracked accounting.
+
+2. CHECKED ALLOCATION: every `_pages_alloc(...)` / `_pages_claim(...)` call
+   outside the primitives must handle the None return (pool full) — the
+   result must be None-compared in the same method (or the call itself sit
+   in an `if` test). An unchecked alloc turns pool backpressure into a
+   loop-killing TypeError three lines later.
+
+3. RELEASE ON ERROR EDGES: a method that allocates must also own a failure
+   edge — a try/except/finally that references `_pages_free`/`_pages_release`,
+   or slot installation (`self.slots[...] = ...`, after which the ordinary
+   `_release` teardown owns the pages), or an explicit requeue of the
+   request. Allocating with neither means an exception between the alloc
+   and the slot install leaks the pages until restart.
+
+4. NO ESCAPED PAGE IDS: page ids live only in the tracked tables
+   (`_slot_pages`, `h_ptable`, the refcount/free structures) or flow
+   through the allocator's return value. Storing a page list into any other
+   `self.<attr>` hides references from the invariant walk.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import astutil
+from ..core import Finding, Pass, Repo
+
+DEFAULT_TARGETS = [("localai_tpu/engine/engine.py", "Engine")]
+
+PRIMITIVES = {"_pages_alloc", "_pages_release", "_pages_claim",
+              "_pages_addref"}
+ALLOC_CALLS = {"_pages_alloc", "_pages_claim"}
+POOL_ATTRS = {"_free_pages", "_page_refs"}
+TRACKED_TABLES = {"_slot_pages", "h_ptable", "_free_pages", "_page_refs"}
+# Containers whose entries own page references with a release path of
+# their own (_prefix_drop): inserting pages here transfers ownership.
+TRACKED_CONTAINERS = {"_prefix_entries", "_prefix_host"}
+_MUTATING_CALLS = {"pop", "append", "appendleft", "extend", "clear",
+                   "insert", "remove"}
+RELEASE_NAMES = {"_pages_free", "_pages_release"}
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {
+        astutil.dotted_name(sub).split(".")[-1]
+        for sub in ast.walk(node)
+        if isinstance(sub, (ast.Attribute, ast.Name))
+    }
+
+
+class PageRefcountPass(Pass):
+    id = "page-refcount"
+    description = (
+        "page-pool booking outside the allocator primitives / unchecked "
+        "alloc / alloc without a release edge / escaped page ids"
+    )
+
+    def __init__(self, targets=None):
+        self.targets = DEFAULT_TARGETS if targets is None else targets
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        for path, class_name in self.targets:
+            if not repo.exists(path):
+                continue
+            cls = repo.find_class(path, class_name)
+            if cls is None:
+                continue
+            methods = astutil.methods_of(cls)
+            construction = astutil.construction_methods(methods)
+            for mname, fn in methods.items():
+                me = astutil.self_name(fn)
+                if me is None:
+                    continue
+                in_primitive = mname in PRIMITIVES or mname in construction
+
+                def self_attr(node) -> str:
+                    if (isinstance(node, ast.Attribute)
+                            and isinstance(node.value, ast.Name)
+                            and node.value.id == me):
+                        return node.attr
+                    return ""
+
+                alloc_calls: list[ast.Call] = []
+                none_checked: set[str] = set()  # local names None-compared
+                calls_in_if_test: set[int] = set()
+                has_release_handler = False
+                installs_slot = False
+                requeues = False
+
+                for node in ast.walk(fn):
+                    # R1: pool-structure mutation outside primitives.
+                    if not in_primitive:
+                        if (isinstance(node, ast.Call)
+                                and isinstance(node.func, ast.Attribute)
+                                and node.func.attr in _MUTATING_CALLS
+                                and self_attr(node.func.value) in POOL_ATTRS):
+                            out.append(self.finding(
+                                path, node.lineno,
+                                f"{class_name}.{mname}() mutates "
+                                f"self.{self_attr(node.func.value)} directly — "
+                                f"page-pool booking belongs in the allocator "
+                                f"primitives ({sorted(PRIMITIVES)}) where the "
+                                f"invariant walk can see it",
+                            ))
+                        if isinstance(node, (ast.Assign, ast.AugAssign)):
+                            targets = (node.targets
+                                       if isinstance(node, ast.Assign)
+                                       else [node.target])
+                            for t in targets:
+                                for tt in ast.walk(t):
+                                    if (isinstance(tt, ast.Subscript)
+                                            and self_attr(tt.value) in POOL_ATTRS):
+                                        out.append(self.finding(
+                                            path, node.lineno,
+                                            f"{class_name}.{mname}() writes "
+                                            f"self.{self_attr(tt.value)}[...] — "
+                                            f"refcount mutation outside the "
+                                            f"allocator primitives",
+                                        ))
+
+                    # Collect allocation calls + None checks (R2/R3).
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr in ALLOC_CALLS
+                            and isinstance(node.func.value, ast.Name)
+                            and node.func.value.id == me):
+                        alloc_calls.append(node)
+                    if isinstance(node, ast.Compare):
+                        ops_none = any(
+                            isinstance(c, ast.Constant) and c.value is None
+                            for c in node.comparators
+                        )
+                        if ops_none:
+                            for sub in ast.walk(node.left):
+                                if isinstance(sub, ast.Name):
+                                    none_checked.add(sub.id)
+                            for sub in ast.walk(node):
+                                if isinstance(sub, ast.Call):
+                                    calls_in_if_test.add(id(sub))
+                    if isinstance(node, ast.Try):
+                        for h in node.handlers + (
+                            [node] if node.finalbody else []
+                        ):
+                            body = (h.body if isinstance(h, ast.ExceptHandler)
+                                    else node.finalbody)
+                            for sub in body:
+                                if _names_in(sub) & RELEASE_NAMES:
+                                    has_release_handler = True
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr in ("extend", "append")
+                            and isinstance(node.func.value, ast.Subscript)
+                            and self_attr(node.func.value.value)
+                            in TRACKED_TABLES):
+                        # e.g. self._slot_pages[i].extend(fresh): claimed
+                        # pages land in a tracked table — ownership moved.
+                        installs_slot = True
+                    if isinstance(node, ast.Assign):
+                        for t in node.targets:
+                            if (isinstance(t, ast.Subscript)
+                                    and self_attr(t.value) in
+                                    ({"slots"} | TRACKED_TABLES)):
+                                installs_slot = True
+                        # R4: page lists escaping into untracked attributes.
+                        rhs_names = _names_in(node.value)
+                        if ("_pages_alloc" in rhs_names
+                                or "_slot_pages" in rhs_names
+                                or "_free_pages" in rhs_names):
+                            for t in node.targets:
+                                a = self_attr(t)
+                                sub_a = (self_attr(t.value)
+                                         if isinstance(t, ast.Subscript) else "")
+                                if ((a and a not in TRACKED_TABLES)
+                                        or (sub_a and sub_a not in TRACKED_TABLES
+                                            and sub_a != "slots")):
+                                    if in_primitive:
+                                        continue
+                                    out.append(self.finding(
+                                        path, node.lineno,
+                                        f"{class_name}.{mname}() stores page "
+                                        f"ids into self.{a or sub_a} — outside "
+                                        f"the tracked tables "
+                                        f"({sorted(TRACKED_TABLES)}); the "
+                                        f"invariant walk cannot see this "
+                                        f"reference",
+                                    ))
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr in ("appendleft", "append",
+                                                   "insert")
+                            and self_attr(node.func.value) in (
+                                {"_pending"} | TRACKED_CONTAINERS)):
+                        # Requeue, or ownership transfer into a prefix
+                        # container whose entries _prefix_drop releases.
+                        requeues = True
+
+                if in_primitive or not alloc_calls:
+                    continue
+
+                # R2: every alloc result must be None-checked.
+                assigned_to: dict[int, str] = {}
+                for node in ast.walk(fn):
+                    if (isinstance(node, ast.Assign)
+                            and isinstance(node.value, ast.Call)
+                            and node.value in alloc_calls):
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                assigned_to[id(node.value)] = t.id
+                for call in alloc_calls:
+                    name = assigned_to.get(id(call))
+                    checked = (
+                        (name is not None and name in none_checked)
+                        or id(call) in calls_in_if_test
+                    )
+                    if not checked:
+                        out.append(self.finding(
+                            path, call.lineno,
+                            f"{class_name}.{mname}() calls "
+                            f"{call.func.attr}() without handling the None "
+                            f"(pool-full) return — backpressure becomes a "
+                            f"loop-killing TypeError",
+                        ))
+
+                # R3: a release edge must exist.
+                if not (has_release_handler or installs_slot or requeues):
+                    out.append(self.finding(
+                        path, alloc_calls[0].lineno,
+                        f"{class_name}.{mname}() allocates pages but has no "
+                        f"failure edge (no try/except-with-release, no slot "
+                        f"install, no requeue) — an exception here leaks the "
+                        f"pages until restart",
+                    ))
+        return out

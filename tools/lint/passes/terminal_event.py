@@ -1,0 +1,168 @@
+"""terminal-event: dropping a request reference without posting a terminal
+event.
+
+The repeated hang class (bitten in PR 1 *and* PR 4): a code path removes an
+entry from the engine's pending queue — or deactivates a slot — without
+posting a "done"/"error" event, and the consumer blocks on its token queue
+forever (BENCH_r05 burned 30 minutes of tier-1 exactly this way; the
+watchdog busy-kill inside the admission gap did it again in PR 1).
+
+Rule, per class (default Engine): every method that DROPS a request
+reference —
+
+  * removes from `self._pending` (`popleft()` / `pop()` / `remove()` /
+    `clear()` or a rebind of `self._pending`), or
+  * deactivates a slot (`self.slots[i] = None`)
+
+— must be "terminal-safe": it posts a terminal event itself (a
+`*._q.put(TokenEvent(kind="done"|"error", ...))` or a call to a method that
+does, transitively), or EVERY intra-class caller of it is terminal-safe
+(helpers like `_release` are owned by posting callers). Re-enqueues
+(`appendleft`/`append` back onto the queue) are not drops. A method that
+fails the rule is a hang waiting for its code path to be hit.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .. import astutil
+from ..core import Finding, Pass, Repo
+
+DEFAULT_TARGETS = [
+    ("localai_tpu/engine/engine.py", "Engine", "_pending", "slots"),
+]
+
+_REMOVE_CALLS = {"popleft", "pop", "remove", "clear"}
+
+
+def _terminal_put_in(fn) -> bool:
+    """True when fn contains `<x>._q.put(TokenEvent(kind='done'|'error'))`
+    or `<x>.put(TokenEvent(...))` with a terminal kind."""
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "put" and node.args):
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Call)
+                and astutil.dotted_name(arg.func).split(".")[-1] == "TokenEvent"):
+            continue
+        kind: Optional[str] = None
+        if arg.args and isinstance(arg.args[0], ast.Constant):
+            kind = arg.args[0].value
+        for kw in arg.keywords:
+            if kw.arg == "kind" and isinstance(kw.value, ast.Constant):
+                kind = kw.value.value
+        if kind in ("done", "error"):
+            return True
+    return False
+
+
+def _drop_sites(fn, me: str, pending_attr: str, slots_attr: str):
+    """[(line, what)] for statements that drop a request reference."""
+    out = []
+    for node in ast.walk(fn):
+        # self._pending.popleft() / .pop() / .remove() / .clear()
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _REMOVE_CALLS
+                and isinstance(node.func.value, ast.Attribute)
+                and isinstance(node.func.value.value, ast.Name)
+                and node.func.value.value.id == me
+                and node.func.value.attr == pending_attr):
+            out.append((node.lineno, f"{pending_attr}.{node.func.attr}()"))
+        # rebind: self._pending = <...> (including tuple unpacking)
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for tt in ast.walk(t):
+                    if (isinstance(tt, ast.Attribute)
+                            and isinstance(tt.ctx, ast.Store)
+                            and isinstance(tt.value, ast.Name)
+                            and tt.value.id == me
+                            and tt.attr == pending_attr):
+                        out.append((node.lineno, f"{pending_attr} rebind"))
+            # slot deactivation: self.slots[i] = None
+            if (isinstance(node.value, ast.Constant)
+                    and node.value.value is None):
+                for t in node.targets:
+                    if (isinstance(t, ast.Subscript)
+                            and isinstance(t.value, ast.Attribute)
+                            and isinstance(t.value.value, ast.Name)
+                            and t.value.value.id == me
+                            and t.value.attr == slots_attr):
+                        out.append((node.lineno, f"{slots_attr}[...] = None"))
+    return out
+
+
+class TerminalEventPass(Pass):
+    id = "terminal-event"
+    description = (
+        "pending-queue removal / slot deactivation on a path that never "
+        "posts a terminal event (caller hangs forever)"
+    )
+
+    def __init__(self, targets=None):
+        self.targets = DEFAULT_TARGETS if targets is None else targets
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        for path, class_name, pending_attr, slots_attr in self.targets:
+            if not repo.exists(path):
+                continue
+            cls = repo.find_class(path, class_name)
+            if cls is None:
+                continue
+            methods = astutil.methods_of(cls)
+
+            # 1. Methods that post a terminal event, transitively through
+            #    intra-class calls.
+            posts = {m for m, fn in methods.items() if _terminal_put_in(fn)}
+            changed = True
+            while changed:
+                changed = False
+                for m, fn in methods.items():
+                    if m in posts:
+                        continue
+                    if astutil.self_calls(fn) & posts:
+                        posts.add(m)
+                        changed = True
+
+            # 2. Intra-class caller graph.
+            callers: dict[str, set[str]] = {m: set() for m in methods}
+            for m, fn in methods.items():
+                for callee in astutil.self_calls(fn):
+                    if callee in callers:
+                        callers[callee].add(m)
+
+            # 3. terminal-safe = posts, or all callers terminal-safe.
+            safe = set(posts)
+            changed = True
+            while changed:
+                changed = False
+                for m in methods:
+                    if m in safe:
+                        continue
+                    cs = callers[m]
+                    if cs and cs <= safe:
+                        safe.add(m)
+                        changed = True
+
+            construction = astutil.construction_methods(methods)
+            for mname, fn in methods.items():
+                me = astutil.self_name(fn)
+                if me is None or mname in construction:
+                    continue  # no consumer exists during construction
+                sites = _drop_sites(fn, me, pending_attr, slots_attr)
+                if not sites or mname in safe:
+                    continue
+                for line, what in sites:
+                    out.append(self.finding(
+                        path, line,
+                        f"{class_name}.{mname}() drops a request reference "
+                        f"({what}) but neither it nor all of its callers "
+                        f"post a terminal TokenEvent — the consumer blocks "
+                        f"on its stream forever (the PR 1/PR 4 hang class)",
+                    ))
+        return out
