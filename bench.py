@@ -573,6 +573,151 @@ def main() -> None:
             print(f"shed row failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
 
+    # Cluster scheduler row (ISSUE 6, docs/CLUSTER.md): sustained
+    # throughput + p99 TTFT at 4x single-engine saturation across 2 local
+    # replicas, prefix-affinity on vs off (hit_weight 0 = least-loaded), a
+    # span_transfer_ms microbench of the prefill→decode frame path, and
+    # disaggregated vs mixed-role TTFT for a warm prompt. Deadline-joined
+    # like the PR 4 rows: a wedged cluster fails the row, not the harness.
+    if os.environ.get("BENCH_CLUSTER", "1") != "0" and max_seq % 128 == 0:
+        creps = []
+        try:
+            from localai_tpu.cluster import (
+                ClusterClient,
+                LocalReplica,
+                build_local_replicas,
+            )
+
+            ccfg = EngineConfig(
+                max_slots=slots, max_seq=max_seq,
+                kv_pages=slots * (max_seq // 128), kv_page_size=128,
+                prefix_admit_async_compile=False,
+            )
+            N = 4 * slots  # 4x one engine's concurrent saturation
+            n_groups = 4   # repeated prompt groups — the affinity signal
+            # Affinity (and span export) needs the prompt to COVER at least
+            # one full KV page past the match cap — a prompt at or under the
+            # page size has no page-aligned prefix to share.
+            cl_prompt = min(max(prompt_len, 2 * 128 + 2),
+                            max_seq - gen_len - 8)
+            if cl_prompt <= 128:
+                raise RuntimeError(
+                    f"max_seq {max_seq} too small for a cluster-row prompt "
+                    f"covering one 128-row KV page")
+            # TWO engines total, shared across every sub-row (a full warmup
+            # per engine per row blew the bench wall); priming compiles the
+            # exact shapes the measurement uses — the concurrent pair covers
+            # the grouped-admission program, the repeat covers cached admit.
+            creps = build_local_replicas(
+                cfg, params, ByteTokenizer(cfg.vocab_size), n=2,
+                engine_cfg=ccfg, roles=["mixed", "mixed"])
+            for rep in creps:
+                pa, pb = [5] * cl_prompt, [6] * cl_prompt
+                pts = [threading.Thread(
+                    target=lambda ids=ids_: rep.engine.generate(
+                        ids, max_new_tokens=gen_len, ignore_eos=True))
+                    for ids_ in (pa, pb)]
+                for t in pts:
+                    t.start()
+                for t in pts:
+                    t.join(timeout=600)
+                rep.engine.generate(pa, max_new_tokens=4, ignore_eos=True)
+
+            def cluster_row(tag, hw, row_seed):
+                client = ClusterClient(creps, hit_weight=hw,
+                                       gauge_refresh_s=0.05)
+                cttfts: list[float] = []
+                cerrs: list[str] = []
+                clock = threading.Lock()
+
+                def cone(i: int) -> None:
+                    g = i % n_groups
+                    ids = [(row_seed + g * 131 + j * 7) % 255 + 1
+                           for j in range(cl_prompt)]
+                    try:
+                        _, ev = client.generate(ids, max_new_tokens=gen_len,
+                                                ignore_eos=True)
+                        with clock:
+                            cttfts.append(ev.timing_prompt_processing)
+                    except Exception as e:  # noqa: BLE001
+                        with clock:
+                            cerrs.append(f"req {i}: {type(e).__name__}: {e}")
+
+                cthreads = [threading.Thread(target=cone, args=(i,))
+                            for i in range(N)]
+                cw0 = time.time()
+                hits0 = sum(r.engine.m_prefix_hits for r in creps)
+                for t in cthreads:
+                    t.start()
+                deadline = time.time() + 600
+                for t in cthreads:
+                    t.join(timeout=max(1.0, deadline - time.time()))
+                if any(t.is_alive() for t in cthreads):
+                    raise RuntimeError(
+                        f"cluster row ({tag}): requests hung past deadline")
+                if cerrs:
+                    raise RuntimeError("; ".join(cerrs[:3]))
+                cwall = time.time() - cw0
+                cttfts.sort()
+                p99 = cttfts[min(len(cttfts) - 1, int(len(cttfts) * 0.99))]
+                hits = sum(r.engine.m_prefix_hits for r in creps) - hits0
+                out[f"cluster_tps_affinity_{tag}"] = round(
+                    N * gen_len / cwall, 1)
+                out[f"cluster_p99_ttft_ms_affinity_{tag}"] = round(
+                    p99 * 1000, 1)
+                out[f"cluster_prefix_hits_affinity_{tag}"] = hits
+                print(
+                    f"cluster({tag}): {N * gen_len / cwall:.1f} tok/s, "
+                    f"p99 TTFT {p99 * 1000:.1f} ms, {hits} prefix hits",
+                    file=sys.stderr,
+                )
+
+            # Distinct prompt sets per row so neither row inherits the
+            # other's cached spans.
+            cluster_row("off", 0.0, 17)
+            cluster_row("on", 4.0, 101)
+
+            # Disaggregated prefill→decode vs mixed-role TTFT + transfer
+            # time — same engines, rewrapped with dedicated roles.
+            droles = [LocalReplica(r.name, r.engine, role)
+                      for r, role in zip(creps, ["prefill", "decode"])]
+            dclient = ClusterClient(droles, gauge_refresh_s=0.05)
+            ids = [(j * 11) % 255 + 1 for j in range(cl_prompt)]
+            # Seed + time the raw span path once.
+            droles[0].engine.generate(ids, max_new_tokens=1, ignore_eos=True)
+            t0 = time.time()
+            frame = droles[0].engine.export_prefix_span(ids)
+            ok = (frame is not None
+                  and droles[1].engine.import_span_bytes(frame))
+            if ok:
+                out["span_transfer_ms"] = round((time.time() - t0) * 1000, 2)
+                out["span_frame_bytes"] = len(frame)
+            _, ev = dclient.generate(ids, max_new_tokens=8, ignore_eos=True)
+            out["disagg_ttft_ms"] = round(
+                ev.timing_prompt_processing * 1000, 1)
+            # Mixed-role baseline: the same prompt shape, cold prefix, full
+            # admission on one engine.
+            mixed_ids = [(j * 13) % 255 + 2 for j in range(len(ids))]
+            _, ev = creps[0].engine.generate(mixed_ids, max_new_tokens=8,
+                                             ignore_eos=True)
+            out["mixed_ttft_ms"] = round(
+                ev.timing_prompt_processing * 1000, 1)
+            print(
+                f"disagg TTFT {out.get('disagg_ttft_ms')} ms vs mixed "
+                f"{out.get('mixed_ttft_ms')} ms "
+                f"(span transfer {out.get('span_transfer_ms')} ms, "
+                f"frame {out.get('span_frame_bytes')} B)",
+                file=sys.stderr,
+            )
+        except Exception as e:  # noqa: BLE001 — extra row is best-effort
+            print(f"cluster row failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+        finally:
+            for rep in creps:
+                rep.engine.stop()
+                rep.engine.params = None
+                rep.engine.cache = None
+
     # Prompt/prefix-cache rows (VERDICT r4 item 3), dense and paged: a LONG
     # shared prefix (4000 tokens, dedicated 8k-seq engines) so the prefill
     # saving (~0.5 s at measured rates) dominates tunnel-RTT noise — at a

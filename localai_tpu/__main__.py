@@ -34,6 +34,13 @@ def _build_parser() -> argparse.ArgumentParser:
         cmd.add_argument("--process-id", type=int, default=None, help="LOCALAI_PROCESS_ID")
         cmd.add_argument("--federator", default=None, help="federation router URL to register with")
         cmd.add_argument("--worker-name", default=None, help="name announced to the federator")
+        # Cluster scheduling (ISSUE 6, docs/CLUSTER.md).
+        cmd.add_argument("--cluster-role", default=None,
+                         help="prefill|decode|mixed, or a comma list for "
+                              "in-process replicas (LOCALAI_CLUSTER_ROLE)")
+        cmd.add_argument("--cluster-replicas", type=int, default=None,
+                         help="fan each text model across N same-host engine "
+                              "replicas (LOCALAI_CLUSTER_REPLICAS)")
 
     run = sub.add_parser("run", help="start the API server (default)")
     add_run_flags(run)
@@ -45,7 +52,8 @@ def _build_parser() -> argparse.ArgumentParser:
     fed = sub.add_parser("federated", help="start the federation front door")
     fed.add_argument("--address", default="0.0.0.0")
     fed.add_argument("--port", type=int, default=9090)
-    fed.add_argument("--strategy", choices=("least-used", "random"), default="least-used")
+    fed.add_argument("--strategy", choices=("least-used", "random", "affinity"),
+                     default="least-used")
     fed.add_argument(
         "--workers", default="",
         help="comma-separated name=url pairs (more can register at runtime)",
@@ -199,6 +207,10 @@ def main(argv: list[str] | None = None) -> int:
         overrides["max_active_models"] = args.max_active_models
     if args.preload:
         overrides["preload_models"] = args.preload
+    if args.cluster_role:
+        overrides["cluster_role"] = args.cluster_role
+    if args.cluster_replicas:
+        overrides["cluster_replicas"] = args.cluster_replicas
     if args.debug:
         overrides["debug"] = True
 

@@ -1,0 +1,39 @@
+"""Cluster scheduler subsystem (ISSUE 6, docs/CLUSTER.md): prefix-affinity
+replica routing + prefill/decode disaggregation over the host-tier page
+substrate. The reference's federated mode picks workers randomly or by
+in-flight count (core/p2p/federated_server.go); here the span-based prefix
+cache makes per-replica hit probability computable, so the scheduler routes
+by expected-prefix-hit × inverse load and moves finished KV spans between
+role-typed replicas through the PR 3 host tier's byte-exact serialization.
+"""
+
+from localai_tpu.cluster.affinity import (
+    byte_span_hashes,
+    leading_overlap,
+    span_hashes,
+)
+from localai_tpu.cluster.replica import (
+    ClusterEngine,
+    LocalReplica,
+    build_local_replicas,
+    parse_roles,
+    scrape_engine_gauges,
+)
+from localai_tpu.cluster.scheduler import ClusterClient, ClusterScheduler
+from localai_tpu.cluster.transfer import SpanTransferError, decode_span, encode_span
+
+__all__ = [
+    "ClusterClient",
+    "ClusterEngine",
+    "ClusterScheduler",
+    "LocalReplica",
+    "SpanTransferError",
+    "build_local_replicas",
+    "byte_span_hashes",
+    "decode_span",
+    "encode_span",
+    "leading_overlap",
+    "parse_roles",
+    "scrape_engine_gauges",
+    "span_hashes",
+]

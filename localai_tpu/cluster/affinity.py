@@ -1,0 +1,79 @@
+"""Prefix-affinity span hashing for the cluster scheduler (ISSUE 6).
+
+The span-based prefix cache makes per-replica hit probability computable:
+an admission's reusable prefix is exactly its leading token spans at the
+cache's own boundaries (paged cache: matches round DOWN to kv_page_size —
+engine._prefix_find), so two prompts share cached work iff they share
+leading spans. We hash those spans with a CHAIN — span i's digest covers
+every token before it — so "replica holds the first k spans" is a single
+longest-common-prefix walk over two digest lists.
+
+Hashes must be stable across processes and Python hash seeds (the scheduler
+compares digests computed in different serving processes), so raw `hash()`
+is banned here: blake2b over the little-endian int32 token bytes only.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+# Digest width per span. 8 bytes keeps per-replica affinity tables small;
+# collisions only cost a mis-scored pick (the engine's real prefix match
+# decides reuse), never correctness.
+DIGEST_SIZE = 8
+
+
+def span_hashes(token_ids, span_tokens: int, max_spans: int = 8) -> list[bytes]:
+    """Chained digests of the prompt's leading FULL spans.
+
+    h_0 = H(span_0), h_i = H(h_{i-1} || span_i) — so h_i identifies the
+    whole prefix up to span boundary (i+1)*span_tokens, matching what the
+    prefix cache could actually serve. Partial trailing spans are never
+    hashed (the paged cache cannot map a partial page either).
+    """
+    if span_tokens <= 0 or max_spans <= 0:
+        return []
+    ids = np.asarray(list(token_ids), np.int32)
+    buf = ids.tobytes()  # little-endian int32 on every supported platform
+    n_spans = min(len(ids) // span_tokens, max_spans)
+    out: list[bytes] = []
+    prev = b""
+    step = span_tokens * 4
+    for i in range(n_spans):
+        h = hashlib.blake2b(prev + buf[i * step:(i + 1) * step],
+                            digest_size=DIGEST_SIZE)
+        prev = h.digest()
+        out.append(prev)
+    return out
+
+
+def byte_span_hashes(data: bytes, span_bytes: int = 256,
+                     max_spans: int = 8) -> list[bytes]:
+    """Chained digests over raw prompt BYTES — the federation front door has
+    no tokenizer, but identical request text tokenizes identically, so byte
+    spans are a sound (conservative) affinity proxy for routing."""
+    if span_bytes <= 0 or max_spans <= 0:
+        return []
+    n_spans = min(len(data) // span_bytes, max_spans)
+    out: list[bytes] = []
+    prev = b""
+    for i in range(n_spans):
+        h = hashlib.blake2b(prev + data[i * span_bytes:(i + 1) * span_bytes],
+                            digest_size=DIGEST_SIZE)
+        prev = h.digest()
+        out.append(prev)
+    return out
+
+
+def leading_overlap(held, hashes) -> int:
+    """How many LEADING spans of `hashes` appear in `held` (a set/dict of
+    digests). Chained digests make membership of span i imply the whole
+    prefix matched, so the walk stops at the first miss."""
+    n = 0
+    for h in hashes:
+        if h not in held:
+            break
+        n += 1
+    return n

@@ -1,0 +1,195 @@
+"""Cluster replicas: role declaration, local replica fan-out, gauge pulls.
+
+A replica is one serving engine with a declared role:
+
+  prefill — takes prompt admissions, exports finished KV spans
+  decode  — imports spans, runs the decode steady state
+  mixed   — both (the default; a 1-replica cluster is just an engine)
+
+Roles come from YAML/ApplicationConfig (`cluster_role`) or the
+LOCALAI_CLUSTER_ROLE env mirror; a comma list ("prefill,decode,decode")
+assigns per-replica roles for in-process fan-out (`cluster_replicas`).
+
+`LocalReplica` wraps an in-process Engine; remote replicas are reached
+through the federation proxy (which schedules with the same
+ClusterScheduler over byte-span hashes) and their load is read with
+`scrape_engine_gauges` from the existing /metrics surface — the wire
+format in cluster.transfer is what makes the prefill→decode hop itself a
+config change rather than a rewrite.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import urllib.request
+from typing import Optional
+
+log = logging.getLogger("localai_tpu.cluster")
+
+
+def parse_roles(n: int, spec: str = "") -> list[str]:
+    """Role list for n replicas from a spec: "" / "mixed" → all mixed;
+    "prefill"/"decode" → every replica that role; "a,b,c" → positional
+    (short lists pad with "mixed", long lists truncate)."""
+    from localai_tpu.cluster.scheduler import ROLES
+
+    spec = (spec or os.environ.get("LOCALAI_CLUSTER_ROLE", "") or "mixed")
+    parts = [p.strip() for p in spec.split(",") if p.strip()]
+    for p in parts:
+        if p not in ROLES:
+            raise ValueError(f"cluster role {p!r} not in {ROLES}")
+    if len(parts) == 1:
+        return [parts[0]] * n
+    return (parts + ["mixed"] * n)[:n]
+
+
+class LocalReplica:
+    """One in-process engine replica (same host, own KV pool + loop)."""
+
+    def __init__(self, name: str, engine, role: str = "mixed"):
+        self.name = name
+        self.engine = engine
+        self.role = role
+
+    def span_tokens(self) -> int:
+        """The affinity span width — the prefix cache's own boundary
+        (paged: the page size; dense: the minimum prefill bucket)."""
+        ecfg = self.engine.ecfg
+        return ecfg.kv_page_size if ecfg.kv_pages else ecfg.min_prefill_bucket
+
+    def gauges(self) -> dict:
+        """Scheduler load inputs — Engine.metrics() already carries the
+        PR 4 gauges (queue_depth, admit_wait_ms, queue_shed, loop_dead)."""
+        return self.engine.metrics()
+
+    def stop(self) -> None:
+        self.engine.stop()
+
+
+def build_local_replicas(cfg, params, tokenizer, n: int, engine_cfg,
+                         roles: Optional[list[str]] = None,
+                         name_prefix: str = "r", **engine_kw):
+    """N same-host engine replicas SHARING one weight tree (each gets its
+    own KV pool, loop thread, and prefix cache — HBM cost is KV only)."""
+    from localai_tpu.engine.engine import Engine
+
+    roles = roles or parse_roles(n)
+    out = []
+    for i in range(n):
+        eng = Engine(cfg, params, tokenizer, engine_cfg=engine_cfg,
+                     **engine_kw)
+        eng.start()
+        out.append(LocalReplica(f"{name_prefix}{i}", eng, role=roles[i]))
+    return out
+
+
+def scrape_engine_gauges(base_url: str, model: str = "",
+                         timeout: float = 3.0) -> dict:
+    """Pull localai_engine_* gauges for one model from a worker's /metrics
+    (the PR 3 scrape surface) into a plain {gauge: value} dict — the remote
+    analogue of LocalReplica.gauges(). Raises on an unreachable worker so
+    the scheduler treats it as dead."""
+    out: dict[str, float] = {}
+    with urllib.request.urlopen(base_url.rstrip("/") + "/metrics",
+                                timeout=timeout) as resp:
+        for raw in resp.read().decode("utf-8", "replace").splitlines():
+            line = raw.strip()
+            if not line.startswith("localai_engine_"):
+                continue
+            head, _, val = line.rpartition(" ")
+            name, _, labels = head.partition("{")
+            if model and f'model="{model}"' not in labels:
+                continue
+            try:
+                out[name[len("localai_engine_"):]] = float(val)
+            except ValueError:
+                continue
+    return out
+
+
+class ClusterEngine:
+    """Engine-shaped facade over N local replicas + the cluster scheduler.
+
+    The server wiring point: when ApplicationConfig.cluster_replicas >= 2,
+    the model manager hands the API layer one of these instead of a bare
+    Engine — submit/generate/metrics/cancel_all/stop keep their shapes, so
+    every endpoint (chat, completions, SSE streaming, /metrics gauges)
+    schedules through the cluster without knowing it exists.
+    """
+
+    def __init__(self, replicas, transfer_max_bytes=None,
+                 affinity_spans: int = 8, gauge_refresh_s: float = 0.5,
+                 hit_weight: float = 4.0):
+        from localai_tpu.cluster import transfer
+        from localai_tpu.cluster.scheduler import ClusterClient
+
+        self.replicas = list(replicas)
+        self.client = ClusterClient(
+            self.replicas,
+            transfer_max_bytes=(transfer.DEFAULT_MAX_BYTES
+                                if transfer_max_bytes is None
+                                else transfer_max_bytes),
+            affinity_spans=affinity_spans,
+            gauge_refresh_s=gauge_refresh_s, hit_weight=hit_weight)
+        self.tokenizer = self.replicas[0].engine.tokenizer
+        self.ecfg = self.replicas[0].engine.ecfg
+        # Teardown parity with Engine (the manager Nones these to drop HBM).
+        self.params = None
+        self.cache = None
+
+    # -------- request path -------- #
+
+    def submit(self, request):
+        return self.client.submit(request)
+
+    def generate(self, prompt_ids, **kw):
+        return self.client.generate(prompt_ids, **kw)
+
+    def embed(self, ids_batch):
+        for rep in self.replicas:
+            if not rep.engine.is_dead:
+                return rep.engine.embed(ids_batch)
+        raise RuntimeError("every cluster replica is dead")
+
+    # -------- lifecycle -------- #
+
+    def start(self) -> None:
+        for rep in self.replicas:
+            rep.engine.start()
+
+    def stop(self) -> None:
+        for rep in self.replicas:
+            rep.engine.stop()
+            rep.engine.params = None
+            rep.engine.cache = None
+
+    def cancel_all(self) -> int:
+        n = self.client.cancel_all()
+        for rep in self.replicas:
+            n += rep.engine.cancel_all()
+        return n
+
+    def warmup(self, *args, **kw) -> None:
+        for rep in self.replicas:
+            rep.engine.warmup(*args, **kw)
+
+    @property
+    def is_dead(self) -> bool:
+        """Crash-only contract at cluster granularity: the cluster is dead
+        only when EVERY replica's loop died — one dead replica reroutes."""
+        return all(rep.engine.is_dead for rep in self.replicas)
+
+    def metrics(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for rep in self.replicas:
+            for k, v in rep.engine.metrics().items():
+                if k == "loop_dead":
+                    continue  # summed deaths would read as a dead cluster
+                out[k] = out.get(k, 0.0) + float(v)
+        out["loop_dead"] = 1.0 if self.is_dead else 0.0
+        out["cluster_replicas"] = float(len(self.replicas))
+        out["cluster_replicas_dead"] = float(
+            sum(1 for rep in self.replicas if rep.engine.is_dead))
+        out.update(self.client.metrics())
+        return out

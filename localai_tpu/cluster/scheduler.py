@@ -1,0 +1,514 @@
+"""Cluster scheduler: prefix-affinity dispatch over N engine replicas.
+
+The tentpole of ISSUE 6. Two layers:
+
+- `ClusterScheduler` — the transport-agnostic core. Replicas register with
+  a name, a role (prefill|decode|mixed), and a gauge callable; the
+  scheduler keeps a per-replica LRU of recently-admitted span digests
+  (localai_tpu.cluster.affinity) and scores candidates by expected prefix
+  hit × inverse load. Load comes from the PR 4 engine gauges — queue_depth,
+  active_slots, admit_wait_ms EWMA, queue_shed, loop_dead — pulled at most
+  every gauge_refresh_s. A replica whose gauges report loop_dead (or whose
+  gauge source fails: a crashed process scrapes like a dead loop) is marked
+  dead and its affinity entries are CLEARED, so stale span digests stop
+  attracting traffic within one gauge refresh; the crash-only manager's
+  restart shows up as the gauges recovering.
+
+- `ClusterClient` — the dispatch engine over in-process replicas
+  (cluster.replica.LocalReplica). submit() returns a RequestHandle exactly
+  like Engine.submit; a pump thread relays events, reroutes on replica
+  death (resubmitting prompt + already-emitted tokens to a survivor, the
+  same continuation shape as the PR 3 recompute resume), and runs the
+  disaggregated prefill→decode handoff: prefill-role replica admits the
+  prompt (1-token probe — admission itself saves the span), exports the
+  span through cluster.transfer, the decode-role replica imports it into
+  its host tier, and the full request admits there as a prefix hit. Any
+  handoff failure (injected span_transfer fault, frame cap, geometry
+  mismatch) falls back to recompute on the decode replica — latency, not
+  correctness.
+
+Failure semantics (the PR 4 invariant extends to the cluster layer): every
+submitted request posts EXACTLY ONE terminal event on every path — replica
+death, reroute exhaustion, injected cluster_dispatch fault, cancellation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+from typing import TYPE_CHECKING
+
+from localai_tpu.cluster import affinity, transfer
+from localai_tpu.testing import faults
+
+if TYPE_CHECKING:  # engine pulls jax — runtime imports stay lazy
+    from localai_tpu.engine.engine import (  # noqa: F401
+        GenRequest,
+        RequestHandle,
+        TokenEvent,
+    )
+
+log = logging.getLogger("localai_tpu.cluster")
+
+
+def _engine_types():
+    """Lazy engine import: the federation front door builds a scheduler
+    without ever paying the jax import (cluster/affinity + this module stay
+    numpy-only until a ClusterClient actually dispatches)."""
+    from localai_tpu.engine.engine import GenRequest, RequestHandle, TokenEvent
+
+    return GenRequest, RequestHandle, TokenEvent
+
+ROLES = ("prefill", "decode", "mixed")
+
+# Load normalization: 100 ms of observed admission wait weighs like one
+# queued request. The scheduler only needs ORDER to be sane, not calibration.
+_ADMIT_WAIT_MS_PER_UNIT = 100.0
+
+
+@dataclasses.dataclass
+class _Replica:
+    """Scheduler-internal replica record. Mutated only under the
+    scheduler's lock (gauge callables run outside it)."""
+
+    name: str
+    target: Any
+    role: str
+    gauge_fn: Optional[Callable[[], dict]]
+    alive: bool = True
+    load: float = 0.0
+    last_shed: float = 0.0
+    gauges: dict = dataclasses.field(default_factory=dict)
+    affinity: "OrderedDict[bytes, float]" = dataclasses.field(
+        default_factory=OrderedDict)
+
+
+class ClusterScheduler:
+    def __init__(self, span_tokens: int = 128, affinity_spans: int = 8,
+                 affinity_capacity: int = 4096, gauge_refresh_s: float = 0.5,
+                 hit_weight: float = 4.0):
+        self.span_tokens = span_tokens
+        self.affinity_spans = affinity_spans
+        self.affinity_capacity = affinity_capacity
+        self.gauge_refresh_s = gauge_refresh_s
+        # hit_weight scales how much an expected prefix hit outbids load
+        # imbalance; 0 degrades to pure least-loaded (affinity off).
+        self.hit_weight = hit_weight
+        self._lock = threading.Lock()
+        self._replicas: dict[str, _Replica] = {}
+        self._last_refresh = 0.0
+
+    # ---------------- membership ---------------- #
+
+    def add_replica(self, name: str, target: Any = None, role: str = "mixed",
+                    gauge_fn: Optional[Callable[[], dict]] = None) -> None:
+        if role not in ROLES:
+            raise ValueError(f"replica role {role!r} not in {ROLES}")
+        with self._lock:
+            self._replicas[name] = _Replica(
+                name=name, target=target, role=role, gauge_fn=gauge_fn)
+
+    def remove_replica(self, name: str) -> None:
+        with self._lock:
+            self._replicas.pop(name, None)
+
+    def set_role(self, name: str, role: str) -> None:
+        """Update a live replica's role in place (federation workers learn
+        their role from health probes AFTER registration) — re-adding would
+        throw away the affinity map."""
+        if role not in ROLES:
+            raise ValueError(f"cluster role {role!r} not in {ROLES}")
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is not None:
+                rep.role = role
+
+    def target(self, name: str) -> Any:
+        with self._lock:
+            rep = self._replicas.get(name)
+            return rep.target if rep is not None else None
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    # ---------------- affinity ---------------- #
+
+    def hashes_for(self, prompt_ids) -> list[bytes]:
+        return affinity.span_hashes(
+            prompt_ids, self.span_tokens, self.affinity_spans)
+
+    def record(self, name: str, hashes) -> None:
+        """Note that `name` just admitted a prompt with these span digests
+        (its prefix cache likely holds the spans now)."""
+        now = time.monotonic()
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None or not rep.alive:
+                return
+            for h in hashes:
+                rep.affinity[h] = now
+                rep.affinity.move_to_end(h)
+            while len(rep.affinity) > self.affinity_capacity:
+                rep.affinity.popitem(last=False)
+
+    def note_dead(self, name: str) -> None:
+        """Out-of-band death report (a dispatch observed the engine die) —
+        takes effect immediately instead of waiting for a gauge refresh."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is not None:
+                self._mark_dead_locked(rep)
+
+    def _mark_dead_locked(self, rep: _Replica) -> None:
+        if rep.alive:
+            log.warning("cluster replica %s marked dead — draining affinity",
+                        rep.name)
+        rep.alive = False
+        # Dead replicas must stop attracting traffic: their cached spans
+        # died with the engine state (crash-only release drops the pool and
+        # host tier), so the digests are stale advertisements.
+        rep.affinity.clear()
+
+    # ---------------- gauges / load ---------------- #
+
+    def refresh(self, force: bool = False) -> None:
+        """Pull every replica's gauges at most once per gauge_refresh_s.
+        Gauge callables run OUTSIDE the lock (they may scrape /metrics)."""
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._last_refresh < self.gauge_refresh_s:
+                return
+            self._last_refresh = now
+            reps = list(self._replicas.values())
+        for rep in reps:
+            if rep.gauge_fn is None:
+                continue
+            try:
+                gauges = dict(rep.gauge_fn() or {})
+                dead = bool(gauges.get("loop_dead", 0.0))
+            except Exception as e:  # noqa: BLE001 — unreachable == dead
+                gauges, dead = {}, True
+                log.debug("gauge source for %s failed: %s", rep.name, e)
+            with self._lock:
+                if self._replicas.get(rep.name) is not rep:
+                    continue  # removed/replaced during the pull
+                rep.gauges = gauges
+                shed = float(gauges.get("queue_shed", 0.0))
+                shed_penalty = 1.0 if shed > rep.last_shed else 0.0
+                rep.last_shed = shed
+                rep.load = (
+                    float(gauges.get("queue_depth", 0.0))
+                    + float(gauges.get("active_slots", 0.0))
+                    + float(gauges.get("admit_wait_ms", 0.0))
+                    / _ADMIT_WAIT_MS_PER_UNIT
+                    + shed_penalty
+                )
+                if dead:
+                    self._mark_dead_locked(rep)
+                else:
+                    rep.alive = True
+
+    # ---------------- the pick ---------------- #
+
+    def pick(self, hashes, role: Optional[str] = None,
+             exclude: tuple = ()) -> Optional[str]:
+        """Choose a replica: expected-prefix-hit × inverse load. Role-typed
+        picks prefer matching+mixed replicas but fall back to any live one
+        (a degraded fleet serves mixed rather than 503ing). Returns the
+        replica name, or None when every replica is dead/excluded."""
+        self.refresh()
+        with self._lock:
+            live = [r for r in self._replicas.values()
+                    if r.alive and r.name not in exclude]
+            if role is not None:
+                typed = [r for r in live if r.role in (role, "mixed")]
+                live = typed or live
+            if not live:
+                return None
+
+            def score(rep: _Replica) -> float:
+                hit = (affinity.leading_overlap(rep.affinity, hashes)
+                       / len(hashes)) if hashes else 0.0
+                return (1.0 + self.hit_weight * hit) / (1.0 + rep.load)
+
+            best = max(live, key=lambda r: (score(r), -r.load, r.name))
+            # In-flight bump: several picks inside one gauge window must
+            # spread instead of all landing on the same momentarily-idle
+            # replica.
+            best.load += 1.0
+            return best.name
+
+    def snapshot(self) -> list[dict]:
+        """Monitoring view (the /cluster/status surface and tests)."""
+        with self._lock:
+            return [
+                {
+                    "name": r.name, "role": r.role, "alive": r.alive,
+                    "load": round(r.load, 3),
+                    "affinity_spans_held": len(r.affinity),
+                }
+                for r in sorted(self._replicas.values(), key=lambda r: r.name)
+            ]
+
+
+class ClusterClient:
+    """Request dispatch over in-process replicas with reroute + handoff.
+
+    The terminal-event contract: `_pending` holds every in-flight dispatch
+    record; the ONLY paths that remove an entry are `_finish` and `_abort`,
+    both of which post a terminal TokenEvent to the caller's handle (the
+    terminal-event lint pass enforces this shape on the class).
+    """
+
+    def __init__(self, replicas, scheduler: Optional[ClusterScheduler] = None,
+                 transfer_max_bytes: int = transfer.DEFAULT_MAX_BYTES,
+                 affinity_spans: int = 8, gauge_refresh_s: float = 0.5,
+                 hit_weight: float = 4.0, disaggregate: Optional[bool] = None):
+        if not replicas:
+            raise ValueError("a cluster needs at least one replica")
+        self.replicas = list(replicas)
+        if scheduler is None:
+            scheduler = ClusterScheduler(
+                span_tokens=self.replicas[0].span_tokens(),
+                affinity_spans=affinity_spans,
+                gauge_refresh_s=gauge_refresh_s, hit_weight=hit_weight)
+        self.scheduler = scheduler
+        for rep in self.replicas:
+            scheduler.add_replica(rep.name, target=rep, role=rep.role,
+                                  gauge_fn=rep.gauges)
+        self.transfer_max_bytes = transfer_max_bytes
+        roles = {r.role for r in self.replicas}
+        self.disaggregate = (("prefill" in roles and
+                              ("decode" in roles or "mixed" in roles))
+                             if disaggregate is None else disaggregate)
+        self._lock = threading.Lock()
+        self._pending: dict[int, dict] = {}
+        self._rid = 0
+        self.slots: list = []  # no slot table at this layer (lint target shape)
+        self.m_dispatches = 0
+        self.m_reroutes = 0
+        self.m_handoffs = 0
+        self.m_handoff_fallbacks = 0
+
+    # ---------------- public surface (Engine-shaped) ---------------- #
+
+    def submit(self, request: "GenRequest") -> "RequestHandle":
+        _, RequestHandle, _ = _engine_types()
+        caller = RequestHandle()
+        caller.t_submit = time.monotonic()
+        with self._lock:
+            self._rid += 1
+            rid = self._rid
+            self._pending[rid] = {
+                "request": request, "caller": caller,
+                "emitted_ids": [], "attempted": set(),
+            }
+        threading.Thread(target=self._run, args=(rid,), daemon=True,
+                         name=f"cluster-pump-{rid}").start()
+        return caller
+
+    def generate(self, prompt_ids, **kw):
+        GenRequest, _, _ = _engine_types()
+        return self.submit(
+            GenRequest(prompt_ids=list(prompt_ids), **kw)).result()
+
+    def metrics(self) -> dict[str, float]:
+        return {
+            "cluster_dispatches": float(self.m_dispatches),
+            "cluster_reroutes": float(self.m_reroutes),
+            "cluster_handoffs": float(self.m_handoffs),
+            "cluster_handoff_fallbacks": float(self.m_handoff_fallbacks),
+        }
+
+    def cancel_all(self) -> int:
+        with self._lock:
+            recs = list(self._pending.values())
+        for rec in recs:
+            rec["caller"].cancel()
+        return len(recs)
+
+    # ---------------- terminal bookkeeping ---------------- #
+
+    def _finish(self, rid: int, ev: "Optional[TokenEvent]") -> None:
+        """Post the caller's terminal event and retire the record — the one
+        sanctioned removal path (with _abort) from _pending."""
+        _, _, TokenEvent = _engine_types()
+        with self._lock:
+            rec = self._pending.pop(rid, None)
+        if rec is None:
+            return
+        if ev is None:
+            rec["caller"]._q.put(TokenEvent(
+                kind="error",
+                error="no live cluster replica could serve the request"))
+        else:
+            rec["caller"]._q.put(ev)
+
+    def _abort(self, rid: int, msg: str) -> None:
+        _, _, TokenEvent = _engine_types()
+        with self._lock:
+            rec = self._pending.pop(rid, None)
+        if rec is not None:
+            rec["caller"]._q.put(TokenEvent(kind="error", error=msg))
+
+    # ---------------- dispatch pump ---------------- #
+
+    def _run(self, rid: int) -> None:
+        try:
+            self._run_inner(rid)
+        except Exception as e:  # noqa: BLE001 — the caller must unblock
+            log.exception("cluster dispatch %d failed", rid)
+            self._abort(rid, f"cluster dispatch failed: "
+                             f"{type(e).__name__}: {e}")
+
+    def _run_inner(self, rid: int) -> None:
+        faults.fire("cluster_dispatch")  # injected dispatch failure (ISSUE 6)
+        _, _, TokenEvent = _engine_types()
+        with self._lock:
+            rec = self._pending.get(rid)
+        if rec is None:
+            return
+        request: "GenRequest" = rec["request"]
+        hashes = self.scheduler.hashes_for(request.prompt_ids)
+        self.m_dispatches += 1
+
+        role = None
+        if self.disaggregate and self._handoff_eligible(request):
+            role = "decode"
+        while True:
+            name = self.scheduler.pick(hashes, role=role,
+                                       exclude=tuple(rec["attempted"]))
+            if name is None:
+                self._finish(rid, None)
+                return
+            rep = self.scheduler.target(name)
+            if rep is None:
+                rec["attempted"].add(name)
+                continue
+            if role == "decode":
+                # Prefill→decode handoff: best-effort — any failure means
+                # the decode replica recomputes the prefix itself.
+                self._try_handoff(request, hashes, decode_rep=rep)
+            emitted = len(rec["emitted_ids"])
+            cur = request if emitted == 0 else dataclasses.replace(
+                request,
+                prompt_ids=list(request.prompt_ids) + rec["emitted_ids"],
+                max_new_tokens=request.max_new_tokens - emitted,
+            )
+            try:
+                handle = rep.engine.submit(cur)
+            except Exception as e:  # noqa: BLE001 — try the next replica
+                log.warning("replica %s refused dispatch %d: %s",
+                            name, rid, e)
+                rec["attempted"].add(name)
+                continue
+            self.scheduler.record(name, hashes)
+            if self._pump(rid, rec, rep, handle, emitted_before=emitted):
+                return
+            # The replica died mid-stream: reroute the continuation.
+            self.scheduler.note_dead(name)
+            rec["attempted"].add(name)
+            if request.grammar is not None:
+                # A grammar machine advanced on the dead replica cannot be
+                # replayed here — fail cleanly rather than emit invalid
+                # continuations.
+                self._abort(rid, "replica died mid-stream; grammar state "
+                                 "is not reroutable")
+                return
+            if len(rec["emitted_ids"]) >= request.max_new_tokens:
+                self._finish(rid, TokenEvent(
+                    kind="done", finish_reason="length",
+                    prompt_tokens=len(request.prompt_ids),
+                    completion_tokens=len(rec["emitted_ids"])))
+                return
+            self.m_reroutes += 1
+            log.warning("replica %s died mid-stream — rerouting request %d "
+                        "(%d tokens emitted)", name, rid,
+                        len(rec["emitted_ids"]))
+
+    def _pump(self, rid: int, rec: dict, rep, handle,
+              emitted_before: int) -> bool:
+        """Relay one replica leg's events to the caller. Returns True when
+        the request reached its terminal event (forwarded), False when the
+        replica died and the request should reroute."""
+        caller: "RequestHandle" = rec["caller"]
+        while True:
+            try:
+                ev: "TokenEvent" = handle._q.get(timeout=0.1)
+            except queue.Empty:
+                if caller.cancelled.is_set():
+                    handle.cancel()  # replica posts the terminal event
+                continue
+            if ev.kind == "token":
+                rec["emitted_ids"].append(ev.token_id)
+                caller._q.put(ev)
+                if caller.cancelled.is_set():
+                    handle.cancel()
+                continue
+            if ev.kind == "done":
+                if emitted_before:
+                    ev = dataclasses.replace(
+                        ev,
+                        completion_tokens=ev.completion_tokens
+                        + emitted_before,
+                        prompt_tokens=len(rec["request"].prompt_ids),
+                    )
+                self._finish(rid, ev)
+                return True
+            # error: replica death is reroutable, anything else terminal.
+            if rep.engine.is_dead and not caller.cancelled.is_set():
+                return False
+            self._finish(rid, ev)
+            return True
+
+    # ---------------- disaggregation ---------------- #
+
+    def _handoff_eligible(self, request: "GenRequest") -> bool:
+        """Prefill→decode handoff only pays off when a span can actually be
+        exported: plain text requests whose prompt covers ≥ 1 cache span.
+        Grammar state machines and image embeddings stay single-replica."""
+        return (request.grammar is None and request.image_embeds is None
+                and request.mrope_positions is None
+                and request.resume is None
+                and len(request.prompt_ids) > self.scheduler.span_tokens)
+
+    def _try_handoff(self, request: "GenRequest", hashes, decode_rep) -> None:
+        """Run the prompt on a prefill-role replica, move its KV span into
+        the decode replica's host tier. Every failure path is silent
+        fallback: the decode replica simply recomputes."""
+        try:
+            name = self.scheduler.pick(hashes, role="prefill",
+                                       exclude=(decode_rep.name,))
+            pre = self.scheduler.target(name) if name is not None else None
+            if pre is None or pre is decode_rep or pre.role != "prefill":
+                return  # no dedicated prefill capacity — nothing to hand off
+            probe = dataclasses.replace(
+                request, max_new_tokens=1, stop=[], grammar=None,
+                logprobs=0, ignore_eos=True)
+            t0 = time.monotonic()
+            pre.engine.submit(probe).result()  # admission saved the span
+            self.scheduler.record(name, hashes)
+            frame = pre.engine.export_prefix_span(
+                request.prompt_ids, max_bytes=self.transfer_max_bytes)
+            if frame is None:
+                raise transfer.SpanTransferError(
+                    "prefill replica stored no exportable span")
+            if not decode_rep.engine.import_span_bytes(
+                    frame, max_bytes=self.transfer_max_bytes):
+                raise transfer.SpanTransferError(
+                    "decode replica rejected the span frame")
+            self.m_handoffs += 1
+            log.debug("handed off %d-token span %s→%s in %.1f ms",
+                      len(request.prompt_ids), name, decode_rep.name,
+                      (time.monotonic() - t0) * 1000)
+        except Exception as e:  # noqa: BLE001 — fallback is recompute
+            self.m_handoff_fallbacks += 1
+            log.info("span handoff fell back to recompute: %s: %s",
+                     type(e).__name__, e)

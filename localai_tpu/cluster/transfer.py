@@ -1,0 +1,133 @@
+"""Framed KV-span transport for prefill→decode disaggregation (ISSUE 6).
+
+The PR 3 host tier already serializes KV pages byte-exactly (the swap images
+restore a preempted slot bit-for-bit), so a finished prompt's span is just
+two numpy arrays + its token key. This module wraps that in a VERSIONED
+frame so a prefill-role replica can export the span and a decode-role
+replica can import it straight into its host tier — single-host today
+(in-process / localhost HTTP POST of the frame bytes), and a network hop is
+a config change, not a rewrite: the frame is self-describing (header JSON
+carries shapes, dtype, and the geometry the importer must match) and the
+version field gates any future layout change.
+
+Frame v1 layout (all integers little-endian):
+
+    MAGIC   5 bytes   b"LAIKV"
+    version u16       1
+    hdr_len u32       JSON header byte length
+    header  hdr_len   {"key": [...], "valid": n, "geom": {...},
+                       "k_shape": [...], "v_shape": [...], "dtype": "...",
+                       "k_bytes": n, "v_bytes": n}
+    k       k_bytes   raw hk array bytes (C order)
+    v       v_bytes   raw hv array bytes (C order)
+
+The importer REJECTS (typed SpanTransferError) on magic/version mismatch,
+truncation, geometry mismatch, or a frame larger than transfer_max_bytes —
+a rejected transfer degrades to recompute-on-decode-replica, never to
+corrupt KV.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+from localai_tpu.testing import faults
+
+MAGIC = b"LAIKV"
+VERSION = 1
+_HEAD = struct.Struct("<5sHI")  # magic, version, header length
+
+# Default frame cap; ApplicationConfig.transfer_max_bytes overrides.
+DEFAULT_MAX_BYTES = 64 << 20
+
+
+class SpanTransferError(RuntimeError):
+    """Typed transfer failure: malformed/oversized/incompatible frame. The
+    caller's contract is fall-back-to-recompute, never propagate-to-user."""
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # fp8 KV storage dtypes live in ml_dtypes (shipped with jax).
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_span(key, valid: int, hk: np.ndarray, hv: np.ndarray,
+                geom: dict, max_bytes: int = DEFAULT_MAX_BYTES) -> bytes:
+    """Frame one exported span. `geom` is the exporter's cache geometry
+    (engine._span_geometry()); the importer must match it exactly."""
+    faults.fire("span_transfer")  # injected transfer failure (ISSUE 6)
+    kb = np.ascontiguousarray(hk)
+    vb = np.ascontiguousarray(hv)
+    if str(kb.dtype) != str(vb.dtype):
+        raise SpanTransferError(
+            f"k/v dtype mismatch: {kb.dtype} vs {vb.dtype}")
+    header = json.dumps({
+        "key": [int(t) for t in key],
+        "valid": int(valid),
+        "geom": geom,
+        "k_shape": list(kb.shape),
+        "v_shape": list(vb.shape),
+        "dtype": str(kb.dtype),
+        "k_bytes": int(kb.nbytes),
+        "v_bytes": int(vb.nbytes),
+    }).encode()
+    total = _HEAD.size + len(header) + kb.nbytes + vb.nbytes
+    if max_bytes > 0 and total > max_bytes:
+        raise SpanTransferError(
+            f"span frame is {total} bytes, cap is {max_bytes} "
+            f"(transfer_max_bytes)")
+    return b"".join((
+        _HEAD.pack(MAGIC, VERSION, len(header)),
+        header, kb.tobytes(), vb.tobytes(),
+    ))
+
+
+def decode_span(frame: bytes, geom: dict,
+                max_bytes: int = DEFAULT_MAX_BYTES):
+    """Parse + validate a frame against the importer's cache geometry.
+    Returns (key int32[n], valid, hk, hv). Raises SpanTransferError on any
+    mismatch — a frame from an incompatible engine must never land."""
+    faults.fire("span_transfer")  # injected transfer failure (ISSUE 6)
+    if max_bytes > 0 and len(frame) > max_bytes:
+        raise SpanTransferError(
+            f"frame is {len(frame)} bytes, cap is {max_bytes}")
+    if len(frame) < _HEAD.size:
+        raise SpanTransferError("truncated frame (no header)")
+    magic, version, hdr_len = _HEAD.unpack_from(frame)
+    if magic != MAGIC:
+        raise SpanTransferError(f"bad magic {magic!r}")
+    if version != VERSION:
+        raise SpanTransferError(
+            f"wire version {version} != {VERSION} — refusing to guess")
+    off = _HEAD.size
+    try:
+        header = json.loads(frame[off:off + hdr_len])
+    except (ValueError, UnicodeDecodeError) as e:
+        raise SpanTransferError(f"unparseable header: {e}") from None
+    off += hdr_len
+    if header.get("geom") != geom:
+        raise SpanTransferError(
+            f"cache geometry mismatch: frame {header.get('geom')} vs "
+            f"local {geom}")
+    kb, vb = int(header["k_bytes"]), int(header["v_bytes"])
+    if len(frame) != off + kb + vb:
+        raise SpanTransferError(
+            f"frame length {len(frame)} != header-declared {off + kb + vb}")
+    dt = _np_dtype(header["dtype"])
+    hk = np.frombuffer(frame, dtype=dt, count=kb // dt.itemsize,
+                       offset=off).reshape(header["k_shape"]).copy()
+    hv = np.frombuffer(frame, dtype=dt, count=vb // dt.itemsize,
+                       offset=off + kb).reshape(header["v_shape"]).copy()
+    key = np.asarray(header["key"], np.int32)
+    valid = int(header["valid"])
+    if valid > len(key):
+        raise SpanTransferError(f"valid {valid} exceeds key len {len(key)}")
+    return key, valid, hk, hv

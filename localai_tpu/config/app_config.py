@@ -57,6 +57,21 @@ class ApplicationConfig:
     # --galleries flag / GALLERIES env, JSON-encoded).
     galleries: list[dict] = dataclasses.field(default_factory=list)
 
+    # Cluster scheduling (ISSUE 6, docs/CLUSTER.md). cluster_role declares
+    # this process's place in a disaggregated fleet (prefill|decode|mixed;
+    # a comma list assigns per-replica roles for in-process fan-out) — it
+    # rides every HTTP response as LocalAI-Cluster-Role so the federation
+    # front door's affinity scheduler can role-type its picks.
+    # cluster_replicas >= 2 fans each text model across that many same-host
+    # engine replicas (shared weights, per-replica KV pools) behind the
+    # prefix-affinity scheduler. affinity_spans bounds how many leading
+    # prompt spans are hashed per request; transfer_max_bytes caps one
+    # prefill→decode KV span frame.
+    cluster_role: str = "mixed"
+    cluster_replicas: int = 0
+    affinity_spans: int = 8
+    transfer_max_bytes: int = 64 << 20
+
     cors: bool = True
     metrics: bool = True
     debug: bool = False
@@ -122,6 +137,10 @@ class ApplicationConfig:
             restart_window_s=_env("LOCALAI_RESTART_WINDOW", cls.restart_window_s, float),
             quarantine_s=_env("LOCALAI_QUARANTINE", cls.quarantine_s, float),
             default_context_size=_env("LOCALAI_CONTEXT_SIZE", cls.default_context_size, int),
+            cluster_role=_env("LOCALAI_CLUSTER_ROLE", cls.cluster_role),
+            cluster_replicas=_env("LOCALAI_CLUSTER_REPLICAS", cls.cluster_replicas, int),
+            affinity_spans=_env("LOCALAI_AFFINITY_SPANS", cls.affinity_spans, int),
+            transfer_max_bytes=_env("LOCALAI_TRANSFER_MAX_BYTES", cls.transfer_max_bytes, int),
             cors=_env("LOCALAI_CORS", True, bool),
             metrics=not _env("LOCALAI_DISABLE_METRICS", False, bool),
             debug=_env("LOCALAI_DEBUG", False, bool),

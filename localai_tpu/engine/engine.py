@@ -733,6 +733,19 @@ class Engine:
         self.m_kv_preempt_recover_ms = 0.0
         self.m_prefix_host_hits = 0
         self.m_peak_active = 0
+        # Cluster KV-span transfer (ISSUE 6, docs/CLUSTER.md): spans framed
+        # by cluster/transfer.py arrive from a prefill-role replica via
+        # import_span_bytes() on ARBITRARY threads; they stage here and the
+        # loop thread merges them into _prefix_host (the host tier already
+        # serves hits from RAM — an imported span is indistinguishable from
+        # a locally-spilled one). Each staged tuple carries a done-Event the
+        # importer waits on, so a handoff is visible to the very next
+        # admission.
+        self._span_inbox: list[tuple[dict, threading.Event]] = []
+        self._span_inbox_lock = threading.Lock()
+        self.m_span_exports = 0
+        self.m_span_imports = 0
+        self.m_span_import_rejects = 0
         self._build_programs()
 
     @property
@@ -2674,6 +2687,133 @@ class Engine:
             * jnp.dtype(self.ecfg.cache_dtype(cfg.dtype)).itemsize
         )
 
+    # ------------------------------------------------------------------ #
+    # Cluster KV-span transfer (ISSUE 6, docs/CLUSTER.md): a prefill-role
+    # replica exports a stored prefix span as a versioned frame; a decode-
+    # role replica imports it into its host tier and the next admission of
+    # that prompt hits it exactly like a locally-spilled span (promote →
+    # copy-on-write page mapping → tail-only prefill).
+    # ------------------------------------------------------------------ #
+
+    def _span_geometry(self) -> dict:
+        """The cache geometry a span frame must match to be importable —
+        same layers/heads/dims/page size/storage dtype, or the raw bytes
+        would reinterpret into garbage KV."""
+        cfg = self.cfg
+        return {
+            "layers": cfg.num_layers,
+            "kv_heads": cfg.cache_kv_heads,
+            "k_dim": cfg.cache_k_dim,
+            "v_dim": cfg.cache_v_dim,
+            "page_size": self.ecfg.kv_page_size,
+            "dtype": str(jnp.dtype(self.ecfg.cache_dtype(cfg.dtype))),
+        }
+
+    def export_prefix_span(self, prompt_ids, max_bytes: int = 0):
+        """Serialize the longest stored device-tier span matching this
+        prompt (page-aligned, like every prefix mapping) as a transfer
+        frame, or None when nothing exportable is stored. Read-only and
+        callable from any thread: the entry list reference is snapshotted,
+        the page gather reads an immutable cache snapshot, and the entry's
+        continued presence is re-checked after the gather so a span evicted
+        mid-export is discarded instead of shipped stale."""
+        if not self._paged or not self._prefix_enabled:
+            return None
+        from localai_tpu.cluster import transfer
+
+        prompt = np.asarray(list(prompt_ids), np.int32)
+        page = self.ecfg.kv_page_size
+        entries = self._prefix_entries  # atomic list-reference snapshot
+        best, best_len = None, 0
+        for entry in entries:
+            if not entry.get("pages"):
+                continue
+            n = min(entry["valid"], len(prompt), len(entry["key"]))
+            eq = entry["key"][:n] == prompt[:n]
+            match = n if eq.all() else int(np.argmin(eq))
+            match = (match // page) * page
+            if match > best_len:
+                best, best_len = entry, match
+        if best is None or best_len < page:
+            return None
+        pages = list(best["pages"][: best_len // page])
+        hk, hv = self._swap_out_pages(pages)
+        if not any(e is best for e in self._prefix_entries):
+            return None  # evicted mid-gather — pages may have been recycled
+        frame = transfer.encode_span(
+            key=best["key"][:best_len], valid=best_len, hk=hk, hv=hv,
+            geom=self._span_geometry(),
+            max_bytes=max_bytes or transfer.DEFAULT_MAX_BYTES,
+        )
+        self.m_span_exports += 1
+        return frame
+
+    def import_span_bytes(self, frame: bytes, max_bytes: int = 0,
+                          timeout_s: float = 10.0) -> bool:
+        """Land a transfer frame in this engine's host prefix tier. Safe
+        from any thread: the decoded entry stages in _span_inbox and the
+        loop thread merges it (host-tier state is loop-owned); this call
+        waits for that merge so the caller can submit the decode request
+        immediately after. Returns False on any rejection — the caller's
+        contract is recompute, never a wedged handoff."""
+        if not self._paged or not self._prefix_enabled:
+            return False
+        from localai_tpu.cluster import transfer
+
+        try:
+            key, valid, hk, hv = transfer.decode_span(
+                frame, geom=self._span_geometry(),
+                max_bytes=max_bytes or transfer.DEFAULT_MAX_BYTES,
+            )
+        except transfer.SpanTransferError as e:
+            log.warning("span import rejected: %s", e)
+            self.m_span_import_rejects += 1
+            return False
+        entry = {
+            "key": key, "valid": valid, "hk": hk, "hv": hv,
+            "bytes": hk.shape[1] * self._page_bytes(),
+        }
+        done = threading.Event()
+        with self._span_inbox_lock:
+            self._span_inbox.append((entry, done))
+        self._wake.set()
+        self.start()
+        if not done.wait(timeout_s):
+            return False
+        return bool(entry.get("accepted"))
+
+    def _drain_span_inbox(self) -> None:
+        """Loop thread: merge staged span imports into the host tier under
+        the shared kv_swap_bytes budget. A span that does not fit (or that
+        an existing entry already covers) is rejected, not queued — the
+        importer falls back to recompute."""
+        if not self._span_inbox:  # unlocked peek — len() is atomic
+            return
+        with self._span_inbox_lock:
+            staged = list(self._span_inbox)
+            self._span_inbox[:] = []
+        for entry, done in staged:
+            try:
+                covered = any(
+                    e["valid"] >= entry["valid"]
+                    and (np.asarray(e["key"][: entry["valid"]])
+                         == entry["key"][: entry["valid"]]).all()
+                    for tier in (self._prefix_entries, self._prefix_host)
+                    for e in tier
+                )
+                if covered:
+                    entry["accepted"] = True  # already served locally
+                    self.m_span_imports += 1
+                elif self._host_make_room(entry["bytes"]):
+                    self._prefix_host.insert(0, entry)
+                    self._host_bytes += entry["bytes"]
+                    entry["accepted"] = True
+                    self.m_span_imports += 1
+                else:
+                    self.m_span_import_rejects += 1
+            finally:
+                done.set()
+
     def _spawn_admit_compile(self, key: tuple, full_args: tuple) -> None:
         """AOT-compile a cached-admit program shape on a daemon thread and
         publish it into _admit_cache; until then hits of this shape fall
@@ -3325,6 +3465,10 @@ class Engine:
             out["kv_host_tier_bytes"] = float(self._host_bytes)
             out["prefix_host_tier_entries"] = float(len(self._prefix_host))
             out["prefix_host_tier_hits"] = float(self.m_prefix_host_hits)
+            # Cluster span transfer (ISSUE 6): disaggregation hand-offs.
+            out["span_exports"] = float(self.m_span_exports)
+            out["span_imports"] = float(self.m_span_imports)
+            out["span_import_rejects"] = float(self.m_span_import_rejects)
         out["peak_active_slots"] = float(self.m_peak_active)
         if self.ecfg.prefill_chunk:
             out["prefill_chunks"] = float(self.m_prefill_chunks)
@@ -3763,6 +3907,14 @@ class Engine:
         self._prefix_entries = []
         self._prefix_host = []
         self._host_bytes = 0
+        # Staged span imports can never merge now — unblock their waiters
+        # (entry["accepted"] stays unset, so importers report failure and
+        # their callers fall back to recompute).
+        with self._span_inbox_lock:
+            staged = list(self._span_inbox)
+            self._span_inbox[:] = []
+        for _entry, done in staged:
+            done.set()
 
     def _loop(self) -> None:
         trace = os.environ.get("LOCALAI_ENGINE_TRACE", "0") == "1"
@@ -3773,6 +3925,7 @@ class Engine:
             self._charge()
             self._purge_pending()
             self._enforce_deadlines()
+            self._drain_span_inbox()
 
             if self._growth_blocked and not self.h_active.any():
                 # The growth-starved slots are gone (finished or preempted

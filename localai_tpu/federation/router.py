@@ -11,6 +11,12 @@ Strategies (reference parity):
 - least-used: fewest in-flight requests (federated_server.go LoadBalanced)
 - random: uniform pick
 - targeted: honor a `LocalAI-Worker` header naming one worker
+- affinity: delegate the pick to the cluster scheduler (ISSUE 6,
+  docs/CLUSTER.md) — chained byte-span hashes of the request's prompt
+  material route repeats to the worker whose prefix cache likely holds
+  them, scored against in-flight load; health/backoff/flap machinery stays
+  exactly as below, the scheduler only chooses among workers this registry
+  says are alive.
 """
 
 from __future__ import annotations
@@ -52,6 +58,10 @@ class Worker:
     # Health-transition counters (monitoring): healthy→unhealthy and back.
     went_unhealthy: int = 0
     went_healthy: int = 0
+    # Cluster role (ISSUE 6): learned from the LocalAI-Cluster-Role header
+    # a worker sends on health-probe responses (server/app.py); the
+    # affinity scheduler role-types its picks with it.
+    role: str = "mixed"
 
 
 class WorkerRegistry:
@@ -89,7 +99,19 @@ class WorkerRegistry:
                 return w if w is not None and w.healthy else None
             healthy = [w for w in self._workers.values() if w.healthy]
             if not healthy:
-                return None
+                # ISSUE 6 satellite: a fully-unhealthy fleet previously
+                # 503'd until the next health-loop tick even when workers
+                # had already recovered. Serve the recovery probe INLINE:
+                # hand the request to the least-recently-failed worker whose
+                # re-probe backoff has expired (due_for_probe semantics) —
+                # success marks it healthy via the normal proxy path, and a
+                # still-dead worker just burns one request that would have
+                # 503'd anyway.
+                now = time.monotonic()
+                due = [w for w in self._workers.values() if now >= w.next_probe]
+                if not due:
+                    return None
+                return min(due, key=lambda w: (w.next_probe, w.name))
             if strategy == "random":
                 return random.choice(healthy)
             # least-used (default — federated_server.go LoadBalanced); ties
@@ -173,6 +195,18 @@ class FederatedServer:
             backoff_base_s=probe_backoff_s, backoff_max_s=probe_backoff_max_s
         )
         self.strategy = strategy
+        # Affinity strategy (ISSUE 6): the cluster scheduler owns the pick;
+        # this registry keeps owning health, backoff, and flap counters.
+        # cluster.affinity/scheduler are numpy-only — no jax import here.
+        self.scheduler = None
+        self.affinity_span_bytes = 256
+        if strategy == "affinity":
+            from localai_tpu.cluster.scheduler import ClusterScheduler
+
+            self.scheduler = ClusterScheduler(
+                span_tokens=0,  # byte-span hashing happens in pick_worker
+                gauge_refresh_s=min(1.0, health_interval_s or 1.0),
+            )
         for name, url in workers or []:
             self.registry.add(name, url)
         self._health_interval = health_interval_s
@@ -205,13 +239,89 @@ class FederatedServer:
                 if not self.registry.due_for_probe(w):
                     continue  # unhealthy and still inside its backoff
                 try:
-                    with urllib.request.urlopen(w.url + "/healthz", timeout=3):
-                        pass
+                    with urllib.request.urlopen(w.url + "/healthz", timeout=3) as resp:
+                        role = resp.headers.get("LocalAI-Cluster-Role", "")
                     self.registry.mark(w, True)
+                    # Role discovery (ISSUE 6): workers advertise their
+                    # cluster role on every response; the affinity
+                    # scheduler role-types picks with it.
+                    if role in ("prefill", "decode", "mixed") and role != w.role:
+                        w.role = role
+                        if self.scheduler is not None:
+                            self.scheduler.set_role(w.name, role)
                 except Exception:  # noqa: BLE001
                     log.warning("worker %s (%s) unhealthy (probe #%d)",
                                 w.name, w.url, w.fail_count + 1)
                     self.registry.mark(w, False)
+
+    # ---------------- affinity delegation (ISSUE 6) ---------------- #
+
+    def _sync_scheduler(self) -> None:
+        """Mirror the registry into the scheduler (workers join/leave at
+        runtime). Existing replicas keep their affinity maps."""
+        workers = {w.name: w for w in self.registry.list()}
+        known = set(self.scheduler.names())
+        for name in known - set(workers):
+            self.scheduler.remove_replica(name)
+        for name, w in workers.items():
+            if name not in known:
+                self.scheduler.add_replica(
+                    name, target=w, role=w.role,
+                    gauge_fn=(lambda w=w: {
+                        "queue_depth": float(w.in_flight),
+                        "loop_dead": 0.0 if w.healthy else 1.0,
+                    }),
+                )
+
+    @staticmethod
+    def _affinity_material(raw_body: Optional[bytes]) -> bytes:
+        """Prompt bytes for byte-span hashing: the front door has no
+        tokenizer, so it hashes the prompt TEXT (identical text tokenizes
+        identically on every worker). Falls back to the raw body."""
+        if not raw_body:
+            return b""
+        try:
+            body = json.loads(raw_body)
+        except (ValueError, UnicodeDecodeError):
+            return raw_body
+        if not isinstance(body, dict):
+            return raw_body
+        msgs = body.get("messages")
+        if isinstance(msgs, list):
+            parts = []
+            for m in msgs:
+                if isinstance(m, dict):
+                    parts.append(f"{m.get('role', '')}\x00{m.get('content', '')}")
+            return "\x1e".join(parts).encode("utf-8", "replace")
+        for key in ("prompt", "input"):
+            if key in body:
+                return json.dumps(body[key], sort_keys=True).encode()
+        return raw_body
+
+    def pick_worker(self, target: Optional[str],
+                    raw_body: Optional[bytes]) -> Optional[Worker]:
+        """One worker for this request. Targeted and non-affinity picks go
+        straight to the registry; affinity picks hash the prompt material
+        and delegate to the cluster scheduler, falling back to least-used
+        when the scheduler abstains (e.g. every worker just registered)."""
+        if self.scheduler is None or target:
+            return self.registry.pick(self.strategy, target)
+        self._sync_scheduler()
+        from localai_tpu.cluster.affinity import byte_span_hashes
+
+        hashes = byte_span_hashes(
+            self._affinity_material(raw_body),
+            span_bytes=self.affinity_span_bytes,
+        )
+        name = self.scheduler.pick(hashes)
+        worker = self.registry.pick("least-used", name) if name else None
+        if worker is None:
+            # Scheduler and registry disagree (a worker died inside the
+            # gauge-refresh window) or everything is dead — the registry's
+            # least-used/recovery logic is the backstop.
+            return self.registry.pick("least-used", None)
+        self.scheduler.record(name, hashes)
+        return worker
 
     def _build(self, address: str, port: int) -> ThreadingHTTPServer:
         fed = self
@@ -262,6 +372,7 @@ class FederatedServer:
                             "fail_count": w.fail_count,
                             "went_unhealthy": w.went_unhealthy,
                             "went_healthy": w.went_healthy,
+                            "role": w.role,
                         }
                         for w in fed.registry.list()
                     ], "strategy": fed.strategy})
@@ -299,15 +410,18 @@ class FederatedServer:
 
             def _proxy(self) -> None:
                 target = self.headers.get("LocalAI-Worker")
-                worker = fed.registry.pick(fed.strategy, target)
+                # Body first: the affinity pick hashes the prompt material
+                # (and the stream must be drained before any response on a
+                # keep-alive connection anyway).
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else None
+                worker = fed.pick_worker(target, body)
                 if worker is None:
                     self._json(503, {"error": {
                         "message": "no healthy federation worker available",
                         "type": "server_error",
                     }})
                     return
-                n = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(n) if n else None
                 headers = {
                     k: v for k, v in self.headers.items()
                     if k.lower() not in HOP_HEADERS and k != "LocalAI-Worker"
@@ -329,6 +443,11 @@ class FederatedServer:
                     }})
                     fed.registry.release(worker)
                     return
+                if not worker.healthy:
+                    # The all-unhealthy recovery path routed here and the
+                    # worker answered — it is back (the health loop would
+                    # only notice at its next due probe).
+                    fed.registry.mark(worker, True)
                 try:
                     self.send_response(resp.status)
                     is_stream = False

@@ -288,6 +288,12 @@ def create_server(app_cfg: ApplicationConfig, router: Router) -> ThreadingHTTPSe
                 h["Access-Control-Allow-Methods"] = "GET, POST, DELETE, OPTIONS"
             if app_cfg.machine_tag:
                 h["LocalAI-Machine-Tag"] = app_cfg.machine_tag
+            # Cluster role advertisement (ISSUE 6): health probes from the
+            # federation front door read this to role-type affinity picks
+            # (prefill/decode workers need no side-channel registration).
+            role = (app_cfg.cluster_role or "").split(",")[0].strip()
+            if role and role != "mixed":
+                h["LocalAI-Cluster-Role"] = role
             return h
 
         def _respond(self, resp: Response) -> None:

@@ -647,6 +647,35 @@ class ModelManager:
             quantization=cfg.quantization,
         )
         engine.start()
+        # Cluster fan-out (ISSUE 6, docs/CLUSTER.md): cluster_replicas >= 2
+        # serves this model through N same-host engine replicas (shared
+        # weight tree, per-replica KV pools/loops) behind the prefix-
+        # affinity scheduler — the ClusterEngine facade keeps the Engine
+        # surface, so every API/watchdog/metrics path is unchanged. Draft
+        # and vision engines stay single-replica (their side state has no
+        # transfer story yet).
+        n_replicas = self.app_cfg.cluster_replicas
+        if n_replicas >= 2 and draft_arch is None and not vlm:
+            from localai_tpu.cluster import ClusterEngine, LocalReplica, parse_roles
+
+            roles = parse_roles(n_replicas, self.app_cfg.cluster_role)
+            replicas = [LocalReplica("r0", engine, role=roles[0])]
+            for i in range(1, n_replicas):
+                extra = Engine(
+                    arch, params, tokenizer, mesh_plan=plan,
+                    engine_cfg=engine.ecfg, quantization=cfg.quantization,
+                )
+                extra.start()
+                replicas.append(LocalReplica(f"r{i}", extra, role=roles[i]))
+            engine = ClusterEngine(
+                replicas,
+                transfer_max_bytes=self.app_cfg.transfer_max_bytes,
+                affinity_spans=self.app_cfg.affinity_spans,
+            )
+            log.info(
+                "model %s: fanned out to %d cluster replicas (roles=%s)",
+                cfg.name, n_replicas, ",".join(roles),
+            )
         evaluator = Evaluator(cfg, tokenizer)
         lm = LoadedModel(cfg, engine, evaluator)
         if vlm:

@@ -89,6 +89,14 @@ class OpenAIApi:
         r.add("GET", "/backend/monitor", self.backend_monitor)
         r.add("POST", "/backend/monitor", self.backend_monitor)
         r.add("POST", "/backend/shutdown", self.backend_shutdown)
+        # Cluster control plane (ISSUE 6, docs/CLUSTER.md): role/status
+        # introspection plus the KV-span transfer seam — a prefill-role
+        # worker answers /cluster/span/export with a versioned binary frame
+        # and a decode-role worker lands it via /cluster/span/import, which
+        # is all a network-hop disaggregation deployment needs.
+        r.add("GET", "/cluster/status", self.cluster_status)
+        r.add("POST", "/cluster/span/export", self.cluster_span_export)
+        r.add("POST", "/cluster/span/import", self.cluster_span_import)
         # Engine gauges (kv pages free/total, queue depth, preemptions,
         # swap bytes, prefix host tier, ...) ride the Prometheus scrape as
         # localai_engine_*{model=...} — create_server polls this at every
@@ -918,3 +926,72 @@ class OpenAIApi:
         if not self.manager.unload(name):
             raise ApiError(404, f"model {name!r} is not loaded")
         return Response(body={"status": "ok"})
+
+    # ------------------------------------------------------------------ #
+    # Cluster control plane (ISSUE 6, docs/CLUSTER.md)
+    # ------------------------------------------------------------------ #
+
+    def cluster_status(self, req: Request) -> Response:
+        app_cfg = self.manager.app_cfg
+        engines = {}
+        for n in self.manager.loaded_names():
+            lm = self.manager.peek(n)
+            if lm is None:
+                continue
+            client = getattr(lm.engine, "client", None)
+            if client is not None:  # ClusterEngine fan-out
+                engines[n] = {
+                    "replicas": client.scheduler.snapshot(),
+                    "metrics": client.metrics(),
+                }
+        return Response(body={
+            "role": app_cfg.cluster_role,
+            "cluster_replicas": app_cfg.cluster_replicas,
+            "affinity_spans": app_cfg.affinity_spans,
+            "transfer_max_bytes": app_cfg.transfer_max_bytes,
+            "engines": engines,
+        })
+
+    def _cluster_engine(self, name: Optional[str]):
+        """A loaded engine with span transfer hooks (never triggers a
+        load — transfer is an optimization, not worth paging a model in)."""
+        if not name:
+            raise ApiError(400, "model is required")
+        lm = self.manager.peek(name)
+        if lm is None:
+            raise ApiError(404, f"model {name!r} is not loaded")
+        eng = lm.engine
+        if not hasattr(eng, "export_prefix_span"):
+            # Cluster fan-out: export/import from the least-loaded live
+            # replica is equivalent (spans are replica-local); use r0.
+            reps = getattr(eng, "replicas", None)
+            if reps:
+                eng = reps[0].engine
+        if not hasattr(eng, "export_prefix_span"):
+            raise ApiError(400, f"model {name!r} has no KV span transfer "
+                                "(paged LLM engines only)")
+        return eng
+
+    def cluster_span_export(self, req: Request) -> Response:
+        body = req.body or {}
+        eng = self._cluster_engine(body.get("model"))
+        prompt_ids = body.get("prompt_ids")
+        if not isinstance(prompt_ids, list) or not prompt_ids:
+            raise ApiError(400, "prompt_ids (non-empty token id list) required")
+        frame = eng.export_prefix_span(
+            [int(t) for t in prompt_ids],
+            max_bytes=self.manager.app_cfg.transfer_max_bytes,
+        )
+        if frame is None:
+            raise ApiError(404, "no exportable span stored for this prompt")
+        return Response(body=frame, content_type="application/octet-stream")
+
+    def cluster_span_import(self, req: Request) -> Response:
+        name = (req.query.get("model") or [None])[0]
+        eng = self._cluster_engine(name)
+        if not req.raw_body:
+            raise ApiError(400, "span frame bytes required as request body")
+        ok = eng.import_span_bytes(
+            req.raw_body, max_bytes=self.manager.app_cfg.transfer_max_bytes
+        )
+        return Response(body={"imported": bool(ok)})

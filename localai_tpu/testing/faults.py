@@ -26,6 +26,14 @@ Hook sites (each is one `faults.fire(SITE)` call in production code):
                      (_swap_out_pages/_swap_in_pages).
   manager_load     — entry of ModelManager._load: exercises the failed-load
                      containment (RuntimeError to that one caller).
+  cluster_dispatch — entry of ClusterClient._run_inner (cluster/scheduler).
+                     Raising here exercises the cluster layer's terminal-
+                     event containment: the caller gets a typed error event,
+                     never a hung stream.
+  span_transfer    — entry of cluster.transfer encode_span/decode_span.
+                     Raising here fails a prefill→decode KV handoff; the
+                     contract is silent fallback to recompute on the decode
+                     replica (ISSUE 6).
 
 Activation:
   - programmatic: `with faults.active(FaultSchedule(seed=7)): ...`
@@ -60,6 +68,8 @@ SITES = (
     "page_alloc",
     "host_swap",
     "manager_load",
+    "cluster_dispatch",
+    "span_transfer",
 )
 
 DEFAULT_RATE = 0.05

@@ -208,6 +208,76 @@ def test_workers_listing_exposes_health_counters(federation):
         fed.registry.mark(w1, True)
 
 
+def test_all_unhealthy_pick_returns_least_recently_failed_due_worker():
+    """ISSUE 6 satellite: with every worker unhealthy, pick() must hand the
+    request to the least-recently-failed worker whose re-probe backoff has
+    expired — a recovering fleet serves its first request inline instead of
+    503ing until the next health-loop tick."""
+    import time
+
+    from localai_tpu.federation.router import WorkerRegistry
+
+    reg = WorkerRegistry(backoff_base_s=0.2, backoff_max_s=1.0)
+    reg.add("w1", "http://127.0.0.1:1")
+    reg.add("w2", "http://127.0.0.1:2")
+    w1 = next(w for w in reg.list() if w.name == "w1")
+    w2 = next(w for w in reg.list() if w.name == "w2")
+    reg.mark(w1, False)
+    reg.mark(w2, False)
+    # Both inside their first backoff window: nothing to try yet.
+    assert reg.pick("least-used") is None
+    # w1's backoff expired longest ago → it is the recovery probe.
+    now = time.monotonic()
+    w1.next_probe = now - 0.5
+    w2.next_probe = now - 0.1
+    assert reg.pick("least-used") is w1
+    # Targeted picks still refuse unhealthy workers (explicit intent).
+    assert reg.pick("least-used", target="w1") is None
+    # A healthy worker always outranks the recovery path.
+    reg.mark(w2, True)
+    assert reg.pick("least-used") is w2
+
+
+def test_affinity_strategy_routes_repeat_prompts_to_one_worker(federation):
+    """ISSUE 6: strategy="affinity" delegates pick() to the cluster
+    scheduler — identical prompt material routes to one worker (its prefix
+    cache holds the spans) while health/backoff stays registry-owned."""
+    _fed, _base, (url1, url2) = federation
+    aff = FederatedServer(
+        address="127.0.0.1", port=0, strategy="affinity",
+        workers=[("w1", url1), ("w2", url2)], health_interval_s=0,
+    )
+    aff.start()
+    try:
+        base = f"http://127.0.0.1:{aff.port}"
+        # > affinity_span_bytes of prompt material so spans exist to hash.
+        big = "repeat after me: " + "lorem ipsum dolore " * 40
+        served = set()
+        for _ in range(3):
+            _out, headers = _post(base, "/v1/chat/completions", {
+                "model": "m", "max_tokens": 2,
+                "messages": [{"role": "user", "content": big}],
+            })
+            served.add(headers["LocalAI-Served-By"])
+        assert len(served) == 1, served
+        # The scheduler mirrors the registry (sync on pick).
+        assert set(aff.scheduler.names()) == {"w1", "w2"}
+        # An unhealthy worker stops attracting its affinity traffic.
+        holder = next(w for w in aff.registry.list() if w.name in served)
+        other = next(w for w in aff.registry.list() if w.name not in served)
+        aff.registry.mark(holder, False)
+        try:
+            _out, headers = _post(base, "/v1/chat/completions", {
+                "model": "m", "max_tokens": 2,
+                "messages": [{"role": "user", "content": big}],
+            })
+            assert headers["LocalAI-Served-By"] == other.name
+        finally:
+            aff.registry.mark(holder, True)
+    finally:
+        aff.stop()
+
+
 def test_federation_register_requires_token():
     """With a shared token set, unauthorized register/unregister are rejected
     (reference parity: core/p2p/p2p.go:31-64 token-gated overlay)."""

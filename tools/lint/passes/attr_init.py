@@ -26,6 +26,9 @@ DEFAULT_TARGETS = [
     ("localai_tpu/federation/router.py", "WorkerRegistry"),
     ("localai_tpu/federation/router.py", "Federator"),
     ("localai_tpu/testing/faults.py", "FaultSchedule"),
+    ("localai_tpu/cluster/scheduler.py", "ClusterScheduler"),
+    ("localai_tpu/cluster/scheduler.py", "ClusterClient"),
+    ("localai_tpu/cluster/replica.py", "ClusterEngine"),
 ]
 
 

@@ -24,6 +24,7 @@ DEFAULT_GLOBS = [
     "localai_tpu/engine/*.py",
     "localai_tpu/server/manager.py",
     "localai_tpu/federation/router.py",
+    "localai_tpu/cluster/*.py",
 ]
 
 _LOCK_CTORS = {"Lock", "RLock", "Condition"}

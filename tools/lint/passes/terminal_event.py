@@ -32,6 +32,9 @@ from ..core import Finding, Pass, Repo
 
 DEFAULT_TARGETS = [
     ("localai_tpu/engine/engine.py", "Engine", "_pending", "slots"),
+    # Cluster dispatch (ISSUE 6): the scheduler layer holds caller handles
+    # in its own _pending map — the same hang class applies one level up.
+    ("localai_tpu/cluster/scheduler.py", "ClusterClient", "_pending", "slots"),
 ]
 
 _REMOVE_CALLS = {"popleft", "pop", "remove", "clear"}

@@ -42,6 +42,10 @@ from ..core import Finding, Pass, Repo
 TRACED_MODULE_GLOBS = [
     "localai_tpu/ops/*.py",
     "localai_tpu/models/llama.py",
+    # The cluster layer is host-side BY CONTRACT (it sits on every dispatch
+    # path): any jnp/lax value it manufactures — and then branches on or
+    # pulls — is a sync the scheduler would pay per request.
+    "localai_tpu/cluster/*.py",
 ]
 
 ENGINE_TARGET = ("localai_tpu/engine/engine.py", "Engine")
