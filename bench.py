@@ -718,6 +718,143 @@ def main() -> None:
                 rep.engine.params = None
                 rep.engine.cache = None
 
+    # Tensor-parallel serving row (ISSUE 7, docs/SHARDED_SERVING.md):
+    # paged decode tok/s + p99 TTFT at tp=1 vs tp=4 vs tp=8 (whatever the
+    # device count and the arch's kv-head divisibility allow — 8B decode is
+    # HBM-bound per chip, so tp multiplies aggregate KV bandwidth), chunked
+    # prefill throughput with and without sp, and an ici_collective_ms
+    # estimate (timed psum of the layer-boundary reduction shape, scaled to
+    # the 2 psums/layer the Megatron layout pays per decode step).
+    # Deadline-joined; measurable on the CPU mesh, real-TPU numbers ride
+    # the next roofline run.
+    if os.environ.get("BENCH_TP", "1") != "0" and max_seq % 128 == 0:
+        try:
+            from localai_tpu.parallel.mesh import MeshPlan, build_mesh, shard_map
+            from localai_tpu.parallel.sharding import max_valid_tp
+
+            ndev = len(jax.devices())
+            tp_gen = min(gen_len, 128)
+            # 1/4/8 are the 8B v5e-8 points; the arch's own max rides along
+            # so the row stays measurable for archs whose kv heads exclude
+            # 4/8 (the tiny CPU smoke measures tp=1 vs tp=2).
+            cand = sorted({1, 4, 8, max_valid_tp(cfg, min(8, ndev))})
+            tps = [t for t in cand
+                   if t <= ndev and max_valid_tp(cfg, t) == t]
+            for tp in tps:
+                teng = Engine(
+                    cfg, params, ByteTokenizer(cfg.vocab_size),
+                    mesh_plan=MeshPlan(tp=tp),
+                    engine_cfg=EngineConfig(
+                        max_slots=slots, max_seq=max_seq,
+                        kv_pages=slots * (max_seq // 128), kv_page_size=128,
+                        prefix_admit_async_compile=False,
+                    ),
+                )
+                try:
+                    teng.start()
+                    teng.warmup(prompt_len)
+                    teng._decode_time = 0.0
+                    teng._decode_tokens = 0
+                    tttfts: list[float] = []
+                    terrs: list[str] = []
+                    tlock = threading.Lock()
+
+                    def tone(i: int, e=teng, acc=tttfts, err=terrs, lk=tlock):
+                        ids = [(i * 41 + j) % 255 + 1 for j in range(prompt_len)]
+                        try:
+                            _, ev = e.generate(ids, max_new_tokens=tp_gen,
+                                               ignore_eos=True)
+                            with lk:
+                                acc.append(ev.timing_prompt_processing)
+                        except Exception as ex:  # noqa: BLE001
+                            with lk:
+                                err.append(f"req {i}: {type(ex).__name__}: {ex}")
+                    tthreads = [threading.Thread(target=tone, args=(i,))
+                                for i in range(slots)]
+                    for t in tthreads:
+                        t.start()
+                    _join_or_die(tthreads, teng, f"tp={tp} decode row")
+                    if terrs:
+                        raise RuntimeError("; ".join(terrs[:3]))
+                    tps_val = (teng._decode_tokens / teng._decode_time
+                               if teng._decode_time else 0.0)
+                    tttfts.sort()
+                    p99 = tttfts[min(len(tttfts) - 1, int(len(tttfts) * 0.99))]
+                    out[f"tp{tp}_decode_tps"] = round(tps_val, 2)
+                    out[f"tp{tp}_p99_ttft_ms"] = round(p99 * 1000, 1)
+                    print(f"tp={tp}: {tps_val:.1f} tok/s, p99 TTFT "
+                          f"{p99 * 1000:.1f} ms", file=sys.stderr)
+                finally:
+                    teng.stop()
+                    teng.params = teng.cache = None
+
+            # ICI collective cost estimate: one psum of the o-projection
+            # boundary shape ([slots, hidden] f32) over the widest measured
+            # tp, scaled to 2 psums/layer (o + MLP down) per decode step.
+            tp_max = max(tps)
+            if tp_max > 1:
+                import jax.numpy as jnp
+                from jax.sharding import PartitionSpec as P
+
+                pm = build_mesh(MeshPlan(tp=tp_max))
+                x = jnp.ones((slots, cfg.hidden_size), jnp.float32)
+                f = jax.jit(shard_map(
+                    lambda v: jax.lax.psum(v, "tp"), pm,
+                    in_specs=P(None, "tp"), out_specs=P()))
+                f(x).block_until_ready()  # compile
+                reps = 50
+                t0 = time.time()
+                for _ in range(reps):
+                    r = f(x)
+                r.block_until_ready()
+                per_psum = (time.time() - t0) / reps
+                out["ici_collective_ms"] = round(
+                    per_psum * 2 * cfg.num_layers * 1000, 4)
+                print(f"ici_collective_ms/step (tp={tp_max} est.): "
+                      f"{out['ici_collective_ms']}", file=sys.stderr)
+
+            # Chunked prefill throughput, with and without sp (dense
+            # engines: sp excludes the paged pool). One long admission per
+            # engine; prefill tok/s = prompt / TTFT of the second run (the
+            # first pays the chunk-program compiles).
+            sp_deg = 2 if (ndev >= 2 and max_seq % 2 == 0) else 1
+            long_p = min(max_seq - tp_gen - 8, 4 * 512)
+            chunk = 512 if long_p > 512 else 256
+            for tag, splan in (("nosp", MeshPlan(tp=1)),
+                               ("sp", MeshPlan(tp=1, sp=sp_deg))):
+                if tag == "sp" and sp_deg == 1:
+                    continue
+                peng = Engine(
+                    cfg, params, ByteTokenizer(cfg.vocab_size),
+                    mesh_plan=splan,
+                    engine_cfg=EngineConfig(
+                        max_slots=2, max_seq=max_seq,
+                        prefill_chunk=0 if tag == "sp" else chunk,
+                        prefix_cache_entries=0,
+                    ),
+                )
+                try:
+                    peng.start()
+                    ids = [(j * 7) % 255 + 1 for j in range(long_p)]
+                    peng.generate(ids, max_new_tokens=1, ignore_eos=True)
+                    ids2 = [(j * 11) % 255 + 2 for j in range(long_p)]
+                    _, ev = peng.generate(ids2, max_new_tokens=1,
+                                          ignore_eos=True)
+                    tput = (long_p / ev.timing_prompt_processing
+                            if ev.timing_prompt_processing else 0.0)
+                    out[f"prefill_chunk_tps_{tag}"] = round(tput, 1)
+                    print(f"prefill({tag}, {long_p} tok): {tput:.1f} tok/s",
+                          file=sys.stderr)
+                finally:
+                    peng.stop()
+                    peng.params = peng.cache = None
+        except Exception as e:  # noqa: BLE001 — extra row is best-effort
+            import traceback
+
+            traceback.print_exc()
+            print(f"BENCH_TP row failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
     # Prompt/prefix-cache rows (VERDICT r4 item 3), dense and paged: a LONG
     # shared prefix (4000 tokens, dedicated 8k-seq engines) so the prefill
     # saving (~0.5 s at measured rates) dominates tunnel-RTT noise — at a

@@ -95,6 +95,15 @@ class ModelConfig:
     # Engine shape knobs.
     max_slots: int = 8
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    # Tensor-parallel serving (ISSUE 7, docs/SHARDED_SERVING.md): shard the
+    # weights, KV cache/page pool, and Pallas kernels over this many chips.
+    # The flat knob (reference: llama.cpp tensor_split / vLLM
+    # tensor_parallel_size) — wins over the nested parallel.tp when > 0;
+    # 0 = auto (all devices left after dp/ep/sp, degraded to the
+    # architecture's max_valid_tp). A value the model cannot shard evenly
+    # degrades to that max with a warning instead of failing the load.
+    # LOCALAI_TENSOR_PARALLEL env var overrides ("auto" = all devices).
+    tensor_parallel: int = 0
     # Paged KV cache (engine/engine.py kv_pages): pool HBM scales with live
     # context instead of max_slots × context_size. 0 = dense cache.
     kv_pages: int = 0

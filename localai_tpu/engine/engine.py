@@ -200,6 +200,19 @@ class EngineConfig:
     # off-TPU runs in interpret mode — tests only). LOCALAI_PAGED_KERNEL
     # env var overrides.
     paged_kernel: str = "auto"
+    # Tensor-parallel serving (ISSUE 7, docs/SHARDED_SERVING.md): shard the
+    # weights (Megatron column/row splits, parallel/sharding.py), the KV
+    # cache / paged pool (kv-head axis — pages live on the head shard that
+    # owns them; the allocator, refcounts, and host tier stay global), and
+    # the Pallas kernels (head-sharded under shard_map, psum only at the
+    # o-projection) over this many devices. 0 = leave the mesh plan alone
+    # (the mesh_plan argument, or single chip); N > 0 = replace the plan's
+    # tp axis with N (clamped to the devices present); -1 = auto: all
+    # available devices. Either way a tp the architecture cannot shard
+    # evenly (GQA kv heads etc.) DEGRADES to max_valid_tp with a warning
+    # instead of failing the load. LOCALAI_TENSOR_PARALLEL env var
+    # overrides ("auto" = -1).
+    tensor_parallel: int = 0
     # Chunked ragged prefill (docs/CHUNKED_PREFILL.md, ISSUE 2): prompts
     # whose un-cached tail exceeds this many tokens admit in
     # prefill_chunk-token chunks that the engine loop interleaves with
@@ -382,6 +395,12 @@ class _Slot:
     dfa: bool = False
 
 
+def _parse_tp_env(val: str) -> int:
+    """LOCALAI_TENSOR_PARALLEL value: an integer, or "auto" (= -1, all
+    available devices with max_valid_tp degrade)."""
+    return -1 if val.strip().lower() == "auto" else int(val)
+
+
 def _host_copy_async(arr: Any) -> None:
     """Start a device→host copy without blocking; np.asarray later is then a
     cheap wait instead of a full round trip."""
@@ -452,6 +471,7 @@ class Engine:
             "LOCALAI_MAX_PENDING": ("max_pending", int),
             "LOCALAI_QUEUE_TIMEOUT": ("queue_timeout_s", float),
             "LOCALAI_DEADLINE": ("deadline_s", float),
+            "LOCALAI_TENSOR_PARALLEL": ("tensor_parallel", _parse_tp_env),
         }.items():
             val = os.environ.get(env)
             if val is not None and val != "":
@@ -477,8 +497,51 @@ class Engine:
                     f"min_prefill_bucket={self.ecfg.min_prefill_bucket}"
                 )
         self.plan = mesh_plan or MeshPlan(dp=1, tp=1)
+        # tensor_parallel knob (ISSUE 7): a nonzero value replaces the
+        # plan's tp axis — the explicit EngineConfig/YAML/env route to
+        # sharded serving that doesn't require callers to build a MeshPlan.
+        tp_req = self.ecfg.tensor_parallel
+        if tp_req:
+            ndev = len(devices) if devices is not None else len(jax.devices())
+            room = max(1, ndev // max(1, self.plan.dp * self.plan.ep * self.plan.sp))
+            tp = room if tp_req < 0 else tp_req
+            if tp > room:
+                log.warning(
+                    "tensor_parallel=%d exceeds the %d device(s) available "
+                    "(dp=%d ep=%d sp=%d) — clamping to tp=%d",
+                    tp_req, ndev, self.plan.dp, self.plan.ep, self.plan.sp,
+                    room,
+                )
+                tp = room
+            self.plan = dataclasses.replace(self.plan, tp=max(1, tp))
+        # Auto-degrade (ISSUE 7 satellite): a tp the architecture (or the
+        # draft's) cannot shard evenly degrades to the largest joint
+        # max_valid_tp instead of crashing at load. ep violations (and any
+        # other non-tp plan error) still raise the typed ShardingPlanError.
+        from localai_tpu.parallel.sharding import ShardingPlanError, max_valid_tp
+
+        tp_cfgs = [cfg] + ([draft_cfg] if draft_cfg is not None else [])
+        tp_eff = self.plan.tp
+        while tp_eff > 1:
+            t2 = min(max_valid_tp(c, tp_eff) for c in tp_cfgs)
+            if t2 == tp_eff:
+                break
+            tp_eff = t2
+        if tp_eff != self.plan.tp:
+            log.warning(
+                "tp=%d cannot shard %s evenly — degrading to tp=%d "
+                "(max_valid_tp)", self.plan.tp,
+                "/".join(c.name for c in tp_cfgs), tp_eff,
+            )
+            self.plan = dataclasses.replace(self.plan, tp=tp_eff)
         validate_plan(cfg, self.plan.tp, self.plan.ep)
         self.mesh = build_mesh(self.plan, devices)
+        # Mesh handed to model/op code: the sp ring path AND the tp
+        # head-sharded Pallas kernel paths key off it; None on single-chip
+        # plans so every existing single-device trace stays byte-identical.
+        self._op_mesh = (
+            self.mesh if (self.plan.sp > 1 or self.plan.tp > 1) else None
+        )
         if self.plan.sp > 1:
             if cfg.is_mla:
                 raise ValueError(
@@ -634,6 +697,20 @@ class Engine:
         # a second concurrent schema falls back to the host walk).
         self.h_gmask = np.zeros((B,), np.float32)  # 1 = slot DFA-constrained
         self.d_gstate = jnp.zeros((B,), jnp.int32)
+        if self.plan.total > 1:
+            # Commit the per-slot control state REPLICATED on the mesh.
+            # Uncommitted single-device arrays leave placement to each
+            # program's inference; an explicit replicated sharding keeps
+            # every compiled program's input contract stable — the AOT
+            # cached-admit lowering takes shardings straight from these
+            # avals (ISSUE 7).
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            rep = NamedSharding(self.mesh, P())
+            for name in ("counts", "rngs", "bias", "d_tokens",
+                         "d_positions", "d_gstate"):
+                setattr(self, name, jax.device_put(getattr(self, name), rep))
         self._dfa: Optional[dict] = None  # {key, mask_bits, trans, tok_cls, host}
         self._dfa_building: set = set()  # schema keys compiling off-thread
         self._tok_fp: Optional[str] = None
@@ -1279,21 +1356,26 @@ class Engine:
         cfg = self.cfg
         # sp>1 routes prefill through ring attention over the mesh's "sp"
         # axis (long-context serving — KV residency per chip is bucket/sp).
+        # _ring_mesh stays the sp-only gate (chunking/kv-window policy key
+        # off it); the mesh ARGUMENT model code receives is _op_mesh, which
+        # is also set on tp>1 plans so the Pallas kernels run head-sharded
+        # under shard_map (ISSUE 7).
         ring_mesh = self.mesh if self.plan.sp > 1 else None
         self._ring_mesh = ring_mesh
+        op_mesh = self._op_mesh
 
         @partial(jax.jit, static_argnames=())
         def _prefill(params, tokens, lengths):
-            return llama.prefill(cfg, params, tokens, lengths, mesh=ring_mesh, ep=self.plan.ep)
+            return llama.prefill(cfg, params, tokens, lengths, mesh=op_mesh, ep=self.plan.ep)
 
         @partial(jax.jit)
         def _embed(params, tokens, lengths):
-            return llama.encode(cfg, params, tokens, lengths, mesh=ring_mesh, ep=self.plan.ep)
+            return llama.encode(cfg, params, tokens, lengths, mesh=op_mesh, ep=self.plan.ep)
 
         @partial(jax.jit)
         def _score(params, tokens, lengths, cond_lengths):
             return llama.sequence_logprob(
-                cfg, params, tokens, lengths, cond_lengths, mesh=ring_mesh,
+                cfg, params, tokens, lengths, cond_lengths, mesh=op_mesh,
                 ep=self.plan.ep,
             )
 
@@ -1397,12 +1479,12 @@ class Engine:
                         cfg, params, tokens, pos_eff, cache, lk, lv, step,
                         ep=self.plan.ep, ptable=ptable,
                         paged_impl=self.ecfg.paged_kernel,
-                        rope_delta=rope_delta,
+                        rope_delta=rope_delta, mesh=self._op_mesh,
                     )
                 else:
                     logits, lk, lv = llama.decode_step_windowed(
                         cfg, params, tokens, positions, read_cache, lk, lv, step,
-                        ep=self.plan.ep, mesh=self._ring_mesh,
+                        ep=self.plan.ep, mesh=self._op_mesh,
                         rope_delta=rope_delta,
                     )
                 split = jax.vmap(lambda k: jax.random.split(k, 2))(rngs)
@@ -1536,7 +1618,7 @@ class Engine:
             )
             inject = (img_embeds, img_offsets) if img_embeds is not None else None
             logits, ks, vs = llama.prefill(
-                cfg, params, prompt_toks, lens, mesh=self._ring_mesh,
+                cfg, params, prompt_toks, lens, mesh=self._op_mesh,
                 inject=inject, ep=self.plan.ep, mrope=mrope_pos,
             )
             valid = (jnp.arange(bucket)[None, :] < lens[:, None]).astype(jnp.int32)
@@ -2010,6 +2092,7 @@ class Engine:
                     cfg, params, toks, aux[0:1], aux[2:3], cache,
                     table_row[None], ep=self.plan.ep,
                     paged_impl=self.ecfg.paged_kernel, with_logits=False,
+                    mesh=self._op_mesh,
                 )
                 d_positions = d_positions.at[aux[1]].set(S - 1)
                 return cache, d_positions, aux
@@ -2106,7 +2189,7 @@ class Engine:
             logits, cache = llama.prefill_chunk_paged(
                 cfg, params, tail_toks, aux[0:1], aux[3:4], cache,
                 table_row[None], ep=self.plan.ep,
-                paged_impl=self.ecfg.paged_kernel,
+                paged_impl=self.ecfg.paged_kernel, mesh=self._op_mesh,
             )
             fvalid = (jnp.arange(fbp)[None, :] < (plen + tail_len)).astype(jnp.int32)
             rows = jnp.zeros((1, V), jnp.int32)
@@ -3113,6 +3196,7 @@ class Engine:
             logits_all, cache = llama.decode_chunk(
                 cfg, params, chunk, pos_chunk, cache, ep=self.plan.ep,
                 ptable=ptable, paged_impl=self.ecfg.paged_kernel,
+                mesh=self._op_mesh,
             )
 
             # 3. Accept-scan with counts updated token by token, so
@@ -4266,6 +4350,11 @@ class Engine:
         prefix_hit: tuple | None = None,
     ) -> None:
         faults.fire("device_dispatch")
+        if self.plan.total > 1:
+            # Sharded admission launches a multi-chip program (ICI
+            # collectives at the qkv/o boundaries) — give the fault harness
+            # a hook that only exists on sharded engines (ISSUE 7).
+            faults.fire("collective_dispatch")
         m = len(chunk)
         V = self.cfg.vocab_size
         dfa_tables = None
@@ -4528,6 +4617,9 @@ class Engine:
         the block's writes — the loop then drains in-flight work and
         preempts the youngest slot (ISSUE 3)."""
         faults.fire("device_dispatch")
+        if self.plan.total > 1:
+            # Sharded decode dispatch — see _dispatch_admit (ISSUE 7).
+            faults.fire("collective_dispatch")
         B = self.ecfg.max_slots
         if grammar:
             variant, n = "grammar", 1

@@ -101,6 +101,56 @@ class _ShardReader:
         return self._open[fname].get_tensor(self._alias.get(name, name))
 
 
+def sharded_put(cfg: ArchConfig, mesh) -> Callable[[str, np.ndarray], jnp.ndarray]:
+    """A `put` callback for load_hf_checkpoint that places each stacked
+    tensor DIRECTLY with its NamedSharding from parallel/sharding.param_specs
+    (ISSUE 7): jax.device_put from a host array with a sharding ships each
+    device exactly its shard, so a tp-sharded checkpoint never materializes
+    a full replicated copy in any chip's HBM — the point where an 8B-in-bf16
+    load on a v5e-8 stops needing a whole chip's worth of slack.
+
+    Loader paths look like "embed", "final_norm", "lm_head", "layers/<name>"
+    (and "layers/<name>@<lo>" for DeepSeek's split stacks, whose dense-prefix
+    MLP specs differ from the MoE stack's — disambiguated by rank). Tensors
+    without a spec (or whose spec rank mismatches) place replicated."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from localai_tpu.parallel.sharding import param_specs
+
+    specs = param_specs(cfg)
+    dt = jnp.dtype(cfg.dtype)
+
+    def lookup(path: str, ndim: int):
+        name = path.split("@")[0]
+        parts = name.split("/")
+        cands = []
+        if len(parts) == 2 and parts[0] == "layers":
+            for stack in ("layers", "dense_layers"):
+                spec = specs.get(stack, {}).get(parts[1])
+                if spec is not None:
+                    cands.append(spec)
+        else:
+            spec = specs.get(parts[0])
+            if spec is not None:
+                cands.append(spec)
+        for spec in cands:
+            if len(tuple(spec)) <= ndim:
+                return spec
+        return None
+
+    def put(path: str, arr: np.ndarray) -> jnp.ndarray:
+        host = np.asarray(arr)
+        if host.dtype != dt and np.issubdtype(host.dtype, np.floating):
+            host = host.astype(dt)
+        spec = lookup(path, host.ndim)
+        if spec is None:
+            spec = P()
+        return jax.device_put(host, NamedSharding(mesh, spec))
+
+    return put
+
+
 def load_hf_checkpoint(
     cfg: ArchConfig,
     ckpt_dir: str,

@@ -702,7 +702,7 @@ def _forward_hidden(
             attn = prefill_attention(
                 q, k, v, length_mask, lengths,
                 softcap=cfg.attn_softcap, window=cfg.sliding_window,
-                sliding=_layer_sliding(cfg, li),
+                sliding=_layer_sliding(cfg, li), mesh=mesh,
             )
         h = h + _attn_out(cfg, lp, attn.reshape(B, S, -1))
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
@@ -870,7 +870,7 @@ def decode_step_windowed(
     local_v: jnp.ndarray,
     step: jnp.ndarray,  # scalar index within the block
     ep: int = 1,
-    mesh=None,  # Mesh with sp>1 → the cache's sequence axis is sp-sharded
+    mesh=None,  # Mesh: sp>1 → sp-sharded cache; tp>1 → head-sharded Pallas
     ptable=None,  # [B, MP] int32 → `cache` is a page pool (paged KV mode)
     paged_impl: str = "auto",  # paged attention kernel: auto|pallas|xla
     rope_delta=None,  # [B] int32 — m-rope: rope at positions+delta (cache
@@ -929,7 +929,7 @@ def decode_step_windowed(
             attn = decode_attention_windowed_paged(
                 q, kc, vc, ptable, lk, lv, k, v, positions, step,
                 softcap=cfg.attn_softcap, window=cfg.sliding_window,
-                sliding=_layer_sliding(cfg, li), impl=paged_impl,
+                sliding=_layer_sliding(cfg, li), impl=paged_impl, mesh=mesh,
             )
         elif use_sp:
             from localai_tpu.ops.attention import decode_attention_windowed_sp
@@ -989,6 +989,7 @@ def decode_chunk(
     ep: int = 1,
     ptable=None,  # [B, MP] int32 → `cache` is a page pool (paged KV mode)
     paged_impl: str = "auto",  # paged attention kernel: auto|pallas|xla
+    mesh=None,  # Mesh with tp>1 → paged Pallas kernel head-sharded
 ):
     """Multi-token decode: write T new k/v per slot and return logits for all
     T positions — the verify pass of speculative decoding (the reference
@@ -1074,7 +1075,7 @@ def decode_chunk(
             acc, m, l = paged_partials_mq(
                 q, kc, vc, ptable, positions[:, 0],
                 softcap=cfg.attn_softcap, window=cfg.sliding_window,
-                sliding=sliding, q_pos=positions, impl=paged_impl,
+                sliding=sliding, q_pos=positions, impl=paged_impl, mesh=mesh,
             )
             attn = _merge_partials_mq(
                 q, acc, m, l, k, v,
@@ -1357,6 +1358,7 @@ def prefill_chunk_paged(
     ep: int = 1,
     paged_impl: str = "auto",
     with_logits: bool = True,
+    mesh=None,  # Mesh with tp>1 → paged Pallas kernel head-sharded
 ):
     """One chunk of a ragged chunked prefill, direct-to-page (ISSUE 2).
 
@@ -1421,7 +1423,7 @@ def prefill_chunk_paged(
         acc, m, l = paged_prefill_partials(
             q, kc, vc, table, offsets,
             softcap=cfg.attn_softcap, window=cfg.sliding_window,
-            sliding=sliding, q_pos=positions, impl=paged_impl,
+            sliding=sliding, q_pos=positions, impl=paged_impl, mesh=mesh,
         )
         attn = _merge_partials_mq(
             q, acc, m, l, k, v, wmask, softcap=cfg.attn_softcap,

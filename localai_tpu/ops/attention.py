@@ -30,6 +30,29 @@ def softcap_scores(sc: jnp.ndarray, cap: float) -> jnp.ndarray:
     return cap * jnp.tanh(sc / cap)
 
 
+def _tp_degree(mesh) -> int:
+    """Tensor-parallel degree of a mesh (0/1 when absent) — the gate for the
+    head-sharded shard_map kernel paths (ISSUE 7)."""
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get("tp", 1))
+
+
+def _head_shard_map(fn, mesh, in_specs, out_specs):
+    """shard_map over the mesh's "tp" axis for per-head kernels. Pallas
+    custom calls are opaque to the SPMD partitioner — under a tp-sharded
+    GSPMD program XLA would all-gather their operands per call, exactly the
+    per-token collective the sharded engine must not pay. Wrapping the
+    kernel in shard_map hands each chip its OWN heads' q/k/v (and paged-pool
+    shard) and runs the unmodified kernel on local shapes; no collective is
+    introduced — the psum stays at the o-projection where GSPMD already puts
+    it (row-parallel wo, parallel/sharding.py)."""
+    from localai_tpu.parallel.mesh import shard_map as _shard_map
+
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=False)
+
+
 def prefill_attention(
     q: jnp.ndarray,  # [B, S, H, D]
     k: jnp.ndarray,  # [B, S, K, D]
@@ -39,10 +62,14 @@ def prefill_attention(
     softcap: float = 0.0,
     window: int = 0,
     sliding=None,  # traced bool scalar: this layer uses the sliding window
+    mesh=None,  # Mesh with tp>1 → flash kernel head-sharded under shard_map
 ) -> jnp.ndarray:
     """Prefill attention dispatcher: Pallas flash kernel on TPU by default
     (opt out with LOCALAI_FLASH=0), dense math otherwise. Softcapping /
-    sliding windows (gemma-2) force the dense path."""
+    sliding windows (gemma-2) force the dense path. With a tp>1 mesh the
+    flash kernel runs head-sharded under shard_map (each chip computes its
+    own heads; the dense-math path needs nothing — GSPMD partitions plain
+    einsums over the head axis by propagation)."""
     S = q.shape[1]
     if (
         lengths is not None
@@ -55,6 +82,19 @@ def prefill_attention(
         from localai_tpu.ops.flash import flash_block_sizes, flash_prefill_attention
 
         bq, bk = flash_block_sizes(S)
+        if _tp_degree(mesh) > 1:
+            from jax.sharding import PartitionSpec as P
+
+            fn = _head_shard_map(
+                lambda qs, ks, vs, ln: flash_prefill_attention(
+                    qs, ks, vs, ln, block_q=bq, block_k=bk
+                ),
+                mesh,
+                in_specs=(P(None, None, "tp", None), P(None, None, "tp", None),
+                          P(None, None, "tp", None), P(None)),
+                out_specs=P(None, None, "tp", None),
+            )
+            return fn(q, k, v, lengths)
         return flash_prefill_attention(q, k, v, lengths, block_q=bq, block_k=bk)
     return causal_prefill_attention(q, k, v, length_mask, softcap=softcap,
                                     window=window, sliding=sliding)
@@ -487,21 +527,66 @@ def _paged_cache_partials(q, k_pool, v_pool, table, limits,
     return acc, m, l
 
 
+def _paged_pallas_sharded(kernel_fn, mesh, q, k_pool, v_pool, table, limits,
+                          q_pos, sliding, mq: bool):
+    """Run a Pallas paged-partials kernel head-sharded over the mesh's "tp"
+    axis (ISSUE 7): q splits on its head axis, the pool on its kv-head axis
+    (the layout the engine stores it in — pages live on the head shard that
+    owns them), the page table/limits replicate (they are host-built i32
+    control state, KBs), and the partials come back head-sharded for the
+    (GSPMD-handled) o-projection psum. The kernel body is unchanged — it
+    just sees K/tp kv heads. `sliding` is a traced per-layer scalar, so it
+    rides as an explicit replicated operand (closure capture of tracers is
+    not valid under shard_map)."""
+    from jax.sharding import PartitionSpec as P
+
+    sl_in = sliding if sliding is not None else jnp.zeros((), bool)
+
+    def local(qs, kp, vp, tbl, lim, qp, sl):
+        return kernel_fn(qs, kp, vp, tbl, lim, q_pos=qp,
+                         sliding=sl if sliding is not None else None)
+
+    q_spec = P(None, None, "tp", None) if mq else P(None, "tp", None)
+    qp_spec = P(None, None) if mq else P(None)
+    out_specs = tuple(
+        P(None, "tp", *([None] * (3 if mq else 2))) for _ in range(3)
+    )
+    fn = _head_shard_map(
+        local, mesh,
+        in_specs=(q_spec, P(None, None, "tp", None), P(None, None, "tp", None),
+                  P(None, None), P(None), qp_spec, P()),
+        out_specs=out_specs,
+    )
+    return fn(q, k_pool, v_pool, table, limits, q_pos, sl_in)
+
+
 def paged_partials(q, k_pool, v_pool, table, limits, softcap: float = 0.0,
                    window: int = 0, sliding=None, q_pos=None,
-                   impl: str = "auto"):
+                   impl: str = "auto", mesh=None):
     """Paged online-softmax partials, dispatched: the fused Pallas ragged
     paged-attention kernel (ops/paged_flash — pages stream HBM→VMEM once,
     walk bounded per slot) or the XLA gather walk below (reference path and
     numeric oracle). Off-TPU the kernel runs in interpret mode, so CPU tier-1
-    tests exercise the same kernel code that compiles for TPU."""
+    tests exercise the same kernel code that compiles for TPU. With a tp>1
+    mesh the Pallas kernel runs head-sharded under shard_map (the XLA walk
+    needs nothing — its gathers/einsums partition over the kv-head axis by
+    GSPMD propagation, no collectives)."""
+    import functools
+
     from localai_tpu.ops.paged_flash import paged_decode_partials, use_pallas
 
     if use_pallas(impl):
+        interp = jax.default_backend() != "tpu"
+        if _tp_degree(mesh) > 1:
+            return _paged_pallas_sharded(
+                functools.partial(paged_decode_partials, softcap=softcap,
+                                  window=window, interpret=interp),
+                mesh, q, k_pool, v_pool, table, limits,
+                limits if q_pos is None else q_pos, sliding, mq=False,
+            )
         return paged_decode_partials(
             q, k_pool, v_pool, table, limits, softcap=softcap, window=window,
-            sliding=sliding, q_pos=q_pos,
-            interpret=jax.default_backend() != "tpu",
+            sliding=sliding, q_pos=q_pos, interpret=interp,
         )
     return _paged_cache_partials(
         q, k_pool, v_pool, table, limits,
@@ -511,19 +596,30 @@ def paged_partials(q, k_pool, v_pool, table, limits, softcap: float = 0.0,
 
 def paged_partials_mq(q, k_pool, v_pool, table, limits, softcap: float = 0.0,
                       window: int = 0, sliding=None, q_pos=None,
-                      impl: str = "auto"):
+                      impl: str = "auto", mesh=None):
     """Multi-query `paged_partials` (speculative verify chunk) — same
     dispatch."""
+    import functools
+
     from localai_tpu.ops.paged_flash import (
         paged_decode_partials_mq,
         use_pallas,
     )
 
     if use_pallas(impl):
+        interp = jax.default_backend() != "tpu"
+        if _tp_degree(mesh) > 1:
+            T = q.shape[1]
+            qp = (jnp.broadcast_to(limits[:, None], (q.shape[0], T))
+                  if q_pos is None else q_pos)
+            return _paged_pallas_sharded(
+                functools.partial(paged_decode_partials_mq, softcap=softcap,
+                                  window=window, interpret=interp),
+                mesh, q, k_pool, v_pool, table, limits, qp, sliding, mq=True,
+            )
         return paged_decode_partials_mq(
             q, k_pool, v_pool, table, limits, softcap=softcap, window=window,
-            sliding=sliding, q_pos=q_pos,
-            interpret=jax.default_backend() != "tpu",
+            sliding=sliding, q_pos=q_pos, interpret=interp,
         )
     return _paged_cache_partials_mq(
         q, k_pool, v_pool, table, limits,
@@ -533,22 +629,35 @@ def paged_partials_mq(q, k_pool, v_pool, table, limits, softcap: float = 0.0,
 
 def paged_prefill_partials(q, k_pool, v_pool, table, limits,
                            softcap: float = 0.0, window: int = 0,
-                           sliding=None, q_pos=None, impl: str = "auto"):
+                           sliding=None, q_pos=None, impl: str = "auto",
+                           mesh=None):
     """Paged partials for a PREFILL CHUNK (models/llama.prefill_chunk_paged):
     q [B, T, H, D] covers a whole chunk, limits[b] is the rows already
     resident (the chunk's start offset). Same dispatch as paged_partials_mq,
     but the Pallas side tiles the chunk's query rows so any chunk size fits
-    the kernel's VMEM running state (ops/paged_flash.paged_prefill_partials_mq)."""
+    the kernel's VMEM running state (ops/paged_flash.paged_prefill_partials_mq).
+    With a tp>1 mesh the tiled kernel runs head-sharded under shard_map."""
+    import functools
+
     from localai_tpu.ops.paged_flash import (
         paged_prefill_partials_mq,
         use_pallas,
     )
 
     if use_pallas(impl):
+        interp = jax.default_backend() != "tpu"
+        if _tp_degree(mesh) > 1:
+            T = q.shape[1]
+            qp = (jnp.broadcast_to(limits[:, None], (q.shape[0], T))
+                  if q_pos is None else q_pos)
+            return _paged_pallas_sharded(
+                functools.partial(paged_prefill_partials_mq, softcap=softcap,
+                                  window=window, interpret=interp),
+                mesh, q, k_pool, v_pool, table, limits, qp, sliding, mq=True,
+            )
         return paged_prefill_partials_mq(
             q, k_pool, v_pool, table, limits, softcap=softcap, window=window,
-            sliding=sliding, q_pos=q_pos,
-            interpret=jax.default_backend() != "tpu",
+            sliding=sliding, q_pos=q_pos, interpret=interp,
         )
     return _paged_cache_partials_mq(
         q, k_pool, v_pool, table, limits,
@@ -571,6 +680,7 @@ def decode_attention_windowed_paged(
     window: int = 0,
     sliding=None,
     impl: str = "auto",
+    mesh=None,  # Mesh with tp>1 → Pallas kernel head-sharded (shard_map)
 ) -> jnp.ndarray:
     """`decode_attention_windowed` over a paged pool: paged partials for
     rows [0, block_start), dense merge of the (tiny) local window + current
@@ -579,7 +689,7 @@ def decode_attention_windowed_paged(
     acc, m, l = paged_partials(
         q, k_pool, v_pool, table, positions - step,
         softcap=softcap, window=window, sliding=sliding, q_pos=positions,
-        impl=impl,
+        impl=impl, mesh=mesh,
     )
     # f32 concat: the block-local window may live in the cache's storage
     # dtype (fp8 KV) while the current token is model-dtype.

@@ -15,4 +15,9 @@ shardings; nothing here opens a socket.
 """
 
 from localai_tpu.parallel.mesh import MeshPlan, build_mesh  # noqa: F401
-from localai_tpu.parallel.sharding import param_shardings, cache_shardings  # noqa: F401
+from localai_tpu.parallel.sharding import (  # noqa: F401
+    ShardingPlanError,
+    cache_shardings,
+    max_valid_tp,
+    param_shardings,
+)

@@ -18,7 +18,7 @@ PartitionSpecs and XLA compiles the collectives.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -26,6 +26,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from localai_tpu.models.config import ArchConfig
 
 Params = dict[str, Any]
+
+
+class ShardingPlanError(ValueError):
+    """A mesh plan cannot shard this architecture evenly (ISSUE 7).
+
+    Subclasses ValueError so existing `except ValueError` probes keep
+    working, but carries structure the engine uses to DEGRADE instead of
+    crash at load: `max_tp` is the largest tp <= the requested one that the
+    architecture supports (via max_valid_tp), or 0 when the failure is not
+    a tp-divisibility problem (e.g. an ep mismatch)."""
+
+    def __init__(self, message: str, *, axis: str = "tp", requested: int = 0,
+                 max_tp: int = 0) -> None:
+        super().__init__(message)
+        self.axis = axis
+        self.requested = requested
+        self.max_tp = max_tp
 
 
 def _attn_specs(cfg: ArchConfig) -> dict[str, P]:
@@ -181,6 +198,39 @@ def cache_shardings(mesh: Mesh, sp: int = 1,
     return NamedSharding(mesh, ks), NamedSharding(mesh, vs)
 
 
+def _tp_violation(cfg: ArchConfig, tp: int) -> Optional[str]:
+    """First tp-divisibility violation, or None. Shared by validate_plan
+    (raises) and max_valid_tp (probes) so probing never constructs
+    exceptions n² deep."""
+    if not cfg.is_mla and cfg.num_kv_heads % tp != 0:
+        # MLA has no per-head kv cache to shard — the latent replicates and
+        # only the H-axis tensors (q_b, w_kb/w_vb, wo) split over tp.
+        return (
+            f"num_kv_heads={cfg.num_kv_heads} not divisible by tp={tp}; "
+            f"choose tp in divisors of kv heads for {cfg.name}"
+        )
+    if cfg.num_heads % tp != 0:
+        return f"num_heads={cfg.num_heads} not divisible by tp={tp}"
+    if cfg.intermediate_size % tp != 0:
+        return f"intermediate_size={cfg.intermediate_size} not divisible by tp={tp}"
+    if cfg.vocab_size % tp != 0:
+        return (
+            f"vocab_size={cfg.vocab_size} not divisible by tp={tp} "
+            "(embed/lm_head are vocab-parallel)"
+        )
+    if cfg.is_moe:
+        if cfg.moe_inter_size % tp != 0:
+            return (
+                f"moe_intermediate_size={cfg.moe_inter_size} not divisible by tp={tp}"
+            )
+        if cfg.n_shared_experts and (cfg.n_shared_experts * cfg.moe_inter_size) % tp != 0:
+            return (
+                f"shared-expert width {cfg.n_shared_experts * cfg.moe_inter_size} "
+                f"not divisible by tp={tp}"
+            )
+    return None
+
+
 def max_valid_tp(cfg: ArchConfig, n_devices: int) -> int:
     """Largest tp ≤ n_devices that divides every sharded dimension.
 
@@ -188,41 +238,26 @@ def max_valid_tp(cfg: ArchConfig, n_devices: int) -> int:
     integers are probed — e.g. 6 kv-heads on 8 devices serves at tp=6.
     """
     for tp in range(n_devices, 1, -1):
-        try:
-            validate_plan(cfg, tp)
+        if _tp_violation(cfg, tp) is None:
             return tp
-        except ValueError:
-            continue
     return 1
 
 
 def validate_plan(cfg: ArchConfig, tp: int, ep: int = 1) -> None:
-    """Fail fast on shapes that cannot shard evenly (XLA would pad silently)."""
-    if not cfg.is_mla and cfg.num_kv_heads % tp != 0:
-        # MLA has no per-head kv cache to shard — the latent replicates and
-        # only the H-axis tensors (q_b, w_kb/w_vb, wo) split over tp.
-        raise ValueError(
-            f"num_kv_heads={cfg.num_kv_heads} not divisible by tp={tp}; "
-            f"choose tp in divisors of kv heads for {cfg.name}"
+    """Fail fast on shapes that cannot shard evenly (XLA would pad silently).
+
+    tp failures raise ShardingPlanError with `max_tp` naming the largest tp
+    this architecture supports at or below the requested one — the engine
+    auto-degrades to it instead of crashing at load (ISSUE 7)."""
+    msg = _tp_violation(cfg, tp)
+    if msg is not None:
+        max_tp = max_valid_tp(cfg, tp)
+        raise ShardingPlanError(
+            f"{msg} (max valid tp for {cfg.name}: {max_tp})",
+            axis="tp", requested=tp, max_tp=max_tp,
         )
-    if cfg.num_heads % tp != 0:
-        raise ValueError(f"num_heads={cfg.num_heads} not divisible by tp={tp}")
-    if cfg.intermediate_size % tp != 0:
-        raise ValueError(f"intermediate_size={cfg.intermediate_size} not divisible by tp={tp}")
-    if cfg.vocab_size % tp != 0:
-        raise ValueError(
-            f"vocab_size={cfg.vocab_size} not divisible by tp={tp} "
-            "(embed/lm_head are vocab-parallel)"
+    if cfg.is_moe and cfg.num_experts % ep != 0:
+        raise ShardingPlanError(
+            f"num_experts={cfg.num_experts} not divisible by ep={ep}",
+            axis="ep", requested=ep, max_tp=0,
         )
-    if cfg.is_moe:
-        if cfg.num_experts % ep != 0:
-            raise ValueError(f"num_experts={cfg.num_experts} not divisible by ep={ep}")
-        if cfg.moe_inter_size % tp != 0:
-            raise ValueError(
-                f"moe_intermediate_size={cfg.moe_inter_size} not divisible by tp={tp}"
-            )
-        if cfg.n_shared_experts and (cfg.n_shared_experts * cfg.moe_inter_size) % tp != 0:
-            raise ValueError(
-                f"shared-expert width {cfg.n_shared_experts * cfg.moe_inter_size} "
-                f"not divisible by tp={tp}"
-            )

@@ -555,8 +555,13 @@ class ModelManager:
         n_devices = len(jax.devices())
         par = cfg.parallel
         avail = n_devices // max(1, par.dp * par.ep * par.sp)
-        tp = par.tp or max_valid_tp(arch, max(1, avail))
-        plan = MeshPlan(dp=par.dp, tp=max(1, tp), ep=par.ep, sp=par.sp)
+        # tensor_parallel (ISSUE 7): the flat YAML knob wins over the nested
+        # parallel.tp; -1/"auto" and 0 both fall back to the auto pick
+        # (all devices left after dp/ep/sp, degraded to max_valid_tp).
+        tp = cfg.tensor_parallel if cfg.tensor_parallel > 0 else par.tp
+        tp = tp or max_valid_tp(arch, max(1, avail))
+        tp = min(max(1, tp), max(1, avail))
+        plan = MeshPlan(dp=par.dp, tp=tp, ep=par.ep, sp=par.sp)
 
         tok_path = cfg.tokenizer or gguf_tok_dir or (ckpt_dir if ckpt_dir else None)
         if (tok_path and tok_path != "synthetic-bytes"
@@ -588,8 +593,20 @@ class ModelManager:
             # Load-time host quantization: the bf16 tree never touches HBM,
             # so int8 checkpoints up to ~2x HBM serve from one chip. LoRA
             # deltas merge on the host in the same pass, before quantizing.
+            put = None
+            if plan.total > 1:
+                # Sharded placement AS EACH TENSOR IS READ (ISSUE 7):
+                # jax.device_put with the param's NamedSharding ships every
+                # chip its shard only — the full tree never materializes
+                # replicated in HBM. (Quantized leaves keep their own
+                # placement path and are re-placed by the engine.)
+                from localai_tpu.engine.weights import sharded_put
+                from localai_tpu.parallel.mesh import build_mesh
+
+                put = sharded_put(arch, build_mesh(plan))
             params = load_hf_checkpoint(
-                arch, ckpt_dir, quantize=cfg.quantization, lora=lora or None
+                arch, ckpt_dir, put=put, quantize=cfg.quantization,
+                lora=lora or None,
             )
             for adir, w in lora:
                 log.info("model %s: merged lora adapter %s (weight=%.2f)",
@@ -630,6 +647,7 @@ class ModelManager:
             mesh_plan=plan,
             engine_cfg=EngineConfig(
                 max_slots=cfg.max_slots, max_seq=cfg.context_size,
+                tensor_parallel=cfg.tensor_parallel,
                 kv_pages=cfg.kv_pages, kv_page_size=cfg.kv_page_size,
                 kv_page_headroom=cfg.kv_page_headroom,
                 kv_preempt=cfg.kv_preempt,

@@ -26,6 +26,18 @@ Hook sites (each is one `faults.fire(SITE)` call in production code):
                      (_swap_out_pages/_swap_in_pages).
   manager_load     — entry of ModelManager._load: exercises the failed-load
                      containment (RuntimeError to that one caller).
+  collective_dispatch — fired by Engine._dispatch_admit/_dispatch_block
+                     ONLY when the engine runs on a multi-device mesh
+                     (tensor parallel, ISSUE 7), just before the sharded
+                     program launch. Stands in for an ICI/collective
+                     failure mid-dispatch: the containment contract is the
+                     same as device_dispatch (error events, never a hung
+                     caller), and a schedule that combines it with
+                     engine_loop must still leave the GLOBAL page
+                     allocator fully accounted after _release_all_state —
+                     the host-side allocator/refcounts are shared by every
+                     shard, so a mid-collective death may not strand any
+                     shard's pages.
   cluster_dispatch — entry of ClusterClient._run_inner (cluster/scheduler).
                      Raising here exercises the cluster layer's terminal-
                      event containment: the caller gets a typed error event,
@@ -70,6 +82,7 @@ SITES = (
     "manager_load",
     "cluster_dispatch",
     "span_transfer",
+    "collective_dispatch",
 )
 
 DEFAULT_RATE = 0.05

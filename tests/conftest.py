@@ -21,11 +21,70 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "multichip: needs the forced 8-device CPU mesh (tp>1 engine tests); "
+        "re-executed in a subprocess with XLA_FLAGS="
+        "--xla_force_host_platform_device_count=8 when this process somehow "
+        "initialized jax with fewer devices",
+    )
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {devs}"
     return devs
+
+
+# multichip marker/fixture (ISSUE 7 satellite): tp=2/tp=4 engine tests need
+# a multi-device mesh. This conftest already forces 8 virtual CPU devices,
+# so the fixture normally just hands back the device count and the test runs
+# inline (a tier-1 pass dot, thread-leak guard included). The subprocess
+# fallback covers the environments where that forcing loses — jax already
+# initialized (sitecustomize pinning a real backend) or an externally-set
+# XLA_FLAGS: the marked tests of the requesting module are re-executed once
+# in a child pytest with the flag forced (same idiom as the
+# affinity-stability subprocess test in test_cluster.py), and the parent
+# test reports the child's verdict.
+_MULTICHIP_MODULE_RESULT: dict = {}
+
+
+@pytest.fixture
+def multichip(request):
+    n = jax.device_count()
+    if n >= 8 or os.environ.get("LOCALAI_MULTICHIP_CHILD") == "1":
+        return n
+    import subprocess
+    import sys
+
+    mod = str(request.node.fspath)
+    if mod not in _MULTICHIP_MODULE_RESULT:
+        kept = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                if "xla_force_host_platform_device_count" not in f]
+        env = {
+            **os.environ,
+            "XLA_FLAGS": " ".join(
+                kept + ["--xla_force_host_platform_device_count=8"]),
+            "JAX_PLATFORMS": "cpu",
+            "LOCALAI_MULTICHIP_CHILD": "1",
+        }
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q", "-m", "multichip",
+             "-p", "no:cacheprovider", mod],
+            capture_output=True, text=True, timeout=600, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        _MULTICHIP_MODULE_RESULT[mod] = (
+            proc.returncode, proc.stdout[-4000:] + proc.stderr[-4000:]
+        )
+    rc, out = _MULTICHIP_MODULE_RESULT[mod]
+    if rc != 0:
+        pytest.fail(
+            f"multichip subprocess re-run of {mod} failed (rc={rc}):\n{out}"
+        )
+    pytest.skip("passed in the 8-device subprocess re-run")
 
 
 # Thread-leak guard (ISSUE 4 satellite): the supervisor restart path is

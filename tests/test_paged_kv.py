@@ -465,14 +465,19 @@ def test_stop_during_preemption_posts_terminal_events():
     assert len(done) == 2 and set(done) <= {"done", "error"}
 
 
-def test_allocator_invariants_randomized():
+@pytest.mark.multichip
+def test_allocator_invariants_randomized(multichip):
     """Seeded random walk over the allocator primitives — admit-style
     alloc (with and without shared prefix pages), growth, prefix-save
     style span pinning, pressure eviction (spill to host tier), host
     promotion, release, double-release, and preempt-style swap-out — with
-    the full invariant suite asserted after every step."""
+    the full invariant suite asserted after every step. Runs under the
+    multichip marker with a tp-SHARDED pool (ISSUE 7): the allocator,
+    refcounts, and page tables are host-global regardless of how the pool's
+    kv-head axis is split, so every invariant must hold unchanged."""
     rng = np.random.default_rng(7)
-    eng = _mk_engine_cfg(kv_pages=16, kv_swap_bytes=64 << 20)
+    eng = _mk_engine_cfg(kv_pages=16, kv_swap_bytes=64 << 20,
+                         tensor_parallel=2 if multichip >= 2 else 0)
     B = eng.ecfg.max_slots
     try:
         serial = 0
@@ -525,11 +530,15 @@ def test_allocator_invariants_randomized():
         eng.stop()
 
 
-def test_randomized_workload_invariants_hold_at_quiesce():
+@pytest.mark.multichip
+def test_randomized_workload_invariants_hold_at_quiesce(multichip):
     """End-to-end randomized admit/decode/finish/preempt churn on a small
-    pool; after every batch drains, the pool must be perfectly accounted."""
+    pool; after every batch drains, the pool must be perfectly accounted.
+    Under the multichip marker the pool is tp-sharded (ISSUE 7) — growth,
+    preemption, swap and quiesce accounting must not notice."""
     rng = np.random.default_rng(3)
-    eng = _mk_engine_cfg(kv_pages=10, max_seq=256, kv_preempt="auto")
+    eng = _mk_engine_cfg(kv_pages=10, max_seq=256, kv_preempt="auto",
+                         tensor_parallel=2 if multichip >= 2 else 0)
     import threading
     try:
         for batch in range(3):

@@ -29,6 +29,7 @@ DEFAULT_TARGETS = [
     ("localai_tpu/cluster/scheduler.py", "ClusterScheduler"),
     ("localai_tpu/cluster/scheduler.py", "ClusterClient"),
     ("localai_tpu/cluster/replica.py", "ClusterEngine"),
+    ("localai_tpu/parallel/sharding.py", "ShardingPlanError"),
 ]
 
 

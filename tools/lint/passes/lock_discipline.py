@@ -25,6 +25,7 @@ DEFAULT_GLOBS = [
     "localai_tpu/server/manager.py",
     "localai_tpu/federation/router.py",
     "localai_tpu/cluster/*.py",
+    "localai_tpu/parallel/*.py",
 ]
 
 _LOCK_CTORS = {"Lock", "RLock", "Condition"}

@@ -46,6 +46,9 @@ TRACED_MODULE_GLOBS = [
     # path): any jnp/lax value it manufactures — and then branches on or
     # pulls — is a sync the scheduler would pay per request.
     "localai_tpu/cluster/*.py",
+    # The parallel layer traces inside every sharded program (shard_map
+    # bodies, ring rotation) — a host sync here stalls ALL chips (ISSUE 7).
+    "localai_tpu/parallel/*.py",
 ]
 
 ENGINE_TARGET = ("localai_tpu/engine/engine.py", "Engine")
