@@ -23,6 +23,13 @@ import jax.numpy as jnp
 
 NEG_INF = -1e30
 
+# Declared ICI-collective boundary (lint: sharding-consistency). The ONLY
+# function in this module allowed to issue cross-chip collectives is the
+# sp-axis flash-decoding combine — everything else must stay collective-free
+# so the per-token path pays ICI exclusively at the o/down projections
+# (GSPMD psums from the row-parallel specs in parallel/sharding.py).
+COLLECTIVE_BOUNDARY = ("_sp_cache_partials",)
+
 
 def softcap_scores(sc: jnp.ndarray, cap: float) -> jnp.ndarray:
     """Gemma-2 attention-logit softcapping: cap·tanh(sc/cap). Applied BEFORE

@@ -22,6 +22,11 @@ from localai_tpu.parallel.mesh import shard_map as _shard_map
 
 NEG_INF = -1e30
 
+# Declared ICI-collective boundary (lint: sharding-consistency): the ring
+# rotation itself. KV blocks ppermute neighbor-to-neighbor inside
+# _local_ring's shard_map body; no other function here may touch ICI.
+COLLECTIVE_BOUNDARY = ("_local_ring",)
+
 
 def _local_ring(q, k, v, lengths, *, axis: str, n_shards: int,
                 softcap: float = 0.0, window: int = 0, sliding=None):

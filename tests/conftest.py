@@ -103,6 +103,15 @@ _GUARDED_THREAD_PREFIXES = (
     "config-watcher",
     "stream-reader",
     "fed-health",
+    # Cluster scheduler threads (ISSUE 8 satellite): the per-request
+    # dispatch pumps ("cluster-pump-<rid>") own the reroute path AND the
+    # scheduler's gauge refresh (refresh() runs inline on them). A pump
+    # that outlives its request means a terminal event was never posted
+    # (the ClusterClient _finish/_abort contract) and the thread spins on
+    # a dead handle forever. They previously outlived tests unchecked.
+    # "cluster-gauge" guards any future dedicated refresher thread.
+    "cluster-pump",
+    "cluster-gauge",
 )
 
 

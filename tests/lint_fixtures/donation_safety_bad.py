@@ -1,0 +1,44 @@
+"""Known-bad fixture for the donation-safety pass: donated buffers read
+after the donating call — directly, on an error path, through a *args
+tuple, and via a builder method (the interprocedural case)."""
+
+import jax
+
+
+def read_after_donate(cache, x):
+    step = jax.jit(lambda c, v: c + v, donate_argnums=(0,))
+    out = step(cache, x)
+    # cache was deleted by the donation — this read explodes at runtime.
+    return out + cache
+
+
+def donate_twice_in_loop(cache, xs):
+    step = jax.jit(lambda c, v: c + v, donate_argnums=(0,))
+    out = None
+    for x in xs:
+        out = step(cache, x)  # iteration 2 donates a deleted buffer
+    return out
+
+
+class Engine:
+    def __init__(self, cache, counts):
+        self.cache = cache
+        self.counts = counts
+
+    def _get_block(self):
+        donate = (1, 2)
+
+        def block(params, cache, counts):
+            return cache + counts, counts + 1
+
+        fn = jax.jit(block, donate_argnums=donate)
+        return fn
+
+    def dispatch(self, params):
+        fn = self._get_block()
+        args = (params, self.cache, self.counts)
+        new_cache, new_counts = fn(*args)
+        self.cache = new_cache
+        # self.counts was donated at position 2 and never rebound — the
+        # next dispatch ships a deleted buffer.
+        return self.counts

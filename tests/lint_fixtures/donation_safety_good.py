@@ -1,0 +1,41 @@
+"""Known-good fixture for the donation-safety pass: the engine's real
+idioms — donate then REBIND from the call's results (directly, through
+*args tuples, and via builder methods) — none of which may fire."""
+
+import jax
+
+
+def rebind(cache, x):
+    step = jax.jit(lambda c, v: c + v, donate_argnums=(0,))
+    cache = step(cache, x)
+    return cache
+
+
+def loop_rebind(cache, xs):
+    step = jax.jit(lambda c, v: c + v, donate_argnums=(0,))
+    for x in xs:
+        cache = step(cache, x)
+    return cache
+
+
+class Engine:
+    def __init__(self, cache, counts):
+        self.cache = cache
+        self.counts = counts
+
+    def _get_block(self):
+        donate = (1, 2)
+
+        def block(params, cache, counts):
+            return cache + counts, counts + 1
+
+        fn = jax.jit(block, donate_argnums=donate)
+        return fn
+
+    def dispatch(self, params):
+        fn = self._get_block()
+        args = (params, self.cache, self.counts)
+        # Every donated operand is rebound from the outputs in the same
+        # statement — the _dispatch_block shape.
+        self.cache, self.counts = fn(*args)
+        return self.counts

@@ -1,0 +1,32 @@
+"""Known-bad fixture for the lock-order pass: a 2-lock cycle split across
+methods — thread A runs rebalance() (sched then pool), thread B runs
+grow() (pool then, via a helper call, sched). Neither function alone is
+wrong; the ORDER INVERSION only exists interprocedurally."""
+
+import threading
+
+
+class Scheduler:
+    def __init__(self):
+        self._sched_lock = threading.Lock()
+        self._pool_lock = threading.Lock()
+        self.assignments = {}
+        self.pages = []
+
+    def rebalance(self):
+        # sched -> pool
+        with self._sched_lock:
+            victims = list(self.assignments)
+            with self._pool_lock:
+                self.pages = [p for p in self.pages if p not in victims]
+
+    def _admit_locked_pages(self):
+        # helper: takes the sched lock to publish the admission
+        with self._sched_lock:
+            self.assignments["new"] = len(self.pages)
+
+    def grow(self):
+        # pool -> (call) -> sched: the inverse order of rebalance()
+        with self._pool_lock:
+            self.pages.append(object())
+            self._admit_locked_pages()

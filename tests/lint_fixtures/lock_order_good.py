@@ -1,0 +1,26 @@
+"""Known-good fixture for the lock-order pass: same two locks, but every
+path takes them in ONE global order (sched before pool), and the
+caller-holds-lock convention (`*_locked`) is used instead of re-acquiring."""
+
+import threading
+
+
+class Scheduler:
+    def __init__(self):
+        self._sched_lock = threading.Lock()
+        self._pool_lock = threading.Lock()
+        self.assignments = {}
+        self.pages = []
+
+    def rebalance(self):
+        with self._sched_lock:
+            victims = list(self.assignments)
+            with self._pool_lock:
+                self.pages = [p for p in self.pages if p not in victims]
+
+    def grow(self):
+        # Same global order: sched first, pool second.
+        with self._sched_lock:
+            with self._pool_lock:
+                self.pages.append(object())
+                self.assignments["new"] = len(self.pages)

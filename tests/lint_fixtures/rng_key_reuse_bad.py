@@ -1,0 +1,40 @@
+"""Known-bad fixture for the rng-key-reuse pass: keys consumed twice —
+straight-line, after a split, every loop iteration, and through a helper
+whose summary says it consumes its key parameter (the interprocedural
+case)."""
+
+import jax
+
+
+def double_draw(key):
+    # Same key, two samplers: noise and temps are CORRELATED.
+    noise = jax.random.normal(key, (8,))
+    temps = jax.random.uniform(key, (8,))
+    return noise + temps
+
+
+def parent_after_split(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (4,))
+    # Splitting again from the already-split parent reproduces k1/k2.
+    k3, k4 = jax.random.split(key)
+    return a, k3, k4
+
+
+def loop_reuse(key, steps):
+    out = []
+    for _ in range(steps):
+        # Identical draw every iteration — the chain never advances.
+        out.append(jax.random.normal(key, (2,)))
+    return out
+
+
+def sample_logits(rng, logits):
+    """Helper that CONSUMES its key parameter (summary: rng consumed)."""
+    return jax.random.categorical(rng, logits)
+
+
+def helper_reuse(key, logits):
+    tok_a = sample_logits(key, logits)
+    tok_b = sample_logits(key, logits)  # same key through the helper
+    return tok_a, tok_b

@@ -1,0 +1,46 @@
+"""Known-good fixture for the rng-key-reuse pass: every idiom the repo
+actually uses — split-chains, fold_in derivation, batched vmap keys, and
+branch-exclusive consumption — none of which may fire."""
+
+import jax
+
+
+def chain(key, steps):
+    out = []
+    for _ in range(steps):
+        key, sub = jax.random.split(key)
+        out.append(jax.random.normal(sub, (2,)))
+    return out
+
+
+def fold_derive(key, steps):
+    # fold_in(key, i) with varying data is the blessed reuse of one base.
+    return [jax.random.normal(jax.random.fold_in(key, i), (2,))
+            for i in range(steps)]
+
+
+def batched(rngs, logits):
+    # The engine's per-slot chain: split every key, draw from the child,
+    # carry the parent forward.
+    split = jax.vmap(lambda k: jax.random.split(k, 2))(rngs)
+    rngs, draw = split[:, 0], split[:, 1]
+    toks = jax.vmap(jax.random.categorical)(draw, logits)
+    return rngs, toks
+
+
+def branch_exclusive(key, flag):
+    # Only ONE branch runs — a single consumption either way.
+    if flag:
+        return jax.random.normal(key, (2,))
+    return jax.random.uniform(key, (2,))
+
+
+def sample_logits(rng, logits):
+    return jax.random.categorical(rng, logits)
+
+
+def helper_once(key, logits):
+    k1, k2 = jax.random.split(key)
+    a = sample_logits(k1, logits)
+    b = sample_logits(k2, logits)
+    return a, b

@@ -1,0 +1,21 @@
+"""Bad: a collective outside the declared boundary, plus a stale
+boundary declaration naming a collective-free function."""
+
+import jax
+import jax.numpy as jnp
+
+COLLECTIVE_BOUNDARY = ("combine_partials",)
+
+
+def combine_partials(acc):
+    # Stale: declared as a boundary but issues no collective anymore.
+    return acc * 2
+
+
+def rogue_reduce(x):
+    # Collective OUTSIDE the declared boundary — an undeclared ICI hop.
+    return jax.lax.psum(x, "tp")
+
+
+def local_math(x):
+    return jnp.sum(x, axis=-1)

@@ -1,0 +1,1 @@
+AXES = ("dp", "tp")

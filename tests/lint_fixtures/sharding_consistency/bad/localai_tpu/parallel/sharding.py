@@ -1,0 +1,15 @@
+"""Bad: spec/tree name drift (wq_proj vs wq), and a ghost mesh axis."""
+
+from jax.sharding import PartitionSpec as P
+
+
+def param_specs(cfg):
+    return {
+        "embed": P("tp", None),
+        # Drift: the tree calls this "wq"; renaming only here strands the
+        # real weight with no spec.
+        "wq_proj": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        # Ghost axis: "mp" is not declared in mesh.AXES.
+        "w_down": P(None, "mp", None),
+    }

@@ -1,0 +1,14 @@
+import jax
+import jax.numpy as jnp
+
+
+def init_params(cfg, key):
+    keys = iter(jax.random.split(key, 8))
+    params = {
+        "embed": jax.random.normal(next(keys), (8, 4)),
+        "wq": jax.random.normal(next(keys), (2, 4, 4)),
+        "wo": jax.random.normal(next(keys), (2, 4, 4)),
+        "w_down": jax.random.normal(next(keys), (2, 4, 4)),
+    }
+    params["final_norm"] = jnp.ones((4,))
+    return params

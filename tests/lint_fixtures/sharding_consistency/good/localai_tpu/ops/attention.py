@@ -1,0 +1,17 @@
+"""Good: collectives only inside the declared boundary, declared axes
+only."""
+
+import jax
+import jax.numpy as jnp
+
+COLLECTIVE_BOUNDARY = ("combine_partials",)
+
+
+def combine_partials(acc, l):
+    m = jax.lax.pmax(acc, "tp")
+    total = jax.lax.psum(l, axis_name="tp")
+    return m, total
+
+
+def local_math(x):
+    return jnp.sum(x, axis=-1)

@@ -1,0 +1,13 @@
+"""Good: spec names match the tree exactly; only declared axes used."""
+
+from jax.sharding import PartitionSpec as P
+
+
+def param_specs(cfg):
+    return {
+        "embed": P("tp", None),
+        "wq": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "w_down": P(None, "tp", None),
+        "final_norm": P(None),
+    }

@@ -19,10 +19,16 @@ from tools.lint import Repo, run_passes, run_repo  # noqa: E402
 from tools.lint.passes import all_passes  # noqa: E402
 from tools.lint.passes.attr_init import AttrInitPass  # noqa: E402
 from tools.lint.passes.config_drift import ConfigDriftPass  # noqa: E402
+from tools.lint.passes.donation_safety import DonationSafetyPass  # noqa: E402
 from tools.lint.passes.fault_sites import FaultSitesPass  # noqa: E402
 from tools.lint.passes.lock_discipline import LockDisciplinePass  # noqa: E402
+from tools.lint.passes.lock_order import LockOrderPass  # noqa: E402
 from tools.lint.passes.metric_counters import MetricCountersPass  # noqa: E402
 from tools.lint.passes.page_refcount import PageRefcountPass  # noqa: E402
+from tools.lint.passes.rng_key_reuse import RngKeyReusePass  # noqa: E402
+from tools.lint.passes.sharding_consistency import (  # noqa: E402
+    ShardingConsistencyPass,
+)
 from tools.lint.passes.terminal_event import TerminalEventPass  # noqa: E402
 from tools.lint.passes.trace_safety import TraceSafetyPass  # noqa: E402
 
@@ -42,18 +48,28 @@ def _full_run():
 
 
 # --------------------------------------------------------------------- #
-# The acceptance gate: the repo itself is clean under all 8 passes.
+# The acceptance gate: the repo itself is clean under all 12 passes.
 # --------------------------------------------------------------------- #
 
 def test_repo_is_clean_under_all_passes():
     result, elapsed = _full_run()
-    assert len(result.pass_ids) == 8, result.pass_ids
+    assert len(result.pass_ids) == 12, result.pass_ids
     assert result.clean, "lint findings on the repo:\n" + "\n".join(
         f.render() for f in result.active
     )
-    # Tier-1 budget: the whole suite must stay fast (ISSUE 5: <10 s; the
-    # run itself gets a tighter bound so fixtures + CLI fit too).
-    assert elapsed < 8.0, f"lint suite took {elapsed:.1f}s"
+    # Tier-1 budget (ISSUE 5/8): all 12 passes under 10 s. Typical
+    # unloaded wall time is ~4-5 s; the bound absorbs CI load. When this
+    # trips, result.timings names the pass that regressed.
+    assert elapsed < 10.0, (
+        f"lint suite took {elapsed:.1f}s — slowest passes: "
+        + ", ".join(f"{pid}={secs*1000:.0f}ms" for pid, secs in
+                    sorted(result.timings.items(), key=lambda kv: -kv[1])[:3])
+    )
+    # Per-pass wall time is reported so budget regressions are attributable
+    # (ISSUE 8 satellite).
+    assert set(result.timings) == set(result.pass_ids)
+    by_pass = result.by_pass()
+    assert all("wall_time_ms" in by_pass[pid] for pid in result.pass_ids)
 
 
 def test_cli_json_exits_zero():
@@ -71,9 +87,9 @@ def test_cli_json_exits_zero():
 
 
 def test_suppression_count_never_grows():
-    """LINT_r01.json pins the suppression budget: future PRs may only
+    """LINT_r02.json pins the suppression budget: future PRs may only
     shrink it (fix the code instead of silencing the pass)."""
-    with open(os.path.join(REPO, "LINT_r01.json")) as f:
+    with open(os.path.join(REPO, "LINT_r02.json")) as f:
         pinned = json.load(f)
     result, _ = _full_run()
     assert len(result.suppressed) <= pinned["total_suppressions"], (
@@ -82,6 +98,9 @@ def test_suppression_count_never_grows():
         "fix the finding instead of suppressing it, or justify lowering "
         "the bar by regenerating LINT_rNN.json in its own PR"
     )
+    # The budget itself stays <= 3 unless each extra carries a written
+    # reason AND the baseline regen documents it (ISSUE 8 satellite).
+    assert pinned["total_suppressions"] <= 3, pinned
 
 
 # --------------------------------------------------------------------- #
@@ -191,6 +210,91 @@ def test_config_drift_fixtures():
     assert _run_single(good, root=groot).clean
 
 
+# ---- interprocedural passes (ISSUE 8) ---- #
+
+def test_lock_order_fixtures():
+    rel = "tests/lint_fixtures/lock_order_bad.py"
+    bad = LockOrderPass(globs=(rel,))
+    r = _run_single(bad)
+    msgs = "\n".join(f.message for f in r.active)
+    assert "lock-order cycle" in msgs, r.findings
+    assert "_sched_lock" in msgs and "_pool_lock" in msgs, msgs
+    good = LockOrderPass(globs=("tests/lint_fixtures/lock_order_good.py",))
+    assert _run_single(good).clean
+
+
+def test_rng_key_reuse_fixtures():
+    bad = RngKeyReusePass(globs=("tests/lint_fixtures/rng_key_reuse_bad.py",))
+    r = _run_single(bad)
+    # All four flavors fire: double draw, parent-after-split, per-iteration
+    # loop reuse, and reuse through a key-consuming helper.
+    lines = sorted(f.line for f in r.active)
+    assert len(lines) == 4, r.findings
+    good = RngKeyReusePass(globs=("tests/lint_fixtures/rng_key_reuse_good.py",))
+    assert _run_single(good).clean
+
+
+def test_donation_safety_fixtures():
+    bad = DonationSafetyPass(
+        globs=("tests/lint_fixtures/donation_safety_bad.py",))
+    r = _run_single(bad)
+    msgs = "\n".join(f.message for f in r.active)
+    assert "'cache'" in msgs, msgs            # read-after-donate + loop
+    assert "'self.counts'" in msgs, msgs      # builder + *args form
+    assert len(r.active) == 3, r.findings
+    good = DonationSafetyPass(
+        globs=("tests/lint_fixtures/donation_safety_good.py",))
+    assert _run_single(good).clean
+
+
+def test_sharding_consistency_fixtures():
+    broot = os.path.join(FIX, "sharding_consistency", "bad")
+    r = _run_single(ShardingConsistencyPass(), root=broot)
+    msgs = "\n".join(f.message for f in r.active)
+    assert "wq_proj" in msgs, msgs          # stale spec (drift)
+    assert "'wq'" in msgs, msgs             # tree name with no spec
+    assert "'mp'" in msgs, msgs             # ghost mesh axis
+    assert "rogue_reduce" in msgs, msgs     # collective outside boundary
+    assert "stale declaration" in msgs, msgs
+    groot = os.path.join(FIX, "sharding_consistency", "good")
+    assert _run_single(ShardingConsistencyPass(), root=groot).clean
+
+
+def test_since_limit_narrows_file_scoped_passes():
+    """--since semantics: a limit that matches no files silences
+    file-scoped passes but leaves project-wide passes running in full."""
+    limited = Repo(REPO, limit=["no/such/file.py"])
+    r = run_passes(limited, [RngKeyReusePass(), DonationSafetyPass(),
+                             MetricCountersPass(), TraceSafetyPass(),
+                             AttrInitPass(), LockDisciplinePass()])
+    assert r.clean and not r.findings
+    # Project-wide passes ignore the limit entirely (the invariant spans
+    # files): sharding-consistency still sees the whole repo.
+    r2 = run_passes(limited, [ShardingConsistencyPass()])
+    assert r2.pass_ids == ["sharding-consistency"]
+    assert ShardingConsistencyPass.project_wide is True
+    assert LockOrderPass.project_wide is True
+
+
+def test_cli_since_mode():
+    """`--since HEAD` (the verify-skill pre-commit step) parses, runs, and
+    keeps the JSON contract."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--json", "--since", "HEAD",
+         "--pass", "rng-key-reuse,donation-safety"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode in (0, 1), proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert set(payload["passes"]) >= {"rng-key-reuse", "donation-safety"}
+    bad = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--since",
+         "no-such-rev-zzz"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert bad.returncode == 2, bad.stdout + bad.stderr
+
+
 def test_fault_sites_fixtures():
     broot = os.path.join(FIX, "fault_sites", "bad")
     bad = FaultSitesPass()
@@ -228,13 +332,15 @@ def test_suppression_without_reason_is_a_finding():
                for f in r.active), r.findings
 
 
-def test_registry_has_the_eight_passes():
+def test_registry_has_the_twelve_passes():
     ids = [p.id for p in all_passes()]
     assert ids == [
         "attr-init", "metric-counters", "lock-discipline", "trace-safety",
         "terminal-event", "page-refcount", "config-drift", "fault-sites",
+        "lock-order", "rng-key-reuse", "sharding-consistency",
+        "donation-safety",
     ], ids
-    assert len(set(ids)) == 8
+    assert len(set(ids)) == 12
 
 
 # --------------------------------------------------------------------- #

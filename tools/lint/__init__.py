@@ -27,6 +27,26 @@ from .passes import all_passes  # noqa: F401
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def run_repo(root: str = REPO_ROOT, only=None, skip=None) -> RunResult:
-    """Run the full registry over a repo checkout."""
-    return run_passes(Repo(root), all_passes(), only=only, skip=skip)
+def run_repo(root: str = REPO_ROOT, only=None, skip=None,
+             limit=None) -> RunResult:
+    """Run the full registry over a repo checkout. `limit` (an iterable of
+    repo-relative paths) narrows FILE-SCOPED passes to those files — the
+    --since incremental mode; project-wide passes always run in full."""
+    return run_passes(Repo(root, limit=limit), all_passes(),
+                      only=only, skip=skip)
+
+
+def changed_since(root: str, rev: str) -> list[str]:
+    """Repo-relative paths changed vs a git rev (staged + unstaged +
+    committed-after-rev), for --since. Raises on a bad rev."""
+    import subprocess
+
+    proc = subprocess.run(
+        ["git", "diff", "--name-only", rev, "--"],
+        cwd=root, capture_output=True, text=True, timeout=30,
+    )
+    if proc.returncode != 0:
+        raise ValueError(
+            f"git diff --name-only {rev!r} failed: {proc.stderr.strip()}"
+        )
+    return [ln.strip() for ln in proc.stdout.splitlines() if ln.strip()]

@@ -1,5 +1,11 @@
 """CLI: python -m tools.lint [--json] [--list] [--pass a,b] [--skip a,b]
-[--root PATH] [--report FILE]. Exit 0 clean, 1 findings, 2 usage error."""
+[--root PATH] [--report FILE] [--since REV]. Exit 0 clean, 1 findings,
+2 usage error.
+
+--since REV lints only files changed vs the git rev (file-scoped passes;
+cross-file passes still run in full over the shared call-graph/summary
+cache) — the fast pre-commit mode the verify skill uses:
+`python -m tools.lint --since HEAD`."""
 
 from __future__ import annotations
 
@@ -7,7 +13,7 @@ import argparse
 import json
 import sys
 
-from . import REPO_ROOT, run_repo
+from . import REPO_ROOT, changed_since, run_repo
 from .core import write_report
 from .passes import all_passes
 
@@ -25,7 +31,11 @@ def main(argv=None) -> int:
     ap.add_argument("--skip", default=None,
                     help="comma-separated pass ids to skip")
     ap.add_argument("--report", default=None,
-                    help="write the LINT_rNN.json counts report here")
+                    help="write the LINT_rNN.json counts report here "
+                         "(includes per-pass wall_time_ms)")
+    ap.add_argument("--since", default=None, metavar="REV",
+                    help="lint only files changed vs this git rev "
+                         "(project-wide passes still run in full)")
     args = ap.parse_args(argv)
 
     if args.list:
@@ -41,7 +51,15 @@ def main(argv=None) -> int:
             print(f"unknown pass id {pid!r} (see --list)", file=sys.stderr)
             return 2
 
-    result = run_repo(args.root, only=only, skip=skip)
+    limit = None
+    if args.since is not None:
+        try:
+            limit = changed_since(args.root, args.since)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+
+    result = run_repo(args.root, only=only, skip=skip, limit=limit)
     if args.report:
         write_report(result, args.report)
     if args.json:
@@ -50,7 +68,10 @@ def main(argv=None) -> int:
         for f in result.findings:
             print(f.render())
         n, s = len(result.active), len(result.suppressed)
-        print(f"{len(result.pass_ids)} passes: "
+        scope = (f" ({len(limit)} changed file(s) vs {args.since})"
+                 if limit is not None else "")
+        total_ms = sum(result.timings.values()) * 1000.0
+        print(f"{len(result.pass_ids)} passes in {total_ms:.0f} ms{scope}: "
               f"{n} finding(s), {s} suppression(s)")
     return 0 if result.clean else 1
 

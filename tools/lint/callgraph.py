@@ -1,0 +1,406 @@
+"""Project-wide call graph for interprocedural lint passes (ISSUE 8).
+
+Every pass before this one was intraprocedural — and PRs 6-7 added exactly
+the bug classes that live *between* functions: lock-order cycles across the
+cluster scheduler / engine / manager threads, RNG keys consumed by helpers,
+donated buffers read by a caller after a callee's dispatch. This module
+gives passes a shared, cached view of "who calls whom" with just enough
+type inference to resolve the call shapes this repo actually uses:
+
+  self.m(...)            -> method of the enclosing class (same-module bases
+                            included — super().__init__ chains resolve)
+  self.attr.m(...)       -> method of the class assigned to self.attr in
+                            construction (`self.x = ClassName(...)`, or via a
+                            local whose type is known, or an annotation)
+  local.m(...)           -> method of the local's inferred class
+  func(...) / mod.f(...) -> same-module or imported project function;
+                            ClassName(...) resolves to ClassName.__init__
+  anything.m(...)        -> fallback: if exactly ONE indexed class defines a
+                            method `m` AND `m` is not a ubiquitous container/
+                            stdlib method name, that method (unique-name
+                            heuristic — `x.add(...)` must never resolve to
+                            WorkerRegistry.add just because x's type is
+                            unknown; it is almost always a set)
+
+Resolution returns CANDIDATES (possibly empty): passes must treat an
+unresolved call as "unknown", never as "safe" or "unsafe" on its own.
+Everything here is pure AST — no imports of the code under analysis — and
+cached on the Repo like the module cache, so N passes pay for one build.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+from . import astutil
+from .core import Repo
+
+
+# Method names shared with builtin containers / files / stdlib objects: the
+# unique-name fallback must never claim these (a bare `x.pop()` is a dict,
+# not the one indexed class that happens to define pop()).
+COMMON_METHOD_NAMES = frozenset({
+    "add", "append", "appendleft", "clear", "close", "copy", "count",
+    "discard", "extend", "flush", "get", "index", "insert", "items", "join",
+    "keys", "pop", "popleft", "popitem", "put", "read", "readline", "recv",
+    "release", "acquire", "remove", "reverse", "run", "seek", "send", "set",
+    "setdefault", "sort", "start", "stop", "tell", "update", "values",
+    "wait", "write", "cancel", "result", "info", "debug", "warning",
+    "error", "exception", "critical", "log", "mark", "list", "search",
+    "match", "sub", "split", "strip", "encode", "decode", "format", "is_set",
+})
+
+
+@dataclasses.dataclass
+class FuncDef:
+    fid: str                 # "path::Class.method" or "path::func"
+    path: str                # repo-relative
+    cls: Optional[str]       # enclosing class name (None for module funcs)
+    name: str                # bare function/method name
+    node: ast.AST            # the FunctionDef/AsyncFunctionDef
+
+
+def module_of(path: str) -> str:
+    """Dotted module name for a repo-relative path."""
+    mod = path[:-3] if path.endswith(".py") else path
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    return mod.replace("/", ".")
+
+
+class CallGraph:
+    """Index + resolver over a set of target files. Build once per glob set
+    (Repo caches instances via `Repo.callgraph`)."""
+
+    def __init__(self, repo: Repo, paths: list[str]):
+        self.repo = repo
+        self.paths = list(paths)
+        self.funcs: dict[str, FuncDef] = {}
+        # (path, class) -> ClassDef; class method tables; base-class names
+        self.classes: dict[tuple[str, str], ast.ClassDef] = {}
+        self._methods: dict[tuple[str, str], dict[str, str]] = {}
+        self._bases: dict[tuple[str, str], list[str]] = {}
+        # method name -> [fid] across every indexed class (unique-name fallback)
+        self.by_method: dict[str, list[str]] = {}
+        # per-module name -> ("func", fid) | ("class", (path, cls)) | ("mod", path)
+        self._module_names: dict[str, dict[str, tuple]] = {}
+        # (path, cls) -> {attr: set[(path, cls)]} inferred self.attr types
+        self._attr_types: dict[tuple[str, str], dict[str, set]] = {}
+        self._mod_to_path = {module_of(p): p for p in repo.files("**/*.py")}
+        self._resolve_memo: dict[tuple, tuple[str, ...]] = {}
+        for p in self.paths:
+            self._index_file(p)
+        self._by_node = {id(fd.node): fd for fd in self.funcs.values()}
+        for p in self.paths:
+            self._module_names[p] = self._build_namespace(p)
+        for p in self.paths:
+            self._infer_attr_types(p)
+
+    # ---------------- indexing ---------------- #
+
+    def _index_file(self, path: str) -> None:
+        tree = self.repo.tree(path)
+        for node in tree.body:
+            if isinstance(node, astutil.FunctionNode):
+                fid = f"{path}::{node.name}"
+                self.funcs[fid] = FuncDef(fid, path, None, node.name, node)
+            elif isinstance(node, ast.ClassDef):
+                key = (path, node.name)
+                self.classes[key] = node
+                self._bases[key] = [
+                    b.id if isinstance(b, ast.Name) else getattr(b, "attr", "")
+                    for b in node.bases
+                ]
+                table: dict[str, str] = {}
+                for m in node.body:
+                    if isinstance(m, astutil.FunctionNode):
+                        fid = f"{path}::{node.name}.{m.name}"
+                        self.funcs[fid] = FuncDef(fid, path, node.name, m.name, m)
+                        table[m.name] = fid
+                        self.by_method.setdefault(m.name, []).append(fid)
+                self._methods[key] = table
+
+    def _build_namespace(self, path: str) -> dict[str, tuple]:
+        """Name -> target for module-level symbols AND imports (function-level
+        imports included: the engine's lazy-import idiom would otherwise hide
+        half the graph; shadowing across scopes is rare enough to accept)."""
+        ns: dict[str, tuple] = {}
+        tree = self.repo.tree(path)
+        for node in tree.body:
+            if isinstance(node, astutil.FunctionNode):
+                ns[node.name] = ("func", f"{path}::{node.name}")
+            elif isinstance(node, ast.ClassDef):
+                ns[node.name] = ("class", (path, node.name))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    tgt = self._mod_to_path.get(alias.name)
+                    if tgt:
+                        ns[alias.asname or alias.name.split(".")[0]] = ("mod", tgt)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = module_of(path).split(".")
+                    base = base[: len(base) - node.level]
+                    src = ".".join(base + ([node.module] if node.module else []))
+                else:
+                    src = node.module or ""
+                src_path = self._mod_to_path.get(src)
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    sub = self._mod_to_path.get(f"{src}.{alias.name}")
+                    if sub:
+                        ns[bound] = ("mod", sub)
+                        continue
+                    if not src_path:
+                        continue
+                    if (src_path, alias.name) in self.classes:
+                        ns[bound] = ("class", (src_path, alias.name))
+                    elif f"{src_path}::{alias.name}" in self.funcs:
+                        ns[bound] = ("func", f"{src_path}::{alias.name}")
+        return ns
+
+    # ---------------- type inference ---------------- #
+
+    def _type_of_expr(self, path: str, node: ast.AST,
+                      local_types: dict[str, set]) -> set:
+        """Possible (path, cls) classes an expression evaluates to."""
+        ns = self._module_names.get(path, {})
+        if isinstance(node, ast.Call):
+            name = astutil.dotted_name(node.func)
+            if not name:
+                return set()
+            head, _, rest = name.partition(".")
+            ent = ns.get(head)
+            if ent is None:
+                return set()
+            if ent[0] == "class" and not rest:
+                return {ent[1]}
+            if ent[0] == "mod" and rest and "." not in rest:
+                if (ent[1], rest) in self.classes:
+                    return {(ent[1], rest)}
+            return set()
+        if isinstance(node, ast.Name):
+            return set(local_types.get(node.id, ()))
+        return set()
+
+    def _annotation_types(self, path: str, ann: ast.AST) -> set:
+        """Class candidates named anywhere inside an annotation (handles
+        Optional[X], "X" strings, x.Y chains)."""
+        out: set = set()
+        ns = self._module_names.get(path, {})
+        for sub in ast.walk(ann):
+            name = None
+            if isinstance(sub, ast.Name):
+                name = sub.id
+            elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                name = sub.value.split(".")[-1].strip("'\" ")
+            if not name:
+                continue
+            ent = ns.get(name)
+            if ent and ent[0] == "class":
+                out.add(ent[1])
+        return out
+
+    def local_types(self, path: str, fn) -> dict[str, set]:
+        """{local name: {(path, cls)}} from constructor calls, parameter
+        annotations, and (second pass) the RETURN annotations of resolvable
+        calls — `lm = self.get(name)` types lm when get() is annotated.
+        Candidates accumulate; resolution tolerates supersets. Cached on
+        the Repo by node identity (AST nodes are shared through the Repo
+        tree cache), so the N pass-specific CallGraphs pay once."""
+        cache = getattr(self.repo, "_ltype_cache", None)
+        if cache is None:
+            cache = self.repo._ltype_cache = {}
+        if id(fn) in cache:
+            return cache[id(fn)]
+        types: dict[str, set] = {}
+        args = fn.args
+        for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+            if a.annotation is not None:
+                t = self._annotation_types(path, a.annotation)
+                if t:
+                    types[a.arg] = set(t)
+        fd = self._by_node.get(id(fn))
+        assigns = [n for n in ast.walk(fn) if isinstance(n, ast.Assign)]
+        for _round in range(2):
+            for node in assigns:
+                t = self._type_of_expr(path, node.value, types)
+                if not t and fd is not None and isinstance(node.value, ast.Call):
+                    # Bypass the memo: these resolutions run with PARTIAL
+                    # type maps mid-build and must not poison later lookups.
+                    for fid in self._resolve_uncached(fd, node.value, types):
+                        callee = self.funcs.get(fid)
+                        ret = getattr(callee.node, "returns", None) if callee else None
+                        if ret is not None:
+                            t = t | self._annotation_types(callee.path, ret)
+                if t:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            types.setdefault(tgt.id, set()).update(t)
+        cache[id(fn)] = types
+        return types
+
+    def _infer_attr_types(self, path: str) -> None:
+        for (p, cname), cls in list(self.classes.items()):
+            if p != path:
+                continue
+            attrs: dict[str, set] = {}
+            for m in cls.body:
+                if not isinstance(m, astutil.FunctionNode):
+                    continue
+                me = astutil.self_name(m)
+                if me is None:
+                    continue
+                ltypes = self.local_types(path, m)
+                for node in ast.walk(m):
+                    tgt = None
+                    if isinstance(node, ast.Assign):
+                        for t in node.targets:
+                            if (isinstance(t, ast.Attribute)
+                                    and isinstance(t.value, ast.Name)
+                                    and t.value.id == me):
+                                tgt = t.attr
+                        val = node.value
+                    elif (isinstance(node, ast.AnnAssign)
+                          and isinstance(node.target, ast.Attribute)
+                          and isinstance(node.target.value, ast.Name)
+                          and node.target.value.id == me):
+                        tgt = node.target.attr
+                        attrs.setdefault(tgt, set()).update(
+                            self._annotation_types(path, node.annotation))
+                        val = node.value
+                    else:
+                        continue
+                    if tgt is None or val is None:
+                        continue
+                    t = self._type_of_expr(path, val, ltypes)
+                    if t:
+                        attrs.setdefault(tgt, set()).update(t)
+            self._attr_types[(path, cname)] = attrs
+
+    # ---------------- lookup helpers ---------------- #
+
+    def method_fid(self, path: str, cls: str, name: str) -> Optional[str]:
+        """Method fid on a class, walking same-module bases (MRO-ish)."""
+        seen: set[tuple[str, str]] = set()
+        stack = [(path, cls)]
+        while stack:
+            key = stack.pop(0)
+            if key in seen or key not in self._methods:
+                continue
+            seen.add(key)
+            fid = self._methods[key].get(name)
+            if fid:
+                return fid
+            for b in self._bases.get(key, []):
+                ent = self._module_names.get(key[0], {}).get(b)
+                if ent and ent[0] == "class":
+                    stack.append(ent[1])
+                elif (key[0], b) in self.classes:
+                    stack.append((key[0], b))
+        return None
+
+    def class_init(self, key: tuple) -> Optional[str]:
+        return self.method_fid(key[0], key[1], "__init__")
+
+    # ---------------- call resolution ---------------- #
+
+    def resolve(self, fd: FuncDef, call: ast.Call,
+                local_types: Optional[dict] = None,
+                local_defs: Optional[dict] = None) -> tuple[str, ...]:
+        """Candidate fids for a call made inside fd. local_defs maps nested
+        function names to their fids (caller-scoped). Memoized per call
+        node — summaries and flow passes resolve the same sites."""
+        memo_key = (fd.fid, id(call))
+        cached = self._resolve_memo.get(memo_key)
+        if cached is not None:
+            return cached
+        out = self._resolve_uncached(fd, call, local_types, local_defs)
+        self._resolve_memo[memo_key] = out
+        return out
+
+    def _resolve_uncached(self, fd: FuncDef, call: ast.Call,
+                          local_types: Optional[dict] = None,
+                          local_defs: Optional[dict] = None) -> tuple[str, ...]:
+        f = call.func
+        path = fd.path
+        ns = self._module_names.get(path, {})
+        me = astutil.self_name(fd.node) if fd.cls else None
+
+        if isinstance(f, ast.Name):
+            if local_defs and f.id in local_defs:
+                return (local_defs[f.id],)
+            ent = ns.get(f.id)
+            if ent:
+                if ent[0] == "func":
+                    return (ent[1],) if ent[1] in self.funcs else ()
+                if ent[0] == "class":
+                    init = self.class_init(ent[1])
+                    return (init,) if init else ()
+            if local_types and f.id in local_types:
+                # calling an instance — __call__ is out of scope
+                return ()
+            return ()
+
+        if not isinstance(f, ast.Attribute):
+            # fn()() — a call of a call: resolve the inner call's return;
+            # passes that care (donation) handle this shape themselves.
+            return ()
+
+        dotted = astutil.dotted_name(f)
+        parts = dotted.split(".") if dotted else []
+        mname = f.attr
+
+        if me is not None and parts and parts[0] == me:
+            if len(parts) == 2:
+                fid = self.method_fid(path, fd.cls, mname)
+                return (fid,) if fid else ()
+            if len(parts) == 3:
+                cands = []
+                for key in self._attr_types.get((path, fd.cls), {}).get(parts[1], ()):
+                    fid = self.method_fid(key[0], key[1], mname)
+                    if fid:
+                        cands.append(fid)
+                if cands:
+                    return tuple(sorted(set(cands)))
+        elif len(parts) == 2:
+            ent = ns.get(parts[0])
+            if ent and ent[0] == "mod":
+                fid = f"{ent[1]}::{mname}"
+                if fid in self.funcs:
+                    return (fid,)
+                if (ent[1], mname) in self.classes:
+                    init = self.class_init((ent[1], mname))
+                    return (init,) if init else ()
+                return ()
+            if local_types and parts[0] in local_types:
+                cands = []
+                for key in local_types[parts[0]]:
+                    fid = self.method_fid(key[0], key[1], mname)
+                    if fid:
+                        cands.append(fid)
+                if cands:
+                    return tuple(sorted(set(cands)))
+
+        # Unique-method-name fallback: receiver type unknown, but only one
+        # indexed class defines this method AND the name is distinctive.
+        if mname in COMMON_METHOD_NAMES or len(mname) <= 3:
+            return ()
+        owners = self.by_method.get(mname, [])
+        if len(owners) == 1:
+            return (owners[0],)
+        return ()
+
+
+def callgraph_for(repo: Repo, globs: tuple[str, ...]) -> CallGraph:
+    """Repo-cached CallGraph for a glob set (the 'cached alongside the
+    module cache' contract — N passes share one build)."""
+    cache = getattr(repo, "_callgraphs", None)
+    if cache is None:
+        cache = repo._callgraphs = {}
+    key = tuple(sorted(globs))
+    if key not in cache:
+        cache[key] = CallGraph(repo, repo.files(*globs))
+    return cache[key]

@@ -32,6 +32,7 @@ import fnmatch
 import json
 import os
 import re
+import time
 from typing import Iterable, Optional
 
 # Matches `# lint: ignore[pass-id] reason...` (reason may start with -, —, :).
@@ -58,12 +59,25 @@ class Repo:
     """Shared parse cache over the repository: each file is read and parsed
     at most once no matter how many passes inspect it."""
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, limit: Optional[Iterable[str]] = None):
         self.root = os.path.abspath(root)
         self._src: dict[str, str] = {}
         self._lines: dict[str, list[str]] = {}
         self._tree: dict[str, ast.Module] = {}
         self._files: dict[tuple, list[str]] = {}
+        # --since incremental mode (ISSUE 8): when set, FILE-SCOPED passes
+        # only analyze these repo-relative paths; project-wide passes
+        # (cross-file invariants: lock-order, sharding-consistency,
+        # config-drift, fault-sites) always see everything — their
+        # call-graph/summary caches make the full view cheap.
+        self.limit: Optional[set[str]] = (
+            None if limit is None
+            else {p.replace(os.sep, "/") for p in limit}
+        )
+
+    def in_scope(self, path: str) -> bool:
+        """Should a file-scoped pass analyze this file under --since?"""
+        return self.limit is None or path.replace(os.sep, "/") in self.limit
 
     def rel(self, path: str) -> str:
         return os.path.relpath(os.path.join(self.root, path), self.root)
@@ -129,11 +143,14 @@ class Repo:
 
 class Pass:
     """Base class for a lint pass. Subclasses set `id` and `description`
-    and implement run(). `default_on` lets future niche passes ship opt-in."""
+    and implement run(). `default_on` lets future niche passes ship opt-in.
+    `project_wide` passes check cross-file invariants and ignore the
+    --since file limit (narrowing them would silently skip the invariant)."""
 
     id: str = ""
     description: str = ""
     default_on: bool = True
+    project_wide: bool = False
 
     def run(self, repo: Repo) -> list[Finding]:  # pragma: no cover - abstract
         raise NotImplementedError
@@ -201,6 +218,9 @@ def apply_suppressions(repo: Repo, findings: list[Finding],
 class RunResult:
     findings: list[Finding]  # all, suppressed included
     pass_ids: list[str]  # passes that ran
+    # Per-pass wall time (seconds) — makes the tier-1 <10 s budget
+    # attributable pass by pass (ISSUE 8 satellite).
+    timings: dict = dataclasses.field(default_factory=dict)
 
     @property
     def active(self) -> list[Finding]:
@@ -221,6 +241,9 @@ class RunResult:
                 f.pass_id, {"findings": 0, "suppressions": 0}
             )
             slot["suppressions" if f.suppressed else "findings"] += 1
+        for pid, secs in self.timings.items():
+            if pid in out:
+                out[pid]["wall_time_ms"] = round(secs * 1000.0, 1)
         return out
 
     def to_json(self) -> dict:
@@ -254,13 +277,17 @@ def run_passes(repo: Repo, passes: list[Pass],
         and p.id not in skip_set
     ]
     findings: list[Finding] = []
+    timings: dict[str, float] = {}
     for p in selected:
+        t0 = time.monotonic()
         findings.extend(p.run(repo))
+        timings[p.id] = time.monotonic() - t0
     findings.extend(
         apply_suppressions(repo, findings, [p.id for p in passes])
     )
     findings.sort(key=lambda f: (f.path, f.line, f.pass_id))
-    return RunResult(findings=findings, pass_ids=[p.id for p in selected])
+    return RunResult(findings=findings, pass_ids=[p.id for p in selected],
+                     timings=timings)
 
 
 def write_report(result: RunResult, path: str) -> None:

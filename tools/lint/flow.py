@@ -1,0 +1,174 @@
+"""Path-sensitive-enough statement walker for consumption analyses.
+
+The rng-key-reuse and donation-safety passes share a shape: a value is
+CONSUMED at some statement (a key drawn from, a buffer donated) and any
+later use of the SAME binding on any path is a bug — unless the name was
+rebound in between. This walker provides the control-flow plumbing both
+need, tuned for low false positives rather than completeness:
+
+  - statements execute in order; a rebind starts a new GENERATION of the
+    name, so `key, sub = jax.random.split(key)` consumes the old key and
+    the follow-up uses the new one.
+  - `if`/`try` forks the state per branch and merges with INTERSECTION of
+    consumed sets (a value consumed on only one branch might never have
+    been consumed at runtime — flagging a later single use would be a
+    false positive; in-branch double consumption is still caught inside
+    the fork).
+  - loop bodies run TWICE: the second pass sees the first iteration's
+    consumptions, which is exactly how "consumed every iteration without a
+    rebind" bugs surface (same key drawn per step, same buffer donated per
+    step).
+
+Subclasses implement `handle_expr(node, state)` (record consumptions) and
+`handle_assign(stmt, state)` (process value THEN rebind targets).
+"""
+
+from __future__ import annotations
+
+import ast
+
+
+class FlowState:
+    """Generation counters + consumed-set per tracked name."""
+
+    def __init__(self):
+        self.gen: dict[str, int] = {}
+        self.consumed: dict[tuple[str, int], int] = {}  # (name, gen) -> line
+        self.tracked: set[str] = set()
+
+    def copy(self) -> "FlowState":
+        st = FlowState()
+        st.gen = dict(self.gen)
+        st.consumed = dict(self.consumed)
+        st.tracked = set(self.tracked)
+        return st
+
+    def merge(self, a: "FlowState", b: "FlowState") -> None:
+        """Join of two branch states, in place."""
+        self.gen = {
+            k: max(a.gen.get(k, 0), b.gen.get(k, 0))
+            for k in set(a.gen) | set(b.gen)
+        }
+        self.consumed = {
+            k: a.consumed[k] for k in set(a.consumed) & set(b.consumed)
+        }
+        self.tracked = a.tracked | b.tracked
+
+    # -------- name lifecycle -------- #
+
+    def track(self, name: str) -> None:
+        self.tracked.add(name)
+        self.gen.setdefault(name, 0)
+
+    def rebind(self, name: str, still_tracked: bool) -> None:
+        if name in self.tracked or still_tracked:
+            self.gen[name] = self.gen.get(name, 0) + 1
+        if still_tracked:
+            self.tracked.add(name)
+        else:
+            self.tracked.discard(name)
+
+    def consume(self, name: str, line: int):
+        """Returns the first-consumption line when this is a REUSE of the
+        current generation, else None (and records the consumption)."""
+        if name not in self.tracked:
+            return None
+        key = (name, self.gen.get(name, 0))
+        if key in self.consumed:
+            return self.consumed[key]
+        self.consumed[key] = line
+        return None
+
+
+class LinearFlow:
+    """Drive exec_block over a function body. Subclasses provide
+    handle_expr / handle_assign; findings accumulate in self.hits as
+    (line, first_line, name) deduped tuples."""
+
+    def __init__(self):
+        self.hits: dict[tuple, tuple] = {}
+
+    # -------- overridables -------- #
+
+    def handle_expr(self, node: ast.AST, st: FlowState) -> None:
+        raise NotImplementedError
+
+    def handle_assign(self, stmt: ast.stmt, st: FlowState) -> None:
+        raise NotImplementedError
+
+    def handle_for_target(self, stmt: ast.stmt, st: FlowState) -> None:
+        """Rebind loop targets; default drops them from tracking."""
+        for sub in ast.walk(stmt.target):
+            if isinstance(sub, ast.Name):
+                st.rebind(sub.id, still_tracked=False)
+
+    # -------- plumbing -------- #
+
+    def exec_block(self, stmts: list, st: FlowState) -> None:
+        for s in stmts:
+            self.exec_stmt(s, st)
+
+    def exec_stmt(self, stmt: ast.stmt, st: FlowState) -> None:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self.handle_assign(stmt, st)
+        elif isinstance(stmt, ast.Expr):
+            self.handle_expr(stmt.value, st)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.handle_expr(stmt.value, st)
+        elif isinstance(stmt, ast.If):
+            self.handle_expr(stmt.test, st)
+            s_then, s_else = st.copy(), st.copy()
+            self.exec_block(stmt.body, s_then)
+            self.exec_block(stmt.orelse, s_else)
+            st.merge(s_then, s_else)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.handle_expr(stmt.iter, st)
+            self.handle_for_target(stmt, st)
+            for _ in range(2):
+                self.exec_block(stmt.body, st)
+            self.exec_block(stmt.orelse, st)
+        elif isinstance(stmt, ast.While):
+            for _ in range(2):
+                self.handle_expr(stmt.test, st)
+                self.exec_block(stmt.body, st)
+            self.exec_block(stmt.orelse, st)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.handle_expr(item.context_expr, st)
+            self.exec_block(stmt.body, st)
+        elif isinstance(stmt, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+            s_body = st.copy()
+            self.exec_block(stmt.body, s_body)
+            merged = s_body
+            for h in stmt.handlers:
+                s_h = st.copy()
+                self.exec_block(h.body, s_h)
+                joined = FlowState()
+                joined.merge(merged, s_h)
+                merged = joined
+            st.gen, st.consumed, st.tracked = (
+                merged.gen, merged.consumed, merged.tracked,
+            )
+            self.exec_block(stmt.orelse, st)
+            self.exec_block(stmt.finalbody, st)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            pass  # nested scopes are analyzed on their own
+        elif isinstance(stmt, (ast.Raise, ast.Assert)):
+            for v in (getattr(stmt, "exc", None), getattr(stmt, "test", None),
+                      getattr(stmt, "msg", None)):
+                if v is not None:
+                    self.handle_expr(v, st)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        st.rebind(sub.id, still_tracked=False)
+        else:
+            for v in ast.iter_child_nodes(stmt):
+                if isinstance(v, ast.expr):
+                    self.handle_expr(v, st)
+
+    def record(self, line: int, first: int, name: str) -> None:
+        self.hits[(line, name)] = (line, first, name)

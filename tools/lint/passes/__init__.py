@@ -5,10 +5,14 @@ from __future__ import annotations
 
 from .attr_init import AttrInitPass
 from .config_drift import ConfigDriftPass
+from .donation_safety import DonationSafetyPass
 from .fault_sites import FaultSitesPass
 from .lock_discipline import LockDisciplinePass
+from .lock_order import LockOrderPass
 from .metric_counters import MetricCountersPass
 from .page_refcount import PageRefcountPass
+from .rng_key_reuse import RngKeyReusePass
+from .sharding_consistency import ShardingConsistencyPass
 from .terminal_event import TerminalEventPass
 from .trace_safety import TraceSafetyPass
 
@@ -24,4 +28,9 @@ def all_passes():
         PageRefcountPass(),
         ConfigDriftPass(),
         FaultSitesPass(),
+        # Interprocedural passes (ISSUE 8): shared call graph + summaries.
+        LockOrderPass(),
+        RngKeyReusePass(),
+        ShardingConsistencyPass(),
+        DonationSafetyPass(),
     ]

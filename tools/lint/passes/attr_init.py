@@ -64,7 +64,7 @@ class AttrInitPass(Pass):
     def run(self, repo: Repo) -> list[Finding]:
         out: list[Finding] = []
         for path, class_name in self.targets:
-            if not repo.exists(path):
+            if not repo.exists(path) or not repo.in_scope(path):
                 continue
             cls = repo.find_class(path, class_name)
             if cls is None:

@@ -1,0 +1,277 @@
+"""donation-safety: a donated buffer read after the donating call.
+
+The engine donates aggressively — every decode block, fused admission,
+chunk program, swap/restore, and the RNG setter pass their cache/counts/
+rngs/token buffers with `donate_argnums` so XLA reuses the HBM in place
+(SNIPPETS.md [1][2]: donation is what makes steady-state serving fit).
+The contract is one-way: after the call, the donated buffer is DELETED.
+Reading it again raises "Array has been deleted" at best — and on some
+paths silently computes on stale aliases at worst. The bug only bites on
+the path that reads (an error fallback, a retry, a second loop iteration),
+which is exactly where tests don't look.
+
+Rule, per function: at every call of a callable known to donate (a local
+`fn = jax.jit(..., donate_argnums=(...))`, a `@partial(jax.jit,
+donate_argnums=...)` def, or a project builder whose summary says it
+RETURNS such a callable — the interprocedural part, covering the engine's
+`fn = self._get_block(...)` / `self._get_rng_set()(...)` idioms), the
+expressions at the donated positional slots (plain locals or `self.attr`
+chains; `*args` tuples built from literals are spliced) become CONSUMED.
+Any later read of the same binding on any path — including passing it to
+the next iteration's donating call — is a finding until a rebind. Only the
+positions donated on EVERY path are claimed (the literal base tuple), so
+conditionally-extended donate lists can't false-positive.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .. import astutil
+from ..core import Finding, Pass, Repo
+from ..flow import FlowState, LinearFlow
+from ..summaries import DEFAULT_SUMMARY_GLOBS, summaries_for
+
+DEFAULT_GLOBS = (
+    "localai_tpu/engine/*.py",
+    "localai_tpu/train/*.py",
+)
+
+
+def _literal_int_tuple(node: ast.AST) -> Optional[tuple[int, ...]]:
+    if isinstance(node, ast.Tuple):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    return None
+
+
+def _jit_donations(call: ast.Call,
+                   lit_locals: dict[str, tuple[int, ...]]) -> Optional[tuple[int, ...]]:
+    """Donated positions of a jax.jit(...) call with a literal (or
+    literal-local) donate_argnums; None when absent/unknowable."""
+    if astutil.dotted_name(call.func) not in ("jax.jit", "jit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        lit = _literal_int_tuple(kw.value)
+        if lit is not None:
+            return lit
+        if isinstance(kw.value, ast.Name):
+            return lit_locals.get(kw.value.id)
+    return None
+
+
+def _decorated_donations(fn) -> Optional[tuple[int, ...]]:
+    """@partial(jax.jit, donate_argnums=(...)) / @jax.jit(donate_argnums=...)
+    on a def."""
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        name = astutil.dotted_name(dec.func)
+        inner = dec
+        if name in ("partial", "functools.partial"):
+            if not (dec.args and astutil.dotted_name(dec.args[0])
+                    in ("jax.jit", "jit")):
+                continue
+        elif name not in ("jax.jit", "jit"):
+            continue
+        for kw in inner.keywords:
+            if kw.arg == "donate_argnums":
+                lit = _literal_int_tuple(kw.value)
+                if lit is not None:
+                    return lit
+    return None
+
+
+class _DonationFlow(LinearFlow):
+    def __init__(self, pass_globs, repo, path, fn):
+        super().__init__()
+        self.repo = repo
+        self.path = path
+        self.fn = fn
+        self.idx = summaries_for(repo, pass_globs)
+        self.graph = self.idx.graph
+        self.fd = self.graph._by_node.get(id(fn))
+        self.ltypes = (self.graph.local_types(path, fn)
+                       if self.fd is not None else {})
+        self.me = astutil.self_name(fn) if self.fd and self.fd.cls else None
+        self.donating: dict[str, tuple[int, ...]] = {}
+        self.lit_tuples: dict[str, tuple[int, ...]] = {}
+        self.arg_tuples: dict[str, list] = {}  # name -> [arg expr nodes]
+        self.donate_line: dict[tuple[str, int], int] = {}
+
+    # -------- expr keys -------- #
+
+    def _expr_key(self, node: ast.AST) -> Optional[str]:
+        """Trackable identity of an argument expression: a plain local name
+        or a self.attr chain."""
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            dotted = astutil.dotted_name(node)
+            if (dotted and self.me is not None
+                    and dotted.startswith(self.me + ".")
+                    and dotted.count(".") == 1):
+                return dotted
+        return None
+
+    # -------- donation resolution -------- #
+
+    def _call_donations(self, call: ast.Call) -> Optional[tuple[int, ...]]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            return self.donating.get(f.id)
+        if isinstance(f, ast.Call):
+            # self._get_rng_set()(rngs, ...) — the builder's return donates.
+            if self.fd is not None:
+                for fid in self.graph.resolve(self.fd, f, self.ltypes):
+                    s = self.idx.summaries.get(fid)
+                    if s and s.donates:
+                        return s.donates
+        return None
+
+    def _positional_exprs(self, call: ast.Call) -> list:
+        """Positional argument expressions with *tuple locals spliced;
+        an unresolvable *star truncates (positions past it are unknown)."""
+        out = []
+        for a in call.args:
+            if isinstance(a, ast.Starred):
+                if (isinstance(a.value, ast.Name)
+                        and a.value.id in self.arg_tuples):
+                    out.extend(self.arg_tuples[a.value.id])
+                    continue
+                break  # unknown splice — stop mapping positions
+            out.append(a)
+        return out
+
+    # -------- flow hooks -------- #
+
+    def _read_check(self, node: ast.AST, st: FlowState) -> None:
+        for sub in ast.walk(node):
+            key = None
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                key = sub.id
+            elif isinstance(sub, ast.Attribute) and isinstance(sub.ctx, ast.Load):
+                key = self._expr_key(sub)
+            if key is None or key not in st.tracked:
+                continue
+            gkey = (key, st.gen.get(key, 0))
+            if gkey in st.consumed:
+                self.record(sub.lineno, st.consumed[gkey], key)
+
+    def handle_expr(self, node: ast.AST, st: FlowState) -> None:
+        if isinstance(node, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        # Reads first: args already donated by an EARLIER call get flagged
+        # here (donating the same buffer twice included).
+        self._read_check(node, st)
+        for call in [n for n in ast.walk(node) if isinstance(n, ast.Call)]:
+            pos = self._call_donations(call)
+            if not pos:
+                continue
+            exprs = self._positional_exprs(call)
+            for i in pos:
+                if i >= len(exprs):
+                    continue
+                key = self._expr_key(exprs[i])
+                if key is None:
+                    continue
+                st.track(key)
+                st.consume(key, call.lineno)
+
+    def handle_assign(self, stmt, st: FlowState) -> None:
+        value = getattr(stmt, "value", None)
+        targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                   else [stmt.target])
+        if value is not None:
+            # Bookkeeping: literal int tuples, arg tuples, jitted locals.
+            lit = _literal_int_tuple(value)
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    if lit is not None:
+                        self.lit_tuples[t.id] = lit
+                    if isinstance(value, ast.Tuple):
+                        self.arg_tuples[t.id] = list(value.elts)
+                    elif (isinstance(value, ast.BinOp)
+                          and isinstance(value.op, ast.Add)
+                          and isinstance(value.left, ast.Name)
+                          and value.left.id in self.arg_tuples
+                          and isinstance(value.right, ast.Tuple)):
+                        self.arg_tuples[t.id] = (
+                            self.arg_tuples[value.left.id]
+                            + list(value.right.elts))
+                    if isinstance(value, ast.Call):
+                        don = _jit_donations(value, self.lit_tuples)
+                        if don is None and self.fd is not None:
+                            for fid in self.graph.resolve(
+                                    self.fd, value, self.ltypes):
+                                s = self.idx.summaries.get(fid)
+                                if s and s.donates:
+                                    don = s.donates
+                                    break
+                        if don:
+                            self.donating[t.id] = don
+            self.handle_expr(value, st)
+        for t in targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Name):
+                    st.rebind(sub.id, still_tracked=sub.id in st.tracked)
+                elif isinstance(sub, ast.Attribute):
+                    key = self._expr_key(sub)
+                    if key is not None:
+                        st.rebind(key, still_tracked=key in st.tracked)
+
+    def exec_stmt(self, stmt, st):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            don = _decorated_donations(stmt)
+            if don:
+                self.donating[stmt.name] = don
+            return
+        super().exec_stmt(stmt, st)
+
+    def run(self, st: FlowState) -> None:
+        self.exec_block(self.fn.body, st)
+
+
+class DonationSafetyPass(Pass):
+    id = "donation-safety"
+    description = (
+        "buffer read after being donated to a jitted call "
+        "(XLA deleted it — 'Array has been deleted' on the untested path)"
+    )
+
+    def __init__(self, globs=None):
+        self.globs = tuple(DEFAULT_GLOBS if globs is None else globs)
+        # Builder-return summaries come from the shared union index on
+        # default scope.
+        self.summary_globs = (DEFAULT_SUMMARY_GLOBS if globs is None
+                              else self.globs)
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        for path in repo.files(*self.globs):
+            if not repo.in_scope(path):
+                continue
+            for node in ast.walk(repo.tree(path)):
+                if not isinstance(node, astutil.FunctionNode):
+                    continue
+                walker = _DonationFlow(self.summary_globs, repo, path, node)
+                walker.run(FlowState())
+                for line, first, key in sorted(walker.hits.values()):
+                    out.append(self.finding(
+                        path, line,
+                        f"{key!r} read after being DONATED to a jitted call "
+                        f"at line {first} — donated buffers are deleted by "
+                        f"XLA; rebind the call's result (or drop the "
+                        f"donation) before touching it again",
+                    ))
+        return out

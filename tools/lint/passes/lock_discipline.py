@@ -117,6 +117,8 @@ class LockDisciplinePass(Pass):
     def run(self, repo: Repo) -> list[Finding]:
         out: list[Finding] = []
         for path in repo.files(*self.globs):
+            if not repo.in_scope(path):
+                continue  # --since incremental mode
             for cls in ast.walk(repo.tree(path)):
                 if not isinstance(cls, ast.ClassDef):
                     continue

@@ -1,0 +1,150 @@
+"""lock-order: cycles in the global lock acquisition-order graph.
+
+PR 6 made the serving path genuinely multi-threaded across module
+boundaries: the engine loop, the cluster pump threads, the scheduler's
+gauge pulls, the manager watchdog, and the federation health loop all take
+locks owned by DIFFERENT classes (`Engine._pending_lock`,
+`ClusterScheduler._lock`, `ClusterClient._lock`, `WorkerRegistry._lock`,
+`ModelManager._lock`, `LoadedModel._lock`, ...). Two threads taking two of
+those locks in opposite orders is a deadlock that no intraprocedural pass
+can see — the two halves of the inversion live in different files.
+
+This pass builds the acquisition-order graph interprocedurally
+(tools.lint.callgraph + tools.lint.summaries): an edge A→B exists when some
+function takes (or may take, transitively through resolved calls) lock B
+while holding lock A. The `*_locked` convention is honored — a
+single-lock-class method named `*_locked` is assumed to run with its class
+lock held. Any cycle in the graph is a potential deadlock and is reported
+once per cycle with a witness site per edge.
+
+Additionally: a provably same-instance re-acquisition of a NON-reentrant
+threading.Lock (a `self.m()` chain from inside `with self.lock:` into a
+method that takes `self.lock` again) is an unconditional self-deadlock and
+is reported directly.
+"""
+
+from __future__ import annotations
+
+from ..core import Finding, Pass, Repo
+from ..summaries import DEFAULT_SUMMARY_GLOBS, summaries_for
+
+
+def _short(lock: str) -> str:
+    """'scheduler.py::ClusterScheduler._lock' for messages."""
+    path, _, rest = lock.partition("::")
+    return f"{path.rsplit('/', 1)[-1]}::{rest}"
+
+
+class LockOrderPass(Pass):
+    id = "lock-order"
+    description = (
+        "cycle in the cross-module lock acquisition-order graph "
+        "(potential deadlock between serving threads)"
+    )
+    project_wide = True  # the graph spans files; --since cannot narrow it
+
+    def __init__(self, globs=None):
+        # Default scope rides the shared union SummaryIndex (one build
+        # serves all four interprocedural passes); custom globs (fixtures)
+        # build their own small index.
+        self.globs = tuple(DEFAULT_SUMMARY_GLOBS if globs is None else globs)
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        idx = summaries_for(repo, self.globs)
+        may = idx.may_acquire()
+
+        # edge (held, acquired) -> witness (path, line, context)
+        edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+
+        def add_edge(a: str, b: str, path: str, line: int, ctx: str) -> None:
+            edges.setdefault((a, b), (path, line, ctx))
+
+        for fid, s in idx.summaries.items():
+            where = f"{s.cls + '.' if s.cls else ''}{s.name}()"
+            for acq in s.acquisitions:
+                for h in acq.held:
+                    if h == acq.lock:
+                        if idx.lock_kinds.get(h) == "Lock":
+                            out.append(self.finding(
+                                s.path, acq.line,
+                                f"{_short(h)} re-acquired in {where} while "
+                                f"already held — threading.Lock is not "
+                                f"reentrant; this path deadlocks itself",
+                            ))
+                        continue
+                    add_edge(h, acq.lock, s.path, acq.line,
+                             f"{where} takes {_short(acq.lock)}")
+            for site in s.calls:
+                if not site.held:
+                    continue
+                for callee in site.callees:
+                    for m in may.get(callee, ()):
+                        cs = idx.summaries.get(callee)
+                        cname = (f"{cs.cls + '.' if cs and cs.cls else ''}"
+                                 f"{cs.name if cs else callee}")
+                        for h in site.held:
+                            if h == m:
+                                # Same-id, same-instance only when the call
+                                # chain is provably `self.` — cross-instance
+                                # same-slot locks are different objects.
+                                if (site.self_call
+                                        and idx.lock_kinds.get(h) == "Lock"
+                                        and m in {a.lock for a in
+                                                  (cs.acquisitions if cs else ())}):
+                                    out.append(self.finding(
+                                        s.path, site.line,
+                                        f"{where} holds {_short(h)} and calls "
+                                        f"{cname}(), which takes the same "
+                                        f"non-reentrant lock — self-deadlock "
+                                        f"(use the *_locked convention)",
+                                    ))
+                                continue
+                            add_edge(h, m, s.path, site.line,
+                                     f"{where} -> {cname}() "
+                                     f"takes {_short(m)}")
+
+        # Cycle detection over the lock graph (DFS; each cycle reported at
+        # its first witness edge).
+        graph: dict[str, set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+
+        seen_cycles: set[frozenset] = set()
+
+        def find_cycle_from(start: str):
+            stack = [(start, [start])]
+            visited = set()
+            while stack:
+                node, trail = stack.pop()
+                for nxt in graph.get(node, ()):
+                    if nxt == start:
+                        return trail + [start]
+                    if nxt not in visited:
+                        visited.add(nxt)
+                        stack.append((nxt, trail + [nxt]))
+            return None
+
+        for start in sorted(graph):
+            cycle = find_cycle_from(start)
+            if not cycle:
+                continue
+            key = frozenset(cycle)
+            if key in seen_cycles:
+                continue
+            seen_cycles.add(key)
+            legs = []
+            wpath, wline = None, 0
+            for a, b in zip(cycle, cycle[1:]):
+                path, line, ctx = edges[(a, b)]
+                if wpath is None:
+                    wpath, wline = path, line
+                legs.append(f"{_short(a)} -> {_short(b)} ({ctx} at "
+                            f"{path}:{line})")
+            out.append(self.finding(
+                wpath, wline,
+                "lock-order cycle — two threads taking these locks in "
+                "opposite orders deadlock: " + "; ".join(legs),
+            ))
+        return out

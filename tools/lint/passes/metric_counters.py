@@ -64,6 +64,8 @@ class MetricCountersPass(Pass):
     def run(self, repo: Repo) -> list[Finding]:
         out: list[Finding] = []
         for path in repo.files(*self.globs):
+            if not repo.in_scope(path):
+                continue  # --since incremental mode
             tree = repo.tree(path)
             module_classes = repo.classes(path)
             for cls in ast.walk(tree):

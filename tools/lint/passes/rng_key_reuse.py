@@ -1,0 +1,251 @@
+"""rng-key-reuse: one jax.random key feeding two consumers.
+
+JAX PRNG keys are values, not stateful generators: drawing from the same
+key twice produces IDENTICAL (or correlated) randomness. The engine's whole
+sampling story is an RNG CHAIN built on this invariant — per-slot keys
+split once per drawn token, the swap/recompute resume path advances the
+saved key one split so re-admitted slots match the uncontended run
+byte-for-byte, and the spec-decode accept loop splits per verify step. One
+code path that consumes a key twice (two samplers, or sampling from a key
+after splitting it) silently correlates "independent" draws — the kind of
+bug that passes every shape check and corrupts sampled output only.
+
+Rule, per function scope: a key-typed binding (assigned from
+jax.random.key/PRNGKey/split/fold_in/wrap_key_data, or a key-named
+parameter) may be consumed at most ONCE per binding generation. Consumers:
+jax.random samplers, jax.random.split (using a parent after splitting it),
+jax.vmap-wrapped forms of either, and project helpers whose summary says
+they consume their key parameter (tools.lint.summaries — the
+interprocedural part). `fold_in(key, i)` does NOT consume: deriving
+per-step keys from one base via fold_in is the blessed pattern.
+Control flow: rebinds start a new generation, if/else branches merge
+conservatively, and loop bodies are walked twice so "same key drawn every
+iteration" surfaces.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import astutil
+from ..core import Finding, Pass, Repo
+from ..flow import FlowState, LinearFlow
+from ..summaries import DEFAULT_SUMMARY_GLOBS, KEY_CONSUMERS, summaries_for
+
+DEFAULT_GLOBS = (
+    "localai_tpu/engine/*.py",
+    "localai_tpu/models/*.py",
+    "localai_tpu/ops/*.py",
+)
+
+# Calls whose RESULT is a key (or batch/array of keys).
+KEY_PRODUCERS = {"key", "PRNGKey", "split", "fold_in", "wrap_key_data"}
+KEY_PARAM_NAMES = {"key", "rng", "rngs", "prng_key", "base_key"}
+
+
+def _jax_random_fn(name: str) -> str:
+    """'categorical' for 'jax.random.categorical', '' when not jax.random."""
+    if name.startswith("jax.random."):
+        return name.split(".")[-1]
+    return ""
+
+
+def _vmap_inner(call: ast.Call):
+    """For `jax.vmap(f)(args)` / `jax.vmap(f, ...)(args)`: the wrapped f
+    node, else None."""
+    if (isinstance(call.func, ast.Call)
+            and astutil.dotted_name(call.func.func) in ("jax.vmap", "vmap")
+            and call.func.args):
+        return call.func.args[0]
+    return None
+
+
+def _names_outside_calls(node: ast.AST):
+    """Name ids in an argument expression, NOT descending into nested
+    calls — `normal(fold_in(key, i))` consumes fold_in's fresh result, not
+    `key` itself (the nested call was already evaluated on its own)."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, ast.Call):
+            continue
+        if isinstance(cur, ast.Name):
+            yield cur.id
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+def _lambda_consumes_param(lam: ast.Lambda) -> bool:
+    """Does the lambda body consume any of its own params as a key?"""
+    params = {a.arg for a in lam.args.args}
+    for node in ast.walk(lam.body):
+        if isinstance(node, ast.Call):
+            fn = _jax_random_fn(astutil.dotted_name(node.func))
+            if fn in KEY_CONSUMERS:
+                for a in node.args:
+                    for sub in ast.walk(a):
+                        if isinstance(sub, ast.Name) and sub.id in params:
+                            return True
+    return False
+
+
+class _KeyFlow(LinearFlow):
+    def __init__(self, pass_globs, repo, path, fn):
+        super().__init__()
+        self.repo = repo
+        self.path = path
+        self.fn = fn
+        self.idx = summaries_for(repo, pass_globs)
+        self.graph = self.idx.graph
+        self.fd = self.graph._by_node.get(id(fn))
+        self.ltypes = (self.graph.local_types(path, fn)
+                       if self.fd is not None else {})
+
+    # -------- key-ness -------- #
+
+    def _expr_is_key(self, node: ast.AST, st: FlowState) -> bool:
+        """Does this RHS produce a key-typed value? STRUCTURAL, not
+        contains-based: a producer call (key/split/fold_in/..., plain or
+        vmap-wrapped), a tracked name, or a subscript/tuple thereof. A
+        SAMPLER call is data even when a key appears in its args — marking
+        `u = uniform(key)` as a key would flag every later use of u."""
+        if isinstance(node, ast.Call):
+            fn = _jax_random_fn(astutil.dotted_name(node.func))
+            if fn in KEY_PRODUCERS:
+                return True
+            if fn in KEY_CONSUMERS:
+                return False
+            inner = _vmap_inner(node)
+            if inner is not None:
+                nm = _jax_random_fn(astutil.dotted_name(inner))
+                if nm in KEY_PRODUCERS:
+                    return True
+                if nm in KEY_CONSUMERS:
+                    return False
+                if isinstance(inner, ast.Lambda):
+                    prods = [
+                        _jax_random_fn(astutil.dotted_name(c.func))
+                        for c in ast.walk(inner.body)
+                        if isinstance(c, ast.Call)
+                    ]
+                    if any(p in KEY_PRODUCERS for p in prods):
+                        return True
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in st.tracked
+        if isinstance(node, ast.Subscript):
+            return self._expr_is_key(node.value, st)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._expr_is_key(e, st) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self._expr_is_key(node.value, st)
+        if isinstance(node, ast.IfExp):
+            return (self._expr_is_key(node.body, st)
+                    or self._expr_is_key(node.orelse, st))
+        return False
+
+    # -------- consumption -------- #
+
+    def _call_consumes(self, call: ast.Call) -> bool:
+        name = astutil.dotted_name(call.func)
+        if _jax_random_fn(name) in KEY_CONSUMERS:
+            return True
+        inner = _vmap_inner(call)
+        if inner is not None:
+            if _jax_random_fn(astutil.dotted_name(inner)) in KEY_CONSUMERS:
+                return True
+            if isinstance(inner, ast.Lambda) and _lambda_consumes_param(inner):
+                return True
+        # Project helper whose summary consumes a key param.
+        if self.fd is not None:
+            for fid in self.graph.resolve(self.fd, call, self.ltypes):
+                s = self.idx.summaries.get(fid)
+                if s and s.key_params_consumed:
+                    return True
+        return False
+
+    def handle_expr(self, node: ast.AST, st: FlowState) -> None:
+        # Evaluate nested calls innermost-first so `split(key)` inside a
+        # larger expression registers before the enclosing call.
+        if isinstance(node, ast.Call):
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                self.handle_expr(a, st)
+            if isinstance(node.func, ast.Call):
+                self.handle_expr(node.func, st)
+            if self._call_consumes(node):
+                for a in list(node.args) + [kw.value for kw in node.keywords]:
+                    for name in _names_outside_calls(a):
+                        if name in st.tracked:
+                            first = st.consume(name, node.lineno)
+                            if first is not None:
+                                self.record(node.lineno, first, name)
+            return
+        if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            return  # separate scope
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.handle_expr(child, st)
+
+    def handle_assign(self, stmt, st: FlowState) -> None:
+        value = getattr(stmt, "value", None)
+        if value is not None:
+            self.handle_expr(value, st)
+        is_key = value is not None and self._expr_is_key(value, st)
+        targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                   else [stmt.target])
+        for t in targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Name):
+                    st.rebind(sub.id, still_tracked=is_key)
+
+    # -------- entry -------- #
+
+    def run(self, st: FlowState) -> None:
+        args = self.fn.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            if a.arg in KEY_PARAM_NAMES or a.arg.endswith("_key"):
+                st.track(a.arg)
+        self.exec_block(self.fn.body, st)
+
+
+def _scopes(tree: ast.Module):
+    """Every function scope in the module (methods and nested defs
+    included) — each analyzed independently, matching trace-safety's
+    scope discipline."""
+    for node in ast.walk(tree):
+        if isinstance(node, astutil.FunctionNode):
+            yield node
+
+
+class RngKeyReusePass(Pass):
+    id = "rng-key-reuse"
+    description = (
+        "jax.random key consumed twice without an interleaving "
+        "split/fold_in (correlated 'independent' draws)"
+    )
+
+    def __init__(self, globs=None):
+        self.globs = tuple(DEFAULT_GLOBS if globs is None else globs)
+        # Helper summaries come from the shared union index on default scope.
+        self.summary_globs = (DEFAULT_SUMMARY_GLOBS if globs is None
+                              else self.globs)
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        for path in repo.files(*self.globs):
+            if not repo.in_scope(path):
+                continue
+            for fn in _scopes(repo.tree(path)):
+                walker = _KeyFlow(self.summary_globs, repo, path, fn)
+                walker.run(FlowState())
+                for line, first, name in sorted(walker.hits.values()):
+                    out.append(self.finding(
+                        path, line,
+                        f"jax.random key {name!r} consumed again (first "
+                        f"consumed at line {first}) with no interleaving "
+                        f"split/fold_in rebind — the two consumers draw "
+                        f"CORRELATED randomness; split the key and pass "
+                        f"the subkeys",
+                    ))
+        return out

@@ -1,0 +1,301 @@
+"""sharding-consistency: the mesh-sharded engine's cross-file name contracts.
+
+Tensor-parallel serving (PR 7) is correct only while four files agree on
+names that Python never checks (PAPERS.md "Scalable Training of Language
+Models using JAX pjit and TPUv4" — spec/tree mismatch is the canonical
+sharded-training failure, and it fails SILENTLY: a missing spec replicates
+the weight, a stale spec KeyErrors at load, a typo'd mesh axis shards over
+nothing):
+
+  C1  parallel/sharding.py `*_specs` names  <->  models/llama.py param-tree
+      names. Every PartitionSpec name must exist in the tree built by
+      init_params/_init_attn_layers and vice versa — both directions,
+      compared as NAME SETS (flag conditions differ per-arch; a name that
+      exists on NEITHER side of any arch is drift).
+
+  C2  every mesh-axis string — in PartitionSpec(...) literals and in
+      collective axis arguments (psum/pmax/ppermute/all_gather/axis_index/
+      ...) — must be declared in parallel/mesh.py AXES. A typo'd axis
+      compiles fine and shards over a 1-sized ghost axis.
+
+  C3  collectives run ONLY inside declared boundary functions: a module
+      that issues jax.lax collectives must declare them in a module-level
+      `COLLECTIVE_BOUNDARY = ("fn", ...)` tuple (ops/attention.py's
+      sp-partials, parallel/ring.py's ring rotation). A collective outside
+      a declared boundary is an undeclared ICI dependency on the per-token
+      path — exactly what the head-sharded kernel work (ISSUE 7) exists to
+      prevent; a declared boundary with no collective is a stale
+      declaration and is also flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import astutil
+from ..core import Finding, Pass, Repo
+
+SHARDING_PY = "localai_tpu/parallel/sharding.py"
+LLAMA_PY = "localai_tpu/models/llama.py"
+MESH_PY = "localai_tpu/parallel/mesh.py"
+
+COLLECTIVE_GLOBS = [
+    "localai_tpu/ops/*.py",
+    "localai_tpu/parallel/*.py",
+    "localai_tpu/models/*.py",
+    "localai_tpu/engine/*.py",
+    "localai_tpu/train/*.py",
+]
+
+# Data-moving collectives that MUST live inside a declared boundary.
+COLLECTIVES = {"psum", "pmax", "pmin", "ppermute", "all_gather",
+               "all_to_all", "psum_scatter", "pmean"}
+# Axis-consuming calls checked against AXES (first positional axis arg
+# after the value operand, or the axis_name/axis keyword).
+AXIS_CALLS = COLLECTIVES | {"axis_index", "axis_size"}
+
+TREE_FNS = ("init_params", "_init_attn_layers")
+
+
+def _is_spec_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and astutil.dotted_name(node.func).split(".")[-1]
+            in ("P", "PartitionSpec"))
+
+
+def _collect_str_keys(fn) -> dict[str, int]:
+    """String keys assigned in a function: dict literals and
+    `X["key"] = ...` subscript stores."""
+    out: dict[str, int] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out.setdefault(k.value, k.lineno)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.slice, ast.Constant)
+                        and isinstance(t.slice.value, str)):
+                    out.setdefault(t.slice.value, t.lineno)
+    return out
+
+
+class ShardingConsistencyPass(Pass):
+    id = "sharding-consistency"
+    description = (
+        "param_specs/param-tree name drift, undeclared mesh axes, and "
+        "collectives outside declared boundary functions"
+    )
+    project_wide = True  # the contract spans four files by construction
+
+    def __init__(self, sharding_py=SHARDING_PY, llama_py=LLAMA_PY,
+                 mesh_py=MESH_PY, collective_globs=None, tree_fns=TREE_FNS):
+        self.sharding_py = sharding_py
+        self.llama_py = llama_py
+        self.mesh_py = mesh_py
+        self.collective_globs = (COLLECTIVE_GLOBS if collective_globs is None
+                                 else collective_globs)
+        self.tree_fns = tree_fns
+
+    # ---------------- C1: specs <-> tree ---------------- #
+
+    def _spec_names(self, repo: Repo) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for node in repo.tree(self.sharding_py).body:
+            if not isinstance(node, astutil.FunctionNode):
+                continue
+            if not (node.name.endswith("_specs") or node.name == "param_specs"):
+                continue
+            out.update(_collect_str_keys(node))
+        return out
+
+    def _tree_names(self, repo: Repo) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for node in repo.tree(self.llama_py).body:
+            if (isinstance(node, astutil.FunctionNode)
+                    and node.name in self.tree_fns):
+                out.update(_collect_str_keys(node))
+        return out
+
+    def _check_names(self, repo: Repo, out: list[Finding]) -> None:
+        if not (repo.exists(self.sharding_py) and repo.exists(self.llama_py)):
+            return
+        specs = self._spec_names(repo)
+        tree = self._tree_names(repo)
+        if not specs or not tree:
+            return
+        for name, line in sorted(specs.items()):
+            if name not in tree:
+                out.append(self.finding(
+                    self.sharding_py, line,
+                    f"param spec {name!r} has no matching name in the "
+                    f"param tree ({self.llama_py} {'/'.join(self.tree_fns)})"
+                    f" — a stale spec KeyErrors placement or shards a "
+                    f"tensor that no longer exists",
+                ))
+        for name, line in sorted(tree.items()):
+            if name not in specs:
+                out.append(self.finding(
+                    self.llama_py, line,
+                    f"param tree name {name!r} has no PartitionSpec in "
+                    f"{self.sharding_py} — the weight would materialize "
+                    f"REPLICATED on every chip (or break the spec/param "
+                    f"tree_map) under tp>1",
+                ))
+
+    # ---------------- C2 + C3: axes and boundaries ---------------- #
+
+    def _declared_axes(self, repo: Repo) -> set[str]:
+        if not repo.exists(self.mesh_py):
+            return set()
+        for node in repo.tree(self.mesh_py).body:
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "AXES"
+                            for t in node.targets)
+                    and isinstance(node.value, (ast.Tuple, ast.List))):
+                return {
+                    e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                }
+        return set()
+
+    @staticmethod
+    def _boundary_decl(tree: ast.Module):
+        """(names, line) of the module-level COLLECTIVE_BOUNDARY tuple, or
+        (None, 0) when the module declares none."""
+        for node in tree.body:
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "COLLECTIVE_BOUNDARY"
+                            for t in node.targets)
+                    and isinstance(node.value, (ast.Tuple, ast.List))):
+                return ({
+                    e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                }, node.lineno)
+        return None, 0
+
+    @staticmethod
+    def _axis_arg(call: ast.Call):
+        """The axis-name argument of a collective/axis call: axis_index(ax)
+        takes it first, value-collectives take it second; axis_name= /
+        axis= keywords win."""
+        for kw in call.keywords:
+            if kw.arg in ("axis_name", "axis"):
+                return kw.value
+        name = astutil.dotted_name(call.func).split(".")[-1]
+        idx = 0 if name in ("axis_index", "axis_size") else 1
+        if len(call.args) > idx:
+            return call.args[idx]
+        return None
+
+    def _check_collectives(self, repo: Repo, axes: set[str],
+                           out: list[Finding]) -> None:
+        files = list(dict.fromkeys(
+            repo.files(*self.collective_globs) + [self.sharding_py]
+        ))
+        for path in files:
+            if not repo.exists(path):
+                continue
+            tree = repo.tree(path)
+            boundary, decl_line = self._boundary_decl(tree)
+
+            # Map every node to its enclosing top-level function.
+            encl: dict[int, str] = {}
+            top_funcs: dict[str, ast.AST] = {}
+            for node in tree.body:
+                if isinstance(node, astutil.FunctionNode):
+                    top_funcs[node.name] = node
+                    for sub in ast.walk(node):
+                        encl[id(sub)] = node.name
+                elif isinstance(node, ast.ClassDef):
+                    for sub in ast.walk(node):
+                        encl[id(sub)] = f"{node.name}.<method>"
+
+            used_boundaries: set[str] = set()
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = astutil.dotted_name(node.func)
+                last = dotted.split(".")[-1]
+                if last not in AXIS_CALLS or not dotted.startswith(
+                        ("jax.lax.", "lax.")):
+                    continue
+                # C2: literal axis names must be declared mesh axes.
+                ax = self._axis_arg(node)
+                if axes and isinstance(ax, ast.Constant) and isinstance(ax.value, str):
+                    if ax.value not in axes:
+                        out.append(self.finding(
+                            path, node.lineno,
+                            f"{last}(..., {ax.value!r}) names a mesh axis "
+                            f"not declared in {self.mesh_py} AXES "
+                            f"({sorted(axes)}) — it would shard over a "
+                            f"ghost axis",
+                        ))
+                # C3: data-moving collectives need a declared boundary.
+                if last not in COLLECTIVES:
+                    continue
+                owner = encl.get(id(node), "<module>")
+                if boundary is None:
+                    out.append(self.finding(
+                        path, node.lineno,
+                        f"jax.lax.{last} in {owner} but {path} declares no "
+                        f"COLLECTIVE_BOUNDARY — declare the boundary "
+                        f"functions so undeclared ICI dependencies can't "
+                        f"creep onto the per-token path",
+                    ))
+                elif owner not in boundary:
+                    out.append(self.finding(
+                        path, node.lineno,
+                        f"jax.lax.{last} in {owner}, which is not in "
+                        f"{path}'s COLLECTIVE_BOUNDARY {sorted(boundary)} — "
+                        f"collectives belong inside the declared o/down "
+                        f"boundary functions only",
+                    ))
+                else:
+                    used_boundaries.add(owner)
+
+            if boundary:
+                for name in sorted(boundary):
+                    if name not in top_funcs:
+                        out.append(self.finding(
+                            path, decl_line,
+                            f"COLLECTIVE_BOUNDARY names {name!r} but no "
+                            f"top-level function of that name exists — "
+                            f"stale declaration",
+                        ))
+                    elif name not in used_boundaries:
+                        out.append(self.finding(
+                            path, decl_line,
+                            f"COLLECTIVE_BOUNDARY names {name!r} but it "
+                            f"contains no collective — stale declaration "
+                            f"(tighten it or delete it)",
+                        ))
+
+            # C2 for PartitionSpec literals everywhere in the file.
+            if axes:
+                for node in ast.walk(tree):
+                    if not _is_spec_call(node):
+                        continue
+                    for a in node.args:
+                        if (isinstance(a, ast.Constant)
+                                and isinstance(a.value, str)
+                                and a.value not in axes):
+                            out.append(self.finding(
+                                path, a.lineno if hasattr(a, "lineno")
+                                else node.lineno,
+                                f"PartitionSpec axis {a.value!r} not "
+                                f"declared in {self.mesh_py} AXES "
+                                f"({sorted(axes)}) — typo'd axes shard "
+                                f"over nothing",
+                            ))
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        self._check_names(repo, out)
+        axes = self._declared_axes(repo)
+        self._check_collectives(repo, axes, out)
+        return out

@@ -112,7 +112,7 @@ class TerminalEventPass(Pass):
     def run(self, repo: Repo) -> list[Finding]:
         out: list[Finding] = []
         for path, class_name, pending_attr, slots_attr in self.targets:
-            if not repo.exists(path):
+            if not repo.exists(path) or not repo.in_scope(path):
                 continue
             cls = repo.find_class(path, class_name)
             if cls is None:

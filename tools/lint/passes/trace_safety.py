@@ -308,11 +308,13 @@ class TraceSafetyPass(Pass):
     def run(self, repo: Repo) -> list[Finding]:
         out: list[Finding] = []
         for path in repo.files(*self.traced_globs):
+            if not repo.in_scope(path):
+                continue  # --since incremental mode
             for node in ast.walk(repo.tree(path)):
                 if isinstance(node, astutil.FunctionNode):
                     self._check_traced_fn(path, node, out)
         epath, ecls = self.engine_target
-        if repo.exists(epath):
+        if repo.exists(epath) and repo.in_scope(epath):
             cls = repo.find_class(epath, ecls)
             if cls is not None:
                 for mname, fn in astutil.methods_of(cls).items():

@@ -1,0 +1,329 @@
+"""Per-function summaries for interprocedural passes (ISSUE 8).
+
+One walk per function computes everything the new passes consume, cached on
+the Repo next to the AST/module cache so the four passes (and a --since
+rerun) share a single build:
+
+  - locks:     which locks a function ACQUIRES (`with self.lock:` /
+               `with MODULE_LOCK:`), which locks are held AT each
+               acquisition and at each call site, and which locks a
+               `*_locked` method assumes held on entry (the repo convention:
+               caller holds the class lock). Lock identity is
+               "path::Class.attr" (or "path::NAME" for module locks) — one
+               id per lock OBJECT SLOT, which is the granularity deadlock
+               ordering is about.
+  - calls:     resolved candidate callees (tools.lint.callgraph) with the
+               held-lock set, for the lock-order fixpoint.
+  - rng keys:  whether a key-named parameter is consumed (passed to a
+               jax.random sampler or split/fold_in) — callers treat passing
+               a key to such a helper as one consumption of that key.
+  - donation:  whether the function returns a `jax.jit(..., donate_argnums=...)`
+               callable and which positions are ALWAYS donated (the literal
+               base tuple; conditional extensions are not claimed).
+
+The fixpoint (`may_acquire`) propagates lock acquisition up the call graph
+until stable, which is what turns "this function takes a lock" into "this
+call may take that lock while you hold yours" — the lock-order edge.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+from . import astutil
+from .callgraph import CallGraph, FuncDef, callgraph_for
+from .core import Repo
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+# The union of every interprocedural pass's default targets: passes running
+# with DEFAULT scope share ONE SummaryIndex build under this key instead of
+# each building their own (fixture runs with custom globs still get their
+# own small index).
+DEFAULT_SUMMARY_GLOBS = (
+    "localai_tpu/engine/*.py",
+    "localai_tpu/server/manager.py",
+    "localai_tpu/federation/router.py",
+    "localai_tpu/cluster/*.py",
+    "localai_tpu/models/*.py",
+    "localai_tpu/ops/*.py",
+    "localai_tpu/parallel/*.py",
+    "localai_tpu/train/*.py",
+)
+
+# jax.random functions that CONSUME a key. `split` is a consumer (splitting
+# the same key twice yields the same children — the canonical correlated-
+# streams bug); `fold_in` is NOT (fold_in(key, i) with varying data is the
+# blessed way to derive many independent keys from one base).
+KEY_CONSUMERS = {
+    "normal", "uniform", "categorical", "gumbel", "bernoulli", "randint",
+    "truncated_normal", "permutation", "choice", "exponential", "laplace",
+    "gamma", "beta", "dirichlet", "poisson", "rademacher", "bits",
+    "split",
+}
+KEY_PARAM_NAMES = {"key", "rng", "rngs", "prng_key", "base_key"}
+
+
+def class_lock_attrs(cls: ast.ClassDef) -> dict[str, str]:
+    """{attr: ctor} for attributes assigned from threading.Lock()/RLock()/
+    Condition() anywhere in the class."""
+    out: dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        ctor = astutil.dotted_name(node.value.func).split(".")[-1]
+        if ctor in _LOCK_CTORS:
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name):
+                    out[t.attr] = ctor
+    return out
+
+
+def module_lock_names(tree: ast.Module) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        ctor = astutil.dotted_name(node.value.func).split(".")[-1]
+        if ctor in _LOCK_CTORS:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = ctor
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Acquisition:
+    lock: str
+    held: tuple[str, ...]  # locks already held when this one is taken
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class CallSite:
+    callees: tuple[str, ...]
+    held: tuple[str, ...]
+    line: int
+    self_call: bool  # receiver provably the same instance (`self.m()`)
+
+
+@dataclasses.dataclass
+class FuncSummary:
+    fid: str
+    path: str
+    cls: Optional[str]
+    name: str
+    entry_locks: tuple[str, ...]
+    acquisitions: tuple[Acquisition, ...]
+    calls: tuple[CallSite, ...]
+    key_params_consumed: tuple[str, ...]
+    donates: Optional[tuple[int, ...]]  # returned-callable donated positions
+
+
+class SummaryIndex:
+    """All function summaries over a CallGraph's files, plus the
+    may-acquire fixpoint."""
+
+    def __init__(self, repo: Repo, graph: CallGraph):
+        self.repo = repo
+        self.graph = graph
+        self.summaries: dict[str, FuncSummary] = {}
+        self._class_locks: dict[tuple[str, str], dict[str, str]] = {}
+        self._module_locks: dict[str, dict[str, str]] = {}
+        # lock id -> threading ctor name ("Lock"/"RLock"/"Condition")
+        self.lock_kinds: dict[str, str] = {}
+        for (path, cname), cls in graph.classes.items():
+            attrs = class_lock_attrs(cls)
+            self._class_locks[(path, cname)] = attrs
+            for attr, ctor in attrs.items():
+                self.lock_kinds[f"{path}::{cname}.{attr}"] = ctor
+        for path in graph.paths:
+            mlocks = module_lock_names(repo.tree(path))
+            self._module_locks[path] = mlocks
+            for name, ctor in mlocks.items():
+                self.lock_kinds[f"{path}::{name}"] = ctor
+        for fid, fd in graph.funcs.items():
+            self.summaries[fid] = self._summarize(fd)
+        self._may_acquire: Optional[dict[str, set[str]]] = None
+
+    # ---------------- per-function walk ---------------- #
+
+    def _entry_locks(self, fd: FuncDef) -> tuple[str, ...]:
+        """`*_locked` methods run with the class lock held BY CONVENTION —
+        only claimable when the class has exactly one lock attr (ambiguous
+        multi-lock classes get no assumption: missing edges over false
+        ones)."""
+        if fd.cls is None or not fd.name.endswith("_locked"):
+            return ()
+        locks = self._class_locks.get((fd.path, fd.cls), set())
+        if len(locks) == 1:
+            return (f"{fd.path}::{fd.cls}.{next(iter(locks))}",)
+        return ()
+
+    def _lock_id_for_with(self, fd: FuncDef, ctx: ast.expr,
+                          me: Optional[str]) -> Optional[str]:
+        if (isinstance(ctx, ast.Attribute) and isinstance(ctx.value, ast.Name)
+                and me is not None and ctx.value.id == me and fd.cls):
+            if ctx.attr in self._class_locks.get((fd.path, fd.cls), ()):
+                return f"{fd.path}::{fd.cls}.{ctx.attr}"
+            return None
+        if isinstance(ctx, ast.Name):
+            if ctx.id in self._module_locks.get(fd.path, ()):
+                return f"{fd.path}::{ctx.id}"
+        return None
+
+    def _donated_positions(self, fn) -> Optional[tuple[int, ...]]:
+        """Base donated positions of a returned jax.jit callable: the
+        FIRST literal tuple bound to donate_argnums (or to the local it
+        names). Conditional `donate += (...)` extensions are ignored —
+        summaries only claim what is donated on EVERY path."""
+        lit_tuples: dict[str, tuple[int, ...]] = {}
+        jitted: dict[str, tuple[int, ...]] = {}
+        returned: Optional[tuple[int, ...]] = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Tuple):
+                vals = []
+                ok = True
+                for e in node.value.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                        vals.append(e.value)
+                    else:
+                        ok = False
+                for t in node.targets:
+                    if ok and isinstance(t, ast.Name) and t.id not in lit_tuples:
+                        lit_tuples[t.id] = tuple(vals)
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                call = node.value
+                if astutil.dotted_name(call.func) in ("jax.jit", "jit"):
+                    pos: Optional[tuple[int, ...]] = None
+                    for kw in call.keywords:
+                        if kw.arg != "donate_argnums":
+                            continue
+                        v = kw.value
+                        if isinstance(v, ast.Tuple):
+                            got = [e.value for e in v.elts
+                                   if isinstance(e, ast.Constant)
+                                   and isinstance(e.value, int)]
+                            pos = tuple(got)
+                        elif isinstance(v, ast.Constant) and isinstance(v.value, int):
+                            pos = (v.value,)
+                        elif isinstance(v, ast.Name) and v.id in lit_tuples:
+                            pos = lit_tuples[v.id]
+                    if pos:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                jitted[t.id] = pos
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Return) and isinstance(node.value, ast.Name)
+                    and node.value.id in jitted):
+                returned = jitted[node.value.id]
+        return returned
+
+    @staticmethod
+    def _key_params(fn) -> set[str]:
+        return {
+            a.arg for a in list(fn.args.posonlyargs) + list(fn.args.args)
+            + list(fn.args.kwonlyargs)
+            if a.arg in KEY_PARAM_NAMES or a.arg.endswith("_key")
+        }
+
+    def _summarize(self, fd: FuncDef) -> FuncSummary:
+        me = astutil.self_name(fd.node) if fd.cls else None
+        entry = self._entry_locks(fd)
+        ltypes = self.graph.local_types(fd.path, fd.node)
+        acquisitions: list[Acquisition] = []
+        calls: list[CallSite] = []
+        key_params = self._key_params(fd.node)
+        keys_consumed: set[str] = set()
+        has_jit = False
+
+        def walk(node: ast.AST, held: tuple[str, ...]) -> None:
+            nonlocal has_jit
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lock = self._lock_id_for_with(fd, item.context_expr, me)
+                    if lock is not None:
+                        acquisitions.append(Acquisition(lock, held, node.lineno))
+                        held = held + (lock,)
+            if isinstance(node, ast.Call):
+                name = astutil.dotted_name(node.func)
+                if name in ("jax.jit", "jit"):
+                    has_jit = True
+                if (key_params and name.startswith("jax.random.")
+                        and name.split(".")[-1] in KEY_CONSUMERS):
+                    for a in node.args:
+                        for sub in ast.walk(a):
+                            if isinstance(sub, ast.Name) and sub.id in key_params:
+                                keys_consumed.add(sub.id)
+                cands = self.graph.resolve(fd, node, local_types=ltypes)
+                is_self = (
+                    isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and me is not None and node.func.value.id == me
+                )
+                if cands:
+                    calls.append(CallSite(cands, held, node.lineno, is_self))
+            for child in ast.iter_child_nodes(node):
+                # Nested defs execute later, not here — their bodies are
+                # summarized separately (and a `with lock:` wrapping a def
+                # does NOT mean the def runs locked). The jit/key scans DO
+                # cover nested defs: a builder's nested jitted fn is the
+                # whole point of the donation summary.
+                if isinstance(child, astutil.FunctionNode) and child is not fd.node:
+                    for sub in ast.walk(child):
+                        if (isinstance(sub, ast.Call)
+                                and astutil.dotted_name(sub.func)
+                                in ("jax.jit", "jit")):
+                            has_jit = True
+                            break
+                    continue
+                walk(child, held)
+
+        walk(fd.node, entry)
+        return FuncSummary(
+            fid=fd.fid, path=fd.path, cls=fd.cls, name=fd.name,
+            entry_locks=entry,
+            acquisitions=tuple(acquisitions),
+            calls=tuple(calls),
+            key_params_consumed=tuple(sorted(keys_consumed)),
+            donates=self._donated_positions(fd.node) if has_jit else None,
+        )
+
+    # ---------------- fixpoint ---------------- #
+
+    def may_acquire(self) -> dict[str, set[str]]:
+        """fid -> every lock the function may take during its execution,
+        transitively through resolved calls, propagated to a fixpoint."""
+        if self._may_acquire is not None:
+            return self._may_acquire
+        acq = {
+            fid: {a.lock for a in s.acquisitions}
+            for fid, s in self.summaries.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for fid, s in self.summaries.items():
+                cur = acq[fid]
+                for site in s.calls:
+                    for callee in site.callees:
+                        extra = acq.get(callee)
+                        if extra and not extra <= cur:
+                            cur |= extra
+                            changed = True
+        self._may_acquire = acq
+        return acq
+
+
+def summaries_for(repo: Repo, globs: tuple[str, ...]) -> SummaryIndex:
+    """Repo-cached SummaryIndex per glob set — the per-function summary
+    cache that rides alongside the AST/module cache."""
+    cache = getattr(repo, "_summary_indexes", None)
+    if cache is None:
+        cache = repo._summary_indexes = {}
+    key = tuple(sorted(globs))
+    if key not in cache:
+        cache[key] = SummaryIndex(repo, callgraph_for(repo, key))
+    return cache[key]
