@@ -398,6 +398,196 @@ def main() -> None:
                 peng.cache = None
                 peng = None
 
+    # Page-size sweep on the paged row (ISSUE 9 satellite): the r04 0.73x
+    # paged_vs_dense gap is partly a page-size tuning question — smaller
+    # pages waste less ragged tail per slot but cost more table columns /
+    # DMA descriptors per walk. One tok/s per size, same 60%-of-dense pool
+    # BYTES, so the TPU run picks the knee with data instead of folklore.
+    if os.environ.get("BENCH_PAGED_SWEEP", "1") != "0" and max_seq % 128 == 0:
+        for page_s in (8, 16, 32):
+            seng = None
+            try:
+                pool_s = max(2, int(slots * (max_seq // page_s) * 0.6))
+                seng = Engine(
+                    cfg, params, ByteTokenizer(cfg.vocab_size),
+                    engine_cfg=EngineConfig(max_slots=slots, max_seq=max_seq,
+                                            kv_pages=pool_s,
+                                            kv_page_size=page_s),
+                )
+                seng.start()
+                seng.warmup(prompt_len)
+                seng._decode_time = 0.0
+                seng._decode_tokens = 0
+                ths = [threading.Thread(target=lambda i=i: seng.generate(
+                    [(i * 37 + j) % 255 + 1 for j in range(prompt_len)],
+                    max_new_tokens=gen_len, ignore_eos=True,
+                )) for i in range(slots)]
+                for t in ths:
+                    t.start()
+                _join_or_die(ths, seng, f"paged sweep page={page_s}")
+                tps_s = (seng._decode_tokens / seng._decode_time
+                         if seng._decode_time else 0.0)
+                out[f"paged_tps_page{page_s}"] = round(tps_s, 2)
+                print(
+                    f"paged sweep: page={page_s} -> {tps_s:.1f} tok/s "
+                    f"({tps_s / max(decode_tps, 1e-9):.2f}x dense)",
+                    file=sys.stderr,
+                )
+            except Exception as e:  # noqa: BLE001 — extra row is best-effort
+                print(f"paged sweep page={page_s} failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+            finally:
+                if seng is not None:
+                    seng.stop()
+                    seng.params = None
+                    seng.cache = None
+                    seng = None
+
+    # Quantized-decode ladder (ISSUE 9, docs/QUANTIZATION.md roofline math):
+    # decode tok/s + derived bytes/token for bf16 / int8 / int4 /
+    # int8+fp8-KV, all through the paged pool at bs `slots`. bytes/token is
+    # the THEORETICAL stream (weight bytes + avg live KV) / batch — the
+    # ratio of tok/s across rows against the ratio of bytes/token is
+    # exactly how much of the quantization win the fused dequant-matmul
+    # kernels actually deliver (XLA's materialized dequant copy made int4
+    # stream ~2.5 B/weight; the kernels stream the packed 0.5).
+    if os.environ.get("BENCH_QUANT", "1") != "0" and max_seq % 128 == 0:
+        page = 128
+        pool = max(2, int(slots * (max_seq // page) * 0.6))
+        qmodes = [
+            ("bf16", "", ""),
+            ("int8", "int8", ""),
+            ("int4", "int4", ""),
+            ("int8_fp8kv", "int8", "fp8"),
+        ]
+        for tag, qmode, kvdt in qmodes:
+            qeng = None
+            try:
+                qeng = Engine(
+                    cfg, params, ByteTokenizer(cfg.vocab_size),
+                    engine_cfg=EngineConfig(
+                        max_slots=slots, max_seq=max_seq, kv_pages=pool,
+                        kv_page_size=page, kv_cache_dtype=kvdt,
+                    ),
+                    quantization=qmode,
+                )
+                qeng.start()
+                qeng.warmup(prompt_len)
+                qeng._decode_time = 0.0
+                qeng._decode_tokens = 0
+                ths = [threading.Thread(target=lambda i=i: qeng.generate(
+                    [(i * 37 + j) % 255 + 1 for j in range(prompt_len)],
+                    max_new_tokens=gen_len, ignore_eos=True,
+                )) for i in range(slots)]
+                for t in ths:
+                    t.start()
+                _join_or_die(ths, qeng, f"quant row {tag}")
+                qtps = (qeng._decode_tokens / qeng._decode_time
+                        if qeng._decode_time else 0.0)
+                wbytes = sum(
+                    a.size * a.dtype.itemsize
+                    for a in jax.tree.leaves(qeng.params)
+                )
+                import jax.numpy as _jnp
+
+                kv_item = _jnp.dtype(
+                    qeng.ecfg.cache_dtype(cfg.dtype)
+                ).itemsize
+                avg_len = prompt_len + gen_len / 2
+                kv_live = (2 * cfg.num_layers * slots * avg_len
+                           * cfg.cache_kv_heads * cfg.head_dim_ * kv_item)
+                bpt = (wbytes + kv_live) / slots
+                out[f"quant_tps_{tag}"] = round(qtps, 2)
+                out[f"quant_bytes_per_token_{tag}"] = int(bpt)
+                roof = 819e9 / (wbytes + kv_live) * slots
+                out[f"quant_pct_roofline_{tag}"] = round(
+                    100.0 * qtps / roof, 1) if roof else 0.0
+                print(
+                    f"quant {tag}: {qtps:.1f} tok/s, {bpt / 1e6:.1f} MB/tok "
+                    f"derived, {out[f'quant_pct_roofline_{tag}']}% of roofline",
+                    file=sys.stderr,
+                )
+            except Exception as e:  # noqa: BLE001 — extra row is best-effort
+                print(f"quant row {tag} failed: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+            finally:
+                if qeng is not None:
+                    qeng.stop()
+                    qeng.params = None
+                    qeng.cache = None
+                    qeng = None
+
+    # Speculative decoding under the paged pool (ISSUE 9 satellite — the
+    # composition has tier-1 tests but was never MEASURED): accepted
+    # tokens/s and decode tok/s with a draft vs the non-draft paged row, at
+    # bs 1 and bs `slots`, plus an int8-target variant (the verify pass
+    # streams the full target weights — exactly what quantization cuts).
+    # Draft and target are random-init, so acceptance is a floor, not the
+    # real-checkpoint number; the MACHINERY cost (draft steps + verify
+    # chunk + accept scan) is what this row prices.
+    if os.environ.get("BENCH_SPEC_PAGED", "1") != "0" and max_seq % 128 == 0:
+        draft_arch = os.environ.get(
+            "BENCH_DRAFT_ARCH",
+            "tiny" if arch.startswith("tiny") else "llama-3.2-1b",
+        )
+        n_draft = int(os.environ.get("BENCH_N_DRAFT", "4"))
+        page = 128
+        pool = max(2, int(slots * (max_seq // page) * 0.6))
+        dcfg = get_arch(draft_arch)
+        dparams = jax.jit(lambda k: init_params(dcfg, k))(jax.random.key(2))
+        for tag, qmode in (("spec_paged", ""), ("spec_paged_quant", "int8")):
+            deng = None
+            try:
+                deng = Engine(
+                    cfg, params, ByteTokenizer(cfg.vocab_size),
+                    draft_cfg=dcfg, draft_params=dparams, n_draft=n_draft,
+                    engine_cfg=EngineConfig(max_slots=slots, max_seq=max_seq,
+                                            kv_pages=pool, kv_page_size=page),
+                    quantization=qmode,
+                )
+                deng.start()
+                deng.warmup(prompt_len)
+                for bs in ((1, slots) if tag == "spec_paged" else (slots,)):
+                    deng._decode_time = 0.0
+                    deng._decode_tokens = 0
+                    deng.m_spec_rounds = 0
+                    deng.m_spec_accepted = 0
+                    ths = [threading.Thread(target=lambda i=i: deng.generate(
+                        [(i * 37 + j) % 255 + 1 for j in range(prompt_len)],
+                        max_new_tokens=gen_len, ignore_eos=True,
+                    )) for i in range(bs)]
+                    for t in ths:
+                        t.start()
+                    _join_or_die(ths, deng, f"{tag} bs{bs}")
+                    stps = (deng._decode_tokens / deng._decode_time
+                            if deng._decode_time else 0.0)
+                    acc_s = (deng.m_spec_accepted / deng._decode_time
+                             if deng._decode_time else 0.0)
+                    rate = (deng.m_spec_accepted
+                            / max(1, deng.m_spec_rounds * n_draft))
+                    out[f"{tag}_tps_bs{bs}"] = round(stps, 2)
+                    out[f"{tag}_accepted_per_s_bs{bs}"] = round(acc_s, 2)
+                    out[f"{tag}_accept_rate_bs{bs}"] = round(rate, 3)
+                    base = out.get("decode_tokens_per_sec_paged")
+                    if bs == slots and base:
+                        out[f"{tag}_vs_paged"] = round(stps / base, 2)
+                    print(
+                        f"{tag} bs{bs}: {stps:.1f} tok/s, "
+                        f"{acc_s:.1f} accepted/s, rate {rate:.2f} "
+                        f"(draft={draft_arch}, k={n_draft})",
+                        file=sys.stderr,
+                    )
+            except Exception as e:  # noqa: BLE001 — extra row is best-effort
+                print(f"{tag} row failed: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+            finally:
+                if deng is not None:
+                    deng.stop()
+                    deng.params = None
+                    deng.cache = None
+                    deng = None
+        dparams = None
+
     # Over-subscription row (ISSUE 3 on-demand KV growth): 2×slots requests
     # claim max_tokens near max_seq but produce SHORT real outputs (a stop
     # string learned from a probe run) on a pool sized so the old up-front

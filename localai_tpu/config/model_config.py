@@ -128,6 +128,17 @@ class ModelConfig:
     # the fused ragged paged-attention Pallas kernel on TPU and the XLA
     # reference elsewhere; "pallas"/"xla" force one.
     paged_kernel: str = "auto"
+    # Quantized-matmul kernel (docs/QUANTIZATION.md): "auto" runs the fused
+    # Pallas dequant-matmul kernels for decode-shape matmuls on TPU (packed
+    # int8/int4 bytes unpacked + scaled in VMEM registers — one HBM pass)
+    # and the XLA dequant path elsewhere; "pallas"/"xla" force one.
+    # LOCALAI_QUANT_KERNEL env var overrides.
+    quant_kernel: str = "auto"
+    # Per-head KV dequant scale for a SCALED fp8 paged pool: rows store
+    # value/kv_scale, readers multiply back in-kernel (docs/QUANTIZATION.md
+    # § fp8 KV). 1.0 = cast-only storage. Requires kv_pages > 0 and an fp8
+    # kv_cache_dtype. LOCALAI_KV_SCALE env var overrides.
+    kv_scale: float = 1.0
     # Chunked ragged prefill (docs/CHUNKED_PREFILL.md): prompts longer than
     # this admit in prefill_chunk-token chunks interleaved with decode
     # blocks, so a long prompt never stalls running requests and TTFT for

@@ -200,6 +200,25 @@ class EngineConfig:
     # off-TPU runs in interpret mode — tests only). LOCALAI_PAGED_KERNEL
     # env var overrides.
     paged_kernel: str = "auto"
+    # Quantized-matmul kernel (ISSUE 9, docs/QUANTIZATION.md): "auto" runs
+    # the fused Pallas dequant-matmul kernels (ops/quant_matmul — nibble
+    # unpack + affine scale in VMEM registers, f32 MXU accumulation; the
+    # packed int8/int4 bytes cross HBM exactly once) for decode-shape
+    # matmuls on TPU and the XLA dequant path elsewhere; "pallas"/"xla"
+    # force one (pallas off-TPU runs in interpret mode — tests only). The
+    # XLA path is kept as the numeric oracle, exactly like paged_kernel.
+    # LOCALAI_QUANT_KERNEL env var overrides.
+    quant_kernel: str = "auto"
+    # Per-head KV dequant scale for a SCALED fp8 paged pool (ISSUE 9):
+    # stored rows are value/kv_scale and every reader — the Pallas ragged
+    # kernel and the XLA page walk alike — multiplies back in-register, so
+    # large K/V magnitudes use the fp8 grid instead of clipping at e4m3's
+    # ±448. 1.0 = today's cast-only storage (byte-identical, no scale
+    # bookkeeping). Requires kv_pages > 0 AND an fp8 kv_cache_dtype; the
+    # engine broadcasts it to a [2, K] per-head array threaded through the
+    # kernels (per-head calibration can land without another plumbing
+    # change). LOCALAI_KV_SCALE env var overrides.
+    kv_scale: float = 1.0
     # Tensor-parallel serving (ISSUE 7, docs/SHARDED_SERVING.md): shard the
     # weights (Megatron column/row splits, parallel/sharding.py), the KV
     # cache / paged pool (kv-head axis — pages live on the head shard that
@@ -472,6 +491,8 @@ class Engine:
             "LOCALAI_QUEUE_TIMEOUT": ("queue_timeout_s", float),
             "LOCALAI_DEADLINE": ("deadline_s", float),
             "LOCALAI_TENSOR_PARALLEL": ("tensor_parallel", _parse_tp_env),
+            "LOCALAI_QUANT_KERNEL": ("quant_kernel", str),
+            "LOCALAI_KV_SCALE": ("kv_scale", float),
         }.items():
             val = os.environ.get(env)
             if val is not None and val != "":
@@ -486,6 +507,31 @@ class Engine:
             raise ValueError("max_pending must be >= 0 (0 = unbounded)")
         if self.ecfg.queue_timeout_s < 0 or self.ecfg.deadline_s < 0:
             raise ValueError("queue_timeout_s / deadline_s must be >= 0")
+        if self.ecfg.quant_kernel not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f"quant_kernel={self.ecfg.quant_kernel!r}: use auto|pallas|xla"
+            )
+        if self.ecfg.kv_scale <= 0:
+            raise ValueError("kv_scale must be > 0")
+        if self.ecfg.kv_scale != 1.0 and not (
+            self.ecfg.kv_pages > 0 and self.ecfg.kv_cache_dtype
+        ):
+            raise ValueError(
+                "kv_scale != 1.0 requires a paged pool (kv_pages > 0) with "
+                "an fp8 kv_cache_dtype — the dense cache has no scaled path"
+            )
+        # Thread the quant-kernel choice to every model-side matmul through
+        # the (frozen) ArchConfig — cfg is the one static object each layer
+        # helper already receives (models/config.py quant_kernel).
+        if self.ecfg.quant_kernel != cfg.quant_kernel:
+            cfg = dataclasses.replace(cfg, quant_kernel=self.ecfg.quant_kernel)
+            self.cfg = cfg
+        if draft_cfg is not None and (
+            self.ecfg.quant_kernel != draft_cfg.quant_kernel
+        ):
+            draft_cfg = dataclasses.replace(
+                draft_cfg, quant_kernel=self.ecfg.quant_kernel
+            )
         # Arm LOCALAI_FAULTS (deterministic fault injection — testing/faults)
         # before the loop thread can hit any hook point.
         faults.ensure_env_installed()
@@ -663,6 +709,18 @@ class Engine:
         self.m_spec_rounds = 0
         self.m_spec_accepted = 0
 
+        # Per-head (k, v) dequant scales for the SCALED fp8 paged pool
+        # (ISSUE 9): None = unscaled storage (every existing byte-exact
+        # swap/span/prefix invariant untouched). The [2, K] layout is what
+        # ops/paged_flash + the XLA walk consume; uniform today, per-head
+        # calibration slots in here.
+        self._kv_scales = None
+        if self.ecfg.kv_scale != 1.0:
+            self._kv_scales = jnp.full(
+                (2, cfg.cache_kv_heads), float(self.ecfg.kv_scale),
+                jnp.float32,
+            )
+
         # Device-resident per-slot state.
         self.counts = jnp.zeros((B, V), jnp.int32)
         self.rngs = jax.random.split(jax.random.key(self.ecfg.base_seed), B)
@@ -711,6 +769,10 @@ class Engine:
             for name in ("counts", "rngs", "bias", "d_tokens",
                          "d_positions", "d_gstate"):
                 setattr(self, name, jax.device_put(getattr(self, name), rep))
+            if self._kv_scales is not None:
+                # Tiny [2, K] constant: replicate; the head-sharded kernel
+                # wrapper re-slices it per shard via its own in_spec.
+                self._kv_scales = jax.device_put(self._kv_scales, rep)
         self._dfa: Optional[dict] = None  # {key, mask_bits, trans, tok_cls, host}
         self._dfa_building: set = set()  # schema keys compiling off-thread
         self._tok_fp: Optional[str] = None
@@ -1457,13 +1519,20 @@ class Engine:
                     k=cache.k[:, :, :kv_win], v=cache.v[:, :, :kv_win]
                 )
             start_pos = positions
+            # SCALED fp8 pool: the block-local window stays in MODEL dtype
+            # (unscaled) — rows quantize ONCE, at the block's pool write,
+            # where the /scale happens. Storing the window pre-quantized
+            # (the unscaled-pool layout) would clip exactly the magnitudes
+            # the scale exists to keep.
+            ldt_k = cache.k.dtype if self._kv_scales is None else jnp.dtype(cfg.dtype)
+            ldt_v = cache.v.dtype if self._kv_scales is None else jnp.dtype(cfg.dtype)
             local_k = jnp.zeros(
                 (cfg.num_layers, B, n, cfg.cache_kv_heads, cfg.cache_k_dim),
-                cache.k.dtype,
+                ldt_k,
             )
             local_v = jnp.zeros(
                 (cfg.num_layers, B, n, cfg.cache_kv_heads, cfg.cache_v_dim),
-                cache.v.dtype,
+                ldt_v,
             )
 
             def body(carry, step):
@@ -1479,6 +1548,7 @@ class Engine:
                         cfg, params, tokens, pos_eff, cache, lk, lv, step,
                         ep=self.plan.ep, ptable=ptable,
                         paged_impl=self.ecfg.paged_kernel,
+                        kv_scale=self._kv_scales,
                         rope_delta=rope_delta, mesh=self._op_mesh,
                     )
                 else:
@@ -1533,7 +1603,8 @@ class Engine:
             )
             if paged:
                 cache = llama.write_block_to_pool(
-                    cache, ptable, local_k, local_v, start_pos
+                    cache, ptable, local_k, local_v, start_pos,
+                    kv_scale=self._kv_scales,
                 )
             else:
                 cache = llama.write_block_to_cache(cache, local_k, local_v, start_pos)
@@ -1646,7 +1717,9 @@ class Engine:
             for j in range(m):  # m is static and small — unrolled
                 s = slot_ids[j]
                 if ptable is not None:
-                    cache = llama.write_prefill_to_pool(cache, ptable[j], ks, vs, j)
+                    cache = llama.write_prefill_to_pool(
+                        cache, ptable[j], ks, vs, j, kv_scale=self._kv_scales
+                    )
                 else:
                     cache = llama.write_prefill_to_cache(
                         cache, ks[:, j:j + 1], vs[:, j:j + 1], s
@@ -1791,7 +1864,7 @@ class Engine:
             )
             logits, tks, tvs = llama.prefill_tail(
                 cfg, params, tail_toks, aux[0:1], aux[3:4], pk, pv,
-                ep=self.plan.ep,
+                ep=self.plan.ep, mesh=self._op_mesh,
             )
             # Penalty counts from the full prompt, on device (_get_admit's
             # exact recipe — the prefix tokens DO reach the device here, as
@@ -1923,10 +1996,12 @@ class Engine:
                 top_p=samp_pack[2], min_p=samp_pack[3], repeat_penalty=samp_pack[4],
                 presence_penalty=samp_pack[5], frequency_penalty=samp_pack[6],
             )
-            pk, pv = llama.gather_pages(cache, pages)  # [L, 1, npg*page, K, Hd]
+            pk, pv = llama.gather_pages(
+                cache, pages, kv_scale=self._kv_scales
+            )  # [L, 1, npg*page, K, Hd] — dequantized when the pool is scaled
             logits, tks, tvs = llama.prefill_tail(
                 cfg, params, tail_toks, aux[0:1], aux[3:4], pk, pv,
-                ep=self.plan.ep,
+                ep=self.plan.ep, mesh=self._op_mesh,
             )
             fvalid = (jnp.arange(fbp)[None, :] < (plen + tail_len)).astype(jnp.int32)
             rows = jnp.zeros((1, V), jnp.int32)
@@ -1950,7 +2025,8 @@ class Engine:
                 lp = (tok_lp, lp_ids, lp_vals)
             # Only the tail rows are written — the span's pages stay
             # untouched (they may back other slots and the entry itself).
-            cache = llama.write_rows_to_pool(cache, table_row, tks, tvs, plen)
+            cache = llama.write_rows_to_pool(cache, table_row, tks, tvs, plen,
+                                             kv_scale=self._kv_scales)
             counts = counts.at[slot].set(rows[0])
             rngs = rngs.at[slot].set(keys0[0])
             bias = bias.at[slot].set(brows[0])
@@ -2092,7 +2168,7 @@ class Engine:
                     cfg, params, toks, aux[0:1], aux[2:3], cache,
                     table_row[None], ep=self.plan.ep,
                     paged_impl=self.ecfg.paged_kernel, with_logits=False,
-                    mesh=self._op_mesh,
+                    mesh=self._op_mesh, kv_scale=self._kv_scales,
                 )
                 d_positions = d_positions.at[aux[1]].set(S - 1)
                 return cache, d_positions, aux
@@ -2110,7 +2186,7 @@ class Engine:
                     cache.v, (0, slot, 0, 0, 0), (L, 1, pwin, K, vd))
                 _, tks, tvs = llama.prefill_tail(
                     cfg, params, toks, aux[0:1], aux[2:3], pk, pv,
-                    ep=self.plan.ep,
+                    ep=self.plan.ep, mesh=self._op_mesh,
                 )
                 cache = llama.write_rows_to_cache(cache, slot, tks, tvs, aux[2])
                 d_positions = d_positions.at[slot].set(S - 1)
@@ -2190,6 +2266,7 @@ class Engine:
                 cfg, params, tail_toks, aux[0:1], aux[3:4], cache,
                 table_row[None], ep=self.plan.ep,
                 paged_impl=self.ecfg.paged_kernel, mesh=self._op_mesh,
+                kv_scale=self._kv_scales,
             )
             fvalid = (jnp.arange(fbp)[None, :] < (plen + tail_len)).astype(jnp.int32)
             rows = jnp.zeros((1, V), jnp.int32)
@@ -3196,7 +3273,7 @@ class Engine:
             logits_all, cache = llama.decode_chunk(
                 cfg, params, chunk, pos_chunk, cache, ep=self.plan.ep,
                 ptable=ptable, paged_impl=self.ecfg.paged_kernel,
-                mesh=self._op_mesh,
+                mesh=self._op_mesh, kv_scale=self._kv_scales,
             )
 
             # 3. Accept-scan with counts updated token by token, so

@@ -126,6 +126,15 @@ class ArchConfig:
     # (ops/rope.mrope_angles); text-only paths reduce to plain rope.
     mrope_section: tuple = ()
     dtype: str = "bfloat16"
+    # Quantized-matmul kernel choice threaded to every model-side matmul
+    # (ISSUE 9): "auto" (fused Pallas dequant-matmul on TPU, XLA dequant
+    # elsewhere) | "pallas" | "xla". Lives on ArchConfig — not a shape, but
+    # cfg is the one static object every layer helper already receives, so
+    # the engine's EngineConfig.quant_kernel knob reaches models/quant.py
+    # through `dataclasses.replace(cfg, quant_kernel=...)` without
+    # re-plumbing ~30 call sites (the paged_impl treatment at entry-point
+    # granularity; quant matmuls live one level deeper).
+    quant_kernel: str = "auto"
 
     @property
     def head_dim_(self) -> int:

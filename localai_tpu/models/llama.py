@@ -194,9 +194,17 @@ def _scan_layers(cfg: ArchConfig, params: Params, h, layer_fn, extras=()):
     return h, out
 
 
-def _moe_mm(x: jnp.ndarray, w, sub: str) -> jnp.ndarray:
-    """Per-expert matmul for plain or quantized expert weights."""
+def _moe_mm(x: jnp.ndarray, w, sub: str, impl: str = "auto",
+            mesh=None) -> jnp.ndarray:
+    """Per-expert matmul for plain or quantized expert weights. Quantized
+    decode-shape calls dispatch to the fused Pallas dequant-matmul kernels
+    (ops/quant_matmul, ISSUE 9); the einsum forms below stay the oracle."""
     if isinstance(w, dict):
+        from localai_tpu.ops.quant_matmul import dispatch_moe_mm
+
+        y = dispatch_moe_mm(x, w, sub, impl=impl, mesh=mesh)
+        if y is not None:
+            return y
         if "q" in w:
             out = jnp.einsum(sub, x, w["q"].astype(x.dtype))
             return out * w["s"].astype(x.dtype)[..., 0, :]
@@ -278,7 +286,8 @@ def _deepseek_route(cfg: ArchConfig, lp: Params, x: jnp.ndarray):
     return weights, sel
 
 
-def _moe_dense(cfg: ArchConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+def _moe_dense(cfg: ArchConfig, lp: Params, x: jnp.ndarray,
+               mesh=None) -> jnp.ndarray:
     """All-experts MoE: every expert runs on every token, outputs combined by
     routing weight. FLOPs ∝ E, but the only path that works on quantized
     (int8/int4 grouped) expert weights without materializing a dequantized
@@ -286,12 +295,13 @@ def _moe_dense(cfg: ArchConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
     weight-HBM-bound (every expert's weights are read regardless), so for
     quantized decode this is near-optimal anyway."""
     E = cfg.num_experts
+    qk = cfg.quant_kernel
     weights, sel = _moe_route(cfg, lp, x)
     onehot = jax.nn.one_hot(sel, E, dtype=jnp.float32)  # [..., topk, E]
     combine = jnp.einsum("...te,...t->...e", onehot, weights)
-    gate = _act(cfg, _moe_mm(x, lp["w_gate"], "...d,edf->...ef"))
-    up = _moe_mm(x, lp["w_up"], "...d,edf->...ef")
-    expert_out = _moe_mm(gate * up, lp["w_down"], "...ef,efd->...ed")  # [..., E, D]
+    gate = _act(cfg, _moe_mm(x, lp["w_gate"], "...d,edf->...ef", qk, mesh))
+    up = _moe_mm(x, lp["w_up"], "...d,edf->...ef", qk, mesh)
+    expert_out = _moe_mm(gate * up, lp["w_down"], "...ef,efd->...ed", qk, mesh)  # [..., E, D]
     return jnp.einsum("...ed,...e->...d", expert_out.astype(jnp.float32), combine).astype(x.dtype)
 
 
@@ -399,7 +409,8 @@ def _moe_capacity(cfg: ArchConfig, lp: Params, x: jnp.ndarray, block: int = 1024
     return y.reshape(*lead, D).astype(x.dtype)
 
 
-def _mlp(cfg: ArchConfig, lp: Params, x: jnp.ndarray, ep: int = 1) -> jnp.ndarray:
+def _mlp(cfg: ArchConfig, lp: Params, x: jnp.ndarray, ep: int = 1,
+         mesh=None) -> jnp.ndarray:
     """SwiGLU MLP; dense or sparse-MoE (Mixtral/DeepSeek top-k routing).
 
     x: [..., D]. MoE is detected per-stack ("router" in lp) so DeepSeek's
@@ -414,33 +425,38 @@ def _mlp(cfg: ArchConfig, lp: Params, x: jnp.ndarray, ep: int = 1) -> jnp.ndarra
     DeepSeek MoE layers add an always-on shared-expert MLP (HF
     DeepseekV3MoE.shared_experts).
     """
+    qk = cfg.quant_kernel
     if "router" not in lp:
-        gate = _act(cfg, matmul(x, lp["w_gate"]))
-        return matmul(gate * matmul(x, lp["w_up"]), lp["w_down"]).astype(x.dtype)
+        gate = _act(cfg, matmul(x, lp["w_gate"], qk, mesh, "col"))
+        return matmul(gate * matmul(x, lp["w_up"], qk, mesh, "col"),
+                      lp["w_down"], qk, mesh, "row").astype(x.dtype)
     if isinstance(lp["w_gate"], dict):
-        y = _moe_dense(cfg, lp, x)
+        y = _moe_dense(cfg, lp, x, mesh=mesh)
     elif ep > 1:
         y = _moe_capacity(cfg, lp, x)
     else:
         y = _moe_ragged(cfg, lp, x)
     if "shared_gate" in lp:
-        sg = _act(cfg, matmul(x, lp["shared_gate"]))
-        y = y + matmul(sg * matmul(x, lp["shared_up"]), lp["shared_down"]).astype(x.dtype)
+        sg = _act(cfg, matmul(x, lp["shared_gate"], qk, mesh, "col"))
+        y = y + matmul(sg * matmul(x, lp["shared_up"], qk, mesh, "col"),
+                       lp["shared_down"], qk, mesh, "row").astype(x.dtype)
     return y
 
 
-def _attn_out(cfg: ArchConfig, lp: Params, attn_flat: jnp.ndarray) -> jnp.ndarray:
+def _attn_out(cfg: ArchConfig, lp: Params, attn_flat: jnp.ndarray,
+              mesh=None) -> jnp.ndarray:
     """Output projection + optional gemma-2 post-attention sandwich norm.
     Shared by every layer body so per-arch structure changes in ONE place."""
-    a = matmul(attn_flat, lp["wo"])
+    a = matmul(attn_flat, lp["wo"], cfg.quant_kernel, mesh, "row")
     if cfg.post_norms:
         a = rms_norm(a, lp["post_attn_norm"], cfg.rms_eps)
     return a
 
 
-def _mlp_out(cfg: ArchConfig, lp: Params, x: jnp.ndarray, ep: int = 1) -> jnp.ndarray:
+def _mlp_out(cfg: ArchConfig, lp: Params, x: jnp.ndarray, ep: int = 1,
+             mesh=None) -> jnp.ndarray:
     """MLP + optional gemma-2 post-feedforward sandwich norm."""
-    m = _mlp(cfg, lp, x, ep)
+    m = _mlp(cfg, lp, x, ep, mesh=mesh)
     if cfg.post_norms:
         m = rms_norm(m, lp["post_ffw_norm"], cfg.rms_eps)
     return m
@@ -466,12 +482,13 @@ def _layer_inv_freq(cfg: ArchConfig, inv_global, inv_local, li):
     return jnp.where(sliding, inv_local, inv_global)
 
 
-def _attn_proj_qkv(cfg: ArchConfig, lp: Params, x: jnp.ndarray):
+def _attn_proj_qkv(cfg: ArchConfig, lp: Params, x: jnp.ndarray, mesh=None):
     """x: [..., D] -> q [..., H, Hd], k/v [..., K, Hd]."""
     H, K, Hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
-    q = matmul(x, lp["wq"])
-    k = matmul(x, lp["wk"])
-    v = matmul(x, lp["wv"])
+    qk = cfg.quant_kernel
+    q = matmul(x, lp["wq"], qk, mesh, "col")
+    k = matmul(x, lp["wk"], qk, mesh, "col")
+    v = matmul(x, lp["wv"], qk, mesh, "col")
     if cfg.attn_qkv_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -509,14 +526,16 @@ def _attn_proj_qkv(cfg: ArchConfig, lp: Params, x: jnp.ndarray):
 # --------------------------------------------------------------------------- #
 
 
-def _mla_q(cfg: ArchConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+def _mla_q(cfg: ArchConfig, lp: Params, x: jnp.ndarray, mesh=None) -> jnp.ndarray:
     """Query projection [..., H, qk_head_dim] (nope|rope concat, pre-rope);
     through the q-lora bottleneck when configured (V3) or direct (V2-Lite)."""
+    qk = cfg.quant_kernel
     if cfg.q_lora_rank:
-        ql = rms_norm(matmul(x, lp["wq_a"]), lp["q_norm_a"], cfg.rms_eps)
-        q = matmul(ql, lp["wq_b"])
+        # wq_a is replicated (the MLA bottleneck is tiny) — no shard part.
+        ql = rms_norm(matmul(x, lp["wq_a"], qk), lp["q_norm_a"], cfg.rms_eps)
+        q = matmul(ql, lp["wq_b"], qk, mesh, "col")
     else:
-        q = matmul(x, lp["wq"])
+        q = matmul(x, lp["wq"], qk, mesh, "col")
     return q.reshape(*x.shape[:-1], cfg.num_heads, cfg.qk_head_dim)
 
 
@@ -526,14 +545,14 @@ def _mla_rows(cfg: ArchConfig, lp: Params, x: jnp.ndarray,
     tokens x [B, T, D] at `positions` [B, T]. This is the ONLY thing MLA
     writes to the KV cache."""
     r = cfg.kv_lora_rank
-    ckv = matmul(x, lp["wkv_a"])  # [B, T, r+rot]
+    ckv = matmul(x, lp["wkv_a"], cfg.quant_kernel)  # [B, T, r+rot] (replicated weight)
     c = rms_norm(ckv[..., :r], lp["kv_norm"], cfg.rms_eps)
     k_pe = apply_rope(ckv[..., None, r:], positions, inv)  # [B, T, 1, rot]
     return jnp.concatenate([c[..., None, :], k_pe], axis=-1)
 
 
 def _mla_full_qkv(cfg: ArchConfig, lp: Params, x: jnp.ndarray,
-                  positions: jnp.ndarray, inv: jnp.ndarray):
+                  positions: jnp.ndarray, inv: jnp.ndarray, mesh=None):
     """Full-rank MLA projections for prefill. x [B, T, D] →
     (q [B,T,H,Dq], k [B,T,H,Dq], v [B,T,H,Dq] zero-padded from v_head_dim,
     rows [B,T,1,r+rot]). The ops reshape outputs to q's head dim, so v rides
@@ -541,7 +560,7 @@ def _mla_full_qkv(cfg: ArchConfig, lp: Params, x: jnp.ndarray,
     H = cfg.num_heads
     n, rot, vd = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
     r = cfg.kv_lora_rank
-    q = _mla_q(cfg, lp, x)
+    q = _mla_q(cfg, lp, x, mesh)
     q = jnp.concatenate([q[..., :n], apply_rope(q[..., n:], positions, inv)], axis=-1)
     amp = rope_query_amp(cfg)
     if amp != 1.0:
@@ -559,13 +578,14 @@ def _mla_full_qkv(cfg: ArchConfig, lp: Params, x: jnp.ndarray,
 
 
 def _mla_absorbed_q(cfg: ArchConfig, lp: Params, x: jnp.ndarray,
-                    positions: jnp.ndarray, inv: jnp.ndarray) -> jnp.ndarray:
+                    positions: jnp.ndarray, inv: jnp.ndarray,
+                    mesh=None) -> jnp.ndarray:
     """Absorbed decode query [B, T, H, r+rot] scoring directly against the
     latent cache. The attention ops scale by the OPERAND width (r+rot), so
     the sqrt((r+rot)/qk_head_dim) ratio is folded in here to restore the
     true 1/sqrt(qk_head_dim) softmax scale (same trick as query_scale)."""
     n = cfg.qk_nope_head_dim
-    q = _mla_q(cfg, lp, x)
+    q = _mla_q(cfg, lp, x, mesh)
     q_pe = apply_rope(q[..., n:], positions, inv)
     q_lat = jnp.einsum("bthn,hnr->bthr", q[..., :n], lp["w_kb"]).astype(x.dtype)
     q_eff = jnp.concatenate([q_lat, q_pe.astype(x.dtype)], axis=-1)
@@ -597,12 +617,13 @@ def _act(cfg: ArchConfig, x: jnp.ndarray) -> jnp.ndarray:
     return jax.nn.silu(x)
 
 
-def _unembed(cfg: ArchConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
+def _unembed(cfg: ArchConfig, params: Params, h: jnp.ndarray,
+             mesh=None) -> jnp.ndarray:
     # bf16 (or int8-dequant) operands with f32 MXU accumulation: casting the
     # [V, D] matrix to f32 would double its HBM traffic on every decode step
     # (the unembed is the single largest weight read at 128k vocabs).
     w = params["embed"] if cfg.tie_embeddings else params["lm_head"]
-    logits = unembed_matmul(h, w)
+    logits = unembed_matmul(h, w, cfg.quant_kernel, mesh)
     if cfg.final_softcap:
         logits = cfg.final_softcap * jnp.tanh(logits / cfg.final_softcap)
     return logits
@@ -670,18 +691,18 @@ def _forward_hidden(
                     "(PARITY.md: ring rotation of latent rows needs its own "
                     "kernel); shard MLA models over tp/ep instead"
                 )
-            q, k, v, rows = _mla_full_qkv(cfg, lp, x, positions, inv)
+            q, k, v, rows = _mla_full_qkv(cfg, lp, x, positions, inv, mesh)
             # Dense path (no `lengths`): the flash kernel tiles head_dim in
             # 128-lane blocks and MLA's qk width (192) is not a multiple.
             attn = prefill_attention(q, k, v, length_mask)
             attn = attn[..., : cfg.v_head_dim]
-            h = h + _attn_out(cfg, lp, attn.reshape(B, S, -1))
+            h = h + _attn_out(cfg, lp, attn.reshape(B, S, -1), mesh)
             x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-            h = h + _mlp_out(cfg, lp, x, ep)
+            h = h + _mlp_out(cfg, lp, x, ep, mesh)
             return h, (
                 (rows, rows[..., :0]) if collect_kv else None
             )
-        q, k, v = _attn_proj_qkv(cfg, lp, x)
+        q, k, v = _attn_proj_qkv(cfg, lp, x, mesh)
         if mrope_ang is not None:
             from localai_tpu.ops.rope import rope_rotate
 
@@ -704,9 +725,9 @@ def _forward_hidden(
                 softcap=cfg.attn_softcap, window=cfg.sliding_window,
                 sliding=_layer_sliding(cfg, li), mesh=mesh,
             )
-        h = h + _attn_out(cfg, lp, attn.reshape(B, S, -1))
+        h = h + _attn_out(cfg, lp, attn.reshape(B, S, -1), mesh)
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-        h = h + _mlp_out(cfg, lp, x, ep)
+        h = h + _mlp_out(cfg, lp, x, ep, mesh)
         return h, ((k, v) if collect_kv else None)
 
     h, kv = _scan_layers(cfg, params, h, layer)
@@ -731,7 +752,7 @@ def prefill(
     )
     last_idx = jnp.maximum(lengths - 1, 0)  # empty prompt reads position 0, not wrap to S-1
     last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]  # [B, D]
-    logits = _unembed(cfg, params, last)
+    logits = _unembed(cfg, params, last, mesh)
     return logits, ks, vs
 
 
@@ -770,7 +791,7 @@ def sequence_logprob(
     rerank.go RPC to a cross-encoder; here relevance is measured as the
     document's conditional likelihood under the LLM given the query)."""
     h, _, _ = _forward_hidden(cfg, params, tokens, lengths, collect_kv=False, mesh=mesh, ep=ep)
-    logits = _unembed(cfg, params, h[:, :-1])  # [B, S-1, V] predicts tokens[1:]
+    logits = _unembed(cfg, params, h[:, :-1], mesh)  # [B, S-1, V] predicts tokens[1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     tgt = tokens[:, 1:]  # [B, S-1]
     tok_lp = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
@@ -818,16 +839,16 @@ def decode_step(
             if use_sp:
                 raise NotImplementedError("MLA + sp is excluded (PARITY.md)")
             x1 = x[:, None]  # [B, 1, D]
-            q_eff = _mla_absorbed_q(cfg, lp, x1, positions[:, None], inv)[:, 0]
+            q_eff = _mla_absorbed_q(cfg, lp, x1, positions[:, None], inv, mesh)[:, 0]
             rows = _mla_rows(cfg, lp, x1, positions[:, None], inv)[:, 0]  # [B,1,r+rot]
             # The latent rides as BOTH k and v operands; [..., :r] of the
             # output is probs·c_kv (see the MLA section header).
             attn = decode_attention_appended(q_eff, kc, kc, rows, rows, positions)
-            h = h + _attn_out(cfg, lp, _mla_unlatent(cfg, lp, attn))
+            h = h + _attn_out(cfg, lp, _mla_unlatent(cfg, lp, attn), mesh)
             x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-            h = h + _mlp_out(cfg, lp, x, ep)
+            h = h + _mlp_out(cfg, lp, x, ep, mesh)
             return h, (rows, rows[..., :0])
-        q, k, v = _attn_proj_qkv(cfg, lp, x)  # q [B,H,Hd], k/v [B,K,Hd]
+        q, k, v = _attn_proj_qkv(cfg, lp, x, mesh)  # q [B,H,Hd], k/v [B,K,Hd]
         q = apply_rope(q[:, None], positions[:, None], inv)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], inv)[:, 0]
         if use_sp:
@@ -844,9 +865,9 @@ def decode_step(
                 softcap=cfg.attn_softcap, window=cfg.sliding_window,
                 sliding=_layer_sliding(cfg, li),
             )
-        h = h + _attn_out(cfg, lp, attn.reshape(B, -1))
+        h = h + _attn_out(cfg, lp, attn.reshape(B, -1), mesh)
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-        h = h + _mlp_out(cfg, lp, x, ep)
+        h = h + _mlp_out(cfg, lp, x, ep, mesh)
         return h, (k, v)
 
     h, (new_k, new_v) = _scan_layers(
@@ -856,7 +877,7 @@ def decode_step(
     k = cache.k.at[:, batch_idx, positions].set(new_k.astype(cache.k.dtype))
     v = cache.v.at[:, batch_idx, positions].set(new_v.astype(cache.v.dtype))
     h = rms_norm(h, params["final_norm"], cfg.rms_eps)
-    logits = _unembed(cfg, params, h)
+    logits = _unembed(cfg, params, h, mesh)
     return logits, KVCache(k=k, v=v)
 
 
@@ -873,6 +894,7 @@ def decode_step_windowed(
     mesh=None,  # Mesh: sp>1 → sp-sharded cache; tp>1 → head-sharded Pallas
     ptable=None,  # [B, MP] int32 → `cache` is a page pool (paged KV mode)
     paged_impl: str = "auto",  # paged attention kernel: auto|pallas|xla
+    kv_scale=None,  # [2, K] f32 per-head (k, v) pool dequant scales (fp8 KV)
     rope_delta=None,  # [B] int32 — m-rope: rope at positions+delta (cache
     # rows stay at positions). After a Qwen2-VL image prefill the 3D
     # position streams are all equal and offset from the row index by a
@@ -901,7 +923,7 @@ def decode_step_windowed(
             if use_sp:
                 raise NotImplementedError("MLA + sp is excluded (PARITY.md)")
             x1 = x[:, None]
-            q_eff = _mla_absorbed_q(cfg, lp, x1, positions[:, None], inv)[:, 0]
+            q_eff = _mla_absorbed_q(cfg, lp, x1, positions[:, None], inv, mesh)[:, 0]
             rows = _mla_rows(cfg, lp, x1, positions[:, None], inv)[:, 0]
             if ptable is not None:
                 from localai_tpu.ops.attention import (
@@ -910,17 +932,17 @@ def decode_step_windowed(
 
                 attn = decode_attention_windowed_paged(
                     q_eff, kc, kc, ptable, lk, lk, rows, rows, positions, step,
-                    impl=paged_impl,
+                    impl=paged_impl, kv_scale=kv_scale,
                 )
             else:
                 attn = decode_attention_windowed(
                     q_eff, kc, kc, lk, lk, rows, rows, positions, step,
                 )
-            h = h + _attn_out(cfg, lp, _mla_unlatent(cfg, lp, attn))
+            h = h + _attn_out(cfg, lp, _mla_unlatent(cfg, lp, attn), mesh)
             x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-            h = h + _mlp_out(cfg, lp, x, ep)
+            h = h + _mlp_out(cfg, lp, x, ep, mesh)
             return h, (rows, rows[..., :0])
-        q, k, v = _attn_proj_qkv(cfg, lp, x)
+        q, k, v = _attn_proj_qkv(cfg, lp, x, mesh)
         q = apply_rope(q[:, None], rope_pos[:, None], inv)[:, 0]
         k = apply_rope(k[:, None], rope_pos[:, None], inv)[:, 0]
         if ptable is not None:
@@ -930,6 +952,7 @@ def decode_step_windowed(
                 q, kc, vc, ptable, lk, lv, k, v, positions, step,
                 softcap=cfg.attn_softcap, window=cfg.sliding_window,
                 sliding=_layer_sliding(cfg, li), impl=paged_impl, mesh=mesh,
+                kv_scale=kv_scale,
             )
         elif use_sp:
             from localai_tpu.ops.attention import decode_attention_windowed_sp
@@ -945,9 +968,9 @@ def decode_step_windowed(
                 softcap=cfg.attn_softcap, window=cfg.sliding_window,
                 sliding=_layer_sliding(cfg, li),
             )
-        h = h + _attn_out(cfg, lp, attn.reshape(B, -1))
+        h = h + _attn_out(cfg, lp, attn.reshape(B, -1), mesh)
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-        h = h + _mlp_out(cfg, lp, x, ep)
+        h = h + _mlp_out(cfg, lp, x, ep, mesh)
         return h, (k, v)
 
     h, (new_k, new_v) = _scan_layers(
@@ -960,7 +983,7 @@ def decode_step_windowed(
         local_v, new_v.astype(local_v.dtype), step, axis=2
     )
     h = rms_norm(h, params["final_norm"], cfg.rms_eps)
-    return _unembed(cfg, params, h), local_k, local_v
+    return _unembed(cfg, params, h, mesh), local_k, local_v
 
 
 def write_block_to_cache(
@@ -990,6 +1013,7 @@ def decode_chunk(
     ptable=None,  # [B, MP] int32 → `cache` is a page pool (paged KV mode)
     paged_impl: str = "auto",  # paged attention kernel: auto|pallas|xla
     mesh=None,  # Mesh with tp>1 → paged Pallas kernel head-sharded
+    kv_scale=None,  # [2, K] f32 per-head (k, v) pool dequant scales (fp8 KV)
 ):
     """Multi-token decode: write T new k/v per slot and return logits for all
     T positions — the verify pass of speculative decoding (the reference
@@ -1022,7 +1046,7 @@ def decode_chunk(
             # Absorbed MLA verify chunk: q_eff scores the latent cache and
             # the window's fresh latent rows; values come back out of the
             # same latents ([..., :r] → W_vb).
-            q_eff = _mla_absorbed_q(cfg, lp, x, positions, inv)  # [B,T,H,De]
+            q_eff = _mla_absorbed_q(cfg, lp, x, positions, inv, mesh)  # [B,T,H,De]
             rows = _mla_rows(cfg, lp, x, positions, inv)  # [B,T,1,De]
             if ptable is not None:
                 from localai_tpu.ops.attention import (
@@ -1032,7 +1056,7 @@ def decode_chunk(
 
                 acc, m, l = paged_partials_mq(
                     q_eff, kc, kc, ptable, positions[:, 0], q_pos=positions,
-                    impl=paged_impl,
+                    impl=paged_impl, kv_scale=kv_scale,
                 )
                 attn = _merge_partials_mq(
                     q_eff, acc, m, l, rows, rows,  # [B, T, 1, De] = [B, E, K, D]
@@ -1054,11 +1078,11 @@ def decode_chunk(
                 )
                 attn = attn.astype(h.dtype)
             attn = _mla_unlatent(cfg, lp, attn)  # [B, T, H·v]
-            h = h + _attn_out(cfg, lp, attn)
+            h = h + _attn_out(cfg, lp, attn, mesh)
             x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-            h = h + _mlp_out(cfg, lp, x, ep)
+            h = h + _mlp_out(cfg, lp, x, ep, mesh)
             return h, (rows, rows[..., :0])
-        q, k, v = _attn_proj_qkv(cfg, lp, x)  # q [B,T,H,Hd], k/v [B,T,K,Hd]
+        q, k, v = _attn_proj_qkv(cfg, lp, x, mesh)  # q [B,T,H,Hd], k/v [B,T,K,Hd]
         q = apply_rope(q, positions, inv)
         k = apply_rope(k, positions, inv)
         K_h = kc.shape[2]
@@ -1076,6 +1100,7 @@ def decode_chunk(
                 q, kc, vc, ptable, positions[:, 0],
                 softcap=cfg.attn_softcap, window=cfg.sliding_window,
                 sliding=sliding, q_pos=positions, impl=paged_impl, mesh=mesh,
+                kv_scale=kv_scale,
             )
             attn = _merge_partials_mq(
                 q, acc, m, l, k, v,
@@ -1103,22 +1128,23 @@ def decode_chunk(
                 "bkgts,bskd->btkgd", probs[..., :S], vc.astype(jnp.float32)
             ) + jnp.einsum("bkgtu,bukd->btkgd", probs[..., S:], v.astype(jnp.float32))
             attn = attn.reshape(B, T, -1).astype(h.dtype)
-        h = h + _attn_out(cfg, lp, attn)
+        h = h + _attn_out(cfg, lp, attn, mesh)
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-        h = h + _mlp_out(cfg, lp, x, ep)
+        h = h + _mlp_out(cfg, lp, x, ep, mesh)
         return h, (k, v)
 
     h, (new_k, new_v) = _scan_layers(
         cfg, params, h, layer, (cache.k, cache.v)
     )
     if ptable is not None:
-        cache = write_chunk_to_pool(cache, ptable, new_k, new_v, positions)
+        cache = write_chunk_to_pool(cache, ptable, new_k, new_v, positions,
+                                    kv_scale=kv_scale)
     else:
         k = cache.k.at[:, batch_idx, positions].set(new_k.astype(cache.k.dtype))
         v = cache.v.at[:, batch_idx, positions].set(new_v.astype(cache.v.dtype))
         cache = KVCache(k=k, v=v)
     h = rms_norm(h, params["final_norm"], cfg.rms_eps)
-    logits = _unembed(cfg, params, h)  # [B, T, V]
+    logits = _unembed(cfg, params, h, mesh)  # [B, T, V]
     return logits, cache
 
 
@@ -1131,6 +1157,7 @@ def prefill_tail(
     prefix_k: jnp.ndarray,  # [L, B, P, K, Hd] cached prefix KV; rows >= offsets[b] ignored
     prefix_v: jnp.ndarray,
     ep: int = 1,
+    mesh=None,  # Mesh with tp>1 → quantized matmuls shard_map over "tp"
 ):
     """Prefill a prompt *tail* against cached prefix KV — the compute half of
     the prompt/prefix cache (reference: `cache_prompt`,
@@ -1162,7 +1189,7 @@ def prefill_tail(
             # Absorbed tail prefill against cached LATENT prefix rows: the
             # identity q·k = q_eff·latent holds for the in-tail tokens too,
             # so both segments score in latent space.
-            q_eff = _mla_absorbed_q(cfg, lp, x, positions, inv)  # [B,T,H,De]
+            q_eff = _mla_absorbed_q(cfg, lp, x, positions, inv, mesh)  # [B,T,H,De]
             rows = _mla_rows(cfg, lp, x, positions, inv)  # [B,T,1,De]
             De = q_eff.shape[-1]
             qf = q_eff.astype(jnp.float32) / De**0.5
@@ -1178,11 +1205,11 @@ def prefill_tail(
                 "bhtu,bud->bthd", probs[..., P:], rf
             )
             attn = _mla_unlatent(cfg, lp, attn.astype(h.dtype))
-            h = h + _attn_out(cfg, lp, attn)
+            h = h + _attn_out(cfg, lp, attn, mesh)
             x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-            h = h + _mlp_out(cfg, lp, x, ep)
+            h = h + _mlp_out(cfg, lp, x, ep, mesh)
             return h, (rows, rows[..., :0])
-        q, k, v = _attn_proj_qkv(cfg, lp, x)  # q [B,T,H,Hd], k/v [B,T,K,Hd]
+        q, k, v = _attn_proj_qkv(cfg, lp, x, mesh)  # q [B,T,H,Hd], k/v [B,T,K,Hd]
         q = apply_rope(q, positions, inv)
         k = apply_rope(k, positions, inv)
         K_h = kc.shape[2]
@@ -1209,9 +1236,9 @@ def prefill_tail(
             "bkgts,bskd->btkgd", probs[..., :P], vc.astype(jnp.float32)
         ) + jnp.einsum("bkgtu,bukd->btkgd", probs[..., P:], v.astype(jnp.float32))
         attn = attn.reshape(B, T, -1).astype(h.dtype)
-        h = h + _attn_out(cfg, lp, attn)
+        h = h + _attn_out(cfg, lp, attn, mesh)
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-        h = h + _mlp_out(cfg, lp, x, ep)
+        h = h + _mlp_out(cfg, lp, x, ep, mesh)
         return h, (k, v)
 
     h, (ks, vs) = _scan_layers(
@@ -1220,7 +1247,7 @@ def prefill_tail(
     h = rms_norm(h, params["final_norm"], cfg.rms_eps)
     last_idx = jnp.maximum(lengths - 1, 0)
     last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]  # [B, D]
-    logits = _unembed(cfg, params, last)
+    logits = _unembed(cfg, params, last, mesh)
     return logits, ks, vs
 
 
@@ -1262,12 +1289,24 @@ def paged_cache_zeros(cfg: ArchConfig, num_pages: int, page_size: int,
     )
 
 
+def _pool_store(rows: jnp.ndarray, pool_dtype, scale_row) -> jnp.ndarray:
+    """Cast KV rows [..., K, Hd] to the pool's storage dtype, dividing by
+    the per-head kv scale first when the pool is SCALED fp8 (ISSUE 9):
+    stored = value / scale, every reader multiplies back in-kernel. The
+    division runs in f32 so bf16 rows keep their mantissa until the final
+    fp8 cast."""
+    if scale_row is None:
+        return rows.astype(pool_dtype)
+    return (rows.astype(jnp.float32) / scale_row[..., :, None]).astype(pool_dtype)
+
+
 def write_block_to_pool(
     pool: KVCache,
     table: jnp.ndarray,  # [B, MP] int32
     local_k: jnp.ndarray,  # [L, B, n, K, Hd]
     local_v: jnp.ndarray,
     start_positions: jnp.ndarray,  # [B]
+    kv_scale=None,  # [2, K] f32 → pool rows store value/scale (fp8 KV)
 ) -> KVCache:
     """Scatter a decode block's window into the page pool (once per block).
     Rows may straddle pages; each (slot, step) row lands at
@@ -1282,8 +1321,10 @@ def write_block_to_pool(
                       MP * page - 1)  # [B, n]
     pid = jnp.take_along_axis(table, row // page, axis=1)  # [B, n]
     off = row % page
-    k = pool.k.at[:, pid, off].set(local_k.astype(pool.k.dtype))
-    v = pool.v.at[:, pid, off].set(local_v.astype(pool.v.dtype))
+    ks = None if kv_scale is None else kv_scale[0]
+    vs = None if kv_scale is None else kv_scale[1]
+    k = pool.k.at[:, pid, off].set(_pool_store(local_k, pool.k.dtype, ks))
+    v = pool.v.at[:, pid, off].set(_pool_store(local_v, pool.v.dtype, vs))
     return KVCache(k=k, v=v)
 
 
@@ -1293,6 +1334,7 @@ def write_chunk_to_pool(
     new_k: jnp.ndarray,  # [L, B, T, K, Hd]
     new_v: jnp.ndarray,
     positions: jnp.ndarray,  # [B, T] row indices (contiguous per slot)
+    kv_scale=None,  # [2, K] f32 → pool rows store value/scale (fp8 KV)
 ) -> KVCache:
     """Scatter a speculative verify chunk's rows into the page pool (the
     paged counterpart of decode_chunk's dense scatter). Rows resolve through
@@ -1304,8 +1346,10 @@ def write_chunk_to_pool(
     row = jnp.minimum(positions, MP * page - 1)  # [B, T]
     pid = jnp.take_along_axis(table, row // page, axis=1)  # [B, T]
     off = row % page
-    k = pool.k.at[:, pid, off].set(new_k.astype(pool.k.dtype))
-    v = pool.v.at[:, pid, off].set(new_v.astype(pool.v.dtype))
+    ks = None if kv_scale is None else kv_scale[0]
+    vs = None if kv_scale is None else kv_scale[1]
+    k = pool.k.at[:, pid, off].set(_pool_store(new_k, pool.k.dtype, ks))
+    v = pool.v.at[:, pid, off].set(_pool_store(new_v, pool.v.dtype, vs))
     return KVCache(k=k, v=v)
 
 
@@ -1315,6 +1359,7 @@ def write_rows_to_pool(
     ks: jnp.ndarray,  # [L, 1, R, K, Hd]
     vs: jnp.ndarray,
     start_row: jnp.ndarray,  # scalar int32 — first destination row
+    kv_scale=None,  # [2, K] f32 → pool rows store value/scale (fp8 KV)
 ) -> KVCache:
     """Scatter R contiguous rows starting at `start_row` into one slot's
     pages (cached-admission tail rows, which start mid-sequence and are not
@@ -1325,14 +1370,17 @@ def write_rows_to_pool(
     row = jnp.minimum(start_row + jnp.arange(R), MP * page - 1)  # [R]
     pid = table_row[row // page]  # [R]
     off = row % page
-    k = pool.k.at[:, pid, off].set(ks[:, 0].astype(pool.k.dtype))
-    v = pool.v.at[:, pid, off].set(vs[:, 0].astype(pool.v.dtype))
+    ksc = None if kv_scale is None else kv_scale[0]
+    vsc = None if kv_scale is None else kv_scale[1]
+    k = pool.k.at[:, pid, off].set(_pool_store(ks[:, 0], pool.k.dtype, ksc))
+    v = pool.v.at[:, pid, off].set(_pool_store(vs[:, 0], pool.v.dtype, vsc))
     return KVCache(k=k, v=v)
 
 
 def gather_pages(
     pool: KVCache,
     pages: jnp.ndarray,  # [NP] int32 page ids (SCRATCH-padded past the span)
+    kv_scale=None,  # [2, K] f32 → rows come back DEQUANTIZED (value·scale)
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Materialize a page list as contiguous KV rows [L, 1, NP*page, K, Hd]
     — the read half of prefix-span sharing under the paged cache (the span's
@@ -1340,6 +1388,11 @@ def gather_pages(
     operand)."""
     k = pool.k[:, pages]  # [L, NP, page, K, Hd]
     v = pool.v[:, pages]
+    if kv_scale is not None:
+        # A SCALED fp8 pool stores value/scale — the dense prefix operand
+        # prefill_tail consumes must be real values again.
+        k = k.astype(jnp.float32) * kv_scale[0][..., :, None]
+        v = v.astype(jnp.float32) * kv_scale[1][..., :, None]
     L, NP, page, K, Hd = k.shape
     return (
         k.reshape(L, 1, NP * page, K, Hd),
@@ -1359,6 +1412,7 @@ def prefill_chunk_paged(
     paged_impl: str = "auto",
     with_logits: bool = True,
     mesh=None,  # Mesh with tp>1 → paged Pallas kernel head-sharded
+    kv_scale=None,  # [2, K] f32 per-head (k, v) pool dequant scales (fp8 KV)
 ):
     """One chunk of a ragged chunked prefill, direct-to-page (ISSUE 2).
 
@@ -1401,20 +1455,20 @@ def prefill_chunk_paged(
             # Absorbed MLA chunk: q_eff scores the latent prefix pages and
             # the chunk's fresh latent rows (values come back out of the
             # same latents — see decode_chunk's MLA branch).
-            q_eff = _mla_absorbed_q(cfg, lp, x, positions, inv)  # [B,T,H,De]
+            q_eff = _mla_absorbed_q(cfg, lp, x, positions, inv, mesh)  # [B,T,H,De]
             rows = _mla_rows(cfg, lp, x, positions, inv)  # [B,T,1,De]
             acc, m, l = paged_prefill_partials(
                 q_eff, kc, kc, table, offsets, q_pos=positions,
-                impl=paged_impl,
+                impl=paged_impl, kv_scale=kv_scale,
             )
             wm = causal[None] & length_mask[:, None, :]  # [B, T, T]
             attn = _merge_partials_mq(q_eff, acc, m, l, rows, rows, wm)
             attn = _mla_unlatent(cfg, lp, attn)  # [B, T, H·v]
-            h = h + _attn_out(cfg, lp, attn)
+            h = h + _attn_out(cfg, lp, attn, mesh)
             x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-            h = h + _mlp_out(cfg, lp, x, ep)
+            h = h + _mlp_out(cfg, lp, x, ep, mesh)
             return h, (rows, rows[..., :0])
-        q, k, v = _attn_proj_qkv(cfg, lp, x)  # q [B,T,H,Hd], k/v [B,T,K,Hd]
+        q, k, v = _attn_proj_qkv(cfg, lp, x, mesh)  # q [B,T,H,Hd], k/v [B,T,K,Hd]
         q = apply_rope(q, positions, inv)
         k = apply_rope(k, positions, inv)
         wmask = causal[None] & length_mask[:, None, :]  # [B, T, T]
@@ -1424,25 +1478,27 @@ def prefill_chunk_paged(
             q, kc, vc, table, offsets,
             softcap=cfg.attn_softcap, window=cfg.sliding_window,
             sliding=sliding, q_pos=positions, impl=paged_impl, mesh=mesh,
+            kv_scale=kv_scale,
         )
         attn = _merge_partials_mq(
             q, acc, m, l, k, v, wmask, softcap=cfg.attn_softcap,
         ).reshape(B, T, -1).astype(h.dtype)
-        h = h + _attn_out(cfg, lp, attn)
+        h = h + _attn_out(cfg, lp, attn, mesh)
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-        h = h + _mlp_out(cfg, lp, x, ep)
+        h = h + _mlp_out(cfg, lp, x, ep, mesh)
         return h, (k, v)
 
     h, (new_k, new_v) = _scan_layers(
         cfg, params, h, layer, (pool.k, pool.v)
     )
-    pool = write_chunk_to_pool(pool, table, new_k, new_v, positions)
+    pool = write_chunk_to_pool(pool, table, new_k, new_v, positions,
+                               kv_scale=kv_scale)
     if not with_logits:
         return None, pool
     h = rms_norm(h, params["final_norm"], cfg.rms_eps)
     last_idx = jnp.maximum(lengths - 1, 0)
     last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]  # [B, D]
-    return _unembed(cfg, params, last), pool
+    return _unembed(cfg, params, last, mesh), pool
 
 
 def write_rows_to_cache(
@@ -1470,6 +1526,7 @@ def write_prefill_to_pool(
     ks: jnp.ndarray,  # [L, B_new, Sb, K, Hd] from prefill
     vs: jnp.ndarray,
     j: int,  # batch row within ks/vs (static)
+    kv_scale=None,  # [2, K] f32 → pool rows store value/scale (fp8 KV)
 ) -> KVCache:
     """Copy one prefilled request's KV into its pages. The prompt starts at
     row 0, so writes are page-aligned; the (static) trailing partial page
@@ -1478,14 +1535,18 @@ def write_prefill_to_pool(
     Sb = ks.shape[2]
     page = pool.k.shape[2]
     k, v = pool.k, pool.v
+    ksc = None if kv_scale is None else kv_scale[0]
+    vsc = None if kv_scale is None else kv_scale[1]
     for p in range(-(-Sb // page)):  # static page count for this bucket
         lo = p * page
         chunk_k = ks[:, j, lo: lo + page]  # [L, c, K, Hd], c static
         chunk_v = vs[:, j, lo: lo + page]
         k = jax.lax.dynamic_update_slice(
-            k, chunk_k[:, None].astype(k.dtype), (0, table_row[p], 0, 0, 0)
+            k, _pool_store(chunk_k, k.dtype, ksc)[:, None],
+            (0, table_row[p], 0, 0, 0)
         )
         v = jax.lax.dynamic_update_slice(
-            v, chunk_v[:, None].astype(v.dtype), (0, table_row[p], 0, 0, 0)
+            v, _pool_store(chunk_v, v.dtype, vsc)[:, None],
+            (0, table_row[p], 0, 0, 0)
         )
     return KVCache(k=k, v=v)

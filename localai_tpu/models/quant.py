@@ -8,6 +8,15 @@ bytes (packed int4) per weight instead of two. Measured on v5e
 (llama-3.2-1b bs8 decode): ~17% faster steps at int8 and half the weight
 footprint; int4 halves it again (llama.cpp Q4-class memory envelope).
 
+XLA folds that convert reliably only for the flat int8 form. The grouped
+int8 and packed int4 forms (reshape → unpack → concat → scale → dot) get a
+materialized dequantized copy in HBM instead, so decode streamed ~2.5
+bytes/weight at int4. ISSUE 9: decode-shape calls now dispatch to fused
+Pallas dequant-matmul kernels (ops/quant_matmul.py, `quant_kernel` /
+LOCALAI_QUANT_KERNEL — auto: Pallas on TPU) that unpack + scale in VMEM
+registers; the XLA forms in this file remain the numeric oracle and the
+prefill/compute-bound path.
+
 Representations consumed by `matmul` / `unembed_matmul`:
 - {"q": int8 [..., in, out], "s": f32 [..., 1, out]} — per-output-channel
   symmetric int8 (mode "int8").
@@ -101,9 +110,31 @@ def grouped_matmul(x: jnp.ndarray, w: dict) -> jnp.ndarray:
     return out
 
 
-def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
-    """x @ w for plain or quantized w (dequant fused into the dot)."""
+def matmul(x: jnp.ndarray, w, impl: str = "auto", mesh=None,
+           part=None) -> jnp.ndarray:
+    """x @ w for plain or quantized w.
+
+    Quantized dispatch (ISSUE 9): decode-shape calls route to the fused
+    Pallas dequant-matmul kernels (ops/quant_matmul — nibble unpack +
+    affine scale in VMEM registers, f32 MXU accumulation; each packed byte
+    crosses HBM once) per `impl` — "auto" is Pallas on TPU. Everything the
+    kernels don't serve (prefill-scale rows, XLA impl, exotic shapes) falls
+    through to the XLA forms below, which double as the kernels' numeric
+    oracle. XLA folds the flat int8 convert into the dot's operand load;
+    the grouped/packed forms are the ones it materializes — the kernels'
+    whole reason to exist.
+
+    mesh/part: under a tp>1 mesh the kernel runs in shard_map with the
+    weight's own partitioning ("col" = out axis sharded, "row" = group/in
+    axis sharded + psum at the declared boundary) — pallas_call is opaque
+    to GSPMD, so unwrapped it would all-gather the sharded weight per call.
+    """
     if isinstance(w, dict):
+        from localai_tpu.ops.quant_matmul import dispatch_matmul
+
+        y = dispatch_matmul(x, w, impl=impl, mesh=mesh, part=part)
+        if y is not None:
+            return y
         if "q" in w:
             return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)[..., 0, :]
         return grouped_matmul(x, w)
@@ -258,9 +289,21 @@ def init_params_quantized(
     return jtu.tree_unflatten(treedef, leaves)
 
 
-def unembed_matmul(h: jnp.ndarray, w) -> jnp.ndarray:
-    """h @ W.T for the (possibly quantized) lm_head/embed matrix → f32."""
+def unembed_matmul(h: jnp.ndarray, w, impl: str = "auto",
+                   mesh=None) -> jnp.ndarray:
+    """h @ W.T for the (possibly quantized) lm_head/embed matrix → f32.
+
+    Quantized heads dispatch to the fused Pallas kernel at decode row
+    counts (ops/quant_matmul.dispatch_unembed — out tiles stream contiguous
+    weight rows, so the transpose never materializes); the XLA form below
+    stays the oracle/fallback. Under tp>1 the kernel shard_maps over the
+    vocab-parallel axis."""
     if isinstance(w, dict):
+        from localai_tpu.ops.quant_matmul import dispatch_unembed
+
+        y = dispatch_unembed(h, w, impl=impl, mesh=mesh)
+        if y is not None:
+            return y
         logits = jnp.dot(
             h, w["q"].T.astype(h.dtype), preferred_element_type=jnp.float32
         )

@@ -460,7 +460,7 @@ def decode_attention(
 
 def _paged_cache_partials(q, k_pool, v_pool, table, limits,
                           softcap: float = 0.0, window: int = 0, sliding=None,
-                          q_pos=None):
+                          q_pos=None, kv_scale=None):
     """Online-softmax partials over a paged cache — the static-shape TPU
     answer to ragged/paged KV (SURVEY §7; reference: llama.cpp's per-slot
     contiguous cache, vLLM's PagedAttention): HBM holds one shared page pool
@@ -475,6 +475,11 @@ def _paged_cache_partials(q, k_pool, v_pool, table, limits,
     limits: [B] — rows with global index >= limits[b] are masked.
     softcap/window/sliding: gemma-2 semantics (softcap BEFORE masking;
     sliding layers mask rows further than `window` below `q_pos` [B]).
+    kv_scale: optional [2, K] f32 per-head (k, v) dequant scales for a
+    scaled fp8 pool (ISSUE 9) — applied to the gathered tile right at the
+    convert, so XLA fuses cast+scale into the einsum's operand load and the
+    dequantized copy never round-trips HBM (mirrors the in-register dequant
+    the Pallas kernel does on its VMEM tile).
     Returns (acc [B, K, G, D], m [B, K, G, 1], l [B, K, G, 1]) f32, scale
     applied.
     """
@@ -502,6 +507,9 @@ def _paged_cache_partials(q, k_pool, v_pool, table, limits,
         pids = table[:, jnp.minimum(cols, MP - 1)]  # [B, CH]
         kp = k_pool[pids].astype(jnp.float32)  # [B, CH, page, K, D]
         vp = v_pool[pids].astype(jnp.float32)
+        if kv_scale is not None:  # in-register fp8 dequant (fused into cast)
+            kp = kp * kv_scale[0][None, None, None, :, None]
+            vp = vp * kv_scale[1][None, None, None, :, None]
         kp = kp.reshape(B, CH * page, K, D)
         vp = vp.reshape(B, CH * page, K, D)
         sc = jnp.einsum("bkgd,bskd->bkgs", qf, kp)
@@ -535,7 +543,7 @@ def _paged_cache_partials(q, k_pool, v_pool, table, limits,
 
 
 def _paged_pallas_sharded(kernel_fn, mesh, q, k_pool, v_pool, table, limits,
-                          q_pos, sliding, mq: bool):
+                          q_pos, sliding, mq: bool, kv_scale=None):
     """Run a Pallas paged-partials kernel head-sharded over the mesh's "tp"
     axis (ISSUE 7): q splits on its head axis, the pool on its kv-head axis
     (the layout the engine stores it in — pages live on the head shard that
@@ -548,10 +556,15 @@ def _paged_pallas_sharded(kernel_fn, mesh, q, k_pool, v_pool, table, limits,
     from jax.sharding import PartitionSpec as P
 
     sl_in = sliding if sliding is not None else jnp.zeros((), bool)
+    # kv scales ride sharded on their head axis like the pool itself; ones
+    # when the pool is unscaled (the kernel's multiply is exact identity).
+    kvs = (jnp.ones((2, k_pool.shape[2]), jnp.float32) if kv_scale is None
+           else kv_scale.astype(jnp.float32))
 
-    def local(qs, kp, vp, tbl, lim, qp, sl):
+    def local(qs, kp, vp, tbl, lim, qp, sl, sc):
         return kernel_fn(qs, kp, vp, tbl, lim, q_pos=qp,
-                         sliding=sl if sliding is not None else None)
+                         sliding=sl if sliding is not None else None,
+                         kv_scale=sc)
 
     q_spec = P(None, None, "tp", None) if mq else P(None, "tp", None)
     qp_spec = P(None, None) if mq else P(None)
@@ -561,15 +574,15 @@ def _paged_pallas_sharded(kernel_fn, mesh, q, k_pool, v_pool, table, limits,
     fn = _head_shard_map(
         local, mesh,
         in_specs=(q_spec, P(None, None, "tp", None), P(None, None, "tp", None),
-                  P(None, None), P(None), qp_spec, P()),
+                  P(None, None), P(None), qp_spec, P(), P(None, "tp")),
         out_specs=out_specs,
     )
-    return fn(q, k_pool, v_pool, table, limits, q_pos, sl_in)
+    return fn(q, k_pool, v_pool, table, limits, q_pos, sl_in, kvs)
 
 
 def paged_partials(q, k_pool, v_pool, table, limits, softcap: float = 0.0,
                    window: int = 0, sliding=None, q_pos=None,
-                   impl: str = "auto", mesh=None):
+                   impl: str = "auto", mesh=None, kv_scale=None):
     """Paged online-softmax partials, dispatched: the fused Pallas ragged
     paged-attention kernel (ops/paged_flash — pages stream HBM→VMEM once,
     walk bounded per slot) or the XLA gather walk below (reference path and
@@ -590,20 +603,22 @@ def paged_partials(q, k_pool, v_pool, table, limits, softcap: float = 0.0,
                                   window=window, interpret=interp),
                 mesh, q, k_pool, v_pool, table, limits,
                 limits if q_pos is None else q_pos, sliding, mq=False,
+                kv_scale=kv_scale,
             )
         return paged_decode_partials(
             q, k_pool, v_pool, table, limits, softcap=softcap, window=window,
-            sliding=sliding, q_pos=q_pos, interpret=interp,
+            sliding=sliding, q_pos=q_pos, interpret=interp, kv_scale=kv_scale,
         )
     return _paged_cache_partials(
         q, k_pool, v_pool, table, limits,
         softcap=softcap, window=window, sliding=sliding, q_pos=q_pos,
+        kv_scale=kv_scale,
     )
 
 
 def paged_partials_mq(q, k_pool, v_pool, table, limits, softcap: float = 0.0,
                       window: int = 0, sliding=None, q_pos=None,
-                      impl: str = "auto", mesh=None):
+                      impl: str = "auto", mesh=None, kv_scale=None):
     """Multi-query `paged_partials` (speculative verify chunk) — same
     dispatch."""
     import functools
@@ -623,21 +638,23 @@ def paged_partials_mq(q, k_pool, v_pool, table, limits, softcap: float = 0.0,
                 functools.partial(paged_decode_partials_mq, softcap=softcap,
                                   window=window, interpret=interp),
                 mesh, q, k_pool, v_pool, table, limits, qp, sliding, mq=True,
+                kv_scale=kv_scale,
             )
         return paged_decode_partials_mq(
             q, k_pool, v_pool, table, limits, softcap=softcap, window=window,
-            sliding=sliding, q_pos=q_pos, interpret=interp,
+            sliding=sliding, q_pos=q_pos, interpret=interp, kv_scale=kv_scale,
         )
     return _paged_cache_partials_mq(
         q, k_pool, v_pool, table, limits,
         softcap=softcap, window=window, sliding=sliding, q_pos=q_pos,
+        kv_scale=kv_scale,
     )
 
 
 def paged_prefill_partials(q, k_pool, v_pool, table, limits,
                            softcap: float = 0.0, window: int = 0,
                            sliding=None, q_pos=None, impl: str = "auto",
-                           mesh=None):
+                           mesh=None, kv_scale=None):
     """Paged partials for a PREFILL CHUNK (models/llama.prefill_chunk_paged):
     q [B, T, H, D] covers a whole chunk, limits[b] is the rows already
     resident (the chunk's start offset). Same dispatch as paged_partials_mq,
@@ -661,14 +678,16 @@ def paged_prefill_partials(q, k_pool, v_pool, table, limits,
                 functools.partial(paged_prefill_partials_mq, softcap=softcap,
                                   window=window, interpret=interp),
                 mesh, q, k_pool, v_pool, table, limits, qp, sliding, mq=True,
+                kv_scale=kv_scale,
             )
         return paged_prefill_partials_mq(
             q, k_pool, v_pool, table, limits, softcap=softcap, window=window,
-            sliding=sliding, q_pos=q_pos, interpret=interp,
+            sliding=sliding, q_pos=q_pos, interpret=interp, kv_scale=kv_scale,
         )
     return _paged_cache_partials_mq(
         q, k_pool, v_pool, table, limits,
         softcap=softcap, window=window, sliding=sliding, q_pos=q_pos,
+        kv_scale=kv_scale,
     )
 
 
@@ -688,6 +707,7 @@ def decode_attention_windowed_paged(
     sliding=None,
     impl: str = "auto",
     mesh=None,  # Mesh with tp>1 → Pallas kernel head-sharded (shard_map)
+    kv_scale=None,  # [2, K] f32 per-head (k, v) dequant scales (fp8 KV)
 ) -> jnp.ndarray:
     """`decode_attention_windowed` over a paged pool: paged partials for
     rows [0, block_start), dense merge of the (tiny) local window + current
@@ -696,7 +716,7 @@ def decode_attention_windowed_paged(
     acc, m, l = paged_partials(
         q, k_pool, v_pool, table, positions - step,
         softcap=softcap, window=window, sliding=sliding, q_pos=positions,
-        impl=impl, mesh=mesh,
+        impl=impl, mesh=mesh, kv_scale=kv_scale,
     )
     # f32 concat: the block-local window may live in the cache's storage
     # dtype (fp8 KV) while the current token is model-dtype.
@@ -713,7 +733,7 @@ def decode_attention_windowed_paged(
 
 def _paged_cache_partials_mq(q, k_pool, v_pool, table, limits,
                              softcap: float = 0.0, window: int = 0,
-                             sliding=None, q_pos=None):
+                             sliding=None, q_pos=None, kv_scale=None):
     """Multi-query `_paged_cache_partials` for the speculative verify chunk:
     q [B, T, H, D] (T = draft window + 1), one page walk shared by all T
     queries. limits [B] bounds the cache prefix every query may see (the
@@ -732,6 +752,9 @@ def _paged_cache_partials_mq(q, k_pool, v_pool, table, limits,
         pids = table[:, p]
         kp = k_pool[pids].astype(jnp.float32)  # [B, page, K, D]
         vp = v_pool[pids].astype(jnp.float32)
+        if kv_scale is not None:  # in-register fp8 dequant (fused into cast)
+            kp = kp * kv_scale[0][None, None, :, None]
+            vp = vp * kv_scale[1][None, None, :, None]
         sc = jnp.einsum("btkgd,bskd->bkgts", qf, kp)  # [B, K, G, T, page]
         if softcap:
             sc = softcap_scores(sc, softcap)

@@ -81,6 +81,8 @@ def _ragged_paged_kernel(
     sliding_ref,  # scalar-prefetch [1] i32
     q_ref,  # [1, K, QR, Dk] f32 (scale applied)
     qpos_ref,  # [1, QR] i32
+    kvs_ref,  # [2, K] f32 SMEM — per-head (k, v) dequant scales (fp8 KV);
+    # ones when the pool is unscaled, so the multiply is exact identity
     k_hbm,  # [P, page, K, Dk] pool dtype, memory_space=ANY
     v_hbm,  # [P, page, K, Dv]
     acc_ref,  # out [1, K, QR, Dv] f32
@@ -149,7 +151,10 @@ def _ragged_paged_kernel(
 
         for kh in range(num_kv):  # static unroll — one MXU pass per kv head
             q = q_ref[0, kh]  # [QR, Dk]
-            kp = kbuf[slot, :, kh, :].astype(jnp.float32)  # [page, Dk]
+            # fp8 KV dequant happens HERE, in registers on the VMEM tile the
+            # DMA just landed — the pool's stored bytes never exist in HBM
+            # at any wider dtype (per-head scale: ISSUE 9).
+            kp = kbuf[slot, :, kh, :].astype(jnp.float32) * kvs_ref[0, kh]
             s = jax.lax.dot_general(
                 q, kp, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -163,7 +168,7 @@ def _ragged_paged_kernel(
             p = jnp.exp(s - m_new)
             p = jnp.where(valid, p, 0.0)
             l_s[kh] = l_s[kh] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-            vp = vbuf[slot, :, kh, :].astype(jnp.float32)  # [page, Dv]
+            vp = vbuf[slot, :, kh, :].astype(jnp.float32) * kvs_ref[1, kh]
             acc_s[kh] = acc_s[kh] * alpha + jax.lax.dot_general(
                 p, vp, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -189,6 +194,7 @@ def _paged_partials_rows(
     window: int,
     sliding,
     interpret: bool,
+    kv_scale=None,  # [2, K] f32 per-head (k, v) dequant scales, or None
 ):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -199,6 +205,8 @@ def _paged_partials_rows(
     sl_arr = jnp.asarray(
         sliding if sliding is not None else False
     ).reshape(1).astype(jnp.int32)
+    kvs = (jnp.ones((2, K), jnp.float32) if kv_scale is None
+           else kv_scale.astype(jnp.float32))
     kernel = functools.partial(
         _ragged_paged_kernel, page=page, num_kv=K,
         softcap=float(softcap), window=int(window),
@@ -211,6 +219,7 @@ def _paged_partials_rows(
             in_specs=[
                 pl.BlockSpec((1, K, QR, Dk), lambda b, *_: (b, 0, 0, 0)),
                 pl.BlockSpec((1, QR), lambda b, *_: (b, 0)),
+                pl.BlockSpec(memory_space=pltpu.SMEM),  # [2, K] kv scales
                 pl.BlockSpec(memory_space=pltpu.ANY),  # pool stays in HBM
                 pl.BlockSpec(memory_space=pltpu.ANY),
             ],
@@ -236,7 +245,7 @@ def _paged_partials_rows(
         interpret=interpret,
     )(
         table.astype(jnp.int32), limits.astype(jnp.int32), sl_arr,
-        qr, qpos_rows.astype(jnp.int32), k_pool, v_pool,
+        qr, qpos_rows.astype(jnp.int32), kvs, k_pool, v_pool,
     )
     return acc, m[..., :1], l[..., :1]
 
@@ -252,6 +261,7 @@ def paged_decode_partials(
     sliding=None,
     q_pos=None,
     interpret: bool = False,
+    kv_scale=None,  # [2, K] f32 per-head (k, v) dequant scales (fp8 KV)
 ):
     """Drop-in for attention._paged_cache_partials: returns
     (acc [B, K, G, Dv], m [B, K, G, 1], l [B, K, G, 1]) f32, scale applied."""
@@ -267,7 +277,7 @@ def paged_decode_partials(
     qpos_rows = jnp.broadcast_to(q_pos[:, None], (B, G))
     return _paged_partials_rows(
         qr, qpos_rows, k_pool, v_pool, table, limits,
-        softcap, window, sliding, interpret,
+        softcap, window, sliding, interpret, kv_scale=kv_scale,
     )
 
 
@@ -282,6 +292,7 @@ def paged_decode_partials_mq(
     sliding=None,
     q_pos=None,  # [B, T]
     interpret: bool = False,
+    kv_scale=None,  # [2, K] f32 per-head (k, v) dequant scales (fp8 KV)
 ):
     """Drop-in for attention._paged_cache_partials_mq (speculative verify
     chunk): one page walk shared by all T queries. Returns
@@ -305,7 +316,7 @@ def paged_decode_partials_mq(
     qpos_rows = jnp.repeat(q_pos, G, axis=1)  # [B, T*G]
     acc, m, l = _paged_partials_rows(
         qr, qpos_rows, k_pool, v_pool, table, limits,
-        softcap, window, sliding, interpret,
+        softcap, window, sliding, interpret, kv_scale=kv_scale,
     )
     acc = acc.reshape(B, K, T, G, Dv).transpose(0, 1, 3, 2, 4)
     m = m.reshape(B, K, T, G, 1).transpose(0, 1, 3, 2, 4)
@@ -335,6 +346,7 @@ def paged_prefill_partials_mq(
     q_pos=None,  # [B, T] global positions of the chunk tokens
     interpret: bool = False,
     max_qrows: int = PREFILL_MAX_QROWS,
+    kv_scale=None,  # [2, K] f32 per-head (k, v) dequant scales (fp8 KV)
 ):
     """`paged_decode_partials_mq` for prefill-chunk query counts: the T·G
     query-row axis is tiled to `max_qrows` per kernel launch so the chunked
@@ -353,6 +365,7 @@ def paged_prefill_partials_mq(
         return paged_decode_partials_mq(
             q, k_pool, v_pool, table, limits, softcap=softcap, window=window,
             sliding=sliding, q_pos=q_pos, interpret=interpret,
+            kv_scale=kv_scale,
         )
     parts = []
     for lo in range(0, T, tq):
@@ -360,7 +373,7 @@ def paged_prefill_partials_mq(
         parts.append(paged_decode_partials_mq(
             q[:, lo:hi], k_pool, v_pool, table, limits, softcap=softcap,
             window=window, sliding=sliding, q_pos=q_pos[:, lo:hi],
-            interpret=interpret,
+            interpret=interpret, kv_scale=kv_scale,
         ))
     return tuple(
         jnp.concatenate([p[i] for p in parts], axis=3) for i in range(3)

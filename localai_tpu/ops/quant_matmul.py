@@ -1,0 +1,484 @@
+"""Fused dequant-matmul Pallas kernels for quantized decode (ISSUE 9).
+
+Why this exists: models/quant.py stores weights int8/int4 and relies on XLA
+folding the int→float convert into the dot's operand load. That folding is
+reliable ONLY for the flat per-channel int8 form. The grouped int8 and
+packed-nibble int4 forms go through reshape → unpack lo/hi → concat → scale
+→ dot, and XLA materializes the dequantized bf16 copy in HBM first — int4
+decode streams ~2.5 bytes/weight instead of ~0.5, which is the whole ballgame
+for an HBM-bound decode step (r04: 85.3% of roofline; the gap is exactly
+these extra passes).
+
+These kernels do the unpack + affine scale in VMEM registers on the weight
+block the Pallas pipeline is already streaming HBM→VMEM (double-buffered
+block DMA between grid steps), and accumulate in f32 on the MXU — each
+packed weight byte crosses HBM exactly once. Decode-shape only: the row
+count (batch × window) is small enough that x and the f32 accumulator sit
+whole in VMEM, so the grid walks (expert, out-tile, k-chunk) with the
+k-chunk axis innermost, revisiting one out block per (expert, out-tile).
+
+Forms served (matching models/quant.py representations):
+- flat int8      {"q": [in, out] i8,      "s": [1, out] f32}
+- grouped int8   {"gq": [G, gs, out] i8,  "gs": [G, 1, out] f32}
+- packed int4    {"g4": [G, gs/2, out] u8, "gs", "gz": [G, 1, out] f32}
+  (value = nibble·s − z; the −z side is a rank-1 correction: −Σᵢx·z per
+  group, one extra tiny MXU dot on the per-group x sums)
+- MoE variants of all three with a leading expert axis, for the two
+  _moe_dense einsum shapes (shared-x and per-expert-x)
+- unembed        {"q": [V, D] i8, "s": [V, 1] f32} used transposed (h @ qᵀ·s)
+
+Sharding (ISSUE 7 shard_map wrapping): pallas_call is opaque to GSPMD, so
+under a tp>1 mesh the kernels run inside shard_map with the weight specs
+parallel/sharding.py already assigns to the q/s/g4 forms — column-parallel
+weights shard their out axis ("tp" on the last dim of every leaf),
+row-parallel weights shard the group/in axis, and the row-parallel partial
+sums psum over "tp" inside the declared boundary below (the same ICI
+boundary GSPMD would have placed at the o/down projection).
+
+Dispatch: models/quant.matmul / unembed_matmul and models/llama._moe_mm call
+the dispatch_* helpers here; a None return means "not engaged" and the
+caller falls through to its XLA form, which stays the numeric oracle
+(tests/test_quant.py runs these kernels in interpret mode on CPU against
+it, exactly like ops/paged_flash vs the XLA page walk).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+# The ONLY function here allowed to issue cross-chip collectives: the
+# row-parallel shard_map closure psums its partial products over "tp" —
+# the same o/down-projection boundary GSPMD places for the XLA path
+# (lint: sharding-consistency C3).
+COLLECTIVE_BOUNDARY = ("_sharded_quant_matmul",)
+
+# Rows (flattened leading dims of x) above which the kernels disengage and
+# the XLA path serves: prefill-scale matmuls are compute-bound (the dequant
+# copy amortizes over S·D² FLOPs) and their x/accumulator would not fit the
+# VMEM-resident decode layout below. Decode blocks (B ≤ max_slots), spec
+# verify chunks (B·(k+1)) and short cached-admit tails all sit far under it.
+QUANT_PALLAS_MAX_ROWS = 256
+
+
+def use_pallas_quant(impl: str = "auto") -> bool:
+    """Resolve the quantized-matmul kernel choice.
+
+    impl: "auto" (Pallas on TPU, XLA dequant elsewhere), "pallas", or
+    "xla". The LOCALAI_QUANT_KERNEL env var overrides — same escape hatch
+    as LOCALAI_PAGED_KERNEL for the paged decode kernel. "pallas" off-TPU
+    runs in interpret mode (slow; tests only).
+    """
+    impl = os.environ.get("LOCALAI_QUANT_KERNEL", "") or impl or "auto"
+    if impl == "auto":
+        return jax.default_backend() == "tpu"
+    if impl not in ("pallas", "xla"):
+        raise ValueError(f"quant kernel impl {impl!r}: use auto|pallas|xla")
+    return impl == "pallas"
+
+
+def _tile(n: int, targets=(512, 256, 128)) -> int:
+    """Largest target that divides n, else n whole (tiny test shapes)."""
+    for t in targets:
+        if t <= n and n % t == 0:
+            return t
+    return n
+
+
+def _rows(x: jnp.ndarray, tail: int = 1) -> int:
+    r = 1
+    for d in x.shape[: x.ndim - tail]:
+        r *= int(d)
+    return r
+
+
+def _tp_degree(mesh) -> int:
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get("tp", 1))
+
+
+# --------------------------------------------------------------------------- #
+# Kernels
+# --------------------------------------------------------------------------- #
+
+
+def _qmm_kernel(x_ref, w_ref, s_ref, *rest, gs: int, gc: int, packed: bool):
+    """One (expert, out-tile, k-chunk) grid step of the dequant-matmul.
+
+    Blocks: x (1, N, kc) float, w (1, kc[/2], bo) i8/u8, s (1, gc|1, bo)
+    f32, optional z (1, gc, bo) f32, out (1, N, bo), acc scratch (N, bo)
+    f32. gs == 0 means the flat per-channel form (scale applied once at the
+    final write); packed means two nibbles per weight byte along the
+    in-group axis (low nibble = first gs/2 elements — models/quant.py).
+    """
+    import jax.experimental.pallas as pl
+
+    z_ref = rest[0] if len(rest) == 3 else None
+    o_ref, acc_ref = rest[-2], rest[-1]
+    k = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    xb = x_ref[0].astype(jnp.float32)  # [N, kc]
+    wb = w_ref[0]  # [kc(,/2), bo] int8/uint8
+    bo = wb.shape[-1]
+    if packed:
+        half = gs // 2
+        wp = wb.reshape(gc, half, bo)
+        nib = jnp.concatenate([wp & jnp.uint8(0xF), wp >> jnp.uint8(4)],
+                              axis=1)  # [gc, gs, bo]
+        wf = nib.astype(jnp.float32)
+    elif gs:
+        wf = wb.reshape(gc, gs, bo).astype(jnp.float32)
+    else:
+        wf = wb.astype(jnp.float32)  # flat: [kc, bo]
+    if gs:
+        # Dequant in registers: the scaled f32 weight tile exists only in
+        # VMEM for this one MXU pass — never written back to HBM.
+        sb = s_ref[0].astype(jnp.float32)  # [gc, bo]
+        wf = (wf * sb[:, None, :]).reshape(gc * gs, bo)
+    acc_ref[...] += jax.lax.dot_general(
+        xb, wf, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if z_ref is not None:
+        # Affine zero point: −Σᵢ x_{g,i} · z_{g,o} per group.
+        zb = z_ref[0].astype(jnp.float32)  # [gc, bo]
+        xs = xb.reshape(xb.shape[0], gc, gs).sum(axis=-1)  # [N, gc]
+        acc_ref[...] -= jax.lax.dot_general(
+            xs, zb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(k == nk - 1)
+    def _emit():
+        res = acc_ref[...]
+        if not gs:
+            res = res * s_ref[0].astype(jnp.float32)  # [1, bo] broadcasts
+        o_ref[0] = res.astype(o_ref.dtype)
+
+
+def _unembed_kernel(h_ref, w_ref, s_ref, o_ref, acc_ref):
+    """h @ qᵀ · s for the vocab-major lm_head layout {"q": [V, D],
+    "s": [V, 1]} — each out tile streams contiguous weight ROWS, so the
+    transpose never materializes. Blocks: h (N, kc), w (bv, kc), s (bv, 1),
+    out (N, bv) f32."""
+    import jax.experimental.pallas as pl
+
+    k = pl.program_id(1)
+    nk = pl.num_programs(1)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    hb = h_ref[...].astype(jnp.float32)  # [N, kc]
+    wb = w_ref[...].astype(jnp.float32)  # [bv, kc]
+    acc_ref[...] += jax.lax.dot_general(
+        hb, wb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(k == nk - 1)
+    def _emit():
+        o_ref[...] = acc_ref[...] * s_ref[...][:, 0][None, :]
+
+
+# --------------------------------------------------------------------------- #
+# pallas_call wrappers (local shapes — shard_map hands these per-chip views)
+# --------------------------------------------------------------------------- #
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _qmm_call(x3, wq, s3, z3, *, gs: int, packed: bool, out_dtype,
+              x_per_expert: bool):
+    """Grid launch over (E, out-tiles, k-chunks).
+
+    x3 [Ex, N, Kin] float (Ex = E when per-expert, else 1); wq [E, Kin(/2),
+    out] int; s3 [E, G|1, out] f32; z3 [E, G, out] f32 or None. Returns
+    [E, N, out] in out_dtype.
+    """
+    import jax.experimental.pallas as pl
+
+    E, kin_w, out = wq.shape
+    _, N, kin = x3.shape
+    if gs:
+        g = kin // gs
+        gc = _tile(g, (16, 8, 4, 2))
+        kc = gc * gs
+        kc_w = kc // 2 if packed else kc
+    else:
+        kc = _tile(kin)
+        kc_w = kc
+        gc = 1
+    bo = _tile(out)
+    nk = kin // kc
+    grid = (E, out // bo, nk)
+
+    def xi(e, j, k):
+        return ((e, 0, k) if x_per_expert else (0, 0, k))
+
+    in_specs = [
+        pl.BlockSpec((1, N, kc), xi),
+        pl.BlockSpec((1, kc_w, bo), lambda e, j, k: (e, k, j)),
+        pl.BlockSpec(
+            (1, gc if gs else 1, bo),
+            (lambda e, j, k: (e, k, j)) if gs else (lambda e, j, k: (e, 0, j)),
+        ),
+    ]
+    args = [x3, wq, s3]
+    if z3 is not None:
+        in_specs.append(pl.BlockSpec((1, gc, bo), lambda e, j, k: (e, k, j)))
+        args.append(z3)
+    from jax.experimental.pallas import tpu as pltpu
+
+    kernel = functools.partial(_qmm_kernel, gs=gs, gc=gc, packed=packed)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, N, bo), lambda e, j, k: (e, 0, j)),
+        out_shape=jax.ShapeDtypeStruct((E, N, out), out_dtype),
+        scratch_shapes=[pltpu.VMEM((N, bo), jnp.float32)],
+        interpret=_interpret(),
+    )(*args)
+
+
+def _plain_matmul(x: jnp.ndarray, w: dict) -> jnp.ndarray:
+    """Non-MoE quantized x @ w on local (possibly shard-local) shapes."""
+    lead = x.shape[:-1]
+    n = _rows(x)
+    x3 = x.reshape(1, n, x.shape[-1])
+    if "q" in w:
+        out = _qmm_call(
+            x3, w["q"][None], w["s"].reshape(1, 1, -1), None,
+            gs=0, packed=False, out_dtype=x.dtype, x_per_expert=False,
+        )
+        return out.reshape(*lead, -1)
+    packed = "g4" in w
+    wq = (w["g4"] if packed else w["gq"])  # [G, gs(/2), out]
+    g, gsw, out_dim = wq.shape
+    gs_width = gsw * (2 if packed else 1)
+    s3 = w["gs"][..., 0, :][None]  # [1, G, out]
+    z3 = w["gz"][..., 0, :][None] if "gz" in w else None
+    out = _qmm_call(
+        x3, wq.reshape(1, g * gsw, out_dim), s3, z3,
+        gs=gs_width, packed=packed, out_dtype=x.dtype, x_per_expert=False,
+    )
+    return out.reshape(*lead, -1)
+
+
+def _plain_moe_mm(x: jnp.ndarray, w: dict, sub: str) -> jnp.ndarray:
+    """MoE dequant-matmul for the two _moe_dense einsum shapes."""
+    per_expert = sub == "...ef,efd->...ed"
+    if per_expert:
+        lead = x.shape[:-2]
+        e = x.shape[-2]
+        n = _rows(x, tail=2)
+        # [.., E, F] → [E, N, F]
+        x3 = jnp.moveaxis(x.reshape(n, e, x.shape[-1]), 1, 0)
+    else:
+        lead = x.shape[:-1]
+        n = _rows(x)
+        x3 = x.reshape(1, n, x.shape[-1])
+    if "q" in w:
+        out = _qmm_call(
+            x3, w["q"], w["s"], None,  # s already [E, 1, out]
+            gs=0, packed=False, out_dtype=x.dtype, x_per_expert=per_expert,
+        )
+    else:
+        packed = "g4" in w
+        wq3 = w["g4"] if packed else w["gq"]  # [E, G, gs(/2), out]
+        e_, g, gsw, out_dim = wq3.shape
+        gs_width = gsw * (2 if packed else 1)
+        out = _qmm_call(
+            x3, wq3.reshape(e_, g * gsw, out_dim),
+            w["gs"][..., 0, :], w["gz"][..., 0, :] if "gz" in w else None,
+            gs=gs_width, packed=packed, out_dtype=x.dtype,
+            x_per_expert=per_expert,
+        )
+    # out [E, N, F|D] → [.., E, F|D]
+    y = jnp.moveaxis(out, 0, 1)  # [N, E, F|D]
+    return y.reshape(*lead, y.shape[1], y.shape[2])
+
+
+def _plain_unembed(h: jnp.ndarray, w: dict) -> jnp.ndarray:
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    lead = h.shape[:-1]
+    n = _rows(h)
+    d = h.shape[-1]
+    v = w["q"].shape[0]
+    h2 = h.reshape(n, d)
+    bv = _tile(v)
+    kc = _tile(d)
+    out = pl.pallas_call(
+        _unembed_kernel,
+        grid=(v // bv, d // kc),
+        in_specs=[
+            pl.BlockSpec((n, kc), lambda j, k: (0, k)),
+            pl.BlockSpec((bv, kc), lambda j, k: (j, k)),
+            pl.BlockSpec((bv, 1), lambda j, k: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((n, bv), lambda j, k: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((n, v), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((n, bv), jnp.float32)],
+        interpret=_interpret(),
+    )(h2, w["q"], w["s"].astype(jnp.float32))
+    return out.reshape(*lead, v)
+
+
+# --------------------------------------------------------------------------- #
+# Sharded dispatch (tp>1 — shard_map over the weight's own partitioning)
+# --------------------------------------------------------------------------- #
+
+
+def _w_specs(w: dict, part: str, moe: bool):
+    """PartitionSpecs for a quantized dict's leaves, mirroring
+    parallel/sharding.param_shardings_for: col shards every leaf's out
+    (last) axis; row shards the group/in axis (the flat scale is per-out
+    and stays replicated)."""
+    from jax.sharding import PartitionSpec as P
+
+    off = 1 if moe else 0
+    specs = {}
+    for key, leaf in w.items():
+        ax = [None] * leaf.ndim
+        if part in ("col", "unembed"):
+            # unembed's out axis is the leading V axis of [V, D]/[V, 1].
+            ax[0 if part == "unembed" else -1] = "tp"
+        elif key != "s":  # row: q in-axis / grouped G-axis; flat s replicated
+            ax[off] = "tp"
+        specs[key] = P(*ax)
+    return specs
+
+
+def _sharded_quant_matmul(x, w, mesh, part: str, moe_sub=None):
+    """Run the local kernel per tp shard; row-parallel partials psum over
+    "tp" here (the declared ICI boundary — see COLLECTIVE_BOUNDARY)."""
+    from jax.sharding import PartitionSpec as P
+
+    from localai_tpu.parallel.mesh import shard_map as _shard_map
+
+    row = part == "row"
+    x_ax = [None] * x.ndim
+    if row:
+        x_ax[-1] = "tp"
+    if part == "unembed":
+        out_ndim = x.ndim
+    elif moe_sub == "...d,edf->...ef":
+        out_ndim = x.ndim + 1
+    else:
+        out_ndim = x.ndim
+    o_ax = [None] * out_ndim
+    if not row:
+        o_ax[-1] = "tp"
+
+    def local(xl, wl):
+        if part == "unembed":
+            y = _plain_unembed(xl, wl)
+        elif moe_sub is not None:
+            y = _plain_moe_mm(xl, wl, moe_sub)
+        else:
+            y = _plain_matmul(xl, wl)
+        if row:
+            y = jax.lax.psum(y, "tp")
+        return y
+
+    leaf = w.get("q", w.get("gq", w.get("g4")))
+    moe = leaf.ndim == (3 if "q" in w else 4)
+    fn = _shard_map(
+        local, mesh=mesh,
+        in_specs=(P(*x_ax), _w_specs(w, part, moe=moe)),
+        out_specs=P(*o_ax),
+        check_vma=False,
+    )
+    return fn(x, w)
+
+
+# --------------------------------------------------------------------------- #
+# Dispatchers (return None → caller falls back to its XLA oracle form)
+# --------------------------------------------------------------------------- #
+
+
+def _engaged(x, impl: str, tail: int = 1) -> bool:
+    return (
+        use_pallas_quant(impl)
+        and jnp.issubdtype(x.dtype, jnp.floating)
+        and _rows(x, tail) <= QUANT_PALLAS_MAX_ROWS
+        and _rows(x, tail) > 0
+    )
+
+
+def _shardable(x, w: dict, part: str, tp: int, moe_off: int = 0) -> bool:
+    """Every axis a tp shard_map would split must divide by tp — otherwise
+    fall back to the XLA path (which GSPMD partitions or replicates as it
+    can). col splits the out axis; row splits x's reduction axis and the
+    weight's in/group axis."""
+    leaf = w.get("q", w.get("gq", w.get("g4")))
+    if part in ("col", "unembed"):
+        out_ax = 0 if part == "unembed" else leaf.ndim - 1
+        return leaf.shape[out_ax] % tp == 0
+    return (x.shape[-1] % tp == 0
+            and leaf.shape[moe_off] % tp == 0)
+
+
+def dispatch_matmul(x, w: dict, impl: str = "auto", mesh=None, part=None):
+    """Fused x @ w for the non-MoE quantized forms, or None to fall back."""
+    leaf = w.get("q", w.get("gq", w.get("g4")))
+    if leaf is None or leaf.ndim != (2 if "q" in w else 3):
+        return None
+    if not _engaged(x, impl):
+        return None
+    tp = _tp_degree(mesh)
+    if tp > 1 and part in ("col", "row"):
+        if not _shardable(x, w, part, tp):
+            return None
+        return _sharded_quant_matmul(x, w, mesh, part)
+    return _plain_matmul(x, w)
+
+
+def dispatch_moe_mm(x, w: dict, sub: str, impl: str = "auto", mesh=None):
+    """Fused MoE dequant-matmul for _moe_dense's two einsum shapes, or
+    None to fall back. Part is implied by the shape: edf projects OUT to
+    the tp-sharded F axis (col), efd contracts the sharded F axis (row).
+    Expert-parallel (ep>1) meshes fall back to the XLA path."""
+    if sub not in ("...d,edf->...ef", "...ef,efd->...ed"):
+        return None
+    per_expert = sub == "...ef,efd->...ed"
+    if not _engaged(x, impl, tail=2 if per_expert else 1):
+        return None
+    tp = _tp_degree(mesh)
+    if tp > 1:
+        part = "row" if per_expert else "col"
+        if int(mesh.shape.get("ep", 1)) > 1:
+            return None
+        if not _shardable(x, w, part, tp, moe_off=1):
+            return None
+        return _sharded_quant_matmul(x, w, mesh, part, moe_sub=sub)
+    return _plain_moe_mm(x, w, sub)
+
+
+def dispatch_unembed(h, w: dict, impl: str = "auto", mesh=None):
+    """Fused h @ qᵀ·s for the quantized lm_head, or None to fall back."""
+    if "q" not in w or w["q"].ndim != 2 or w["s"].shape[-1] != 1:
+        return None
+    if not _engaged(h, impl):
+        return None
+    tp = _tp_degree(mesh)
+    if tp > 1:
+        if not _shardable(h, w, "unembed", tp):
+            return None
+        return _sharded_quant_matmul(h, w, mesh, "unembed")
+    return _plain_unembed(h, w)

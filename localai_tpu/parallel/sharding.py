@@ -144,7 +144,14 @@ def param_shardings_for(cfg: ArchConfig, mesh: Mesh, params: Params) -> Params:
     (models/quant.py). The quantized payload keeps the weight's spec (grouped
     forms shard the group axis the way the in axis was sharded; the
     within-group axis never shards); scales drop spec axes where their
-    dimension is 1."""
+    dimension is 1.
+
+    The fused dequant-matmul kernels consume EXACTLY this partitioning under
+    their tp shard_map (ops/quant_matmul._w_specs rebuilds it per call from
+    the col/row role — out axis for column-parallel, group/in axis for
+    row-parallel). Keep the two in sync: a spec change here that _w_specs
+    does not mirror makes the sharded Pallas path reshard every weight per
+    decode step (ISSUE 9)."""
     specs = param_specs(cfg)
 
     def scale_spec(base: tuple, shape: tuple) -> P:

@@ -654,6 +654,8 @@ class ModelManager:
                 kv_swap_bytes=cfg.kv_swap_bytes,
                 kv_cache_dtype=cfg.kv_cache_dtype,
                 paged_kernel=cfg.paged_kernel,
+                quant_kernel=cfg.quant_kernel,
+                kv_scale=cfg.kv_scale,
                 prefill_chunk=cfg.prefill_chunk,
                 max_pending=cfg.max_pending,
                 queue_timeout_s=cfg.queue_timeout_s,

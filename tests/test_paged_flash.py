@@ -306,3 +306,212 @@ def test_engine_paged_pallas_matches_xla_greedy():
         finally:
             eng.stop()
     assert texts["pallas"] == texts["xla"]
+
+
+# --------------------------------------------------------------------------- #
+# fp8 KV per-head dequant scale (ISSUE 9): pool rows store value/scale,
+# BOTH paged paths multiply back in-kernel — XLA walk vs Pallas kernel.
+# --------------------------------------------------------------------------- #
+
+
+def test_partials_fp8_kv_scale_parity():
+    """Per-head (k, v) scales: the Pallas kernel's in-register dequant must
+    match the XLA walk's fused cast+scale on a SCALED fp8 pool."""
+    B, H, K, D, MP, P = 2, 4, 2, 32, 3, 8
+    q = jax.random.normal(jax.random.key(30), (B, H, D))
+    k_pool, v_pool = _pool(jax.random.key(31), P, PAGE, K, D)
+    kv_scale = jnp.asarray([[2.0, 0.5], [1.5, 3.0]], jnp.float32)  # [2, K]
+    # Store value/scale like the engine's write path does.
+    k8 = (k_pool / kv_scale[0][None, None, :, None]).astype(jnp.float8_e4m3fn)
+    v8 = (v_pool / kv_scale[1][None, None, :, None]).astype(jnp.float8_e4m3fn)
+    table = _table(B, MP, P, seed=9)
+    limits = jnp.array([41, 26], jnp.int32)
+
+    want = _paged_cache_partials(q, k8, v8, table, limits, kv_scale=kv_scale)
+    got = paged_decode_partials(q, k8, v8, table, limits, kv_scale=kv_scale,
+                                interpret=True)
+    _assert_partials_close(got, want, tol=1e-3)
+    # And the mq (verify-chunk) variant.
+    T = 2
+    qm = jax.random.normal(jax.random.key(32), (B, T, H, D))
+    q_pos = limits[:, None] + jnp.arange(T)[None, :]
+    want = _paged_cache_partials_mq(qm, k8, v8, table, limits, q_pos=q_pos,
+                                    kv_scale=kv_scale)
+    got = paged_decode_partials_mq(qm, k8, v8, table, limits, q_pos=q_pos,
+                                   kv_scale=kv_scale, interpret=True)
+    _assert_partials_close(got, want, tol=1e-3)
+
+
+def test_kv_scale_recovers_clipped_fp8_range():
+    """The point of the scale: values past e4m3's ±448 clip without it and
+    survive with it."""
+    B, H, K, D, MP, P = 1, 2, 1, 32, 2, 4
+    q = jax.random.normal(jax.random.key(33), (B, H, D))
+    k_pool, v_pool = _pool(jax.random.key(34), P, PAGE, K, D)
+    v_pool = v_pool * 600.0  # past the e4m3 max
+    table = _table(B, MP, P, seed=10)
+    limits = jnp.array([24], jnp.int32)
+    want = _paged_cache_partials(q, k_pool, v_pool, table, limits)  # f32 truth
+
+    scale = jnp.asarray([[1.0], [16.0]], jnp.float32)
+    v8_scaled = (v_pool / scale[1][None, None, :, None]).astype(jnp.float8_e4m3fn)
+    v8_clip = v_pool.astype(jnp.float8_e4m3fn)
+    k8 = k_pool.astype(jnp.float8_e4m3fn)
+    acc_s, _, _ = paged_decode_partials(q, k8, v8_scaled, table, limits,
+                                        kv_scale=scale, interpret=True)
+    acc_c, _, _ = paged_decode_partials(q, k8, v8_clip, table, limits,
+                                        interpret=True)
+    ref = float(jnp.abs(want[0]).max())
+    err_scaled = float(jnp.abs(acc_s - want[0]).max())
+    err_clip = float(jnp.abs(acc_c - want[0]).max())
+    assert err_scaled < 0.15 * ref, (err_scaled, ref)
+    # Unscaled storage either saturates to e4m3's NaN or clips hard.
+    assert np.isnan(err_clip) or err_clip > 2 * err_scaled, (err_clip, err_scaled)
+
+
+def test_windowed_paged_kv_scale_end_to_end():
+    """decode_attention_windowed_paged with a scaled fp8 pool: pallas impl
+    == xla impl (the local window / current token stay model-dtype and are
+    merged outside the scale)."""
+    B, H, K, D, MP, P, n = 2, 4, 2, 32, 4, 10, 4
+    ks = jax.random.split(jax.random.key(35), 6)
+    q = jax.random.normal(ks[0], (B, H, D), jnp.bfloat16)
+    kv_scale = jnp.asarray([[2.0, 0.5], [1.5, 3.0]], jnp.float32)
+    k_f = jax.random.normal(ks[1], (P, PAGE, K, D))
+    v_f = jax.random.normal(ks[2], (P, PAGE, K, D))
+    k_pool = (k_f / kv_scale[0][None, None, :, None]).astype(jnp.float8_e4m3fn)
+    v_pool = (v_f / kv_scale[1][None, None, :, None]).astype(jnp.float8_e4m3fn)
+    k_local = jax.random.normal(ks[3], (B, n, K, D), jnp.bfloat16)
+    v_local = jax.random.normal(ks[4], (B, n, K, D), jnp.bfloat16)
+    k_new = jax.random.normal(ks[5], (B, K, D), jnp.bfloat16)
+    v_new = k_new * 0.5
+    table = _table(B, MP, P, seed=11)
+    step = jnp.int32(2)
+    positions = jnp.array([39, 18], jnp.int32)
+
+    outs = {}
+    for impl in ("xla", "pallas"):
+        outs[impl] = decode_attention_windowed_paged(
+            q, k_pool, v_pool, table, k_local, v_local, k_new, v_new,
+            positions, step, impl=impl, kv_scale=kv_scale,
+        )
+    diff = np.abs(np.asarray(outs["pallas"], np.float32)
+                  - np.asarray(outs["xla"], np.float32))
+    assert diff.max() < 2e-2, diff.max()
+
+
+def test_engine_fp8_kv_scale_paged_pallas_matches_xla():
+    """End-to-end: a paged fp8 engine with kv_scale=2.0 — write paths store
+    value/scale, both attention kernels dequantize in-kernel — decodes the
+    same greedy tokens under pallas and xla paged kernels."""
+    from localai_tpu.engine.engine import Engine, EngineConfig
+    from localai_tpu.engine.tokenizer import ByteTokenizer
+    from localai_tpu.models import get_arch
+    from localai_tpu.models.llama import init_params
+
+    cfg = get_arch("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    prompt = list(range(1, 20))
+    texts = {}
+    for impl in ("xla", "pallas"):
+        eng = Engine(
+            cfg, params, ByteTokenizer(cfg.vocab_size),
+            engine_cfg=EngineConfig(
+                max_slots=2, max_seq=256, kv_pages=6, kv_page_size=64,
+                paged_kernel=impl, kv_cache_dtype="fp8", kv_scale=2.0,
+            ),
+        )
+        try:
+            text, ev = eng.generate(prompt, max_new_tokens=8, ignore_eos=True)
+            assert ev.kind == "done"
+            texts[impl] = text
+        finally:
+            eng.stop()
+    assert texts["pallas"] == texts["xla"]
+
+
+def test_engine_kv_scale_validation():
+    from localai_tpu.engine.engine import Engine, EngineConfig
+    from localai_tpu.engine.tokenizer import ByteTokenizer
+    from localai_tpu.models import get_arch
+    from localai_tpu.models.llama import init_params
+
+    cfg = get_arch("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    tok = ByteTokenizer(cfg.vocab_size)
+    # Scale without an fp8 paged pool is a config error, not a silent no-op.
+    with pytest.raises(ValueError):
+        Engine(cfg, params, tok,
+               engine_cfg=EngineConfig(max_slots=1, max_seq=64, kv_scale=2.0))
+    with pytest.raises(ValueError):
+        Engine(cfg, params, tok,
+               engine_cfg=EngineConfig(max_slots=1, max_seq=64, kv_pages=4,
+                                       kv_page_size=32, kv_scale=2.0))
+    with pytest.raises(ValueError):
+        Engine(cfg, params, tok,
+               engine_cfg=EngineConfig(max_slots=1, max_seq=64,
+                                       kv_cache_dtype="fp8", kv_scale=-1.0))
+
+
+def test_mla_paged_decode_numerics_tiny_mla():
+    """MLA paged decode on the tiny-mla (DeepSeek-V3-shaped) config: the
+    latent pool walks the same paged kernels (K=1 pseudo-head) — Pallas ==
+    XLA greedy tokens (the dense engine agrees too; verified out-of-band,
+    left out of tier-1 for the extra compile it costs)."""
+    from localai_tpu.engine.engine import Engine, EngineConfig
+    from localai_tpu.engine.tokenizer import ByteTokenizer
+    from localai_tpu.models import get_arch
+    from localai_tpu.models.llama import init_params
+
+    cfg = get_arch("tiny-mla")
+    params = init_params(cfg, jax.random.key(0))
+    prompt = list(range(1, 24))
+    texts = {}
+    for name, ecfg in (
+        ("paged-xla", EngineConfig(max_slots=2, max_seq=256, kv_pages=8,
+                                   kv_page_size=32, paged_kernel="xla")),
+        ("paged-pallas", EngineConfig(max_slots=2, max_seq=256, kv_pages=8,
+                                      kv_page_size=32, paged_kernel="pallas")),
+    ):
+        eng = Engine(cfg, params, ByteTokenizer(cfg.vocab_size),
+                     engine_cfg=ecfg)
+        try:
+            text, ev = eng.generate(prompt, max_new_tokens=8, ignore_eos=True)
+            assert ev.kind == "done"
+            texts[name] = text
+        finally:
+            eng.stop()
+    assert texts["paged-pallas"] == texts["paged-xla"]
+
+
+@pytest.mark.slow
+def test_spec_decode_composes_with_fp8_kv_scale():
+    """Speculative decoding under a SCALED fp8 paged pool: the verify
+    chunk's paged partials and pool writes thread the per-head scale —
+    pallas == xla greedy tokens with a draft in the loop."""
+    from localai_tpu.engine.engine import Engine, EngineConfig
+    from localai_tpu.engine.tokenizer import ByteTokenizer
+    from localai_tpu.models import get_arch
+    from localai_tpu.models.llama import init_params
+
+    cfg = get_arch("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    dparams = init_params(cfg, jax.random.key(1))
+    prompt = list(range(1, 18))
+    texts = {}
+    for impl in ("xla", "pallas"):
+        eng = Engine(
+            cfg, params, ByteTokenizer(cfg.vocab_size),
+            draft_cfg=cfg, draft_params=dparams, n_draft=3,
+            engine_cfg=EngineConfig(
+                max_slots=2, max_seq=256, kv_pages=6, kv_page_size=64,
+                paged_kernel=impl, kv_cache_dtype="fp8", kv_scale=2.0,
+            ),
+        )
+        try:
+            text, ev = eng.generate(prompt, max_new_tokens=8, ignore_eos=True)
+            assert ev.kind == "done"
+            texts[impl] = text
+        finally:
+            eng.stop()
+    assert texts["pallas"] == texts["xla"]
