@@ -588,6 +588,136 @@ def main() -> None:
                     deng = None
         dparams = None
 
+    # Multi-tenant LoRA row (ISSUE 10, docs/LORA_SERVING.md): decode tok/s
+    # at `slots` slots × `slots` DISTINCT adapters (every decode row gathers
+    # its own rank factors through the ragged Pallas kernel) vs one shared
+    # adapter vs the adapter-less base on the same paged config — the
+    # tenancy tax in one ratio (target: mixed ≥ 0.9× single-adapter) —
+    # plus adapter_swap_in_ms (cold tenant: disk fetch + device promote +
+    # first admission) and an int8-base + LoRA composition variant (the
+    # delta runs bf16 beside the fused dequant matmul).
+    if os.environ.get("BENCH_LORA", "1") != "0" and max_seq % 128 == 0:
+        import shutil
+        import tempfile
+
+        lora_tmp = tempfile.mkdtemp(prefix="bench_lora_")
+        leng = None
+        try:
+            import numpy as np
+
+            from safetensors.numpy import save_file as _sf_save
+
+            lrank = int(os.environ.get("BENCH_LORA_RANK", "16"))
+            D = cfg.hidden_size
+            Hq = cfg.num_heads * cfg.head_dim_
+            Kv = cfg.num_kv_heads * cfg.head_dim_
+            lrng = np.random.default_rng(0)
+
+            def _mk_adapter(i: int) -> str:
+                path = os.path.join(lora_tmp, f"a{i}")
+                os.makedirs(path, exist_ok=True)
+                t = {}
+                for li in range(cfg.num_layers):
+                    for mod, od in (("self_attn.q_proj", Hq),
+                                    ("self_attn.v_proj", Kv)):
+                        pre = f"base_model.model.model.layers.{li}.{mod}"
+                        t[f"{pre}.lora_A.weight"] = lrng.normal(
+                            0, 0.01, (lrank, D)).astype(np.float32)
+                        t[f"{pre}.lora_B.weight"] = lrng.normal(
+                            0, 0.01, (od, lrank)).astype(np.float32)
+                _sf_save(t, os.path.join(path, "adapter_model.safetensors"))
+                with open(os.path.join(path, "adapter_config.json"), "w") as f:
+                    json.dump({"r": lrank, "lora_alpha": lrank}, f)
+                return path
+
+            adirs = [_mk_adapter(i) for i in range(slots + 1)]
+            page = 128
+            pool = max(2, int(slots * (max_seq // page) * 0.6))
+
+            def _lora_engine(qmode: str = ""):
+                e = Engine(
+                    cfg, params, ByteTokenizer(cfg.vocab_size),
+                    engine_cfg=EngineConfig(max_slots=slots, max_seq=max_seq,
+                                            kv_pages=pool, kv_page_size=page),
+                    quantization=qmode,
+                )
+                e.start()
+                e.warmup(prompt_len)
+                return e
+
+            def _measure(e, tenants: list) -> float:
+                e._decode_time = 0.0
+                e._decode_tokens = 0
+                ths = [threading.Thread(target=lambda i=i, ad=ad: e.generate(
+                    [(i * 37 + j) % 255 + 1 for j in range(prompt_len)],
+                    max_new_tokens=gen_len, ignore_eos=True, adapter=ad,
+                )) for i, ad in enumerate(tenants)]
+                for t in ths:
+                    t.start()
+                _join_or_die(ths, e, "lora row")
+                return (e._decode_tokens / e._decode_time
+                        if e._decode_time else 0.0)
+
+            leng = _lora_engine()
+            base_tps = _measure(leng, [None] * slots)
+            for i in range(slots):
+                leng.register_adapter(f"tenant{i}", adirs[i])
+            # Warm pass promotes every tenant + compiles the lora programs,
+            # so the measured passes price steady-state serving.
+            _measure(leng, [f"tenant{i}" for i in range(slots)])
+            multi_tps = _measure(leng, [f"tenant{i}" for i in range(slots)])
+            single_tps = _measure(leng, ["tenant0"] * slots)
+            # Cold-tenant swap-in: a registered-but-never-promoted adapter's
+            # first admission pays disk fetch + device promote; the same
+            # request warm prices the baseline.
+            leng.register_adapter("cold", adirs[slots])
+            cold_ids = [(7 + j) % 255 + 1 for j in range(prompt_len)]
+            t0 = time.time()
+            leng.generate(cold_ids, max_new_tokens=4, ignore_eos=True,
+                          adapter="cold")
+            cold_s = time.time() - t0
+            t0 = time.time()
+            leng.generate(cold_ids, max_new_tokens=4, ignore_eos=True,
+                          adapter="cold")
+            warm_s = time.time() - t0
+            out["lora_tps_base"] = round(base_tps, 2)
+            out["lora_tps_multi8"] = round(multi_tps, 2)
+            out["lora_tps_single"] = round(single_tps, 2)
+            out["lora_multi_vs_single"] = round(
+                multi_tps / max(single_tps, 1e-9), 3)
+            out["lora_multi_vs_base"] = round(
+                multi_tps / max(base_tps, 1e-9), 3)
+            out["adapter_swap_in_ms"] = round(
+                max(0.0, (cold_s - warm_s)) * 1e3, 1)
+            print(
+                f"lora: base {base_tps:.1f} tok/s, {slots}x distinct "
+                f"{multi_tps:.1f} ({out['lora_multi_vs_single']}x single "
+                f"{single_tps:.1f}), swap-in "
+                f"{out['adapter_swap_in_ms']} ms",
+                file=sys.stderr,
+            )
+            leng.stop()
+            leng.params = None
+            leng.cache = None
+            leng = _lora_engine("int8")
+            for i in range(slots):
+                leng.register_adapter(f"tenant{i}", adirs[i])
+            _measure(leng, [f"tenant{i}" for i in range(slots)])
+            q_tps = _measure(leng, [f"tenant{i}" for i in range(slots)])
+            out["lora_tps_multi8_int8"] = round(q_tps, 2)
+            print(f"lora int8 base + bf16 delta: {q_tps:.1f} tok/s",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — extra row is best-effort
+            print(f"BENCH_LORA row failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+        finally:
+            if leng is not None:
+                leng.stop()
+                leng.params = None
+                leng.cache = None
+                leng = None
+            shutil.rmtree(lora_tmp, ignore_errors=True)
+
     # Over-subscription row (ISSUE 3 on-demand KV growth): 2×slots requests
     # claim max_tokens near max_seq but produce SHORT real outputs (a stop
     # string learned from a probe run) on a pool sized so the old up-front

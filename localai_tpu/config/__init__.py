@@ -7,6 +7,7 @@ core/config/model_config.go:31-83, :520-538, application_config.go).
 """
 
 from localai_tpu.config.model_config import (  # noqa: F401
+    LoraConfigError,
     ModelConfig,
     ModelConfigLoader,
     Usecase,

@@ -49,6 +49,16 @@ class Usecase(enum.Flag):
 _NAME_RE = re.compile(r"^[a-zA-Z0-9_\-./:]+$")
 
 
+class LoraConfigError(ValueError):
+    """A LoRA serving configuration is self-contradictory (ISSUE 10,
+    docs/LORA_SERVING.md): merge-at-load `lora_adapters` and a runtime
+    `adapter` configured against the same base would apply the delta twice
+    (or silently disagree about quantization order), a virtual model is
+    missing its `base_model`/`adapter` half, or virtual models are nested.
+    Typed so the manager/API can 400 the one model instead of failing the
+    config load."""
+
+
 @dataclasses.dataclass
 class TemplateConfig:
     """Prompt template selection (reference: TemplateConfig model_config.go:250-278)."""
@@ -167,6 +177,26 @@ class ModelConfig:
     # `model` (absolute or under models_dir).
     lora_adapters: list = dataclasses.field(default_factory=list)
 
+    # Multi-tenant runtime LoRA (ISSUE 10, docs/LORA_SERVING.md). A config
+    # naming `base_model` + `adapter` is a VIRTUAL MODEL: it resolves to
+    # the base's ONE shared engine with the adapter registered as a tenant
+    # — the OpenAI `model` field then selects the tenant, and N virtual
+    # models cost one set of base weights instead of N engines. The
+    # adapter path resolves like `model`; the delta is applied UNMERGED
+    # in the decode/prefill programs (composes with a quantized base).
+    # Mutually exclusive with `lora_adapters` on the same config, and the
+    # BASE must not itself merge lora_adapters (LoraConfigError).
+    base_model: str = ""
+    adapter: str = ""
+    adapter_weight: float = 1.0
+    # Ragged per-slot LoRA delta kernel: auto | pallas | xla
+    # (docs/LORA_SERVING.md; LOCALAI_LORA_KERNEL env var overrides).
+    lora_kernel: str = "auto"
+    # Host-RAM byte budget for the adapter factor-image tier (LRU; lets
+    # registered adapters far exceed device residency).
+    # LOCALAI_ADAPTER_CACHE_BYTES env var overrides.
+    adapter_cache_bytes: int = 64 << 20
+
     # Weight-only quantization at load ("int8"; reference analogue:
     # quantized GGUF serving). Halves weight HBM traffic + footprint.
     quantization: str = ""
@@ -198,13 +228,26 @@ class ModelConfig:
     known_usecases: Optional[Usecase] = None  # explicit override
 
     def validate(self) -> None:
-        """Reject path traversal and malformed names (model_config.go:480-508)."""
+        """Reject path traversal and malformed names (model_config.go:480-508)
+        plus contradictory LoRA serving setups (ISSUE 10)."""
         if not self.name or not _NAME_RE.match(self.name):
             raise ValueError(f"invalid model name {self.name!r}")
-        for field in ("model", "tokenizer"):
+        for field in ("model", "tokenizer", "adapter", "base_model"):
             v = getattr(self, field)
             if ".." in v.split(os.sep):
                 raise ValueError(f"path traversal in {field}: {v!r}")
+        if self.base_model or self.adapter:
+            if not (self.base_model and self.adapter):
+                raise LoraConfigError(
+                    f"model {self.name!r}: a virtual model needs BOTH "
+                    "`base_model` and `adapter` (docs/LORA_SERVING.md)"
+                )
+            if self.lora_adapters:
+                raise LoraConfigError(
+                    f"model {self.name!r}: `lora_adapters` (merge-at-load) "
+                    "and a runtime `adapter` on the same config would apply "
+                    "a delta twice — pick ONE path (docs/LORA_SERVING.md)"
+                )
 
     def usecases(self) -> Usecase:
         """Endpoint routing (reference GuessUsecases, model_config.go:593-679)."""

@@ -9,6 +9,7 @@ backend/cpp/llama-cpp/grpc-server.cpp:679 PredictStream → slot queue).
 """
 
 from localai_tpu.engine.engine import (  # noqa: F401
+    AdapterError,
     Engine,
     EngineConfig,
     GenRequest,

@@ -48,7 +48,7 @@ import os
 import queue
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from functools import partial
 from typing import Any, Iterator, Optional, Sequence
 
@@ -86,6 +86,16 @@ class QueueFullError(RuntimeError):
         self.depth = depth
         self.limit = limit
         self.retry_after_s = retry_after_s
+
+
+class AdapterError(RuntimeError):
+    """A multi-tenant LoRA adapter operation failed (ISSUE 10,
+    docs/LORA_SERVING.md): unknown adapter name, a base the runtime path
+    cannot serve (MoE/MLA/speculative engines), or every device adapter
+    slot pinned by active requests. Typed so the HTTP layer and the
+    admission containment paths can fail ONE tenant's request cleanly
+    while the engine keeps serving everyone else."""
+
 
 _SAMPLING_FIELDS = (
     "temperature",
@@ -219,6 +229,25 @@ class EngineConfig:
     # kernels (per-head calibration can land without another plumbing
     # change). LOCALAI_KV_SCALE env var overrides.
     kv_scale: float = 1.0
+    # Ragged per-slot LoRA delta kernel (ISSUE 10, docs/LORA_SERVING.md):
+    # "auto" runs the Pallas segmented grouped matmul (ops/lora_matmul —
+    # per-slot adapter ids scalar-prefetched, factor blocks gathered out of
+    # the stacked HBM tensors by the double-buffered grid pipeline) for
+    # decode-shape deltas on TPU and the XLA gather path elsewhere;
+    # "pallas"/"xla" force one (pallas off-TPU runs in interpret mode —
+    # tests only). The XLA path is kept as the numeric oracle, same
+    # contract as paged_kernel/quant_kernel. LOCALAI_LORA_KERNEL env var
+    # overrides.
+    lora_kernel: str = "auto"
+    # Host-RAM byte budget for the adapter tier (ISSUE 10): fetched adapter
+    # factor images page through a bounded LRU exactly like the KV swap
+    # tier, so thousands of REGISTERED adapters far exceed what is
+    # device-resident (the stacked factors hold only the adapters active
+    # slots are using; unpinned rows evict LRU and re-fetch through this
+    # tier — or from disk on a tier miss). 0 disables host caching (every
+    # promote re-reads the adapter from disk).
+    # LOCALAI_ADAPTER_CACHE_BYTES env var overrides.
+    adapter_cache_bytes: int = 64 << 20
     # Tensor-parallel serving (ISSUE 7, docs/SHARDED_SERVING.md): shard the
     # weights (Megatron column/row splits, parallel/sharding.py), the KV
     # cache / paged pool (kv-head axis — pages live on the head shard that
@@ -335,6 +364,11 @@ class GenRequest:
     # cancelled and its slot/KV pages released. 0 = engine default
     # (EngineConfig.deadline_s), which may itself be 0 (no deadline).
     deadline_s: float = 0.0
+    # Multi-tenant LoRA (ISSUE 10): name of a registered runtime adapter
+    # (Engine.register_adapter) applied UNMERGED to this request — the
+    # OpenAI `model` field selects it through a virtual-model config
+    # (docs/LORA_SERVING.md). None = serve the shared base weights.
+    adapter: Optional[str] = None
     # INTERNAL — set by the engine when it preempts a slot (ISSUE 3).
     # Carries the victim's host-side continuation state (generated tokens,
     # RNG chain, swap image) so re-admission resumes the original stream
@@ -493,6 +527,8 @@ class Engine:
             "LOCALAI_TENSOR_PARALLEL": ("tensor_parallel", _parse_tp_env),
             "LOCALAI_QUANT_KERNEL": ("quant_kernel", str),
             "LOCALAI_KV_SCALE": ("kv_scale", float),
+            "LOCALAI_LORA_KERNEL": ("lora_kernel", str),
+            "LOCALAI_ADAPTER_CACHE_BYTES": ("adapter_cache_bytes", int),
         }.items():
             val = os.environ.get(env)
             if val is not None and val != "":
@@ -511,6 +547,12 @@ class Engine:
             raise ValueError(
                 f"quant_kernel={self.ecfg.quant_kernel!r}: use auto|pallas|xla"
             )
+        if self.ecfg.lora_kernel not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f"lora_kernel={self.ecfg.lora_kernel!r}: use auto|pallas|xla"
+            )
+        if self.ecfg.adapter_cache_bytes < 0:
+            raise ValueError("adapter_cache_bytes must be >= 0")
         if self.ecfg.kv_scale <= 0:
             raise ValueError("kv_scale must be > 0")
         if self.ecfg.kv_scale != 1.0 and not (
@@ -525,6 +567,10 @@ class Engine:
         # helper already receives (models/config.py quant_kernel).
         if self.ecfg.quant_kernel != cfg.quant_kernel:
             cfg = dataclasses.replace(cfg, quant_kernel=self.ecfg.quant_kernel)
+            self.cfg = cfg
+        # Same treatment for the ragged LoRA delta kernel (ISSUE 10).
+        if self.ecfg.lora_kernel != cfg.lora_kernel:
+            cfg = dataclasses.replace(cfg, lora_kernel=self.ecfg.lora_kernel)
             self.cfg = cfg
         if draft_cfg is not None and (
             self.ecfg.quant_kernel != draft_cfg.quant_kernel
@@ -885,6 +931,33 @@ class Engine:
         self.m_span_exports = 0
         self.m_span_imports = 0
         self.m_span_import_rejects = 0
+        # Multi-tenant LoRA serving (ISSUE 10, docs/LORA_SERVING.md).
+        # _adapter_registry (name -> {dir, weight}) is the only structure
+        # touched off the loop thread (register_adapter / submit) and is
+        # guarded by _adapter_lock. Everything else — the host-RAM factor-
+        # image LRU (_adapter_host, bounded by adapter_cache_bytes), the
+        # device row table (_adapter_rows / _adapter_refs / _adapter_last)
+        # and the stacked factor tree (_lora_tree: {key: {"a": [L, NA, in,
+        # R], "b": [L, NA, R, out]}}, row 0 = the all-zero null adapter) —
+        # is loop-thread-only, like the page allocator. A device row's
+        # refcount counts the ACTIVE slots decoding through it; eviction of
+        # a row with refs > 0 is forbidden (allocator-primitive discipline,
+        # _adapter_acquire/_adapter_unpin only), so a tenant's factors can
+        # never be swapped out from under a mid-flight request.
+        self._adapter_lock = threading.Lock()
+        self._adapter_registry: dict[str, dict] = {}
+        self._adapter_host: "OrderedDict[str, dict]" = OrderedDict()
+        self._adapter_host_bytes = 0
+        self._adapter_rows: list[Optional[str]] = []
+        self._adapter_refs = np.zeros((0,), np.int32)
+        self._adapter_last: list[float] = []
+        self._lora_tree: Optional[dict] = None
+        self._lora_keys: tuple = ()
+        self._lora_rank = 0
+        self.h_adapter = np.zeros((B,), np.int32)
+        self.m_adapter_fetches = 0
+        self.m_adapter_promotes = 0
+        self.m_adapter_evictions = 0
         self._build_programs()
 
     @property
@@ -1295,6 +1368,9 @@ class Engine:
         self.h_active[victim] = False
         self.h_override_mask[victim] = False
         self.h_gmask[victim] = 0.0
+        # The resume request still carries .adapter — re-admission re-pins
+        # it (possibly into a different row after churn).
+        self._slot_release_adapter(victim)
         self._pages_free(victim)
         with self._pending_lock:
             self._pending.appendleft((resume_req, handle))
@@ -1323,9 +1399,26 @@ class Engine:
         host image back, reinstall the slot's device rows — no prefill, no
         sampling; the slot resumes decoding exactly where it stopped."""
         rec = request.resume
+        row_a = 0
+        if request.adapter:
+            # Re-pin the tenant's adapter BEFORE pages: its factors may
+            # have been evicted while the slot sat swapped out. A failed
+            # re-pin consumes the request with a typed error event (the
+            # KV image is released) instead of stalling the queue head.
+            try:
+                row_a = self._adapter_acquire(request.adapter)
+            except Exception as e:  # noqa: BLE001 — fail one tenant only
+                log.exception("adapter re-pin failed on swap resume")
+                self._resume_discard(request)
+                handle._q.put(TokenEvent(
+                    kind="error", error=f"{type(e).__name__}: {e}"
+                ))
+                return True
         total = self._resume_swap_pages(request)
         row = self._pages_alloc(slot_idx, total)
         if row is None:
+            if row_a:
+                self._adapter_unpin(row_a)
             return False
         n_live = rec["hk"].shape[1]
         self._swap_in_pages(self._slot_pages[slot_idx][:n_live],
@@ -1365,6 +1458,7 @@ class Engine:
         self.h_active[slot_idx] = True
         self.h_override_mask[slot_idx] = False
         self.h_gmask[slot_idx] = 0.0
+        self.h_adapter[slot_idx] = row_a
         self._host_bytes -= rec["bytes"]
         self.m_kv_swap_bytes_in += rec["bytes"]
         self.m_kv_preempt_recover_ms += (
@@ -1411,6 +1505,246 @@ class Engine:
         )
 
     # ------------------------------------------------------------------ #
+    # Multi-tenant LoRA adapters (ISSUE 10, docs/LORA_SERVING.md)
+    # ------------------------------------------------------------------ #
+
+    def register_adapter(self, name: str, adapter_dir: str,
+                         weight: float = 1.0) -> None:
+        """Register a PEFT-format adapter as a servable tenant of this
+        engine. Registration is metadata-only (no disk I/O): the factor
+        image is fetched through the bounded host tier and promoted into
+        the stacked device factors lazily, at the first admission that
+        names it — thousands of registered adapters cost nothing until
+        they serve. Idempotent for an identical (dir, weight); re-binding
+        a name to a different source is an error (tenant identity must be
+        stable while requests may be in flight)."""
+        if self.draft_cfg is not None:
+            raise AdapterError(
+                "runtime LoRA adapters are not supported on speculative "
+                "engines — the draft model would decode without the delta"
+            )
+        if self.cfg.is_mla or self.cfg.is_moe:
+            raise AdapterError(
+                f"runtime LoRA adapters serve dense llama-family bases only "
+                f"({self.cfg.name} is {'MLA' if self.cfg.is_mla else 'MoE'}) "
+                "— merge at load via `lora_adapters` instead"
+            )
+        with self._adapter_lock:
+            prev = self._adapter_registry.get(name)
+            if prev is not None:
+                if prev["dir"] != adapter_dir or prev["weight"] != float(weight):
+                    raise AdapterError(
+                        f"adapter {name!r} is already registered from "
+                        f"{prev['dir']!r} (weight={prev['weight']}) — "
+                        "unregister/rename instead of rebinding"
+                    )
+                return
+            self._adapter_registry[name] = {
+                "dir": adapter_dir, "weight": float(weight),
+            }
+
+    def adapter_names(self) -> list[str]:
+        with self._adapter_lock:
+            return sorted(self._adapter_registry)
+
+    def _adapter_image(self, name: str, reg: dict) -> dict:
+        """Host-tier factor image for one adapter: {rank, stacks: {key:
+        (A [L, in, r], B [L, r, out]) f32}, bytes}. Hits promote within the
+        LRU; misses read the PEFT checkpoint from disk (faults site
+        `adapter_fetch`) and insert under the adapter_cache_bytes budget —
+        LRU entries evict to make room, and an image bigger than the whole
+        budget serves this promote but is not retained (loop thread
+        only)."""
+        entry = self._adapter_host.get(name)
+        if entry is not None:
+            self._adapter_host.move_to_end(name)
+            return entry
+        faults.fire("adapter_fetch")
+        from localai_tpu.engine.weights import load_lora_factors, lora_target_dims
+
+        rank, per_key = load_lora_factors(reg["dir"], reg["weight"], self.cfg)
+        dims = lora_target_dims(self.cfg)
+        L = self.cfg.num_layers
+        stacks: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+        nbytes = 0
+        for key, layers_d in per_key.items():
+            d_in, d_out = dims[key]
+            a = np.zeros((L, d_in, rank), np.float32)
+            b = np.zeros((L, rank, d_out), np.float32)
+            for li, (a_t, b_t) in layers_d.items():
+                r = a_t.shape[1]
+                a[li, :, :r] = a_t
+                b[li, :r, :] = b_t
+            stacks[key] = (a, b)
+            nbytes += a.nbytes + b.nbytes
+        entry = {"rank": rank, "stacks": stacks, "bytes": nbytes}
+        self._adapter_host[name] = entry
+        self._adapter_host_bytes += nbytes
+        self.m_adapter_fetches += 1
+        budget = self.ecfg.adapter_cache_bytes
+        while self._adapter_host_bytes > budget and len(self._adapter_host) > 1:
+            victim = next(iter(self._adapter_host))
+            if victim == name:
+                self._adapter_host.move_to_end(name, last=False)
+                victim = next(iter(self._adapter_host))
+                if victim == name:
+                    break
+            self._adapter_host_bytes -= self._adapter_host.pop(victim)["bytes"]
+        if self._adapter_host_bytes > budget:
+            # The image alone exceeds the budget: serve it, don't retain it.
+            self._adapter_host_bytes -= self._adapter_host.pop(name)["bytes"]
+        return entry
+
+    def _lora_rebuild(self, keys: tuple, na: int, rank: int) -> None:
+        """(Re)allocate the stacked device factor tree at (keys, na, rank),
+        copying every resident adapter's rows from the old tree. Row 0 is
+        the all-zero null adapter. Shapes are static program inputs, so a
+        rebuild retraces the lora-enabled programs — growth doubles (capped
+        at max_slots + 1 rows: every slot a distinct tenant) to keep
+        rebuilds logarithmic. tp>1 places A/B with the factor partitioning
+        mirroring the base weight's role (ops/lora_matmul)."""
+        from localai_tpu.engine.weights import lora_target_dims
+        from localai_tpu.ops.lora_matmul import LORA_PART, lora_factor_specs
+
+        dims = lora_target_dims(self.cfg)
+        dt = jnp.dtype(self.cfg.dtype)
+        L = self.cfg.num_layers
+        old = self._lora_tree or {}
+        new_tree: dict = {}
+        with self.mesh:
+            for key in keys:
+                d_in, d_out = dims[key]
+                a = jnp.zeros((L, na, d_in, rank), dt)
+                b = jnp.zeros((L, na, rank, d_out), dt)
+                o = old.get(key)
+                if o is not None:
+                    ona, orank = o["a"].shape[1], o["a"].shape[3]
+                    a = a.at[:, :ona, :, :orank].set(o["a"])
+                    b = b.at[:, :ona, :orank, :].set(o["b"])
+                if self.plan.total > 1:
+                    from jax.sharding import NamedSharding
+
+                    specs = lora_factor_specs(LORA_PART[key])
+                    a = jax.device_put(a, NamedSharding(self.mesh, specs["a"]))
+                    b = jax.device_put(b, NamedSharding(self.mesh, specs["b"]))
+                new_tree[key] = {"a": a, "b": b}
+        self._lora_tree = new_tree
+        self._lora_keys = keys
+        self._lora_rank = rank
+        while len(self._adapter_rows) < na:
+            self._adapter_rows.append(None)
+            self._adapter_last.append(0.0)
+        if len(self._adapter_refs) < na:
+            refs = np.zeros((na,), np.int32)
+            refs[: len(self._adapter_refs)] = self._adapter_refs
+            self._adapter_refs = refs
+
+    def _lora_write_row(self, row: int, image: dict) -> None:
+        """Install one host factor image into device row `row` (every
+        target key: absent keys write zeros so a recycled row never leaks
+        the previous tenant's factors)."""
+        from localai_tpu.engine.weights import lora_target_dims
+
+        dims = lora_target_dims(self.cfg)
+        dt = jnp.dtype(self.cfg.dtype)
+        L = self.cfg.num_layers
+        rank = self._lora_rank
+        for key in self._lora_keys:
+            d_in, d_out = dims[key]
+            st = image["stacks"].get(key)
+            if st is None:
+                a_np = np.zeros((L, d_in, rank), np.float32)
+                b_np = np.zeros((L, rank, d_out), np.float32)
+            else:
+                a_np, b_np = st
+                r = a_np.shape[-1]
+                if r < rank:
+                    a_np = np.pad(a_np, ((0, 0), (0, 0), (0, rank - r)))
+                    b_np = np.pad(b_np, ((0, 0), (0, rank - r), (0, 0)))
+            ent = self._lora_tree[key]
+            ent["a"] = ent["a"].at[:, row].set(jnp.asarray(a_np, dt))
+            ent["b"] = ent["b"].at[:, row].set(jnp.asarray(b_np, dt))
+
+    def _adapter_acquire(self, name: str) -> int:
+        """Pin `name` into a device adapter row and return the row id
+        (allocator primitive — the ONLY place a row is claimed; loop thread
+        only). Resident adapters just bump their refcount; otherwise the
+        factor image is fetched through the host tier and promoted into a
+        free row, a grown row, or the LRU UNPINNED row — a row with live
+        references is never evicted, so mid-flight tenants keep their
+        factors until _adapter_unpin drops the last ref."""
+        with self._adapter_lock:
+            reg = self._adapter_registry.get(name)
+        if reg is None:
+            raise AdapterError(
+                f"unknown adapter {name!r} — register_adapter() first"
+            )
+        if name in self._adapter_rows:
+            row = self._adapter_rows.index(name)
+        else:
+            image = self._adapter_image(name, reg)
+            faults.fire("adapter_fetch")
+            keys = tuple(sorted(set(self._lora_keys) | set(image["stacks"])))
+            rank = max(self._lora_rank, image["rank"], 1)
+            cap = self.ecfg.max_slots + 1
+            na = len(self._adapter_rows)
+            row = next(
+                (i for i in range(1, na) if self._adapter_rows[i] is None),
+                None,
+            )
+            if row is None and na < cap:
+                row = max(1, na)
+                na = min(cap, max(2, na * 2))
+            if row is None:
+                cands = [
+                    i for i in range(1, na)
+                    if self._adapter_rows[i] is not None
+                    and self._adapter_refs[i] == 0
+                ]
+                if cands:
+                    row = min(cands, key=lambda i: self._adapter_last[i])
+                    self._adapter_rows[row] = None
+                    self.m_adapter_evictions += 1
+            if row is None:
+                raise AdapterError(
+                    "every device adapter slot is pinned by an active "
+                    "request — retry when traffic drains or raise max_slots"
+                )
+            if (keys != self._lora_keys or rank != self._lora_rank
+                    or na != len(self._adapter_rows)):
+                self._lora_rebuild(keys, na, rank)
+            self._lora_write_row(row, image)
+            self._adapter_rows[row] = name
+            self.m_adapter_promotes += 1
+        self._adapter_refs[row] += 1
+        self._adapter_last[row] = time.monotonic()
+        return row
+
+    def _adapter_unpin(self, row: int) -> None:
+        """Drop one reference on a device adapter row (allocator primitive
+        — the only decrement; loop thread only). Underflow clamps and logs
+        like _pages_release (LOCALAI_ALLOC_DEBUG=1 raises)."""
+        if row <= 0 or row >= len(self._adapter_refs):
+            return
+        v = int(self._adapter_refs[row])
+        if v <= 0:
+            msg = f"adapter refcount underflow at device row {row}"
+            if os.environ.get("LOCALAI_ALLOC_DEBUG", "0") == "1":
+                raise AssertionError(msg)
+            log.warning("%s — clamped", msg)
+            self._adapter_refs[row] = 0
+            return
+        self._adapter_refs[row] = v - 1
+
+    def _slot_release_adapter(self, slot_idx: int) -> None:
+        """Unpin a slot's adapter row on any teardown path (finish, cancel,
+        preempt, loop death release runs its own bulk reset)."""
+        row = int(self.h_adapter[slot_idx])
+        if row:
+            self.h_adapter[slot_idx] = 0
+            self._adapter_unpin(row)
+
+    # ------------------------------------------------------------------ #
     # Compiled programs
     # ------------------------------------------------------------------ #
 
@@ -1446,7 +1780,8 @@ class Engine:
         self._score_fn = _score
 
     def _get_block(self, variant: str, n: int, with_lp: bool = False,
-                   with_dfa: bool = False, kv_win: Optional[int] = None):
+                   with_dfa: bool = False, kv_win: Optional[int] = None,
+                   with_lora: bool = False):
         """Fused n-step decode block program for one sampling variant.
 
         variant: "greedy" | "simple" | "filtered" | "grammar".
@@ -1475,7 +1810,7 @@ class Engine:
         picks the smallest bucket covering every active slot's position;
         writes still target the full cache, so this is read-side only.
         """
-        key = (variant, n, with_lp, with_dfa, kv_win)
+        key = (variant, n, with_lp, with_dfa, kv_win, with_lora)
         fn = self._block_cache.get(key)
         if fn is not None:
             return fn
@@ -1491,7 +1826,7 @@ class Engine:
 
         def block(params, cache, counts, rngs, bias, tokens, positions, pack,
                   rope_delta=None, ptable=None, mask_bits=None, gtrans=None,
-                  tok_cls=None, gstate=None):
+                  tok_cls=None, gstate=None, lora=None):
             active = pack[0] > 0
             samp = SamplingParams(
                 temperature=pack[1], top_k=pack[2].astype(jnp.int32),
@@ -1550,12 +1885,13 @@ class Engine:
                         paged_impl=self.ecfg.paged_kernel,
                         kv_scale=self._kv_scales,
                         rope_delta=rope_delta, mesh=self._op_mesh,
+                        lora=lora,
                     )
                 else:
                     logits, lk, lv = llama.decode_step_windowed(
                         cfg, params, tokens, positions, read_cache, lk, lv, step,
                         ep=self.plan.ep, mesh=self._op_mesh,
-                        rope_delta=rope_delta,
+                        rope_delta=rope_delta, lora=lora,
                     )
                 split = jax.vmap(lambda k: jax.random.split(k, 2))(rngs)
                 rngs, draw = split[:, 0], split[:, 1]
@@ -1617,7 +1953,8 @@ class Engine:
             return out
 
         # Positional wrapper: [8 base] [rope_delta?] [ptable?] [dfa: mask,
-        # trans, cls, gstate] — mirrors _dispatch_block's argument assembly.
+        # trans, cls, gstate] [lora: stacks, ids] — mirrors
+        # _dispatch_block's argument assembly.
         def wrapped(*args):
             i = 8
             rope_delta = None
@@ -1631,9 +1968,11 @@ class Engine:
             mask_bits = gtrans = tok_cls = gstate = None
             if with_dfa:
                 mask_bits, gtrans, tok_cls, gstate = args[i: i + 4]
+                i += 4
+            lora = (args[i], args[i + 1]) if with_lora else None
             return block(*args[:8], rope_delta=rope_delta, ptable=ptable,
                          mask_bits=mask_bits, gtrans=gtrans, tok_cls=tok_cls,
-                         gstate=gstate)
+                         gstate=gstate, lora=lora)
 
         donate = (1, 2, 3, 5, 6)
         if with_dfa:
@@ -1644,7 +1983,8 @@ class Engine:
 
     def _get_admit(self, m: int, bucket: int, has_bias: bool, with_topk: bool,
                    with_lp: bool = False, n_img: int = 0,
-                   with_dfa: bool = False, with_mrope: bool = False):
+                   with_dfa: bool = False, with_mrope: bool = False,
+                   with_lora: bool = False):
         """Fused admission program: prefill M prompts, write their KV/state
         into their slots, and sample each first token — one dispatch.
 
@@ -1663,7 +2003,7 @@ class Engine:
         with no host round-trip.
         """
         key = (m, bucket, has_bias, with_topk, with_lp, n_img, with_dfa,
-               with_mrope)
+               with_mrope, with_lora)
         fn = self._admit_cache.get(key)
         if fn is not None:
             return fn
@@ -1680,7 +2020,8 @@ class Engine:
         def admit(params, cache, counts, rngs, bias, d_tokens, d_positions,
                   prompt_toks, aux, samp_pack, bias_rows, img_embeds=None,
                   img_offsets=None, mrope_pos=None, gmask0=None, gtrans=None,
-                  tok_cls=None, ginit=None, d_gstate=None, ptable=None):
+                  tok_cls=None, ginit=None, d_gstate=None, ptable=None,
+                  lora=None):
             lens, slot_ids, seeds = aux[0], aux[1], aux[2]
             samp = SamplingParams(
                 temperature=samp_pack[0], top_k=samp_pack[1].astype(jnp.int32),
@@ -1690,7 +2031,7 @@ class Engine:
             inject = (img_embeds, img_offsets) if img_embeds is not None else None
             logits, ks, vs = llama.prefill(
                 cfg, params, prompt_toks, lens, mesh=self._op_mesh,
-                inject=inject, ep=self.plan.ep, mrope=mrope_pos,
+                inject=inject, ep=self.plan.ep, mrope=mrope_pos, lora=lora,
             )
             valid = (jnp.arange(bucket)[None, :] < lens[:, None]).astype(jnp.int32)
             rows = jnp.zeros((m, V), jnp.int32)
@@ -1739,7 +2080,7 @@ class Engine:
         paged = self._paged
         if self.draft_cfg is None:
             # Uniform positional wrapper: [7 state] [d_gstate?] [4 request]
-            # [img 2?] [mrope?] [dfa 4?] [ptable?] — mirrors
+            # [img 2?] [mrope?] [dfa 4?] [ptable?] [lora 2?] — mirrors
             # _dispatch_admit's arg assembly so every flag combination
             # shares one code path.
             def wrapped(*args):
@@ -1763,14 +2104,18 @@ class Engine:
                 if with_dfa:
                     gmask0, gtrans, tok_cls, ginit = args[i: i + 4]
                     i += 4
-                ptable = args[i] if paged else None
+                ptable = None
+                if paged:
+                    ptable = args[i]
+                    i += 1
+                lora = (args[i], args[i + 1]) if with_lora else None
                 return admit(params, cache, counts, rngs, bias, d_tokens,
                              d_positions, prompt_toks, aux, samp_pack,
                              bias_rows, img_embeds=img_embeds,
                              img_offsets=img_offsets, mrope_pos=mrope_pos,
                              gmask0=gmask0,
                              gtrans=gtrans, tok_cls=tok_cls, ginit=ginit,
-                             d_gstate=d_gstate, ptable=ptable)
+                             d_gstate=d_gstate, ptable=ptable, lora=lora)
 
             donate = (1, 2, 3, 4, 5, 6) + ((7,) if with_dfa else ())
             fn = jax.jit(wrapped, donate_argnums=donate)
@@ -2133,6 +2478,11 @@ class Engine:
         if not C or len(request.prompt_ids) - match_len <= C:
             return False
         if request.image_embeds is not None or request.mrope_positions is not None:
+            return False
+        if request.adapter is not None:
+            # Adapter prompts admit single-shot: the chunk mid/final
+            # programs carry no per-slot lora operand (ISSUE 10 keeps the
+            # runtime-LoRA surface to admission + decode blocks).
             return False
         if self.draft_cfg is not None and (
             request.grammar is not None or request.logprobs > 0
@@ -2615,7 +2965,11 @@ class Engine:
         cached variant — they must be decided at PLANNING time (treated as
         misses) so the paged planner budgets FULL pages; deciding at
         dispatch would leave a tail-only budget for a full admission
-        (pool-gate break / requeue livelock)."""
+        (pool-gate break / requeue livelock). Adapter requests never use
+        the prefix cache in either direction — their wk/wv deltas make the
+        cached K/V rows tenant-specific (ISSUE 10)."""
+        if request.adapter is not None:
+            return False
         if self.draft_cfg is None:
             return True
         return request.grammar is None and request.logprobs <= 0
@@ -3467,6 +3821,21 @@ class Engine:
                 raise ValueError(
                     f"mrope_positions shape {p3.shape} != (3, prompt_len)"
                 )
+        if request.adapter is not None:
+            # Fail fast on tenant-identity errors; the actual fetch/promote
+            # happens at admission on the loop thread (and may still fail
+            # with an error event — disk, faults, pinned rows).
+            if self.draft_cfg is not None:
+                raise AdapterError(
+                    "adapter requests are not supported with a draft model"
+                )
+            with self._adapter_lock:
+                known = request.adapter in self._adapter_registry
+            if not known:
+                raise AdapterError(
+                    f"unknown adapter {request.adapter!r} — "
+                    "register_adapter() first"
+                )
         if request.grammar is not None and self._tok_strs is None:
             self._token_str(0)  # build the table here, not in the engine loop
         handle = RequestHandle()
@@ -3630,6 +3999,19 @@ class Engine:
             out["span_exports"] = float(self.m_span_exports)
             out["span_imports"] = float(self.m_span_imports)
             out["span_import_rejects"] = float(self.m_span_import_rejects)
+        with self._adapter_lock:
+            n_adapters = len(self._adapter_registry)
+        if n_adapters or self._lora_tree is not None:
+            # Multi-tenant LoRA (ISSUE 10): registry size, device residency
+            # and the host-tier footprint per tenant churn.
+            out["adapters_registered"] = float(n_adapters)
+            out["adapter_device_resident"] = float(
+                sum(1 for nm in self._adapter_rows if nm is not None)
+            )
+            out["adapter_host_bytes"] = float(self._adapter_host_bytes)
+            out["adapter_fetches"] = float(self.m_adapter_fetches)
+            out["adapter_promotes"] = float(self.m_adapter_promotes)
+            out["adapter_evictions"] = float(self.m_adapter_evictions)
         out["peak_active_slots"] = float(self.m_peak_active)
         if self.ecfg.prefill_chunk:
             out["prefill_chunks"] = float(self.m_prefill_chunks)
@@ -4057,8 +4439,14 @@ class Engine:
             self.h_active[i] = False
             self.h_override_mask[i] = False
             self.h_gmask[i] = 0.0
+            self.h_adapter[i] = 0
             if self._paged and self._slot_pages[i]:
                 self._pages_free(i)
+        # No slot references an adapter row anymore; zero the pins so the
+        # device rows are evictable (the registry and host tier survive —
+        # a reloaded engine starts cold on factors, not on metadata).
+        if len(self._adapter_refs):
+            self._adapter_refs[:] = 0
         if self._paged:
             # Prefix spans hold pool-page references; the reloaded engine
             # starts cold anyway.
@@ -4382,7 +4770,10 @@ class Engine:
 
             def _special(r: GenRequest) -> bool:
                 if (bool(r.logit_bias) or r.grammar is not None
-                        or r.logprobs > 0 or r.image_embeds is not None):
+                        or r.logprobs > 0 or r.image_embeds is not None
+                        or r.adapter is not None):
+                    # Adapter requests admit as singletons so a fetch/
+                    # promote failure fails exactly one tenant's request.
                     return True
                 # One LCP scan per request per round; hits are handed to
                 # _dispatch_admit rather than re-searched there. A memoized
@@ -4482,6 +4873,23 @@ class Engine:
                     self._wake.set()
                     return
         t0 = time.monotonic()
+        # Multi-tenant LoRA (ISSUE 10): pin each request's adapter into a
+        # device row BEFORE anything else is claimed — a fetch/promote
+        # failure (disk error, injected adapter_fetch fault, all rows
+        # pinned) then fails just this chunk (adapter requests admit as
+        # singletons via _special) with nothing to unwind.
+        adapter_rows = [0] * m
+        acquired_rows: list[int] = []
+        try:
+            for j, (r, _h) in enumerate(chunk):
+                if r.adapter:
+                    row = self._adapter_acquire(r.adapter)
+                    adapter_rows[j] = row
+                    acquired_rows.append(row)
+        except Exception:
+            for row in acquired_rows:
+                self._adapter_unpin(row)
+            raise
         prompt_toks = np.zeros((m, bucket), np.int32)
         aux = np.zeros((3, m), np.int32)  # lens, slot ids, seeds
         aux[1] = np.asarray(slot_ids, np.int32)
@@ -4519,11 +4927,16 @@ class Engine:
         if m == 1 and chunk[0][0].image_embeds is not None:
             n_img = int(np.asarray(chunk[0][0].image_embeds).shape[0])
         with_mrope = (m == 1 and chunk[0][0].mrope_positions is not None)
+        # Once any adapter is device-resident EVERY admission runs the
+        # lora-enabled program (id 0 rows ride the exact-zero null adapter)
+        # so mixed-tenant and adapter-less admissions share one compile.
+        with_lora = self._lora_tree is not None
         trace = os.environ.get("LOCALAI_ENGINE_TRACE", "0") == "1"
         t_a = time.monotonic()
         with_dfa = self._dfa_mode_of(dfa_tables)
         fn = self._get_admit(m, bucket, has_bias, with_topk, with_lp, n_img,
-                             with_dfa=with_dfa, with_mrope=with_mrope)
+                             with_dfa=with_dfa, with_mrope=with_mrope,
+                             with_lora=with_lora)
         t_b = time.monotonic()
         args_in = (
             jnp.asarray(prompt_toks), jnp.asarray(aux), jnp.asarray(samp_pack),
@@ -4570,6 +4983,8 @@ class Engine:
                     # backpressure) instead of killing the engine loop.
                     for s in allocated_slots:
                         self._pages_free(s)
+                    for row in acquired_rows:
+                        self._adapter_unpin(row)
                     with self._pending_lock:
                         for item in reversed(chunk):
                             self._pending.appendleft(item)
@@ -4578,6 +4993,10 @@ class Engine:
                 allocated_slots.append(slot_ids[j])
                 rows_tbl[j] = prow
             args_in = args_in + (jnp.asarray(rows_tbl),)
+        if with_lora:
+            args_in = args_in + (
+                self._lora_tree, jnp.asarray(adapter_rows, dtype=jnp.int32),
+            )
         t_c = time.monotonic()
         try:
             if self.draft_cfg is None:
@@ -4597,9 +5016,11 @@ class Engine:
                     out = fn(*pre, *args_in)
         except Exception:
             # Slots were never claimed, so _release won't run — return the
-            # reserved pages before surfacing the error.
+            # reserved pages and adapter pins before surfacing the error.
             for s in allocated_slots:
                 self._pages_free(s)
+            for row in acquired_rows:
+                self._adapter_unpin(row)
             raise
         (
             self.cache, self.counts, self.rngs, self.bias,
@@ -4637,8 +5058,12 @@ class Engine:
             self.h_active[slot_idx] = True
             self.h_override_mask[slot_idx] = False
             self.h_gmask[slot_idx] = 1.0 if with_dfa else 0.0
+            self.h_adapter[slot_idx] = adapter_rows[j]
             items.append((slot_idx, r, handle, int(aux[0, j]), t0))
-            if r.image_embeds is None:
+            if r.image_embeds is None and r.adapter is None:
+                # Adapter slots never feed the prefix cache: their K/V rows
+                # are tenant-specific (wk/wv deltas), so a token-keyed span
+                # would leak one tenant's KV into another's admission.
                 self._prefix_save(slot_idx, r.prompt_ids, int(aux[0, j]))
         self._track(
             _Entry(kind="admit", toks=toks, tk=tk, lp=lp, gen=list(self._slot_gen), items=items)
@@ -4765,7 +5190,8 @@ class Engine:
         pack[9] = self.h_override_mask
         if with_dfa:
             pack[10] = self.h_gmask
-        fn = self._get_block(variant, n, with_lp, with_dfa, kv_win)
+        with_lora = self._lora_tree is not None
+        fn = self._get_block(variant, n, with_lp, with_dfa, kv_win, with_lora)
         args = (
             self.params, self.cache, self.counts, self.rngs, self.bias,
             self.d_tokens, self.d_positions, jnp.asarray(pack),
@@ -4774,19 +5200,22 @@ class Engine:
             args = args + (jnp.asarray(self.h_rope_delta),)
         if self._paged:
             args = args + (jnp.asarray(self.h_ptable),)
+        lora_args = (
+            (self._lora_tree, jnp.asarray(self.h_adapter)) if with_lora else ()
+        )
         if with_dfa:
             d = self._dfa
             (
                 self.cache, self.counts, self.rngs, self.d_tokens,
                 self.d_positions, toks_block, tk_block, lp_block, self.d_gstate,
             ) = fn(*args, d["mask_bits"], self._dfa_table(d, with_dfa),
-                   d["tok_cls"], self.d_gstate)
+                   d["tok_cls"], self.d_gstate, *lora_args)
             self.m_dfa_tokens += n * int((self.h_gmask * active_snapshot).sum())
         else:
             (
                 self.cache, self.counts, self.rngs, self.d_tokens, self.d_positions,
                 toks_block, tk_block, lp_block,
-            ) = fn(*args)
+            ) = fn(*args, *lora_args)
         _host_copy_async(toks_block)
         if tk_block is not None:
             _host_copy_async(tk_block)
@@ -5145,7 +5574,8 @@ class Engine:
     def _finish(self, slot_idx: int, reason: str) -> None:
         slot = self.slots[slot_idx]
         assert slot is not None
-        if self._prefix_enabled and slot.request.image_embeds is None:
+        if (self._prefix_enabled and slot.request.image_embeds is None
+                and slot.request.adapter is None):
             # Rows for prompt + all but the last generated token are
             # guaranteed written (a token's KV row lands when it is consumed
             # as the next step's input).
@@ -5177,5 +5607,6 @@ class Engine:
         self.h_active[slot_idx] = False
         self.h_override_mask[slot_idx] = False
         self.h_gmask[slot_idx] = 0.0
+        self._slot_release_adapter(slot_idx)
         if self._paged:
             self._pages_free(slot_idx)

@@ -690,6 +690,146 @@ def load_lora_deltas(
     return per_key
 
 
+def lora_target_dims(cfg: ArchConfig) -> dict[str, tuple[int, int]]:
+    """(in, out) of every runtime-servable LoRA target projection, derived
+    from the architecture (the engine's param leaves may be quantized dicts
+    whose shapes no longer spell the matmul dims)."""
+    D, F = cfg.hidden_size, cfg.intermediate_size
+    H = cfg.num_heads * cfg.head_dim_
+    K = cfg.num_kv_heads * cfg.head_dim_
+    return {
+        "wq": (D, H), "wk": (D, K), "wv": (D, K), "wo": (H, D),
+        "w_gate": (D, F), "w_up": (D, F), "w_down": (F, D),
+    }
+
+
+def load_lora_factors(
+    adapter_dir: str, weight: float = 1.0, cfg: ArchConfig | None = None
+) -> tuple[int, dict[str, dict[int, tuple[np.ndarray, np.ndarray]]]]:
+    """Read a PEFT-format adapter into UNMERGED per-layer rank factors for
+    runtime multi-tenant serving (ISSUE 10, docs/LORA_SERVING.md).
+
+    Returns (rank, {our_key: {layer: (A [in, r] f32, B [r, out] f32)}})
+    with weight·(alpha/r) folded into B, so the served delta is exactly the
+    B·(A·x) the merge path would have added — byte-layout aside, the same
+    math as load_lora_deltas, kept factorized. Fused phi-3 targets
+    (`qkv_proj`, `gate_up_proj`) split by B's output columns (A is shared).
+    MoE expert targets are rejected — the runtime path serves the dense
+    llama-family projections only; merge those at load instead."""
+    import re
+
+    from safetensors import safe_open
+
+    with open(os.path.join(adapter_dir, "adapter_config.json")) as f:
+        acfg = json.load(f)
+    r_cfg = int(acfg.get("r", 8))
+    alpha = float(acfg.get("lora_alpha", r_cfg))
+    scale = weight * alpha / max(r_cfg, 1)
+
+    path = os.path.join(adapter_dir, "adapter_model.safetensors")
+    tensors: dict[str, np.ndarray] = {}
+    with safe_open(path, framework="numpy") as f:
+        for name in f.keys():
+            tensors[name] = np.asarray(f.get_tensor(name), np.float32)
+
+    pat = re.compile(r"layers\.(\d+)\.(.+)\.lora_A\.weight$")
+    expert_pat = re.compile(r"experts\.(\d+)\.(w[123])$")
+    per_key: dict[str, dict[int, tuple[np.ndarray, np.ndarray]]] = {}
+    rank = 0
+
+    def add(our: str, layer: int, a_t: np.ndarray, b_t: np.ndarray) -> None:
+        # A [in, r] (PEFT stores [r, in]); B [r, out] with the scale folded.
+        nonlocal rank
+        tgt = per_key.setdefault(our, {})
+        if layer in tgt:
+            raise ValueError(
+                f"lora adapter {adapter_dir!r}: duplicate runtime target "
+                f"{our!r} layer {layer}"
+            )
+        tgt[layer] = (np.ascontiguousarray(a_t), np.ascontiguousarray(b_t))
+        rank = max(rank, a_t.shape[1])
+
+    unmatched: list[str] = []
+    for name, a in tensors.items():
+        if not name.endswith("lora_A.weight"):
+            continue
+        m = pat.search(name)
+        if m is None:
+            if not any(tag in name for tag in _LORA_IGNORED):
+                unmatched.append(name)
+            continue
+        layer, module = int(m.group(1)), m.group(2)
+        b = tensors.get(name[: -len("lora_A.weight")] + "lora_B.weight")
+        if b is None:
+            unmatched.append(f"{module} (no lora_B)")
+            continue
+        short = module.split(".")[-1]
+        if expert_pat.search(module) is not None:
+            raise ValueError(
+                f"lora adapter {adapter_dir!r} targets MoE expert "
+                f"projections ({module!r}) — the runtime multi-tenant path "
+                "serves dense llama-family targets only; merge at load via "
+                "`lora_adapters` instead"
+            )
+        our = _LORA_TARGETS.get(module) or _LORA_TARGETS.get(short)
+        if our is not None:
+            add(our, layer, a.T, b.T * scale)
+            continue
+        if short in _LORA_FUSED:
+            if short == "qkv_proj" and cfg is None:
+                raise ValueError(
+                    f"adapter {adapter_dir!r} targets fused {short!r}; "
+                    "splitting it needs the model's head sizes (cfg)"
+                )
+            bt = b.T * scale  # [r, out_total]
+            if short == "qkv_proj":
+                sizes = [cfg.num_heads * cfg.head_dim_,
+                         cfg.num_kv_heads * cfg.head_dim_,
+                         cfg.num_kv_heads * cfg.head_dim_]
+            else:
+                sizes = [bt.shape[1] // 2] * 2
+            if bt.shape[1] != sum(sizes):
+                raise ValueError(
+                    f"lora delta for fused {short!r} layer {layer} has "
+                    f"{bt.shape[1]} output cols, expected {sum(sizes)}"
+                )
+            off = 0
+            for part_key, size in zip(_LORA_FUSED[short], sizes):
+                add(part_key, layer, a.T, bt[:, off: off + size])
+                off += size
+            continue
+        if not any(tag in module for tag in _LORA_IGNORED):
+            unmatched.append(module)
+
+    if unmatched:
+        log.warning(
+            "lora adapter %s: unrecognized target modules skipped: %s",
+            adapter_dir, sorted(set(unmatched)),
+        )
+    if not per_key:
+        raise ValueError(
+            f"lora adapter {adapter_dir!r} matched no served weight — "
+            "no runtime-servable lora_A/lora_B pairs found"
+        )
+    if cfg is not None:
+        dims = lora_target_dims(cfg)
+        for our, layers_d in per_key.items():
+            d_in, d_out = dims[our]
+            for li, (a_t, b_t) in layers_d.items():
+                if li >= cfg.num_layers:
+                    raise ValueError(
+                        f"lora factors for {our!r} target layer {li}, "
+                        f"model has {cfg.num_layers}"
+                    )
+                if a_t.shape[0] != d_in or b_t.shape[1] != d_out:
+                    raise ValueError(
+                        f"lora factors for {our!r} layer {li} map "
+                        f"{a_t.shape[0]}->{b_t.shape[1]}, model expects "
+                        f"{d_in}->{d_out}"
+                    )
+    return rank, per_key
+
+
 def apply_lora(
     cfg: ArchConfig, params: Params, adapter_dir: str, weight: float = 1.0
 ) -> Params:
@@ -710,8 +850,13 @@ def apply_lora(
             raise KeyError(f"lora targets {our!r} absent from the model tree")
         if isinstance(leaf, dict):
             raise ValueError(
-                "cannot merge a LoRA adapter into quantized weights — load "
-                "the checkpoint unquantized and quantize after merging"
+                "cannot merge a LoRA adapter into quantized weights — either "
+                "load the checkpoint unquantized and quantize after merging "
+                "(load_hf_checkpoint(lora=...)), or serve the adapter "
+                "UNMERGED through the runtime path (a virtual model with "
+                "`base_model` + `adapter`, docs/LORA_SERVING.md), which DOES "
+                "compose with a quantized base: the delta runs bf16 beside "
+                "the int8/int4 matmul"
             )
         for idx, delta in deltas.items():
             _check_lora_index(our, idx, leaf.shape)

@@ -135,6 +135,12 @@ class ArchConfig:
     # re-plumbing ~30 call sites (the paged_impl treatment at entry-point
     # granularity; quant matmuls live one level deeper).
     quant_kernel: str = "auto"
+    # Ragged per-slot LoRA delta kernel choice (ISSUE 10,
+    # docs/LORA_SERVING.md): "auto" (Pallas segmented matmul on TPU, XLA
+    # gather elsewhere) | "pallas" | "xla". Threaded exactly like
+    # quant_kernel — EngineConfig.lora_kernel reaches ops/lora_matmul.py
+    # through `dataclasses.replace(cfg, lora_kernel=...)`.
+    lora_kernel: str = "auto"
 
     @property
     def head_dim_(self) -> int:

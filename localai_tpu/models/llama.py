@@ -409,8 +409,30 @@ def _moe_capacity(cfg: ArchConfig, lp: Params, x: jnp.ndarray, block: int = 1024
     return y.reshape(*lead, D).astype(x.dtype)
 
 
+def _lora_add(cfg: ArchConfig, lora, key: str, x: jnp.ndarray,
+              y: jnp.ndarray, part: str, mesh=None) -> jnp.ndarray:
+    """y + the per-row ragged adapter delta for one target projection
+    (multi-tenant runtime LoRA, ISSUE 10 / docs/LORA_SERVING.md): unmerged
+    B·(A·x) beside the base matmul, so the base weights stay shared (and
+    possibly int8/int4-quantized) while each row's tenant rides its own
+    rank-r factors. lora = (per-layer stacks, ids) or None; stacks is the
+    layer-scan slice {key: {"a": [NA, in, R], "b": [NA, R, out]}}; id 0 is
+    the all-zero null adapter (exact no-op for adapter-less rows)."""
+    if lora is None:
+        return y
+    la, ids = lora
+    entry = la.get(key)
+    if entry is None:
+        return y
+    from localai_tpu.ops.lora_matmul import lora_delta
+
+    return y + lora_delta(
+        x, entry, ids, impl=cfg.lora_kernel, mesh=mesh, part=part
+    )
+
+
 def _mlp(cfg: ArchConfig, lp: Params, x: jnp.ndarray, ep: int = 1,
-         mesh=None) -> jnp.ndarray:
+         mesh=None, lora=None) -> jnp.ndarray:
     """SwiGLU MLP; dense or sparse-MoE (Mixtral/DeepSeek top-k routing).
 
     x: [..., D]. MoE is detected per-stack ("router" in lp) so DeepSeek's
@@ -427,9 +449,19 @@ def _mlp(cfg: ArchConfig, lp: Params, x: jnp.ndarray, ep: int = 1,
     """
     qk = cfg.quant_kernel
     if "router" not in lp:
-        gate = _act(cfg, matmul(x, lp["w_gate"], qk, mesh, "col"))
-        return matmul(gate * matmul(x, lp["w_up"], qk, mesh, "col"),
-                      lp["w_down"], qk, mesh, "row").astype(x.dtype)
+        gate = _act(cfg, _lora_add(
+            cfg, lora, "w_gate", x, matmul(x, lp["w_gate"], qk, mesh, "col"),
+            "col", mesh,
+        ))
+        up = _lora_add(
+            cfg, lora, "w_up", x, matmul(x, lp["w_up"], qk, mesh, "col"),
+            "col", mesh,
+        )
+        gu = gate * up
+        return _lora_add(
+            cfg, lora, "w_down", gu, matmul(gu, lp["w_down"], qk, mesh, "row"),
+            "row", mesh,
+        ).astype(x.dtype)
     if isinstance(lp["w_gate"], dict):
         y = _moe_dense(cfg, lp, x, mesh=mesh)
     elif ep > 1:
@@ -444,19 +476,23 @@ def _mlp(cfg: ArchConfig, lp: Params, x: jnp.ndarray, ep: int = 1,
 
 
 def _attn_out(cfg: ArchConfig, lp: Params, attn_flat: jnp.ndarray,
-              mesh=None) -> jnp.ndarray:
+              mesh=None, lora=None) -> jnp.ndarray:
     """Output projection + optional gemma-2 post-attention sandwich norm.
     Shared by every layer body so per-arch structure changes in ONE place."""
-    a = matmul(attn_flat, lp["wo"], cfg.quant_kernel, mesh, "row")
+    a = _lora_add(
+        cfg, lora, "wo", attn_flat,
+        matmul(attn_flat, lp["wo"], cfg.quant_kernel, mesh, "row"),
+        "row", mesh,
+    )
     if cfg.post_norms:
         a = rms_norm(a, lp["post_attn_norm"], cfg.rms_eps)
     return a
 
 
 def _mlp_out(cfg: ArchConfig, lp: Params, x: jnp.ndarray, ep: int = 1,
-             mesh=None) -> jnp.ndarray:
+             mesh=None, lora=None) -> jnp.ndarray:
     """MLP + optional gemma-2 post-feedforward sandwich norm."""
-    m = _mlp(cfg, lp, x, ep, mesh=mesh)
+    m = _mlp(cfg, lp, x, ep, mesh=mesh, lora=lora)
     if cfg.post_norms:
         m = rms_norm(m, lp["post_ffw_norm"], cfg.rms_eps)
     return m
@@ -482,13 +518,17 @@ def _layer_inv_freq(cfg: ArchConfig, inv_global, inv_local, li):
     return jnp.where(sliding, inv_local, inv_global)
 
 
-def _attn_proj_qkv(cfg: ArchConfig, lp: Params, x: jnp.ndarray, mesh=None):
+def _attn_proj_qkv(cfg: ArchConfig, lp: Params, x: jnp.ndarray, mesh=None,
+                   lora=None):
     """x: [..., D] -> q [..., H, Hd], k/v [..., K, Hd]."""
     H, K, Hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
     qk = cfg.quant_kernel
-    q = matmul(x, lp["wq"], qk, mesh, "col")
-    k = matmul(x, lp["wk"], qk, mesh, "col")
-    v = matmul(x, lp["wv"], qk, mesh, "col")
+    q = _lora_add(cfg, lora, "wq", x, matmul(x, lp["wq"], qk, mesh, "col"),
+                  "col", mesh)
+    k = _lora_add(cfg, lora, "wk", x, matmul(x, lp["wk"], qk, mesh, "col"),
+                  "col", mesh)
+    v = _lora_add(cfg, lora, "wv", x, matmul(x, lp["wv"], qk, mesh, "col"),
+                  "col", mesh)
     if cfg.attn_qkv_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -639,6 +679,8 @@ def _forward_hidden(
     inject=None,  # (embeds [B, N, D], offsets [B]) — VLM image features
     ep: int = 1,  # expert-parallel degree (MoE implementation choice)
     mrope=None,  # [B, 3, S] (t, h, w) position streams — Qwen2-VL m-rope
+    lora=None,  # (stacked adapter factors {key: {"a": [L,NA,in,R], "b":
+    # [L,NA,R,out]}}, ids [B]) — per-row runtime LoRA (ISSUE 10)
 ):
     """Shared full-sequence forward. Returns (h [B,S,D] after final norm,
     length_mask [B,S], (ks, vs) or None). Single source of truth for the layer
@@ -681,7 +723,12 @@ def _forward_hidden(
         )(h, embeds, offsets)
 
     def layer(h, xs):
-        lp, li = xs  # li: layer index (sliding windows alternate by layer)
+        if lora is None:
+            lp, li = xs  # li: layer index (sliding windows alternate by layer)
+            llora = None
+        else:
+            lp, li, la = xs  # la: this layer's adapter-factor slice
+            llora = (la, lora[1])
         x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
         inv = _layer_inv_freq(cfg, inv_freq, inv_local, li)
         if cfg.is_mla:
@@ -702,7 +749,7 @@ def _forward_hidden(
             return h, (
                 (rows, rows[..., :0]) if collect_kv else None
             )
-        q, k, v = _attn_proj_qkv(cfg, lp, x, mesh)
+        q, k, v = _attn_proj_qkv(cfg, lp, x, mesh, lora=llora)
         if mrope_ang is not None:
             from localai_tpu.ops.rope import rope_rotate
 
@@ -725,12 +772,13 @@ def _forward_hidden(
                 softcap=cfg.attn_softcap, window=cfg.sliding_window,
                 sliding=_layer_sliding(cfg, li), mesh=mesh,
             )
-        h = h + _attn_out(cfg, lp, attn.reshape(B, S, -1), mesh)
+        h = h + _attn_out(cfg, lp, attn.reshape(B, S, -1), mesh, lora=llora)
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-        h = h + _mlp_out(cfg, lp, x, ep, mesh)
+        h = h + _mlp_out(cfg, lp, x, ep, mesh, lora=llora)
         return h, ((k, v) if collect_kv else None)
 
-    h, kv = _scan_layers(cfg, params, h, layer)
+    extras = () if lora is None else (lora[0],)
+    h, kv = _scan_layers(cfg, params, h, layer, extras)
     h = rms_norm(h, params["final_norm"], cfg.rms_eps)
     return h, length_mask, kv
 
@@ -744,11 +792,12 @@ def prefill(
     inject=None,  # (embeds [B, N, D], offsets [B]) — VLM image features
     ep: int = 1,
     mrope=None,  # [B, 3, S] m-rope position streams (Qwen2-VL)
+    lora=None,  # (stacked adapter factors, ids [B]) — runtime LoRA
 ):
     """Prompt processing. Returns (last_logits [B, V] f32, k [L,B,S,K,Hd], v)."""
     h, _, (ks, vs) = _forward_hidden(
         cfg, params, tokens, lengths, collect_kv=True, mesh=mesh, inject=inject,
-        ep=ep, mrope=mrope,
+        ep=ep, mrope=mrope, lora=lora,
     )
     last_idx = jnp.maximum(lengths - 1, 0)  # empty prompt reads position 0, not wrap to S-1
     last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]  # [B, D]
@@ -899,6 +948,8 @@ def decode_step_windowed(
     # rows stay at positions). After a Qwen2-VL image prefill the 3D
     # position streams are all equal and offset from the row index by a
     # per-request constant, so plain rope at the shifted position is exact.
+    lora=None,  # (stacked adapter factors, ids [B]) — per-slot runtime
+    # LoRA deltas applied unmerged beside the base matmuls (ISSUE 10)
 ):
     """One step of a fused decode block with a block-local KV window.
 
@@ -916,7 +967,12 @@ def decode_step_windowed(
     h = _embed(cfg, params, tokens)
 
     def layer(h, xs):
-        lp, li, kc, vc, lk, lv = xs
+        if lora is None:
+            lp, li, kc, vc, lk, lv = xs
+            llora = None
+        else:
+            lp, li, kc, vc, lk, lv, la = xs
+            llora = (la, lora[1])
         x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
         inv = _layer_inv_freq(cfg, inv_freq, inv_local, li)
         if cfg.is_mla:
@@ -942,7 +998,7 @@ def decode_step_windowed(
             x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
             h = h + _mlp_out(cfg, lp, x, ep, mesh)
             return h, (rows, rows[..., :0])
-        q, k, v = _attn_proj_qkv(cfg, lp, x, mesh)
+        q, k, v = _attn_proj_qkv(cfg, lp, x, mesh, lora=llora)
         q = apply_rope(q[:, None], rope_pos[:, None], inv)[:, 0]
         k = apply_rope(k[:, None], rope_pos[:, None], inv)[:, 0]
         if ptable is not None:
@@ -968,14 +1024,15 @@ def decode_step_windowed(
                 softcap=cfg.attn_softcap, window=cfg.sliding_window,
                 sliding=_layer_sliding(cfg, li),
             )
-        h = h + _attn_out(cfg, lp, attn.reshape(B, -1), mesh)
+        h = h + _attn_out(cfg, lp, attn.reshape(B, -1), mesh, lora=llora)
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-        h = h + _mlp_out(cfg, lp, x, ep, mesh)
+        h = h + _mlp_out(cfg, lp, x, ep, mesh, lora=llora)
         return h, (k, v)
 
-    h, (new_k, new_v) = _scan_layers(
-        cfg, params, h, layer, (cache.k, cache.v, local_k, local_v)
-    )
+    extras = (cache.k, cache.v, local_k, local_v)
+    if lora is not None:
+        extras = extras + (lora[0],)
+    h, (new_k, new_v) = _scan_layers(cfg, params, h, layer, extras)
     local_k = jax.lax.dynamic_update_index_in_dim(
         local_k, new_k.astype(local_k.dtype), step, axis=2
     )

@@ -14,6 +14,14 @@ The reference reaches the same goals by passing `tensor_split` to llama.cpp
 (grpc-server.cpp:493-496) or `tensor_parallel_size` to vLLM
 (backend/python/vllm/backend.py:106-107); here the plan is explicit
 PartitionSpecs and XLA compiles the collectives.
+
+Runtime LoRA factor stacks (ISSUE 10) are NOT part of the param tree and
+keep their specs next to their kernel in ops/lora_matmul.lora_factor_specs:
+column-parallel targets replicate A and shard B on the out axis, row-parallel
+targets shard A on the in axis (mirroring the roles _layer_specs assigns the
+base weights below) — the sharding-consistency lint pins THIS file's spec
+names 1:1 against the llama param tree, so tenant state that lives outside
+the tree must not add names here.
 """
 
 from __future__ import annotations

@@ -76,6 +76,33 @@ class LoadedModel:
         return Lease(self)
 
 
+class VirtualModel(LoadedModel):
+    """A per-tenant view over a shared base engine (ISSUE 10,
+    docs/LORA_SERVING.md): same Engine object (the adapter is registered
+    as a runtime tenant and every request carries `adapter=<name>`), but
+    the tenant's OWN ModelConfig — templates, system prompt, generation
+    defaults — so the OpenAI `model` field selects a fully-skinned tenant
+    while N virtual models share one set of base weights. In-flight
+    accounting delegates to the base LoadedModel so eviction/drain logic
+    sees the engine's true load."""
+
+    def __init__(self, cfg: ModelConfig, base: LoadedModel, adapter: str,
+                 evaluator: Evaluator):
+        super().__init__(cfg, base.engine, evaluator)
+        self.base = base
+        self.adapter = adapter
+
+    def touch(self) -> None:
+        super().touch()
+        self.base.touch()
+
+    def acquire(self) -> None:
+        self.base.acquire()
+
+    def release(self) -> None:
+        self.base.release()
+
+
 class Lease:
     """Idempotent in-flight marker: release() is safe to call from both a
     streaming generator's finally and an error path without double-counting."""
@@ -223,7 +250,12 @@ class ModelManager:
     def get(self, name: str) -> LoadedModel:
         """Singleflight load (reference: loader.go:163-221). Raises KeyError
         for unknown models, ModelQuarantinedError while the model's restart
-        budget is exhausted."""
+        budget is exhausted. Virtual models (base_model + adapter,
+        ISSUE 10) resolve to the base's shared engine with the adapter
+        registered as a runtime tenant."""
+        vcfg = self.configs.get(name)
+        if vcfg is not None and (vcfg.base_model or vcfg.adapter):
+            return self._get_virtual(name, vcfg)
         while True:
             self._reap_dead(name)
             self._check_quarantine(name)
@@ -264,14 +296,63 @@ class ModelManager:
                 self._loading.pop(name, None)
             ev.set()
 
+    def _get_virtual(self, name: str, cfg: ModelConfig) -> "VirtualModel":
+        """Resolve a virtual model (ISSUE 10): load/reuse the base engine,
+        register the adapter as a runtime tenant (idempotent — the loop
+        thread fetches/promotes its factors lazily at first admission),
+        and hand back a per-tenant view. Rebuilt per call so a crash-only
+        base restart transparently re-registers every tenant."""
+        from localai_tpu.config.model_config import LoraConfigError
+
+        cfg.validate()  # typed LoraConfigError on half-configured entries
+        base_cfg = self.configs.get(cfg.base_model)
+        if base_cfg is None:
+            raise KeyError(
+                f"virtual model {name!r}: base model {cfg.base_model!r} "
+                "not found"
+            )
+        if base_cfg.base_model or base_cfg.adapter:
+            raise LoraConfigError(
+                f"virtual model {name!r}: base {cfg.base_model!r} is itself "
+                "a virtual model — adapters do not nest"
+            )
+        if base_cfg.lora_adapters:
+            # The merge/runtime seam (ISSUE 10 satellite): the base already
+            # folded adapters into its weights at load; registering another
+            # runtime tenant on top would serve base+merged+runtime deltas
+            # with no way to reason about which tenant sees what.
+            raise LoraConfigError(
+                f"virtual model {name!r}: base {cfg.base_model!r} merges "
+                "`lora_adapters` at load — a base serving runtime adapter "
+                "tenants must keep its weights pristine "
+                "(docs/LORA_SERVING.md)"
+            )
+        base = self.get(cfg.base_model)
+        engine = base.engine
+        if not hasattr(engine, "register_adapter"):
+            raise LoraConfigError(
+                f"virtual model {name!r}: backend {base_cfg.backend!r} has "
+                "no runtime adapter support"
+            )
+        engine.register_adapter(
+            name, self._resolve_ckpt_dir(cfg.adapter),
+            weight=cfg.adapter_weight,
+        )
+        return VirtualModel(
+            cfg, base, adapter=name,
+            evaluator=Evaluator(cfg, engine.tokenizer),
+        )
+
     def lease(self, name: str) -> tuple[LoadedModel, Lease]:
         """get() + acquire, atomically w.r.t. eviction: the lease is taken
         while the model is verifiably still resident, so LRU/drain logic sees
-        in_flight > 0 before any teardown can start."""
+        in_flight > 0 before any teardown can start. Virtual models anchor
+        on their BASE LoadedModel (they are never in _loaded themselves)."""
         while True:
             lm = self.get(name)
+            anchor = getattr(lm, "base", lm)
             with self._lock:
-                if self._loaded.get(name) is lm:
+                if self._loaded.get(anchor.cfg.name) is anchor:
                     return lm, lm.lease()
             # evicted in the window between get() and now — reload and retry
 
@@ -655,6 +736,8 @@ class ModelManager:
                 kv_cache_dtype=cfg.kv_cache_dtype,
                 paged_kernel=cfg.paged_kernel,
                 quant_kernel=cfg.quant_kernel,
+                lora_kernel=cfg.lora_kernel,
+                adapter_cache_bytes=cfg.adapter_cache_bytes,
                 kv_scale=cfg.kv_scale,
                 prefill_chunk=cfg.prefill_chunk,
                 max_pending=cfg.max_pending,

@@ -16,8 +16,8 @@ import uuid
 from typing import Any, Callable, Iterator, Optional
 
 from localai_tpu import __version__
-from localai_tpu.config import Usecase
-from localai_tpu.engine import GenRequest, QueueFullError
+from localai_tpu.config import LoraConfigError, Usecase
+from localai_tpu.engine import AdapterError, GenRequest, QueueFullError
 from localai_tpu.server.app import ApiError, Request, Response, Router, SSEStream
 from localai_tpu.server.manager import (
     LoadedModel,
@@ -141,6 +141,10 @@ class OpenAIApi:
             return self.manager.lease(name)
         except KeyError:
             raise ApiError(404, f"model {name!r} not found") from None
+        except LoraConfigError as e:
+            # Contradictory virtual-model / merge-at-load setup (ISSUE 10):
+            # a clean 400 for this one model, serving stays up.
+            raise ApiError(400, str(e)) from None
         except ModelQuarantinedError as e:
             # Crash-only supervision tripped its restart budget (ISSUE 4):
             # a clean 503 with the remaining quarantine window, not a
@@ -167,6 +171,12 @@ class OpenAIApi:
                 429, str(e), "rate_limit_exceeded",
                 retry_after=e.retry_after_s,
             ) from None
+        except AdapterError as e:
+            # Tenant-identity failure (ISSUE 10): the adapter vanished
+            # between resolution and submit, or the base cannot serve it.
+            for h in handles:
+                h.cancel()
+            raise ApiError(400, str(e)) from None
         return handles
 
     def _proxy_remote(self, req: Request, lm: LoadedModel, lease) -> Response | SSEStream:
@@ -252,6 +262,9 @@ class OpenAIApi:
             # YAML's default; past it, pending requests shed and active
             # ones cancel (docs/ROBUSTNESS.md).
             deadline_s=float(pick("deadline_s", cfg.deadline_s)),
+            # Multi-tenant LoRA (ISSUE 10): a virtual model resolves to
+            # the base's shared engine + this tenant's adapter name.
+            adapter=getattr(lm, "adapter", None),
         )
 
     @staticmethod

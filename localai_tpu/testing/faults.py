@@ -46,6 +46,13 @@ Hook sites (each is one `faults.fire(SITE)` call in production code):
                      Raising here fails a prefill→decode KV handoff; the
                      contract is silent fallback to recompute on the decode
                      replica (ISSUE 6).
+  adapter_fetch    — host-tier adapter fetch (Engine._adapter_image: disk →
+                     host-RAM LRU) and device promote
+                     (Engine._adapter_acquire: host image → stacked device
+                     factors), ISSUE 10. Raising here fails THAT request's
+                     admission with a typed error event; the engine keeps
+                     serving every other tenant and the per-slot adapter
+                     refcounts stay fully accounted at quiesce.
 
 Activation:
   - programmatic: `with faults.active(FaultSchedule(seed=7)): ...`
@@ -83,6 +90,7 @@ SITES = (
     "cluster_dispatch",
     "span_transfer",
     "collective_dispatch",
+    "adapter_fetch",
 )
 
 DEFAULT_RATE = 0.05
