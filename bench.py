@@ -153,6 +153,60 @@ def main() -> None:
     # the paged row — at a 512-token prefix both paths are ~1 tunnel RTT
     # and the ratio is noise; r4 recorded a 0.34x artifact that way.)
 
+    # Request-lifecycle journal overhead row (ISSUE 11, BENCH_TRACE):
+    # decode tok/s with the flight-recorder journal detached vs attached
+    # on the SAME warmed engine (no recompiles — the journal is host-side
+    # bookkeeping only), plus the /debug/timeline export cost. Guards the
+    # "observability is free" claim with a number every round.
+    if os.environ.get("BENCH_TRACE", "1") != "0":
+        def _trace_round() -> float:
+            eng._decode_time = 0.0
+            eng._decode_tokens = 0
+            errs0 = len(errors)
+            tthreads = [threading.Thread(target=one, args=(i,))
+                        for i in range(slots)]
+            for t in tthreads:
+                t.start()
+            _join_or_die(tthreads, eng, "trace overhead row")
+            if len(errors) > errs0:
+                for err in errors[errs0:]:
+                    print(err, file=sys.stderr)
+                print("trace overhead row failed", file=sys.stderr)
+                sys.exit(1)
+            return (eng._decode_tokens / eng._decode_time
+                    if eng._decode_time else 0.0)
+
+        saved_journal = eng._journal
+        eng._journal = None
+        tps_journal_off = _trace_round()
+        if saved_journal is None:
+            from localai_tpu.observe.journal import EventJournal
+
+            saved_journal = EventJournal(4096)
+        eng._journal = saved_journal
+        tps_journal_on = _trace_round()
+        from localai_tpu.observe import timeline as _timeline
+
+        t_exp = time.time()
+        tl = _timeline.chrome_trace({"bench": saved_journal})
+        export_ms = (time.time() - t_exp) * 1000.0
+        overhead_pct = (
+            100.0 * (tps_journal_off - tps_journal_on) / tps_journal_off
+            if tps_journal_off else 0.0
+        )
+        print(
+            f"trace row: journal_off={tps_journal_off:.1f} tok/s "
+            f"journal_on={tps_journal_on:.1f} tok/s "
+            f"overhead={overhead_pct:.2f}% "
+            f"timeline_export={export_ms:.1f}ms "
+            f"({len(tl['traceEvents'])} events)",
+            file=sys.stderr,
+        )
+        out["trace_journal_off_tps"] = round(tps_journal_off, 2)
+        out["trace_journal_on_tps"] = round(tps_journal_on, 2)
+        out["trace_journal_overhead_pct"] = round(overhead_pct, 2)
+        out["timeline_export_ms"] = round(export_ms, 2)
+
     # Grammar-constrained decode row: on-device DFA masking vs the host
     # candidate-walk fallback (same schema, greedy). The DFA path keeps full
     # block depth and no per-token host round-trip (functions/dfa.py).

@@ -180,6 +180,26 @@ class ClusterEngine:
         only when EVERY replica's loop died — one dead replica reroutes."""
         return all(rep.engine.is_dead for rep in self.replicas)
 
+    @property
+    def postmortem_path(self) -> str:
+        """First replica flight-recorder dump, for the loop_dead gauge
+        labels (ISSUE 11) — "" while every replica is alive."""
+        for rep in self.replicas:
+            p = getattr(rep.engine, "postmortem_path", "")
+            if p:
+                return p
+        return ""
+
+    def journals(self) -> dict:
+        """{replica name: EventJournal} for /debug/timeline — one Perfetto
+        process row per replica (ISSUE 11)."""
+        out = {}
+        for rep in self.replicas:
+            j = getattr(rep.engine, "journal", None)
+            if j is not None:
+                out[rep.name] = j
+        return out
+
     def metrics(self) -> dict[str, float]:
         out: dict[str, float] = {}
         for rep in self.replicas:

@@ -303,6 +303,23 @@ class ClusterClient:
         _, RequestHandle, _ = _engine_types()
         caller = RequestHandle()
         caller.t_submit = time.monotonic()
+        rid = getattr(request, "request_id", "")
+        if rid:
+            # Coordinator trace leg (ISSUE 11): reroute/handoff
+            # annotations land here; the replica engines open their own
+            # legs under the same traceparent when they serve the request.
+            from localai_tpu.observe.trace import STORE as _tstore
+            from localai_tpu.observe.trace import RequestTrace
+
+            tr = RequestTrace(
+                rid, traceparent=getattr(request, "traceparent", ""),
+                engine="cluster",
+            )
+            caller.rid = rid
+            caller.trace = tr
+            caller._q.trace = tr
+            _tstore.register(tr)
+            tr.note("queued")
         with self._lock:
             self._rid += 1
             rid = self._rid
@@ -429,6 +446,15 @@ class ClusterClient:
                     completion_tokens=len(rec["emitted_ids"])))
                 return
             self.m_reroutes += 1
+            # Trace continuity (ISSUE 11): the reroute shows up on the
+            # request's live trace leg; the survivor's own submit opens
+            # the next leg under the same traceparent.
+            if getattr(request, "request_id", ""):
+                from localai_tpu.observe.trace import STORE as _tstore
+
+                _tstore.annotate(request.request_id, "reroute",
+                                 dead_replica=name,
+                                 emitted=len(rec["emitted_ids"]))
             log.warning("replica %s died mid-stream — rerouting request %d "
                         "(%d tokens emitted)", name, rid,
                         len(rec["emitted_ids"]))
@@ -489,14 +515,20 @@ class ClusterClient:
             pre = self.scheduler.target(name) if name is not None else None
             if pre is None or pre is decode_rep or pre.role != "prefill":
                 return  # no dedicated prefill capacity — nothing to hand off
+            rid = getattr(request, "request_id", "")
             probe = dataclasses.replace(
                 request, max_new_tokens=1, stop=[], grammar=None,
-                logprobs=0, ignore_eos=True)
+                logprobs=0, ignore_eos=True,
+                # The prefill leg traces under "<rid>:prefill" with the
+                # SAME traceparent, so /debug/trace shows one trace with
+                # a prefill leg and a decode leg (ISSUE 11).
+                request_id=(rid + ":prefill") if rid else "")
             t0 = time.monotonic()
             pre.engine.submit(probe).result()  # admission saved the span
             self.scheduler.record(name, hashes)
             frame = pre.engine.export_prefix_span(
-                request.prompt_ids, max_bytes=self.transfer_max_bytes)
+                request.prompt_ids, max_bytes=self.transfer_max_bytes,
+                trace_id=rid)
             if frame is None:
                 raise transfer.SpanTransferError(
                     "prefill replica stored no exportable span")
@@ -505,6 +537,12 @@ class ClusterClient:
                 raise transfer.SpanTransferError(
                     "decode replica rejected the span frame")
             self.m_handoffs += 1
+            if rid:
+                from localai_tpu.observe.trace import STORE as _tstore
+
+                _tstore.annotate(rid, "span_handoff", prefill=name,
+                                 decode=decode_rep.name,
+                                 ms=round((time.monotonic() - t0) * 1000, 2))
             log.debug("handed off %d-token span %s→%s in %.1f ms",
                       len(request.prompt_ids), name, decode_rep.name,
                       (time.monotonic() - t0) * 1000)

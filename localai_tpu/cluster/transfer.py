@@ -60,9 +60,13 @@ def _np_dtype(name: str) -> np.dtype:
 
 
 def encode_span(key, valid: int, hk: np.ndarray, hv: np.ndarray,
-                geom: dict, max_bytes: int = DEFAULT_MAX_BYTES) -> bytes:
+                geom: dict, max_bytes: int = DEFAULT_MAX_BYTES,
+                trace_id: str = "") -> bytes:
     """Frame one exported span. `geom` is the exporter's cache geometry
-    (engine._span_geometry()); the importer must match it exactly."""
+    (engine._span_geometry()); the importer must match it exactly.
+    `trace_id` (ISSUE 11) rides the JSON header so a disaggregated
+    prefill→decode handoff stays one trace — additive, so v1 importers
+    that ignore it keep working."""
     faults.fire("span_transfer")  # injected transfer failure (ISSUE 6)
     kb = np.ascontiguousarray(hk)
     vb = np.ascontiguousarray(hv)
@@ -78,6 +82,7 @@ def encode_span(key, valid: int, hk: np.ndarray, hv: np.ndarray,
         "dtype": str(kb.dtype),
         "k_bytes": int(kb.nbytes),
         "v_bytes": int(vb.nbytes),
+        **({"trace": str(trace_id)} if trace_id else {}),
     }).encode()
     total = _HEAD.size + len(header) + kb.nbytes + vb.nbytes
     if max_bytes > 0 and total > max_bytes:
@@ -88,6 +93,22 @@ def encode_span(key, valid: int, hk: np.ndarray, hv: np.ndarray,
         _HEAD.pack(MAGIC, VERSION, len(header)),
         header, kb.tobytes(), vb.tobytes(),
     ))
+
+
+def span_meta(frame: bytes) -> dict:
+    """Best-effort header-only parse (no payload validation): trace id and
+    geometry for logging/journal attribution (ISSUE 11). Returns {} on any
+    malformed frame — attribution must never fail an import."""
+    try:
+        if len(frame) < _HEAD.size:
+            return {}
+        magic, _version, hdr_len = _HEAD.unpack_from(frame)
+        if magic != MAGIC:
+            return {}
+        header = json.loads(frame[_HEAD.size:_HEAD.size + hdr_len])
+        return header if isinstance(header, dict) else {}
+    except (ValueError, UnicodeDecodeError, struct.error):
+        return {}
 
 
 def decode_span(frame: bytes, geom: dict,

@@ -72,6 +72,12 @@ class ApplicationConfig:
     affinity_spans: int = 8
     transfer_max_bytes: int = 64 << 20
 
+    # Flight recorder (ISSUE 11, docs/OBSERVABILITY.md): directory where a
+    # dying engine loop dumps its postmortem JSON (journal tail + state
+    # snapshot). "" = a stable tempdir child. Forwarded to every engine
+    # through the manager; LOCALAI_POSTMORTEM_DIR overrides either way.
+    postmortem_dir: str = ""
+
     cors: bool = True
     metrics: bool = True
     debug: bool = False
@@ -141,6 +147,7 @@ class ApplicationConfig:
             cluster_replicas=_env("LOCALAI_CLUSTER_REPLICAS", cls.cluster_replicas, int),
             affinity_spans=_env("LOCALAI_AFFINITY_SPANS", cls.affinity_spans, int),
             transfer_max_bytes=_env("LOCALAI_TRANSFER_MAX_BYTES", cls.transfer_max_bytes, int),
+            postmortem_dir=_env("LOCALAI_POSTMORTEM_DIR", cls.postmortem_dir),
             cors=_env("LOCALAI_CORS", True, bool),
             metrics=not _env("LOCALAI_DISABLE_METRICS", False, bool),
             debug=_env("LOCALAI_DEBUG", False, bool),

@@ -166,6 +166,12 @@ class ModelConfig:
     queue_timeout_s: float = 0.0
     deadline_s: float = 0.0
 
+    # Request-lifecycle event journal capacity (ISSUE 11,
+    # docs/OBSERVABILITY.md): ring-buffer size of the engine flight
+    # recorder behind /debug/timeline and the loop-death postmortem.
+    # 0 disables. LOCALAI_TRACE_JOURNAL env var overrides.
+    trace_journal_events: int = 4096
+
     # Speculative decoding (reference: draft_model/n_draft,
     # core/config/model_config.go:211-212).
     draft_model: str = ""  # arch preset or checkpoint dir; empty = off

@@ -58,6 +58,10 @@ import numpy as np
 
 from localai_tpu.models import llama
 from localai_tpu.models.config import ArchConfig
+from localai_tpu.observe import fence as ofence
+from localai_tpu.observe import postmortem as opostmortem
+from localai_tpu.observe import trace as otrace
+from localai_tpu.observe.journal import EventJournal
 from localai_tpu.ops.sampling import (
     SamplingParams,
     sample,
@@ -290,6 +294,29 @@ class EngineConfig:
     # release on the next processed block). 0 disables.
     # LOCALAI_DEADLINE overrides.
     deadline_s: float = 0.0
+    # Request-lifecycle event journal (ISSUE 11, docs/OBSERVABILITY.md):
+    # capacity (in events) of the engine loop's preallocated ring-buffer
+    # flight recorder — queued/admitted/chunk/decode-block/preempt/swap/
+    # resume/prefix-hit/span-transfer/terminal events plus per-iteration
+    # dispatch records. Appends are lock-free from the loop thread, O(1),
+    # allocation-free, and never touch the device (trace-safety lint
+    # covers the module). 0 disables the journal (and with it /debug/
+    # timeline and the postmortem journal tail). LOCALAI_TRACE_JOURNAL
+    # env var overrides.
+    trace_journal_events: int = 4096
+    # Fenced per-dispatch device timing (debug): when true, every decode-
+    # block dispatch blocks until the device finishes and the journal's
+    # loop_iter record carries the fenced device time — this SERIALIZES
+    # the pipeline (pipeline_depth effectively 1), so it is a measurement
+    # mode, never a serving default. LOCALAI_TRACE_FENCE env var
+    # overrides ("1" enables).
+    trace_fence: bool = False
+    # Flight-recorder output directory (ISSUE 11): where the engine dumps
+    # its postmortem JSON (journal tail + state snapshot) when the loop
+    # dies. "" = a stable tempdir child (observe/postmortem.default_dir).
+    # The ApplicationConfig.postmortem_dir / LOCALAI_POSTMORTEM_DIR knob
+    # forwards here through the manager.
+    postmortem_dir: str = ""
     # KV-cache storage dtype (reference: CacheTypeKey/CacheTypeValue,
     # backend/backend.proto:261-262, llama.cpp q8 KV). "" = model dtype;
     # "fp8" (e4m3) / "fp8_e5m2" halve KV bytes — the TPU-native equivalent
@@ -369,6 +396,15 @@ class GenRequest:
     # OpenAI `model` field selects it through a virtual-model config
     # (docs/LORA_SERVING.md). None = serve the shared base weights.
     adapter: Optional[str] = None
+    # Request-lifecycle tracing (ISSUE 11, docs/OBSERVABILITY.md): a
+    # caller-visible request id (the OpenAI response id at the HTTP layer)
+    # keys the span tree at /debug/trace/{request_id}; traceparent is the
+    # W3C header value propagated from HTTP through cluster dispatch,
+    # federation proxying, and span-transfer frames so a disaggregated
+    # prefill→decode request stays ONE trace across replicas. Empty =
+    # untraced (library/bench callers pay nothing).
+    request_id: str = ""
+    traceparent: str = ""
     # INTERNAL — set by the engine when it preempts a slot (ISSUE 3).
     # Carries the victim's host-side continuation state (generated tokens,
     # RNG chain, swap image) so re-admission resumes the original stream
@@ -388,22 +424,53 @@ class TokenEvent:
     completion_tokens: int = 0
     timing_prompt_processing: float = 0.0  # seconds (TTFT component)
     timing_token_generation: float = 0.0
+    # Seconds spent in the pending queue before the admission dispatch
+    # (ISSUE 11): ttft = queue wait + prompt processing; the HTTP layer
+    # feeds the queue_wait/ttft histograms from these.
+    timing_queue_wait: float = 0.0
     # Filled on "token" when the request asked for logprobs.
     logprob: Optional[float] = None
     top_logprobs: Optional[list] = None  # [(token_id, logprob)] descending
+
+
+class _EventQueue(queue.Queue):
+    """Token-event queue that mirrors TERMINAL events into the request's
+    trace (ISSUE 11). Every path that ends a stream — _finish, cancel,
+    deadline sweeps, loop death, stop() — funnels through put() on this
+    queue, so routing the terminal note here guarantees each traced
+    request records exactly one terminal (RequestTrace.terminal is
+    idempotent; stop()'s deliberate duplicate done events are ignored).
+    Untraced requests (trace is None) pay one attribute check per event."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.trace: Optional[otrace.RequestTrace] = None
+
+    def put(self, item, *args, **kwargs):
+        tr = self.trace
+        if tr is not None and getattr(item, "kind", None) in ("done", "error"):
+            tr.terminal(item)
+        super().put(item, *args, **kwargs)
 
 
 class RequestHandle:
     """Streaming consumer side of a submitted request."""
 
     def __init__(self) -> None:
-        self._q: "queue.Queue[TokenEvent]" = queue.Queue()
+        self._q: "_EventQueue" = _EventQueue()
         self.cancelled = threading.Event()
         # Stamped by submit(): admission-wait measurement + deadline/queue-
         # timeout enforcement (ISSUE 4). 0.0 / None on handles built outside
         # submit (warmup) — every consumer guards on that.
         self.t_submit: float = 0.0
         self.deadline: Optional[float] = None  # absolute monotonic
+        # Lifecycle tracing (ISSUE 11): journal request id (always set by
+        # submit) and the request's span-tree recorder (None = untraced).
+        self.rid: str = ""
+        self.trace: Optional[otrace.RequestTrace] = None
+        # Admission-dispatch stamp (_note_admitted): terminal events derive
+        # timing_queue_wait from it.
+        self.t_admit: float = 0.0
 
     def __iter__(self) -> Iterator[TokenEvent]:
         while True:
@@ -452,6 +519,11 @@ def _parse_tp_env(val: str) -> int:
     """LOCALAI_TENSOR_PARALLEL value: an integer, or "auto" (= -1, all
     available devices with max_valid_tp degrade)."""
     return -1 if val.strip().lower() == "auto" else int(val)
+
+
+def _parse_flag_env(val: str) -> bool:
+    """Boolean env values ("1"/"true"/"yes"/"on"); bool("0") would be True."""
+    return val.strip().lower() in ("1", "true", "yes", "on")
 
 
 def _host_copy_async(arr: Any) -> None:
@@ -529,6 +601,9 @@ class Engine:
             "LOCALAI_KV_SCALE": ("kv_scale", float),
             "LOCALAI_LORA_KERNEL": ("lora_kernel", str),
             "LOCALAI_ADAPTER_CACHE_BYTES": ("adapter_cache_bytes", int),
+            "LOCALAI_TRACE_JOURNAL": ("trace_journal_events", int),
+            "LOCALAI_TRACE_FENCE": ("trace_fence", _parse_flag_env),
+            "LOCALAI_POSTMORTEM_DIR": ("postmortem_dir", str),
         }.items():
             val = os.environ.get(env)
             if val is not None and val != "":
@@ -553,6 +628,8 @@ class Engine:
             )
         if self.ecfg.adapter_cache_bytes < 0:
             raise ValueError("adapter_cache_bytes must be >= 0")
+        if self.ecfg.trace_journal_events < 0:
+            raise ValueError("trace_journal_events must be >= 0 (0 = off)")
         if self.ecfg.kv_scale <= 0:
             raise ValueError("kv_scale must be > 0")
         if self.ecfg.kv_scale != 1.0 and not (
@@ -958,7 +1035,97 @@ class Engine:
         self.m_adapter_fetches = 0
         self.m_adapter_promotes = 0
         self.m_adapter_evictions = 0
+        # Request-lifecycle observability (ISSUE 11, docs/OBSERVABILITY.md):
+        # the loop-owned event journal (None = disabled), the fenced-timing
+        # debug flag, a submit-side id counter for requests that carry no
+        # caller request_id, and the path of the last flight-recorder dump
+        # (surfaced via the loop_dead gauge labels + manager log).
+        self._journal = (
+            EventJournal(self.ecfg.trace_journal_events)
+            if self.ecfg.trace_journal_events > 0 else None
+        )
+        self._trace_fence = bool(self.ecfg.trace_fence)
+        self._postmortem_path = ""
         self._build_programs()
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle journal / tracing (ISSUE 11)
+    # ------------------------------------------------------------------ #
+
+    @property
+    def journal(self) -> Optional[EventJournal]:
+        """The engine's event journal (None when trace_journal_events=0);
+        /debug/timeline renders it as a Perfetto-loadable trace."""
+        return self._journal
+
+    @property
+    def postmortem_path(self) -> str:
+        """Path of the flight-recorder dump written when the loop died
+        ("" while alive) — rides the loop_dead gauge labels."""
+        return self._postmortem_path
+
+    def _jnote(self, event: str, rid: str = "", slot: int = -1,
+               a: float = 0.0, b: float = 0.0) -> None:
+        """Loop-thread journal append (lock-free; no-op when disabled)."""
+        j = self._journal
+        if j is not None:
+            j.append(event, rid=rid, slot=slot, a=a, b=b)
+
+    def _jstage(self, event: str, rid: str = "", slot: int = -1,
+                a: float = 0.0, b: float = 0.0) -> None:
+        """Cross-thread journal emit (submit / span export): staged into
+        the journal's sidecar, drained by the loop thread in order."""
+        j = self._journal
+        if j is not None:
+            j.stage(event, rid=rid, slot=slot, a=a, b=b)
+
+    def _jnote_fault(self, e: BaseException) -> None:
+        """Journal an injected fault under its per-site event type
+        (fault_<site> — cross-checked against faults.SITES by the
+        journal-events lint pass). Real failures journal as "error"."""
+        if not isinstance(e, faults.InjectedFault):
+            return
+        msg = str(e)
+        for site in faults.SITES:
+            if f"at {site} " in msg:
+                self._jnote("fault_" + site)
+                return
+
+    def _write_postmortem(self, reason: str, live: list,
+                          pending_rids: list) -> str:
+        """Flight-recorder dump (loop death): journal tail + engine state
+        snapshot → one JSON file. Runs on the dying loop thread, after the
+        terminal events posted and the allocator was released."""
+        j = self._journal
+        payload = {
+            "reason": reason,
+            "engine": self.cfg.name,
+            "wall_time": time.time(),
+            "slots": [
+                {"slot": i, "rid": rid, "generated": gen, "prompt_len": plen}
+                for i, rid, gen, plen in live
+            ],
+            "pending": list(pending_rids),
+            "pending_depth": len(pending_rids),
+            "pool": {
+                "kv_pages": int(self.ecfg.kv_pages),
+                "free_pages": len(self._free_pages),
+                "host_tier_bytes": int(self._host_bytes),
+                "prefix_entries": len(self._prefix_entries),
+                "prefix_host_entries": len(self._prefix_host),
+            },
+            "config": {
+                "max_slots": self.ecfg.max_slots,
+                "max_seq": self.ecfg.max_seq,
+                "kv_page_size": self.ecfg.kv_page_size,
+                "prefill_chunk": self.ecfg.prefill_chunk,
+                "tensor_parallel": self.plan.tp,
+            },
+            "journal": j.snapshot(last=512) if j is not None else [],
+        }
+        return opostmortem.write(
+            self.ecfg.postmortem_dir, self.cfg.name, payload
+        )
 
     @property
     def _paged(self) -> bool:
@@ -1353,6 +1520,14 @@ class Engine:
         else:
             self.m_kv_preempt_recomputes += 1
         self.m_kv_preemptions += 1
+        self._jnote("preempt", rid=slot.handle.rid, slot=victim,
+                    a=float(ctx_rows))
+        if policy == "swap":
+            self._jnote("swap_out", rid=slot.handle.rid, slot=victim,
+                        a=float(span_bytes))
+        tr = slot.handle.trace
+        if tr is not None:
+            tr.note("preempt", policy=policy, ctx_rows=ctx_rows)
         resume_req = dataclasses.replace(
             r, prompt_ids=list(r.prompt_ids) + list(slot.generated),
             resume=rec,
@@ -1464,6 +1639,8 @@ class Engine:
         self.m_kv_preempt_recover_ms += (
             (time.monotonic() - rec["t_preempt"]) * 1e3
         )
+        self._jnote("swap_in", rid=handle.rid, slot=slot_idx,
+                    a=float(rec["bytes"]))
         self._last_admit_t = time.monotonic()
         return True
 
@@ -1477,6 +1654,8 @@ class Engine:
         rec = slot.request.resume if slot is not None else None
         if rec is None:
             return
+        self._jnote("resume", rid=slot.handle.rid, slot=slot_idx,
+                    a=float(len(rec["generated"])))
         orig = list(slot.request.prompt_ids[: rec["orig_prompt_len"]])
         slot.request = dataclasses.replace(
             slot.request, prompt_ids=orig, resume=None
@@ -2772,6 +2951,11 @@ class Engine:
                     break
             self.m_prefix_hits += 1
             self.m_prefix_tokens += match_len
+            self._jnote("prefix_hit", rid=handle.rid, slot=slot_idx,
+                        a=float(match_len))
+            tr = handle.trace
+            if tr is not None:
+                tr.note("prefix_hit", matched_tokens=match_len)
         self.slots[slot_idx] = _Slot(
             request=request, handle=handle, prompt_len=len(ids), t_submit=t0,
             sched_rows=len(ids),
@@ -2836,6 +3020,7 @@ class Engine:
                      jnp.asarray(toks), jnp.asarray(aux))
         self.cache, self.d_positions, marker = out
         self.m_prefill_chunks += 1
+        self._jnote("chunk", rid=st["handle"].rid, slot=slot_idx, a=float(n))
         self._track(_Entry(kind="chunk", toks=marker, tk=None,
                            gen=list(self._slot_gen)))
 
@@ -2940,6 +3125,8 @@ class Engine:
         self.h_gmask[slot_idx] = 1.0 if with_dfa else 0.0
         self.m_prefill_chunks += 1
         self.m_chunked_admits += 1
+        self._jnote("admitted", rid=handle.rid, slot=slot_idx,
+                    a=float(len(ids)), b=1.0)
         self._track(_Entry(
             kind="admit", toks=toks, tk=tk, lp=lp, gen=list(self._slot_gen),
             items=[(slot_idx, request, handle, len(ids), t0)],
@@ -3223,7 +3410,8 @@ class Engine:
             "dtype": str(jnp.dtype(self.ecfg.cache_dtype(cfg.dtype))),
         }
 
-    def export_prefix_span(self, prompt_ids, max_bytes: int = 0):
+    def export_prefix_span(self, prompt_ids, max_bytes: int = 0,
+                           trace_id: str = ""):
         """Serialize the longest stored device-tier span matching this
         prompt (page-aligned, like every prefix mapping) as a transfer
         frame, or None when nothing exportable is stored. Read-only and
@@ -3258,8 +3446,11 @@ class Engine:
             key=best["key"][:best_len], valid=best_len, hk=hk, hv=hv,
             geom=self._span_geometry(),
             max_bytes=max_bytes or transfer.DEFAULT_MAX_BYTES,
+            trace_id=trace_id,
         )
         self.m_span_exports += 1
+        # Any-thread caller → staged journal emit (ISSUE 11).
+        self._jstage("span_export", rid=trace_id, a=float(best_len))
         return frame
 
     def import_span_bytes(self, frame: bytes, max_bytes: int = 0,
@@ -3286,6 +3477,9 @@ class Engine:
         entry = {
             "key": key, "valid": valid, "hk": hk, "hv": hv,
             "bytes": hk.shape[1] * self._page_bytes(),
+            # Trace continuity (ISSUE 11): the frame header carries the
+            # exporter's trace id so the import journals under it.
+            "trace": transfer.span_meta(frame).get("trace", ""),
         }
         done = threading.Event()
         with self._span_inbox_lock:
@@ -3318,11 +3512,15 @@ class Engine:
                 if covered:
                     entry["accepted"] = True  # already served locally
                     self.m_span_imports += 1
+                    self._jnote("span_import", rid=entry.get("trace", ""),
+                                a=float(entry["valid"]))
                 elif self._host_make_room(entry["bytes"]):
                     self._prefix_host.insert(0, entry)
                     self._host_bytes += entry["bytes"]
                     entry["accepted"] = True
                     self.m_span_imports += 1
+                    self._jnote("span_import", rid=entry.get("trace", ""),
+                                a=float(entry["valid"]))
                 else:
                     self.m_span_import_rejects += 1
             finally:
@@ -3529,6 +3727,13 @@ class Engine:
                 break
         self.m_prefix_hits += 1
         self.m_prefix_tokens += match_len
+        self._jnote("prefix_hit", rid=handle.rid, slot=slot_idx,
+                    a=float(match_len))
+        self._jnote("admitted", rid=handle.rid, slot=slot_idx,
+                    a=float(len(ids)))
+        tr0 = handle.trace
+        if tr0 is not None:
+            tr0.note("prefix_hit", matched_tokens=match_len)
         for kf in _SAMPLING_FIELDS:
             self.h_sampling[kf][slot_idx] = getattr(request, kf)
         if self._mrope:
@@ -3840,6 +4045,21 @@ class Engine:
             self._token_str(0)  # build the table here, not in the engine loop
         handle = RequestHandle()
         handle.t_submit = time.monotonic()
+        # Lifecycle tracing (ISSUE 11): every request gets a journal id;
+        # span-tree recording only when the caller named the request (the
+        # HTTP layer always does) or sent a W3C traceparent — anonymous
+        # library/bench submits stay zero-overhead on the trace side.
+        handle.rid = request.request_id or f"h{id(handle):x}"
+        tr = None
+        if request.request_id or request.traceparent:
+            tr = otrace.RequestTrace(
+                handle.rid, traceparent=request.traceparent,
+                engine=self.cfg.name,
+            )
+            handle.trace = tr
+            handle._q.trace = tr
+            otrace.STORE.register(tr)
+            tr.note("queued", prompt_tokens=len(request.prompt_ids))
         deadline_s = request.deadline_s or self.ecfg.deadline_s
         if deadline_s > 0:
             handle.deadline = handle.t_submit + deadline_s
@@ -3847,25 +4067,35 @@ class Engine:
         # set-dead-and-drain: either this submit observes the death (error
         # event below) or its entry lands before the drain and is drained
         # with an error event — never appended after it and orphaned.
-        with self._pending_lock:
-            dead = self._loop_dead
-            if dead is None:
-                if (self.ecfg.max_pending
-                        and len(self._pending) >= self.ecfg.max_pending):
-                    # Shed at the door (ISSUE 4): a queue past max_pending
-                    # only manufactures timeouts. Raise a typed error the
-                    # HTTP layer maps to 429 + Retry-After.
-                    self.m_queue_shed += 1
-                    raise QueueFullError(
-                        len(self._pending), self.ecfg.max_pending,
-                        self.admission_wait_estimate(),
-                    )
-                self._pending.append((request, handle))
-                self._last_submit_t = handle.t_submit
+        try:
+            with self._pending_lock:
+                dead = self._loop_dead
+                if dead is None:
+                    if (self.ecfg.max_pending
+                            and len(self._pending) >= self.ecfg.max_pending):
+                        # Shed at the door (ISSUE 4): a queue past
+                        # max_pending only manufactures timeouts. Raise a
+                        # typed error the HTTP layer maps to 429 +
+                        # Retry-After.
+                        self.m_queue_shed += 1
+                        raise QueueFullError(
+                            len(self._pending), self.ecfg.max_pending,
+                            self.admission_wait_estimate(),
+                        )
+                    self._pending.append((request, handle))
+                    self._last_submit_t = handle.t_submit
+        except QueueFullError as e:
+            # The handle never reaches a consumer — close its trace here
+            # so the span tree still ends in exactly one terminal.
+            if tr is not None:
+                tr.terminal(TokenEvent(kind="error", error=str(e)))
+            raise
         if dead is not None:
             # The loop thread is gone — nothing will ever serve this request.
             handle._q.put(TokenEvent(kind="error", error=dead))
             return handle
+        self._jstage("queued", rid=handle.rid,
+                     a=float(len(request.prompt_ids)))
         self._wake.set()
         self.start()
         return handle
@@ -3880,6 +4110,10 @@ class Engine:
         (loop thread only; handles built outside submit() carry no stamp)."""
         if handle.t_submit <= 0.0:
             return
+        handle.t_admit = time.monotonic()
+        tr = handle.trace
+        if tr is not None:
+            tr.note("admitted")
         wait = max(0.0, time.monotonic() - handle.t_submit)
         if self._admit_wait_ewma == 0.0:
             self._admit_wait_ewma = wait
@@ -4013,6 +4247,11 @@ class Engine:
             out["adapter_promotes"] = float(self.m_adapter_promotes)
             out["adapter_evictions"] = float(self.m_adapter_evictions)
         out["peak_active_slots"] = float(self.m_peak_active)
+        if self._journal is not None:
+            # Lifecycle journal health (ISSUE 11): total events recorded
+            # and cross-thread events dropped by a stalled writer.
+            out["journal_events"] = float(self._journal.n)
+            out["journal_dropped"] = float(self._journal.dropped_staged)
         if self.ecfg.prefill_chunk:
             out["prefill_chunks"] = float(self.m_prefill_chunks)
             out["chunked_admissions"] = float(self.m_chunked_admits)
@@ -4407,22 +4646,52 @@ class Engine:
             with self._pending_lock:
                 self._loop_dead = err
                 pending, self._pending = list(self._pending), deque()
-            for i in range(self.ecfg.max_slots):
-                slot = self.slots[i]
-                if slot is not None:
-                    slot.handle._q.put(TokenEvent(kind="error", error=err))
-            for request, handle in pending:
-                self._resume_discard(request)
-                handle._q.put(TokenEvent(kind="error", error=err))
+            # Flight-recorder context (ISSUE 11): capture the dying
+            # request set BEFORE the teardown clears it — the postmortem
+            # names exactly what was live/pending at death, and the error
+            # events below post through these captured handles.
+            live_slots = [
+                (i, s) for i, s in enumerate(self.slots) if s is not None
+            ]
+            live_snapshot = [
+                (i, s.handle.rid, len(s.generated), s.prompt_len)
+                for i, s in live_slots
+            ]
+            pending_rids = [h.rid for _r, h in pending]
             # Crash-only teardown (ISSUE 4): release every per-request
-            # claim on the page pool and host tier so the dying engine's
-            # accounting quiesces clean — the manager will evict and reload,
-            # but the fault harness (and any monitoring scrape in between)
-            # must see a fully-accounted pool, not one wedged mid-request.
+            # claim on the page pool and host tier BEFORE any terminal
+            # event posts — the moment a caller unblocks it may assert the
+            # pool fully accounted (the fault sweep does exactly that), so
+            # the release must already be complete, not merely imminent.
+            # Queued resume images surrender their host-tier bytes first
+            # (release zeroes the tier wholesale; discarding after it
+            # would double-subtract).
             try:
+                for request, _handle in pending:
+                    self._resume_discard(request)
                 self._release_all_state()
             except Exception:  # noqa: BLE001 — best-effort on a dead engine
                 log.exception("post-death state release failed")
+            for _i, slot in live_slots:
+                slot.handle._q.put(TokenEvent(kind="error", error=err))
+            for _request, handle in pending:
+                handle._q.put(TokenEvent(kind="error", error=err))
+            # Flight recorder (ISSUE 11): this thread is the journal's
+            # writer, so the final events and the dump race nothing.
+            try:
+                j = self._journal
+                if j is not None:
+                    j.drain_staged()
+                self._jnote("loop_dead", a=float(len(live_snapshot)),
+                            b=float(len(pending_rids)))
+                self._jnote_fault(e)
+                self._postmortem_path = self._write_postmortem(
+                    err, live_snapshot, pending_rids
+                )
+                log.error("engine postmortem written to %s",
+                          self._postmortem_path)
+            except Exception:  # noqa: BLE001 — the dump must not mask the crash
+                log.exception("postmortem write failed")
             # No re-raise: the failure is fully reported (log + error events);
             # an unhandled thread exception would only add noise.
 
@@ -4472,6 +4741,11 @@ class Engine:
         while not self._shutdown.is_set():
             faults.fire("engine_loop")  # injected loop death (ISSUE 4)
             self._charge()
+            jr = self._journal
+            if jr is not None:
+                # Move cross-thread events (queued, span export) into the
+                # single-writer ring in order.
+                jr.drain_staged()
             self._purge_pending()
             self._enforce_deadlines()
             self._drain_span_inbox()
@@ -4511,6 +4785,8 @@ class Engine:
                     did = self._dispatch_block(grammar)
                 except Exception as e:  # noqa: BLE001 — fail requests, not the loop
                     log.exception("decode block dispatch failed")
+                    self._jnote("error", a=1.0)
+                    self._jnote_fault(e)
                     for i in range(self.ecfg.max_slots):
                         slot = self.slots[i]
                         if slot is not None:
@@ -4520,6 +4796,18 @@ class Engine:
                             self._release(i)
                     continue
                 if did:
+                    dispatch_ms = (time.monotonic() - t0) * 1000.0
+                    ent = self._inflight[-1]
+                    # Optional fenced device time (LOCALAI_TRACE_FENCE):
+                    # the fence module is the declared sync point — this
+                    # serializes the pipeline and is debug-only.
+                    fence_ms = (ofence.fenced_wait_ms(ent.toks)
+                                if self._trace_fence else 0.0)
+                    self._jnote("decode_block", slot=-1, a=float(ent.n),
+                                b=dispatch_ms)
+                    self._jnote("loop_iter", slot=-1,
+                                a=float(int(self.h_active.sum())),
+                                b=fence_ms)
                     if trace:
                         print(f"[eng {time.monotonic():.3f}] dispatch block n={self._inflight[-1].n} "
                               f"took {(time.monotonic()-t0)*1000:.1f}ms inflight={len(self._inflight)}")
@@ -4747,6 +5035,11 @@ class Engine:
                 if (len(self._free_pages) >= need
                         and self._dispatch_resume_swap(request, handle, free[0])):
                     self._note_admitted(handle)
+                    tr = handle.trace
+                    if tr is not None:
+                        # Swap resumes skip the admission program entirely
+                        # (no first-token entry will mark the decode phase).
+                        tr.note("resumed")
                     admitted = True
                     continue  # re-plan the remaining queue
                 with self._pending_lock:
@@ -4805,6 +5098,8 @@ class Engine:
                     admitted = True
                 except Exception as e:  # noqa: BLE001 — surface to callers, keep serving
                     log.exception("admission dispatch failed (m=%d)", len(chunk))
+                    self._jnote("error", a=float(len(chunk)))
+                    self._jnote_fault(e)
                     for request, handle in chunk:
                         handle._q.put(
                             TokenEvent(kind="error", error=f"{type(e).__name__}: {e}")
@@ -5060,6 +5355,8 @@ class Engine:
             self.h_gmask[slot_idx] = 1.0 if with_dfa else 0.0
             self.h_adapter[slot_idx] = adapter_rows[j]
             items.append((slot_idx, r, handle, int(aux[0, j]), t0))
+            self._jnote("admitted", rid=handle.rid, slot=slot_idx,
+                        a=float(aux[0, j]), b=float(m))
             if r.image_embeds is None and r.adapter is None:
                 # Adapter slots never feed the prefix cache: their K/V rows
                 # are tenant-specific (wk/wv deltas), so a token-keyed span
@@ -5355,10 +5652,18 @@ class Engine:
                         self.h_override_tok[slot_idx] = chosen
                         self.h_override_mask[slot_idx] = True
                     tok = chosen
+                tr = handle.trace
                 if not slot.t_first:
                     # Resumed slots keep their original TTFT; only a truly
                     # first token stamps it.
                     slot.t_first = time.monotonic()
+                    self._jnote("first_token", rid=handle.rid, slot=slot_idx)
+                    if tr is not None:
+                        tr.note("first_token")
+                elif tr is not None:
+                    # A recompute resume re-admits through the ordinary
+                    # admission program — mark the stream back in decode.
+                    tr.note("resumed")
                 self.m_prompt_tokens += plen
                 lpj = (lp[0][j], lp[1][j], lp[2][j]) if lp is not None else None
                 self._post_token(slot_idx, tok, lpj)
@@ -5585,7 +5890,13 @@ class Engine:
             )
         now = time.monotonic()
         t_first = slot.t_first or now
-        slot.handle._q.put(
+        h = slot.handle
+        queue_wait = 0.0
+        if h.t_submit > 0.0 and h.t_admit >= h.t_submit:
+            queue_wait = h.t_admit - h.t_submit
+        self._jnote("terminal", rid=h.rid, slot=slot_idx,
+                    a=float(len(slot.generated)))
+        h._q.put(
             TokenEvent(
                 kind="done",
                 finish_reason=reason,
@@ -5593,6 +5904,7 @@ class Engine:
                 completion_tokens=len(slot.generated),
                 timing_prompt_processing=t_first - slot.t_submit,
                 timing_token_generation=now - t_first,
+                timing_queue_wait=queue_wait,
             )
         )
         self._release(slot_idx)

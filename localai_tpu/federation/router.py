@@ -426,6 +426,13 @@ class FederatedServer:
                     k: v for k, v in self.headers.items()
                     if k.lower() not in HOP_HEADERS and k != "LocalAI-Worker"
                 }
+                if not any(k.lower() == "traceparent" for k in headers):
+                    # Trace propagation (ISSUE 11): clients that sent no
+                    # W3C traceparent still get ONE trace id across every
+                    # worker hop — the front door mints it.
+                    from localai_tpu.observe.trace import new_traceparent
+
+                    headers["traceparent"] = new_traceparent()
                 req = urllib.request.Request(
                     worker.url + self.path, data=body, headers=headers,
                     method=self.command,

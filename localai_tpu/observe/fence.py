@@ -1,0 +1,29 @@
+"""Opt-in fenced device timing (LOCALAI_TRACE_FENCE=1), ISSUE 11.
+
+This module is a DECLARED synchronization point and is deliberately
+excluded from the trace-safety lint targets, exactly like the engine's
+drainer thread: its whole purpose is to block on the device, and it only
+runs when the operator explicitly asked for fenced per-dispatch device
+times (which serializes the pipeline — a measurement mode, not a serving
+mode). Everything else in localai_tpu/observe/ IS lint-covered and must
+stay sync-free.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+
+def fenced_wait_ms(x: Any) -> float:
+    """Block until `x` (an array or pytree of arrays) is ready; return the
+    wait in milliseconds. Returns 0.0 on any failure — fencing is a debug
+    measurement, never worth failing a dispatch over."""
+    t0 = time.monotonic()
+    try:
+        import jax
+
+        jax.block_until_ready(x)
+    except Exception:  # noqa: BLE001 — measurement only
+        return 0.0
+    return (time.monotonic() - t0) * 1000.0

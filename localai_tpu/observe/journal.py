@@ -1,0 +1,172 @@
+"""Bounded ring-buffer event journal for the engine loop (ISSUE 11).
+
+Design constraints, in order:
+
+- The append path is called from the engine loop between decode-block
+  dispatches, so it must be O(1), allocation-free, and never touch the
+  device. Storage is a preallocated numpy structured array; appends write
+  FIELD-WISE into fixed storage (no tuple/dict is built), and the only
+  state change is a monotonically-growing sequence counter. The loop
+  thread is the single writer — no lock on the hot path.
+- Other threads DO emit lifecycle events (submit queues a request on a
+  caller thread, span export runs on HTTP threads). Those `stage()` into a
+  small locked sidecar list the loop thread drains at the top of each
+  iteration (`drain_staged` — same idiom as the engine's span inbox), so
+  the ring stays single-writer.
+- Readers (`snapshot`) are best-effort: they copy the buffer and walk it
+  by sequence number. A reader racing the writer can observe a freshly
+  overwritten slot — acceptable for a flight recorder; the alternative is
+  a lock on every append.
+
+Event types are declared here (`EVENTS`); the fault subset
+(`FAULT_EVENTS`) mirrors `localai_tpu.testing.faults.SITES` one-to-one and
+the `journal-events` lint pass (tools/lint) checks BOTH directions, the
+same contract the `fault-sites` pass enforces for `faults.fire()` calls.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+# Lifecycle + loop events. Order is the wire code (index), so append-only.
+BASE_EVENTS = (
+    "queued",        # request entered the pending queue (staged; rid)
+    "admitted",      # slot claimed, admission program dispatched (slot, a=plen)
+    "chunk",         # one mid prefill chunk dispatched (slot, a=tokens)
+    "first_token",   # admission result produced the first token (slot)
+    "decode_block",  # decode/spec block dispatched (a=block size, b=dispatch ms)
+    "loop_iter",     # loop iteration that dispatched (a=occupancy, b=fenced device ms)
+    "preempt",       # slot preempted for pool pressure (slot, a=ctx rows)
+    "swap_out",      # preempt-swap image written to the host tier (a=bytes)
+    "swap_in",       # swap resume restored pool pages (slot, a=bytes)
+    "resume",        # recompute resume re-admitted (slot)
+    "prefix_hit",    # admission mapped a cached span (slot, a=matched tokens)
+    "span_export",   # prefix span framed for transfer (staged; a=tokens)
+    "span_import",   # transfer frame merged into the host tier (a=tokens)
+    "terminal",      # request finished (slot, a=completion tokens)
+    "error",         # a dispatch failed; affected requests got error events
+    "loop_dead",     # the engine loop died (postmortem follows)
+    "profile",       # a jax.profiler capture window ran (a=seconds)
+)
+
+# One journal event type per fault-injection site (faults.SITES), checked
+# both directions by the journal-events lint pass: a site added without an
+# event type (or vice versa) is a finding. Literal on purpose — the check
+# is AST-level, like fault-sites.
+FAULT_EVENTS = (
+    "fault_device_dispatch",
+    "fault_engine_loop",
+    "fault_page_alloc",
+    "fault_host_swap",
+    "fault_manager_load",
+    "fault_cluster_dispatch",
+    "fault_span_transfer",
+    "fault_collective_dispatch",
+    "fault_adapter_fetch",
+)
+
+EVENTS = BASE_EVENTS + FAULT_EVENTS
+CODES = {name: i for i, name in enumerate(EVENTS)}
+
+_DTYPE = np.dtype([
+    ("t", np.float64),      # time.monotonic() at emit
+    ("code", np.int16),     # index into EVENTS
+    ("slot", np.int16),     # engine slot, -1 = engine-wide
+    ("a", np.float64),      # event-specific scalar (see EVENTS comments)
+    ("b", np.float64),      # second event-specific scalar
+    ("rid", "U40"),         # request id (empty for engine-wide events)
+])
+
+_STAGED_CAP = 1024
+
+
+class EventJournal:
+    """Fixed-capacity ring of typed events. Single writer (the engine
+    loop); `stage()` is the cross-thread entry point."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = max(int(capacity), 8)
+        self._buf = np.zeros(self.capacity, dtype=_DTYPE)
+        self.n = 0  # total events ever appended (monotonic sequence)
+        self._staged: list[tuple] = []
+        self._staged_lock = threading.Lock()
+        self.dropped_staged = 0
+        # Wall-clock anchor so exports can place monotonic stamps in time.
+        self.t0_mono = time.monotonic()
+        self.t0_wall = time.time()
+
+    # ---------------- write side ---------------- #
+
+    def append(self, event: str, rid: str = "", slot: int = -1,
+               a: float = 0.0, b: float = 0.0) -> None:
+        """Writer-thread append: O(1), no allocation, no lock, no device."""
+        self._append_raw(time.monotonic(), event, rid, slot, a, b)
+
+    def _append_raw(self, t: float, event: str, rid: str, slot: int,
+                    a: float, b: float) -> None:
+        i = self.n % self.capacity
+        buf = self._buf
+        buf["t"][i] = t
+        buf["code"][i] = CODES[event]
+        buf["slot"][i] = slot
+        buf["a"][i] = a
+        buf["b"][i] = b
+        buf["rid"][i] = rid
+        self.n += 1
+
+    def stage(self, event: str, rid: str = "", slot: int = -1,
+              a: float = 0.0, b: float = 0.0) -> None:
+        """Cross-thread emit: park the event for the writer thread to
+        append in order. Bounded — a stalled writer drops (and counts)
+        staged events instead of growing without limit."""
+        rec = (time.monotonic(), event, rid, slot, a, b)
+        with self._staged_lock:
+            if len(self._staged) >= _STAGED_CAP:
+                self.dropped_staged += 1
+                return
+            self._staged.append(rec)
+
+    def drain_staged(self) -> None:
+        """Writer thread: move staged events into the ring (original
+        timestamps preserved)."""
+        if not self._staged:  # unlocked peek — len() is atomic in CPython
+            return
+        with self._staged_lock:
+            staged, self._staged = self._staged, []
+        for rec in staged:
+            self._append_raw(*rec)
+
+    # ---------------- read side ---------------- #
+
+    def snapshot(self, last: int | None = None) -> list[dict]:
+        """Best-effort ordered copy of the retained events (ring tail +
+        currently staged), oldest first. Safe from any thread."""
+        n = self.n
+        buf = self._buf.copy()
+        start = max(0, n - self.capacity)
+        out = []
+        for seq in range(start, n):
+            rec = buf[seq % self.capacity]
+            out.append({
+                "seq": seq,
+                "t": float(rec["t"]),
+                "event": EVENTS[int(rec["code"])],
+                "slot": int(rec["slot"]),
+                "a": float(rec["a"]),
+                "b": float(rec["b"]),
+                "rid": str(rec["rid"]),
+            })
+        with self._staged_lock:
+            staged = list(self._staged)
+        for t, event, rid, slot, a, b in staged:
+            out.append({
+                "seq": -1, "t": float(t), "event": event, "slot": int(slot),
+                "a": float(a), "b": float(b), "rid": str(rid),
+            })
+        out.sort(key=lambda e: e["t"])
+        if last is not None and last >= 0:
+            out = out[-last:]
+        return out

@@ -1,0 +1,40 @@
+"""On-demand jax.profiler capture windows (/debug/profile), ISSUE 11.
+
+Gated behind LOCALAI_PROFILE (the capture output directory): profiling
+allocates device trace buffers and perturbs serving, so it must be an
+explicit operator opt-in, not a reachable default. One capture at a time —
+jax.profiler keeps process-global state. Like `fence`, this module is a
+declared sync/measurement point outside the trace-safety lint targets.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+_capture_lock = threading.Lock()
+
+MAX_SECONDS = 30.0
+
+
+def capture(dirpath: str, seconds: float) -> dict:
+    """Run one profiler capture window (blocking). Raises RuntimeError
+    when a capture is already in flight or the profiler fails."""
+    seconds = max(0.1, min(float(seconds), MAX_SECONDS))
+    if not _capture_lock.acquire(blocking=False):
+        raise RuntimeError("a profiler capture is already running")
+    try:
+        import jax
+
+        t0 = time.monotonic()
+        jax.profiler.start_trace(dirpath)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+        return {
+            "dir": dirpath,
+            "seconds": round(time.monotonic() - t0, 3),
+        }
+    finally:
+        _capture_lock.release()

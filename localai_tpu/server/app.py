@@ -125,9 +125,16 @@ class ApiError(Exception):
 
 
 class Metrics:
-    """api_call duration histogram + engine gauges, Prometheus text format.
+    """Named, labeled duration histograms + engine gauges, Prometheus text
+    format.
 
     Reference: core/services/metrics.go:28-46 (OTel histogram `api_call`).
+    Generalized (ISSUE 11): `observe(name, seconds, labels)` records into
+    any histogram — `api_call` by path as before, plus the per-model
+    request-lifecycle histograms (ttft, inter_token, queue_wait, admit)
+    the API layer feeds from terminal-event timings. Each histogram
+    renders its own `# HELP`/`# TYPE` block.
+
     Gauges come from two places: `gauge()` for values the server pushes,
     and `add_gauge_source()` callbacks polled at scrape time — how the
     per-model engine gauges (kv pages, queue depth, preemptions, swap
@@ -136,22 +143,35 @@ class Metrics:
 
     BUCKETS = (0.005, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0, float("inf"))
 
+    # # HELP text per histogram (unknown names get a generic line).
+    HELP = {
+        "api_call": "API call duration seconds",
+        "ttft": "Time to first token seconds (queue wait included)",
+        "inter_token": "Mean inter-token interval seconds per request",
+        "queue_wait": "Seconds a request waited in the pending queue",
+        "admit": "Admission-to-first-token seconds (prefill + sample)",
+    }
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._hist: dict[str, list[int]] = {}
-        self._sum: dict[str, float] = {}
-        self._count: dict[str, int] = {}
+        # Histograms keyed by (name, sorted label items).
+        self._hist: dict[tuple[str, tuple], list[int]] = {}
+        self._sum: dict[tuple[str, tuple], float] = {}
+        self._count: dict[tuple[str, tuple], int] = {}
         self._gauges: dict[tuple[str, tuple], float] = {}
         self._gauge_sources: list[Callable[[], Any]] = []
 
-    def observe(self, path: str, seconds: float) -> None:
+    def observe(self, name: str, seconds: float,
+                labels: Optional[dict[str, str]] = None) -> None:
+        """Record one duration sample into the named histogram."""
+        key = (name, tuple(sorted((labels or {}).items())))
         with self._lock:
-            h = self._hist.setdefault(path, [0] * len(self.BUCKETS))
+            h = self._hist.setdefault(key, [0] * len(self.BUCKETS))
             for i, b in enumerate(self.BUCKETS):
                 if seconds <= b:
                     h[i] += 1
-            self._sum[path] = self._sum.get(path, 0.0) + seconds
-            self._count[path] = self._count.get(path, 0) + 1
+            self._sum[key] = self._sum.get(key, 0.0) + seconds
+            self._count[key] = self._count.get(key, 0) + 1
 
     def gauge(self, name: str, value: float,
               labels: Optional[dict[str, str]] = None) -> None:
@@ -163,32 +183,55 @@ class Metrics:
 
     def add_gauge_source(self, fn: Callable[[], Any]) -> None:
         """Register a scrape-time callback yielding (name, labels, value)
-        triples — polled fresh on every /metrics render."""
-        self._gauge_sources.append(fn)
+        triples — polled fresh on every /metrics render. Registration is
+        locked: render() snapshots this list under the same lock (the
+        unguarded append/iterate pair was a cross-thread race)."""
+        with self._lock:
+            self._gauge_sources.append(fn)
 
     @staticmethod
-    def _fmt_labels(labels: tuple) -> str:
-        if not labels:
-            return ""
+    def _fmt_labels(labels: tuple, extra: str = "") -> str:
         inner = ",".join(f'{k}="{v}"' for k, v in labels)
+        if extra:
+            inner = f"{inner},{extra}" if inner else extra
+        if not inner:
+            return ""
         return "{" + inner + "}"
 
     def render(self) -> str:
-        lines = [
-            "# HELP localai_api_call API call duration seconds",
-            "# TYPE localai_api_call histogram",
-        ]
+        lines: list[str] = []
         with self._lock:
-            for path, h in sorted(self._hist.items()):
+            hist = {k: list(v) for k, v in self._hist.items()}
+            sums = dict(self._sum)
+            counts = dict(self._count)
+            samples = dict(self._gauges)
+            sources = list(self._gauge_sources)
+        by_hist: dict[str, list[tuple]] = {}
+        for name, labels in hist:
+            by_hist.setdefault(name, []).append(labels)
+        for name in sorted(by_hist):
+            help_text = self.HELP.get(name, f"{name} duration seconds")
+            lines.append(f"# HELP localai_{name} {help_text}")
+            lines.append(f"# TYPE localai_{name} histogram")
+            for labels in sorted(by_hist[name]):
+                key = (name, labels)
+                h = hist[key]
                 for i, b in enumerate(self.BUCKETS):
                     le = "+Inf" if b == float("inf") else repr(b)
+                    le_label = f'le="{le}"'
                     lines.append(
-                        f'localai_api_call_bucket{{path="{path}",le="{le}"}} {h[i]}'
+                        f"localai_{name}_bucket"
+                        f"{self._fmt_labels(labels, le_label)} {h[i]}"
                     )
-                lines.append(f'localai_api_call_sum{{path="{path}"}} {self._sum[path]}')
-                lines.append(f'localai_api_call_count{{path="{path}"}} {self._count[path]}')
-            samples = dict(self._gauges)
-        for src in self._gauge_sources:
+                lines.append(
+                    f"localai_{name}_sum{self._fmt_labels(labels)} {sums[key]}"
+                )
+                lines.append(
+                    f"localai_{name}_count{self._fmt_labels(labels)} "
+                    f"{counts[key]}"
+                )
+        # Gauge sources run OUTSIDE the lock (they may scrape engines).
+        for src in sources:
             try:
                 for name, labels, value in src():
                     key = (name, tuple(sorted((labels or {}).items())))
@@ -457,7 +500,8 @@ def create_server(app_cfg: ApplicationConfig, router: Router) -> ThreadingHTTPSe
                 self._respond(ApiError(500, f"{type(e).__name__}: {e}", "server_error").to_response())
                 return
             finally:
-                metrics.observe(path, time.monotonic() - start)
+                metrics.observe("api_call", time.monotonic() - start,
+                                {"path": path})
 
             if isinstance(result, SSEStream):
                 self._respond_sse(result)

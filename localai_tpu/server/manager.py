@@ -195,9 +195,12 @@ class ModelManager:
                 return False
             self._loaded.pop(name)
             self._note_death_locked(name, time.monotonic())
+        pm = getattr(lm.engine, "postmortem_path", "")
         log.warning(
-            "model %s: engine loop died (%s) — evicted for crash-only restart",
+            "model %s: engine loop died (%s) — evicted for crash-only "
+            "restart%s",
             name, getattr(lm.engine, "_loop_dead", "?"),
+            f" — postmortem: {pm}" if pm else "",
         )
         threading.Thread(
             target=self._teardown, args=(lm,), daemon=True,
@@ -743,6 +746,8 @@ class ModelManager:
                 max_pending=cfg.max_pending,
                 queue_timeout_s=cfg.queue_timeout_s,
                 deadline_s=cfg.deadline_s,
+                trace_journal_events=cfg.trace_journal_events,
+                postmortem_dir=self.app_cfg.postmortem_dir,
             ),
             draft_cfg=draft_arch,
             draft_params=draft_params,

@@ -67,12 +67,17 @@ class OpenAIApi:
     def __init__(self, manager: ModelManager):
         self.manager = manager
         self.started_at = time.time()
+        # Set by register(): the router carries the Metrics registry
+        # (create_server attaches it) that the per-model lifecycle
+        # histograms (ttft/inter_token/queue_wait/admit, ISSUE 11) feed.
+        self.router: Optional[Router] = None
 
     # ------------------------------------------------------------------ #
     # Routing
     # ------------------------------------------------------------------ #
 
     def register(self, r: Router) -> None:
+        self.router = r
         for prefix in ("/v1", ""):
             r.add("POST", f"{prefix}/chat/completions", self.chat)
             r.add("POST", f"{prefix}/completions", self.completion)
@@ -97,6 +102,13 @@ class OpenAIApi:
         r.add("GET", "/cluster/status", self.cluster_status)
         r.add("POST", "/cluster/span/export", self.cluster_span_export)
         r.add("POST", "/cluster/span/import", self.cluster_span_import)
+        # Request-lifecycle observability (ISSUE 11, docs/OBSERVABILITY.md):
+        # per-request span trees (W3C traceparent propagated), the engine
+        # journal as Perfetto-loadable Chrome trace JSON, and an opt-in
+        # jax.profiler capture window (LOCALAI_PROFILE gates it).
+        r.add("GET", "/debug/trace/:request_id", self.debug_trace)
+        r.add("GET", "/debug/timeline", self.debug_timeline)
+        r.add("POST", "/debug/profile", self.debug_profile)
         # Engine gauges (kv pages free/total, queue depth, preemptions,
         # swap bytes, prefix host tier, ...) ride the Prometheus scrape as
         # localai_engine_*{model=...} — create_server polls this at every
@@ -324,6 +336,45 @@ class OpenAIApi:
         return "".join(parts), toks, final
 
     @staticmethod
+    def _tag_requests(gens: list, rid: str, traceparent: str) -> None:
+        """Stamp lifecycle-tracing identity onto each GenRequest (ISSUE 11):
+        the response id keys /debug/trace/{id} (choice i > 0 gets `-i`),
+        and a traceparent is minted when the client sent none so every
+        leg — cluster replicas, disaggregated prefill/decode — shares one
+        trace id."""
+        from localai_tpu.observe.trace import new_traceparent, parse_traceparent
+
+        if not (traceparent and parse_traceparent(traceparent)):
+            traceparent = new_traceparent()
+        for i, g in enumerate(gens):
+            g.request_id = rid if i == 0 else f"{rid}-{i}"
+            g.traceparent = traceparent
+
+    def _note_request_metrics(self, model_name: str, finals: list) -> None:
+        """Feed the per-model lifecycle histograms (ISSUE 11) from the
+        terminal events' timing fields. No-op when the router has no
+        Metrics yet (unit tests that call handlers directly)."""
+        m = getattr(self.router, "metrics", None) if self.router else None
+        if m is None:
+            return
+        labels = {"model": model_name}
+        for f in finals:
+            if f is None or getattr(f, "kind", "done") != "done":
+                continue
+            m.observe("queue_wait", f.timing_queue_wait, labels)
+            m.observe("admit", f.timing_prompt_processing, labels)
+            m.observe(
+                "ttft", f.timing_queue_wait + f.timing_prompt_processing,
+                labels,
+            )
+            if f.completion_tokens > 1 and f.timing_token_generation > 0:
+                m.observe(
+                    "inter_token",
+                    f.timing_token_generation / (f.completion_tokens - 1),
+                    labels,
+                )
+
+    @staticmethod
     def _sum_usage(finals: list, extra: bool) -> dict[str, Any]:
         pt = sum(f.prompt_tokens for f in finals)
         ct = sum(f.completion_tokens for f in finals)
@@ -506,6 +557,7 @@ class OpenAIApi:
             gens.append(g)
 
         rid = f"chatcmpl-{uuid.uuid4().hex[:28]}"
+        self._tag_requests(gens, rid, req.headers.get("traceparent", ""))
         created = _now()
         model_name = lm.cfg.name
         extra_usage = "extra-usage" in req.headers
@@ -564,6 +616,7 @@ class OpenAIApi:
                         else:
                             finals[idx] = ev
                     done_finals = [f for f in finals if f is not None]
+                    self._note_request_metrics(model_name, done_finals)
                     for idx in range(n):
                         s, final = st[idx], finals[idx]
                         if final is None:
@@ -605,6 +658,7 @@ class OpenAIApi:
 
         from localai_tpu.utils.finetune import finetune, needs_finetune
 
+        self._note_request_metrics(model_name, [r[2] for r in results])
         choices = []
         for idx, (text, toks, final) in enumerate(results):
             if needs_finetune(lm.cfg):
@@ -649,7 +703,10 @@ class OpenAIApi:
         created = _now()
         extra_usage = "extra-usage" in req.headers
         try:
-            return self._completion_inner(lm, lease, body, prompts, rid, created, extra_usage)
+            return self._completion_inner(
+                lm, lease, body, prompts, rid, created, extra_usage,
+                traceparent=req.headers.get("traceparent", ""),
+            )
         except BaseException:
             lease.release()
             raise
@@ -681,7 +738,8 @@ class OpenAIApi:
             "top_logprobs": tops, "text_offset": offsets,
         }
 
-    def _completion_inner(self, lm, lease, body, prompts, rid, created, extra_usage) -> Response | SSEStream:
+    def _completion_inner(self, lm, lease, body, prompts, rid, created,
+                          extra_usage, traceparent="") -> Response | SSEStream:
         n = self._n_choices(body)
         lp_n = self._completion_lp(body)
 
@@ -705,6 +763,7 @@ class OpenAIApi:
                 if g.seed is not None and n > 1:
                     g.seed = int(g.seed) + j
                 gens.append(g)
+        self._tag_requests(gens, rid, traceparent)
 
         if body.get("stream"):
             handles = self._submit_all(lm, gens)
@@ -730,6 +789,7 @@ class OpenAIApi:
                         else:
                             finals[idx] = ev
                     done = [f for f in finals if f is not None]
+                    self._note_request_metrics(lm.cfg.name, done)
                     for idx, final in enumerate(finals):
                         if final is None:
                             continue
@@ -755,6 +815,7 @@ class OpenAIApi:
 
         from localai_tpu.utils.finetune import finetune, needs_finetune
 
+        self._note_request_metrics(lm.cfg.name, [r[2] for r in results])
         choices = []
         for idx, (text, toks, final) in enumerate(results):
             prompt = prompts[idx // n]
@@ -786,9 +847,15 @@ class OpenAIApi:
         try:
             prompt = lm.evaluator.template_edit(instruction, body.get("input", ""))
             ids = lm.engine.tokenizer.encode(prompt, add_bos=True)
-            text, final = self._submit_all(lm, [self._gen_request(lm, body, ids)])[0].result()
+            g = self._gen_request(lm, body, ids)
+            self._tag_requests(
+                [g], f"edit-{uuid.uuid4().hex[:28]}",
+                req.headers.get("traceparent", ""),
+            )
+            text, final = self._submit_all(lm, [g])[0].result()
         finally:
             lease.release()
+        self._note_request_metrics(lm.cfg.name, [final])
         if needs_finetune(lm.cfg):
             text = finetune(lm.cfg, prompt, text)
         return Response(body={
@@ -909,7 +976,15 @@ class OpenAIApi:
             except Exception:  # noqa: BLE001 — scrape survives a dying engine
                 continue
             for k, v in gauges.items():
-                out.append((f"localai_engine_{k}", {"model": n}, v))
+                labels = {"model": n}
+                if k == "loop_dead":
+                    # Flight recorder (ISSUE 11): a dead loop's gauge
+                    # carries the postmortem path so the on-call can jump
+                    # from the alert straight to the dump.
+                    pm = getattr(lm.engine, "postmortem_path", "")
+                    if pm:
+                        labels["postmortem"] = pm
+                out.append((f"localai_engine_{k}", labels, v))
         # Supervision gauges (ISSUE 4): restart / quarantine counters live
         # on the manager, not the (replaceable) engines.
         out.extend(self.manager.health_gauges())
@@ -1008,3 +1083,90 @@ class OpenAIApi:
             req.raw_body, max_bytes=self.manager.app_cfg.transfer_max_bytes
         )
         return Response(body={"imported": bool(ok)})
+
+    # ------------------------------------------------------------------ #
+    # Request-lifecycle observability (ISSUE 11, docs/OBSERVABILITY.md)
+    # ------------------------------------------------------------------ #
+
+    def debug_trace(self, req: Request) -> Response:
+        """Span tree(s) for one request id — every leg the process saw
+        (engine, cluster coordinator, disaggregated prefill), grouped by
+        trace id. The id is the OpenAI response id (`chatcmpl-*`/`cmpl-*`,
+        `-i` suffix for choice i > 0)."""
+        from localai_tpu.observe.trace import STORE
+
+        rid = req.params["request_id"]
+        data = STORE.get_json(rid)
+        if data is None:
+            raise ApiError(
+                404,
+                f"no trace recorded for request {rid!r} (traces are kept "
+                "for the most recent requests only)",
+            )
+        return Response(body=data)
+
+    def _engine_journals(self, model: Optional[str]) -> dict:
+        """{display name: EventJournal} across loaded engines (peek only —
+        a debug pull must never trigger a model load). Cluster fan-outs
+        contribute one journal per replica."""
+        out: dict = {}
+        for n in self.manager.loaded_names():
+            if model and n != model:
+                continue
+            lm = self.manager.peek(n)
+            if lm is None:
+                continue
+            eng = lm.engine
+            journals = getattr(eng, "journals", None)
+            if callable(journals):  # ClusterEngine: one row per replica
+                for rname, j in journals().items():
+                    out[f"{n}/{rname}"] = j
+                continue
+            j = getattr(eng, "journal", None)
+            if j is not None:
+                out[n] = j
+        return out
+
+    def debug_timeline(self, req: Request) -> Response:
+        """The engine journal(s) as Chrome trace-event JSON — load the
+        response body directly in Perfetto / chrome://tracing. `?model=`
+        narrows to one model; cluster replicas render as process rows."""
+        from localai_tpu.observe.timeline import chrome_trace
+
+        model = (req.query.get("model") or [None])[0]
+        journals = self._engine_journals(model)
+        if not journals:
+            raise ApiError(
+                404,
+                "no event journal available"
+                + (f" for model {model!r}" if model else "")
+                + " — is the model loaded and trace_journal_events > 0?",
+            )
+        return Response(body=chrome_trace(journals))
+
+    def debug_profile(self, req: Request) -> Response:
+        """Run one jax.profiler capture window (POST {"seconds": N}).
+        Gated behind LOCALAI_PROFILE=<output dir>: profiling perturbs
+        serving and writes device traces to disk, so it is an explicit
+        operator opt-in."""
+        import os
+
+        from localai_tpu.observe import profile as oprofile
+
+        prof_dir = os.environ.get("LOCALAI_PROFILE", "")
+        if not prof_dir:
+            raise ApiError(
+                403,
+                "profiling is disabled — set LOCALAI_PROFILE=<output dir> "
+                "to allow /debug/profile capture windows",
+            )
+        seconds = float((req.body or {}).get("seconds", 1.0))
+        try:
+            result = oprofile.capture(prof_dir, seconds)
+        except RuntimeError as e:
+            raise ApiError(409, str(e)) from None
+        # Mark the capture window in every journal so the timeline and the
+        # profiler trace can be lined up.
+        for j in self._engine_journals(None).values():
+            j.stage("profile", a=result["seconds"])
+        return Response(body={"status": "ok", **result})

@@ -1,0 +1,11 @@
+"""Known-bad fixture: journal fault events that drifted from SITES."""
+
+BASE_EVENTS = ("queued", "terminal")
+
+FAULT_EVENTS = (
+    "fault_device_dispatch",
+    "fault_page_allok",   # typo'd site — must fire (no such SITES entry)
+    "badly_named_event",  # not fault_<site> shaped — must fire
+)
+
+EVENTS = BASE_EVENTS + FAULT_EVENTS

@@ -1,0 +1,6 @@
+"""Known-bad fixture: SITES entry with no journal fault event."""
+
+SITES = (
+    "device_dispatch",
+    "ghost_site",  # no fault_ghost_site in the journal — must fire
+)

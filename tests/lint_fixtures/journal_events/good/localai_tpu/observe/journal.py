@@ -1,0 +1,10 @@
+"""Known-good fixture: FAULT_EVENTS mirrors SITES exactly."""
+
+BASE_EVENTS = ("queued", "terminal")
+
+FAULT_EVENTS = (
+    "fault_device_dispatch",
+    "fault_engine_loop",
+)
+
+EVENTS = BASE_EVENTS + FAULT_EVENTS

@@ -1,0 +1,6 @@
+"""Known-good fixture: every site has its journal fault event."""
+
+SITES = (
+    "device_dispatch",
+    "engine_loop",
+)

@@ -21,6 +21,7 @@ from tools.lint.passes.attr_init import AttrInitPass  # noqa: E402
 from tools.lint.passes.config_drift import ConfigDriftPass  # noqa: E402
 from tools.lint.passes.donation_safety import DonationSafetyPass  # noqa: E402
 from tools.lint.passes.fault_sites import FaultSitesPass  # noqa: E402
+from tools.lint.passes.journal_events import JournalEventsPass  # noqa: E402
 from tools.lint.passes.lock_discipline import LockDisciplinePass  # noqa: E402
 from tools.lint.passes.lock_order import LockOrderPass  # noqa: E402
 from tools.lint.passes.metric_counters import MetricCountersPass  # noqa: E402
@@ -48,16 +49,16 @@ def _full_run():
 
 
 # --------------------------------------------------------------------- #
-# The acceptance gate: the repo itself is clean under all 12 passes.
+# The acceptance gate: the repo itself is clean under all 13 passes.
 # --------------------------------------------------------------------- #
 
 def test_repo_is_clean_under_all_passes():
     result, elapsed = _full_run()
-    assert len(result.pass_ids) == 12, result.pass_ids
+    assert len(result.pass_ids) == 13, result.pass_ids
     assert result.clean, "lint findings on the repo:\n" + "\n".join(
         f.render() for f in result.active
     )
-    # Tier-1 budget (ISSUE 5/8): all 12 passes under 10 s. Typical
+    # Tier-1 budget (ISSUE 5/8): all 13 passes under 10 s. Typical
     # unloaded wall time is ~4-5 s; the bound absorbs CI load. When this
     # trips, result.timings names the pass that regressed.
     assert elapsed < 10.0, (
@@ -295,6 +296,20 @@ def test_cli_since_mode():
     assert bad.returncode == 2, bad.stdout + bad.stderr
 
 
+def test_journal_events_fixtures():
+    """Flight-recorder consistency (ISSUE 11): SITES ↔ FAULT_EVENTS both
+    ways, fault-sites style."""
+    broot = os.path.join(FIX, "journal_events", "bad")
+    r = _run_single(JournalEventsPass(), root=broot)
+    msgs = "\n".join(f.message for f in r.active)
+    assert "ghost_site" in msgs, msgs          # site without journal event
+    assert "fault_page_allok" in msgs, msgs    # event naming no site
+    assert "badly_named_event" in msgs, msgs   # not fault_<site> shaped
+    groot = os.path.join(FIX, "journal_events", "good")
+    assert _run_single(JournalEventsPass(), root=groot).clean
+    assert JournalEventsPass.project_wide is True
+
+
 def test_fault_sites_fixtures():
     broot = os.path.join(FIX, "fault_sites", "bad")
     bad = FaultSitesPass()
@@ -332,15 +347,15 @@ def test_suppression_without_reason_is_a_finding():
                for f in r.active), r.findings
 
 
-def test_registry_has_the_twelve_passes():
+def test_registry_has_the_thirteen_passes():
     ids = [p.id for p in all_passes()]
     assert ids == [
         "attr-init", "metric-counters", "lock-discipline", "trace-safety",
         "terminal-event", "page-refcount", "config-drift", "fault-sites",
         "lock-order", "rng-key-reuse", "sharding-consistency",
-        "donation-safety",
+        "donation-safety", "journal-events",
     ], ids
-    assert len(set(ids)) == 12
+    assert len(set(ids)) == 13
 
 
 # --------------------------------------------------------------------- #

@@ -577,6 +577,18 @@ def _run_engine_schedule(tiny, seed, sites, rate=0.12, max_faults=3,
                                      max_faults=max_faults)
         with faults.active(sched):
             outcomes = _churn_traffic(eng, n_req=n_req, seed=seed)
+        if sched.fired.get("engine_loop", 0):
+            # An engine_loop injection ALWAYS kills the loop, but the raise
+            # may still be mid-flight on the loop thread when the window
+            # closes (idle iterations keep drawing from the schedule after
+            # the last outcome drains). Settle it — join the thread so the
+            # crash-only teardown (release + postmortem) has fully run —
+            # before branching on is_dead; otherwise this check races the
+            # death and the recovery probe below hits a dying engine.
+            t = eng._thread
+            if t is not None:
+                t.join(timeout=60.0)
+            assert eng.is_dead, "engine_loop fault fired but the loop lives"
         if eng.is_dead:
             assert len(eng._free_pages) == eng.ecfg.kv_pages
             assert eng._host_bytes == 0

@@ -1,0 +1,148 @@
+"""bench_gate: fail on >10% regressions between two BENCH_rNN.json rounds.
+
+The ROADMAP's still-unpaid bench-regression gate (ISSUE 11 satellite):
+perf landed between TPU runs could silently rot because nothing compared
+BENCH_rNN against rNN-1. This tool does exactly that:
+
+    python -m tools.bench_gate BENCH_r06.json BENCH_r05.json
+    python tools/bench_gate.py NEW.json OLD.json --threshold 0.10
+
+Input: either a raw bench metrics dict (the JSON line bench.py prints) or
+a BENCH_rNN.json wrapper whose `parsed` field holds it. Only keys PRESENT
+IN BOTH rounds are compared — new rows gate from their next round, removed
+rows are reported but don't fail (a renamed row should be caught in
+review, not silently dropped from the gate).
+
+Direction is inferred per key: throughput-like keys (tok/s, tps, speedup,
+rate, pct, concurrency, accepted) must not DROP more than the threshold;
+latency/size-like keys (_ms, _s suffixes, ttft, latency, stall, bytes,
+recover) must not RISE more than the threshold. Higher-is-better wins when
+both patterns match (`prefix_ttft_speedup` is a speedup).
+
+Exit codes: 0 = no regression, 1 = regression(s), 2 = usage/parse error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+DEFAULT_THRESHOLD = 0.10
+
+# Checked FIRST: a key matching any of these is higher-is-better even when
+# a lower-is-better marker also appears in it.
+HIGHER_MARKERS = (
+    "tok_per", "tokens_per", "tok/s", "tps", "speedup", "throughput",
+    "rate", "pct", "percent", "concurrency", "accepted", "roofline",
+    "fraction", "hits",
+)
+LOWER_MARKERS = (
+    "_ms", "_s", "ms_", "latency", "ttft", "stall", "bytes", "recover",
+    "err", "p50", "p95", "p99", "overhead",
+)
+
+# Non-metric bookkeeping keys in bench payloads.
+SKIP_KEYS = {"metric", "unit", "vs_baseline", "value"}
+
+
+def direction(key: str) -> str:
+    """'higher' (a drop regresses) or 'lower' (a rise regresses)."""
+    k = key.lower()
+    if any(m in k for m in HIGHER_MARKERS):
+        return "higher"
+    if any(m in k for m in LOWER_MARKERS):
+        return "lower"
+    return "higher"
+
+
+def load_metrics(path: str) -> dict[str, float]:
+    """Numeric metrics from a bench JSON (raw dict or BENCH_rNN wrapper)."""
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    if isinstance(data.get("parsed"), dict):
+        data = data["parsed"]
+    out: dict[str, float] = {}
+    for k, v in data.items():
+        if k in SKIP_KEYS or isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[k] = float(v)
+    return out
+
+
+def compare(new: dict[str, float], old: dict[str, float],
+            threshold: float = DEFAULT_THRESHOLD) -> dict:
+    """{'regressions': [...], 'improvements': [...], 'missing': [...],
+    'added': [...]} over the shared numeric keys."""
+    regressions, improvements = [], []
+    for key in sorted(set(new) & set(old)):
+        a, b = old[key], new[key]
+        if a == 0.0:
+            continue  # no baseline signal — a ratio would be meaningless
+        change = (b - a) / abs(a)
+        d = direction(key)
+        bad = -change if d == "higher" else change
+        entry = {
+            "key": key, "old": a, "new": b, "direction": d,
+            "change_pct": round(change * 100.0, 2),
+        }
+        if bad > threshold:
+            regressions.append(entry)
+        elif bad < -threshold:
+            improvements.append(entry)
+    return {
+        "regressions": regressions,
+        "improvements": improvements,
+        "missing": sorted(set(old) - set(new)),
+        "added": sorted(set(new) - set(old)),
+    }
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_gate",
+        description="fail on >threshold regressions between bench rounds",
+    )
+    ap.add_argument("new", help="current round JSON (BENCH_rNN.json)")
+    ap.add_argument("old", help="previous round JSON (BENCH_rNN-1.json)")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="allowed fractional drop on shared keys "
+                         "(default 0.10)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full comparison as JSON")
+    args = ap.parse_args(argv)
+    if args.threshold <= 0:
+        print("bench_gate: --threshold must be > 0", file=sys.stderr)
+        return 2
+    try:
+        new = load_metrics(args.new)
+        old = load_metrics(args.old)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"bench_gate: {e}", file=sys.stderr)
+        return 2
+    result = compare(new, old, threshold=args.threshold)
+    if args.json:
+        print(json.dumps(result, indent=1, sort_keys=True))
+    else:
+        for r in result["regressions"]:
+            print(f"REGRESSION {r['key']}: {r['old']} -> {r['new']} "
+                  f"({r['change_pct']:+.1f}%, {r['direction']}-is-better)")
+        for r in result["improvements"]:
+            print(f"improved   {r['key']}: {r['old']} -> {r['new']} "
+                  f"({r['change_pct']:+.1f}%)")
+        if result["missing"]:
+            print("missing vs previous round (not gated): "
+                  + ", ".join(result["missing"]))
+        n_shared = len(set(new) & set(old))
+        print(f"bench_gate: {len(result['regressions'])} regression(s) over "
+              f"{n_shared} shared key(s), threshold "
+              f"{args.threshold * 100:.0f}%")
+    return 1 if result["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

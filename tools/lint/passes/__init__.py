@@ -7,6 +7,7 @@ from .attr_init import AttrInitPass
 from .config_drift import ConfigDriftPass
 from .donation_safety import DonationSafetyPass
 from .fault_sites import FaultSitesPass
+from .journal_events import JournalEventsPass
 from .lock_discipline import LockDisciplinePass
 from .lock_order import LockOrderPass
 from .metric_counters import MetricCountersPass
@@ -33,4 +34,7 @@ def all_passes():
         RngKeyReusePass(),
         ShardingConsistencyPass(),
         DonationSafetyPass(),
+        # Flight-recorder consistency (ISSUE 11): faults.SITES ↔ journal
+        # fault event types, both directions.
+        JournalEventsPass(),
     ]

@@ -30,6 +30,12 @@ DEFAULT_TARGETS = [
     ("localai_tpu/cluster/scheduler.py", "ClusterClient"),
     ("localai_tpu/cluster/replica.py", "ClusterEngine"),
     ("localai_tpu/parallel/sharding.py", "ShardingPlanError"),
+    # Observability layer (ISSUE 11): the journal/trace structures are
+    # touched from the engine loop and HTTP threads — an unassigned attr
+    # here is the same loop-killing class as on the Engine.
+    ("localai_tpu/observe/journal.py", "EventJournal"),
+    ("localai_tpu/observe/trace.py", "RequestTrace"),
+    ("localai_tpu/observe/trace.py", "TraceStore"),
 ]
 
 

@@ -1,0 +1,86 @@
+"""journal-events: faults.SITES ↔ journal FAULT_EVENTS, both directions.
+
+The flight recorder (localai_tpu/observe/journal.py, ISSUE 11) declares one
+journal event type per fault-injection site (`fault_<site>` in
+FAULT_EVENTS) so an injected fault is attributable in the postmortem's
+journal tail. Nothing ties the two declarations together at runtime — a
+site added to `faults.SITES` without its journal event would make that
+fault class invisible to the flight recorder, and a `fault_*` event naming
+a deleted/renamed site could never be emitted. Same shape as the
+`fault-sites` pass, checked both ways:
+
+  * every name in `faults.SITES` has a `fault_<name>` entry in the
+    journal's FAULT_EVENTS tuple;
+  * every FAULT_EVENTS entry is `fault_<site>` for a site in SITES.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Pass, Repo
+from .fault_sites import FAULTS_PY, declared_sites
+
+JOURNAL_PY = "localai_tpu/observe/journal.py"
+
+
+def declared_fault_events(repo: Repo, journal_py: str) -> dict[str, int]:
+    """{event: line} from the FAULT_EVENTS tuple in journal.py."""
+    for node in ast.walk(repo.tree(journal_py)):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "FAULT_EVENTS"
+                        for t in node.targets)
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            return {
+                elt.value: elt.lineno
+                for elt in node.value.elts
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+            }
+    return {}
+
+
+class JournalEventsPass(Pass):
+    id = "journal-events"
+    description = (
+        "faults.SITES entries without a journal fault_<site> event type, "
+        "and journal fault events naming no fault site"
+    )
+    # Cross-file invariant: --since must never narrow it away.
+    project_wide = True
+
+    def __init__(self, faults_py=FAULTS_PY, journal_py=JOURNAL_PY):
+        self.faults_py = faults_py
+        self.journal_py = journal_py
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        if not (repo.exists(self.faults_py) and repo.exists(self.journal_py)):
+            return out
+        sites = declared_sites(repo, self.faults_py)
+        events = declared_fault_events(repo, self.journal_py)
+        for site, line in sorted(sites.items()):
+            if f"fault_{site}" not in events:
+                out.append(self.finding(
+                    self.faults_py, line,
+                    f"faults.SITES entry {site!r} has no journal event type "
+                    f"'fault_{site}' in {self.journal_py} FAULT_EVENTS — "
+                    f"injected faults at this site would be invisible to "
+                    f"the flight recorder",
+                ))
+        for event, line in sorted(events.items()):
+            if not event.startswith("fault_"):
+                out.append(self.finding(
+                    self.journal_py, line,
+                    f"FAULT_EVENTS entry {event!r} does not follow the "
+                    f"'fault_<site>' naming — the cross-check cannot map "
+                    f"it to a faults.SITES entry",
+                ))
+                continue
+            if event[len("fault_"):] not in sites:
+                out.append(self.finding(
+                    self.journal_py, line,
+                    f"journal FAULT_EVENTS entry {event!r} names no "
+                    f"faults.SITES site — the event can never correspond "
+                    f"to an injected fault (renamed or deleted site)",
+                ))
+        return out

@@ -26,6 +26,9 @@ DEFAULT_GLOBS = [
     "localai_tpu/federation/router.py",
     "localai_tpu/cluster/*.py",
     "localai_tpu/parallel/*.py",
+    # Observability layer (ISSUE 11): the journal's staged sidecar and the
+    # trace store are written by engine/HTTP threads concurrently.
+    "localai_tpu/observe/*.py",
 ]
 
 _LOCK_CTORS = {"Lock", "RLock", "Condition"}

@@ -35,6 +35,12 @@ DEFAULT_TARGETS = [
     # Cluster dispatch (ISSUE 6): the scheduler layer holds caller handles
     # in its own _pending map — the same hang class applies one level up.
     ("localai_tpu/cluster/scheduler.py", "ClusterClient", "_pending", "slots"),
+    # Trace store (ISSUE 11): live traces may only leave `_live` through
+    # `retire()` — the trace-side analogue of posting a terminal event
+    # (retire is invoked exactly by RequestTrace.terminal). A fifth tuple
+    # element names such sanctioned terminal-marker methods.
+    ("localai_tpu/observe/trace.py", "TraceStore", "_live", "slots",
+     ("retire",)),
 ]
 
 _REMOVE_CALLS = {"popleft", "pop", "remove", "clear"}
@@ -111,7 +117,12 @@ class TerminalEventPass(Pass):
 
     def run(self, repo: Repo) -> list[Finding]:
         out: list[Finding] = []
-        for path, class_name, pending_attr, slots_attr in self.targets:
+        for target in self.targets:
+            path, class_name, pending_attr, slots_attr = target[:4]
+            # Optional fifth element: method names that ARE the sanctioned
+            # terminal marker for this class (ISSUE 11: TraceStore.retire
+            # plays the role TokenEvent puts play for the engine).
+            markers = set(target[4]) if len(target) > 4 else set()
             if not repo.exists(path) or not repo.in_scope(path):
                 continue
             cls = repo.find_class(path, class_name)
@@ -121,7 +132,8 @@ class TerminalEventPass(Pass):
 
             # 1. Methods that post a terminal event, transitively through
             #    intra-class calls.
-            posts = {m for m, fn in methods.items() if _terminal_put_in(fn)}
+            posts = {m for m, fn in methods.items()
+                     if m in markers or _terminal_put_in(fn)}
             changed = True
             while changed:
                 changed = False

@@ -49,6 +49,16 @@ TRACED_MODULE_GLOBS = [
     # The parallel layer traces inside every sharded program (shard_map
     # bodies, ring rotation) — a host sync here stalls ALL chips (ISSUE 7).
     "localai_tpu/parallel/*.py",
+    # The observability layer (ISSUE 11) rides the engine loop between
+    # every dispatch: journal appends, trace notes, timeline/postmortem
+    # reads must never sync the device. observe/fence.py and
+    # observe/profile.py are EXCLUDED by design — they are the declared
+    # sync/measurement points (LOCALAI_TRACE_FENCE / LOCALAI_PROFILE),
+    # exactly like the engine drainer thread is excluded from HOT_METHODS.
+    "localai_tpu/observe/journal.py",
+    "localai_tpu/observe/trace.py",
+    "localai_tpu/observe/timeline.py",
+    "localai_tpu/observe/postmortem.py",
 ]
 
 ENGINE_TARGET = ("localai_tpu/engine/engine.py", "Engine")
