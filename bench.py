@@ -617,8 +617,7 @@ def main() -> None:
                             if deng._decode_time else 0.0)
                     acc_s = (deng.m_spec_accepted / deng._decode_time
                              if deng._decode_time else 0.0)
-                    rate = (deng.m_spec_accepted
-                            / max(1, deng.m_spec_rounds * n_draft))
+                    rate = deng.metrics().get("spec_accept_rate", 0.0)
                     out[f"{tag}_tps_bs{bs}"] = round(stps, 2)
                     out[f"{tag}_accepted_per_s_bs{bs}"] = round(acc_s, 2)
                     out[f"{tag}_accept_rate_bs{bs}"] = round(rate, 3)
@@ -640,7 +639,80 @@ def main() -> None:
                     deng.params = None
                     deng.cache = None
                     deng = None
+        out["spec_paged_draft_ckpt_bytes"] = int(sum(
+            a.size * a.dtype.itemsize for a in jax.tree.leaves(dparams)
+        ))
         dparams = None
+
+        # Model-free variants (ISSUE 12, docs/SPECULATIVE.md): prompt-lookup
+        # and self-draft rows on a REPETITIVE-CONTINUATION workload (logit
+        # bias pins each request to a fixed continuation token, the serving
+        # shape that prompt lookup exists for — RAG quoting, code echo).
+        # Zero extra checkpoint bytes resident by construction (the
+        # draft_ckpt_bytes row above is what these modes delete). ROADMAP
+        # target (recorded, gated once the TPU campaign runs):
+        # accepted-tokens/s ≥ 1.5x plain paged decode at bs `slots`.
+        for smode in ("prompt_lookup", "self_draft"):
+            seng = None
+            skey = ("spec_lookup" if smode == "prompt_lookup"
+                    else "spec_selfdraft")
+            try:
+                seng = Engine(
+                    cfg, params, ByteTokenizer(cfg.vocab_size),
+                    n_draft=n_draft,
+                    engine_cfg=EngineConfig(
+                        max_slots=slots, max_seq=max_seq,
+                        kv_pages=pool, kv_page_size=page, spec_mode=smode,
+                    ),
+                )
+                seng.start()
+                seng.warmup(prompt_len)
+                for bs in (1, slots):
+                    seng._decode_time = 0.0
+                    seng._decode_tokens = 0
+                    seng.m_spec_rounds = 0
+                    seng.m_spec_accepted = 0
+                    seng.m_spec_drafted = 0
+                    seng.m_spec_dlen_hist = {}
+                    ths = [threading.Thread(target=lambda i=i: seng.generate(
+                        [(i * 13 + j) % 17 + 60 for j in range(prompt_len)],
+                        max_new_tokens=gen_len, ignore_eos=True,
+                        logit_bias={(i * 7) % 200 + 30: 24.0},
+                    )) for i in range(bs)]
+                    for t in ths:
+                        t.start()
+                    _join_or_die(ths, seng, f"{skey} bs{bs}")
+                    stps = (seng._decode_tokens / seng._decode_time
+                            if seng._decode_time else 0.0)
+                    acc_s = (seng.m_spec_accepted / seng._decode_time
+                             if seng._decode_time else 0.0)
+                    rate = seng.metrics().get("spec_accept_rate", 0.0)
+                    out[f"{skey}_tps_bs{bs}"] = round(stps, 2)
+                    out[f"{skey}_accepted_per_s_bs{bs}"] = round(acc_s, 2)
+                    out[f"{skey}_accept_rate_bs{bs}"] = round(rate, 3)
+                    base = out.get("decode_tokens_per_sec_paged")
+                    if bs == slots and base:
+                        out[f"{skey}_vs_paged"] = round(stps / base, 2)
+                        out[f"{skey}_accepted_vs_paged"] = round(
+                            acc_s / base, 2)
+                    print(
+                        f"{skey} bs{bs}: {stps:.1f} tok/s, "
+                        f"{acc_s:.1f} accepted/s, rate {rate:.2f}",
+                        file=sys.stderr,
+                    )
+                out[f"{skey}_draft_hist"] = {
+                    str(k): v
+                    for k, v in sorted(seng.m_spec_dlen_hist.items())
+                }
+            except Exception as e:  # noqa: BLE001 — extra row is best-effort
+                print(f"{skey} row failed: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+            finally:
+                if seng is not None:
+                    seng.stop()
+                    seng.params = None
+                    seng.cache = None
+                    seng = None
 
     # Multi-tenant LoRA row (ISSUE 10, docs/LORA_SERVING.md): decode tok/s
     # at `slots` slots × `slots` DISTINCT adapters (every decode row gathers

@@ -173,9 +173,26 @@ class ModelConfig:
     trace_journal_events: int = 4096
 
     # Speculative decoding (reference: draft_model/n_draft,
-    # core/config/model_config.go:211-212).
+    # core/config/model_config.go:211-212; ISSUE 12 docs/SPECULATIVE.md).
     draft_model: str = ""  # arch preset or checkpoint dir; empty = off
     n_draft: int = 5
+    # Draft source: off | draft_model | prompt_lookup | self_draft | auto
+    # (auto = draft_model when draft_model is set, else off). The model-
+    # free modes (prompt_lookup / self_draft) need no draft checkpoint —
+    # when one of them is selected the manager skips loading draft_model
+    # entirely (zero extra HBM). LOCALAI_SPEC_MODE env var overrides.
+    spec_mode: str = "auto"
+    # spec_mode=self_draft: how many leading target layers draft (0 = auto,
+    # num_layers // 4). LOCALAI_SELF_DRAFT_LAYERS env var overrides.
+    self_draft_layers: int = 0
+    # Per-slot acceptance EWMA coefficient driving acceptance-aware draft
+    # lengths (docs/SPECULATIVE.md § scheduler).
+    # LOCALAI_SPEC_ACCEPT_EWMA env var overrides.
+    spec_accept_ewma: float = 0.4
+    # Draft-length buckets the verify programs compile for ([] = auto:
+    # {0, n_draft/2, n_draft}). LOCALAI_SPEC_DRAFT_BUCKETS env var
+    # overrides (comma-separated).
+    spec_draft_buckets: list = dataclasses.field(default_factory=list)
 
     # LoRA adapters merged into the base weights at load (reference:
     # backend.proto LoraAdapter/LoraScale; grpc-server.cpp params_parse).

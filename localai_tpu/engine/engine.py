@@ -57,12 +57,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from localai_tpu.models import llama
+from localai_tpu.engine import speclookup
 from localai_tpu.models.config import ArchConfig
 from localai_tpu.observe import fence as ofence
 from localai_tpu.observe import postmortem as opostmortem
 from localai_tpu.observe import trace as otrace
 from localai_tpu.observe.journal import EventJournal
 from localai_tpu.ops.sampling import (
+    NEG_INF,
     SamplingParams,
     sample,
     sample_greedy,
@@ -317,6 +319,48 @@ class EngineConfig:
     # The ApplicationConfig.postmortem_dir / LOCALAI_POSTMORTEM_DIR knob
     # forwards here through the manager.
     postmortem_dir: str = ""
+    # Speculative decoding draft source (ISSUE 12, docs/SPECULATIVE.md):
+    #   "off"           — plain decode blocks only.
+    #   "draft_model"   — the separate draft checkpoint (draft_cfg/
+    #                     draft_params/n_draft engine args; the only mode
+    #                     that costs extra HBM).
+    #   "prompt_lookup" — model-free: per-slot n-gram suffix matches over
+    #                     prompt+output (engine/speclookup.py, host-side)
+    #                     feed deterministic drafts into the same verify
+    #                     machinery. Greedy output is byte-identical to
+    #                     plain decode; composes with paged pools, quantized
+    #                     targets, grammar-DFA slots, LoRA tenants and tp>1.
+    #   "self_draft"    — model-free: the target's own first
+    #                     self_draft_layers layers + unembed draft on the
+    #                     SAME sharded params (llama.self_draft_view — no
+    #                     second checkpoint resident), with a dense scratch
+    #                     KV for the k-layer prefix.
+    #   "auto"          — draft_model when a draft checkpoint is configured,
+    #                     else off (model-free modes are opt-in: they change
+    #                     sampled requests' RNG consumption, so flipping
+    #                     them on by default would break seeded streams).
+    # LOCALAI_SPEC_MODE env var overrides.
+    spec_mode: str = "auto"
+    # First-k-layer prefix for spec_mode=self_draft. 0 = auto
+    # (num_layers // 4, min 1). Threaded into ArchConfig.self_draft_layers
+    # like quant_kernel. LOCALAI_SELF_DRAFT_LAYERS env var overrides.
+    self_draft_layers: int = 0
+    # Per-slot acceptance EWMA coefficient (ISSUE 12 acceptance-aware
+    # scheduling): after each verify round a slot's estimate moves by this
+    # fraction toward the round's accepted/drafted ratio. The EWMA chooses
+    # each slot's next draft length — hot slots draft long, cold slots
+    # decay to draft 0 and ride the plain blocks.
+    # LOCALAI_SPEC_ACCEPT_EWMA env var overrides.
+    spec_accept_ewma: float = 0.4
+    # Draft-length buckets the verify-block programs compile for (the
+    # BLOCK's draft window is bucketed up to the smallest covering entry;
+    # per-slot draft lengths stay exact and ride the dispatch pack).
+    # Bounds the AOT compile family set exactly like block_sizes does for
+    # plain blocks. () = auto: {0, n_draft // 2, n_draft}. 0 always counts
+    # as a bucket (an all-cold round dispatches a plain block, no spec
+    # program at all). LOCALAI_SPEC_DRAFT_BUCKETS env var overrides
+    # (comma-separated).
+    spec_draft_buckets: tuple[int, ...] = ()
     # KV-cache storage dtype (reference: CacheTypeKey/CacheTypeValue,
     # backend/backend.proto:261-262, llama.cpp q8 KV). "" = model dtype;
     # "fp8" (e4m3) / "fp8_e5m2" halve KV bytes — the TPU-native equivalent
@@ -526,6 +570,13 @@ def _parse_flag_env(val: str) -> bool:
     return val.strip().lower() in ("1", "true", "yes", "on")
 
 
+def _parse_buckets_env(val: str) -> tuple[int, ...]:
+    """LOCALAI_SPEC_DRAFT_BUCKETS value: comma/pipe-separated ints."""
+    return tuple(
+        int(x) for x in val.replace("|", ",").split(",") if x.strip()
+    )
+
+
 def _host_copy_async(arr: Any) -> None:
     """Start a device→host copy without blocking; np.asarray later is then a
     cheap wait instead of a full round trip."""
@@ -547,6 +598,9 @@ class _Entry:
     items: Optional[list] = None  # admit: [(slot_idx, request, handle, plen, t0)]
     active: Optional[np.ndarray] = None  # block: active mask at dispatch
     n: int = 0  # block: tokens per slot in this entry
+    # Spec rounds (ISSUE 12): per-slot draft lengths chosen at dispatch —
+    # the acceptance-EWMA update needs the denominator per slot.
+    dlens: Optional[np.ndarray] = None
     # Host-side results pulled by the drainer thread (toks, tk, lp as numpy).
     host: Optional[tuple] = None
     host_done: bool = False
@@ -566,6 +620,18 @@ class Engine:
     GRAMMAR_TOPK = 64
     LOGPROB_TOPK = 20  # OpenAI caps top_logprobs at 20
     _KV_WIN_MIN = 256  # smallest read-side KV window bucket (doubles up to max_seq)
+    # Acceptance-aware scheduling (ISSUE 12): a slot whose acceptance EWMA
+    # falls below the floor drafts 0 (plain decode); every PROBE_EVERY
+    # cold rounds it re-tries the smallest nonzero bucket so a stream
+    # whose statistics improved (e.g. entered a quoting span) can warm
+    # back up.
+    _SPEC_EWMA_FLOOR = 0.15
+    _SPEC_PROBE_EVERY = 32
+    # When a model-free spec round found nothing to draft, the fallback
+    # plain block is capped at this many steps: a full-depth (64-step)
+    # block would forfeit every draft opportunity inside its window — the
+    # suffix index / EWMA only get to re-plan between dispatches.
+    _SPEC_REPLAN_BLOCK = 16
 
     def __init__(
         self,
@@ -604,6 +670,10 @@ class Engine:
             "LOCALAI_TRACE_JOURNAL": ("trace_journal_events", int),
             "LOCALAI_TRACE_FENCE": ("trace_fence", _parse_flag_env),
             "LOCALAI_POSTMORTEM_DIR": ("postmortem_dir", str),
+            "LOCALAI_SPEC_MODE": ("spec_mode", str),
+            "LOCALAI_SELF_DRAFT_LAYERS": ("self_draft_layers", int),
+            "LOCALAI_SPEC_ACCEPT_EWMA": ("spec_accept_ewma", float),
+            "LOCALAI_SPEC_DRAFT_BUCKETS": ("spec_draft_buckets", _parse_buckets_env),
         }.items():
             val = os.environ.get(env)
             if val is not None and val != "":
@@ -737,6 +807,71 @@ class Engine:
                 f"draft model vocab ({draft_cfg.vocab_size}) must match the "
                 f"target vocab ({cfg.vocab_size})"
             )
+        # Draft-source selection (ISSUE 12, docs/SPECULATIVE.md): resolve
+        # spec_mode before any spec state is sized.
+        mode = self.ecfg.spec_mode
+        if mode not in ("off", "auto", "draft_model", "prompt_lookup",
+                        "self_draft"):
+            raise ValueError(
+                f"spec_mode={mode!r}: use "
+                "off|draft_model|prompt_lookup|self_draft|auto"
+            )
+        if mode == "auto":
+            mode = "draft_model" if draft_cfg is not None else "off"
+        if mode == "draft_model" and draft_cfg is None:
+            raise ValueError(
+                "spec_mode=draft_model needs a draft checkpoint "
+                "(draft_model in the model YAML / draft_cfg+draft_params)"
+            )
+        if mode in ("prompt_lookup", "self_draft") and draft_cfg is not None:
+            raise ValueError(
+                f"spec_mode={mode} is model-free — the configured draft "
+                "model would sit dead in HBM; drop draft_model or use "
+                "spec_mode=draft_model"
+            )
+        if mode in ("prompt_lookup", "self_draft") and self.plan.sp > 1:
+            raise ValueError(
+                "speculative decoding with a sequence-sharded KV cache "
+                "(sp>1) is not supported yet — drop spec_mode or sp"
+            )
+        self._sd_layers = 0
+        if mode == "self_draft":
+            if cfg.is_moe or cfg.is_mla or cfg.first_k_dense:
+                raise ValueError(
+                    "spec_mode=self_draft needs a homogeneous dense layer "
+                    f"stack ({cfg.name} is "
+                    f"{'MoE' if cfg.is_moe else 'MLA/dense-prefix'}) — use "
+                    "prompt_lookup instead"
+                )
+            kl = self.ecfg.self_draft_layers or max(1, cfg.num_layers // 4)
+            if not 1 <= kl < cfg.num_layers:
+                raise ValueError(
+                    f"self_draft_layers={kl} must be in [1, "
+                    f"num_layers={cfg.num_layers})"
+                )
+            self._sd_layers = kl
+            if cfg.self_draft_layers != kl:
+                # Threaded like quant_kernel: the one static object the
+                # layer helpers already receive (llama.self_draft_view).
+                cfg = dataclasses.replace(cfg, self_draft_layers=kl)
+                self.cfg = cfg
+        self._spec_mode = mode
+        if not 0.0 < self.ecfg.spec_accept_ewma <= 1.0:
+            raise ValueError("spec_accept_ewma must be in (0, 1]")
+        # Draft-length bucket set: the verify BLOCK's draft window is
+        # bucketed up to the smallest covering entry (compile families stay
+        # bounded, exactly like block_sizes); per-slot lengths stay exact.
+        raw_buckets = self.ecfg.spec_draft_buckets
+        if raw_buckets:
+            bl = sorted({int(b) for b in raw_buckets if int(b) >= 0} | {0})
+        else:
+            bl = sorted({0, self.n_draft // 2, self.n_draft})
+        if mode != "off" and bl[-1] < 1:
+            raise ValueError(
+                f"spec_draft_buckets={raw_buckets} needs at least one "
+                "bucket >= 1"
+            )
+        self._spec_buckets = tuple(bl)
 
         B, S, V = self.ecfg.max_slots, self.ecfg.max_seq, cfg.vocab_size
         from localai_tpu.models.quant import is_prequantized, quantize_params
@@ -828,9 +963,48 @@ class Engine:
                         jnp.zeros(dbase + (draft_cfg.cache_v_dim,), ddt), dv
                     ),
                 )
+        # Self-draft scratch KV (ISSUE 12): a dense cache for the first-k-
+        # layer prefix — sized like a draft model's cache but k layers deep.
+        # Rows are resynced FROM the target cache lazily per slot
+        # generation (_spec_sd_sync): the target's stored rows for the
+        # first k layers are exactly what the early-exit scan would have
+        # written, so admission/swap/recompute resume all share one sync
+        # path instead of new admit program families.
+        self.sd_cache = None
+        if self._spec_mode == "self_draft":
+            with self.mesh:
+                sdk, sdv = cache_shardings(self.mesh, mla=cfg.is_mla)
+                sdbase = (self._sd_layers, B, S, cfg.cache_kv_heads)
+                sddt = jnp.dtype(cfg.dtype)
+                self.sd_cache = llama.KVCache(
+                    k=jax.device_put(
+                        jnp.zeros(sdbase + (cfg.cache_k_dim,), sddt), sdk
+                    ),
+                    v=jax.device_put(
+                        jnp.zeros(sdbase + (cfg.cache_v_dim,), sddt), sdv
+                    ),
+                )
+        # Acceptance-aware per-slot scheduling state (ISSUE 12): EWMA of
+        # accepted/drafted per slot drives each slot's next draft length;
+        # optimistic start so fresh slots try a full window first. All
+        # host-side numpy — read/written only on the loop thread.
+        self.h_accept_ewma = np.ones((B,), np.float32)
+        self.h_draft_len = np.zeros((B,), np.int32)
+        self._spec_probe = np.zeros((B,), np.int32)
+        # Prompt-lookup suffix indexes, (re)built lazily per slot
+        # generation from prompt+generated (engine/speclookup.py): entry is
+        # (slot_gen, SuffixIndex, tokens_fed) or None.
+        self._lookup: list[Optional[tuple]] = [None] * B
+        # Self-draft scratch sync generation per slot (-1 = never synced).
+        self._sd_gen = [-1] * B
         # Metrics for speculative acceptance (tokens accepted / window).
         self.m_spec_rounds = 0
         self.m_spec_accepted = 0
+        self.m_spec_drafted = 0
+        self.m_spec_draft_len = 0.0
+        # Draft-length histogram {chosen length: dispatch count} over
+        # active slots (bench.py reports it; not a /metrics scalar).
+        self.m_spec_dlen_hist: dict[int, int] = {}
 
         # Per-head (k, v) dequant scales for the SCALED fp8 paged pool
         # (ISSUE 9): None = unscaled storage (every existing byte-exact
@@ -1476,7 +1650,12 @@ class Engine:
         span_bytes = n_live * self._page_bytes()
         policy = self.ecfg.kv_preempt
         if self.draft_cfg is not None:
-            policy = "recompute"  # the draft's dense KV has no swap image
+            # Only the SEPARATE draft checkpoint forces recompute (its
+            # dense KV has no swap image). Model-free spec slots swap
+            # byte-exactly: prompt_lookup keeps no device draft state at
+            # all, and the self_draft scratch resyncs from the restored
+            # target cache on the slot-generation bump (_spec_sd_sync).
+            policy = "recompute"
         elif grammar_victim:
             # Swap cannot restore a DFA slot's device automaton row into a
             # possibly-swapped table set; recompute re-admits through the
@@ -1543,6 +1722,10 @@ class Engine:
         self.h_active[victim] = False
         self.h_override_mask[victim] = False
         self.h_gmask[victim] = 0.0
+        # Spec scheduling state resets with the slot; the resumed request
+        # rebuilds its lookup index / EWMA from its restored history.
+        self.h_accept_ewma[victim] = 1.0
+        self._spec_probe[victim] = 0
         # The resume request still carries .adapter — re-admission re-pins
         # it (possibly into a different row after churn).
         self._slot_release_adapter(victim)
@@ -1699,8 +1882,10 @@ class Engine:
         stable while requests may be in flight)."""
         if self.draft_cfg is not None:
             raise AdapterError(
-                "runtime LoRA adapters are not supported on speculative "
-                "engines — the draft model would decode without the delta"
+                "runtime LoRA adapters are not supported with a separate "
+                "draft model — the draft would decode without the delta; "
+                "model-free speculation (spec_mode=prompt_lookup/"
+                "self_draft) serves adapter tenants"
             )
         if self.cfg.is_mla or self.cfg.is_moe:
             raise AdapterError(
@@ -3757,69 +3942,123 @@ class Engine:
         self._prefix_save(slot_idx, ids, len(ids))
         return True
 
-    def _get_spec_block(self):
-        """Speculative block with stochastic verify: n_draft draft-model
-        steps SAMPLE a token window from the draft's processed distribution
-        q, one target decode_chunk scores it, and an accept-scan applies the
-        canonical speculative-sampling test — accept draft token x with
+    def _get_spec_block(self, mode: str, kb: int, with_dfa=False,
+                        with_lora: bool = False):
+        """Speculative verify block for one draft source (ISSUE 12,
+        docs/SPECULATIVE.md): a kb-token draft window is scored by ONE
+        target decode_chunk, and an accept-scan applies the canonical
+        speculative-sampling test per slot — accept draft token x with
         probability min(1, p(x)/q(x)), on rejection resample from
         normalize(max(p - q, 0)), and append one bonus sample from p when
-        the whole window survives. Unbiased for ANY q, so temperature>0
-        requests (llama.cpp's stochastic speculative sampling) keep the
-        draft speedup; temperature==0 degenerates to exact greedy (q and p
-        become one-hots and the test reduces to argmax agreement).
+        the slot's whole window survives. Unbiased for ANY q, so
+        temperature>0 requests keep the draft speedup; temperature==0
+        degenerates to exact greedy (p becomes a one-hot and the test
+        reduces to argmax agreement — byte-identical to the plain blocks).
 
-        p and q both come from ops/sampling.processed_logprobs — the same
-        penalties/bias/filter/temperature chain the plain blocks sample
-        from, which is what makes the verify exact. Generates 1..n_draft+1
-        tokens per dispatch; device-state contract matches the normal
-        blocks.
+        Draft sources:
+          draft_model   — n_draft-style separate checkpoint: kb draft-model
+                          steps SAMPLE a window from the draft's processed
+                          distribution q (the original stochastic verify).
+          self_draft    — the target's own first self_draft_layers layers +
+                          unembed (llama.self_draft_view) draft against the
+                          dense scratch sd_cache; q from the early exit.
+          prompt_lookup — the draft window arrives from the HOST (per-slot
+                          suffix-index matches); q is a point mass, so the
+                          test reduces to accept-w.p.-p(x) and the residual
+                          to p-without-x (ops/sampling.deterministic_accept).
+
+        Per-slot draft lengths ride pack row 8: slot b treats step
+        t == dlen[b] as its bonus draw and stops after it, so one compiled
+        program (keyed by the BUCKETED window kb) serves heterogeneous
+        lengths — a dlen-0 slot simply takes one plain sample from p.
+        with_dfa (model-free modes only) masks p to the slot automaton's
+        legal set and advances the state per EMITTED token, exactly like
+        the plain with_dfa blocks; with_lora threads the stacked adapter
+        factors into the verify decode_chunk so multi-tenant slots verify
+        against their own deltas. p and q both come from
+        ops/sampling.processed_logprobs — one shared implementation is
+        what makes the acceptance test exact. Generates 1..kb+1 tokens per
+        dispatch; device-state contract matches the normal blocks.
         """
-        fn = self._block_cache.get(("spec",))
+        key = ("spec", mode, kb, with_dfa, with_lora)
+        fn = self._block_cache.get(key)
         if fn is not None:
             return fn
         cfg, dcfg = self.cfg, self.draft_cfg
         B, S, V = self.ecfg.max_slots, self.ecfg.max_seq, self.cfg.vocab_size
-        k = self.n_draft
+        k = kb
         paged = self._paged
-        from localai_tpu.ops.sampling import processed_logprobs, update_counts
+        from localai_tpu.ops.sampling import (
+            deterministic_accept,
+            processed_logprobs,
+            update_counts,
+        )
 
         def spec(params, dparams, cache, dcache, counts, rngs, bias,
-                 tokens, positions, pack, ptable=None):
+                 tokens, positions, pack, drafts=None, ptable=None,
+                 mask_bits=None, gtrans=None, tok_cls=None, gstate=None,
+                 lora=None):
             active = pack[0] > 0
             samp = SamplingParams(
                 temperature=pack[1], top_k=pack[2].astype(jnp.int32),
                 top_p=pack[3], min_p=pack[4], repeat_penalty=pack[5],
                 presence_penalty=pack[6], frequency_penalty=pack[7],
             )
+            dlen = pack[8].astype(jnp.int32)  # [B] per-slot draft length
             counts0 = counts  # round-start counts condition the draft's q
+            if with_dfa:
+                gmask = pack[9] > 0
+                gstate = jnp.where(gmask, gstate, 0)  # FREE for unconstrained
 
-            # 1. Draft samples k proposals from its own processed
-            # distribution.
-            def dstep(carry, i):
-                cur, dcache, rngs = carry
-                pos_i = jnp.minimum(positions + i, S - 1)
-                logits, dcache = llama.decode_step(dcfg, dparams, cur, pos_i, dcache, ep=self.plan.ep)
-                ql = processed_logprobs(logits, samp, counts0, bias)  # [B, V]
-                split = jax.vmap(lambda kk: jax.random.split(kk, 2))(rngs)
-                rngs, draw = split[:, 0], split[:, 1]
-                nxt = jax.vmap(jax.random.categorical)(draw, ql).astype(jnp.int32)
-                return (nxt, dcache, rngs), (nxt, ql)
+            # 1. Draft window. Model draft sources sample kb proposals from
+            # their own processed distribution; prompt lookup ships them
+            # from the host (qlogs stays None — deterministic q).
+            qlogs = None
+            if mode == "prompt_lookup":
+                chunk = jnp.concatenate([tokens[:, None], drafts], axis=1)
+            else:
+                def dstep(carry, i):
+                    cur, dkv, rngs = carry
+                    pos_i = jnp.minimum(positions + i, S - 1)
+                    if mode == "self_draft":
+                        scfg, sparams = llama.self_draft_view(cfg, params)
+                        logits, dkv = llama.decode_step(
+                            scfg, sparams, cur, pos_i, dkv, ep=self.plan.ep
+                        )
+                    else:
+                        logits, dkv = llama.decode_step(
+                            dcfg, dparams, cur, pos_i, dkv, ep=self.plan.ep
+                        )
+                    ql = processed_logprobs(logits, samp, counts0, bias)
+                    split = jax.vmap(lambda kk: jax.random.split(kk, 2))(rngs)
+                    rngs, draw = split[:, 0], split[:, 1]
+                    nxt = jax.vmap(jax.random.categorical)(draw, ql).astype(jnp.int32)
+                    return (nxt, dkv, rngs), (nxt, ql)
 
-            (last, dcache, rngs), (drafts, qlogs) = jax.lax.scan(
-                dstep, (tokens, dcache, rngs), jnp.arange(k)
-            )  # drafts [k, B]; qlogs [k, B, V]
-            # One more KV-only step so a fully-accepted window's next round
-            # (position pos+k+1) sees the last proposal's kv row; its logits
-            # and proposal are irrelevant, so no sampling work here.
-            _, dcache = llama.decode_step(
-                dcfg, dparams, last, jnp.minimum(positions + k, S - 1), dcache,
-                ep=self.plan.ep,
-            )
+                (last, dcache, rngs), (dtoks, qlogs) = jax.lax.scan(
+                    dstep, (tokens, dcache, rngs), jnp.arange(k)
+                )  # dtoks [k, B]; qlogs [k, B, V]
+                # One more KV-only step so a fully-accepted window's next
+                # round (position pos+k+1) sees the last proposal's kv row;
+                # its logits and proposal are irrelevant, so no sampling
+                # work here.
+                if mode == "self_draft":
+                    scfg, sparams = llama.self_draft_view(cfg, params)
+                    _, dcache = llama.decode_step(
+                        scfg, sparams, last,
+                        jnp.minimum(positions + k, S - 1), dcache,
+                        ep=self.plan.ep,
+                    )
+                else:
+                    _, dcache = llama.decode_step(
+                        dcfg, dparams, last,
+                        jnp.minimum(positions + k, S - 1), dcache,
+                        ep=self.plan.ep,
+                    )
+                chunk = jnp.concatenate([tokens[:, None], dtoks.T], axis=1)
 
             # 2. Target scores the whole window in one chunked decode
             # (paged mode walks the page pool and writes through the table).
-            chunk = jnp.concatenate([tokens[:, None], drafts.T], axis=1)  # [B, k+1]
             if paged:
                 # Idle slots' positions keep ratcheting; unpinned they would
                 # drive the paged fori_loop bound to the full table. Their
@@ -3828,76 +4067,139 @@ class Engine:
                 pos_base = jnp.where(active, positions, 0)
             else:
                 pos_base = positions
-            pos_chunk = jnp.minimum(pos_base[:, None] + jnp.arange(k + 1)[None, :], S - 1)
+            pos_chunk = jnp.minimum(
+                pos_base[:, None] + jnp.arange(k + 1)[None, :], S - 1
+            )
             logits_all, cache = llama.decode_chunk(
                 cfg, params, chunk, pos_chunk, cache, ep=self.plan.ep,
                 ptable=ptable, paged_impl=self.ecfg.paged_kernel,
-                mesh=self._op_mesh, kv_scale=self._kv_scales,
+                mesh=self._op_mesh, kv_scale=self._kv_scales, lora=lora,
             )
 
             # 3. Accept-scan with counts updated token by token, so
             # repeat/presence/frequency semantics match the plain blocks.
+            idx = jnp.arange(B)
+
             def vstep(carry, t):
-                counts, still, cur_tok, rngs = carry
+                counts, still, cur_tok, rngs, gs = carry
                 lt = jax.lax.dynamic_index_in_dim(
                     logits_all, t, axis=1, keepdims=False
                 )  # [B, V]
+                if with_dfa:
+                    allowed = self._dfa_allowed(mask_bits, gs, V)
+                    lt = jnp.where(allowed, lt, NEG_INF)
                 pl = processed_logprobs(lt, samp, counts, bias)
                 split = jax.vmap(lambda kk: jax.random.split(kk, 3))(rngs)
                 rngs, k_u, k_res = split[:, 0], split[:, 1], split[:, 2]
 
                 x = jax.lax.dynamic_index_in_dim(
                     chunk, jnp.minimum(t + 1, k), axis=1, keepdims=False
-                )  # draft token under test (valid for t < k)
-                ql = jax.lax.dynamic_index_in_dim(
-                    qlogs, jnp.minimum(t, k - 1), axis=0, keepdims=False
-                )
-                idx = jnp.arange(B)
-                ratio = pl[idx, x] - ql[idx, x]
+                )  # draft token under test (valid for t < dlen)
+                if qlogs is None:
+                    ratio, res_log = deterministic_accept(pl, x)
+                else:
+                    ql = jax.lax.dynamic_index_in_dim(
+                        qlogs, jnp.minimum(t, k - 1), axis=0, keepdims=False
+                    )
+                    ratio = pl[idx, x] - ql[idx, x]
+                    # rejection draw: normalize(max(p - q, 0)); exact-match
+                    # rows (residual mass ~0) fall back to p itself
+                    res = jnp.maximum(jnp.exp(pl) - jnp.exp(ql), 0.0)
+                    res_mass = res.sum(axis=-1, keepdims=True)
+                    res_log = jnp.where(
+                        res_mass > 1e-9,
+                        jnp.log(res / jnp.maximum(res_mass, 1e-9) + 1e-38),
+                        pl,
+                    )
                 u = jax.vmap(lambda kk: jax.random.uniform(kk))(k_u)
                 accepted = jnp.log(jnp.maximum(u, 1e-38)) < ratio
 
-                # rejection draw: normalize(max(p - q, 0)); exact-match rows
-                # (residual mass ~0) fall back to p itself
-                res = jnp.maximum(jnp.exp(pl) - jnp.exp(ql), 0.0)
-                res_mass = res.sum(axis=-1, keepdims=True)
-                res_log = jnp.where(
-                    res_mass > 1e-9,
-                    jnp.log(res / jnp.maximum(res_mass, 1e-9) + 1e-38),
-                    pl,
-                )
-                is_bonus = t >= k  # past the window: sample from p directly
-                draw_log = jnp.where(is_bonus, pl, res_log)
+                is_bonus = t >= dlen  # [B]: past the slot's window → p draw
+                draw_log = jnp.where(is_bonus[:, None], pl, res_log)
                 y = jax.vmap(jax.random.categorical)(k_res, draw_log).astype(jnp.int32)
 
                 take_draft = accepted & ~is_bonus
                 emit_tok = jnp.where(take_draft, x, y)
                 emit = still & active
                 counts = update_counts(counts, emit_tok, emit)
+                if with_dfa:
+                    ns = self._dfa_advance(with_dfa, gtrans, tok_cls, gs,
+                                           emit_tok)
+                    gs = jnp.where(emit, ns, gs)  # FREE rows self-loop
                 cur_tok = jnp.where(emit, emit_tok, cur_tok)
                 still = still & take_draft  # reject or bonus ends the window
-                return (counts, still, cur_tok, rngs), jnp.where(emit, emit_tok, -1)
+                return ((counts, still, cur_tok, rngs, gs),
+                        jnp.where(emit, emit_tok, -1))
 
-            (counts, _, cur_tok, rngs), toks_out = jax.lax.scan(
+            gs0 = gstate if with_dfa else jnp.zeros((B,), jnp.int32)
+            (counts, _, cur_tok, rngs, gs), toks_out = jax.lax.scan(
                 vstep,
-                (counts, jnp.ones((B,), bool), tokens, rngs),
+                (counts, jnp.ones((B,), bool), tokens, rngs, gs0),
                 jnp.arange(k + 1),
             )  # toks_out [k+1, B], -1 where not emitted
             acc = jnp.sum((toks_out >= 0).astype(jnp.int32), axis=0)  # [B]
             new_tokens = jnp.where(active, cur_tok, tokens)
             new_positions = jnp.minimum(positions + acc, S - 1)
-            return cache, dcache, counts, rngs, new_tokens, new_positions, toks_out, acc
+            out = (cache, dcache, counts, rngs, new_tokens, new_positions,
+                   toks_out, acc)
+            if with_dfa:
+                out = out + (gs,)
+            return out
 
-        if paged:
-            def spec_paged(params, dparams, cache, dcache, counts, rngs, bias,
-                           tokens, positions, pack, ptable):
-                return spec(params, dparams, cache, dcache, counts, rngs,
-                            bias, tokens, positions, pack, ptable=ptable)
+        # Positional wrapper mirroring _dispatch_spec_block's argument
+        # assembly: [mode-specific head] bias tokens positions pack
+        # [drafts?] [ptable?] [dfa: mask, trans, cls, gstate] [lora: stacks,
+        # ids]. Donated: every consumed device-state buffer.
+        has_dstate = mode in ("draft_model", "self_draft")
+        nhead = 4 if has_dstate else 2  # params [dparams] cache [dcache]
 
-            fn = jax.jit(spec_paged, donate_argnums=(2, 3, 4, 5, 7, 8))
+        def wrapped(*args):
+            if mode == "draft_model":
+                params, dparams, cache, dcache = args[:4]
+            elif mode == "self_draft":
+                params, cache, dcache = args[:3]
+                dparams = None
+            else:
+                params, cache = args[:2]
+                dparams = dcache = None
+            i = nhead if mode != "self_draft" else 3
+            counts, rngs, bias, tokens, positions, pack = args[i: i + 6]
+            i += 6
+            drafts = None
+            if mode == "prompt_lookup":
+                drafts = args[i]
+                i += 1
+            ptable = None
+            if paged:
+                ptable = args[i]
+                i += 1
+            mask_bits = gtrans = tok_cls = gstate = None
+            if with_dfa:
+                mask_bits, gtrans, tok_cls, gstate = args[i: i + 4]
+                i += 4
+            lora = (args[i], args[i + 1]) if with_lora else None
+            res = spec(params, dparams, cache, dcache, counts, rngs, bias,
+                       tokens, positions, pack, drafts=drafts, ptable=ptable,
+                       mask_bits=mask_bits, gtrans=gtrans, tok_cls=tok_cls,
+                       gstate=gstate, lora=lora)
+            if not has_dstate:
+                # drop the dcache slot for the stateless draft source
+                res = res[:1] + res[2:]
+            return res
+
+        if mode == "draft_model":
+            donate = (2, 3, 4, 5, 7, 8)
+            base = 10
+        elif mode == "self_draft":
+            donate = (1, 2, 3, 4, 6, 7)
+            base = 9
         else:
-            fn = jax.jit(spec, donate_argnums=(2, 3, 4, 5, 7, 8))
-        self._block_cache[("spec",)] = fn
+            donate = (1, 2, 3, 5, 6)
+            base = 8 + 1  # + drafts operand
+        if with_dfa:
+            donate = donate + (base + (1 if paged else 0) + 3,)
+        fn = jax.jit(wrapped, donate_argnums=donate)
+        self._block_cache[key] = fn
         return fn
 
     # ------------------------------------------------------------------ #
@@ -4032,7 +4334,8 @@ class Engine:
             # with an error event — disk, faults, pinned rows).
             if self.draft_cfg is not None:
                 raise AdapterError(
-                    "adapter requests are not supported with a draft model"
+                    "adapter requests are not supported with a separate "
+                    "draft model — use model-free spec_mode instead"
                 )
             with self._adapter_lock:
                 known = request.adapter in self._adapter_registry
@@ -4255,12 +4558,24 @@ class Engine:
         if self.ecfg.prefill_chunk:
             out["prefill_chunks"] = float(self.m_prefill_chunks)
             out["chunked_admissions"] = float(self.m_chunked_admits)
-        if self.draft_cfg is not None:
+        if self._spec_mode != "off":
+            # Speculative decoding (ISSUE 12): acceptance fed from the
+            # per-slot EWMA scheduler. accept_rate = emitted / scored
+            # (drafted tokens + one bonus/resample per round) — identical
+            # to the old rounds×(n_draft+1) denominator when every slot
+            # drafts the full window.
             out["spec_rounds"] = float(self.m_spec_rounds)
             out["spec_tokens_accepted"] = float(self.m_spec_accepted)
+            out["spec_tokens_drafted"] = float(self.m_spec_drafted)
             out["spec_accept_rate"] = (
-                self.m_spec_accepted / (self.m_spec_rounds * (self.n_draft + 1))
+                self.m_spec_accepted
+                / max(1, self.m_spec_drafted + self.m_spec_rounds)
                 if self.m_spec_rounds else 0.0
+            )
+            out["spec_draft_len"] = float(self.m_spec_draft_len)
+            out["spec_accept_ewma"] = (
+                float(self.h_accept_ewma[self.h_active].mean())
+                if self.h_active.any() else 1.0
             )
         return out
 
@@ -5459,24 +5774,41 @@ class Engine:
             if w < self.ecfg.max_seq:
                 kv_win = w
 
-        # Stochastic verify keeps speculation exact for sampled requests too
-        # (greedy degenerates to the old argmax-agreement test), so every
-        # non-grammar, non-logprobs variant rides the draft model.
-        spec = (
-            self.draft_cfg is not None
+        # Speculative decoding (ISSUE 12): pick the draft source, plan this
+        # round's per-slot draft lengths from the acceptance EWMA (and, for
+        # prompt lookup, match availability), and dispatch a verify block
+        # whenever anyone drafts. Stochastic verify keeps speculation exact
+        # for sampled requests (greedy degenerates to argmax agreement);
+        # model-free modes additionally compose with the device grammar DFA.
+        smode = self._spec_mode
+        spec_ok = (
+            smode != "off"
             and not grammar
-            and not with_dfa
             and not with_lp
             and not self.h_override_mask.any()
+            and not (smode == "draft_model" and with_dfa)
         )
+        plan = self._spec_plan(smode) if spec_ok else None
+        if isinstance(plan, str):  # "wait": host history lags an in-flight
+            return False           # verify round — drain before re-drafting
+        if plan is None and spec_ok and smode in ("prompt_lookup",
+                                                  "self_draft"):
+            # Nothing to draft THIS round — keep the fallback block short
+            # so the scheduler re-plans soon (token streams turn repetitive
+            # mid-flight; a 64-step block would sail past every match).
+            for bs in sorted(self.ecfg.block_sizes, reverse=True):
+                if bs <= self._SPEC_REPLAN_BLOCK:
+                    n = min(n, bs)
+                    break
         # On-demand page growth (ISSUE 3): the block's writes must resolve
         # through real pages BEFORE dispatch — rows past a slot's table
         # land in SCRATCH and would be silently lost.
-        if not self._grow_for_decode((self.n_draft + 1) if spec else n):
+        if not self._grow_for_decode((plan[0] + 1) if plan else n):
             return False
         self.m_peak_active = max(self.m_peak_active, int(self.h_active.sum()))
-        if spec:
-            self._dispatch_spec_block()
+        if plan is not None:
+            self._dispatch_spec_block(smode, plan[0], plan[1], plan[2],
+                                      with_dfa)
             return True
         active_snapshot = self.h_active.copy()
         pack = np.zeros((11 if with_dfa else 10, B), np.float32)
@@ -5529,40 +5861,246 @@ class Engine:
         )
         return True
 
-    def _dispatch_spec_block(self) -> None:
-        """One speculative round: draft k + verify. Emits 1..k+1 tokens per
-        active slot (kind="spec"; tk carries accepted counts)."""
+    def _spec_len_for(self, i: int, kmax: int) -> int:
+        """EWMA-chosen draft length for one active slot (pure — probe
+        bookkeeping happens when the plan COMMITS). Below the floor a cold
+        slot drafts 0 (plain decode) until its probe counter re-tries the
+        smallest nonzero bucket so it can warm back up when its stream
+        turns predictable again."""
+        a = float(self.h_accept_ewma[i])
+        if a < self._SPEC_EWMA_FLOOR:
+            if self._spec_probe[i] >= self._SPEC_PROBE_EVERY:
+                for b in self._spec_buckets:
+                    if b > 0:
+                        return min(b, kmax)
+            return 0
+        return max(1, min(kmax, int(round(a * kmax))))
+
+    def _lookup_propose(self, i: int, kmax: int) -> list:
+        """Draft continuation for slot i from its suffix index, (re)built
+        lazily per slot generation and fed only the history delta since the
+        last call (prompt first, then the generated tail)."""
+        slot = self.slots[i]
+        gen = self._slot_gen[i]
+        st = self._lookup[i]
+        if st is None or st[0] != gen:
+            st = (gen, speclookup.SuffixIndex(), 0)
+        _g, ix, fed = st
+        hist_p = slot.request.prompt_ids
+        total = len(hist_p) + len(slot.generated)
+        if fed < total:
+            if fed < len(hist_p):
+                ix.extend(hist_p[fed:])
+                fed = len(hist_p)
+            ix.extend(slot.generated[fed - len(hist_p):])
+            fed = total
+        self._lookup[i] = (gen, ix, fed)
+        return ix.propose(kmax)
+
+    def _spec_plan(self, mode: str):
+        """Plan one verify round: per-slot draft lengths from the
+        acceptance EWMA (+ proposal availability for prompt lookup), the
+        block's draft window bucketed up to the smallest covering entry of
+        spec_draft_buckets. Returns (kb, dlens [B], drafts [B, kb] | None),
+        None when every active slot drafts 0 this round (the caller then
+        dispatches a plain block), or "wait" when a prompt-lookup draft is
+        available but in-flight dispatches still carry unprocessed tokens —
+        proposals mined from a lagging host history would continue from the
+        wrong point and be rejected wholesale, so the loop drains first
+        (a round then drafts against the true suffix)."""
+        B = self.ecfg.max_slots
+        kmax = self._spec_buckets[-1]
+        dlens = np.zeros((B,), np.int32)
+        drafts = np.zeros((B, kmax), np.int32) if mode == "prompt_lookup" else None
+        for i in range(B):
+            if not self.h_active[i] or self.slots[i] is None:
+                continue
+            want = self._spec_len_for(i, kmax)
+            if mode == "prompt_lookup" and want > 0:
+                prop = self._lookup_propose(i, kmax)
+                want = min(want, len(prop))
+                if want > 0:
+                    drafts[i, :want] = prop[:want]
+            dlens[i] = want
+        need = int(dlens.max()) if dlens.size else 0
+        if need > 0 and mode == "prompt_lookup":
+            for e in self._inflight:
+                # Any entry that will still append tokens to the history
+                # ("admit"/"block"/"spec") makes the mined suffix stale.
+                if e.kind != "chunk":
+                    return "wait"
+        # COMMIT: probe ticks + the draft-length histogram record only for
+        # plans that actually schedule (wait iterations spin on the loop).
+        for i in range(B):
+            if not self.h_active[i] or self.slots[i] is None:
+                continue
+            if dlens[i] == 0:
+                if self.h_accept_ewma[i] < self._SPEC_EWMA_FLOOR:
+                    self._spec_probe[i] += 1
+            elif self.h_accept_ewma[i] < self._SPEC_EWMA_FLOOR:
+                self._spec_probe[i] = 0  # probe fired: one trial round
+            self.m_spec_dlen_hist[int(dlens[i])] = (
+                self.m_spec_dlen_hist.get(int(dlens[i]), 0) + 1
+            )
+        if need == 0:
+            return None
+        kb = next(b for b in self._spec_buckets if b >= need)
+        if mode == "self_draft":
+            self._spec_sd_sync()
+        return kb, dlens, (drafts[:, :kb] if drafts is not None else None)
+
+    def _spec_sd_sync(self) -> None:
+        """Resync the self-draft scratch KV for slots whose generation
+        changed (fresh admission, swap/recompute resume): the target
+        cache's stored rows for the first self_draft_layers layers are
+        exactly what the early-exit scan would have written, so one copy
+        program serves every admission flavor — no new admit families."""
+        for i in range(self.ecfg.max_slots):
+            if not self.h_active[i] or self.slots[i] is None:
+                continue
+            if self._sd_gen[i] == self._slot_gen[i]:
+                continue
+            if self._paged:
+                pages = self._slot_pages[i]
+                npgb = self._pow2_pages(max(1, len(pages)))
+                rows = np.full((npgb,), self.ecfg.kv_pages, np.int32)
+                rows[:len(pages)] = pages  # padding gathers SCRATCH rows
+                self.sd_cache = self._get_sd_sync_paged(npgb)(
+                    self.sd_cache, self.cache, jnp.asarray(rows),
+                    jnp.int32(i),
+                )
+            else:
+                self.sd_cache = self._get_sd_sync()(
+                    self.sd_cache, self.cache, jnp.int32(i)
+                )
+            self._sd_gen[i] = self._slot_gen[i]
+
+    def _get_sd_sync(self):
+        """Dense-cache → self-draft scratch copy for one slot (full row —
+        rows past the live context are never attended)."""
+        fn = self._block_cache.get(("sd-sync",))
+        if fn is not None:
+            return fn
+        kl = self._sd_layers
+
+        def sync(sd, cache, slot):
+            return llama.KVCache(
+                k=sd.k.at[:, slot].set(cache.k[:kl, slot].astype(sd.k.dtype)),
+                v=sd.v.at[:, slot].set(cache.v[:kl, slot].astype(sd.v.dtype)),
+            )
+
+        fn = jax.jit(sync, donate_argnums=(0,))
+        self._block_cache[("sd-sync",)] = fn
+        return fn
+
+    def _get_sd_sync_paged(self, npgb: int):
+        """Page-pool → self-draft scratch gather for one slot, compiled per
+        power-of-two page-count bucket (same family policy as the swap
+        gathers). fp8 pool rows dequantize through the engine's kv scales
+        so the scratch stays model-dtype like a draft model's cache."""
+        key = ("sd-sync", npgb)
+        fn = self._block_cache.get(key)
+        if fn is not None:
+            return fn
+        kl = self._sd_layers
+        page = self.ecfg.kv_page_size
+        S = self.ecfg.max_seq
+        W = min(npgb * page, S)
+        scales = self._kv_scales
+
+        def sync(sd, cache, pages, slot):
+            gk = cache.k[:kl, pages]  # [kl, npgb, page, K, Dk]
+            gv = cache.v[:kl, pages]
+            gk = gk.reshape(kl, npgb * page, *gk.shape[3:])[:, :W]
+            gv = gv.reshape(kl, npgb * page, *gv.shape[3:])[:, :W]
+            if scales is not None:
+                gk = gk.astype(jnp.float32) * scales[0][None, None, :, None]
+                gv = gv.astype(jnp.float32) * scales[1][None, None, :, None]
+            return llama.KVCache(
+                k=sd.k.at[:, slot, :W].set(gk.astype(sd.k.dtype)),
+                v=sd.v.at[:, slot, :W].set(gv.astype(sd.v.dtype)),
+            )
+
+        fn = jax.jit(sync, donate_argnums=(0,))
+        self._block_cache[key] = fn
+        return fn
+
+    def _dispatch_spec_block(self, mode: str, kb: int, dlens: np.ndarray,
+                             drafts: Optional[np.ndarray],
+                             with_dfa) -> None:
+        """One speculative round for the chosen draft source: draft a
+        (per-slot ≤ kb) window + verify. Emits 1..kb+1 tokens per active
+        slot (kind="spec"; tk carries accepted counts)."""
+        faults.fire("spec_verify")
         B = self.ecfg.max_slots
         active_snapshot = self.h_active.copy()
         pack = np.zeros((10, B), np.float32)
         pack[0] = active_snapshot
         for fi, k in enumerate(_SAMPLING_FIELDS):
             pack[1 + fi] = self.h_sampling[k]
-        fn = self._get_spec_block()
-        args = (
-            self.params, self.draft_params, self.cache, self.d_cache,
-            self.counts, self.rngs, self.bias, self.d_tokens, self.d_positions,
-            jnp.asarray(pack),
+        pack[8] = dlens
+        if with_dfa:
+            pack[9] = self.h_gmask
+        # Draft-model engines reject adapters (typed AdapterError); the
+        # model-free verify chunk threads the tenant deltas through.
+        with_lora = self._lora_tree is not None and mode != "draft_model"
+        fn = self._get_spec_block(mode, kb, with_dfa=with_dfa,
+                                  with_lora=with_lora)
+        if mode == "draft_model":
+            args = (self.params, self.draft_params, self.cache, self.d_cache)
+        elif mode == "self_draft":
+            args = (self.params, self.cache, self.sd_cache)
+        else:
+            args = (self.params, self.cache)
+        args = args + (
+            self.counts, self.rngs, self.bias, self.d_tokens,
+            self.d_positions, jnp.asarray(pack),
         )
+        if mode == "prompt_lookup":
+            args = args + (jnp.asarray(drafts),)
         if self._paged:
             args = args + (jnp.asarray(self.h_ptable),)
+        if with_dfa:
+            d = self._dfa
+            args = args + (d["mask_bits"], self._dfa_table(d, with_dfa),
+                           d["tok_cls"], self.d_gstate)
+        if with_lora:
+            args = args + (self._lora_tree, jnp.asarray(self.h_adapter))
+        out = fn(*args)
+        if mode == "draft_model":
+            self.cache, self.d_cache = out[0], out[1]
+            rest = out[2:]
+        elif mode == "self_draft":
+            self.cache, self.sd_cache = out[0], out[1]
+            rest = out[2:]
+        else:
+            self.cache = out[0]
+            rest = out[1:]
         (
-            self.cache, self.d_cache, self.counts, self.rngs, self.d_tokens,
-            self.d_positions, toks_out, acc,
-        ) = fn(*args)
+            self.counts, self.rngs, self.d_tokens, self.d_positions,
+            toks_out, acc,
+        ) = rest[:6]
+        if with_dfa:
+            self.d_gstate = rest[6]
+            self.m_dfa_tokens += int((self.h_gmask * active_snapshot).sum())
         _host_copy_async(toks_out)
         _host_copy_async(acc)
+        nact = int(active_snapshot.sum())
+        drafted = int(dlens[active_snapshot].sum())
+        self.h_draft_len[active_snapshot] = dlens[active_snapshot]
+        self.m_spec_draft_len = drafted / max(1, nact)
+        self._jnote("spec_draft", a=float(drafted), b=float(kb))
         for i in range(B):
             if active_snapshot[i] and self.slots[i] is not None:
                 self.slots[i].scheduled += 1  # ≥1 token guaranteed per round
-                # Page growth must cover the whole verify window (k+1 rows
+                # Page growth must cover the whole verify window (kb+1 rows
                 # are written even when fewer tokens are accepted).
-                self.slots[i].sched_rows += self.n_draft + 1
+                self.slots[i].sched_rows += kb + 1
         self._track(
             _Entry(
                 kind="spec", toks=toks_out, tk=acc,
                 gen=list(self._slot_gen), active=active_snapshot,
-                n=self.n_draft + 1,
+                n=kb + 1, dlens=dlens.copy(),
             )
         )
 
@@ -5609,12 +6147,12 @@ class Engine:
             # the FINAL chunk rides an "admit" entry with the first token.
             return
         if e.kind == "spec":
-            # toks [k+1, B] with -1 marking not-emitted; tk holds accepted
+            # toks [kb+1, B] with -1 marking not-emitted; tk holds accepted
             # counts per slot. Only slots that actually emit count toward the
             # acceptance-rate denominator (pipelined overshoot rounds after a
             # request finished would otherwise dilute it).
             consumed = 0
-            emitting_slots = set()
+            emitted_per = np.zeros((self.ecfg.max_slots,), np.int64)
             for step in range(e.n):
                 for i in range(self.ecfg.max_slots):
                     if not e.active[i] or self._slot_gen[i] != e.gen[i]:
@@ -5625,11 +6163,30 @@ class Engine:
                     if tok < 0:
                         continue
                     consumed += 1
-                    emitting_slots.add(i)
+                    emitted_per[i] += 1
                     self._post_token(i, tok)
-            self.m_spec_rounds += len(emitting_slots)
+            self.m_spec_rounds += int((emitted_per > 0).sum())
             self.m_spec_accepted += consumed
             self._decode_tokens += consumed
+            # Acceptance-aware scheduling (ISSUE 12): fold each slot's
+            # accepted/drafted ratio into its EWMA — the NEXT round's draft
+            # length comes from it. A round always emits one non-draft
+            # token (bonus or resample), so accepted drafts = emitted - 1.
+            # Slots freed while processing keep their claim-time reset.
+            drafted = 0
+            alpha = self.ecfg.spec_accept_ewma
+            for i in range(self.ecfg.max_slots):
+                if emitted_per[i] == 0 or e.dlens is None:
+                    continue
+                drafted += int(e.dlens[i])
+                if (e.dlens[i] > 0 and self.slots[i] is not None
+                        and self._slot_gen[i] == e.gen[i]):
+                    ratio = (emitted_per[i] - 1) / float(e.dlens[i])
+                    self.h_accept_ewma[i] = (
+                        (1.0 - alpha) * self.h_accept_ewma[i] + alpha * ratio
+                    )
+            self.m_spec_drafted += drafted
+            self._jnote("spec_verify", a=float(drafted), b=float(consumed))
             return
         if e.kind == "admit":
             for j, (slot_idx, request, handle, plen, _t0) in enumerate(e.items):
@@ -5917,6 +6474,11 @@ class Engine:
             st for st in self._chunkings if st["slot"] != slot_idx
         ]
         self.h_active[slot_idx] = False
+        # Acceptance scheduling state is per-REQUEST: the next occupant of
+        # this slot index starts optimistic, not with its predecessor's
+        # statistics (ISSUE 12).
+        self.h_accept_ewma[slot_idx] = 1.0
+        self._spec_probe[slot_idx] = 0
         self.h_override_mask[slot_idx] = False
         self.h_gmask[slot_idx] = 0.0
         self._slot_release_adapter(slot_idx)

@@ -141,6 +141,15 @@ class ArchConfig:
     # quant_kernel — EngineConfig.lora_kernel reaches ops/lora_matmul.py
     # through `dataclasses.replace(cfg, lora_kernel=...)`.
     lora_kernel: str = "auto"
+    # Self-draft early-exit prefix (ISSUE 12, docs/SPECULATIVE.md): > 0
+    # means `spec_mode=self_draft` drafts with the target's OWN first k
+    # layers + final norm + unembed — `llama.self_draft_view` slices the
+    # stacked layer tensors to [:k] inside the traced program, so the
+    # draft shares the sharded weight buffers (no second checkpoint in
+    # HBM). Lives on ArchConfig like quant_kernel/lora_kernel: the engine's
+    # EngineConfig.self_draft_layers knob reaches the layer-scan helpers
+    # through `dataclasses.replace(cfg, self_draft_layers=...)`.
+    self_draft_layers: int = 0
 
     @property
     def head_dim_(self) -> int:

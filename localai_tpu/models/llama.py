@@ -930,6 +930,32 @@ def decode_step(
     return logits, KVCache(k=k, v=v)
 
 
+def self_draft_view(cfg: ArchConfig, params: Params):
+    """Early-exit draft view for `spec_mode=self_draft` (ISSUE 12,
+    docs/SPECULATIVE.md): the target's first `cfg.self_draft_layers` layers
+    plus the SHARED embed/final-norm/unembed act as the draft model, so one
+    set of sharded weights serves both roles.
+
+    Called INSIDE the traced spec program: the [:k] slices of the stacked
+    [L, ...] layer tensors are views XLA fuses into the draft scan's operand
+    reads — no second parameter tree is ever materialized in HBM (the whole
+    point vs a separate draft checkpoint). Works for plain and quantized
+    stacks alike (every leaf, scale tensors included, carries the leading L
+    axis). Heterogeneous stacks (MoE / DeepSeek dense-prefix / MLA) are
+    rejected at engine construction, not here.
+
+    Returns (draft_cfg, draft_params): cfg with num_layers=k, params with
+    only the sliced homogeneous "layers" stack swapped.
+    """
+    k = cfg.self_draft_layers
+    assert 0 < k < cfg.num_layers, "engine validates self_draft_layers"
+    view = {name: leaf for name, leaf in params.items() if name != "layers"}
+    view["layers"] = jax.tree.map(lambda a: a[:k], params["layers"])
+    import dataclasses as _dc
+
+    return _dc.replace(cfg, num_layers=k), view
+
+
 def decode_step_windowed(
     cfg: ArchConfig,
     params: Params,
@@ -1071,6 +1097,10 @@ def decode_chunk(
     paged_impl: str = "auto",  # paged attention kernel: auto|pallas|xla
     mesh=None,  # Mesh with tp>1 → paged Pallas kernel head-sharded
     kv_scale=None,  # [2, K] f32 per-head (k, v) pool dequant scales (fp8 KV)
+    lora=None,  # (stacked adapter factors, ids [B]) — per-slot runtime LoRA
+    # deltas applied unmerged beside the base matmuls, so model-free spec
+    # verify composes with multi-tenant adapters (ISSUE 12; the [B, T, in]
+    # delta rides the XLA gather oracle, same as prefill)
 ):
     """Multi-token decode: write T new k/v per slot and return logits for all
     T positions — the verify pass of speculative decoding (the reference
@@ -1095,7 +1125,12 @@ def decode_chunk(
     win_dist = jnp.arange(T)[:, None] - jnp.arange(T)[None, :]
 
     def layer(h, xs):
-        lp, li, kc, vc = xs
+        if lora is None:
+            lp, li, kc, vc = xs
+            llora = None
+        else:
+            lp, li, kc, vc, la = xs
+            llora = (la, lora[1])
         sliding = _layer_sliding(cfg, li)
         x = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
         inv = _layer_inv_freq(cfg, inv_freq, inv_local, li)
@@ -1139,7 +1174,7 @@ def decode_chunk(
             x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
             h = h + _mlp_out(cfg, lp, x, ep, mesh)
             return h, (rows, rows[..., :0])
-        q, k, v = _attn_proj_qkv(cfg, lp, x, mesh)  # q [B,T,H,Hd], k/v [B,T,K,Hd]
+        q, k, v = _attn_proj_qkv(cfg, lp, x, mesh, lora=llora)  # q [B,T,H,Hd], k/v [B,T,K,Hd]
         q = apply_rope(q, positions, inv)
         k = apply_rope(k, positions, inv)
         K_h = kc.shape[2]
@@ -1185,14 +1220,15 @@ def decode_chunk(
                 "bkgts,bskd->btkgd", probs[..., :S], vc.astype(jnp.float32)
             ) + jnp.einsum("bkgtu,bukd->btkgd", probs[..., S:], v.astype(jnp.float32))
             attn = attn.reshape(B, T, -1).astype(h.dtype)
-        h = h + _attn_out(cfg, lp, attn, mesh)
+        h = h + _attn_out(cfg, lp, attn, mesh, lora=llora)
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-        h = h + _mlp_out(cfg, lp, x, ep, mesh)
+        h = h + _mlp_out(cfg, lp, x, ep, mesh, lora=llora)
         return h, (k, v)
 
-    h, (new_k, new_v) = _scan_layers(
-        cfg, params, h, layer, (cache.k, cache.v)
-    )
+    extras = (cache.k, cache.v)
+    if lora is not None:
+        extras = extras + (lora[0],)
+    h, (new_k, new_v) = _scan_layers(cfg, params, h, layer, extras)
     if ptable is not None:
         cache = write_chunk_to_pool(cache, ptable, new_k, new_v, positions,
                                     kv_scale=kv_scale)

@@ -50,6 +50,8 @@ BASE_EVENTS = (
     "error",         # a dispatch failed; affected requests got error events
     "loop_dead",     # the engine loop died (postmortem follows)
     "profile",       # a jax.profiler capture window ran (a=seconds)
+    "spec_draft",    # verify round dispatched (a=drafted tokens, b=window)
+    "spec_verify",   # verify round processed (a=drafted, b=emitted tokens)
 )
 
 # One journal event type per fault-injection site (faults.SITES), checked
@@ -66,6 +68,7 @@ FAULT_EVENTS = (
     "fault_span_transfer",
     "fault_collective_dispatch",
     "fault_adapter_fetch",
+    "fault_spec_verify",
 )
 
 EVENTS = BASE_EVENTS + FAULT_EVENTS

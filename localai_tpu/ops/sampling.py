@@ -197,6 +197,31 @@ def update_counts(counts: jnp.ndarray, tokens: jnp.ndarray, active: jnp.ndarray)
     return counts.at[jnp.arange(B), tokens].add(inc)
 
 
+def deterministic_accept(
+    pl: jnp.ndarray,  # [B, V] target processed log-probs (processed_logprobs)
+    x: jnp.ndarray,  # [B] int32 draft token under test
+):
+    """Speculative accept inputs for a DETERMINISTIC draft source (prompt
+    lookup, ISSUE 12): the proposal distribution q is a point mass at x, so
+    the canonical test accept-w.p.-min(1, p(x)/q(x)) reduces to p(x), and
+    the rejection draw normalize(max(p - q, 0)) reduces to p with x zeroed,
+    renormalized. Returns (log_ratio [B] = log p(x), residual_logprobs
+    [B, V]); greedy (one-hot p) degenerates to exact argmax agreement —
+    reject unless x IS the argmax, then resample lands on the argmax.
+    """
+    B, V = pl.shape
+    idx = jnp.arange(B)
+    log_ratio = pl[idx, x]
+    res = jnp.where(jnp.arange(V)[None, :] == x[:, None], 0.0, jnp.exp(pl))
+    mass = res.sum(axis=-1, keepdims=True)
+    res_log = jnp.where(
+        mass > 1e-9,
+        jnp.log(res / jnp.maximum(mass, 1e-9) + 1e-38),
+        pl,  # residual mass ~0: the draft matched p's entire support
+    )
+    return log_ratio, res_log
+
+
 def processed_logprobs(
     logits: jnp.ndarray,  # [B, V] any float dtype
     params: SamplingParams,

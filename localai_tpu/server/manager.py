@@ -708,7 +708,16 @@ class ModelManager:
 
         draft_arch = None
         draft_params = None
-        if cfg.draft_model:
+        if cfg.draft_model and cfg.spec_mode in ("prompt_lookup",
+                                                 "self_draft"):
+            # Model-free spec (ISSUE 12): the draft checkpoint would sit
+            # dead in HBM — the target's own weights / the host-visible
+            # token streams do the drafting. Don't even load it.
+            log.info(
+                "model %s: spec_mode=%s is model-free — skipping draft "
+                "checkpoint %s", cfg.name, cfg.spec_mode, cfg.draft_model,
+            )
+        elif cfg.draft_model:
             if cfg.draft_model in PRESETS:
                 draft_arch = get_arch(cfg.draft_model)
                 draft_params = jax.jit(lambda k: init_params(draft_arch, k))(
@@ -748,6 +757,10 @@ class ModelManager:
                 deadline_s=cfg.deadline_s,
                 trace_journal_events=cfg.trace_journal_events,
                 postmortem_dir=self.app_cfg.postmortem_dir,
+                spec_mode=cfg.spec_mode,
+                self_draft_layers=cfg.self_draft_layers,
+                spec_accept_ewma=cfg.spec_accept_ewma,
+                spec_draft_buckets=tuple(cfg.spec_draft_buckets),
             ),
             draft_cfg=draft_arch,
             draft_params=draft_params,

@@ -53,6 +53,15 @@ Hook sites (each is one `faults.fire(SITE)` call in production code):
                      admission with a typed error event; the engine keeps
                      serving every other tenant and the per-slot adapter
                      refcounts stay fully accounted at quiesce.
+  spec_verify      — entry of Engine._dispatch_spec_block (ISSUE 12), just
+                     before a speculative verify round launches (any draft
+                     source: draft_model / prompt_lookup / self_draft). The
+                     containment contract matches device_dispatch (error
+                     events to the affected slots, the engine keeps
+                     serving) and additionally the acceptance EWMAs and the
+                     page-pool accounting must be intact at quiesce — a
+                     failed verify round may not leave a slot's draft
+                     bookkeeping half-updated.
 
 Activation:
   - programmatic: `with faults.active(FaultSchedule(seed=7)): ...`
@@ -91,6 +100,7 @@ SITES = (
     "span_transfer",
     "collective_dispatch",
     "adapter_fetch",
+    "spec_verify",
 )
 
 DEFAULT_RATE = 0.05

@@ -351,7 +351,8 @@ def test_typed_adapter_errors(tiny, adapters):
             eng.register_adapter("t0", adapters["t1"])
     finally:
         _stop(eng)
-    # Speculative engines reject runtime adapters outright.
+    # Only engines with a SEPARATE draft model reject runtime adapters
+    # (model-free spec_mode serves tenants — ISSUE 12).
     deng = Engine(
         cfg, params, ByteTokenizer(cfg.vocab_size),
         engine_cfg=EngineConfig(max_slots=2, max_seq=128,
@@ -359,7 +360,7 @@ def test_typed_adapter_errors(tiny, adapters):
         draft_cfg=cfg, draft_params=params, n_draft=2,
     )
     try:
-        with pytest.raises(AdapterError, match="speculative"):
+        with pytest.raises(AdapterError, match="separate"):
             deng.register_adapter("t0", adapters["t0"])
         with pytest.raises(AdapterError, match="draft"):
             deng.submit(GenRequest(prompt_ids=[1, 2], adapter="t0"))
@@ -467,3 +468,69 @@ def test_virtual_model_resolves_to_shared_engine(tiny, adapters, tmp_path):
             mgr.get("tenant-on-merged")
     finally:
         mgr.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# Model-free speculation × tenancy (ISSUE 12, docs/SPECULATIVE.md)
+# --------------------------------------------------------------------- #
+
+
+def test_model_free_spec_serves_adapter_tenants(tiny, adapters):
+    """The PR 10 restriction only applies to a SEPARATE draft model: with
+    spec_mode=prompt_lookup the target's own weights verify, the per-slot
+    deltas thread into the verify chunk (llama.decode_chunk lora=), and a
+    mixed-tenant batch under speculation is byte-identical to each tenant
+    solo on a plain engine."""
+    plain = _mk(tiny, paged=True)
+    spec = _mk(tiny, paged=True, spec_mode="prompt_lookup")
+    try:
+        for eng in (plain, spec):
+            eng.register_adapter("t1", adapters["t1"])
+            eng.register_adapter("t2", adapters["t2"])
+        # Repetitive prompt so lookup actually drafts while tenants decode.
+        rep = [11, 12, 13] * 8
+        solo = {
+            name: _gen_ids(plain, prompt=rep, adapter=name,
+                           max_new_tokens=12)
+            for name in (None, "t1", "t2")
+        }
+        ths, got = [], {}
+        def run(name):
+            got[name] = _gen_ids(spec, prompt=rep, adapter=name,
+                                 max_new_tokens=12)
+        for name in (None, "t1", "t2"):
+            ths.append(threading.Thread(target=run, args=(name,)))
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=600)
+            assert not t.is_alive(), "mixed-tenant spec batch hung"
+        for name in (None, "t1", "t2"):
+            assert got[name] == solo[name], (name, solo[name], got[name])
+        assert got["t1"] != got[None]  # the delta actually applied
+    finally:
+        _stop(plain)
+        _stop(spec)
+
+
+def test_draft_model_engine_still_rejects_adapters(tiny, adapters):
+    """spec_mode=draft_model keeps the typed AdapterError (the draft would
+    decode without the delta)."""
+    cfg, params = tiny
+    from localai_tpu.models.config import ArchConfig
+
+    dc = ArchConfig(name="d", vocab_size=cfg.vocab_size, hidden_size=32,
+                    intermediate_size=64, num_layers=1, num_heads=2,
+                    num_kv_heads=1, max_position=256)
+    eng = Engine(
+        cfg, params, ByteTokenizer(cfg.vocab_size),
+        engine_cfg=EngineConfig(max_slots=2, max_seq=128,
+                                min_prefill_bucket=16),
+        draft_cfg=dc, draft_params=init_params(dc, jax.random.key(3)),
+        n_draft=3,
+    )
+    try:
+        with pytest.raises(AdapterError, match="model-free"):
+            eng.register_adapter("t1", adapters["t1"])
+    finally:
+        eng.stop()

@@ -37,6 +37,12 @@ HIGHER_MARKERS = (
     "tok_per", "tokens_per", "tok/s", "tps", "speedup", "throughput",
     "rate", "pct", "percent", "concurrency", "accepted", "roofline",
     "fraction", "hits",
+    # Speculative decoding rows (ISSUE 12, BENCH_SPEC_PAGED): accept rates,
+    # accepted-tokens/s and the spec-vs-plain-paged ratios all gate
+    # higher-is-better once two rounds share them; *_draft_hist is a dict
+    # (skipped) and *_draft_ckpt_bytes rides the "bytes" lower-is-better
+    # marker.
+    "accept", "vs_paged",
 )
 LOWER_MARKERS = (
     "_ms", "_s", "ms_", "latency", "ttft", "stall", "bytes", "recover",

@@ -59,6 +59,10 @@ TRACED_MODULE_GLOBS = [
     "localai_tpu/observe/trace.py",
     "localai_tpu/observe/timeline.py",
     "localai_tpu/observe/postmortem.py",
+    # Prompt-lookup drafting (ISSUE 12): the suffix index runs on the
+    # engine loop between every dispatch — it must stay pure Python/numpy
+    # (a traced value or device pull here stalls the whole decode cadence).
+    "localai_tpu/engine/speclookup.py",
 ]
 
 ENGINE_TARGET = ("localai_tpu/engine/engine.py", "Engine")
@@ -77,6 +81,9 @@ HOT_METHODS = {
     "_grow_for_decode", "_pages_grow_slot", "_pages_alloc", "_pages_free",
     "_pick_block_size", "_has_unscheduled", "_charge", "_track",
     "_note_admitted", "_grammar_choose", "_grammar_advance",
+    # Speculative scheduling (ISSUE 12): planning + lookup mining run
+    # between every dispatch; the sd-sync walks per-slot state each round.
+    "_spec_plan", "_spec_len_for", "_lookup_propose", "_spec_sd_sync",
 }
 
 DEVICE_ROOTS = {
