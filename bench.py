@@ -1164,6 +1164,156 @@ def main() -> None:
                 rep.engine.params = None
                 rep.engine.cache = None
 
+    # Multi-host cluster row (ISSUE 13, docs/CLUSTER.md § multi-host): a
+    # 2-process SIMULATED cluster — one spawned prefill-role worker process
+    # (own jax runtime, real HTTP hop) + a local decode engine behind the
+    # cluster client. Measures aggregate tok/s + p99 TTFT at 4x one-host
+    # saturation with cluster-wide disaggregation on, span_transfer_ms over
+    # the real network hop (streamed, checksummed), and disagg-vs-recompute
+    # TTFT. Deadline-joined; gated in tools/bench_gate.py (tps/ttft/ms
+    # direction markers).
+    if os.environ.get("BENCH_MULTIHOST", "1") != "0" and max_seq % 128 == 0:
+        mh_worker = None
+        mh_dec = None
+        try:
+            import tempfile
+
+            from localai_tpu.cluster import (
+                ClusterClient,
+                LocalReplica,
+                RemoteReplica,
+            )
+            from localai_tpu.testing import multihost
+
+            mh_pages = slots * (max_seq // 128)
+            mdir = tempfile.mkdtemp(prefix="bench-mh-")
+            multihost.write_tiny_model_yaml(
+                mdir, name="mh", arch=arch, context_size=max_seq,
+                max_slots=slots, kv_pages=mh_pages, kv_page_size=128)
+            mh_worker = multihost.spawn_worker(mdir, role="prefill",
+                                               boot_timeout_s=600.0)
+            mh_dec = Engine(
+                cfg, params, ByteTokenizer(cfg.vocab_size),
+                engine_cfg=EngineConfig(
+                    max_slots=slots, max_seq=max_seq,
+                    kv_pages=mh_pages, kv_page_size=128,
+                    prefix_admit_async_compile=False,
+                ))
+            mh_dec.start()
+            mh_prompt = min(max(prompt_len, 2 * 128 + 2),
+                            max_seq - gen_len - 8)
+            if mh_prompt <= 128:
+                raise RuntimeError(
+                    f"max_seq {max_seq} too small for a multihost-row "
+                    f"prompt covering one 128-row KV page")
+            # Prime the decode engine's programs (concurrent pair + repeat,
+            # same recipe as the cluster row).
+            pa, pb = [5] * mh_prompt, [6] * mh_prompt
+            pts = [threading.Thread(
+                target=lambda ids=ids_: mh_dec.generate(
+                    ids, max_new_tokens=gen_len, ignore_eos=True))
+                for ids_ in (pa, pb)]
+            for t in pts:
+                t.start()
+            for t in pts:
+                t.join(timeout=600)
+            mh_dec.generate(pa, max_new_tokens=4, ignore_eos=True)
+
+            remote = RemoteReplica("host2", mh_worker.url, model="mh",
+                                   timeout_s=600.0)
+            mclient = ClusterClient(
+                [LocalReplica("d0", mh_dec, role="decode"), remote],
+                gauge_refresh_s=0.5, disaggregate=True)
+
+            # Raw network-hop span path, warmed then timed: the worker
+            # computes+streams the span once (cold), the timed fetch rides
+            # its prefix cache.
+            ids = [(j * 11) % 255 + 1 for j in range(mh_prompt)]
+            from localai_tpu.cluster import netspan as _netspan
+
+            frame = _netspan.fetch_span(mh_worker.url, "mh", ids,
+                                        timeout_s=600.0)
+            t0 = time.time()
+            frame = _netspan.fetch_span(mh_worker.url, "mh", ids,
+                                        timeout_s=600.0)
+            ok = mh_dec.import_span_bytes(frame)
+            if ok:
+                out["multihost_span_transfer_ms"] = round(
+                    (time.time() - t0) * 1000, 2)
+                out["multihost_span_frame_bytes"] = len(frame)
+            # Disaggregated TTFT (remote span already hot in the local host
+            # tier) vs recompute TTFT (same shape, cold prefix, full local
+            # admission — the fallback path's cost).
+            _, ev = mclient.generate(ids, max_new_tokens=8, ignore_eos=True)
+            out["multihost_disagg_ttft_ms"] = round(
+                ev.timing_prompt_processing * 1000, 1)
+            cold_ids = [(j * 13) % 255 + 2 for j in range(mh_prompt)]
+            _, ev = mh_dec.generate(cold_ids, max_new_tokens=8,
+                                    ignore_eos=True)
+            out["multihost_recompute_ttft_ms"] = round(
+                ev.timing_prompt_processing * 1000, 1)
+
+            # Aggregate serving at 4x one-host saturation through the
+            # 2-process cluster (grouped prompts: first of each group pays
+            # the remote handoff, repeats ride local prefix affinity).
+            N = 4 * slots
+            n_groups = 4
+            mttfts: list[float] = []
+            merrs: list[str] = []
+            mlock = threading.Lock()
+
+            def mone(i: int) -> None:
+                g = i % n_groups
+                ids_ = [(g * 131 + j * 7) % 255 + 1
+                        for j in range(mh_prompt)]
+                try:
+                    _, ev = mclient.generate(ids_, max_new_tokens=gen_len,
+                                             ignore_eos=True)
+                    with mlock:
+                        mttfts.append(ev.timing_prompt_processing)
+                except Exception as e:  # noqa: BLE001
+                    with mlock:
+                        merrs.append(f"req {i}: {type(e).__name__}: {e}")
+
+            mthreads = [threading.Thread(target=mone, args=(i,))
+                        for i in range(N)]
+            mw0 = time.time()
+            for t in mthreads:
+                t.start()
+            deadline = time.time() + 600
+            for t in mthreads:
+                t.join(timeout=max(1.0, deadline - time.time()))
+            if any(t.is_alive() for t in mthreads):
+                raise RuntimeError("multihost row: requests hung past "
+                                   "deadline")
+            if merrs:
+                raise RuntimeError("; ".join(merrs[:3]))
+            mwall = time.time() - mw0
+            mttfts.sort()
+            p99 = mttfts[min(len(mttfts) - 1, int(len(mttfts) * 0.99))]
+            out["multihost_tps"] = round(N * gen_len / mwall, 1)
+            out["multihost_p99_ttft_ms"] = round(p99 * 1000, 1)
+            out["multihost_remote_handoffs"] = mclient.m_remote_handoffs
+            print(
+                f"multihost: {out['multihost_tps']} tok/s, p99 TTFT "
+                f"{out['multihost_p99_ttft_ms']} ms, disagg TTFT "
+                f"{out.get('multihost_disagg_ttft_ms')} ms vs recompute "
+                f"{out.get('multihost_recompute_ttft_ms')} ms (span "
+                f"{out.get('multihost_span_transfer_ms')} ms over HTTP, "
+                f"{mclient.m_remote_handoffs} remote handoffs)",
+                file=sys.stderr,
+            )
+        except Exception as e:  # noqa: BLE001 — extra row is best-effort
+            print(f"multihost row failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+        finally:
+            if mh_dec is not None:
+                mh_dec.stop()
+                mh_dec.params = None
+                mh_dec.cache = None
+            if mh_worker is not None:
+                mh_worker.stop()
+
     # Tensor-parallel serving row (ISSUE 7, docs/SHARDED_SERVING.md):
     # paged decode tok/s + p99 TTFT at tp=1 vs tp=4 vs tp=8 (whatever the
     # device count and the arch's kv-head divisibility allow — 8B decode is

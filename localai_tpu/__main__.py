@@ -41,6 +41,10 @@ def _build_parser() -> argparse.ArgumentParser:
         cmd.add_argument("--cluster-replicas", type=int, default=None,
                          help="fan each text model across N same-host engine "
                               "replicas (LOCALAI_CLUSTER_REPLICAS)")
+        cmd.add_argument("--cluster-peers", default=None,
+                         help="comma-separated name=url remote workers for "
+                              "cross-host prefill handoff / span transfer "
+                              "(LOCALAI_CLUSTER_PEERS)")
 
     run = sub.add_parser("run", help="start the API server (default)")
     add_run_flags(run)
@@ -211,6 +215,16 @@ def main(argv: list[str] | None = None) -> int:
         overrides["cluster_role"] = args.cluster_role
     if args.cluster_replicas:
         overrides["cluster_replicas"] = args.cluster_replicas
+    if args.cluster_peers:
+        overrides["cluster_peers"] = [
+            p.strip() for p in args.cluster_peers.split(",") if p.strip()
+        ]
+    if getattr(args, "coordinator", None):
+        overrides["coordinator_address"] = args.coordinator
+    if getattr(args, "num_processes", None):
+        overrides["num_processes"] = args.num_processes
+    if getattr(args, "process_id", None) is not None:
+        overrides["process_id"] = args.process_id
     if args.debug:
         overrides["debug"] = True
 
@@ -227,14 +241,11 @@ def main(argv: list[str] | None = None) -> int:
     log = logging.getLogger("localai_tpu")
 
     # Multi-host: wire this process into the global device mesh BEFORE any
-    # jax computation (jax.distributed must come first).
-    from localai_tpu.parallel.distributed import init_distributed
+    # jax computation (jax.distributed must come first). CLI args landed in
+    # app_cfg above; env mirrors (LOCALAI_COORDINATOR/...) ride from_env.
+    from localai_tpu.parallel.distributed import init_from_config
 
-    init_distributed(
-        coordinator=getattr(args, "coordinator", None),
-        num_processes=getattr(args, "num_processes", None),
-        process_id=getattr(args, "process_id", None),
-    )
+    init_from_config(app_cfg)
 
     from localai_tpu.gallery import Gallery, GalleryService
     from localai_tpu.server import ModelManager, Router, create_server
@@ -283,6 +294,7 @@ def main(argv: list[str] | None = None) -> int:
         federator=getattr(args, "federator", None)
         or os.environ.get("LOCALAI_FEDERATOR"),
         worker_name=getattr(args, "worker_name", None),
+        cluster_peers=app_cfg.cluster_peers,
     ).register(router)
 
     for name in app_cfg.preload_models:

@@ -15,8 +15,11 @@ from localai_tpu.cluster.affinity import (
 from localai_tpu.cluster.replica import (
     ClusterEngine,
     LocalReplica,
+    RemoteReplica,
     build_local_replicas,
+    parse_peers,
     parse_roles,
+    probe_worker_role,
     scrape_engine_gauges,
 )
 from localai_tpu.cluster.scheduler import ClusterClient, ClusterScheduler
@@ -27,13 +30,16 @@ __all__ = [
     "ClusterEngine",
     "ClusterScheduler",
     "LocalReplica",
+    "RemoteReplica",
     "SpanTransferError",
     "build_local_replicas",
     "byte_span_hashes",
     "decode_span",
     "encode_span",
     "leading_overlap",
+    "parse_peers",
     "parse_roles",
+    "probe_worker_role",
     "scrape_engine_gauges",
     "span_hashes",
 ]

@@ -1,0 +1,363 @@
+"""Networked LAIKV span streaming (ISSUE 13, docs/CLUSTER.md § multi-host).
+
+cluster/transfer.py frames a KV span as one self-describing LAIKV v1 blob;
+this module carries that blob across a REAL network hop through the existing
+`/cluster/span/export|import` HTTP seam. The design goals, in order:
+
+  1. A corrupted or truncated transfer must be DETECTED, never imported —
+     every chunk carries a CRC32, the stream ends with a running-CRC
+     trailer, and the whole frame is covered by a blake2b digest announced
+     up front. Any mismatch is a typed SpanTransferError; the caller's
+     contract (same as transfer.decode_span) is recompute, never corrupt KV.
+  2. Size bounds hold MID-STREAM: the assembler aborts as soon as the bytes
+     received exceed `transfer_max_bytes` (or the announced total), so an
+     oversized/lying exporter cannot balloon the importer's memory.
+  3. Transfers are RESUMABLE and ABORTABLE: the fetch client re-requests
+     from its verified byte offset after a connection drop (the control
+     header's digest pins the exporter to the same frame — a changed span
+     409s and the client falls back), and a caller-supplied abort probe is
+     checked at every chunk boundary.
+
+Wire format (LAIKV-STREAM v1, little-endian; rides inside the HTTP body as
+chunked transfer encoding on export and a framed POST body on import):
+
+    MESSAGE := HDR(16 bytes) PAYLOAD
+    HDR     := magic b"LAIC" | seq u32 | payload_len u32 | crc32 u32
+
+    seq 0        control: JSON {"v": 1, "total": frame bytes, "digest":
+                 blake2b-128 hex of the WHOLE frame, "offset": resume
+                 offset, "trace": trace id}
+    seq 1..n     consecutive frame slices starting at `offset`
+    trailer      payload_len == 0; crc32 field holds the RUNNING crc of
+                 every payload byte sent this stream
+
+Fault sites (ISSUE 13 satellite, localai_tpu.testing.faults):
+`host_partition` raises at a chunk boundary (the peer vanished mid-stream);
+`slow_network` sleeps SLOW_NETWORK_DELAY_S at a chunk boundary (a stalled
+peer — the caller's socket timeout turns it into a typed failure). Both
+degrade to recompute/reroute, never a hung caller.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import struct
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import zlib
+from typing import Callable, Iterator, Optional
+
+from localai_tpu.cluster.transfer import DEFAULT_MAX_BYTES, SpanTransferError
+from localai_tpu.testing import faults
+
+CHUNK_MAGIC = b"LAIC"
+STREAM_VERSION = 1
+_HDR = struct.Struct("<4sIII")  # magic, seq, payload_len, crc32
+
+DEFAULT_CHUNK_BYTES = 1 << 20
+# How long an injected slow_network fault stalls one chunk boundary. Tests
+# set the caller's timeout below this so the stall surfaces as a typed
+# timeout failure, exactly like a congested DCN link would.
+SLOW_NETWORK_DELAY_S = 2.0
+# Client-side read granularity; independent of the sender's chunk_bytes.
+_READ_BYTES = 1 << 16
+
+
+def frame_digest(frame: bytes) -> str:
+    """blake2b-128 of a whole LAIKV frame — pins a resumed transfer to the
+    exact bytes the first attempt started streaming."""
+    return hashlib.blake2b(frame, digest_size=16).hexdigest()
+
+
+def _maybe_slow() -> None:
+    """slow_network hook: an injected fault here STALLS (the failure mode is
+    the peer's clock, not an exception) — callers see it as their socket
+    timeout expiring."""
+    try:
+        faults.fire("slow_network")
+    except faults.InjectedFault:
+        time.sleep(SLOW_NETWORK_DELAY_S)
+
+
+def _partition_point() -> None:
+    """host_partition hook: the peer dropped off the network mid-stream."""
+    faults.fire("host_partition")
+
+
+def encode_stream(frame: bytes, chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                  offset: int = 0, trace: str = "") -> Iterator[bytes]:
+    """Generate the wire messages for one frame (from `offset`). Runs on
+    the EXPORTER — as an HTTP RawStream body generator or a push client."""
+    if offset < 0 or offset > len(frame):
+        raise SpanTransferError(
+            f"resume offset {offset} outside frame of {len(frame)} bytes")
+    chunk_bytes = max(1, int(chunk_bytes))
+    control = json.dumps({
+        "v": STREAM_VERSION,
+        "total": len(frame),
+        "digest": frame_digest(frame),
+        "offset": int(offset),
+        **({"trace": str(trace)} if trace else {}),
+    }).encode()
+    yield _HDR.pack(CHUNK_MAGIC, 0, len(control), zlib.crc32(control)) + control
+    run_crc = 0
+    seq = 0
+    for lo in range(offset, len(frame), chunk_bytes):
+        _partition_point()
+        _maybe_slow()
+        seq += 1
+        piece = frame[lo:lo + chunk_bytes]
+        run_crc = zlib.crc32(piece, run_crc)
+        yield _HDR.pack(CHUNK_MAGIC, seq, len(piece), zlib.crc32(piece)) + piece
+    yield _HDR.pack(CHUNK_MAGIC, seq + 1, 0, run_crc)
+
+
+class StreamAssembler:
+    """Incremental parser/validator for a LAIKV-STREAM byte sequence.
+
+    feed() raises SpanTransferError the moment anything is provably wrong
+    (bad magic, CRC mismatch, out-of-order seq, mid-stream size-bound
+    violation, digest/offset disagreement); bytes land in the assembled
+    frame only after their chunk CRC verified, so `frame_so_far()` is
+    always a safe resume point.
+    """
+
+    def __init__(self, max_bytes: int = DEFAULT_MAX_BYTES, prior: bytes = b"",
+                 expect_digest: str = "", verify: bool = True):
+        self._buf = bytearray()
+        self._frame = bytearray(prior)
+        self._base = len(prior)
+        self.max_bytes = int(max_bytes)
+        self.expect_digest = expect_digest
+        self.verify = verify
+        self.meta: dict = {}
+        self._next_seq = 0
+        self._run_crc = 0
+        self._total: Optional[int] = None
+        self.done = False
+
+    def frame_so_far(self) -> bytes:
+        """Verified-so-far frame bytes (prior + CRC-checked chunks)."""
+        return bytes(self._frame)
+
+    def feed(self, data: bytes) -> None:
+        if self.done:
+            raise SpanTransferError("bytes past the stream trailer")
+        self._buf += data
+        while True:
+            if len(self._buf) < _HDR.size:
+                return
+            magic, seq, plen, crc = _HDR.unpack_from(self._buf)
+            if magic != CHUNK_MAGIC:
+                raise SpanTransferError(
+                    f"bad stream chunk magic {bytes(magic)!r}")
+            if self.max_bytes > 0 and plen > self.max_bytes:
+                raise SpanTransferError(
+                    f"stream chunk of {plen} bytes exceeds the "
+                    f"{self.max_bytes}-byte transfer cap")
+            if len(self._buf) < _HDR.size + plen:
+                return
+            payload = bytes(self._buf[_HDR.size:_HDR.size + plen])
+            del self._buf[:_HDR.size + plen]
+            if seq != self._next_seq:
+                raise SpanTransferError(
+                    f"stream chunk seq {seq} != expected {self._next_seq}")
+            if self.verify and plen and zlib.crc32(payload) != crc:
+                raise SpanTransferError(
+                    f"stream chunk {seq} CRC mismatch — corrupt transfer")
+            if seq == 0:
+                self._control(payload)
+            elif plen == 0:
+                self._trailer(crc)
+                if self._buf:
+                    raise SpanTransferError("bytes past the stream trailer")
+                return
+            else:
+                self._run_crc = zlib.crc32(payload, self._run_crc)
+                self._frame += payload
+                self._bounds_check()
+            self._next_seq += 1
+
+    def _control(self, payload: bytes) -> None:
+        try:
+            meta = json.loads(payload)
+        except (ValueError, UnicodeDecodeError) as e:
+            raise SpanTransferError(
+                f"unparseable stream control header: {e}") from None
+        if not isinstance(meta, dict):
+            raise SpanTransferError("stream control header is not an object")
+        self.meta = meta
+        self._total = int(meta.get("total", -1))
+        if self._total < 0:
+            raise SpanTransferError("stream control header missing total")
+        if self.max_bytes > 0 and self._total > self.max_bytes:
+            raise SpanTransferError(
+                f"announced frame of {self._total} bytes exceeds the "
+                f"{self.max_bytes}-byte transfer cap")
+        if int(meta.get("offset", 0)) != self._base:
+            raise SpanTransferError(
+                f"stream resumes at {meta.get('offset')} but "
+                f"{self._base} bytes are already assembled")
+        digest = str(meta.get("digest", ""))
+        if self.expect_digest and digest and digest != self.expect_digest:
+            raise SpanTransferError(
+                "frame digest changed between transfer attempts — the "
+                "exporter's span is no longer the one this transfer began")
+        self._bounds_check()
+
+    def _bounds_check(self) -> None:
+        n = len(self._frame)
+        if self.max_bytes > 0 and n > self.max_bytes:
+            raise SpanTransferError(
+                f"assembled {n} bytes, cap is {self.max_bytes} "
+                f"(transfer_max_bytes, enforced mid-stream)")
+        if self._total is not None and n > self._total:
+            raise SpanTransferError(
+                f"assembled {n} bytes past the announced total {self._total}")
+
+    def _trailer(self, crc: int) -> None:
+        if self._total is None:
+            raise SpanTransferError("stream trailer before control header")
+        if len(self._frame) != self._total:
+            raise SpanTransferError(
+                f"stream ended at {len(self._frame)} of {self._total} bytes")
+        if self.verify and crc != self._run_crc:
+            raise SpanTransferError(
+                "stream trailer CRC mismatch — payload corrupted in flight")
+        if self.verify and self._base == 0:
+            digest = str(self.meta.get("digest", ""))
+            if digest and frame_digest(bytes(self._frame)) != digest:
+                raise SpanTransferError(
+                    "assembled frame digest mismatch — corrupt transfer")
+        self.done = True
+
+    def result(self) -> bytes:
+        if not self.done:
+            raise SpanTransferError(
+                f"stream truncated: {len(self._frame)} bytes assembled, "
+                f"no trailer seen")
+        return bytes(self._frame)
+
+
+def assemble(data: bytes, max_bytes: int = DEFAULT_MAX_BYTES,
+             verify: bool = True) -> tuple[bytes, dict]:
+    """One-shot assembly of a complete wire byte sequence (the import
+    handler's path). Size bounds still apply chunk-by-chunk as the walk
+    proceeds. Returns (frame, control meta)."""
+    asm = StreamAssembler(max_bytes=max_bytes, verify=verify)
+    asm.feed(data)
+    return asm.result(), asm.meta
+
+
+# --------------------------------------------------------------------- #
+# HTTP clients over the /cluster/span seam
+# --------------------------------------------------------------------- #
+
+
+def fetch_span(base_url: str, model: str, prompt_ids,
+               max_bytes: int = DEFAULT_MAX_BYTES,
+               chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+               timeout_s: float = 30.0, trace_id: str = "",
+               traceparent: str = "", compute: bool = True,
+               max_resumes: int = 2, verify: bool = True,
+               should_abort: Optional[Callable[[], bool]] = None) -> bytes:
+    """Pull one prompt's KV span from a remote exporter as a verified LAIKV
+    frame. Resumes from the verified offset after connection drops (up to
+    `max_resumes` times); raises SpanTransferError on any terminal failure
+    — the caller's contract is recompute."""
+    got = b""
+    digest = ""
+    attempts = 0
+    while True:
+        asm = StreamAssembler(max_bytes=max_bytes, prior=got,
+                              expect_digest=digest, verify=verify)
+        body = json.dumps({
+            "model": model,
+            "prompt_ids": [int(t) for t in prompt_ids],
+            "stream": True,
+            "offset": len(got),
+            "chunk_bytes": int(chunk_bytes),
+            # Only the FIRST attempt may trigger a prefill: a resume must
+            # find the same span, not recompute a new one.
+            "compute": bool(compute) and not got,
+            "digest": digest,
+            "trace": str(trace_id),
+        }).encode()
+        headers = {"Content-Type": "application/json"}
+        if traceparent:
+            headers["traceparent"] = traceparent
+        req = urllib.request.Request(
+            base_url.rstrip("/") + "/cluster/span/export",
+            data=body, headers=headers)
+        err: object = None
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                while True:
+                    if should_abort is not None and should_abort():
+                        raise SpanTransferError(
+                            "span transfer aborted by caller")
+                    # slow_network fires only where bytes are PRODUCED
+                    # (encode_stream) — here it surfaces as this read
+                    # blocking past timeout_s.
+                    _partition_point()
+                    data = resp.read(_READ_BYTES)
+                    if not data:
+                        break
+                    asm.feed(data)
+            if asm.done:
+                return asm.result()
+            err = "stream ended before the trailer"
+        except SpanTransferError:
+            raise  # corruption/cap/abort: a rejection, not a retry
+        except urllib.error.HTTPError as e:
+            code = e.code
+            e.close()
+            if code == 404:
+                raise SpanTransferError(
+                    "exporter stored no span for this prompt") from None
+            if code == 409:
+                raise SpanTransferError(
+                    "exporter's span changed mid-transfer") from None
+            err = f"HTTP {code}"
+        except faults.InjectedFault as e:
+            err = e  # host_partition: resumable, like any dropped link
+        except (OSError, http.client.HTTPException) as e:
+            err = e  # timeout / reset / refused / truncated chunked body
+        got = asm.frame_so_far()
+        digest = str(asm.meta.get("digest", "")) or digest
+        attempts += 1
+        if attempts > max_resumes:
+            raise SpanTransferError(
+                f"span fetch failed after {attempts} attempt(s) "
+                f"({len(got)} bytes verified): {err}")
+
+
+def push_span(base_url: str, model: str, frame: bytes,
+              max_bytes: int = DEFAULT_MAX_BYTES,
+              chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+              timeout_s: float = 30.0, trace_id: str = "",
+              traceparent: str = "") -> bool:
+    """Push a frame INTO a remote importer's host tier over the framed wire
+    format (per-chunk CRCs + digest, cap enforced on the importer as it
+    walks the chunks). Returns the importer's verdict; raises
+    SpanTransferError on transport failure."""
+    body = b"".join(encode_stream(frame, chunk_bytes=chunk_bytes,
+                                  trace=trace_id))
+    headers = {"Content-Type": "application/x-laikv-stream"}
+    if traceparent:
+        headers["traceparent"] = traceparent
+    url = (base_url.rstrip("/")
+           + "/cluster/span/import?model=" + urllib.parse.quote(model))
+    req = urllib.request.Request(url, data=body, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            out = json.loads(resp.read())
+        return bool(out.get("imported"))
+    except faults.InjectedFault as e:
+        raise SpanTransferError(f"span push failed: {e}") from None
+    except (OSError, http.client.HTTPException, ValueError) as e:
+        raise SpanTransferError(f"span push failed: {e}") from None

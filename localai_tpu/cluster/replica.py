@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 import urllib.request
 from typing import Optional
 
@@ -46,6 +47,8 @@ def parse_roles(n: int, spec: str = "") -> list[str]:
 
 class LocalReplica:
     """One in-process engine replica (same host, own KV pool + loop)."""
+
+    remote = False
 
     def __init__(self, name: str, engine, role: str = "mixed"):
         self.name = name
@@ -82,6 +85,135 @@ def build_local_replicas(cfg, params, tokenizer, n: int, engine_cfg,
         eng.start()
         out.append(LocalReplica(f"{name_prefix}{i}", eng, role=roles[i]))
     return out
+
+
+def probe_worker_role(base_url: str, timeout: float = 3.0) -> str:
+    """One /healthz probe reading the LocalAI-Cluster-Role header a worker
+    advertises on every response (server/app.py). Returns "mixed" when the
+    worker declares nothing; raises on an unreachable worker."""
+    with urllib.request.urlopen(base_url.rstrip("/") + "/healthz",
+                                timeout=timeout) as resp:
+        role = resp.headers.get("LocalAI-Cluster-Role", "")
+    from localai_tpu.cluster.scheduler import ROLES
+
+    return role if role in ROLES else "mixed"
+
+
+def parse_peers(specs) -> list[tuple[str, str]]:
+    """[(name, url)] from cluster_peers entries ("name=url" or bare URL —
+    bare URLs get positional names)."""
+    out: list[tuple[str, str]] = []
+    for i, spec in enumerate(specs or []):
+        spec = str(spec).strip()
+        if not spec:
+            continue
+        name, sep, url = spec.partition("=")
+        if not sep:
+            name, url = f"peer{i}", spec
+        out.append((name.strip(), url.strip().rstrip("/")))
+    return out
+
+
+class RemoteReplica:
+    """A worker on ANOTHER machine, reached over HTTP (ISSUE 13).
+
+    Not a dispatch target for the in-process ClusterClient (its engine
+    lives elsewhere — the federation front door owns request proxying);
+    it IS a prefill-handoff target: `fetch_span` pulls a finished prompt's
+    KV over the networked LAIKV stream (cluster.netspan — checksummed,
+    size-bounded, resumable) into the local decode replica's host tier.
+
+    Load comes from the peer's /metrics scrape with a STALENESS BOUND:
+    gauges older than `gauge_stale_s` refresh on the next read, and a peer
+    unreachable past the bound raises — the scheduler then marks it dead
+    and drains its affinity, exactly like a crashed local replica. Roles
+    ride the LocalAI-Cluster-Role header on the same cadence.
+    """
+
+    remote = True
+    engine = None  # never dispatched in-process
+
+    def __init__(self, name: str, url: str, model: str = "",
+                 role: str = "mixed", gauge_stale_s: float = 5.0,
+                 timeout_s: float = 20.0,
+                 chunk_bytes: int = 1 << 20, verify: bool = True,
+                 max_resumes: int = 2, discover_role: bool = True):
+        self.name = name
+        self.url = url.rstrip("/")
+        self.model = model
+        self.role = role
+        self.gauge_stale_s = gauge_stale_s
+        self.timeout_s = timeout_s
+        self.chunk_bytes = chunk_bytes
+        self.verify = verify
+        self.max_resumes = max_resumes
+        self._gauges: dict = {}
+        self._gauge_at = 0.0
+        self._role_at = 0.0
+        if discover_role:
+            # Eager discovery: role decides whether the cluster client
+            # enables disaggregation AT CONSTRUCTION (a down peer keeps the
+            # declared default and re-discovers at the next gauge refresh).
+            try:
+                self.role = probe_worker_role(
+                    self.url, timeout=min(3.0, timeout_s))
+                self._role_at = time.monotonic()
+            except Exception:  # noqa: BLE001 — peer may not be up yet
+                log.info("cluster peer %s (%s) unreachable at construction "
+                         "— role stays %r until a probe lands",
+                         name, self.url, role)
+
+    def span_tokens(self) -> int:
+        return 0  # the local decode replica's geometry governs
+
+    def last_gauge_age(self) -> Optional[float]:
+        if not self._gauge_at:
+            return None
+        return time.monotonic() - self._gauge_at
+
+    def gauges(self) -> dict:
+        """Staleness-bounded /metrics scrape. Raises once the peer has been
+        unreachable past gauge_stale_s — an exception here is how the
+        scheduler learns a host is dead (refresh() catches it)."""
+        now = time.monotonic()
+        if now - self._gauge_at < self.gauge_stale_s and self._gauges:
+            return self._gauges
+        try:
+            g = scrape_engine_gauges(self.url, model=self.model,
+                                     timeout=min(3.0, self.timeout_s))
+        except Exception:
+            if now - self._gauge_at > self.gauge_stale_s:
+                raise  # stale past the bound == dead host
+            return self._gauges
+        self._gauges, self._gauge_at = g, time.monotonic()
+        if now - self._role_at >= self.gauge_stale_s:
+            # Role discovery rides the same refresh tick (cheap /healthz);
+            # scheduler.refresh() syncs rep.role from this attribute.
+            try:
+                self.role = probe_worker_role(
+                    self.url, timeout=min(3.0, self.timeout_s))
+                self._role_at = time.monotonic()
+            except Exception:  # noqa: BLE001 — role keeps its last value
+                pass
+        return self._gauges
+
+    def fetch_span(self, prompt_ids, max_bytes: int = 0, trace_id: str = "",
+                   traceparent: str = "", should_abort=None) -> bytes:
+        """Pull (computing on demand) this prompt's KV span from the peer
+        over the streamed wire format. Raises SpanTransferError on any
+        terminal failure — the caller recomputes."""
+        from localai_tpu.cluster import netspan, transfer
+
+        return netspan.fetch_span(
+            self.url, self.model, prompt_ids,
+            max_bytes=max_bytes or transfer.DEFAULT_MAX_BYTES,
+            chunk_bytes=self.chunk_bytes, timeout_s=self.timeout_s,
+            trace_id=trace_id, traceparent=traceparent, compute=True,
+            max_resumes=self.max_resumes, verify=self.verify,
+            should_abort=should_abort)
+
+    def stop(self) -> None:  # lifecycle parity with LocalReplica
+        return None
 
 
 def scrape_engine_gauges(base_url: str, model: str = "",
@@ -132,8 +264,12 @@ class ClusterEngine:
                                 else transfer_max_bytes),
             affinity_spans=affinity_spans,
             gauge_refresh_s=gauge_refresh_s, hit_weight=hit_weight)
-        self.tokenizer = self.replicas[0].engine.tokenizer
-        self.ecfg = self.replicas[0].engine.ecfg
+        # Engine-shaped surface comes from the LOCAL replicas; remote peers
+        # (ISSUE 13) have no in-process engine to borrow from.
+        self.local_replicas = [r for r in self.replicas
+                               if not getattr(r, "remote", False)]
+        self.tokenizer = self.local_replicas[0].engine.tokenizer
+        self.ecfg = self.local_replicas[0].engine.ecfg
         # Teardown parity with Engine (the manager Nones these to drop HBM).
         self.params = None
         self.cache = None
@@ -147,7 +283,7 @@ class ClusterEngine:
         return self.client.generate(prompt_ids, **kw)
 
     def embed(self, ids_batch):
-        for rep in self.replicas:
+        for rep in self.local_replicas:
             if not rep.engine.is_dead:
                 return rep.engine.embed(ids_batch)
         raise RuntimeError("every cluster replica is dead")
@@ -155,36 +291,37 @@ class ClusterEngine:
     # -------- lifecycle -------- #
 
     def start(self) -> None:
-        for rep in self.replicas:
+        for rep in self.local_replicas:
             rep.engine.start()
 
     def stop(self) -> None:
-        for rep in self.replicas:
+        for rep in self.local_replicas:
             rep.engine.stop()
             rep.engine.params = None
             rep.engine.cache = None
 
     def cancel_all(self) -> int:
         n = self.client.cancel_all()
-        for rep in self.replicas:
+        for rep in self.local_replicas:
             n += rep.engine.cancel_all()
         return n
 
     def warmup(self, *args, **kw) -> None:
-        for rep in self.replicas:
+        for rep in self.local_replicas:
             rep.engine.warmup(*args, **kw)
 
     @property
     def is_dead(self) -> bool:
         """Crash-only contract at cluster granularity: the cluster is dead
-        only when EVERY replica's loop died — one dead replica reroutes."""
-        return all(rep.engine.is_dead for rep in self.replicas)
+        only when EVERY local replica's loop died — one dead replica
+        reroutes, and remote peers never gate local liveness."""
+        return all(rep.engine.is_dead for rep in self.local_replicas)
 
     @property
     def postmortem_path(self) -> str:
         """First replica flight-recorder dump, for the loop_dead gauge
         labels (ISSUE 11) — "" while every replica is alive."""
-        for rep in self.replicas:
+        for rep in self.local_replicas:
             p = getattr(rep.engine, "postmortem_path", "")
             if p:
                 return p
@@ -194,7 +331,7 @@ class ClusterEngine:
         """{replica name: EventJournal} for /debug/timeline — one Perfetto
         process row per replica (ISSUE 11)."""
         out = {}
-        for rep in self.replicas:
+        for rep in self.local_replicas:
             j = getattr(rep.engine, "journal", None)
             if j is not None:
                 out[rep.name] = j
@@ -202,7 +339,7 @@ class ClusterEngine:
 
     def metrics(self) -> dict[str, float]:
         out: dict[str, float] = {}
-        for rep in self.replicas:
+        for rep in self.local_replicas:
             for k, v in rep.engine.metrics().items():
                 if k == "loop_dead":
                     continue  # summed deaths would read as a dead cluster
@@ -210,6 +347,8 @@ class ClusterEngine:
         out["loop_dead"] = 1.0 if self.is_dead else 0.0
         out["cluster_replicas"] = float(len(self.replicas))
         out["cluster_replicas_dead"] = float(
-            sum(1 for rep in self.replicas if rep.engine.is_dead))
+            sum(1 for rep in self.local_replicas if rep.engine.is_dead))
+        out["cluster_remote_replicas"] = float(
+            len(self.replicas) - len(self.local_replicas))
         out.update(self.client.metrics())
         return out

@@ -84,6 +84,10 @@ class _Replica:
     alive: bool = True
     load: float = 0.0
     last_shed: float = 0.0
+    # False for REMOTE replicas (ISSUE 13): valid prefill-handoff/affinity
+    # targets, but the in-process ClusterClient cannot submit to them — the
+    # federation front door owns cross-host request proxying.
+    dispatchable: bool = True
     gauges: dict = dataclasses.field(default_factory=dict)
     affinity: "OrderedDict[bytes, float]" = dataclasses.field(
         default_factory=OrderedDict)
@@ -107,12 +111,14 @@ class ClusterScheduler:
     # ---------------- membership ---------------- #
 
     def add_replica(self, name: str, target: Any = None, role: str = "mixed",
-                    gauge_fn: Optional[Callable[[], dict]] = None) -> None:
+                    gauge_fn: Optional[Callable[[], dict]] = None,
+                    dispatchable: bool = True) -> None:
         if role not in ROLES:
             raise ValueError(f"replica role {role!r} not in {ROLES}")
         with self._lock:
             self._replicas[name] = _Replica(
-                name=name, target=target, role=role, gauge_fn=gauge_fn)
+                name=name, target=target, role=role, gauge_fn=gauge_fn,
+                dispatchable=dispatchable)
 
     def remove_replica(self, name: str) -> None:
         with self._lock:
@@ -200,6 +206,13 @@ class ClusterScheduler:
                 if self._replicas.get(rep.name) is not rep:
                     continue  # removed/replaced during the pull
                 rep.gauges = gauges
+                # Role sync (ISSUE 13): remote replicas and federation
+                # workers discover their role from health probes AFTER
+                # registration (LocalAI-Cluster-Role header) — the target
+                # object's role attribute is the source of truth.
+                trole = getattr(rep.target, "role", None)
+                if isinstance(trole, str) and trole in ROLES:
+                    rep.role = trole
                 shed = float(gauges.get("queue_shed", 0.0))
                 shed_penalty = 1.0 if shed > rep.last_shed else 0.0
                 rep.last_shed = shed
@@ -218,15 +231,18 @@ class ClusterScheduler:
     # ---------------- the pick ---------------- #
 
     def pick(self, hashes, role: Optional[str] = None,
-             exclude: tuple = ()) -> Optional[str]:
+             exclude: tuple = (), require_dispatch: bool = False) -> Optional[str]:
         """Choose a replica: expected-prefix-hit × inverse load. Role-typed
         picks prefer matching+mixed replicas but fall back to any live one
         (a degraded fleet serves mixed rather than 503ing). Returns the
-        replica name, or None when every replica is dead/excluded."""
+        replica name, or None when every replica is dead/excluded.
+        require_dispatch narrows to in-process submit targets (remote
+        replicas stay eligible for handoff-typed picks only)."""
         self.refresh()
         with self._lock:
             live = [r for r in self._replicas.values()
-                    if r.alive and r.name not in exclude]
+                    if r.alive and r.name not in exclude
+                    and (r.dispatchable or not require_dispatch)]
             if role is not None:
                 typed = [r for r in live if r.role in (role, "mixed")]
                 live = typed or live
@@ -253,6 +269,7 @@ class ClusterScheduler:
                     "name": r.name, "role": r.role, "alive": r.alive,
                     "load": round(r.load, 3),
                     "affinity_spans_held": len(r.affinity),
+                    "remote": not r.dispatchable,
                 }
                 for r in sorted(self._replicas.values(), key=lambda r: r.name)
             ]
@@ -274,15 +291,21 @@ class ClusterClient:
         if not replicas:
             raise ValueError("a cluster needs at least one replica")
         self.replicas = list(replicas)
+        local = [r for r in self.replicas if not getattr(r, "remote", False)]
+        if not local:
+            raise ValueError(
+                "a cluster needs at least one LOCAL replica — remote peers "
+                "are handoff targets, not dispatch targets")
         if scheduler is None:
             scheduler = ClusterScheduler(
-                span_tokens=self.replicas[0].span_tokens(),
+                span_tokens=local[0].span_tokens(),
                 affinity_spans=affinity_spans,
                 gauge_refresh_s=gauge_refresh_s, hit_weight=hit_weight)
         self.scheduler = scheduler
         for rep in self.replicas:
-            scheduler.add_replica(rep.name, target=rep, role=rep.role,
-                                  gauge_fn=rep.gauges)
+            scheduler.add_replica(
+                rep.name, target=rep, role=rep.role, gauge_fn=rep.gauges,
+                dispatchable=not getattr(rep, "remote", False))
         self.transfer_max_bytes = transfer_max_bytes
         roles = {r.role for r in self.replicas}
         self.disaggregate = (("prefill" in roles and
@@ -296,6 +319,7 @@ class ClusterClient:
         self.m_reroutes = 0
         self.m_handoffs = 0
         self.m_handoff_fallbacks = 0
+        self.m_remote_handoffs = 0
 
     # ---------------- public surface (Engine-shaped) ---------------- #
 
@@ -342,6 +366,7 @@ class ClusterClient:
             "cluster_reroutes": float(self.m_reroutes),
             "cluster_handoffs": float(self.m_handoffs),
             "cluster_handoff_fallbacks": float(self.m_handoff_fallbacks),
+            "cluster_remote_handoffs": float(self.m_remote_handoffs),
         }
 
     def cancel_all(self) -> int:
@@ -401,7 +426,8 @@ class ClusterClient:
             role = "decode"
         while True:
             name = self.scheduler.pick(hashes, role=role,
-                                       exclude=tuple(rec["attempted"]))
+                                       exclude=tuple(rec["attempted"]),
+                                       require_dispatch=True)
             if name is None:
                 self._finish(rid, None)
                 return
@@ -506,9 +532,10 @@ class ClusterClient:
                 and len(request.prompt_ids) > self.scheduler.span_tokens)
 
     def _try_handoff(self, request: "GenRequest", hashes, decode_rep) -> None:
-        """Run the prompt on a prefill-role replica, move its KV span into
-        the decode replica's host tier. Every failure path is silent
-        fallback: the decode replica simply recomputes."""
+        """Run the prompt on a prefill-role replica — in-process OR on a
+        remote host over the networked LAIKV stream (ISSUE 13) — and move
+        its KV span into the decode replica's host tier. Every failure path
+        is silent fallback: the decode replica simply recomputes."""
         try:
             name = self.scheduler.pick(hashes, role="prefill",
                                        exclude=(decode_rep.name,))
@@ -516,19 +543,31 @@ class ClusterClient:
             if pre is None or pre is decode_rep or pre.role != "prefill":
                 return  # no dedicated prefill capacity — nothing to hand off
             rid = getattr(request, "request_id", "")
-            probe = dataclasses.replace(
-                request, max_new_tokens=1, stop=[], grammar=None,
-                logprobs=0, ignore_eos=True,
-                # The prefill leg traces under "<rid>:prefill" with the
-                # SAME traceparent, so /debug/trace shows one trace with
-                # a prefill leg and a decode leg (ISSUE 11).
-                request_id=(rid + ":prefill") if rid else "")
             t0 = time.monotonic()
-            pre.engine.submit(probe).result()  # admission saved the span
-            self.scheduler.record(name, hashes)
-            frame = pre.engine.export_prefix_span(
-                request.prompt_ids, max_bytes=self.transfer_max_bytes,
-                trace_id=rid)
+            remote = bool(getattr(pre, "remote", False))
+            if remote:
+                # Remote prefill peer: one streamed fetch computes the
+                # prompt there (compute-on-demand) and pulls the span over
+                # the checksummed, resumable wire format. SpanTransferError
+                # lands in the except below — recompute, never corrupt KV.
+                frame = pre.fetch_span(
+                    request.prompt_ids, max_bytes=self.transfer_max_bytes,
+                    trace_id=rid,
+                    traceparent=getattr(request, "traceparent", ""))
+                self.scheduler.record(name, hashes)
+            else:
+                probe = dataclasses.replace(
+                    request, max_new_tokens=1, stop=[], grammar=None,
+                    logprobs=0, ignore_eos=True,
+                    # The prefill leg traces under "<rid>:prefill" with the
+                    # SAME traceparent, so /debug/trace shows one trace with
+                    # a prefill leg and a decode leg (ISSUE 11).
+                    request_id=(rid + ":prefill") if rid else "")
+                pre.engine.submit(probe).result()  # admission saved the span
+                self.scheduler.record(name, hashes)
+                frame = pre.engine.export_prefix_span(
+                    request.prompt_ids, max_bytes=self.transfer_max_bytes,
+                    trace_id=rid)
             if frame is None:
                 raise transfer.SpanTransferError(
                     "prefill replica stored no exportable span")
@@ -537,14 +576,17 @@ class ClusterClient:
                 raise transfer.SpanTransferError(
                     "decode replica rejected the span frame")
             self.m_handoffs += 1
+            if remote:
+                self.m_remote_handoffs += 1
             if rid:
                 from localai_tpu.observe.trace import STORE as _tstore
 
                 _tstore.annotate(rid, "span_handoff", prefill=name,
-                                 decode=decode_rep.name,
+                                 decode=decode_rep.name, remote=remote,
                                  ms=round((time.monotonic() - t0) * 1000, 2))
-            log.debug("handed off %d-token span %s→%s in %.1f ms",
+            log.debug("handed off %d-token span %s→%s%s in %.1f ms",
                       len(request.prompt_ids), name, decode_rep.name,
+                      " (remote)" if remote else "",
                       (time.monotonic() - t0) * 1000)
         except Exception as e:  # noqa: BLE001 — fallback is recompute
             self.m_handoff_fallbacks += 1

@@ -71,6 +71,30 @@ class ApplicationConfig:
     cluster_replicas: int = 0
     affinity_spans: int = 8
     transfer_max_bytes: int = 64 << 20
+    # Multi-host cluster (ISSUE 13, docs/CLUSTER.md § multi-host).
+    # cluster_peers names REMOTE workers ("name=http://host:port" or bare
+    # URLs, comma-separated in the env mirror) this process may hand
+    # prefill work to / fetch KV spans from over the networked LAIKV
+    # stream; roles are discovered from each peer's LocalAI-Cluster-Role
+    # header. transfer_chunk_bytes sizes one stream chunk (each chunk
+    # carries its own CRC32); transfer_checksum=false skips checksum
+    # verification on trusted links (framing is still parsed);
+    # transfer_resumes bounds how many times a dropped fetch resumes from
+    # its verified offset before degrading to recompute.
+    # cluster_gauge_stale_s bounds how old a remote replica's scraped
+    # gauges may be before the scheduler treats the host as dead.
+    cluster_peers: list[str] = dataclasses.field(default_factory=list)
+    transfer_chunk_bytes: int = 1 << 20
+    transfer_checksum: bool = True
+    transfer_resumes: int = 2
+    cluster_gauge_stale_s: float = 5.0
+    # jax.distributed serving bootstrap (ISSUE 13): process 0's host:port,
+    # the process count, and this process's rank. Empty/0 = single-process.
+    # Env mirrors LOCALAI_COORDINATOR / LOCALAI_NUM_PROCESSES /
+    # LOCALAI_PROCESS_ID match the train dryrun's contract.
+    coordinator_address: str = ""
+    num_processes: int = 0
+    process_id: int = 0
 
     # Flight recorder (ISSUE 11, docs/OBSERVABILITY.md): directory where a
     # dying engine loop dumps its postmortem JSON (journal tail + state
@@ -147,6 +171,13 @@ class ApplicationConfig:
             cluster_replicas=_env("LOCALAI_CLUSTER_REPLICAS", cls.cluster_replicas, int),
             affinity_spans=_env("LOCALAI_AFFINITY_SPANS", cls.affinity_spans, int),
             transfer_max_bytes=_env("LOCALAI_TRANSFER_MAX_BYTES", cls.transfer_max_bytes, int),
+            transfer_chunk_bytes=_env("LOCALAI_TRANSFER_CHUNK_BYTES", cls.transfer_chunk_bytes, int),
+            transfer_checksum=_env("LOCALAI_TRANSFER_CHECKSUM", cls.transfer_checksum, bool),
+            transfer_resumes=_env("LOCALAI_TRANSFER_RESUMES", cls.transfer_resumes, int),
+            cluster_gauge_stale_s=_env("LOCALAI_CLUSTER_GAUGE_STALE", cls.cluster_gauge_stale_s, float),
+            coordinator_address=_env("LOCALAI_COORDINATOR", cls.coordinator_address),
+            num_processes=_env("LOCALAI_NUM_PROCESSES", cls.num_processes, int),
+            process_id=_env("LOCALAI_PROCESS_ID", cls.process_id, int),
             postmortem_dir=_env("LOCALAI_POSTMORTEM_DIR", cls.postmortem_dir),
             cors=_env("LOCALAI_CORS", True, bool),
             metrics=not _env("LOCALAI_DISABLE_METRICS", False, bool),
@@ -159,6 +190,9 @@ class ApplicationConfig:
         preload = os.environ.get("LOCALAI_PRELOAD_MODELS", "")
         if preload:
             cfg.preload_models = [m.strip() for m in preload.split(",") if m.strip()]
+        peers = os.environ.get("LOCALAI_CLUSTER_PEERS", "")
+        if peers:
+            cfg.cluster_peers = [p.strip() for p in peers.split(",") if p.strip()]
         galleries = os.environ.get("LOCALAI_GALLERIES", "")
         if not galleries:
             # Built-in starter gallery of TPU-servable (HF safetensors)

@@ -139,6 +139,8 @@ def sharded_put(cfg: ArchConfig, mesh) -> Callable[[str, np.ndarray], jnp.ndarra
                 return spec
         return None
 
+    multiprocess = jax.process_count() > 1
+
     def put(path: str, arr: np.ndarray) -> jnp.ndarray:
         host = np.asarray(arr)
         if host.dtype != dt and np.issubdtype(host.dtype, np.floating):
@@ -146,7 +148,17 @@ def sharded_put(cfg: ArchConfig, mesh) -> Callable[[str, np.ndarray], jnp.ndarra
         spec = lookup(path, host.ndim)
         if spec is None:
             spec = P()
-        return jax.device_put(host, NamedSharding(mesh, spec))
+        sharding = NamedSharding(mesh, spec)
+        if multiprocess:
+            # Multi-host serving (ISSUE 13): the mesh spans processes, so
+            # device_put of a host array would touch non-addressable
+            # devices. make_array_from_callback materializes ONLY this
+            # process's shards of the global array — every host reads the
+            # checkpoint but ships its own slice, which is exactly the
+            # per-process shard-load the dp-across-hosts plan needs.
+            return jax.make_array_from_callback(
+                host.shape, sharding, lambda idx: host[idx])
+        return jax.device_put(host, sharding)
 
     return put
 

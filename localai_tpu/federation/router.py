@@ -62,6 +62,14 @@ class Worker:
     # a worker sends on health-probe responses (server/app.py); the
     # affinity scheduler role-types its picks with it.
     role: str = "mixed"
+    # Remote engine gauges (ISSUE 13): the last localai_engine_* /metrics
+    # scrape and when it landed. The affinity scheduler reads these through
+    # a STALENESS BOUND — gauges older than gauge_stale_s re-scrape, and a
+    # worker unreachable past the dead bound scores as loop_dead. The
+    # /federation/workers view surfaces the age so operators can see WHY
+    # the scheduler skipped a replica.
+    gauges: dict = field(default_factory=dict)
+    last_gauge_at: float = 0.0
 
 
 class WorkerRegistry:
@@ -181,6 +189,7 @@ class FederatedServer:
         token: Optional[str] = None,
         probe_backoff_s: float = 1.0,
         probe_backoff_max_s: float = 60.0,
+        gauge_stale_s: float = 5.0,
     ):
         # Shared-token gate on the control plane (reference parity:
         # core/p2p/p2p.go:31-64 — the libp2p overlay requires a shared
@@ -200,6 +209,7 @@ class FederatedServer:
         # cluster.affinity/scheduler are numpy-only — no jax import here.
         self.scheduler = None
         self.affinity_span_bytes = 256
+        self.gauge_stale_s = gauge_stale_s
         if strategy == "affinity":
             from localai_tpu.cluster.scheduler import ClusterScheduler
 
@@ -256,6 +266,29 @@ class FederatedServer:
 
     # ---------------- affinity delegation (ISSUE 6) ---------------- #
 
+    def _worker_gauges(self, w: Worker) -> dict:
+        """Remote load for the affinity scheduler (ISSUE 13): the worker's
+        own localai_engine_* gauges scraped over HTTP with a staleness
+        bound. An unreachable worker keeps serving its last scrape until
+        the bound expires, then scores as dead (the scheduler drains its
+        affinity); the front door's in-flight count rides on top so
+        proxied-but-unadmitted requests still register as load."""
+        if not w.healthy:
+            return {"loop_dead": 1.0}
+        now = time.monotonic()
+        if now - w.last_gauge_at >= self.gauge_stale_s:
+            from localai_tpu.cluster.replica import scrape_engine_gauges
+
+            try:
+                g = scrape_engine_gauges(w.url, timeout=2.0)
+                w.gauges, w.last_gauge_at = g, time.monotonic()
+            except Exception:  # noqa: BLE001 — scrape failure ages out
+                if now - w.last_gauge_at > 3 * self.gauge_stale_s:
+                    return {"loop_dead": 1.0}
+        g = dict(w.gauges)
+        g["queue_depth"] = g.get("queue_depth", 0.0) + float(w.in_flight)
+        return g
+
     def _sync_scheduler(self) -> None:
         """Mirror the registry into the scheduler (workers join/leave at
         runtime). Existing replicas keep their affinity maps."""
@@ -267,10 +300,7 @@ class FederatedServer:
             if name not in known:
                 self.scheduler.add_replica(
                     name, target=w, role=w.role,
-                    gauge_fn=(lambda w=w: {
-                        "queue_depth": float(w.in_flight),
-                        "loop_dead": 0.0 if w.healthy else 1.0,
-                    }),
+                    gauge_fn=(lambda w=w: self._worker_gauges(w)),
                 )
 
     @staticmethod
@@ -365,6 +395,7 @@ class FederatedServer:
                     if not self._authorized():
                         self._json(401, {"error": "federation token required"})
                         return True
+                    now = time.monotonic()
                     self._json(200, {"workers": [
                         {
                             "name": w.name, "url": w.url, "healthy": w.healthy,
@@ -372,7 +403,15 @@ class FederatedServer:
                             "fail_count": w.fail_count,
                             "went_unhealthy": w.went_unhealthy,
                             "went_healthy": w.went_healthy,
+                            # Discovered cluster role + gauge freshness
+                            # (ISSUE 13 satellite): why the affinity
+                            # scheduler skipped a replica — wrong role for
+                            # the pick, or gauges stale past the bound.
                             "role": w.role,
+                            "last_gauge_age_s": (
+                                round(now - w.last_gauge_at, 2)
+                                if w.last_gauge_at else None),
+                            "queue_depth": w.gauges.get("queue_depth"),
                         }
                         for w in fed.registry.list()
                     ], "strategy": fed.strategy})

@@ -66,6 +66,8 @@ FAULT_EVENTS = (
     "fault_manager_load",
     "fault_cluster_dispatch",
     "fault_span_transfer",
+    "fault_host_partition",
+    "fault_slow_network",
     "fault_collective_dispatch",
     "fault_adapter_fetch",
     "fault_spec_verify",

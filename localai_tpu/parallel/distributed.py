@@ -16,11 +16,30 @@ Env contract (mirrors the reference's worker env flags, core/cli/worker):
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import os
 from typing import Optional
 
 log = logging.getLogger("localai_tpu.distributed")
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessTopology:
+    """This process's place in the (possibly single-process) job."""
+
+    process_id: int = 0
+    num_processes: int = 1
+    coordinator: str = ""
+
+    @property
+    def multiprocess(self) -> bool:
+        return self.num_processes > 1
+
+
+# Set by init_distributed() so the serving path (manager, bench, tests) can
+# ask "did the bootstrap run?" without re-deriving env state.
+_TOPOLOGY = ProcessTopology()
 
 
 def init_distributed(
@@ -35,6 +54,9 @@ def init_distributed(
     built from it shards programs across the whole pod (dp/tp/... axes ride
     ICI within a slice and DCN across slices).
     """
+    global _TOPOLOGY
+    if _TOPOLOGY.multiprocess:
+        return True  # idempotent: the bootstrap already ran this process
     coordinator = coordinator or os.environ.get("LOCALAI_COORDINATOR")
     if not coordinator:
         return False
@@ -56,8 +78,92 @@ def init_distributed(
         num_processes=num_processes,
         process_id=process_id,
     )
+    _TOPOLOGY = ProcessTopology(
+        process_id=process_id, num_processes=num_processes,
+        coordinator=coordinator,
+    )
     log.info(
         "jax.distributed up: process %d/%d via %s — %d global devices",
         process_id, num_processes, coordinator, len(jax.devices()),
     )
     return True
+
+
+def init_from_config(app_cfg) -> bool:
+    """Serving-path bootstrap (ISSUE 13): wire this process into the global
+    mesh from ApplicationConfig knobs (`coordinator_address` /
+    `num_processes` / `process_id`, env mirrors LOCALAI_COORDINATOR /
+    LOCALAI_NUM_PROCESSES / LOCALAI_PROCESS_ID). Must run before any jax
+    computation; a no-op (False) for single-process deployments."""
+    return init_distributed(
+        coordinator=getattr(app_cfg, "coordinator_address", "") or None,
+        num_processes=getattr(app_cfg, "num_processes", 0) or None,
+        process_id=getattr(app_cfg, "process_id", 0),
+    )
+
+
+def topology() -> ProcessTopology:
+    """The bootstrap's view of this process, falling back to the live jax
+    runtime (covers callers that ran jax.distributed.initialize themselves,
+    e.g. the train dryrun)."""
+    if _TOPOLOGY.multiprocess:
+        return _TOPOLOGY
+    import jax
+
+    n = jax.process_count()
+    if n > 1:
+        return ProcessTopology(process_id=jax.process_index(),
+                               num_processes=n)
+    return _TOPOLOGY
+
+
+def is_multiprocess() -> bool:
+    return topology().multiprocess
+
+
+def multihost_plan(num_processes: int, local_devices: int, tp: int = 0,
+                   ep: int = 1, sp: int = 1):
+    """The multi-host serving mesh plan: dp ACROSS hosts (each host serves
+    its own replica of the batch over DCN-free decode steps) × tp WITHIN a
+    host (the collectives stay on ICI). Pure function — unit-testable
+    without a multi-process runtime.
+
+    tp=0 means "all local devices left after ep/sp"; a tp the local chip
+    count cannot hold is an error here (silent spill onto DCN would turn
+    every layer's psum into a cross-host hop)."""
+    from localai_tpu.parallel.mesh import MeshPlan
+
+    if num_processes < 1:
+        raise ValueError(f"num_processes must be >= 1, got {num_processes}")
+    room = local_devices // max(1, ep * sp)
+    if room < 1:
+        raise ValueError(
+            f"ep={ep} sp={sp} needs {ep * sp} local devices, "
+            f"have {local_devices}")
+    tp = tp or room
+    if tp * ep * sp > local_devices:
+        raise ValueError(
+            f"tp={tp} ep={ep} sp={sp} spans {tp * ep * sp} devices but this "
+            f"host holds {local_devices} — tp must stay within one host "
+            f"(ICI); scale dp across hosts instead")
+    return MeshPlan(dp=num_processes, tp=tp, ep=ep, sp=sp)
+
+
+def serving_devices():
+    """The global device list ordered host-major (process_index, then id) —
+    reshaped by build_mesh into (dp, tp, ...) this puts each host's devices
+    on one dp row, so the dp axis strides across hosts and tp stays on
+    local ICI."""
+    import jax
+
+    return sorted(jax.devices(),
+                  key=lambda d: (d.process_index, d.id))
+
+
+def local_view(mesh):
+    """This process's addressable devices within a global mesh — what the
+    engine/manager use to size host-side staging and per-process work."""
+    import jax
+
+    me = jax.process_index()
+    return [d for d in mesh.devices.flat if d.process_index == me]

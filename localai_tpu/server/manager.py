@@ -137,6 +137,14 @@ class ModelManager:
         self._quarantined_until: dict[str, float] = {}
         self._quarantine_total: dict[str, int] = {}
         faults.ensure_env_installed()
+        # Multi-host serving bootstrap (ISSUE 13): wire this process into
+        # the global device mesh BEFORE any engine touches jax. Idempotent
+        # — a no-op for single-process deployments and for entrypoints
+        # (__main__) that already ran it.
+        if app_cfg.coordinator_address:
+            from localai_tpu.parallel import distributed
+
+            distributed.init_from_config(app_cfg)
         self._wd_stop = threading.Event()
         self._wd_thread: Optional[threading.Thread] = None
         if app_cfg.watchdog_idle_timeout_s > 0 or app_cfg.watchdog_busy_timeout_s > 0:
@@ -634,18 +642,42 @@ class ModelManager:
 
         arch = _apply_rope_overrides(arch, cfg)
 
+        from localai_tpu.parallel import distributed
         from localai_tpu.parallel.sharding import max_valid_tp
 
-        n_devices = len(jax.devices())
         par = cfg.parallel
-        avail = n_devices // max(1, par.dp * par.ep * par.sp)
-        # tensor_parallel (ISSUE 7): the flat YAML knob wins over the nested
-        # parallel.tp; -1/"auto" and 0 both fall back to the auto pick
-        # (all devices left after dp/ep/sp, degraded to max_valid_tp).
-        tp = cfg.tensor_parallel if cfg.tensor_parallel > 0 else par.tp
-        tp = tp or max_valid_tp(arch, max(1, avail))
-        tp = min(max(1, tp), max(1, avail))
-        plan = MeshPlan(dp=par.dp, tp=tp, ep=par.ep, sp=par.sp)
+        engine_devices = None
+        if distributed.is_multiprocess():
+            # Multi-host replica (ISSUE 13): dp strides ACROSS hosts, tp
+            # stays within this host's chips (collectives on ICI, not DCN).
+            # The engine/manager see the process-local device view of the
+            # global mesh; weights shard-load per process via sharded_put.
+            topo = distributed.topology()
+            n_local = jax.local_device_count()
+            tp = cfg.tensor_parallel if cfg.tensor_parallel > 0 else par.tp
+            avail = n_local // max(1, par.ep * par.sp)
+            tp = tp or max_valid_tp(arch, max(1, avail))
+            tp = min(max(1, tp), max(1, avail))
+            plan = distributed.multihost_plan(
+                topo.num_processes, n_local, tp=tp, ep=par.ep, sp=par.sp)
+            engine_devices = distributed.serving_devices()
+            log.info(
+                "model %s: multi-host plan dp=%d (hosts) x tp=%d (local "
+                "chips) — process %d/%d",
+                cfg.name, plan.dp, plan.tp, topo.process_id,
+                topo.num_processes,
+            )
+        else:
+            n_devices = len(jax.devices())
+            avail = n_devices // max(1, par.dp * par.ep * par.sp)
+            # tensor_parallel (ISSUE 7): the flat YAML knob wins over the
+            # nested parallel.tp; -1/"auto" and 0 both fall back to the auto
+            # pick (all devices left after dp/ep/sp, degraded to
+            # max_valid_tp).
+            tp = cfg.tensor_parallel if cfg.tensor_parallel > 0 else par.tp
+            tp = tp or max_valid_tp(arch, max(1, avail))
+            tp = min(max(1, tp), max(1, avail))
+            plan = MeshPlan(dp=par.dp, tp=tp, ep=par.ep, sp=par.sp)
 
         tok_path = cfg.tokenizer or gguf_tok_dir or (ckpt_dir if ckpt_dir else None)
         if (tok_path and tok_path != "synthetic-bytes"
@@ -687,7 +719,7 @@ class ModelManager:
                 from localai_tpu.engine.weights import sharded_put
                 from localai_tpu.parallel.mesh import build_mesh
 
-                put = sharded_put(arch, build_mesh(plan))
+                put = sharded_put(arch, build_mesh(plan, engine_devices))
             params = load_hf_checkpoint(
                 arch, ckpt_dir, put=put, quantize=cfg.quantization,
                 lora=lora or None,
@@ -738,6 +770,7 @@ class ModelManager:
             params,
             tokenizer,
             mesh_plan=plan,
+            devices=engine_devices,
             engine_cfg=EngineConfig(
                 max_slots=cfg.max_slots, max_seq=cfg.context_size,
                 tensor_parallel=cfg.tensor_parallel,
@@ -775,27 +808,52 @@ class ModelManager:
         # surface, so every API/watchdog/metrics path is unchanged. Draft
         # and vision engines stay single-replica (their side state has no
         # transfer story yet).
-        n_replicas = self.app_cfg.cluster_replicas
-        if n_replicas >= 2 and draft_arch is None and not vlm:
-            from localai_tpu.cluster import ClusterEngine, LocalReplica, parse_roles
+        from localai_tpu.cluster.replica import parse_peers
 
-            roles = parse_roles(n_replicas, self.app_cfg.cluster_role)
+        n_replicas = self.app_cfg.cluster_replicas
+        peers = [] if (draft_arch is not None or vlm) else parse_peers(
+            self.app_cfg.cluster_peers)
+        if (n_replicas >= 2 or peers) and draft_arch is None and not vlm:
+            from localai_tpu.cluster import (
+                ClusterEngine,
+                LocalReplica,
+                RemoteReplica,
+                parse_roles,
+            )
+
+            n_local = max(1, n_replicas)
+            roles = parse_roles(n_local, self.app_cfg.cluster_role)
             replicas = [LocalReplica("r0", engine, role=roles[0])]
-            for i in range(1, n_replicas):
+            for i in range(1, n_local):
                 extra = Engine(
                     arch, params, tokenizer, mesh_plan=plan,
+                    devices=engine_devices,
                     engine_cfg=engine.ecfg, quantization=cfg.quantization,
                 )
                 extra.start()
                 replicas.append(LocalReplica(f"r{i}", extra, role=roles[i]))
+            # Remote peers (ISSUE 13): workers on OTHER machines, reached
+            # over HTTP. Roles come from their LocalAI-Cluster-Role header
+            # at the first gauge refresh; the scheduler treats them as
+            # prefill-handoff/affinity targets, never in-process dispatch.
+            for pname, purl in peers:
+                replicas.append(RemoteReplica(
+                    pname, purl, model=cfg.name,
+                    gauge_stale_s=self.app_cfg.cluster_gauge_stale_s,
+                    chunk_bytes=self.app_cfg.transfer_chunk_bytes,
+                    verify=self.app_cfg.transfer_checksum,
+                    max_resumes=self.app_cfg.transfer_resumes,
+                ))
             engine = ClusterEngine(
                 replicas,
                 transfer_max_bytes=self.app_cfg.transfer_max_bytes,
                 affinity_spans=self.app_cfg.affinity_spans,
             )
             log.info(
-                "model %s: fanned out to %d cluster replicas (roles=%s)",
-                cfg.name, n_replicas, ",".join(roles),
+                "model %s: fanned out to %d cluster replicas (roles=%s)"
+                "%s",
+                cfg.name, n_local, ",".join(roles),
+                f" + {len(peers)} remote peer(s)" if peers else "",
             )
         evaluator = Evaluator(cfg, tokenizer)
         lm = LoadedModel(cfg, engine, evaluator)

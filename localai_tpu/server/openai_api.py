@@ -18,7 +18,14 @@ from typing import Any, Callable, Iterator, Optional
 from localai_tpu import __version__
 from localai_tpu.config import LoraConfigError, Usecase
 from localai_tpu.engine import AdapterError, GenRequest, QueueFullError
-from localai_tpu.server.app import ApiError, Request, Response, Router, SSEStream
+from localai_tpu.server.app import (
+    ApiError,
+    RawStream,
+    Request,
+    Response,
+    Router,
+    SSEStream,
+)
 from localai_tpu.server.manager import (
     LoadedModel,
     ModelManager,
@@ -1035,8 +1042,10 @@ class OpenAIApi:
         return Response(body={
             "role": app_cfg.cluster_role,
             "cluster_replicas": app_cfg.cluster_replicas,
+            "cluster_peers": list(app_cfg.cluster_peers),
             "affinity_spans": app_cfg.affinity_spans,
             "transfer_max_bytes": app_cfg.transfer_max_bytes,
+            "transfer_chunk_bytes": app_cfg.transfer_chunk_bytes,
             "engines": engines,
         })
 
@@ -1060,27 +1069,84 @@ class OpenAIApi:
                                 "(paged LLM engines only)")
         return eng
 
-    def cluster_span_export(self, req: Request) -> Response:
+    def cluster_span_export(self, req: Request) -> "Response | RawStream":
+        """KV span out. Plain mode returns the raw LAIKV frame (back-compat
+        with the ISSUE 6 single-host seam); `stream: true` (ISSUE 13)
+        returns the chunked LAIKV-STREAM wire format — per-chunk CRC32s, a
+        digest-pinned control header, and resume-from-`offset` support —
+        and `compute: true` admits the prompt first when no span is stored
+        yet (the remote-prefill entry point: one round trip computes AND
+        streams the span)."""
         body = req.body or {}
         eng = self._cluster_engine(body.get("model"))
         prompt_ids = body.get("prompt_ids")
         if not isinstance(prompt_ids, list) or not prompt_ids:
             raise ApiError(400, "prompt_ids (non-empty token id list) required")
+        app_cfg = self.manager.app_cfg
+        pids = [int(t) for t in prompt_ids]
+        trace = str(body.get("trace") or "")
         frame = eng.export_prefix_span(
-            [int(t) for t in prompt_ids],
-            max_bytes=self.manager.app_cfg.transfer_max_bytes,
-        )
+            pids, max_bytes=app_cfg.transfer_max_bytes, trace_id=trace)
+        if frame is None and body.get("compute"):
+            # Prefill-on-demand: one probe admission saves the span in the
+            # prefix cache (the same shape ClusterClient's in-process
+            # handoff uses); it traces as the "<trace>:prefill" leg under
+            # the caller's traceparent so a disaggregated request stays ONE
+            # trace across machines (ISSUE 11/13).
+            eng.generate(
+                pids, max_new_tokens=1, ignore_eos=True,
+                request_id=(trace + ":prefill") if trace else "",
+                traceparent=req.headers.get("traceparent", ""))
+            frame = eng.export_prefix_span(
+                pids, max_bytes=app_cfg.transfer_max_bytes, trace_id=trace)
         if frame is None:
             raise ApiError(404, "no exportable span stored for this prompt")
-        return Response(body=frame, content_type="application/octet-stream")
+        if not body.get("stream"):
+            return Response(body=frame, content_type="application/octet-stream")
+        from localai_tpu.cluster import netspan
+
+        digest = netspan.frame_digest(frame)
+        want = str(body.get("digest") or "")
+        if want and want != digest:
+            # The span was re-admitted/evicted between resume attempts —
+            # the client must restart (or recompute), never splice frames.
+            raise ApiError(409, "span changed since the transfer began",
+                           kind="conflict")
+        offset = int(body.get("offset") or 0)
+        if offset < 0 or offset > len(frame):
+            raise ApiError(400, f"offset {offset} outside the "
+                                f"{len(frame)}-byte frame")
+        chunk = int(body.get("chunk_bytes") or 0) or app_cfg.transfer_chunk_bytes
+        return RawStream(
+            netspan.encode_stream(frame, chunk_bytes=chunk, offset=offset,
+                                  trace=trace),
+            content_type="application/x-laikv-stream",
+        )
 
     def cluster_span_import(self, req: Request) -> Response:
+        """KV span in. Accepts a raw LAIKV frame (back-compat) or the
+        LAIKV-STREAM wire format (detected by its chunk magic) — the
+        latter is CRC/digest-verified chunk by chunk with the size cap
+        enforced mid-walk, and a rejected stream reports `imported: false`
+        plus the typed reason instead of landing corrupt KV."""
         name = (req.query.get("model") or [None])[0]
         eng = self._cluster_engine(name)
         if not req.raw_body:
             raise ApiError(400, "span frame bytes required as request body")
+        app_cfg = self.manager.app_cfg
+        raw = req.raw_body
+        from localai_tpu.cluster import netspan
+        from localai_tpu.cluster.transfer import SpanTransferError
+
+        if raw[:len(netspan.CHUNK_MAGIC)] == netspan.CHUNK_MAGIC:
+            try:
+                raw, _meta = netspan.assemble(
+                    raw, max_bytes=app_cfg.transfer_max_bytes,
+                    verify=app_cfg.transfer_checksum)
+            except SpanTransferError as e:
+                return Response(body={"imported": False, "error": str(e)})
         ok = eng.import_span_bytes(
-            req.raw_body, max_bytes=self.manager.app_cfg.transfer_max_bytes
+            raw, max_bytes=app_cfg.transfer_max_bytes
         )
         return Response(body={"imported": bool(ok)})
 

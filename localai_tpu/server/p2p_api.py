@@ -21,13 +21,36 @@ from localai_tpu.server.app import Request, Response, Router
 class P2pApi:
     def __init__(self, federator: Optional[str] = None,
                  worker_name: Optional[str] = None,
-                 explorer: Optional[str] = None):
+                 explorer: Optional[str] = None,
+                 cluster_peers: Optional[list] = None):
         self._federator = federator
         self._worker_name = worker_name
         self._explorer = explorer
+        self._cluster_peers = list(cluster_peers or [])
 
     def register(self, r: Router) -> None:
         r.add("GET", "/p2p/status", self.status)
+        r.add("GET", "/p2p/cluster", self.cluster)
+
+    def cluster(self, req: Request) -> Response:
+        """Configured cluster peers (ISSUE 13) probed SERVER-SIDE: name,
+        URL, reachability, and the role each advertises via its
+        LocalAI-Cluster-Role header — the discovery seam remote replicas
+        are built from. Only CONFIGURED urls are probed (no SSRF surface),
+        and a dead peer reports unreachable instead of failing the view."""
+        from localai_tpu.cluster.replica import parse_peers, probe_worker_role
+
+        peers = []
+        for name, url in parse_peers(self._cluster_peers):
+            entry = {"name": name, "url": url,
+                     "reachable": False, "role": None}
+            try:
+                entry["role"] = probe_worker_role(url, timeout=3)
+                entry["reachable"] = True
+            except Exception as e:  # noqa: BLE001 — view stays best-effort
+                entry["error"] = f"{type(e).__name__}: {e}"
+            peers.append(entry)
+        return Response(body={"cluster_peers": peers})
 
     def _fetch_json(self, url: str):
         req = urllib.request.Request(url, headers={"Accept": "application/json"})

@@ -46,6 +46,18 @@ Hook sites (each is one `faults.fire(SITE)` call in production code):
                      Raising here fails a prefill→decode KV handoff; the
                      contract is silent fallback to recompute on the decode
                      replica (ISSUE 6).
+  host_partition   — chunk boundaries of the networked span stream
+                     (cluster.netspan encode/fetch, ISSUE 13): the peer
+                     dropped off the network mid-transfer. The client sees
+                     a resumable connection failure; past its resume budget
+                     the transfer fails TYPED (SpanTransferError) and the
+                     caller recomputes/reroutes — never a hung caller, and
+                     the importing engine's pool/host-tier stay accounted.
+  slow_network     — same chunk boundaries, but the failure mode is TIME:
+                     the hook stalls SLOW_NETWORK_DELAY_S instead of
+                     raising, standing in for a congested/flapping DCN
+                     link. The caller's socket timeout converts the stall
+                     into the same typed-failure path as host_partition.
   adapter_fetch    — host-tier adapter fetch (Engine._adapter_image: disk →
                      host-RAM LRU) and device promote
                      (Engine._adapter_acquire: host image → stacked device
@@ -98,6 +110,8 @@ SITES = (
     "manager_load",
     "cluster_dispatch",
     "span_transfer",
+    "host_partition",
+    "slow_network",
     "collective_dispatch",
     "adapter_fetch",
     "spec_verify",

@@ -29,6 +29,13 @@ def pytest_configure(config):
         "--xla_force_host_platform_device_count=8 when this process somehow "
         "initialized jax with fewer devices",
     )
+    config.addinivalue_line(
+        "markers",
+        "multiproc: spawns REAL worker processes (separate jax CPU "
+        "runtimes + an HTTP hop) via localai_tpu.testing.multihost — the "
+        "2-process simulated cluster the ISSUE 13 span-transfer and "
+        "discovery tests run against; tier-1 on CPU like multichip",
+    )
 
 
 @pytest.fixture(scope="session")
@@ -85,6 +92,22 @@ def multichip(request):
             f"multichip subprocess re-run of {mod} failed (rc={rc}):\n{out}"
         )
     pytest.skip("passed in the 8-device subprocess re-run")
+
+
+# multiproc fixture (ISSUE 13 satellite): one REAL prefill-role worker
+# process (own jax CPU runtime, tiny paged model "mh") shared across the
+# session — the remote end of the 2-process span-transfer/discovery tests.
+# Boot cost (~a tiny-model load) is paid once; tests must treat the worker
+# as shared state (assert deltas, use distinct prompts).
+@pytest.fixture(scope="session")
+def multiproc_worker(tmp_path_factory):
+    from localai_tpu.testing import multihost
+
+    d = tmp_path_factory.mktemp("mh-models")
+    multihost.write_tiny_model_yaml(str(d))
+    worker = multihost.spawn_worker(str(d), role="prefill")
+    yield worker
+    worker.stop()
 
 
 # Thread-leak guard (ISSUE 4 satellite): the supervisor restart path is

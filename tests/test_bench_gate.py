@@ -35,6 +35,18 @@ def test_direction_heuristics():
     assert direction("some_unknown_metric") == "higher"
 
 
+def test_direction_markers_cover_multihost_rows():
+    """BENCH_MULTIHOST keys (ISSUE 13 satellite) gate in the right
+    direction from their first shared round."""
+    assert direction("multihost_tps") == "higher"
+    assert direction("multihost_p99_ttft_ms") == "lower"
+    assert direction("multihost_span_transfer_ms") == "lower"
+    assert direction("multihost_span_frame_bytes") == "lower"
+    assert direction("multihost_disagg_ttft_ms") == "lower"
+    assert direction("multihost_recompute_ttft_ms") == "lower"
+    assert direction("multihost_remote_handoffs") == "higher"
+
+
 def test_compare_flags_drops_in_the_bad_direction():
     old = {"decode_tps": 1000.0, "p99_ttft_ms": 100.0, "accept_rate": 0.5}
     new = {"decode_tps": 850.0, "p99_ttft_ms": 125.0, "accept_rate": 0.52}

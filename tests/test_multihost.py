@@ -1,0 +1,494 @@
+"""Multi-host cluster serving tests (ISSUE 13, docs/CLUSTER.md § multi-host):
+the networked LAIKV span stream (wire framing, checksums, mid-stream size
+bounds, resume), the jax.distributed serving plan helpers, remote-replica
+discovery + cluster-wide prefill/decode disaggregation over a REAL HTTP hop,
+partition/slow-network fault schedules degrading to recompute with zero hung
+callers, and the 2-process (subprocess, CPU-mesh) export→stream→import
+round-trip byte-identical to a single-process run.
+"""
+
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from localai_tpu.cluster import (
+    ClusterClient,
+    LocalReplica,
+    RemoteReplica,
+    SpanTransferError,
+    netspan,
+    probe_worker_role,
+)
+from localai_tpu.config import ApplicationConfig
+from localai_tpu.engine.engine import Engine, EngineConfig
+from localai_tpu.engine.tokenizer import ByteTokenizer
+from localai_tpu.models import get_arch
+from localai_tpu.models.llama import init_params
+from localai_tpu.parallel import distributed
+from localai_tpu.parallel.mesh import MeshPlan, build_mesh
+from localai_tpu.server import ModelManager, Router, create_server
+from localai_tpu.server.openai_api import OpenAIApi
+from localai_tpu.testing import faults, multihost
+
+PAGE = 32
+PROMPT = [(i * 37) % 251 + 1 for i in range(70)]  # 2 full pages
+
+
+def _ecfg(**kw):
+    """Local engine config matching write_tiny_model_yaml's geometry."""
+    defaults = dict(
+        max_slots=2, max_seq=256, min_prefill_bucket=32,
+        kv_pages=16, kv_page_size=PAGE,
+        prefix_cache_entries=8, prefix_cache_min=PAGE,
+        prefix_admit_async_compile=False,
+    )
+    defaults.update(kw)
+    return EngineConfig(**defaults)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = get_arch("tiny")
+    # jit'd init exactly like the manager's preset path — the subprocess
+    # worker's weights must be BIT-IDENTICAL for cross-process KV identity.
+    return cfg, jax.jit(lambda k: init_params(cfg, k))(jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def local_engine(tiny):
+    cfg, params = tiny
+    eng = Engine(cfg, params, ByteTokenizer(cfg.vocab_size),
+                 engine_cfg=_ecfg())
+    eng.start()
+    yield eng
+    eng.stop()
+    eng.params = None
+    eng.cache = None
+
+
+@pytest.fixture(scope="module")
+def inproc_worker(tmp_path_factory):
+    """An in-process prefill-role worker server over a tiny paged model —
+    the fast (no subprocess) remote end for stream/fault tests."""
+    d = tmp_path_factory.mktemp("mh-inproc")
+    multihost.write_tiny_model_yaml(str(d))
+    app_cfg = ApplicationConfig(address="127.0.0.1", port=0,
+                                models_dir=str(d), cluster_role="prefill")
+    manager = ModelManager(app_cfg)
+    router = Router()
+    OpenAIApi(manager).register(router)
+    server = create_server(app_cfg, router)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="mh-inproc-server").start()
+    manager.get("mh")  # load before the first span fetch pays a timeout
+    yield f"http://127.0.0.1:{server.server_address[1]}", manager
+    server.shutdown()
+    manager.shutdown()
+
+
+def _assert_pool_accounted(eng):
+    """Page pool fully accounted (the ISSUE 4 invariant, asserted after
+    every fault schedule here)."""
+    P = eng.ecfg.kv_pages
+    refs = np.zeros(P, np.int64)
+    for pages in eng._slot_pages:
+        for p in pages:
+            refs[p] += 1
+    for e in eng._prefix_entries:
+        for p in e.get("pages", []):
+            refs[p] += 1
+    assert (refs == np.asarray(eng._page_refs[:P])).all()
+    free = eng._free_pages
+    assert len(set(free)) == len(free)
+    assert all(refs[p] == 0 for p in free)
+    assert set(free) | {p for p in range(P) if refs[p] > 0} == set(range(P))
+    assert eng._host_bytes == sum(
+        e.get("bytes", 0) for e in eng._prefix_host)
+
+
+# --------------------------------------------------------------------- #
+# jax.distributed serving plan (pure helpers — no multi-process runtime)
+# --------------------------------------------------------------------- #
+
+
+def test_multihost_plan_dp_across_hosts_tp_within():
+    plan = distributed.multihost_plan(4, 8)
+    assert (plan.dp, plan.tp) == (4, 8)
+    plan = distributed.multihost_plan(2, 8, tp=4)
+    assert (plan.dp, plan.tp) == (2, 4)
+    plan = distributed.multihost_plan(2, 8, tp=0, ep=2)
+    assert (plan.dp, plan.tp, plan.ep) == (2, 4, 2)
+    with pytest.raises(ValueError):
+        distributed.multihost_plan(2, 4, tp=8)  # tp must stay on-host
+    with pytest.raises(ValueError):
+        distributed.multihost_plan(0, 4)
+    with pytest.raises(ValueError):
+        distributed.multihost_plan(2, 2, ep=4)
+
+
+def test_serving_devices_order_and_local_view(devices8):
+    devs = distributed.serving_devices()
+    assert len(devs) == len(jax.devices())
+    assert devs == sorted(devs, key=lambda d: (d.process_index, d.id))
+    mesh = build_mesh(MeshPlan(dp=2, tp=4), devs)
+    local = distributed.local_view(mesh)
+    # Single-process run: every mesh device is addressable here.
+    assert len(local) == 8
+    assert {d.id for d in local} == {d.id for d in mesh.devices.flat}
+    assert distributed.topology().multiprocess is False
+
+
+# --------------------------------------------------------------------- #
+# Wire format: framing, checksums, bounds, resume
+# --------------------------------------------------------------------- #
+
+
+def test_stream_roundtrip_resume_and_rejections():
+    frame = bytes(range(256)) * 500
+    blob = b"".join(netspan.encode_stream(frame, chunk_bytes=10_000))
+    asm = netspan.StreamAssembler()
+    for i in range(0, len(blob), 777):  # ragged feeds
+        asm.feed(blob[i:i + 777])
+    assert asm.done and asm.result() == frame
+    assert asm.meta["digest"] == netspan.frame_digest(frame)
+
+    # Resume: verified prefix + a second stream from that offset.
+    prior = frame[:33_000]
+    tail = b"".join(netspan.encode_stream(frame, chunk_bytes=10_000,
+                                          offset=len(prior)))
+    asm2 = netspan.StreamAssembler(
+        prior=prior, expect_digest=netspan.frame_digest(frame))
+    asm2.feed(tail)
+    assert asm2.result() == frame
+
+    # Digest pinning: a resume against a DIFFERENT frame is rejected.
+    other = frame[:-1] + b"\x00"
+    tail_other = b"".join(netspan.encode_stream(other, chunk_bytes=10_000,
+                                                offset=len(prior)))
+    asm3 = netspan.StreamAssembler(
+        prior=prior, expect_digest=netspan.frame_digest(frame))
+    with pytest.raises(SpanTransferError):
+        asm3.feed(tail_other)
+
+    # Offset mismatch between control header and assembled prefix.
+    with pytest.raises(SpanTransferError):
+        netspan.StreamAssembler(prior=b"xy").feed(blob)
+
+    # Payload corruption → chunk CRC.
+    bad = bytearray(blob)
+    bad[60] ^= 0xFF
+    with pytest.raises(SpanTransferError, match="CRC"):
+        netspan.assemble(bytes(bad))
+
+    # Bad magic, truncation, size cap mid-stream, trailing garbage.
+    with pytest.raises(SpanTransferError, match="magic"):
+        netspan.assemble(b"NOPE" + blob[4:])
+    asm4 = netspan.StreamAssembler()
+    asm4.feed(blob[:-20])
+    with pytest.raises(SpanTransferError, match="truncated"):
+        asm4.result()
+    with pytest.raises(SpanTransferError, match="cap"):
+        netspan.assemble(blob, max_bytes=1_000)
+    with pytest.raises(SpanTransferError, match="past the stream trailer"):
+        netspan.assemble(blob + b"junk")
+
+
+# --------------------------------------------------------------------- #
+# The HTTP hop: streamed export → local import, faults, discovery
+# --------------------------------------------------------------------- #
+
+
+def test_streamed_export_imports_byte_identical(inproc_worker, local_engine,
+                                                tiny):
+    url, _ = inproc_worker
+    cfg, params = tiny
+    # Remote worker advertises its role on every response.
+    assert probe_worker_role(url) == "prefill"
+    frame = netspan.fetch_span(url, "mh", PROMPT, chunk_bytes=4096,
+                               trace_id="t-stream")
+    assert frame[:5] == b"LAIKV"
+    # Plain (non-stream) export of the SAME span still answers (the ISSUE 6
+    # single-host seam stays compatible).
+    import json as _json
+    req = urllib.request.Request(
+        url + "/cluster/span/export",
+        data=_json.dumps({"model": "mh", "prompt_ids": PROMPT}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.read()[:5] == b"LAIKV"
+
+    # Baseline: a COLD local engine computes the prefix itself.
+    want, ev = local_engine.generate(PROMPT, max_new_tokens=10,
+                                     ignore_eos=True)
+
+    # A fresh decode engine that never saw the prompt imports the remotely
+    # computed span and must produce byte-identical output over it.
+    dec = Engine(cfg, params, ByteTokenizer(cfg.vocab_size),
+                 engine_cfg=_ecfg())
+    dec.start()
+    try:
+        assert dec.import_span_bytes(frame) is True
+        assert dec.m_span_imports == 1
+        got, gev = dec.generate(PROMPT, max_new_tokens=10, ignore_eos=True)
+        assert got == want
+        assert gev.completion_tokens == ev.completion_tokens
+        assert dec.m_prefix_host_hits >= 1  # decode rode the imported span
+    finally:
+        dec.stop()
+        dec.params = None
+        dec.cache = None
+
+
+def test_remote_prefill_handoff_cluster_wide(inproc_worker, tiny):
+    """The tentpole path: a decode-role LOCAL engine + a prefill-role
+    REMOTE replica (discovered over HTTP) — the cluster client hands the
+    prompt's prefill to the remote host and streams the KV span back."""
+    url, _ = inproc_worker
+    cfg, params = tiny
+    dec = Engine(cfg, params, ByteTokenizer(cfg.vocab_size),
+                 engine_cfg=_ecfg())
+    dec.start()
+    base = Engine(cfg, params, ByteTokenizer(cfg.vocab_size),
+                  engine_cfg=_ecfg())
+    base.start()
+    try:
+        remote = RemoteReplica("peer0", url, model="mh", timeout_s=30.0)
+        assert remote.role == "prefill"  # discovered at construction
+        client = ClusterClient(
+            [LocalReplica("d0", dec, role="decode"), remote],
+            gauge_refresh_s=0.0)
+        assert client.disaggregate is True
+        prompt = [(i * 41) % 251 + 1 for i in range(70)]
+        want, _ = base.generate(prompt, max_new_tokens=10, ignore_eos=True)
+        got, ev = client.generate(prompt, max_new_tokens=10,
+                                  ignore_eos=True)
+        assert ev.kind == "done" and got == want
+        assert client.m_handoffs == 1 and client.m_remote_handoffs == 1
+        assert dec.m_span_imports == 1
+        assert dec.m_prefix_host_hits >= 1  # served from the imported span
+        snap = {r["name"]: r for r in client.scheduler.snapshot()}
+        assert snap["peer0"]["remote"] is True
+        assert snap["peer0"]["role"] == "prefill"
+        assert not client._pending
+    finally:
+        for e in (dec, base):
+            e.stop()
+            e.params = None
+            e.cache = None
+
+
+def test_host_partition_degrades_to_recompute(inproc_worker, tiny):
+    """ISSUE 13 satellite: a fixed-seed host_partition schedule — the peer
+    drops mid-stream past the resume budget; the handoff fails TYPED and
+    the decode replica recomputes. Zero hung callers, pool accounted."""
+    url, _ = inproc_worker
+    cfg, params = tiny
+    dec = Engine(cfg, params, ByteTokenizer(cfg.vocab_size),
+                 engine_cfg=_ecfg())
+    dec.start()
+    try:
+        remote = RemoteReplica("peer0", url, model="mh", timeout_s=30.0,
+                               max_resumes=1)
+        client = ClusterClient(
+            [LocalReplica("d0", dec, role="decode"), remote],
+            gauge_refresh_s=0.0)
+        prompt = [(i * 43) % 251 + 1 for i in range(70)]
+        with faults.active(faults.FaultSchedule(
+                seed=77, rate=1.0, sites=("host_partition",),
+                max_faults=8)):
+            t0 = time.monotonic()
+            got, ev = client.generate(prompt, max_new_tokens=8,
+                                      ignore_eos=True)
+            assert time.monotonic() - t0 < 60.0
+        assert ev.kind == "done" and len(got) > 0
+        assert client.m_handoff_fallbacks == 1
+        assert client.m_handoffs == 0 and dec.m_span_imports == 0
+        assert not client._pending, "records leaked past their terminals"
+        # Recovery: the exhausted schedule lets the next handoff land, and
+        # the recomputed output was already correct.
+        got2, _ = client.generate(prompt, max_new_tokens=8, ignore_eos=True)
+        assert got2 == got
+        assert client.m_handoffs == 1 and client.m_remote_handoffs == 1
+        _assert_pool_accounted(dec)
+    finally:
+        dec.stop()
+        dec.params = None
+        dec.cache = None
+
+
+def test_slow_network_times_out_typed(inproc_worker, monkeypatch):
+    """A SLOW peer (injected stalls at every chunk boundary) trips the
+    fetch client's socket timeout and fails typed within its budget."""
+    url, _ = inproc_worker
+    monkeypatch.setattr(netspan, "SLOW_NETWORK_DELAY_S", 0.6)
+    prompt = [(i * 47) % 251 + 1 for i in range(70)]
+    with faults.active(faults.FaultSchedule(
+            seed=5, rate=1.0, sites=("slow_network",), max_faults=64)):
+        t0 = time.monotonic()
+        with pytest.raises(SpanTransferError):
+            netspan.fetch_span(url, "mh", prompt, timeout_s=0.2,
+                               max_resumes=1)
+        assert time.monotonic() - t0 < 30.0
+
+
+def test_push_import_rejects_corrupt_and_truncated(inproc_worker, tiny):
+    """The import direction over real HTTP: framed pushes land; corrupted
+    and truncated streams (and truncated raw frames) are rejected by the
+    checksum/validation path — imported: false, never corrupt KV."""
+    url, manager = inproc_worker
+    cfg, params = tiny
+    src = Engine(cfg, params, ByteTokenizer(cfg.vocab_size),
+                 engine_cfg=_ecfg())
+    src.start()
+    try:
+        prompt = [(i * 53) % 251 + 1 for i in range(70)]
+        src.generate(prompt, max_new_tokens=1, ignore_eos=True)
+        frame = src.export_prefix_span(prompt)
+        assert frame is not None
+        assert netspan.push_span(url, "mh", frame, chunk_bytes=4096) is True
+
+        blob = b"".join(netspan.encode_stream(frame, chunk_bytes=4096))
+        bad = bytearray(blob)
+        bad[40] ^= 0xFF  # corrupt the first data chunk's payload
+
+        def _post(body):
+            req = urllib.request.Request(
+                url + "/cluster/span/import?model=mh", data=bytes(body),
+                headers={"Content-Type": "application/x-laikv-stream"})
+            import json as _json
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return _json.loads(resp.read())
+
+        out = _post(bad)
+        assert out["imported"] is False and "CRC" in out.get("error", "")
+        out = _post(blob[:-20])  # truncated stream — no trailer
+        assert out["imported"] is False
+        out = _post(frame[:-8])  # truncated RAW frame — transfer.decode_span
+        assert out["imported"] is False
+    finally:
+        src.stop()
+        src.params = None
+        src.cache = None
+
+
+def test_p2p_cluster_peer_discovery_view(inproc_worker):
+    """/p2p/cluster probes configured peers server-side: reachability +
+    the role each advertises via LocalAI-Cluster-Role."""
+    from localai_tpu.server.p2p_api import P2pApi
+    from localai_tpu.server.app import Request
+
+    url, _ = inproc_worker
+    api = P2pApi(cluster_peers=[f"w1={url}", "dead=http://127.0.0.1:9"])
+    req = Request(method="GET", path="/p2p/cluster", params={}, query={},
+                  headers={}, body=None)
+    body = api.cluster(req).body
+    by_name = {p["name"]: p for p in body["cluster_peers"]}
+    assert by_name["w1"]["reachable"] is True
+    assert by_name["w1"]["role"] == "prefill"
+    assert by_name["dead"]["reachable"] is False
+    assert "error" in by_name["dead"]
+
+
+# --------------------------------------------------------------------- #
+# 2-process (subprocess) simulated cluster — the acceptance path
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.multiproc
+def test_two_process_span_stream_byte_identical(multiproc_worker, tiny):
+    """export→stream→import across a REAL process boundary (separate jax
+    CPU runtime), byte-identical to a single-process run — greedy AND
+    seeded — with the disaggregated request flowing through the cluster
+    client exactly like the in-process path."""
+    assert multiproc_worker.alive()
+    url = multiproc_worker.url
+    cfg, params = tiny
+    dec = Engine(cfg, params, ByteTokenizer(cfg.vocab_size),
+                 engine_cfg=_ecfg())
+    dec.start()
+    base = Engine(cfg, params, ByteTokenizer(cfg.vocab_size),
+                  engine_cfg=_ecfg())
+    base.start()
+    try:
+        remote = RemoteReplica("host2", url, model="mh", timeout_s=120.0)
+        assert remote.role == "prefill"
+        client = ClusterClient(
+            [LocalReplica("d0", dec, role="decode"), remote],
+            gauge_refresh_s=0.0)
+        for i, kw in enumerate((dict(temperature=0.0),
+                                dict(temperature=0.9, top_k=8, seed=11))):
+            prompt = [(i * 131 + j * 7) % 251 + 1 for j in range(70)]
+            want, ev = base.generate(prompt, max_new_tokens=10,
+                                     ignore_eos=True, **kw)
+            got, gev = client.generate(prompt, max_new_tokens=10,
+                                       ignore_eos=True, **kw)
+            assert got == want, (kw, got, want)
+            assert gev.completion_tokens == ev.completion_tokens
+        assert client.m_remote_handoffs == 2
+        assert dec.m_span_imports == 2
+        assert dec.m_prefix_host_hits >= 2
+        assert not client._pending
+        # Remote gauges came over HTTP (the worker's /metrics scrape).
+        g = remote.gauges()
+        assert "queue_depth" in g and remote.last_gauge_age() is not None
+    finally:
+        for e in (dec, base):
+            e.stop()
+            e.params = None
+            e.cache = None
+
+
+@pytest.mark.multiproc
+def test_two_process_federation_discovery(multiproc_worker):
+    """The discovery leg: the federation front door health-probes the
+    subprocess worker, learns its cluster role from the
+    LocalAI-Cluster-Role header, serves a proxied request, and surfaces
+    role + last-gauge-age in /federation/workers."""
+    from localai_tpu.federation import FederatedServer
+
+    url = multiproc_worker.url
+    fed = FederatedServer(address="127.0.0.1", port=0, strategy="affinity",
+                          workers=[("w2", url)], health_interval_s=0.2,
+                          gauge_stale_s=0.5)
+    fed.start()
+    try:
+        import json as _json
+
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            w = next(iter(fed.registry.list()))
+            if w.role == "prefill":
+                break
+            time.sleep(0.05)
+        assert w.role == "prefill", "role never discovered from the header"
+
+        # One proxied request end-to-end (engages the affinity scheduler's
+        # remote gauge pull on the way).
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fed.port}/v1/chat/completions",
+            data=_json.dumps({
+                "model": "mh",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 2,
+            }).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = _json.loads(resp.read())
+            served_by = resp.headers.get("LocalAI-Served-By")
+        assert out["object"] == "chat.completion"
+        assert served_by == "w2"
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{fed.port}/federation/workers",
+                timeout=10) as resp:
+            view = _json.loads(resp.read())
+        (entry,) = view["workers"]
+        assert entry["role"] == "prefill"
+        assert entry["last_gauge_age_s"] is not None
+        assert "queue_depth" in entry
+    finally:
+        fed.stop()

@@ -29,6 +29,12 @@ DEFAULT_TARGETS = [
     ("localai_tpu/cluster/scheduler.py", "ClusterScheduler"),
     ("localai_tpu/cluster/scheduler.py", "ClusterClient"),
     ("localai_tpu/cluster/replica.py", "ClusterEngine"),
+    # Multi-host subsystem (ISSUE 13): the stream assembler and remote
+    # replica are touched from dispatch pumps and scheduler refreshes —
+    # the same cross-thread AttributeError class as the Engine.
+    ("localai_tpu/cluster/replica.py", "RemoteReplica"),
+    ("localai_tpu/cluster/netspan.py", "StreamAssembler"),
+    ("localai_tpu/testing/multihost.py", "WorkerProc"),
     ("localai_tpu/parallel/sharding.py", "ShardingPlanError"),
     # Observability layer (ISSUE 11): the journal/trace structures are
     # touched from the engine loop and HTTP threads — an unassigned attr
