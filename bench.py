@@ -1889,6 +1889,158 @@ def main() -> None:
             ieng.params = ieng.cache = None
             gc.collect()
 
+    # Million-token context ladder (ISSUE 14, docs/LONG_CONTEXT.md,
+    # BENCH_LONGCTX): 32k/128k/512k contexts on dedicated long-context
+    # engines — paged pool, hierarchical page tables (kv_l1_span),
+    # windowed+sink attention with cold-page spill, chunked prefill. Per
+    # rung: prefill tok/s, TTFT, decode tok/s; plus an N-users-one-document
+    # aggregate (CoW span sharing at scale) on the smallest rung. Rows are
+    # gated by tools/bench_gate.py with the standard direction markers
+    # (tok_per_s/rate → higher-is-better, ttft_ms → lower-is-better;
+    # covered in tests/test_bench_gate.py).
+    if os.environ.get("BENCH_LONGCTX", "1") != "0":
+        import gc
+
+        ladder = [
+            int(x) for x in os.environ.get(
+                "BENCH_LONGCTX_LADDER", "32768,131072,524288"
+            ).split(",") if x.strip()
+        ]
+        lc_page = 128
+        lc_chunk = int(os.environ.get("BENCH_LONGCTX_CHUNK", "512"))
+        lc_window = int(os.environ.get("BENCH_LONGCTX_WINDOW", "4096"))
+        lc_sink = int(os.environ.get("BENCH_LONGCTX_SINK", "128"))
+        lc_gen = 32
+        for ctx in ladder:
+            lceng = None
+            try:
+                gc.collect()
+                lmax = -(-(ctx + 4 * lc_page) // lc_page) * lc_page
+                lceng = Engine(
+                    cfg, params, ByteTokenizer(cfg.vocab_size),
+                    engine_cfg=EngineConfig(
+                        max_slots=2, max_seq=lmax,
+                        kv_pages=lmax // lc_page + 8, kv_page_size=lc_page,
+                        kv_l1_span=128,
+                        attention_sink=lc_sink, attention_window=lc_window,
+                        kv_spill_bytes=2 << 30,
+                        prefill_chunk=lc_chunk,
+                        prefix_cache_entries=0,  # raw ladder; sharing row below
+                        prefix_admit_async_compile=False,
+                    ),
+                )
+                lceng.start()
+                # Warm the chunk/final/decode shapes on a short prompt.
+                lceng.generate([(j % 250) + 1 for j in range(2 * lc_chunk)],
+                               max_new_tokens=4, ignore_eos=True)
+                ids = [(j * 31) % 253 + 1 for j in range(ctx - lc_gen - 8)]
+                res: list = []
+
+                def lc_one() -> None:
+                    res.append(lceng.generate(
+                        ids, max_new_tokens=lc_gen, ignore_eos=True,
+                    ))
+
+                thr = threading.Thread(target=lc_one)
+                thr.start()
+                _join_or_die([thr], lceng, f"longctx {ctx} row",
+                             timeout=1800.0)
+                _, ev = res[0]
+                tag = f"{ctx // 1024}k"
+                ttft = ev.timing_prompt_processing
+                dec_t = ev.timing_token_generation
+                out[f"longctx_{tag}_prefill_tok_per_s"] = round(
+                    len(ids) / max(ttft, 1e-9), 1)
+                out[f"longctx_{tag}_ttft_ms"] = round(ttft * 1000, 1)
+                out[f"longctx_{tag}_decode_tok_per_s"] = round(
+                    max(ev.completion_tokens - 1, 1) / max(dec_t, 1e-9), 1)
+                mtr = lceng.metrics()
+                print(
+                    f"longctx {tag}: prefill "
+                    f"{out[f'longctx_{tag}_prefill_tok_per_s']} tok/s "
+                    f"(ttft {out[f'longctx_{tag}_ttft_ms']} ms, "
+                    f"{lceng.m_prefill_chunks} chunks), decode "
+                    f"{out[f'longctx_{tag}_decode_tok_per_s']} tok/s, "
+                    f"{int(mtr.get('kv_pages_spilled', 0))} pages spilled "
+                    f"({int(mtr.get('kv_spill_host_bytes', 0)) >> 20} MiB "
+                    "on host)", file=sys.stderr,
+                )
+            except Exception as e:  # noqa: BLE001 — extra row is best-effort
+                print(f"longctx {ctx} row failed: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+            finally:
+                if lceng is not None:
+                    lceng.stop()
+                    lceng.params = lceng.cache = None
+                    lceng = None
+        # N users over ONE long document: CoW span sharing at scale — the
+        # document's pages (and its L1 directory chunks) are paid once, each
+        # user prefills only its own tail through the masked chunk path.
+        lc_users = int(os.environ.get("BENCH_LONGCTX_USERS", "4"))
+        doc_len = min(ladder) if ladder else 32768
+        lceng = None
+        try:
+            gc.collect()
+            lmax = -(-(doc_len + 8 * lc_page) // lc_page) * lc_page
+            lceng = Engine(
+                cfg, params, ByteTokenizer(cfg.vocab_size),
+                engine_cfg=EngineConfig(
+                    max_slots=max(lc_users, 2), max_seq=lmax,
+                    kv_pages=lmax // lc_page + 32 * lc_users,
+                    kv_page_size=lc_page, kv_l1_span=128,
+                    attention_sink=lc_sink, attention_window=lc_window,
+                    kv_spill_bytes=2 << 30, prefill_chunk=lc_chunk,
+                    prefix_cache_entries=4,
+                    prefix_admit_async_compile=False,
+                ),
+            )
+            lceng.start()
+            doc = [(j * 29) % 251 + 1 for j in range(doc_len - 512)]
+            # Seed the document span (and warm every shape).
+            lceng.generate(doc + [3, 5], max_new_tokens=4, ignore_eos=True)
+            lceng.generate(doc + [7, 9], max_new_tokens=4, ignore_eos=True)
+            hits0 = lceng.m_prefix_hits
+            outs: list = []
+            lk = threading.Lock()
+
+            def lc_user(i: int) -> None:
+                tail = [(i * 37 + j) % 251 + 1 for j in range(64)]
+                r = lceng.generate(doc + tail, max_new_tokens=lc_gen,
+                                   ignore_eos=True)
+                with lk:
+                    outs.append(r)
+
+            thrs = [threading.Thread(target=lc_user, args=(i,))
+                    for i in range(lc_users)]
+            w0 = time.time()
+            for t in thrs:
+                t.start()
+            _join_or_die(thrs, lceng, "longctx users row", timeout=1800.0)
+            wall = time.time() - w0
+            hits = lceng.m_prefix_hits - hits0
+            total_new = sum(ev.completion_tokens for _, ev in outs)
+            out["longctx_users_agg_tok_per_s"] = round(
+                total_new / max(wall, 1e-9), 1)
+            out["longctx_users_prefix_hit_rate"] = round(
+                hits / max(lc_users, 1), 3)
+            out["longctx_users_doc_tokens"] = doc_len
+            print(
+                f"longctx users: {lc_users} users x {doc_len}-token doc — "
+                f"{out['longctx_users_agg_tok_per_s']} tok/s aggregate, "
+                f"hit rate {out['longctx_users_prefix_hit_rate']} "
+                f"({lceng.m_prefix_tokens} prefix tokens reused)",
+                file=sys.stderr,
+            )
+        except Exception as e:  # noqa: BLE001 — extra row is best-effort
+            print(f"longctx users row failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+        finally:
+            if lceng is not None:
+                lceng.stop()
+                lceng.params = lceng.cache = None
+                lceng = None
+            gc.collect()
+
     # North-star row (BASELINE.md): llama-3-8b int8, served end-to-end over
     # HTTP POST /v1/chat/completions with stream:true. Synthetic weights
     # (zero egress) on the real 8B arch; decode tok/s from the engine's

@@ -155,6 +155,27 @@ class ModelConfig:
     # short prompts stops queueing behind long ones. Power of two; 0 = off
     # (single-shot admission). LOCALAI_PREFILL_CHUNK env var overrides.
     prefill_chunk: int = 0
+    # Million-token context serving (ISSUE 14, docs/LONG_CONTEXT.md).
+    # Windowed+sink attention: decode (and the paged chunked-prefill
+    # prefix walk) attends only the first attention_sink positions plus
+    # the trailing attention_window — linear-cost long context. 0 = full
+    # attention. LOCALAI_ATTENTION_SINK / LOCALAI_ATTENTION_WINDOW env
+    # vars override.
+    attention_sink: int = 0
+    attention_window: int = 0
+    # Host-RAM budget for spilled COLD pages (pages behind every live
+    # query's window; restored byte-exactly when needed hot again).
+    # 0 disables spill. LOCALAI_KV_SPILL_BYTES env var overrides.
+    kv_spill_bytes: int = 0
+    # Hierarchical page tables: page ids per L0 table page (0 = flat
+    # table). Keeps a 1M-token slot's table out of the kernel's scalar-
+    # prefetch/SMEM budget and shares directories CoW across slots.
+    # LOCALAI_KV_L1_SPAN env var overrides.
+    kv_l1_span: int = 0
+    # Sequence-parallel chunked prefill toggle (sp > 1 + paged pool):
+    # ring-shard each prefill chunk's attention over "sp".
+    # LOCALAI_SP_PREFILL env var overrides ("0" disables).
+    sp_prefill: bool = True
 
     # Bounded admission + deadlines (ISSUE 4, docs/ROBUSTNESS.md). A full
     # pending queue rejects at submit (HTTP 429 + Retry-After); requests

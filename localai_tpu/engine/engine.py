@@ -361,6 +361,52 @@ class EngineConfig:
     # program at all). LOCALAI_SPEC_DRAFT_BUCKETS env var overrides
     # (comma-separated).
     spec_draft_buckets: tuple[int, ...] = ()
+    # --- Million-token context serving (ISSUE 14, docs/LONG_CONTEXT.md) ---
+    # Windowed+sink attention: when attention_window > 0, decode (and the
+    # chunked-prefill prefix walk under the paged pool) attends only rows
+    # with position < attention_sink plus rows within attention_window of
+    # the query — StreamingLLM-style, absolute rope positions. This is what
+    # makes a 512k–1M context's attention LINEAR in context length, and it
+    # is the precondition for cold-page spill (kv_spill_bytes): a page that
+    # falls out of the window can never be attended again, so its device
+    # bytes can move to host RAM. Requires, under the paged pool, a chunked
+    # prefill (prefill_chunk > 0, prefill_chunk <= attention_window) so
+    # every long admission runs the one masked numeric path; incompatible
+    # with arch sliding windows (gemma-2), draft models, spec modes and
+    # mrope. 0 = full attention. LOCALAI_ATTENTION_WINDOW /
+    # LOCALAI_ATTENTION_SINK env vars override.
+    attention_sink: int = 0
+    attention_window: int = 0
+    # Host-RAM byte budget for COLD-page spill (ISSUE 14): with windowed+
+    # sink decode active, pages wholly behind every live query's window
+    # (and past the sink) are copied to host RAM and their device pages
+    # returned to the pool — restored byte-exactly when a consumer needs
+    # them hot again (prefix save), merged byte-exactly into preempt-swap
+    # images otherwise. Shared (CoW prefix-span) pages never spill — they
+    # are hot BECAUSE other slots read them. Separate from kv_swap_bytes so
+    # spill pressure can't evict preempt images. 0 disables spill (windowed
+    # decode still works; everything stays hot). LOCALAI_KV_SPILL_BYTES
+    # env var overrides.
+    kv_spill_bytes: int = 0
+    # Hierarchical page-table geometry (ISSUE 14, ops/ptable): 0 = the flat
+    # [max_slots, max_seq/page] table (fine to ~tens of k tokens); N >= 2 =
+    # two-level tables with N page ids per L0 table page — each slot ships
+    # an ML1 = ceil(max_pages/N)-entry L1 directory instead of one giant
+    # row, the Pallas kernel walks L1 in-kernel, and table pages are shared
+    # copy-on-write across slots exactly like the KV pages they map (N
+    # readers of one 500k-token span pay its directory once). The
+    # allocator/refcount/growth/swap machinery is unchanged either way.
+    # LOCALAI_KV_L1_SPAN env var overrides.
+    kv_l1_span: int = 0
+    # Sequence-parallel chunked prefill (ISSUE 14): with an sp>1 mesh AND a
+    # paged pool, each prefill chunk's attention runs ring-sharded over
+    # "sp" (parallel/ring.ring_chunk_paged_attention — per-chip chunk
+    # compute is chunk/sp, in-chunk K/V rotating neighbor-to-neighbor)
+    # while the chunk's K/V still scatters straight into pool pages. False
+    # = keep sp meshes on the dense single-shot ring path (paged + sp then
+    # rejects at load, the pre-ISSUE-14 behavior). LOCALAI_SP_PREFILL env
+    # var overrides ("0" disables).
+    sp_prefill: bool = True
     # KV-cache storage dtype (reference: CacheTypeKey/CacheTypeValue,
     # backend/backend.proto:261-262, llama.cpp q8 KV). "" = model dtype;
     # "fp8" (e4m3) / "fp8_e5m2" halve KV bytes — the TPU-native equivalent
@@ -674,6 +720,11 @@ class Engine:
             "LOCALAI_SELF_DRAFT_LAYERS": ("self_draft_layers", int),
             "LOCALAI_SPEC_ACCEPT_EWMA": ("spec_accept_ewma", float),
             "LOCALAI_SPEC_DRAFT_BUCKETS": ("spec_draft_buckets", _parse_buckets_env),
+            "LOCALAI_ATTENTION_SINK": ("attention_sink", int),
+            "LOCALAI_ATTENTION_WINDOW": ("attention_window", int),
+            "LOCALAI_KV_SPILL_BYTES": ("kv_spill_bytes", int),
+            "LOCALAI_KV_L1_SPAN": ("kv_l1_span", int),
+            "LOCALAI_SP_PREFILL": ("sp_prefill", _parse_flag_env),
         }.items():
             val = os.environ.get(env)
             if val is not None and val != "":
@@ -709,6 +760,60 @@ class Engine:
                 "kv_scale != 1.0 requires a paged pool (kv_pages > 0) with "
                 "an fp8 kv_cache_dtype — the dense cache has no scaled path"
             )
+        # Windowed+sink long-context serving (ISSUE 14,
+        # docs/LONG_CONTEXT.md): validate the knob set, then thread it to
+        # every attention call through the (frozen) ArchConfig like
+        # quant_kernel below.
+        sink_t = self.ecfg.attention_sink
+        win_t = self.ecfg.attention_window
+        if sink_t < 0 or win_t < 0:
+            raise ValueError("attention_sink / attention_window must be >= 0")
+        if sink_t and not win_t:
+            raise ValueError(
+                "attention_sink without attention_window is full attention "
+                "— set attention_window > 0 (or drop the sink)"
+            )
+        if win_t:
+            if cfg.sliding_window:
+                raise ValueError(
+                    f"attention_window composes with full-attention models "
+                    f"only — {cfg.name} already has an architectural "
+                    f"sliding window"
+                )
+            if getattr(cfg, "mrope_section", ()):
+                raise ValueError(
+                    "attention_window excludes m-rope (VLM) models this "
+                    "round — text decoders only"
+                )
+            if self.ecfg.kv_pages > 0:
+                C0 = self.ecfg.prefill_chunk
+                if not C0:
+                    raise ValueError(
+                        "attention_window on a paged pool requires chunked "
+                        "prefill (prefill_chunk > 0) — long admissions must "
+                        "run the one masked prefix-walk path"
+                    )
+                if C0 > win_t:
+                    raise ValueError(
+                        f"prefill_chunk={C0} must be <= attention_window="
+                        f"{win_t} (the in-chunk causal part must sit inside "
+                        "the window for the mask to stay exact)"
+                    )
+        if self.ecfg.kv_spill_bytes < 0:
+            raise ValueError("kv_spill_bytes must be >= 0")
+        if self.ecfg.kv_l1_span:
+            if self.ecfg.kv_l1_span < 2:
+                raise ValueError("kv_l1_span must be >= 2 (0 = flat table)")
+            if self.ecfg.kv_pages <= 0:
+                raise ValueError(
+                    "kv_l1_span (hierarchical page tables) requires a paged "
+                    "pool (kv_pages > 0)"
+                )
+        if (cfg.attention_sink != sink_t or cfg.attention_window != win_t):
+            cfg = dataclasses.replace(
+                cfg, attention_sink=sink_t, attention_window=win_t
+            )
+            self.cfg = cfg
         # Thread the quant-kernel choice to every model-side matmul through
         # the (frozen) ArchConfig — cfg is the one static object each layer
         # helper already receives (models/config.py quant_kernel).
@@ -872,6 +977,14 @@ class Engine:
                 "bucket >= 1"
             )
         self._spec_buckets = tuple(bl)
+        if self.ecfg.attention_window and (
+            mode != "off" or draft_cfg is not None
+        ):
+            raise ValueError(
+                "attention_window excludes speculative decoding this round "
+                "— the verify chunk has no windowed+sink variant; drop "
+                "spec_mode/draft_model or the window"
+            )
 
         B, S, V = self.ecfg.max_slots, self.ecfg.max_seq, cfg.vocab_size
         from localai_tpu.models.quant import is_prequantized, quantize_params
@@ -892,11 +1005,26 @@ class Engine:
                 )(self.params)
             if self.ecfg.kv_pages > 0:
                 # Paged pool [L, P, page, K, Hd]: kv-heads shard over tp;
-                # pages are shared across slots, so dp/sp don't apply.
-                if self.plan.dp > 1 or self.plan.sp > 1:
+                # pages are shared across slots so dp doesn't apply, and
+                # sp>1 serves ONLY the ring-sharded chunked prefill (ISSUE
+                # 14, sp_prefill) — the pool itself replicates over sp.
+                if self.plan.dp > 1:
                     raise ValueError(
-                        "paged KV cache (kv_pages > 0) requires dp == sp == 1"
+                        "paged KV cache (kv_pages > 0) requires dp == 1"
                     )
+                if self.plan.sp > 1:
+                    C0 = self.ecfg.prefill_chunk
+                    if not (self.ecfg.sp_prefill and C0):
+                        raise ValueError(
+                            "paged KV cache with sp > 1 requires the "
+                            "sequence-parallel chunked prefill (sp_prefill "
+                            "on AND prefill_chunk > 0, ISSUE 14)"
+                        )
+                    if C0 % self.plan.sp:
+                        raise ValueError(
+                            f"prefill_chunk={C0} must divide by "
+                            f"sp={self.plan.sp}"
+                        )
                 if S % self.ecfg.kv_page_size:
                     raise ValueError(
                         f"max_seq={S} must divide by kv_page_size="
@@ -1135,6 +1263,50 @@ class Engine:
         )
         self._free_pages: list[int] = list(range(self.ecfg.kv_pages))
         self._slot_pages: list[list[int]] = [[] for _ in range(B)]
+        # Hierarchical page tables (ISSUE 14, ops/ptable, kv_l1_span > 0):
+        # h_l1 [B, ML1] holds per-slot directories of TABLE-PAGE ids; h_l0
+        # [NTP+1, SPAN] is the global table-page pool (row 0 = the all-
+        # SCRATCH table page every idle directory entry points at). Table
+        # pages are refcounted and shared copy-on-write across slots and
+        # prefix entries exactly like the KV pages they map — _ptable_set
+        # copies a shared table page before writing through it. NTP is
+        # sized so claims cannot fail: every slot + every prefix entry can
+        # hold a full directory, plus CoW transients.
+        self._l1_span = self.ecfg.kv_l1_span if self.ecfg.kv_pages else 0
+        self._hier = self._l1_span > 0
+        ml1 = (-(-max(self._max_pages, 1) // self._l1_span)
+               if self._hier else 0)
+        self._ml1 = ml1
+        ntp = ((B + max(self.ecfg.prefix_cache_entries, 0) + 2) * ml1
+               if self._hier else 0)
+        self._scratch_tp = 0
+        self.h_l0 = np.full(
+            (ntp + 1, max(self._l1_span, 1)), self._scratch_page, np.int32
+        )
+        self.h_l1 = np.full((B, max(ml1, 1)), self._scratch_tp, np.int32)
+        self._tp_free: list[int] = list(range(1, ntp + 1))
+        self._tp_refs = np.zeros((ntp + 1,), np.int32)
+        self._slot_tps: list[list[int]] = [[] for _ in range(B)]
+        # Cold-page spill (ISSUE 14, docs/LONG_CONTEXT.md): per-slot
+        # {page column: (hk [L,1,page,K,Dk], hv)} host images of spilled
+        # cold-middle pages; the matching _slot_pages entries hold the
+        # SPILLED (-1) sentinel and the directory entries point at SCRATCH.
+        # _spill_bytes tracks the images against kv_spill_bytes (its own
+        # budget — spill pressure must not evict preempt-swap images).
+        self._slot_spill: list[dict] = [{} for _ in range(B)]
+        # Next directory column each slot's spill scan resumes from —
+        # query positions only grow, so the scan never needs to revisit.
+        self._spill_cursor = np.zeros((B,), np.int64)
+        self._spill_bytes = 0
+        self._spill_on = (
+            self._paged and self.ecfg.attention_window > 0
+            and self.ecfg.kv_spill_bytes > 0
+        )
+        self.m_kv_spill_bytes_out = 0
+        self.m_kv_spill_bytes_in = 0
+        self.m_kv_pages_spilled = 0
+        self.m_kv_pages_restored = 0
+        self.m_kv_spill_skips = 0
         # Chunked ragged prefill state (EngineConfig.prefill_chunk): each
         # in-progress chunked admission holds a reserved slot (inactive —
         # decode blocks skip it) and, under the paged pool, its page table
@@ -1285,6 +1457,8 @@ class Engine:
                 "kv_pages": int(self.ecfg.kv_pages),
                 "free_pages": len(self._free_pages),
                 "host_tier_bytes": int(self._host_bytes),
+                "spilled_pages": int(sum(len(d) for d in self._slot_spill)),
+                "spill_bytes": int(self._spill_bytes),
                 "prefix_entries": len(self._prefix_entries),
                 "prefix_host_entries": len(self._prefix_host),
             },
@@ -1304,6 +1478,146 @@ class Engine:
     @property
     def _paged(self) -> bool:
         return self.ecfg.kv_pages > 0
+
+    # ------------------------------------------------------------------ #
+    # Hierarchical page tables (ISSUE 14, ops/ptable — kv_l1_span > 0)
+    #
+    # The flat h_ptable row is replaced by a per-slot L1 directory of
+    # refcounted TABLE PAGES (h_l1 → h_l0 rows of kv_l1_span page ids).
+    # Directories share table pages copy-on-write with prefix entries and
+    # other slots: mapping a 500k-token span costs ML1 addrefs, not 4k
+    # int writes, and the device ships a 64-entry row instead of a 4k one.
+    # The KV-page allocator itself (claim/addref/release, _free_pages,
+    # _page_refs) is untouched — these helpers only maintain the mapping.
+    # ------------------------------------------------------------------ #
+
+    def _tp_claim(self) -> int:
+        """Claim a fresh table page (refcount 1, all-SCRATCH content)."""
+        if not self._tp_free:
+            # Sized so this cannot happen (see __init__); heal like the
+            # page allocator's clamp paths rather than corrupting state.
+            if os.environ.get("LOCALAI_ALLOC_DEBUG", "0") == "1":
+                raise AssertionError("table-page pool exhausted")
+            grow = max(self._ml1, 1)
+            base = self.h_l0.shape[0]
+            self.h_l0 = np.concatenate([
+                self.h_l0,
+                np.full((grow, self.h_l0.shape[1]), self._scratch_page,
+                        np.int32),
+            ])
+            self._tp_refs = np.concatenate([
+                self._tp_refs, np.zeros((grow,), np.int32)
+            ])
+            self._tp_free.extend(range(base, base + grow))
+            log.error("table-page pool exhausted — grew by %d", grow)
+        tp = self._tp_free.pop()
+        self._tp_refs[tp] = 1
+        self.h_l0[tp, :] = self._scratch_page
+        return tp
+
+    def _tp_release(self, tps: list[int]) -> None:
+        for tp in tps:
+            if tp == self._scratch_tp:
+                continue
+            if self._tp_refs[tp] <= 0:
+                if os.environ.get("LOCALAI_ALLOC_DEBUG", "0") == "1":
+                    raise AssertionError(f"double release of table page {tp}")
+                log.error("double release of table page %d ignored", tp)
+                self._tp_refs[tp] = 0
+                continue
+            self._tp_refs[tp] -= 1
+            if self._tp_refs[tp] == 0:
+                self._tp_free.append(tp)
+
+    def _ptable_set(self, slot_idx: int, pos: int, page_id: int) -> None:
+        """Write one directory entry (hier mode): point slot column `pos`
+        at `page_id`, copy-on-writing the backing table page if shared."""
+        span = self._l1_span
+        c, o = divmod(pos, span)
+        tps = self._slot_tps[slot_idx]
+        while len(tps) <= c:
+            tp_new = self._tp_claim()
+            tps.append(tp_new)
+            self.h_l1[slot_idx, len(tps) - 1] = tp_new
+        tp = tps[c]
+        if self._tp_refs[tp] > 1:
+            # Shared with a prefix entry / another slot — copy before write.
+            tp_new = self._tp_claim()
+            self.h_l0[tp_new, :] = self.h_l0[tp]
+            self._tp_release([tp])
+            tps[c] = tp_new
+            self.h_l1[slot_idx, c] = tp_new
+            tp = tp_new
+        self.h_l0[tp, o] = page_id
+
+    def _ptable_build_slot(self, slot_idx: int, pages: list[int],
+                           shared_tps: Optional[list[int]] = None,
+                           n_shared: int = 0) -> np.ndarray:
+        """Build a slot's L1 directory for `pages` (hier mode). Full
+        SPAN-chunks of the leading `n_shared` shared pages reuse the donor
+        entry's table pages (addref — the CoW path); everything else writes
+        into freshly-claimed private table pages. Returns the slot's L1
+        row (the device-shippable analogue of the flat h_ptable row)."""
+        span = self._l1_span
+        tps = self._slot_tps[slot_idx]
+        assert not tps, f"slot {slot_idx} already holds a directory"
+        self.h_l1[slot_idx, :] = self._scratch_tp
+        start = 0
+        if shared_tps and n_shared:
+            full = min(n_shared // span, len(shared_tps))
+            for c in range(full):
+                tp = shared_tps[c]
+                self._tp_refs[tp] += 1
+                tps.append(tp)
+                self.h_l1[slot_idx, c] = tp
+            start = full * span
+        for pos in range(start, len(pages)):
+            self._ptable_set(slot_idx, pos, pages[pos])
+        return self.h_l1[slot_idx].copy()
+
+    def _ptable_free_slot(self, slot_idx: int) -> None:
+        self._tp_release(self._slot_tps[slot_idx])
+        self._slot_tps[slot_idx] = []
+        self.h_l1[slot_idx, :] = self._scratch_tp
+
+    def _entry_tps_for_pages(self, pages: list[int]) -> list[int]:
+        """Fresh table pages mapping an ENTRY's page list (hier mode) —
+        the host-tier promote path, where the pages belong to no slot."""
+        span = self._l1_span
+        tps = []
+        for c in range(-(-len(pages) // span)):
+            tp = self._tp_claim()
+            chunkp = pages[c * span: (c + 1) * span]
+            self.h_l0[tp, : len(chunkp)] = chunkp
+            tps.append(tp)
+        return tps
+
+    def _entry_tps(self, slot_idx: int, n_pages: int) -> list[int]:
+        """Addref'd table pages covering a prefix entry's n_pages leading
+        pages (hier mode) — the directory half of copy-on-write span
+        sharing. The entry keeps these rows byte-stable: any later slot
+        write through a shared table page copies it first (_ptable_set)."""
+        span = self._l1_span
+        n_tp = -(-n_pages // span)
+        tps = self._slot_tps[slot_idx][:n_tp]
+        for tp in tps:
+            self._tp_refs[tp] += 1
+        return list(tps)
+
+    def _ptable_device(self):
+        """The device ptable operand for batched programs: the flat
+        [B, MP] row table, or the hierarchical (l1, l0) pair."""
+        if self._hier:
+            return (jnp.asarray(self.h_l1), jnp.asarray(self.h_l0))
+        return jnp.asarray(self.h_ptable)
+
+    def _ptable_device_row(self, row: np.ndarray):
+        """One slot's table operand from its host row (flat [MP] or hier
+        L1 [ML1] — the l0 pool rides along CURRENT, so directory-content
+        updates between dispatches are visible)."""
+        if self._hier:
+            return (jnp.asarray(row), jnp.asarray(self.h_l0))
+        return jnp.asarray(row)
 
     def _pages_worst(self, request: GenRequest) -> int:
         """Worst-case pages for a request: the prefill writes a full bucket
@@ -1342,14 +1656,20 @@ class Engine:
         return min(base + self.ecfg.kv_page_headroom, cap)
 
     def _pages_alloc(self, slot_idx: int, n: int,
-                     shared: Optional[list[int]] = None) -> Optional[np.ndarray]:
+                     shared: Optional[list[int]] = None,
+                     shared_tps: Optional[list[int]] = None,
+                     ) -> Optional[np.ndarray]:
         """Build a slot's page table: `shared` read-only prefix pages (a
         prefix-cache span — refcounted, never written by this slot because
         all its writes land at rows past the shared span) followed by `n`
-        freshly-allocated pages. A slot that already holds a table is a
-        caller bug — overwriting it would leak its pages' refcounts into
-        the pool forever, so the stale table is released first (and raised
-        under LOCALAI_ALLOC_DEBUG=1 / the test suite)."""
+        freshly-allocated pages. Under hierarchical tables, `shared_tps`
+        (the donor entry's table pages) lets full directory chunks of the
+        shared span map by addref instead of rewrite. A slot that already
+        holds a table is a caller bug — overwriting it would leak its
+        pages' refcounts into the pool forever, so the stale table is
+        released first (and raised under LOCALAI_ALLOC_DEBUG=1 / the test
+        suite). Returns the slot's device-shippable table row (flat [MP] or
+        hier L1 [ML1]), or None on pool pressure (no mutation)."""
         # Injected allocator failure fires BEFORE any mutation so pool
         # accounting stays exact across the fault (testing/faults).
         faults.fire("page_alloc")
@@ -1372,6 +1692,11 @@ class Engine:
         self._pages_addref(shared)
         pages = shared + fresh
         self._slot_pages[slot_idx] = pages
+        if self._hier:
+            return self._ptable_build_slot(
+                slot_idx, pages, shared_tps=shared_tps,
+                n_shared=len(shared),
+            )
         # Unused tail entries point at SCRATCH so any row past the slot's
         # reservation (end-of-request block overshoot) lands harmlessly.
         row = np.full((self._max_pages,), self._scratch_page, np.int32)
@@ -1413,6 +1738,8 @@ class Engine:
 
     def _pages_release(self, pages: list[int]) -> None:
         for p in pages:
+            if p < 0:
+                continue  # SPILLED sentinel — the image owns no device page
             if self._page_refs[p] <= 0:
                 # Double release: the page is already free (or never
                 # allocated). Appending it to the free list AGAIN would let
@@ -1446,7 +1773,11 @@ class Engine:
         if fresh is None:
             return False
         self._slot_pages[slot_idx].extend(fresh)
-        self.h_ptable[slot_idx, have:need_pages] = fresh
+        if self._hier:
+            for off, p in enumerate(fresh):
+                self._ptable_set(slot_idx, have + off, p)
+        else:
+            self.h_ptable[slot_idx, have:need_pages] = fresh
         self.m_kv_pages_grown += grow
         return True
 
@@ -1474,9 +1805,209 @@ class Engine:
     def _pages_free(self, slot_idx: int) -> None:
         self._pages_release(self._slot_pages[slot_idx])
         self._slot_pages[slot_idx] = []
+        if self._slot_spill[slot_idx]:
+            # Spilled cold-page images die with the slot (their device
+            # pages were already returned at spill time).
+            self._spill_bytes -= (
+                len(self._slot_spill[slot_idx]) * self._page_bytes()
+            )
+            self._slot_spill[slot_idx] = {}
+        self._spill_cursor[slot_idx] = 0
         # The slot stays in every decode block's scatter until re-admitted —
         # its stale table must not alias pages handed to the next request.
-        self.h_ptable[slot_idx] = self._scratch_page
+        if self._hier:
+            self._ptable_free_slot(slot_idx)
+        else:
+            self.h_ptable[slot_idx] = self._scratch_page
+
+    # ------------------------------------------------------------------ #
+    # Cold-page spill for live slots (ISSUE 14, docs/LONG_CONTEXT.md)
+    #
+    # With windowed+sink decode active, a page whose LAST row sits further
+    # than attention_window behind every live query (and past the sink)
+    # can never be attended again — query positions only grow. Its bytes
+    # move to host RAM (bounded by kv_spill_bytes), the device page
+    # returns to the pool, and the directory entry points at SCRATCH; any
+    # in-flight dispatch that still lists the old page id reads rows its
+    # mask zeroes, so recycling under the pipeline is exact. Shared (CoW
+    # span) pages never spill — other slots read them hot. Restoration is
+    # byte-exact: prefix save swaps the images back into fresh pages;
+    # preempt-swap splices them into the swap image host-side.
+    # ------------------------------------------------------------------ #
+
+    _SPILL_MAX_PER_TICK = 64  # pages per loop iteration — bounds the D2H
+    # gather so spilling a 512k slot amortizes over iterations instead of
+    # stalling dispatch for one giant copy
+
+    def _spill_cold_pages(self) -> None:
+        """Loop-thread tick: move cold middle pages of live/chunking slots
+        to the host tier. Any failure (injected page_spill/host_swap fault,
+        allocator oddity) skips that slot's batch — it simply stays hot
+        (exact attention), never a hung caller."""
+        if not self._spill_on:
+            return
+        page = self.ecfg.kv_page_size
+        swin = self.cfg.attention_window
+        sink_cols = (-(-self.cfg.attention_sink // page)
+                     if self.cfg.attention_sink else 0)
+        # Conservative margin: in-flight chunk queries sit up to one chunk
+        # behind st["offset"], in-flight decode queries up to one block
+        # behind the processed count.
+        margin = self.ecfg.prefill_chunk + max(self.ecfg.block_sizes)
+        pb = self._page_bytes()
+        by_slot = {st["slot"]: st for st in self._chunkings}
+        done = 0
+        for i in range(self.ecfg.max_slots):
+            if done >= self._SPILL_MAX_PER_TICK:
+                return
+            st = by_slot.get(i)
+            if st is not None:
+                floor = st["offset"]
+            elif self.h_active[i] and self.slots[i] is not None:
+                s = self.slots[i]
+                floor = s.prompt_len + len(s.generated)
+            else:
+                continue
+            pages = self._slot_pages[i]
+            cand: list[int] = []
+            c = max(int(self._spill_cursor[i]), sink_cols)
+            while (c < len(pages)
+                   and (c + 1) * page <= floor - swin - margin
+                   and done + len(cand) < self._SPILL_MAX_PER_TICK):
+                p = pages[c]
+                if p < 0:
+                    self._spill_cursor[i] = c + 1  # already spilled
+                elif self._page_refs[p] > 1:
+                    # Shared with a prefix span / another slot — hot on
+                    # purpose; releasing our ref would save no memory.
+                    self.m_kv_spill_skips += 1
+                    self._spill_cursor[i] = c + 1
+                elif (self._spill_bytes + (len(cand) + 1) * pb
+                      > self.ecfg.kv_spill_bytes):
+                    break  # budget full — retry once images are freed
+                else:
+                    cand.append(c)
+                c += 1
+            if not cand:
+                continue
+            try:
+                faults.fire("page_spill")
+                hk, hv = self._swap_out_pages([pages[c] for c in cand])
+            except Exception as e:  # noqa: BLE001 — degrade to exact/hot
+                self._jnote_fault(e)
+                if not isinstance(e, faults.InjectedFault):
+                    log.exception("cold-page spill failed (slot %d)", i)
+                self.m_kv_spill_skips += len(cand)
+                # Cursor moves past the batch: these pages stay hot for
+                # the slot's lifetime (exact attention fallback).
+                self._spill_cursor[i] = cand[-1] + 1
+                continue
+            span = self._l1_span
+            spilled = 0
+            for j, c in enumerate(cand):
+                if (self._hier and st is not None
+                        and self._tp_refs[self._slot_tps[i][c // span]] > 1):
+                    # Chunking slots ship a SAVED L1 row per dispatch — a
+                    # CoW would orphan it, and writing a SHARED table page
+                    # in place would corrupt the donor entry. Shared table
+                    # pages during chunking only back shared KV pages
+                    # (skipped above), so this is belt and braces: leave
+                    # the page hot.
+                    self.m_kv_spill_skips += 1
+                    self._spill_cursor[i] = c + 1
+                    continue
+                self._slot_spill[i][c] = (
+                    np.ascontiguousarray(hk[:, j: j + 1]),
+                    np.ascontiguousarray(hv[:, j: j + 1]),
+                )
+                self._pages_release([pages[c]])
+                pages[c] = -1
+                if self._hier:
+                    self._ptable_set(i, c, self._scratch_page)
+                elif st is not None:
+                    st["table_row"][c] = self._scratch_page
+                else:
+                    self.h_ptable[i, c] = self._scratch_page
+                self._spill_cursor[i] = c + 1
+                spilled += 1
+            if not spilled:
+                continue
+            nbytes = spilled * pb
+            self._spill_bytes += nbytes
+            self.m_kv_pages_spilled += spilled
+            self.m_kv_spill_bytes_out += nbytes
+            done += spilled
+            self._jnote("page_spill", slot=i, a=float(spilled),
+                        b=float(nbytes))
+
+    def _restore_spilled(self, slot_idx: int) -> bool:
+        """Swap a slot's spilled cold pages back into fresh pool pages —
+        byte-exact re-admission to full residency (prefix save needs every
+        page hot before it can pin the span). Returns False when the pool
+        cannot cover it right now (callers degrade: the span is not
+        saved)."""
+        images = self._slot_spill[slot_idx]
+        if not images:
+            return True
+        faults.fire("page_spill")
+        need = len(images)
+        if len(self._free_pages) < need:
+            self._prefix_evict_for_pages(need)
+        fresh = self._pages_claim(need)
+        if fresh is None:
+            return False
+        cols = sorted(images)
+        hk = np.concatenate([images[c][0] for c in cols], axis=1)
+        hv = np.concatenate([images[c][1] for c in cols], axis=1)
+        self._swap_in_pages(fresh, hk, hv)
+        pages = self._slot_pages[slot_idx]
+        st = next((s for s in self._chunkings if s["slot"] == slot_idx),
+                  None)
+        for p, c in zip(fresh, cols):
+            pages[c] = p
+            if self._hier:
+                self._ptable_set(slot_idx, c, p)
+            elif st is not None:
+                st["table_row"][c] = p
+            else:
+                self.h_ptable[slot_idx, c] = p
+        nbytes = need * self._page_bytes()
+        self._spill_bytes -= nbytes
+        self._slot_spill[slot_idx] = {}
+        self._spill_cursor[slot_idx] = 0
+        self.m_kv_pages_restored += need
+        self.m_kv_spill_bytes_in += nbytes
+        self._jnote("page_restore", slot=slot_idx, a=float(need),
+                    b=float(nbytes))
+        return True
+
+    def _swap_out_slot_span(self, slot_idx: int,
+                            n_live: int) -> tuple[np.ndarray, np.ndarray]:
+        """A preempt-swap image of the slot's first n_live pages with any
+        spilled cold pages spliced in from their host images — byte-exact
+        without re-admitting them to the device first."""
+        pages = self._slot_pages[slot_idx][:n_live]
+        images = self._slot_spill[slot_idx]
+        hot = [(j, p) for j, p in enumerate(pages) if p >= 0]
+        if all(p >= 0 for p in pages):
+            return self._swap_out_pages(pages)
+        hk_hot, hv_hot = (self._swap_out_pages([p for _, p in hot])
+                          if hot else (None, None))
+        sample_k, sample_v = next(iter(images.values()))
+        if hk_hot is not None:
+            sample_k, sample_v = hk_hot, hv_hot
+        hk = np.zeros((sample_k.shape[0], n_live) + sample_k.shape[2:],
+                      sample_k.dtype)
+        hv = np.zeros((sample_v.shape[0], n_live) + sample_v.shape[2:],
+                      sample_v.dtype)
+        for idx, (j, _p) in enumerate(hot):
+            hk[:, j] = hk_hot[:, idx]
+            hv[:, j] = hv_hot[:, idx]
+        for c, (ik, iv) in images.items():
+            if c < n_live:
+                hk[:, c] = ik[:, 0]
+                hv[:, c] = iv[:, 0]
+        return hk, hv
 
     # ------------------------------------------------------------------ #
     # Preemption + host-RAM swap tier (ISSUE 3)
@@ -1685,8 +2216,9 @@ class Engine:
             "rope_delta": int(self.h_rope_delta[victim]),
         }
         if policy == "swap":
-            pages = self._slot_pages[victim][:n_live]
-            hk, hv = self._swap_out_pages(pages)
+            # Spilled cold pages splice in from their host images — the
+            # swap image is byte-exact without re-admitting them first.
+            hk, hv = self._swap_out_slot_span(victim, n_live)
             rec.update({
                 "hk": hk, "hv": hv, "ctx_rows": ctx_rows,
                 "d_tok": int(np.asarray(self.d_tokens)[victim]),
@@ -2122,6 +2654,15 @@ class Engine:
         # under shard_map (ISSUE 7).
         ring_mesh = self.mesh if self.plan.sp > 1 else None
         self._ring_mesh = ring_mesh
+        # Sequence-parallel chunked prefill (ISSUE 14): with sp>1 AND a
+        # paged pool, the chunk programs ring-shard each chunk's attention
+        # over "sp" (parallel/ring.ring_chunk_paged_attention) while K/V
+        # scatters direct-to-page; the pool itself replicates over sp.
+        self._sp_chunk_mesh = (
+            self.mesh
+            if (self._paged and self.plan.sp > 1 and self.ecfg.sp_prefill)
+            else None
+        )
         op_mesh = self._op_mesh
 
         @partial(jax.jit, static_argnames=())
@@ -2422,8 +2963,11 @@ class Engine:
             for j in range(m):  # m is static and small — unrolled
                 s = slot_ids[j]
                 if ptable is not None:
+                    from localai_tpu.ops import ptable as _pt
+
                     cache = llama.write_prefill_to_pool(
-                        cache, ptable[j], ks, vs, j, kv_scale=self._kv_scales
+                        cache, _pt.select_row(ptable, j), ks, vs, j,
+                        kv_scale=self._kv_scales,
                     )
                 else:
                     cache = llama.write_prefill_to_cache(
@@ -2816,8 +3360,12 @@ class Engine:
     @property
     def _chunk_size(self) -> int:
         """Effective chunk size: 0 when chunking is off or prefill runs
-        ring attention (sp>1 — the chunk path has no ring variant)."""
-        return 0 if self._ring_mesh is not None else self.ecfg.prefill_chunk
+        DENSE ring attention (sp>1 without a paged pool — the dense chunk
+        path has no ring variant). Paged sp>1 engines chunk as usual: the
+        chunk programs themselves ring-shard over sp (ISSUE 14)."""
+        if self._ring_mesh is not None and self._sp_chunk_mesh is None:
+            return 0
+        return self.ecfg.prefill_chunk
 
     def _chunk_admit_rows(self, total_len: int, match_len: int) -> int:
         """Exact KV rows a chunked admission writes: the matched prefix,
@@ -2839,7 +3387,7 @@ class Engine:
         prefill); draft engines mirror _cached_admit_ok's exclusions (no
         grammar/logprob final-chunk variant composes with the draft)."""
         C = self._chunk_size
-        if not C or len(request.prompt_ids) - match_len <= C:
+        if not C:
             return False
         if request.image_embeds is not None or request.mrope_positions is not None:
             return False
@@ -2847,6 +3395,20 @@ class Engine:
             # Adapter prompts admit single-shot: the chunk mid/final
             # programs carry no per-slot lora operand (ISSUE 10 keeps the
             # runtime-LoRA surface to admission + decode blocks).
+            return False
+        if (self._paged and self.cfg.attention_window
+                and (match_len or len(request.prompt_ids) > C)):
+            # Windowed+sink paged serving (ISSUE 14): EVERY admission that
+            # attends past one chunk — long prompts and all prefix hits —
+            # must run the chunk programs' masked prefix walk, the one
+            # numeric path the window semantics are defined on. (The
+            # single-shot cached path would gather_pages a possibly-huge
+            # span densely AND attend it unmasked.) Short cold prompts
+            # (<= prefill_chunk <= attention_window) stay single-shot:
+            # every query's window covers the whole prompt, so the mask is
+            # a no-op there and the full-attention program is exact.
+            return True
+        if len(request.prompt_ids) - match_len <= C:
             return False
         if self.draft_cfg is not None and (
             request.grammar is not None or request.logprobs > 0
@@ -2876,13 +3438,16 @@ class Engine:
         S = self.ecfg.max_seq
 
         if self._paged:
+            from localai_tpu.ops import ptable as _pt
+
             def chunk(params, cache, d_positions, toks, aux, table_row):
                 # aux: [chunk_len, slot, offset] i32
                 _, cache = llama.prefill_chunk_paged(
                     cfg, params, toks, aux[0:1], aux[2:3], cache,
-                    table_row[None], ep=self.plan.ep,
+                    _pt.batch_row(table_row), ep=self.plan.ep,
                     paged_impl=self.ecfg.paged_kernel, with_logits=False,
                     mesh=self._op_mesh, kv_scale=self._kv_scales,
+                    sp_mesh=self._sp_chunk_mesh,
                 )
                 d_positions = d_positions.at[aux[1]].set(S - 1)
                 return cache, d_positions, aux
@@ -2976,11 +3541,13 @@ class Engine:
                 top_p=samp_pack[2], min_p=samp_pack[3], repeat_penalty=samp_pack[4],
                 presence_penalty=samp_pack[5], frequency_penalty=samp_pack[6],
             )
+            from localai_tpu.ops import ptable as _pt
+
             logits, cache = llama.prefill_chunk_paged(
                 cfg, params, tail_toks, aux[0:1], aux[3:4], cache,
-                table_row[None], ep=self.plan.ep,
+                _pt.batch_row(table_row), ep=self.plan.ep,
                 paged_impl=self.ecfg.paged_kernel, mesh=self._op_mesh,
-                kv_scale=self._kv_scales,
+                kv_scale=self._kv_scales, sp_mesh=self._sp_chunk_mesh,
             )
             fvalid = (jnp.arange(fbp)[None, :] < (plen + tail_len)).astype(jnp.int32)
             rows = jnp.zeros((1, V), jnp.int32)
@@ -3105,15 +3672,24 @@ class Engine:
                 self._prefix_evict_for_pages(
                     fresh, protect=[entry] if entry is not None else []
                 )
-            if self._pages_alloc(slot_idx, fresh, shared=shared) is None:
+            table_row = self._pages_alloc(
+                slot_idx, fresh, shared=shared,
+                shared_tps=(entry.get("tps")
+                            if (entry is not None and self._hier) else None),
+            )
+            if table_row is None:
                 with self._pending_lock:
                     self._pending.appendleft((request, handle))
                 return False
             # Keep the slot on SCRATCH until the final chunk activates it:
             # decode blocks write every slot every step, and the real table
-            # must not be reachable while this prefill owns the pages.
-            table_row = self.h_ptable[slot_idx].copy()
-            self.h_ptable[slot_idx] = self._scratch_page
+            # must not be reachable while this prefill owns the pages. The
+            # SAVED row (flat page row / hier L1 directory row) rides the
+            # chunk dispatches instead.
+            if self._hier:
+                self.h_l1[slot_idx, :] = self._scratch_tp
+            else:
+                self.h_ptable[slot_idx] = self._scratch_page
         else:
             # Dense cache: pin the idle slot's carried position FIRST (see
             # _get_chunk_pin — blocks dispatched from here on must not stamp
@@ -3197,7 +3773,7 @@ class Engine:
             fn = self._get_chunk_mid(n, None)
             out = fn(self.params, self.cache, self.d_positions,
                      jnp.asarray(toks), jnp.asarray(aux),
-                     jnp.asarray(st["table_row"]))
+                     self._ptable_device_row(st["table_row"]))
         else:
             pwin = self._bucket_for(max(offset, 1))
             fn = self._get_chunk_mid(n, pwin)
@@ -3248,8 +3824,11 @@ class Engine:
             # Publish the real table NOW (loop thread): blocks dispatched
             # from here on — all strictly after this program on the device
             # stream — may read and write the slot's pages.
-            self.h_ptable[slot_idx] = st["table_row"]
-            args = (jnp.asarray(st["table_row"]),)
+            if self._hier:
+                self.h_l1[slot_idx] = st["table_row"]
+            else:
+                self.h_ptable[slot_idx] = st["table_row"]
+            args = (self._ptable_device_row(st["table_row"]),)
         else:
             pb = self._bucket_for(max(offset, 1))
             pk, pv = self._get_snapshot(pb)(self.cache, jnp.int32(slot_idx))
@@ -3317,7 +3896,8 @@ class Engine:
             items=[(slot_idx, request, handle, len(ids), t0)],
         ))
         self._last_admit_t = time.monotonic()
-        self._prefix_save(slot_idx, ids, len(ids))
+        self._prefix_save(slot_idx, ids, len(ids),
+                          min_extend=self.ecfg.prefix_cache_min)
 
     # ------------------------------------------------------------------ #
     # Prompt/prefix KV cache (host side)
@@ -3401,7 +3981,8 @@ class Engine:
             self._snap_cache[pb] = fn
         return fn
 
-    def _prefix_save(self, slot_idx: int, key_tokens, valid_len: int) -> None:
+    def _prefix_save(self, slot_idx: int, key_tokens, valid_len: int,
+                     min_extend: int = 0) -> None:
         """Store the slot's KV rows [0:valid_len] under `key_tokens`.
 
         Called right after an admission dispatch (prompt KV) and at finish
@@ -3425,6 +4006,43 @@ class Engine:
             if n_pages * page_bytes > self.ecfg.prefix_cache_bytes:
                 return
         key = np.asarray(key_tokens, np.int32)[:valid_len]
+        # Skip saves that barely extend existing coverage (min_extend > 0 —
+        # the ADMISSION-side callers). Every cached HIT used to re-save its
+        # freshly-assembled prompt span: the new span out-keyed the stored
+        # one by a couple of tail tokens, so each warm admit queued a
+        # full-bucket device snapshot (dense) or re-pinned the span's pages
+        # (paged) ahead of the next request's program — asymmetric standing
+        # device work a cold MISS never paid, which is what put BENCH_r04's
+        # dense prefix_ttft_speedup at 0.34 (a HIT slower than a MISS). An
+        # admission-side span must now add at least prefix_cache_min tokens
+        # of new coverage to be worth storing — the same floor that gates a
+        # span's minimum size. Finish-time saves pass min_extend=0: the
+        # generated-KV suffix is NEW information (multi-turn reuse) however
+        # short it is.
+        if min_extend:
+            cov = 0
+            for e in self._prefix_entries:
+                n = min(e["valid"], valid_len)
+                if n <= cov:
+                    continue
+                eq = e["key"][:n] == key[:n]
+                cov = max(cov, n if eq.all() else int(np.argmin(eq)))
+            if cov and valid_len - cov < min_extend:
+                return
+        if self._paged and self._slot_spill[slot_idx]:
+            # Cold pages were spilled off-device — a span can only pin HOT
+            # pages. Restore them byte-exactly first; on pool pressure (or
+            # an injected page_spill fault) skip the save: the request is
+            # already finished, a missing span just means re-prefill later.
+            try:
+                restored = self._restore_spilled(slot_idx)
+            except Exception as e:  # noqa: BLE001 — degrade to no-save
+                self._jnote_fault(e)
+                if not isinstance(e, faults.InjectedFault):
+                    log.exception("spill restore failed (slot %d)", slot_idx)
+                restored = False
+            if not restored:
+                return
         # Skip if an existing entry already covers this span; drop entries
         # this span subsumes.
         kept = []
@@ -3452,7 +4070,13 @@ class Engine:
                 self._prefix_entries = kept
                 return  # slot reservation shorter than the span (shouldn't happen)
             self._pages_addref(pages)
-            kept.insert(0, {"key": key, "valid": valid_len, "pages": list(pages)})
+            entry_new = {"key": key, "valid": valid_len, "pages": list(pages)}
+            if self._hier:
+                # Directory half of CoW span sharing (ISSUE 14): the entry
+                # pins the slot's table pages covering the span, so later
+                # admissions map the L1 chunks by addref.
+                entry_new["tps"] = self._entry_tps(slot_idx, n_pages)
+            kept.insert(0, entry_new)
             while len(kept) > self.ecfg.prefix_cache_entries:
                 self._prefix_drop(kept.pop())
             budget = self.ecfg.prefix_cache_bytes // max(
@@ -3486,10 +4110,14 @@ class Engine:
 
     def _prefix_drop(self, entry: dict) -> None:
         """Release one prefix entry's resources (paged entries hold page
-        refcounts; dense snapshots just GC)."""
+        refcounts — and table-page refcounts under hierarchical tables;
+        dense snapshots just GC)."""
         if self._paged and "pages" in entry:
             self._pages_release(entry["pages"])
             entry["pages"] = []
+        if self._hier and entry.get("tps"):
+            self._tp_release(entry["tps"])
+            entry["tps"] = []
 
     def _prefix_evict_for_pages(self, need: int,
                                 protect: Optional[list] = None) -> None:
@@ -3552,6 +4180,8 @@ class Engine:
         self._swap_in_pages(pages, hentry["hk"], hentry["hv"])
         entry = {"key": hentry["key"], "valid": hentry["valid"],
                  "pages": pages}
+        if self._hier:
+            entry["tps"] = self._entry_tps_for_pages(pages)
         self._prefix_entries.insert(0, entry)
         while len(self._prefix_entries) > self.ecfg.prefix_cache_entries:
             dead = self._prefix_entries.pop()
@@ -3771,6 +4401,13 @@ class Engine:
             # both gate on _cached_admit_ok); direct callers get the same
             # full-admission answer.
             return "full"
+        if self._paged and self.cfg.attention_window:
+            # Windowed+sink paged serving routes every hit through the
+            # chunk programs (_chunkable); a hit found late (saved after
+            # planning) degrades to full single-shot admission — by then
+            # the prompt is <= prefill_chunk <= attention_window, where
+            # the window mask is a no-op and full attention is exact.
+            return "full"
         fbp = self._bucket_for(len(ids))  # full-prompt bucket (count row/draft)
         paged_alloc: Optional[np.ndarray] = None
         if self._paged and "hk" in entry:
@@ -3791,7 +4428,10 @@ class Engine:
             # On-demand (ISSUE 3): only the tail bucket + headroom; decode
             # growth allocates the rest as the context actually extends.
             fresh = self._pages_needed_cached(request, match_len)
-            paged_alloc = self._pages_alloc(slot_idx, fresh, shared=shared)
+            paged_alloc = self._pages_alloc(
+                slot_idx, fresh, shared=shared,
+                shared_tps=(entry.get("tps") if self._hier else None),
+            )
             if paged_alloc is None:
                 return False  # pool pressure — full admission will backpressure
         tail_toks = np.zeros((1, tb), np.int32)
@@ -3821,8 +4461,10 @@ class Engine:
             key = ("cached-paged", npg, tb, fbp, has_bias, with_topk, with_lp,
                    with_dfa, draft)
             getter = self._get_admit_cached_paged
+            row = (self.h_l1[slot_idx] if self._hier
+                   else self.h_ptable[slot_idx])
             args = (
-                jnp.asarray(pages_arr), jnp.asarray(self.h_ptable[slot_idx]),
+                jnp.asarray(pages_arr), self._ptable_device_row(row),
             )
         else:
             key = ("cached", entry["pb"], tb, fbp, has_bias, with_topk,
@@ -3938,8 +4580,10 @@ class Engine:
         ))
         self._last_admit_t = time.monotonic()
         # The freshly-assembled prompt span is itself the best prefix for the
-        # next request in the conversation.
-        self._prefix_save(slot_idx, ids, len(ids))
+        # next request in the conversation — but only if it extends stored
+        # coverage enough to beat the snapshot it costs (min_extend).
+        self._prefix_save(slot_idx, ids, len(ids),
+                          min_extend=self.ecfg.prefix_cache_min)
         return True
 
     def _get_spec_block(self, mode: str, kb: int, with_dfa=False,
@@ -4532,6 +5176,19 @@ class Engine:
             out["kv_host_tier_bytes"] = float(self._host_bytes)
             out["prefix_host_tier_entries"] = float(len(self._prefix_host))
             out["prefix_host_tier_hits"] = float(self.m_prefix_host_hits)
+            if self._spill_on or self.m_kv_pages_spilled:
+                # Cold-page spill (ISSUE 14): live spilled pages + churn.
+                out["kv_spilled_pages"] = float(
+                    sum(len(d) for d in self._slot_spill)
+                )
+                out["kv_spill_host_bytes"] = float(self._spill_bytes)
+                out["kv_spill_bytes_out"] = float(self.m_kv_spill_bytes_out)
+                out["kv_spill_bytes_in"] = float(self.m_kv_spill_bytes_in)
+                out["kv_pages_spilled"] = float(self.m_kv_pages_spilled)
+                out["kv_pages_restored"] = float(self.m_kv_pages_restored)
+            if self._hier:
+                out["kv_table_pages_total"] = float(len(self._tp_refs) - 1)
+                out["kv_table_pages_free"] = float(len(self._tp_free))
             # Cluster span transfer (ISSUE 6): disaggregation hand-offs.
             out["span_exports"] = float(self.m_span_exports)
             out["span_imports"] = float(self.m_span_imports)
@@ -4683,7 +5340,7 @@ class Engine:
         if self._mrope:
             args = args + (jnp.asarray(self.h_rope_delta),)
         if self._paged:
-            args = args + (jnp.asarray(self.h_ptable),)
+            args = args + (self._ptable_device(),)
         (
             self.cache, self.counts, self.rngs, self.d_tokens, self.d_positions,
             toks, _tk, _lp,
@@ -4705,8 +5362,15 @@ class Engine:
         )
         if self._paged:
             # Warm against the scratch page so throwaway writes land nowhere.
-            args = args + (jnp.full((m, self._max_pages), self._scratch_page,
-                                    jnp.int32),)
+            if self._hier:
+                args = args + ((
+                    jnp.full((m, self._ml1), self._scratch_tp, jnp.int32),
+                    jnp.asarray(self.h_l0),
+                ),)
+            else:
+                args = args + (jnp.full(
+                    (m, self._max_pages), self._scratch_page, jnp.int32
+                ),)
         if self.draft_cfg is None:
             (
                 self.cache, self.counts, self.rngs, self.bias,
@@ -5032,12 +5696,16 @@ class Engine:
         if len(self._adapter_refs):
             self._adapter_refs[:] = 0
         if self._paged:
-            # Prefix spans hold pool-page references; the reloaded engine
+            # Prefix spans hold pool-page references (and table-page
+            # references under hierarchical tables); the reloaded engine
             # starts cold anyway.
             for entry in self._prefix_entries:
                 if entry.get("pages"):
                     self._pages_release(entry["pages"])
+                if self._hier and entry.get("tps"):
+                    self._tp_release(entry["tps"])
         self._prefix_entries = []
+        self._spill_bytes = 0
         self._prefix_host = []
         self._host_bytes = 0
         # Staged span imports can never merge now — unblock their waiters
@@ -5139,6 +5807,11 @@ class Engine:
             # blocks and prefill chunks instead of stalling every live slot
             # behind a monolithic long-prompt prefill.
             self._advance_chunked()
+
+            # Cold-page spill tick (ISSUE 14): pages that fell out of every
+            # live query's sink+window move to the host tier, bounded per
+            # iteration so the copy never stalls dispatch.
+            self._spill_cold_pages()
 
             if self._inflight:
                 front = self._inflight[0]
@@ -5583,7 +6256,9 @@ class Engine:
             )
         allocated_slots: list[int] = []
         if self._paged:
-            rows_tbl = np.zeros((m, self._max_pages), np.int32)
+            rows_tbl = np.zeros(
+                (m, self._ml1 if self._hier else self._max_pages), np.int32
+            )
             for j, (r, _h) in enumerate(chunk):
                 prow = self._pages_alloc(slot_ids[j], self._pages_needed(r))
                 if prow is None:
@@ -5602,7 +6277,12 @@ class Engine:
                     return
                 allocated_slots.append(slot_ids[j])
                 rows_tbl[j] = prow
-            args_in = args_in + (jnp.asarray(rows_tbl),)
+            if self._hier:
+                args_in = args_in + (
+                    (jnp.asarray(rows_tbl), jnp.asarray(self.h_l0)),
+                )
+            else:
+                args_in = args_in + (jnp.asarray(rows_tbl),)
         if with_lora:
             args_in = args_in + (
                 self._lora_tree, jnp.asarray(adapter_rows, dtype=jnp.int32),
@@ -5676,7 +6356,8 @@ class Engine:
                 # Adapter slots never feed the prefix cache: their K/V rows
                 # are tenant-specific (wk/wv deltas), so a token-keyed span
                 # would leak one tenant's KV into another's admission.
-                self._prefix_save(slot_idx, r.prompt_ids, int(aux[0, j]))
+                self._prefix_save(slot_idx, r.prompt_ids, int(aux[0, j]),
+                                  min_extend=self.ecfg.prefix_cache_min)
         self._track(
             _Entry(kind="admit", toks=toks, tk=tk, lp=lp, gen=list(self._slot_gen), items=items)
         )
@@ -5828,7 +6509,7 @@ class Engine:
         if self._mrope:
             args = args + (jnp.asarray(self.h_rope_delta),)
         if self._paged:
-            args = args + (jnp.asarray(self.h_ptable),)
+            args = args + (self._ptable_device(),)
         lora_args = (
             (self._lora_tree, jnp.asarray(self.h_adapter)) if with_lora else ()
         )
@@ -6059,7 +6740,7 @@ class Engine:
         if mode == "prompt_lookup":
             args = args + (jnp.asarray(drafts),)
         if self._paged:
-            args = args + (jnp.asarray(self.h_ptable),)
+            args = args + (self._ptable_device(),)
         if with_dfa:
             d = self._dfa
             args = args + (d["mask_bits"], self._dfa_table(d, with_dfa),
@@ -6440,10 +7121,16 @@ class Engine:
                 and slot.request.adapter is None):
             # Rows for prompt + all but the last generated token are
             # guaranteed written (a token's KV row lands when it is consumed
-            # as the next step's input).
+            # as the next step's input). A span that carries generated rows
+            # is NEW information (multi-turn reuse — always save); one that
+            # doesn't is a re-keyed copy of the prompt span the admission
+            # already ruled on, so it takes the same min-extension bar.
             valid = slot.prompt_len + max(0, len(slot.generated) - 1)
             self._prefix_save(
-                slot_idx, list(slot.request.prompt_ids) + slot.generated, valid
+                slot_idx, list(slot.request.prompt_ids) + slot.generated,
+                valid,
+                min_extend=(0 if valid > slot.prompt_len
+                            else self.ecfg.prefix_cache_min),
             )
         now = time.monotonic()
         t_first = slot.t_first or now

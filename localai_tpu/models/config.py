@@ -150,6 +150,16 @@ class ArchConfig:
     # EngineConfig.self_draft_layers knob reaches the layer-scan helpers
     # through `dataclasses.replace(cfg, self_draft_layers=...)`.
     self_draft_layers: int = 0
+    # Windowed+sink long-context serving (ISSUE 14, docs/LONG_CONTEXT.md):
+    # when attention_window > 0, decode (and the chunked-prefill prefix
+    # walk under the paged pool) attends only rows with position < sink or
+    # within `attention_window` of the query — StreamingLLM-style, with
+    # ABSOLUTE rope positions (rows keep their original positions; no
+    # re-rope). Lives on ArchConfig like quant_kernel: the engine's
+    # EngineConfig knobs reach every attention call through
+    # `dataclasses.replace(cfg, ...)`. 0/0 = full attention (default).
+    attention_sink: int = 0
+    attention_window: int = 0
 
     @property
     def head_dim_(self) -> int:

@@ -1034,7 +1034,8 @@ def decode_step_windowed(
                 q, kc, vc, ptable, lk, lv, k, v, positions, step,
                 softcap=cfg.attn_softcap, window=cfg.sliding_window,
                 sliding=_layer_sliding(cfg, li), impl=paged_impl, mesh=mesh,
-                kv_scale=kv_scale,
+                kv_scale=kv_scale, sink=cfg.attention_sink,
+                swin=cfg.attention_window,
             )
         elif use_sp:
             from localai_tpu.ops.attention import decode_attention_windowed_sp
@@ -1042,13 +1043,15 @@ def decode_step_windowed(
             attn = decode_attention_windowed_sp(
                 q, kc, vc, lk, lv, k, v, positions, step, mesh,
                 softcap=cfg.attn_softcap, window=cfg.sliding_window,
-                sliding=_layer_sliding(cfg, li),
+                sliding=_layer_sliding(cfg, li), sink=cfg.attention_sink,
+                swin=cfg.attention_window,
             )
         else:
             attn = decode_attention_windowed(
                 q, kc, vc, lk, lv, k, v, positions, step,
                 softcap=cfg.attn_softcap, window=cfg.sliding_window,
-                sliding=_layer_sliding(cfg, li),
+                sliding=_layer_sliding(cfg, li), sink=cfg.attention_sink,
+                swin=cfg.attention_window,
             )
         h = h + _attn_out(cfg, lp, attn.reshape(B, -1), mesh, lora=llora)
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
@@ -1407,12 +1410,14 @@ def write_block_to_pool(
     idle slots and rows past a slot's reservation resolve through the
     engine's SCRATCH-filled table entries to a page nobody attends, so they
     can never corrupt a live request."""
+    from localai_tpu.ops import ptable as _pt
+
     L, B, n = local_k.shape[:3]
     page = pool.k.shape[2]
-    MP = table.shape[1]
+    MP = _pt.width(table)
     row = jnp.minimum(start_positions[:, None] + jnp.arange(n)[None, :],
                       MP * page - 1)  # [B, n]
-    pid = jnp.take_along_axis(table, row // page, axis=1)  # [B, n]
+    pid = _pt.gather_cols(table, row // page)  # [B, n]
     off = row % page
     ks = None if kv_scale is None else kv_scale[0]
     vs = None if kv_scale is None else kv_scale[1]
@@ -1434,10 +1439,12 @@ def write_chunk_to_pool(
     the table like write_block_to_pool — rejected-window overshoot rows land
     in later pages of the same slot's reservation and are overwritten by the
     next round's writes at the same positions."""
+    from localai_tpu.ops import ptable as _pt
+
     page = pool.k.shape[2]
-    MP = table.shape[1]
+    MP = _pt.width(table)
     row = jnp.minimum(positions, MP * page - 1)  # [B, T]
-    pid = jnp.take_along_axis(table, row // page, axis=1)  # [B, T]
+    pid = _pt.gather_cols(table, row // page)  # [B, T]
     off = row % page
     ks = None if kv_scale is None else kv_scale[0]
     vs = None if kv_scale is None else kv_scale[1]
@@ -1457,11 +1464,13 @@ def write_rows_to_pool(
     """Scatter R contiguous rows starting at `start_row` into one slot's
     pages (cached-admission tail rows, which start mid-sequence and are not
     page-aligned)."""
+    from localai_tpu.ops import ptable as _pt
+
     R = ks.shape[2]
     page = pool.k.shape[2]
-    MP = table_row.shape[0]
+    MP = _pt.width(table_row)
     row = jnp.minimum(start_row + jnp.arange(R), MP * page - 1)  # [R]
-    pid = table_row[row // page]  # [R]
+    pid = _pt.row_lookup(table_row, row // page)  # [R]
     off = row % page
     ksc = None if kv_scale is None else kv_scale[0]
     vsc = None if kv_scale is None else kv_scale[1]
@@ -1500,14 +1509,23 @@ def prefill_chunk_paged(
     lengths: jnp.ndarray,  # [B] int32 valid chunk lengths
     offsets: jnp.ndarray,  # [B] int32 rows already resident (chunk starts here)
     pool: KVCache,
-    table: jnp.ndarray,  # [B, MP] int32 page tables (prefix + destination pages)
+    table,  # [B, MP] int32 page tables (prefix + destination pages), or
+    # the hierarchical (l1, l0) pair (ops/ptable)
     ep: int = 1,
     paged_impl: str = "auto",
     with_logits: bool = True,
     mesh=None,  # Mesh with tp>1 → paged Pallas kernel head-sharded
     kv_scale=None,  # [2, K] f32 per-head (k, v) pool dequant scales (fp8 KV)
+    sp_mesh=None,  # Mesh with sp>1 → the chunk's attention runs ring-
+    # sharded over "sp" (parallel/ring.ring_chunk_paged_attention): each
+    # shard holds T/sp chunk tokens, walks the slot's resident pages for
+    # its own queries (pool replicated over sp) and rotates the in-chunk
+    # K/V blocks neighbor-to-neighbor — per-chip chunk compute is T/sp
+    # while the fresh K/V still scatters straight into pool pages
 ):
-    """One chunk of a ragged chunked prefill, direct-to-page (ISSUE 2).
+    """One chunk of a ragged chunked prefill, direct-to-page (ISSUE 2;
+    sequence-parallel leg + windowed+sink prefix walk: ISSUE 14,
+    docs/LONG_CONTEXT.md).
 
     Chunk token t attends the slot's already-written rows [0, offsets[b])
     through the paged-partials walk — the same scalar-prefetch page-table
@@ -1564,6 +1582,20 @@ def prefill_chunk_paged(
         q, k, v = _attn_proj_qkv(cfg, lp, x, mesh)  # q [B,T,H,Hd], k/v [B,T,K,Hd]
         q = apply_rope(q, positions, inv)
         k = apply_rope(k, positions, inv)
+        if sp_mesh is not None:
+            # Sequence-parallel chunk attention (ISSUE 14): ring over "sp".
+            from localai_tpu.parallel.ring import ring_chunk_paged_attention
+
+            attn = ring_chunk_paged_attention(
+                q, k, v, offsets, lengths, kc, vc, table, sp_mesh,
+                softcap=cfg.attn_softcap, window=cfg.sliding_window,
+                sliding=sliding, sink=cfg.attention_sink,
+                swin=cfg.attention_window, kv_scale=kv_scale,
+            ).reshape(B, T, -1).astype(h.dtype)
+            h = h + _attn_out(cfg, lp, attn, mesh)
+            x = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
+            h = h + _mlp_out(cfg, lp, x, ep, mesh)
+            return h, (k, v)
         wmask = causal[None] & length_mask[:, None, :]  # [B, T, T]
         if cfg.sliding_window and sliding is not None:
             wmask = wmask & (~sliding | (win_dist[None] < cfg.sliding_window))
@@ -1571,7 +1603,8 @@ def prefill_chunk_paged(
             q, kc, vc, table, offsets,
             softcap=cfg.attn_softcap, window=cfg.sliding_window,
             sliding=sliding, q_pos=positions, impl=paged_impl, mesh=mesh,
-            kv_scale=kv_scale,
+            kv_scale=kv_scale, sink=cfg.attention_sink,
+            swin=cfg.attention_window,
         )
         attn = _merge_partials_mq(
             q, acc, m, l, k, v, wmask, softcap=cfg.attn_softcap,
@@ -1625,6 +1658,8 @@ def write_prefill_to_pool(
     row 0, so writes are page-aligned; the (static) trailing partial page
     writes whatever fits. Chunked admission (EngineConfig.prefill_chunk)
     bypasses this dense-bucket scatter entirely — see prefill_chunk_paged."""
+    from localai_tpu.ops import ptable as _pt
+
     Sb = ks.shape[2]
     page = pool.k.shape[2]
     k, v = pool.k, pool.v
@@ -1636,10 +1671,10 @@ def write_prefill_to_pool(
         chunk_v = vs[:, j, lo: lo + page]
         k = jax.lax.dynamic_update_slice(
             k, _pool_store(chunk_k, k.dtype, ksc)[:, None],
-            (0, table_row[p], 0, 0, 0)
+            (0, _pt.row_lookup(table_row, p), 0, 0, 0)
         )
         v = jax.lax.dynamic_update_slice(
             v, _pool_store(chunk_v, v.dtype, vsc)[:, None],
-            (0, table_row[p], 0, 0, 0)
+            (0, _pt.row_lookup(table_row, p), 0, 0, 0)
         )
     return KVCache(k=k, v=v)

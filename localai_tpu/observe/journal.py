@@ -52,6 +52,10 @@ BASE_EVENTS = (
     "profile",       # a jax.profiler capture window ran (a=seconds)
     "spec_draft",    # verify round dispatched (a=drafted tokens, b=window)
     "spec_verify",   # verify round processed (a=drafted, b=emitted tokens)
+    "page_spill",    # cold middle pages copied to host, device pages freed
+    #                  (slot, a=pages, b=bytes; docs/LONG_CONTEXT.md)
+    "page_restore",  # spilled pages swapped back into fresh pool pages
+    #                  (slot, a=pages, b=bytes)
 )
 
 # One journal event type per fault-injection site (faults.SITES), checked
@@ -71,6 +75,7 @@ FAULT_EVENTS = (
     "fault_collective_dispatch",
     "fault_adapter_fetch",
     "fault_spec_verify",
+    "fault_page_spill",
 )
 
 EVENTS = BASE_EVENTS + FAULT_EVENTS

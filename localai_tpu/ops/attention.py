@@ -204,6 +204,8 @@ def decode_attention_windowed(
     softcap: float = 0.0,
     window: int = 0,
     sliding=None,  # traced bool scalar: this layer uses the sliding window
+    sink: int = 0,  # windowed+sink decode (docs/LONG_CONTEXT.md): rows
+    swin: int = 0,  # attended iff gpos < sink or q_pos - gpos < swin
 ) -> jnp.ndarray:
     """Decode attention over `cache[0:block_start] ⊕ local[0:step] ⊕ current`.
 
@@ -229,6 +231,9 @@ def decode_attention_windowed(
         # q position is `positions`; cache row s sits at position s.
         dist_c = positions[:, None] - jnp.arange(S)[None, :]
         valid_c = valid_c & (~sliding | (dist_c < window))
+    if swin:
+        dist_c = positions[:, None] - jnp.arange(S)[None, :]
+        valid_c = valid_c & ((jnp.arange(S)[None, :] < sink) | (dist_c < swin))
     sc = jnp.where(valid_c[:, None, None, :], sc, NEG_INF)
     sl = jnp.einsum("bkgd,bnkd->bkgn", qf, k_local.astype(jnp.float32))
     if softcap:
@@ -237,7 +242,12 @@ def decode_attention_windowed(
     if window and sliding is not None:
         # local row i sits at distance step - i from the current token.
         valid_l = valid_l & (~sliding | ((step - jnp.arange(n)) < window))
-    sl = jnp.where(valid_l[None, None, None, :], sl, NEG_INF)
+    valid_l = jnp.broadcast_to(valid_l[None, :], (B, n))
+    if swin:
+        dist_l = (step - jnp.arange(n))[None, :]
+        gpos_l = positions[:, None] - dist_l
+        valid_l = valid_l & ((gpos_l < sink) | (dist_l < swin))
+    sl = jnp.where(valid_l[:, None, None, :], sl, NEG_INF)
     cur = jnp.einsum("bkgd,bkd->bkg", qf, k_new.astype(jnp.float32))[..., None]
     if softcap:
         cur = softcap_scores(cur, softcap)
@@ -252,7 +262,7 @@ def decode_attention_windowed(
 
 def _sp_cache_partials(q, k_cache, v_cache, limits, mesh,
                        softcap: float = 0.0, window: int = 0, sliding=None,
-                       q_pos=None):
+                       q_pos=None, sink: int = 0, swin: int = 0):
     """Online-softmax partial attention over an "sp"-sharded cache.
 
     The KV cache's sequence axis is sharded over the mesh's "sp" axis (see
@@ -295,6 +305,9 @@ def _sp_cache_partials(q, k_cache, v_cache, limits, mesh,
         if window and sliding is not None:
             dist = qp[:, None] - gpos[None, :]
             valid = valid & (~sl | (dist < window))
+        if swin:
+            dist = qp[:, None] - gpos[None, :]
+            valid = valid & ((gpos[None, :] < sink) | (dist < swin))
         sc = jnp.where(valid[:, None, None, :], sc, NEG_INF)
         m = jnp.max(sc, axis=-1, keepdims=True)
         p = jnp.exp(sc - m)  # exp(NEG_INF - NEG_INF) rows zeroed by valid below
@@ -401,6 +414,8 @@ def decode_attention_windowed_sp(
     softcap: float = 0.0,
     window: int = 0,
     sliding=None,
+    sink: int = 0,  # windowed+sink decode (docs/LONG_CONTEXT.md)
+    swin: int = 0,
 ) -> jnp.ndarray:
     """`decode_attention_windowed` for an sp-sharded cache: sharded partials
     over cache[0:block_start], dense merge of the block-local window and the
@@ -409,6 +424,7 @@ def decode_attention_windowed_sp(
     acc_g, m_g, l_g = _sp_cache_partials(
         q, k_cache, v_cache, positions - step, mesh,
         softcap=softcap, window=window, sliding=sliding, q_pos=positions,
+        sink=sink, swin=swin,
     )
     # f32 concat: the block-local window may live in the cache's storage
     # dtype (fp8 KV) while the current token is model-dtype.
@@ -425,6 +441,12 @@ def decode_attention_windowed_sp(
         # n << window — but the mask keeps the semantics exact.)
         dist = jnp.concatenate([step - jnp.arange(n), jnp.zeros((1,), jnp.int32)])
         mask = mask & (~sliding | (dist < window))
+    if swin:
+        dist = jnp.concatenate(
+            [step - jnp.arange(n), jnp.zeros((1,), jnp.int32)]
+        )[None, :]
+        gpos = positions[:, None] - dist
+        mask = mask[None, :] & ((gpos < sink) | (dist < swin))
     return _merge_partials(q, acc_g, m_g, l_g, ek, ev, mask, softcap=softcap)
 
 
@@ -458,9 +480,30 @@ def decode_attention(
 # --------------------------------------------------------------------------- #
 
 
+def _sink_window_cols(limits, q_min, page, MP, sink, swin):
+    """Per-slot walk plan for windowed+sink attention (ISSUE 14): page
+    columns outside `[0, ceil(sink/page)) ∪ [win_lo, np_live)` can never be
+    attended (a row is live iff `gpos < sink` or `q_pos - gpos < swin`, and
+    q_pos only grows), so the walk skips them entirely — the whole point of
+    spilling cold middle pages to the host tier. Returns (sink_cols [B],
+    win_lo [B], n_cols [B]): column j of the walk maps to table column
+    `j < sink_cols ? j : j + (win_lo - sink_cols)`.
+
+    Skipping is EXACT, not approximate: a skipped page's scores would be
+    NEG_INF under the mask, contributing zero to (acc, l) and leaving m
+    unchanged — identical online-softmax state either way."""
+    sink_pages = -(-sink // page) if sink else 0
+    np_live = jnp.minimum((limits + page - 1) // page, MP)
+    sink_cols = jnp.minimum(sink_pages, np_live)
+    win_lo = jnp.clip((q_min - swin + 1) // page, 0, np_live)
+    win_lo = jnp.maximum(win_lo, sink_cols)
+    return sink_cols, win_lo, sink_cols + np_live - win_lo
+
+
 def _paged_cache_partials(q, k_pool, v_pool, table, limits,
                           softcap: float = 0.0, window: int = 0, sliding=None,
-                          q_pos=None, kv_scale=None):
+                          q_pos=None, kv_scale=None, sink: int = 0,
+                          swin: int = 0):
     """Online-softmax partials over a paged cache — the static-shape TPU
     answer to ragged/paged KV (SURVEY §7; reference: llama.cpp's per-slot
     contiguous cache, vLLM's PagedAttention): HBM holds one shared page pool
@@ -471,10 +514,16 @@ def _paged_cache_partials(q, k_pool, v_pool, table, limits,
     in the batch (ceil(max(limits)/page/CH)), so per-step bandwidth scales
     with what is actually resident, not max_seq.
 
-    q: [B, H, D]; k/v_pool: [P, page, K, D]; table: [B, MP] int32 page ids;
+    q: [B, H, D]; k/v_pool: [P, page, K, D]; table: [B, MP] int32 page ids,
+    or the hierarchical (l1, l0) pair (ops/ptable — a 1M-token slot's table
+    resolves through an L1 directory instead of one giant row);
     limits: [B] — rows with global index >= limits[b] are masked.
     softcap/window/sliding: gemma-2 semantics (softcap BEFORE masking;
     sliding layers mask rows further than `window` below `q_pos` [B]).
+    sink/swin: engine-level windowed+sink decode (docs/LONG_CONTEXT.md) —
+    a row is attended iff `gpos < sink` or `q_pos - gpos < swin`; the walk
+    additionally SKIPS page columns that are entirely masked (cold middle
+    pages — possibly spilled off-device), per slot.
     kv_scale: optional [2, K] f32 per-head (k, v) dequant scales for a
     scaled fp8 pool (ISSUE 9) — applied to the gathered tile right at the
     convert, so XLA fuses cast+scale into the einsum's operand load and the
@@ -483,11 +532,13 @@ def _paged_cache_partials(q, k_pool, v_pool, table, limits,
     Returns (acc [B, K, G, D], m [B, K, G, 1], l [B, K, G, 1]) f32, scale
     applied.
     """
+    from localai_tpu.ops import ptable as _pt
+
     B, H, D = q.shape
     page = k_pool.shape[1]
     K = k_pool.shape[2]
     G = H // K
-    MP = table.shape[1]
+    MP = _pt.width(table)
     scale = 1.0 / (D**0.5)
     qf = (q.astype(jnp.float32) * scale).reshape(B, K, G, D)
     if q_pos is None:
@@ -499,12 +550,23 @@ def _paged_cache_partials(q, k_pool, v_pool, table, limits,
     # 32k context is 256 sequential iterations PER LAYER (measured ~2 tok/s
     # at 32k bs1). Chunking turns that into 32 steps of MXU-sized work.
     CH = min(8, MP)
+    if swin:
+        sink_cols, win_lo, n_cols = _sink_window_cols(
+            limits, q_pos, page, MP, sink, swin
+        )
 
     def body(p, carry):
         m, l, acc = carry
-        cols = p * CH + jnp.arange(CH)  # [CH] table columns this step
-        col_ok = cols < MP
-        pids = table[:, jnp.minimum(cols, MP - 1)]  # [B, CH]
+        j = p * CH + jnp.arange(CH)  # [CH] walk columns this step
+        if swin:
+            # Cold-middle skip: remap walk column → table column per slot.
+            cols = jnp.where(j[None, :] < sink_cols[:, None], j[None, :],
+                             j[None, :] + (win_lo - sink_cols)[:, None])
+            col_ok = j[None, :] < n_cols[:, None]  # [B, CH]
+        else:
+            cols = jnp.broadcast_to(j[None, :], (B, CH))
+            col_ok = jnp.broadcast_to((j < MP)[None, :], (B, CH))
+        pids = _pt.gather_cols(table, jnp.minimum(cols, MP - 1))  # [B, CH]
         kp = k_pool[pids].astype(jnp.float32)  # [B, CH, page, K, D]
         vp = v_pool[pids].astype(jnp.float32)
         if kv_scale is not None:  # in-register fp8 dequant (fused into cast)
@@ -517,11 +579,14 @@ def _paged_cache_partials(q, k_pool, v_pool, table, limits,
             sc = softcap_scores(sc, softcap)
         # global rows covered by this chunk (clamped duplicate columns are
         # masked out via col_ok, never double-counted)
-        gpos = (cols[:, None] * page + jnp.arange(page)[None, :]).reshape(-1)
-        valid = (gpos[None, :] < limits[:, None]) & jnp.repeat(col_ok, page)[None, :]
+        gpos = (cols[:, :, None] * page
+                + jnp.arange(page)[None, None, :]).reshape(B, -1)
+        valid = (gpos < limits[:, None]) & jnp.repeat(col_ok, page, axis=1)
         if window and sliding is not None:
-            dist = q_pos[:, None] - gpos[None, :]
+            dist = q_pos[:, None] - gpos
             valid = valid & (~sliding | (dist < window))
+        if swin:
+            valid = valid & ((gpos < sink) | ((q_pos[:, None] - gpos) < swin))
         sc = jnp.where(valid[:, None, None, :], sc, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(sc, axis=-1, keepdims=True))
         alpha = jnp.exp(jnp.maximum(m - m_new, -80.0))
@@ -534,9 +599,12 @@ def _paged_cache_partials(q, k_pool, v_pool, table, limits,
     m0 = jnp.full((B, K, G, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, K, G, 1), jnp.float32)
     a0 = jnp.zeros((B, K, G, D), jnp.float32)
-    p_hi = jnp.minimum(
-        (jnp.max(limits) + page - 1) // page, MP
-    ).astype(jnp.int32)
+    if swin:
+        p_hi = jnp.max(n_cols).astype(jnp.int32)
+    else:
+        p_hi = jnp.minimum(
+            (jnp.max(limits) + page - 1) // page, MP
+        ).astype(jnp.int32)
     ch_hi = (p_hi + CH - 1) // CH
     m, l, acc = jax.lax.fori_loop(0, ch_hi, body, (m0, l0, a0))
     return acc, m, l
@@ -555,6 +623,8 @@ def _paged_pallas_sharded(kernel_fn, mesh, q, k_pool, v_pool, table, limits,
     not valid under shard_map)."""
     from jax.sharding import PartitionSpec as P
 
+    from localai_tpu.ops import ptable as _pt
+
     sl_in = sliding if sliding is not None else jnp.zeros((), bool)
     # kv scales ride sharded on their head axis like the pool itself; ones
     # when the pool is unscaled (the kernel's multiply is exact identity).
@@ -568,13 +638,16 @@ def _paged_pallas_sharded(kernel_fn, mesh, q, k_pool, v_pool, table, limits,
 
     q_spec = P(None, None, "tp", None) if mq else P(None, "tp", None)
     qp_spec = P(None, None) if mq else P(None)
+    # Flat tables are one replicated [B, MP] operand; the hierarchical pair
+    # replicates both levels (host-built i32 control state, KBs).
+    tbl_spec = _pt.shard_spec(table, P(None, None), P(None, None))
     out_specs = tuple(
         P(None, "tp", *([None] * (3 if mq else 2))) for _ in range(3)
     )
     fn = _head_shard_map(
         local, mesh,
         in_specs=(q_spec, P(None, None, "tp", None), P(None, None, "tp", None),
-                  P(None, None), P(None), qp_spec, P(), P(None, "tp")),
+                  tbl_spec, P(None), qp_spec, P(), P(None, "tp")),
         out_specs=out_specs,
     )
     return fn(q, k_pool, v_pool, table, limits, q_pos, sl_in, kvs)
@@ -582,7 +655,8 @@ def _paged_pallas_sharded(kernel_fn, mesh, q, k_pool, v_pool, table, limits,
 
 def paged_partials(q, k_pool, v_pool, table, limits, softcap: float = 0.0,
                    window: int = 0, sliding=None, q_pos=None,
-                   impl: str = "auto", mesh=None, kv_scale=None):
+                   impl: str = "auto", mesh=None, kv_scale=None,
+                   sink: int = 0, swin: int = 0):
     """Paged online-softmax partials, dispatched: the fused Pallas ragged
     paged-attention kernel (ops/paged_flash — pages stream HBM→VMEM once,
     walk bounded per slot) or the XLA gather walk below (reference path and
@@ -590,7 +664,8 @@ def paged_partials(q, k_pool, v_pool, table, limits, softcap: float = 0.0,
     tests exercise the same kernel code that compiles for TPU. With a tp>1
     mesh the Pallas kernel runs head-sharded under shard_map (the XLA walk
     needs nothing — its gathers/einsums partition over the kv-head axis by
-    GSPMD propagation, no collectives)."""
+    GSPMD propagation, no collectives). sink/swin: windowed+sink mask +
+    cold-page skip (ISSUE 14), identical semantics in both backends."""
     import functools
 
     from localai_tpu.ops.paged_flash import paged_decode_partials, use_pallas
@@ -600,7 +675,8 @@ def paged_partials(q, k_pool, v_pool, table, limits, softcap: float = 0.0,
         if _tp_degree(mesh) > 1:
             return _paged_pallas_sharded(
                 functools.partial(paged_decode_partials, softcap=softcap,
-                                  window=window, interpret=interp),
+                                  window=window, interpret=interp,
+                                  sink=sink, swin=swin),
                 mesh, q, k_pool, v_pool, table, limits,
                 limits if q_pos is None else q_pos, sliding, mq=False,
                 kv_scale=kv_scale,
@@ -608,17 +684,19 @@ def paged_partials(q, k_pool, v_pool, table, limits, softcap: float = 0.0,
         return paged_decode_partials(
             q, k_pool, v_pool, table, limits, softcap=softcap, window=window,
             sliding=sliding, q_pos=q_pos, interpret=interp, kv_scale=kv_scale,
+            sink=sink, swin=swin,
         )
     return _paged_cache_partials(
         q, k_pool, v_pool, table, limits,
         softcap=softcap, window=window, sliding=sliding, q_pos=q_pos,
-        kv_scale=kv_scale,
+        kv_scale=kv_scale, sink=sink, swin=swin,
     )
 
 
 def paged_partials_mq(q, k_pool, v_pool, table, limits, softcap: float = 0.0,
                       window: int = 0, sliding=None, q_pos=None,
-                      impl: str = "auto", mesh=None, kv_scale=None):
+                      impl: str = "auto", mesh=None, kv_scale=None,
+                      sink: int = 0, swin: int = 0):
     """Multi-query `paged_partials` (speculative verify chunk) — same
     dispatch."""
     import functools
@@ -636,31 +714,36 @@ def paged_partials_mq(q, k_pool, v_pool, table, limits, softcap: float = 0.0,
                   if q_pos is None else q_pos)
             return _paged_pallas_sharded(
                 functools.partial(paged_decode_partials_mq, softcap=softcap,
-                                  window=window, interpret=interp),
+                                  window=window, interpret=interp,
+                                  sink=sink, swin=swin),
                 mesh, q, k_pool, v_pool, table, limits, qp, sliding, mq=True,
                 kv_scale=kv_scale,
             )
         return paged_decode_partials_mq(
             q, k_pool, v_pool, table, limits, softcap=softcap, window=window,
             sliding=sliding, q_pos=q_pos, interpret=interp, kv_scale=kv_scale,
+            sink=sink, swin=swin,
         )
     return _paged_cache_partials_mq(
         q, k_pool, v_pool, table, limits,
         softcap=softcap, window=window, sliding=sliding, q_pos=q_pos,
-        kv_scale=kv_scale,
+        kv_scale=kv_scale, sink=sink, swin=swin,
     )
 
 
 def paged_prefill_partials(q, k_pool, v_pool, table, limits,
                            softcap: float = 0.0, window: int = 0,
                            sliding=None, q_pos=None, impl: str = "auto",
-                           mesh=None, kv_scale=None):
+                           mesh=None, kv_scale=None, sink: int = 0,
+                           swin: int = 0):
     """Paged partials for a PREFILL CHUNK (models/llama.prefill_chunk_paged):
     q [B, T, H, D] covers a whole chunk, limits[b] is the rows already
     resident (the chunk's start offset). Same dispatch as paged_partials_mq,
     but the Pallas side tiles the chunk's query rows so any chunk size fits
     the kernel's VMEM running state (ops/paged_flash.paged_prefill_partials_mq).
-    With a tp>1 mesh the tiled kernel runs head-sharded under shard_map."""
+    With a tp>1 mesh the tiled kernel runs head-sharded under shard_map.
+    sink/swin bound the prefix walk to the sink pages + trailing window —
+    what makes a 512k-token chunked prefill linear instead of quadratic."""
     import functools
 
     from localai_tpu.ops.paged_flash import (
@@ -676,18 +759,20 @@ def paged_prefill_partials(q, k_pool, v_pool, table, limits,
                   if q_pos is None else q_pos)
             return _paged_pallas_sharded(
                 functools.partial(paged_prefill_partials_mq, softcap=softcap,
-                                  window=window, interpret=interp),
+                                  window=window, interpret=interp,
+                                  sink=sink, swin=swin),
                 mesh, q, k_pool, v_pool, table, limits, qp, sliding, mq=True,
                 kv_scale=kv_scale,
             )
         return paged_prefill_partials_mq(
             q, k_pool, v_pool, table, limits, softcap=softcap, window=window,
             sliding=sliding, q_pos=q_pos, interpret=interp, kv_scale=kv_scale,
+            sink=sink, swin=swin,
         )
     return _paged_cache_partials_mq(
         q, k_pool, v_pool, table, limits,
         softcap=softcap, window=window, sliding=sliding, q_pos=q_pos,
-        kv_scale=kv_scale,
+        kv_scale=kv_scale, sink=sink, swin=swin,
     )
 
 
@@ -708,6 +793,8 @@ def decode_attention_windowed_paged(
     impl: str = "auto",
     mesh=None,  # Mesh with tp>1 → Pallas kernel head-sharded (shard_map)
     kv_scale=None,  # [2, K] f32 per-head (k, v) dequant scales (fp8 KV)
+    sink: int = 0,  # windowed+sink decode (docs/LONG_CONTEXT.md): rows
+    swin: int = 0,  # attended iff gpos < sink or q_pos - gpos < swin
 ) -> jnp.ndarray:
     """`decode_attention_windowed` over a paged pool: paged partials for
     rows [0, block_start), dense merge of the (tiny) local window + current
@@ -716,7 +803,7 @@ def decode_attention_windowed_paged(
     acc, m, l = paged_partials(
         q, k_pool, v_pool, table, positions - step,
         softcap=softcap, window=window, sliding=sliding, q_pos=positions,
-        impl=impl, mesh=mesh, kv_scale=kv_scale,
+        impl=impl, mesh=mesh, kv_scale=kv_scale, sink=sink, swin=swin,
     )
     # f32 concat: the block-local window may live in the cache's storage
     # dtype (fp8 KV) while the current token is model-dtype.
@@ -728,28 +815,56 @@ def decode_attention_windowed_paged(
     if window and sliding is not None:
         dist = jnp.concatenate([step - jnp.arange(n), jnp.zeros((1,), jnp.int32)])
         mask = mask & (~sliding | (dist < window))
+    mask = jnp.broadcast_to(mask[None, :], (q.shape[0], n + 1))
+    if swin:
+        # Exact mask on the local rows too: row i sits at global position
+        # block_start + i = positions - step + i, distance step - i.
+        dist = jnp.concatenate(
+            [step - jnp.arange(n), jnp.zeros((1,), jnp.int32)]
+        )[None, :]
+        gpos = positions[:, None] - dist
+        mask = mask & ((gpos < sink) | (dist < swin))
     return _merge_partials(q, acc, m, l, ek, ev, mask, softcap=softcap)
 
 
 def _paged_cache_partials_mq(q, k_pool, v_pool, table, limits,
                              softcap: float = 0.0, window: int = 0,
-                             sliding=None, q_pos=None, kv_scale=None):
-    """Multi-query `_paged_cache_partials` for the speculative verify chunk:
-    q [B, T, H, D] (T = draft window + 1), one page walk shared by all T
-    queries. limits [B] bounds the cache prefix every query may see (the
-    chunk's in-window causal part is merged separately). Returns
+                             sliding=None, q_pos=None, kv_scale=None,
+                             sink: int = 0, swin: int = 0):
+    """Multi-query `_paged_cache_partials` for the speculative verify chunk
+    and the chunked-prefill prefix walk: q [B, T, H, D], one page walk
+    shared by all T queries. limits [B] bounds the cache prefix every query
+    may see (the chunk's in-window causal part is merged separately).
+    table is flat [B, MP] or the hierarchical (l1, l0) pair; sink/swin add
+    the windowed+sink mask AND the per-slot cold-page skip (see
+    _paged_cache_partials — the skip is bounded by the SMALLEST query
+    position in the chunk, so every query's window stays covered). Returns
     (acc [B, K, G, T, D], m [B, K, G, T, 1], l [B, K, G, T, 1])."""
+    from localai_tpu.ops import ptable as _pt
+
     B, T, H, D = q.shape
     page = k_pool.shape[1]
     K = k_pool.shape[2]
     G = H // K
-    MP = table.shape[1]
+    MP = _pt.width(table)
     scale = 1.0 / (D**0.5)
     qf = (q.astype(jnp.float32) * scale).reshape(B, T, K, G, D)
+    if swin:
+        sink_cols, win_lo, n_cols = _sink_window_cols(
+            limits, jnp.min(q_pos, axis=1), page, MP, sink, swin
+        )
 
     def body(p, carry):
         m, l, acc = carry
-        pids = table[:, p]
+        if swin:
+            col = jnp.where(p < sink_cols, p, p + (win_lo - sink_cols))  # [B]
+            col_ok = p < n_cols  # [B]
+        else:
+            col = jnp.broadcast_to(p, (B,))
+            col_ok = jnp.ones((B,), bool)
+        pids = _pt.gather_cols(
+            table, jnp.minimum(col, MP - 1)[:, None]
+        )[:, 0]  # [B]
         kp = k_pool[pids].astype(jnp.float32)  # [B, page, K, D]
         vp = v_pool[pids].astype(jnp.float32)
         if kv_scale is not None:  # in-register fp8 dequant (fused into cast)
@@ -758,11 +873,15 @@ def _paged_cache_partials_mq(q, k_pool, v_pool, table, limits,
         sc = jnp.einsum("btkgd,bskd->bkgts", qf, kp)  # [B, K, G, T, page]
         if softcap:
             sc = softcap_scores(sc, softcap)
-        gpos = p * page + jnp.arange(page)
-        valid = gpos[None, None, :] < limits[:, None, None]  # [B, 1, page]
+        gpos = col[:, None] * page + jnp.arange(page)[None, :]  # [B, page]
+        valid = (gpos < limits[:, None]) & col_ok[:, None]  # [B, page]
+        valid = valid[:, None, :]  # [B, 1, page]
         if window and sliding is not None:
-            dist = q_pos[:, :, None] - gpos[None, None, :]  # [B, T, page]
+            dist = q_pos[:, :, None] - gpos[:, None, :]  # [B, T, page]
             valid = valid & (~sliding | (dist < window))
+        if swin:
+            dist = q_pos[:, :, None] - gpos[:, None, :]  # [B, T, page]
+            valid = valid & ((gpos[:, None, :] < sink) | (dist < swin))
         vmask = valid[:, None, None]  # [B, 1, 1, T|1, page]
         sc = jnp.where(vmask, sc, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(sc, axis=-1, keepdims=True))
@@ -776,7 +895,12 @@ def _paged_cache_partials_mq(q, k_pool, v_pool, table, limits,
     m0 = jnp.full((B, K, G, T, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, K, G, T, 1), jnp.float32)
     a0 = jnp.zeros((B, K, G, T, D), jnp.float32)
-    p_hi = jnp.minimum((jnp.max(limits) + page - 1) // page, MP).astype(jnp.int32)
+    if swin:
+        p_hi = jnp.max(n_cols).astype(jnp.int32)
+    else:
+        p_hi = jnp.minimum(
+            (jnp.max(limits) + page - 1) // page, MP
+        ).astype(jnp.int32)
     m, l, acc = jax.lax.fori_loop(0, p_hi, body, (m0, l0, a0))
     return acc, m, l
 

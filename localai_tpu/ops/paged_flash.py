@@ -76,55 +76,112 @@ def use_pallas(impl: str = "auto") -> bool:
 
 
 def _ragged_paged_kernel(
-    table_ref,  # scalar-prefetch [B, MP] i32
-    limits_ref,  # scalar-prefetch [B] i32
-    sliding_ref,  # scalar-prefetch [1] i32
-    q_ref,  # [1, K, QR, Dk] f32 (scale applied)
-    qpos_ref,  # [1, QR] i32
-    kvs_ref,  # [2, K] f32 SMEM — per-head (k, v) dequant scales (fp8 KV);
-    # ones when the pool is unscaled, so the multiply is exact identity
-    k_hbm,  # [P, page, K, Dk] pool dtype, memory_space=ANY
-    v_hbm,  # [P, page, K, Dv]
-    acc_ref,  # out [1, K, QR, Dv] f32
-    m_ref,  # out [1, K, QR, STAT_LANES] f32
-    l_ref,  # out [1, K, QR, STAT_LANES] f32
-    kbuf,  # VMEM scratch [2, page, K, Dk] pool dtype
-    vbuf,  # VMEM scratch [2, page, K, Dv]
-    acc_s,  # VMEM scratch [K, QR, Dv] f32
-    m_s,  # VMEM scratch [K, QR, 1] f32
-    l_s,  # VMEM scratch [K, QR, 1] f32
-    sem,  # DMA semaphores [2, 2]
-    *,
+    *refs,  # scalar-prefetch table refs (see below), then operands/outs
     page: int,
     num_kv: int,
     softcap: float,
     window: int,
+    sink: int = 0,
+    swin: int = 0,
+    l1_span: int = 0,
 ):
+    """Kernel body. Scalar-prefetch layout depends on the table layout:
+
+    FLAT (l1_span == 0):  table_ref [B, MP] i32
+    HIER (l1_span  > 0):  l1_ref [B, ML1] i32, l0_ref [NTP, SPAN] i32 — a
+        slot's page COLUMN j resolves through l0[l1[b, j // SPAN], j % SPAN]
+        (ops/ptable), so one 1M-token slot ships a 64-entry directory row
+        instead of an 8192-wide flat row that blows the SMEM prefetch
+        budget.
+
+    Then: limits_ref [B] i32, sliding_ref [1] i32 (both prefetch), and the
+    regular operands q_ref [1, K, QR, Dk] f32, qpos_ref [1, QR] i32,
+    kvs_ref [2, K] f32 SMEM, k_hbm/v_hbm pools (ANY), outputs acc/m/l, VMEM
+    scratch kbuf/vbuf/acc_s/m_s/l_s and the DMA semaphores.
+
+    sink/swin (windowed+sink decode, docs/LONG_CONTEXT.md): a row is
+    attended iff `gpos < sink` or `q_pos - gpos < swin`. The page walk then
+    SKIPS the cold middle — it visits columns [0, sink_cols) ∪ [win_lo,
+    np_live) via an index remap, so a spilled slot streams only its sink
+    pages + trailing window from HBM. Exact: skipped pages are fully masked
+    either way.
+    """
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    if l1_span:
+        l1_ref, l0_ref = refs[0], refs[1]
+        refs = refs[2:]
+        table_width = l1_ref.shape[1] * l1_span
+    else:
+        table_ref = refs[0]
+        refs = refs[1:]
+        table_width = table_ref.shape[1]
+    (
+        limits_ref,  # scalar-prefetch [B] i32
+        sliding_ref,  # scalar-prefetch [1] i32
+        q_ref,  # [1, K, QR, Dk] f32 (scale applied)
+        qpos_ref,  # [1, QR] i32
+        kvs_ref,  # [2, K] f32 SMEM — per-head (k, v) dequant scales (fp8
+        # KV); ones when the pool is unscaled (multiply is exact identity)
+        k_hbm,  # [P, page, K, Dk] pool dtype, memory_space=ANY
+        v_hbm,  # [P, page, K, Dv]
+        acc_ref,  # out [1, K, QR, Dv] f32
+        m_ref,  # out [1, K, QR, STAT_LANES] f32
+        l_ref,  # out [1, K, QR, STAT_LANES] f32
+        kbuf,  # VMEM scratch [2, page, K, Dk] pool dtype
+        vbuf,  # VMEM scratch [2, page, K, Dv]
+        acc_s,  # VMEM scratch [K, QR, Dv] f32
+        m_s,  # VMEM scratch [K, QR, 1] f32
+        l_s,  # VMEM scratch [K, QR, 1] f32
+        sem,  # DMA semaphores [2, 2]
+    ) = refs
 
     b = pl.program_id(0)
     QR = q_ref.shape[2]
     lim = limits_ref[b]
     # This slot's own page count (ragged), clamped to the table width so a
     # bad limit can never index the table out of bounds.
-    np_live = jnp.minimum((lim + page - 1) // page, table_ref.shape[1])
+    np_live = jnp.minimum((lim + page - 1) // page, table_width)
+
+    if swin:
+        # Cold-middle skip: walk iteration j covers table column col(j).
+        sink_cols = jnp.minimum(-(-sink // page) if sink else 0, np_live)
+        qmin = jnp.min(qpos_ref[0])
+        win_lo = jnp.clip((qmin - swin + 1) // page, 0, np_live)
+        win_lo = jnp.maximum(win_lo, sink_cols)
+        n_iter = sink_cols + np_live - win_lo
+        gap = win_lo - sink_cols
+
+        def col_of(j):
+            return jnp.where(j < sink_cols, j, j + gap)
+    else:
+        n_iter = np_live
+
+        def col_of(j):
+            return j
+
+    def tbl(j):
+        col = col_of(j)
+        if l1_span:
+            return l0_ref[l1_ref[b, col // l1_span], col % l1_span]
+        return table_ref[b, col]
 
     def dma_k(slot, j):
         return pltpu.make_async_copy(
-            k_hbm.at[table_ref[b, j]], kbuf.at[slot], sem.at[slot, 0]
+            k_hbm.at[tbl(j)], kbuf.at[slot], sem.at[slot, 0]
         )
 
     def dma_v(slot, j):
         return pltpu.make_async_copy(
-            v_hbm.at[table_ref[b, j]], vbuf.at[slot], sem.at[slot, 1]
+            v_hbm.at[tbl(j)], vbuf.at[slot], sem.at[slot, 1]
         )
 
     acc_s[...] = jnp.zeros_like(acc_s)
     m_s[...] = jnp.full_like(m_s, NEG_INF)
     l_s[...] = jnp.zeros_like(l_s)
 
-    @pl.when(np_live > 0)
+    @pl.when(n_iter > 0)
     def _warmup():
         dma_k(0, 0).start()
         dma_v(0, 0).start()
@@ -132,7 +189,7 @@ def _ragged_paged_kernel(
     def body(j, carry):
         slot = j % 2
 
-        @pl.when(j + 1 < np_live)
+        @pl.when(j + 1 < n_iter)
         def _prefetch():  # next page rides the wire while this one computes
             dma_k((j + 1) % 2, j + 1).start()
             dma_v((j + 1) % 2, j + 1).start()
@@ -140,14 +197,20 @@ def _ragged_paged_kernel(
         dma_k(slot, j).wait()
         dma_v(slot, j).wait()
 
-        # Global row indices covered by table column j.
-        gpos = j * page + jax.lax.broadcasted_iota(jnp.int32, (QR, page), 1)
+        # Global row indices covered by the table column this step visits.
+        gpos = col_of(j) * page + jax.lax.broadcasted_iota(
+            jnp.int32, (QR, page), 1
+        )
         valid = gpos < lim
         if window:
             qp = qpos_ref[0]  # [QR]
             sl = sliding_ref[0] > 0
             dist = qp[:, None] - gpos
             valid = valid & (~sl | (dist < window))
+        if swin:
+            qp = qpos_ref[0]  # [QR]
+            dist = qp[:, None] - gpos
+            valid = valid & ((gpos < sink) | (dist < swin))
 
         for kh in range(num_kv):  # static unroll — one MXU pass per kv head
             q = q_ref[0, kh]  # [QR, Dk]
@@ -176,7 +239,7 @@ def _ragged_paged_kernel(
             m_s[kh] = m_new
         return carry
 
-    jax.lax.fori_loop(0, np_live, body, 0)
+    jax.lax.fori_loop(0, n_iter, body, 0)
 
     acc_ref[0] = acc_s[...]
     m_ref[0] = jnp.broadcast_to(m_s[...], m_ref.shape[1:])
@@ -188,16 +251,20 @@ def _paged_partials_rows(
     qpos_rows: jnp.ndarray,  # [B, QR] i32
     k_pool: jnp.ndarray,  # [P, page, K, Dk]
     v_pool: jnp.ndarray,  # [P, page, K, Dv]
-    table: jnp.ndarray,  # [B, MP] i32
+    table,  # [B, MP] i32, or hierarchical (l1 [B, ML1], l0 [NTP, SPAN])
     limits: jnp.ndarray,  # [B] i32
     softcap: float,
     window: int,
     sliding,
     interpret: bool,
     kv_scale=None,  # [2, K] f32 per-head (k, v) dequant scales, or None
+    sink: int = 0,  # windowed+sink decode (docs/LONG_CONTEXT.md)
+    swin: int = 0,
 ):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    from localai_tpu.ops import ptable as _pt
 
     B, K, QR, Dk = qr.shape
     page = k_pool.shape[1]
@@ -207,14 +274,22 @@ def _paged_partials_rows(
     ).reshape(1).astype(jnp.int32)
     kvs = (jnp.ones((2, K), jnp.float32) if kv_scale is None
            else kv_scale.astype(jnp.float32))
+    if _pt.is_hier(table):
+        l1, l0 = table
+        l1_span = int(l0.shape[-1])
+        tbl_args = (l1.astype(jnp.int32), l0.astype(jnp.int32))
+    else:
+        l1_span = 0
+        tbl_args = (table.astype(jnp.int32),)
     kernel = functools.partial(
         _ragged_paged_kernel, page=page, num_kv=K,
         softcap=float(softcap), window=int(window),
+        sink=int(sink), swin=int(swin), l1_span=l1_span,
     )
     acc, m, l = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,
+            num_scalar_prefetch=len(tbl_args) + 2,
             grid=(B,),
             in_specs=[
                 pl.BlockSpec((1, K, QR, Dk), lambda b, *_: (b, 0, 0, 0)),
@@ -244,7 +319,7 @@ def _paged_partials_rows(
         ],
         interpret=interpret,
     )(
-        table.astype(jnp.int32), limits.astype(jnp.int32), sl_arr,
+        *tbl_args, limits.astype(jnp.int32), sl_arr,
         qr, qpos_rows.astype(jnp.int32), kvs, k_pool, v_pool,
     )
     return acc, m[..., :1], l[..., :1]
@@ -262,6 +337,8 @@ def paged_decode_partials(
     q_pos=None,
     interpret: bool = False,
     kv_scale=None,  # [2, K] f32 per-head (k, v) dequant scales (fp8 KV)
+    sink: int = 0,  # windowed+sink decode (docs/LONG_CONTEXT.md)
+    swin: int = 0,
 ):
     """Drop-in for attention._paged_cache_partials: returns
     (acc [B, K, G, Dv], m [B, K, G, 1], l [B, K, G, 1]) f32, scale applied."""
@@ -278,6 +355,7 @@ def paged_decode_partials(
     return _paged_partials_rows(
         qr, qpos_rows, k_pool, v_pool, table, limits,
         softcap, window, sliding, interpret, kv_scale=kv_scale,
+        sink=sink, swin=swin,
     )
 
 
@@ -293,6 +371,8 @@ def paged_decode_partials_mq(
     q_pos=None,  # [B, T]
     interpret: bool = False,
     kv_scale=None,  # [2, K] f32 per-head (k, v) dequant scales (fp8 KV)
+    sink: int = 0,  # windowed+sink decode (docs/LONG_CONTEXT.md)
+    swin: int = 0,
 ):
     """Drop-in for attention._paged_cache_partials_mq (speculative verify
     chunk): one page walk shared by all T queries. Returns
@@ -317,6 +397,7 @@ def paged_decode_partials_mq(
     acc, m, l = _paged_partials_rows(
         qr, qpos_rows, k_pool, v_pool, table, limits,
         softcap, window, sliding, interpret, kv_scale=kv_scale,
+        sink=sink, swin=swin,
     )
     acc = acc.reshape(B, K, T, G, Dv).transpose(0, 1, 3, 2, 4)
     m = m.reshape(B, K, T, G, 1).transpose(0, 1, 3, 2, 4)
@@ -347,6 +428,8 @@ def paged_prefill_partials_mq(
     interpret: bool = False,
     max_qrows: int = PREFILL_MAX_QROWS,
     kv_scale=None,  # [2, K] f32 per-head (k, v) dequant scales (fp8 KV)
+    sink: int = 0,  # windowed+sink prefix walk (docs/LONG_CONTEXT.md)
+    swin: int = 0,
 ):
     """`paged_decode_partials_mq` for prefill-chunk query counts: the T·G
     query-row axis is tiled to `max_qrows` per kernel launch so the chunked
@@ -365,7 +448,7 @@ def paged_prefill_partials_mq(
         return paged_decode_partials_mq(
             q, k_pool, v_pool, table, limits, softcap=softcap, window=window,
             sliding=sliding, q_pos=q_pos, interpret=interpret,
-            kv_scale=kv_scale,
+            kv_scale=kv_scale, sink=sink, swin=swin,
         )
     parts = []
     for lo in range(0, T, tq):
@@ -373,7 +456,7 @@ def paged_prefill_partials_mq(
         parts.append(paged_decode_partials_mq(
             q[:, lo:hi], k_pool, v_pool, table, limits, softcap=softcap,
             window=window, sliding=sliding, q_pos=q_pos[:, lo:hi],
-            interpret=interpret, kv_scale=kv_scale,
+            interpret=interpret, kv_scale=kv_scale, sink=sink, swin=swin,
         ))
     return tuple(
         jnp.concatenate([p[i] for p in parts], axis=3) for i in range(3)

@@ -1,0 +1,89 @@
+"""Page-table representations shared by the paged-attention ops, the model
+write paths and the engine (ISSUE 14, docs/LONG_CONTEXT.md).
+
+Two layouts resolve a slot-local page COLUMN index to a pool page id:
+
+- FLAT  — `table[..., MP] int32`: one page id per column. Fine up to tens of
+  thousands of rows per slot, but a 1M-token slot at page 128 needs an
+  8192-wide row; shipped per dispatch for every slot and scalar-prefetched
+  whole into SMEM by the Pallas ragged kernel, that blows the prefetch/VMEM
+  budget long before the pool does.
+- HIER  — `(l1 [..., ML1] int32, l0 [NTP, SPAN] int32)`: a two-level radix.
+  Column j resolves through `l0[l1[..., j // SPAN], j % SPAN]`. The L1
+  directory is MP/SPAN entries per slot (64 at 1M tokens, SPAN 128) and the
+  L0 table-page pool is GLOBAL — shared CoW across slots, so N slots over
+  one long prefix pay its directory once, exactly like its KV pages.
+
+Every consumer goes through these helpers, so one code path serves both
+layouts; the engine picks per `EngineConfig.kv_l1_span` (0 = flat).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def is_hier(table) -> bool:
+    """True when `table` is the hierarchical (l1, l0) pair."""
+    return isinstance(table, (tuple, list))
+
+
+def width(table) -> int:
+    """Logical column count MP (static)."""
+    if is_hier(table):
+        l1, l0 = table
+        return l1.shape[-1] * l0.shape[-1]
+    return table.shape[-1]
+
+
+def gather_cols(table, cols: jnp.ndarray) -> jnp.ndarray:
+    """Resolve per-slot column indices to page ids.
+
+    table: flat [B, MP] or hier ([B, ML1], [NTP, SPAN]); cols: [B, N] int.
+    Returns [B, N] int32 page ids. Out-of-range columns are the CALLER's
+    responsibility to clamp (both layouts index-error past their width)."""
+    if is_hier(table):
+        l1, l0 = table
+        span = l0.shape[-1]
+        tp = jnp.take_along_axis(l1, cols // span, axis=-1)  # [B, N]
+        return l0[tp, cols % span]
+    return jnp.take_along_axis(table, cols, axis=-1)
+
+
+def row_lookup(table_row, idx):
+    """Resolve column indices of ONE slot's table row.
+
+    table_row: flat [MP] or hier ([ML1], [NTP, SPAN]); idx: int array or a
+    static python int. Returns page ids shaped like idx."""
+    if is_hier(table_row):
+        l1, l0 = table_row
+        span = l0.shape[-1]
+        return l0[l1[idx // span], idx % span]
+    return table_row[idx]
+
+
+def select_row(table, j):
+    """Row j of a batched table: flat [m, MP] → [MP]; hier ([m, ML1], l0) →
+    ([ML1], l0) — the l0 pool is global, so it rides whole."""
+    if is_hier(table):
+        l1, l0 = table
+        return (l1[j], l0)
+    return table[j]
+
+
+def shard_spec(table, flat_spec, rep_spec):
+    """shard_map in_spec for a table operand: the flat layout takes
+    `flat_spec`; the hier pair replicates both levels (`rep_spec` each) —
+    they are host-built i32 control state, KBs."""
+    if is_hier(table):
+        return (rep_spec, rep_spec)
+    return flat_spec
+
+
+def batch_row(table_row):
+    """Lift one slot's table row to the batched form the chunk programs
+    take: flat [MP] → [1, MP]; hier ([ML1], l0) → ([1, ML1], l0)."""
+    if is_hier(table_row):
+        l1, l0 = table_row
+        return (l1[None], l0)
+    return table_row[None]

@@ -785,6 +785,11 @@ class ModelManager:
                 adapter_cache_bytes=cfg.adapter_cache_bytes,
                 kv_scale=cfg.kv_scale,
                 prefill_chunk=cfg.prefill_chunk,
+                attention_sink=cfg.attention_sink,
+                attention_window=cfg.attention_window,
+                kv_spill_bytes=cfg.kv_spill_bytes,
+                kv_l1_span=cfg.kv_l1_span,
+                sp_prefill=cfg.sp_prefill,
                 max_pending=cfg.max_pending,
                 queue_timeout_s=cfg.queue_timeout_s,
                 deadline_s=cfg.deadline_s,

@@ -65,6 +65,14 @@ Hook sites (each is one `faults.fire(SITE)` call in production code):
                      admission with a typed error event; the engine keeps
                      serving every other tenant and the per-slot adapter
                      refcounts stay fully accounted at quiesce.
+  page_spill       — the cold-page spill/restore edges of windowed+sink
+                     long-context serving (Engine._spill_cold_pages /
+                     Engine._restore_spilled, ISSUE 14). Raising at the
+                     spill edge leaves that slot's pages HOT (exact
+                     attention continues untouched); raising at the restore
+                     edge degrades the consumer (prefix save skipped, span
+                     export refused) — in every case zero hung callers and
+                     the pool + host tier fully accounted at quiesce.
   spec_verify      — entry of Engine._dispatch_spec_block (ISSUE 12), just
                      before a speculative verify round launches (any draft
                      source: draft_model / prompt_lookup / self_draft). The
@@ -115,6 +123,7 @@ SITES = (
     "collective_dispatch",
     "adapter_fetch",
     "spec_verify",
+    "page_spill",
 )
 
 DEFAULT_RATE = 0.05

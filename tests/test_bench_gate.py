@@ -47,6 +47,21 @@ def test_direction_markers_cover_multihost_rows():
     assert direction("multihost_remote_handoffs") == "higher"
 
 
+def test_direction_markers_cover_longctx_rows():
+    """BENCH_LONGCTX keys (ISSUE 14, docs/LONG_CONTEXT.md) gate in the
+    right direction from their first shared round."""
+    assert direction("longctx_32k_prefill_tok_per_s") == "higher"
+    assert direction("longctx_128k_prefill_tok_per_s") == "higher"
+    assert direction("longctx_512k_prefill_tok_per_s") == "higher"
+    assert direction("longctx_512k_decode_tok_per_s") == "higher"
+    assert direction("longctx_512k_ttft_ms") == "lower"
+    assert direction("longctx_users_agg_tok_per_s") == "higher"
+    assert direction("longctx_users_prefix_hit_rate") == "higher"
+    # Workload descriptor, pinned so a bigger benchmark document can never
+    # read as a regression.
+    assert direction("longctx_users_doc_tokens") == "higher"
+
+
 def test_compare_flags_drops_in_the_bad_direction():
     old = {"decode_tps": 1000.0, "p99_ttft_ms": 100.0, "accept_rate": 0.5}
     new = {"decode_tps": 850.0, "p99_ttft_ms": 125.0, "accept_rate": 0.52}

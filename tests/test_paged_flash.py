@@ -515,3 +515,130 @@ def test_spec_decode_composes_with_fp8_kv_scale():
         finally:
             eng.stop()
     assert texts["pallas"] == texts["xla"]
+
+
+# ---------------------------------------------------------------------- #
+# ISSUE 14 (docs/LONG_CONTEXT.md): hierarchical page tables + windowed+
+# sink walk — kernel (interpret mode) vs XLA oracle, and hier vs flat.
+# ---------------------------------------------------------------------- #
+
+def _hier_of(table, span):
+    """Split a flat [B, MP] table into the (l1, l0) pair: chunk c of slot b
+    becomes its own table page (worst case — no sharing)."""
+    B, MP = table.shape
+    ml1 = -(-MP // span)
+    flat = np.asarray(table)
+    l0 = [np.zeros((span,), np.int32)]  # row 0 = scratch-ish, unused
+    l1 = np.zeros((B, ml1), np.int32)
+    for b in range(B):
+        for c in range(ml1):
+            row = np.zeros((span,), np.int32)
+            chunk = flat[b, c * span: (c + 1) * span]
+            row[: len(chunk)] = chunk
+            l1[b, c] = len(l0)
+            l0.append(row)
+    return jnp.asarray(l1), jnp.asarray(np.stack(l0), jnp.int32)
+
+
+@pytest.mark.parametrize("span", [1, 2, 4])
+def test_hier_table_matches_flat_kernel_and_xla(span):
+    """The two-level table resolves to the same pages as the flat row — in
+    the Pallas kernel's in-kernel L1 walk AND the XLA gather walk."""
+    B, H, K, D, MP, P = 3, 4, 2, 32, 4, 16
+    q = jax.random.normal(jax.random.key(10), (B, H, D))
+    k_pool, v_pool = _pool(jax.random.key(11), P, PAGE, K, D)
+    table = _table(B, MP, P, seed=3)
+    hier = _hier_of(table, span)
+    limits = jnp.array([37, 64, 0], jnp.int32)
+
+    want = _paged_cache_partials(q, k_pool, v_pool, table, limits)
+    got_x = _paged_cache_partials(q, k_pool, v_pool, hier, limits)
+    _assert_partials_close(got_x, want)
+    got_k = paged_decode_partials(q, k_pool, v_pool, hier, limits,
+                                  interpret=True)
+    _assert_partials_close(got_k, want)
+
+
+def test_sink_window_walk_matches_xla_and_masks_exactly():
+    """Windowed+sink decode (sink/swin): the kernel's two-segment skip walk
+    equals the XLA per-slot remapped walk, and both equal a brute-force
+    mask over the full walk — the skip is exact, not approximate."""
+    B, H, K, D, MP, P = 2, 4, 2, 32, 8, 20
+    page = PAGE
+    q = jax.random.normal(jax.random.key(12), (B, H, D))
+    k_pool, v_pool = _pool(jax.random.key(13), P, page, K, D)
+    table = _table(B, MP, P, seed=4)
+    limits = jnp.array([8 * page, 5 * page + 3], jnp.int32)
+    q_pos = limits
+    sink, swin = 20, 40  # sink ends mid-page; window spans ~3 pages
+
+    # Brute force: full walk + explicit mask via a one-off reference.
+    def brute():
+        import numpy as _np
+        out = []
+        qn = _np.asarray(q, _np.float32) * (1.0 / D**0.5)
+        for b in range(B):
+            rows_k, rows_v, keep = [], [], []
+            for g in range(int(limits[b])):
+                pid = int(_np.asarray(table)[b, g // page])
+                rk = _np.asarray(k_pool, _np.float32)[pid, g % page]
+                rv = _np.asarray(v_pool, _np.float32)[pid, g % page]
+                rows_k.append(rk)
+                rows_v.append(rv)
+                keep.append(g < sink or (int(q_pos[b]) - g) < swin)
+            rows_k = _np.stack(rows_k)  # [S, K, D]
+            rows_v = _np.stack(rows_v)
+            keep = _np.asarray(keep)
+            G = H // K
+            qb = qn[b].reshape(K, G, D)
+            sc = _np.einsum("kgd,skd->kgs", qb, rows_k)
+            sc[:, :, ~keep] = -1e30
+            m = sc.max(axis=-1, keepdims=True)
+            p = _np.exp(sc - m)
+            p[:, :, ~keep] = 0.0
+            l = p.sum(axis=-1, keepdims=True)
+            acc = _np.einsum("kgs,skd->kgd", p, rows_v)
+            out.append((acc, m, l))
+        acc = _np.stack([o[0] for o in out])
+        m = _np.stack([o[1] for o in out])
+        l = _np.stack([o[2] for o in out])
+        return acc, m, l
+
+    want = brute()
+    got_x = _paged_cache_partials(q, k_pool, v_pool, table, limits,
+                                  q_pos=q_pos, sink=sink, swin=swin)
+    _assert_partials_close(got_x, want, tol=5e-4)
+    got_k = paged_decode_partials(q, k_pool, v_pool, table, limits,
+                                  q_pos=q_pos, sink=sink, swin=swin,
+                                  interpret=True)
+    _assert_partials_close(got_k, want, tol=5e-4)
+    # Hier + sink/window composed, kernel side.
+    hier = _hier_of(table, 2)
+    got_h = paged_decode_partials(q, k_pool, v_pool, hier, limits,
+                                  q_pos=q_pos, sink=sink, swin=swin,
+                                  interpret=True)
+    _assert_partials_close(got_h, want, tol=5e-4)
+
+
+def test_sink_window_mq_prefill_walk_matches_xla():
+    """The multi-query (prefill-chunk) walk under sink/swin: kernel ==
+    XLA oracle, skip bounded by the smallest query position."""
+    B, T, H, K, D, MP, P = 2, 4, 4, 2, 32, 8, 20
+    q = jax.random.normal(jax.random.key(14), (B, T, H, D))
+    k_pool, v_pool = _pool(jax.random.key(15), P, PAGE, K, D)
+    table = _table(B, MP, P, seed=5)
+    limits = jnp.array([7 * PAGE, 4 * PAGE], jnp.int32)
+    q_pos = limits[:, None] + jnp.arange(T)[None, :]
+    sink, swin = PAGE, 3 * PAGE
+
+    want = _paged_cache_partials_mq(q, k_pool, v_pool, table, limits,
+                                    q_pos=q_pos, sink=sink, swin=swin)
+    got = paged_decode_partials_mq(q, k_pool, v_pool, table, limits,
+                                   q_pos=q_pos, sink=sink, swin=swin,
+                                   interpret=True)
+    _assert_partials_close(got, want)
+    hier = _hier_of(table, 4)
+    got_h = paged_decode_partials_mq(q, k_pool, v_pool, hier, limits,
+                                     q_pos=q_pos, sink=sink, swin=swin,
+                                     interpret=True)
+    _assert_partials_close(got_h, want)

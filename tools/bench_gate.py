@@ -43,6 +43,15 @@ HIGHER_MARKERS = (
     # (skipped) and *_draft_ckpt_bytes rides the "bytes" lower-is-better
     # marker.
     "accept", "vs_paged",
+    # Million-token context ladder (ISSUE 14, BENCH_LONGCTX):
+    # longctx_<len>_prefill_tok_per_s / _decode_tok_per_s and the N-users-
+    # one-document longctx_users_agg_tok_per_s ride "tok_per";
+    # longctx_users_prefix_hit_rate rides "rate"/"hit_rate";
+    # longctx_<len>_ttft_ms rides the "ttft"/"_ms" lower-is-better markers.
+    # longctx_users_doc_tokens is a workload descriptor, not a metric —
+    # "doc_tokens" pins it higher-is-better so a bigger benchmark document
+    # can never read as a regression.
+    "hit_rate", "doc_tokens",
 )
 LOWER_MARKERS = (
     "_ms", "_s", "ms_", "latency", "ttft", "stall", "bytes", "recover",
