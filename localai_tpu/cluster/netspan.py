@@ -128,6 +128,8 @@ class StreamAssembler:
 
     def __init__(self, max_bytes: int = DEFAULT_MAX_BYTES, prior: bytes = b"",
                  expect_digest: str = "", verify: bool = True):
+        # thread: instance-owned — one assembler per transfer stream, fed
+        # by the single thread draining that connection
         self._buf = bytearray()
         self._frame = bytearray(prior)
         self._base = len(prior)

@@ -1351,6 +1351,11 @@ class Engine:
         # admission.
         self._span_inbox: list[tuple[dict, threading.Event]] = []
         self._span_inbox_lock = threading.Lock()
+        # Host-tier byte accounting is mutated from the loop (make-room,
+        # preempt swap, promote/spill) AND from caller threads (stop /
+        # cancel_all discarding queued resumes) — every read-modify-write
+        # of _host_bytes holds this leaf lock so no update is lost.
+        self._host_lock = threading.Lock()
         self.m_span_exports = 0
         self.m_span_imports = 0
         self.m_span_import_rejects = 0
@@ -1529,9 +1534,12 @@ class Engine:
             if self._tp_refs[tp] == 0:
                 self._tp_free.append(tp)
 
+    # thread: engine-loop-only
     def _ptable_set(self, slot_idx: int, pos: int, page_id: int) -> None:
         """Write one directory entry (hier mode): point slot column `pos`
-        at `page_id`, copy-on-writing the backing table page if shared."""
+        at `page_id`, copy-on-writing the backing table page if shared.
+        Declared loop-only: the hierarchical table's COW bookkeeping has no
+        lock — a second mutator thread would corrupt refcounts."""
         span = self._l1_span
         c, o = divmod(pos, span)
         tps = self._slot_tps[slot_idx]
@@ -2124,11 +2132,12 @@ class Engine:
         required state, not cache."""
         if need > self.ecfg.kv_swap_bytes:
             return False
-        while (self._host_bytes + need > self.ecfg.kv_swap_bytes
-               and self._prefix_host):
-            dead = self._prefix_host.pop()
-            self._host_bytes -= dead["bytes"]
-        return self._host_bytes + need <= self.ecfg.kv_swap_bytes
+        with self._host_lock:
+            while (self._host_bytes + need > self.ecfg.kv_swap_bytes
+                   and self._prefix_host):
+                dead = self._prefix_host.pop()
+                self._host_bytes -= dead["bytes"]
+            return self._host_bytes + need <= self.ecfg.kv_swap_bytes
 
     def _host_bias_row(self, request: GenRequest) -> np.ndarray:
         """The bias row the admission program would build — logit_bias plus
@@ -2149,7 +2158,11 @@ class Engine:
         """Release a queued resume's host-tier bytes (cancellation path)."""
         rec = request.resume
         if rec is not None and "bytes" in rec:
-            self._host_bytes -= rec["bytes"]
+            # Runs on caller threads (stop/cancel_all) concurrently with
+            # the loop's host-tier accounting — locked RMW or the budget
+            # drifts (shared-state-race).
+            with self._host_lock:
+                self._host_bytes -= rec["bytes"]
             rec.pop("hk", None)
             rec.pop("hv", None)
             rec["bytes"] = 0
@@ -2225,7 +2238,8 @@ class Engine:
                 "d_pos": int(np.asarray(self.d_positions)[victim]),
                 "bytes": span_bytes,
             })
-            self._host_bytes += span_bytes
+            with self._host_lock:
+                self._host_bytes += span_bytes
             self.m_kv_swap_bytes_out += span_bytes
             self.m_kv_preempt_swaps += 1
         else:
@@ -2349,7 +2363,8 @@ class Engine:
         self.h_override_mask[slot_idx] = False
         self.h_gmask[slot_idx] = 0.0
         self.h_adapter[slot_idx] = row_a
-        self._host_bytes -= rec["bytes"]
+        with self._host_lock:
+            self._host_bytes -= rec["bytes"]
         self.m_kv_swap_bytes_in += rec["bytes"]
         self.m_kv_preempt_recover_ms += (
             (time.monotonic() - rec["t_preempt"]) * 1e3
@@ -4060,10 +4075,12 @@ class Engine:
             for e in self._prefix_host:
                 if (e["valid"] <= valid_len
                         and (e["key"][:e["valid"]] == key[:e["valid"]]).all()):
-                    self._host_bytes -= e["bytes"]
+                    with self._host_lock:
+                        self._host_bytes -= e["bytes"]
                     continue
                 keep_h.append(e)
-            self._prefix_host = keep_h
+            with self._host_lock:
+                self._prefix_host = keep_h
         if self._paged:
             pages = self._slot_pages[slot_idx][: n_pages]
             if len(pages) < n_pages:
@@ -4157,7 +4174,8 @@ class Engine:
             "key": entry["key"], "valid": entry["valid"],
             "hk": hk, "hv": hv, "bytes": sz,
         })
-        self._host_bytes += sz
+        with self._host_lock:
+            self._host_bytes += sz
         self.m_kv_swap_bytes_out += sz
 
     def _prefix_promote(self, hentry: dict) -> Optional[dict]:
@@ -4168,14 +4186,17 @@ class Engine:
         npg = hentry["hk"].shape[1]
         # Claim the entry first so _host_make_room (run for spills during
         # the eviction below) can never evict the span we are promoting.
-        self._prefix_host = [e for e in self._prefix_host if e is not hentry]
-        self._host_bytes -= hentry["bytes"]
+        with self._host_lock:
+            self._prefix_host = [e for e in self._prefix_host
+                                 if e is not hentry]
+            self._host_bytes -= hentry["bytes"]
         if len(self._free_pages) < npg:
             self._prefix_evict_for_pages(npg)
         pages = self._pages_claim(npg)
         if pages is None:
             self._prefix_host.insert(0, hentry)  # back to the tier, LRU-bumped
-            self._host_bytes += hentry["bytes"]
+            with self._host_lock:
+                self._host_bytes += hentry["bytes"]
             return None
         self._swap_in_pages(pages, hentry["hk"], hentry["hv"])
         entry = {"key": hentry["key"], "valid": hentry["valid"],
@@ -4240,7 +4261,10 @@ class Engine:
 
         prompt = np.asarray(list(prompt_ids), np.int32)
         page = self.ecfg.kv_page_size
-        entries = self._prefix_entries  # atomic list-reference snapshot
+        # Runs on exporter (HTTP/pump) threads while the loop mutates the
+        # tier: list() is an atomic C-level copy, iterating the live list
+        # here raced loop-side appends/evictions (shared-state-race).
+        entries = list(self._prefix_entries)
         best, best_len = None, 0
         for entry in entries:
             if not entry.get("pages"):
@@ -4255,7 +4279,7 @@ class Engine:
             return None
         pages = list(best["pages"][: best_len // page])
         hk, hv = self._swap_out_pages(pages)
-        if not any(e is best for e in self._prefix_entries):
+        if not any(e is best for e in list(self._prefix_entries)):
             return None  # evicted mid-gather — pages may have been recycled
         frame = transfer.encode_span(
             key=best["key"][:best_len], valid=best_len, hk=hk, hv=hv,
@@ -4287,7 +4311,10 @@ class Engine:
             )
         except transfer.SpanTransferError as e:
             log.warning("span import rejected: %s", e)
-            self.m_span_import_rejects += 1
+            # Caller-thread increment races the loop's drain-side rejects
+            # — same lock on both sides (shared-state-race).
+            with self._span_inbox_lock:
+                self.m_span_import_rejects += 1
             return False
         entry = {
             "key": key, "valid": valid, "hk": hk, "hv": hv,
@@ -4331,13 +4358,15 @@ class Engine:
                                 a=float(entry["valid"]))
                 elif self._host_make_room(entry["bytes"]):
                     self._prefix_host.insert(0, entry)
-                    self._host_bytes += entry["bytes"]
+                    with self._host_lock:
+                        self._host_bytes += entry["bytes"]
                     entry["accepted"] = True
                     self.m_span_imports += 1
                     self._jnote("span_import", rid=entry.get("trace", ""),
                                 a=float(entry["valid"]))
                 else:
-                    self.m_span_import_rejects += 1
+                    with self._span_inbox_lock:
+                        self.m_span_import_rejects += 1
             finally:
                 done.set()
 
@@ -5178,8 +5207,10 @@ class Engine:
             out["prefix_host_tier_hits"] = float(self.m_prefix_host_hits)
             if self._spill_on or self.m_kv_pages_spilled:
                 # Cold-page spill (ISSUE 14): live spilled pages + churn.
+                # list(): scrape threads must not iterate live loop-owned
+                # structure (shared-state-race) — the copy is GIL-atomic.
                 out["kv_spilled_pages"] = float(
-                    sum(len(d) for d in self._slot_spill)
+                    sum(len(d) for d in list(self._slot_spill))
                 )
                 out["kv_spill_host_bytes"] = float(self._spill_bytes)
                 out["kv_spill_bytes_out"] = float(self.m_kv_spill_bytes_out)
@@ -5200,7 +5231,7 @@ class Engine:
             # and the host-tier footprint per tenant churn.
             out["adapters_registered"] = float(n_adapters)
             out["adapter_device_resident"] = float(
-                sum(1 for nm in self._adapter_rows if nm is not None)
+                sum(1 for nm in list(self._adapter_rows) if nm is not None)
             )
             out["adapter_host_bytes"] = float(self._adapter_host_bytes)
             out["adapter_fetches"] = float(self.m_adapter_fetches)
@@ -5706,8 +5737,9 @@ class Engine:
                     self._tp_release(entry["tps"])
         self._prefix_entries = []
         self._spill_bytes = 0
-        self._prefix_host = []
-        self._host_bytes = 0
+        with self._host_lock:
+            self._prefix_host = []
+            self._host_bytes = 0
         # Staged span imports can never merge now — unblock their waiters
         # (entry["accepted"] stays unset, so importers report failure and
         # their callers fall back to recompute).

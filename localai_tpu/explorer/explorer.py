@@ -110,6 +110,10 @@ class DiscoveryService:
         self.failure_threshold = failure_threshold
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # probe() mutates entry counters from the discovery loop AND from
+        # HTTP-triggered probes — serialized, or concurrent probes of the
+        # same entry lose failure counts (shared-state-race).
+        self._probe_lock = threading.Lock()
 
     def start(self) -> None:
         if self._thread is None:
@@ -125,6 +129,10 @@ class DiscoveryService:
 
     def probe(self, entry: NetworkEntry) -> NetworkEntry:
         """One liveness check; mutates + persists the entry."""
+        with self._probe_lock:
+            return self._probe_locked(entry)
+
+    def _probe_locked(self, entry: NetworkEntry) -> NetworkEntry:
         base = entry.url.rstrip("/")
         try:
             req = urllib.request.Request(base + "/federation/workers")

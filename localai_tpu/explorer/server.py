@@ -36,7 +36,8 @@ class ExplorerServer:
         return self._server.server_address[1]
 
     def start(self) -> None:
-        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="explorer-server").start()
         self.discovery.start()
 
     def stop(self) -> None:

@@ -99,6 +99,9 @@ class EventJournal:
 
     def __init__(self, capacity: int = 4096):
         self.capacity = max(int(capacity), 8)
+        # thread: single-writer engine-loop — the ring is written by the
+        # loop thread alone (appends + staged drain); snapshot() readers
+        # are deliberately best-effort (may see a freshly overwritten slot)
         self._buf = np.zeros(self.capacity, dtype=_DTYPE)
         self.n = 0  # total events ever appended (monotonic sequence)
         self._staged: list[tuple] = []
@@ -110,9 +113,13 @@ class EventJournal:
 
     # ---------------- write side ---------------- #
 
+    # thread: engine-loop-only
     def append(self, event: str, rid: str = "", slot: int = -1,
                a: float = 0.0, b: float = 0.0) -> None:
-        """Writer-thread append: O(1), no allocation, no lock, no device."""
+        """Writer-thread append: O(1), no allocation, no lock, no device.
+        The `# thread:` declaration makes the single-writer convention
+        machine-checked (thread-affinity lint pass): any call chain from a
+        non-loop root is a finding — cross-thread emitters use stage()."""
         self._append_raw(time.monotonic(), event, rid, slot, a, b)
 
     def _append_raw(self, t: float, event: str, rid: str, slot: int,
@@ -139,6 +146,7 @@ class EventJournal:
                 return
             self._staged.append(rec)
 
+    # thread: engine-loop-only
     def drain_staged(self) -> None:
         """Writer thread: move staged events into the ring (original
         timestamps preserved)."""

@@ -253,9 +253,13 @@ Handler = Callable[[Request], "Response | SSEStream"]
 
 class Router:
     def __init__(self) -> None:
+        # thread: single-writer main — the route table is built during
+        # startup, before create_server() spawns handler threads; handlers
+        # only read it
         self.routes: list[tuple[str, re.Pattern, Handler]] = []
         # Original (method, pattern, handler) tuples — the OpenAPI doc and
         # WebUI introspect these (reference: swagger route).
+        # thread: single-writer main — same startup-only build as routes
         self.declared: list[tuple[str, str, Handler]] = []
 
     def add(self, method: str, pattern: str, handler: Handler) -> None:

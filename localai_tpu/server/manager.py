@@ -47,6 +47,9 @@ class ModelQuarantinedError(RuntimeError):
 class LoadedModel:
     def __init__(self, cfg: ModelConfig, engine: Engine, evaluator: Evaluator):
         self.cfg = cfg
+        # thread: instance-owned — teardown mutates engine/params only
+        # after winning the `_loaded.pop()` ownership handoff, so exactly
+        # one thread ever tears a given instance down
         self.engine = engine
         self.evaluator = evaluator
         self.loaded_at = time.monotonic()
@@ -383,7 +386,8 @@ class ModelManager:
         if lm is None:
             return False
         threading.Thread(
-            target=self._drain_and_teardown, args=(lm, drain_s), daemon=True
+            target=self._drain_and_teardown, args=(lm, drain_s), daemon=True,
+            name="unload-drain",
         ).start()
         return True
 
@@ -540,7 +544,8 @@ class ModelManager:
             _, victim = min(idle)
             lm = self._loaded.pop(victim)
             threading.Thread(
-                target=self._drain_and_teardown, args=(lm, 30.0), daemon=True
+                target=self._drain_and_teardown, args=(lm, 30.0), daemon=True,
+                name="unload-drain",
             ).start()
 
     def _resolve_ckpt_dir(self, model: str) -> str:

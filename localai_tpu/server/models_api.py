@@ -131,7 +131,8 @@ class ModelsApi:
             finally:
                 job["processed"] = True
 
-        threading.Thread(target=run, daemon=True).start()
+        threading.Thread(target=run, daemon=True,
+                         name="models-import").start()
         return Response(status=202, body={"uuid": job_id, "name": cfg_dict["name"]})
 
     def import_job(self, req: Request) -> Response:

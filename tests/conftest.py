@@ -119,22 +119,19 @@ def multiproc_worker(tmp_path_factory):
 # LAZILY — a server fixture's model loads during the first request, so its
 # engine threads legitimately appear mid-test and live until the fixture's
 # module teardown; that teardown runs before this guard's check.
-_GUARDED_THREAD_PREFIXES = (
-    "engine-loop",
-    "engine-drain",
-    "watchdog",
-    "config-watcher",
-    "stream-reader",
-    "fed-health",
-    # Cluster scheduler threads (ISSUE 8 satellite): the per-request
-    # dispatch pumps ("cluster-pump-<rid>") own the reroute path AND the
-    # scheduler's gauge refresh (refresh() runs inline on them). A pump
-    # that outlives its request means a terminal event was never posted
-    # (the ClusterClient _finish/_abort contract) and the thread spins on
-    # a dead handle forever. They previously outlived tests unchecked.
-    # "cluster-gauge" guards any future dedicated refresher thread.
-    "cluster-pump",
-    "cluster-gauge",
+#
+# The watch list lives in tools/lint/threads.py (ISSUE 15): the lint
+# thread-root discovery and this guard share ONE source, and a drift test
+# in tests/test_lint.py fails when a new threading.Thread site is covered
+# by neither the guard nor the documented exemption list there.
+import sys as _sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in _sys.path:
+    _sys.path.insert(0, _REPO_ROOT)
+
+from tools.lint.threads import (  # noqa: E402
+    GUARDED_THREAD_PREFIXES as _GUARDED_THREAD_PREFIXES,
 )
 
 

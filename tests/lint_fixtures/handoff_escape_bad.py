@@ -1,0 +1,36 @@
+"""Known-bad fixture for the handoff-escape pass: (1) a thread started
+mid-construction while a later-assigned attribute is read by the thread's
+code, (2) `self` published into a registry before construction completes,
+(3) a producer mutating an object after handing it into a queue."""
+
+import queue
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.jobs = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="escape-loop"
+        )
+        self._thread.start()
+        # ESCAPE: the loop thread is already running and reads this.
+        self.limit = 10
+
+    def _run(self):
+        while True:
+            job = self.jobs.get()
+            if job > self.limit:
+                continue
+
+    def send(self, job):
+        self.jobs.put(job)
+        # ESCAPE: the consumer owns `job` from the put onward.
+        job.acked = True
+
+
+class Member:
+    def __init__(self, registry):
+        registry.append(self)
+        # ESCAPE: whoever reads the registry can see ready unset.
+        self.ready = True

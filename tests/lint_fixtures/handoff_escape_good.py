@@ -1,0 +1,32 @@
+"""Known-good fixture for the handoff-escape pass: construction finishes
+every assignment BEFORE the thread starts / `self` is published, and the
+producer completes all writes before the queue handoff."""
+
+import queue
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.jobs = queue.Queue()
+        self.limit = 10
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="escape-loop"
+        )
+        self._thread.start()  # everything the loop reads is assigned
+
+    def _run(self):
+        while True:
+            job = self.jobs.get()
+            if job > self.limit:
+                continue
+
+    def send(self, job):
+        job.acked = False  # writes finish BEFORE ownership transfers
+        self.jobs.put(job)
+
+
+class Member:
+    def __init__(self, registry):
+        self.ready = True
+        registry.append(self)  # published fully constructed

@@ -1,0 +1,63 @@
+"""Known-bad fixture for the shared-state-race pass.
+
+Shape 1 is the PRE-FIX PR 11 `Metrics._gauge_sources` incident verbatim:
+registration appends to the source list with no lock while the /metrics
+handler (an HTTP-handler-root entry via the router registration) iterates
+it. Shape 2 is a loop-thread container mutation iterated by a public
+reader; shape 3 is a scalar counter incremented from two roots (lost
+update)."""
+
+import threading
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._gauge_sources = []
+
+    def add_gauge_source(self, fn):
+        # PRE-FIX shape: unlocked append racing the render iteration.
+        self._gauge_sources.append(fn)
+
+    def render(self):
+        out = []
+        for src in self._gauge_sources:  # iterated on HTTP scrape threads
+            out.append(src())
+        return "\n".join(out)
+
+
+class MetricsApi:
+    def __init__(self, metrics: Metrics):
+        self.metrics = metrics
+
+    def attach(self, r):
+        r.add("GET", "/metrics", self.scrape)
+
+    def scrape(self, req):
+        return self.metrics.render()
+
+
+class Loop:
+    def __init__(self):
+        self._stats = {}
+        self.m_hits = 0
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="fixture-loop"
+        )
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            self._stats["ticks"] = self._stats.get("ticks", 0) + 1
+            self.m_hits += 1
+
+    def totals(self):
+        # Public reader (main root) iterating live loop-owned structure.
+        return sum(v for v in self._stats.values())
+
+    def bump(self):
+        # Same scalar counter incremented from the main root too — a
+        # cross-root read-modify-write loses updates.
+        self.m_hits += 1

@@ -1,0 +1,81 @@
+"""Known-good fixture for the shared-state-race pass: every blessed
+cross-thread idiom, each of which must stay SILENT.
+
+- locked mutation + locked iteration (common lock)
+- queue.Queue handoff (sync-typed attribute, put→get happens-before)
+- the staged-sidecar idiom: locked append, unlocked len-peek, locked
+  swap, iteration over the swapped-out LOCAL
+- `# thread: single-writer <role>` ring: loop-thread writes, best-effort
+  readers over an atomic copy
+- single-writer scalar counters read by a scrape (stale reads are fine)
+- iteration over a `list(...)` atomic copy instead of the live container
+"""
+
+import queue
+import threading
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._gauge_sources = []
+
+    def add_gauge_source(self, fn):
+        with self._lock:
+            self._gauge_sources.append(fn)
+
+    def render(self):
+        with self._lock:
+            sources = list(self._gauge_sources)
+        return "\n".join(str(s()) for s in sources)
+
+
+class MetricsApi:
+    def __init__(self, metrics: Metrics):
+        self.metrics = metrics
+
+    def attach(self, r):
+        r.add("GET", "/metrics", self.scrape)
+
+    def scrape(self, req):
+        return self.metrics.render()
+
+
+class Loop:
+    def __init__(self):
+        self._inbox = queue.Queue()
+        self._staged = []
+        self._staged_lock = threading.Lock()
+        # thread: single-writer fixture-loop — readers snapshot copies
+        self._ring = [0] * 64
+        self.m_ticks = 0
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="fixture-loop"
+        )
+
+    def start(self):
+        self._thread.start()
+
+    def submit(self, item):
+        self._inbox.put(item)  # queue handoff: internally synchronized
+
+    def stage(self, rec):
+        with self._staged_lock:
+            self._staged.append(rec)
+
+    def _run(self):
+        while True:
+            item = self._inbox.get()
+            if self._staged:  # unlocked len-peek: GIL-atomic plain read
+                with self._staged_lock:
+                    staged, self._staged = self._staged, []
+                for rec in staged:  # iterating the swapped-out local
+                    self._ring[self.m_ticks % 64] = rec
+                    self.m_ticks += 1
+            self._ring[self.m_ticks % 64] = item
+            self.m_ticks += 1
+
+    def snapshot(self):
+        # Best-effort reader over an atomic copy of the declared
+        # single-writer ring; the scalar read is stale-tolerant.
+        return list(self._ring), self.m_ticks

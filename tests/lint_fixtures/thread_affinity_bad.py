@@ -1,0 +1,41 @@
+"""Known-bad fixture for the thread-affinity pass: a `# thread:
+<role>-only` method reachable from a foreign root (the watchdog thread
+calls the loop-only journal append), and a STALE declaration naming a
+role no discovered root matches."""
+
+import threading
+
+
+class Journal:
+    def __init__(self):
+        self._buf = []
+
+    # thread: fixture-loop-only
+    def append(self, ev):
+        self._buf.append(ev)
+
+    # thread: ghost-pump-only
+    def drain(self):
+        return len(self._buf)
+
+
+class Engine:
+    def __init__(self):
+        self.journal = Journal()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="fixture-loop"
+        )
+        self._wd = threading.Thread(
+            target=self._watch, daemon=True, name="fixture-watchdog"
+        )
+
+    def start(self):
+        self._thread.start()
+        self._wd.start()
+
+    def _loop(self):
+        self.journal.append("tick")  # the declared owner: fine
+
+    def _watch(self):
+        # VIOLATION: a foreign root enters the loop-only append path.
+        self.journal.append("watchdog-probe")

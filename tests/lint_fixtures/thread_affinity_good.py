@@ -1,0 +1,49 @@
+"""Known-good fixture for the thread-affinity pass: the declared owner is
+the only root that reaches the declared methods, foreign threads use the
+staged path, and every declared role names a real discovered root."""
+
+import threading
+
+
+class Journal:
+    def __init__(self):
+        self._buf = []
+        self._staged = []
+        self._staged_lock = threading.Lock()
+
+    # thread: fixture-loop-only
+    def append(self, ev):
+        self._buf.append(ev)
+
+    def stage(self, ev):
+        with self._staged_lock:
+            self._staged.append(ev)
+
+    # thread: fixture-loop-only
+    def drain_staged(self):
+        with self._staged_lock:
+            staged, self._staged = self._staged, []
+        for ev in staged:
+            self.append(ev)
+
+
+class Engine:
+    def __init__(self):
+        self.journal = Journal()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="fixture-loop"
+        )
+        self._wd = threading.Thread(
+            target=self._watch, daemon=True, name="fixture-watchdog"
+        )
+
+    def start(self):
+        self._thread.start()
+        self._wd.start()
+
+    def _loop(self):
+        self.journal.drain_staged()
+        self.journal.append("tick")
+
+    def _watch(self):
+        self.journal.stage("watchdog-probe")  # cross-thread: staged path

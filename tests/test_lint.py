@@ -21,6 +21,7 @@ from tools.lint.passes.attr_init import AttrInitPass  # noqa: E402
 from tools.lint.passes.config_drift import ConfigDriftPass  # noqa: E402
 from tools.lint.passes.donation_safety import DonationSafetyPass  # noqa: E402
 from tools.lint.passes.fault_sites import FaultSitesPass  # noqa: E402
+from tools.lint.passes.handoff_escape import HandoffEscapePass  # noqa: E402
 from tools.lint.passes.journal_events import JournalEventsPass  # noqa: E402
 from tools.lint.passes.lock_discipline import LockDisciplinePass  # noqa: E402
 from tools.lint.passes.lock_order import LockOrderPass  # noqa: E402
@@ -30,8 +31,17 @@ from tools.lint.passes.rng_key_reuse import RngKeyReusePass  # noqa: E402
 from tools.lint.passes.sharding_consistency import (  # noqa: E402
     ShardingConsistencyPass,
 )
+from tools.lint.passes.shared_state_race import (  # noqa: E402
+    SharedStateRacePass,
+)
 from tools.lint.passes.terminal_event import TerminalEventPass  # noqa: E402
+from tools.lint.passes.thread_affinity import ThreadAffinityPass  # noqa: E402
 from tools.lint.passes.trace_safety import TraceSafetyPass  # noqa: E402
+from tools.lint.threads import (  # noqa: E402
+    GUARDED_THREAD_PREFIXES,
+    UNGUARDED_THREAD_ROLES,
+    threads_for,
+)
 
 FIX = os.path.join(REPO, "tests", "lint_fixtures")
 
@@ -49,17 +59,17 @@ def _full_run():
 
 
 # --------------------------------------------------------------------- #
-# The acceptance gate: the repo itself is clean under all 13 passes.
+# The acceptance gate: the repo itself is clean under all 16 passes.
 # --------------------------------------------------------------------- #
 
 def test_repo_is_clean_under_all_passes():
     result, elapsed = _full_run()
-    assert len(result.pass_ids) == 13, result.pass_ids
+    assert len(result.pass_ids) == 16, result.pass_ids
     assert result.clean, "lint findings on the repo:\n" + "\n".join(
         f.render() for f in result.active
     )
-    # Tier-1 budget (ISSUE 5/8): all 13 passes under 10 s. Typical
-    # unloaded wall time is ~4-5 s; the bound absorbs CI load. When this
+    # Tier-1 budget (ISSUE 5/8/15): all 16 passes under 10 s. Typical
+    # unloaded wall time is ~6-7 s; the bound absorbs CI load. When this
     # trips, result.timings names the pass that regressed.
     assert elapsed < 10.0, (
         f"lint suite took {elapsed:.1f}s — slowest passes: "
@@ -88,9 +98,9 @@ def test_cli_json_exits_zero():
 
 
 def test_suppression_count_never_grows():
-    """LINT_r02.json pins the suppression budget: future PRs may only
+    """LINT_r03.json pins the suppression budget: future PRs may only
     shrink it (fix the code instead of silencing the pass)."""
-    with open(os.path.join(REPO, "LINT_r02.json")) as f:
+    with open(os.path.join(REPO, "LINT_r03.json")) as f:
         pinned = json.load(f)
     result, _ = _full_run()
     assert len(result.suppressed) <= pinned["total_suppressions"], (
@@ -100,8 +110,12 @@ def test_suppression_count_never_grows():
         "the bar by regenerating LINT_rNN.json in its own PR"
     )
     # The budget itself stays <= 3 unless each extra carries a written
-    # reason AND the baseline regen documents it (ISSUE 8 satellite).
+    # reason AND the baseline regen documents it (ISSUE 8/15 satellite).
     assert pinned["total_suppressions"] <= 3, pinned
+    # The r03 baseline covers the full 16-pass registry with per-pass
+    # timings (ISSUE 15 satellite).
+    assert len(pinned["passes"]) == 16, sorted(pinned["passes"])
+    assert all("wall_time_ms" in v for v in pinned["passes"].values())
 
 
 # --------------------------------------------------------------------- #
@@ -310,6 +324,110 @@ def test_journal_events_fixtures():
     assert JournalEventsPass.project_wide is True
 
 
+# ---- thread-model passes (ISSUE 15) ---- #
+
+def test_shared_state_race_fixtures():
+    """The known-bad file carries the PRE-FIX shape of the PR 11
+    Metrics._gauge_sources bug — the incident class is demonstrably
+    covered — plus a loop-vs-reader container iterate and a two-root
+    scalar lost-update. The known-good file is every blessed idiom."""
+    bad = SharedStateRacePass(
+        globs=("tests/lint_fixtures/shared_state_race_bad.py",))
+    r = _run_single(bad)
+    msgs = "\n".join(f.message for f in r.active)
+    assert "_gauge_sources" in msgs, r.findings       # the PR 11 incident
+    assert "http-handler" in msgs, msgs               # scrape-side root
+    assert "_stats" in msgs, msgs                     # loop-vs-main iterate
+    assert "m_hits" in msgs, msgs                     # scalar lost update
+    assert len(r.active) == 3, r.findings
+    good = SharedStateRacePass(
+        globs=("tests/lint_fixtures/shared_state_race_good.py",))
+    assert _run_single(good).clean, _run_single(good).findings
+
+
+def test_thread_affinity_fixtures():
+    bad = ThreadAffinityPass(
+        globs=("tests/lint_fixtures/thread_affinity_bad.py",))
+    r = _run_single(bad)
+    msgs = "\n".join(f.message for f in r.active)
+    assert "fixture-watchdog" in msgs, r.findings     # foreign-root reach
+    assert "ghost-pump" in msgs, msgs                 # stale declaration
+    assert len(r.active) == 2, r.findings
+    good = ThreadAffinityPass(
+        globs=("tests/lint_fixtures/thread_affinity_good.py",))
+    assert _run_single(good).clean, _run_single(good).findings
+
+
+def test_handoff_escape_fixtures():
+    bad = HandoffEscapePass(
+        globs=("tests/lint_fixtures/handoff_escape_bad.py",))
+    r = _run_single(bad)
+    msgs = "\n".join(f.message for f in r.active)
+    assert "self.limit" in msgs, r.findings           # publish-before-init
+    assert "handed off" in msgs, msgs                 # mutate-after-put
+    assert "self.ready" in msgs, msgs                 # self into registry
+    assert len(r.active) == 3, r.findings
+    good = HandoffEscapePass(
+        globs=("tests/lint_fixtures/handoff_escape_good.py",))
+    assert _run_single(good).clean, _run_single(good).findings
+
+
+def test_thread_pass_project_wide():
+    """--since must never narrow the thread model: roots/effects span
+    files by construction."""
+    assert SharedStateRacePass.project_wide is True
+    assert ThreadAffinityPass.project_wide is True
+    assert HandoffEscapePass.project_wide is True
+
+
+def test_thread_root_discovery_covers_known_roles():
+    """The model discovers the serving core's real thread roles over the
+    repo (cached SummaryIndex — this rides the _full_run build)."""
+    model = threads_for(Repo(REPO))
+    roles = {r.role for r in model.roots}
+    for expected in ("engine-loop", "engine-drain", "watchdog",
+                     "config-watcher", "cluster-pump", "http-handler",
+                     "main", "fed-health"):
+        assert expected in roles, (expected, sorted(roles))
+    # The engine loop reaches its own dispatch machinery...
+    loop = next(r for r in model.roots if r.role == "engine-loop")
+    reach = model.reach(loop)
+    assert any(fid.endswith("Engine._loop") for fid in reach), len(reach)
+    # ...and the journal's declared loop-only append.
+    assert any("EventJournal.append" in fid for fid in reach)
+
+
+def test_thread_guard_drift_against_discovery():
+    """Conftest's thread-leak guard and lint discovery share one source
+    (tools.lint.threads): every discovered threading.Thread site must be
+    covered by a guarded prefix or a documented exemption. A new Thread
+    site that is covered by neither fails HERE, not three PRs later when
+    a leaked thread wedges CI."""
+    import fnmatch as _fn
+
+    from tests.conftest import _GUARDED_THREAD_PREFIXES
+
+    assert _GUARDED_THREAD_PREFIXES == GUARDED_THREAD_PREFIXES  # one source
+    model = threads_for(Repo(REPO))
+    sites = model.discovered_roles()
+    assert sites, "thread-root discovery found no Thread sites at all?"
+    uncovered = []
+    for s in sites:
+        role = s.pattern or s.role
+        guarded = any(role.startswith(p) for p in GUARDED_THREAD_PREFIXES)
+        exempt = any(_fn.fnmatch(s.role, pat) or _fn.fnmatch(role, pat)
+                     for pat in UNGUARDED_THREAD_ROLES)
+        if not (guarded or exempt):
+            uncovered.append(f"{s.path}:{s.line} role={s.role!r}")
+    assert not uncovered, (
+        "threading.Thread sites covered by neither the conftest leak-guard "
+        "prefixes nor tools.lint.threads.UNGUARDED_THREAD_ROLES (add a "
+        "guard prefix or a written exemption):\n" + "\n".join(uncovered)
+    )
+    # Exemptions carry written reasons, suppression-style.
+    assert all(reason.strip() for reason in UNGUARDED_THREAD_ROLES.values())
+
+
 def test_fault_sites_fixtures():
     broot = os.path.join(FIX, "fault_sites", "bad")
     bad = FaultSitesPass()
@@ -347,15 +465,16 @@ def test_suppression_without_reason_is_a_finding():
                for f in r.active), r.findings
 
 
-def test_registry_has_the_thirteen_passes():
+def test_registry_has_the_sixteen_passes():
     ids = [p.id for p in all_passes()]
     assert ids == [
         "attr-init", "metric-counters", "lock-discipline", "trace-safety",
         "terminal-event", "page-refcount", "config-drift", "fault-sites",
         "lock-order", "rng-key-reuse", "sharding-consistency",
-        "donation-safety", "journal-events",
+        "donation-safety", "journal-events", "shared-state-race",
+        "thread-affinity", "handoff-escape",
     ], ids
-    assert len(set(ids)) == 13
+    assert len(set(ids)) == 16
 
 
 # --------------------------------------------------------------------- #

@@ -1,0 +1,194 @@
+"""Hammer-style regression tests for the true positives the
+shared-state-race lint pass flushed out (ISSUE 15) — the same shape as
+PR 11's `Metrics._gauge_sources` test (tests/test_observe.py): drive the
+REAL fixed code paths from multiple threads and assert no update is lost
+and no iteration blows up. Each of these flaked (or silently drifted)
+against the pre-fix code.
+
+Kept deliberately cheap: one tiny paged engine per module plus bare-object
+hammers for the accounting primitives (no device work in the hot
+assertions)."""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import jax
+import pytest
+
+from localai_tpu.engine.engine import Engine, EngineConfig
+from localai_tpu.engine.tokenizer import ByteTokenizer
+from localai_tpu.models import get_arch
+from localai_tpu.models.llama import init_params
+
+PAGE = 32
+
+
+@pytest.fixture(scope="module")
+def paged_engine():
+    cfg = get_arch("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    eng = Engine(
+        cfg, params, ByteTokenizer(cfg.vocab_size),
+        engine_cfg=EngineConfig(
+            max_slots=2, max_seq=256, min_prefill_bucket=32,
+            kv_pages=16, kv_page_size=PAGE,
+            prefix_cache_entries=4, prefix_cache_min=PAGE,
+            kv_swap_bytes=1 << 20,
+        ),
+    )
+    eng.start()
+    yield eng
+    eng.stop()
+    eng.params = None
+    eng.cache = None
+
+
+def _hammer(n_threads, fn):
+    errors = []
+
+    def run():
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — the assertion is "none"
+            errors.append(e)
+
+    threads = [threading.Thread(target=run) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert not [t for t in threads if t.is_alive()]
+
+
+def test_span_import_reject_counter_survives_concurrent_rejects(paged_engine):
+    """m_span_import_rejects is bumped on caller threads (bad frame) and on
+    the loop (drain rejects) — pre-fix, concurrent increments lost counts.
+    Every concurrent garbage import must be accounted for exactly."""
+    eng = paged_engine
+    before = eng.m_span_import_rejects
+    per, n_threads = 25, 8
+
+    def reject_some():
+        for i in range(per):
+            assert eng.import_span_bytes(b"LAIKV\x00garbage-frame") is False
+
+    _hammer(n_threads, reject_some)
+    assert eng.m_span_import_rejects - before == per * n_threads
+
+
+def test_host_bytes_accounting_survives_concurrent_discards():
+    """stop()/cancel_all() discard queued resumes on caller threads while
+    the loop runs make-room — pre-fix the unlocked RMW on _host_bytes lost
+    updates and the host-tier budget drifted forever. Bare-object hammer of
+    the real primitives."""
+    eng = Engine.__new__(Engine)
+    eng._host_lock = threading.Lock()
+    eng._prefix_host = []
+    eng.ecfg = SimpleNamespace(kv_swap_bytes=1 << 30)
+    per, n_threads = 400, 8
+    eng._host_bytes = per * n_threads
+    reqs = [
+        [SimpleNamespace(resume={"bytes": 1, "hk": 0, "hv": 0})
+         for _ in range(per)]
+        for _ in range(n_threads)
+    ]
+    batches = iter(reqs)
+    lock = threading.Lock()
+
+    def discard_batch():
+        with lock:
+            mine = next(batches)
+        for r in mine:
+            eng._resume_discard(r)
+            assert eng._host_make_room(0) is True  # loop-side RMW partner
+
+    _hammer(n_threads, discard_batch)
+    assert eng._host_bytes == 0
+
+
+def test_metrics_scrape_survives_slot_spill_churn(paged_engine):
+    """/metrics renders on HTTP threads while the loop mutates the spill
+    bookkeeping — pre-fix, metrics() iterated the LIVE list/dicts
+    ("changed size during iteration" under churn). The fixed scrape copies
+    first; hammering both sides must never raise."""
+    eng = paged_engine
+    eng.m_kv_pages_spilled = max(eng.m_kv_pages_spilled, 1)  # enable branch
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            d = {}
+            eng._slot_spill.append(d)
+            d[i % 7] = i
+            if len(eng._slot_spill) > 4:
+                eng._slot_spill.pop(0)
+            i += 1
+
+    t = threading.Thread(target=churn)
+    t.start()
+    try:
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline:
+            m = eng.metrics()
+            assert "kv_spilled_pages" in m
+    except Exception as e:  # noqa: BLE001
+        errors.append(e)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not errors, errors
+
+
+def test_export_prefix_span_survives_prefix_churn(paged_engine):
+    """export_prefix_span runs on exporter (pump/HTTP) threads; pre-fix it
+    iterated the live _prefix_entries (the "atomic list-reference
+    snapshot" comment copied the REFERENCE, not the list). Export while
+    the tier churns must never raise."""
+    eng = paged_engine
+    stop = threading.Event()
+
+    def churn():
+        while not stop.is_set():
+            eng._prefix_entries.append({"pages": [], "valid": 0, "key": []})
+            if len(eng._prefix_entries) > 3:
+                eng._prefix_entries.pop(0)
+
+    t = threading.Thread(target=churn)
+    t.start()
+    try:
+        prompt = [(i * 37) % 251 + 1 for i in range(2 * PAGE)]
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline:
+            eng.export_prefix_span(prompt)  # None is fine; raising is not
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        eng._prefix_entries[:] = [e for e in eng._prefix_entries
+                                  if e.get("pages")]
+
+
+def test_explorer_probe_failures_survive_concurrent_probes(tmp_path):
+    """Discovery-loop probes and HTTP-triggered probes mutate the same
+    entry counters — pre-fix the unlocked `failures += 1` lost counts and
+    the drop threshold never fired under contention."""
+    from localai_tpu.explorer.explorer import (
+        Database, DiscoveryService, NetworkEntry,
+    )
+
+    db = Database(str(tmp_path / "db.json"))
+    entry = NetworkEntry(name="dead", url="http://127.0.0.1:9")
+    db.set(entry)
+    svc = DiscoveryService(db, failure_threshold=10**9)
+    per, n_threads = 10, 6
+
+    def probe_some():
+        for _ in range(per):
+            svc.probe(entry)
+
+    _hammer(n_threads, probe_some)
+    assert entry.failures == per * n_threads
+    assert entry.online is False

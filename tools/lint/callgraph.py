@@ -97,6 +97,13 @@ class CallGraph:
             self._module_names[p] = self._build_namespace(p)
         for p in self.paths:
             self._infer_attr_types(p)
+        # Local-type entries computed DURING attr-type inference cached
+        # without the self-attr-alias rule (`j = self._journal`) — flush so
+        # post-build consumers (summaries, passes) recompute with the full
+        # attr-type map available.
+        cache = getattr(repo, "_ltype_cache", None)
+        if cache is not None:
+            cache.clear()
 
     # ---------------- indexing ---------------- #
 
@@ -106,21 +113,31 @@ class CallGraph:
             if isinstance(node, astutil.FunctionNode):
                 fid = f"{path}::{node.name}"
                 self.funcs[fid] = FuncDef(fid, path, None, node.name, node)
-            elif isinstance(node, ast.ClassDef):
-                key = (path, node.name)
-                self.classes[key] = node
-                self._bases[key] = [
-                    b.id if isinstance(b, ast.Name) else getattr(b, "attr", "")
-                    for b in node.bases
-                ]
-                table: dict[str, str] = {}
-                for m in node.body:
-                    if isinstance(m, astutil.FunctionNode):
-                        fid = f"{path}::{node.name}.{m.name}"
-                        self.funcs[fid] = FuncDef(fid, path, node.name, m.name, m)
-                        table[m.name] = fid
-                        self.by_method.setdefault(m.name, []).append(fid)
-                self._methods[key] = table
+        # Classes are indexed at ANY depth (ISSUE 15): the HTTP handler
+        # classes this repo spawns threads into (`class Proxy(Base...)`
+        # inside FederationRouter._build, the server's RequestHandlerImpl)
+        # are defined inside builder functions, and the thread-model passes
+        # need their methods as roots. First definition of a name wins on
+        # the rare same-file collision.
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            key = (path, node.name)
+            if key in self.classes:
+                continue
+            self.classes[key] = node
+            self._bases[key] = [
+                b.id if isinstance(b, ast.Name) else getattr(b, "attr", "")
+                for b in node.bases
+            ]
+            table: dict[str, str] = {}
+            for m in node.body:
+                if isinstance(m, astutil.FunctionNode):
+                    fid = f"{path}::{node.name}.{m.name}"
+                    self.funcs[fid] = FuncDef(fid, path, node.name, m.name, m)
+                    table[m.name] = fid
+                    self.by_method.setdefault(m.name, []).append(fid)
+            self._methods[key] = table
 
     def _build_namespace(self, path: str) -> dict[str, tuple]:
         """Name -> target for module-level symbols AND imports (function-level
@@ -167,6 +184,11 @@ class CallGraph:
                       local_types: dict[str, set]) -> set:
         """Possible (path, cls) classes an expression evaluates to."""
         ns = self._module_names.get(path, {})
+        if isinstance(node, ast.IfExp):
+            # `EventJournal(n) if enabled else None` — the engine's
+            # feature-gated attr idiom: union of both arms.
+            return (self._type_of_expr(path, node.body, local_types)
+                    | self._type_of_expr(path, node.orelse, local_types))
         if isinstance(node, ast.Call):
             name = astutil.dotted_name(node.func)
             if not name:
@@ -223,10 +245,19 @@ class CallGraph:
                 if t:
                     types[a.arg] = set(t)
         fd = self._by_node.get(id(fn))
+        me = astutil.self_name(fn) if fd is not None and fd.cls else None
         assigns = [n for n in ast.walk(fn) if isinstance(n, ast.Assign)]
         for _round in range(2):
             for node in assigns:
                 t = self._type_of_expr(path, node.value, types)
+                if (not t and me is not None
+                        and isinstance(node.value, ast.Attribute)
+                        and isinstance(node.value.value, ast.Name)
+                        and node.value.value.id == me):
+                    # `j = self._journal` — the local carries the attr's
+                    # inferred type (the engine's local-alias idiom).
+                    t = set(self._attr_types.get(
+                        (path, fd.cls), {}).get(node.value.attr, ()))
                 if not t and fd is not None and isinstance(node.value, ast.Call):
                     # Bypass the memo: these resolutions run with PARTIAL
                     # type maps mid-build and must not poison later lookups.

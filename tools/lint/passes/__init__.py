@@ -7,6 +7,7 @@ from .attr_init import AttrInitPass
 from .config_drift import ConfigDriftPass
 from .donation_safety import DonationSafetyPass
 from .fault_sites import FaultSitesPass
+from .handoff_escape import HandoffEscapePass
 from .journal_events import JournalEventsPass
 from .lock_discipline import LockDisciplinePass
 from .lock_order import LockOrderPass
@@ -14,7 +15,9 @@ from .metric_counters import MetricCountersPass
 from .page_refcount import PageRefcountPass
 from .rng_key_reuse import RngKeyReusePass
 from .sharding_consistency import ShardingConsistencyPass
+from .shared_state_race import SharedStateRacePass
 from .terminal_event import TerminalEventPass
+from .thread_affinity import ThreadAffinityPass
 from .trace_safety import TraceSafetyPass
 
 
@@ -37,4 +40,9 @@ def all_passes():
         # Flight-recorder consistency (ISSUE 11): faults.SITES ↔ journal
         # fault event types, both directions.
         JournalEventsPass(),
+        # Thread-model passes (ISSUE 15): thread-root reachability ×
+        # attribute effect sets over the shared SummaryIndex.
+        SharedStateRacePass(),
+        ThreadAffinityPass(),
+        HandoffEscapePass(),
     ]

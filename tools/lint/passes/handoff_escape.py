@@ -1,0 +1,191 @@
+"""handoff-escape: objects published to another thread too early, or
+mutated after being handed off.
+
+Two shapes of the same ownership bug:
+
+1. **Publish before construction completes.** `__init__` (or a method it
+   calls) starts a thread — or puts `self` into a queue/registry — and
+   THEN keeps assigning attributes. The new thread can observe a
+   half-constructed object: exactly the BENCH_r05 class of AttributeError
+   (engine loop reading an attr `__init__` had not assigned yet), but
+   as a runtime interleaving instead of a missing line. A thread start in
+   construction is only flagged when a LATER-assigned attribute is
+   actually touched by the spawned root's reachable closure; a `self`
+   publish into a queue is flagged on any later assignment (the consumer
+   is unknowable).
+
+2. **Mutate after handoff.** `q.put(obj)` transfers ownership — the
+   consumer thread processes `obj` concurrently from that line on. A
+   producer that keeps writing `obj.attr` after the put races its own
+   consumer. (The drain-queue idiom is the blessed direction: the
+   CONSUMER writes results onto the entry it got; the producer only
+   reads them behind the `host_done` flag.)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import astutil
+from ..core import Finding, Pass, Repo
+from ..summaries import DEFAULT_SUMMARY_GLOBS, MUTATOR_METHODS
+from ..threads import threads_for
+
+
+class HandoffEscapePass(Pass):
+    id = "handoff-escape"
+    description = (
+        "object published to another thread before construction completes, "
+        "or mutated by the producer after a queue handoff"
+    )
+    project_wide = True
+
+    def __init__(self, globs=None):
+        self.globs = tuple(DEFAULT_SUMMARY_GLOBS if globs is None else globs)
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        model = threads_for(repo, self.globs)
+        idx = model.idx
+        graph = model.graph
+
+        # Construction-method fids per class (publish-point scope).
+        construction: dict[str, tuple[str, str]] = {}
+        for (path, cname) in graph.classes:
+            table = graph._methods.get((path, cname), {})
+            nodes = {n: graph.funcs[f].node for n, f in table.items()}
+            for name in astutil.construction_methods(nodes):
+                construction[table[name]] = (path, cname)
+
+        def reach_effect_objs(entry: str) -> set[str]:
+            """Attr objs the closure of one entry fid touches."""
+            seen: set[str] = set()
+            objs: set[str] = set()
+            frontier = [entry]
+            while frontier:
+                fid = frontier.pop()
+                if fid in seen:
+                    continue
+                seen.add(fid)
+                s = idx.summaries.get(fid)
+                if s is None:
+                    continue
+                for e in s.effects:
+                    objs.add(e.obj)
+                for site in s.calls:
+                    frontier.extend(site.callees)
+            return objs
+
+        def later_self_assigns(fn, me, after_line):
+            """[(attr, line)] of self.attr assignments after a line."""
+            got = []
+            for node in ast.walk(fn):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == me and node.lineno > after_line):
+                        got.append((t.attr, node.lineno))
+            return sorted(got, key=lambda p: p[1])
+
+        # ---- shape 1a: thread started during construction ---- #
+        for site in model.sites:
+            owner = construction.get(site.in_summary)
+            if owner is None or site.target_fid is None:
+                continue
+            path, cname = owner
+            fd = graph.funcs[site.in_summary]
+            me = astutil.self_name(fd.node)
+            if me is None:
+                continue
+            touched = reach_effect_objs(site.target_fid)
+            for attr, line in later_self_assigns(fd.node, me, site.line):
+                if f"{path}::{cname}.{attr}" in touched:
+                    out.append(self.finding(
+                        path, line,
+                        f"self.{attr} is assigned after the '{site.role}' "
+                        f"thread is started at line {site.line}, and that "
+                        f"thread's code touches it — the new thread can "
+                        f"observe a half-constructed {cname}; start "
+                        f"threads at the END of construction",
+                    ))
+                    break  # one witness per spawn site
+
+        # ---- shape 1b: `self` put into a queue/registry in __init__ ---- #
+        for fid, (path, cname) in construction.items():
+            fd = graph.funcs[fid]
+            me = astutil.self_name(fd.node)
+            if me is None:
+                continue
+            publish_line = None
+            for node in ast.walk(fd.node):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("put", "put_nowait", "append",
+                                               "add", "register")
+                        and not (isinstance(node.func.value, ast.Name)
+                                 and node.func.value.id == me)
+                        and any(isinstance(a, ast.Name) and a.id == me
+                                for a in node.args)):
+                    publish_line = node.lineno
+                    break
+            if publish_line is None:
+                continue
+            later = later_self_assigns(fd.node, me, publish_line)
+            if later:
+                attr, line = later[0]
+                out.append(self.finding(
+                    path, line,
+                    f"self.{attr} is assigned after `self` was published "
+                    f"into a queue/registry at line "
+                    f"{publish_line} — whoever consumes that handoff can "
+                    f"see a half-constructed {cname}; publish last",
+                ))
+
+        # ---- shape 2: producer mutates an object after q.put(obj) ---- #
+        for fid, fd in graph.funcs.items():
+            puts: list[tuple[int, str]] = []
+            for node in ast.walk(fd.node):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("put", "put_nowait")
+                        and len(node.args) >= 1
+                        and isinstance(node.args[0], ast.Name)):
+                    puts.append((node.lineno, node.args[0].id))
+            if not puts:
+                continue
+            for node in ast.walk(fd.node):
+                tgt = None
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)):
+                            tgt = (t.value.id, node.lineno)
+                elif (isinstance(node, ast.Call)
+                      and isinstance(node.func, ast.Attribute)
+                      and node.func.attr in MUTATOR_METHODS
+                      and isinstance(node.func.value, ast.Attribute)
+                      and isinstance(node.func.value.value, ast.Name)):
+                    tgt = (node.func.value.value.id, node.lineno)
+                if tgt is None:
+                    continue
+                var, line = tgt
+                first_put = next((pl for pl, pv in puts
+                                  if pv == var and line > pl), None)
+                if first_put is not None:
+                    out.append(self.finding(
+                        fd.path, line,
+                        f"{var} is written at line {line} after "
+                        f"being handed off via .put() at line {first_put} "
+                        f"— the consumer thread already owns it; finish "
+                        f"writes before the handoff (or hand back through "
+                        f"a reply queue)",
+                    ))
+                    break  # one witness per function
+        return out

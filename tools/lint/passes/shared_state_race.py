@@ -1,0 +1,272 @@
+"""shared-state-race: cross-thread-root attribute conflicts with no
+common lock.
+
+The incident class (PR 11): `Metrics.add_gauge_source()` appended to
+`self._gauge_sources` from registration threads while `/metrics` renders
+iterated it on HTTP handler threads — no lock in common, a
+mutation-during-iteration crash waiting for load. The convention the
+EventJournal relies on (loop-thread-only ring appends) had no checker at
+all. This pass joins the per-function attribute EFFECT SETS
+(tools.lint.summaries) with the thread-root reachability model
+(tools.lint.threads): an attribute mutated from one root and touched from
+another with no lock held in common across the conflicting pair is a
+finding.
+
+Precision rules (Python memory model, GIL):
+
+- A **rebind** (`self.x = v`) is an atomic reference swap: rebind-vs-read
+  across roots is SILENT (the reader sees the old or the new object, both
+  consistent). This is the thread-start/stop handoff idiom
+  (`self._thread = Thread(...)`) and flagging it would be noise.
+- A **mutate** (`+=`, `d[k] = v`, `.append()`, `del d[k]`) is a
+  read-modify-write. On a CONTAINER attribute, each single op is itself
+  GIL-atomic — what breaks is ITERATION from another root interleaving
+  with a structural mutation ("dict changed size during iteration",
+  skipped/duplicated elements), so container conflicts are
+  mutate-vs-iterate pairs. The staged-sidecar idiom (locked append +
+  unlocked `if not self._staged:` len-peek + locked swap) stays silent by
+  construction: the peek is a plain read. On a scalar/object attribute a
+  mutate conflicts with unlocked WRITES from another root (lost updates)
+  while cross-root reads of a single-writer counter stay silent (a torn
+  read of an int cannot happen; `/metrics` reading a slightly stale
+  `m_*` is by design).
+- Attributes holding synchronization/handoff objects (Lock, Event,
+  Condition, Semaphore, queue.Queue and anything `*Queue`) are the
+  BLESSED cross-thread idioms — put→get and set→wait carry their own
+  happens-before — and are exempt.
+- Accesses inside a class's construction methods happen before the object
+  is published to any other thread and are exempt (handoff-escape checks
+  the publish ordering itself).
+- `# thread: single-writer <role>` on an attribute assignment declares a
+  deliberately lock-free single-writer slot (the journal ring): writes
+  from any OTHER root are findings, cross-root best-effort reads are
+  blessed. `# thread: <role>-only` on a def attributes its accesses to
+  that root alone (the thread-affinity pass checks the declaration).
+- HTTP handler-class instance state (`BaseHTTPRequestHandler` subclasses)
+  is per-request/per-thread and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .. import astutil
+from ..core import Finding, Pass, Repo
+from ..summaries import DEFAULT_SUMMARY_GLOBS
+from ..threads import ThreadModel, role_matches, threads_for
+
+_SYNC_CTORS = {
+    "Lock", "RLock", "Condition", "Event", "Semaphore", "BoundedSemaphore",
+    "Barrier", "local", "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+}
+
+
+def _value_kind(v: ast.AST) -> str:
+    """'sync' | 'container' | 'scalar' | 'object' for one assigned value."""
+    if isinstance(v, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                      ast.DictComp, ast.SetComp)):
+        return "container"
+    if isinstance(v, ast.Constant) or (
+            isinstance(v, ast.UnaryOp) and isinstance(v.operand, ast.Constant)):
+        return "scalar"
+    if isinstance(v, ast.Call):
+        ctor = astutil.dotted_name(v.func).split(".")[-1]
+        if ctor in _SYNC_CTORS or ctor.endswith("Queue"):
+            return "sync"
+        if ctor in ("list", "dict", "set", "deque", "defaultdict",
+                    "OrderedDict", "Counter"):
+            return "container"
+        if ctor in ("int", "float", "bool", "str", "len", "monotonic",
+                    "time", "perf_counter"):
+            return "scalar"
+    return "object"
+
+
+class SharedStateRacePass(Pass):
+    id = "shared-state-race"
+    description = (
+        "attribute mutated from one thread root and touched from another "
+        "with no common lock (the Metrics._gauge_sources incident class)"
+    )
+    project_wide = True  # roots/effects span files; --since cannot narrow
+
+    def __init__(self, globs=None):
+        self.globs = tuple(DEFAULT_SUMMARY_GLOBS if globs is None else globs)
+
+    # ------------- per-class attribute classification ------------- #
+
+    def _attr_kinds(self, model: ThreadModel) -> dict[str, str]:
+        """obj id -> sync/container/scalar/object, from every value ever
+        assigned to the attribute anywhere in its class (sync wins, then
+        container: `self._x = None` in __init__ rebound to a dict later is
+        a container)."""
+        rank = {"sync": 3, "container": 2, "object": 1, "scalar": 0}
+        kinds: dict[str, str] = {}
+        for (path, cname), cls in model.graph.classes.items():
+            for m in cls.body:
+                if not isinstance(m, astutil.FunctionNode):
+                    continue
+                me = astutil.self_name(m)
+                if me is None:
+                    continue
+                for node in ast.walk(m):
+                    if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                        continue
+                    if node.value is None:
+                        continue
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        if not (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == me):
+                            continue
+                        obj = f"{path}::{cname}.{t.attr}"
+                        k = _value_kind(node.value)
+                        if obj not in kinds or rank[k] > rank[kinds[obj]]:
+                            kinds[obj] = k
+        return kinds
+
+    def _construction_fids(self, model: ThreadModel) -> set[str]:
+        """Fids that run during their own class's construction — effects
+        there happen before the object is shared."""
+        out: set[str] = set()
+        for (path, cname) in model.graph.classes:
+            table = model.graph._methods.get((path, cname), {})
+            nodes = {n: model.graph.funcs[f].node for n, f in table.items()}
+            for name in astutil.construction_methods(nodes):
+                out.add(table[name])
+        return out
+
+    # ------------- the pass ------------- #
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        model = threads_for(repo, self.globs)
+        idx = model.idx
+        kinds = self._attr_kinds(model)
+        construction = self._construction_fids(model)
+        handler_cls = {f"{p}::{c}." for (p, c) in model._handler_classes()}
+        roots = {r.role: r for r in model.roots}
+
+        # obj -> role -> [(effect, fid)]
+        acc: dict[str, dict[str, list]] = {}
+        for root in model.roots:
+            for fid in model.reach(root):
+                s = idx.summaries.get(fid)
+                if s is None or not s.effects:
+                    continue
+                decl = model.affinity.get(fid)
+                if decl is not None and not role_matches(decl[0], root):
+                    # Declared single-owner: the thread-affinity pass
+                    # reports foreign reachability; attributing the
+                    # effects here too would double-report every access.
+                    continue
+                base = fid.split("@")[0].rsplit(".", 1)[0] if "@" in fid else fid
+                in_ctor = fid in construction or base in construction
+                for e in s.effects:
+                    if in_ctor and e.obj.startswith(f"{s.path}::{s.cls}."):
+                        continue  # pre-publication
+                    if any(e.obj.startswith(h) for h in handler_cls):
+                        continue  # per-request handler instance state
+                    acc.setdefault(e.obj, {}).setdefault(
+                        root.role, []).append((e, fid))
+
+        def fname(fid: str) -> str:
+            s = idx.summaries.get(fid)
+            if s is None:
+                return fid
+            return f"{s.cls + '.' if s.cls else ''}{s.name}()"
+
+        def short(obj: str) -> str:
+            path, _, qual = obj.partition("::")
+            return f"{path.rsplit('/', 1)[-1]}::{qual}"
+
+        for obj in sorted(acc):
+            byrole = acc[obj]
+            if obj in model.instance_owned:
+                # Each instance is owned by one thread at a time (per-
+                # request objects; ownership transfers by pop/queue) —
+                # class-granularity conflicts are cross-instance noise.
+                continue
+            sw = model.single_writer.get(obj)
+            if sw is not None:
+                declared, dpath, dline = sw
+                for role in sorted(byrole):
+                    if role_matches(declared, roots[role]):
+                        continue
+                    for e, fid in sorted(byrole[role],
+                                         key=lambda p: p[0].line):
+                        if e.kind in ("rebind", "mutate"):
+                            out.append(self.finding(
+                                e.obj.partition("::")[0], e.line,
+                                f"{short(obj)} is declared `# thread: "
+                                f"single-writer {declared}` "
+                                f"({dpath}:{dline}) but {fname(fid)} "
+                                f"writes it from thread root "
+                                f"'{role}' — the lock-free slot has "
+                                f"exactly one blessed writer",
+                            ))
+                            break
+                continue
+            kind = kinds.get(obj, "container" if "." not in
+                             obj.partition("::")[2] else "object")
+            if kind == "sync":
+                continue
+            mutates = []
+            for role in sorted(byrole):
+                for e, fid in byrole[role]:
+                    if e.kind == "mutate":
+                        mutates.append((role, e, fid))
+            if not mutates:
+                continue
+            mutates.sort(key=lambda t: (t[0], t[1].line))
+            hit: Optional[tuple] = None
+            for roleA, e1, fid1 in mutates:
+                for roleB in sorted(byrole):
+                    if hit:
+                        break
+                    same = roleB == roleA
+                    if same and not roots[roleA].multi:
+                        continue
+                    for e2, fid2 in sorted(byrole[roleB],
+                                           key=lambda p: p[0].line):
+                        if e2 is e1:
+                            continue
+                        if same and fid2 == fid1:
+                            # Two instances of a multi root in the SAME
+                            # function: overwhelmingly per-instance state
+                            # (each pump/handler works its own object).
+                            # Cross-function same-role conflicts (one
+                            # handler registers, another iterates) stand.
+                            continue
+                        if kind == "container":
+                            if e2.kind != "iterate":
+                                continue  # single container ops are
+                                #           GIL-atomic; iteration is not
+                        elif e2.kind in ("read", "iterate"):
+                            continue  # stale-read-tolerant scalar scrape
+                        if set(e1.held) & set(e2.held):
+                            continue
+                        hit = (roleA, e1, fid1, roleB, e2, fid2, same)
+                        break
+                if hit:
+                    break
+            if not hit:
+                continue
+            roleA, e1, fid1, roleB, e2, fid2, same = hit
+            verb = {"read": "read", "iterate": "iterated",
+                    "rebind": "written", "mutate": "mutated"}[e2.kind]
+            other = (f"another '{roleB}' thread" if same
+                     else f"thread root '{roleB}'")
+            out.append(self.finding(
+                e1.obj.partition("::")[0], e1.line,
+                f"{short(obj)} ({kind}) mutated by {fname(fid1)} on thread "
+                f"root '{roleA}' and {verb} by {fname(fid2)} on {other} "
+                f"(line {e2.line}) with no lock in common — hold one lock "
+                f"across both sides, hand off through a queue, or declare "
+                f"`# thread: single-writer <role>` if the slot is "
+                f"deliberately lock-free",
+            ))
+        return out

@@ -1,0 +1,88 @@
+"""thread-affinity: `# thread: <role>-only` declarations, checked.
+
+The EventJournal's lock-free ring append is safe because exactly one
+thread (the engine loop) ever calls it — a convention that, before this
+pass, lived in a docstring. A declaration comment on the def makes the
+ownership machine-checked:
+
+    # thread: engine-loop-only
+    def append(self, event, ...):
+
+Findings:
+- the declared function is REACHABLE (through the interprocedural call
+  graph) from any thread root that does not match the declared role —
+  the convention is being violated, or the graph got a new edge nobody
+  noticed;
+- the declared role matches NO discovered thread root (stale declaration:
+  the role was renamed or deleted — an unchecked comment is worse than
+  none);
+- same staleness check for `# thread: single-writer <role>` attribute
+  declarations (enforced by shared-state-race; validated here).
+
+Declared functions are excluded from the `main` root's public-entry
+surface — the declaration IS the statement that callers on arbitrary
+threads must not call it — so the check bites exactly when a real call
+chain from another root exists.
+"""
+
+from __future__ import annotations
+
+from ..core import Finding, Pass, Repo
+from ..summaries import DEFAULT_SUMMARY_GLOBS
+from ..threads import role_matches, threads_for
+
+
+class ThreadAffinityPass(Pass):
+    id = "thread-affinity"
+    description = (
+        "`# thread: <role>-only` declaration violated (reachable from a "
+        "foreign thread root) or stale (no such root)"
+    )
+    project_wide = True
+
+    def __init__(self, globs=None):
+        self.globs = tuple(DEFAULT_SUMMARY_GLOBS if globs is None else globs)
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        model = threads_for(repo, self.globs)
+        roles = sorted({r.role for r in model.roots})
+
+        for fid in sorted(model.affinity):
+            declared, path, line = model.affinity[fid]
+            matched = [r for r in model.roots if role_matches(declared, r)]
+            if not matched:
+                out.append(self.finding(
+                    path, line,
+                    f"`# thread: {declared}-only` names no discovered "
+                    f"thread root (known roots: {', '.join(roles)}) — "
+                    f"the role was renamed or removed; fix or drop the "
+                    f"declaration",
+                ))
+                continue
+            for root in model.roots:
+                if role_matches(declared, root):
+                    continue
+                if fid in model.reach(root):
+                    s = model.idx.summaries.get(fid)
+                    where = (f"{s.cls + '.' if s and s.cls else ''}"
+                             f"{s.name if s else fid}")
+                    out.append(self.finding(
+                        path, line,
+                        f"{where}() is declared `# thread: {declared}-only` "
+                        f"but is reachable from thread root '{root.role}' "
+                        f"— a foreign thread can enter the single-owner "
+                        f"path; break the call chain or widen the "
+                        f"declaration",
+                    ))
+        for obj in sorted(model.single_writer):
+            declared, path, line = model.single_writer[obj]
+            if not any(role_matches(declared, r) for r in model.roots):
+                out.append(self.finding(
+                    path, line,
+                    f"`# thread: single-writer {declared}` on "
+                    f"{obj.partition('::')[2]} names no discovered thread "
+                    f"root (known roots: {', '.join(roles)}) — stale "
+                    f"declaration",
+                ))
+        return out

@@ -1,8 +1,8 @@
 """Per-function summaries for interprocedural passes (ISSUE 8).
 
 One walk per function computes everything the new passes consume, cached on
-the Repo next to the AST/module cache so the four passes (and a --since
-rerun) share a single build:
+the Repo next to the AST/module cache so the consuming passes (and a
+--since rerun) share a single build:
 
   - locks:     which locks a function ACQUIRES (`with self.lock:` /
                `with MODULE_LOCK:`), which locks are held AT each
@@ -20,10 +20,28 @@ rerun) share a single build:
   - donation:  whether the function returns a `jax.jit(..., donate_argnums=...)`
                callable and which positions are ALWAYS donated (the literal
                base tuple; conditional extensions are not claimed).
+  - effects:   attribute EFFECT SETS (ISSUE 15) — every `self.attr` /
+               typed-receiver attribute / module-global access the function
+               makes, with the held-lock set at the access and a kind:
+               "read" (a Load), "rebind" (the slot is re-pointed — a
+               GIL-atomic reference swap), or "mutate" (read-modify-write:
+               AugAssign, subscript store/delete, or a mutator method like
+               .append()/.update() called on the attribute). The
+               shared-state-race / thread-affinity / handoff-escape passes
+               join these with the thread-root reachability model
+               (tools.lint.threads) to find cross-thread conflicts.
 
 The fixpoint (`may_acquire`) propagates lock acquisition up the call graph
 until stable, which is what turns "this function takes a lock" into "this
 call may take that lock while you hold yours" — the lock-order edge.
+
+Nested `def`s are summarized too (synthetic fid `{parent}::{name}@{line}`
+under `SummaryIndex.nested_defs`): `threading.Thread(target=work)` bodies
+are real thread roots, and their effects/locks must not vanish just
+because the function is a closure. A nested def inside a method inherits
+the enclosing receiver name, so its `self.x` accesses resolve; its
+held-set starts EMPTY (it runs later, on another thread — a `with lock:`
+around the `def` statement does not protect the body).
 """
 
 from __future__ import annotations
@@ -41,12 +59,17 @@ _LOCK_CTORS = {"Lock", "RLock", "Condition"}
 # The union of every interprocedural pass's default targets: passes running
 # with DEFAULT scope share ONE SummaryIndex build under this key instead of
 # each building their own (fixture runs with custom globs still get their
-# own small index).
+# own small index). The thread-model passes (ISSUE 15) widened this to the
+# full threaded surface: server/, observe/, explorer/, services/, gallery/.
 DEFAULT_SUMMARY_GLOBS = (
     "localai_tpu/engine/*.py",
-    "localai_tpu/server/manager.py",
+    "localai_tpu/server/*.py",
     "localai_tpu/federation/router.py",
     "localai_tpu/cluster/*.py",
+    "localai_tpu/observe/*.py",
+    "localai_tpu/explorer/*.py",
+    "localai_tpu/services/*.py",
+    "localai_tpu/gallery/*.py",
     "localai_tpu/models/*.py",
     "localai_tpu/ops/*.py",
     "localai_tpu/parallel/*.py",
@@ -64,6 +87,45 @@ KEY_CONSUMERS = {
     "split",
 }
 KEY_PARAM_NAMES = {"key", "rng", "rngs", "prng_key", "base_key"}
+
+# Method names that MUTATE their receiver in place. Calling one of these on
+# `self.attr` is a read-modify-write of shared structure — the
+# `_gauge_sources.append()` vs `/metrics` iterate incident class (PR 11) —
+# and is recorded as a "mutate" effect, unlike a plain Load.
+MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "pop",
+    "popleft", "popitem", "remove", "discard", "clear", "add", "update",
+    "setdefault", "sort", "reverse", "rotate", "fill", "subtract",
+})
+
+# Containers whose constructor at module level makes a name a tracked
+# module-global mutable (functions reading/mutating it are effects).
+_CONTAINER_CTORS = frozenset({
+    "list", "dict", "set", "deque", "defaultdict", "OrderedDict", "Counter",
+})
+
+
+def module_mutables(tree: ast.Module) -> set[str]:
+    """Module-level names bound to mutable containers — the module-global
+    half of the effect-set model. Constants (UPPER_CASE tuples/strings) and
+    rebindable scalars are not tracked; container identity is what threads
+    share."""
+    out: set[str] = set()
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        is_container = isinstance(v, (ast.List, ast.Dict, ast.Set,
+                                      ast.ListComp, ast.DictComp, ast.SetComp))
+        if isinstance(v, ast.Call):
+            ctor = astutil.dotted_name(v.func).split(".")[-1]
+            is_container = ctor in _CONTAINER_CTORS
+        if not is_container:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
 
 
 def class_lock_attrs(cls: ast.ClassDef) -> dict[str, str]:
@@ -109,6 +171,24 @@ class CallSite:
     self_call: bool  # receiver provably the same instance (`self.m()`)
 
 
+@dataclasses.dataclass(frozen=True)
+class Effect:
+    obj: str                # "path::Class.attr" or "path::NAME" (mod global)
+    kind: str               # "read" | "iterate" | "rebind" | "mutate"
+    held: tuple[str, ...]   # locks held at the access
+    line: int
+
+
+# Builtins whose argument is ITERATED with interleaving bytecodes — a
+# concurrent structural mutation can raise "changed size during
+# iteration" or skip/duplicate elements. (list()/tuple()/dict() copies
+# are single C calls and count as plain reads: GIL-atomic.)
+_ITERATING_FUNCS = frozenset({
+    "sorted", "sum", "any", "all", "max", "min", "enumerate", "zip",
+    "map", "filter",
+})
+
+
 @dataclasses.dataclass
 class FuncSummary:
     fid: str
@@ -120,6 +200,7 @@ class FuncSummary:
     calls: tuple[CallSite, ...]
     key_params_consumed: tuple[str, ...]
     donates: Optional[tuple[int, ...]]  # returned-callable donated positions
+    effects: tuple[Effect, ...] = ()
 
 
 class SummaryIndex:
@@ -139,12 +220,18 @@ class SummaryIndex:
             self._class_locks[(path, cname)] = attrs
             for attr, ctor in attrs.items():
                 self.lock_kinds[f"{path}::{cname}.{attr}"] = ctor
+        self._module_mutables: dict[str, set[str]] = {}
         for path in graph.paths:
             mlocks = module_lock_names(repo.tree(path))
             self._module_locks[path] = mlocks
             for name, ctor in mlocks.items():
                 self.lock_kinds[f"{path}::{name}"] = ctor
-        for fid, fd in graph.funcs.items():
+            self._module_mutables[path] = module_mutables(repo.tree(path))
+        # (parent fid, nested def name) -> synthetic fid, for thread-root
+        # discovery (`threading.Thread(target=work)` where work is a
+        # closure). Synthetic summaries live in self.summaries too.
+        self.nested_defs: dict[tuple[str, str], str] = {}
+        for fid, fd in list(graph.funcs.items()):
             self.summaries[fid] = self._summarize(fd)
         self._may_acquire: Optional[dict[str, set[str]]] = None
 
@@ -229,34 +316,198 @@ class SummaryIndex:
             if a.arg in KEY_PARAM_NAMES or a.arg.endswith("_key")
         }
 
-    def _summarize(self, fd: FuncDef) -> FuncSummary:
-        me = astutil.self_name(fd.node) if fd.cls else None
+    def _is_method_attr(self, path: str, cls: Optional[str],
+                        attr: str) -> bool:
+        """Loading a bound method (`self.m` in `self.m()`) is not a state
+        read — filter those out of the effect set."""
+        if cls is None:
+            return False
+        return self.graph.method_fid(path, cls, attr) is not None
+
+    _UNSET = object()
+
+    def _summarize(self, fd: FuncDef, me_override=_UNSET) -> FuncSummary:
+        if me_override is not self._UNSET:
+            me = me_override  # nested def: the enclosing receiver closes over
+        else:
+            me = astutil.self_name(fd.node) if fd.cls else None
         entry = self._entry_locks(fd)
-        ltypes = self.graph.local_types(fd.path, fd.node)
+        ltypes = dict(self.graph.local_types(fd.path, fd.node))
+        if me_override is not self._UNSET and me is not None and fd.cls is not None:
+            # Let `self.m()` resolve inside the closure: the free receiver
+            # is typed as the enclosing class.
+            ltypes.setdefault(me, set()).add((fd.path, fd.cls))
         acquisitions: list[Acquisition] = []
         calls: list[CallSite] = []
+        effects: list[Effect] = []
         key_params = self._key_params(fd.node)
         keys_consumed: set[str] = set()
         has_jit = False
+        nested: list = []
+        globals_here = self._module_mutables.get(fd.path, set())
+        # Names the function declares `global` (stores rebind the module
+        # binding) vs names it shadows with a local assignment or param.
+        gdecl: set[str] = set()
+        shadowed: set[str] = set()
+        a = fd.node.args
+        for p in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs):
+            shadowed.add(p.arg)
+        for sub in ast.walk(fd.node):
+            if isinstance(sub, ast.Global):
+                gdecl |= set(sub.names)
+            elif isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+                shadowed.add(sub.id)
+        shadowed -= gdecl
+        # Attribute nodes already consumed by a write/mutate record — their
+        # Load visit must not double-report a read.
+        claimed: set[int] = set()
+
+        def attr_obj(node: ast.AST) -> Optional[tuple[str, ast.AST]]:
+            """(effect object id, the Attribute node) for `self.x` /
+            `typed_local.x` receivers; None when the receiver is unknown."""
+            if not isinstance(node, ast.Attribute):
+                return None
+            v = node.value
+            if isinstance(v, ast.Name):
+                if me is not None and v.id == me and fd.cls is not None:
+                    return (f"{fd.path}::{fd.cls}.{node.attr}", node)
+                cands = ltypes.get(v.id, ())
+                if len(cands) == 1 and v.id != me:
+                    (cp, cc), = cands
+                    return (f"{cp}::{cc}.{node.attr}", node)
+            return None
+
+        def store_target(t: ast.AST, held: tuple[str, ...],
+                         kind_for_attr: str) -> None:
+            """Record effects for one assignment target (Tuple/Starred
+            unpacked). kind_for_attr: 'rebind' for =, 'mutate' for +=."""
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    store_target(e, held, kind_for_attr)
+                return
+            if isinstance(t, ast.Starred):
+                store_target(t.value, held, kind_for_attr)
+                return
+            if isinstance(t, ast.Attribute):
+                got = attr_obj(t)
+                if got is not None:
+                    effects.append(Effect(got[0], kind_for_attr, held, t.lineno))
+                    return
+                # `self.cfg.field = v` — a field write THROUGH an attribute
+                # is a mutation of that attribute's object.
+                inner = attr_obj(t.value)
+                if inner is not None:
+                    claimed.add(id(inner[1]))
+                    effects.append(Effect(inner[0], "mutate", held, t.lineno))
+                return
+            if isinstance(t, ast.Subscript):
+                inner = attr_obj(t.value)
+                if inner is not None:
+                    claimed.add(id(inner[1]))
+                    effects.append(Effect(inner[0], "mutate", held, t.lineno))
+                elif (isinstance(t.value, ast.Name)
+                      and t.value.id in globals_here
+                      and t.value.id not in shadowed):
+                    effects.append(Effect(f"{fd.path}::{t.value.id}", "mutate",
+                                          held, t.lineno))
+                return
+            if isinstance(t, ast.Name) and t.id in gdecl and t.id in globals_here:
+                effects.append(Effect(f"{fd.path}::{t.id}", "rebind",
+                                      held, t.lineno))
+
+        def mark_iterates(exprs, held: tuple[str, ...]) -> None:
+            """Attr/global loads inside an iteration expression are
+            'iterate' effects — the dangerous container read. Loads wrapped
+            in an atomic copy (`for g in list(self.galleries)`,
+            `sorted(list(self.events))`) iterate the COPY, not the shared
+            object, and stay plain reads."""
+            def nodes_outside_copies(expr):
+                if (isinstance(expr, ast.Call)
+                        and isinstance(expr.func, ast.Name)
+                        and expr.func.id in ("list", "tuple", "set", "dict",
+                                             "frozenset", "bytes",
+                                             "bytearray")):
+                    return
+                yield expr
+                for child in ast.iter_child_nodes(expr):
+                    yield from nodes_outside_copies(child)
+
+            for expr in exprs:
+                if expr is None:
+                    continue
+                for sub in nodes_outside_copies(expr):
+                    if (isinstance(sub, ast.Attribute)
+                            and isinstance(sub.ctx, ast.Load)
+                            and id(sub) not in claimed):
+                        got = attr_obj(sub)
+                        if got is not None:
+                            claimed.add(id(sub))
+                            effects.append(Effect(got[0], "iterate", held,
+                                                  sub.lineno))
+                    elif (isinstance(sub, ast.Name)
+                          and isinstance(sub.ctx, ast.Load)
+                          and sub.id in globals_here
+                          and sub.id not in shadowed
+                          and id(sub) not in claimed):
+                        claimed.add(id(sub))
+                        effects.append(Effect(f"{fd.path}::{sub.id}",
+                                              "iterate", held, sub.lineno))
 
         def walk(node: ast.AST, held: tuple[str, ...]) -> None:
             nonlocal has_jit
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                mark_iterates([node.iter], held)
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                mark_iterates([g.iter for g in node.generators], held)
+            elif (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                  and node.func.id in _ITERATING_FUNCS):
+                mark_iterates(node.args, held)
             if isinstance(node, ast.With):
                 for item in node.items:
                     lock = self._lock_id_for_with(fd, item.context_expr, me)
                     if lock is not None:
                         acquisitions.append(Acquisition(lock, held, node.lineno))
                         held = held + (lock,)
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    store_target(t, held, "rebind")
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                store_target(node.target, held, "rebind")
+            elif isinstance(node, ast.AugAssign):
+                store_target(node.target, held, "mutate")
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    store_target(t, held, "rebind" if isinstance(t, ast.Attribute)
+                                 else "mutate")
             if isinstance(node, ast.Call):
                 name = astutil.dotted_name(node.func)
                 if name in ("jax.jit", "jit"):
                     has_jit = True
                 if (key_params and name.startswith("jax.random.")
                         and name.split(".")[-1] in KEY_CONSUMERS):
-                    for a in node.args:
-                        for sub in ast.walk(a):
+                    for arg in node.args:
+                        for sub in ast.walk(arg):
                             if isinstance(sub, ast.Name) and sub.id in key_params:
                                 keys_consumed.add(sub.id)
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in MUTATOR_METHODS):
+                    recv = node.func.value
+                    got = attr_obj(recv)
+                    if got is not None:
+                        claimed.add(id(got[1]))
+                        effects.append(Effect(got[0], "mutate", held,
+                                              node.lineno))
+                    elif (isinstance(recv, ast.Subscript)):
+                        inner = attr_obj(recv.value)
+                        if inner is not None:
+                            claimed.add(id(inner[1]))
+                            effects.append(Effect(inner[0], "mutate", held,
+                                                  node.lineno))
+                    elif (isinstance(recv, ast.Name) and recv.id in globals_here
+                          and recv.id not in shadowed):
+                        effects.append(Effect(f"{fd.path}::{recv.id}", "mutate",
+                                              held, node.lineno))
                 cands = self.graph.resolve(fd, node, local_types=ltypes)
                 is_self = (
                     isinstance(node.func, ast.Attribute)
@@ -265,13 +516,30 @@ class SummaryIndex:
                 )
                 if cands:
                     calls.append(CallSite(cands, held, node.lineno, is_self))
+            if (isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load)
+                    and id(node) not in claimed):
+                got = attr_obj(node)
+                if got is not None:
+                    obj = got[0]
+                    _, _, qual = obj.partition("::")
+                    ocls, _, oattr = qual.rpartition(".")
+                    opath = obj.partition("::")[0]
+                    if not self._is_method_attr(opath, ocls or None, oattr):
+                        effects.append(Effect(obj, "read", held, node.lineno))
+            if (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)
+                    and node.id in globals_here and node.id not in shadowed
+                    and id(node) not in claimed):
+                effects.append(Effect(f"{fd.path}::{node.id}", "read", held,
+                                      node.lineno))
             for child in ast.iter_child_nodes(node):
-                # Nested defs execute later, not here — their bodies are
-                # summarized separately (and a `with lock:` wrapping a def
-                # does NOT mean the def runs locked). The jit/key scans DO
-                # cover nested defs: a builder's nested jitted fn is the
-                # whole point of the donation summary.
+                # Nested defs execute later, not here — their bodies become
+                # SYNTHETIC summaries below (a `with lock:` wrapping a def
+                # does NOT mean the def runs locked, so their held-set
+                # starts empty). The jit scan still covers them inline: a
+                # builder's nested jitted fn is the whole point of the
+                # donation summary.
                 if isinstance(child, astutil.FunctionNode) and child is not fd.node:
+                    nested.append(child)
                     for sub in ast.walk(child):
                         if (isinstance(sub, ast.Call)
                                 and astutil.dotted_name(sub.func)
@@ -282,6 +550,11 @@ class SummaryIndex:
                 walk(child, held)
 
         walk(fd.node, entry)
+        for child in nested:
+            nfid = f"{fd.fid}.{child.name}@{child.lineno}"
+            nfd = FuncDef(nfid, fd.path, fd.cls, child.name, child)
+            self.nested_defs[(fd.fid, child.name)] = nfid
+            self.summaries[nfid] = self._summarize(nfd, me_override=me)
         return FuncSummary(
             fid=fd.fid, path=fd.path, cls=fd.cls, name=fd.name,
             entry_locks=entry,
@@ -289,6 +562,7 @@ class SummaryIndex:
             calls=tuple(calls),
             key_params_consumed=tuple(sorted(keys_consumed)),
             donates=self._donated_positions(fd.node) if has_jit else None,
+            effects=tuple(effects),
         )
 
     # ---------------- fixpoint ---------------- #

@@ -1,0 +1,491 @@
+"""Thread model for localai-lint (ISSUE 15): thread-root discovery,
+per-root reachability, and `# thread:` declarations.
+
+The serving core is a dozen cooperating thread roles — engine loop,
+journal drainer, watchdog, config watcher, cluster pumps, HTTP handler
+threads, stream readers, federation prober — sharing mutable engine /
+manager / metrics state. PR 11 hand-fixed a data race in
+`Metrics._gauge_sources` (add_gauge_source vs /metrics render), and the
+EventJournal's lock-free loop-thread append is safe only by an ownership
+convention nothing checked. This module makes the thread structure itself
+a lint-visible artifact:
+
+- **Roots**: every `threading.Thread(target=...)` site project-wide is a
+  root (role = the thread's `name=` pattern, `cluster-pump-{rid}` →
+  `cluster-pump-*`); HTTP handler methods (router registrations in
+  `server/`, `BaseHTTPRequestHandler` subclasses in `federation/` /
+  `explorer/` — nested classes included) form one multi-instance
+  `http-handler` root; and everything else a library user may call lands
+  in the `main` root (all public functions/methods not owned by another
+  root).
+- **Reachability**: per-root reachable function sets over the
+  interprocedural call graph (tools.lint.callgraph + summaries) — the
+  attribution that turns a per-function attribute effect set into "root A
+  writes this, root B reads it".
+- **Declarations**: `# thread: <role>-only` on a `def` makes single-owner
+  code explicit (EventJournal.append, slot-table mutators); `# thread:
+  single-writer <role>` on an `__init__` attribute assignment blesses a
+  deliberately lock-free single-writer/best-effort-reader slot (the
+  journal ring). Both are *checked*: the thread-affinity pass reports
+  declared functions reachable from foreign roots and stale roles; the
+  shared-state-race pass reports writes to a single-writer slot from any
+  other role.
+
+The conftest thread-leak guard and this discovery share ONE source:
+`GUARDED_THREAD_PREFIXES` below is imported by tests/conftest.py, and a
+drift test in tests/test_lint.py fails when a discovered Thread site is
+covered by neither the guard list nor `UNGUARDED_THREAD_ROLES` (each
+exemption carries a written reason, suppression-style).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import re
+from typing import Optional
+
+from . import astutil
+from .core import Repo
+from .summaries import DEFAULT_SUMMARY_GLOBS, SummaryIndex, summaries_for
+
+# ---------------------------------------------------------------------- #
+# The conftest thread-leak guard's watch list (single source, ISSUE 15):
+# threads with these name prefixes must be GONE after each test module.
+# ---------------------------------------------------------------------- #
+GUARDED_THREAD_PREFIXES = (
+    "engine-loop",
+    "engine-drain",
+    "watchdog",
+    "config-watcher",
+    "stream-reader",
+    "fed-health",
+    # Cluster scheduler threads (ISSUE 8 satellite): the per-request
+    # dispatch pumps ("cluster-pump-<rid>") own the reroute path AND the
+    # scheduler's gauge refresh (refresh() runs inline on them). A pump
+    # that outlives its request means a terminal event was never posted
+    # (the ClusterClient _finish/_abort contract) and the thread spins on
+    # a dead handle forever. "cluster-gauge" guards any future dedicated
+    # refresher thread.
+    "cluster-pump",
+    "cluster-gauge",
+)
+
+# Thread roles discovery knows about that the leak guard deliberately does
+# NOT watch. Every entry needs a written reason — the drift test fails on
+# a role covered by neither list. Patterns are fnmatch'd against the
+# discovered role.
+UNGUARDED_THREAD_ROLES = {
+    "prefix-admit-compile": "one-shot AOT compile worker; exits after "
+                            "publishing (or failing) its executable",
+    "grammar-dfa-build": "one-shot DFA table build; exits after caching",
+    "model-teardown": "one-shot crash-eviction teardown; exits after "
+                      "freeing the dead engine",
+    "span-import": "one-shot span-transfer merge worker; exits after the "
+                   "import settles (done Event)",
+    "fed-server": "ThreadingHTTPServer acceptor; lives for the router's "
+                  "lifetime, stopped by server.shutdown() in stop()",
+    "explorer-server": "ThreadingHTTPServer acceptor for the explorer UI; "
+                       "stopped by server.shutdown()",
+    "explorer-discovery": "explorer poller with its own stop() Event; "
+                          "holds HTTP handles only, never engine state",
+    "gallery-install": "daemon job worker parked on its queue between "
+                       "installs; holds no engine/device handles",
+    "agent-jobs": "scheduler loop with its own stop() Event, joined in "
+                  "stop(); no engine handles held between ticks",
+    "multihost-drain": "pipe drain for a child worker process; exits when "
+                       "the child's stdout closes",
+    "models-import": "one-shot model-import job worker (models_api); "
+                     "terminal state recorded on the job dict",
+    "unload-drain": "one-shot drain-then-teardown worker for an explicit "
+                    "unload; exits after drain_s at the latest",
+}
+
+# Matches `# thread: <role>-only` (function affinity declaration).
+_AFFINITY_RE = re.compile(r"#\s*thread:\s*(?P<role>[a-z0-9_*-]+?)-only\b")
+# Matches `# thread: single-writer <role>` (attribute declaration).
+_SINGLE_WRITER_RE = re.compile(
+    r"#\s*thread:\s*single-writer\s+(?P<role>[a-z0-9_*-]+)"
+)
+# Matches `# thread: instance-owned <why>` (attribute declaration): each
+# INSTANCE is owned/serialized by exactly one thread at a time (per-request
+# objects, ownership handed over by a pop/queue). Class-level sharing
+# analysis cannot see instance boundaries, so the owner states them.
+_INSTANCE_OWNED_RE = re.compile(r"#\s*thread:\s*instance-owned\b")
+
+_HTTP_VERBS = {"GET", "POST", "PUT", "DELETE", "PATCH", "HEAD", "OPTIONS"}
+
+
+@dataclasses.dataclass
+class ThreadSite:
+    """One `threading.Thread(...)` construction site."""
+    path: str
+    line: int
+    role: str                 # canonical role ("engine-loop", "models-import")
+    pattern: str              # thread-name pattern ("cluster-pump-*"); ""
+    #                           when the site passes no name= kwarg
+    target_fid: Optional[str]  # resolved entry, None for lambda/unknown
+    multi: bool               # several live instances possible
+    in_summary: str           # fid of the function containing the site
+
+
+@dataclasses.dataclass
+class ThreadRoot:
+    role: str
+    kind: str                  # "thread" | "http" | "main"
+    entries: tuple[str, ...]   # entry fids
+    multi: bool
+    path: str = ""
+    line: int = 0
+    pattern: str = ""
+
+
+def role_matches(declared: str, root: "ThreadRoot") -> bool:
+    """Does a declared role name cover a discovered root? Exact role,
+    fnmatch against the role, or fnmatch against the thread-name pattern
+    (`cluster-pump` covers `cluster-pump-*`)."""
+    if declared == root.role:
+        return True
+    if fnmatch.fnmatch(root.role, declared) or fnmatch.fnmatch(
+            root.role, declared + "-*"):
+        return True
+    if root.pattern and (fnmatch.fnmatch(root.pattern, declared)
+                         or fnmatch.fnmatch(root.pattern, declared + "-*")):
+        return True
+    return False
+
+
+def _name_pattern(kw: Optional[ast.expr]) -> tuple[str, str, bool]:
+    """(role, pattern, multi_hint) from a Thread name= kwarg value.
+    f-strings become fnmatch patterns: f"cluster-pump-{rid}" ->
+    ("cluster-pump-*", multi)."""
+    if isinstance(kw, ast.Constant) and isinstance(kw.value, str):
+        return kw.value, kw.value, False
+    if isinstance(kw, ast.JoinedStr):
+        parts = []
+        for v in kw.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append("*")
+        pat = "".join(parts)
+        role = pat.rstrip("*-")
+        return (role or pat), pat, True
+    return "", "", False
+
+
+class ThreadModel:
+    """Roots + per-root reachability + declarations over one
+    SummaryIndex. Cached on the Repo via threads_for()."""
+
+    def __init__(self, repo: Repo, idx: SummaryIndex):
+        self.repo = repo
+        self.idx = idx
+        self.graph = idx.graph
+        self.sites: list[ThreadSite] = []
+        self.roots: list[ThreadRoot] = []
+        # fid -> (declared role, path, line)
+        self.affinity: dict[str, tuple[str, str, int]] = {}
+        # attr obj id -> (declared role, path, line)
+        self.single_writer: dict[str, tuple[str, str, int]] = {}
+        # attr obj ids declared `# thread: instance-owned`
+        self.instance_owned: set[str] = set()
+        self._reach: dict[str, frozenset] = {}
+        self._discover_sites()
+        self._collect_declarations()
+        self._build_roots()
+
+    # ---------------- discovery ---------------- #
+
+    def _thread_calls(self, fn: ast.AST):
+        """(call, assigned_to_attr) for threading.Thread(...) ctor calls in
+        a function body (nested defs included — sites inside closures still
+        spawn threads)."""
+        assigned: set[int] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if any(isinstance(t, ast.Attribute) for t in node.targets):
+                    assigned.add(id(node.value))
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = astutil.dotted_name(node.func)
+            if name in ("threading.Thread", "Thread"):
+                yield node, id(node) in assigned
+
+    def _resolve_target(self, fid: str, fd, call: ast.Call) -> tuple[
+            Optional[str], bool]:
+        """(target fid, is_serve_forever) for a Thread site's target=."""
+        target = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = kw.value
+        if target is None:
+            return None, False
+        me = astutil.self_name(fd.node) if fd.cls else None
+        if isinstance(target, ast.Attribute):
+            if target.attr == "serve_forever":
+                return None, True
+            dn = astutil.dotted_name(target)
+            parts = dn.split(".") if dn else []
+            if me is not None and len(parts) == 2 and parts[0] == me:
+                got = self.graph.method_fid(fd.path, fd.cls, target.attr)
+                return got, False
+            ltypes = self.graph.local_types(fd.path, fd.node)
+            if len(parts) == 2 and parts[0] in ltypes:
+                for (cp, cc) in ltypes[parts[0]]:
+                    got = self.graph.method_fid(cp, cc, target.attr)
+                    if got:
+                        return got, False
+            return None, False
+        if isinstance(target, ast.Name):
+            nested = self.idx.nested_defs.get((fid, target.id))
+            if nested:
+                return nested, False
+            ent = self.graph._module_names.get(fd.path, {}).get(target.id)
+            if ent and ent[0] == "func":
+                return ent[1], False
+        return None, False
+
+    def _discover_sites(self) -> None:
+        for fid, fd in self.graph.funcs.items():
+            for call, assigned in self._thread_calls(fd.node):
+                name_kw = None
+                for kw in call.keywords:
+                    if kw.arg == "name":
+                        name_kw = kw.value
+                role, pattern, multi_hint = _name_pattern(name_kw)
+                tfid, is_serve = self._resolve_target(fid, fd, call)
+                if is_serve and not role:
+                    stem = fd.path.rsplit("/", 1)[-1][:-3]
+                    role = f"{stem}-server"
+                if not role:
+                    # Unnamed thread: derive a stable role from the target.
+                    tname = tfid.rsplit(".", 1)[-1].split("@")[0] if tfid \
+                        else "<lambda>"
+                    stem = fd.path.rsplit("/", 1)[-1][:-3]
+                    role = f"{stem}:{tname}"
+                self.sites.append(ThreadSite(
+                    path=fd.path, line=call.lineno, role=role,
+                    pattern=pattern, target_fid=tfid,
+                    multi=multi_hint or not assigned,
+                    in_summary=fid,
+                ))
+
+    def _handler_classes(self) -> list[tuple[str, str]]:
+        """(path, class) of BaseHTTPRequestHandler subclasses (nested
+        classes included — the call graph indexes them)."""
+        out = []
+        for (path, cname), node in self.graph.classes.items():
+            bases = self.graph._bases.get((path, cname), [])
+            if any("BaseHTTPRequestHandler" in b for b in bases):
+                out.append((path, cname))
+        return out
+
+    def _http_entries(self) -> set[str]:
+        entries: set[str] = set()
+        # (a) router registrations: X.add("VERB", pattern, handler).
+        for fid, fd in self.graph.funcs.items():
+            ltypes = None
+            for node in ast.walk(fd.node):
+                if isinstance(node, astutil.FunctionNode) and node is not fd.node:
+                    continue
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "add"
+                        and len(node.args) >= 3
+                        and isinstance(node.args[0], ast.Constant)
+                        and str(node.args[0].value).upper() in _HTTP_VERBS):
+                    continue
+                handler = node.args[2]
+                me = astutil.self_name(fd.node) if fd.cls else None
+                if (isinstance(handler, ast.Attribute)
+                        and isinstance(handler.value, ast.Name)
+                        and me is not None and handler.value.id == me):
+                    got = self.graph.method_fid(fd.path, fd.cls, handler.attr)
+                    if got:
+                        entries.add(got)
+                elif isinstance(handler, ast.Name):
+                    ent = self.graph._module_names.get(fd.path, {}).get(
+                        handler.id)
+                    if ent and ent[0] == "func":
+                        entries.add(ent[1])
+                elif isinstance(handler, ast.Lambda):
+                    # `lambda req: ...m.render()...` — resolve the calls the
+                    # lambda body makes with the enclosing context.
+                    if ltypes is None:
+                        ltypes = self.graph.local_types(fd.path, fd.node)
+                    for sub in ast.walk(handler.body):
+                        if isinstance(sub, ast.Call):
+                            for cand in self.graph.resolve(
+                                    fd, sub, local_types=ltypes):
+                                entries.add(cand)
+        # (b) every method of a BaseHTTPRequestHandler subclass.
+        for (path, cname) in self._handler_classes():
+            for mname, mfid in self.graph._methods.get((path, cname),
+                                                       {}).items():
+                entries.add(mfid)
+        # (c) closure dispatch: a handler class nested inside a method of
+        # an outer class calls the outer instance through a closure var the
+        # resolver cannot type — the outer class's public methods ARE the
+        # HTTP surface (FederationRouter.route, ExplorerServer handlers).
+        handler_nodes = {id(self.graph.classes[k]): k
+                         for k in self._handler_classes()}
+        for (path, cname), node in list(self.graph.classes.items()):
+            if (path, cname) in self._handler_classes():
+                continue
+            owns = False
+            for sub in ast.walk(node):
+                if id(sub) in handler_nodes and sub is not node:
+                    owns = True
+            if not owns:
+                continue
+            for mname, mfid in self.graph._methods.get((path, cname),
+                                                       {}).items():
+                if not mname.startswith("_"):
+                    entries.add(mfid)
+        return entries
+
+    # ---------------- declarations ---------------- #
+
+    def _collect_declarations(self) -> None:
+        for fid, fd in self.graph.funcs.items():
+            lines = self.repo.lines(fd.path)
+            ln = fd.node.lineno
+            texts = []
+            if 1 <= ln <= len(lines):
+                texts.append((lines[ln - 1], ln))
+            if ln >= 2:
+                texts.append((lines[ln - 2], ln - 1))
+            for text, at in texts:
+                m = _AFFINITY_RE.search(text)
+                if m:
+                    self.affinity[fid] = (m.group("role"), fd.path, at)
+                    break
+        # Attribute single-writer declarations: on `self.x = ...` lines
+        # anywhere in a class body (construction is where they belong, but
+        # the comment governs the slot wherever it sits).
+        for (path, cname), node in self.graph.classes.items():
+            lines = self.repo.lines(path)
+            for sub in ast.walk(node):
+                if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = sub.targets if isinstance(sub, ast.Assign) \
+                    else [sub.target]
+                attrs = [t.attr for t in targets
+                         if isinstance(t, ast.Attribute)
+                         and isinstance(t.value, ast.Name)]
+                if not attrs:
+                    continue
+                # The marker may sit on the assignment line or anywhere in
+                # the comment BLOCK directly above it (declarations carry
+                # written reasons, which wrap).
+                candidates = [(lines[sub.lineno - 1], sub.lineno)]
+                ln = sub.lineno - 1
+                while ln >= 1 and lines[ln - 1].lstrip().startswith("#"):
+                    candidates.append((lines[ln - 1], ln))
+                    ln -= 1
+                for text, at in candidates:
+                    m = _SINGLE_WRITER_RE.search(text)
+                    if m:
+                        for attr in attrs:
+                            self.single_writer[f"{path}::{cname}.{attr}"] = (
+                                m.group("role"), path, at)
+                        break
+                    if _INSTANCE_OWNED_RE.search(text):
+                        for attr in attrs:
+                            self.instance_owned.add(f"{path}::{cname}.{attr}")
+                        break
+
+    # ---------------- roots ---------------- #
+
+    def _build_roots(self) -> None:
+        by_role: dict[str, ThreadRoot] = {}
+        thread_targets: set[str] = set()
+        for s in self.sites:
+            if s.target_fid is None:
+                continue
+            thread_targets.add(s.target_fid)
+            prev = by_role.get(s.role)
+            if prev is None:
+                by_role[s.role] = ThreadRoot(
+                    role=s.role, kind="thread", entries=(s.target_fid,),
+                    multi=s.multi, path=s.path, line=s.line,
+                    pattern=s.pattern or s.role,
+                )
+            else:
+                ents = tuple(sorted(set(prev.entries) | {s.target_fid}))
+                prev.entries = ents
+                prev.multi = prev.multi or s.multi
+        http = self._http_entries()
+        if http:
+            by_role["http-handler"] = ThreadRoot(
+                role="http-handler", kind="http",
+                entries=tuple(sorted(http)), multi=True,
+            )
+        # Everything else a user may call from their own thread: public
+        # functions and methods not owned by another root and not declared
+        # `# thread: <role>-only` (the declaration is exactly the statement
+        # that the main thread must NOT call it).
+        owned = thread_targets | http | set(self.affinity)
+        main_entries = []
+        for fid, fd in self.graph.funcs.items():
+            if fid in owned or fd.name.startswith("_"):
+                continue
+            main_entries.append(fid)
+        by_role["main"] = ThreadRoot(
+            role="main", kind="main", entries=tuple(sorted(main_entries)),
+            multi=False,
+        )
+        self.roots = [by_role[r] for r in sorted(by_role)]
+
+    # ---------------- reachability ---------------- #
+
+    def reach(self, root: ThreadRoot) -> frozenset:
+        """Fids reachable from a root's entries through resolved calls."""
+        got = self._reach.get(root.role)
+        if got is not None:
+            return got
+        seen: set[str] = set()
+        frontier = [f for f in root.entries if f in self.idx.summaries]
+        while frontier:
+            fid = frontier.pop()
+            if fid in seen:
+                continue
+            seen.add(fid)
+            s = self.idx.summaries.get(fid)
+            if s is None:
+                continue
+            for site in s.calls:
+                for callee in site.callees:
+                    if callee not in seen:
+                        frontier.append(callee)
+        out = frozenset(seen)
+        self._reach[root.role] = out
+        return out
+
+    def roots_reaching(self, fid: str) -> list[ThreadRoot]:
+        return [r for r in self.roots if fid in self.reach(r)]
+
+    # ---------------- drift-test surface ---------------- #
+
+    def discovered_roles(self) -> list[ThreadSite]:
+        """Every Thread construction site (lambda targets included) — the
+        conftest-guard drift test walks this."""
+        return list(self.sites)
+
+
+def threads_for(repo: Repo, globs: tuple[str, ...] = DEFAULT_SUMMARY_GLOBS
+                ) -> ThreadModel:
+    """Repo-cached ThreadModel per glob set, riding the same SummaryIndex
+    the other interprocedural passes share. Like those summaries, the
+    model is always built over the FULL glob set — --since must not narrow
+    a cross-file invariant."""
+    cache = getattr(repo, "_thread_models", None)
+    if cache is None:
+        cache = repo._thread_models = {}
+    key = tuple(sorted(globs))
+    if key not in cache:
+        cache[key] = ThreadModel(repo, summaries_for(repo, key))
+    return cache[key]
