@@ -393,6 +393,83 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — extra row is best-effort
             print(f"bs1 row failed: {type(e).__name__}: {e}", file=sys.stderr)
 
+    # Host loop-overhead row (ISSUE 17, BENCH_LOOP): ms of host work per
+    # dispatched decode block, pipelined runtime vs the serial loop
+    # (LOCALAI_LOOP_PREPARE_AHEAD=0), at three occupancies. Uses dedicated
+    # tiny engines so the row isolates HOST overhead (planning, control
+    # uploads, housekeeping) from device compute, and so the serial
+    # comparison engine doesn't double the big arch's cache HBM. The
+    # counters come straight from the loop's phase clock
+    # (m_loop_host_ms / m_loop_blocks — wait time excluded), the same
+    # numbers Engine.metrics() exports as loop_host_overhead_per_block_ms.
+    if os.environ.get("BENCH_LOOP", "1") != "0":
+        try:
+            tcfg = get_arch("tiny")
+            tparams = jax.jit(lambda k: init_params(tcfg, k))(jax.random.key(1))
+            loop_slots = 16
+            occs = (1, 8, loop_slots)
+            lgen = 64
+
+            def loop_engine(pipelined: bool) -> Engine:
+                le = Engine(
+                    tcfg, tparams, ByteTokenizer(tcfg.vocab_size),
+                    engine_cfg=EngineConfig(
+                        max_slots=loop_slots, max_seq=256,
+                        min_prefill_bucket=16, spec_mode="off",
+                        loop_prepare_ahead=pipelined))
+                le.start()
+                return le
+
+            def loop_round(le: Engine, occ: int) -> float:
+                lerrs: list[str] = []
+
+                def lone(i: int) -> None:
+                    ids = [(i * 13 + j) % 255 + 1 for j in range(8)]
+                    try:
+                        le.generate(ids, max_new_tokens=lgen,
+                                    ignore_eos=True)
+                    except Exception as e:  # noqa: BLE001
+                        lerrs.append(f"{type(e).__name__}: {e}")
+
+                lthreads = [threading.Thread(target=lone, args=(i,))
+                            for i in range(occ)]
+                for t in lthreads:
+                    t.start()
+                for t in lthreads:
+                    t.join()
+                if lerrs:
+                    raise RuntimeError(f"loop row occ={occ}: {lerrs[0]}")
+                return le.m_loop_host_ms / max(le.m_loop_blocks, 1)
+
+            overheads: dict[tuple[str, int], float] = {}
+            for mode, flag in (("pipelined", True), ("serial", False)):
+                le = loop_engine(flag)
+                try:
+                    for occ in occs:
+                        loop_round(le, occ)  # warm this occupancy's variants
+                        le.m_loop_host_ms = 0.0
+                        le.m_loop_blocks = 0
+                        overheads[(mode, occ)] = loop_round(le, occ)
+                finally:
+                    le.stop()
+            for occ in occs:
+                p = overheads[("pipelined", occ)]
+                s = overheads[("serial", occ)]
+                out[f"loop_host_overhead_per_block_ms_bs{occ}_pipelined"] = (
+                    round(p, 3))
+                out[f"loop_host_overhead_per_block_ms_bs{occ}_serial"] = (
+                    round(s, 3))
+                out[f"loop_overhead_speedup_bs{occ}"] = round(
+                    s / max(p, 1e-9), 2)
+                print(
+                    f"loop row bs{occ}: serial {s:.3f} ms/block vs "
+                    f"pipelined {p:.3f} ms/block -> "
+                    f"{s / max(p, 1e-9):.2f}x less host overhead",
+                    file=sys.stderr,
+                )
+        except Exception as e:  # noqa: BLE001 — extra row is best-effort
+            print(f"loop row failed: {type(e).__name__}: {e}", file=sys.stderr)
+
     eng.stop()
 
     # Paged-KV row (SURVEY §7 ragged/paged KV): same arch/params served from

@@ -58,6 +58,7 @@ import numpy as np
 
 from localai_tpu.models import llama
 from localai_tpu.engine import speclookup
+from localai_tpu.engine.runtime import ControlStager, DeadlineIndex, LoopPhases
 from localai_tpu.models.config import ArchConfig
 from localai_tpu.observe import fence as ofence
 from localai_tpu.observe import postmortem as opostmortem
@@ -140,6 +141,24 @@ class EngineConfig:
     block_sizes: tuple[int, ...] = (64, 16, 4, 1)
     # Decode blocks kept in flight while the host processes earlier results.
     pipeline_depth: int = 3
+    # Pipelined loop runtime (ISSUE 17, docs/ENGINE_RUNTIME.md). True: while
+    # a block is in flight the loop prepares the NEXT block's control plan
+    # (pack/variant/growth) into a staging slot, commits control state as
+    # ONE dirty-diffed H2D transfer (skipped entirely when unchanged — the
+    # steady-state decode case), and runs purge/deadline/spill housekeeping
+    # on a budgeted tick instead of every iteration. False: the serial
+    # pre-ISSUE-17 loop (per-field uploads, every-iteration housekeeping) —
+    # byte-identical output either way; the serial path is the bench
+    # baseline. LOCALAI_LOOP_PREPARE_AHEAD env var overrides.
+    loop_prepare_ahead: bool = True
+    # Wall budget in ms for one housekeeping tick of the pipelined loop
+    # (loop_prepare_ahead). The lifecycle-critical sweeps (pending purge +
+    # active-deadline enforcement) always run on a due tick; optional work
+    # (cold-page spill, deferred prefix-span saves) runs only while the
+    # tick is under budget, so housekeeping can never delay a ready
+    # dispatch by more than roughly this bound plus one bounded task.
+    # LOCALAI_HOUSEKEEPING_BUDGET_MS env var overrides.
+    housekeeping_budget_ms: float = 2.0
     # Admission coalescing: when no decode block is in flight yet and a slot
     # was admitted within this window, hold the first block briefly so a
     # burst of simultaneous arrivals lands in the SAME block phase. A
@@ -660,6 +679,32 @@ class _Entry:
             return True
 
 
+@dataclasses.dataclass
+class _BlockPlan:
+    """One decode block's control state, built ahead of dispatch (ISSUE 17).
+
+    The prepare-ahead path fills this while the previous block is still in
+    flight; the post-result path then only commits + dispatches. `epoch`
+    stamps the scheduler state the plan was derived from — any mutation
+    that could change the plan (slot claim/release, preempt, override
+    write, chunk activation) bumps Engine._ctrl_epoch and the stale plan
+    is dropped, so a consumed plan is always byte-identical to what
+    _plan_block would build at dispatch time."""
+
+    grammar: bool
+    variant: str
+    n: int
+    with_dfa: Any        # False or the dfa mode string (see _dfa_mode)
+    with_lp: bool
+    kv_win: Optional[int]
+    with_lora: bool
+    # None, or (smode, (kb, dlens, windows)) — a planned speculative round.
+    spec: Optional[tuple]
+    active: Optional[np.ndarray]   # active-mask snapshot (plain blocks)
+    pack: Optional[np.ndarray]     # sampling/override pack (plain blocks)
+    epoch: int = 0
+
+
 class Engine:
     """Persistent multi-slot generation engine for one loaded model."""
 
@@ -725,6 +770,10 @@ class Engine:
             "LOCALAI_KV_SPILL_BYTES": ("kv_spill_bytes", int),
             "LOCALAI_KV_L1_SPAN": ("kv_l1_span", int),
             "LOCALAI_SP_PREFILL": ("sp_prefill", _parse_flag_env),
+            "LOCALAI_LOOP_PREPARE_AHEAD": ("loop_prepare_ahead",
+                                           _parse_flag_env),
+            "LOCALAI_HOUSEKEEPING_BUDGET_MS": ("housekeeping_budget_ms",
+                                               float),
         }.items():
             val = os.environ.get(env)
             if val is not None and val != "":
@@ -751,6 +800,8 @@ class Engine:
             raise ValueError("adapter_cache_bytes must be >= 0")
         if self.ecfg.trace_journal_events < 0:
             raise ValueError("trace_journal_events must be >= 0 (0 = off)")
+        if self.ecfg.housekeeping_budget_ms <= 0:
+            raise ValueError("housekeeping_budget_ms must be > 0")
         if self.ecfg.kv_scale <= 0:
             raise ValueError("kv_scale must be > 0")
         if self.ecfg.kv_scale != 1.0 and not (
@@ -1397,6 +1448,34 @@ class Engine:
         )
         self._trace_fence = bool(self.ecfg.trace_fence)
         self._postmortem_path = ""
+        # Pipelined loop runtime (ISSUE 17, docs/ENGINE_RUNTIME.md).
+        # thread: single-writer engine-loop — the control stager's cache
+        # and counters are loop-thread state; bench/tests read the
+        # counters best-effort after generation settles.
+        self._ctrl = ControlStager()
+        # thread: single-writer engine-loop — per-iteration host-phase
+        # accumulator feeding the coalesced loop_iter journal emission.
+        self._phases = LoopPhases()
+        # Deadline min-heap: submit-side threads push (internally locked),
+        # the loop's housekeeping gate peeks — O(1) "anything due?" instead
+        # of scanning every pending request every iteration.
+        self._deadlines = DeadlineIndex()
+        # thread: single-writer engine-loop — the prepare-ahead staging
+        # slot: the NEXT block's control plan, built while the loop waits
+        # on an in-flight block, consumed (or discarded as stale) by the
+        # next dispatch. _ctrl_epoch stamps plan validity: every mutation
+        # of plan inputs (slot claim/teardown, activation, grammar
+        # override) bumps it via _plan_dirty and orphans the staged plan.
+        self._staged_plan = None
+        self._ctrl_epoch = 0
+        # thread: single-writer engine-loop — housekeeping-tick clock and
+        # deferred admission-time prefix-span saves [(slot, ids, rows,
+        # gen)], flushed on ticks and before the owning slot finishes.
+        self._hk_last = 0.0
+        self._deferred_saves: list[tuple] = []
+        self._last_fence_ms = 0.0
+        self.m_loop_host_ms = 0.0
+        self.m_loop_blocks = 0
         self._build_programs()
 
     # ------------------------------------------------------------------ #
@@ -1416,11 +1495,12 @@ class Engine:
         return self._postmortem_path
 
     def _jnote(self, event: str, rid: str = "", slot: int = -1,
-               a: float = 0.0, b: float = 0.0) -> None:
-        """Loop-thread journal append (lock-free; no-op when disabled)."""
+               a: float = 0.0, b: float = 0.0, phases=None) -> None:
+        """Loop-thread journal append (lock-free; no-op when disabled).
+        `phases` (loop_iter only) is the LOOP_PHASES-ordered ms vector."""
         j = self._journal
         if j is not None:
-            j.append(event, rid=rid, slot=slot, a=a, b=b)
+            j.append(event, rid=rid, slot=slot, a=a, b=b, phases=phases)
 
     def _jstage(self, event: str, rid: str = "", slot: int = -1,
                 a: float = 0.0, b: float = 0.0) -> None:
@@ -1614,10 +1694,22 @@ class Engine:
 
     def _ptable_device(self):
         """The device ptable operand for batched programs: the flat
-        [B, MP] row table, or the hierarchical (l1, l0) pair."""
+        [B, MP] row table, or the hierarchical (l1, l0) pair.
+
+        Stager-backed (ISSUE 17): the table barely changes between decode
+        blocks (steady decode grows one slot's row occasionally), so the
+        dirty-diff cache skips the upload entirely on a byte match and
+        ships only the changed rows otherwise. Sound because no block/spec
+        program donates its ptable operand. Serial mode (loop_prepare_ahead
+        off) keeps the legacy per-dispatch upload for A/B parity runs."""
+        if not self.ecfg.loop_prepare_ahead:
+            if self._hier:
+                return (jnp.asarray(self.h_l1), jnp.asarray(self.h_l0))
+            return jnp.asarray(self.h_ptable)
         if self._hier:
-            return (jnp.asarray(self.h_l1), jnp.asarray(self.h_l0))
-        return jnp.asarray(self.h_ptable)
+            return (self._ctrl.commit("ptable_l1", self.h_l1),
+                    self._ctrl.commit("ptable_l0", self.h_l0))
+        return self._ctrl.commit("ptable", self.h_ptable)
 
     def _ptable_device_row(self, row: np.ndarray):
         """One slot's table operand from its host row (flat [MP] or hier
@@ -2261,6 +2353,7 @@ class Engine:
         # Tear the slot down WITHOUT a terminal event — the handle lives on
         # and the resumed slot keeps streaming into it. The generation bump
         # makes any straggler result for this slot index drop on the floor.
+        self._plan_dirty()
         self._slot_gen[victim] += 1
         self.slots[victim] = None
         self._chunkings = [st for st in self._chunkings
@@ -2371,6 +2464,7 @@ class Engine:
         )
         self._jnote("swap_in", rid=handle.rid, slot=slot_idx,
                     a=float(rec["bytes"]))
+        self._plan_dirty()
         self._last_admit_t = time.monotonic()
         return True
 
@@ -3910,9 +4004,9 @@ class Engine:
             kind="admit", toks=toks, tk=tk, lp=lp, gen=list(self._slot_gen),
             items=[(slot_idx, request, handle, len(ids), t0)],
         ))
+        self._plan_dirty()
         self._last_admit_t = time.monotonic()
-        self._prefix_save(slot_idx, ids, len(ids),
-                          min_extend=self.ecfg.prefix_cache_min)
+        self._defer_prefix_save(slot_idx, ids, len(ids))
 
     # ------------------------------------------------------------------ #
     # Prompt/prefix KV cache (host side)
@@ -4607,12 +4701,12 @@ class Engine:
             kind="admit", toks=toks, tk=tk, lp=lp, gen=list(self._slot_gen),
             items=[(slot_idx, request, handle, len(ids), t0)],
         ))
+        self._plan_dirty()
         self._last_admit_t = time.monotonic()
         # The freshly-assembled prompt span is itself the best prefix for the
         # next request in the conversation — but only if it extends stored
         # coverage enough to beat the snapshot it costs (min_extend).
-        self._prefix_save(slot_idx, ids, len(ids),
-                          min_extend=self.ecfg.prefix_cache_min)
+        self._defer_prefix_save(slot_idx, ids, len(ids))
         return True
 
     def _get_spec_block(self, mode: str, kb: int, with_dfa=False,
@@ -5039,6 +5133,13 @@ class Engine:
         deadline_s = request.deadline_s or self.ecfg.deadline_s
         if deadline_s > 0:
             handle.deadline = handle.t_submit + deadline_s
+            # Deadline index (ISSUE 17): the loop's housekeeping tick asks
+            # the heap "is anything due?" instead of scanning the queue
+            # every iteration. Lazy-deletion — an early finish just pops
+            # as a no-op tick when it comes due.
+            self._deadlines.push(handle.deadline)
+        if self.ecfg.queue_timeout_s > 0:
+            self._deadlines.push(handle.t_submit + self.ecfg.queue_timeout_s)
         # Dead-check and append share _pending_lock with _loop_guard's
         # set-dead-and-drain: either this submit observes the death (error
         # event below) or its entry lands before the drain and is drained
@@ -5238,6 +5339,19 @@ class Engine:
             out["adapter_promotes"] = float(self.m_adapter_promotes)
             out["adapter_evictions"] = float(self.m_adapter_evictions)
         out["peak_active_slots"] = float(self.m_peak_active)
+        if self.m_loop_blocks:
+            # Pipelined loop runtime (ISSUE 17): host ms spent per decode
+            # block outside the wait phase, and the control-stager's
+            # transfer economy (skips = commits served from cache).
+            out["loop_blocks"] = float(self.m_loop_blocks)
+            out["loop_host_ms_total"] = float(self.m_loop_host_ms)
+            out["loop_host_overhead_per_block_ms"] = float(
+                self.m_loop_host_ms / self.m_loop_blocks
+            )
+        if self._ctrl.commits:
+            out["ctrl_commits"] = float(self._ctrl.commits)
+            out["ctrl_transfers"] = float(self._ctrl.transfers())
+            out["ctrl_commit_skips"] = float(self._ctrl.skips)
         if self._journal is not None:
             # Lifecycle journal health (ISSUE 11): total events recorded
             # and cross-thread events dropped by a stalled writer.
@@ -5753,16 +5867,33 @@ class Engine:
         trace = os.environ.get("LOCALAI_ENGINE_TRACE", "0") == "1"
         self._charge_last = time.monotonic()
         self._charge_was_active = False
+        ph = self._phases
+        pipelined = bool(self.ecfg.loop_prepare_ahead)
         while not self._shutdown.is_set():
             faults.fire("engine_loop")  # injected loop death (ISSUE 4)
             self._charge()
+            ph.mark()
+            ph.iters += 1
+            did = processed = False
             jr = self._journal
             if jr is not None:
                 # Move cross-thread events (queued, span export) into the
                 # single-writer ring in order.
                 jr.drain_staged()
-            self._purge_pending()
-            self._enforce_deadlines()
+            ph.lap("drain")
+            if pipelined:
+                # Budgeted sidecar (ISSUE 17): purge/deadline sweeps run on
+                # a DUE tick — the deadline heap says something expired, or
+                # the forced interval elapsed — instead of scanning every
+                # pending request every iteration.
+                now = time.monotonic()
+                if self._hk_due(now):
+                    self._housekeeping(now)
+                ph.lap("housekeeping")
+            else:
+                self._purge_pending()
+                self._enforce_deadlines()
+                ph.lap("purge")
             self._drain_span_inbox()
 
             if self._growth_blocked and not self.h_active.any():
@@ -5771,6 +5902,7 @@ class Engine:
                 # so admission must unblock or the queue starves.
                 self._growth_blocked = False
             admitted = self._admit_pending()
+            ph.lap("admit")
             # Only host-walk grammars force single-step, serialized blocks;
             # DFA-constrained slots pipeline at full depth like everyone else.
             grammar = self._legacy_grammar_active()
@@ -5784,31 +5916,24 @@ class Engine:
                 # blocks — another dispatch would compute only discarded
                 # overshoot tokens. Wait for results instead.
                 dispatchable = False
-            if (dispatchable and nblocks == 0
+            # Coalesce a burst: hold the first block briefly so near-
+            # simultaneous arrivals share its phase (a block costs the
+            # same with 1 active slot as with all of them). The hold only
+            # suppresses DISPATCH — chunk progress, cold-page spill and
+            # in-flight result processing below still run (the pre-ISSUE-17
+            # `continue` here starved them for the whole hold window).
+            hold = (dispatchable and nblocks == 0
                     and self.ecfg.admit_coalesce_ms > 0
                     and any(s is None for s in self.slots)
                     and (time.monotonic() - self._last_admit_t) * 1000
-                    < self.ecfg.admit_coalesce_ms):
-                # Coalesce a burst: hold the first block briefly so near-
-                # simultaneous arrivals share its phase (a block costs the
-                # same with 1 active slot as with all of them).
-                time.sleep(0.0005)
-                continue
-            if dispatchable:
+                    < self.ecfg.admit_coalesce_ms)
+            if dispatchable and not hold:
                 t0 = time.monotonic()
                 try:
                     did = self._dispatch_block(grammar)
                 except Exception as e:  # noqa: BLE001 — fail requests, not the loop
-                    log.exception("decode block dispatch failed")
-                    self._jnote("error", a=1.0)
-                    self._jnote_fault(e)
-                    for i in range(self.ecfg.max_slots):
-                        slot = self.slots[i]
-                        if slot is not None:
-                            slot.handle._q.put(TokenEvent(
-                                kind="error", error=f"{type(e).__name__}: {e}"
-                            ))
-                            self._release(i)
+                    self._fail_block(e)
+                    self._flush_loop_iter(False, False)
                     continue
                 if did:
                     dispatch_ms = (time.monotonic() - t0) * 1000.0
@@ -5816,13 +5941,10 @@ class Engine:
                     # Optional fenced device time (LOCALAI_TRACE_FENCE):
                     # the fence module is the declared sync point — this
                     # serializes the pipeline and is debug-only.
-                    fence_ms = (ofence.fenced_wait_ms(ent.toks)
-                                if self._trace_fence else 0.0)
+                    self._last_fence_ms = (ofence.fenced_wait_ms(ent.toks)
+                                           if self._trace_fence else 0.0)
                     self._jnote("decode_block", slot=-1, a=float(ent.n),
                                 b=dispatch_ms)
-                    self._jnote("loop_iter", slot=-1,
-                                a=float(int(self.h_active.sum())),
-                                b=fence_ms)
                     if trace:
                         print(f"[eng {time.monotonic():.3f}] dispatch block n={self._inflight[-1].n} "
                               f"took {(time.monotonic()-t0)*1000:.1f}ms inflight={len(self._inflight)}")
@@ -5840,10 +5962,13 @@ class Engine:
             # behind a monolithic long-prompt prefill.
             self._advance_chunked()
 
-            # Cold-page spill tick (ISSUE 14): pages that fell out of every
-            # live query's sink+window move to the host tier, bounded per
-            # iteration so the copy never stalls dispatch.
-            self._spill_cold_pages()
+            if not pipelined:
+                # Cold-page spill tick (ISSUE 14): pages that fell out of
+                # every live query's sink+window move to the host tier,
+                # bounded per iteration so the copy never stalls dispatch.
+                # Pipelined loops run this from the budgeted sidecar.
+                self._spill_cold_pages()
+            ph.lap("dispatch")
 
             if self._inflight:
                 front = self._inflight[0]
@@ -5852,16 +5977,174 @@ class Engine:
                     t0 = time.monotonic()
                     e = self._inflight.popleft()
                     self._process_entry(e)
+                    processed = True
+                    ph.lap("process")
                     if trace:
                         print(f"[eng {time.monotonic():.3f}] process {e.kind} n={e.n} ready={fr} "
                               f"took {(time.monotonic()-t0)*1000:.1f}ms inflight={len(self._inflight)}")
                 else:
-                    # Nothing ready and nothing to dispatch (e.g. grammar mode
-                    # waiting on an in-flight admit): don't busy-spin.
-                    time.sleep(0.001)
+                    # The loop would otherwise wait on the in-flight block:
+                    # prepare the NEXT block's control plan (so the post-
+                    # result path is commit + dispatch only), give the
+                    # budgeted sidecar the idle window, then sleep.
+                    staged = False
+                    if pipelined and not grammar:
+                        try:
+                            staged = self._stage_plan()
+                        except Exception as e:  # noqa: BLE001 — same containment as dispatch
+                            self._fail_block(e)
+                            self._flush_loop_iter(False, False)
+                            continue
+                    ph.lap("prep")
+                    if pipelined:
+                        now = time.monotonic()
+                        if self._hk_due(now, idle=True):
+                            self._housekeeping(now)
+                        ph.lap("housekeeping")
+                    if not staged:
+                        # Nothing ready, nothing to prepare (e.g. grammar
+                        # mode waiting on an in-flight admit): don't
+                        # busy-spin.
+                        if pipelined:
+                            self._wake.wait(timeout=0.001)
+                            self._wake.clear()
+                        else:
+                            time.sleep(0.001)
+                    ph.lap("wait")
             elif not active and not admitted:
+                if pipelined:
+                    now = time.monotonic()
+                    if self._hk_due(now, idle=True):
+                        self._housekeeping(now)
+                    ph.lap("housekeeping")
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
+                ph.lap("wait")
+            elif hold and not did:
+                # Held dispatch with nothing in flight to process: brief
+                # pause (chunk progress and spill above already ran).
+                time.sleep(0.0005)
+                ph.lap("wait")
+            self._flush_loop_iter(did, processed)
+
+    # thread: engine-loop-only
+    def _fail_block(self, e: Exception) -> None:
+        """Containment for a failed decode-block dispatch OR a failed
+        prepare-ahead plan (both run the same planning code, so both take
+        the same path): post a typed error event to every active request
+        and release its state — fail requests, not the loop."""
+        log.exception("decode block dispatch failed")
+        self._jnote("error", a=1.0)
+        self._jnote_fault(e)
+        for i in range(self.ecfg.max_slots):
+            slot = self.slots[i]
+            if slot is not None:
+                slot.handle._q.put(TokenEvent(
+                    kind="error", error=f"{type(e).__name__}: {e}"
+                ))
+                self._release(i)
+
+    # Housekeeping cadence (ISSUE 17): the forced interval bounds how stale
+    # purge/deadline/spill sweeps can get while the loop is busy; the idle
+    # interval lets a waiting loop tick more eagerly since the time is free.
+    _HK_INTERVAL_S = 0.02
+    _HK_IDLE_S = 0.002
+
+    # thread: engine-loop-only
+    def _hk_due(self, now: float, idle: bool = False) -> bool:
+        """Is a housekeeping tick due? O(1): the deadline heap's earliest
+        expiry, or the forced interval."""
+        if self._deadlines.due(now):
+            return True
+        return now - self._hk_last >= (self._HK_IDLE_S if idle
+                                       else self._HK_INTERVAL_S)
+
+    # thread: engine-loop-only
+    def _housekeeping(self, now: float) -> None:
+        """One budgeted sidecar tick (ISSUE 17): lifecycle-critical sweeps
+        first (pending purge + active-deadline enforcement run on EVERY due
+        tick), then optional work — deferred prefix-span saves, cold-page
+        spill — only while the tick is under housekeeping_budget_ms. The
+        budget is checked before each optional task, so a tick overruns by
+        at most one bounded task; that bound is what "housekeeping never
+        delays a ready dispatch beyond its budget" means in
+        docs/ENGINE_RUNTIME.md."""
+        self._hk_last = now
+        budget_s = self.ecfg.housekeeping_budget_ms / 1000.0
+        self._purge_pending()
+        self._enforce_deadlines()
+        if time.monotonic() - now >= budget_s:
+            return
+        self._flush_deferred_saves()
+        if time.monotonic() - now >= budget_s:
+            return
+        self._spill_cold_pages()
+
+    # thread: engine-loop-only
+    def _defer_prefix_save(self, slot_idx: int, ids, rows: int) -> None:
+        """Admission-time prefix-span save, moved off the admission path
+        (ISSUE 17): the snapshot costs a device gather + host copy that the
+        serial loop paid before the next dispatch could go out. Pipelined
+        loops park the save for the budgeted sidecar; _finish flushes (or
+        subsumes) whatever is still parked, so a span is only ever saved
+        LATER than the serial loop would have — never lost. Serial mode
+        saves inline, unchanged."""
+        if not self.ecfg.loop_prepare_ahead:
+            self._prefix_save(slot_idx, ids, rows,
+                              min_extend=self.ecfg.prefix_cache_min)
+            return
+        if not self._prefix_enabled:
+            return
+        self._deferred_saves.append(
+            (slot_idx, list(ids), int(rows), self._slot_gen[slot_idx])
+        )
+
+    # thread: engine-loop-only
+    def _flush_deferred_saves(self, slot_idx: Optional[int] = None) -> None:
+        """Run parked admission saves (all of them, or one slot's before it
+        finishes). Entries whose slot generation moved on are dropped — the
+        slot was preempted or released, so the rows the save would snapshot
+        no longer belong to that request."""
+        if not self._deferred_saves:
+            return
+        run: list = []
+        keep: list = []
+        for item in self._deferred_saves:
+            (run if slot_idx is None or item[0] == slot_idx
+             else keep).append(item)
+        self._deferred_saves = keep
+        for si, ids, rows, gen in run:
+            if self._slot_gen[si] == gen and self.slots[si] is not None:
+                self._prefix_save(si, ids, rows,
+                                  min_extend=self.ecfg.prefix_cache_min)
+
+    # thread: engine-loop-only
+    def _flush_loop_iter(self, did: bool, processed: bool) -> None:
+        """Coalesced loop_iter emission (ISSUE 17): every host millisecond
+        lands in exactly ONE loop_iter window, attributed by phase. A
+        window closes on dispatch, on result processing, or after ~25 ms of
+        quiet waiting/housekeeping — emitting each of the ~1/ms wait
+        iterations instead would flood the 4096-event ring and evict the
+        lifecycle events a postmortem needs."""
+        ph = self._phases
+        host_ms = ph.total()  # excludes the wait phase
+        if not (did or processed) and host_ms < 25.0:
+            if ph.ms["wait"] >= 1000.0:
+                # Pure idle: drop the window instead of emitting — a
+                # long-idle server must not evict lifecycle events with
+                # wait-only loop_iter records.
+                ph.reset()
+            return
+        self.m_loop_host_ms += host_ms
+        if did:
+            self.m_loop_blocks += 1
+        self._jnote(
+            "loop_iter", slot=-1, a=float(int(self.h_active.sum())),
+            b=(self._last_fence_ms if (self._trace_fence and did)
+               else host_ms),
+            phases=ph.vector(),
+        )
+        ph.reset()
 
     # ------------------------------------------------------------------ #
     # Request-lifecycle enforcement (ISSUE 4, docs/ROBUSTNESS.md)
@@ -6388,11 +6671,12 @@ class Engine:
                 # Adapter slots never feed the prefix cache: their K/V rows
                 # are tenant-specific (wk/wv deltas), so a token-keyed span
                 # would leak one tenant's KV into another's admission.
-                self._prefix_save(slot_idx, r.prompt_ids, int(aux[0, j]),
-                                  min_extend=self.ecfg.prefix_cache_min)
+                self._defer_prefix_save(slot_idx, r.prompt_ids,
+                                        int(aux[0, j]))
         self._track(
             _Entry(kind="admit", toks=toks, tk=tk, lp=lp, gen=list(self._slot_gen), items=items)
         )
+        self._plan_dirty()
         self._last_admit_t = time.monotonic()
 
     # ------------------------------------------------------------------ #
@@ -6438,15 +6722,56 @@ class Engine:
             chosen = n
         return chosen
 
-    def _dispatch_block(self, grammar: bool) -> bool:
-        """Dispatch one decode block (or speculative round). Returns False
-        without dispatching when the paged pool could not be grown to cover
-        the block's writes — the loop then drains in-flight work and
-        preempts the youngest slot (ISSUE 3)."""
-        faults.fire("device_dispatch")
-        if self.plan.total > 1:
-            # Sharded decode dispatch — see _dispatch_admit (ISSUE 7).
-            faults.fire("collective_dispatch")
+    # thread: engine-loop-only
+    def _plan_dirty(self) -> None:
+        """Invalidate any prepared-ahead block plan (ISSUE 17). Called by
+        every mutation that can change the next block's control decisions —
+        slot claim/activation, release, preempt/resume, grammar override
+        writes. One int bump; the staging path replans on the next idle
+        wait, so a consumed plan is always what _plan_block would build at
+        dispatch time (the byte-exactness invariant of the pipeline)."""
+        self._ctrl_epoch += 1
+
+    # thread: engine-loop-only
+    def _stage_plan(self) -> bool:
+        """Prepare-ahead (ISSUE 17): build the NEXT block's control plan
+        while the loop waits on in-flight results, so the post-result path
+        is commit + dispatch only. Plain decode only — _spec_plan COMMITS
+        probe/bookkeeping state when it runs (must stay on the dispatch
+        edge), and legacy-grammar blocks serialize at depth 1 anyway.
+        Returns True when a plan was built this call (planning was this
+        iteration's useful work, so the caller skips its sleep)."""
+        if self._spec_mode != "off" or self._growth_blocked:
+            return False
+        sp = self._staged_plan
+        if sp is not None and sp.epoch == self._ctrl_epoch:
+            return False
+        self._staged_plan = None
+        if not self.h_active.any() or not self._has_unscheduled():
+            return False
+        plan = self._plan_block(False)
+        if isinstance(plan, _BlockPlan):
+            self._staged_plan = plan
+            return True
+        return False
+
+    def _plan_block(self, grammar: bool):
+        """Build one decode block's control plan: no device work; the only
+        scheduler mutation is on-demand page growth, which is monotone and
+        idempotent (pages grown for a plan that is later invalidated stay
+        valid for the replan, and page frees bump the plan epoch so a
+        stale plan never survives them — running growth at STAGE time is
+        therefore byte-equivalent to running it at dispatch).
+
+        Returns a _BlockPlan; or "wait" when host history lags an
+        in-flight spec verify round (drain before re-drafting); or None
+        when the paged pool could not be grown to cover the block
+        (_grow_for_decode already set _growth_blocked; the loop drains
+        in-flight work and preempts the youngest slot, ISSUE 3).
+
+        Shared verbatim by the dispatch path and the prepare-ahead path:
+        pipelining exactness rests on this being the ONLY place block
+        shape/variant/pack decisions are made."""
         B = self.ecfg.max_slots
         if grammar:
             variant, n = "grammar", 1
@@ -6503,7 +6828,7 @@ class Engine:
         )
         plan = self._spec_plan(smode) if spec_ok else None
         if isinstance(plan, str):  # "wait": host history lags an in-flight
-            return False           # verify round — drain before re-drafting
+            return "wait"          # verify round — drain before re-drafting
         if plan is None and spec_ok and smode in ("prompt_lookup",
                                                   "self_draft"):
             # Nothing to draft THIS round — keep the fallback block short
@@ -6517,12 +6842,16 @@ class Engine:
         # through real pages BEFORE dispatch — rows past a slot's table
         # land in SCRATCH and would be silently lost.
         if not self._grow_for_decode((plan[0] + 1) if plan else n):
-            return False
+            return None
         self.m_peak_active = max(self.m_peak_active, int(self.h_active.sum()))
+        with_lora = self._lora_tree is not None
         if plan is not None:
-            self._dispatch_spec_block(smode, plan[0], plan[1], plan[2],
-                                      with_dfa)
-            return True
+            return _BlockPlan(
+                grammar=grammar, variant=variant, n=n, with_dfa=with_dfa,
+                with_lp=with_lp, kv_win=kv_win, with_lora=with_lora,
+                spec=(smode, plan), active=None, pack=None,
+                epoch=self._ctrl_epoch,
+            )
         active_snapshot = self.h_active.copy()
         pack = np.zeros((11 if with_dfa else 10, B), np.float32)
         pack[0] = active_snapshot
@@ -6532,25 +6861,110 @@ class Engine:
         pack[9] = self.h_override_mask
         if with_dfa:
             pack[10] = self.h_gmask
-        with_lora = self._lora_tree is not None
-        fn = self._get_block(variant, n, with_lp, with_dfa, kv_win, with_lora)
+        return _BlockPlan(
+            grammar=grammar, variant=variant, n=n, with_dfa=with_dfa,
+            with_lp=with_lp, kv_win=kv_win, with_lora=with_lora, spec=None,
+            active=active_snapshot, pack=pack, epoch=self._ctrl_epoch,
+        )
+
+    def _dispatch_block(self, grammar: bool) -> bool:
+        """Dispatch one decode block (or speculative round). Returns False
+        without dispatching when the paged pool could not be grown to cover
+        the block's writes — the loop then drains in-flight work and
+        preempts the youngest slot (ISSUE 3). Consumes the prepared-ahead
+        plan when one is still valid (same epoch, same grammar mode);
+        otherwise plans inline (ISSUE 17)."""
+        faults.fire("device_dispatch")
+        if self.plan.total > 1:
+            # Sharded decode dispatch — see _dispatch_admit (ISSUE 7).
+            faults.fire("collective_dispatch")
+        plan = self._staged_plan
+        self._staged_plan = None
+        if (not isinstance(plan, _BlockPlan) or plan.epoch != self._ctrl_epoch
+                or plan.grammar != grammar
+                or not self.ecfg.loop_prepare_ahead):
+            plan = self._plan_block(grammar)
+        self._phases.lap("prep")
+        if plan is None or isinstance(plan, str):
+            return False
+        if plan.spec is not None:
+            smode, sp = plan.spec
+            self._dispatch_spec_block(smode, sp[0], sp[1], sp[2],
+                                      plan.with_dfa)
+            return True
+        return self._commit_block(plan)
+
+    def _commit_ctrl(self, p: "_BlockPlan"):
+        """ONE batched H2D control commit for a decode block (ISSUE 17):
+        the sampling/override pack plus, when the model takes them, the
+        rope-delta and adapter-row vectors ride a single stacked f32 array
+        through the dirty-diff stager — a steady-state block whose control
+        state did not change issues ZERO transfers; any change issues
+        exactly one. Every carried value is f32 sampling state or a small
+        int (< 2^24: token ids, rope deltas, adapter rows), so the f32
+        stack is exact and the int rows cast back losslessly. Serial mode
+        (loop_prepare_ahead off) keeps the legacy per-field uploads for
+        A/B parity runs. Returns (d_pack, d_rope, d_adapter)."""
+        faults.fire("control_commit")
+        rope = self._mrope
+        adapter = p.with_lora
+        if not self.ecfg.loop_prepare_ahead:
+            return (
+                jnp.asarray(p.pack),
+                jnp.asarray(self.h_rope_delta) if rope else None,
+                jnp.asarray(self.h_adapter) if adapter else None,
+            )
+        parts = [p.pack]
+        if rope:
+            parts.append(np.asarray(self.h_rope_delta, np.float32)[None])
+        if adapter:
+            parts.append(np.asarray(self.h_adapter, np.float32)[None])
+        ctrl = p.pack if len(parts) == 1 else np.concatenate(parts, axis=0)
+        npk = p.pack.shape[0]
+        extra = len(parts) > 1
+
+        def build(dev):
+            # Runs only on upload; the derived views are cached with the
+            # entry, so a steady-state hit re-serves them with zero device
+            # work.
+            d_pack = dev[:npk] if extra else dev
+            i = npk
+            d_rope = d_adapter = None
+            if rope:
+                d_rope = dev[i].astype(jnp.int32)
+                i += 1
+            if adapter:
+                d_adapter = dev[i].astype(jnp.int32)
+            return (d_pack, d_rope, d_adapter)
+
+        return self._ctrl.commit(f"ctrl{ctrl.shape[0]}", ctrl, build=build)
+
+    def _commit_block(self, p: "_BlockPlan") -> bool:
+        """Commit + dispatch a planned plain decode block: upload whatever
+        control state changed (usually nothing), launch the block program,
+        advance scheduling. The post-result hot path of the pipelined loop
+        is exactly this method (ISSUE 17)."""
+        n = p.n
+        active_snapshot = p.active
+        fn = self._get_block(p.variant, n, p.with_lp, p.with_dfa, p.kv_win,
+                             p.with_lora)
+        d_pack, d_rope, d_adapter = self._commit_ctrl(p)
         args = (
             self.params, self.cache, self.counts, self.rngs, self.bias,
-            self.d_tokens, self.d_positions, jnp.asarray(pack),
+            self.d_tokens, self.d_positions, d_pack,
         )
         if self._mrope:
-            args = args + (jnp.asarray(self.h_rope_delta),)
+            args = args + (d_rope,)
         if self._paged:
             args = args + (self._ptable_device(),)
-        lora_args = (
-            (self._lora_tree, jnp.asarray(self.h_adapter)) if with_lora else ()
-        )
-        if with_dfa:
+        lora_args = ((self._lora_tree, d_adapter) if p.with_lora else ())
+        self._phases.lap("commit")
+        if p.with_dfa:
             d = self._dfa
             (
                 self.cache, self.counts, self.rngs, self.d_tokens,
                 self.d_positions, toks_block, tk_block, lp_block, self.d_gstate,
-            ) = fn(*args, d["mask_bits"], self._dfa_table(d, with_dfa),
+            ) = fn(*args, d["mask_bits"], self._dfa_table(d, p.with_dfa),
                    d["tok_cls"], self.d_gstate, *lora_args)
             self.m_dfa_tokens += n * int((self.h_gmask * active_snapshot).sum())
         else:
@@ -6562,7 +6976,7 @@ class Engine:
         if tk_block is not None:
             _host_copy_async(tk_block)
         self.h_override_mask[:] = False
-        for i in range(B):
+        for i in range(self.ecfg.max_slots):
             if active_snapshot[i] and self.slots[i] is not None:
                 self.slots[i].scheduled += n
                 self.slots[i].sched_rows += n
@@ -6921,6 +7335,7 @@ class Engine:
                     if chosen != tok:
                         self.h_override_tok[slot_idx] = chosen
                         self.h_override_mask[slot_idx] = True
+                        self._plan_dirty()
                     tok = chosen
                 tr = handle.trace
                 if not slot.t_first:
@@ -6960,6 +7375,7 @@ class Engine:
                     if chosen != tok:
                         self.h_override_tok[i] = chosen
                         self.h_override_mask[i] = True
+                        self._plan_dirty()
                     tok = chosen
                 consumed += 1
                 lpi = (lp[0][step, i], lp[1][step, i], lp[2][step, i]) if lp is not None else None
@@ -7149,8 +7565,19 @@ class Engine:
     def _finish(self, slot_idx: int, reason: str) -> None:
         slot = self.slots[slot_idx]
         assert slot is not None
-        if (self._prefix_enabled and slot.request.image_embeds is None
-                and slot.request.adapter is None):
+        will_save = (self._prefix_enabled and slot.request.image_embeds is None
+                     and slot.request.adapter is None)
+        if will_save:
+            # The finish-time span below covers prompt + generated rows, a
+            # superset of any admission save still parked on the sidecar
+            # (ISSUE 17) — drop the parked one instead of paying its
+            # snapshot twice.
+            self._deferred_saves = [
+                x for x in self._deferred_saves if x[0] != slot_idx
+            ]
+        else:
+            self._flush_deferred_saves(slot_idx)
+        if will_save:
             # Rows for prompt + all but the last generated token are
             # guaranteed written (a token's KV row lands when it is consumed
             # as the next step's input). A span that carries generated rows
@@ -7186,6 +7613,10 @@ class Engine:
         self._release(slot_idx)
 
     def _release(self, slot_idx: int) -> None:
+        # Membership changed — and for paged engines the teardown below
+        # frees pages, so a block plan staged before this release (its
+        # growth included) must be rebuilt (ISSUE 17).
+        self._plan_dirty()
         self.slots[slot_idx] = None
         # A chunked prefill whose slot is being torn down (dispatch failure,
         # stop) must not keep dispatching chunks into a freed slot.

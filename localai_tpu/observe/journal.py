@@ -38,7 +38,10 @@ BASE_EVENTS = (
     "chunk",         # one mid prefill chunk dispatched (slot, a=tokens)
     "first_token",   # admission result produced the first token (slot)
     "decode_block",  # decode/spec block dispatched (a=block size, b=dispatch ms)
-    "loop_iter",     # loop iteration that dispatched (a=occupancy, b=fenced device ms)
+    "loop_iter",     # coalesced loop-iteration window (a=occupancy, b=host ms
+    #                  spent this window — or fenced device ms under
+    #                  trace_fence when the window dispatched; the per-phase
+    #                  host-ms breakdown rides the `phases` vector, ISSUE 17)
     "preempt",       # slot preempted for pool pressure (slot, a=ctx rows)
     "swap_out",      # preempt-swap image written to the host tier (a=bytes)
     "swap_in",       # swap resume restored pool pages (slot, a=bytes)
@@ -76,10 +79,20 @@ FAULT_EVENTS = (
     "fault_adapter_fetch",
     "fault_spec_verify",
     "fault_page_spill",
+    "fault_control_commit",
 )
 
 EVENTS = BASE_EVENTS + FAULT_EVENTS
 CODES = {name: i for i, name in enumerate(EVENTS)}
+
+# Host-phase names for one loop_iter window (engine/runtime.LOOP_PHASES is
+# the writer-side source; this copy keeps the observe layer engine-free and
+# a unit test pins the two tuples equal). The per-event `ph` vector stores
+# milliseconds per phase in this order.
+LOOP_PHASES = (
+    "drain", "purge", "admit", "prep", "commit", "dispatch", "process",
+    "housekeeping", "wait",
+)
 
 _DTYPE = np.dtype([
     ("t", np.float64),      # time.monotonic() at emit
@@ -88,6 +101,7 @@ _DTYPE = np.dtype([
     ("a", np.float64),      # event-specific scalar (see EVENTS comments)
     ("b", np.float64),      # second event-specific scalar
     ("rid", "U40"),         # request id (empty for engine-wide events)
+    ("ph", np.float32, (len(LOOP_PHASES),)),  # loop_iter host-phase ms
 ])
 
 _STAGED_CAP = 1024
@@ -115,15 +129,16 @@ class EventJournal:
 
     # thread: engine-loop-only
     def append(self, event: str, rid: str = "", slot: int = -1,
-               a: float = 0.0, b: float = 0.0) -> None:
+               a: float = 0.0, b: float = 0.0, phases=None) -> None:
         """Writer-thread append: O(1), no allocation, no lock, no device.
         The `# thread:` declaration makes the single-writer convention
         machine-checked (thread-affinity lint pass): any call chain from a
-        non-loop root is a finding — cross-thread emitters use stage()."""
-        self._append_raw(time.monotonic(), event, rid, slot, a, b)
+        non-loop root is a finding — cross-thread emitters use stage().
+        `phases` (loop_iter only) is a LOOP_PHASES-ordered ms sequence."""
+        self._append_raw(time.monotonic(), event, rid, slot, a, b, phases)
 
     def _append_raw(self, t: float, event: str, rid: str, slot: int,
-                    a: float, b: float) -> None:
+                    a: float, b: float, phases=None) -> None:
         i = self.n % self.capacity
         buf = self._buf
         buf["t"][i] = t
@@ -132,6 +147,7 @@ class EventJournal:
         buf["a"][i] = a
         buf["b"][i] = b
         buf["rid"][i] = rid
+        buf["ph"][i] = phases if phases is not None else 0.0
         self.n += 1
 
     def stage(self, event: str, rid: str = "", slot: int = -1,
@@ -168,7 +184,7 @@ class EventJournal:
         out = []
         for seq in range(start, n):
             rec = buf[seq % self.capacity]
-            out.append({
+            d = {
                 "seq": seq,
                 "t": float(rec["t"]),
                 "event": EVENTS[int(rec["code"])],
@@ -176,7 +192,12 @@ class EventJournal:
                 "a": float(rec["a"]),
                 "b": float(rec["b"]),
                 "rid": str(rec["rid"]),
-            })
+            }
+            ph = rec["ph"]
+            if ph.any():
+                d["phases"] = {LOOP_PHASES[k]: float(v)
+                               for k, v in enumerate(ph) if v}
+            out.append(d)
         with self._staged_lock:
             staged = list(self._staged)
         for t, event, rid, slot, a, b in staged:

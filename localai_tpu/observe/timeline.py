@@ -43,6 +43,10 @@ def chrome_trace(journals: dict[str, Any]) -> dict:
             args = {"seq": rec["seq"], "a": rec["a"], "b": rec["b"]}
             if rec["rid"]:
                 args["rid"] = rec["rid"]
+            if "phases" in rec:
+                # loop_iter host-phase ms breakdown (ISSUE 17) — visible in
+                # the Perfetto args panel per window.
+                args["phases"] = rec["phases"]
             ev: dict = {
                 "name": rec["event"], "cat": "engine",
                 "pid": pid, "tid": tid, "args": args,

@@ -21,6 +21,7 @@ layouts; the engine picks per `EngineConfig.kv_l1_span` (0 = flat).
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 
 def is_hier(table) -> bool:
@@ -87,3 +88,33 @@ def batch_row(table_row):
         l1, l0 = table_row
         return (l1[None], l0)
     return table_row[None]
+
+
+# ------------------------------------------------------------------ #
+# Host-side diff/commit helpers (ISSUE 17, engine/runtime.ControlStager):
+# the pipelined loop compares each control operand's host bytes against
+# its last upload and ships only what changed — usually nothing (steady
+# decode) or a handful of table rows (one slot grew).
+# ------------------------------------------------------------------ #
+
+def dirty_rows(prev: np.ndarray, cur: np.ndarray) -> np.ndarray:
+    """Leading-axis indices where two equal-shape host arrays differ
+    (every index for 0-/1-d arrays with any difference, so callers can
+    treat `rows.size == 0` uniformly as "unchanged")."""
+    if prev.shape != cur.shape or prev.dtype != cur.dtype:
+        raise ValueError(
+            f"dirty_rows: shape/dtype mismatch {prev.shape}/{prev.dtype} "
+            f"vs {cur.shape}/{cur.dtype} — re-key the operand instead"
+        )
+    neq = prev != cur
+    if not neq.any():
+        return np.empty((0,), np.int64)
+    if cur.ndim < 2:
+        return np.arange(cur.shape[0] if cur.ndim else 1, dtype=np.int64)
+    return np.nonzero(neq.any(axis=tuple(range(1, cur.ndim))))[0]
+
+
+def host_equal(prev: np.ndarray, cur: np.ndarray) -> bool:
+    """Byte equality of two host tables (shape + dtype + content)."""
+    return (prev.shape == cur.shape and prev.dtype == cur.dtype
+            and bool(np.array_equal(prev, cur)))

@@ -82,6 +82,18 @@ Hook sites (each is one `faults.fire(SITE)` call in production code):
                      page-pool accounting must be intact at quiesce — a
                      failed verify round may not leave a slot's draft
                      bookkeeping half-updated.
+  control_commit   — the batched H2D control commit of a decode block
+                     (Engine._commit_ctrl, ISSUE 17): the one transfer the
+                     pipelined loop issues per block (sampling pack +
+                     rope/adapter rows; the stager skips it entirely when
+                     nothing changed). Raising here fails the block BEFORE
+                     any device state mutated or any slot's `scheduled`
+                     advanced, so the containment contract is
+                     device_dispatch's: the loop catches, posts typed error
+                     events to the affected slots, releases them, and keeps
+                     serving — zero hung callers, pool fully accounted, and
+                     the stager's cache must not retain a half-committed
+                     entry (the failed commit never stores one).
 
 Activation:
   - programmatic: `with faults.active(FaultSchedule(seed=7)): ...`
@@ -108,7 +120,7 @@ import contextlib
 import os
 import random
 import threading
-from typing import Iterator, Optional, Sequence
+from typing import Iterable, Iterator, Optional, Sequence
 
 SITES = (
     "device_dispatch",
@@ -124,6 +136,7 @@ SITES = (
     "adapter_fetch",
     "spec_verify",
     "page_spill",
+    "control_commit",
 )
 
 DEFAULT_RATE = 0.05
@@ -148,6 +161,13 @@ class FaultSchedule:
                   (None = unbounded). Bounding it lets churn tests assert
                   RECOVERY, not just failure: traffic after the last
                   injection must succeed.
+    threads     — thread idents eligible for injection (None = all).
+                  fire() calls from other threads are invisible to the
+                  schedule: not counted, no draw consumed. Scoping matters
+                  when unrelated engines share the process (module-scoped
+                  fixture engines idle in the background and their loops
+                  also call fire()): an unscoped max_faults=1 schedule can
+                  be eaten by a bystander instead of the engine under test.
     """
 
     def __init__(
@@ -157,6 +177,7 @@ class FaultSchedule:
         sites: Optional[Sequence[str]] = None,
         max_faults: Optional[int] = None,
         site_rates: Optional[dict[str, float]] = None,
+        threads: Optional[Iterable[int]] = None,
     ) -> None:
         self.seed = int(seed)
         self.rate = float(rate)
@@ -166,6 +187,7 @@ class FaultSchedule:
             raise ValueError(f"unknown fault sites {sorted(unknown)} — use {SITES}")
         self.max_faults = max_faults
         self.site_rates = dict(site_rates or {})
+        self.threads = frozenset(threads) if threads is not None else None
         self._lock = threading.Lock()
         self._rngs = {s: random.Random(f"{self.seed}:{s}") for s in SITES}
         self.calls: dict[str, int] = {s: 0 for s in SITES}
@@ -176,6 +198,12 @@ class FaultSchedule:
             return sum(self.fired.values())
 
     def should_fire(self, site: str) -> bool:
+        # Thread scoping happens BEFORE call accounting: a scoped schedule
+        # sees exactly the call sequence its target threads produce, so
+        # bystander loops can't skew the (seed, site, call index) pattern.
+        if (self.threads is not None
+                and threading.get_ident() not in self.threads):
+            return False
         with self._lock:
             self.calls[site] = self.calls.get(site, 0) + 1
             # Draw BEFORE eligibility filters so the per-site decision
@@ -193,9 +221,11 @@ class FaultSchedule:
             return True
 
     def __repr__(self) -> str:  # shows up in InjectedFault messages/logs
+        scope = ("" if self.threads is None
+                 else f", threads={sorted(self.threads)}")
         return (
             f"FaultSchedule(seed={self.seed}, rate={self.rate}, "
-            f"sites={self.sites}, max_faults={self.max_faults})"
+            f"sites={self.sites}, max_faults={self.max_faults}{scope})"
         )
 
 

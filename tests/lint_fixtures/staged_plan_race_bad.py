@@ -1,0 +1,74 @@
+"""Known-bad fixture for the shared-state-race pass: the ISSUE-17
+pipelined-runtime shapes WITHOUT their `# thread:` declarations.
+
+Shape 1: the plan-invalidation epoch bumped (read-modify-write) from
+both the loop root and a public entry point — the lost update silently
+resurrects a stale staged plan. Shape 2: the housekeeping sidecar's
+deferred-work list appended by the loop and iterated live by a public
+flush. Shape 3: the stager's keyed upload cache mutated by the loop
+while an HTTP metrics scrape iterates it."""
+
+import threading
+
+
+class Stager:
+    def __init__(self):
+        self._cache = {}
+        self.uploads = 0
+
+    def commit(self, key, host):
+        self._cache[key] = host
+        self.uploads += 1
+
+    def render(self):
+        out = []
+        for key in self._cache:  # iterated on HTTP scrape threads
+            out.append(key)
+        return ",".join(out)
+
+
+class Engine:
+    def __init__(self):
+        self._ctrl_epoch = 0
+        self._deferred_saves = []
+        self._stager = Stager()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="fixture-loop"
+        )
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            self._ctrl_epoch += 1
+            self._deferred_saves.append("span")
+            self._stager.commit("pack", self._ctrl_epoch)
+
+    def invalidate(self):
+        # VIOLATION: main-root read-modify-write of the loop's epoch — a
+        # lost bump lets a stale staged plan pass the epoch check.
+        self._ctrl_epoch += 1
+
+    def flush_deferred(self):
+        # VIOLATION: main-root iteration over the live sidecar list the
+        # loop appends to.
+        for item in self._deferred_saves:
+            self._save(item)
+        self._deferred_saves.clear()
+
+    def _save(self, item):
+        return item
+
+
+class StagerApi:
+    def __init__(self, eng: Engine):
+        self.eng = eng
+
+    def attach(self, r):
+        r.add("GET", "/stager", self.scrape)
+
+    def scrape(self, req):
+        # VIOLATION: scrape-thread iteration over the cache dict the loop
+        # commits into.
+        return self.eng._stager.render()

@@ -1,0 +1,100 @@
+"""Known-good fixture for the shared-state-race pass: the ISSUE-17
+pipelined-runtime shapes WITH their ownership declared — every one must
+stay silent.
+
+- the staging slot and sidecar list are `# thread: fixture-loop-only`
+  state: the flush/invalidate entry points carry the affinity
+  declaration, so only the loop root ever reaches them;
+- stager counters are `# thread: single-writer fixture-loop`: the scrape
+  reads are best-effort snapshots of monotone floats;
+- the deadline index takes its own lock around every heap access, so
+  submit-side pushes and loop-side pops never share unlocked state."""
+
+import heapq
+import threading
+
+
+class Stager:
+    def __init__(self):
+        # thread: instance-owned — each stager belongs to one engine loop;
+        # nothing outside that thread touches the cache.
+        self._cache = {}
+        # thread: single-writer fixture-loop — monotone counters; scrape
+        # reads are best-effort snapshots.
+        self.uploads = 0
+        # thread: single-writer fixture-loop — see above.
+        self.skips = 0
+
+    # thread: fixture-loop-only
+    def commit(self, key, host):
+        if self._cache.get(key) == host:
+            self.skips += 1
+        else:
+            self._cache[key] = host
+            self.uploads += 1
+
+
+class DeadlineIndex:
+    def __init__(self):
+        self._heap = []
+        self._lock = threading.Lock()
+
+    def push(self, t):
+        with self._lock:
+            heapq.heappush(self._heap, t)
+
+    def due(self, now):
+        with self._lock:
+            return bool(self._heap) and self._heap[0] <= now
+
+
+class Engine:
+    def __init__(self):
+        # thread: single-writer fixture-loop — staged plan; consumed and
+        # cleared only on the loop thread.
+        self._staged_plan = None
+        # thread: single-writer fixture-loop — sidecar parking list.
+        self._deferred_saves = []
+        self._stager = Stager()
+        self._deadlines = DeadlineIndex()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="fixture-loop"
+        )
+
+    def start(self):
+        self._thread.start()
+
+    def submit(self, deadline):
+        # Cross-thread producers touch only the internally-locked seam.
+        self._deadlines.push(deadline)
+
+    def _run(self):
+        while True:
+            self._staged_plan = ("plan", len(self._deferred_saves))
+            self._deferred_saves.append("span")
+            self._stager.commit(bool(self._staged_plan))
+            if self._deadlines.due(0.0):
+                self._flush_deferred()
+
+    # thread: fixture-loop-only
+    def _flush_deferred(self):
+        for item in self._deferred_saves:
+            self._save(item)
+        self._deferred_saves.clear()
+        self._staged_plan = None
+
+    # thread: fixture-loop-only
+    def _save(self, item):
+        return item
+
+
+class StagerApi:
+    def __init__(self, eng: Engine):
+        self.eng = eng
+
+    def attach(self, r):
+        r.add("GET", "/stager", self.scrape)
+
+    def scrape(self, req):
+        s = self.eng._stager
+        return s.uploads + s.skips

@@ -47,6 +47,19 @@ def test_direction_markers_cover_multihost_rows():
     assert direction("multihost_remote_handoffs") == "higher"
 
 
+def test_direction_markers_cover_loop_rows():
+    """BENCH_LOOP keys (ISSUE 17, docs/ENGINE_RUNTIME.md) gate in the
+    right direction from their first shared round: host overhead per
+    block must not RISE, the pipelined-vs-serial ratio must not DROP."""
+    for occ in (1, 8, 16):
+        assert direction(
+            f"loop_host_overhead_per_block_ms_bs{occ}_pipelined") == "lower"
+        assert direction(
+            f"loop_host_overhead_per_block_ms_bs{occ}_serial") == "lower"
+        # "speedup" outranks the lower-is-better "overhead" marker.
+        assert direction(f"loop_overhead_speedup_bs{occ}") == "higher"
+
+
 def test_direction_markers_cover_longctx_rows():
     """BENCH_LONGCTX keys (ISSUE 14, docs/LONG_CONTEXT.md) gate in the
     right direction from their first shared round."""

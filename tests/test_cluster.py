@@ -376,8 +376,24 @@ def test_replica_death_mid_stream_reroutes_with_terminal_events(tiny):
         assert all(ev.kind == "token" for ev in firsts), firsts
         assert all(r.engine.m_prompt_tokens > 0 for r in replicas), \
             "traffic did not spread across both replicas"
+        # Scope the injection to THIS cluster's mid-stream loop threads:
+        # the module-scoped fixture engines idle in the background and
+        # their loops also call fire() — unscoped, the single fault can
+        # land on a bystander and neither replica ever dies. Eligible
+        # replicas must hold a request with real HEADROOM (≥8 tokens to
+        # go — the last request just streamed its first, so one always
+        # qualifies): a near-done request can drain in the instants
+        # between this snapshot and the fault landing, and a death with
+        # nothing live reroutes nothing.
+        loop_idents = {
+            r.engine._thread.ident for r in replicas
+            if any(s is not None and len(s.generated) <= n_new - 8
+                   for s in r.engine.slots)
+        }
+        assert loop_idents, "no replica mid-stream at fault activation"
         with faults.active(faults.FaultSchedule(
-                seed=99, rate=1.0, sites=("engine_loop",), max_faults=1)):
+                seed=99, rate=1.0, sites=("engine_loop",), max_faults=1,
+                threads=loop_idents)):
             deadline = time.monotonic() + 60.0
             while (not any(r.engine.is_dead for r in replicas)
                    and time.monotonic() < deadline):

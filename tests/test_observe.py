@@ -453,8 +453,11 @@ def test_queue_wait_timing_field(tiny):
 
 
 def _kill_engine(eng, timeout=30.0):
+    # threads= scopes the injection to THIS engine's loop: any other live
+    # engine in the process would otherwise race for the single fault.
     with faults.active(faults.FaultSchedule(
-            seed=0, rate=1.0, sites=("engine_loop",), max_faults=1)):
+            seed=0, rate=1.0, sites=("engine_loop",), max_faults=1,
+            threads={eng._thread.ident})):
         eng._wake.set()
         deadline = time.monotonic() + timeout
         while not eng.is_dead and time.monotonic() < deadline:

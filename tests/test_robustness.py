@@ -290,8 +290,11 @@ def test_cancel_all_terminates_pending_and_active(tiny):
 def _kill_engine(eng, timeout=30.0):
     """Deterministically kill the engine loop via the injected-fault site
     and wait for the death to be fully processed."""
+    # threads= scopes the injection to THIS engine's loop: any other live
+    # engine in the process would otherwise race for the single fault.
     with faults.active(faults.FaultSchedule(
-            seed=0, rate=1.0, sites=("engine_loop",), max_faults=1)):
+            seed=0, rate=1.0, sites=("engine_loop",), max_faults=1,
+            threads={eng._thread.ident})):
         eng._wake.set()
         deadline = time.monotonic() + timeout
         while not eng.is_dead and time.monotonic() < deadline:
@@ -504,6 +507,32 @@ def test_fault_schedule_is_deterministic_per_site():
         c.should_fire("page_alloc")
         pattern_c.append(c.should_fire("device_dispatch"))
     assert pattern_c == pattern_a
+
+
+def test_fault_schedule_thread_scoping():
+    """threads= makes fire() calls from other threads invisible: not
+    counted, no draw consumed — a bystander loop can't eat a max_faults=1
+    injection aimed at a specific engine's thread (the cluster
+    replica-death test depends on exactly this)."""
+    me = threading.get_ident()
+    scoped = faults.FaultSchedule(seed=3, rate=1.0, sites=("page_alloc",),
+                                  max_faults=1, threads={me + 1})
+    assert not scoped.should_fire("page_alloc")  # wrong thread: filtered
+    assert scoped.calls["page_alloc"] == 0       # ...and not counted
+    hit = faults.FaultSchedule(seed=3, rate=1.0, sites=("page_alloc",),
+                               max_faults=1, threads={me})
+    assert hit.should_fire("page_alloc")
+    assert "threads=" in repr(hit) and "threads=" not in repr(scoped.sites)
+
+    # From a worker thread inside the scope set, the same schedule fires.
+    out = []
+    t = threading.Thread(
+        target=lambda s: out.append(s.should_fire("page_alloc")),
+        args=(faults.FaultSchedule(seed=3, rate=1.0, sites=("page_alloc",),
+                                   max_faults=1, threads=None),),
+        name="fault-scope-probe")
+    t.start(); t.join(timeout=10)
+    assert out == [True]  # threads=None keeps the old everyone-eligible path
 
 
 def test_fault_env_parsing():

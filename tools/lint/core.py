@@ -166,7 +166,10 @@ def _suppression_for(lines: list[str], line: int, pass_id: str):
     candidates = []
     if 1 <= line <= len(lines):
         candidates.append(lines[line - 1])
-    if line >= 2 and lines[line - 2].lstrip().startswith("#"):
+    # Bounds-checked above AND below: a pass may anchor a cross-file
+    # relationship (e.g. a race's mutation site) to a line number that
+    # doesn't exist in the finding's own file.
+    if 2 <= line <= len(lines) + 1 and lines[line - 2].lstrip().startswith("#"):
         candidates.append(lines[line - 2])
     for text in candidates:
         m = _SUPPRESS_RE.search(text)
