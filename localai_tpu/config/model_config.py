@@ -176,6 +176,12 @@ class ModelConfig:
     # ring-shard each prefill chunk's attention over "sp".
     # LOCALAI_SP_PREFILL env var overrides ("0" disables).
     sp_prefill: bool = True
+    # Tree-batched parallel sampling (ISSUE 18, docs/TREE_SAMPLING.md):
+    # n>1 / best_of groups admit ONE shared prefill and fork the slot
+    # CoW per branch on paged engines. Off → every branch is an
+    # independent clone admission. LOCALAI_FORK_SAMPLING env var
+    # overrides ("0" disables).
+    fork_sampling: bool = True
 
     # Bounded admission + deadlines (ISSUE 4, docs/ROBUSTNESS.md). A full
     # pending queue rejects at submit (HTTP 429 + Retry-After); requests

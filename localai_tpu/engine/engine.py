@@ -42,6 +42,7 @@ pipeline_depth * block size and is the price of keeping the device saturated.
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import logging
 import os
@@ -433,6 +434,14 @@ class EngineConfig:
     # the cache reads/writes). Composes with dense/paged/sp/spec/prefix:
     # every kernel reads via astype(f32) and writes via astype(cache dtype).
     kv_cache_dtype: str = ""
+    # Tree-batched parallel sampling (ISSUE 18, docs/TREE_SAMPLING.md):
+    # submit_fork() admits a shared prompt ONCE and forks the slot N-1
+    # times by addref'ing its KV pages and CoW-mapping its L1 directory
+    # chunks — n>1 / best_of pay one prefill instead of N. False (or
+    # LOCALAI_FORK_SAMPLING=0) degrades every fork to the N-clone
+    # admission path (byte-identical output, N× prefill + KV). Dense
+    # (kv_pages=0) engines and draft-model spec always clone.
+    fork_sampling: bool = True
 
     def cache_dtype(self, model_dtype):
         import jax.numpy as _jnp
@@ -519,6 +528,12 @@ class GenRequest:
     # RNG chain, swap image) so re-admission resumes the original stream
     # instead of starting over. Never set by callers.
     resume: Optional[dict] = None
+    # INTERNAL — set by submit_fork() on the group's PRIMARY request
+    # (ISSUE 18): [(branch_request, branch_handle), ...] siblings to fork
+    # off this request's slot right after its one shared-prompt prefill.
+    # Every path that terminates a pending primary must also terminate or
+    # requeue these (see _fork_group_detach). Never set by callers.
+    fork_group: Optional[list] = None
 
 
 @dataclasses.dataclass
@@ -770,6 +785,7 @@ class Engine:
             "LOCALAI_KV_SPILL_BYTES": ("kv_spill_bytes", int),
             "LOCALAI_KV_L1_SPAN": ("kv_l1_span", int),
             "LOCALAI_SP_PREFILL": ("sp_prefill", _parse_flag_env),
+            "LOCALAI_FORK_SAMPLING": ("fork_sampling", _parse_flag_env),
             "LOCALAI_LOOP_PREPARE_AHEAD": ("loop_prepare_ahead",
                                            _parse_flag_env),
             "LOCALAI_HOUSEKEEPING_BUDGET_MS": ("housekeeping_budget_ms",
@@ -1265,6 +1281,22 @@ class Engine:
         self._last_submit_t = 0.0
         self._admit_hold_start = 0.0
         self._loop_dead: Optional[str] = None  # set by _loop_guard on crash
+        # Tree-batched fork sampling (ISSUE 18, docs/TREE_SAMPLING.md).
+        # _fork_logits: final-position logits stashed by the primary's
+        # admission dispatch (with_logits variants) for the fork-sample
+        # program — loop-thread only, consumed and cleared by
+        # _fork_after_admit in the same loop step that set it.
+        self._fork_logits = None
+        # Mid-stream fork requests staged by Engine.fork() (any thread,
+        # under _fork_lock); the loop services them at a quiesce point
+        # (_service_forks). Each entry: (src_handle, [(req, handle), ...]).
+        self._fork_requests: list = []
+        self._fork_lock = threading.Lock()
+        self.m_forks = 0               # branches admitted via slot fork
+        self.m_fork_clone_fallbacks = 0  # branches degraded to clone admission
+        # Peak pages simultaneously in use (pool size - free low-water):
+        # the allocator-accounted probe behind fork_kv_bytes_ratio.
+        self.m_kv_pages_peak = 0
         # Bounded-admission / deadline accounting (ISSUE 4). _admit_wait_ewma
         # tracks observed submit→admission latency (seconds) and feeds the
         # Retry-After hint on QueueFullError.
@@ -1816,6 +1848,9 @@ class Engine:
         fresh = [self._free_pages.pop() for _ in range(n)]
         for p in fresh:
             self._page_refs[p] = 1
+        used = self.ecfg.kv_pages - len(self._free_pages)
+        if used > self.m_kv_pages_peak:
+            self.m_kv_pages_peak = used
         return fresh
 
     def _pages_addref(self, pages: list[int]) -> None:
@@ -2998,7 +3033,7 @@ class Engine:
     def _get_admit(self, m: int, bucket: int, has_bias: bool, with_topk: bool,
                    with_lp: bool = False, n_img: int = 0,
                    with_dfa: bool = False, with_mrope: bool = False,
-                   with_lora: bool = False):
+                   with_lora: bool = False, with_logits: bool = False):
         """Fused admission program: prefill M prompts, write their KV/state
         into their slots, and sample each first token — one dispatch.
 
@@ -3015,9 +3050,14 @@ class Engine:
         slot's device automaton state is initialized by walking that token's
         char classes — so follow-up decode blocks can pipeline immediately
         with no host round-trip.
+
+        with_logits (fork sampling, ISSUE 18): the final-position logits row
+        rides the output tuple LAST, so _fork_after_admit can sample each
+        sibling branch's first token from the exact same distribution the
+        primary's (or a clone's) admission would have produced.
         """
         key = (m, bucket, has_bias, with_topk, with_lp, n_img, with_dfa,
-               with_mrope, with_lora)
+               with_mrope, with_lora, with_logits)
         fn = self._admit_cache.get(key)
         if fn is not None:
             return fn
@@ -3092,6 +3132,8 @@ class Engine:
             out = (cache, counts, rngs, bias, d_tokens, d_positions, toks, tk, lp)
             if with_dfa:
                 out = out + (d_gstate,)
+            if with_logits:
+                out = out + (logits,)
             return out
 
         paged = self._paged
@@ -3326,6 +3368,7 @@ class Engine:
                                 has_bias: bool, with_topk: bool,
                                 with_lp: bool, with_dfa: bool = False,
                                 draft: bool = False,
+                                with_logits: bool = False,
                                 build_only: bool = False):
         """Cached admission against the PAGE POOL: the span's pages are
         mapped read-only into the slot's table (no copy — copy-on-write
@@ -3337,7 +3380,7 @@ class Engine:
         Penalty counts/bias ride as in _get_admit_cached: full-prompt token
         bucket on device, bias row only when the request has one."""
         key = ("cached-paged", npg, tb, fbp, has_bias, with_topk, with_lp,
-               with_dfa, draft)
+               with_dfa, draft, with_logits)
         fn = self._admit_cache.get(key)
         if fn is not None:
             return fn
@@ -3398,6 +3441,8 @@ class Engine:
             if with_dfa:
                 gnext = self._dfa_advance(with_dfa, gtrans, tok_cls, ginit, toks)
                 out = out + (d_gstate.at[slot].set(gnext[0]),)
+            if with_logits:
+                out = out + (logits,)
             return out
 
         dcfg = self.draft_cfg
@@ -3621,7 +3666,8 @@ class Engine:
 
     def _get_chunk_final_paged(self, tb: int, fbp: int, has_bias: bool,
                                with_topk: bool, with_lp: bool,
-                               with_dfa=False, draft: bool = False):
+                               with_dfa=False, draft: bool = False,
+                               with_logits: bool = False):
         """Final chunk of a paged chunked admission: prefill the last
         ≤prefill_chunk tokens direct-to-page (prefix attention walks the
         slot's OWN pages — no gather_pages materialization of a 32k
@@ -3630,7 +3676,7 @@ class Engine:
         prefill_chunk_paged in place of gather_pages + prefill_tail; `aux`
         is [4] i32 (tail_len, slot, seed, prefix_len)."""
         key = ("chunk-final", tb, fbp, has_bias, with_topk, with_lp,
-               with_dfa, draft)
+               with_dfa, draft, with_logits)
         fn = self._admit_cache.get(key)
         if fn is not None:
             return fn
@@ -3687,6 +3733,8 @@ class Engine:
             if with_dfa:
                 gnext = self._dfa_advance(with_dfa, gtrans, tok_cls, ginit, toks)
                 out = out + (d_gstate.at[slot].set(gnext[0]),)
+            if with_logits:
+                out = out + (logits,)
             return out
 
         dcfg = self.draft_cfg
@@ -3851,6 +3899,7 @@ class Engine:
         if st["handle"].cancelled.is_set():
             self._chunkings.pop(0)
             st["handle"]._q.put(TokenEvent(kind="done", finish_reason="stop"))
+            self._fork_group_requeue(st["request"])
             self._release(slot_idx)
             return True
         C = self.ecfg.prefill_chunk
@@ -3870,6 +3919,9 @@ class Engine:
             st["handle"]._q.put(
                 TokenEvent(kind="error", error=f"{type(e).__name__}: {e}")
             )
+            self._fork_group_fail(st["request"], TokenEvent(
+                kind="error", error=f"{type(e).__name__}: {e}"
+            ))
             self._release(slot_idx)
         return True
 
@@ -3905,6 +3957,11 @@ class Engine:
         tb = self._bucket_for(len(tail))
         fbp = self._bucket_for(len(ids))
         draft = self.draft_cfg is not None
+        # Fork primaries (ISSUE 18) need the final-position logits so
+        # _fork_after_admit can sample each sibling's first token from the
+        # same distribution a clone admission would have produced.
+        with_logits = (request.fork_group is not None and self._paged
+                       and not draft)
         dfa_tables = None
         if request.grammar is not None and request.resume is None:
             dfa_tables = self._dfa_for(request)
@@ -3929,7 +3986,8 @@ class Engine:
             samp_pack[fi, 0] = getattr(request, kf)
         if self._paged:
             fn = self._get_chunk_final_paged(tb, fbp, has_bias, with_topk,
-                                             with_lp, with_dfa, draft)
+                                             with_lp, with_dfa, draft,
+                                             with_logits=with_logits)
             # Publish the real table NOW (loop thread): blocks dispatched
             # from here on — all strictly after this program on the device
             # stream — may read and write the slot's pages.
@@ -3982,6 +4040,8 @@ class Engine:
             self.d_gstate = out[9]
         elif draft:
             self.d_cache = out[9]
+        if with_logits:
+            self._fork_logits = out[-1]
         _host_copy_async(toks)
         for kf in _SAMPLING_FIELDS:
             self.h_sampling[kf][slot_idx] = getattr(request, kf)
@@ -4007,6 +4067,669 @@ class Engine:
         self._plan_dirty()
         self._last_admit_t = time.monotonic()
         self._defer_prefix_save(slot_idx, ids, len(ids))
+        if request.fork_group is not None:
+            # Fork the freshly-activated slot NOW, before any decode block
+            # can touch its control row (the fork program reconstructs the
+            # prompt bincount from counts[slot] - the first sampled token).
+            self._fork_after_admit(slot_idx, request, dfa_tables)
+
+    # ------------------------------------------------------------------ #
+    # Tree-batched parallel sampling: CoW slot forking (ISSUE 18,
+    # docs/TREE_SAMPLING.md)
+    # ------------------------------------------------------------------ #
+
+    def _get_fork_sample(self, nb: int, with_topk: bool, with_lp: bool,
+                         with_dfa):
+        """Fork-sample program: give `nb` sibling branches their own control
+        rows off a freshly-admitted source slot, sampling each branch's
+        first token from the source's stashed final-position logits.
+
+        Byte-identity contract (the fork-vs-clone tests pin this): every
+        per-branch op below replays _get_admit's m=1 recipe exactly — the
+        prompt bincount is recovered as counts[src] minus the source's first
+        sampled token (integer math, bit-exact), the RNG chain is
+        key(seed_b) folded at 0, the sampling mask is the source's bias row
+        (fork groups share logit_bias by construction) plus the grammar
+        start mask — so a greedy or seeded fork emits the same bytes the
+        branch's own clone admission would have.
+
+        aux [3, nb] i32: row 0 = dst slots, row 1 = seeds, row 2 = src slot
+        (broadcast). samp_pack [7, nb] f32 — per-branch sampling params.
+        The branch loop is unrolled (nb is small and static)."""
+        key = ("fork", nb, with_topk, with_lp, with_dfa)
+        fn = self._admit_cache.get(key)
+        if fn is not None:
+            return fn
+        V = self.cfg.vocab_size
+        K = min(self.GRAMMAR_TOPK, V)
+        LK = min(self.LOGPROB_TOPK, V)
+
+        def fork_fn(*args):
+            counts, rngs, bias, d_tokens, d_positions = args[:5]
+            logits, aux, samp_pack = args[5:8]
+            gmask0 = gtrans = tok_cls = ginit = d_gstate = None
+            if with_dfa:
+                gmask0, gtrans, tok_cls, ginit, d_gstate = args[8:13]
+            src = aux[2, 0]
+            # counts[src] = prompt bincount + first sampled token (admit
+            # added it); subtracting d_tokens[src] recovers the bincount a
+            # clone admission would have computed. Integer ops — bit-exact.
+            rows0 = counts[src].at[d_tokens[src]].add(-1)
+            brow = bias[src]
+            pos = d_positions[src]
+            if with_topk:
+                tk_row = jax.lax.top_k(logits + brow[None], K)[1]
+            if with_lp:
+                logp = jax.nn.log_softmax(
+                    logits.astype(jnp.float32) + brow[None], axis=-1
+                )
+                lp_vals, lp_ids = jax.lax.top_k(logp, LK)
+            toks_l = []
+            tk_l: list = []
+            lp_tok: list = []
+            for b in range(nb):
+                samp = SamplingParams(
+                    temperature=samp_pack[0, b:b + 1],
+                    top_k=samp_pack[1, b:b + 1].astype(jnp.int32),
+                    top_p=samp_pack[2, b:b + 1],
+                    min_p=samp_pack[3, b:b + 1],
+                    repeat_penalty=samp_pack[4, b:b + 1],
+                    presence_penalty=samp_pack[5, b:b + 1],
+                    frequency_penalty=samp_pack[6, b:b + 1],
+                )
+                keys0 = jax.vmap(jax.random.key)(
+                    aux[1, b:b + 1].astype(jnp.uint32)
+                )
+                draws = jax.vmap(lambda k: jax.random.fold_in(k, 0))(keys0)
+                srow = brow[None] + gmask0 if with_dfa else brow[None]
+                tok = sample(logits, draws, samp, rows0[None], srow)  # [1]
+                dst = aux[0, b]
+                counts = counts.at[dst].set(rows0.at[tok[0]].add(1))
+                rngs = rngs.at[dst].set(keys0[0])
+                bias = bias.at[dst].set(brow)
+                d_tokens = d_tokens.at[dst].set(tok[0])
+                d_positions = d_positions.at[dst].set(pos)
+                toks_l.append(tok[0])
+                if with_topk:
+                    tk_l.append(tk_row[0])
+                if with_lp:
+                    lp_tok.append(logp[0, tok[0]])
+                if with_dfa:
+                    gnext = self._dfa_advance(
+                        with_dfa, gtrans, tok_cls, ginit, tok
+                    )
+                    d_gstate = d_gstate.at[dst].set(gnext[0])
+            toks = jnp.stack(toks_l)
+            tk = jnp.stack(tk_l) if with_topk else None
+            lp = None
+            if with_lp:
+                lp = (jnp.stack(lp_tok),
+                      jnp.broadcast_to(lp_ids, (nb, LK)),
+                      jnp.broadcast_to(lp_vals, (nb, LK)))
+            out = (counts, rngs, bias, d_tokens, d_positions, toks, tk, lp)
+            if with_dfa:
+                out = out + (d_gstate,)
+            return out
+
+        donate = (0, 1, 2, 3, 4) + ((12,) if with_dfa else ())
+        fn = jax.jit(fork_fn, donate_argnums=donate)
+        self._admit_cache[key] = fn
+        return fn
+
+    def _get_fork_page_copy(self):
+        """One-page KV copy (CoW materialization of a fork's partially-
+        filled boundary page): both lineages would write rows of that page,
+        so the branch gets a private copy before its first decode write.
+        Quantized caches copy the stored bytes verbatim — the KV scales are
+        a global per-head constant (self._kv_scales), not per-page state."""
+        key = ("fork-page-copy",)
+        fn = self._admit_cache.get(key)
+        if fn is not None:
+            return fn
+
+        def copy_page(cache, srcp, dstp):
+            k = cache.k.at[:, dstp].set(cache.k[:, srcp])
+            v = cache.v.at[:, dstp].set(cache.v[:, srcp])
+            return llama.KVCache(k=k, v=v)
+
+        fn = jax.jit(copy_page, donate_argnums=(0,))
+        self._admit_cache[key] = fn
+        return fn
+
+    def _get_fork_ctrl_copy(self, with_dfa: bool):
+        """Mid-stream fork control copy (Engine.fork): duplicate one slot's
+        control row into a free slot, decorrelating the branch's RNG chain
+        by folding `salt` into the source's key. aux [3] i32: src, dst,
+        salt. Mid-stream forks are deliberately NOT clone-byte-compatible —
+        there is no clone equivalent of an in-flight RNG chain."""
+        key = ("fork-ctrl-copy", bool(with_dfa))
+        fn = self._admit_cache.get(key)
+        if fn is not None:
+            return fn
+
+        def ctrl_copy(*args):
+            counts, rngs, bias, d_tokens, d_positions, aux = args[:6]
+            src, dst, salt = aux[0], aux[1], aux[2]
+            counts = counts.at[dst].set(counts[src])
+            rngs = rngs.at[dst].set(jax.random.fold_in(rngs[src], salt))
+            bias = bias.at[dst].set(bias[src])
+            d_tokens = d_tokens.at[dst].set(d_tokens[src])
+            d_positions = d_positions.at[dst].set(d_positions[src])
+            out = (counts, rngs, bias, d_tokens, d_positions)
+            if with_dfa:
+                d_gstate = args[6]
+                out = out + (d_gstate.at[dst].set(d_gstate[src]),)
+            return out
+
+        donate = (0, 1, 2, 3, 4) + ((6,) if with_dfa else ())
+        fn = jax.jit(ctrl_copy, donate_argnums=donate)
+        self._admit_cache[key] = fn
+        return fn
+
+    def _fork_supported(self, requests: list[GenRequest]) -> bool:
+        """Whether a request group can admit via slot forking. The shared
+        prefill means every branch must agree on everything that shapes the
+        prompt's KV and sampling mask: same adapter (KV rows are tenant-
+        specific under LoRA), same logit_bias, grammar all-or-none (the
+        machines themselves must be equivalent — the HTTP layer builds each
+        branch's machine from the same spec). Draft-model engines, dense
+        caches, multimodal and resume requests always clone."""
+        if not (self._paged and self.ecfg.fork_sampling):
+            return False
+        if self.draft_cfg is not None:
+            return False
+        r0 = requests[0]
+        b0 = r0.logit_bias or {}
+        g0 = r0.grammar is not None
+        for r in requests:
+            if r.image_embeds is not None or r.mrope_positions is not None:
+                return False
+            if r.resume is not None:
+                return False
+            if r.adapter != r0.adapter:
+                return False
+            if (r.logit_bias or {}) != b0 or (r.grammar is not None) != g0:
+                return False
+        return True
+
+    def _pages_fork_need(self, request: GenRequest) -> int:
+        """Fresh pages ONE forked branch claims at fork time: the partially-
+        filled boundary page (materialized CoW copy) if the prompt doesn't
+        end on a page boundary, plus decode headroom — capped so headroom
+        never books past what the branch could ever write beyond the shared
+        span. Everything else is addref'd from the source."""
+        page = self.ecfg.kv_page_size
+        plen = len(request.prompt_ids)
+        partial = 1 if plen % page else 0
+        cap = max(partial, self._pages_worst(request) - plen // page)
+        return min(partial + self.ecfg.kv_page_headroom, cap)
+
+    def _branch_handle(self, request: GenRequest) -> RequestHandle:
+        """Handle for a fork-group branch: the same rid/trace/deadline
+        wiring submit() gives the primary. The branch never sits in
+        _pending itself — its lifecycle rides the primary's fork_group
+        until fork admission (or detach requeues it as an ordinary
+        independent entry)."""
+        handle = RequestHandle()
+        handle.t_submit = time.monotonic()
+        handle.rid = request.request_id or f"h{id(handle):x}"
+        if request.request_id or request.traceparent:
+            tr = otrace.RequestTrace(
+                handle.rid, traceparent=request.traceparent,
+                engine=self.cfg.name,
+            )
+            handle.trace = tr
+            handle._q.trace = tr
+            otrace.STORE.register(tr)
+            tr.note("queued", prompt_tokens=len(request.prompt_ids))
+        deadline_s = request.deadline_s or self.ecfg.deadline_s
+        if deadline_s > 0:
+            handle.deadline = handle.t_submit + deadline_s
+            self._deadlines.push(handle.deadline)
+        if self.ecfg.queue_timeout_s > 0:
+            self._deadlines.push(handle.t_submit + self.ecfg.queue_timeout_s)
+        self._jstage("queued", rid=handle.rid,
+                     a=float(len(request.prompt_ids)))
+        return handle
+
+    def submit_fork(self, requests: list[GenRequest]) -> list[RequestHandle]:
+        """Admit a group of same-prompt requests paying ONE prefill
+        (ISSUE 18, docs/TREE_SAMPLING.md): the first request is the
+        primary — it rides the ordinary admission path (batched, chunked,
+        or prefix-cached) — and the rest fork off its slot right after the
+        prefill, addref'ing its KV pages. Engines that can't fork (dense
+        cache, draft model, fork_sampling off, mixed adapters/bias/grammar)
+        degrade to N independent submits — same API, same outputs, N×
+        prefill. Returns one handle per request, in order."""
+        if not requests:
+            return []
+        if len(requests) == 1:
+            return [self.submit(requests[0])]
+        p0 = list(requests[0].prompt_ids)
+        for r in requests[1:]:
+            if list(r.prompt_ids) != p0:
+                raise ValueError(
+                    "submit_fork requires identical prompts across the group"
+                )
+        if not self._fork_supported(requests):
+            return [self.submit(r) for r in requests]
+        branches = []
+        limit = self.ecfg.max_seq - 1
+        for r in requests[1:]:
+            ids = list(r.prompt_ids)
+            if len(ids) > limit:
+                # Mirror submit()'s truncation so branch state matches the
+                # primary's post-truncation prompt.
+                ids = [ids[0]] + ids[-(limit - 1):]
+            rr = dataclasses.replace(r, prompt_ids=ids, fork_group=None)
+            branches.append((rr, self._branch_handle(rr)))
+        primary = dataclasses.replace(requests[0], fork_group=branches)
+        try:
+            h0 = self.submit(primary)
+        except BaseException as e:
+            # The branch handles never reach the loop — close them here so
+            # no caller (or trace) is left open.
+            for _r, bh in branches:
+                bh._q.put(TokenEvent(
+                    kind="error", error=f"fork submit failed: {e}"
+                ))
+            raise
+        if self._loop_dead is not None:
+            # submit() observed (or raced) a dead loop: it errored the
+            # primary itself, but the loop will never detach the group.
+            # Duplicate terminals on a branch are harmless.
+            for _r, bh in branches:
+                bh._q.put(TokenEvent(kind="error", error=self._loop_dead))
+        return [h0] + [bh for _r, bh in branches]
+
+    def _fork_group_fail(self, request: GenRequest, event: TokenEvent) -> None:
+        """Propagate a fork primary's terminal error to every branch handle
+        (the branches never reach _pending, so no other path would close
+        them)."""
+        group = request.fork_group
+        if not group:
+            return
+        request.fork_group = None
+        for _r, h in group:
+            h._q.put(dataclasses.replace(event))
+
+    def _fork_group_requeue(self, request: GenRequest) -> None:
+        """The fork primary was cancelled before admission: its LIVE
+        branches requeue as ordinary independent entries (each pays its own
+        prefill — correctness over the lost sharing), cancelled ones get
+        their terminal now. Takes _pending_lock — callers inside the
+        admission scan's locked region defer the call until the lock is
+        released."""
+        group = request.fork_group
+        if not group:
+            return
+        request.fork_group = None
+        live = []
+        for r, h in group:
+            if h.cancelled.is_set():
+                h._q.put(TokenEvent(kind="done", finish_reason="stop"))
+            else:
+                live.append((r, h))
+        if not live:
+            return
+        with self._pending_lock:
+            dead = self._loop_dead
+            if dead is None:
+                self._pending.extend(live)
+        if dead is not None:
+            for _r, h in live:
+                h._q.put(TokenEvent(kind="error", error=dead))
+            return
+        self._wake.set()
+
+    # thread: engine-loop-only
+    def _fork_after_admit(self, src_slot: int, request: GenRequest,
+                          dfa_tables: Optional[dict] = None) -> None:
+        """Admit the primary's fork_group branches by forking its freshly-
+        admitted slot (the tentpole): each branch addrefs the full prompt
+        pages ([0, plen // page) — whole directory chunks share by addref
+        under hierarchical tables), gets a private copy of the partially-
+        filled boundary page, and samples its own first token from the
+        primary's stashed final-position logits — byte-identical to what
+        that branch's clone admission would have produced. Branches that
+        cannot fork (no free slot, pool pressure, adapter pin failure,
+        injected slot_fork fault, or no stashed logits) degrade to ordinary
+        clone admission via the pending queue: strictly slower, never
+        wrong. Must run before any decode block touches the source's
+        control row. Loop thread only."""
+        branches = request.fork_group
+        request.fork_group = None
+        logits = self._fork_logits
+        self._fork_logits = None
+        if not branches:
+            return
+        page = self.ecfg.kv_page_size
+        plen = len(request.prompt_ids)
+        nfull = plen // page
+        partial = plen % page
+        src_pages = list(self._slot_pages[src_slot]) if self._paged else []
+        shared = src_pages[:nfull]
+        clones: list[tuple[GenRequest, RequestHandle]] = []
+        forked: list[tuple[int, GenRequest, RequestHandle, int]] = []
+        copies: list[tuple[int, int]] = []
+        taken: set[int] = set()
+        for r, h in branches:
+            if h.cancelled.is_set():
+                h._q.put(TokenEvent(kind="done", finish_reason="stop"))
+                continue
+            dst = next((i for i, s in enumerate(self.slots)
+                        if s is None and i not in taken), None)
+            if dst is None or logits is None or not self._paged:
+                clones.append((r, h))
+                continue
+            try:
+                # Injected fork failure (testing/faults): the branch
+                # degrades to clone admission, the journal records it.
+                faults.fire("slot_fork")
+            except faults.InjectedFault as e:
+                self._jnote_fault(e)
+                clones.append((r, h))
+                continue
+            row = self._pages_alloc(
+                dst, self._pages_fork_need(r), shared=shared,
+                shared_tps=(self._slot_tps[src_slot] if self._hier else None),
+            )
+            if row is None:
+                clones.append((r, h))
+                continue
+            arow = 0
+            if r.adapter:
+                try:
+                    arow = self._adapter_acquire(r.adapter)
+                except Exception:  # noqa: BLE001 — degrade this branch only
+                    self._pages_free(dst)
+                    clones.append((r, h))
+                    continue
+            if partial:
+                copies.append((src_pages[nfull],
+                               self._slot_pages[dst][nfull]))
+            taken.add(dst)
+            forked.append((dst, r, h, arow))
+        if forked:
+            try:
+                self._dispatch_fork(src_slot, plen, forked, copies, logits,
+                                    dfa_tables)
+                self.m_forks += len(forked)
+            except Exception as e:  # noqa: BLE001 — degrade, keep serving
+                log.exception(
+                    "fork dispatch failed — degrading %d branches to clone "
+                    "admission", len(forked)
+                )
+                self._jnote("error", a=float(len(forked)))
+                self._jnote_fault(e)
+                for dst, r, h, arow in forked:
+                    self._pages_free(dst)
+                    if arow:
+                        self._adapter_unpin(arow)
+                    clones.append((r, h))
+        if clones:
+            self.m_fork_clone_fallbacks += len(clones)
+            with self._pending_lock:
+                self._pending.extend(clones)
+            self._wake.set()
+
+    # thread: engine-loop-only
+    def _dispatch_fork(self, src_slot: int, plen: int, forked: list,
+                       copies: list, logits, dfa_tables) -> None:
+        """Device work + slot installs for _fork_after_admit's fork set.
+        Boundary-page copies dispatch FIRST so device-stream order makes
+        them visible to every later branch read."""
+        nb = len(forked)
+        with_dfa = self._dfa_mode_of(dfa_tables)
+        with_topk = any(r.grammar is not None
+                        for _d, r, _h, _a in forked) and not with_dfa
+        with_lp = any(r.logprobs > 0 for _d, r, _h, _a in forked)
+        aux = np.zeros((3, nb), np.int32)
+        samp_pack = np.zeros((7, nb), np.float32)
+        aux[2] = src_slot
+        for j, (dst, r, _h, _arow) in enumerate(forked):
+            aux[0, j] = dst
+            aux[1, j] = (
+                r.seed & 0x7FFFFFFF if r.seed is not None
+                else int.from_bytes(os.urandom(4), "little") & 0x7FFFFFFF
+            )
+            for fi, kf in enumerate(_SAMPLING_FIELDS):
+                samp_pack[fi, j] = getattr(r, kf)
+        if copies:
+            cp = self._get_fork_page_copy()
+            for sp, dp in copies:
+                self.cache = cp(self.cache, jnp.int32(sp), jnp.int32(dp))
+        args = (logits, jnp.asarray(aux), jnp.asarray(samp_pack))
+        if with_dfa:
+            host = dfa_tables["host"]
+            V = self.cfg.vocab_size
+            rowb = np.unpackbits(
+                host.mask_bits[host.init_state], bitorder="little"
+            )[:V].astype(bool)
+            gmask0 = np.where(rowb, 0.0, -1e30).astype(np.float32)[None, :]
+            ginit = np.full((1,), host.init_state, np.int32)
+            args = args + (
+                jnp.asarray(gmask0), self._dfa_table(dfa_tables, with_dfa),
+                dfa_tables["tok_cls"], jnp.asarray(ginit), self.d_gstate,
+            )
+        fn = self._get_fork_sample(nb, with_topk, with_lp, with_dfa)
+        out = fn(self.counts, self.rngs, self.bias, self.d_tokens,
+                 self.d_positions, *args)
+        (self.counts, self.rngs, self.bias, self.d_tokens,
+         self.d_positions, toks, tk, lp) = out[:8]
+        if with_dfa:
+            self.d_gstate = out[8]
+        _host_copy_async(toks)
+        t0 = time.monotonic()
+        items = []
+        for j, (dst, r, h, arow) in enumerate(forked):
+            for kf in _SAMPLING_FIELDS:
+                self.h_sampling[kf][dst] = getattr(r, kf)
+            if self._mrope:
+                self.h_rope_delta[dst] = 0  # fork groups are text-only
+            self._slot_gen[dst] += 1
+            self.slots[dst] = _Slot(
+                request=r, handle=h, prompt_len=plen, scheduled=1,
+                t_submit=(h.t_submit or t0), dfa=with_dfa, sched_rows=plen,
+            )
+            self.h_active[dst] = True
+            self.h_override_mask[dst] = False
+            self.h_gmask[dst] = 1.0 if with_dfa else 0.0
+            self.h_adapter[dst] = arow
+            items.append((dst, r, h, plen, t0))
+            self._note_admitted(h)
+            self._jnote("forked", rid=h.rid, slot=dst, a=float(plen),
+                        b=float(src_slot))
+            tr = h.trace
+            if tr is not None:
+                tr.note("forked", source_slot=src_slot)
+        self._track(_Entry(kind="admit", toks=toks, tk=tk, lp=lp,
+                           gen=list(self._slot_gen), items=items))
+        self._plan_dirty()
+        self._last_admit_t = time.monotonic()
+
+    def fork(self, handle: RequestHandle, n: int = 1,
+             seeds: Optional[list] = None) -> list[RequestHandle]:
+        """Fork a LIVE stream `n` ways at its current position — the agent
+        fan-out seam (ISSUE 18): each branch inherits the source's prompt
+        and generation so far (KV shared CoW on paged engines, boundary
+        page copied) and continues decoding with a decorrelated RNG chain.
+        Branch streams emit only continuation tokens. Executes on the
+        engine loop at its next quiesce point (nothing in flight); if the
+        source finishes or is cancelled first, branch handles get an error
+        event. Dense engines degrade to recompute-clone admission (the
+        prompt + generation re-prefill as a fresh request). Mid-stream
+        forks are NOT clone-byte-compatible by design — there is no clone
+        equivalent of an in-flight RNG chain. Thread-safe."""
+        if n < 1:
+            raise ValueError("fork n must be >= 1")
+        if seeds is not None and len(seeds) != n:
+            raise ValueError(f"fork got {len(seeds)} seeds for n={n}")
+        out = []
+        for _ in range(n):
+            bh = RequestHandle()
+            bh.t_submit = time.monotonic()
+            bh.rid = f"h{id(bh):x}"
+            out.append(bh)
+        entry = (handle, list(seeds) if seeds is not None else [None] * n,
+                 out)
+        with self._fork_lock:
+            self._fork_requests.append(entry)
+        # Dead-loop check AFTER the append: the guard drains _fork_requests
+        # under _fork_lock after setting _loop_dead, so if we read None
+        # here the drain is still ahead of our entry and will error it. If
+        # we read dead, the drain may have run either side of our append —
+        # unstage if still staged and post the terminals ourselves
+        # (duplicate terminals on a handle are harmless).
+        dead = self._loop_dead
+        if dead is not None:
+            with self._fork_lock:
+                if entry in self._fork_requests:
+                    self._fork_requests.remove(entry)
+            for bh in out:
+                bh._q.put(TokenEvent(kind="error", error=dead))
+            return out
+        self._wake.set()
+        return out
+
+    # thread: engine-loop-only
+    def _service_forks(self) -> None:
+        """Execute staged mid-stream forks (Engine.fork) at a quiesce point:
+        nothing in flight and no chunked prefill, so every slot's device
+        control row exactly matches its host view (scheduled ==
+        len(generated)) and copying a row forks the stream at a well-
+        defined position. The loop holds new admissions and block
+        dispatches while forks are staged, so the wait is bounded by the
+        in-flight pipeline draining."""
+        if not self._fork_requests:
+            return
+        if self._inflight or self._chunkings:
+            return
+        with self._fork_lock:
+            staged, self._fork_requests = self._fork_requests, []
+        for src_handle, seeds, handles in staged:
+            src = next((i for i, s in enumerate(self.slots)
+                        if s is not None and s.handle is src_handle), None)
+            if src is None:
+                for bh in handles:
+                    bh._q.put(TokenEvent(
+                        kind="error",
+                        error="fork source is not an active stream",
+                    ))
+                continue
+            self._fork_midstream(src, seeds, handles)
+
+    # thread: engine-loop-only
+    def _fork_midstream(self, src: int, seeds: list, handles: list) -> None:
+        """Fork one live slot for _service_forks. Paged: addref the full
+        pages of the [0, boundary) span, copy the boundary page, copy the
+        control row with a salted RNG fold. Dense: recompute-clone — the
+        prompt + generation requeue as a fresh prefill whose stream
+        continues from the fork point."""
+        slot = self.slots[src]
+        req0 = slot.request
+        gen = list(slot.generated)
+        boundary = slot.prompt_len + max(0, len(gen) - 1)
+        page = self.ecfg.kv_page_size
+        for j, bh in enumerate(handles):
+            seed = seeds[j]
+            salt = (int(seed) & 0x7FFFFFFF if seed is not None
+                    else int.from_bytes(os.urandom(4), "little") & 0x7FFFFFFF)
+            if not self._paged:
+                ids = list(req0.prompt_ids) + gen
+                r = dataclasses.replace(
+                    req0, prompt_ids=ids, fork_group=None, resume=None,
+                    seed=(int(seed) if seed is not None else req0.seed),
+                    max_new_tokens=max(1, req0.max_new_tokens - len(gen)),
+                )
+                with self._pending_lock:
+                    self._pending.append((r, bh))
+                self.m_fork_clone_fallbacks += 1
+                self._wake.set()
+                continue
+            dst = next((i for i, s in enumerate(self.slots) if s is None),
+                       None)
+            nfull = boundary // page
+            partial = boundary % page
+            src_pages = list(self._slot_pages[src])
+            need = min((1 if partial else 0) + self.ecfg.kv_page_headroom,
+                       max(1 if partial else 0,
+                           self._pages_worst(req0) - nfull))
+            row = None
+            if dst is not None:
+                row = self._pages_alloc(
+                    dst, need, shared=src_pages[:nfull],
+                    shared_tps=(self._slot_tps[src] if self._hier else None),
+                )
+            if row is None:
+                bh._q.put(TokenEvent(
+                    kind="error", error="fork failed: no slot/page capacity"
+                ))
+                continue
+            arow = 0
+            if req0.adapter:
+                try:
+                    arow = self._adapter_acquire(req0.adapter)
+                except Exception:  # noqa: BLE001 — fail this branch only
+                    self._pages_free(dst)
+                    bh._q.put(TokenEvent(
+                        kind="error", error="fork failed: adapter pin"
+                    ))
+                    continue
+            try:
+                rg = (copy.deepcopy(req0.grammar)
+                      if req0.grammar is not None else None)
+            except Exception:  # noqa: BLE001 — fail this branch only
+                self._pages_free(dst)
+                if arow:
+                    self._adapter_unpin(arow)
+                bh._q.put(TokenEvent(
+                    kind="error", error="fork failed: grammar state copy"
+                ))
+                continue
+            if partial:
+                sp, dp = src_pages[nfull], self._slot_pages[dst][nfull]
+                cp = self._get_fork_page_copy()
+                self.cache = cp(self.cache, jnp.int32(sp), jnp.int32(dp))
+            fn = self._get_fork_ctrl_copy(bool(slot.dfa))
+            aux = np.asarray([src, dst, salt], np.int32)
+            state = (self.counts, self.rngs, self.bias, self.d_tokens,
+                     self.d_positions)
+            if slot.dfa:
+                out = fn(*state, jnp.asarray(aux), self.d_gstate)
+                self.d_gstate = out[5]
+            else:
+                out = fn(*state, jnp.asarray(aux))
+            (self.counts, self.rngs, self.bias, self.d_tokens,
+             self.d_positions) = out[:5]
+            r = dataclasses.replace(
+                req0, prompt_ids=list(req0.prompt_ids), fork_group=None,
+                resume=None, grammar=rg,
+                seed=(int(seed) if seed is not None else req0.seed),
+            )
+            for kf in _SAMPLING_FIELDS:
+                self.h_sampling[kf][dst] = getattr(r, kf)
+            if self._mrope:
+                self.h_rope_delta[dst] = self.h_rope_delta[src]
+            self._slot_gen[dst] += 1
+            ns = _Slot(
+                request=r, handle=bh, prompt_len=slot.prompt_len,
+                generated=list(gen), emitted_len=slot.emitted_len,
+                scheduled=len(gen), t_submit=bh.t_submit, dfa=slot.dfa,
+                sched_rows=boundary,
+            )
+            ns.t_first = time.monotonic()
+            self.slots[dst] = ns
+            self.h_active[dst] = True
+            self.h_override_tok[dst] = self.h_override_tok[src]
+            self.h_override_mask[dst] = self.h_override_mask[src]
+            self.h_gmask[dst] = self.h_gmask[src]
+            self.h_adapter[dst] = arow
+            self.m_forks += 1
+            self._note_admitted(bh)
+            self._jnote("forked", rid=bh.rid, slot=dst, a=float(boundary),
+                        b=float(src))
+        self._plan_dirty()
 
     # ------------------------------------------------------------------ #
     # Prompt/prefix KV cache (host side)
@@ -4508,7 +5231,8 @@ class Engine:
 
     def _dispatch_admit_cached(self, request: GenRequest, handle: RequestHandle,
                                slot_idx: int, entry: dict, match_len: int,
-                               dfa_tables: Optional[dict] = None):
+                               dfa_tables: Optional[dict] = None,
+                               with_logits: bool = False):
         """Admission via the prompt cache: ship only the tail tokens.
         Returns True (admitted), False (stale hit / pool pressure — paged
         callers requeue), or "full" (cached program still compiling in the
@@ -4582,7 +5306,7 @@ class Engine:
             pages_arr = np.full((npg,), self._scratch_page, np.int32)
             pages_arr[: len(shared)] = shared
             key = ("cached-paged", npg, tb, fbp, has_bias, with_topk, with_lp,
-                   with_dfa, draft)
+                   with_dfa, draft, with_logits)
             getter = self._get_admit_cached_paged
             row = (self.h_l1[slot_idx] if self._hier
                    else self.h_ptable[slot_idx])
@@ -4667,6 +5391,8 @@ class Engine:
             self.d_gstate = out[9]
         elif draft:
             self.d_cache = out[9]
+        if with_logits:
+            self._fork_logits = out[-1]
         _host_copy_async(toks)
         # LRU bump + metrics. Identity scan, not `in`: dict == would compare
         # the numpy key arrays elementwise (and raises on length mismatch).
@@ -5037,11 +5763,22 @@ class Engine:
         for slot in self.slots:
             if slot is not None:
                 slot.handle._q.put(TokenEvent(kind="done", finish_reason="stop"))
+                for _r, bh in (slot.request.fork_group or ()):
+                    bh._q.put(TokenEvent(kind="done", finish_reason="stop"))
+                slot.request.fork_group = None
         with self._pending_lock:
             pending, self._pending = list(self._pending), deque()
         for req, handle in pending:
             self._resume_discard(req)
             handle._q.put(TokenEvent(kind="done", finish_reason="stop"))
+            for _r, bh in (req.fork_group or ()):
+                bh._q.put(TokenEvent(kind="done", finish_reason="stop"))
+            req.fork_group = None
+        with self._fork_lock:
+            staged, self._fork_requests = self._fork_requests, []
+        for _src, _seeds, handles in staged:
+            for bh in handles:
+                bh._q.put(TokenEvent(kind="done", finish_reason="stop"))
         if self._tok_fp is not None:
             # Release grammar tables prewarm pinned against this engine's
             # tokenizer — they can never hit again after the model swaps.
@@ -5226,6 +5963,9 @@ class Engine:
             for _req, handle in self._pending:
                 handle.cancel()
                 n += 1
+                for _r, bh in (_req.fork_group or ()):
+                    bh.cancel()
+                    n += 1
         for slot in list(self.slots):
             if slot is not None:
                 slot.handle.cancel()
@@ -5238,6 +5978,9 @@ class Engine:
             for request, handle in pending:
                 self._resume_discard(request)
                 handle._q.put(TokenEvent(kind="done", finish_reason="stop"))
+                for _r, bh in (request.fork_group or ()):
+                    bh._q.put(TokenEvent(kind="done", finish_reason="stop"))
+                request.fork_group = None
         return n
 
     def embed(self, ids_batch: list[list[int]]) -> np.ndarray:
@@ -5297,6 +6040,7 @@ class Engine:
             out["kv_pages_total"] = float(self.ecfg.kv_pages)
             out["kv_pages_free"] = float(len(self._free_pages))
             out["kv_pages_grown"] = float(self.m_kv_pages_grown)
+            out["kv_pages_peak"] = float(self.m_kv_pages_peak)
             out["kv_preemptions"] = float(self.m_kv_preemptions)
             out["kv_preempt_swaps"] = float(self.m_kv_preempt_swaps)
             out["kv_preempt_recomputes"] = float(self.m_kv_preempt_recomputes)
@@ -5339,6 +6083,11 @@ class Engine:
             out["adapter_promotes"] = float(self.m_adapter_promotes)
             out["adapter_evictions"] = float(self.m_adapter_evictions)
         out["peak_active_slots"] = float(self.m_peak_active)
+        if self.m_forks or self.m_fork_clone_fallbacks:
+            # Tree-batched fork sampling (ISSUE 18): branches admitted by
+            # slot fork vs degraded to the N-clone path (fault/pressure).
+            out["fork_branches"] = float(self.m_forks)
+            out["fork_clone_fallbacks"] = float(self.m_fork_clone_fallbacks)
         if self.m_loop_blocks:
             # Pipelined loop runtime (ISSUE 17): host ms spent per decode
             # block outside the wait phase, and the control-stager's
@@ -5798,8 +6547,20 @@ class Engine:
                 log.exception("post-death state release failed")
             for _i, slot in live_slots:
                 slot.handle._q.put(TokenEvent(kind="error", error=err))
+                for _r, bh in (slot.request.fork_group or ()):
+                    bh._q.put(TokenEvent(kind="error", error=err))
+                slot.request.fork_group = None
             for _request, handle in pending:
                 handle._q.put(TokenEvent(kind="error", error=err))
+                for _r, bh in (_request.fork_group or ()):
+                    bh._q.put(TokenEvent(kind="error", error=err))
+                _request.fork_group = None
+            # Staged mid-stream forks (Engine.fork) can never execute now.
+            with self._fork_lock:
+                staged_forks, self._fork_requests = self._fork_requests, []
+            for _src, _seeds, fhandles in staged_forks:
+                for bh in fhandles:
+                    bh._q.put(TokenEvent(kind="error", error=err))
             # Flight recorder (ISSUE 11): this thread is the journal's
             # writer, so the final events and the dump race nothing.
             try:
@@ -5901,7 +6662,13 @@ class Engine:
                 # during the drain) — nothing is waiting on pages anymore,
                 # so admission must unblock or the queue starves.
                 self._growth_blocked = False
-            admitted = self._admit_pending()
+            if self._fork_requests:
+                # Mid-stream forks (Engine.fork) execute at a quiesce point;
+                # while any are staged, hold new admissions and blocks so
+                # in-flight work drains and the fork wait stays bounded.
+                self._service_forks()
+            admitted = (False if self._fork_requests
+                        else self._admit_pending())
             ph.lap("admit")
             # Only host-walk grammars force single-step, serialized blocks;
             # DFA-constrained slots pipeline at full depth like everyone else.
@@ -5910,7 +6677,9 @@ class Engine:
             nblocks = sum(1 for e in self._inflight if e.kind == "block")
             active = bool(self.h_active.any())
 
-            dispatchable = active and nblocks < depth and not (grammar and self._inflight)
+            dispatchable = (active and nblocks < depth
+                            and not (grammar and self._inflight)
+                            and not self._fork_requests)
             if dispatchable and not grammar and not self._has_unscheduled():
                 # Every active slot's budget is already covered by in-flight
                 # blocks — another dispatch would compute only discarded
@@ -6040,6 +6809,11 @@ class Engine:
             slot = self.slots[i]
             if slot is not None:
                 slot.handle._q.put(TokenEvent(
+                    kind="error", error=f"{type(e).__name__}: {e}"
+                ))
+                # A chunked fork primary still carries its branch group
+                # until the final chunk activates it.
+                self._fork_group_fail(slot.request, TokenEvent(
                     kind="error", error=f"{type(e).__name__}: {e}"
                 ))
                 self._release(i)
@@ -6181,6 +6955,9 @@ class Engine:
             self._resume_discard(request)
             if why is None:
                 handle._q.put(TokenEvent(kind="done", finish_reason="stop"))
+                # A cancelled fork primary's live branches requeue as
+                # independents (each pays its own prefill).
+                self._fork_group_requeue(request)
                 continue
             if why == "deadline":
                 self.m_deadline_expired += 1
@@ -6194,6 +6971,10 @@ class Engine:
                        f"(queue_timeout_s) — server saturated")
             handle.cancel()  # a racing admit must not serve it anyway
             handle._q.put(TokenEvent(kind="error", error=err))
+            # An expired fork primary takes its whole group down — the
+            # branches share its prompt, deadline pressure and fate.
+            self._fork_group_fail(request,
+                                  TokenEvent(kind="error", error=err))
 
     def _enforce_deadlines(self) -> None:
         """Cancel ACTIVE slots whose deadline has passed (loop thread). The
@@ -6265,7 +7046,13 @@ class Engine:
             pages_planned = 0
             chunk_item = None  # ((request, handle), hit) → chunked admission
             swap_item = None  # (request, handle) → swap-preempted resume
+            fork_item = None  # (request, handle) → fork-group primary
             prefix_hits: dict[int, tuple] = {}  # id(request) -> (entry, len)
+            # Cancelled fork primaries found during the locked scan requeue
+            # their live branches AFTER the lock drops (_fork_group_requeue
+            # takes _pending_lock itself; the branches land at the queue
+            # tail either way).
+            requeue_forks: list[GenRequest] = []
             with self._pending_lock:
                 while self._pending and len(group) < len(free):
                     request, handle = self._pending[0]
@@ -6273,6 +7060,8 @@ class Engine:
                         self._pending.popleft()
                         self._resume_discard(request)
                         handle._q.put(TokenEvent(kind="done", finish_reason="stop"))
+                        if request.fork_group:
+                            requeue_forks.append(request)
                         continue
                     if (self._paged and request.resume is not None
                             and request.resume.get("mode") == "swap"):
@@ -6297,6 +7086,16 @@ class Engine:
                                 break  # dispatch the batched group first
                             chunk_item = (self._pending.popleft(), hit0)
                             break
+                    if request.fork_group is not None:
+                        # Fork primaries plan as singleton rounds (ISSUE 18):
+                        # _fork_after_admit claims EXTRA slots right after
+                        # the primary's admission dispatch, which must not
+                        # collide with slots this round already handed to
+                        # other chunks. Budgeting happens outside the lock.
+                        if group:
+                            break  # dispatch the batched group first
+                        fork_item = self._pending.popleft()
+                        break
                     if self._paged:
                         # A prefix hit shares the span's pages — gate on the
                         # reduced (tail-only) need. Requests the cached path
@@ -6330,6 +7129,8 @@ class Engine:
                     elif b != bucket:
                         break  # different bucket — next round
                     group.append(self._pending.popleft())
+            for _req in requeue_forks:
+                self._fork_group_requeue(_req)
             if swap_item is not None:
                 request, handle = swap_item
                 need = self._resume_swap_pages(request)
@@ -6355,6 +7156,50 @@ class Engine:
                     admitted = True
                     continue  # re-plan the remaining queue
                 return admitted  # pool backpressure — wait for a finish
+            if fork_item is not None:
+                request, handle = fork_item
+                if self._paged:
+                    hit = prefix_hits.get(id(request))
+                    if hit is None and self._cached_admit_ok(request):
+                        hit = self._prefix_find(request.prompt_ids)
+                        if hit is not None:
+                            prefix_hits[id(request)] = hit
+                    need = (self._pages_needed_cached(request, hit[1],
+                                                      host="hk" in hit[0])
+                            if hit is not None
+                            else self._pages_needed(request))
+                    # Budget the whole tree: the primary's prefill pages plus
+                    # each branch's boundary-copy + headroom claim. Branches
+                    # the pool can't cover at fork time degrade to clones,
+                    # but planning for the full tree avoids flapping.
+                    need += sum(self._pages_fork_need(r)
+                                for r, _h in request.fork_group)
+                    if need > len(self._free_pages):
+                        self._prefix_evict_for_pages(
+                            need,
+                            protect=[h[0] for h in prefix_hits.values()],
+                        )
+                    if need > len(self._free_pages):
+                        with self._pending_lock:
+                            self._pending.appendleft(fork_item)
+                        return admitted  # pool backpressure — wait
+                self._note_admitted(handle)
+                try:
+                    self._dispatch_admit(
+                        [fork_item],
+                        self._bucket_for(len(request.prompt_ids)), [free[0]],
+                        prefix_hit=prefix_hits.get(id(request)),
+                    )
+                    admitted = True
+                except Exception as e:  # noqa: BLE001 — surface to callers, keep serving
+                    log.exception("fork admission dispatch failed")
+                    self._jnote("error", a=1.0)
+                    self._jnote_fault(e)
+                    ev = TokenEvent(kind="error",
+                                    error=f"{type(e).__name__}: {e}")
+                    handle._q.put(ev)
+                    self._fork_group_fail(request, ev)
+                continue  # re-plan the remaining queue
             if not group:
                 return admitted
             for _req, gh in group:
@@ -6423,6 +7268,10 @@ class Engine:
             faults.fire("collective_dispatch")
         m = len(chunk)
         V = self.cfg.vocab_size
+        # Fork primaries (ISSUE 18) are admitted as singletons and need the
+        # final-position logits stashed for _fork_after_admit.
+        with_logits = (m == 1 and chunk[0][0].fork_group is not None
+                       and self._paged and self.draft_cfg is None)
         dfa_tables = None
         # Resume requests keep the HOST grammar walk: the machine object
         # carries the mid-stream state a fresh device-DFA init would lose.
@@ -6443,9 +7292,15 @@ class Engine:
             if hit is not None:
                 res = self._dispatch_admit_cached(
                     chunk[0][0], chunk[0][1], slot_ids[0], *hit,
-                    dfa_tables=dfa_tables,
+                    dfa_tables=dfa_tables, with_logits=with_logits,
                 )
                 if res is True:
+                    if chunk[0][0].fork_group is not None:
+                        # Fork of a prefix-hit span: the siblings addref the
+                        # hit's pages through the primary's slot — pure
+                        # sharing, zero prefill.
+                        self._fork_after_admit(slot_ids[0], chunk[0][0],
+                                               dfa_tables)
                     return
                 if res == "full":
                     # Cached-admit program still compiling in the background:
@@ -6534,7 +7389,7 @@ class Engine:
         with_dfa = self._dfa_mode_of(dfa_tables)
         fn = self._get_admit(m, bucket, has_bias, with_topk, with_lp, n_img,
                              with_dfa=with_dfa, with_mrope=with_mrope,
-                             with_lora=with_lora)
+                             with_lora=with_lora, with_logits=with_logits)
         t_b = time.monotonic()
         args_in = (
             jnp.asarray(prompt_toks), jnp.asarray(aux), jnp.asarray(samp_pack),
@@ -6637,6 +7492,8 @@ class Engine:
             rest = rest[1:]
         if self.draft_cfg is not None:
             self.d_cache = rest[0]
+        if with_logits:
+            self._fork_logits = out[-1]
         t_d = time.monotonic()
         _host_copy_async(toks)
         if trace:
@@ -6678,6 +7535,8 @@ class Engine:
         )
         self._plan_dirty()
         self._last_admit_t = time.monotonic()
+        if m == 1 and chunk[0][0].fork_group is not None:
+            self._fork_after_admit(slot_ids[0], chunk[0][0], dfa_tables)
 
     # ------------------------------------------------------------------ #
     # Decode blocks

@@ -59,6 +59,9 @@ BASE_EVENTS = (
     #                  (slot, a=pages, b=bytes; docs/LONG_CONTEXT.md)
     "page_restore",  # spilled pages swapped back into fresh pool pages
     #                  (slot, a=pages, b=bytes)
+    "forked",        # slot forked off a freshly-admitted sibling (slot=branch,
+    #                  a=shared prompt/boundary rows, b=source slot;
+    #                  docs/TREE_SAMPLING.md)
 )
 
 # One journal event type per fault-injection site (faults.SITES), checked
@@ -80,6 +83,7 @@ FAULT_EVENTS = (
     "fault_spec_verify",
     "fault_page_spill",
     "fault_control_commit",
+    "fault_slot_fork",
 )
 
 EVENTS = BASE_EVENTS + FAULT_EVENTS

@@ -795,6 +795,7 @@ class ModelManager:
                 kv_spill_bytes=cfg.kv_spill_bytes,
                 kv_l1_span=cfg.kv_l1_span,
                 sp_prefill=cfg.sp_prefill,
+                fork_sampling=cfg.fork_sampling,
                 max_pending=cfg.max_pending,
                 queue_timeout_s=cfg.queue_timeout_s,
                 deadline_s=cfg.deadline_s,

@@ -173,16 +173,29 @@ class OpenAIApi:
             ) from None
 
     @staticmethod
-    def _submit_all(lm: LoadedModel, gens: list) -> list:
+    def _submit_all(lm: LoadedModel, gens: list, group: int = 1) -> list:
         """Submit every GenRequest, mapping engine backpressure to HTTP:
         a full queue (QueueFullError) becomes 429 + Retry-After derived
         from the engine's observed admission latency, and any handles
         already submitted are cancelled so a partially-admitted multi-
-        choice request never leaks slots."""
+        choice request never leaks slots.
+
+        `group` > 1 routes each run of `group` consecutive same-prompt
+        requests (one n>1 / best_of choice group) through ONE fork
+        admission (ISSUE 18, docs/TREE_SAMPLING.md): the group pays a
+        single prefill and the engine forks the slot CoW per branch.
+        Engines without the fork surface (remote proxies, cluster
+        facades) and conditions the engine can't fork (dense cache,
+        draft model, fork_sampling off) fall back to independent clone
+        submits with identical outputs."""
         handles = []
         try:
-            for g in gens:
-                handles.append(lm.engine.submit(g))
+            if group > 1 and hasattr(lm.engine, "submit_fork"):
+                for k in range(0, len(gens), group):
+                    handles.extend(lm.engine.submit_fork(gens[k:k + group]))
+            else:
+                for g in gens:
+                    handles.append(lm.engine.submit(g))
         except QueueFullError as e:
             for h in handles:
                 h.cancel()
@@ -296,6 +309,40 @@ class OpenAIApi:
         if n < 1 or n > 64:
             raise ApiError(400, "n must be between 1 and 64")
         return n
+
+    @staticmethod
+    def _best_of(body: dict[str, Any], n: int) -> int:
+        """Validated `best_of` branch count (docs/TREE_SAMPLING.md):
+        generate best_of branches off one shared prefill, rank by
+        cumulative logprob, return the top n. Defaults to n (no
+        over-generation); streaming cannot rank after the fact, so
+        best_of > n on a stream is a client error (OpenAI semantics)."""
+        bo = body.get("best_of")
+        if bo is None:
+            return n
+        try:
+            bo = int(bo)
+        except (TypeError, ValueError):
+            raise ApiError(400, "best_of must be an integer") from None
+        if bo < n:
+            raise ApiError(400, "best_of must be >= n")
+        if bo > 64:
+            raise ApiError(400, "best_of must be between n and 64")
+        if bo > n and body.get("stream"):
+            raise ApiError(400, "best_of > n cannot be used with streaming")
+        return bo
+
+    @staticmethod
+    def _select_best(results: list, n: int) -> list:
+        """best_of ranking for one choice group: highest cumulative token
+        logprob first (ties keep submission order), top n re-indexed in
+        rank order."""
+        def score(r) -> float:
+            return sum(ev.logprob for ev in r[1] if ev.logprob is not None)
+
+        order = sorted(range(len(results)),
+                       key=lambda i: (-score(results[i]), i))
+        return [results[i] for i in order[:n]]
 
     @staticmethod
     def _merge_streams(handles: list) -> Iterator[tuple[int, Any]]:
@@ -549,17 +596,21 @@ class OpenAIApi:
                     len(ids), image_offset, grid, merge=vision.merge
                 )
 
-        # Independent GenRequest per choice: fresh grammar machine (the
-        # pushdown state is mutable), decorrelated seeds when one was given.
+        # Independent GenRequest per branch: fresh grammar machine (the
+        # pushdown state is mutable), decorrelated seeds when one was
+        # given. best_of > n over-generates and ranks by cumulative
+        # logprob, so ranking forces per-token logprobs internally (the
+        # response strips them unless the client asked).
+        bo = self._best_of(body, n)
         gens = []
-        for i in range(n):
+        for i in range(bo):
             g = self._gen_request(lm, body, ids, extra_stop=lm.evaluator.stop_sequences())
             g.grammar = make_grammar() if make_grammar else None
-            g.logprobs = lp_n
+            g.logprobs = lp_n if bo == n else max(lp_n, 1)
             g.image_embeds = image_embeds
             g.image_offset = image_offset
             g.mrope_positions = mrope_positions
-            if g.seed is not None and n > 1:
+            if g.seed is not None and bo > 1:
                 g.seed = int(g.seed) + i
             gens.append(g)
 
@@ -570,7 +621,7 @@ class OpenAIApi:
         extra_usage = "extra-usage" in req.headers
 
         if body.get("stream"):
-            handles = self._submit_all(lm, gens)
+            handles = self._submit_all(lm, gens, group=len(gens))
 
             def cancel_all() -> None:
                 for h in handles:
@@ -618,6 +669,10 @@ class OpenAIApi:
                                 s["emitted"] = len(s["parts"])
                                 yield chunk(idx, {"content": text}, ev=ev)
                         elif ev.kind == "error":
+                            # A failed choice abandons the whole stream:
+                            # cancel the siblings so their slots stop
+                            # decoding into it (ISSUE 18 satellite).
+                            cancel_all()
                             yield {"error": {"message": ev.error, "type": "server_error"}}
                             return
                         else:
@@ -653,7 +708,7 @@ class OpenAIApi:
             return SSEStream(events(), on_disconnect=cancel_all)
 
         try:
-            handles = self._submit_all(lm, gens)
+            handles = self._submit_all(lm, gens, group=len(gens))
             try:
                 results = [self._collect(h) for h in handles]
             except BaseException:
@@ -665,7 +720,12 @@ class OpenAIApi:
 
         from localai_tpu.utils.finetune import finetune, needs_finetune
 
+        # Usage/metrics count every generated branch (the client paid for
+        # best_of completions); choices carry only the ranked top n.
         self._note_request_metrics(model_name, [r[2] for r in results])
+        all_finals = [r[2] for r in results]
+        if bo > n:
+            results = self._select_best(results, n)
         choices = []
         for idx, (text, toks, final) in enumerate(results):
             if needs_finetune(lm.cfg):
@@ -687,7 +747,7 @@ class OpenAIApi:
             "id": rid, "object": "chat.completion", "created": created,
             "model": model_name, "system_fingerprint": _fingerprint(),
             "choices": choices,
-            "usage": self._sum_usage([r[2] for r in results], extra_usage),
+            "usage": self._sum_usage(all_finals, extra_usage),
         })
 
     # ------------------------------------------------------------------ #
@@ -748,32 +808,35 @@ class OpenAIApi:
     def _completion_inner(self, lm, lease, body, prompts, rid, created,
                           extra_usage, traceparent="") -> Response | SSEStream:
         n = self._n_choices(body)
+        bo = self._best_of(body, n)
         lp_n = self._completion_lp(body)
 
         # Raw GBNF grammar on completions too (the reference's Grammar field
         # rides PredictOptions for every text endpoint).
         make_grammar = self._gbnf_factory(body)
 
-        # One GenRequest per (prompt, choice): all submitted up front so free
-        # slots run them concurrently (multi-prompt requests previously ran
-        # serially — VERDICT weak #7).
+        # One GenRequest per (prompt, branch): all submitted up front so
+        # free slots run them concurrently (multi-prompt requests
+        # previously ran serially — VERDICT weak #7); each prompt's
+        # branches form one fork group (shared prefill). best_of > n
+        # forces internal logprobs for the ranking pass.
         gens = []
         templated_prompts = []
         for p in prompts:
             templated = lm.evaluator.template_completion(p)
             templated_prompts.append(templated)
             ids = lm.engine.tokenizer.encode(templated, add_bos=True)
-            for j in range(n):
+            for j in range(bo):
                 g = self._gen_request(lm, body, ids)
                 g.grammar = make_grammar() if make_grammar else None
-                g.logprobs = lp_n
-                if g.seed is not None and n > 1:
+                g.logprobs = lp_n if bo == n else max(lp_n, 1)
+                if g.seed is not None and bo > 1:
                     g.seed = int(g.seed) + j
                 gens.append(g)
         self._tag_requests(gens, rid, traceparent)
 
         if body.get("stream"):
-            handles = self._submit_all(lm, gens)
+            handles = self._submit_all(lm, gens, group=bo)
 
             def cancel_all() -> None:
                 for h in handles:
@@ -791,6 +854,10 @@ class OpenAIApi:
                                 c["logprobs"] = self._completion_lp_block(lm, [ev], 0)
                             yield {**base, "choices": [c]}
                         elif ev.kind == "error":
+                            # A failed choice abandons the whole stream:
+                            # cancel the siblings so their slots stop
+                            # decoding into it (ISSUE 18 satellite).
+                            cancel_all()
                             yield {"error": {"message": ev.error, "type": "server_error"}}
                             return
                         else:
@@ -810,7 +877,7 @@ class OpenAIApi:
             return SSEStream(events(), on_disconnect=cancel_all)
 
         try:
-            handles = self._submit_all(lm, gens)
+            handles = self._submit_all(lm, gens, group=bo)
             try:
                 results = [self._collect(h) for h in handles]
             except BaseException:
@@ -822,7 +889,13 @@ class OpenAIApi:
 
         from localai_tpu.utils.finetune import finetune, needs_finetune
 
+        # Usage/metrics count every generated branch (the client paid for
+        # best_of completions); choices carry only each prompt's top n.
         self._note_request_metrics(lm.cfg.name, [r[2] for r in results])
+        all_finals = [r[2] for r in results]
+        if bo > n:
+            results = [r for k in range(0, len(results), bo)
+                       for r in self._select_best(results[k:k + bo], n)]
         choices = []
         for idx, (text, toks, final) in enumerate(results):
             prompt = prompts[idx // n]
@@ -840,7 +913,7 @@ class OpenAIApi:
         return Response(body={
             "id": rid, "object": "text_completion", "created": created,
             "model": lm.cfg.name, "choices": choices,
-            "usage": self._sum_usage([r[2] for r in results], extra_usage),
+            "usage": self._sum_usage(all_finals, extra_usage),
         })
 
     def edit(self, req: Request) -> Response:

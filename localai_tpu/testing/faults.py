@@ -137,6 +137,7 @@ SITES = (
     "spec_verify",
     "page_spill",
     "control_commit",
+    "slot_fork",
 )
 
 DEFAULT_RATE = 0.05

@@ -75,6 +75,19 @@ def test_direction_markers_cover_longctx_rows():
     assert direction("longctx_users_doc_tokens") == "higher"
 
 
+def test_direction_markers_cover_fork_rows():
+    """BENCH_FORK keys (ISSUE 18, docs/TREE_SAMPLING.md) gate in the
+    right direction from their first shared round: a rising KV ratio
+    means CoW sharing broke; the fork-vs-clone speedup must not drop."""
+    assert direction("fork_best_of_1_decode_tok_per_s") == "higher"
+    assert direction("fork_best_of_8_decode_tok_per_s") == "higher"
+    assert direction("fork_best_of_1_p99_ttft_ms") == "lower"
+    assert direction("fork_best_of_8_p99_ttft_ms") == "lower"
+    assert direction("fork_kv_bytes_ratio") == "lower"
+    # "speedup" outranks the lower-is-better "ttft" marker.
+    assert direction("fork_vs_clone_ttft_speedup") == "higher"
+
+
 def test_compare_flags_drops_in_the_bad_direction():
     old = {"decode_tps": 1000.0, "p99_ttft_ms": 100.0, "accept_rate": 0.5}
     new = {"decode_tps": 850.0, "p99_ttft_ms": 125.0, "accept_rate": 0.52}

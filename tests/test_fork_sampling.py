@@ -1,0 +1,335 @@
+"""Tree-batched parallel sampling (ISSUE 18, docs/TREE_SAMPLING.md).
+
+A same-prompt request group admits ONE prefill; the engine forks the
+primary's slot per branch by addref'ing its KV pages (CoW boundary page)
+and replaying the admission sampling recipe per branch from the stashed
+final-position logits. The contract under test: fork output is
+BYTE-IDENTICAL to N independent clone admissions (greedy and seeded,
+dense fallback and paged, chunked prefill, prefix hit, grammar-DFA,
+spec modes, tp=2), and best-of-8 stays within 1.5x the KV pages of
+best-of-1 (allocator-counted).
+"""
+
+import threading
+
+import jax
+import pytest
+
+from localai_tpu.engine.engine import Engine, EngineConfig, GenRequest
+from localai_tpu.engine.tokenizer import ByteTokenizer
+from localai_tpu.models import get_arch
+from localai_tpu.models.llama import init_params
+from localai_tpu.parallel.mesh import MeshPlan
+
+PAGE = 16
+
+
+def _mk(paged=True, tp=1, **kw):
+    cfg = get_arch("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    defaults = dict(max_slots=8, max_seq=256, min_prefill_bucket=16)
+    if paged:
+        defaults.update(kv_pages=64, kv_page_size=PAGE)
+    defaults.update(kw)
+    eng = Engine(
+        cfg, params, ByteTokenizer(cfg.vocab_size),
+        mesh_plan=MeshPlan(tp=tp) if tp > 1 else None,
+        engine_cfg=EngineConfig(**defaults),
+    )
+    eng.start()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def engines():
+    dense = _mk(paged=False)
+    paged = _mk(paged=True)
+    yield dense, paged
+    dense.stop()
+    paged.stop()
+
+
+def _drain(h):
+    toks, final = [], None
+    for ev in h:
+        if ev.kind == "token":
+            toks.append(ev.token_id)
+        else:
+            final = ev
+    return toks, final
+
+
+def _drain_all(handles):
+    outs = [None] * len(handles)
+
+    def one(i):
+        outs[i] = _drain(handles[i])
+
+    ts = [threading.Thread(target=one, args=(i,)) for i in range(len(handles))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    return outs
+
+
+def _reqs(prompt, n, max_new=16, **kw):
+    return [GenRequest(prompt_ids=list(prompt), max_new_tokens=max_new,
+                       ignore_eos=True, **kw) for _ in range(n)]
+
+
+def _run_group(eng, reqs, fork):
+    if fork:
+        handles = eng.submit_fork(reqs)
+    else:
+        handles = [eng.submit(r) for r in reqs]
+    outs = _drain_all(handles)
+    for i, (toks, final) in enumerate(outs):
+        assert final is not None and final.kind == "done", (
+            i, final.kind if final else None, getattr(final, "error", None))
+    return [toks for toks, _f in outs]
+
+
+def test_fork_greedy_matches_clone_paged(engines):
+    _dense, paged = engines
+    prompt = list(range(40, 90))  # 3 full pages + a partial boundary page
+    before = paged.m_forks
+    got = _run_group(paged, _reqs(prompt, 4), fork=True)
+    want = _run_group(paged, _reqs(prompt, 4), fork=False)
+    assert got == want
+    assert paged.m_forks - before == 3, "group did not admit via fork"
+
+
+def test_fork_page_aligned_prompt(engines):
+    """No partial boundary page: every prompt page is shared, zero copies."""
+    _dense, paged = engines
+    prompt = [(j * 7) % 250 + 1 for j in range(64)]  # 64 % PAGE == 0
+    got = _run_group(paged, _reqs(prompt, 3), fork=True)
+    want = _run_group(paged, _reqs(prompt, 3), fork=False)
+    assert got == want
+
+
+def test_fork_seeded_matches_clone(engines):
+    """seed+i decorrelation is byte-compatible with the clone fallback:
+    branch i's RNG chain is exactly what its own admission would build."""
+    dense, paged = engines
+    prompt = [(j * 11) % 250 + 1 for j in range(45)]
+    for eng in (paged, dense):
+        reqs = [GenRequest(prompt_ids=list(prompt), max_new_tokens=14,
+                           ignore_eos=True, temperature=0.9, top_k=24,
+                           seed=900 + i) for i in range(4)]
+        got = _run_group(eng, [GenRequest(**vars(r)) for r in reqs], fork=True)
+        want = _run_group(eng, reqs, fork=False)
+        assert got == want, ("dense" if eng is dense else "paged")
+
+
+def test_fork_dense_fallback(engines):
+    """Dense engines keep the N-clone fallback behind the same API."""
+    dense, _paged = engines
+    before = dense.m_forks
+    prompt = list(range(5, 45))
+    got = _run_group(dense, _reqs(prompt, 3), fork=True)
+    want = _run_group(dense, _reqs(prompt, 3), fork=False)
+    assert got == want
+    assert dense.m_forks == before, "dense engine must not fork"
+
+
+def test_fork_disabled_by_config():
+    eng = _mk(paged=True, fork_sampling=False)
+    try:
+        prompt = list(range(30, 70))
+        before = eng.m_forks
+        got = _run_group(eng, _reqs(prompt, 3), fork=True)
+        want = _run_group(eng, _reqs(prompt, 3), fork=False)
+        assert got == want
+        assert eng.m_forks == before
+    finally:
+        eng.stop()
+
+
+def test_fork_chunked_prefill_matches_clone():
+    """Long prompt admits via chunked prefill; the fork happens at the
+    final chunk's dispatch (one chunked prefill for the whole group)."""
+    eng = _mk(paged=True, max_seq=512, prefill_chunk=64)
+    try:
+        prompt = [(j * 13) % 250 + 1 for j in range(200)]
+        before = eng.m_forks
+        got = _run_group(eng, _reqs(prompt, 4), fork=True)
+        want = _run_group(eng, _reqs(prompt, 4), fork=False)
+        assert got == want
+        assert eng.m_forks - before == 3
+    finally:
+        eng.stop()
+
+
+def test_fork_prefix_hit_matches_clone():
+    """Fork off a prefix-cache hit: the primary's admission maps the
+    cached span (pure addref) and the branches addref the same pages."""
+    eng = _mk(paged=True, prefix_cache_entries=4,
+              prefix_admit_async_compile=False)
+    try:
+        prompt = [(j * 17) % 250 + 1 for j in range(80)]
+        # Warm the span, then fork a group on the same prompt.
+        eng.generate(list(prompt), max_new_tokens=4, ignore_eos=True)
+        hits0 = eng.m_prefix_hits
+        got = _run_group(eng, _reqs(prompt, 3), fork=True)
+        assert eng.m_prefix_hits > hits0, "prefix span never hit"
+        want = _run_group(eng, _reqs(prompt, 3), fork=False)
+        assert got == want
+    finally:
+        eng.stop()
+
+
+def test_fork_grammar_dfa_matches_clone():
+    """Each branch gets its own grammar machine / DFA lane; constrained
+    fork output matches constrained clone output byte-for-byte."""
+    from localai_tpu.functions.jsonschema import GrammarConstraint
+
+    schema = {"type": "object",
+              "properties": {"a": {"type": "integer"},
+                             "b": {"type": "boolean"}},
+              "required": ["a", "b"]}
+    eng = _mk(paged=True)
+    try:
+        # Compile the schema's DFA tables up front: uncached schemas build
+        # off-thread and their first request host-walks — a different
+        # (equally valid) whitespace path that would break the byte
+        # comparison below.
+        assert eng.prewarm_grammar(schema)
+        prompt = list(range(60, 100))
+
+        def group(seeded):
+            return [GenRequest(prompt_ids=list(prompt), max_new_tokens=24,
+                               grammar=GrammarConstraint(schema),
+                               temperature=(0.8 if seeded else 0.0),
+                               seed=(70 + i if seeded else None))
+                    for i in range(3)]
+
+        for seeded in (False, True):
+            got = _run_group(eng, group(seeded), fork=True)
+            want = _run_group(eng, group(seeded), fork=False)
+            assert got == want, f"seeded={seeded}"
+        assert eng.m_dfa_tokens > 0, "DFA path did not engage"
+    finally:
+        eng.stop()
+
+
+@pytest.mark.parametrize("mode", ["prompt_lookup", "self_draft"])
+def test_fork_spec_modes_match_clone(mode):
+    """Self-speculative engines fork too (no separate draft model): the
+    branch's spec state is slot-generation-keyed and rebuilds lazily."""
+    kw = dict(spec_mode=mode)
+    if mode == "self_draft":
+        kw["self_draft_layers"] = 1
+    eng = _mk(paged=True, **kw)
+    try:
+        prompt = [(j * 3) % 250 + 1 for j in range(50)]
+        got = _run_group(eng, _reqs(prompt, 3, max_new=20), fork=True)
+        want = _run_group(eng, _reqs(prompt, 3, max_new=20), fork=False)
+        assert got == want
+    finally:
+        eng.stop()
+
+
+@pytest.mark.multichip
+def test_fork_tp2_matches_clone(multichip):
+    """Sharded engine (tp=2): the fork programs ride the same mesh."""
+    eng = _mk(paged=True, tp=2)
+    try:
+        prompt = list(range(20, 70))
+        got = _run_group(eng, _reqs(prompt, 3), fork=True)
+        want = _run_group(eng, _reqs(prompt, 3), fork=False)
+        assert got == want
+        assert eng.m_forks >= 2
+    finally:
+        eng.stop()
+
+
+def test_best_of_8_kv_pages_within_1_5x():
+    """The ROADMAP BENCH target, asserted from allocator accounting:
+    best-of-8 on a shared 512-token prompt peaks at <= 1.5x the pool
+    pages of best-of-1 (clones would peak at ~8x)."""
+    eng = _mk(paged=True, max_slots=9, max_seq=576, kv_pages=80,
+              prefix_cache_entries=0)
+    try:
+        prompt = [(j * 29) % 250 + 1 for j in range(512)]  # 32 full pages
+        _run_group(eng, _reqs(prompt, 1, max_new=8), fork=True)
+        peak1 = eng.metrics()["kv_pages_peak"]
+        assert peak1 >= 32
+        eng.m_kv_pages_peak = 0
+        before = eng.m_forks
+        _run_group(eng, _reqs(prompt, 8, max_new=8), fork=True)
+        peak8 = eng.metrics()["kv_pages_peak"]
+        assert eng.m_forks - before == 7, "branches degraded to clones"
+        assert peak8 <= 1.5 * peak1, (peak8, peak1)
+    finally:
+        eng.stop()
+
+
+def test_fork_midstream_continues():
+    """Engine.fork (the agent fan-out seam): branches continue a live
+    stream from its current boundary; the source is unaffected."""
+    eng = _mk(paged=True, max_seq=512)
+    try:
+        prompt = list(range(40, 90))
+        h = eng.submit(GenRequest(prompt_ids=list(prompt),
+                                  max_new_tokens=200, ignore_eos=True))
+        first = next(iter(h))
+        assert first.kind == "token"
+        bhs = eng.fork(h, n=2, seeds=[7, 8])
+        toks, final = _drain(h)
+        assert final.kind == "done"
+        assert len(toks) == 199  # source stream unaffected by the fork
+        for bh in bhs:
+            btoks, bfin = _drain(bh)
+            assert bfin.kind == "done", getattr(bfin, "error", None)
+            # Branches emit only continuation tokens past the boundary.
+            assert 0 < len(btoks) <= 199
+        assert eng.m_forks >= 2
+    finally:
+        eng.stop()
+
+
+def test_fork_midstream_dead_source_errors():
+    """Forking a finished stream posts an error event per branch handle
+    instead of hanging the caller."""
+    eng = _mk(paged=True)
+    try:
+        h = eng.submit(GenRequest(prompt_ids=[1, 2, 3], max_new_tokens=4,
+                                  ignore_eos=True))
+        _drain(h)
+        bhs = eng.fork(h, n=2)
+        for bh in bhs:
+            _toks, fin = _drain(bh)
+            assert fin.kind == "error"
+            assert "not an active stream" in fin.error
+    finally:
+        eng.stop()
+
+
+def test_fork_group_cancel_before_admission():
+    """Cancelling the primary before admission requeues live branches as
+    independents; cancelled branches get their terminal."""
+    eng = _mk(paged=True, max_slots=2)
+    try:
+        prompt = list(range(10, 60))
+        reqs = _reqs(prompt, 3, max_new=8)
+        handles = eng.submit_fork(reqs)
+        handles[0].cancel()
+        handles[2].cancel()
+        outs = _drain_all(handles)
+        for toks, fin in outs:
+            assert fin is not None and fin.kind == "done"
+        # The un-cancelled branch still produced tokens.
+        assert len(outs[1][0]) == 8
+    finally:
+        eng.stop()
+
+
+def test_submit_fork_rejects_mixed_prompts(engines):
+    _dense, paged = engines
+    with pytest.raises(ValueError, match="identical prompts"):
+        paged.submit_fork([
+            GenRequest(prompt_ids=[1, 2, 3], max_new_tokens=4),
+            GenRequest(prompt_ids=[1, 2, 4], max_new_tokens=4),
+        ])

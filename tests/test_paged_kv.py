@@ -998,3 +998,105 @@ def test_512k_context_acceptance():
         _check_pool_invariants(sut)
     finally:
         sut.stop()
+
+
+# ---------------------------------------------------------------------- #
+# Tree-batched parallel sampling (ISSUE 18, docs/TREE_SAMPLING.md):
+# fork/diverge/cancel churn accounting + slot_fork fault injection.
+# ---------------------------------------------------------------------- #
+
+def test_fork_churn_invariants_hold_at_quiesce():
+    """Randomized fork/diverge/cancel churn over a small HIERARCHICAL
+    pool: same-prompt groups admit via one fork admission (branches
+    addref KV pages AND L1 directory chunks), branches diverge into
+    private pages, some cancel mid-stream, some groups overflow the slot
+    count and degrade to clone admission — after every batch drains the
+    pool and the L1 table pages must be perfectly accounted."""
+    import threading
+
+    rng = np.random.default_rng(13)
+    eng = _mk_engine_cfg(kv_pages=24, max_slots=6, max_seq=256,
+                         kv_l1_span=2, kv_swap_bytes=64 << 20)
+    try:
+        for batch in range(3):
+            handles = []
+            for _g in range(2):
+                plen = int(rng.integers(20, 100))
+                ids = [int(x) % 255 + 1 for x in rng.integers(0, 255, plen)]
+                reqs = [
+                    GenRequest(
+                        prompt_ids=list(ids),
+                        max_new_tokens=int(rng.integers(8, 60)),
+                        temperature=0.8, seed=int(rng.integers(0, 2 ** 31)),
+                        ignore_eos=True,
+                    )
+                    for _ in range(int(rng.integers(2, 5)))
+                ]
+                handles.extend(eng.submit_fork(reqs))
+            for h in handles:
+                if rng.random() < 0.25:
+                    h.cancel()
+            # Mid-stream fan-out off a (possibly live) member of the batch.
+            n_group = len(handles)
+            handles.extend(eng.fork(handles[int(rng.integers(0, n_group))],
+                                    n=1, seeds=[int(rng.integers(0, 2 ** 31))]))
+            outs = [None] * len(handles)
+
+            def drain(i, h):
+                outs[i] = list(h)[-1].kind
+
+            ts = [threading.Thread(target=drain, args=(i, h))
+                  for i, h in enumerate(handles)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=120)
+            assert not any(t.is_alive() for t in ts), "hung fork caller"
+            # Group members always finish; the mid-stream branch may get a
+            # clean error when its source finished/cancelled first or the
+            # pool had no capacity for it.
+            assert set(outs[:n_group]) == {"done"}, outs
+            assert outs[n_group] in ("done", "error")
+            _quiesce(eng)
+            _check_pool_invariants(eng)
+        assert eng.m_forks > 0, "churn never exercised the fork path"
+        _flush_prefix(eng)
+        _check_pool_invariants(eng)
+    finally:
+        eng.stop()
+
+
+def test_slot_fork_fault_degrades_to_clone():
+    """Fixed-seed slot_fork fault smoke (ISSUE 18 satellite): with the
+    site firing at every fork-time page claim, every branch degrades to
+    ordinary clone admission — outputs byte-identical (clone IS the
+    fallback contract), zero hung callers, journal carries
+    fault_slot_fork, pool fully accounted at quiesce."""
+    from localai_tpu.testing import faults
+
+    eng = _mk_engine_cfg(kv_pages=32, max_slots=6, max_seq=256)
+    ids = list(range(30, 80))
+
+    def group():
+        return [GenRequest(prompt_ids=list(ids), max_new_tokens=12,
+                           ignore_eos=True) for _ in range(3)]
+
+    try:
+        want = [h.result()[0] for h in [eng.submit(g) for g in group()]]
+        forks0 = eng.m_forks
+        with faults.active(faults.FaultSchedule(
+            seed=5, rate=1.0, sites=("slot_fork",),
+        )) as sched:
+            handles = eng.submit_fork(group())
+            got = [h.result()[0] for h in handles]
+            assert sched.total_fired() > 0, "site never fired"
+        assert got == want
+        assert eng.m_forks == forks0, "a faulted branch still forked"
+        assert eng.m_fork_clone_fallbacks >= 2
+        _quiesce(eng)
+        _check_pool_invariants(eng)
+        evs = [e["event"] for e in eng.journal.snapshot()]
+        assert "fault_slot_fork" in evs
+        assert "forked" not in evs
+    finally:
+        eng.stop()

@@ -454,3 +454,128 @@ def test_metrics_scrape_includes_engine_gauges(api):
     assert 'localai_engine_prefix_host_tier_entries{model="tiny-paged"}' in body
     # The histogram must still be there (regression guard).
     assert "localai_api_call_bucket" in body
+
+
+# ---------------------------------------------------------------------- #
+# Tree-batched parallel sampling surface (ISSUE 18, docs/TREE_SAMPLING.md)
+# ---------------------------------------------------------------------- #
+
+def test_completion_best_of(api):
+    """best_of over-generates branches off one shared prefill and returns
+    the top n ranked by cumulative logprob; usage counts every branch."""
+    base, _ = api
+    out = _post(base, "/v1/completions", {
+        "model": "tiny-paged", "prompt": "rank me", "max_tokens": 5,
+        "n": 2, "best_of": 4, "temperature": 0.0,
+    })
+    assert len(out["choices"]) == 2
+    assert [c["index"] for c in out["choices"]] == [0, 1]
+    # Internal ranking logprobs must not leak when the client asked none.
+    assert all("logprobs" not in c for c in out["choices"])
+    # Greedy branches are identical, so the ranked top-2 must be too.
+    assert out["choices"][0]["text"] == out["choices"][1]["text"]
+    # usage counts all best_of branches, not just the returned n.
+    assert out["usage"]["completion_tokens"] >= 4
+
+
+def test_chat_best_of(api):
+    base, _ = api
+    out = _post(base, "/v1/chat/completions", {
+        "model": "tiny-chat",
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 4, "n": 1, "best_of": 3,
+    })
+    assert len(out["choices"]) == 1
+    assert "logprobs" not in out["choices"][0]
+
+
+def test_best_of_validation(api):
+    base, _ = api
+    for body, msg in [
+        ({"n": 3, "best_of": 2}, "best_of must be >= n"),
+        ({"best_of": "x"}, "integer"),
+        ({"n": 1, "best_of": 4, "stream": True}, "streaming"),
+    ]:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, "/v1/completions", {
+                "model": "tiny-2", "prompt": "p", "max_tokens": 2, **body,
+            })
+        assert ei.value.code == 400, msg
+
+
+class _FakeHandle:
+    def __init__(self, evs):
+        self.evs = evs
+        self.cancelled = threading.Event()
+
+    def __iter__(self):
+        for ev in self.evs:
+            yield ev
+            if ev.kind in ("done", "error"):
+                return
+
+    def cancel(self):
+        self.cancelled.set()
+
+
+def _fake_lm(handles):
+    """Minimal LoadedModel stand-in: enough surface for the chat and
+    completion inner paths, streaming from canned handles."""
+    from types import SimpleNamespace
+
+    eng = SimpleNamespace(
+        tokenizer=SimpleNamespace(encode=lambda text, add_bos=True: [1, 2, 3]),
+        submit=lambda g: handles.pop(0),
+    )
+    cfg = SimpleNamespace(
+        name="fake", max_tokens=8, temperature=0.0, top_k=0, top_p=1.0,
+        min_p=0.0, repeat_penalty=1.0, presence_penalty=0.0,
+        frequency_penalty=0.0, seed=None, deadline_s=0.0, echo=False,
+        template=SimpleNamespace(use_tokenizer_template=False),
+    )
+    evaluator = SimpleNamespace(
+        template_completion=lambda p: p,
+        template_messages=lambda msgs, tools_prompt="": "prompt",
+        stop_sequences=lambda: [],
+    )
+    return SimpleNamespace(engine=eng, cfg=cfg, evaluator=evaluator)
+
+
+@pytest.mark.parametrize("endpoint", ["completion", "chat"])
+def test_stream_error_cancels_sibling_handles(endpoint):
+    """ISSUE 18 satellite regression: when one choice of an n>1 stream
+    posts an error event, the generator must cancel the SIBLING handles
+    before returning — previously their slots kept decoding into the
+    abandoned stream until max_new_tokens."""
+    from types import SimpleNamespace
+
+    from localai_tpu.engine.engine import TokenEvent
+    from localai_tpu.server.openai_api import OpenAIApi
+
+    h_err = _FakeHandle([TokenEvent(kind="error", error="boom")])
+    h_ok = _FakeHandle([
+        TokenEvent(kind="token", token_id=1, text="x"),
+        TokenEvent(kind="done", finish_reason="length"),
+    ])
+    lm = _fake_lm([h_err, h_ok])
+    lease = SimpleNamespace(release=lambda: None)
+    oai = OpenAIApi.__new__(OpenAIApi)
+    oai.manager = None
+    oai.router = None
+
+    if endpoint == "completion":
+        resp = oai._completion_inner(
+            lm, lease, {"stream": True, "n": 2, "max_tokens": 4},
+            ["p"], "cmpl-x", 0, False)
+    else:
+        from localai_tpu.server.app import Request
+
+        body = {"stream": True, "n": 2, "max_tokens": 4,
+                "messages": [{"role": "user", "content": "hi"}]}
+        req = Request(method="POST", path="/v1/chat/completions", params={},
+                      query={}, headers={}, body=body)
+        resp = oai._chat_inner(req, lm, lease, body)
+    frames = list(resp.events)
+    assert any("error" in f for f in frames if isinstance(f, dict))
+    assert h_err.cancelled.is_set()
+    assert h_ok.cancelled.is_set(), "sibling handle left decoding"

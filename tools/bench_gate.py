@@ -52,6 +52,13 @@ HIGHER_MARKERS = (
     # "doc_tokens" pins it higher-is-better so a bigger benchmark document
     # can never read as a regression.
     "hit_rate", "doc_tokens",
+    # Tree-batched parallel sampling rows (ISSUE 18, BENCH_FORK,
+    # docs/TREE_SAMPLING.md) ride existing markers:
+    # fork_best_of_{1,8}_decode_tok_per_s -> "tok_per" (higher),
+    # fork_best_of_{1,8}_p99_ttft_ms -> "ttft"/"_ms"/"p99" (lower),
+    # fork_kv_bytes_ratio -> "bytes" (lower: CoW forking must keep the
+    # best-of-8 page peak near best-of-1, a rise means sharing broke),
+    # fork_vs_clone_ttft_speedup -> "speedup" (higher, outranks "ttft").
 )
 LOWER_MARKERS = (
     "_ms", "_s", "ms_", "latency", "ttft", "stall", "bytes", "recover",
