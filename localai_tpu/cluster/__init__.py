@@ -12,6 +12,12 @@ from localai_tpu.cluster.affinity import (
     leading_overlap,
     span_hashes,
 )
+from localai_tpu.cluster.netretry import (
+    BreakerOpen,
+    CircuitBreaker,
+    RetryPolicy,
+    call_with_retry,
+)
 from localai_tpu.cluster.replica import (
     ClusterEngine,
     LocalReplica,
@@ -22,18 +28,29 @@ from localai_tpu.cluster.replica import (
     probe_worker_role,
     scrape_engine_gauges,
 )
-from localai_tpu.cluster.scheduler import ClusterClient, ClusterScheduler
+from localai_tpu.cluster.scheduler import (
+    MEMBER_STATES,
+    ClusterClient,
+    ClusterScheduler,
+    continuation_seed,
+)
 from localai_tpu.cluster.transfer import SpanTransferError, decode_span, encode_span
 
 __all__ = [
+    "BreakerOpen",
+    "CircuitBreaker",
     "ClusterClient",
     "ClusterEngine",
     "ClusterScheduler",
     "LocalReplica",
+    "MEMBER_STATES",
     "RemoteReplica",
+    "RetryPolicy",
     "SpanTransferError",
     "build_local_replicas",
     "byte_span_hashes",
+    "call_with_retry",
+    "continuation_seed",
     "decode_span",
     "encode_span",
     "leading_overlap",

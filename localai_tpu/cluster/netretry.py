@@ -1,0 +1,245 @@
+"""Remote-call hardening: deadlines, bounded retry, circuit breakers.
+
+Every cross-host call the cluster layer makes (healthz/role probes, gauge
+scrapes, LAIKV span transfers, RemoteEngine request proxying) used to be
+one-shot: a single transient failure dropped a worker at registration, a
+slow /metrics scrape read as a crashed replica, and a dead peer got
+hammered on every gauge refresh forever. This module is the shared
+hardening substrate (ISSUE 19):
+
+- `RetryPolicy` + `call_with_retry` — bounded attempts with exponential
+  backoff and deterministic jitter, under an optional overall deadline.
+  Retries are for *transient transport* failures (connection refused,
+  reset, timeout); typed application failures propagate immediately.
+- `CircuitBreaker` — per-replica closed → open → half-open state machine.
+  `failure_threshold` consecutive failures open the breaker; while open,
+  every call is refused instantly (typed `BreakerOpen`, an OSError, so
+  existing transport-failure handling catches it); after `reset_s` the
+  breaker admits exactly ONE probe per half-open window — probe success
+  closes it, probe failure re-opens it for another window. The scheduler
+  journals `breaker_open` / `breaker_probe` / `breaker_close` transitions
+  through the `on_event` hook (observe/journal.py BASE_EVENTS).
+
+Determinism: jitter comes from a `random.Random` seeded by the call's
+`what` label, so a retry pattern is a pure function of (label, attempt) —
+reproducible across runs, like the fault schedules in testing/faults.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import random
+import threading
+import time
+import urllib.error
+from typing import Callable, Optional
+
+# Transport-level failures worth retrying. urllib wraps socket errors in
+# URLError (an OSError subclass); HTTPError is a RESPONSE (the peer is up
+# and answered) and is deliberately NOT retried here — callers decide what
+# 4xx/5xx mean.
+TRANSIENT_ERRORS: tuple = (OSError, http.client.HTTPException)
+
+
+class BreakerOpen(ConnectionError):
+    """Refused without touching the network: the breaker is open. An
+    OSError subclass on purpose — every existing transport-failure path
+    (scheduler gauge refresh, netspan resume loop) treats it as the dead
+    peer it stands for, without a new except arm."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded-retry shape: `attempts` total tries, exponential backoff
+    from `base_delay_s` (×`multiplier` per retry, capped at `max_delay_s`)
+    with ±`jitter` fractional randomization, all under an optional overall
+    `deadline_s` (0 = attempts alone bound the call)."""
+
+    attempts: int = 3
+    base_delay_s: float = 0.1
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.5
+    deadline_s: float = 0.0
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        """Backoff before retry number `attempt` (1-based)."""
+        d = min(self.max_delay_s,
+                self.base_delay_s * (self.multiplier ** (attempt - 1)))
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
+        return max(0.0, d)
+
+
+DEFAULT_POLICY = RetryPolicy()
+# Registration probes (ISSUE 19 satellite): one transient failure must not
+# drop a worker at registration, but a genuinely-down peer should fail the
+# construction path quickly — short fuse, fast backoff.
+PROBE_POLICY = RetryPolicy(attempts=3, base_delay_s=0.05, max_delay_s=0.5)
+
+
+def call_with_retry(fn: Callable, *, policy: RetryPolicy = DEFAULT_POLICY,
+                    retry_on: tuple = TRANSIENT_ERRORS,
+                    breaker: Optional["CircuitBreaker"] = None,
+                    what: str = "", sleep: Callable[[float], None] = time.sleep):
+    """Run `fn()` under the policy. Raises the LAST transport error once
+    attempts (or the deadline) are exhausted; non-retryable exceptions
+    propagate immediately. With a breaker: refused instantly while open,
+    and every outcome feeds the breaker's failure accounting."""
+    if breaker is not None:
+        breaker.guard(what=what)
+    rng = random.Random(f"netretry:{what}")
+    t0 = time.monotonic()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            out = fn()
+        except retry_on as e:
+            if isinstance(e, urllib.error.HTTPError):
+                raise  # an answer, not an outage (HTTPError is an OSError)
+            if breaker is not None:
+                breaker.record_failure()
+            if attempt >= policy.attempts:
+                raise
+            d = policy.delay(attempt, rng)
+            if policy.deadline_s > 0.0:
+                remaining = policy.deadline_s - (time.monotonic() - t0)
+                if remaining <= 0.0:
+                    raise
+                d = min(d, remaining)
+            sleep(d)
+            if breaker is not None:
+                # The breaker may have been opened by a concurrent caller
+                # between attempts — stop hammering mid-retry too.
+                breaker.guard(what=what)
+            continue
+        if breaker is not None:
+            breaker.record_success()
+        return out
+
+
+class CircuitBreaker:
+    """Per-replica call gate: closed → open → half-open.
+
+    closed     every call admitted; `failure_threshold` CONSECUTIVE
+               failures trip it open.
+    open       every call refused instantly (BreakerOpen) for `reset_s`.
+    half-open  after `reset_s`, exactly ONE probe call is admitted per
+               window (concurrent callers are refused while it is in
+               flight). Probe success closes the breaker; probe failure
+               re-opens it for another full window.
+
+    `on_event(event, a)` fires on transitions ("breaker_open",
+    "breaker_probe", "breaker_close") — the scheduler stages these into
+    its journal so chaos runs can assert the ≤-1-probe-per-window bound
+    from events alone. Thread-safe; all state sits behind one lock.
+    """
+
+    def __init__(self, name: str = "", failure_threshold: int = 3,
+                 reset_s: float = 5.0,
+                 on_event: Optional[Callable[[str, float], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.name = name
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.reset_s = float(reset_s)
+        self.on_event = on_event
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self.m_opens = 0
+        self.m_probes = 0
+        self.m_refused = 0
+
+    # ---------------- observation ---------------- #
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> str:
+        if (self._state == "open"
+                and self._clock() - self._opened_at >= self.reset_s):
+            self._state = "half_open"
+            self._probe_inflight = False
+        return self._state
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._state_locked(),
+                "failures": self._failures,
+                "opens": self.m_opens,
+                "probes": self.m_probes,
+                "refused": self.m_refused,
+            }
+
+    # ---------------- call gate ---------------- #
+
+    def allow(self) -> bool:
+        """True when a call may proceed. In half-open, the True answer IS
+        the probe admission — at most one per window."""
+        emit = None
+        with self._lock:
+            st = self._state_locked()
+            if st == "closed":
+                return True
+            if st == "half_open" and not self._probe_inflight:
+                self._probe_inflight = True
+                self.m_probes += 1
+                emit = ("breaker_probe", float(self.m_probes))
+            else:
+                self.m_refused += 1
+        if emit is not None:
+            self._emit(*emit)
+            return True
+        return False
+
+    def guard(self, what: str = "") -> None:
+        if not self.allow():
+            raise BreakerOpen(
+                f"circuit breaker open for {self.name or what or 'peer'} — "
+                f"call refused without touching the network")
+
+    def record_success(self) -> None:
+        emit = None
+        with self._lock:
+            was = self._state
+            self._state = "closed"
+            self._failures = 0
+            self._probe_inflight = False
+            if was != "closed":
+                emit = ("breaker_close", 0.0)
+        if emit is not None:
+            self._emit(*emit)
+
+    def record_failure(self) -> None:
+        emit = None
+        with self._lock:
+            self._failures += 1
+            st = self._state_locked()
+            trip = (st == "half_open"
+                    or (st == "closed"
+                        and self._failures >= self.failure_threshold))
+            if trip:
+                self._state = "open"
+                self._opened_at = self._clock()
+                self._probe_inflight = False
+                self.m_opens += 1
+                emit = ("breaker_open", float(self._failures))
+        if emit is not None:
+            self._emit(*emit)
+
+    def _emit(self, event: str, a: float) -> None:
+        cb = self.on_event
+        if cb is None:
+            return
+        try:
+            cb(event, a)
+        except Exception:  # noqa: BLE001 — observation must not fail calls
+            pass

@@ -266,15 +266,26 @@ def fetch_span(base_url: str, model: str, prompt_ids,
                timeout_s: float = 30.0, trace_id: str = "",
                traceparent: str = "", compute: bool = True,
                max_resumes: int = 2, verify: bool = True,
-               should_abort: Optional[Callable[[], bool]] = None) -> bytes:
+               should_abort: Optional[Callable[[], bool]] = None,
+               breaker=None) -> bytes:
     """Pull one prompt's KV span from a remote exporter as a verified LAIKV
     frame. Resumes from the verified offset after connection drops (up to
     `max_resumes` times); raises SpanTransferError on any terminal failure
-    — the caller's contract is recompute."""
+    — the caller's contract is recompute.
+
+    `breaker` (cluster.netretry.CircuitBreaker, ISSUE 19): each attempt is
+    gated on it and feeds its failure accounting, so a fetch against a peer
+    whose breaker is already open fails typed WITHOUT a connect, and
+    repeated partition failures here open the breaker for the gauge path
+    too — the two surfaces share one view of the peer's health."""
     got = b""
     digest = ""
     attempts = 0
     while True:
+        if breaker is not None and not breaker.allow():
+            raise SpanTransferError(
+                f"span fetch refused: circuit breaker open for "
+                f"{base_url} ({len(got)} bytes verified)")
         asm = StreamAssembler(max_bytes=max_bytes, prior=got,
                               expect_digest=digest, verify=verify)
         body = json.dumps({
@@ -311,6 +322,8 @@ def fetch_span(base_url: str, model: str, prompt_ids,
                         break
                     asm.feed(data)
             if asm.done:
+                if breaker is not None:
+                    breaker.record_success()
                 return asm.result()
             err = "stream ended before the trailer"
         except SpanTransferError:
@@ -329,6 +342,8 @@ def fetch_span(base_url: str, model: str, prompt_ids,
             err = e  # host_partition: resumable, like any dropped link
         except (OSError, http.client.HTTPException) as e:
             err = e  # timeout / reset / refused / truncated chunked body
+        if breaker is not None:
+            breaker.record_failure()  # any resumable failure counts
         got = asm.frame_so_far()
         digest = str(asm.meta.get("digest", "")) or digest
         attempts += 1

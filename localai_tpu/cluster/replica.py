@@ -26,6 +26,8 @@ import time
 import urllib.request
 from typing import Optional
 
+from localai_tpu.cluster import netretry
+
 log = logging.getLogger("localai_tpu.cluster")
 
 
@@ -87,13 +89,24 @@ def build_local_replicas(cfg, params, tokenizer, n: int, engine_cfg,
     return out
 
 
-def probe_worker_role(base_url: str, timeout: float = 3.0) -> str:
-    """One /healthz probe reading the LocalAI-Cluster-Role header a worker
+def probe_worker_role(base_url: str, timeout: float = 3.0,
+                      retry: Optional["netretry.RetryPolicy"] = None,
+                      breaker: Optional["netretry.CircuitBreaker"] = None,
+                      ) -> str:
+    """/healthz probe reading the LocalAI-Cluster-Role header a worker
     advertises on every response (server/app.py). Returns "mixed" when the
-    worker declares nothing; raises on an unreachable worker."""
-    with urllib.request.urlopen(base_url.rstrip("/") + "/healthz",
-                                timeout=timeout) as resp:
-        role = resp.headers.get("LocalAI-Cluster-Role", "")
+    worker declares nothing; raises once the bounded retry (default:
+    netretry.PROBE_POLICY — one transient failure must not drop a worker at
+    registration, ISSUE 19) exhausts on an unreachable worker."""
+
+    def _probe() -> str:
+        with urllib.request.urlopen(base_url.rstrip("/") + "/healthz",
+                                    timeout=timeout) as resp:
+            return resp.headers.get("LocalAI-Cluster-Role", "")
+
+    role = netretry.call_with_retry(
+        _probe, policy=retry or netretry.PROBE_POLICY, breaker=breaker,
+        what=f"probe_role:{base_url}")
     from localai_tpu.cluster.scheduler import ROLES
 
     return role if role in ROLES else "mixed"
@@ -128,6 +141,13 @@ class RemoteReplica:
     unreachable past the bound raises — the scheduler then marks it dead
     and drains its affinity, exactly like a crashed local replica. Roles
     ride the LocalAI-Cluster-Role header on the same cadence.
+
+    Every wire call (role probe, gauge scrape, span fetch) goes through the
+    replica's own circuit breaker (cluster.netretry, ISSUE 19): a few
+    consecutive transport failures open it and subsequent calls are refused
+    WITHOUT touching the network — a dead peer costs one probe per
+    half-open window instead of a connect timeout per gauge tick. The
+    scheduler wires `breaker.on_event` to its journal at registration.
     """
 
     remote = True
@@ -137,7 +157,8 @@ class RemoteReplica:
                  role: str = "mixed", gauge_stale_s: float = 5.0,
                  timeout_s: float = 20.0,
                  chunk_bytes: int = 1 << 20, verify: bool = True,
-                 max_resumes: int = 2, discover_role: bool = True):
+                 max_resumes: int = 2, discover_role: bool = True,
+                 breaker: Optional[netretry.CircuitBreaker] = None):
         self.name = name
         self.url = url.rstrip("/")
         self.model = model
@@ -147,6 +168,8 @@ class RemoteReplica:
         self.chunk_bytes = chunk_bytes
         self.verify = verify
         self.max_resumes = max_resumes
+        self.breaker = breaker if breaker is not None else (
+            netretry.CircuitBreaker(name=name, reset_s=gauge_stale_s))
         self._gauges: dict = {}
         self._gauge_at = 0.0
         self._role_at = 0.0
@@ -154,6 +177,9 @@ class RemoteReplica:
             # Eager discovery: role decides whether the cluster client
             # enables disaggregation AT CONSTRUCTION (a down peer keeps the
             # declared default and re-discovers at the next gauge refresh).
+            # Bounded-retry probe, but NO breaker involvement: construction
+            # failures must not start a half-open cycle before the replica
+            # is even registered.
             try:
                 self.role = probe_worker_role(
                     self.url, timeout=min(3.0, timeout_s))
@@ -180,7 +206,8 @@ class RemoteReplica:
             return self._gauges
         try:
             g = scrape_engine_gauges(self.url, model=self.model,
-                                     timeout=min(3.0, self.timeout_s))
+                                     timeout=min(3.0, self.timeout_s),
+                                     breaker=self.breaker)
         except Exception:
             if now - self._gauge_at > self.gauge_stale_s:
                 raise  # stale past the bound == dead host
@@ -191,7 +218,8 @@ class RemoteReplica:
             # scheduler.refresh() syncs rep.role from this attribute.
             try:
                 self.role = probe_worker_role(
-                    self.url, timeout=min(3.0, self.timeout_s))
+                    self.url, timeout=min(3.0, self.timeout_s),
+                    breaker=self.breaker)
                 self._role_at = time.monotonic()
             except Exception:  # noqa: BLE001 — role keeps its last value
                 pass
@@ -201,7 +229,8 @@ class RemoteReplica:
                    traceparent: str = "", should_abort=None) -> bytes:
         """Pull (computing on demand) this prompt's KV span from the peer
         over the streamed wire format. Raises SpanTransferError on any
-        terminal failure — the caller recomputes."""
+        terminal failure — the caller recomputes. Gated by the replica
+        breaker: a peer already known-dead is refused without a connect."""
         from localai_tpu.cluster import netspan, transfer
 
         return netspan.fetch_span(
@@ -210,33 +239,45 @@ class RemoteReplica:
             chunk_bytes=self.chunk_bytes, timeout_s=self.timeout_s,
             trace_id=trace_id, traceparent=traceparent, compute=True,
             max_resumes=self.max_resumes, verify=self.verify,
-            should_abort=should_abort)
+            should_abort=should_abort, breaker=self.breaker)
 
     def stop(self) -> None:  # lifecycle parity with LocalReplica
         return None
 
 
 def scrape_engine_gauges(base_url: str, model: str = "",
-                         timeout: float = 3.0) -> dict:
+                         timeout: float = 3.0,
+                         retry: Optional["netretry.RetryPolicy"] = None,
+                         breaker: Optional["netretry.CircuitBreaker"] = None,
+                         ) -> dict:
     """Pull localai_engine_* gauges for one model from a worker's /metrics
     (the PR 3 scrape surface) into a plain {gauge: value} dict — the remote
-    analogue of LocalReplica.gauges(). Raises on an unreachable worker so
-    the scheduler treats it as dead."""
+    analogue of LocalReplica.gauges(). The scrape itself runs under a
+    bounded retry (default netretry.PROBE_POLICY) and optional circuit
+    breaker; raises once those exhaust, and scheduler.refresh() counts that
+    toward the replica's gauge_fail_threshold — not instant death."""
+
+    def _scrape() -> bytes:
+        with urllib.request.urlopen(base_url.rstrip("/") + "/metrics",
+                                    timeout=timeout) as resp:
+            return resp.read()
+
+    body = netretry.call_with_retry(
+        _scrape, policy=retry or netretry.PROBE_POLICY, breaker=breaker,
+        what=f"scrape_gauges:{base_url}")
     out: dict[str, float] = {}
-    with urllib.request.urlopen(base_url.rstrip("/") + "/metrics",
-                                timeout=timeout) as resp:
-        for raw in resp.read().decode("utf-8", "replace").splitlines():
-            line = raw.strip()
-            if not line.startswith("localai_engine_"):
-                continue
-            head, _, val = line.rpartition(" ")
-            name, _, labels = head.partition("{")
-            if model and f'model="{model}"' not in labels:
-                continue
-            try:
-                out[name[len("localai_engine_"):]] = float(val)
-            except ValueError:
-                continue
+    for raw in body.decode("utf-8", "replace").splitlines():
+        line = raw.strip()
+        if not line.startswith("localai_engine_"):
+            continue
+        head, _, val = line.rpartition(" ")
+        name, _, labels = head.partition("{")
+        if model and f'model="{model}"' not in labels:
+            continue
+        try:
+            out[name[len("localai_engine_"):]] = float(val)
+        except ValueError:
+            continue
     return out
 
 

@@ -8,11 +8,25 @@ The tentpole of ISSUE 6. Two layers:
   (localai_tpu.cluster.affinity) and scores candidates by expected prefix
   hit × inverse load. Load comes from the PR 4 engine gauges — queue_depth,
   active_slots, admit_wait_ms EWMA, queue_shed, loop_dead — pulled at most
-  every gauge_refresh_s. A replica whose gauges report loop_dead (or whose
-  gauge source fails: a crashed process scrapes like a dead loop) is marked
-  dead and its affinity entries are CLEARED, so stale span digests stop
-  attracting traffic within one gauge refresh; the crash-only manager's
-  restart shows up as the gauges recovering.
+  every gauge_refresh_s.
+
+  Membership is a lifecycle state machine (ISSUE 19, MEMBER_STATES):
+  joining → probing → active → draining → dead → removed. A replica joins
+  "joining" and becomes routable only once a gauge scrape succeeds; a
+  FAILED scrape is no longer instant death — it counts toward
+  `gauge_fail_threshold` consecutive failures (routing continues on the
+  last-good gauges in between), while an affirmative loop_dead gauge still
+  kills immediately. Dead replicas recover to active when their gauges come
+  back (the crash-only manager's restart). `begin_drain` stops NEW picks
+  while in-flight streams (tracked via begin_stream/end_stream) finish, and
+  hands the replica's span affinity to the least-loaded active survivor —
+  a routing hint, recompute-on-miss — instead of dropping it; `leave`
+  drains then removes once in-flight hits zero. Death still CLEARS affinity
+  (the spans died with the engine state; the digests are stale
+  advertisements). Every transition is staged into the scheduler's own
+  EventJournal (`member_state` events), as are per-replica circuit-breaker
+  transitions (cluster.netretry) and mid-stream grammar replays, so chaos
+  runs (tools/chaos_run.py) assert robustness invariants from events.
 
 - `ClusterClient` — the dispatch engine over in-process replicas
   (cluster.replica.LocalReplica). submit() returns a RequestHandle exactly
@@ -35,6 +49,7 @@ death, reroute exhaustion, injected cluster_dispatch fault, cancellation.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import logging
 import queue
 import threading
@@ -45,6 +60,7 @@ from typing import Any, Callable, Optional
 from typing import TYPE_CHECKING
 
 from localai_tpu.cluster import affinity, transfer
+from localai_tpu.observe.journal import EventJournal
 from localai_tpu.testing import faults
 
 if TYPE_CHECKING:  # engine pulls jax — runtime imports stay lazy
@@ -67,9 +83,30 @@ def _engine_types():
 
 ROLES = ("prefill", "decode", "mixed")
 
+# Replica lifecycle (ISSUE 19). Order is the `member_state` journal wire
+# code (a=new index, b=old index), so append-only.
+#   joining   registered, no successful gauge scrape yet — not routable
+#   probing   a join-time scrape failed; retried every refresh
+#   active    routable: eligible for pick()
+#   draining  no NEW picks; in-flight streams finish; affinity handed off
+#   dead      crashed (loop_dead gauge, threshold of failed scrapes, or an
+#             out-of-band note_dead) — recovers to active when gauges return
+#   removed   terminal; the record leaves the table
+MEMBER_STATES = ("joining", "probing", "active", "draining", "dead", "removed")
+
 # Load normalization: 100 ms of observed admission wait weighs like one
 # queued request. The scheduler only needs ORDER to be sane, not calibration.
 _ADMIT_WAIT_MS_PER_UNIT = 100.0
+
+
+def continuation_seed(seed: int, emitted: int) -> int:
+    """Deterministic RNG seed for a mid-stream reroute continuation: a pure
+    31-bit function of (original seed, emitted position), so a rerouted
+    sampled stream depends only on the request and where the fault landed —
+    never on which survivor picked it up or wall-clock timing. 31-bit
+    because the engine packs seeds as `seed & 0x7FFFFFFF` into i32 aux rows."""
+    h = hashlib.blake2b(f"{seed}:{emitted}".encode(), digest_size=4).digest()
+    return int.from_bytes(h, "big") & 0x7FFFFFFF
 
 
 @dataclasses.dataclass
@@ -81,9 +118,16 @@ class _Replica:
     target: Any
     role: str
     gauge_fn: Optional[Callable[[], dict]]
-    alive: bool = True
+    state: str = "active"  # MEMBER_STATES
     load: float = 0.0
     last_shed: float = 0.0
+    # Consecutive failed gauge scrapes; reset on any success. Death needs
+    # gauge_fail_threshold of these (one slow /metrics is not a crash).
+    gauge_failures: int = 0
+    # In-flight streams dispatched to this replica (begin/end_stream) —
+    # what drain waits on before a deferred removal completes.
+    inflight: int = 0
+    pending_remove: bool = False
     # False for REMOTE replicas (ISSUE 13): valid prefill-handoff/affinity
     # targets, but the in-process ClusterClient cannot submit to them — the
     # federation front door owns cross-host request proxying.
@@ -92,11 +136,20 @@ class _Replica:
     affinity: "OrderedDict[bytes, float]" = dataclasses.field(
         default_factory=OrderedDict)
 
+    @property
+    def alive(self) -> bool:
+        """Not crashed/removed. Routability is narrower: routable() —
+        draining members are alive but take no new work."""
+        return self.state in ("joining", "probing", "active", "draining")
+
+    def routable(self) -> bool:
+        return self.state == "active"
+
 
 class ClusterScheduler:
     def __init__(self, span_tokens: int = 128, affinity_spans: int = 8,
                  affinity_capacity: int = 4096, gauge_refresh_s: float = 0.5,
-                 hit_weight: float = 4.0):
+                 hit_weight: float = 4.0, gauge_fail_threshold: int = 3):
         self.span_tokens = span_tokens
         self.affinity_spans = affinity_spans
         self.affinity_capacity = affinity_capacity
@@ -104,9 +157,18 @@ class ClusterScheduler:
         # hit_weight scales how much an expected prefix hit outbids load
         # imbalance; 0 degrades to pure least-loaded (affinity off).
         self.hit_weight = hit_weight
+        # Consecutive failed gauge scrapes before a replica reads as dead
+        # (an affirmative loop_dead gauge still kills on the first scrape).
+        self.gauge_fail_threshold = max(1, int(gauge_fail_threshold))
         self._lock = threading.Lock()
         self._replicas: dict[str, _Replica] = {}
         self._last_refresh = 0.0
+        # Membership/breaker/failover event stream. The scheduler has no
+        # engine loop, so the single-writer append path is never used:
+        # every emitter goes through stage() (cross-thread safe) and every
+        # reader through snapshot() (which includes staged events without
+        # draining them) — journal_events() below is that reader.
+        self.journal = EventJournal(capacity=1024)
 
     # ---------------- membership ---------------- #
 
@@ -115,14 +177,129 @@ class ClusterScheduler:
                     dispatchable: bool = True) -> None:
         if role not in ROLES:
             raise ValueError(f"replica role {role!r} not in {ROLES}")
+        # A gauge-less replica has nothing to probe — it joins active, the
+        # pre-lifecycle contract every boot-time caller already relies on.
+        state = "active" if gauge_fn is None else "joining"
+        # Per-replica circuit breaker (cluster.netretry): journal its
+        # open/probe/close transitions under this replica's name so chaos
+        # runs can assert the ≤-1-probe-per-half-open-window bound.
+        breaker = getattr(target, "breaker", None)
+        if breaker is not None and getattr(breaker, "on_event", None) is None:
+            breaker.on_event = self._breaker_hook(name)
         with self._lock:
             self._replicas[name] = _Replica(
                 name=name, target=target, role=role, gauge_fn=gauge_fn,
-                dispatchable=dispatchable)
+                state=state, dispatchable=dispatchable)
+            self.journal.stage("member_state", rid=name,
+                               a=float(MEMBER_STATES.index(state)), b=-1.0)
 
     def remove_replica(self, name: str) -> None:
+        """Immediate removal — no drain. `leave()` is the graceful path."""
         with self._lock:
+            rep = self._replicas.pop(name, None)
+            if rep is not None:
+                self._set_state_locked(rep, "removed")
+
+    def _breaker_hook(self, name: str) -> Callable[[str, float], None]:
+        def emit(event: str, a: float = 0.0) -> None:
+            self.journal.stage(event, rid=name, a=a)
+        return emit
+
+    def _set_state_locked(self, rep: _Replica, state: str) -> None:
+        if rep.state == state:
+            return
+        old = rep.state
+        rep.state = state
+        self.journal.stage("member_state", rid=rep.name,
+                           a=float(MEMBER_STATES.index(state)),
+                           b=float(MEMBER_STATES.index(old)))
+
+    def journal_events(self, last: Optional[int] = None) -> list[dict]:
+        """Ordered membership/breaker/failover events (staged included)."""
+        return self.journal.snapshot(last=last)
+
+    def state(self, name: str) -> str:
+        with self._lock:
+            rep = self._replicas.get(name)
+            return rep.state if rep is not None else "removed"
+
+    def begin_drain(self, name: str) -> bool:
+        """active → draining: no new picks; in-flight streams finish;
+        affinity moves to a survivor. Returns False for unknown/dead/
+        removed replicas (nothing to drain)."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None or rep.state in ("dead", "removed"):
+                return False
+            if rep.state != "draining":
+                self._handoff_affinity_locked(rep)
+                self._set_state_locked(rep, "draining")
+            return True
+
+    def leave(self, name: str, force: bool = False) -> str:
+        """Graceful removal: drain, then remove once in-flight hits zero
+        (end_stream completes a deferred removal). Returns the resulting
+        state — "removed", or "draining" while streams are still live.
+        `force` removes immediately, in-flight or not."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                return "removed"
+            if not force and rep.inflight > 0 and rep.state != "dead":
+                rep.pending_remove = True
+                if rep.state != "draining":
+                    self._handoff_affinity_locked(rep)
+                    self._set_state_locked(rep, "draining")
+                return "draining"
+            self._handoff_affinity_locked(rep)
+            self._set_state_locked(rep, "removed")
             self._replicas.pop(name, None)
+            return "removed"
+
+    def begin_stream(self, name: str) -> None:
+        """A dispatch leg started on `name` — drain/leave wait on these."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is not None:
+                rep.inflight += 1
+
+    def end_stream(self, name: str) -> None:
+        """A dispatch leg finished on `name`; completes a deferred leave()
+        once the last in-flight stream drains."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                return
+            rep.inflight = max(0, rep.inflight - 1)
+            if rep.pending_remove and rep.inflight == 0:
+                self._handoff_affinity_locked(rep)
+                self._set_state_locked(rep, "removed")
+                self._replicas.pop(rep.name, None)
+
+    def _handoff_affinity_locked(self, rep: _Replica) -> None:
+        """Move `rep`'s span digests to the least-loaded active survivor
+        (ISSUE 19): a draining replica's spans remain fetchable until it
+        leaves, and affinity is a routing HINT — a miss recomputes, so the
+        worst case of a transferred digest is the latency we'd pay anyway.
+        Dead replicas don't come here: their spans died with the engine
+        state, so _mark_dead_locked clears instead."""
+        if not rep.affinity:
+            return
+        survivors = [r for r in self._replicas.values()
+                     if r is not rep and r.state == "active"]
+        if survivors:
+            dst = min(survivors, key=lambda r: (r.load, r.name))
+            moved = 0
+            for h, t in rep.affinity.items():
+                if h not in dst.affinity:
+                    dst.affinity[h] = t
+                    dst.affinity.move_to_end(h)
+                    moved += 1
+            while len(dst.affinity) > self.affinity_capacity:
+                dst.affinity.popitem(last=False)
+            self.journal.stage("affinity_handoff", rid=rep.name,
+                               a=float(moved))
+        rep.affinity.clear()
 
     def set_role(self, name: str, role: str) -> None:
         """Update a live replica's role in place (federation workers learn
@@ -156,6 +333,8 @@ class ClusterScheduler:
         now = time.monotonic()
         with self._lock:
             rep = self._replicas.get(name)
+            # Any non-crashed member may accumulate affinity — a joiner's
+            # first admissions count (dead/removed spans are stale).
             if rep is None or not rep.alive:
                 return
             for h in hashes:
@@ -173,10 +352,10 @@ class ClusterScheduler:
                 self._mark_dead_locked(rep)
 
     def _mark_dead_locked(self, rep: _Replica) -> None:
-        if rep.alive:
+        if rep.state != "dead":
             log.warning("cluster replica %s marked dead — draining affinity",
                         rep.name)
-        rep.alive = False
+        self._set_state_locked(rep, "dead")
         # Dead replicas must stop attracting traffic: their cached spans
         # died with the engine state (crash-only release drops the pool and
         # host tier), so the digests are stale advertisements.
@@ -196,15 +375,36 @@ class ClusterScheduler:
         for rep in reps:
             if rep.gauge_fn is None:
                 continue
+            failed = injected = False
+            gauges: dict = {}
+            dead = False
             try:
+                faults.fire("gauge_scrape")  # chaos: flapping /metrics
                 gauges = dict(rep.gauge_fn() or {})
                 dead = bool(gauges.get("loop_dead", 0.0))
-            except Exception as e:  # noqa: BLE001 — unreachable == dead
-                gauges, dead = {}, True
+            except Exception as e:  # noqa: BLE001 — counted, not fatal
+                failed = True
+                injected = isinstance(e, faults.InjectedFault)
                 log.debug("gauge source for %s failed: %s", rep.name, e)
             with self._lock:
                 if self._replicas.get(rep.name) is not rep:
                     continue  # removed/replaced during the pull
+                if injected:
+                    self.journal.stage("fault_gauge_scrape", rid=rep.name)
+                if failed:
+                    # One unreachable scrape is NOT a crash (ISSUE 19):
+                    # keep routing on the last-good gauges until
+                    # gauge_fail_threshold consecutive failures. Members
+                    # still joining just stay unrouted (probing).
+                    rep.gauge_failures += 1
+                    if rep.state in ("joining", "probing"):
+                        self._set_state_locked(rep, "probing")
+                    elif (rep.state in ("active", "draining")
+                            and rep.gauge_failures
+                            >= self.gauge_fail_threshold):
+                        self._mark_dead_locked(rep)
+                    continue
+                rep.gauge_failures = 0
                 rep.gauges = gauges
                 # Role sync (ISSUE 13): remote replicas and federation
                 # workers discover their role from health probes AFTER
@@ -224,9 +424,14 @@ class ClusterScheduler:
                     + shed_penalty
                 )
                 if dead:
+                    # An affirmative loop_dead gauge is a crash REPORT,
+                    # not a transport flake — immediate.
                     self._mark_dead_locked(rep)
-                else:
-                    rep.alive = True
+                elif rep.state in ("joining", "probing", "dead"):
+                    # First successful scrape admits a joiner; a dead
+                    # replica's gauges coming back is the crash-only
+                    # restart recovering. Draining stays draining.
+                    self._set_state_locked(rep, "active")
 
     # ---------------- the pick ---------------- #
 
@@ -237,11 +442,13 @@ class ClusterScheduler:
         (a degraded fleet serves mixed rather than 503ing). Returns the
         replica name, or None when every replica is dead/excluded.
         require_dispatch narrows to in-process submit targets (remote
-        replicas stay eligible for handoff-typed picks only)."""
+        replicas stay eligible for handoff-typed picks only). Only ACTIVE
+        members are candidates — joining/probing members aren't admitted
+        yet and draining members take no new work (ISSUE 19)."""
         self.refresh()
         with self._lock:
             live = [r for r in self._replicas.values()
-                    if r.alive and r.name not in exclude
+                    if r.routable() and r.name not in exclude
                     and (r.dispatchable or not require_dispatch)]
             if role is not None:
                 typed = [r for r in live if r.role in (role, "mixed")]
@@ -267,6 +474,7 @@ class ClusterScheduler:
             return [
                 {
                     "name": r.name, "role": r.role, "alive": r.alive,
+                    "state": r.state, "inflight": r.inflight,
                     "load": round(r.load, 3),
                     "affinity_spans_held": len(r.affinity),
                     "remote": not r.dispatchable,
@@ -287,7 +495,8 @@ class ClusterClient:
     def __init__(self, replicas, scheduler: Optional[ClusterScheduler] = None,
                  transfer_max_bytes: int = transfer.DEFAULT_MAX_BYTES,
                  affinity_spans: int = 8, gauge_refresh_s: float = 0.5,
-                 hit_weight: float = 4.0, disaggregate: Optional[bool] = None):
+                 hit_weight: float = 4.0, disaggregate: Optional[bool] = None,
+                 reroute_budget: int = 3):
         if not replicas:
             raise ValueError("a cluster needs at least one replica")
         self.replicas = list(replicas)
@@ -311,6 +520,9 @@ class ClusterClient:
         self.disaggregate = (("prefill" in roles and
                               ("decode" in roles or "mixed" in roles))
                              if disaggregate is None else disaggregate)
+        # Mid-stream deaths a single request may absorb before the typed
+        # abort — a flapping fleet must not bounce one request forever.
+        self.reroute_budget = max(0, int(reroute_budget))
         self._lock = threading.Lock()
         self._pending: dict[int, dict] = {}
         self._rid = 0
@@ -320,6 +532,7 @@ class ClusterClient:
         self.m_handoffs = 0
         self.m_handoff_fallbacks = 0
         self.m_remote_handoffs = 0
+        self.m_grammar_replays = 0
 
     # ---------------- public surface (Engine-shaped) ---------------- #
 
@@ -367,6 +580,7 @@ class ClusterClient:
             "cluster_handoffs": float(self.m_handoffs),
             "cluster_handoff_fallbacks": float(self.m_handoff_fallbacks),
             "cluster_remote_handoffs": float(self.m_remote_handoffs),
+            "cluster_grammar_replays": float(self.m_grammar_replays),
         }
 
     def cancel_all(self) -> int:
@@ -424,6 +638,7 @@ class ClusterClient:
         role = None
         if self.disaggregate and self._handoff_eligible(request):
             role = "decode"
+        reroutes = 0
         while True:
             name = self.scheduler.pick(hashes, role=role,
                                        exclude=tuple(rec["attempted"]),
@@ -440,11 +655,45 @@ class ClusterClient:
                 # the decode replica recomputes the prefix itself.
                 self._try_handoff(request, hashes, decode_rep=rep)
             emitted = len(rec["emitted_ids"])
-            cur = request if emitted == 0 else dataclasses.replace(
-                request,
-                prompt_ids=list(request.prompt_ids) + rec["emitted_ids"],
-                max_new_tokens=request.max_new_tokens - emitted,
-            )
+            if emitted == 0:
+                cur = request
+            else:
+                cont: dict = {
+                    "prompt_ids":
+                        list(request.prompt_ids) + rec["emitted_ids"],
+                    "max_new_tokens": request.max_new_tokens - emitted,
+                }
+                if request.grammar is not None:
+                    # Stateful failover (ISSUE 19): rebuild the grammar
+                    # machine at the emitted position by replaying the
+                    # stream through a FRESH constraint with the
+                    # survivor's tokenizer — the dead replica's machine
+                    # object is unrecoverable, but the walk it took is a
+                    # pure function of the emitted bytes.
+                    fresh = self._replay_grammar(
+                        request, rec["emitted_ids"], rep.engine)
+                    if fresh is None:
+                        self._abort(
+                            rid, "replica died mid-stream; grammar state "
+                                 "could not be replayed on the survivor")
+                        return
+                    cont["grammar"] = fresh
+                    cont["grammar_pos"] = emitted
+                    self.m_grammar_replays += 1
+                    self.scheduler.journal.stage(
+                        "reroute_replay",
+                        rid=getattr(request, "request_id", "") or str(rid),
+                        a=float(emitted), b=float(reroutes))
+                if request.seed is not None and request.temperature > 0:
+                    # Deterministic continuation seed, derived from (seed,
+                    # emitted position): the rerouted sampled stream is a
+                    # pure function of the original seed and WHERE the
+                    # fault landed — reproducible under an identical fault
+                    # schedule. (Greedy ignores the RNG entirely, so a
+                    # greedy reroute is byte-identical to the no-fault
+                    # run with no help.)
+                    cont["seed"] = continuation_seed(request.seed, emitted)
+                cur = dataclasses.replace(request, **cont)
             try:
                 handle = rep.engine.submit(cur)
             except Exception as e:  # noqa: BLE001 — try the next replica
@@ -453,23 +702,28 @@ class ClusterClient:
                 rec["attempted"].add(name)
                 continue
             self.scheduler.record(name, hashes)
-            if self._pump(rid, rec, rep, handle, emitted_before=emitted):
+            self.scheduler.begin_stream(name)
+            try:
+                done = self._pump(rid, rec, rep, handle,
+                                  emitted_before=emitted)
+            finally:
+                self.scheduler.end_stream(name)
+            if done:
                 return
             # The replica died mid-stream: reroute the continuation.
             self.scheduler.note_dead(name)
             rec["attempted"].add(name)
-            if request.grammar is not None:
-                # A grammar machine advanced on the dead replica cannot be
-                # replayed here — fail cleanly rather than emit invalid
-                # continuations.
-                self._abort(rid, "replica died mid-stream; grammar state "
-                                 "is not reroutable")
-                return
             if len(rec["emitted_ids"]) >= request.max_new_tokens:
                 self._finish(rid, TokenEvent(
                     kind="done", finish_reason="length",
                     prompt_tokens=len(request.prompt_ids),
                     completion_tokens=len(rec["emitted_ids"])))
+                return
+            reroutes += 1
+            if reroutes > self.reroute_budget:
+                self._abort(
+                    rid, f"reroute budget exhausted after {reroutes - 1} "
+                         f"mid-stream replica deaths")
                 return
             self.m_reroutes += 1
             # Trace continuity (ISSUE 11): the reroute shows up on the
@@ -484,6 +738,44 @@ class ClusterClient:
             log.warning("replica %s died mid-stream — rerouting request %d "
                         "(%d tokens emitted)", name, rid,
                         len(rec["emitted_ids"]))
+
+    def _replay_grammar(self, request: "GenRequest", emitted_ids: list,
+                        engine) -> Optional[Any]:
+        """Rebuild a grammar constraint advanced to the emitted position.
+
+        Both engine constraint types (functions.jsonschema.GrammarConstraint,
+        functions.gbnf.GbnfConstraint) retain their source on `.schema` —
+        the GBNF one as the {"__gbnf__": text} marker dict the DFA compiler
+        keys on — so a fresh machine can be built and walked forward with
+        the survivor's token strings, skipping EOS ids exactly like the
+        engine's own _grammar_advance. Returns None when the constraint
+        carries no rebuildable source or the emitted stream does not parse
+        (either way the caller aborts typed — never invalid continuations)."""
+        src = getattr(request.grammar, "schema", None)
+        if src is None:
+            return None
+        try:
+            if isinstance(src, dict) and "__gbnf__" in src:
+                from localai_tpu.functions.gbnf import GbnfConstraint
+
+                fresh: Any = GbnfConstraint(src["__gbnf__"])
+            else:
+                from localai_tpu.functions.jsonschema import GrammarConstraint
+
+                fresh = GrammarConstraint(src)
+            eos = set(engine.tokenizer.eos_ids)
+            for tok in emitted_ids:
+                if tok in eos:
+                    continue
+                text = engine.token_text(int(tok))
+                if text and not fresh.advance(text):
+                    log.warning("grammar replay rejected emitted token %d "
+                                "(%r)", tok, text)
+                    return None
+            return fresh
+        except Exception as e:  # noqa: BLE001 — abort beats corrupt output
+            log.warning("grammar replay failed: %s: %s", type(e).__name__, e)
+            return None
 
     def _pump(self, rid: int, rec: dict, rep, handle,
               emitted_before: int) -> bool:

@@ -534,6 +534,13 @@ class GenRequest:
     # Every path that terminates a pending primary must also terminate or
     # requeue these (see _fork_group_detach). Never set by callers.
     fork_group: Optional[list] = None
+    # INTERNAL — set by the cluster layer on a mid-stream grammar failover
+    # (ISSUE 19): the `grammar` object arrives already advanced past this
+    # many emitted tokens (replayed on the survivor). Non-zero keeps the
+    # request on the HOST grammar walk — a device-DFA init starts at the
+    # grammar's initial state, which is wrong mid-stream (same reason
+    # `resume` requests skip the DFA). Never set by callers.
+    grammar_pos: int = 0
 
 
 @dataclasses.dataclass
@@ -3963,7 +3970,8 @@ class Engine:
         with_logits = (request.fork_group is not None and self._paged
                        and not draft)
         dfa_tables = None
-        if request.grammar is not None and request.resume is None:
+        if (request.grammar is not None and request.resume is None
+                and request.grammar_pos == 0):
             dfa_tables = self._dfa_for(request)
         with_dfa = self._dfa_mode_of(dfa_tables)
         with_topk = request.grammar is not None and not with_dfa
@@ -7275,9 +7283,12 @@ class Engine:
         dfa_tables = None
         # Resume requests keep the HOST grammar walk: the machine object
         # carries the mid-stream state a fresh device-DFA init would lose.
+        # Cluster grammar failovers (grammar_pos > 0, ISSUE 19) skip the
+        # DFA for the same reason: the replayed machine is mid-stream.
         if (m == 1 and chunk[0][0].grammar is not None
                 and chunk[0][0].image_embeds is None
-                and chunk[0][0].resume is None):
+                and chunk[0][0].resume is None
+                and chunk[0][0].grammar_pos == 0):
             dfa_tables = self._dfa_for(chunk[0][0])
         if (m == 1 and chunk[0][0].image_embeds is None
                 and self._cached_admit_ok(chunk[0][0])):

@@ -63,9 +63,20 @@ class RemoteEngine:
 
     # ------------------------------------------------------------------ #
 
+    # Proxy ceiling when the caller states no budget: long enough for any
+    # sane completion, short enough that a wedged peer can't pin a server
+    # thread forever.
+    DEFAULT_TIMEOUT_S = 600.0
+
     def request(self, path: str, body: Optional[dict], method: str = "POST",
-                stream: bool = False):
-        """Forward one API call; returns the live HTTPResponse."""
+                stream: bool = False, deadline_s: float = 0.0):
+        """Forward one API call; returns the live HTTPResponse.
+
+        `deadline_s` is the REQUEST'S remaining budget (the API layer plumbs
+        the body's deadline_s through, ISSUE 19) and becomes the socket
+        timeout; 0 falls back to DEFAULT_TIMEOUT_S instead of the old
+        hardwired 600 — a 30 s-deadline request no longer holds a proxy
+        thread for 10 minutes when the peer wedges."""
         self.ensure_up()
         headers = {"Content-Type": "application/json"}
         if self.api_key:
@@ -82,7 +93,8 @@ class RemoteEngine:
             self.base_url + path, data=data, headers=headers, method=method
         )
         self.m_requests += 1
-        return urllib.request.urlopen(req, timeout=600)
+        timeout = deadline_s if deadline_s > 0 else self.DEFAULT_TIMEOUT_S
+        return urllib.request.urlopen(req, timeout=timeout)
 
 
 def _free_port() -> int:
@@ -161,11 +173,22 @@ class SubprocessEngine(RemoteEngine):
     def stop(self) -> None:
         with self._lock:
             if self._proc is not None and self._proc.poll() is None:
+                # SIGTERM → (10 s) → SIGKILL escalation: a child wedged in
+                # device teardown must not block the parent's shutdown, and
+                # stop() never raises — the kill is the containment.
                 self._proc.terminate()
                 try:
                     self._proc.wait(timeout=10)
                 except subprocess.TimeoutExpired:
+                    log.warning(
+                        "backend subprocess %s ignored SIGTERM for 10 s "
+                        "— escalating to SIGKILL", self.name)
                     self._proc.kill()
+                    try:
+                        self._proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        log.error("backend subprocess %s survived SIGKILL "
+                                  "wait — abandoning the handle", self.name)
             self._proc = None
 
     def metrics(self) -> dict[str, float]:

@@ -62,6 +62,24 @@ BASE_EVENTS = (
     "forked",        # slot forked off a freshly-admitted sibling (slot=branch,
     #                  a=shared prompt/boundary rows, b=source slot;
     #                  docs/TREE_SAMPLING.md)
+    "member_state",  # cluster replica lifecycle transition (staged; rid=
+    #                  replica name, a=new state index, b=old state index —
+    #                  indices into cluster.scheduler.MEMBER_STATES;
+    #                  docs/CLUSTER.md "Membership lifecycle", ISSUE 19)
+    "breaker_open",  # per-replica circuit breaker tripped open (staged;
+    #                  rid=replica name, a=consecutive failures)
+    "breaker_probe", # half-open breaker admitted its ONE probe call
+    #                  (staged; rid=replica name, a=total probes) — chaos
+    #                  runs assert ≤1 per half-open window from these
+    "breaker_close", # breaker closed again after a successful probe
+    #                  (staged; rid=replica name)
+    "reroute_replay",# grammar-bearing request rerouted mid-stream: emitted
+    #                  tokens replayed through a fresh grammar machine on
+    #                  the survivor (staged; rid, a=replayed tokens,
+    #                  b=reroute attempt number; docs/CLUSTER.md)
+    "affinity_handoff",  # a draining/dead replica's span affinity moved to
+    #                  a survivor instead of being dropped (staged; rid=
+    #                  source replica, a=digests moved)
 )
 
 # One journal event type per fault-injection site (faults.SITES), checked
@@ -84,6 +102,7 @@ FAULT_EVENTS = (
     "fault_page_spill",
     "fault_control_commit",
     "fault_slot_fork",
+    "fault_gauge_scrape",
 )
 
 EVENTS = BASE_EVENTS + FAULT_EVENTS

@@ -109,6 +109,14 @@ class OpenAIApi:
         r.add("GET", "/cluster/status", self.cluster_status)
         r.add("POST", "/cluster/span/export", self.cluster_span_export)
         r.add("POST", "/cluster/span/import", self.cluster_span_import)
+        # Elastic membership (ISSUE 19, docs/CLUSTER.md "Membership
+        # lifecycle"): join a remote worker at runtime, drain a member
+        # (in-flight streams finish, no new picks), leave gracefully
+        # (drain, then removal once in-flight hits zero).
+        for prefix in ("/v1", ""):
+            r.add("POST", f"{prefix}/cluster/join", self.cluster_join)
+            r.add("POST", f"{prefix}/cluster/drain", self.cluster_drain)
+            r.add("POST", f"{prefix}/cluster/leave", self.cluster_leave)
         # Request-lifecycle observability (ISSUE 11, docs/OBSERVABILITY.md):
         # per-request span trees (W3C traceparent propagated), the engine
         # journal as Perfetto-loadable Chrome trace JSON, and an opt-in
@@ -220,7 +228,16 @@ class OpenAIApi:
         eng = lm.engine
         stream = bool((req.body or {}).get("stream"))
         try:
-            resp = eng.request(req.path, req.body, method=req.method)
+            # Per-call deadline (ISSUE 19): the request's own remaining
+            # budget bounds the proxy socket instead of a flat 600 s —
+            # body deadline_s, else the model's configured deadline.
+            deadline = float((req.body or {}).get("deadline_s")
+                             or getattr(lm.cfg, "deadline_s", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            deadline = 0.0
+        try:
+            resp = eng.request(req.path, req.body, method=req.method,
+                               deadline_s=deadline)
         except urllib.error.HTTPError as e:
             body = e.read()
             lease.release()
@@ -1111,6 +1128,9 @@ class OpenAIApi:
                 engines[n] = {
                     "replicas": client.scheduler.snapshot(),
                     "metrics": client.metrics(),
+                    # Membership/breaker/failover event tail (ISSUE 19) —
+                    # what the chaos driver asserts its invariants from.
+                    "events": client.scheduler.journal_events(last=100),
                 }
         return Response(body={
             "role": app_cfg.cluster_role,
@@ -1120,6 +1140,96 @@ class OpenAIApi:
             "transfer_max_bytes": app_cfg.transfer_max_bytes,
             "transfer_chunk_bytes": app_cfg.transfer_chunk_bytes,
             "engines": engines,
+        })
+
+    def _cluster_client(self, name: Optional[str]):
+        """The ClusterClient behind a loaded cluster-served model (never
+        triggers a load — membership changes on an unloaded model are
+        meaningless; its cluster doesn't exist yet)."""
+        if not name:
+            raise ApiError(400, "model is required")
+        lm = self.manager.peek(name)
+        if lm is None:
+            raise ApiError(404, f"model {name!r} is not loaded")
+        client = getattr(lm.engine, "client", None)
+        if client is None:
+            raise ApiError(
+                400, f"model {name!r} is not served by a cluster engine "
+                     "(cluster_replicas >= 2 or cluster_peers required)")
+        return client
+
+    def cluster_join(self, req: Request) -> Response:
+        """Runtime membership join (ISSUE 19): register a remote worker as
+        a replica while traffic flows. The member enters the lifecycle at
+        `joining` and becomes routable on its first successful gauge
+        scrape — a joiner that never comes up never attracts traffic."""
+        body = req.body or {}
+        client = self._cluster_client(body.get("model"))
+        name = str(body.get("name") or "").strip()
+        url = str(body.get("url") or "").strip()
+        if not name or not url:
+            raise ApiError(400, "replica name and url are required")
+        from localai_tpu.cluster.replica import RemoteReplica
+        from localai_tpu.cluster.scheduler import ROLES
+
+        role = str(body.get("role") or "mixed")
+        if role not in ROLES:
+            raise ApiError(400, f"cluster role {role!r} not in {ROLES}")
+        if any(r.name == name for r in client.replicas):
+            raise ApiError(409, f"replica {name!r} is already a member",
+                           kind="conflict")
+        rep = RemoteReplica(
+            name, url, role=role,
+            model=str(body.get("remote_model") or body.get("model") or ""))
+        client.replicas.append(rep)
+        client.scheduler.add_replica(
+            rep.name, target=rep, role=rep.role, gauge_fn=rep.gauges,
+            dispatchable=False)
+        # One immediate probe round so a ready worker serves from this
+        # response on, not from the next natural gauge tick.
+        client.scheduler.refresh(force=True)
+        return Response(body={
+            "joined": name,
+            "state": client.scheduler.state(name),
+            "replicas": client.scheduler.snapshot(),
+        })
+
+    def cluster_drain(self, req: Request) -> Response:
+        """Drain a member: no NEW requests route to it, in-flight streams
+        finish, and its span affinity moves to a survivor."""
+        body = req.body or {}
+        client = self._cluster_client(body.get("model"))
+        name = str(body.get("name") or "").strip()
+        if not name:
+            raise ApiError(400, "replica name is required")
+        if not client.scheduler.begin_drain(name):
+            raise ApiError(404, f"replica {name!r} is not a drainable "
+                                "member (unknown, dead, or removed)")
+        return Response(body={
+            "draining": name,
+            "state": client.scheduler.state(name),
+            "replicas": client.scheduler.snapshot(),
+        })
+
+    def cluster_leave(self, req: Request) -> Response:
+        """Graceful removal: drain, then drop the member once its last
+        in-flight stream ends (`force: true` removes immediately). The
+        response reports the resulting state — "draining" means removal is
+        deferred on live streams and completes automatically."""
+        body = req.body or {}
+        client = self._cluster_client(body.get("model"))
+        name = str(body.get("name") or "").strip()
+        if not name:
+            raise ApiError(400, "replica name is required")
+        state = client.scheduler.leave(name, force=bool(body.get("force")))
+        if state == "removed":
+            # The scheduler's table is the routing truth; the client's list
+            # only feeds facade metrics — prune it for a clean status view.
+            client.replicas = [r for r in client.replicas if r.name != name]
+        return Response(body={
+            "name": name,
+            "state": state,
+            "replicas": client.scheduler.snapshot(),
         })
 
     def _cluster_engine(self, name: Optional[str]):

@@ -82,6 +82,15 @@ Hook sites (each is one `faults.fire(SITE)` call in production code):
                      page-pool accounting must be intact at quiesce — a
                      failed verify round may not leave a slot's draft
                      bookkeeping half-updated.
+  gauge_scrape     — the per-replica gauge refresh call in
+                     ClusterScheduler.refresh (cluster/scheduler.py), fired
+                     just before the replica's gauge callable runs (outside
+                     the scheduler lock). Stands in for a slow or flapping
+                     /metrics endpoint. The containment contract (ISSUE 19):
+                     ONE failed scrape must NOT mark the replica dead — only
+                     `gauge_fail_threshold` consecutive failures (or a
+                     loop_dead gauge) transition it, and routing continues
+                     on the last-good gauges in between.
   control_commit   — the batched H2D control commit of a decode block
                      (Engine._commit_ctrl, ISSUE 17): the one transfer the
                      pipelined loop issues per block (sampling pack +
@@ -138,6 +147,7 @@ SITES = (
     "page_spill",
     "control_commit",
     "slot_fork",
+    "gauge_scrape",
 )
 
 DEFAULT_RATE = 0.05
@@ -301,6 +311,92 @@ def ensure_env_installed() -> None:
         _env_checked = True
         if _active is None:
             _active = parse_env(os.environ.get("LOCALAI_FAULTS", ""))
+
+
+class ChaosPhase:
+    """One scripted injection window inside a ChaosScript (ISSUE 19).
+
+    A phase targets ONE site and arms only after that site has been called
+    `after_calls` times — "kill the engine loop at block 40", "partition
+    the THIRD span-transfer chunk" — which is what the randomized
+    FaultSchedule cannot express. While armed it injects with `rate`
+    (default: always) until it has fired `max_faults` times, then goes
+    quiet forever. Phases are independent: each keeps its own fired count,
+    and several phases may script the same site at different depths.
+    """
+
+    def __init__(self, site: str, after_calls: int = 0, rate: float = 1.0,
+                 max_faults: int = 1) -> None:
+        if site not in SITES:
+            raise ValueError(f"unknown fault site {site!r} — use {SITES}")
+        self.site = site
+        self.after_calls = int(after_calls)
+        self.rate = float(rate)
+        self.max_faults = int(max_faults)
+        self.fired = 0
+
+    def __repr__(self) -> str:
+        return (f"ChaosPhase({self.site!r}, after_calls={self.after_calls}, "
+                f"rate={self.rate}, max_faults={self.max_faults}, "
+                f"fired={self.fired})")
+
+
+class ChaosScript(FaultSchedule):
+    """Phase-scheduled multi-site fault script — the chaos-harness side of
+    the FaultSchedule coin. Where FaultSchedule answers "fail ~5% of calls
+    at these sites", a ChaosScript answers "fail call #N at site A, then
+    calls #M..M+2 at site B": deterministic placement for the scenarios
+    tools/chaos_run.py drives (kill-at-block-N, slow-gauge,
+    partition-during-transfer, join-under-load).
+
+    Drop-in wherever a FaultSchedule goes (install/active/LOCALAI_FAULTS
+    machinery, thread scoping, call accounting). The per-site RNG draw is
+    still consumed on EVERY counted call, exactly like the parent, so a
+    rate<1.0 phase sees the same (seed, site, call-index) decision sequence
+    a FaultSchedule would — phases narrow WHERE faults land, never
+    reshuffle the underlying pattern.
+    """
+
+    def __init__(self, seed: int, phases: Sequence[ChaosPhase],
+                 threads: Optional[Iterable[int]] = None) -> None:
+        phases = list(phases)
+        super().__init__(
+            seed,
+            rate=0.0,  # nothing fires outside a scripted phase
+            sites=tuple(dict.fromkeys(p.site for p in phases)) or None,
+            threads=threads,
+        )
+        self.phases = phases
+
+    def should_fire(self, site: str) -> bool:
+        if (self.threads is not None
+                and threading.get_ident() not in self.threads):
+            return False
+        with self._lock:
+            self.calls[site] = self.calls.get(site, 0) + 1
+            calls = self.calls[site]
+            # Draw unconditionally — see class docstring.
+            draw = self._rngs[site].random()
+            for phase in self.phases:
+                if (phase.site == site
+                        and calls > phase.after_calls
+                        and phase.fired < phase.max_faults
+                        and draw < phase.rate):
+                    phase.fired += 1
+                    self.fired[site] = self.fired.get(site, 0) + 1
+                    return True
+            return False
+
+    def exhausted(self) -> bool:
+        """True once every phase has fired its full budget — the moment a
+        chaos run can start asserting recovery instead of failure."""
+        with self._lock:
+            return all(p.fired >= p.max_faults for p in self.phases)
+
+    def __repr__(self) -> str:
+        scope = ("" if self.threads is None
+                 else f", threads={sorted(self.threads)}")
+        return f"ChaosScript(seed={self.seed}, phases={self.phases}{scope})"
 
 
 def fire(site: str) -> None:

@@ -1,0 +1,27 @@
+"""Known-bad fixture for net-call-deadline: outbound calls with no stated
+deadline (or the block-forever default spelled out)."""
+
+import socket
+import urllib.request
+from urllib.request import urlopen
+
+
+def bare_urlopen(url):
+    return urlopen(url)  # no timeout → global default (block forever)
+
+
+def dotted_urlopen(url, req):
+    with urllib.request.urlopen(req) as resp:  # no timeout
+        return resp.read()
+
+
+def explicit_none(url):
+    return urllib.request.urlopen(url, timeout=None)  # states the default
+
+
+def bare_connect(host, port):
+    return socket.create_connection((host, port))  # no timeout
+
+
+def global_mutation():
+    socket.setdefaulttimeout(30.0)  # process-global — per-call is the contract

@@ -1,0 +1,28 @@
+"""Known-good fixture for net-call-deadline: every outbound call states an
+explicit finite deadline."""
+
+import socket
+import urllib.request
+from urllib.request import urlopen
+
+
+def timed_urlopen(url, deadline_s):
+    return urlopen(url, timeout=deadline_s)
+
+
+def dotted_timed(req):
+    with urllib.request.urlopen(req, timeout=5.0) as resp:
+        return resp.read()
+
+
+def timed_connect(host, port):
+    return socket.create_connection((host, port), 3.0)  # positional timeout
+
+
+def kw_connect(host, port):
+    return socket.create_connection((host, port), timeout=3.0)
+
+
+def unrelated_fire(client):
+    # Same attribute name on an unrelated object is not a network call.
+    return client.urlopen_count

@@ -1,0 +1,178 @@
+"""Chaos harness tier (ISSUE 19, docs/ROBUSTNESS.md): the tools/chaos_run
+scenarios drive a 2-replica tiny-model mini-cluster under phase-scheduled
+fault scripts and assert the membership/failover invariants — zero hung
+callers, every request terminal, drained affinity handed off, grammar
+replay byte-identical, ≤ 1 breaker probe per half-open window.
+
+Tier-1 runs the kill-mid-decode smoke, the grammar-replay byte-identity
+acceptance check, the engine-free breaker/netretry unit tests; the rest of
+the scenario matrix is marked slow (`python -m tools.chaos_run` runs it
+all standalone)."""
+
+import os
+import sys
+import urllib.error
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from localai_tpu.cluster import (  # noqa: E402
+    BreakerOpen,
+    CircuitBreaker,
+    RetryPolicy,
+    call_with_retry,
+    continuation_seed,
+)
+from localai_tpu.testing import faults  # noqa: E402
+from tools.chaos_run import SCENARIOS  # noqa: E402
+
+
+# --------------------------------------------------------------------- #
+# Engine-free units: retry policy, breaker, chaos script, seeds.
+# --------------------------------------------------------------------- #
+
+
+def test_call_with_retry_bounded_backoff_and_typed_passthrough():
+    sleeps = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionResetError("transient")
+        return "ok"
+
+    policy = RetryPolicy(attempts=3, base_delay_s=0.1, max_delay_s=1.0,
+                         multiplier=2.0, jitter=0.0)
+    out = call_with_retry(flaky, policy=policy, what="t", sleep=sleeps.append)
+    assert out == "ok" and calls["n"] == 3
+    assert sleeps == [0.1, 0.2]  # exponential, deterministic (jitter 0)
+
+    # Exhaustion raises the LAST transport error; attempt count is exact.
+    calls["n"] = -10
+    with pytest.raises(ConnectionResetError):
+        call_with_retry(flaky, policy=policy, sleep=lambda s: None)
+    assert calls["n"] == -7  # exactly `attempts` tries
+
+    # HTTPError is an ANSWER (peer up) — never retried, even though it is
+    # an OSError subclass.
+    n = {"v": 0}
+
+    def http_fail():
+        n["v"] += 1
+        raise urllib.error.HTTPError("http://x", 503, "busy", {}, None)
+
+    with pytest.raises(urllib.error.HTTPError):
+        call_with_retry(http_fail, policy=policy, sleep=lambda s: None)
+    assert n["v"] == 1
+
+    # Typed application errors propagate immediately too.
+    def boom():
+        n["v"] += 1
+        raise ValueError("not transport")
+
+    with pytest.raises(ValueError):
+        call_with_retry(boom, policy=policy, sleep=lambda s: None)
+    assert n["v"] == 2
+
+    # Deterministic jitter: same label → same delay sequence.
+    jp = RetryPolicy(attempts=2, base_delay_s=0.1, jitter=0.5)
+    import random
+    d1 = jp.delay(1, random.Random("netretry:x"))
+    d2 = jp.delay(1, random.Random("netretry:x"))
+    assert d1 == d2
+
+
+def test_breaker_opens_refuses_and_recovers():
+    clock = {"t": 0.0}
+    br = CircuitBreaker(name="peer", failure_threshold=2, reset_s=1.0,
+                        clock=lambda: clock["t"])
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "closed"  # one failure is not an outage
+    br.record_failure()
+    assert br.state == "open"
+
+    # While open: refused instantly, typed as an OSError so transport
+    # failure paths need no new except arm.
+    with pytest.raises(BreakerOpen):
+        br.guard()
+    assert isinstance(BreakerOpen("x"), OSError)
+
+    def die():
+        raise AssertionError("breaker must refuse before calling fn")
+
+    with pytest.raises(BreakerOpen):
+        call_with_retry(die, breaker=br, sleep=lambda s: None)
+
+    # Half-open after reset_s: exactly one probe per window.
+    clock["t"] = 1.5
+    assert br.allow() is True
+    assert br.allow() is False  # second in-window caller refused
+    br.record_failure()         # failed probe re-opens a full window
+    assert br.state == "open" and not br.allow()
+    clock["t"] = 3.0
+    assert br.allow() is True
+    br.record_success()
+    assert br.state == "closed" and br.allow()
+    snap = br.snapshot()
+    assert snap["opens"] == 2 and snap["probes"] == 2
+
+
+def test_chaos_script_phase_placement_is_deterministic():
+    """ChaosScript fires at the scripted call index, every run."""
+    for _ in range(2):
+        script = faults.ChaosScript(seed=3, phases=[
+            faults.ChaosPhase("gauge_scrape", after_calls=2, rate=1.0,
+                              max_faults=1)])
+        fired_at = [i for i in range(1, 7)
+                    if script.should_fire("gauge_scrape")]
+        assert fired_at == [3], fired_at
+        assert script.exhausted()
+    with pytest.raises(ValueError):
+        faults.ChaosPhase("no_such_site")
+
+
+def test_continuation_seed_is_pure_and_31_bit():
+    assert continuation_seed(42, 7) == continuation_seed(42, 7)
+    assert continuation_seed(42, 7) != continuation_seed(42, 8)
+    assert continuation_seed(7, 0) != continuation_seed(8, 0)
+    for s, e in [(0, 0), (2**31 - 1, 10_000), (123, 1)]:
+        v = continuation_seed(s, e)
+        assert 0 <= v < 2**31
+
+
+def test_breaker_window_scenario_probe_discipline():
+    """The journal-level ≤-1-probe-per-half-open-window acceptance check."""
+    out = SCENARIOS["breaker_window"]()
+    assert out["probes"] == 2 and out["refused"] >= 5
+
+
+# --------------------------------------------------------------------- #
+# Mini-cluster scenarios (tiny model, 2 local replicas).
+# --------------------------------------------------------------------- #
+
+
+def test_chaos_smoke_kill_mid_decode():
+    """Tier-1 chaos smoke (ISSUE 19 satellite): scripted mid-decode loop
+    kill → every request reroutes and reaches its terminal event."""
+    out = SCENARIOS["kill_mid_decode"]()
+    assert out["reroutes"] >= 1 and out["dead"] == 1
+
+
+def test_grammar_replay_byte_identity():
+    """Acceptance: a grammar-constrained greedy request killed mid-stream
+    is replayed on the survivor BYTE-IDENTICAL to the no-fault run (the
+    scenario asserts got == want and json-validity internally)."""
+    out = SCENARIOS["grammar_replay"]()
+    assert out["replays"] >= 1 and out["bytes"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", ["slow_gauge", "partition_during_transfer",
+                                  "join_under_load", "drain_under_load"])
+def test_chaos_scenario_matrix(name):
+    SCENARIOS[name]()

@@ -479,3 +479,88 @@ def test_manager_fans_out_cluster_replicas(tmp_path):
         assert m["loop_dead"] == 0.0 and "cluster_dispatches" in m
     finally:
         mgr.shutdown()
+
+
+def test_cluster_membership_endpoints(tmp_path):
+    """ISSUE 19 membership surface over real HTTP: /v1/cluster/join walks
+    a (down) peer in at `joining`, duplicate joins 409, /v1/cluster/drain
+    stops new routing without breaking service, /v1/cluster/leave removes,
+    and /v1/cluster/status exposes the lifecycle + journal event tail."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    import yaml
+
+    from localai_tpu.config import ApplicationConfig
+    from localai_tpu.server import ModelManager, Router, create_server
+    from localai_tpu.server.openai_api import OpenAIApi
+
+    d = tmp_path / "models"
+    d.mkdir()
+    (d / "cm.yaml").write_text(yaml.safe_dump({
+        "name": "cm", "model": "tiny", "context_size": 128,
+        "max_slots": 2, "max_tokens": 8,
+        "kv_pages": 8, "kv_page_size": 32,
+    }))
+    app_cfg = ApplicationConfig(
+        address="127.0.0.1", port=0, models_dir=str(d),
+        cluster_replicas=2, cluster_role="mixed")
+    mgr = ModelManager(app_cfg)
+    router = Router()
+    OpenAIApi(mgr).register(router)
+    server = create_server(app_cfg, router)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read())
+
+    try:
+        # Load the cluster-served model, then exercise membership.
+        out = post("/v1/completions", {"model": "cm", "prompt": "hi",
+                                       "max_tokens": 2})
+        assert out["choices"]
+        # Join a peer that is DOWN: it must enter at joining/probing and
+        # never become routable — service is unaffected.
+        out = post("/v1/cluster/join", {"model": "cm", "name": "peer9",
+                                        "url": "http://127.0.0.1:9"})
+        assert out["joined"] == "peer9"
+        assert out["state"] in ("joining", "probing")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("/v1/cluster/join", {"model": "cm", "name": "peer9",
+                                      "url": "http://127.0.0.1:9"})
+        assert ei.value.code == 409
+        # Drain r0: state flips, requests still serve (r1 takes them).
+        out = post("/v1/cluster/drain", {"model": "cm", "name": "r0"})
+        assert out["state"] == "draining"
+        out = post("/v1/completions", {"model": "cm", "prompt": "hi",
+                                       "max_tokens": 2})
+        assert out["choices"]
+        with urllib.request.urlopen(base + "/cluster/status",
+                                    timeout=30) as r:
+            status = json.loads(r.read())
+        snap = {s["name"]: s for s in status["engines"]["cm"]["replicas"]}
+        assert snap["r0"]["state"] == "draining"
+        assert snap["peer9"]["state"] in ("joining", "probing")
+        events = status["engines"]["cm"]["events"]
+        assert any(e["event"] == "member_state" for e in events)
+        # Leave: the down peer goes first, then the drained replica.
+        out = post("/v1/cluster/leave", {"model": "cm", "name": "peer9",
+                                         "force": True})
+        assert out["state"] == "removed"
+        out = post("/v1/cluster/leave", {"model": "cm", "name": "r0"})
+        assert out["state"] == "removed"  # nothing in flight → immediate
+        assert {s["name"] for s in out["replicas"]} == {"r1"}
+        # A one-replica fleet still serves.
+        out = post("/v1/completions", {"model": "cm", "prompt": "hi",
+                                       "max_tokens": 2})
+        assert out["choices"]
+    finally:
+        server.shutdown()
+        mgr.shutdown()

@@ -26,6 +26,9 @@ from tools.lint.passes.journal_events import JournalEventsPass  # noqa: E402
 from tools.lint.passes.lock_discipline import LockDisciplinePass  # noqa: E402
 from tools.lint.passes.lock_order import LockOrderPass  # noqa: E402
 from tools.lint.passes.metric_counters import MetricCountersPass  # noqa: E402
+from tools.lint.passes.net_call_deadline import (  # noqa: E402
+    NetCallDeadlinePass,
+)
 from tools.lint.passes.page_refcount import PageRefcountPass  # noqa: E402
 from tools.lint.passes.rng_key_reuse import RngKeyReusePass  # noqa: E402
 from tools.lint.passes.sharding_consistency import (  # noqa: E402
@@ -59,12 +62,12 @@ def _full_run():
 
 
 # --------------------------------------------------------------------- #
-# The acceptance gate: the repo itself is clean under all 16 passes.
+# The acceptance gate: the repo itself is clean under all 17 passes.
 # --------------------------------------------------------------------- #
 
 def test_repo_is_clean_under_all_passes():
     result, elapsed = _full_run()
-    assert len(result.pass_ids) == 16, result.pass_ids
+    assert len(result.pass_ids) == 17, result.pass_ids
     assert result.clean, "lint findings on the repo:\n" + "\n".join(
         f.render() for f in result.active
     )
@@ -101,9 +104,9 @@ def test_cli_json_exits_zero():
 
 
 def test_suppression_count_never_grows():
-    """LINT_r05.json pins the suppression budget: future PRs may only
+    """LINT_r06.json pins the suppression budget: future PRs may only
     shrink it (fix the code instead of silencing the pass)."""
-    with open(os.path.join(REPO, "LINT_r05.json")) as f:
+    with open(os.path.join(REPO, "LINT_r06.json")) as f:
         pinned = json.load(f)
     result, _ = _full_run()
     assert len(result.suppressed) <= pinned["total_suppressions"], (
@@ -115,9 +118,9 @@ def test_suppression_count_never_grows():
     # The budget itself stays <= 3 unless each extra carries a written
     # reason AND the baseline regen documents it (ISSUE 8/15 satellite).
     assert pinned["total_suppressions"] <= 3, pinned
-    # The r05 baseline covers the full 16-pass registry with per-pass
-    # timings (ISSUE 15 satellite).
-    assert len(pinned["passes"]) == 16, sorted(pinned["passes"])
+    # The r06 baseline covers the full 17-pass registry with per-pass
+    # timings (ISSUE 19 satellite).
+    assert len(pinned["passes"]) == 17, sorted(pinned["passes"])
     assert all("wall_time_ms" in v for v in pinned["passes"].values())
 
 
@@ -452,6 +455,23 @@ def test_thread_guard_drift_against_discovery():
     assert all(reason.strip() for reason in UNGUARDED_THREAD_ROLES.values())
 
 
+def test_net_call_deadline_fixtures():
+    """ISSUE 19 remote-call hardening: outbound calls must state their
+    deadline — the retry/breaker layer only works if calls return."""
+    bad = NetCallDeadlinePass(
+        code_globs=["tests/lint_fixtures/net_call_deadline_bad.py"])
+    r = _run_single(bad)
+    msgs = "\n".join(f.message for f in r.active)
+    assert "without an explicit timeout" in msgs, r.findings
+    assert "timeout=None" in msgs, msgs
+    assert "create_connection" in msgs, msgs
+    assert "setdefaulttimeout" in msgs, msgs
+    assert len(r.active) == 5, r.findings
+    good = NetCallDeadlinePass(
+        code_globs=["tests/lint_fixtures/net_call_deadline_good.py"])
+    assert _run_single(good).clean, _run_single(good).findings
+
+
 def test_fault_sites_fixtures():
     broot = os.path.join(FIX, "fault_sites", "bad")
     bad = FaultSitesPass()
@@ -489,16 +509,16 @@ def test_suppression_without_reason_is_a_finding():
                for f in r.active), r.findings
 
 
-def test_registry_has_the_sixteen_passes():
+def test_registry_has_the_seventeen_passes():
     ids = [p.id for p in all_passes()]
     assert ids == [
         "attr-init", "metric-counters", "lock-discipline", "trace-safety",
         "terminal-event", "page-refcount", "config-drift", "fault-sites",
         "lock-order", "rng-key-reuse", "sharding-consistency",
         "donation-safety", "journal-events", "shared-state-race",
-        "thread-affinity", "handoff-escape",
+        "thread-affinity", "handoff-escape", "net-call-deadline",
     ], ids
-    assert len(set(ids)) == 16
+    assert len(set(ids)) == 17
 
 
 # --------------------------------------------------------------------- #

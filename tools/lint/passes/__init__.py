@@ -12,6 +12,7 @@ from .journal_events import JournalEventsPass
 from .lock_discipline import LockDisciplinePass
 from .lock_order import LockOrderPass
 from .metric_counters import MetricCountersPass
+from .net_call_deadline import NetCallDeadlinePass
 from .page_refcount import PageRefcountPass
 from .rng_key_reuse import RngKeyReusePass
 from .sharding_consistency import ShardingConsistencyPass
@@ -45,4 +46,7 @@ def all_passes():
         SharedStateRacePass(),
         ThreadAffinityPass(),
         HandoffEscapePass(),
+        # Remote-call hardening (ISSUE 19): every outbound network call
+        # states its deadline.
+        NetCallDeadlinePass(),
     ]

@@ -1,0 +1,95 @@
+"""net-call-deadline: every outbound network call states an explicit timeout.
+
+The cluster's remote-call surface (role probes, gauge scrapes, span fetches,
+RemoteEngine proxying) runs on threads the caller is waiting on: an
+`urllib.request.urlopen(...)` with no `timeout=` inherits the global socket
+default — None, i.e. block forever — and one wedged peer pins the calling
+thread for the life of the process. ISSUE 19's netretry/breaker layer only
+works if the underlying call actually returns; a missing timeout turns every
+retry policy into a single infinite attempt.
+
+Flagged in production code (localai_tpu/):
+
+  * `urllib.request.urlopen(...)` / `request.urlopen(...)` / bare
+    `urlopen(...)` calls without an explicit `timeout=` keyword;
+  * `socket.create_connection(...)` without a timeout (positional arg 2 or
+    `timeout=` keyword) and `socket.setdefaulttimeout(...)` (process-global
+    mutation — per-call deadlines are the contract).
+
+A literal `timeout=None` is also flagged: it states the default rather than
+a deadline. Tests are exempt (they may probe hang behaviour on purpose).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import astutil
+from ..core import Finding, Pass, Repo
+
+CODE_GLOBS = ["localai_tpu/**/*.py", "localai_tpu/*.py"]
+
+URLOPEN_NAMES = ("urlopen", "request.urlopen", "urllib.request.urlopen")
+CREATE_CONN_NAMES = ("create_connection", "socket.create_connection")
+SETDEFAULT_NAMES = ("setdefaulttimeout", "socket.setdefaulttimeout")
+
+
+def _timeout_kw(node: ast.Call):
+    for kw in node.keywords:
+        if kw.arg == "timeout":
+            return kw
+    return None
+
+
+class NetCallDeadlinePass(Pass):
+    id = "net-call-deadline"
+    description = (
+        "outbound network calls (urlopen / socket connect) without an "
+        "explicit timeout — a wedged peer pins the calling thread forever"
+    )
+
+    def __init__(self, code_globs=None):
+        self.code_globs = CODE_GLOBS if code_globs is None else code_globs
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        for path in repo.files(*self.code_globs):
+            for node in ast.walk(repo.tree(path)):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = astutil.dotted_name(node.func)
+                if name in URLOPEN_NAMES:
+                    kw = _timeout_kw(node)
+                    if kw is None:
+                        # A **kwargs splat may carry the timeout — flag only
+                        # calls with no splat (a splat defeats static proof
+                        # but is not used on this surface today).
+                        if any(k.arg is None for k in node.keywords):
+                            continue
+                        out.append(self.finding(
+                            path, node.lineno,
+                            "urlopen(...) without an explicit timeout= — "
+                            "inherits the global socket default (block "
+                            "forever); pass the request's deadline",
+                        ))
+                    elif (isinstance(kw.value, ast.Constant)
+                            and kw.value.value is None):
+                        out.append(self.finding(
+                            path, node.lineno,
+                            "urlopen(..., timeout=None) states the "
+                            "block-forever default — pass a finite deadline",
+                        ))
+                elif name in CREATE_CONN_NAMES:
+                    if len(node.args) < 2 and _timeout_kw(node) is None:
+                        out.append(self.finding(
+                            path, node.lineno,
+                            "socket.create_connection(...) without a "
+                            "timeout — pass the call's deadline",
+                        ))
+                elif name in SETDEFAULT_NAMES:
+                    out.append(self.finding(
+                        path, node.lineno,
+                        "socket.setdefaulttimeout(...) mutates process-"
+                        "global state — use per-call timeout= instead",
+                    ))
+        return out
