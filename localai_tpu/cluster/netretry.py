@@ -38,7 +38,7 @@ from typing import Callable, Optional
 # Transport-level failures worth retrying. urllib wraps socket errors in
 # URLError (an OSError subclass); HTTPError is a RESPONSE (the peer is up
 # and answered) and is deliberately NOT retried here — callers decide what
-# 4xx/5xx mean.
+# 4xx/5xx mean, and the breaker counts it as transport SUCCESS.
 TRANSIENT_ERRORS: tuple = (OSError, http.client.HTTPException)
 
 
@@ -87,8 +87,9 @@ def call_with_retry(fn: Callable, *, policy: RetryPolicy = DEFAULT_POLICY,
     attempts (or the deadline) are exhausted; non-retryable exceptions
     propagate immediately. With a breaker: refused instantly while open,
     and every outcome feeds the breaker's failure accounting."""
+    holds_probe = False
     if breaker is not None:
-        breaker.guard(what=what)
+        holds_probe = breaker.guard(what=what)
     rng = random.Random(f"netretry:{what}")
     t0 = time.monotonic()
     attempt = 0
@@ -98,9 +99,17 @@ def call_with_retry(fn: Callable, *, policy: RetryPolicy = DEFAULT_POLICY,
             out = fn()
         except retry_on as e:
             if isinstance(e, urllib.error.HTTPError):
-                raise  # an answer, not an outage (HTTPError is an OSError)
+                # An answer, not an outage (HTTPError is an OSError): the
+                # TRANSPORT verdict is success — the peer is reachable —
+                # so a held probe closes the breaker instead of leaking
+                # its slot. What the status code means is the caller's
+                # business; the call still raises.
+                if breaker is not None:
+                    breaker.record_success()
+                raise
             if breaker is not None:
                 breaker.record_failure()
+                holds_probe = False  # resolved: a failed probe re-opens
             if attempt >= policy.attempts:
                 raise
             d = policy.delay(attempt, rng)
@@ -113,8 +122,16 @@ def call_with_retry(fn: Callable, *, policy: RetryPolicy = DEFAULT_POLICY,
             if breaker is not None:
                 # The breaker may have been opened by a concurrent caller
                 # between attempts — stop hammering mid-retry too.
-                breaker.guard(what=what)
+                holds_probe = breaker.guard(what=what)
             continue
+        except BaseException:
+            # A typed application failure propagating out of fn() carries
+            # no transport verdict either way — but an admitted half-open
+            # probe must still resolve, or the breaker wedges half-open
+            # and refuses every future call.
+            if breaker is not None and holds_probe:
+                breaker.release_probe()
+            raise
         if breaker is not None:
             breaker.record_success()
         return out
@@ -181,14 +198,17 @@ class CircuitBreaker:
 
     # ---------------- call gate ---------------- #
 
-    def allow(self) -> bool:
-        """True when a call may proceed. In half-open, the True answer IS
-        the probe admission — at most one per window."""
+    def admit(self) -> Optional[str]:
+        """Admission check that reports HOW the call was admitted:
+        "closed" (normal), "probe" (this caller holds THE half-open probe
+        slot and MUST resolve it with exactly one record_success /
+        record_failure / release_probe — an admitted probe that never
+        resolves wedges the breaker half-open forever), or None (refused)."""
         emit = None
         with self._lock:
             st = self._state_locked()
             if st == "closed":
-                return True
+                return "closed"
             if st == "half_open" and not self._probe_inflight:
                 self._probe_inflight = True
                 self.m_probes += 1
@@ -197,14 +217,23 @@ class CircuitBreaker:
                 self.m_refused += 1
         if emit is not None:
             self._emit(*emit)
-            return True
-        return False
+            return "probe"
+        return None
 
-    def guard(self, what: str = "") -> None:
-        if not self.allow():
+    def allow(self) -> bool:
+        """True when a call may proceed. In half-open, the True answer IS
+        the probe admission — at most one per window."""
+        return self.admit() is not None
+
+    def guard(self, what: str = "") -> bool:
+        """Admit or refuse (BreakerOpen). Returns True when this admission
+        is the half-open probe — the caller owns the slot (see admit)."""
+        adm = self.admit()
+        if adm is None:
             raise BreakerOpen(
                 f"circuit breaker open for {self.name or what or 'peer'} — "
                 f"call refused without touching the network")
+        return adm == "probe"
 
     def record_success(self) -> None:
         emit = None
@@ -215,6 +244,25 @@ class CircuitBreaker:
             self._probe_inflight = False
             if was != "closed":
                 emit = ("breaker_close", 0.0)
+        if emit is not None:
+            self._emit(*emit)
+
+    def release_probe(self) -> None:
+        """Resolve a held half-open probe that ended with NO transport
+        verdict (a typed application error propagated out of the probed
+        call). Conservative: the breaker re-opens for a full window — the
+        ≤-1-probe-per-window bound holds and the slot cannot leak; the
+        alternative (a half-open breaker whose probe slot is stuck
+        in-flight) refuses every future call forever. No-op unless a probe
+        is actually in flight."""
+        emit = None
+        with self._lock:
+            if self._state == "half_open" and self._probe_inflight:
+                self._state = "open"
+                self._opened_at = self._clock()
+                self._probe_inflight = False
+                self.m_opens += 1
+                emit = ("breaker_open", float(self._failures))
         if emit is not None:
             self._emit(*emit)
 

@@ -282,10 +282,6 @@ def fetch_span(base_url: str, model: str, prompt_ids,
     digest = ""
     attempts = 0
     while True:
-        if breaker is not None and not breaker.allow():
-            raise SpanTransferError(
-                f"span fetch refused: circuit breaker open for "
-                f"{base_url} ({len(got)} bytes verified)")
         asm = StreamAssembler(max_bytes=max_bytes, prior=got,
                               expect_digest=digest, verify=verify)
         body = json.dumps({
@@ -306,6 +302,19 @@ def fetch_span(base_url: str, model: str, prompt_ids,
         req = urllib.request.Request(
             base_url.rstrip("/") + "/cluster/span/export",
             data=body, headers=headers)
+        held_probe = False
+        if breaker is not None:
+            admission = breaker.admit()
+            if admission is None:
+                raise SpanTransferError(
+                    f"span fetch refused: circuit breaker open for "
+                    f"{base_url} ({len(got)} bytes verified)")
+            # "probe": this attempt owns the half-open probe slot and must
+            # resolve it — record_success/record_failure below, or
+            # release_probe on the terminal paths that raise with no
+            # transport verdict. A leaked slot wedges the shared
+            # per-replica breaker (which also gates the gauge path).
+            held_probe = admission == "probe"
         err: object = None
         try:
             with urllib.request.urlopen(req, timeout=timeout_s) as resp:
@@ -327,10 +336,22 @@ def fetch_span(base_url: str, model: str, prompt_ids,
                 return asm.result()
             err = "stream ended before the trailer"
         except SpanTransferError:
-            raise  # corruption/cap/abort: a rejection, not a retry
+            # corruption/cap/abort: a rejection, not a retry — no
+            # transport verdict, so a held probe slot is released (the
+            # breaker re-opens) instead of leaking.
+            if breaker is not None and held_probe:
+                breaker.release_probe()
+            raise
         except urllib.error.HTTPError as e:
             code = e.code
             e.close()
+            if code in (404, 409):
+                # The peer ANSWERED — transport success even though the
+                # fetch terminally fails. "No span for this prompt" is a
+                # normal occurrence; it must not open (or wedge) the
+                # shared breaker.
+                if breaker is not None:
+                    breaker.record_success()
             if code == 404:
                 raise SpanTransferError(
                     "exporter stored no span for this prompt") from None
@@ -342,6 +363,12 @@ def fetch_span(base_url: str, model: str, prompt_ids,
             err = e  # host_partition: resumable, like any dropped link
         except (OSError, http.client.HTTPException) as e:
             err = e  # timeout / reset / refused / truncated chunked body
+        except BaseException:
+            # Anything else (a programming error) still may not leak an
+            # admitted probe slot.
+            if breaker is not None and held_probe:
+                breaker.release_probe()
+            raise
         if breaker is not None:
             breaker.record_failure()  # any resumable failure counts
         got = asm.frame_so_far()

@@ -128,6 +128,10 @@ class _Replica:
     # what drain waits on before a deferred removal completes.
     inflight: int = 0
     pending_remove: bool = False
+    # An operator asked for a drain (begin_drain or a deferred leave).
+    # Survives a crash: a dead member recovering with this set comes back
+    # DRAINING, not active — recovery must not undo an explicit drain.
+    drain_requested: bool = False
     # False for REMOTE replicas (ISSUE 13): valid prefill-handoff/affinity
     # targets, but the in-process ClusterClient cannot submit to them — the
     # federation front door owns cross-host request proxying.
@@ -231,6 +235,7 @@ class ClusterScheduler:
             rep = self._replicas.get(name)
             if rep is None or rep.state in ("dead", "removed"):
                 return False
+            rep.drain_requested = True
             if rep.state != "draining":
                 self._handoff_affinity_locked(rep)
                 self._set_state_locked(rep, "draining")
@@ -247,6 +252,7 @@ class ClusterScheduler:
                 return "removed"
             if not force and rep.inflight > 0 and rep.state != "dead":
                 rep.pending_remove = True
+                rep.drain_requested = True
                 if rep.state != "draining":
                     self._handoff_affinity_locked(rep)
                     self._set_state_locked(rep, "draining")
@@ -427,6 +433,18 @@ class ClusterScheduler:
                     # An affirmative loop_dead gauge is a crash REPORT,
                     # not a transport flake — immediate.
                     self._mark_dead_locked(rep)
+                elif (rep.state == "dead"
+                        and (rep.drain_requested or rep.pending_remove)):
+                    # The operator asked for a drain BEFORE the crash:
+                    # recovery resumes it instead of silently promoting
+                    # back to active — and a deferred leave() with nothing
+                    # left in flight completes right here.
+                    if rep.pending_remove and rep.inflight == 0:
+                        self._handoff_affinity_locked(rep)
+                        self._set_state_locked(rep, "removed")
+                        self._replicas.pop(rep.name, None)
+                    else:
+                        self._set_state_locked(rep, "draining")
                 elif rep.state in ("joining", "probing", "dead"):
                     # First successful scrape admits a joiner; a dead
                     # replica's gauges coming back is the crash-only
@@ -436,7 +454,8 @@ class ClusterScheduler:
     # ---------------- the pick ---------------- #
 
     def pick(self, hashes, role: Optional[str] = None,
-             exclude: tuple = (), require_dispatch: bool = False) -> Optional[str]:
+             exclude: tuple = (), require_dispatch: bool = False,
+             reserve: bool = False) -> Optional[str]:
         """Choose a replica: expected-prefix-hit × inverse load. Role-typed
         picks prefer matching+mixed replicas but fall back to any live one
         (a degraded fleet serves mixed rather than 503ing). Returns the
@@ -444,7 +463,12 @@ class ClusterScheduler:
         require_dispatch narrows to in-process submit targets (remote
         replicas stay eligible for handoff-typed picks only). Only ACTIVE
         members are candidates — joining/probing members aren't admitted
-        yet and draining members take no new work (ISSUE 19)."""
+        yet and draining members take no new work (ISSUE 19).
+        `reserve` counts the stream in-flight under the SAME lock that
+        chose the replica — without it a concurrent leave()/end_stream can
+        observe inflight==0 between pick and begin_stream and remove the
+        replica under a live dispatch. The caller owes exactly one
+        end_stream() for a reserved name, on EVERY path."""
         self.refresh()
         with self._lock:
             live = [r for r in self._replicas.values()
@@ -466,6 +490,8 @@ class ClusterScheduler:
             # spread instead of all landing on the same momentarily-idle
             # replica.
             best.load += 1.0
+            if reserve:
+                best.inflight += 1
             return best.name
 
     def snapshot(self) -> list[dict]:
@@ -640,69 +666,82 @@ class ClusterClient:
             role = "decode"
         reroutes = 0
         while True:
+            # reserve=True: the in-flight count is taken under the pick
+            # lock itself, closing the pick→begin_stream window where a
+            # concurrent leave() could observe inflight==0 and remove the
+            # replica under this live dispatch. Every path below that
+            # abandons `name` must end_stream it exactly once.
             name = self.scheduler.pick(hashes, role=role,
                                        exclude=tuple(rec["attempted"]),
-                                       require_dispatch=True)
+                                       require_dispatch=True, reserve=True)
             if name is None:
                 self._finish(rid, None)
                 return
             rep = self.scheduler.target(name)
             if rep is None:
+                self.scheduler.end_stream(name)
                 rec["attempted"].add(name)
                 continue
-            if role == "decode":
-                # Prefill→decode handoff: best-effort — any failure means
-                # the decode replica recomputes the prefix itself.
-                self._try_handoff(request, hashes, decode_rep=rep)
-            emitted = len(rec["emitted_ids"])
-            if emitted == 0:
-                cur = request
-            else:
-                cont: dict = {
-                    "prompt_ids":
-                        list(request.prompt_ids) + rec["emitted_ids"],
-                    "max_new_tokens": request.max_new_tokens - emitted,
-                }
-                if request.grammar is not None:
-                    # Stateful failover (ISSUE 19): rebuild the grammar
-                    # machine at the emitted position by replaying the
-                    # stream through a FRESH constraint with the
-                    # survivor's tokenizer — the dead replica's machine
-                    # object is unrecoverable, but the walk it took is a
-                    # pure function of the emitted bytes.
-                    fresh = self._replay_grammar(
-                        request, rec["emitted_ids"], rep.engine)
-                    if fresh is None:
-                        self._abort(
-                            rid, "replica died mid-stream; grammar state "
-                                 "could not be replayed on the survivor")
-                        return
-                    cont["grammar"] = fresh
-                    cont["grammar_pos"] = emitted
-                    self.m_grammar_replays += 1
-                    self.scheduler.journal.stage(
-                        "reroute_replay",
-                        rid=getattr(request, "request_id", "") or str(rid),
-                        a=float(emitted), b=float(reroutes))
-                if request.seed is not None and request.temperature > 0:
-                    # Deterministic continuation seed, derived from (seed,
-                    # emitted position): the rerouted sampled stream is a
-                    # pure function of the original seed and WHERE the
-                    # fault landed — reproducible under an identical fault
-                    # schedule. (Greedy ignores the RNG entirely, so a
-                    # greedy reroute is byte-identical to the no-fault
-                    # run with no help.)
-                    cont["seed"] = continuation_seed(request.seed, emitted)
-                cur = dataclasses.replace(request, **cont)
             try:
+                if role == "decode":
+                    # Prefill→decode handoff: best-effort — any failure
+                    # means the decode replica recomputes the prefix
+                    # itself.
+                    self._try_handoff(request, hashes, decode_rep=rep)
+                emitted = len(rec["emitted_ids"])
+                if emitted == 0:
+                    cur = request
+                else:
+                    cont: dict = {
+                        "prompt_ids":
+                            list(request.prompt_ids) + rec["emitted_ids"],
+                        "max_new_tokens": request.max_new_tokens - emitted,
+                    }
+                    if request.grammar is not None:
+                        # Stateful failover (ISSUE 19): rebuild the
+                        # grammar machine at the emitted position by
+                        # replaying the stream through a FRESH constraint
+                        # with the survivor's tokenizer — the dead
+                        # replica's machine object is unrecoverable, but
+                        # the walk it took is a pure function of the
+                        # emitted bytes.
+                        fresh = self._replay_grammar(
+                            request, rec["emitted_ids"], rep.engine)
+                        if fresh is None:
+                            self.scheduler.end_stream(name)
+                            self._abort(
+                                rid, "replica died mid-stream; grammar "
+                                     "state could not be replayed on the "
+                                     "survivor")
+                            return
+                        cont["grammar"] = fresh
+                        cont["grammar_pos"] = emitted
+                        self.m_grammar_replays += 1
+                        self.scheduler.journal.stage(
+                            "reroute_replay",
+                            rid=getattr(request, "request_id", "")
+                            or str(rid),
+                            a=float(emitted), b=float(reroutes))
+                    if request.seed is not None and request.temperature > 0:
+                        # Deterministic continuation seed, derived from
+                        # (seed, emitted position): the rerouted sampled
+                        # stream is a pure function of the original seed
+                        # and WHERE the fault landed — reproducible under
+                        # an identical fault schedule. (Greedy ignores the
+                        # RNG entirely, so a greedy reroute is
+                        # byte-identical to the no-fault run with no
+                        # help.)
+                        cont["seed"] = continuation_seed(
+                            request.seed, emitted)
+                    cur = dataclasses.replace(request, **cont)
                 handle = rep.engine.submit(cur)
             except Exception as e:  # noqa: BLE001 — try the next replica
+                self.scheduler.end_stream(name)
                 log.warning("replica %s refused dispatch %d: %s",
                             name, rid, e)
                 rec["attempted"].add(name)
                 continue
             self.scheduler.record(name, hashes)
-            self.scheduler.begin_stream(name)
             try:
                 done = self._pump(rid, rec, rep, handle,
                                   emitted_before=emitted)

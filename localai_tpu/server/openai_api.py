@@ -1176,15 +1176,22 @@ class OpenAIApi:
         if role not in ROLES:
             raise ApiError(400, f"cluster role {role!r} not in {ROLES}")
         if any(r.name == name for r in client.replicas):
+            # Fast refusal before the (probing) RemoteReplica construction.
             raise ApiError(409, f"replica {name!r} is already a member",
                            kind="conflict")
         rep = RemoteReplica(
             name, url, role=role,
             model=str(body.get("remote_model") or body.get("model") or ""))
-        client.replicas.append(rep)
-        client.scheduler.add_replica(
-            rep.name, target=rep, role=rep.role, gauge_fn=rep.gauges,
-            dispatchable=False)
+        # Check-and-register atomically: two concurrent joins with the same
+        # name must not both pass the duplicate check — the loser 409s.
+        with client._lock:
+            if any(r.name == name for r in client.replicas):
+                raise ApiError(409, f"replica {name!r} is already a member",
+                               kind="conflict")
+            client.replicas.append(rep)
+            client.scheduler.add_replica(
+                rep.name, target=rep, role=rep.role, gauge_fn=rep.gauges,
+                dispatchable=False)
         # One immediate probe round so a ready worker serves from this
         # response on, not from the next natural gauge tick.
         client.scheduler.refresh(force=True)
@@ -1225,7 +1232,11 @@ class OpenAIApi:
         if state == "removed":
             # The scheduler's table is the routing truth; the client's list
             # only feeds facade metrics — prune it for a clean status view.
-            client.replicas = [r for r in client.replicas if r.name != name]
+            # Rebuild under the client lock so a concurrent join's append
+            # is not lost to this list swap.
+            with client._lock:
+                client.replicas = [
+                    r for r in client.replicas if r.name != name]
         return Response(body={
             "name": name,
             "state": state,

@@ -122,6 +122,97 @@ def test_breaker_opens_refuses_and_recovers():
     assert snap["opens"] == 2 and snap["probes"] == 2
 
 
+def test_breaker_probe_slot_never_leaks():
+    """An admitted half-open probe must resolve on EVERY exit path of
+    call_with_retry — HTTPError passthrough (an answer: transport success,
+    the breaker closes) and typed application errors (release: re-open) —
+    instead of wedging the breaker half-open with allow() refusing every
+    future call forever."""
+    clock = {"t": 0.0}
+
+    def half_open_breaker():
+        br = CircuitBreaker(name="peer", failure_threshold=1, reset_s=1.0,
+                            clock=lambda: clock["t"])
+        br.record_failure()  # open
+        clock["t"] += 1.5    # window elapsed: next admission is THE probe
+        return br
+
+    # A recovering peer answering the probe with HTTP 500: an ANSWER, so
+    # the probe resolves as transport success and the breaker closes (the
+    # caller still sees the HTTPError).
+    br = half_open_breaker()
+
+    def http500():
+        raise urllib.error.HTTPError("http://x", 500, "boom", {}, None)
+
+    with pytest.raises(urllib.error.HTTPError):
+        call_with_retry(http500, breaker=br, sleep=lambda s: None)
+    assert br.state == "closed" and br.allow()
+
+    # A typed application error during the probe: no transport verdict —
+    # the slot releases by RE-OPENING (the ≤-1-probe-per-window bound
+    # holds) and a later window admits a fresh probe.
+    br = half_open_breaker()
+
+    def boom():
+        raise ValueError("not transport")
+
+    with pytest.raises(ValueError):
+        call_with_retry(boom, breaker=br, sleep=lambda s: None)
+    assert br.state == "open" and not br.allow()
+    clock["t"] += 1.5
+    assert br.admit() == "probe"  # fresh window probes again — no leak
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_fetch_span_terminal_paths_resolve_breaker_probe(monkeypatch):
+    """fetch_span's terminal exits must resolve an admitted half-open
+    probe: 404/409 are ANSWERS (the breaker closes — 'no span for this
+    prompt' is a normal occurrence), and SpanTransferError/abort releases
+    the slot — the shared per-replica breaker (which also gates the gauge
+    path) must never wedge."""
+    import urllib.request
+
+    from localai_tpu.cluster import netspan
+    from localai_tpu.cluster.transfer import SpanTransferError
+
+    clock = {"t": 0.0}
+    br = CircuitBreaker(name="peer", failure_threshold=1, reset_s=1.0,
+                        clock=lambda: clock["t"])
+    br.record_failure()
+    clock["t"] = 1.5  # half-open: the next admission is THE probe
+
+    def urlopen_404(req, timeout=0.0):
+        raise urllib.error.HTTPError(req.full_url, 404, "no span", {}, None)
+
+    monkeypatch.setattr(urllib.request, "urlopen", urlopen_404)
+    with pytest.raises(SpanTransferError):
+        netspan.fetch_span("http://peer", "m", [1, 2, 3], breaker=br)
+    assert br.state == "closed" and br.allow()  # answered — not wedged
+
+    # Caller abort mid-probe: no transport verdict — the slot releases by
+    # re-opening; the next window admits a fresh probe.
+    br.record_failure()
+    clock["t"] = 3.0
+
+    class _Resp:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    monkeypatch.setattr(urllib.request, "urlopen",
+                        lambda req, timeout=0.0: _Resp())
+    with pytest.raises(SpanTransferError):
+        netspan.fetch_span("http://peer", "m", [1], breaker=br,
+                           should_abort=lambda: True)
+    assert br.state == "open" and not br.allow()
+    clock["t"] = 4.5
+    assert br.admit() == "probe"  # no leak
+
+
 def test_chaos_script_phase_placement_is_deterministic():
     """ChaosScript fires at the scripted call index, every run."""
     for _ in range(2):

@@ -227,6 +227,54 @@ def test_scheduler_role_typed_picks_fall_back():
     assert sched.pick([], role="decode") == "d"
 
 
+def test_scheduler_drain_intent_survives_crash_recovery():
+    """A draining member that crashes and recovers comes back DRAINING —
+    recovery must not silently undo an operator's drain request."""
+    state = {"dead": 0.0}
+    sched = _fake_sched()
+    sched.add_replica("a", gauge_fn=lambda: {"loop_dead": state["dead"]})
+    sched.add_replica("b", gauge_fn=dict)
+    sched.refresh(force=True)
+    assert sched.state("a") == "active"
+    assert sched.begin_drain("a")
+    state["dead"] = 1.0
+    sched.refresh(force=True)
+    assert sched.state("a") == "dead"
+    state["dead"] = 0.0
+    sched.refresh(force=True)
+    assert sched.state("a") == "draining"  # intent survived the crash
+    assert sched.pick([]) == "b"           # still takes no new work
+
+    # A deferred leave() keeps its removal intent across the crash too:
+    # the recovered member resumes draining and the last end_stream
+    # completes the removal.
+    state_b = {"dead": 1.0}
+    sched2 = _fake_sched()
+    sched2.add_replica("c", gauge_fn=lambda: {"loop_dead": state_b["dead"]})
+    sched2.begin_stream("c")
+    assert sched2.leave("c") == "draining"
+    sched2.refresh(force=True)
+    assert sched2.state("c") == "dead"
+    state_b["dead"] = 0.0
+    sched2.refresh(force=True)
+    assert sched2.state("c") == "draining"
+    sched2.end_stream("c")
+    assert sched2.state("c") == "removed"
+
+
+def test_scheduler_pick_reserve_blocks_concurrent_leave():
+    """pick(reserve=True) counts the stream under the pick lock itself, so
+    a leave() racing the dispatch defers on the just-picked stream instead
+    of removing the replica out from under it."""
+    sched = _fake_sched()
+    sched.add_replica("a", gauge_fn=dict)
+    sched.refresh(force=True)
+    assert sched.pick([], reserve=True) == "a"
+    assert sched.leave("a") == "draining"  # deferred: the pick holds it
+    sched.end_stream("a")                  # the dispatch leg finishes
+    assert sched.state("a") == "removed"
+
+
 # --------------------------------------------------------------------- #
 # Transfer frame format
 # --------------------------------------------------------------------- #
