@@ -708,11 +708,16 @@ class ClusterClient:
                         fresh = self._replay_grammar(
                             request, rec["emitted_ids"], rep.engine)
                         if fresh is None:
-                            self.scheduler.end_stream(name)
+                            # Abort BEFORE end_stream: if the abort raises,
+                            # the handler below end_streams `name` — with
+                            # the old order that was a second end_stream
+                            # for one reservation, driving the inflight
+                            # gauge negative.
                             self._abort(
                                 rid, "replica died mid-stream; grammar "
                                      "state could not be replayed on the "
                                      "survivor")
+                            self.scheduler.end_stream(name)
                             return
                         cont["grammar"] = fresh
                         cont["grammar_pos"] = emitted

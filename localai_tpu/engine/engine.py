@@ -2454,7 +2454,14 @@ class Engine:
                 ))
                 return True
         total = self._resume_swap_pages(request)
-        row = self._pages_alloc(slot_idx, total)
+        try:
+            row = self._pages_alloc(slot_idx, total)
+        except BaseException:
+            # An allocator raise (page-geometry validation) must not strand
+            # the adapter pin taken above.
+            if row_a:
+                self._adapter_unpin(row_a)
+            raise
         if row is None:
             if row_a:
                 self._adapter_unpin(row_a)
@@ -4688,9 +4695,11 @@ class Engine:
                 rg = (copy.deepcopy(req0.grammar)
                       if req0.grammar is not None else None)
             except Exception:  # noqa: BLE001 — fail this branch only
-                self._pages_free(dst)
+                # Unpin before _pages_free: the free can raise (page
+                # geometry validation) and would strand the pin.
                 if arow:
                     self._adapter_unpin(arow)
+                self._pages_free(dst)
                 bh._q.put(TokenEvent(
                     kind="error", error="fork failed: grammar state copy"
                 ))

@@ -1,0 +1,22 @@
+"""Known-bad fixture for the counter-balance pass: a begin/end counter
+window where an exception edge exits after bumping begin but before end —
+the in-flight gauge (begin − end) drifts permanently."""
+
+
+class Engine:
+    def __init__(self):
+        self.m_decode_begin = 0
+        self.m_decode_end = 0
+
+    def step_bad(self, batch):
+        # run() raising leaves m_decode_begin ahead forever. MUST be
+        # flagged.
+        self.m_decode_begin += 1
+        out = self.run(batch)
+        self.m_decode_end += 1
+        return out
+
+    def run(self, batch):
+        if not batch:
+            raise ValueError("empty batch")
+        return batch

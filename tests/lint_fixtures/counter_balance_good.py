@@ -1,0 +1,34 @@
+"""Known-good fixture for the counter-balance pass: try/finally balances
+the window on every edge, and a pair split across methods (begin in
+submit, end in the completion callback) is a handoff protocol the pass
+deliberately exempts."""
+
+
+class Engine:
+    def __init__(self):
+        self.m_decode_begin = 0
+        self.m_decode_end = 0
+        self.m_inflight_begin = 0
+        self.m_inflight_end = 0
+
+    def step_good(self, batch):
+        self.m_decode_begin += 1
+        try:
+            return self.run(batch)
+        finally:
+            self.m_decode_end += 1
+
+    def submit(self, req):
+        # Cross-function pair: the end lives in on_done(), so this method
+        # never mentions m_inflight_end — exempt, not a finding.
+        self.m_inflight_begin += 1
+        return req
+
+    def on_done(self, req):
+        self.m_inflight_end += 1
+        return req
+
+    def run(self, batch):
+        if not batch:
+            raise ValueError("empty batch")
+        return batch

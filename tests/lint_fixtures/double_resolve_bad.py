@@ -1,0 +1,51 @@
+"""Known-bad fixture for the double-resolve pass: one acquisition, two
+resolves reachable on a single path — the handler already ended the
+reservation and the fall-through ends it again (inflight gauge goes
+negative), and a double page release under-refcounts a shared block."""
+
+
+def hashes(req):
+    return [hash(req)]
+
+
+class Dispatcher:
+    def __init__(self, sched):
+        self.sched = sched
+
+    def double_end(self, req):
+        # The except arm ends the reservation, then falls through to the
+        # shared end_stream: on the raise path end_stream runs TWICE for
+        # one pick. MUST be flagged.
+        name = self.sched.pick(hashes(req), reserve=True)
+        if name is None:
+            return
+        try:
+            self.submit(req)
+        except Exception:
+            self.sched.end_stream(name)
+        self.sched.end_stream(name)
+
+    def submit(self, req):
+        if req is None:
+            raise RuntimeError("replica refused the dispatch")
+        return req
+
+
+class Engine:
+    def __init__(self):
+        self._page_refs = [0] * 16
+
+    def _pages_addref(self, pages):
+        for p in pages:
+            self._page_refs[p] += 1
+
+    def _pages_release(self, pages):
+        for p in pages:
+            self._page_refs[p] -= 1
+
+    def double_release(self, pages):
+        # One addref, two releases on the same path: a LIVE sharer's pages
+        # go back to the free list. MUST be flagged.
+        self._pages_addref(pages)
+        self._pages_release(pages)
+        self._pages_release(pages)

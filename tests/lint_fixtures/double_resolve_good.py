@@ -1,0 +1,60 @@
+"""Known-good fixture for the double-resolve pass: each path resolves the
+acquisition exactly once — re-raising handlers, blanket slot teardown
+(prunes rather than arms), and clamp-and-heal protocols stay silent."""
+
+
+def hashes(req):
+    return [hash(req)]
+
+
+class Dispatcher:
+    def __init__(self, sched):
+        self.sched = sched
+
+    def single_end(self, req):
+        # Handler ends the reservation and RE-RAISES; the fall-through
+        # end_stream is on the disjoint (no-raise) path. Exactly once per
+        # path: fine.
+        name = self.sched.pick(hashes(req), reserve=True)
+        if name is None:
+            return
+        try:
+            self.submit(req)
+        except Exception:
+            self.sched.end_stream(name)
+            raise
+        self.sched.end_stream(name)
+
+    def submit(self, req):
+        if req is None:
+            raise RuntimeError("replica refused the dispatch")
+        return req
+
+
+class Engine:
+    def __init__(self):
+        self._page_refs = [0] * 16
+        self._slot_pages = [[] for _ in range(4)]
+
+    def _pages_addref(self, pages):
+        for p in pages:
+            self._page_refs[p] += 1
+
+    def _pages_release(self, pages):
+        for p in pages:
+            self._page_refs[p] -= 1
+
+    def _pages_free(self, slot_idx):
+        self._pages_release(self._slot_pages[slot_idx])
+        self._slot_pages[slot_idx] = []
+
+    def release_once(self, pages):
+        self._pages_addref(pages)
+        self._pages_release(pages)
+
+    def teardown(self, pages, slot_idx):
+        # Blanket slot teardown after a token release: _pages_free prunes
+        # the path (it tears down a different holder), not a double.
+        self._pages_addref(pages)
+        self._pages_release(pages)
+        self._pages_free(slot_idx)

@@ -1,0 +1,53 @@
+"""Known-bad fixture for the resource-leak pass: acquisitions whose
+exception edges exit without a resolve — the PR 19 breaker probe-slot
+incident minimized, the scheduler's pick→begin_stream window, and a
+manually-paired lock dropped by a raising loop body."""
+
+from urllib.request import urlopen
+
+
+def hashes(req):
+    return [hash(req)]
+
+
+class Caller:
+    def __init__(self, breaker, sched, lock):
+        self.breaker = breaker
+        self.sched = sched
+        self._lock = lock
+
+    def call_probe_leak(self, url):
+        # The PR 19 incident, minimized: the half-open probe slot is taken,
+        # then urlopen raises (HTTPError et al.) and neither record_* nor
+        # release_probe runs on that edge — the breaker is stuck half-open
+        # with its only probe slot leaked. MUST be flagged.
+        admission = self.breaker.admit()
+        if admission == "probe":
+            body = urlopen(url)
+            self.breaker.record_success()
+            return body
+        return None
+
+    def dispatch_window_leak(self, req):
+        # pick(reserve=True) takes the inflight reservation under the pick
+        # lock; submit() raising before end_stream leaks it and the replica
+        # can never drain to zero. MUST be flagged.
+        name = self.sched.pick(hashes(req), reserve=True)
+        if name is None:
+            return False
+        self.submit(req)
+        self.sched.end_stream(name)
+        return True
+
+    def lock_leak(self, items):
+        # A raising loop body between acquire() and release(): every later
+        # caller deadlocks. MUST be flagged.
+        self._lock.acquire()
+        for it in items:
+            self.submit(it)
+        self._lock.release()
+
+    def submit(self, req):
+        if req is None:
+            raise RuntimeError("replica refused the dispatch")
+        return req

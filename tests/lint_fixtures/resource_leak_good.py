@@ -1,0 +1,62 @@
+"""Known-good fixture for the resource-leak pass: the same acquisitions as
+the bad twin, resolved on every exit path — handler resolves on the
+exception edges, try/finally for the lock, ownership transfer by return."""
+
+from urllib.request import urlopen
+
+
+def hashes(req):
+    return [hash(req)]
+
+
+class Caller:
+    def __init__(self, breaker, sched, lock):
+        self.breaker = breaker
+        self.sched = sched
+        self._lock = lock
+
+    def call_probe_clean(self, url):
+        # The PR 19 fix shape: the probe outcome is recorded on the raise
+        # edge too, so the slot always comes back.
+        admission = self.breaker.admit()
+        if admission != "probe":
+            return None
+        try:
+            body = urlopen(url)
+        except Exception:
+            self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
+        return body
+
+    def dispatch_window_clean(self, req):
+        # The reservation is ended exactly once on every path out of the
+        # pick→end_stream window.
+        name = self.sched.pick(hashes(req), reserve=True)
+        if name is None:
+            return False
+        try:
+            self.submit(req)
+        except Exception:
+            self.sched.end_stream(name)
+            raise
+        self.sched.end_stream(name)
+        return True
+
+    def lock_clean(self, items):
+        self._lock.acquire()
+        try:
+            for it in items:
+                self.submit(it)
+        finally:
+            self._lock.release()
+
+    def handle_transfer(self, url):
+        # Returning the handle transfers ownership to the caller: not a
+        # leak here.
+        return urlopen(url)
+
+    def submit(self, req):
+        if req is None:
+            raise RuntimeError("replica refused the dispatch")
+        return req

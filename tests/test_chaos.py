@@ -242,6 +242,31 @@ def test_breaker_window_scenario_probe_discipline():
     assert out["probes"] == 2 and out["refused"] >= 5
 
 
+def test_journal_balance_check_catches_unresolved_begin():
+    """The chaos harness's registry-driven balance check (ISSUE 20): a
+    journaled protocol's begin event with no following end event is a
+    failure; a balanced stream and ends-without-begins (a plain breaker
+    trip) pass. Driven by tools/lint/resources.py JOURNAL_BALANCE — the
+    same declarations the resource-leak lint verifies statically."""
+    from tools.chaos_run import assert_journal_balance
+    from tools.lint.resources import JOURNAL_BALANCE
+
+    assert "breaker-probe" in JOURNAL_BALANCE
+    begin, ends = JOURNAL_BALANCE["breaker-probe"]
+
+    def ev(name, rid="peer"):
+        return {"event": name, "rid": rid, "a": 0.0, "b": 0.0}
+
+    # Balanced: begin then one of its ends; a bare end is legal.
+    assert_journal_balance([ev(ends[0]), ev(begin), ev(ends[1])])
+    # A probe that never resolves — the PR 19 leak, as journal evidence.
+    with pytest.raises(AssertionError, match="never followed"):
+        assert_journal_balance([ev(begin)])
+    # Two begins with the first still outstanding.
+    with pytest.raises(AssertionError, match="still unresolved"):
+        assert_journal_balance([ev(begin), ev(begin), ev(ends[0])])
+
+
 # --------------------------------------------------------------------- #
 # Mini-cluster scenarios (tiny model, 2 local replicas).
 # --------------------------------------------------------------------- #

@@ -277,3 +277,150 @@ def test_scheduler_membership_survives_dead_refresh_pick_drain_races():
         assert int(ev["a"]) != int(ev["b"]), ev
     # "a" and "c" were never removed; "b" ends either present or removed.
     assert {"a", "c"} <= set(sched.names())
+
+
+# --------------------------------------------------------------------- #
+# ISSUE 20 true positives: the resource-leak / double-resolve lint passes
+# flushed out three exception-ordering bugs. Same bare-object hammer shape
+# as above — drive the REAL fixed code paths with the fault injected and
+# assert the resource balance holds. Each of these leaked (adapter pin) or
+# went negative (inflight gauge) against the pre-fix code.
+# --------------------------------------------------------------------- #
+
+def test_resume_swap_unpins_adapter_when_allocator_raises():
+    """resource-leak TP: _dispatch_resume_swap re-pins the adapter before
+    allocating pages; a _pages_alloc raise (page-geometry validation) must
+    unwind the pin — pre-fix it stranded one LRU slot per raise."""
+    pins = []
+    lock = threading.Lock()
+
+    def one_round():
+        eng = Engine.__new__(Engine)
+        eng._adapter_acquire = lambda name: (pins.append(name), 3)[1]
+        eng._adapter_unpin = (
+            lambda row: pins.pop() if row else None)
+        eng._resume_swap_pages = lambda req: 4
+
+        def boom(slot_idx, total):
+            raise ValueError("kv page geometry")
+
+        eng._pages_alloc = boom
+        req = SimpleNamespace(adapter="t0", resume={"bytes": 1})
+        for _ in range(25):
+            with pytest.raises(ValueError):
+                eng._dispatch_resume_swap(req, SimpleNamespace(), 0)
+        with lock:
+            assert not pins, pins
+
+    _hammer(4, one_round)
+    assert not pins, pins
+
+
+def test_fork_midstream_unpins_adapter_before_raising_pages_free():
+    """resource-leak TP: the grammar-copy failure handler must unpin the
+    branch's adapter row BEFORE _pages_free — the free can raise (page
+    geometry validation) and pre-fix the pin leaked with it."""
+    import queue as _queue
+
+    class _PoisonGrammar:
+        def __deepcopy__(self, memo):
+            raise RuntimeError("grammar state copy failed")
+
+    def one_round():
+        pins = []
+        eng = Engine.__new__(Engine)
+        req0 = SimpleNamespace(
+            adapter="t0", grammar=_PoisonGrammar(), prompt_ids=[1, 2, 3],
+            max_new_tokens=8, seed=None,
+        )
+        eng.slots = [SimpleNamespace(request=req0, generated=[1, 2],
+                                     prompt_len=4), None]
+        eng.ecfg = SimpleNamespace(kv_page_size=32, kv_page_headroom=1,
+                                   kv_pages=16)
+        eng._hier = False
+        eng._slot_pages = [[0, 1], []]
+        eng._pages_worst = lambda req: 4
+        eng._pages_alloc = (
+            lambda dst, need, shared=None, shared_tps=None: 1)
+        eng._adapter_acquire = lambda name: (pins.append(name), 2)[1]
+        eng._adapter_unpin = (
+            lambda row: pins.pop() if row else None)
+
+        def raising_free(slot_idx):
+            raise ValueError("kv page geometry")
+
+        eng._pages_free = raising_free
+        for _ in range(25):
+            bh = SimpleNamespace(_q=_queue.Queue())
+            with pytest.raises(ValueError):
+                eng._fork_midstream(0, [None], [bh])
+            assert not pins, pins
+
+    _hammer(4, one_round)
+
+
+def test_cluster_abort_raise_does_not_double_end_stream():
+    """double-resolve TP: on grammar-replay failure _run_inner aborts and
+    end_streams the reservation. Pre-fix the order was end_stream → abort;
+    an abort raise then fell into the dispatch-refused handler which
+    end_streamed AGAIN — one pick, two ends, inflight gauge negative."""
+    import queue as _queue
+
+    from localai_tpu.cluster.scheduler import ClusterClient
+
+    class _SchedStub:
+        def __init__(self):
+            self.inflight = 0
+            self.min_inflight = 0
+            self.picks = 0
+
+        def hashes_for(self, ids):
+            return [0]
+
+        def pick(self, hashes, role=None, exclude=(),
+                 require_dispatch=False, reserve=False):
+            self.picks += 1
+            if self.picks > 1:
+                return None
+            self.inflight += 1
+            return "rep1"
+
+        def target(self, name):
+            return SimpleNamespace(engine=None)
+
+        def end_stream(self, name):
+            self.inflight -= 1
+            self.min_inflight = min(self.min_inflight, self.inflight)
+
+    def one_round():
+        for _ in range(25):
+            cc = ClusterClient.__new__(ClusterClient)
+            cc._lock = threading.Lock()
+            req = SimpleNamespace(
+                prompt_ids=[1, 2], grammar=object(), max_new_tokens=8,
+                seed=None, temperature=0.0, adapter=None,
+            )
+            rec = {"request": req, "attempted": set(), "emitted_ids": [5],
+                   "caller": SimpleNamespace(_q=_queue.Queue())}
+            cc._pending = {7: rec}
+            sched = _SchedStub()
+            cc.scheduler = sched
+            cc.m_dispatches = 0
+            cc.disaggregate = False
+            aborts = []
+
+            def aborting(rid, msg, _a=aborts):
+                _a.append(msg)
+                raise RuntimeError("journal write failed during abort")
+
+            cc._abort = aborting
+            cc._replay_grammar = lambda request, emitted, engine: None
+            cc._finish = lambda rid, ev: None
+            cc._run_inner(7)
+            assert len(aborts) == 1, aborts
+            # Exactly one end per pick, and the gauge never dipped below
+            # zero mid-flight.
+            assert sched.inflight == 0, sched.inflight
+            assert sched.min_inflight == 0, sched.min_inflight
+
+    _hammer(4, one_round)

@@ -11,7 +11,12 @@ robustness invariants the membership/failover layer promises:
   * grammar-constrained greedy output survives a mid-stream replica death
     byte-identical to the no-fault run (stateful replay, not abort);
   * the per-replica circuit breaker sends at most ONE probe per half-open
-    window (asserted from journal events).
+    window (asserted from journal events);
+  * every journaled resource protocol balances (ISSUE 20): for each
+    protocol declared with a `journal=` pair in tools/lint/resources.py
+    (the same registry the resource-leak lint verifies statically), each
+    begin event in the stream is eventually followed by one of its end
+    events — runtime evidence that nothing leaked under chaos.
 
 Usage:
     JAX_PLATFORMS=cpu python -m tools.chaos_run                 # all
@@ -164,6 +169,36 @@ def assert_breaker_probe_discipline(events):
             windows.pop(rid, None)
 
 
+def assert_journal_balance(events):
+    """Registry-driven lifecycle balance (ISSUE 20): for every protocol
+    with a `journal=(begin, ends)` declaration in tools/lint/resources.py,
+    each begin event is eventually followed by one of its end events for
+    the same rid. This is the runtime mirror of the resource-leak lint —
+    the static pass proves no code path drops the resource, this proves no
+    scenario actually did."""
+    from tools.lint.resources import JOURNAL_BALANCE
+
+    names = {e["event"] for e in events}
+    for pid, (begin, ends) in JOURNAL_BALANCE.items():
+        if begin not in names:
+            continue  # scenario never exercised this protocol
+        open_by_rid: dict[str, int] = {}
+        for e in events:
+            rid = e["rid"]
+            if e["event"] == begin:
+                assert open_by_rid.get(rid, 0) == 0, (
+                    f"{pid}: second {begin} on {rid} while the previous "
+                    f"one is still unresolved")
+                open_by_rid[rid] = 1
+            elif e["event"] in ends:
+                # Ends without a begin are legal (breaker_open fires on a
+                # plain trip too) — the check is begin ⇒ eventual end.
+                open_by_rid[rid] = 0
+        stuck = [rid for rid, n in open_by_rid.items() if n]
+        assert not stuck, (
+            f"{pid}: {begin} never followed by any of {ends} for {stuck}")
+
+
 # --------------------------------------------------------------------- #
 # Scenarios
 # --------------------------------------------------------------------- #
@@ -202,7 +237,9 @@ def kill_mid_decode(seed=99):
             assert n_toks == n_new, (i, n_toks)
         assert client.m_reroutes >= 1
         assert not client._pending, "records leaked past their terminals"
-        trans = _member_transitions(client.scheduler.journal_events())
+        events = client.scheduler.journal_events()
+        assert_journal_balance(events)
+        trans = _member_transitions(events)
         assert any(new == "dead" for _, _, new in trans), trans
         return {"reroutes": client.m_reroutes,
                 "dead": sum(r.engine.is_dead for r in replicas)}
@@ -230,6 +267,7 @@ def slow_gauge(seed=5):
         _assert_all_terminal(results, 4)
         assert script.exhausted(), "the gauge flap never fired"
         events = client.scheduler.journal_events()
+        assert_journal_balance(events)
         assert any(e["event"] == "fault_gauge_scrape" for e in events)
         trans = _member_transitions(events)
         assert not any(new == "dead" for _, _, new in trans), \
@@ -301,7 +339,9 @@ def join_under_load(seed=0):
         h2, f2 = _submit_streams(client, 3, 8)
         r2, hung2 = _drain_all(h2, f2)
         assert not hung2 and len(r2) == 3
-        trans = _member_transitions(client.scheduler.journal_events())
+        events = client.scheduler.journal_events()
+        assert_journal_balance(events)
+        trans = _member_transitions(events)
         assert (joiner.name, None, "joining") in trans, trans
         assert (joiner.name, "joining", "active") in trans, trans
         return {"joiner_prompt_tokens":
@@ -344,6 +384,7 @@ def drain_under_load(seed=0):
         assert snap[victim]["affinity_spans_held"] == 0, \
             "drain left affinity behind"
         events = sched.journal_events()
+        assert_journal_balance(events)
         handed = [e for e in events if e["event"] == "affinity_handoff"]
         assert handed and handed[0]["rid"] == victim, events
         # Graceful exit completes now that in-flight is zero.
@@ -414,6 +455,7 @@ def grammar_replay(seed=0):
         json.loads(got)  # no grammar-invalid bytes ever reached the caller
         assert client.m_grammar_replays >= 1
         events = client.scheduler.journal_events()
+        assert_journal_balance(events)
         assert any(e["event"] == "reroute_replay" for e in events), events
         return {"replays": client.m_grammar_replays, "bytes": len(got)}
     finally:
@@ -456,6 +498,7 @@ def breaker_window(seed=0):
     assert br.state == "closed"
     events = journal.snapshot()
     assert_breaker_probe_discipline(events)
+    assert_journal_balance(events)
     kinds = [e["event"] for e in events]
     assert kinds.count("breaker_open") == 2
     assert kinds.count("breaker_probe") == 2

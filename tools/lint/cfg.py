@@ -1,0 +1,419 @@
+"""Exception-edge-aware per-function control-flow graph (ISSUE 20).
+
+Every resource-lifecycle incident this repo has hit (the PR 19 breaker
+probe-slot leak, the pick→begin_stream inflight window, the PR 1/PR 4
+terminal-event hangs) lived on an exit path the AST walkers could not see:
+a `raise` out of a handler, an exception edge at a may-raise call, a
+`finally` that runs on five different continuations. This module builds the
+graph those passes reason over:
+
+- one node per simple statement, one branch node per `if`/`while`/`for`
+  test, explicit ENTRY / EXIT / RAISE_EXIT nodes;
+- `return` / `break` / `continue` edges routed through every pending
+  `finally` (each abrupt continuation gets its own finally copy, so a
+  witness path through a finally is line-accurate);
+- exception edges: a `raise` statement, or a statement containing a call
+  that MAY raise, gets edges to the enclosing try's handlers — and, when
+  no except-all handler catches, onward to RAISE_EXIT. "May raise" is an
+  injected predicate (`call_may_raise`): the resource passes wire it to the
+  interprocedural may-raise fixpoint (tools.lint.summaries) plus the
+  known-raiser table; inside a `try` with handlers EVERY call is treated as
+  raising — wrapping a call in try/except is the programmer's own
+  declaration that it can throw, and the handler paths are exactly where
+  leaks hide;
+- `with` bodies flow normally (the context manager's __exit__ runs on every
+  unwind, so a with-managed acquisition can never leak — the protocol
+  matcher in tools.lint.resources treats it as self-resolving).
+
+Pure AST, no imports of analyzed code, cached per function on the Repo by
+the consuming passes. Edge kinds: next true false loop except raise return
+break continue finally case.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Optional
+
+_SKIP_BODIES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# Builtins / container ops that cannot meaningfully raise here. The
+# "every call inside a try raises into its handlers" rule needs this carve-
+# out: `acquired.append(row)` between an acquire and its handler-resolve
+# would otherwise fabricate an exception path on which the append "threw"
+# before ownership was recorded. KeyError/IndexError out of these are
+# programmer-error crashes, the same bucket as assert.
+_SAFE_CALLS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "add", "discard",
+    "clear", "popleft", "pop", "remove", "insert", "update", "setdefault",
+    "get", "keys", "values", "items", "put", "len", "str", "repr", "int",
+    "float", "bool", "list", "dict", "tuple", "set", "frozenset", "sorted",
+    "min", "max", "sum", "abs", "enumerate", "zip", "range", "isinstance",
+    "id", "monotonic", "time", "perf_counter", "is_set", "join", "split",
+    "strip", "startswith", "endswith", "format",
+})
+
+
+def _call_last_name(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+@dataclasses.dataclass
+class Node:
+    idx: int
+    kind: str                      # entry|exit|raise-exit|stmt|branch|join
+    line: int
+    stmt: Optional[ast.AST] = None  # the statement (or test owner) node
+    test: Optional[ast.expr] = None  # branch nodes: the test expression
+
+
+class CFG:
+    """succ[i] = [(target idx, edge kind)]. `stmt_nodes` maps id(stmt) to
+    every node built from that statement (finally bodies are duplicated per
+    continuation, so one statement may own several nodes)."""
+
+    def __init__(self) -> None:
+        self.nodes: list[Node] = []
+        self.succ: list[list[tuple[int, str]]] = []
+        self.entry = 0
+        self.exit = 0
+        self.raise_exit = 0
+        self.stmt_nodes: dict[int, list[int]] = {}
+
+    def node(self, kind: str, line: int = 0, stmt: Optional[ast.AST] = None,
+             test: Optional[ast.expr] = None) -> int:
+        idx = len(self.nodes)
+        self.nodes.append(Node(idx, kind, line, stmt, test))
+        self.succ.append([])
+        if stmt is not None:
+            self.stmt_nodes.setdefault(id(stmt), []).append(idx)
+        return idx
+
+    def edge(self, src: int, dst: int, kind: str) -> None:
+        if (dst, kind) not in self.succ[src]:
+            self.succ[src].append((dst, kind))
+
+    def preds(self) -> dict[int, list[tuple[int, str]]]:
+        out: dict[int, list[tuple[int, str]]] = {i: [] for i in range(len(self.nodes))}
+        for i, edges in enumerate(self.succ):
+            for dst, kind in edges:
+                out[dst].append((i, kind))
+        return out
+
+
+def _const_truth(test: ast.expr) -> Optional[bool]:
+    if isinstance(test, ast.Constant):
+        return bool(test.value)
+    return None
+
+
+class _Builder:
+    def __init__(self, fn, call_may_raise: Callable[[ast.Call], bool]):
+        self.fn = fn
+        self.call_may_raise = call_may_raise
+        self.cfg = CFG()
+        self.cfg.entry = self.cfg.node("entry", getattr(fn, "lineno", 0))
+        self.cfg.exit = self.cfg.node("exit")
+        self.cfg.raise_exit = self.cfg.node("raise-exit")
+
+    # ---------------- raising ---------------- #
+
+    def _calls_in(self, stmt: ast.AST) -> list[ast.Call]:
+        out = []
+        stack = [stmt]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, _SKIP_BODIES) and n is not stmt:
+                continue
+            if isinstance(n, ast.Call):
+                out.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        return out
+
+    def _stmt_may_raise(self, stmt: ast.AST, frames: list) -> bool:
+        calls = [c for c in self._calls_in(stmt)
+                 if _call_last_name(c) not in _SAFE_CALLS]
+        if not calls:
+            return False
+        if any(fr["kind"] == "try_body" and fr["info"]["handlers"]
+               for fr in frames):
+            # Inside a try with handlers every call raises into them: the
+            # try IS the programmer's may-raise declaration.
+            return True
+        return any(self.call_may_raise(c) for c in calls)
+
+    def _raise_dests(self, frames: list) -> list[tuple[int, str]]:
+        """Where an exception raised under `frames` lands: each enclosing
+        try's handlers (stopping at an except-all), else RAISE_EXIT —
+        threading pending finally bodies on the way out."""
+        out: list[tuple[int, str]] = []
+        pending: list[dict] = []  # finally infos, innermost first
+        for fr in reversed(frames):
+            info = fr["info"]
+            if fr["kind"] == "try_body":
+                for h in info["handlers"]:
+                    out.append((self._through_finallys(pending, h, "except"),
+                                "except"))
+                if info["catch_all"]:
+                    return out
+                if info["final"]:
+                    pending.append(info)
+            elif fr["kind"] == "fin_scope":
+                if info["final"]:
+                    pending.append(info)
+        out.append((self._through_finallys(pending, self.cfg.raise_exit,
+                                           "raise"), "raise"))
+        return out
+
+    def _through_finallys(self, pending: list[dict], target: int,
+                          kind: str) -> int:
+        """Chain finally-body copies (innermost runs first) in front of
+        `target`; returns the entry to jump to. One copy per (target, kind)
+        per try — all raise sites through a try share it."""
+        cur = target
+        for info in reversed(pending):
+            cur = self._finally_copy(info, cur, kind)
+        return cur
+
+    def _finally_copy(self, info: dict, cont: int, kind: str) -> int:
+        key = (cont, kind)
+        if key in info["cache"]:
+            return info["cache"][key]
+        anchor = self.cfg.node("join", info["line"])
+        # Reserve the cache slot BEFORE building: a finally whose body
+        # raises back through itself must not recurse forever.
+        info["cache"][key] = anchor
+        ends = self.build_stmts(info["final"], list(info["outer"]),
+                                [(anchor, "finally")])
+        for i, k in ends:
+            self.cfg.edge(i, cont, kind)
+        return anchor
+
+    # ---------------- abrupt exits ---------------- #
+
+    def _unwind_to(self, frames: list, stop: str) -> tuple[list[dict], Optional[dict]]:
+        """(pending finallys, loop frame or None) walking out until `stop`
+        ("loop" or "func")."""
+        pending: list[dict] = []
+        for fr in reversed(frames):
+            if fr["kind"] in ("try_body", "fin_scope") and fr["info"]["final"]:
+                pending.append(fr["info"])
+            if stop == "loop" and fr["kind"] == "loop":
+                return pending, fr
+        return pending, None
+
+    # ---------------- statements ---------------- #
+
+    def build_stmts(self, stmts: list, frames: list,
+                    preds: list[tuple[int, str]]) -> list[tuple[int, str]]:
+        for stmt in stmts:
+            preds = self.build_stmt(stmt, frames, preds)
+            if not preds:
+                break  # unreachable tail after return/raise/break/continue
+        return preds
+
+    def _connect(self, preds: list[tuple[int, str]], dst: int) -> None:
+        for i, k in preds:
+            self.cfg.edge(i, dst, k)
+
+    def build_stmt(self, stmt, frames: list,
+                   preds: list[tuple[int, str]]) -> list[tuple[int, str]]:
+        cfg = self.cfg
+        if isinstance(stmt, ast.If):
+            n = cfg.node("branch", stmt.lineno, stmt, stmt.test)
+            self._connect(preds, n)
+            self._maybe_raise(n, stmt.test, frames)
+            truth = _const_truth(stmt.test)
+            out: list[tuple[int, str]] = []
+            if truth is not False:
+                out += self.build_stmts(stmt.body, frames, [(n, "true")])
+            if stmt.orelse:
+                if truth is not True:
+                    out += self.build_stmts(stmt.orelse, frames, [(n, "false")])
+            elif truth is not True:
+                out.append((n, "false"))
+            return out
+
+        if isinstance(stmt, ast.While):
+            head = cfg.node("branch", stmt.lineno, stmt, stmt.test)
+            self._connect(preds, head)
+            self._maybe_raise(head, stmt.test, frames)
+            after = cfg.node("join", stmt.lineno)
+            loop_fr = {"kind": "loop", "info": {"final": None},
+                       "head": head, "after": after}
+            truth = _const_truth(stmt.test)
+            if truth is not False:
+                ends = self.build_stmts(stmt.body, frames + [loop_fr],
+                                        [(head, "true")])
+                for i, k in ends:
+                    cfg.edge(i, head, "loop")
+            if truth is not True:
+                tail = [(head, "false")]
+                if stmt.orelse:
+                    tail = self.build_stmts(stmt.orelse, frames, tail)
+                self._connect(tail, after)
+            return [(after, "next")]
+
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            head = cfg.node("branch", stmt.lineno, stmt, None)
+            self._connect(preds, head)
+            self._maybe_raise(head, stmt.iter, frames)
+            after = cfg.node("join", stmt.lineno)
+            loop_fr = {"kind": "loop", "info": {"final": None},
+                       "head": head, "after": after}
+            ends = self.build_stmts(stmt.body, frames + [loop_fr],
+                                    [(head, "true")])
+            for i, k in ends:
+                cfg.edge(i, head, "loop")
+            tail = [(head, "false")]
+            if stmt.orelse:
+                tail = self.build_stmts(stmt.orelse, frames, tail)
+            self._connect(tail, after)
+            return [(after, "next")]
+
+        if isinstance(stmt, ast.Try):
+            info = {
+                "handlers": [], "catch_all": False,
+                "final": stmt.finalbody or None, "cache": {},
+                "outer": list(frames), "line": stmt.lineno,
+            }
+            for h in stmt.handlers:
+                info["handlers"].append(cfg.node("stmt", h.lineno, h))
+                if h.type is None:
+                    info["catch_all"] = True
+                else:
+                    names = {
+                        (e.id if isinstance(e, ast.Name)
+                         else getattr(e, "attr", ""))
+                        for e in (h.type.elts if isinstance(h.type, ast.Tuple)
+                                  else [h.type])
+                    }
+                    if names & {"Exception", "BaseException"}:
+                        info["catch_all"] = True
+            body_fr = {"kind": "try_body", "info": info}
+            fin_fr = {"kind": "fin_scope", "info": info}
+            body_ends = self.build_stmts(stmt.body, frames + [body_fr], preds)
+            normal = self.build_stmts(stmt.orelse, frames + [fin_fr],
+                                      body_ends)
+            for hn, h in zip(info["handlers"], stmt.handlers):
+                normal += self.build_stmts(h.body, frames + [fin_fr],
+                                           [(hn, "next")])
+            if stmt.finalbody:
+                return self.build_stmts(stmt.finalbody, frames, normal)
+            return normal
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            n = cfg.node("stmt", stmt.lineno, stmt)
+            self._connect(preds, n)
+            for item in stmt.items:
+                self._maybe_raise(n, item.context_expr, frames)
+            return self.build_stmts(stmt.body, frames, [(n, "next")])
+
+        if isinstance(stmt, ast.Match):
+            n = cfg.node("branch", stmt.lineno, stmt, stmt.subject)
+            self._connect(preds, n)
+            out: list[tuple[int, str]] = []
+            for case in stmt.cases:
+                out += self.build_stmts(case.body, frames, [(n, "case")])
+            out.append((n, "false"))
+            return out
+
+        # ---- simple statements ---- #
+        n = cfg.node("stmt", getattr(stmt, "lineno", 0), stmt)
+        self._connect(preds, n)
+
+        if isinstance(stmt, ast.Return):
+            pending, _ = self._unwind_to(frames, "func")
+            dst = self._through_finallys(pending, cfg.exit, "return")
+            cfg.edge(n, dst, "return")
+            self._maybe_raise(n, stmt.value, frames)
+            return []
+        if isinstance(stmt, ast.Raise):
+            for dst, kind in self._raise_dests(frames):
+                cfg.edge(n, dst, kind)
+            return []
+        if isinstance(stmt, ast.Break):
+            pending, loop_fr = self._unwind_to(frames, "loop")
+            if loop_fr is not None:
+                dst = self._through_finallys(pending, loop_fr["after"],
+                                             "break")
+                cfg.edge(n, dst, "break")
+            return []
+        if isinstance(stmt, ast.Continue):
+            pending, loop_fr = self._unwind_to(frames, "loop")
+            if loop_fr is not None:
+                dst = self._through_finallys(pending, loop_fr["head"],
+                                             "continue")
+                cfg.edge(n, dst, "continue")
+            return []
+        if isinstance(stmt, ast.Assert):
+            # AssertionError is a programmer-error crash, not control flow
+            # the resource passes track (mirrors the may-raise seed rule).
+            return [(n, "next")]
+
+        self._maybe_raise(n, stmt, frames)
+        return [(n, "next")]
+
+    def _maybe_raise(self, n: int, expr, frames: list) -> None:
+        if expr is not None and self._stmt_may_raise(expr, frames):
+            for dst, kind in self._raise_dests(frames):
+                self.cfg.edge(n, dst, kind)
+
+    def build(self) -> CFG:
+        ends = self.build_stmts(self.fn.body, [], [(self.cfg.entry, "next")])
+        self._connect(ends, self.cfg.exit)
+        return self.cfg
+
+
+def build_cfg(fn, call_may_raise: Optional[Callable[[ast.Call], bool]] = None
+              ) -> CFG:
+    """CFG for one FunctionDef/AsyncFunctionDef. `call_may_raise` decides
+    which calls OUTSIDE a try get exception edges (None = none do); calls
+    inside a try with handlers always raise into them."""
+    return _Builder(fn, call_may_raise or (lambda c: False)).build()
+
+
+def ast_parents(fn) -> dict[int, ast.AST]:
+    """{id(child): parent} over a function body — the acquire-context
+    seeding walk (which if/else arms dominate a statement) uses this."""
+    out: dict[int, ast.AST] = {}
+    stack = [fn]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            out[id(child)] = node
+            if not (isinstance(child, _SKIP_BODIES) and child is not fn):
+                stack.append(child)
+    return out
+
+
+def dominating_tests(fn, stmt) -> list[tuple[ast.expr, bool]]:
+    """[(test expr, polarity)] for every enclosing `if` whose body (True)
+    or orelse (False) lexically contains `stmt`. Seeds the path-consistency
+    facts when an analysis starts mid-function at an acquire site."""
+    parents = ast_parents(fn)
+    out: list[tuple[ast.expr, bool]] = []
+    node = stmt
+    while id(node) in parents:
+        parent = parents[id(node)]
+        if isinstance(parent, ast.If):
+            in_body = any(node is s or _contains(s, node) for s in parent.body)
+            out.append((parent.test, in_body))
+        elif isinstance(parent, ast.While):
+            if any(node is s or _contains(s, node) for s in parent.body):
+                out.append((parent.test, True))
+        node = parent
+    return out
+
+
+def _contains(tree: ast.AST, target: ast.AST) -> bool:
+    for sub in ast.walk(tree):
+        if sub is target:
+            return True
+    return False

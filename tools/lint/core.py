@@ -49,10 +49,18 @@ class Finding:
     message: str
     suppressed: bool = False
     reason: str = ""  # suppression reason when suppressed
+    # Ordered witness path from acquisition to the exit that loses the
+    # resource (ISSUE 20): `"file:line"` entries, abnormal edges annotated
+    # `"file:line (except)"` etc. Stable in --json (dataclasses.asdict);
+    # empty for passes that don't trace paths.
+    witness: list = dataclasses.field(default_factory=list)
 
     def render(self) -> str:
         tag = " [suppressed: %s]" % self.reason if self.suppressed else ""
-        return f"{self.path}:{self.line}: [{self.pass_id}] {self.message}{tag}"
+        out = f"{self.path}:{self.line}: [{self.pass_id}] {self.message}{tag}"
+        if self.witness:
+            out += "\n    witness: " + " -> ".join(self.witness)
+        return out
 
 
 class Repo:
@@ -155,8 +163,10 @@ class Pass:
     def run(self, repo: Repo) -> list[Finding]:  # pragma: no cover - abstract
         raise NotImplementedError
 
-    def finding(self, path: str, line: int, message: str) -> Finding:
-        return Finding(pass_id=self.id, path=path, line=line, message=message)
+    def finding(self, path: str, line: int, message: str,
+                witness: Optional[list] = None) -> Finding:
+        return Finding(pass_id=self.id, path=path, line=line, message=message,
+                       witness=list(witness or ()))
 
 
 def _suppression_for(lines: list[str], line: int, pass_id: str):

@@ -5,7 +5,9 @@ from __future__ import annotations
 
 from .attr_init import AttrInitPass
 from .config_drift import ConfigDriftPass
+from .counter_balance import CounterBalancePass
 from .donation_safety import DonationSafetyPass
+from .double_resolve import DoubleResolvePass
 from .fault_sites import FaultSitesPass
 from .handoff_escape import HandoffEscapePass
 from .journal_events import JournalEventsPass
@@ -14,6 +16,7 @@ from .lock_order import LockOrderPass
 from .metric_counters import MetricCountersPass
 from .net_call_deadline import NetCallDeadlinePass
 from .page_refcount import PageRefcountPass
+from .resource_leak import ResourceLeakPass
 from .rng_key_reuse import RngKeyReusePass
 from .sharding_consistency import ShardingConsistencyPass
 from .shared_state_race import SharedStateRacePass
@@ -49,4 +52,10 @@ def all_passes():
         # Remote-call hardening (ISSUE 19): every outbound network call
         # states its deadline.
         NetCallDeadlinePass(),
+        # Resource-lifecycle verification (ISSUE 20): exception-edge CFG ×
+        # the declarative protocol registry (tools.lint.resources) — the
+        # leak-on-error class the PR 19 breaker-slot incident belonged to.
+        ResourceLeakPass(),
+        DoubleResolvePass(),
+        CounterBalancePass(),
     ]

@@ -1,0 +1,136 @@
+"""counter-balance: paired `m_*_begin` / `m_*_end` counters must balance
+on every path.
+
+The observability cousin of resource-leak (ISSUE 20): a gauge implemented
+as begin/end counter pairs (the journal/metrics idiom for windows —
+in-flight work is `begin - end`) drifts permanently if any CFG path bumps
+`begin` and exits without bumping `end`. The gauge then reads phantom
+in-flight work forever; dashboards and the chaos harness's balance
+assertions (tools/chaos_run.py) both go quietly wrong. Exception edges are
+where this hides — the happy path always balances.
+
+Rule: within one function, every `self.m_X_begin += …` must reach a
+`self.m_X_end += …` on every CFG exit path (exception edges included).
+Counter pairs split across functions (begin in submit, end in the
+completion callback) are a different, handoff-shaped protocol and are
+exempt: only functions touching BOTH sides are checked.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .. import astutil
+from ..core import Finding, Pass, Repo
+from ..resources import (AcqSpec, Acquisition, FlowAnalysis, Protocol,
+                         _local_exprs, _TokenInfo, cfg_for)
+from ..summaries import DEFAULT_SUMMARY_GLOBS, summaries_for
+
+_BEGIN_RE = re.compile(r"^(m_.+)_begin$")
+
+_COUNTER_PROTO = Protocol(
+    pid="counter-balance", what="begin/end counter window",
+    acquires=(), strict=False,
+)
+
+
+class _CounterClassifier:
+    """FlowAnalysis classifier for counter pairs: the 'resolve' is a store
+    to the matching *_end attribute; nothing transfers or kills."""
+
+    def __init__(self, me: str, end_attr: str):
+        self.me = me
+        self.end_attr = end_attr
+        self.proto = _COUNTER_PROTO
+        self.ti = _TokenInfo("always")
+        self.acq_call = None
+
+    def resolve_at(self, node):
+        for expr in _local_exprs(node):
+            for sub in ast.walk(expr):
+                if (isinstance(sub, ast.Attribute)
+                        and isinstance(sub.ctx, ast.Store)
+                        and isinstance(sub.value, ast.Name)
+                        and sub.value.id == self.me
+                        and sub.attr == self.end_attr):
+                    return ("blanket", sub.lineno)
+        return None
+
+    def transfers_at(self, node) -> bool:
+        return False
+
+    def kills_token(self, node) -> bool:
+        return False
+
+
+def _begin_sites(fn, me: str):
+    """[(stmt, begin attr)] for `self.m_X_begin += …` statements."""
+    out = []
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.AugAssign, ast.Assign)):
+            continue
+        targets = ([node.target] if isinstance(node, ast.AugAssign)
+                   else node.targets)
+        for t in targets:
+            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id == me and _BEGIN_RE.match(t.attr)):
+                out.append((node, t.attr))
+    return out
+
+
+def _mentions_attr(fn, me: str, attr: str) -> bool:
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == me and node.attr == attr):
+            return True
+    return False
+
+
+class CounterBalancePass(Pass):
+    id = "counter-balance"
+    description = (
+        "m_*_begin counter bumped on a path that exits without the "
+        "matching m_*_end (the gauge drifts permanently)"
+    )
+
+    def __init__(self, globs=None):
+        self.globs = tuple(globs) if globs else DEFAULT_SUMMARY_GLOBS
+
+    def run(self, repo: Repo) -> list[Finding]:
+        index = summaries_for(repo, self.globs)
+        out: list[Finding] = []
+        for fid, fd in index.graph.funcs.items():
+            if not repo.in_scope(fd.path):
+                continue
+            if "_begin" not in repo.source(fd.path):
+                continue
+            me = astutil.self_name(fd.node) if fd.cls else None
+            if me is None:
+                continue
+            sites = _begin_sites(fd.node, me)
+            if not sites:
+                continue
+            cfg = cfg_for(repo, index, fd)
+            for stmt, begin_attr in sites:
+                end_attr = _BEGIN_RE.match(begin_attr).group(1) + "_end"
+                if not _mentions_attr(fd.node, me, end_attr):
+                    continue  # cross-function pair: not this pass's protocol
+                acq = Acquisition(
+                    spec=AcqSpec(begin_attr, "always"),
+                    protocol=_COUNTER_PROTO, stmt=stmt, call=None,
+                    line=stmt.lineno, token=None)
+                classifier = _CounterClassifier(me, end_attr)
+                issues = FlowAnalysis(cfg, fd.path, fd.node, acq, classifier,
+                                      mode="leak").run()
+                for iss in issues:
+                    out.append(self.finding(
+                        fd.path, iss.line,
+                        f"{fd.cls}.{fd.name}() bumps {begin_attr} here but "
+                        f"a path reaching line {iss.exit_line} exits "
+                        f"without bumping {end_attr} — the window gauge "
+                        f"(begin − end) drifts permanently on that path",
+                        witness=iss.witness,
+                    ))
+        return out

@@ -1,0 +1,83 @@
+"""double-resolve: one acquisition, two resolves on a single path.
+
+The mirror image of resource-leak (ISSUE 20): `end_stream` called twice
+for one reservation drives the scheduler's inflight gauge negative (it
+clamps, silently corrupting least-loaded placement); a double
+`_pages_release` under-refcounts a shared prefix block so a LIVE stream's
+pages return to the free list. Both are harder to see in review than a
+leak because each call looks correct in isolation.
+
+Checked on the same exception-edge CFG and protocol registry as
+resource-leak: after a token-matched resolve, a second token-matched
+resolve of the SAME handle reachable on the same path is a finding.
+Clamp-and-heal protocols (breaker `record_*`: legal to call without a
+held probe, by design) declare `strict=False` in the registry and are
+excluded; blanket resolves (`_pages_free` slot teardown) prune the path
+instead of arming it — only a literal second resolve of the same token
+fires.
+"""
+
+from __future__ import annotations
+
+from .. import astutil
+from ..core import Finding, Pass, Repo
+from ..resources import (ADAPTER_PIN, KV_PAGES, LOCK_MANUAL, SCHED_INFLIGHT,
+                         analyze_protocol, releasing_methods)
+from ..summaries import DEFAULT_SUMMARY_GLOBS, summaries_for
+
+DEFAULT_PROTOCOLS = (KV_PAGES, SCHED_INFLIGHT, ADAPTER_PIN, LOCK_MANUAL)
+
+
+class DoubleResolvePass(Pass):
+    id = "double-resolve"
+    description = (
+        "two resolves of one acquisition reachable on a single CFG path "
+        "(double release / double end_stream)"
+    )
+
+    def __init__(self, globs=None, protocols=None):
+        self.globs = tuple(globs) if globs else DEFAULT_SUMMARY_GLOBS
+        self.protocols = tuple(protocols) if protocols else DEFAULT_PROTOCOLS
+
+    def run(self, repo: Repo) -> list[Finding]:
+        index = summaries_for(repo, self.globs)
+        acquire_names = sorted({s.call for p in self.protocols
+                                for s in p.acquires})
+        hot_path: dict[str, bool] = {}
+        releasing: dict[tuple, tuple] = {}
+        out: list[Finding] = []
+        for fid, fd in index.graph.funcs.items():
+            if not repo.in_scope(fd.path):
+                continue
+            if fd.path not in hot_path:
+                src = repo.source(fd.path)
+                hot_path[fd.path] = any(n in src for n in acquire_names)
+            if not hot_path[fd.path]:
+                continue
+            extra = ()
+            if fd.cls is not None:
+                key = (fd.path, fd.cls)
+                if key not in releasing:
+                    cls_node = index.graph.classes.get(key)
+                    # Methods that transitively release (e.g. the engine's
+                    # _resume_discard) prune like the primitives do.
+                    releasing[key] = () if cls_node is None else tuple(
+                        releasing_methods(astutil.methods_of(cls_node)))
+                extra = releasing[key]
+            for iss in analyze_protocol(repo, index, fd, self.protocols,
+                                        mode="double",
+                                        extra_blanket_resolves=extra):
+                if iss.kind != "double":
+                    continue
+                proto = iss.protocol
+                owner = f"{fd.cls}.{fd.name}" if fd.cls else fd.name
+                out.append(self.finding(
+                    fd.path, iss.exit_line,
+                    f"{owner}() resolves the {proto.what} acquired at line "
+                    f"{iss.line} twice on one path (first at line "
+                    f"{iss.first_resolve}, again here) — the second "
+                    f"{proto.pid} resolve corrupts the balance "
+                    f"(double-release / double-end_stream class)",
+                    witness=iss.witness,
+                ))
+        return out

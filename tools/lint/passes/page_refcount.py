@@ -17,12 +17,15 @@ walk could see it. Rules, per class (default Engine):
    in an `if` test). An unchecked alloc turns pool backpressure into a
    loop-killing TypeError three lines later.
 
-3. RELEASE ON ERROR EDGES: a method that allocates must also own a failure
-   edge — a try/except/finally that references `_pages_free`/`_pages_release`,
-   or slot installation (`self.slots[...] = ...`, after which the ordinary
-   `_release` teardown owns the pages), or an explicit requeue of the
-   request. Allocating with neither means an exception between the alloc
-   and the slot install leaks the pages until restart.
+3. RELEASE ON ERROR EDGES: every allocation (`_pages_alloc` /
+   `_pages_claim` / `_pages_addref` outside the primitives) must resolve on
+   EVERY exception-edge CFG path — released, freed, or ownership
+   transferred into a tracked table / prefix container / requeue. Since
+   ISSUE 20 this is the kv-pages protocol of the resource registry
+   (tools.lint.resources) run in leak mode over the exception-edge CFG,
+   replacing the old lexical "a try that mentions _pages_free exists
+   somewhere in the body" check: the release must actually lie on the
+   leaking path, not merely in the same method.
 
 4. NO ESCAPED PAGE IDS: page ids live only in the tracked tables
    (`_slot_pages`, `h_ptable`, the refcount/free structures) or flow
@@ -33,9 +36,12 @@ walk could see it. Rules, per class (default Engine):
 from __future__ import annotations
 
 import ast
+import os
 
 from .. import astutil
 from ..core import Finding, Pass, Repo
+from ..resources import KV_PAGES, analyze_protocol, releasing_methods
+from ..summaries import summaries_for
 
 DEFAULT_TARGETS = [("localai_tpu/engine/engine.py", "Engine")]
 
@@ -49,7 +55,6 @@ TRACKED_TABLES = {"_slot_pages", "h_ptable", "_free_pages", "_page_refs"}
 TRACKED_CONTAINERS = {"_prefix_entries", "_prefix_host"}
 _MUTATING_CALLS = {"pop", "append", "appendleft", "extend", "clear",
                    "insert", "remove"}
-RELEASE_NAMES = {"_pages_free", "_pages_release"}
 
 
 def _names_in(node: ast.AST) -> set[str]:
@@ -96,9 +101,6 @@ class PageRefcountPass(Pass):
                 alloc_calls: list[ast.Call] = []
                 none_checked: set[str] = set()  # local names None-compared
                 calls_in_if_test: set[int] = set()
-                has_release_handler = False
-                installs_slot = False
-                requeues = False
 
                 for node in ast.walk(fn):
                     # R1: pool-structure mutation outside primitives.
@@ -150,30 +152,7 @@ class PageRefcountPass(Pass):
                             for sub in ast.walk(node):
                                 if isinstance(sub, ast.Call):
                                     calls_in_if_test.add(id(sub))
-                    if isinstance(node, ast.Try):
-                        for h in node.handlers + (
-                            [node] if node.finalbody else []
-                        ):
-                            body = (h.body if isinstance(h, ast.ExceptHandler)
-                                    else node.finalbody)
-                            for sub in body:
-                                if _names_in(sub) & RELEASE_NAMES:
-                                    has_release_handler = True
-                    if (isinstance(node, ast.Call)
-                            and isinstance(node.func, ast.Attribute)
-                            and node.func.attr in ("extend", "append")
-                            and isinstance(node.func.value, ast.Subscript)
-                            and self_attr(node.func.value.value)
-                            in TRACKED_TABLES):
-                        # e.g. self._slot_pages[i].extend(fresh): claimed
-                        # pages land in a tracked table — ownership moved.
-                        installs_slot = True
                     if isinstance(node, ast.Assign):
-                        for t in node.targets:
-                            if (isinstance(t, ast.Subscript)
-                                    and self_attr(t.value) in
-                                    ({"slots"} | TRACKED_TABLES)):
-                                installs_slot = True
                         # R4: page lists escaping into untracked attributes.
                         rhs_names = _names_in(node.value)
                         if ("_pages_alloc" in rhs_names
@@ -197,16 +176,6 @@ class PageRefcountPass(Pass):
                                         f"invariant walk cannot see this "
                                         f"reference",
                                     ))
-                    if (isinstance(node, ast.Call)
-                            and isinstance(node.func, ast.Attribute)
-                            and node.func.attr in ("appendleft", "append",
-                                                   "insert")
-                            and self_attr(node.func.value) in (
-                                {"_pending"} | TRACKED_CONTAINERS)):
-                        # Requeue, or ownership transfer into a prefix
-                        # container whose entries _prefix_drop releases.
-                        requeues = True
-
                 if in_primitive or not alloc_calls:
                     continue
 
@@ -234,13 +203,33 @@ class PageRefcountPass(Pass):
                             f"loop-killing TypeError",
                         ))
 
-                # R3: a release edge must exist.
-                if not (has_release_handler or installs_slot or requeues):
+            # R3: every allocation resolves on every exception-edge CFG
+            # path — the kv-pages protocol in leak mode, with the class's
+            # transitively-releasing helpers (e.g. _resume_discard) as
+            # blanket resolves.
+            rel = os.path.relpath(repo.abspath(path),
+                                  repo.root).replace(os.sep, "/")
+            index = summaries_for(repo, (rel,))
+            extra = tuple(releasing_methods(methods))
+            for fid, fd in index.graph.funcs.items():
+                if fd.path != rel or fd.cls != class_name:
+                    continue
+                if fd.name in construction:
+                    continue  # no consumer can observe a half-built pool
+                for iss in analyze_protocol(repo, index, fd, (KV_PAGES,),
+                                            mode="leak",
+                                            extra_blanket_resolves=extra):
+                    if iss.kind != "leak":
+                        continue
+                    exit_desc = ("the function's exception exit"
+                                 if iss.exit_kind == "raise-exit"
+                                 else "a return")
                     out.append(self.finding(
-                        path, alloc_calls[0].lineno,
-                        f"{class_name}.{mname}() allocates pages but has no "
-                        f"failure edge (no try/except-with-release, no slot "
-                        f"install, no requeue) — an exception here leaks the "
-                        f"pages until restart",
+                        path, iss.line,
+                        f"{class_name}.{fd.name}() allocates pages here but "
+                        f"{exit_desc} (via line {iss.exit_line}) is "
+                        f"reachable without releasing or installing them — "
+                        f"the pages leak from the pool until restart",
+                        witness=iss.witness,
                     ))
         return out

@@ -1,0 +1,81 @@
+"""resource-leak: an acquired resource must resolve on EVERY exit path.
+
+The PR 19 incident class, generalized (ISSUE 20): `call_with_retry`
+admitted a circuit-breaker half-open probe, then exited through the
+HTTPError edge without `record_success`/`record_failure`/`release_probe` —
+the breaker wedged half-open and refused every future call to that
+replica. The same shape: a `pick(reserve=True)` inflight reservation
+abandoned before `end_stream`, an adapter pin dropped on an early return,
+a manually-acquired lock left held on a raise, a stream handle lost on a
+typed-error edge.
+
+The rule is protocol-generic: for every acquisition declared in
+tools.lint.resources, every path of the exception-edge CFG (tools.lint.cfg
+— `raise`, handler, finally, and may-raise call edges included) from the
+acquire site to EXIT or RAISE_EXIT must contain a resolve primitive or an
+ownership transfer (return of the handle, store into the protocol's
+declared owner container). The first leaking path is reported with a
+line-numbered witness trace (Finding.witness; `--json` carries it stably).
+
+kv pages are checked by the page-refcount pass (same registry declaration,
+different finding vocabulary); this pass covers the other five protocols.
+"""
+
+from __future__ import annotations
+
+from ..core import Finding, Pass, Repo
+from ..resources import (ADAPTER_PIN, BREAKER_PROBE, LOCK_MANUAL, NET_HANDLE,
+                         SCHED_INFLIGHT, analyze_protocol)
+from ..summaries import DEFAULT_SUMMARY_GLOBS, summaries_for
+
+DEFAULT_PROTOCOLS = (BREAKER_PROBE, SCHED_INFLIGHT, ADAPTER_PIN, LOCK_MANUAL,
+                     NET_HANDLE)
+
+_EXIT_DESC = {
+    "exit": "a normal exit",
+    "raise-exit": "the function's exception exit",
+}
+
+
+class ResourceLeakPass(Pass):
+    id = "resource-leak"
+    description = (
+        "acquisition (probe slot / inflight reservation / adapter pin / "
+        "lock / net handle) with a CFG exit path that never resolves it"
+    )
+
+    def __init__(self, globs=None, protocols=None):
+        self.globs = tuple(globs) if globs else DEFAULT_SUMMARY_GLOBS
+        self.protocols = tuple(protocols) if protocols else DEFAULT_PROTOCOLS
+
+    def run(self, repo: Repo) -> list[Finding]:
+        index = summaries_for(repo, self.globs)
+        acquire_names = sorted({s.call for p in self.protocols
+                                for s in p.acquires})
+        hot_path: dict[str, bool] = {}
+        out: list[Finding] = []
+        for fid, fd in index.graph.funcs.items():
+            if not repo.in_scope(fd.path):
+                continue
+            if fd.path not in hot_path:
+                src = repo.source(fd.path)
+                hot_path[fd.path] = any(n in src for n in acquire_names)
+            if not hot_path[fd.path]:
+                continue
+            for iss in analyze_protocol(repo, index, fd, self.protocols,
+                                        mode="leak"):
+                proto = iss.protocol
+                where = _EXIT_DESC.get(iss.exit_kind, iss.exit_kind)
+                owner = f"{fd.cls}.{fd.name}" if fd.cls else fd.name
+                out.append(self.finding(
+                    fd.path, iss.line,
+                    f"{owner}() acquires a {proto.what} here but {where} "
+                    f"(via line {iss.exit_line}) is reachable without "
+                    f"resolving it — the {proto.pid} protocol leaks on "
+                    f"that path (the PR 19 probe-slot incident class); "
+                    f"resolve with one of "
+                    f"{sorted(proto.resolves + proto.blanket_resolves)} "
+                    f"or transfer ownership",
+                    witness=iss.witness,
+                ))
+        return out

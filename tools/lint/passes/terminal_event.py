@@ -20,6 +20,17 @@ does, transitively), or EVERY intra-class caller of it is terminal-safe
 (helpers like `_release` are owned by posting callers). Re-enqueues
 (`appendleft`/`append` back onto the queue) are not drops. A method that
 fails the rule is a hang waiting for its code path to be hit.
+
+Since ISSUE 20 the self-posting arm runs on the exception-edge CFG
+(tools.lint.cfg): a drop site inside a posting method is only safe when
+some post point is CFG-connected to it — the post reachable from the drop,
+or the drop reachable from a post (post-then-remove order), or a
+re-enqueue. The check is existential ("some path balances") rather than
+resource-leak's universal one: a drop whose post sits in a possibly-zero-
+iteration loop is the drain idiom, not a hang. What the CFG adds is
+catching a drop on an early-return or handler path that can never meet the
+method's post — the exact shape the pre-CFG "posts anywhere in the body"
+rule waved through.
 """
 
 from __future__ import annotations
@@ -28,6 +39,7 @@ import ast
 from typing import Optional
 
 from .. import astutil
+from ..cfg import ast_parents, build_cfg
 from ..core import Finding, Pass, Repo
 
 DEFAULT_TARGETS = [
@@ -70,7 +82,8 @@ def _terminal_put_in(fn) -> bool:
 
 
 def _drop_sites(fn, me: str, pending_attr: str, slots_attr: str):
-    """[(line, what)] for statements that drop a request reference."""
+    """[(ast node, line, what)] for statements that drop a request
+    reference."""
     out = []
     for node in ast.walk(fn):
         # self._pending.popleft() / .pop() / .remove() / .clear()
@@ -81,7 +94,8 @@ def _drop_sites(fn, me: str, pending_attr: str, slots_attr: str):
                 and isinstance(node.func.value.value, ast.Name)
                 and node.func.value.value.id == me
                 and node.func.value.attr == pending_attr):
-            out.append((node.lineno, f"{pending_attr}.{node.func.attr}()"))
+            out.append((node, node.lineno,
+                        f"{pending_attr}.{node.func.attr}()"))
         # rebind: self._pending = <...> (including tuple unpacking)
         if isinstance(node, ast.Assign):
             for t in node.targets:
@@ -91,7 +105,8 @@ def _drop_sites(fn, me: str, pending_attr: str, slots_attr: str):
                             and isinstance(tt.value, ast.Name)
                             and tt.value.id == me
                             and tt.attr == pending_attr):
-                        out.append((node.lineno, f"{pending_attr} rebind"))
+                        out.append((node, node.lineno,
+                                    f"{pending_attr} rebind"))
             # slot deactivation: self.slots[i] = None
             if (isinstance(node.value, ast.Constant)
                     and node.value.value is None):
@@ -101,8 +116,73 @@ def _drop_sites(fn, me: str, pending_attr: str, slots_attr: str):
                             and isinstance(t.value.value, ast.Name)
                             and t.value.value.id == me
                             and t.value.attr == slots_attr):
-                        out.append((node.lineno, f"{slots_attr}[...] = None"))
+                        out.append((node, node.lineno,
+                                    f"{slots_attr}[...] = None"))
     return out
+
+
+def _node_local_exprs(node):
+    """The expressions a CFG node itself evaluates (compound statements'
+    bodies belong to their own nodes)."""
+    s = node.stmt
+    if s is None:
+        return []
+    if node.kind == "branch":
+        if isinstance(s, (ast.If, ast.While)):
+            return [s.test]
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            return [s.iter]
+        if isinstance(s, ast.Match):
+            return [s.subject]
+        return []
+    if isinstance(s, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in s.items]
+    if isinstance(s, ast.ExceptHandler):
+        return []
+    return [s]
+
+
+def _is_post(expr, me: str, posting: set, pending_attr: str) -> bool:
+    """Does this expression post terminally: a direct terminal put, a call
+    to a (transitively) posting method, or a re-enqueue onto the queue?"""
+    if _terminal_put_in(expr):
+        return True
+    for sub in ast.walk(expr):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        if (isinstance(f.value, ast.Name) and f.value.id == me
+                and f.attr in posting):
+            return True
+        if (f.attr in ("append", "appendleft")
+                and isinstance(f.value, ast.Attribute)
+                and isinstance(f.value.value, ast.Name)
+                and f.value.value.id == me
+                and f.value.attr == pending_attr):
+            return True
+    return False
+
+
+def _stmt_node_idxs(cfg, parents, node) -> list[int]:
+    """CFG node indices of the statement enclosing an arbitrary AST node."""
+    n = node
+    while n is not None and id(n) not in cfg.stmt_nodes:
+        n = parents.get(id(n))
+    return list(cfg.stmt_nodes.get(id(n), ())) if n is not None else []
+
+
+def _reachable(cfg, starts) -> set[int]:
+    seen = set(starts)
+    stack = list(starts)
+    while stack:
+        i = stack.pop()
+        for dst, _kind in cfg.succ[i]:
+            if dst not in seen:
+                seen.add(dst)
+                stack.append(dst)
+    return seen
 
 
 class TerminalEventPass(Pass):
@@ -165,19 +245,54 @@ class TerminalEventPass(Pass):
                         changed = True
 
             construction = astutil.construction_methods(methods)
+            posting = posts | markers
             for mname, fn in methods.items():
                 me = astutil.self_name(fn)
                 if me is None or mname in construction:
                     continue  # no consumer exists during construction
+                if mname in markers:
+                    continue  # the sanctioned terminal marker itself
                 sites = _drop_sites(fn, me, pending_attr, slots_attr)
-                if not sites or mname in safe:
+                if not sites:
                     continue
-                for line, what in sites:
+                cs = callers[mname]
+                if cs and cs <= safe:
+                    continue  # helper owned by terminal-safe callers
+                if mname not in safe:
+                    for _node, line, what in sites:
+                        out.append(self.finding(
+                            path, line,
+                            f"{class_name}.{mname}() drops a request "
+                            f"reference ({what}) but neither it nor all of "
+                            f"its callers post a terminal TokenEvent — the "
+                            f"consumer blocks on its stream forever (the "
+                            f"PR 1/PR 4 hang class)",
+                        ))
+                    continue
+                # The method posts (directly or transitively): each drop
+                # must be CFG-connected to some post point.
+                cfg = build_cfg(fn)
+                parents = ast_parents(fn)
+                post_idxs = {
+                    idx for idx, node in enumerate(cfg.nodes)
+                    if any(_is_post(e, me, posting, pending_attr)
+                           for e in _node_local_exprs(node))
+                }
+                post_fwd = _reachable(cfg, post_idxs)
+                for node, line, what in sites:
+                    drop_idxs = _stmt_node_idxs(cfg, parents, node)
+                    if not drop_idxs:
+                        continue
+                    fwd = _reachable(cfg, drop_idxs)
+                    if fwd & post_idxs or any(d in post_fwd
+                                              for d in drop_idxs):
+                        continue
                     out.append(self.finding(
                         path, line,
                         f"{class_name}.{mname}() drops a request reference "
-                        f"({what}) but neither it nor all of its callers "
-                        f"post a terminal TokenEvent — the consumer blocks "
-                        f"on its stream forever (the PR 1/PR 4 hang class)",
+                        f"({what}) on a path that neither reaches nor "
+                        f"follows any of its terminal posts — on that path "
+                        f"the consumer blocks on its stream forever (the "
+                        f"PR 1/PR 4 hang class)",
                     ))
         return out

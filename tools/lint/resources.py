@@ -1,0 +1,1151 @@
+"""Declarative resource-protocol registry + CFG lifecycle verification.
+
+ISSUE 20: every recent incident was one bug class — a resource acquired
+and not resolved on some exit path. The PR 19 breaker probe slot leaked on
+the HTTPError edge of `call_with_retry`; the `pick(reserve=True)` →
+`end_stream` inflight window leaked on early-continue edges; the PR 1/PR 4
+terminal-event hangs were pending-entry drops without a posted event. This
+module makes the protocol the DECLARATION and the checking generic:
+
+- `Protocol` names acquire primitives (with how the acquisition is
+  conditioned on the return value), resolve primitives, and transfer/escape
+  forms. Adding a protocol is adding a declaration here — no pass code.
+- `find_acquisitions` locates acquire sites in a function body.
+- `FlowAnalysis` runs the acquisition forward over the exception-edge CFG
+  (tools.lint.cfg): every path from the acquire must hit a resolve or a
+  transfer before EXIT / RAISE_EXIT. Path sensitivity comes from a small
+  fact store over simple comparisons (`x is None`, `x == "probe"`,
+  `code in (404, 409)`, truthiness) with an implication oracle, so the
+  infeasible `in (404,409)`-False-then-`== 404`-True path in netspan does
+  not produce a false leak. The first leaking path found is reported with a
+  line-numbered witness trace (the Finding.witness field).
+
+Consumed by the resource-leak / double-resolve / counter-balance passes and
+by the CFG rewrite of page-refcount; `tools/chaos_run.py` reads
+JOURNAL_BALANCE to tie each declared protocol to runtime journal evidence.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from collections import deque
+from typing import Callable, Optional
+
+from . import astutil
+from .callgraph import FuncDef
+from .cfg import CFG, build_cfg, dominating_tests
+from .summaries import KNOWN_RAISERS, SummaryIndex
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AcqSpec:
+    """One acquire primitive: calling `call` acquires the resource when the
+    return value satisfies `mode` ("always" | "truthy" | "not_none" |
+    "eq" against eq_value). `token` names where the handle lives: "ret"
+    (the assigned name), "arg0" (first positional arg — begin_stream(name),
+    _pages_addref(pages)), or "recv" (the receiver itself —
+    self._lock.acquire())."""
+
+    call: str
+    mode: str = "always"
+    eq_value: object = None
+    token: str = "ret"
+    self_only: bool = False          # only `self.<call>(...)` matches
+    kwarg_gate: tuple = ()           # ("reserve", True): kwarg must equal
+    recv_hint: str = ""              # receiver last segment must contain
+    carry_arg0: bool = False         # arg0 also identifies the acquisition
+    #                                  (_pages_alloc(slot_idx, …): cleanup
+    #                                  is keyed by the slot index)
+
+
+@dataclasses.dataclass(frozen=True)
+class Protocol:
+    pid: str
+    what: str                        # human name of the resource
+    acquires: tuple
+    resolves: tuple = ()             # token-matched resolve call names
+    blanket_resolves: tuple = ()     # resolve regardless of arguments
+    transfer_attrs: tuple = ()       # self.<attr> stores WITH token = owner
+    blanket_transfer_attrs: tuple = ()  # any store/mutator on these = owner
+    owner_methods: tuple = ()        # primitive impls themselves: exempt
+    owner_classes: tuple = ()        # whole classes exempt (the provider)
+    strict: bool = True              # double-resolve applies (clamp-and-heal
+    #                                  protocols declare strict=False)
+    journal: tuple = ()              # (begin event, (end events…)) — chaos
+    #                                  harness balance check (JOURNAL_BALANCE)
+
+
+KV_PAGES = Protocol(
+    pid="kv-pages", what="kv page block",
+    acquires=(
+        AcqSpec("_pages_alloc", "not_none", token="ret", self_only=True,
+                carry_arg0=True),
+        AcqSpec("_pages_claim", "not_none", token="ret", self_only=True),
+        AcqSpec("_pages_addref", "always", token="arg0", self_only=True),
+    ),
+    resolves=("_pages_release",),
+    blanket_resolves=("_pages_free",),
+    transfer_attrs=("_slot_pages", "h_ptable", "_prefix_entries",
+                    "_prefix_host"),
+    blanket_transfer_attrs=("slots", "_slot_pages", "h_ptable", "_pending",
+                            "_prefix_entries", "_prefix_host"),
+    owner_methods=("_pages_alloc", "_pages_claim", "_pages_addref",
+                   "_pages_release", "_pages_free"),
+    strict=True,
+)
+
+BREAKER_PROBE = Protocol(
+    pid="breaker-probe", what="circuit-breaker half-open probe slot",
+    acquires=(
+        AcqSpec("guard", "truthy", token="ret"),
+        AcqSpec("admit", "eq", eq_value="probe", token="ret"),
+    ),
+    # record_success / record_failure / release_probe resolve whatever probe
+    # is in flight — and are ordinary accounting when none is (clamp-and-
+    # heal by design), hence blanket + strict=False.
+    blanket_resolves=("record_success", "record_failure", "release_probe"),
+    owner_classes=("CircuitBreaker",),
+    strict=False,
+    journal=("breaker_probe", ("breaker_close", "breaker_open")),
+)
+
+SCHED_INFLIGHT = Protocol(
+    pid="sched-inflight", what="scheduler inflight reservation",
+    acquires=(
+        AcqSpec("pick", "not_none", token="ret",
+                kwarg_gate=("reserve", True)),
+        AcqSpec("begin_stream", "always", token="arg0"),
+    ),
+    resolves=("end_stream",),
+    owner_classes=("ClusterScheduler",),
+    strict=True,
+)
+
+ADAPTER_PIN = Protocol(
+    pid="adapter-pin", what="adapter weight pin",
+    acquires=(
+        AcqSpec("_adapter_acquire", "truthy", token="ret", self_only=True),
+    ),
+    resolves=("_adapter_unpin",),
+    transfer_attrs=("h_adapter",),
+    owner_methods=("_adapter_acquire", "_adapter_unpin"),
+    strict=True,
+)
+
+LOCK_MANUAL = Protocol(
+    pid="lock-manual", what="manually-paired lock",
+    acquires=(
+        # Only receivers named like locks: `self._lock.acquire()`. Lease
+        # accounting that happens to use acquire/release names (e.g.
+        # server/manager.py LoadedModel) is a different protocol and is
+        # deliberately not matched.
+        AcqSpec("acquire", "always", token="recv", recv_hint="lock"),
+    ),
+    resolves=("release",),
+    strict=True,
+)
+
+NET_HANDLE = Protocol(
+    pid="net-handle", what="network stream handle",
+    acquires=(AcqSpec("urlopen", "always", token="ret"),),
+    resolves=("close",),
+    strict=False,  # close() is idempotent
+)
+
+PROTOCOLS: tuple = (KV_PAGES, BREAKER_PROBE, SCHED_INFLIGHT, ADAPTER_PIN,
+                    LOCK_MANUAL, NET_HANDLE)
+
+# Chaos-harness contract (ISSUE 20 satellite): protocols whose lifecycle is
+# journaled must show balance in the event stream after every scenario —
+# each begin event eventually followed by one of its end events. Runtime
+# evidence for the same declarations the static passes verify.
+JOURNAL_BALANCE = {
+    p.pid: p.journal for p in PROTOCOLS if p.journal
+}
+
+
+# ---------------------------------------------------------------------------
+# Acquisition discovery
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Acquisition:
+    spec: AcqSpec
+    protocol: Protocol
+    stmt: ast.AST            # the statement anchoring the acquire
+    call: ast.Call
+    line: int
+    token: Optional[str]     # primary handle name (None = anonymous)
+    in_test: bool = False    # call sits in an if/while test
+    test_polarity: Optional[bool] = None  # held on the True (or False) edge
+
+
+def _call_parts(call: ast.Call) -> tuple[str, str]:
+    """(last name, dotted receiver) of a call."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr, astutil.dotted_name(f.value)
+    if isinstance(f, ast.Name):
+        return f.id, ""
+    return "", ""
+
+
+def _spec_matches(call: ast.Call, spec: AcqSpec, me: Optional[str]) -> bool:
+    name, recv = _call_parts(call)
+    if name != spec.call:
+        return False
+    if spec.self_only and (me is None or recv != me):
+        return False
+    if spec.recv_hint and spec.recv_hint not in recv.split(".")[-1].lower():
+        return False
+    if spec.kwarg_gate:
+        k, v = spec.kwarg_gate
+        for kw in call.keywords:
+            if (kw.arg == k and isinstance(kw.value, ast.Constant)
+                    and kw.value.value == v):
+                break
+        else:
+            return False
+    return True
+
+
+def _stmt_iter(fn) -> list[ast.AST]:
+    """Every statement in the function body, nested defs not descended."""
+    out: list[ast.AST] = []
+    stack = list(fn.body)
+    while stack:
+        s = stack.pop()
+        out.append(s)
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for field in ("body", "orelse", "finalbody"):
+            stack.extend(getattr(s, field, None) or [])
+        for h in getattr(s, "handlers", None) or []:
+            stack.extend(h.body)
+        for c in getattr(s, "cases", None) or []:
+            stack.extend(c.body)
+    return out
+
+
+def find_acquisitions(fn, me: Optional[str],
+                      protocols) -> list[Acquisition]:
+    out: list[Acquisition] = []
+    with_managed: set[int] = set()
+    for s in _stmt_iter(fn):
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                for sub in ast.walk(item.context_expr):
+                    if isinstance(sub, ast.Call):
+                        # `with urlopen(...) as resp:` — __exit__ resolves
+                        # on every unwind; never a leak.
+                        with_managed.add(id(sub))
+    for s in _stmt_iter(fn):
+        for proto in protocols:
+            for spec in proto.acquires:
+                acq = _match_acquire(s, spec, proto, me, with_managed)
+                if acq is not None:
+                    out.append(acq)
+    return out
+
+
+def _match_acquire(s: ast.AST, spec: AcqSpec, proto: Protocol,
+                   me: Optional[str],
+                   with_managed: set[int]) -> Optional[Acquisition]:
+    def token_for(call: ast.Call, assigned: Optional[str]) -> Optional[str]:
+        if spec.token == "ret":
+            return assigned
+        if spec.token == "arg0":
+            if call.args and isinstance(call.args[0], ast.Name):
+                return call.args[0].id
+            return None
+        if spec.token == "recv":
+            return _call_parts(call)[1] or None
+        return None
+
+    if isinstance(s, ast.Assign) and isinstance(s.value, ast.Call):
+        call = s.value
+        if id(call) not in with_managed and _spec_matches(call, spec, me):
+            assigned = (s.targets[0].id
+                        if len(s.targets) == 1
+                        and isinstance(s.targets[0], ast.Name) else None)
+            return Acquisition(spec, proto, s, call, s.lineno,
+                               token_for(call, assigned))
+    elif isinstance(s, ast.Expr) and isinstance(s.value, ast.Call):
+        call = s.value
+        if id(call) not in with_managed and _spec_matches(call, spec, me):
+            return Acquisition(spec, proto, s, call, s.lineno,
+                               token_for(call, None))
+    elif isinstance(s, (ast.If, ast.While)):
+        # `if self._pages_claim(n) is None:` / `if breaker.allow():` — the
+        # branch itself is the acquire; heldness is an edge polarity.
+        got = _test_acquire(s.test, spec, me, with_managed)
+        if got is not None:
+            call, polarity = got
+            return Acquisition(spec, proto, s, call, s.lineno, None,
+                               in_test=True, test_polarity=polarity)
+    elif isinstance(s, ast.Return) and s.value is not None:
+        # `return self._pages_claim(n)` — ownership transfers to the caller
+        # in the same statement; nothing to track.
+        return None
+    return None
+
+
+def _test_acquire(test: ast.expr, spec: AcqSpec, me,
+                  with_managed) -> Optional[tuple[ast.Call, bool]]:
+    """(call, polarity): resource held on the `polarity` edge of the test."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        got = _test_acquire(test.operand, spec, me, with_managed)
+        if got:
+            return got[0], not got[1]
+        return None
+    if isinstance(test, ast.Call):
+        if id(test) not in with_managed and _spec_matches(test, spec, me) \
+                and spec.mode in ("truthy", "not_none", "always"):
+            return test, True
+        return None
+    if (isinstance(test, ast.Compare) and len(test.ops) == 1
+            and isinstance(test.left, ast.Call)
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None
+            and spec.mode == "not_none"):
+        call = test.left
+        if id(call) in with_managed or not _spec_matches(call, spec, me):
+            return None
+        if isinstance(test.ops[0], (ast.Is, ast.Eq)):
+            return call, False   # `claim() is None` true ⇒ NOT held
+        if isinstance(test.ops[0], (ast.IsNot, ast.NotEq)):
+            return call, True
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Facts: atoms over simple comparisons, with an implication oracle
+# ---------------------------------------------------------------------------
+
+# Atom forms (name is always a plain local):
+#   ("truthy", name)        bool(name)
+#   ("isnone", name)        name is None
+#   ("eq", name, const)     name == const (const not None)
+#   ("in", name, consts)    name in (c1, c2, …)
+#   ("opaque", text)        whole-test fallback (call-free tests only)
+
+
+def _parse_atom(test: ast.expr):
+    """(atom, invert) with test-truth == atom-truth XOR invert, or None."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        got = _parse_atom(test.operand)
+        return (got[0], not got[1]) if got else None
+    if isinstance(test, ast.Name):
+        return ("truthy", test.id), False
+    if (isinstance(test, ast.Compare) and len(test.ops) == 1
+            and isinstance(test.left, ast.Name)):
+        name, op, right = test.left.id, test.ops[0], test.comparators[0]
+        if isinstance(right, ast.Constant):
+            if right.value is None:
+                if isinstance(op, (ast.Is, ast.Eq)):
+                    return ("isnone", name), False
+                if isinstance(op, (ast.IsNot, ast.NotEq)):
+                    return ("isnone", name), True
+                return None
+            if isinstance(op, (ast.Eq, ast.Is)):
+                return ("eq", name, right.value), False
+            if isinstance(op, (ast.NotEq, ast.IsNot)):
+                return ("eq", name, right.value), True
+            return None
+        if isinstance(right, (ast.Tuple, ast.List, ast.Set)) and isinstance(
+                op, (ast.In, ast.NotIn)):
+            vals = tuple(e.value for e in right.elts
+                         if isinstance(e, ast.Constant))
+            if len(vals) == len(right.elts):
+                return ("in", name, vals), isinstance(op, ast.NotIn)
+    if not any(isinstance(sub, ast.Call) for sub in ast.walk(test)):
+        try:
+            return ("opaque", ast.unparse(test)), False
+        except Exception:
+            return None
+    return None
+
+
+def _atom_names(atom) -> tuple[str, ...]:
+    if atom[0] == "opaque":
+        return ()
+    return (atom[1],)
+
+
+def _eval_atom(atom, facts: dict) -> Optional[bool]:
+    """Truth of `atom` under `facts` (atom -> bool), via implications."""
+    if atom in facts:
+        return facts[atom]
+    kind = atom[0]
+    if kind == "opaque":
+        return None
+    name = atom[1]
+    for known, val in facts.items():
+        if known[0] == "opaque" or known[1] != name:
+            continue
+        k = known[0]
+        if kind == "eq":
+            c = atom[2]
+            if k == "eq" and val and known[2] != c:
+                return False
+            if k == "isnone" and val:
+                return False
+            if k == "in" and not val and c in known[2]:
+                return False
+            if k == "in" and val and c not in known[2]:
+                return False
+            if k == "truthy" and not val and bool(c):
+                return False
+        elif kind == "in":
+            S = atom[2]
+            if k == "eq" and val:
+                return known[2] in S
+            if k == "isnone" and val:
+                return None in S
+        elif kind == "isnone":
+            if k == "eq" and val and known[2] is not None:
+                return False
+            if k == "truthy" and val:
+                return False
+        elif kind == "truthy":
+            if k == "isnone" and val:
+                return False
+            if k == "eq" and val:
+                return bool(known[2])
+    return None
+
+
+class _TokenInfo:
+    """Which local names carry the acquisition handle, under which
+    semantics. `held_false(facts)` answers: do the facts PROVE the handle
+    was never acquired / already dropped on this path?"""
+
+    def __init__(self, mode: str, eq_value=None):
+        self.mode = mode
+        self.eq_value = eq_value
+        self.truthy: set[str] = set()    # truthiness == heldness
+        self.eq: set[str] = set()        # == eq_value means held
+        self.none: set[str] = set()      # is None means NOT held
+        self.carries: set[str] = set()   # container copies: carry the
+        #                                  handle for transfer/resolve
+        #                                  matching, no heldness semantics
+
+    def all_names(self) -> set[str]:
+        return self.truthy | self.eq | self.none | self.carries
+
+    def held_false(self, facts: dict) -> bool:
+        for n in self.truthy:
+            if _eval_atom(("truthy", n), facts) is False:
+                return True
+        for n in self.none:
+            if _eval_atom(("isnone", n), facts) is True:
+                return True
+        for n in self.eq:
+            if _eval_atom(("eq", n, self.eq_value), facts) is False:
+                return True
+        return False
+
+
+def token_info_for(fn, acq: Acquisition) -> _TokenInfo:
+    """Flow-insensitive alias closure: `held = admission == "probe"` makes
+    `held` a truthy-alias of an eq-mode token; `x = tok` copies class."""
+    ti = _TokenInfo(acq.spec.mode, acq.spec.eq_value)
+    tok = acq.token
+    if tok is None:
+        return ti
+    if acq.spec.mode == "truthy":
+        ti.truthy.add(tok)
+    elif acq.spec.mode == "eq":
+        ti.eq.add(tok)
+    elif acq.spec.mode == "not_none":
+        ti.none.add(tok)
+        ti.truthy.add(tok)  # `if row:` on a page list refines too
+    else:
+        ti.truthy.add(tok)  # "always": truthiness tests are vacuous but
+        #                      a `tok = False` kill is still a drop signal
+    if (acq.spec.carry_arg0 and acq.call is not None and acq.call.args
+            and isinstance(acq.call.args[0], ast.Name)):
+        ti.carries.add(acq.call.args[0].id)
+    changed = True
+    while changed:
+        changed = False
+        for s in _stmt_iter(fn):
+            if not (isinstance(s, ast.Assign) and len(s.targets) == 1
+                    and isinstance(s.targets[0], ast.Name)):
+                continue
+            t = s.targets[0].id
+            v = s.value
+            if isinstance(v, ast.Name) and v.id in ti.all_names():
+                for group in (ti.truthy, ti.eq, ti.none):
+                    if v.id in group and t not in group:
+                        group.add(t)
+                        changed = True
+            elif (acq.spec.mode == "eq" and isinstance(v, ast.Compare)
+                  and len(v.ops) == 1 and isinstance(v.ops[0], ast.Eq)
+                  and isinstance(v.left, ast.Name) and v.left.id in ti.eq
+                  and isinstance(v.comparators[0], ast.Constant)
+                  and v.comparators[0].value == acq.spec.eq_value
+                  and t not in ti.truthy):
+                ti.truthy.add(t)
+                changed = True
+            elif (_is_container_copy(v) and t not in ti.carries
+                  and any(isinstance(x, ast.Name) and x.id in ti.all_names()
+                          for x in ast.walk(v))):
+                # entry = {"pages": list(pages)} / pair = (dst, row): the
+                # container carries the handle — storing IT somewhere is
+                # storing the handle.
+                ti.carries.add(t)
+                changed = True
+    return ti
+
+
+def _is_container_copy(v: ast.expr) -> bool:
+    if isinstance(v, (ast.Dict, ast.List, ast.Tuple, ast.Set)):
+        return True
+    return (isinstance(v, ast.Call) and isinstance(v.func, ast.Name)
+            and v.func.id in ("list", "tuple", "set", "sorted", "frozenset",
+                              "dict"))
+
+
+# ---------------------------------------------------------------------------
+# Per-node classification
+# ---------------------------------------------------------------------------
+
+
+def _local_exprs(node) -> list:
+    """The code a CFG node itself executes (compound bodies excluded)."""
+    s = node.stmt
+    if s is None:
+        return []
+    if node.kind == "branch":
+        if isinstance(s, (ast.If, ast.While)):
+            return [s.test]
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            return [s.iter]
+        if isinstance(s, ast.Match):
+            return [s.subject]
+        return []
+    if isinstance(s, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in s.items]
+    if isinstance(s, ast.ExceptHandler):
+        return []
+    return [s]
+
+
+def _assigned_names(node) -> set[str]:
+    s = node.stmt
+    out: set[str] = set()
+    if s is None:
+        return out
+    if node.kind == "branch" and isinstance(s, (ast.For, ast.AsyncFor)):
+        for sub in ast.walk(s.target):
+            if isinstance(sub, ast.Name):
+                out.add(sub.id)
+        return out
+    if node.kind == "branch":
+        return out
+    if isinstance(s, ast.ExceptHandler):
+        if s.name:
+            out.add(s.name)
+        return out
+    if isinstance(s, (ast.With, ast.AsyncWith)):
+        for i in s.items:
+            if i.optional_vars is not None:
+                for sub in ast.walk(i.optional_vars):
+                    if isinstance(sub, ast.Name):
+                        out.add(sub.id)
+        return out
+    if isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = s.targets if isinstance(s, ast.Assign) else [s.target]
+        for t in targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+    return out
+
+
+# Mutators through which a handle can escape into a container the caller
+# (or a later loop in the same function) owns and drains.
+_CONTAINER_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "update",
+    "setdefault", "put",
+})
+
+
+class _Classifier:
+    """Protocol-specific meaning of one CFG node: resolve / transfer /
+    token kill. Built once per (acquisition, function)."""
+
+    def __init__(self, proto: Protocol, spec: AcqSpec, ti: _TokenInfo,
+                 me: Optional[str], extra_blanket_resolves: tuple = (),
+                 acq_call: Optional[ast.Call] = None):
+        self.proto = proto
+        self.spec = spec
+        self.ti = ti
+        self.me = me
+        self.extra_blanket = frozenset(extra_blanket_resolves)
+        self.acq_call = acq_call
+
+    def resolve_at(self, node) -> Optional[tuple[str, int]]:
+        """("resolve"|"blanket", line) when this node resolves the
+        acquisition."""
+        names = self.ti.all_names()
+        for expr in _local_exprs(node):
+            for sub in ast.walk(expr):
+                if not isinstance(sub, ast.Call) or sub is self.acq_call:
+                    continue
+                cname, recv = _call_parts(sub)
+                if cname in self.proto.blanket_resolves or \
+                        cname in self.extra_blanket:
+                    return ("blanket", sub.lineno)
+                if cname in self.proto.resolves:
+                    if not names:
+                        return ("blanket", sub.lineno)
+                    if recv in names:
+                        return ("resolve", sub.lineno)
+                    for a in sub.args:
+                        if isinstance(a, ast.Name) and a.id in names:
+                            return ("resolve", sub.lineno)
+        return None
+
+    def transfers_at(self, node) -> bool:
+        names = self.ti.all_names()
+        s = node.stmt
+        if (node.kind == "branch" and isinstance(s, (ast.For, ast.AsyncFor))
+                and names
+                and any(isinstance(x, ast.Name) and x.id in names
+                        for x in ast.walk(s.iter))
+                and self._distributes(s)):
+            # Distributing loop (`for p, c in zip(fresh, cols): pages[c]=p`):
+            # installing each element transfers the whole collection. Safe
+            # to anchor at the loop head — a zero-iteration run means the
+            # collection is empty, so there is nothing to leak.
+            return True
+        for expr in _local_exprs(node):
+            for sub in ast.walk(expr):
+                if isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom)):
+                    v = getattr(sub, "value", None)
+                    if v is not None and names and any(
+                            isinstance(x, ast.Name) and x.id in names
+                            for x in ast.walk(v)):
+                        return True
+                if isinstance(sub, ast.Call):
+                    f = sub.func
+                    if (isinstance(f, ast.Attribute)
+                            and f.attr in _CONTAINER_MUTATORS):
+                        attr = self._owned_attr(f.value)
+                        token_in_args = names and any(
+                            isinstance(a, ast.Name) and a.id in names
+                            for x in sub.args for a in ast.walk(x))
+                        if attr is not None:
+                            if attr in self.proto.blanket_transfer_attrs:
+                                return True
+                            if attr in self.proto.transfer_attrs and \
+                                    token_in_args:
+                                return True
+                        elif (isinstance(f.value, ast.Name)
+                              and f.value.id != self.me and token_in_args):
+                            # Handle stashed into a LOCAL container
+                            # (`forked.append((dst, row))`): ownership
+                            # escapes to whoever drains the list — the
+                            # cleanup loop there is that path's resolve.
+                            return True
+        if isinstance(s, ast.Assign) and node.kind != "branch":
+            for t in s.targets:
+                attr = self._store_attr(t)
+                if attr is None:
+                    if (isinstance(t, ast.Subscript)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id != self.me and names and any(
+                                isinstance(x, ast.Name) and x.id in names
+                                for x in ast.walk(s.value))):
+                        return True  # local[i] = token: same local escape
+                    continue
+                if attr in self.proto.blanket_transfer_attrs:
+                    return True
+                if attr in self.proto.transfer_attrs and names and any(
+                        isinstance(x, ast.Name) and x.id in names
+                        for x in ast.walk(s.value)):
+                    return True
+        return False
+
+    def _distributes(self, loop) -> bool:
+        """Does the loop body install a loop-target element into a
+        subscript store (local table alias or tracked self attribute)?"""
+        targets = {x.id for x in ast.walk(loop.target)
+                   if isinstance(x, ast.Name)}
+        tracked = (set(self.proto.transfer_attrs)
+                   | set(self.proto.blanket_transfer_attrs))
+        for stmt in loop.body:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                if not any(isinstance(x, ast.Name) and x.id in targets
+                           for x in ast.walk(sub.value)):
+                    continue
+                for t in sub.targets:
+                    base = t
+                    while isinstance(base, ast.Subscript):
+                        base = base.value
+                    if base is t:
+                        continue  # not a subscript store
+                    if isinstance(base, ast.Name) and base.id != self.me:
+                        return True
+                    if (isinstance(base, ast.Attribute)
+                            and isinstance(base.value, ast.Name)
+                            and (self.me is None or base.value.id == self.me)
+                            and base.attr in tracked):
+                        return True
+        return False
+
+    def _owned_attr(self, recv) -> Optional[str]:
+        """attr name when `recv` is `self.<attr>` or `self.<attr>[i]`."""
+        if isinstance(recv, ast.Subscript):
+            recv = recv.value
+        if (isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and (self.me is None or recv.value.id == self.me)):
+            return recv.attr
+        return None
+
+    def _store_attr(self, target) -> Optional[str]:
+        if isinstance(target, ast.Subscript):
+            target = target.value
+        if (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and (self.me is None or target.value.id == self.me)):
+            return target.attr
+        return None
+
+    def kills_token(self, node) -> bool:
+        """Non-constant reassignment of a token name (re-acquire, re-guard,
+        handle replaced): this acquisition stops being trackable — prune.
+        Constant assigns become facts instead; alias definitions are not
+        kills."""
+        s = node.stmt
+        if not (node.kind == "stmt" and isinstance(s, ast.Assign)
+                and len(s.targets) == 1
+                and isinstance(s.targets[0], ast.Name)):
+            return False
+        t = s.targets[0].id
+        if t not in self.ti.all_names():
+            return False
+        v = s.value
+        if isinstance(v, ast.Constant):
+            return False
+        if isinstance(v, ast.Name) and v.id in self.ti.all_names():
+            return False  # alias copy
+        if isinstance(v, ast.Compare):
+            return False  # alias definition (held = admission == "probe")
+        return True
+
+
+# ---------------------------------------------------------------------------
+# The flow analysis
+# ---------------------------------------------------------------------------
+
+_EXC_EDGES = ("except", "raise")
+_ANNOTATED = ("except", "raise", "return", "break", "continue", "finally")
+_MAX_STATES = 60000
+_MAX_FACTS = 12
+
+
+@dataclasses.dataclass
+class FlowIssue:
+    kind: str          # "leak" | "double"
+    line: int          # acquisition line
+    exit_line: int     # line of the exit / second resolve
+    exit_kind: str     # "exit" | "raise-exit" | resolve detail
+    witness: list
+    first_resolve: int = 0
+
+
+class FlowAnalysis:
+    """Forward exploration of one acquisition over the CFG."""
+
+    def __init__(self, cfg: CFG, path: str, fn, acq: Acquisition,
+                 classifier: _Classifier, mode: str = "leak"):
+        self.cfg = cfg
+        self.path = path
+        self.fn = fn
+        self.acq = acq
+        self.cls = classifier
+        self.mode = mode
+        self.ti = classifier.ti
+        # Branch-consistency tracking is restricted to names that matter:
+        # token/alias names plus names compared in 2+ parseable tests.
+        counts: dict[str, int] = {}
+        for s in _stmt_iter(fn):
+            test = getattr(s, "test", None)
+            if test is None:
+                continue
+            for part in self._conjuncts(test):
+                got = _parse_atom(part)
+                if got:
+                    for n in _atom_names(got[0]):
+                        counts[n] = counts.get(n, 0) + 1
+        self.tracked = {n for n, c in counts.items() if c >= 2}
+        self.tracked |= self.ti.all_names()
+
+    @staticmethod
+    def _conjuncts(test: ast.expr) -> list[ast.expr]:
+        if isinstance(test, ast.BoolOp):
+            out = []
+            for v in test.values:
+                out.extend(FlowAnalysis._conjuncts(v))
+            return out
+        return [test]
+
+    # ---------------- facts ---------------- #
+
+    def _seed_facts(self) -> dict:
+        facts: dict = {}
+        for test, polarity in dominating_tests(self.fn, self.acq.stmt):
+            self._record_test(test, polarity, facts)
+        # Note for opaque/complex dominating tests nothing is recorded —
+        # sound: fewer known facts, more paths explored.
+        return facts
+
+    def _record_test(self, test: ast.expr, value: bool, facts: dict) -> None:
+        if isinstance(test, ast.BoolOp):
+            if isinstance(test.op, ast.And) and value:
+                for v in test.values:
+                    self._record_test(v, True, facts)
+            elif isinstance(test.op, ast.Or) and not value:
+                for v in test.values:
+                    self._record_test(v, False, facts)
+            return
+        got = _parse_atom(test)
+        if got is None:
+            return
+        atom, invert = got
+        names = _atom_names(atom)
+        if atom[0] != "opaque" and not all(n in self.tracked for n in names):
+            return
+        if len(facts) < _MAX_FACTS or atom in facts:
+            facts[atom] = value ^ invert
+
+    def _eval_test(self, test: ast.expr, facts: dict) -> Optional[bool]:
+        if isinstance(test, ast.BoolOp):
+            vals = [self._eval_test(v, facts) for v in test.values]
+            if isinstance(test.op, ast.And):
+                if any(v is False for v in vals):
+                    return False
+                if all(v is True for v in vals):
+                    return True
+                return None
+            if any(v is True for v in vals):
+                return True
+            if all(v is False for v in vals):
+                return False
+            return None
+        got = _parse_atom(test)
+        if got is None:
+            return None
+        atom, invert = got
+        val = _eval_atom(atom, facts)
+        return None if val is None else val ^ invert
+
+    def _branch_facts(self, test: ast.expr, edge_true: bool,
+                      facts: dict) -> Optional[dict]:
+        """Facts after taking the true/false edge; None = edge infeasible."""
+        known = self._eval_test(test, facts)
+        if known is not None and known != edge_true:
+            return None
+        out = dict(facts)
+        if isinstance(test, ast.BoolOp):
+            vals = [(v, self._eval_test(v, facts)) for v in test.values]
+            if isinstance(test.op, ast.And):
+                if edge_true:
+                    for v, _ in vals:
+                        self._record_test(v, True, out)
+                else:
+                    unknown = [v for v, val in vals if val is None]
+                    if len(unknown) == 1:
+                        # the rest are known True: the single unknown
+                        # conjunct is what failed
+                        self._record_test(unknown[0], False, out)
+            else:  # Or
+                if not edge_true:
+                    for v, _ in vals:
+                        self._record_test(v, False, out)
+                else:
+                    unknown = [v for v, val in vals if val is None]
+                    if len(unknown) == 1:
+                        self._record_test(unknown[0], True, out)
+            return out
+        self._record_test(test, edge_true, out)
+        return out
+
+    def _invalidate(self, facts: dict, names: set[str]) -> dict:
+        if not names:
+            return facts
+        out = {a: v for a, v in facts.items()
+               if not (set(_atom_names(a)) & names)
+               and not (a[0] == "opaque" and any(n in a[1] for n in names))}
+        return out
+
+    def _const_assign_facts(self, node, facts: dict) -> dict:
+        s = node.stmt
+        if not (node.kind == "stmt" and isinstance(s, ast.Assign)
+                and len(s.targets) == 1
+                and isinstance(s.targets[0], ast.Name)
+                and isinstance(s.value, ast.Constant)):
+            return facts
+        name = s.targets[0].id
+        if name not in self.tracked:
+            return facts
+        v = s.value.value
+        out = dict(facts)
+        if len(out) >= _MAX_FACTS:
+            return facts
+        out[("truthy", name)] = bool(v)
+        if v is None:
+            out[("isnone", name)] = True
+        elif isinstance(v, (int, str, float, bool)):
+            out[("isnone", name)] = False
+            out[("eq", name, v)] = True
+        return out
+
+    # ---------------- the walk ---------------- #
+
+    def run(self) -> list[FlowIssue]:
+        cfg = self.cfg
+        anchors = cfg.stmt_nodes.get(id(self.acq.stmt), [])
+        if not anchors:
+            return []
+        issues: list[FlowIssue] = []
+        facts0 = self._seed_facts()
+        start = anchors[0]
+        initial: list[tuple] = []
+        if self.acq.in_test:
+            # the acquire is a branch test: held only on the polarity edge
+            want = "true" if self.acq.test_polarity else "false"
+            for dst, kind in cfg.succ[start]:
+                if kind == want:
+                    nf = self._branch_facts(
+                        cfg.nodes[start].test, self.acq.test_polarity, facts0
+                    ) if cfg.nodes[start].test is not None else dict(facts0)
+                    if nf is not None:
+                        initial.append((dst, kind, "maybe", nf))
+        else:
+            # exceptional edges out of the acquire itself: nothing acquired
+            for dst, kind in cfg.succ[start]:
+                if kind not in _EXC_EDGES:
+                    initial.append((dst, kind, "maybe", dict(facts0)))
+        seen: set = set()
+        parent: dict = {}
+        queue: deque = deque()
+        for dst, kind, hs, facts in initial:
+            st = (dst, hs, frozenset(facts.items()))
+            if st not in seen:
+                seen.add(st)
+                parent[st] = (None, kind)
+                queue.append((st, facts))
+        while queue:
+            if len(seen) > _MAX_STATES:
+                return issues  # blown budget: stay silent, never FP
+            (node_idx, hs, _fkey), facts = st_facts = queue.popleft()
+            st = (node_idx, hs, _fkey)
+            node = cfg.nodes[node_idx]
+            if node.kind in ("exit", "raise-exit"):
+                if hs == "maybe" and self.mode == "leak":
+                    issues.append(FlowIssue(
+                        kind="leak", line=self.acq.line,
+                        exit_line=self._witness_line(st, parent),
+                        exit_kind=node.kind,
+                        witness=self._witness(st, parent)))
+                    return issues  # first (shortest) witness is the report
+                continue
+            # --- node effects (normal continuation) --- #
+            resolved_here = None
+            transferred = False
+            killed = False
+            if node.stmt is not None:
+                resolved_here = self.cls.resolve_at(node)
+                transferred = self.cls.transfers_at(node)
+                killed = self.cls.kills_token(node)
+            post_hs = hs
+            skip_normal = False
+            if resolved_here is not None:
+                rkind, rline = resolved_here
+                if hs == "maybe":
+                    if self.mode == "leak":
+                        skip_normal = True  # resolved: this path is done
+                    elif rkind == "resolve" and self.cls.proto.strict:
+                        post_hs = ("resolved", rline)
+                    else:
+                        skip_normal = True
+                else:  # already resolved
+                    if rkind == "resolve":
+                        issues.append(FlowIssue(
+                            kind="double", line=self.acq.line,
+                            exit_line=rline, exit_kind="double-resolve",
+                            witness=self._witness(st, parent),
+                            first_resolve=hs[1]))
+                        return issues
+                    skip_normal = True
+            if transferred or killed:
+                skip_normal = True
+            assigned = _assigned_names(node) if node.stmt is not None else set()
+            for dst, kind in cfg.succ[node_idx]:
+                if kind in _EXC_EDGES:
+                    if resolved_here is not None and hs == "maybe":
+                        # The resolver itself raised: the resolution attempt
+                        # still happened — whatever went wrong inside the
+                        # primitive is the primitive's bug, not this
+                        # caller's leak.
+                        continue
+                    # exception DURING the stmt: effects did not complete
+                    nf = self._invalidate(facts, assigned & self.tracked)
+                    self._push(dst, hs, nf, st, kind, seen, parent, queue)
+                    continue
+                if skip_normal:
+                    continue
+                if node.kind == "branch" and kind in ("true", "false") \
+                        and node.test is not None:
+                    nf = self._branch_facts(node.test, kind == "true", facts)
+                    if nf is None:
+                        continue  # infeasible edge
+                else:
+                    nf = dict(facts)
+                nf = self._invalidate(nf, assigned & self.tracked)
+                nf = self._const_assign_facts(node, nf)
+                new_hs = post_hs
+                if new_hs == "maybe" and self.ti.held_false(nf):
+                    continue  # proven not-held on this path
+                self._push(dst, new_hs, nf, st, kind, seen, parent, queue)
+        return issues
+
+    def _push(self, dst, hs, facts, prev, kind, seen, parent, queue):
+        st = (dst, hs, frozenset(facts.items()))
+        if st in seen:
+            return
+        seen.add(st)
+        parent[st] = (prev, kind)
+        queue.append((st, facts))
+
+    # ---------------- witness ---------------- #
+
+    def _witness(self, st, parent) -> list[str]:
+        chain = []
+        cur = st
+        while cur is not None:
+            prev, kind = parent.get(cur, (None, "next"))
+            chain.append((cur[0], kind))
+            cur = prev
+        chain.reverse()
+        out = [f"{self.path}:{self.acq.line}"]
+        last_line = self.acq.line
+        for node_idx, kind in chain:
+            node = self.cfg.nodes[node_idx]
+            line = node.line or last_line
+            last_line = line
+            if node.kind == "exit":
+                entry = f"{self.path}:{line} (exit)" if kind not in _ANNOTATED \
+                    else f"{self.path}:{line} ({kind})"
+            elif node.kind == "raise-exit":
+                entry = f"{self.path}:{line} ({kind})"
+            elif kind in _ANNOTATED:
+                entry = f"{self.path}:{line} ({kind})"
+            elif node.kind in ("join",):
+                continue
+            else:
+                entry = f"{self.path}:{line}"
+            if not out or out[-1] != entry:
+                out.append(entry)
+        return out
+
+    def _witness_line(self, st, parent) -> int:
+        prev, _ = parent.get(st, (None, "next"))
+        while prev is not None:
+            node = self.cfg.nodes[prev[0]]
+            if node.line:
+                return node.line
+            prev = parent.get(prev, (None, "next"))[0]
+        return self.acq.line
+
+
+# ---------------------------------------------------------------------------
+# Repo-level helpers for the passes
+# ---------------------------------------------------------------------------
+
+
+def releasing_methods(methods: dict) -> set[str]:
+    """Class methods that transitively reach a kv release primitive
+    (`_pages_release`/`_pages_free`) through intra-class calls — calling
+    one is a blanket resolve for kv-pages acquisitions (the engine's
+    `_resume_discard` teardown shape)."""
+    out = set()
+    for m, fn in methods.items():
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and _call_parts(node)[0] in (
+                    "_pages_release", "_pages_free"):
+                out.add(m)
+                break
+    changed = True
+    while changed:
+        changed = False
+        for m, fn in methods.items():
+            if m in out:
+                continue
+            if astutil.self_calls(fn) & out:
+                out.add(m)
+                changed = True
+    return out
+
+
+def cfg_for(repo, index: SummaryIndex, fd: FuncDef) -> CFG:
+    """Exception-edge CFG for one function, cached on the Repo. Raise edges
+    for out-of-try calls come from the interprocedural may-raise fixpoint
+    (plus KNOWN_RAISERS); a --since run rebuilds only the changed files'
+    CFGs while the fixpoint stays full."""
+    cache = getattr(repo, "_cfgs", None)
+    if cache is None:
+        cache = repo._cfgs = {}
+    key = (id(fd.node), id(index))
+    if key in cache:
+        return cache[key]
+    may = index.may_raise()
+    ltypes = index.graph.local_types(fd.path, fd.node)
+
+    def call_may_raise(call: ast.Call) -> bool:
+        if astutil.dotted_name(call.func).split(".")[-1] in KNOWN_RAISERS:
+            return True
+        cands = index.graph.resolve(fd, call, local_types=ltypes)
+        return any(may.get(c) for c in cands)
+
+    cache[key] = build_cfg(fd.node, call_may_raise)
+    return cache[key]
+
+
+def analyze_protocol(repo, index: SummaryIndex, fd: FuncDef,
+                     protocols, mode: str = "leak",
+                     extra_blanket_resolves: tuple = ()) -> list[FlowIssue]:
+    """All lifecycle issues for one function under the given protocols."""
+    me = astutil.self_name(fd.node) if fd.cls else None
+    acquisitions = find_acquisitions(fd.node, me, protocols)
+    if not acquisitions:
+        return []
+    cfg = cfg_for(repo, index, fd)
+    out: list[FlowIssue] = []
+    for acq in acquisitions:
+        if fd.cls and fd.cls in acq.protocol.owner_classes:
+            continue
+        if fd.name in acq.protocol.owner_methods:
+            continue
+        ti = token_info_for(fd.node, acq)
+        classifier = _Classifier(acq.protocol, acq.spec, ti, me,
+                                 extra_blanket_resolves, acq.call)
+        issues = FlowAnalysis(cfg, fd.path, fd.node, acq, classifier,
+                              mode=mode).run()
+        for iss in issues:
+            iss.protocol = acq.protocol  # type: ignore[attr-defined]
+        out.extend(issues)
+    return out

@@ -104,6 +104,81 @@ _CONTAINER_CTORS = frozenset({
     "list", "dict", "set", "deque", "defaultdict", "OrderedDict", "Counter",
 })
 
+# Call names that raise without any `raise` statement visible to this
+# analysis — the seeds of the may-raise fixpoint besides explicit raises
+# (ISSUE 20). Deliberately minimal: urlopen is the repo's entire network
+# surface (URLError/HTTPError on every transfer), and that is the exception
+# class the resource passes exist for. `faults.fire` raises too, but only
+# under injected chaos — treating it as a raiser would put exception edges
+# on every hot-path statement; the chaos harness's journal-balance check
+# covers fault-path leaks at runtime instead.
+KNOWN_RAISERS = frozenset({"urlopen"})
+
+
+def _is_assert_raise(node: ast.Raise) -> bool:
+    """`raise AssertionError(...)` — the allocator's clamp-and-heal debug
+    raises (gated on LOCALAI_ALLOC_DEBUG). Programmer-error crashes, not
+    exit paths resource protocols must survive; excluded from seeds, the
+    same way `assert` statements get no exception edge in the CFG."""
+    exc = node.exc
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    return isinstance(exc, ast.Name) and exc.id == "AssertionError"
+
+
+def _handlers_catch_all(handlers: list) -> bool:
+    for h in handlers:
+        if h.type is None:
+            return True
+        elts = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+        for e in elts:
+            name = e.id if isinstance(e, ast.Name) else getattr(e, "attr", "")
+            if name in ("Exception", "BaseException"):
+                return True
+    return False
+
+
+def escape_info(fn) -> tuple[bool, frozenset[int]]:
+    """(raises directly, lines of calls whose exceptions ESCAPE fn) — both
+    ignoring anything sitting under an except-all barrier (`except:` /
+    `except Exception` / `except BaseException`), which is how a handler
+    cuts may-raise propagation. A bare `raise` inside a handler counts as a
+    seed when the handler itself is not barriered: re-raising IS escaping.
+    """
+    seed = False
+    lines: set[int] = set()
+
+    def walk(node: ast.AST, barriered: bool) -> None:
+        nonlocal seed
+        if isinstance(node, ast.Try):
+            inner = barriered or _handlers_catch_all(node.handlers)
+            for ch in node.body:
+                walk(ch, inner)
+            # Handler and else bodies are NOT protected by this try's own
+            # handlers; finally runs on the way out either way.
+            for h in node.handlers:
+                for ch in h.body:
+                    walk(ch, barriered)
+            for ch in node.orelse + node.finalbody:
+                walk(ch, barriered)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            return
+        if isinstance(node, ast.Raise) and not barriered:
+            if not _is_assert_raise(node):
+                seed = True
+        if isinstance(node, ast.Call) and not barriered:
+            lines.add(node.lineno)
+            if astutil.dotted_name(node.func).split(".")[-1] in KNOWN_RAISERS:
+                seed = True
+        for ch in ast.iter_child_nodes(node):
+            walk(ch, barriered)
+
+    for stmt in fn.body:
+        walk(stmt, False)
+    return seed, frozenset(lines)
+
 
 def module_mutables(tree: ast.Module) -> set[str]:
     """Module-level names bound to mutable containers — the module-global
@@ -234,6 +309,7 @@ class SummaryIndex:
         for fid, fd in list(graph.funcs.items()):
             self.summaries[fid] = self._summarize(fd)
         self._may_acquire: Optional[dict[str, set[str]]] = None
+        self._may_raise: Optional[dict[str, bool]] = None
 
     # ---------------- per-function walk ---------------- #
 
@@ -589,6 +665,44 @@ class SummaryIndex:
                             changed = True
         self._may_acquire = acq
         return acq
+
+    def may_raise(self) -> dict[str, bool]:
+        """fid -> may an exception ESCAPE a call to this function. Seeded
+        by explicit non-assert `raise` statements and KNOWN_RAISERS calls,
+        propagated up the call graph like may_acquire — but an except-all
+        barrier around a call site cuts the edge: `try: x() except
+        Exception: ...` absorbs whatever x may raise (ISSUE 20). The
+        exception-edge CFG consumes this to decide which out-of-try calls
+        get a raise edge."""
+        if self._may_raise is not None:
+            return self._may_raise
+        seeds: dict[str, bool] = {}
+        escaping: dict[str, Optional[frozenset[int]]] = {}
+        for fid, s in self.summaries.items():
+            fd = self.graph.funcs.get(fid)
+            if fd is not None:
+                seeds[fid], escaping[fid] = escape_info(fd.node)
+            else:
+                # Nested defs: no barrier map — treat every call line as
+                # escaping (conservative) and no direct seed.
+                seeds[fid], escaping[fid] = False, None
+        out = dict(seeds)
+        changed = True
+        while changed:
+            changed = False
+            for fid, s in self.summaries.items():
+                if out[fid]:
+                    continue
+                esc = escaping[fid]
+                for site in s.calls:
+                    if esc is not None and site.line not in esc:
+                        continue
+                    if any(out.get(c) for c in site.callees):
+                        out[fid] = True
+                        changed = True
+                        break
+        self._may_raise = out
+        return out
 
 
 def summaries_for(repo: Repo, globs: tuple[str, ...]) -> SummaryIndex:
